@@ -1,0 +1,17 @@
+"""Test harness config: run all tests on an 8-virtual-device CPU mesh.
+
+Multi-chip TPU hardware isn't available in CI, so every test runs against
+8 fake CPU devices (SURVEY.md §4's recommended strategy): sharding, psum
+collectives, and pjit compilation are exercised for real, just on host
+devices. Must run before the first ``import jax`` anywhere in the test
+process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,61 @@
+"""Unit tests for the Gilbert-equation physical baseline (SURVEY.md C16)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuflow.core import (
+    ACHONG,
+    BAXENDELL,
+    GILBERT,
+    ROS,
+    gilbert_flow,
+    gilbert_wellhead_pressure,
+)
+
+
+def test_gilbert_roundtrip():
+    """flow -> pressure -> flow is the identity."""
+    q = jnp.array([100.0, 500.0, 1200.0])
+    s = jnp.array([24.0, 32.0, 48.0])
+    glr = jnp.array([0.5, 1.2, 2.0])
+    pwh = gilbert_wellhead_pressure(q, s, glr)
+    q_back = gilbert_flow(pwh, s, glr)
+    np.testing.assert_allclose(np.asarray(q_back), np.asarray(q), rtol=1e-5)
+
+
+def test_gilbert_golden_value():
+    """Hand-computed: q = P * S^1.89 / (10 * GLR^0.546)."""
+    pwh, s, glr = 200.0, 32.0, 1.0
+    expected = 200.0 * 32.0**1.89 / (10.0 * 1.0**0.546)
+    got = float(gilbert_flow(jnp.float32(pwh), jnp.float32(s), jnp.float32(glr)))
+    assert got == pytest.approx(expected, rel=1e-5)
+
+
+def test_monotonicity():
+    """Physically sensible: flow grows with pressure and choke, falls with GLR."""
+    base = float(gilbert_flow(200.0, 32.0, 1.0))
+    assert float(gilbert_flow(250.0, 32.0, 1.0)) > base
+    assert float(gilbert_flow(200.0, 40.0, 1.0)) > base
+    assert float(gilbert_flow(200.0, 32.0, 2.0)) < base
+
+
+@pytest.mark.parametrize("coeffs", [GILBERT, ROS, BAXENDELL, ACHONG])
+def test_coefficient_family_roundtrip(coeffs):
+    q = jnp.array([300.0])
+    pwh = gilbert_wellhead_pressure(q, 32.0, 1.5, coeffs)
+    q_back = gilbert_flow(pwh, 32.0, 1.5, coeffs)
+    np.testing.assert_allclose(np.asarray(q_back), np.asarray(q), rtol=1e-5)
+
+
+def test_jit_and_grad():
+    """The physical model is a first-class JAX citizen: jittable, differentiable."""
+    f = jax.jit(gilbert_flow)
+    assert float(f(200.0, 32.0, 1.0)) > 0
+    g = jax.grad(lambda p: gilbert_flow(p, 32.0, 1.0))(200.0)
+    assert float(g) > 0  # dq/dP > 0
+
+
+def test_glr_zero_is_safe():
+    assert np.isfinite(float(gilbert_flow(200.0, 32.0, 0.0)))

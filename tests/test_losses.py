@@ -1,0 +1,57 @@
+"""Golden-value tests for losses — especially mae_clip parity with the
+reference's Theano clip semantics (reference cnn.py:29-32, CLIP_VALUE=6)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuflow.core import huber, mae, mae_clip, mse
+
+
+def test_mae_clip_golden():
+    """errors [1, 5, 10, 0] -> clipped [1, 5, 6, 0] -> mean 3.0."""
+    y_true = jnp.array([0.0, 0.0, 0.0, 0.0])
+    y_pred = jnp.array([1.0, -5.0, 10.0, 0.0])
+    assert float(mae_clip(y_true, y_pred)) == pytest.approx(3.0)
+
+
+def test_mae_clip_below_threshold_equals_mae():
+    y_true = jnp.array([1.0, 2.0, 3.0])
+    y_pred = jnp.array([1.5, 1.0, 3.2])
+    assert float(mae_clip(y_true, y_pred)) == pytest.approx(float(mae(y_true, y_pred)))
+
+
+def test_mae_clip_saturates():
+    """All-outlier batch: loss caps at exactly CLIP_VALUE."""
+    y_true = jnp.zeros(8)
+    y_pred = jnp.full(8, 1e6)
+    assert float(mae_clip(y_true, y_pred)) == pytest.approx(6.0)
+
+
+def test_mae_clip_custom_clip():
+    y_true, y_pred = jnp.zeros(2), jnp.array([1.0, 9.0])
+    assert float(mae_clip(y_true, y_pred, clip_value=2.0)) == pytest.approx(1.5)
+
+
+def test_mae_clip_gradient_zero_in_saturated_region():
+    """Outliers beyond the clip contribute zero gradient — the mechanism that
+    makes the loss outlier-resistant."""
+    g = jax.grad(lambda p: mae_clip(jnp.zeros(1), p))(jnp.array([100.0]))
+    assert float(g[0]) == pytest.approx(0.0)
+    g2 = jax.grad(lambda p: mae_clip(jnp.zeros(1), p))(jnp.array([3.0]))
+    assert float(g2[0]) == pytest.approx(1.0)
+
+
+def test_mse_and_huber():
+    y_true = jnp.array([0.0, 0.0])
+    y_pred = jnp.array([1.0, 3.0])
+    assert float(mse(y_true, y_pred)) == pytest.approx(5.0)
+    # huber(delta=1): 0.5*1 for err=1; 0.5 + 1*(3-1) = 2.5 for err=3 -> mean 1.5
+    assert float(huber(y_true, y_pred)) == pytest.approx(1.5)
+
+
+def test_losses_jittable():
+    f = jax.jit(mae_clip)
+    x = jnp.ones(16)
+    np.testing.assert_allclose(float(f(x, x)), 0.0)

@@ -1,0 +1,28 @@
+"""Tests for evaluation metrics."""
+
+import jax.numpy as jnp
+import pytest
+
+from tpuflow.core import mae_vs_baseline, r2_score, rmse
+
+
+def test_rmse():
+    assert float(rmse(jnp.array([0.0, 0.0]), jnp.array([3.0, 4.0]))) == pytest.approx(
+        (12.5) ** 0.5
+    )
+
+
+def test_r2_perfect_and_mean():
+    y = jnp.array([1.0, 2.0, 3.0, 4.0])
+    assert float(r2_score(y, y)) == pytest.approx(1.0)
+    assert float(r2_score(y, jnp.full_like(y, jnp.mean(y)))) == pytest.approx(0.0)
+
+
+def test_mae_vs_baseline_ratio():
+    y = jnp.array([10.0, 20.0])
+    pred = jnp.array([11.0, 21.0])  # MAE 1
+    base = jnp.array([12.0, 22.0])  # MAE 2
+    out = mae_vs_baseline(y, pred, base)
+    assert float(out["mae"]) == pytest.approx(1.0)
+    assert float(out["baseline_mae"]) == pytest.approx(2.0)
+    assert float(out["mae_ratio"]) == pytest.approx(0.5)

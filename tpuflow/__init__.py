@@ -1,0 +1,24 @@
+"""tpuflow — a TPU-native deep-learning framework for well-flow prediction.
+
+A ground-up JAX/XLA re-design of the capability surface of
+OmarZOS/deep-learning-at-scale (see SURVEY.md): a Gilbert's-equation physical
+baseline, a family of learned regressors (static ANN, dynamic windowed ANN,
+1-D CNN, single- and multi-well LSTMs), a dynamic-schema tabular data
+pipeline, and data-parallel training over a TPU device mesh.
+
+Layers (bottom-to-top, mirroring SURVEY.md §1's L0-L6 map, TPU-natively):
+
+- ``tpuflow.parallel``  — device mesh + collectives over ICI/DCN (replaces the
+  reference's Spark/Hadoop cluster runtime, SURVEY §5.8).
+- ``tpuflow.data``      — dynamic-schema ingest + feature ETL (replaces Spark
+  DataFrames / Spark ML pipelines, reference cnn.py:48-107).
+- ``tpuflow.core``      — pure functions: Gilbert equation, losses, metrics.
+- ``tpuflow.models``    — Flax modules (replaces Keras Sequential models).
+- ``tpuflow.train``     — jitted train/eval steps, early stopping, save-best
+  checkpointing (replaces Keras callbacks, reference cnn.py:110-134).
+- ``tpuflow.api``       — ``train(config)`` entrypoint + CLI preserving the
+  reference's dynamic-schema contract (reference cnn.py:2,41-44).
+- ``tpuflow.kernels``   — Pallas TPU kernels for the hot ops.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,13 @@
+"""Pure-function core: physical models, losses, metrics."""
+
+from tpuflow.core.gilbert import (  # noqa: F401
+    ChokeCoefficients,
+    GILBERT,
+    ROS,
+    BAXENDELL,
+    ACHONG,
+    gilbert_flow,
+    gilbert_wellhead_pressure,
+)
+from tpuflow.core.losses import mae_clip, mae, mse, huber  # noqa: F401
+from tpuflow.core.metrics import rmse, r2_score, mae_vs_baseline  # noqa: F401

@@ -1,0 +1,103 @@
+"""Gilbert's-equation physical choke-flow model (pure JAX).
+
+The reference system uses "a physical model (using the Gilbert's equation)"
+as the closed-form accuracy baseline for all learned flow regressors
+(reference Readme.md:7-8; SURVEY.md C16 — the script itself is absent from
+the reference snapshot, so this module implements the documented intent).
+
+Gilbert's (1954) empirical correlation for two-phase flow through a wellhead
+choke relates wellhead pressure, gas-liquid ratio, gross liquid rate, and
+choke size:
+
+    P_wh = A * GLR^B * q / S^C
+
+with Gilbert's original coefficients A=10.0, B=0.546, C=1.89 when
+P_wh is in psig, GLR in Mscf/stb, q in stb/day and S in 64ths of an inch.
+Solved for the liquid rate, the *flow prediction* used as the eval baseline:
+
+    q = P_wh * S^C / (A * GLR^B)
+
+The same functional form with different (A, B, C) gives the classic
+Ros / Baxendell / Achong correlations, exposed here as a coefficient family
+so the physical baseline is configurable per field.
+
+Everything is pure ``jax.numpy`` — differentiable, jittable, vmappable —
+so the physical model composes with learned models (e.g. residual learning
+on top of the Gilbert prediction) and runs on TPU like any other op.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class ChokeCoefficients(NamedTuple):
+    """Coefficients (A, B, C) of the Gilbert-form choke correlation.
+
+    Float-only on purpose: instances are valid pytrees whose leaves are all
+    numeric, so a coefficient set can be passed straight through ``jax.jit``
+    / ``jax.vmap`` boundaries (a name string would fail tracing).
+    """
+
+    a: float
+    b: float
+    c: float
+
+
+# Classic published coefficient sets for P_wh = a * GLR^b * q / S^c.
+GILBERT = ChokeCoefficients(10.0, 0.546, 1.89)
+ROS = ChokeCoefficients(17.4, 0.5, 2.0)
+BAXENDELL = ChokeCoefficients(9.56, 0.546, 1.93)
+ACHONG = ChokeCoefficients(3.82, 0.65, 1.88)
+
+COEFFICIENTS = {
+    "gilbert": GILBERT,
+    "ros": ROS,
+    "baxendell": BAXENDELL,
+    "achong": ACHONG,
+}
+
+_EPS = 1e-6
+
+
+def gilbert_flow(
+    wellhead_pressure: jnp.ndarray,
+    choke_size: jnp.ndarray,
+    glr: jnp.ndarray,
+    coeffs: ChokeCoefficients = GILBERT,
+) -> jnp.ndarray:
+    """Closed-form gross liquid rate q [stb/day] through the choke.
+
+    q = P_wh * S^c / (a * GLR^b)
+
+    Args:
+      wellhead_pressure: P_wh [psig].
+      choke_size: S [64ths of an inch].
+      glr: gas-liquid ratio [Mscf/stb]; clamped away from zero.
+      coeffs: correlation coefficients (Gilbert by default).
+    """
+    glr = jnp.maximum(glr, _EPS)
+    return (
+        wellhead_pressure
+        * jnp.power(choke_size, coeffs.c)
+        / (coeffs.a * jnp.power(glr, coeffs.b))
+    )
+
+
+def gilbert_wellhead_pressure(
+    flow_rate: jnp.ndarray,
+    choke_size: jnp.ndarray,
+    glr: jnp.ndarray,
+    coeffs: ChokeCoefficients = GILBERT,
+) -> jnp.ndarray:
+    """Forward form of the correlation: P_wh = a * GLR^b * q / S^c."""
+    choke_size = jnp.maximum(choke_size, _EPS)
+    glr = jnp.maximum(glr, _EPS)
+    return (
+        coeffs.a
+        * jnp.power(glr, coeffs.b)
+        * flow_rate
+        / jnp.power(choke_size, coeffs.c)
+    )

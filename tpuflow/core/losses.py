@@ -1,0 +1,47 @@
+"""Training losses (pure JAX).
+
+The reference's single custom loss is a clipped mean-absolute-error written
+in Theano tensor ops with CLIP_VALUE = 6 (reference cnn.py:29-32, 37):
+
+    mae_clip(y_true, y_pred) = mean(clip(|y_true - y_pred|, 0, 6))
+
+i.e. an outlier-resistant regression loss whose per-sample contribution
+saturates at 6 flow units. Reproduced here with identical semantics in
+``jax.numpy`` (golden-value tested in tests/test_losses.py), plus the
+standard losses the wider model family needs.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+CLIP_VALUE = 6.0
+
+
+def mae_clip(
+    y_true: jnp.ndarray, y_pred: jnp.ndarray, clip_value: float = CLIP_VALUE
+) -> jnp.ndarray:
+    """Clipped MAE: mean(clip(|y_true - y_pred|, 0, clip_value))."""
+    return jnp.mean(jnp.clip(jnp.abs(y_true - y_pred), 0.0, clip_value))
+
+
+def mae(y_true: jnp.ndarray, y_pred: jnp.ndarray) -> jnp.ndarray:
+    """Mean absolute error."""
+    return jnp.mean(jnp.abs(y_true - y_pred))
+
+
+def mse(y_true: jnp.ndarray, y_pred: jnp.ndarray) -> jnp.ndarray:
+    """Mean squared error."""
+    return jnp.mean(jnp.square(y_true - y_pred))
+
+
+def huber(
+    y_true: jnp.ndarray, y_pred: jnp.ndarray, delta: float = 1.0
+) -> jnp.ndarray:
+    """Huber loss: quadratic within ``delta``, linear outside."""
+    err = jnp.abs(y_true - y_pred)
+    quad = jnp.minimum(err, delta)
+    return jnp.mean(0.5 * quad**2 + delta * (err - quad))
+
+
+LOSSES = {"mae_clip": mae_clip, "mae": mae, "mse": mse, "huber": huber}

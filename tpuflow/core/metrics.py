@@ -1,0 +1,41 @@
+"""Evaluation metrics (pure JAX).
+
+The reference's only quality gate is a printed test-set loss
+(reference cnn.py:132-134); the system-level accuracy yardstick is
+"well-flow MAE vs Gilbert-eq baseline" (BASELINE.json). These helpers make
+both first-class.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rmse(y_true: jnp.ndarray, y_pred: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sqrt(jnp.mean(jnp.square(y_true - y_pred)))
+
+
+def r2_score(y_true: jnp.ndarray, y_pred: jnp.ndarray) -> jnp.ndarray:
+    """Coefficient of determination."""
+    ss_res = jnp.sum(jnp.square(y_true - y_pred))
+    ss_tot = jnp.sum(jnp.square(y_true - jnp.mean(y_true)))
+    return 1.0 - ss_res / jnp.maximum(ss_tot, 1e-12)
+
+
+def mae_vs_baseline(
+    y_true: jnp.ndarray,
+    y_pred: jnp.ndarray,
+    y_baseline: jnp.ndarray,
+) -> dict:
+    """Model MAE next to a physical-baseline MAE (the BASELINE.json metric).
+
+    Returns model MAE, baseline MAE, and their ratio (<1 means the learned
+    model beats the physical model).
+    """
+    model_mae = jnp.mean(jnp.abs(y_true - y_pred))
+    base_mae = jnp.mean(jnp.abs(y_true - y_baseline))
+    return {
+        "mae": model_mae,
+        "baseline_mae": base_mae,
+        "mae_ratio": model_mae / jnp.maximum(base_mae, 1e-12),
+    }
