@@ -1,0 +1,71 @@
+"""Benchmark: LSTM-64 teacher-forced training throughput (samples/sec/chip).
+
+The BASELINE.json north-star metric: train the dynamic LSTM flow model at
+>=10k samples/sec/chip. This script times the full jitted training step
+(fwd + bwd + SGD update) of the LSTM-64 config on the available chip and
+prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+vs_baseline is value / 10_000 (the driver-set target; the reference
+publishes no numbers of its own — BASELINE.md).
+
+Env knobs: BENCH_BATCH (default 4096), BENCH_SECONDS (default 10).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from tpuflow.core.losses import mae_clip
+    from tpuflow.models import LSTMRegressor
+    from tpuflow.train import create_state, make_train_step
+
+    batch = int(os.environ.get("BENCH_BATCH", 4096))
+    seconds = float(os.environ.get("BENCH_SECONDS", 10))
+    window, features = 24, 5
+
+    model = LSTMRegressor(hidden=64, dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, window, features)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((batch, window)), jnp.float32)
+
+    state = create_state(model, jax.random.PRNGKey(0), x[:2])
+    step = make_train_step(mae_clip)
+    key = jax.random.PRNGKey(0)
+
+    # Warmup/compile.
+    state, m = step(state, x, y, key)
+    jax.block_until_ready(m["loss"])
+
+    # Timed run.
+    t0 = time.perf_counter()
+    steps = 0
+    while time.perf_counter() - t0 < seconds:
+        state, m = step(state, x, y, key)
+        steps += 1
+    jax.block_until_ready(m["loss"])
+    elapsed = time.perf_counter() - t0
+
+    samples_per_sec = batch * steps / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "lstm64_train_samples_per_sec_per_chip",
+                "value": round(samples_per_sec, 1),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(samples_per_sec / 10_000.0, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

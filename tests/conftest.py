@@ -15,3 +15,9 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The environment force-registers the axon TPU platform ahead of the env
+# var (config resolves to "axon,cpu"); pin the config explicitly.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,136 @@
+"""Tests for splits, windowing, CSV I/O, synthetic data, and the end-to-end
+host pipeline."""
+
+import numpy as np
+import pytest
+
+from tpuflow.data import (
+    Schema,
+    batches,
+    generate_wells,
+    prepare_tabular,
+    prepare_windowed,
+    random_split,
+    read_csv,
+    sliding_windows,
+    teacher_forcing_pairs,
+    wells_to_table,
+    write_csv,
+)
+from tpuflow.data.synthetic import (
+    SYNTHETIC_COLUMN_NAMES,
+    SYNTHETIC_COLUMN_TYPES,
+    SYNTHETIC_TARGET,
+)
+
+
+def test_random_split_partition_and_determinism():
+    a, b, c = random_split(1000, seed=7)
+    assert len(a) + len(b) + len(c) == 1000
+    assert len(a) == 640 and len(b) == 160 and len(c) == 200
+    merged = np.concatenate([a, b, c])
+    assert len(np.unique(merged)) == 1000
+    a2, _, _ = random_split(1000, seed=7)
+    np.testing.assert_array_equal(a, a2)
+    a3, _, _ = random_split(1000, seed=8)
+    assert not np.array_equal(a, a3)
+
+
+def test_random_split_bad_fractions():
+    with pytest.raises(ValueError):
+        random_split(10, fractions=(0.5, 0.2))
+
+
+def test_sliding_windows_shapes_and_alignment():
+    T, F = 30, 2
+    series = np.arange(T * F, dtype=np.float32).reshape(T, F)
+    targets = np.arange(T, dtype=np.float32)
+    x, y = sliding_windows(series, targets, length=24, stride=1)
+    assert x.shape == (7, 24, 2)
+    assert y.shape == (7,)
+    # window i covers steps [i, i+23]; its target is step i+23
+    np.testing.assert_array_equal(y, np.arange(23, 30))
+    np.testing.assert_array_equal(x[0], series[:24])
+
+
+def test_teacher_forcing_pairs():
+    series = np.ones((26, 3), dtype=np.float32)
+    targets = np.arange(26, dtype=np.float32)
+    x, y = teacher_forcing_pairs(series, targets, length=24)
+    assert x.shape == (3, 24, 3)
+    assert y.shape == (3, 24)
+    np.testing.assert_array_equal(y[1], np.arange(1, 25))
+
+
+def test_windows_too_short_series():
+    x, y = sliding_windows(np.ones((5, 2)), np.ones(5), length=24)
+    assert x.shape == (0, 24, 2) and y.shape == (0,)
+
+
+def test_csv_roundtrip(tmp_path):
+    schema = Schema.from_cli(
+        SYNTHETIC_COLUMN_NAMES, SYNTHETIC_COLUMN_TYPES, SYNTHETIC_TARGET
+    )
+    wells = generate_wells(n_wells=2, steps=32, seed=1)
+    table = wells_to_table(wells)
+    path = str(tmp_path / "wells.csv")
+    write_csv(path, table, list(schema.names))
+    back = read_csv(path, schema)
+    np.testing.assert_allclose(back["pressure"], table["pressure"], rtol=1e-5)
+    np.testing.assert_array_equal(back["completion"], table["completion"])
+    assert back["flow"].dtype == np.float32
+
+
+def test_csv_bad_row(tmp_path):
+    schema = Schema.from_cli("a,b", "float,float", "b")
+    p = tmp_path / "bad.csv"
+    p.write_text("1.0,2.0\n3.0\n")
+    with pytest.raises(ValueError, match="expected 2 fields"):
+        read_csv(str(p), schema)
+
+
+def test_synthetic_wells_learnable_structure():
+    """True flow deviates from Gilbert systematically (correction < 1)."""
+    wells = generate_wells(n_wells=4, steps=128, seed=0)
+    for w in wells:
+        ratio = w.flow / np.maximum(w.gilbert_flow, 1e-6)
+        assert ratio.mean() < 1.0  # water cut + completion efficiency
+        assert np.all(w.flow > 0)
+
+
+def test_prepare_tabular_end_to_end():
+    schema = Schema.from_cli(
+        SYNTHETIC_COLUMN_NAMES, SYNTHETIC_COLUMN_TYPES, SYNTHETIC_TARGET
+    )
+    table = wells_to_table(generate_wells(n_wells=3, steps=100, seed=2))
+    splits = prepare_tabular(schema, table, seed=0)
+    n = 300
+    assert splits.train.n + splits.val.n + splits.test.n == n
+    F = splits.pipeline.feature_dim
+    assert splits.train.x.shape == (splits.train.n, F)
+    assert splits.train.x.dtype == np.float32
+    # standardized train features ~ zero mean
+    assert abs(splits.train.x.mean()) < 0.2
+
+
+def test_prepare_windowed_end_to_end():
+    wells = generate_wells(n_wells=3, steps=100, seed=3)
+    ws = prepare_windowed(wells, window=24, stride=4, seed=0)
+    assert ws.train.x.shape[1:] == (24, 5)
+    assert ws.train.y.ndim == 1
+    wtf = prepare_windowed(wells, window=24, stride=4, seed=0, teacher_forcing=True)
+    assert wtf.train.y.shape[1:] == (24,)
+
+
+def test_batches_static_shape_and_shuffle():
+    from tpuflow.data import ArrayDataset
+
+    ds = ArrayDataset(np.arange(20, dtype=np.float32)[:, None], np.arange(20.0))
+    bs = list(batches(ds, batch_size=8, seed=0))
+    assert len(bs) == 2  # drop remainder
+    assert all(x.shape == (8, 1) for x, _ in bs)
+    seen = np.concatenate([y for _, y in bs])
+    assert len(np.unique(seen)) == 16
+    # deterministic given seed
+    bs2 = list(batches(ds, batch_size=8, seed=0))
+    np.testing.assert_array_equal(bs[0][0], bs2[0][0])

@@ -1,0 +1,88 @@
+"""Tests for the feature pipeline — especially the fixed fit-once semantics
+(reference bug C6: per-split re-fit, cnn.py:89-91)."""
+
+import numpy as np
+import pytest
+
+from tpuflow.data import FeaturePipeline, Schema
+
+SCHEMA = Schema.from_cli(
+    "pressure,completion,flow", "float,string,float", "flow"
+)
+
+
+def _cols(pressure, completion, flow):
+    return {
+        "pressure": np.asarray(pressure, dtype=np.float32),
+        "completion": np.asarray(completion),
+        "flow": np.asarray(flow, dtype=np.float32),
+    }
+
+
+def test_one_hot_assembly_order_and_width():
+    train = _cols([1.0, 2.0, 3.0], ["a", "b", "a"], [10.0, 20.0, 30.0])
+    pipe = FeaturePipeline(SCHEMA, standardize=False).fit(train)
+    # vocab ordered by freq desc: a (2), b (1)
+    assert pipe.vocabs["completion"] == ["a", "b"]
+    assert pipe.feature_dim == 3  # 2 one-hot + 1 continuous
+    x = pipe.transform(train)
+    np.testing.assert_array_equal(
+        x, [[1, 0, 1.0], [0, 1, 2.0], [1, 0, 3.0]]
+    )
+
+
+def test_fit_once_consistent_across_splits():
+    """Same category must map to the same index in every split."""
+    train = _cols([1, 2, 3], ["a", "b", "a"], [1, 2, 3])
+    val = _cols([4], ["b"], [4])
+    pipe = FeaturePipeline(SCHEMA, standardize=False).fit(train)
+    xv = pipe.transform(val)
+    np.testing.assert_array_equal(xv[0, :2], [0, 1])  # 'b' -> index 1 always
+
+
+def test_unknown_category_all_zeros():
+    train = _cols([1, 2], ["a", "b"], [1, 2])
+    pipe = FeaturePipeline(SCHEMA, standardize=False).fit(train)
+    x = pipe.transform(_cols([5], ["NEVER_SEEN"], [5]))
+    np.testing.assert_array_equal(x[0, :2], [0, 0])
+
+
+def test_standardization_train_stats_only():
+    train = _cols([0.0, 2.0], ["a", "a"], [1, 2])
+    test = _cols([4.0], ["a"], [3])
+    pipe = FeaturePipeline(SCHEMA, standardize=True).fit(train)
+    xt = pipe.transform(test)
+    # continuous col: mean 1, std 1 -> (4-1)/1 = 3
+    assert xt[0, -1] == pytest.approx(3.0)
+
+
+def test_continuous_target_passthrough_and_categorical_target_indexing():
+    train = _cols([1, 2], ["a", "b"], [5.5, 6.5])
+    pipe = FeaturePipeline(
+        SCHEMA, standardize=False, standardize_target=False
+    ).fit(train)
+    np.testing.assert_allclose(pipe.transform_target(train), [5.5, 6.5])
+
+    cat_schema = Schema.from_cli("x,lbl", "float,string", "lbl")
+    cols = {
+        "x": np.asarray([1.0, 2.0, 3.0], dtype=np.float32),
+        "lbl": np.asarray(["hi", "lo", "hi"]),
+    }
+    p2 = FeaturePipeline(cat_schema, standardize=False).fit(cols)
+    np.testing.assert_array_equal(p2.transform_target(cols), [0, 1, 0])
+
+
+def test_target_standardization_and_inverse():
+    """Raw flow targets are O(10^3); scaled targets keep clip=6 meaningful."""
+    train = _cols([1, 2, 3], ["a", "a", "b"], [1000.0, 2000.0, 3000.0])
+    pipe = FeaturePipeline(SCHEMA, standardize=False).fit(train)
+    y = pipe.transform_target(train)
+    assert abs(y.mean()) < 1e-5 and y.std() == pytest.approx(1.0, rel=1e-4)
+    np.testing.assert_allclose(
+        pipe.inverse_target(y), [1000.0, 2000.0, 3000.0], rtol=1e-5
+    )
+
+
+def test_transform_before_fit_raises():
+    with pytest.raises(RuntimeError):
+        FeaturePipeline(SCHEMA).transform(_cols([1], ["a"], [1]))

@@ -1,0 +1,118 @@
+"""Tests for the Flax model family: shapes, init, jit, gradients."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuflow.models import (
+    CNN1D,
+    DynamicMLP,
+    GilbertResidualMLP,
+    LSTMRegressor,
+    StaticMLP,
+    build_model,
+)
+
+RNG = jax.random.PRNGKey(0)
+
+
+def _init_and_apply(model, x, **apply_kw):
+    params = model.init(RNG, x)["params"]
+    return params, model.apply({"params": params}, x, **apply_kw)
+
+
+def test_static_mlp_shape():
+    x = jnp.ones((8, 11))
+    _, y = _init_and_apply(StaticMLP(), x)
+    assert y.shape == (8,)
+
+
+def test_dynamic_mlp_shape():
+    x = jnp.ones((8, 24, 5))
+    _, y = _init_and_apply(DynamicMLP(), x)
+    assert y.shape == (8,)
+
+
+def test_cnn1d_shape_and_dropout_rng():
+    x = jnp.ones((4, 24, 5))
+    model = CNN1D()
+    params = model.init(RNG, x)["params"]
+    y = model.apply({"params": params}, x, deterministic=True)
+    assert y.shape == (4,)
+    # stochastic path needs a dropout rng and differs from deterministic
+    y2 = model.apply(
+        {"params": params}, x, deterministic=False, rngs={"dropout": RNG}
+    )
+    assert y2.shape == (4,)
+
+
+def test_lstm_sequence_and_last_readout():
+    x = jnp.ones((6, 24, 5))
+    _, y_seq = _init_and_apply(LSTMRegressor(hidden=16), x)
+    assert y_seq.shape == (6, 24)
+    _, y_last = _init_and_apply(LSTMRegressor(hidden=16, readout="last"), x)
+    assert y_last.shape == (6,)
+
+
+def test_stacked_lstm():
+    x = jnp.ones((2, 12, 5))
+    _, y = _init_and_apply(LSTMRegressor(hidden=8, num_layers=3), x)
+    assert y.shape == (2, 12)
+
+
+def test_lstm_recurrence_is_causal():
+    """Changing a late timestep must not affect earlier predictions."""
+    model = LSTMRegressor(hidden=8)
+    x = jax.random.normal(RNG, (1, 10, 3))
+    params = model.init(RNG, x)["params"]
+    y1 = model.apply({"params": params}, x)
+    x2 = x.at[0, 9, :].set(100.0)
+    y2 = model.apply({"params": params}, x2)
+    np.testing.assert_allclose(
+        np.asarray(y1[0, :9]), np.asarray(y2[0, :9]), atol=1e-5
+    )
+    assert abs(float(y1[0, 9] - y2[0, 9])) > 1e-6
+
+
+def test_lstm_bfloat16_compute():
+    model = LSTMRegressor(hidden=16, dtype=jnp.bfloat16)
+    x = jnp.ones((4, 8, 3))
+    params = model.init(RNG, x)["params"]
+    y = model.apply({"params": params}, x)
+    assert y.dtype == jnp.float32  # output cast back
+    # params stay float32 for optimizer stability
+    assert params["lstm_0"]["w_x"].dtype == jnp.float32
+
+
+def test_gilbert_residual_starts_at_physical_model():
+    x = jnp.concatenate(
+        [jnp.ones((4, 3)), jnp.full((4, 1), 500.0)], axis=1
+    )  # last col = gilbert prediction
+    model = GilbertResidualMLP()
+    params = model.init(RNG, x)["params"]
+    y = model.apply({"params": params}, x)
+    # at init the correction is exactly softplus(0.5413)=1 -> gilbert
+    np.testing.assert_allclose(np.asarray(y), 500.0, rtol=1e-3)
+
+
+def test_models_jit_and_grad():
+    x = jnp.ones((4, 24, 5))
+    for name in ("dynamic_mlp", "cnn1d", "lstm"):
+        model = build_model(name)
+        params = model.init(RNG, x)["params"]
+
+        def loss(p):
+            return jnp.mean(
+                model.apply({"params": p}, x, deterministic=True) ** 2
+            )
+
+        g = jax.jit(jax.grad(loss))(params)
+        assert jax.tree_util.tree_structure(g) == jax.tree_util.tree_structure(
+            params
+        )
+
+
+def test_registry_unknown():
+    with pytest.raises(ValueError, match="unknown model"):
+        build_model("nope")

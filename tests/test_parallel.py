@@ -1,0 +1,158 @@
+"""Multi-device tests on the 8-virtual-CPU-device mesh (conftest.py):
+mesh construction, collectives, and DP-vs-single-chip gradient equivalence
+— the SURVEY.md §4 test obligation the reference never had."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpuflow.core import mae
+from tpuflow.data.pipeline import ArrayDataset
+from tpuflow.models import StaticMLP
+from tpuflow.parallel import (
+    all_gather,
+    make_dp_eval_step,
+    make_dp_train_step,
+    make_mesh,
+    pmean,
+    ppermute_ring,
+    psum,
+    reduce_scatter,
+    shard_batch,
+)
+from tpuflow.parallel.dp import replicate
+from tpuflow.train import create_state, make_eval_step, make_train_step
+
+
+def test_eight_virtual_devices():
+    assert jax.device_count() == 8
+
+
+def test_make_mesh_shapes():
+    mesh = make_mesh()
+    assert mesh.shape == {"data": 8, "model": 1}
+    mesh2 = make_mesh(n_data=4, n_model=2)
+    assert mesh2.shape == {"data": 4, "model": 2}
+    with pytest.raises(ValueError):
+        make_mesh(n_data=3)
+
+
+def test_collectives_in_shard_map():
+    mesh = make_mesh()
+    x = jnp.arange(8.0)
+
+    def body(x):
+        return (
+            psum(x),
+            pmean(x),
+            all_gather(x),
+            reduce_scatter(all_gather(x)),
+            ppermute_ring(x),
+        )
+
+    s, m, g, rs, pp = map(
+        np.asarray,
+        jax.jit(
+            jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=P("data"),
+                out_specs=(P(), P(), P("data"), P("data"), P("data")),
+            )
+        )(x),
+    )
+    assert float(s[0]) == pytest.approx(28.0)  # sum 0..7
+    assert float(m[0]) == pytest.approx(3.5)
+    np.testing.assert_allclose(g[:8], np.arange(8.0))  # gathered
+    # reduce_scatter(all_gather(x)) == 8 copies summed then scattered = 8*x
+    np.testing.assert_allclose(rs, np.arange(8.0) * 8)
+    # ring shift by 1: device i ends up with device (i-1)'s shard
+    np.testing.assert_allclose(pp, np.roll(np.arange(8.0), 1))
+
+
+def _toy(n=256, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 4)).astype(np.float32)
+    y = (x @ np.array([1.0, -2.0, 0.5, 3.0]) + 0.5).astype(np.float32)
+    return ArrayDataset(x, y)
+
+
+def test_dp_step_matches_single_device_math():
+    """One DP step over 8 shards == one single-device step on the full batch."""
+    ds = _toy()
+    model = StaticMLP(hidden=(16,))
+    mesh = make_mesh()
+    rng = jax.random.PRNGKey(0)
+
+    state_single = create_state(model, jax.random.PRNGKey(42), ds.x[:4])
+    state_dp = create_state(model, jax.random.PRNGKey(42), ds.x[:4])
+    state_dp = replicate(mesh, state_dp)
+
+    x, y = ds.x[:64], ds.y[:64]
+    single_step = make_train_step(mae, donate=False)
+    dp_step = make_dp_train_step(mesh, mae)
+
+    state_single, m_single = single_step(state_single, x, y, rng)
+    xs, ys = shard_batch(mesh, x, y)
+    state_dp, m_dp = dp_step(state_dp, xs, ys, rng)
+
+    # loss identical; params identical after the all-reduced update
+    assert float(m_dp["loss"]) == pytest.approx(float(m_single["loss"]), rel=1e-5)
+    flat_s = jax.tree_util.tree_leaves(state_single.params)
+    flat_d = jax.tree_util.tree_leaves(state_dp.params)
+    for a, b in zip(flat_s, flat_d):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_dp_eval_matches_single_device():
+    ds = _toy(128)
+    model = StaticMLP(hidden=(8,))
+    mesh = make_mesh()
+    state = create_state(model, jax.random.PRNGKey(1), ds.x[:4])
+
+    single = make_eval_step(mae)
+    m1 = single(state, ds.x[:64], ds.y[:64], jnp.ones(64))
+
+    dp = make_dp_eval_step(mesh, mae)
+    xs, ys, ms = shard_batch(mesh, ds.x[:64], ds.y[:64], np.ones(64, np.float32))
+    m2 = dp(replicate(mesh, state), xs, ys, ms)
+    assert float(m2["count"]) == 64
+    assert float(m2["loss_sum"]) == pytest.approx(float(m1["loss_sum"]), rel=1e-5)
+
+
+def test_dp_training_converges():
+    """A few DP epochs on the virtual mesh actually learn the toy problem."""
+    ds = _toy(512)
+    model = StaticMLP(hidden=(32,))
+    mesh = make_mesh()
+    state = replicate(
+        mesh, create_state(model, jax.random.PRNGKey(0), ds.x[:4])
+    )
+    step = make_dp_train_step(mesh, mae)
+    rng = jax.random.PRNGKey(0)
+    first = last = None
+    for epoch in range(30):
+        for s in range(0, 512, 64):
+            x, y = shard_batch(mesh, ds.x[s : s + 64], ds.y[s : s + 64])
+            state, m = step(state, x, y, rng)
+            if first is None:
+                first = float(m["loss"])
+    last = float(m["loss"])
+    assert last < first * 0.5
+
+
+def test_lstm_dp_step_compiles_and_runs():
+    """Flagship model under DP on the virtual mesh (sequence targets)."""
+    from tpuflow.models import LSTMRegressor
+
+    mesh = make_mesh()
+    model = LSTMRegressor(hidden=8)
+    x = np.random.default_rng(0).standard_normal((16, 12, 3)).astype(np.float32)
+    y = np.ones((16, 12), dtype=np.float32)
+    state = replicate(mesh, create_state(model, jax.random.PRNGKey(0), x[:2]))
+    step = make_dp_train_step(mesh)
+    xs, ys = shard_batch(mesh, x, y)
+    state, m = step(state, xs, ys, jax.random.PRNGKey(0))
+    assert np.isfinite(float(m["loss"]))

@@ -1,0 +1,43 @@
+"""Tests for the dynamic-schema contract (SURVEY.md C1/C3)."""
+
+import numpy as np
+import pytest
+
+from tpuflow.data import Schema
+
+
+def test_from_cli_reference_contract():
+    """Comma-separated names/types + target, per reference cnn.py:2,59-60."""
+    s = Schema.from_cli("a,b,c,flow", "int,float,string,float", "flow")
+    assert s.names == ("a", "b", "c", "flow")
+    assert s["a"].numpy_dtype == np.int32
+    assert s["b"].numpy_dtype == np.float32
+    assert s["c"].numpy_dtype.kind == "U"
+
+
+def test_type_mapping_fallthrough():
+    """Any non-int/float type string is categorical (reference cnn.py:53-58)."""
+    s = Schema.from_cli("x,y,t", "varchar,bool,float", "t")
+    assert [c.name for c in s.categorical_features] == ["x", "y"]
+    assert s.continuous_features == ()
+
+
+def test_feature_partition_excludes_target():
+    s = Schema.from_cli("p,c,comp,flow", "float,float,string,float", "flow")
+    assert [c.name for c in s.continuous_features] == ["p", "c"]
+    assert [c.name for c in s.categorical_features] == ["comp"]
+    assert s.target_spec.is_continuous
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError, match="not in schema"):
+        Schema.from_cli("a,b", "int,int", "nope")
+    with pytest.raises(ValueError, match="duplicate"):
+        Schema.from_cli("a,a", "int,int", "a")
+    with pytest.raises(ValueError, match="names but"):
+        Schema.from_cli("a,b", "int", "a")
+
+
+def test_whitespace_tolerant():
+    s = Schema.from_cli(" a , b ", " int , float ", "b")
+    assert s.names == ("a", "b")

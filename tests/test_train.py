@@ -1,0 +1,142 @@
+"""Tests for the training subsystem: optimizer parity, steps, early
+stopping, checkpointing, and a real end-to-end fit that must learn."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuflow.core import mae
+from tpuflow.data.pipeline import ArrayDataset
+from tpuflow.models import StaticMLP
+from tpuflow.train import (
+    BestCheckpointer,
+    EarlyStopping,
+    FitConfig,
+    create_state,
+    evaluate,
+    fit,
+    keras_sgd,
+    make_eval_step,
+    make_train_step,
+)
+
+
+def _toy_linear_data(n=512, seed=0):
+    """y = 3*x0 - 2*x1 + 1, learnable in a few epochs."""
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 2)).astype(np.float32)
+    y = (3 * x[:, 0] - 2 * x[:, 1] + 1).astype(np.float32)
+    return ArrayDataset(x, y)
+
+
+def test_keras_sgd_decay_schedule():
+    """lr_t = lr/(1+decay*t): verify via two identical grads."""
+    import optax
+
+    tx = keras_sgd(learning_rate=0.1, momentum=0.0, decay=1.0, nesterov=False)
+    params = {"w": jnp.array(0.0)}
+    opt_state = tx.init(params)
+    g = {"w": jnp.array(1.0)}
+    upd0, opt_state = tx.update(g, opt_state, params)
+    upd1, _ = tx.update(g, opt_state, params)
+    assert float(upd0["w"]) == pytest.approx(-0.1)  # step 0: lr 0.1
+    assert float(upd1["w"]) == pytest.approx(-0.05)  # step 1: lr 0.1/2
+
+
+def test_early_stopping_patience():
+    es = EarlyStopping(patience=3)
+    assert not es.update(1.0)
+    assert not es.update(0.9)  # improvement resets
+    assert not es.update(0.95)
+    assert not es.update(0.95)
+    assert es.update(0.95)  # 3rd bad epoch -> stop
+    assert es.best == pytest.approx(0.9)
+
+
+def test_train_step_reduces_loss():
+    ds = _toy_linear_data()
+    model = StaticMLP(hidden=(16,))
+    state = create_state(model, jax.random.PRNGKey(0), ds.x[:4])
+    step = make_train_step(mae, donate=False)
+    rng = jax.random.PRNGKey(1)
+    _, m0 = step(state, ds.x[:64], ds.y[:64], rng)
+    for _ in range(50):
+        state, m = step(state, ds.x[:64], ds.y[:64], rng)
+    assert float(m["loss"]) < float(m0["loss"])
+    assert float(m["grad_norm"]) >= 0
+
+
+def test_eval_step_masked_sums_exact():
+    ds = _toy_linear_data(n=8)
+    model = StaticMLP(hidden=(4,))
+    state = create_state(model, jax.random.PRNGKey(0), ds.x)
+    # evaluate with batch 5 (pad 2 in tail) must equal batch 8 (no pad)
+    a = evaluate(state, ds, batch_size=5, loss=mae)
+    b = evaluate(state, ds, batch_size=8, loss=mae)
+    assert a["loss"] == pytest.approx(b["loss"], rel=1e-5)
+    assert a["mae"] == pytest.approx(b["mae"], rel=1e-5)
+
+
+def test_fit_end_to_end_learns_and_reports():
+    train, val = _toy_linear_data(512, 0), _toy_linear_data(128, 1)
+    model = StaticMLP(hidden=(32,))
+    state = create_state(model, jax.random.PRNGKey(0), train.x[:4])
+    cfg = FitConfig(max_epochs=30, batch_size=64, patience=10, verbose=False, loss=mae)
+    result = fit(state, train, val, cfg)
+    assert result.history[-1]["val_loss"] < result.history[0]["val_loss"]
+    assert result.best_val_loss < 1.0
+    assert result.time_elapsed > 0
+    assert result.samples_per_sec > 0
+    assert "Time elapsed" in result.report()
+
+
+def test_fit_early_stops():
+    """Tiny lr on converged-ish data: val loss plateaus -> stops < max_epochs."""
+    train, val = _toy_linear_data(64, 0), _toy_linear_data(64, 0)
+    model = StaticMLP(hidden=(4,))
+    state = create_state(
+        model, jax.random.PRNGKey(0), train.x[:4], keras_sgd(learning_rate=0.0)
+    )
+    cfg = FitConfig(max_epochs=100, batch_size=32, patience=3, verbose=False)
+    result = fit(state, train, val, cfg)
+    assert result.epochs_ran <= 5
+
+
+def test_best_checkpointer_save_best_and_restore(tmp_path):
+    params = {"w": jnp.arange(4.0), "b": jnp.zeros(2)}
+    ck = BestCheckpointer(str(tmp_path), "unit")
+    ck.maybe_save(1, params, val_loss=5.0)
+    worse = jax.tree_util.tree_map(lambda a: a + 100, params)
+    ck.maybe_save(2, worse, val_loss=9.0)  # worse: must not become best
+    better = jax.tree_util.tree_map(lambda a: a + 1, params)
+    ck.maybe_save(3, better, val_loss=1.0)
+    assert ck.best_step == 3
+    restored = ck.restore_best(params)
+    np.testing.assert_allclose(np.asarray(restored["w"]), np.arange(4.0) + 1)
+    ck.close()
+
+    # resume path: a fresh manager over the same dir finds the best
+    ck2 = BestCheckpointer(str(tmp_path), "unit")
+    assert ck2.best_step == 3
+    restored2 = ck2.restore_best(params)
+    np.testing.assert_allclose(np.asarray(restored2["b"]), np.ones(2))
+    ck2.close()
+
+
+def test_fit_with_checkpointing(tmp_path):
+    train, val = _toy_linear_data(128, 0), _toy_linear_data(64, 1)
+    model = StaticMLP(hidden=(8,))
+    state = create_state(model, jax.random.PRNGKey(0), train.x[:4])
+    cfg = FitConfig(
+        max_epochs=5, batch_size=32, verbose=False, loss=mae,
+        storage_path=str(tmp_path), model_name="mlp",
+    )
+    result = fit(state, train, val, cfg)
+    ck = BestCheckpointer(str(tmp_path), "mlp")
+    assert ck.best_step is not None
+    restored = ck.restore_best(result.state.params)
+    assert jax.tree_util.tree_structure(restored) == jax.tree_util.tree_structure(
+        result.state.params
+    )
+    ck.close()

@@ -1,0 +1,11 @@
+"""Job-level API: config + web-callable train entrypoint.
+
+The L6/L5 layers of the reference (SURVEY.md §1): where its web component
+shelled out ``spark-submit <script> <argv>`` (reference Readme.md:4,
+cnn.py:2), a service here calls ``tpuflow.api.train(TrainJobConfig(...))``
+in-process, and the CLI (``python -m tpuflow.cli``) preserves the
+positional dynamic-schema contract for drop-in job submission.
+"""
+
+from tpuflow.api.config import TrainJobConfig  # noqa: F401
+from tpuflow.api.train_api import TrainReport, train  # noqa: F401

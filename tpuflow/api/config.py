@@ -1,0 +1,54 @@
+"""Job configuration.
+
+One dataclass carries everything a submission needs. The first four fields
+are the reference's exact positional CLI contract (reference cnn.py:2,
+41-44): comma-separated column names, comma-separated type strings, target
+column, artifact storage path. ``data_path`` is the explicit data location
+the reference intended but lost to its argv bug (SURVEY.md C4).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TrainJobConfig:
+    # --- the reference's dynamic-schema contract (runtime inputs) ---
+    column_names: str = ""  # "pressure,choke,...", comma-separated
+    column_types: str = ""  # "float,float,...,string", comma-separated
+    target: str = "flow"
+    storage_path: str | None = None  # checkpoint root ({storage}/models/...)
+
+    # --- data source (C4 fixed: explicit path; synthetic fallback) ---
+    data_path: str | None = None  # headerless CSV; None -> synthetic wells
+    synthetic_wells: int = 8
+    synthetic_steps: int = 512
+
+    # --- model ---
+    model: str = "lstm"  # key into tpuflow.models.MODELS
+    model_kwargs: dict = field(default_factory=dict)
+    window: int = 24  # sequence window (BASELINE configs)
+    stride: int = 1
+
+    # --- training (reference defaults: cnn.py:121,128) ---
+    max_epochs: int = 1000
+    batch_size: int = 20
+    patience: int = 10
+    loss: str = "mae_clip"
+    optimizer: str = "keras_sgd"
+    optimizer_kwargs: dict = field(default_factory=dict)
+    seed: int = 0
+    verbose: bool = True
+
+    # --- parallelism ---
+    n_devices: int | None = None  # None -> all visible devices; 1 -> no DP
+
+    @property
+    def is_sequence_model(self) -> bool:
+        return self.model in ("dynamic_mlp", "cnn1d", "lstm", "stacked_lstm")
+
+    @property
+    def teacher_forcing(self) -> bool:
+        """Sequence-target training for the LSTM family (BASELINE config 4)."""
+        return self.model in ("lstm", "stacked_lstm")

@@ -1,0 +1,73 @@
+"""CLI — the reference's job-submission contract, preserved and fixed.
+
+Usage (reference cnn.py:2 contract, plus the data path its argv bug lost):
+
+    python -m tpuflow.cli columnNames columnTypes targetColumn storagePath \
+        [--data PATH] [--model NAME] [--epochs N] ...
+
+Positional args are the reference's exact four: comma-separated column
+names, comma-separated types (int|float|anything-else=categorical), the
+target column, and the artifact storage path (reference cnn.py:41-44).
+With no positional args, the synthetic well schema is used end-to-end.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpuflow",
+        description="TPU-native well-flow model training",
+    )
+    p.add_argument("columnNames", nargs="?", default="", help="comma-separated feature/target column names")
+    p.add_argument("columnTypes", nargs="?", default="", help="comma-separated types: int|float|other=categorical")
+    p.add_argument("targetColumn", nargs="?", default="flow", help="target column name")
+    p.add_argument("storagePath", nargs="?", default=None, help="artifact root; best model saved under {storagePath}/models/")
+    p.add_argument("--data", default=None, help="headerless CSV data path (omit for synthetic wells)")
+    p.add_argument("--model", default="lstm", help="static_mlp|dynamic_mlp|cnn1d|lstm|stacked_lstm")
+    p.add_argument("--epochs", type=int, default=1000)
+    p.add_argument("--batch-size", type=int, default=20)
+    p.add_argument("--patience", type=int, default=10)
+    p.add_argument("--window", type=int, default=24)
+    p.add_argument("--loss", default="mae_clip")
+    p.add_argument("--optimizer", default="keras_sgd")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--devices", type=int, default=None, help="data-parallel device count (default: all)")
+    p.add_argument("--synthetic-wells", type=int, default=8)
+    p.add_argument("--synthetic-steps", type=int, default=512)
+    p.add_argument("--quiet", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from tpuflow.api import TrainJobConfig, train
+
+    config = TrainJobConfig(
+        column_names=args.columnNames,
+        column_types=args.columnTypes,
+        target=args.targetColumn,
+        storage_path=args.storagePath,
+        data_path=args.data,
+        model=args.model,
+        max_epochs=args.epochs,
+        batch_size=args.batch_size,
+        patience=args.patience,
+        window=args.window,
+        loss=args.loss,
+        optimizer=args.optimizer,
+        seed=args.seed,
+        n_devices=args.devices,
+        synthetic_wells=args.synthetic_wells,
+        synthetic_steps=args.synthetic_steps,
+        verbose=not args.quiet,
+    )
+    train(config)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

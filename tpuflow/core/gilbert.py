@@ -79,6 +79,7 @@ def gilbert_flow(
       coeffs: correlation coefficients (Gilbert by default).
     """
     glr = jnp.maximum(glr, _EPS)
+    choke_size = jnp.maximum(choke_size, _EPS)
     return (
         wellhead_pressure
         * jnp.power(choke_size, coeffs.c)

@@ -32,8 +32,10 @@ def mae_vs_baseline(
     Returns model MAE, baseline MAE, and their ratio (<1 means the learned
     model beats the physical model).
     """
-    model_mae = jnp.mean(jnp.abs(y_true - y_pred))
-    base_mae = jnp.mean(jnp.abs(y_true - y_baseline))
+    from tpuflow.core.losses import mae
+
+    model_mae = mae(y_true, y_pred)
+    base_mae = mae(y_true, y_baseline)
     return {
         "mae": model_mae,
         "baseline_mae": base_mae,

@@ -1,0 +1,21 @@
+"""Host-side data plane: dynamic-schema ingest, feature ETL, batching.
+
+Replaces the reference's Spark data layers (L1/L2, reference cnn.py:48-107)
+with a NumPy host pipeline that resolves per-submission dynamic schemas
+(reference Readme.md:25) into the *static* shapes XLA requires, then feeds
+device-resident batches — closing the Spark-DataFrame→Keras seam the
+reference never bridged (reference cnn.py:127; SURVEY.md §3.1).
+"""
+
+from tpuflow.data.schema import ColumnSpec, Schema  # noqa: F401
+from tpuflow.data.splits import random_split  # noqa: F401
+from tpuflow.data.features import FeaturePipeline  # noqa: F401
+from tpuflow.data.windows import sliding_windows, teacher_forcing_pairs  # noqa: F401
+from tpuflow.data.synthetic import generate_wells, wells_to_table, write_csv  # noqa: F401
+from tpuflow.data.csv_io import read_csv  # noqa: F401
+from tpuflow.data.pipeline import (  # noqa: F401
+    ArrayDataset,
+    batches,
+    prepare_tabular,
+    prepare_windowed,
+)

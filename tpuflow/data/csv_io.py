@@ -1,0 +1,58 @@
+"""Headerless-CSV ingest against a dynamic schema.
+
+Equivalent of the reference's ``spark.read.csv(path, header=False,
+schema=schema)`` (reference cnn.py:65) — minus its [BUG] of reading the
+columnTypes argv slot as the path (SURVEY.md C4): here the data path is an
+explicit, separate argument.
+
+A native C++ fast path (``tpuflow._native``) is used when built; the NumPy
+implementation is the always-available fallback with identical results.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpuflow.data.schema import Schema
+
+
+def read_csv(path: str, schema: Schema) -> dict[str, np.ndarray]:
+    """Read a headerless CSV into per-column arrays, typed by the schema.
+
+    Returns a dict: column name -> 1-D array (int32 / float32 / unicode).
+    """
+    try:
+        from tpuflow._native import read_csv_native  # built lazily
+
+        out = read_csv_native(path, schema)
+        if out is not None:
+            return out
+    except ImportError:
+        pass
+    return _read_csv_numpy(path, schema)
+
+
+def _read_csv_numpy(path: str, schema: Schema) -> dict[str, np.ndarray]:
+    ncols = len(schema.columns)
+    cells: list[list[str]] = [[] for _ in range(ncols)]
+    with open(path, "r", encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.rstrip("\n").rstrip("\r")
+            if not line:
+                continue
+            parts = line.split(",")
+            if len(parts) != ncols:
+                raise ValueError(
+                    f"{path}:{lineno}: expected {ncols} fields, got {len(parts)}"
+                )
+            for i, p in enumerate(parts):
+                cells[i].append(p)
+    out: dict[str, np.ndarray] = {}
+    for spec, col in zip(schema.columns, cells):
+        if spec.kind == "int":
+            out[spec.name] = np.asarray(col, dtype=np.int32)
+        elif spec.kind == "float":
+            out[spec.name] = np.asarray(col, dtype=np.float32)
+        else:
+            out[spec.name] = np.asarray(col, dtype=np.str_)
+    return out

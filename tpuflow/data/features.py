@@ -1,0 +1,144 @@
+"""Feature ETL: categorical indexing, one-hot, assembly, standardization.
+
+TPU-native rebuild of the reference's Spark ML pipeline (reference
+cnn.py:71-107): ``StringIndexer`` per categorical column → ``OneHotEncoder``
+→ ``VectorAssembler`` merging one-hots with the continuous columns into a
+single ``features`` matrix, plus the target label indexer the reference
+created but never wired in (reference cnn.py:106-107, SURVEY.md C8).
+
+Two reference bugs are deliberately fixed (SURVEY.md C6):
+- The pipeline is **fit exactly once on the training split** and then
+  applied to val/test, so category indices are consistent across splits
+  (the reference re-fit per split, reference cnn.py:89-91).
+- Unknown categories at transform time map to an all-zeros one-hot instead
+  of crashing.
+
+Vocabularies are ordered by descending training frequency (ties broken
+lexically), matching Spark ``StringIndexer``'s default ``frequencyDesc``.
+Output is a dense float32 ``[N, F]`` matrix with a *static* feature width —
+the shape contract XLA compilation needs (SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from tpuflow.data.schema import Schema
+
+
+def _vocab_frequency_desc(values: np.ndarray) -> list[str]:
+    uniq, counts = np.unique(values, return_counts=True)
+    order = np.lexsort((uniq, -counts))  # freq desc, then lexical
+    return [str(u) for u in uniq[order]]
+
+
+@dataclass
+class FeaturePipeline:
+    """Fit-once / transform-many feature pipeline for a dynamic schema."""
+
+    schema: Schema
+    standardize: bool = True
+    standardize_target: bool = True
+    vocabs: dict[str, list[str]] = field(default_factory=dict)
+    target_vocab: list[str] | None = None
+    mean_: np.ndarray | None = None
+    std_: np.ndarray | None = None
+    target_mean_: float = 0.0
+    target_std_: float = 1.0
+    fitted: bool = False
+
+    def fit(self, train_columns: dict[str, np.ndarray]) -> "FeaturePipeline":
+        """Learn vocabularies and standardization stats from TRAIN only."""
+        for spec in self.schema.categorical_features:
+            self.vocabs[spec.name] = _vocab_frequency_desc(
+                train_columns[spec.name]
+            )
+        tspec = self.schema.target_spec
+        if not tspec.is_continuous:
+            # The reference's intended target StringIndexer (cnn.py:106-107).
+            self.target_vocab = _vocab_frequency_desc(train_columns[tspec.name])
+        elif self.standardize_target:
+            # Targets TRAIN in standardized units: with raw flow targets
+            # (O(10^3) stb/day) every residual would saturate the clip=6
+            # loss and its gradient is exactly zero — the loss only makes
+            # sense on O(1)-scale targets (SURVEY.md §7 "accuracy parity
+            # discipline"). Metrics are reported back in raw units via
+            # ``target_std`` / ``inverse_target``.
+            tv = np.asarray(train_columns[tspec.name], dtype=np.float64)
+            self.target_mean_ = float(tv.mean())
+            std = float(tv.std())
+            self.target_std_ = std if std > 1e-8 else 1.0
+        raw = self._assemble(train_columns)
+        if self.standardize:
+            self.mean_ = raw.mean(axis=0)
+            std = raw.std(axis=0)
+            self.std_ = np.where(std < 1e-8, 1.0, std).astype(np.float32)
+        self.fitted = True
+        return self
+
+    def inverse_target(self, y: np.ndarray) -> np.ndarray:
+        """Scaled-unit predictions/targets back to raw units."""
+        return np.asarray(y) * self.target_std_ + self.target_mean_
+
+    @property
+    def feature_dim(self) -> int:
+        """Static width of the assembled feature vector."""
+        dim = len(self.schema.continuous_features)
+        for spec in self.schema.categorical_features:
+            dim += len(self.vocabs[spec.name])
+        return dim
+
+    def _one_hot(self, name: str, values: np.ndarray) -> np.ndarray:
+        vocab = self.vocabs[name]
+        index = {v: i for i, v in enumerate(vocab)}
+        out = np.zeros((len(values), len(vocab)), dtype=np.float32)
+        for row, v in enumerate(values):
+            j = index.get(str(v))
+            if j is not None:  # unknown category -> all-zeros row
+                out[row, j] = 1.0
+        return out
+
+    def _assemble(self, columns: dict[str, np.ndarray]) -> np.ndarray:
+        """One-hot categoricals + continuous columns -> [N, F] float32.
+
+        Column order: categorical one-hot blocks (schema order) first, then
+        continuous columns (schema order) — the reference's assembler order
+        (`categorical-features` vector then continuous cols, cnn.py:96-99).
+        """
+        blocks = [
+            self._one_hot(spec.name, columns[spec.name])
+            for spec in self.schema.categorical_features
+        ]
+        for spec in self.schema.continuous_features:
+            blocks.append(
+                np.asarray(columns[spec.name], dtype=np.float32)[:, None]
+            )
+        if not blocks:
+            raise ValueError("schema has no feature columns")
+        return np.concatenate(blocks, axis=1)
+
+    def transform(self, columns: dict[str, np.ndarray]) -> np.ndarray:
+        if not self.fitted:
+            raise RuntimeError("FeaturePipeline.transform before fit")
+        out = self._assemble(columns)
+        if self.standardize:
+            out = (out - self.mean_) / self.std_
+        return out.astype(np.float32)
+
+    def transform_target(self, columns: dict[str, np.ndarray]) -> np.ndarray:
+        """Target column -> float32 vector (label-indexed if categorical)."""
+        if not self.fitted:
+            raise RuntimeError("FeaturePipeline.transform_target before fit")
+        tspec = self.schema.target_spec
+        values = columns[tspec.name]
+        if tspec.is_continuous:
+            y = np.asarray(values, dtype=np.float32)
+            if self.standardize_target:
+                y = (y - self.target_mean_) / self.target_std_
+            return y.astype(np.float32)
+        index = {v: i for i, v in enumerate(self.target_vocab)}
+        return np.asarray(
+            [index.get(str(v), -1) for v in values], dtype=np.float32
+        )

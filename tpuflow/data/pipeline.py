@@ -1,0 +1,148 @@
+"""End-to-end host pipeline: columns -> splits -> features -> device batches.
+
+The composition layer that makes SURVEY.md §3.1's broken seam real: raw
+dynamic-schema columns become static-shape float32 arrays, split 64/16/20
+(reference cnn.py:68), featurized by a pipeline fit once on train, and
+served as fixed-size minibatches ready for a jitted train step.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, NamedTuple, Sequence
+
+import numpy as np
+
+from tpuflow.data.features import FeaturePipeline
+from tpuflow.data.schema import Schema
+from tpuflow.data.splits import DEFAULT_FRACTIONS, random_split
+from tpuflow.data.synthetic import WellLog
+from tpuflow.data.windows import sliding_windows, teacher_forcing_pairs
+
+
+class ArrayDataset(NamedTuple):
+    """Device-ready arrays: x [N, ...] float32, y [N] or [N, T] float32."""
+
+    x: np.ndarray
+    y: np.ndarray
+
+    @property
+    def n(self) -> int:
+        return len(self.x)
+
+
+@dataclass
+class TabularSplits:
+    train: ArrayDataset
+    val: ArrayDataset
+    test: ArrayDataset
+    pipeline: FeaturePipeline
+
+
+def _take(columns: dict[str, np.ndarray], idx: np.ndarray) -> dict[str, np.ndarray]:
+    return {k: v[idx] for k, v in columns.items()}
+
+
+def prepare_tabular(
+    schema: Schema,
+    columns: dict[str, np.ndarray],
+    seed: int = 0,
+    fractions: Sequence[float] = DEFAULT_FRACTIONS,
+    standardize: bool = True,
+) -> TabularSplits:
+    """Static-model path: split, fit features on train ONLY, transform all."""
+    n = len(next(iter(columns.values())))
+    tr, va, te = (
+        _take(columns, idx) for idx in random_split(n, fractions, seed)
+    )
+    pipe = FeaturePipeline(schema, standardize=standardize).fit(tr)
+    mk = lambda c: ArrayDataset(pipe.transform(c), pipe.transform_target(c))
+    return TabularSplits(mk(tr), mk(va), mk(te), pipe)
+
+
+@dataclass
+class WindowedSplits:
+    train: ArrayDataset
+    val: ArrayDataset
+    test: ArrayDataset
+    feature_names: tuple[str, ...]
+    norm_mean: np.ndarray
+    norm_std: np.ndarray
+    # Target standardization (train stats): training runs in scaled units so
+    # the clip=6 loss is meaningful; invert with y*target_std + target_mean.
+    target_mean: float = 0.0
+    target_std: float = 1.0
+
+    def inverse_target(self, y: np.ndarray) -> np.ndarray:
+        return np.asarray(y) * self.target_std + self.target_mean
+
+
+_SEQ_CHANNELS = ("pressure", "choke", "glr", "temperature", "water_cut")
+
+
+def prepare_windowed(
+    wells: Sequence[WellLog],
+    window: int = 24,
+    stride: int = 1,
+    seed: int = 0,
+    fractions: Sequence[float] = DEFAULT_FRACTIONS,
+    teacher_forcing: bool = False,
+) -> WindowedSplits:
+    """Sequence-model path: window each well's log, then split by window.
+
+    Splitting happens at the *window* level across all wells (the
+    multi-well training population), with normalization stats computed from
+    the training windows only.
+    """
+    xs, ys = [], []
+    for w in wells:
+        series = np.stack(
+            [getattr(w, ch) for ch in _SEQ_CHANNELS], axis=1
+        ).astype(np.float32)
+        fn = teacher_forcing_pairs if teacher_forcing else sliding_windows
+        x, y = fn(series, w.flow, length=window, stride=stride)
+        if len(x):
+            xs.append(x)
+            ys.append(y)
+    x = np.concatenate(xs, axis=0)
+    y = np.concatenate(ys, axis=0)
+    tr_i, va_i, te_i = random_split(len(x), fractions, seed)
+
+    mean = x[tr_i].reshape(-1, x.shape[-1]).mean(axis=0)
+    std = x[tr_i].reshape(-1, x.shape[-1]).std(axis=0)
+    std = np.where(std < 1e-8, 1.0, std).astype(np.float32)
+    norm = lambda a: ((a - mean) / std).astype(np.float32)
+
+    t_mean = float(y[tr_i].mean())
+    t_std = float(y[tr_i].std())
+    t_std = t_std if t_std > 1e-8 else 1.0
+    norm_y = lambda a: ((a - t_mean) / t_std).astype(np.float32)
+
+    mk = lambda idx: ArrayDataset(norm(x[idx]), norm_y(y[idx]))
+    return WindowedSplits(
+        mk(tr_i), mk(va_i), mk(te_i), _SEQ_CHANNELS, mean, std, t_mean, t_std
+    )
+
+
+def batches(
+    dataset: ArrayDataset,
+    batch_size: int,
+    seed: int | None = None,
+    drop_remainder: bool = True,
+) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Minibatch iterator with optional shuffling.
+
+    ``drop_remainder=True`` keeps every batch the same shape — one XLA
+    compilation for the whole epoch (SURVEY.md §7: no per-schema/shape
+    recompilation blowups).
+    """
+    n = dataset.n
+    order = (
+        np.random.default_rng(seed).permutation(n)
+        if seed is not None
+        else np.arange(n)
+    )
+    stop = n - (n % batch_size) if drop_remainder else n
+    for s in range(0, stop, batch_size):
+        idx = order[s : s + batch_size]
+        yield dataset.x[idx], dataset.y[idx]

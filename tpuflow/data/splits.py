@@ -1,0 +1,39 @@
+"""Seeded train/val/test splitting.
+
+The reference splits 64% / 16% / 20% via Spark's ``randomSplit`` (reference
+cnn.py:68) with no seed. Here the split is deterministic given a seed, so
+runs are reproducible and resumable.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+DEFAULT_FRACTIONS = (0.64, 0.16, 0.20)
+
+
+def random_split(
+    n: int,
+    fractions: Sequence[float] = DEFAULT_FRACTIONS,
+    seed: int = 0,
+) -> tuple[np.ndarray, ...]:
+    """Partition ``range(n)`` into len(fractions) disjoint index arrays.
+
+    Fractions must sum to 1 (within tolerance). The last part absorbs
+    rounding remainder, so every index lands in exactly one part.
+    """
+    total = float(sum(fractions))
+    if abs(total - 1.0) > 1e-6:
+        raise ValueError(f"fractions must sum to 1, got {total}")
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    out = []
+    start = 0
+    for frac in fractions[:-1]:
+        size = int(round(n * frac))
+        out.append(np.sort(perm[start : start + size]))
+        start += size
+    out.append(np.sort(perm[start:]))
+    return tuple(out)

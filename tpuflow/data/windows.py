@@ -1,0 +1,69 @@
+"""Sequence windowing for the dynamic-ANN and LSTM model families.
+
+The reference family's sequence models operate on 24-step well-log windows
+(BASELINE.json configs; reference Readme.md:19-21 — the scripts themselves
+are absent from the snapshot, so this implements the documented intent).
+Windows are materialized host-side as static-shape arrays; the time axis is
+consumed on-chip by ``lax.scan`` (SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+DEFAULT_WINDOW = 24
+
+
+def sliding_windows(
+    series: np.ndarray,
+    targets: np.ndarray,
+    length: int = DEFAULT_WINDOW,
+    stride: int = 1,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Windows over a single well's log.
+
+    Args:
+      series: [T, F] per-timestep features.
+      targets: [T] per-timestep target (e.g. flow rate).
+      length: window length (24 per BASELINE configs).
+      stride: hop between window starts.
+
+    Returns:
+      (windows [N, length, F], y [N]) where ``y[i]`` is the target at the
+      window's **last** step — the "predict current flow from the trailing
+      window" task of the dynamic models.
+    """
+    T = series.shape[0]
+    if T < length:
+        return (
+            np.zeros((0, length, series.shape[1]), dtype=np.float32),
+            np.zeros((0,), dtype=np.float32),
+        )
+    starts = np.arange(0, T - length + 1, stride)
+    windows = np.stack([series[s : s + length] for s in starts])
+    y = targets[starts + length - 1]
+    return windows.astype(np.float32), y.astype(np.float32)
+
+
+def teacher_forcing_pairs(
+    series: np.ndarray,
+    targets: np.ndarray,
+    length: int = DEFAULT_WINDOW,
+    stride: int = 1,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Teacher-forced sequence pairs for LSTM training (BASELINE "LSTM-64
+    single-well sequence model (teacher-forced)").
+
+    Returns (windows [N, length, F], y [N, length]) — a target for *every*
+    step, so the LSTM is supervised along the whole sequence.
+    """
+    T = series.shape[0]
+    if T < length:
+        return (
+            np.zeros((0, length, series.shape[1]), dtype=np.float32),
+            np.zeros((0, length), dtype=np.float32),
+        )
+    starts = np.arange(0, T - length + 1, stride)
+    windows = np.stack([series[s : s + length] for s in starts])
+    y = np.stack([targets[s : s + length] for s in starts])
+    return windows.astype(np.float32), y.astype(np.float32)

@@ -1,0 +1,14 @@
+"""Flax model family — the TPU-native rebuild of the reference's model suite.
+
+The reference names four learned model types plus a physical baseline
+(reference Readme.md:7-21): a static ANN, a dynamic ANN, a 1-D CNN (the one
+surviving script, cnn.py:110-114), and an LSTM; BASELINE.json adds the
+multi-well stacked-LSTM data-parallel config. Each is a ``flax.linen``
+module here, shaped for the MXU: dense/conv compute in large batched
+matmuls, recurrence via an on-chip scan.
+"""
+
+from tpuflow.models.mlp import StaticMLP, DynamicMLP, GilbertResidualMLP  # noqa: F401
+from tpuflow.models.cnn import CNN1D  # noqa: F401
+from tpuflow.models.lstm import LSTMRegressor  # noqa: F401
+from tpuflow.models.registry import MODELS, build_model  # noqa: F401

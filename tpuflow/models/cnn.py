@@ -1,0 +1,34 @@
+"""1-D CNN regressor — parity with the reference's only coded model.
+
+The reference model (cnn.py:110-114, Keras-0.x positional style) is:
+``Convolution1D(input_dim=1, nb_filter=100, filter_length=13,
+activation="relu")`` → ``Dropout(0.5)`` → ``Flatten`` → ``Dense``. Rebuilt
+here as a Flax module over [B, T, F] windows: Conv(100 filters, width 13,
+relu) → dropout 0.5 → flatten → dense head. The reference head's odd
+``Dense(3600, 12)`` 12-unit output is part of its never-ran glue
+(SURVEY.md C10/C14); the documented intent — a regression script whose
+loss is clipped MAE against a scalar flow target — needs a scalar head,
+so the head is Dense(1).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class CNN1D(nn.Module):
+    """[B, T, F] -> [B] via 1-D convolution over the time axis."""
+
+    filters: int = 100
+    kernel_size: int = 13
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, deterministic: bool = True) -> jnp.ndarray:
+        x = nn.relu(
+            nn.Conv(features=self.filters, kernel_size=(self.kernel_size,))(x)
+        )
+        x = nn.Dropout(self.dropout_rate, deterministic=deterministic)(x)
+        x = x.reshape(x.shape[0], -1)
+        return nn.Dense(1)(x)[..., 0]

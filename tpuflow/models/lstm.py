@@ -1,0 +1,102 @@
+"""LSTM sequence regressors — the flagship, throughput-critical family.
+
+The reference names an LSTM model for dynamic flow prediction (reference
+Readme.md:21; SURVEY.md C19 — script absent from the snapshot) and the
+north-star benchmark is "LSTM-64 single-well sequence model
+(teacher-forced)" plus "multi-well stacked-LSTM, data-parallel"
+(BASELINE.json configs) at ≥10k samples/sec/chip.
+
+TPU-first design (SURVEY.md §3.4, §7 "hard parts"):
+
+- **Input projections are hoisted out of the recurrence.** ``x_t @ W_x``
+  for all timesteps is ONE large ``[B*T, F] x [F, 4H]`` matmul that tiles
+  onto the MXU, instead of T skinny per-step matmuls.
+- The remaining per-step work — ``h @ W_h`` plus the elementwise gate
+  math — runs in a single ``lax.scan`` over the time axis, carrying
+  ``(h, c)``. XLA fuses the gate elementwise ops into the recurrent
+  matmul's epilogue.
+- All four gates share one fused weight matrix ``[·, 4H]``; the forget
+  gate gets the standard +1 bias at init.
+- Optional bfloat16 compute (params stay float32) for MXU-native matmuls.
+
+A Pallas fused-cell kernel can replace the scan body without changing this
+module's interface (``tpuflow.kernels``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class LSTMLayer(nn.Module):
+    """One LSTM layer: [B, T, F] -> [B, T, H], batch-major in/out."""
+
+    hidden: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        B, T, F = x.shape
+        H = self.hidden
+        w_x = self.param("w_x", nn.initializers.lecun_normal(), (F, 4 * H))
+        w_h = self.param("w_h", nn.initializers.orthogonal(), (H, 4 * H))
+        # Forget-gate bias +1 (gate order: i, f, g, o).
+        b = self.param(
+            "b",
+            lambda key, shape: jnp.concatenate(
+                [jnp.zeros(H), jnp.ones(H), jnp.zeros(2 * H)]
+            ).astype(jnp.float32),
+            (4 * H,),
+        )
+        dt = self.dtype
+        x = x.astype(dt)
+        w_x, w_h, b = w_x.astype(dt), w_h.astype(dt), b.astype(dt)
+
+        # Hoisted input projection: one MXU-sized matmul for all timesteps.
+        xw = (x.reshape(B * T, F) @ w_x).reshape(B, T, 4 * H)
+        xw = jnp.swapaxes(xw, 0, 1)  # time-major for the scan: [T, B, 4H]
+
+        def step(carry, xw_t):
+            h, c = carry
+            z = xw_t + h @ w_h + b
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            c = nn.sigmoid(f) * c + nn.sigmoid(i) * jnp.tanh(g)
+            h = nn.sigmoid(o) * jnp.tanh(c)
+            return (h, c), h
+
+        h0 = jnp.zeros((B, H), dtype=dt)
+        (_, _), hs = lax.scan(step, (h0, h0), xw)
+        return jnp.swapaxes(hs, 0, 1)  # back to batch-major [B, T, H]
+
+
+class LSTMRegressor(nn.Module):
+    """Stacked-LSTM flow regressor.
+
+    ``num_layers=1, hidden=64`` is the BASELINE "LSTM-64" config;
+    ``num_layers>=2`` is the "multi-well stacked-LSTM" config. With
+    ``readout="sequence"`` the head emits a prediction per step ([B, T],
+    teacher-forced training); ``readout="last"`` emits only the final step
+    ([B]).
+    """
+
+    hidden: int = 64
+    num_layers: int = 1
+    readout: str = "sequence"  # "sequence" | "last"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, deterministic: bool = True) -> jnp.ndarray:
+        for layer in range(self.num_layers):
+            x = LSTMLayer(self.hidden, dtype=self.dtype, name=f"lstm_{layer}")(x)
+        y = nn.Dense(1, dtype=self.dtype, name="head")(x)[..., 0]  # [B, T]
+        y = y.astype(jnp.float32)
+        if self.readout == "last":
+            return y[:, -1]
+        if self.readout == "sequence":
+            return y
+        raise ValueError(f"unknown readout {self.readout!r}")

@@ -1,0 +1,75 @@
+"""MLP regressors: the static and dynamic ANN model families.
+
+Static ANN (SURVEY.md C17; reference Readme.md:17, BASELINE "3-layer MLP
+single-well regressor"): an MLP over the assembled tabular feature vector.
+
+Dynamic ANN (SURVEY.md C18; reference Readme.md:19, BASELINE "windowed MLP
+on 24-step well-log sequences"): the same MLP over a flattened trailing
+window of time-varying features.
+
+``GilbertResidualMLP`` goes beyond the reference: it predicts a
+*multiplicative correction* to the Gilbert physical prediction — the
+physics-informed variant the reference's pairing of a physical model with
+learned regressors (Readme.md:7-21) gestures at but never builds.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class StaticMLP(nn.Module):
+    """3-layer MLP over tabular features: [B, F] -> [B]."""
+
+    hidden: Sequence[int] = (64, 64)
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, deterministic: bool = True) -> jnp.ndarray:
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+            if self.dropout_rate > 0:
+                x = nn.Dropout(self.dropout_rate, deterministic=deterministic)(x)
+        return nn.Dense(1)(x)[..., 0]
+
+
+class DynamicMLP(nn.Module):
+    """Windowed MLP: [B, T, F] -> [B], flattening the trailing window."""
+
+    hidden: Sequence[int] = (128, 64)
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, deterministic: bool = True) -> jnp.ndarray:
+        x = x.reshape(x.shape[0], -1)
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+            if self.dropout_rate > 0:
+                x = nn.Dropout(self.dropout_rate, deterministic=deterministic)(x)
+        return nn.Dense(1)(x)[..., 0]
+
+
+class GilbertResidualMLP(nn.Module):
+    """Physics-informed MLP: Gilbert flow × learned correction.
+
+    Expects the Gilbert-equation prediction as the LAST feature column
+    (un-standardized); the MLP maps the remaining features to a positive
+    correction factor via softplus, centred at 1.
+    """
+
+    hidden: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, deterministic: bool = True) -> jnp.ndarray:
+        gilbert_q = x[..., -1]
+        h = x[..., :-1]
+        for width in self.hidden:
+            h = nn.relu(nn.Dense(width)(h))
+        # Zero-init head => raw=0 at init => softplus(0.5413)=1.0: training
+        # starts exactly at the physical model and learns deviations.
+        raw = nn.Dense(1, kernel_init=nn.initializers.zeros)(h)[..., 0]
+        correction = nn.softplus(raw + 0.5413)
+        return gilbert_q * correction

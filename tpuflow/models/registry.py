@@ -1,0 +1,36 @@
+"""Model registry: name -> constructor, the L4 "one script per model type"
+layer of the reference (SURVEY.md §1) collapsed into a single lookup."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tpuflow.models.cnn import CNN1D
+from tpuflow.models.lstm import LSTMRegressor
+from tpuflow.models.mlp import DynamicMLP, GilbertResidualMLP, StaticMLP
+
+MODELS: dict[str, Callable[..., nn.Module]] = {
+    # BASELINE config 1: "Static ANN: 3-layer MLP single-well regressor"
+    "static_mlp": lambda **kw: StaticMLP(**kw),
+    # BASELINE config 3: "Dynamic ANN: windowed MLP on 24-step well-logs"
+    "dynamic_mlp": lambda **kw: DynamicMLP(**kw),
+    # Reference cnn.py parity model
+    "cnn1d": lambda **kw: CNN1D(**kw),
+    # BASELINE config 4: "LSTM-64 single-well sequence model"
+    "lstm": lambda **kw: LSTMRegressor(**{"hidden": 64, **kw}),
+    # BASELINE config 5: "Multi-well stacked-LSTM"
+    "stacked_lstm": lambda **kw: LSTMRegressor(
+        **{"hidden": 64, "num_layers": 2, **kw}
+    ),
+    # Physics-informed extension (Gilbert x learned correction)
+    "gilbert_residual": lambda **kw: GilbertResidualMLP(**kw),
+}
+
+
+def build_model(name: str, **kwargs) -> nn.Module:
+    if name not in MODELS:
+        raise ValueError(f"unknown model {name!r}; known: {sorted(MODELS)}")
+    return MODELS[name](**kwargs)

@@ -1,0 +1,25 @@
+"""Distributed layer: device mesh, collectives, data-parallel training.
+
+TPU-native replacement for the reference's entire distributed story —
+Spark executors over a Hadoop cluster reached via ``spark-submit``
+(reference Readme.md:3-4; SURVEY.md §5.8). Here the cluster runtime is the
+XLA runtime itself: a ``jax.sharding.Mesh`` over TPU chips, SPMD train
+steps compiled with ``shard_map``/``jit``, gradient all-reduce as
+``lax.pmean`` riding ICI, and ``jax.distributed`` for multi-host pods over
+DCN. No JVM, no shuffle service, no executor processes.
+"""
+
+from tpuflow.parallel.mesh import make_mesh, data_sharding, replicated  # noqa: F401
+from tpuflow.parallel.collectives import (  # noqa: F401
+    all_gather,
+    pmean,
+    ppermute_ring,
+    psum,
+    reduce_scatter,
+)
+from tpuflow.parallel.dp import (  # noqa: F401
+    make_dp_eval_step,
+    make_dp_train_step,
+    shard_batch,
+)
+from tpuflow.parallel.distributed import init_distributed  # noqa: F401

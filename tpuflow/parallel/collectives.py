@@ -1,0 +1,42 @@
+"""Named wrappers over XLA collectives.
+
+The framework's communication vocabulary — psum / pmean / all_gather /
+reduce_scatter / ring ppermute — compiled by XLA onto ICI (in-pod) or DCN
+(cross-pod), replacing the reference's implicit Spark JVM shuffle/RPC
+transport (SURVEY.md §5.8). All of these are meaningful only inside an
+SPMD region (``shard_map``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpuflow.parallel.mesh import DATA_AXIS
+
+
+def psum(x, axis: str = DATA_AXIS):
+    return lax.psum(x, axis)
+
+
+def pmean(x, axis: str = DATA_AXIS):
+    return lax.pmean(x, axis)
+
+
+def all_gather(x, axis: str = DATA_AXIS, *, tiled: bool = True):
+    return lax.all_gather(x, axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str = DATA_AXIS):
+    """Sum-reduce across the axis, scattering equal chunks of the leading
+    dim to each participant."""
+    return lax.psum_scatter(x, axis, tiled=True)
+
+
+def ppermute_ring(x, axis: str = DATA_AXIS, shift: int = 1):
+    """Rotate shards around the mesh axis ring — the primitive under ring
+    attention and pipeline schedules."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)

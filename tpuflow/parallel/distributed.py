@@ -1,0 +1,44 @@
+"""Multi-host initialization.
+
+On a multi-host pod, ``jax.distributed.initialize`` brings up the
+cross-host control plane (DCN); in-pod collectives still ride ICI. This is
+the moral equivalent of the reference's ``spark-submit`` cluster attach
+(reference Readme.md:3) — one call, environment-driven, no-op when single
+process.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Initialize multi-host JAX if a cluster environment is present.
+
+    Explicit args win; otherwise standard env vars
+    (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``
+    or a TPU pod's auto-detected environment) are used. Returns True if
+    distributed mode was initialized.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+
+    if coordinator_address is None and num_processes is None:
+        return False  # single-process: nothing to do
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True

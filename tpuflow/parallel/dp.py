@@ -1,0 +1,111 @@
+"""Data-parallel SPMD train/eval steps.
+
+The TPU-native equivalent of the reference's Spark-executor data
+parallelism (SURVEY.md §2, §5.8): each device holds a replica of the
+params and a shard of the batch; gradients are all-reduced with
+``lax.pmean`` over the ``data`` mesh axis inside one compiled step. The
+SPMD region is expressed with ``jax.shard_map`` — collectives are explicit
+and auditable — then jitted, so XLA lays the all-reduce on ICI.
+
+Per-device RNG is decorrelated by folding the device's axis index into the
+dropout key.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpuflow.core.losses import mae_clip
+from tpuflow.parallel.mesh import DATA_AXIS, data_sharding
+
+LossFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+
+def make_dp_train_step(
+    mesh: Mesh, loss_fn: LossFn = mae_clip, axis: str = DATA_AXIS
+):
+    """Jitted SPMD step: (state, x, y, rng) -> (state, metrics).
+
+    ``state`` is replicated; ``x``/``y`` are sharded on the batch dim.
+    """
+
+    def body(state, x, y, rng):
+        # Decorrelate dropout across devices and steps.
+        local_rng = jax.random.fold_in(
+            jax.random.fold_in(rng, state.step), lax.axis_index(axis)
+        )
+
+        def loss_of(params):
+            pred = state.apply_fn(
+                {"params": params},
+                x,
+                deterministic=False,
+                rngs={"dropout": local_rng},
+            )
+            return loss_fn(y, pred)
+
+        loss, grads = jax.value_and_grad(loss_of)(state.params)
+        # The DP collective: gradient all-reduce over ICI.
+        grads = lax.pmean(grads, axis)
+        loss = lax.pmean(loss, axis)
+        state = state.apply_gradients(grads=grads)
+        return state, {"loss": loss}
+
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_dp_eval_step(
+    mesh: Mesh, loss_fn: LossFn = mae_clip, axis: str = DATA_AXIS
+):
+    """Jitted SPMD eval step with masked sums (see train.steps.make_eval_step)."""
+
+    def body(state, x, y, mask):
+        pred = state.apply_fn({"params": state.params}, x, deterministic=True)
+        per_loss = jax.vmap(loss_fn)(y, pred)
+        per_mae = jnp.abs(y - pred).reshape(y.shape[0], -1).mean(axis=1)
+        return {
+            "loss_sum": lax.psum(jnp.sum(per_loss * mask), axis),
+            "mae_sum": lax.psum(jnp.sum(per_mae * mask), axis),
+            "count": lax.psum(jnp.sum(mask), axis),
+        }
+
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P(axis)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def shard_batch(mesh: Mesh, *arrays):
+    """Device_put host arrays with leading-dim sharding over the data axis.
+
+    The batch size must divide the data-axis size (keep global batches a
+    multiple of the mesh; the host pipeline's drop_remainder guarantees
+    this).
+    """
+    sharding = data_sharding(mesh)
+    out = tuple(jax.device_put(np.asarray(a), sharding) for a in arrays)
+    return out if len(out) > 1 else out[0]
+
+
+def replicate(mesh: Mesh, tree):
+    """Replicate a pytree (e.g. TrainState) across the mesh."""
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(tree, sharding)

@@ -1,0 +1,47 @@
+"""Device-mesh construction and sharding helpers.
+
+Axis conventions (scaling-book style):
+- ``data``  — batch (data-parallel) axis; gradient all-reduce rides ICI.
+- ``model`` — reserved tensor-parallel axis (size 1 for the flow models,
+  which are far below the per-chip HBM limit, but the API keeps it
+  expressible per SURVEY.md §2's TP note).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    n_data: int | None = None,
+    n_model: int = 1,
+    devices=None,
+) -> Mesh:
+    """Build a ``(data, model)`` mesh over the available devices.
+
+    Defaults to all devices on the data axis — the reference family's only
+    parallelism (SURVEY.md §2 "Parallelism strategies").
+    """
+    devices = devices if devices is not None else jax.devices()
+    if n_data is None:
+        n_data = len(devices) // n_model
+    if n_data * n_model != len(devices):
+        raise ValueError(
+            f"mesh {n_data}x{n_model} != {len(devices)} devices"
+        )
+    return jax.make_mesh(
+        (n_data, n_model), (DATA_AXIS, MODEL_AXIS), devices=devices
+    )
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch-dim sharding: leading axis split over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

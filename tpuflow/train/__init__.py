@@ -1,0 +1,16 @@
+"""Training: optimizer/state, jitted steps, callbacks, fit loop, checkpoints.
+
+TPU-native rebuild of the reference's Keras training layer (L3, reference
+cnn.py:110-134): SGD with the reference's exact hyperparameters, early
+stopping on val_loss (patience 10), save-best checkpointing, and the
+elapsed-time + test-loss final report — plus what the reference lacked:
+deterministic resume, structured per-step metrics, and samples/sec/chip
+accounting.
+"""
+
+from tpuflow.train.optim import keras_sgd, build_optimizer  # noqa: F401
+from tpuflow.train.state import create_state  # noqa: F401
+from tpuflow.train.steps import make_train_step, make_eval_step  # noqa: F401
+from tpuflow.train.callbacks import EarlyStopping  # noqa: F401
+from tpuflow.train.checkpoint import BestCheckpointer  # noqa: F401
+from tpuflow.train.loop import FitConfig, FitResult, fit, evaluate  # noqa: F401

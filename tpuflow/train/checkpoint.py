@@ -1,0 +1,68 @@
+"""Orbax checkpointing: save-best plus resume.
+
+The reference saves only the best model by val_loss to shared storage —
+``ModelCheckpoint(storagePath + "models/cnn.mdl", save_best_only=True)``
+(reference cnn.py:122) — with **no** resume path. Here save-best is kept
+(same contract: best-by-val-loss under ``{storage_path}/models/{name}``)
+and resume is added: restoring the latest/best checkpoint is the TPU-native
+answer to Spark's task-retry fault-tolerance story (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class BestCheckpointer:
+    """Save-best-by-val-loss checkpoint manager with restore support."""
+
+    def __init__(self, storage_path: str, name: str = "model"):
+        # Same artifact layout as the reference: {storagePath}/models/{name}
+        # (reference cnn.py:39,122 — MDL_NAME constant + path join).
+        self.directory = os.path.abspath(
+            os.path.join(storage_path, "models", name)
+        )
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=1,
+                best_fn=lambda metrics: metrics["val_loss"],
+                best_mode="min",
+                enable_async_checkpointing=False,
+            ),
+        )
+
+    def maybe_save(self, step: int, params: Any, val_loss: float) -> bool:
+        """Offer a checkpoint; the manager keeps it only if it's the best."""
+        saved = self._mngr.save(
+            step,
+            args=ocp.args.StandardSave(params),
+            metrics={"val_loss": float(val_loss)},
+        )
+        self._mngr.wait_until_finished()
+        return bool(saved)
+
+    @property
+    def best_step(self) -> int | None:
+        return self._mngr.best_step()
+
+    def restore_best(self, params_like: Any | None = None) -> Any:
+        """Restore the best params (optionally into an example structure)."""
+        step = self._mngr.best_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        if params_like is not None:
+            abstract = jax.tree_util.tree_map(
+                ocp.utils.to_shape_dtype_struct, params_like
+            )
+            return self._mngr.restore(
+                step, args=ocp.args.StandardRestore(abstract)
+            )
+        return self._mngr.restore(step)
+
+    def close(self):
+        self._mngr.close()

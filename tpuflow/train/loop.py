@@ -1,0 +1,152 @@
+"""The fit loop: epochs, early stopping, save-best, timing, final report.
+
+Behavioral parity with the reference's training driver (reference
+cnn.py:121-134): up to 1000 epochs of minibatch SGD (batch 20), early
+stopping on val_loss with patience 10, best-model checkpointing, wall-clock
+timing around fit, and a final elapsed-time + test-loss report — minus its
+[BUG]s (the Spark-DataFrame seam C14 and the py2 print C15) and plus
+structured metrics (samples/sec/chip, grad norm).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+import jax
+import numpy as np
+
+from tpuflow.core.losses import mae_clip
+from tpuflow.data.pipeline import ArrayDataset, batches
+from tpuflow.train.callbacks import EarlyStopping
+from tpuflow.train.checkpoint import BestCheckpointer
+from tpuflow.train.steps import make_eval_step, make_train_step
+
+
+@dataclass
+class FitConfig:
+    # Reference defaults: cnn.py:121 (patience), cnn.py:128 (epochs, batch).
+    max_epochs: int = 1000
+    batch_size: int = 20
+    patience: int = 10
+    seed: int = 0
+    loss: Callable = mae_clip
+    storage_path: str | None = None  # enables save-best checkpointing
+    model_name: str = "model"
+    verbose: bool = True
+    log_every: int = 1  # epochs between log lines
+
+
+@dataclass
+class FitResult:
+    state: object
+    history: list = field(default_factory=list)
+    time_elapsed: float = 0.0
+    test_loss: float | None = None
+    test_mae: float | None = None
+    best_val_loss: float = float("inf")
+    epochs_ran: int = 0
+    samples_per_sec: float = 0.0
+
+    def report(self) -> str:
+        """The reference's final report (cnn.py:133-134), working and extended."""
+        lines = [
+            f"Time elapsed: {self.time_elapsed:.2f}s",
+            f"Testing set loss: {self.test_loss}",
+            f"Throughput: {self.samples_per_sec:.0f} samples/sec/chip",
+        ]
+        return "\n".join(lines)
+
+
+def fit(
+    state,
+    train_ds: ArrayDataset,
+    val_ds: ArrayDataset,
+    config: FitConfig = FitConfig(),
+    train_step=None,
+    eval_step=None,
+) -> FitResult:
+    """Train with early stopping and optional save-best checkpointing.
+
+    ``train_step``/``eval_step`` may be injected (e.g. the data-parallel
+    sharded steps from ``tpuflow.parallel``); defaults are the single-chip
+    jitted steps.
+    """
+    train_step = train_step or make_train_step(config.loss)
+    eval_step = eval_step or make_eval_step(config.loss)
+    rng = jax.random.PRNGKey(config.seed)
+
+    stopper = EarlyStopping(patience=config.patience)
+    ckpt = (
+        BestCheckpointer(config.storage_path, config.model_name)
+        if config.storage_path
+        else None
+    )
+    result = FitResult(state=state)
+    samples_seen = 0
+    t0 = time.time()
+
+    for epoch in range(1, config.max_epochs + 1):
+        te = time.time()
+        train_losses = []
+        for x, y in batches(
+            train_ds, config.batch_size, seed=config.seed + epoch
+        ):
+            state, metrics = train_step(state, x, y, rng)
+            train_losses.append(metrics["loss"])
+            samples_seen += len(x)
+
+        val = _eval_dataset(eval_step, state, val_ds, config.batch_size)
+        train_loss = float(np.mean([float(l) for l in train_losses]))
+        epoch_time = time.time() - te
+        result.history.append(
+            {"epoch": epoch, "loss": train_loss, "val_loss": val["loss"],
+             "val_mae": val["mae"], "time": epoch_time}
+        )
+        if config.verbose and epoch % config.log_every == 0:
+            print(
+                f"Epoch {epoch}/{config.max_epochs} - {epoch_time:.2f}s"
+                f" - loss: {train_loss:.4f} - val_loss: {val['loss']:.4f}"
+            )
+
+        if val["loss"] < result.best_val_loss:
+            result.best_val_loss = val["loss"]
+        should_stop = stopper.update(val["loss"])
+        if ckpt is not None and stopper.improved:
+            ckpt.maybe_save(epoch, state.params, val["loss"])
+        result.epochs_ran = epoch
+        if should_stop:
+            break
+
+    result.time_elapsed = time.time() - t0
+    result.samples_per_sec = samples_seen / max(result.time_elapsed, 1e-9)
+    result.state = state
+    if ckpt is not None:
+        ckpt.close()
+    return result
+
+
+def evaluate(state, ds: ArrayDataset, batch_size: int = 256, eval_step=None, loss=mae_clip):
+    """Full-dataset eval: mean loss/MAE over fixed-size batches."""
+    eval_step = eval_step or make_eval_step(loss)
+    return _eval_dataset(eval_step, state, ds, batch_size)
+
+
+def _eval_dataset(eval_step, state, ds: ArrayDataset, batch_size: int):
+    loss_sum = mae_sum = count = 0.0
+    for x, y in batches(ds, batch_size, seed=None, drop_remainder=False):
+        # Pad the tail batch to the fixed shape (one XLA compile), mask the
+        # pad rows out of the aggregation (exact dataset metrics).
+        n = len(x)
+        mask = np.ones(batch_size, dtype=np.float32)
+        if n < batch_size:
+            pad = batch_size - n
+            x = np.concatenate([x, np.repeat(x[-1:], pad, axis=0)])
+            y = np.concatenate([y, np.repeat(y[-1:], pad, axis=0)])
+            mask[n:] = 0.0
+        m = eval_step(state, x, y, mask)
+        loss_sum += float(m["loss_sum"])
+        mae_sum += float(m["mae_sum"])
+        count += float(m["count"])
+    return {"loss": loss_sum / count, "mae": mae_sum / count}

@@ -1,0 +1,35 @@
+"""Optimizers.
+
+The reference's optimizer (SURVEY.md C11, reference cnn.py:117-118):
+``SGD(lr=0.001, momentum=0.99, decay=1e-6, nesterov=True)``. Keras-era
+``decay`` is a per-update learning-rate decay ``lr_t = lr / (1 + decay*t)``
+— reproduced here as an optax schedule.
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def keras_sgd(
+    learning_rate: float = 1e-3,
+    momentum: float = 0.99,
+    decay: float = 1e-6,
+    nesterov: bool = True,
+) -> optax.GradientTransformation:
+    """SGD with Keras-style inverse-time lr decay (reference defaults)."""
+
+    def schedule(step):
+        return learning_rate / (1.0 + decay * step)
+
+    return optax.sgd(schedule, momentum=momentum, nesterov=nesterov)
+
+
+def build_optimizer(name: str = "keras_sgd", **kwargs) -> optax.GradientTransformation:
+    if name == "keras_sgd":
+        return keras_sgd(**kwargs)
+    if name == "adam":
+        return optax.adam(kwargs.pop("learning_rate", 1e-3), **kwargs)
+    if name == "adamw":
+        return optax.adamw(kwargs.pop("learning_rate", 1e-3), **kwargs)
+    raise ValueError(f"unknown optimizer {name!r}")

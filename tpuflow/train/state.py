@@ -1,0 +1,24 @@
+"""Train state construction."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training import train_state
+
+from tpuflow.train.optim import keras_sgd
+
+
+def create_state(
+    model: nn.Module,
+    rng: jax.Array,
+    sample_x: jnp.ndarray,
+    tx: optax.GradientTransformation | None = None,
+) -> train_state.TrainState:
+    """Initialize params from a sample batch and wrap them in a TrainState."""
+    params = model.init(rng, jnp.asarray(sample_x))["params"]
+    return train_state.TrainState.create(
+        apply_fn=model.apply, params=params, tx=tx or keras_sgd()
+    )

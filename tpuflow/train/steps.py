@@ -1,0 +1,76 @@
+"""Jitted train/eval steps.
+
+Each step is traced once per (model, shape) and reused for the whole run —
+the XLA contract SURVEY.md §7 calls out. Dropout randomness is derived by
+folding the step counter into a base rng, so steps stay functional.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from flax.training.train_state import TrainState
+
+from tpuflow.core.losses import mae_clip
+
+LossFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+
+def make_train_step(loss_fn: LossFn = mae_clip, donate: bool = True):
+    """Build a jitted step: (state, x, y, rng) -> (state, metrics)."""
+
+    def step(state: TrainState, x, y, rng):
+        dropout_rng = jax.random.fold_in(rng, state.step)
+
+        def loss_of(params):
+            pred = state.apply_fn(
+                {"params": params},
+                x,
+                deterministic=False,
+                rngs={"dropout": dropout_rng},
+            )
+            return loss_fn(y, pred)
+
+        loss, grads = jax.value_and_grad(loss_of)(state.params)
+        state = state.apply_gradients(grads=grads)
+        gnorm = optax_global_norm(grads)
+        return state, {"loss": loss, "grad_norm": gnorm}
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(loss_fn: LossFn = mae_clip):
+    """Build a jitted eval step returning masked per-example SUMS.
+
+    Returning sums + a valid-row mask (instead of a batch mean) lets the
+    caller pad the tail batch to the fixed XLA shape and still aggregate
+    exact dataset-level metrics.
+    """
+
+    def step(state: TrainState, x, y, mask):
+        pred = state.apply_fn({"params": state.params}, x, deterministic=True)
+        per_loss = jax.vmap(loss_fn)(y, pred)  # [B]: per-example mean loss
+        per_mae = jnp.abs(y - pred).reshape(y.shape[0], -1).mean(axis=1)
+        return {
+            "loss_sum": jnp.sum(per_loss * mask),
+            "mae_sum": jnp.sum(per_mae * mask),
+            "count": jnp.sum(mask),
+        }
+
+    return jax.jit(step)
+
+
+def make_predict(model_apply):
+    """Jitted deterministic forward pass."""
+
+    def predict(params, x):
+        return model_apply({"params": params}, x, deterministic=True)
+
+    return jax.jit(predict)
+
+
+def optax_global_norm(tree) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l)) for l in leaves))
