@@ -1,0 +1,1 @@
+"""The five BASELINE-config benchmarks (BASELINE.md "Targets to establish")."""

@@ -1,0 +1,44 @@
+"""BASELINE config 3: Dynamic ANN — windowed MLP on 24-step well logs."""
+
+from __future__ import annotations
+
+import sys
+
+sys.path.insert(0, ".")
+
+from benchmarks.common import emit
+from tpuflow.api import TrainJobConfig, train
+
+
+def main(seed: int = 0) -> None:
+    report = train(
+        TrainJobConfig(
+            model="dynamic_mlp",
+            window=24,
+            max_epochs=80,
+            batch_size=256,
+            patience=10,
+            seed=seed,
+            verbose=False,
+            n_devices=1,
+        )
+    )
+    emit(
+        "dynamic_ann",
+        "well_flow_mae",
+        report.test_mae,
+        "stb/day",
+        gilbert_mae=round(report.gilbert_mae, 4),
+        beats_gilbert=report.test_mae <= report.gilbert_mae,
+    )
+    emit(
+        "dynamic_ann",
+        "train_throughput",
+        report.result.samples_per_sec,
+        "samples/sec/chip",
+    )
+    emit("dynamic_ann", "train_wallclock", report.time_elapsed, "s")
+
+
+if __name__ == "__main__":
+    main()

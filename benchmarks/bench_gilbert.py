@@ -1,0 +1,51 @@
+"""BASELINE config 2: Gilbert physical-equation baseline.
+
+The closed-form accuracy yardstick every learned model is judged against
+(reference Readme.md:7-8; SURVEY.md §3.3). Reports the Gilbert MAE on the
+synthetic test rows and the closed-form predict throughput.
+"""
+
+from __future__ import annotations
+
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import numpy as np
+
+from benchmarks.common import emit, time_steps
+from tpuflow.core.gilbert import gilbert_flow
+from tpuflow.data.splits import random_split
+from tpuflow.data.synthetic import generate_wells, wells_to_table
+
+
+def main(seed: int = 0) -> None:
+    table = wells_to_table(generate_wells(n_wells=8, steps=512, seed=seed))
+    n = len(table["flow"])
+    _, _, te = random_split(n, seed=seed)
+
+    pred = np.asarray(
+        gilbert_flow(table["pressure"][te], table["choke"][te], table["glr"][te])
+    )
+    mae = float(np.mean(np.abs(table["flow"][te] - pred)))
+    emit("gilbert_baseline", "well_flow_mae", mae, "stb/day")
+
+    # Closed-form throughput (jitted, one big batch).
+    import jax.numpy as jnp
+
+    p = jnp.asarray(np.tile(table["pressure"], 16))
+    c = jnp.asarray(np.tile(table["choke"], 16))
+    g = jnp.asarray(np.tile(table["glr"], 16))
+    f = jax.jit(gilbert_flow)
+    steps, elapsed = time_steps(f, p, c, g, seconds=2.0, block=lambda o: o)
+    emit(
+        "gilbert_baseline",
+        "predict_throughput",
+        steps * p.shape[0] / elapsed,
+        "samples/sec/chip",
+    )
+
+
+if __name__ == "__main__":
+    main()

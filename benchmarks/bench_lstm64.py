@@ -1,0 +1,84 @@
+"""BASELINE config 4: LSTM-64 single-well sequence model (teacher-forced).
+
+The north-star config (BASELINE.json: >=10k samples/sec/chip at
+Gilbert-matching MAE). Reports:
+
+- raw jitted-train-step throughput (the number ``bench.py`` records), for
+  both the XLA-scan and the fused-Pallas-kernel backends;
+- end-to-end accuracy (well-flow MAE vs Gilbert) from a short train run.
+
+Env knobs: BENCH_BATCH (4096), BENCH_SECONDS (5).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from benchmarks.common import emit, time_train_steps
+from tpuflow.api import TrainJobConfig, train
+from tpuflow.models import LSTMRegressor
+from tpuflow.train import create_state, make_train_step
+
+
+def step_throughput(backend: str, batch: int, seconds: float) -> float:
+    model = LSTMRegressor(hidden=64, dtype=jnp.bfloat16, backend=backend)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, 24, 5)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((batch, 24)), jnp.float32)
+    state = create_state(model, jax.random.PRNGKey(0), x[:2])
+    steps, elapsed = time_train_steps(
+        state, make_train_step(), x, y, seconds=seconds
+    )
+    return batch * steps / elapsed
+
+
+def main(seed: int = 0) -> None:
+    batch = int(os.environ.get("BENCH_BATCH", 4096))
+    seconds = float(os.environ.get("BENCH_SECONDS", 5))
+
+    for backend in ("xla", "pallas"):
+        try:
+            sps = step_throughput(backend, batch, seconds)
+        except Exception as e:  # pallas unavailable on exotic backends
+            emit("lstm64", f"train_step_throughput_{backend}", -1.0, "samples/sec/chip",
+                 error=str(e)[:200])
+            continue
+        emit(
+            "lstm64",
+            f"train_step_throughput_{backend}",
+            sps,
+            "samples/sec/chip",
+            vs_north_star=round(sps / 10_000.0, 3),
+        )
+
+    report = train(
+        TrainJobConfig(
+            model="lstm",
+            window=24,
+            max_epochs=40,
+            batch_size=256,
+            patience=10,
+            seed=seed,
+            verbose=False,
+            n_devices=1,
+        )
+    )
+    emit(
+        "lstm64",
+        "well_flow_mae",
+        report.test_mae,
+        "stb/day",
+        gilbert_mae=round(report.gilbert_mae, 4),
+        beats_gilbert=report.test_mae <= report.gilbert_mae,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""BASELINE config 5: Multi-well stacked-LSTM, data-parallel over the mesh.
+
+Measures the sharded train step (psum gradient all-reduce over ICI) across
+all visible devices and reports per-chip throughput plus the DP scaling
+factor vs the single-device step. On a one-chip runner this degenerates to
+DP=1; run with XLA_FLAGS=--xla_force_host_platform_device_count=8
+JAX_PLATFORMS=cpu to exercise 8-way DP on host devices (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from benchmarks.common import emit, time_train_steps
+from tpuflow.models import LSTMRegressor
+from tpuflow.parallel import make_dp_train_step, make_mesh, shard_batch
+from tpuflow.parallel.dp import replicate
+from tpuflow.train import create_state, make_train_step
+
+
+def main() -> None:
+    per_chip_batch = int(os.environ.get("BENCH_BATCH", 2048))
+    seconds = float(os.environ.get("BENCH_SECONDS", 5))
+    n_dev = jax.device_count()
+    model = LSTMRegressor(hidden=64, num_layers=2, dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+
+    # Single-device reference.
+    x1 = jnp.asarray(rng.standard_normal((per_chip_batch, 24, 5)), jnp.float32)
+    y1 = jnp.asarray(rng.standard_normal((per_chip_batch, 24)), jnp.float32)
+    state = create_state(model, jax.random.PRNGKey(0), x1[:2])
+    steps, elapsed = time_train_steps(
+        state, make_train_step(), x1, y1, seconds=seconds
+    )
+    single = per_chip_batch * steps / elapsed
+    emit("stacked_lstm_dp", "single_device_throughput", single, "samples/sec/chip")
+
+    # DP across the full mesh, same per-chip batch.
+    B = per_chip_batch * n_dev
+    x = np.asarray(rng.standard_normal((B, 24, 5)), np.float32)
+    y = np.asarray(rng.standard_normal((B, 24)), np.float32)
+    mesh = make_mesh(n_data=n_dev)
+    state = replicate(mesh, create_state(model, jax.random.PRNGKey(0), x1[:2]))
+    dp_step = make_dp_train_step(mesh)
+    xs, ys = shard_batch(mesh, x, y)
+    steps, elapsed = time_train_steps(state, dp_step, xs, ys, seconds=seconds)
+    total = B * steps / elapsed
+    per_chip = total / n_dev
+    emit(
+        "stacked_lstm_dp",
+        "dp_throughput_per_chip",
+        per_chip,
+        "samples/sec/chip",
+        n_devices=n_dev,
+        total_throughput=round(total, 1),
+        scaling_efficiency=round(per_chip / single, 3),
+    )
+
+
+if __name__ == "__main__":
+    main()

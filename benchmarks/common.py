@@ -1,0 +1,67 @@
+"""Shared benchmark harness.
+
+Every benchmark prints one JSON line per metric:
+``{"config", "metric", "value", "unit", ...}`` — the machine-readable
+equivalent of the reference's elapsed-time/test-loss prints (reference
+cnn.py:133-134), recorded instead of lost (SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+# This environment force-registers the axon TPU platform ahead of the
+# JAX_PLATFORMS env var; honor an explicit cpu request (e.g. the 8-virtual-
+# device CI mesh) by pinning the config before the backend initializes.
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def emit(config: str, metric: str, value: float, unit: str, **extra) -> dict:
+    rec = {
+        "config": config,
+        "metric": metric,
+        "value": round(float(value), 4),
+        "unit": unit,
+        **extra,
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def time_steps(step_fn, *args, seconds: float = 5.0, block) -> tuple[int, float]:
+    """Run ``step_fn(*args)`` repeatedly for ~``seconds`` after a warmup
+    call; returns (steps, elapsed). ``block`` extracts a value to
+    block_until_ready on from the step's result."""
+    import jax
+
+    out = step_fn(*args)
+    jax.block_until_ready(block(out))
+    t0 = time.perf_counter()
+    steps = 0
+    while time.perf_counter() - t0 < seconds:
+        out = step_fn(*args)
+        steps += 1
+    jax.block_until_ready(block(out))
+    return steps, time.perf_counter() - t0
+
+
+def time_train_steps(state, step, x, y, seconds: float = 5.0):
+    """Time a (state, x, y, rng) -> (state, metrics) train step, threading
+    the state through so donation stays valid."""
+    import jax
+
+    key = jax.random.PRNGKey(0)
+    state, m = step(state, x, y, key)
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    steps = 0
+    while time.perf_counter() - t0 < seconds:
+        state, m = step(state, x, y, key)
+        steps += 1
+    jax.block_until_ready(m["loss"])
+    return steps, time.perf_counter() - t0

@@ -1,0 +1,56 @@
+"""Run the five BASELINE-config benchmarks; write benchmarks/results.json.
+
+Usage: python benchmarks/run_all.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+SCRIPTS = [
+    "bench_gilbert.py",
+    "bench_static_ann.py",
+    "bench_dynamic_ann.py",
+    "bench_lstm64.py",
+    "bench_stacked_lstm_dp.py",
+]
+
+
+def main() -> None:
+    here = os.path.dirname(os.path.abspath(__file__))
+    root = os.path.dirname(here)
+    env = dict(os.environ)
+    if "--quick" in sys.argv:
+        env.setdefault("BENCH_SECONDS", "2")
+        env.setdefault("BENCH_BATCH", "1024")
+    records = []
+    failed = []
+    for script in SCRIPTS:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(here, script)],
+            capture_output=True,
+            text=True,
+            cwd=root,
+            env=env,
+        )
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                records.append(json.loads(line))
+                print(line, flush=True)
+        if proc.returncode != 0:
+            failed.append(script)
+            print(f"[run_all] {script} FAILED:\n{proc.stderr[-2000:]}", file=sys.stderr)
+    out = os.path.join(here, "results.json")
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(records, f, indent=2)
+    print(f"[run_all] wrote {len(records)} records to {out}", file=sys.stderr)
+    if failed:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,260 @@
+// Native data plane: multithreaded headerless-CSV parser + window extraction.
+//
+// The TPU-native replacement for the reference's delegated-native data layer
+// (Spark/JVM reached via PySpark — reference cnn.py:18-23,49,65; SURVEY.md
+// §5.8): the host-side ingest that feeds the TPU now lives in-process as a
+// C shared library instead of in a JVM cluster. Exposed to Python through
+// ctypes (tpuflow/_native/__init__.py); semantics match the NumPy fallback
+// in tpuflow/data/csv_io.py exactly (same dynamic-schema contract:
+// int/float/other → int32/float32/string, reference cnn.py:53-58).
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC -pthread)
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Column {
+  int kind;  // 0=int, 1=float, 2=string
+  std::vector<int32_t> ints;
+  std::vector<float> floats;
+  std::vector<std::string> strs;
+};
+
+struct CsvTable {
+  std::vector<Column> cols;
+  long nrows = 0;
+  std::string error;
+};
+
+// Parse one chunk of the buffer [begin, end); chunk boundaries are
+// guaranteed to fall on line starts. Appends into per-chunk columns.
+bool parse_chunk(const char* begin, const char* end, int ncols,
+                 const int* kinds, std::vector<Column>& out,
+                 long* nrows, std::string& err, long approx_rows) {
+  out.resize(ncols);
+  for (int c = 0; c < ncols; ++c) {
+    out[c].kind = kinds[c];
+    if (kinds[c] == 0) out[c].ints.reserve(approx_rows);
+    else if (kinds[c] == 1) out[c].floats.reserve(approx_rows);
+    else out[c].strs.reserve(approx_rows);
+  }
+  const char* p = begin;
+  long rows = 0;
+  while (p < end) {
+    const char* line_end = static_cast<const char*>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    if (line_end == nullptr) line_end = end;
+    const char* stop = line_end;
+    while (stop > p && (stop[-1] == '\r')) --stop;
+    if (stop == p) {  // blank line — skipped, matching the NumPy fallback
+      p = line_end + 1;
+      continue;
+    }
+    const char* f = p;
+    for (int c = 0; c < ncols; ++c) {
+      const char* fe = static_cast<const char*>(
+          memchr(f, ',', static_cast<size_t>(stop - f)));
+      bool last = (c == ncols - 1);
+      if (last) {
+        if (fe != nullptr) {
+          err = "too many fields";
+          return false;
+        }
+        fe = stop;
+      } else if (fe == nullptr) {
+        err = "expected " + std::to_string(ncols) + " fields";
+        return false;
+      }
+      Column& col = out[c];
+      if (col.kind == 0 || col.kind == 1) {
+        // Tolerate surrounding whitespace, matching the NumPy fallback
+        // (np.asarray strips it). The buffer is NUL-terminated by the
+        // caller, so strtol/strtof cannot scan past the allocation.
+        const char* fs = f;
+        while (fs < fe && (*fs == ' ' || *fs == '\t')) ++fs;
+        const char* fe_trim = fe;
+        while (fe_trim > fs &&
+               (fe_trim[-1] == ' ' || fe_trim[-1] == '\t'))
+          --fe_trim;
+        char* endp = nullptr;
+        if (col.kind == 0) {
+          long v = strtol(fs, &endp, 10);
+          if (fs == fe_trim || endp != fe_trim) {
+            err = "bad int field";
+            return false;
+          }
+          col.ints.push_back(static_cast<int32_t>(v));
+        } else {
+          float v = strtof(fs, &endp);
+          if (fs == fe_trim || endp != fe_trim) {
+            err = "bad float field";
+            return false;
+          }
+          col.floats.push_back(v);
+        }
+      } else {
+        col.strs.emplace_back(f, static_cast<size_t>(fe - f));
+      }
+      f = fe + 1;
+    }
+    ++rows;
+    p = line_end + 1;
+  }
+  *nrows = rows;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns a table handle, or nullptr with *err_out filled (caller buffer).
+CsvTable* tf_csv_read(const char* path, const int* kinds, int ncols,
+                      char* err_out, int err_len) {
+  auto fail = [&](const std::string& msg) -> CsvTable* {
+    snprintf(err_out, static_cast<size_t>(err_len), "%s", msg.c_str());
+    return nullptr;
+  };
+  FILE* fp = fopen(path, "rb");
+  if (fp == nullptr) return fail(std::string("cannot open ") + path);
+  fseek(fp, 0, SEEK_END);
+  long size = ftell(fp);
+  fseek(fp, 0, SEEK_SET);
+  // +1 for a NUL terminator: files without a trailing newline would
+  // otherwise let strtol/strtof scan past the allocation.
+  std::vector<char> buf(static_cast<size_t>(size) + 1, '\0');
+  if (size > 0 && fread(buf.data(), 1, static_cast<size_t>(size), fp) !=
+                      static_cast<size_t>(size)) {
+    fclose(fp);
+    return fail("short read");
+  }
+  fclose(fp);
+
+  // Split at line boundaries into one chunk per thread.
+  unsigned hw = std::thread::hardware_concurrency();
+  int nthreads = static_cast<int>(hw == 0 ? 4 : hw);
+  if (size < (1 << 20)) nthreads = 1;  // small files: threading overhead loses
+  std::vector<std::pair<const char*, const char*>> chunks;
+  const char* base = buf.data();
+  const char* end = base + size;
+  const char* start = base;
+  for (int t = 0; t < nthreads && start < end; ++t) {
+    const char* stop =
+        (t == nthreads - 1) ? end : base + size * (t + 1) / nthreads;
+    if (stop < end) {
+      const char* nl = static_cast<const char*>(
+          memchr(stop, '\n', static_cast<size_t>(end - stop)));
+      stop = (nl == nullptr) ? end : nl + 1;
+    }
+    if (stop > start) chunks.emplace_back(start, stop);
+    start = stop;
+  }
+
+  long approx_rows_per_chunk =
+      chunks.empty() ? 0 : size / (80 * static_cast<long>(chunks.size())) + 16;
+  std::vector<std::vector<Column>> parts(chunks.size());
+  std::vector<long> part_rows(chunks.size(), 0);
+  std::vector<std::string> part_errs(chunks.size());
+  std::vector<std::thread> workers;
+  std::atomic<bool> ok{true};
+  for (size_t t = 0; t < chunks.size(); ++t) {
+    workers.emplace_back([&, t]() {
+      if (!parse_chunk(chunks[t].first, chunks[t].second, ncols, kinds,
+                       parts[t], &part_rows[t], part_errs[t],
+                       approx_rows_per_chunk)) {
+        ok = false;
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
+  if (!ok) {
+    for (auto& e : part_errs)
+      if (!e.empty()) return fail(e);
+    return fail("parse error");
+  }
+
+  auto* table = new CsvTable();
+  table->cols.resize(static_cast<size_t>(ncols));
+  for (int c = 0; c < ncols; ++c) table->cols[c].kind = kinds[c];
+  for (size_t t = 0; t < parts.size(); ++t) {
+    table->nrows += part_rows[t];
+    for (int c = 0; c < ncols; ++c) {
+      Column& dst = table->cols[static_cast<size_t>(c)];
+      Column& src = parts[t][static_cast<size_t>(c)];
+      dst.ints.insert(dst.ints.end(), src.ints.begin(), src.ints.end());
+      dst.floats.insert(dst.floats.end(), src.floats.begin(),
+                        src.floats.end());
+      for (auto& s : src.strs) dst.strs.emplace_back(std::move(s));
+    }
+  }
+  return table;
+}
+
+long tf_csv_nrows(CsvTable* t) { return t->nrows; }
+
+void tf_csv_get_int(CsvTable* t, int col, int32_t* out) {
+  const auto& v = t->cols[static_cast<size_t>(col)].ints;
+  memcpy(out, v.data(), v.size() * sizeof(int32_t));
+}
+
+void tf_csv_get_float(CsvTable* t, int col, float* out) {
+  const auto& v = t->cols[static_cast<size_t>(col)].floats;
+  memcpy(out, v.data(), v.size() * sizeof(float));
+}
+
+int tf_csv_str_maxlen(CsvTable* t, int col) {
+  size_t m = 0;
+  for (const auto& s : t->cols[static_cast<size_t>(col)].strs)
+    if (s.size() > m) m = s.size();
+  return static_cast<int>(m);
+}
+
+// Fixed-width UTF-8 bytes, zero-padded — matches numpy 'S<width>' layout.
+void tf_csv_get_str(CsvTable* t, int col, char* out, int width) {
+  const auto& v = t->cols[static_cast<size_t>(col)].strs;
+  for (size_t i = 0; i < v.size(); ++i) {
+    char* dst = out + i * static_cast<size_t>(width);
+    memset(dst, 0, static_cast<size_t>(width));
+    memcpy(dst, v[i].data(),
+           std::min(v[i].size(), static_cast<size_t>(width)));
+  }
+}
+
+void tf_csv_free(CsvTable* t) { delete t; }
+
+// ---- window extraction (tpuflow/data/windows.py fast path) ----
+
+long tf_window_count(long T, long length, long stride) {
+  if (T < length) return 0;
+  return (T - length) / stride + 1;
+}
+
+// series [T, F] row-major, target [T]. Matches tpuflow/data/windows.py:
+// teacher_forcing=0: y[n] = target[start+length-1]            (out_y [N])
+// teacher_forcing=1: y[n,:] = target[start .. start+length-1] (out_y [N, L])
+void tf_sliding_windows(const float* series, const float* target, long T,
+                        long F, long length, long stride, int teacher_forcing,
+                        float* out_x, float* out_y) {
+  long n = tf_window_count(T, length, stride);
+  for (long i = 0; i < n; ++i) {
+    long s = i * stride;
+    memcpy(out_x + i * length * F, series + s * F,
+           static_cast<size_t>(length * F) * sizeof(float));
+    if (teacher_forcing) {
+      memcpy(out_y + i * length, target + s,
+             static_cast<size_t>(length) * sizeof(float));
+    } else {
+      out_y[i] = target[s + length - 1];
+    }
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,155 @@
+"""Native C++ data plane vs the NumPy fallbacks — identical results.
+
+Builds the shared library on demand (make -C native); if no toolchain is
+available the tests skip and the fallbacks remain covered by
+test_data_pipeline.py.
+"""
+
+import numpy as np
+import pytest
+
+from tpuflow.data.csv_io import _read_csv_numpy, read_csv
+from tpuflow.data.schema import Schema
+
+native = pytest.importorskip("tpuflow._native")
+
+if not native.native_available():
+    pytest.skip("native library not built", allow_module_level=True)
+
+
+@pytest.fixture
+def csv_file(tmp_path):
+    path = tmp_path / "wells.csv"
+    rng = np.random.default_rng(0)
+    rows = []
+    for i in range(1000):
+        rows.append(
+            f"{rng.uniform(100, 400):.4f},{int(rng.integers(16, 64))},"
+            f"{rng.uniform(0.1, 3):.6f},well_{i % 7},{rng.uniform(50, 900):.4f}"
+        )
+    path.write_text("\n".join(rows) + "\n")
+    return str(path)
+
+
+SCHEMA = Schema.from_cli(
+    "pressure,choke,glr,well,flow", "float,int,float,string,float", "flow"
+)
+
+
+class TestNativeCsv:
+    def test_matches_numpy_fallback(self, csv_file):
+        got = native.read_csv_native(csv_file, SCHEMA)
+        want = _read_csv_numpy(csv_file, SCHEMA)
+        assert set(got) == set(want)
+        for name in want:
+            if want[name].dtype.kind == "U":
+                assert got[name].tolist() == want[name].tolist()
+            else:
+                np.testing.assert_array_equal(got[name], want[name], err_msg=name)
+
+    def test_read_csv_uses_native(self, csv_file):
+        # The public entry point routes through the native parser.
+        out = read_csv(csv_file, SCHEMA)
+        assert len(out["flow"]) == 1000
+        assert out["choke"].dtype == np.int32
+
+    def test_blank_lines_skipped(self, tmp_path):
+        path = tmp_path / "b.csv"
+        path.write_text("1.0,2,0.5,a,3.0\n\n4.0,5,0.25,b,6.0\n")
+        out = native.read_csv_native(str(path), SCHEMA)
+        assert len(out["flow"]) == 2
+
+    def test_field_count_error(self, tmp_path):
+        path = tmp_path / "bad.csv"
+        path.write_text("1.0,2,0.5,a\n")
+        with pytest.raises(ValueError):
+            native.read_csv_native(str(path), SCHEMA)
+
+    def test_bad_float_error(self, tmp_path):
+        path = tmp_path / "bad.csv"
+        path.write_text("oops,2,0.5,a,3.0\n")
+        with pytest.raises(ValueError):
+            native.read_csv_native(str(path), SCHEMA)
+
+    def test_no_trailing_newline(self, tmp_path):
+        path = tmp_path / "n.csv"
+        path.write_text("1.0,2,0.5,a,3.0\n4.0,5,0.25,b,6.5")
+        out = native.read_csv_native(str(path), SCHEMA)
+        np.testing.assert_allclose(out["flow"], [3.0, 6.5])
+
+    def test_whitespace_padded_fields(self, tmp_path):
+        # The NumPy fallback strips whitespace; the native parser must too.
+        path = tmp_path / "w.csv"
+        path.write_text(" 1.0 , 2 ,0.5,a, 3.0\n")
+        out = native.read_csv_native(str(path), SCHEMA)
+        np.testing.assert_allclose(out["pressure"], [1.0])
+        assert out["choke"][0] == 2
+        np.testing.assert_allclose(out["flow"], [3.0])
+
+    def test_non_ascii_strings(self, tmp_path):
+        path = tmp_path / "u.csv"
+        path.write_text("1.0,2,0.5,pözo_å,3.0\n", encoding="utf-8")
+        out = native.read_csv_native(str(path), SCHEMA)
+        assert out["well"][0] == "pözo_å"
+
+
+class TestNativeWindows:
+    @pytest.mark.parametrize("teacher_forcing", [False, True])
+    @pytest.mark.parametrize("stride", [1, 3])
+    def test_matches_numpy(self, teacher_forcing, stride):
+        rng = np.random.default_rng(1)
+        series = rng.standard_normal((100, 5)).astype(np.float32)
+        target = rng.standard_normal(100).astype(np.float32)
+        x_n, y_n = native.sliding_windows_native(
+            series, target, length=24, stride=stride, teacher_forcing=teacher_forcing
+        )
+
+        # NumPy reference (windows.py fallback semantics).
+        starts = np.arange(0, 100 - 24 + 1, stride)
+        x_ref = np.stack([series[s : s + 24] for s in starts])
+        if teacher_forcing:
+            y_ref = np.stack([target[s : s + 24] for s in starts])
+        else:
+            y_ref = target[starts + 24 - 1]
+        np.testing.assert_array_equal(x_n, x_ref)
+        np.testing.assert_array_equal(y_n, y_ref)
+
+    def test_short_series(self):
+        series = np.zeros((5, 3), np.float32)
+        target = np.zeros(5, np.float32)
+        x, y = native.sliding_windows_native(series, target, length=24)
+        assert x.shape == (0, 24, 3)
+        assert y.shape == (0,)
+
+
+class TestPrefetch:
+    def test_prefetch_order_and_completeness(self):
+        from tpuflow.data.prefetch import prefetch
+
+        items = list(prefetch(iter(range(50)), buffer_size=4))
+        assert items == list(range(50))
+
+    def test_prefetch_propagates_errors(self):
+        from tpuflow.data.prefetch import prefetch
+
+        def gen():
+            yield 1
+            raise RuntimeError("boom")
+
+        it = prefetch(gen(), buffer_size=2)
+        assert next(it) == 1
+        with pytest.raises(RuntimeError, match="boom"):
+            list(it)
+
+    def test_device_prefetch(self):
+        import jax
+
+        from tpuflow.data.prefetch import device_prefetch
+
+        batches = [
+            (np.ones((4, 3), np.float32), np.zeros(4, np.float32))
+            for _ in range(3)
+        ]
+        out = list(device_prefetch(iter(batches), buffer_size=2))
+        assert len(out) == 3
+        assert isinstance(out[0][0], jax.Array)

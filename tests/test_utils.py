@@ -1,0 +1,76 @@
+"""Observability utilities: timers, guards, metrics logger."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuflow.utils import MetricsLogger, StepTimer, check_finite, finite_or_raise
+
+
+class TestStepTimer:
+    def test_accumulates_steps(self):
+        t = StepTimer()
+        x = jnp.ones((64, 64))
+        f = jax.jit(lambda a: a @ a)
+        for _ in range(3):
+            t.start()
+            y = f(x)
+            t.stop(block_on=y)
+        assert len(t.times) == 3
+        assert t.total > 0
+        assert t.mean > 0
+        assert t.samples_per_sec(64) > 0
+
+    def test_context_manager(self):
+        t = StepTimer()
+        with t.step() as s:
+            s["block_on"] = jnp.ones(4) * 2
+        assert len(t.times) == 1
+
+
+class TestGuards:
+    def test_check_finite_true(self):
+        tree = {"a": jnp.ones(3), "b": {"c": jnp.zeros((2, 2))}}
+        assert bool(check_finite(tree))
+
+    def test_check_finite_false(self):
+        tree = {"a": jnp.ones(3), "b": jnp.asarray([1.0, jnp.nan])}
+        assert not bool(check_finite(tree))
+
+    def test_check_finite_inside_jit(self):
+        @jax.jit
+        def f(tree):
+            return check_finite(tree)
+
+        assert bool(f({"x": jnp.ones(2)}))
+        assert not bool(f({"x": jnp.asarray([jnp.inf, 1.0])}))
+
+    def test_finite_or_raise_names_leaf(self):
+        tree = {"w": jnp.ones(2), "grads": {"dense": jnp.asarray([np.nan])}}
+        with pytest.raises(FloatingPointError, match="grads"):
+            finite_or_raise(tree, "state")
+
+    def test_finite_or_raise_passes(self):
+        finite_or_raise({"w": jnp.ones(2)})
+
+
+class TestMetricsLogger:
+    def test_writes_jsonl(self, tmp_path):
+        path = str(tmp_path / "m.jsonl")
+        with MetricsLogger(path) as log:
+            log.write("train_step", step=1, loss=0.5)
+            log.write("eval", epoch=2, val_loss=0.4)
+        lines = [json.loads(l) for l in open(path)]
+        assert lines[0]["event"] == "train_step"
+        assert lines[0]["loss"] == 0.5
+        assert lines[1]["val_loss"] == 0.4
+        assert all("time" in l for l in lines)
+
+    def test_no_path_no_crash(self):
+        log = MetricsLogger()
+        rec = log.write("x", v=1)
+        assert rec["v"] == 1
+        log.close()

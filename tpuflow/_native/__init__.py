@@ -1,0 +1,176 @@
+"""ctypes bindings for the native data plane (native/csv.cc).
+
+The C++ library is the TPU-native stand-in for the reference's delegated
+native data layer (Spark/JVM via PySpark — SURVEY.md §5.8): multithreaded
+headerless-CSV parsing under the dynamic schema, plus window extraction.
+Every entry point returns ``None`` when the shared library isn't built, and
+the pure-NumPy fallbacks in ``tpuflow.data`` take over with identical
+results — the native path is an accelerator, never a requirement.
+
+Build: ``make -C native`` (or it is attempted automatically once per
+process; set TPUFLOW_BUILD_NATIVE=0 to disable).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+if TYPE_CHECKING:
+    from tpuflow.data.schema import Schema
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libtpuflow_native.so")
+_lib = None
+_build_attempted = False
+
+
+def _load():
+    global _lib, _build_attempted
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH) and not _build_attempted:
+        _build_attempted = True
+        if os.environ.get("TPUFLOW_BUILD_NATIVE", "1") != "0":
+            native_dir = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+                "native",
+            )
+            try:
+                subprocess.run(
+                    ["make", "-C", native_dir],
+                    capture_output=True,
+                    timeout=120,
+                    check=True,
+                )
+            except Exception as e:  # toolchain absent → fallback path
+                print(
+                    f"tpuflow._native: build skipped ({type(e).__name__})",
+                    file=sys.stderr,
+                )
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError as e:  # corrupt/incompatible .so → NumPy fallback
+        print(f"tpuflow._native: load failed ({e}); using fallbacks",
+              file=sys.stderr)
+        return None
+    lib.tf_csv_read.restype = ctypes.c_void_p
+    lib.tf_csv_read.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_int,
+    ]
+    lib.tf_csv_nrows.restype = ctypes.c_long
+    lib.tf_csv_nrows.argtypes = [ctypes.c_void_p]
+    lib.tf_csv_get_int.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p]
+    lib.tf_csv_get_float.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p]
+    lib.tf_csv_str_maxlen.restype = ctypes.c_int
+    lib.tf_csv_str_maxlen.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.tf_csv_get_str.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_void_p,
+        ctypes.c_int,
+    ]
+    lib.tf_csv_free.argtypes = [ctypes.c_void_p]
+    lib.tf_window_count.restype = ctypes.c_long
+    lib.tf_window_count.argtypes = [ctypes.c_long] * 3
+    lib.tf_sliding_windows.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_long,
+        ctypes.c_long,
+        ctypes.c_long,
+        ctypes.c_long,
+        ctypes.c_int,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+    ]
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+_KIND_CODES = {"int": 0, "float": 1}
+
+
+def read_csv_native(path: str, schema: "Schema") -> dict[str, np.ndarray] | None:
+    """Parse a headerless CSV with the C++ library; None if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    kinds = [_KIND_CODES.get(c.kind, 2) for c in schema.columns]
+    ckinds = (ctypes.c_int * len(kinds))(*kinds)
+    err = ctypes.create_string_buffer(512)
+    handle = lib.tf_csv_read(
+        path.encode(), ckinds, len(kinds), err, len(err)
+    )
+    if not handle:
+        raise ValueError(
+            f"{path}: {err.value.decode(errors='replace')}"
+        )
+    try:
+        n = lib.tf_csv_nrows(handle)
+        out: dict[str, np.ndarray] = {}
+        for i, spec in enumerate(schema.columns):
+            if kinds[i] == 0:
+                a = np.empty(n, dtype=np.int32)
+                lib.tf_csv_get_int(handle, i, a.ctypes.data_as(ctypes.c_void_p))
+            elif kinds[i] == 1:
+                a = np.empty(n, dtype=np.float32)
+                lib.tf_csv_get_float(handle, i, a.ctypes.data_as(ctypes.c_void_p))
+            else:
+                width = max(lib.tf_csv_str_maxlen(handle, i), 1)
+                buf = np.zeros(n, dtype=f"S{width}")
+                lib.tf_csv_get_str(
+                    handle, i, buf.ctypes.data_as(ctypes.c_void_p), width
+                )
+                # Bytes are UTF-8 (astype would decode latin-1).
+                a = np.char.decode(buf, "utf-8")
+            out[spec.name] = a
+        return out
+    finally:
+        lib.tf_csv_free(handle)
+
+
+def sliding_windows_native(
+    series: np.ndarray,
+    targets: np.ndarray,
+    length: int,
+    stride: int = 1,
+    teacher_forcing: bool = False,
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """Window extraction via the C++ library; None if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    series = np.ascontiguousarray(series, dtype=np.float32)
+    targets = np.ascontiguousarray(targets, dtype=np.float32)
+    T, F = series.shape
+    n = lib.tf_window_count(T, length, stride)
+    x = np.empty((n, length, F), dtype=np.float32)
+    y = np.empty((n, length) if teacher_forcing else (n,), dtype=np.float32)
+    if n:
+        lib.tf_sliding_windows(
+            series.ctypes.data_as(ctypes.c_void_p),
+            targets.ctypes.data_as(ctypes.c_void_p),
+            T,
+            F,
+            length,
+            stride,
+            int(teacher_forcing),
+            x.ctypes.data_as(ctypes.c_void_p),
+            y.ctypes.data_as(ctypes.c_void_p),
+        )
+    return x, y

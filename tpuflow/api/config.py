@@ -22,6 +22,7 @@ class TrainJobConfig:
 
     # --- data source (C4 fixed: explicit path; synthetic fallback) ---
     data_path: str | None = None  # headerless CSV; None -> synthetic wells
+    well_column: str | None = None  # groups CSV rows into per-well logs
     synthetic_wells: int = 8
     synthetic_steps: int = 512
 

@@ -24,6 +24,7 @@ from tpuflow.data import (
     generate_wells,
     prepare_tabular,
     prepare_windowed,
+    prepare_windowed_table,
     read_csv,
     wells_to_table,
 )
@@ -93,37 +94,51 @@ def train(config: TrainJobConfig) -> TrainReport:
     gilbert_test = None
     if config.is_sequence_model:
         if config.data_path is not None:
-            raise NotImplementedError(
-                "sequence models on CSV data need per-well grouping; "
-                "round-1 sequence path uses synthetic wells (data_path=None)"
+            columns = read_csv(config.data_path, schema)
+            splits = prepare_windowed_table(
+                schema,
+                columns,
+                well_column=config.well_column,
+                window=config.window,
+                stride=config.stride,
+                seed=config.seed,
+                teacher_forcing=config.teacher_forcing,
             )
-        wells = _load_wells(config)
-        splits = prepare_windowed(
-            wells,
-            window=config.window,
-            stride=config.stride,
-            seed=config.seed,
-            teacher_forcing=config.teacher_forcing,
-        )
+        else:
+            splits = prepare_windowed(
+                _load_wells(config),
+                window=config.window,
+                stride=config.stride,
+                seed=config.seed,
+                teacher_forcing=config.teacher_forcing,
+            )
         train_ds, val_ds, test_ds = splits.train, splits.val, splits.test
         target_std = splits.target_std
-        # Physical baseline on the test windows' final step, from the
-        # UN-standardized channels (pressure, choke, glr are cols 0,1,2)
-        # against the RAW-unit targets.
-        raw_last = test_ds.x[:, -1, :] * splits.norm_std + splits.norm_mean
-        y_ref = splits.inverse_target(
-            test_ds.y[:, -1] if config.teacher_forcing else test_ds.y
-        )
-        gilbert_test = float(
-            np.mean(
-                np.abs(
-                    y_ref
-                    - np.asarray(
-                        gilbert_flow(raw_last[:, 0], raw_last[:, 1], raw_last[:, 2])
+        names = splits.feature_names
+        if {"pressure", "choke", "glr"} <= set(names):
+            # Physical baseline on the test windows' final step, from the
+            # UN-standardized channels against RAW-unit targets.
+            ip, ic, ig = (
+                names.index("pressure"),
+                names.index("choke"),
+                names.index("glr"),
+            )
+            raw_last = test_ds.x[:, -1, :] * splits.norm_std + splits.norm_mean
+            y_ref = splits.inverse_target(
+                test_ds.y[:, -1] if config.teacher_forcing else test_ds.y
+            )
+            gilbert_test = float(
+                np.mean(
+                    np.abs(
+                        y_ref
+                        - np.asarray(
+                            gilbert_flow(
+                                raw_last[:, ip], raw_last[:, ic], raw_last[:, ig]
+                            )
+                        )
                     )
                 )
             )
-        )
     else:
         if config.data_path is not None:
             columns = read_csv(config.data_path, schema)

@@ -27,6 +27,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("targetColumn", nargs="?", default="flow", help="target column name")
     p.add_argument("storagePath", nargs="?", default=None, help="artifact root; best model saved under {storagePath}/models/")
     p.add_argument("--data", default=None, help="headerless CSV data path (omit for synthetic wells)")
+    p.add_argument("--well-column", default=None, help="column grouping CSV rows into per-well logs (sequence models)")
     p.add_argument("--model", default="lstm", help="static_mlp|dynamic_mlp|cnn1d|lstm|stacked_lstm")
     p.add_argument("--epochs", type=int, default=1000)
     p.add_argument("--batch-size", type=int, default=20)
@@ -52,6 +53,7 @@ def main(argv=None) -> int:
         target=args.targetColumn,
         storage_path=args.storagePath,
         data_path=args.data,
+        well_column=args.well_column,
         model=args.model,
         max_epochs=args.epochs,
         batch_size=args.batch_size,

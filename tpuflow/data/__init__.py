@@ -18,4 +18,6 @@ from tpuflow.data.pipeline import (  # noqa: F401
     batches,
     prepare_tabular,
     prepare_windowed,
+    prepare_windowed_table,
 )
+from tpuflow.data.prefetch import device_prefetch, prefetch  # noqa: F401

@@ -94,16 +94,89 @@ def prepare_windowed(
     multi-well training population), with normalization stats computed from
     the training windows only.
     """
+    pairs = [
+        (
+            np.stack([getattr(w, ch) for ch in _SEQ_CHANNELS], axis=1).astype(
+                np.float32
+            ),
+            w.flow,
+        )
+        for w in wells
+    ]
+    return _windowed_from_pairs(
+        pairs, _SEQ_CHANNELS, window, stride, seed, fractions, teacher_forcing
+    )
+
+
+def prepare_windowed_table(
+    schema: Schema,
+    columns: dict[str, np.ndarray],
+    well_column: str | None = None,
+    window: int = 24,
+    stride: int = 1,
+    seed: int = 0,
+    fractions: Sequence[float] = DEFAULT_FRACTIONS,
+    teacher_forcing: bool = False,
+) -> WindowedSplits:
+    """Sequence-model path from a dynamic-schema table (CSV ingest).
+
+    Rows are assumed time-ordered within each well. ``well_column`` groups
+    rows into per-well logs (the multi-well population); ``None`` treats
+    the whole table as a single well's log. Features are the schema's
+    continuous feature columns (minus the grouping column), in schema
+    order — the sequence-model analog of the reference's continuous
+    selection (reference cnn.py:93).
+    """
+    feature_names = tuple(
+        c.name
+        for c in schema.continuous_features
+        if c.name != well_column
+    )
+    if not feature_names:
+        raise ValueError("no continuous feature columns for sequence model")
+    target = columns[schema.target].astype(np.float32)
+    series_all = np.stack(
+        [columns[n].astype(np.float32) for n in feature_names], axis=1
+    )
+    if well_column is None:
+        pairs = [(series_all, target)]
+    else:
+        # One-pass grouping: stable argsort of the inverse codes clusters
+        # each well's rows while preserving their original (time) order.
+        ids = np.asarray(columns[well_column])
+        _, inverse, counts = np.unique(
+            ids, return_inverse=True, return_counts=True
+        )
+        grouped = np.argsort(inverse, kind="stable")
+        pairs = [
+            (series_all[rows], target[rows])
+            for rows in np.split(grouped, np.cumsum(counts)[:-1])
+        ]
+    return _windowed_from_pairs(
+        pairs, feature_names, window, stride, seed, fractions, teacher_forcing
+    )
+
+
+def _windowed_from_pairs(
+    pairs: Sequence[tuple[np.ndarray, np.ndarray]],
+    feature_names: tuple[str, ...],
+    window: int,
+    stride: int,
+    seed: int,
+    fractions: Sequence[float],
+    teacher_forcing: bool,
+) -> WindowedSplits:
     xs, ys = [], []
-    for w in wells:
-        series = np.stack(
-            [getattr(w, ch) for ch in _SEQ_CHANNELS], axis=1
-        ).astype(np.float32)
+    for series, target in pairs:
         fn = teacher_forcing_pairs if teacher_forcing else sliding_windows
-        x, y = fn(series, w.flow, length=window, stride=stride)
+        x, y = fn(series, target, length=window, stride=stride)
         if len(x):
             xs.append(x)
             ys.append(y)
+    if not xs:
+        raise ValueError(
+            f"no windows: every series is shorter than window={window}"
+        )
     x = np.concatenate(xs, axis=0)
     y = np.concatenate(ys, axis=0)
     tr_i, va_i, te_i = random_split(len(x), fractions, seed)
@@ -120,7 +193,7 @@ def prepare_windowed(
 
     mk = lambda idx: ArrayDataset(norm(x[idx]), norm_y(y[idx]))
     return WindowedSplits(
-        mk(tr_i), mk(va_i), mk(te_i), _SEQ_CHANNELS, mean, std, t_mean, t_std
+        mk(tr_i), mk(va_i), mk(te_i), tuple(feature_names), mean, std, t_mean, t_std
     )
 
 

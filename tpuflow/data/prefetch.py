@@ -1,0 +1,66 @@
+"""Background batch prefetching: overlap host ETL with device compute.
+
+The host pipeline must never bound samples/sec (SURVEY.md §7 "hard parts":
+"careful host-pipeline overlap so input feed doesn't bound samples/sec").
+``prefetch`` runs the upstream batch iterator in a daemon thread and keeps
+a small bounded queue of ready batches; ``device_prefetch`` additionally
+moves them onto the device (optionally sharded over a mesh) ahead of use,
+so the accelerator never waits on a host→device copy.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator
+
+_SENTINEL = object()
+
+
+def prefetch(iterator: Iterable, buffer_size: int = 2) -> Iterator:
+    """Run ``iterator`` in a background thread, ``buffer_size`` items ahead."""
+    q: queue.Queue = queue.Queue(maxsize=buffer_size)
+    err: list[BaseException] = []
+
+    def worker():
+        try:
+            for item in iterator:
+                q.put(item)
+        except BaseException as e:  # re-raised on the consumer side
+            err.append(e)
+        finally:
+            q.put(_SENTINEL)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _SENTINEL:
+            if err:
+                raise err[0]
+            return
+        yield item
+
+
+def device_prefetch(
+    iterator: Iterable,
+    buffer_size: int = 2,
+    sharding=None,
+) -> Iterator:
+    """Prefetch AND device_put batches ahead of consumption.
+
+    Each yielded item is a tuple of device arrays. With ``sharding`` (e.g.
+    ``tpuflow.parallel.data_sharding(mesh)``) the batch lands pre-sharded
+    over the mesh; otherwise it goes to the default device. The transfer of
+    batch k+1 overlaps the compute of batch k.
+    """
+    import jax
+
+    def put(item):
+        if sharding is None:
+            return jax.tree_util.tree_map(jax.device_put, item)
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, sharding), item
+        )
+
+    return prefetch((put(item) for item in iterator), buffer_size)

@@ -14,6 +14,18 @@ import numpy as np
 DEFAULT_WINDOW = 24
 
 
+def _native_windows(series, targets, length, stride, teacher_forcing):
+    """C++ fast path (native/csv.cc); None → use the NumPy fallback."""
+    try:
+        from tpuflow._native import sliding_windows_native
+
+        return sliding_windows_native(
+            series, targets, length, stride, teacher_forcing
+        )
+    except ImportError:
+        return None
+
+
 def sliding_windows(
     series: np.ndarray,
     targets: np.ndarray,
@@ -39,6 +51,9 @@ def sliding_windows(
             np.zeros((0, length, series.shape[1]), dtype=np.float32),
             np.zeros((0,), dtype=np.float32),
         )
+    native = _native_windows(series, targets, length, stride, False)
+    if native is not None:
+        return native
     starts = np.arange(0, T - length + 1, stride)
     windows = np.stack([series[s : s + length] for s in starts])
     y = targets[starts + length - 1]
@@ -63,6 +78,9 @@ def teacher_forcing_pairs(
             np.zeros((0, length, series.shape[1]), dtype=np.float32),
             np.zeros((0, length), dtype=np.float32),
         )
+    native = _native_windows(series, targets, length, stride, True)
+    if native is not None:
+        return native
     starts = np.arange(0, T - length + 1, stride)
     windows = np.stack([series[s : s + length] for s in starts])
     y = np.stack([targets[s : s + length] for s in starts])

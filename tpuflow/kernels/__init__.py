@@ -1,0 +1,23 @@
+"""Pallas TPU kernels for the hot ops (SURVEY.md §7 layer 7).
+
+The reference delegates all native compute to external runtimes (Spark/JVM
+and the Theano backend — SURVEY.md §2 "Native components: none"); the
+TPU-native equivalent of that delegated-native layer is XLA plus, where a
+fused kernel pays off, Pallas (Mosaic) kernels:
+
+- ``lstm_scan``      — fused LSTM recurrence (the north-star hot loop,
+  SURVEY.md §3.4): per-step recurrent matmul on the MXU with the gate
+  elementwise math fused in VMEM, forward AND backward as Pallas kernels
+  under a ``jax.custom_vjp``.
+- ``mae_clip_pallas`` — fused clipped-MAE loss (reference cnn.py:29-32
+  semantics) as a single tiled reduction kernel.
+
+All kernels run compiled on TPU and fall back to Pallas interpret mode on
+CPU so the same code paths are unit-testable on the 8-virtual-device CI
+mesh (SURVEY.md §4).
+"""
+
+from tpuflow.kernels.lstm import lstm_scan
+from tpuflow.kernels.losses import mae_clip_pallas
+
+__all__ = ["lstm_scan", "mae_clip_pallas"]

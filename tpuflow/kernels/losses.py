@@ -1,0 +1,109 @@
+"""Fused clipped-MAE loss as a Pallas TPU reduction kernel.
+
+Same semantics as the reference's Theano loss — ``mean(clip(|y_true -
+y_pred|, 0, 6))`` (reference cnn.py:29-32) and as ``tpuflow.core.losses
+.mae_clip`` (the golden-value-tested jnp version). The forward pass is one
+tiled Pallas kernel: abs-diff, clip, and partial-sum per tile in VMEM, so
+the whole loss is a single HBM read of each operand. The backward pass is
+the closed-form subgradient in plain jnp (memory-bound elementwise — XLA
+already fuses it optimally; a kernel would buy nothing).
+
+Runs compiled on TPU, interpret-mode elsewhere (CI per SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpuflow.core.losses import CLIP_VALUE
+
+_LANES = 128
+_ROWS_PER_TILE = 512
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _sum_kernel(clip_ref, yt_ref, yp_ref, out_ref):
+    # TPU grid steps run sequentially, so one (1,1) SMEM cell accumulates
+    # the partial sums across the whole grid.
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        out_ref[0, 0] = 0.0
+
+    diff = jnp.abs(
+        yt_ref[:].astype(jnp.float32) - yp_ref[:].astype(jnp.float32)
+    )
+    out_ref[0, 0] += jnp.sum(jnp.clip(diff, 0.0, clip_ref[0]))
+
+
+def _clipped_abs_sum(y_true: jnp.ndarray, y_pred: jnp.ndarray, clip: float):
+    """Sum of clip(|y_true - y_pred|, 0, clip) over all elements."""
+    yt = y_true.reshape(-1)
+    yp = y_pred.reshape(-1)
+    n = yt.shape[0]
+    # Pad both operands with zeros: |0 - 0| = 0 contributes nothing to the
+    # SUM, so no in-kernel masking is needed.
+    tile = _ROWS_PER_TILE * _LANES
+    pad = (-n) % tile
+    if pad:
+        yt = jnp.pad(yt, (0, pad))
+        yp = jnp.pad(yp, (0, pad))
+    rows = yt.shape[0] // _LANES
+    yt = yt.reshape(rows, _LANES)
+    yp = yp.reshape(rows, _LANES)
+    grid = rows // _ROWS_PER_TILE
+
+    partials = pl.pallas_call(
+        _sum_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((1,), lambda i: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (_ROWS_PER_TILE, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (_ROWS_PER_TILE, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        interpret=_interpret(),
+    )(jnp.full((1,), clip, jnp.float32), yt, yp)
+    return partials[0, 0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def mae_clip_pallas(
+    y_true: jnp.ndarray, y_pred: jnp.ndarray, clip_value: float = CLIP_VALUE
+) -> jnp.ndarray:
+    """Fused ``mean(clip(|y_true - y_pred|, 0, clip_value))``."""
+    n = y_true.size
+    return _clipped_abs_sum(y_true, y_pred, clip_value) / n
+
+
+def _fwd(y_true, y_pred, clip_value):
+    n = y_true.size
+    loss = _clipped_abs_sum(y_true, y_pred, clip_value) / n
+    return loss, (y_true, y_pred)
+
+
+def _bwd(clip_value, res, g):
+    y_true, y_pred = res
+    diff = y_true.astype(jnp.float32) - y_pred.astype(jnp.float32)
+    # Subgradient of mean(clip(|d|, 0, c)) — zero where saturated.
+    inner = jnp.sign(diff) * (jnp.abs(diff) < clip_value)
+    scale = g / y_true.size
+    dyt = (scale * inner).astype(y_true.dtype)
+    return dyt, (-dyt).astype(y_pred.dtype)
+
+
+mae_clip_pallas.defvjp(_fwd, _bwd)

@@ -1,0 +1,264 @@
+"""Fused LSTM recurrence as Pallas TPU kernels.
+
+The flagship hot loop (SURVEY.md §3.4; BASELINE.json north star is LSTM
+samples/sec/chip). The surrounding model (``tpuflow.models.lstm``) already
+hoists the input projection ``x @ W_x`` out of the recurrence as one large
+MXU matmul; what remains per step is the recurrent matmul ``h @ W_h`` plus
+the gate elementwise math. This module fuses that whole remainder into a
+single Pallas kernel:
+
+- the time loop runs *inside* the kernel (``fori_loop``), carrying ``h``
+  and ``c`` in VMEM scratch — no per-step HBM round-trip for the carry;
+- the recurrent matmul rides the MXU with float32 accumulation; the gate
+  sigmoid/tanh elementwise work happens in-register on the VPU;
+- the batch dimension is tiled over the Pallas grid, so arbitrary batch
+  sizes stream through fixed VMEM blocks;
+- backward is a second Pallas kernel running the standard reverse-time
+  LSTM recurrence (recomputing gate activations from residuals rather
+  than storing them — rematerialisation trades FLOPs for HBM, the right
+  trade on TPU), wired up via ``jax.custom_vjp``.
+
+On non-TPU backends the kernels run in Pallas interpret mode, so CI on the
+8-virtual-CPU-device mesh exercises the identical code path (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _batch_block(B: int, T: int, H: int, itemsize: int) -> int:
+    """Largest batch tile keeping the kernel's VMEM footprint under ~8 MB."""
+    for bb in (512, 256, 128, 64, 32, 16, 8):
+        # fwd: xw[T,bb,4H] + hs/cs[T,bb,H]*2 + scratch; bwd ~2x.
+        footprint = T * bb * 4 * H * itemsize * 2 + 2 * T * bb * H * itemsize * 2
+        if footprint <= 8 * 1024 * 1024:
+            return min(bb, max(B, 8))
+    return 8
+
+
+def _split_gates(z: jnp.ndarray, H: int):
+    return z[:, :H], z[:, H : 2 * H], z[:, 2 * H : 3 * H], z[:, 3 * H :]
+
+
+def _fwd_kernel(xw_ref, wh_ref, b_ref, hs_ref, cs_ref, h_scr, c_scr):
+    """One batch tile: scan T steps, write hidden/cell sequences."""
+    T = xw_ref.shape[0]
+    H = wh_ref.shape[0]
+    dt = xw_ref.dtype
+    h_scr[:] = jnp.zeros_like(h_scr)
+    c_scr[:] = jnp.zeros_like(c_scr)
+
+    def step(t, _):
+        xw_t = xw_ref[pl.ds(t, 1)][0]  # [Bb, 4H]
+        z = (
+            xw_t.astype(jnp.float32)
+            + jnp.dot(h_scr[:], wh_ref[:], preferred_element_type=jnp.float32)
+            + b_ref[0].astype(jnp.float32)
+        )
+        i, f, g, o = _split_gates(z, H)
+        c = jax.nn.sigmoid(f) * c_scr[:].astype(jnp.float32) + jax.nn.sigmoid(
+            i
+        ) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        h_scr[:] = h.astype(dt)
+        c_scr[:] = c.astype(jnp.float32)
+        hs_ref[pl.ds(t, 1)] = h.astype(dt)[None]
+        cs_ref[pl.ds(t, 1)] = c.astype(dt)[None]
+        return 0
+
+    jax.lax.fori_loop(0, T, step, 0)
+
+
+def _bwd_kernel(
+    xw_ref, wh_ref, b_ref, hs_ref, cs_ref, dhs_ref,
+    dxw_ref, dwh_ref, db_ref,
+    dh_scr, dc_scr,
+):
+    """Reverse-time recurrence for one batch tile.
+
+    Gate activations are recomputed from (xw, h_prev) rather than stored —
+    the rematerialisation trade SURVEY.md's HBM-bandwidth note calls for.
+    ``dwh``/``db`` accumulate per-tile partials (summed by the wrapper).
+    """
+    T = xw_ref.shape[0]
+    H = wh_ref.shape[0]
+    dt = xw_ref.dtype
+    dh_scr[:] = jnp.zeros_like(dh_scr)
+    dc_scr[:] = jnp.zeros_like(dc_scr)
+    dwh_ref[0] = jnp.zeros(dwh_ref.shape[1:], dwh_ref.dtype)
+    db_ref[0] = jnp.zeros(db_ref.shape[1:], db_ref.dtype)
+    wh32 = wh_ref[:].astype(jnp.float32)
+
+    def step(k, _):
+        t = T - 1 - k
+        prev = jnp.maximum(t - 1, 0)
+        has_prev = (t > 0).astype(jnp.float32)
+        h_prev = hs_ref[pl.ds(prev, 1)][0].astype(jnp.float32) * has_prev
+        c_prev = cs_ref[pl.ds(prev, 1)][0].astype(jnp.float32) * has_prev
+
+        # Recompute this step's pre-activations and gates.
+        z = (
+            xw_ref[pl.ds(t, 1)][0].astype(jnp.float32)
+            + jnp.dot(
+                h_prev.astype(dt), wh_ref[:], preferred_element_type=jnp.float32
+            )
+            + b_ref[0].astype(jnp.float32)
+        )
+        zi, zf, zg, zo = _split_gates(z, H)
+        i, f, o = jax.nn.sigmoid(zi), jax.nn.sigmoid(zf), jax.nn.sigmoid(zo)
+        g = jnp.tanh(zg)
+        c = cs_ref[pl.ds(t, 1)][0].astype(jnp.float32)
+        tanh_c = jnp.tanh(c)
+
+        dh = dhs_ref[pl.ds(t, 1)][0].astype(jnp.float32) + dh_scr[:]
+        do = dh * tanh_c
+        dc = dc_scr[:] + dh * o * (1.0 - tanh_c * tanh_c)
+        di, df, dg = dc * g, dc * c_prev, dc * i
+
+        dz = jnp.concatenate(
+            [
+                di * i * (1.0 - i),
+                df * f * (1.0 - f),
+                dg * (1.0 - g * g),
+                do * o * (1.0 - o),
+            ],
+            axis=-1,
+        )  # [Bb, 4H]
+
+        dxw_ref[pl.ds(t, 1)] = dz.astype(dt)[None]
+        dwh_ref[0] += jax.lax.dot_general(
+            h_prev, dz, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        db_ref[0] += jnp.sum(dz, axis=0, keepdims=True)
+        dh_scr[:] = jax.lax.dot_general(
+            dz, wh32, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dc_scr[:] = dc * f
+        return 0
+
+    jax.lax.fori_loop(0, T, step, 0)
+
+
+def _pad_batch(a: jnp.ndarray, Bb: int):
+    B = a.shape[1]
+    pad = (-B) % Bb
+    if pad:
+        a = jnp.pad(a, ((0, 0), (0, pad), (0, 0)))
+    return a, B
+
+
+def _fwd(xw: jnp.ndarray, wh: jnp.ndarray, b: jnp.ndarray):
+    T, B, H4 = xw.shape
+    H = H4 // 4
+    Bb = _batch_block(B, T, H, xw.dtype.itemsize)
+    xw_p, B0 = _pad_batch(xw, Bb)
+    Bp = xw_p.shape[1]
+    grid = Bp // Bb
+    b2 = b.reshape(1, H4)
+
+    hs, cs = pl.pallas_call(
+        _fwd_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((T, Bb, H4), lambda i: (0, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((H, H4), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, H4), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((T, Bb, H), lambda i: (0, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((T, Bb, H), lambda i: (0, i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, Bp, H), xw.dtype),
+            jax.ShapeDtypeStruct((T, Bp, H), xw.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((Bb, H), xw.dtype),
+            pltpu.VMEM((Bb, H), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(xw_p, wh, b2)
+    return hs[:, :B0], cs[:, :B0]
+
+
+def _bwd(xw, wh, b, hs, cs, dhs):
+    T, B, H4 = xw.shape
+    H = H4 // 4
+    Bb = _batch_block(B, T, H, xw.dtype.itemsize)
+    xw_p, B0 = _pad_batch(xw, Bb)
+    hs_p, _ = _pad_batch(hs, Bb)
+    cs_p, _ = _pad_batch(cs, Bb)
+    dhs_p, _ = _pad_batch(dhs, Bb)
+    Bp = xw_p.shape[1]
+    grid = Bp // Bb
+    b2 = b.reshape(1, H4)
+
+    dxw, dwh_parts, db_parts = pl.pallas_call(
+        _bwd_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((T, Bb, H4), lambda i: (0, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((H, H4), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, H4), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((T, Bb, H), lambda i: (0, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((T, Bb, H), lambda i: (0, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((T, Bb, H), lambda i: (0, i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((T, Bb, H4), lambda i: (0, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, H, H4), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, H4), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, Bp, H4), xw.dtype),
+            jax.ShapeDtypeStruct((grid, H, H4), jnp.float32),
+            jax.ShapeDtypeStruct((grid, 1, H4), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((Bb, H), jnp.float32),
+            pltpu.VMEM((Bb, H), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(xw_p, wh, b2, hs_p, cs_p, dhs_p)
+
+    dwh = jnp.sum(dwh_parts, axis=0).astype(wh.dtype)
+    db = jnp.sum(db_parts, axis=(0, 1)).astype(b.dtype)
+    return dxw[:, :B0], dwh, db
+
+
+@jax.custom_vjp
+def lstm_scan(xw: jnp.ndarray, wh: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Fused LSTM recurrence: ``xw [T,B,4H] -> hs [T,B,H]`` (time-major).
+
+    ``xw`` is the pre-computed input projection for all steps (gate order
+    i, f, g, o — matching ``tpuflow.models.lstm``); ``wh [H,4H]`` the
+    recurrent weights; ``b [4H]`` the bias. Zero initial state, matching
+    the XLA-scan reference implementation.
+    """
+    hs, _ = _fwd(xw, wh, b)
+    return hs
+
+
+def _lstm_scan_fwd(xw, wh, b):
+    hs, cs = _fwd(xw, wh, b)
+    return hs, (xw, wh, b, hs, cs)
+
+
+def _lstm_scan_bwd(res, dhs):
+    xw, wh, b, hs, cs = res
+    return _bwd(xw, wh, b, hs, cs, dhs.astype(xw.dtype))
+
+
+lstm_scan.defvjp(_lstm_scan_fwd, _lstm_scan_bwd)

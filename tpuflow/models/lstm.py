@@ -33,11 +33,33 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def lstm_step(carry, xw_t, w_h, b):
+    """One LSTM step (gate order i, f, g, o; the single source of the cell
+    math — the scan path, the sequence-parallel path, and the Pallas
+    kernels all implement/verify against this).
+
+    ``carry = (h, c)``; ``xw_t`` is the pre-projected input ``x_t @ W_x``.
+    """
+    h, c = carry
+    z = xw_t + h @ w_h + b
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    c = nn.sigmoid(f) * c + nn.sigmoid(i) * jnp.tanh(g)
+    h = nn.sigmoid(o) * jnp.tanh(c)
+    return (h, c), h
+
+
 class LSTMLayer(nn.Module):
-    """One LSTM layer: [B, T, F] -> [B, T, H], batch-major in/out."""
+    """One LSTM layer: [B, T, F] -> [B, T, H], batch-major in/out.
+
+    ``backend="xla"`` runs the recurrence as a ``lax.scan`` (XLA fuses the
+    gate math into the recurrent matmul); ``backend="pallas"`` swaps in the
+    fused Pallas kernel from ``tpuflow.kernels`` — same math, same
+    parameters, interchangeable checkpoints.
+    """
 
     hidden: int
     dtype: Any = jnp.float32
+    backend: str = "xla"  # "xla" | "pallas"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -61,16 +83,17 @@ class LSTMLayer(nn.Module):
         xw = (x.reshape(B * T, F) @ w_x).reshape(B, T, 4 * H)
         xw = jnp.swapaxes(xw, 0, 1)  # time-major for the scan: [T, B, 4H]
 
-        def step(carry, xw_t):
-            h, c = carry
-            z = xw_t + h @ w_h + b
-            i, f, g, o = jnp.split(z, 4, axis=-1)
-            c = nn.sigmoid(f) * c + nn.sigmoid(i) * jnp.tanh(g)
-            h = nn.sigmoid(o) * jnp.tanh(c)
-            return (h, c), h
+        if self.backend == "pallas":
+            from tpuflow.kernels import lstm_scan
 
-        h0 = jnp.zeros((B, H), dtype=dt)
-        (_, _), hs = lax.scan(step, (h0, h0), xw)
+            hs = lstm_scan(xw, w_h, b)
+        else:
+            h0 = jnp.zeros((B, H), dtype=dt)
+            (_, _), hs = lax.scan(
+                lambda carry, xw_t: lstm_step(carry, xw_t, w_h, b),
+                (h0, h0),
+                xw,
+            )
         return jnp.swapaxes(hs, 0, 1)  # back to batch-major [B, T, H]
 
 
@@ -88,11 +111,17 @@ class LSTMRegressor(nn.Module):
     num_layers: int = 1
     readout: str = "sequence"  # "sequence" | "last"
     dtype: Any = jnp.float32
+    backend: str = "xla"  # "xla" | "pallas"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, deterministic: bool = True) -> jnp.ndarray:
         for layer in range(self.num_layers):
-            x = LSTMLayer(self.hidden, dtype=self.dtype, name=f"lstm_{layer}")(x)
+            x = LSTMLayer(
+                self.hidden,
+                dtype=self.dtype,
+                backend=self.backend,
+                name=f"lstm_{layer}",
+            )(x)
         y = nn.Dense(1, dtype=self.dtype, name="head")(x)[..., 0]  # [B, T]
         y = y.astype(jnp.float32)
         if self.readout == "last":

@@ -23,3 +23,4 @@ from tpuflow.parallel.dp import (  # noqa: F401
     shard_batch,
 )
 from tpuflow.parallel.distributed import init_distributed  # noqa: F401
+from tpuflow.parallel.sp import make_sp_forward, ring_lstm_scan  # noqa: F401

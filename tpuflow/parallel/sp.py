@@ -1,0 +1,128 @@
+"""Sequence (context) parallelism: time-axis sharding for long well logs.
+
+The reference family's sequences are short 24-step windows, handled
+on-chip by ``lax.scan`` (SURVEY.md §5.7) — but the framework is designed
+for logs far longer than one chip's HBM can hold activations for. This
+module shards the **time axis** of the LSTM recurrence across the mesh:
+
+- each device owns a contiguous time chunk of the input projections
+  (``xw [T/N, B, 4H]``) and materializes only its chunk's activations —
+  an N-fold activation-memory reduction, the point of context
+  parallelism for recurrent models;
+- the carry ``(h, c)`` is handed around the device ring with
+  ``lax.ppermute`` — one tiny [B, H]×2 transfer per round riding ICI;
+- the wall-clock stays O(T) (an LSTM's dependency chain is inherently
+  sequential — unlike attention, time cannot be parallelized away), so
+  this trades idle compute for memory capacity. Shard batch for
+  throughput, shard time for length (SURVEY.md §5.7's "shard batch,
+  never time" is about throughput; this is the capacity story).
+
+For the attention-free model family this is the honest TPU equivalent of
+ring-attention-style context parallelism: same ring topology, same
+carry-passing collective, applied to a recurrence.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpuflow.parallel.mesh import DATA_AXIS
+
+
+def _lstm_chunk_scan(carry, xw_chunk, wh, b):
+    """Plain lax.scan over one local time chunk. xw_chunk: [t, B, 4H].
+
+    Cell math comes from ``tpuflow.models.lstm.lstm_step`` — the single
+    source shared with the on-chip scan path.
+    """
+    from tpuflow.models.lstm import lstm_step
+
+    return lax.scan(
+        lambda c, xw_t: lstm_step(c, xw_t, wh, b), carry, xw_chunk
+    )
+
+
+def ring_lstm_scan(
+    mesh: Mesh,
+    xw: jnp.ndarray,
+    wh: jnp.ndarray,
+    b: jnp.ndarray,
+    axis: str = DATA_AXIS,
+):
+    """Time-sharded LSTM scan over the mesh ring: ``xw [T,B,4H] -> hs [T,B,H]``.
+
+    ``T`` must divide by the axis size. Device ``k`` owns timesteps
+    ``[k*T/N, (k+1)*T/N)`` and stores only that chunk's activations. The
+    ring runs ``N`` rounds; in round ``r`` device ``r``'s chunk is the
+    active one and its final carry is ppermuted to device ``r+1``.
+
+    Returns the full hidden sequence, sharded along time.
+    """
+    n = mesh.shape[axis]
+    T = xw.shape[0]
+    if T % n:
+        raise ValueError(f"sequence length {T} not divisible by {axis}={n}")
+
+    def body(xw_local, wh, b):
+        # xw_local: [T/n, B, 4H] — this device's time chunk.
+        B, H = xw_local.shape[1], wh.shape[0]
+        idx = lax.axis_index(axis)
+        zero = (
+            jnp.zeros((B, H), xw_local.dtype),
+            jnp.zeros((B, H), xw_local.dtype),
+        )
+        hs_out = jnp.zeros(
+            (xw_local.shape[0], B, H), xw_local.dtype
+        )
+        received = zero
+        for r in range(n):
+            start = received if r > 0 else zero
+            # Every device runs its chunk scan each round (SPMD); only the
+            # active device's round-r results are kept.
+            carry_in = jax.tree_util.tree_map(
+                lambda z, s: jnp.where(idx == r, s, z), zero, start
+            )
+            (h_end, c_end), hs = _lstm_chunk_scan(carry_in, xw_local, wh, b)
+            active = idx == r
+            hs_out = jnp.where(active, hs, hs_out)
+            # Hand the active device's end-carry to its right neighbor.
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            received = jax.tree_util.tree_map(
+                lambda t: lax.ppermute(t, axis, perm), (h_end, c_end)
+            )
+        return hs_out
+
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis), P(), P()),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    return sharded(xw, wh, b)
+
+
+def make_sp_forward(
+    mesh: Mesh, hidden: int, axis: str = DATA_AXIS
+) -> Callable:
+    """Jitted long-sequence LSTM forward: (params-tuple, x [B,T,F]) -> [B,T,H].
+
+    Hoists the input projection (embarrassingly parallel along time, done
+    sharded), then runs the ring scan. Params are the same (w_x, w_h, b)
+    pytree an ``LSTMLayer`` learns — usable directly for sharded inference
+    over logs too long for one chip.
+    """
+
+    def forward(w_x, w_h, b, x):
+        B, T, F = x.shape
+        xw = (x.reshape(B * T, F) @ w_x).reshape(B, T, 4 * hidden)
+        xw = jnp.swapaxes(xw, 0, 1)  # [T, B, 4H]
+        hs = ring_lstm_scan(mesh, xw, w_h, b, axis=axis)
+        return jnp.swapaxes(hs, 0, 1)
+
+    return jax.jit(forward)

@@ -1,0 +1,20 @@
+"""Observability: tracing, timing, NaN guards, structured metrics.
+
+SURVEY.md §5.1/§5.2/§5.5: the reference's entire observability stack is a
+wall-clock print pair around ``model.fit`` plus loose prints (reference
+cnn.py:126-134). Kept as the CLI summary contract; extended here with real
+device profiling, per-step timing, numeric guards, and recorded (not just
+printed) metrics.
+"""
+
+from tpuflow.utils.profiling import StepTimer, trace
+from tpuflow.utils.guards import check_finite, finite_or_raise
+from tpuflow.utils.logging import MetricsLogger
+
+__all__ = [
+    "StepTimer",
+    "trace",
+    "check_finite",
+    "finite_or_raise",
+    "MetricsLogger",
+]

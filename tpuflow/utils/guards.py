@@ -1,0 +1,35 @@
+"""Numeric guards: NaN/Inf detection for losses, grads, and params.
+
+SURVEY.md §5.2: the reference has no sanitizers and no native code to
+sanitize; the TPU-framework equivalent is numeric-health checking of the
+training state (plus ``jax.config.update("jax_debug_nans", True)`` for
+deep debugging, which these helpers don't require).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def check_finite(tree) -> jnp.ndarray:
+    """Scalar bool: True iff every leaf of the pytree is fully finite.
+
+    Jit-safe — usable inside a train step (e.g. to skip a bad update).
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    ok = jnp.asarray(True)
+    for leaf in leaves:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(leaf)))
+    return ok
+
+
+def finite_or_raise(tree, name: str = "tree") -> None:
+    """Host-side check (blocks): raise FloatingPointError naming the first
+    non-finite leaf path."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in flat:
+        if not bool(jnp.all(jnp.isfinite(leaf))):
+            raise FloatingPointError(
+                f"non-finite values in {name}{jax.tree_util.keystr(path)}"
+            )

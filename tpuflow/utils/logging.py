@@ -1,0 +1,54 @@
+"""Structured metrics logging: JSONL records instead of lost prints.
+
+The reference prints schema echoes, per-epoch Keras lines, and a final
+elapsed/loss pair, recording none of it (SURVEY.md §5.5, reference
+cnn.py:62,128,133-134). ``MetricsLogger`` appends one JSON object per
+event to a file (and optionally echoes), so every run leaves an auditable
+metric trail.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import IO
+
+
+class MetricsLogger:
+    """Append-only JSONL metrics writer.
+
+    Usage::
+
+        with MetricsLogger("runs/exp1/metrics.jsonl") as log:
+            log.write("train_step", step=1, loss=0.5)
+    """
+
+    def __init__(self, path: str | None = None, echo: bool = False):
+        self.path = path
+        self.echo = echo
+        self._fh: IO | None = None
+        if path:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._fh = open(path, "a", encoding="utf-8")
+
+    def write(self, event: str, **fields) -> dict:
+        rec = {"event": event, "time": time.time(), **fields}
+        line = json.dumps(rec)
+        if self._fh:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+        if self.echo:
+            print(line)
+        return rec
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,71 @@
+"""Device profiling + per-step timing.
+
+``trace`` wraps ``jax.profiler`` (XLA/TPU traces viewable in
+TensorBoard/Perfetto); ``StepTimer`` gives honest step timings by blocking
+on device results — the recorded version of the reference's
+``t0 = time.time(); model.fit(...)`` wall-clock pair (cnn.py:126-133).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a device trace for the enclosed block.
+
+    View with TensorBoard's profile plugin or ui.perfetto.dev.
+    """
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@dataclass
+class StepTimer:
+    """Accumulates per-step wall-clock; blocks on a result each step so the
+    measured time covers device execution, not just dispatch."""
+
+    times: list = field(default_factory=list)
+    _t0: float | None = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, block_on=None) -> float:
+        import jax
+
+        if block_on is not None:
+            jax.block_until_ready(block_on)
+        dt = time.perf_counter() - (self._t0 or time.perf_counter())
+        self.times.append(dt)
+        return dt
+
+    @contextlib.contextmanager
+    def step(self):
+        """Time one step: set ``out["block_on"]`` to the step's device
+        result so the timing covers execution, not just dispatch."""
+        self.start()
+        out = {}
+        try:
+            yield out
+        finally:
+            self.stop(out.get("block_on"))
+
+    @property
+    def mean(self) -> float:
+        return sum(self.times) / len(self.times) if self.times else 0.0
+
+    @property
+    def total(self) -> float:
+        return sum(self.times)
+
+    def samples_per_sec(self, batch_size: int) -> float:
+        return batch_size / self.mean if self.mean else 0.0
