@@ -87,7 +87,10 @@ bool parse_chunk(const char* begin, const char* end, int ncols,
         char* endp = nullptr;
         if (col.kind == 0) {
           long v = strtol(fs, &endp, 10);
-          if (fs == fe_trim || endp != fe_trim) {
+          if (fs == fe_trim || endp != fe_trim || v < INT32_MIN ||
+              v > INT32_MAX) {
+            // Out-of-range ints error out like the NumPy fallback
+            // (np.asarray int32 OverflowError) instead of wrapping.
             err = "bad int field";
             return false;
           }

@@ -86,6 +86,13 @@ class TestNativeCsv:
         assert out["choke"][0] == 2
         np.testing.assert_allclose(out["flow"], [3.0])
 
+    def test_int32_overflow_errors(self, tmp_path):
+        # NumPy fallback raises OverflowError; native must error too, not wrap.
+        path = tmp_path / "o.csv"
+        path.write_text("1.0,3000000000,0.5,a,3.0\n")
+        with pytest.raises(ValueError):
+            native.read_csv_native(str(path), SCHEMA)
+
     def test_non_ascii_strings(self, tmp_path):
         path = tmp_path / "u.csv"
         path.write_text("1.0,2,0.5,pözo_å,3.0\n", encoding="utf-8")

@@ -1,0 +1,199 @@
+"""Serving path: train -> artifact (params + sidecar) -> predict.
+
+SURVEY.md §3.2: the web layer reads the artifact after a job; the artifact
+must be self-contained (params + preprocessor + model config).
+"""
+
+import numpy as np
+import pytest
+
+from tpuflow.api import Predictor, TrainJobConfig, predict, train
+from tpuflow.data.features import FeaturePipeline
+from tpuflow.data.schema import Schema
+from tpuflow.data.synthetic import generate_wells, wells_to_table, write_csv
+
+NAMES = "pressure,choke,glr,temperature,water_cut,completion,flow"
+TYPES = "float,float,float,float,float,string,float"
+
+
+def _train_tabular(tmp_path, model="static_mlp"):
+    report = train(
+        TrainJobConfig(
+            model=model,
+            max_epochs=3,
+            batch_size=64,
+            seed=0,
+            verbose=False,
+            n_devices=1,
+            storage_path=str(tmp_path),
+            synthetic_wells=2,
+            synthetic_steps=128,
+        )
+    )
+    return report
+
+
+class TestFeaturePipelineSerialization:
+    def test_roundtrip(self):
+        table = wells_to_table(generate_wells(2, 64, seed=0))
+        schema = Schema.from_cli(NAMES, TYPES, "flow")
+        pipe = FeaturePipeline(schema).fit(table)
+        restored = FeaturePipeline.from_dict(pipe.to_dict())
+        np.testing.assert_allclose(
+            restored.transform(table), pipe.transform(table), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            restored.transform_target(table),
+            pipe.transform_target(table),
+            rtol=1e-6,
+        )
+
+
+class TestTabularServing:
+    def test_train_then_predict(self, tmp_path):
+        _train_tabular(tmp_path)
+        table = wells_to_table(generate_wells(1, 64, seed=7))
+        y = predict(str(tmp_path), "static_mlp", columns=table)
+        assert y.shape == (64,)
+        assert np.all(np.isfinite(y))
+        # Raw units: same order of magnitude as true flow.
+        assert y.mean() > 10.0
+
+    def test_predict_csv(self, tmp_path):
+        _train_tabular(tmp_path)
+        table = wells_to_table(generate_wells(1, 32, seed=8))
+        csv = str(tmp_path / "new.csv")
+        write_csv(csv, table, NAMES.split(","))
+        y = predict(str(tmp_path), "static_mlp", data_path=csv)
+        assert y.shape == (32,)
+
+    def test_predict_unlabeled_csv(self, tmp_path):
+        """Serving data has no target column — the usual case."""
+        _train_tabular(tmp_path)
+        table = wells_to_table(generate_wells(1, 32, seed=8))
+        labeled = str(tmp_path / "l.csv")
+        unlabeled = str(tmp_path / "u.csv")
+        write_csv(labeled, table, NAMES.split(","))
+        features_only = [n for n in NAMES.split(",") if n != "flow"]
+        write_csv(unlabeled, table, features_only)
+        y_l = predict(str(tmp_path), "static_mlp", data_path=labeled)
+        y_u = predict(str(tmp_path), "static_mlp", data_path=unlabeled)
+        np.testing.assert_allclose(y_u, y_l, rtol=1e-6)
+
+    def test_predictor_reusable(self, tmp_path):
+        _train_tabular(tmp_path)
+        pred = Predictor.load(str(tmp_path), "static_mlp")
+        t1 = wells_to_table(generate_wells(1, 16, seed=1))
+        t2 = wells_to_table(generate_wells(1, 16, seed=2))
+        assert pred.predict_columns(t1).shape == (16,)
+        assert pred.predict_columns(t2).shape == (16,)
+
+
+class TestWindowedServing:
+    def test_lstm_train_then_predict(self, tmp_path):
+        train(
+            TrainJobConfig(
+                model="lstm",
+                window=24,
+                max_epochs=2,
+                batch_size=32,
+                seed=0,
+                verbose=False,
+                n_devices=1,
+                storage_path=str(tmp_path),
+                synthetic_wells=2,
+                synthetic_steps=96,
+            )
+        )
+        w = generate_wells(1, 64, seed=5)[0]
+        cols = {
+            "pressure": w.pressure,
+            "choke": w.choke,
+            "glr": w.glr,
+            "temperature": w.temperature,
+            "water_cut": w.water_cut,
+        }
+        y = predict(str(tmp_path), "lstm", columns=cols)
+        # 64-24+1 windows, teacher-forced sequence readout -> [N, 24].
+        assert y.shape == (41, 24)
+        assert np.all(np.isfinite(y))
+
+    def test_window_index_input_order(self, tmp_path):
+        """Wells come back in input (first-appearance) order with a usable
+        prediction→row index; short wells are skipped with a warning."""
+        train(
+            TrainJobConfig(
+                model="lstm",
+                window=24,
+                max_epochs=1,
+                batch_size=32,
+                seed=0,
+                verbose=False,
+                n_devices=1,
+                storage_path=str(tmp_path),
+                synthetic_wells=2,
+                synthetic_steps=96,
+                well_column="well",
+                column_names="well,pressure,choke,glr,temperature,water_cut,flow",
+                column_types="string,float,float,float,float,float,float",
+            )
+        )
+        wells = generate_wells(3, 30, seed=6)
+        # Input order: zeta first, then alpha, then a too-short well.
+        cols = {
+            "well": np.concatenate(
+                [np.full(30, "zeta"), np.full(30, "alpha"), np.full(10, "mid")]
+            ),
+            "pressure": np.concatenate(
+                [wells[0].pressure, wells[1].pressure, wells[2].pressure[:10]]
+            ),
+            "choke": np.concatenate(
+                [wells[0].choke, wells[1].choke, wells[2].choke[:10]]
+            ),
+            "glr": np.concatenate(
+                [wells[0].glr, wells[1].glr, wells[2].glr[:10]]
+            ),
+            "temperature": np.concatenate(
+                [wells[0].temperature, wells[1].temperature,
+                 wells[2].temperature[:10]]
+            ),
+            "water_cut": np.concatenate(
+                [wells[0].water_cut, wells[1].water_cut,
+                 wells[2].water_cut[:10]]
+            ),
+        }
+        y, idx = predict(
+            str(tmp_path), "lstm", columns=cols, return_index=True
+        )
+        n_per_well = 30 - 24 + 1
+        assert len(y) == 2 * n_per_well  # "mid" skipped (too short)
+        assert idx.wells[:n_per_well] == ["zeta"] * n_per_well  # input order
+        assert idx.wells[n_per_well:] == ["alpha"] * n_per_well
+        # Starts index into the ORIGINAL rows: alpha's block starts at 30.
+        assert idx.starts[n_per_well] == 30
+
+    def test_too_short_input_raises(self, tmp_path):
+        train(
+            TrainJobConfig(
+                model="lstm",
+                window=24,
+                max_epochs=1,
+                batch_size=32,
+                seed=0,
+                verbose=False,
+                n_devices=1,
+                storage_path=str(tmp_path),
+                synthetic_wells=2,
+                synthetic_steps=96,
+            )
+        )
+        w = generate_wells(1, 10, seed=5)[0]
+        cols = {
+            "pressure": w.pressure,
+            "choke": w.choke,
+            "glr": w.glr,
+            "temperature": w.temperature,
+            "water_cut": w.water_cut,
+        }
+        with pytest.raises(ValueError, match="no full"):
+            predict(str(tmp_path), "lstm", columns=cols)

@@ -1,0 +1,248 @@
+"""Serving: load a trained artifact and predict flow for new data.
+
+The reference's implied serving path (SURVEY.md §3.2): the web component
+reads the model artifact at ``{storagePath}models/{name}.mdl`` after a
+training job (reference cnn.py:39,122) and serves predictions. Here the
+artifact is completed into a self-contained deployable: best params
+(Orbax) **plus** a JSON sidecar with the model config and the fitted
+preprocessor state, so serving needs no training-time context — exactly
+what the reference's save-params-only artifact was missing.
+
+Serving accepts **unlabeled** data: a CSV may carry all trained columns or
+all-but-the-target (the usual case — the target is what's being
+predicted); the column count picks the schema variant.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+from tpuflow.data.csv_io import read_csv
+from tpuflow.data.features import FeaturePipeline
+from tpuflow.data.schema import ColumnSpec, Schema
+from tpuflow.models import build_model
+from tpuflow.train.checkpoint import BestCheckpointer
+from tpuflow.train.steps import make_predict
+
+
+def _meta_path(storage_path: str, name: str) -> str:
+    return os.path.join(storage_path, "meta", f"{name}.json")
+
+
+def save_artifact_meta(
+    storage_path: str,
+    name: str,
+    model: str,
+    model_kwargs: dict,
+    kind: str,
+    preprocessor: dict,
+    sample_shape: tuple,
+) -> None:
+    """Write the serving sidecar next to the checkpoint tree."""
+    path = _meta_path(storage_path, name)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(
+            {
+                "model": model,
+                "model_kwargs": model_kwargs,
+                "kind": kind,  # "tabular" | "windowed"
+                "preprocessor": preprocessor,
+                "sample_shape": list(sample_shape),
+            },
+            f,
+        )
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+@dataclass
+class WindowIndex:
+    """Maps windowed predictions back to input rows: prediction ``i`` is
+    the window of ``window`` steps starting at ``starts[i]`` (a row index
+    into the original input) of well ``wells[i]``."""
+
+    wells: list
+    starts: np.ndarray
+
+
+@dataclass
+class Predictor:
+    """A loaded artifact: jitted forward + preprocessor, ready to serve."""
+
+    model_name: str
+    kind: str
+    _predict_fn: object
+    _params: object
+    _meta: dict
+    _pipeline: FeaturePipeline | None = None  # tabular only, cached
+
+    @classmethod
+    def load(cls, storage_path: str, name: str) -> "Predictor":
+        with open(_meta_path(storage_path, name), "r", encoding="utf-8") as f:
+            meta = json.load(f)
+        model = build_model(meta["model"], **meta["model_kwargs"])
+        sample = np.zeros([2] + list(meta["sample_shape"][1:]), np.float32)
+        template = model.init(jax.random.PRNGKey(0), sample)["params"]
+        ckpt = BestCheckpointer(storage_path, name)
+        params = ckpt.restore_best(template)
+        ckpt.close()
+        pipeline = (
+            FeaturePipeline.from_dict(meta["preprocessor"])
+            if meta["kind"] == "tabular"
+            else None
+        )
+        return cls(
+            model_name=name,
+            kind=meta["kind"],
+            _predict_fn=make_predict(model.apply),
+            _params=params,
+            _meta=meta,
+            _pipeline=pipeline,
+        )
+
+    # --- input preparation ---
+
+    def _features_windowed(
+        self, columns: dict[str, np.ndarray]
+    ) -> tuple[np.ndarray, WindowIndex]:
+        p = self._meta["preprocessor"]
+        names = p["feature_names"]
+        window, stride = p["window"], p["stride"]
+        series = np.stack(
+            [np.asarray(columns[n], np.float32) for n in names], axis=1
+        )
+        mean = np.asarray(p["mean"], np.float32)
+        std = np.asarray(p["std"], np.float32)
+        well_col = p.get("well_column")
+        if well_col and well_col in columns:
+            ids = np.asarray(columns[well_col])
+            # First-appearance order, preserving row (time) order per well —
+            # predictions come out in input order, not sorted-id order.
+            _, first_idx = np.unique(ids, return_index=True)
+            well_order = ids[np.sort(first_idx)]
+            groups = [(w, np.flatnonzero(ids == w)) for w in well_order]
+        else:
+            groups = [(None, np.arange(len(series)))]
+        chunks, wells_out, starts_out = [], [], []
+        for well, rows in groups:
+            s = series[rows]
+            if len(s) < window:
+                print(
+                    f"tpuflow.predict: well {well!r} has {len(s)} rows "
+                    f"< window={window}; skipped",
+                    file=sys.stderr,
+                )
+                continue
+            starts = np.arange(0, len(s) - window + 1, stride)
+            chunks.append(np.stack([s[i : i + window] for i in starts]))
+            wells_out.extend([well] * len(starts))
+            starts_out.append(rows[starts])
+        if not chunks:
+            raise ValueError(f"no full {window}-step windows in input")
+        x = np.concatenate(chunks, axis=0)
+        x = ((x - mean) / std).astype(np.float32)
+        return x, WindowIndex(wells_out, np.concatenate(starts_out))
+
+    def schema(self, with_target: bool = True) -> Schema:
+        """The trained schema; ``with_target=False`` = serving variant for
+        unlabeled CSVs."""
+        p = self._meta["preprocessor"]
+        if self.kind == "tabular":
+            cols = list(zip(p["names"], p["kinds"]))
+            target = p["target"]
+        else:
+            cols = [(c["name"], c["kind"]) for c in p["schema_columns"]]
+            target = p["target"]
+        if not with_target:
+            cols = [(n, k) for n, k in cols if n != target]
+            target = None
+        return Schema(
+            columns=tuple(ColumnSpec(n, k) for n, k in cols), target=target
+        )
+
+    # --- serving entry points ---
+
+    def _forward_batched(self, x: np.ndarray, batch_size: int) -> np.ndarray:
+        """Chunked jitted forward with pow-2 padding on the ragged tail, so
+        compile count stays O(log batch_size) across request sizes."""
+        outs = []
+        for s in range(0, len(x), batch_size):
+            chunk = x[s : s + batch_size]
+            n = len(chunk)
+            padded = min(_next_pow2(n), batch_size)
+            if padded > n:
+                chunk = np.concatenate(
+                    [chunk, np.repeat(chunk[-1:], padded - n, axis=0)]
+                )
+            pred = np.asarray(self._predict_fn(self._params, chunk))
+            outs.append(pred[:n])
+        return np.concatenate(outs, axis=0)
+
+    def predict_columns(
+        self,
+        columns: dict[str, np.ndarray],
+        batch_size: int = 4096,
+        return_index: bool = False,
+    ):
+        """Predict RAW-unit flow from raw input columns.
+
+        For windowed models, ``return_index=True`` additionally returns a
+        ``WindowIndex`` mapping each prediction to its well + start row.
+        """
+        index = None
+        if self.kind == "tabular":
+            x = self._pipeline.transform(columns)
+        else:
+            x, index = self._features_windowed(columns)
+        p = self._meta["preprocessor"]
+        y = self._forward_batched(x, batch_size)
+        y = y * float(p["target_std"]) + float(p["target_mean"])
+        if return_index:
+            return y, index
+        return y
+
+    def predict_csv(
+        self, path: str, batch_size: int = 4096, return_index: bool = False
+    ):
+        """Predict from a headerless CSV — with or without the target column
+        (field count selects the schema variant)."""
+        with open(path, "r", encoding="utf-8") as f:
+            first = f.readline()
+        nfields = len(first.rstrip("\n").rstrip("\r").split(","))
+        full = self.schema(with_target=True)
+        schema = (
+            full if nfields == len(full.columns) else self.schema(False)
+        )
+        return self.predict_columns(
+            read_csv(path, schema),
+            batch_size=batch_size,
+            return_index=return_index,
+        )
+
+
+def predict(
+    storage_path: str,
+    name: str,
+    data_path: str | None = None,
+    columns: dict[str, np.ndarray] | None = None,
+    return_index: bool = False,
+):
+    """One-call serving: load artifact, predict raw-unit flow."""
+    pred = Predictor.load(storage_path, name)
+    if data_path is not None:
+        return pred.predict_csv(data_path, return_index=return_index)
+    if columns is not None:
+        return pred.predict_columns(columns, return_index=return_index)
+    raise ValueError("pass data_path or columns")

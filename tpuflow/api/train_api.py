@@ -37,6 +37,7 @@ from tpuflow.data.synthetic import (
 from tpuflow.api.config import TrainJobConfig
 from tpuflow.models import build_model
 from tpuflow.parallel import (
+    data_sharding,
     init_distributed,
     make_dp_eval_step,
     make_dp_train_step,
@@ -208,7 +209,17 @@ def train(config: TrainJobConfig) -> TrainReport:
         model_name=config.model,
         verbose=config.verbose,
     )
-    result = fit(state, train_ds, val_ds, fit_cfg, train_step, eval_step)
+    result = fit(
+        state,
+        train_ds,
+        val_ds,
+        fit_cfg,
+        train_step,
+        eval_step,
+        # DP runs: land prefetched batches pre-sharded over the mesh so the
+        # step's shard_batch is a no-op instead of a device0 re-transfer.
+        batch_sharding=(data_sharding(mesh) if n_dev > 1 else None),
+    )
 
     # --- final evaluation (cnn.py:132-134, working) ---
     test = evaluate(
@@ -218,6 +229,39 @@ def train(config: TrainJobConfig) -> TrainReport:
         eval_step=eval_step,
         loss=loss_fn,
     )
+    # --- serving sidecar (SURVEY.md §3.2: the artifact the web layer reads) ---
+    if config.storage_path:
+        from tpuflow.api.predict_api import save_artifact_meta
+
+        if config.is_sequence_model:
+            pre = {
+                "feature_names": list(splits.feature_names),
+                "window": config.window,
+                "stride": config.stride,
+                "well_column": config.well_column,
+                "mean": splits.norm_mean.tolist(),
+                "std": splits.norm_std.tolist(),
+                "target_mean": splits.target_mean,
+                "target_std": splits.target_std,
+                "schema_columns": [
+                    {"name": c.name, "kind": c.kind} for c in schema.columns
+                ],
+                "target": schema.target,
+            }
+            kind = "windowed"
+        else:
+            pre = splits.pipeline.to_dict()
+            kind = "tabular"
+        save_artifact_meta(
+            config.storage_path,
+            config.model,
+            config.model,
+            config.model_kwargs,
+            kind,
+            pre,
+            tuple(train_ds.x.shape),
+        )
+
     report = TrainReport(
         result=result,
         test_loss=test["loss"],

@@ -40,11 +40,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--synthetic-wells", type=int, default=8)
     p.add_argument("--synthetic-steps", type=int, default=512)
     p.add_argument("--quiet", action="store_true")
+    p.add_argument("--predict", action="store_true",
+                   help="serve: load the trained artifact from storagePath and predict --data")
+    p.add_argument("--out", default=None, help="with --predict: write predictions CSV here")
     return p
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.predict:
+        return _predict_main(args)
     from tpuflow.api import TrainJobConfig, train
 
     config = TrainJobConfig(
@@ -68,6 +73,24 @@ def main(argv=None) -> int:
         verbose=not args.quiet,
     )
     train(config)
+    return 0
+
+
+def _predict_main(args) -> int:
+    """Serving path (SURVEY.md §3.2): artifact + new data -> predictions."""
+    if not args.storagePath or not args.data:
+        print("--predict needs storagePath and --data", file=sys.stderr)
+        return 2
+    from tpuflow.api import predict
+
+    y = predict(args.storagePath, args.model, data_path=args.data)
+    if args.out:
+        import numpy as np
+
+        np.savetxt(args.out, y.reshape(len(y), -1), delimiter=",", fmt="%.6f")
+        print(f"wrote {len(y)} predictions to {args.out}")
+    else:
+        print(f"{len(y)} predictions; first 5: {y[:5].tolist()}")
     return 0
 
 

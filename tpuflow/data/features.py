@@ -82,6 +82,46 @@ class FeaturePipeline:
         """Scaled-unit predictions/targets back to raw units."""
         return np.asarray(y) * self.target_std_ + self.target_mean_
 
+    def to_dict(self) -> dict:
+        """JSON-serializable state for serving (tpuflow.api.predict)."""
+        return {
+            "names": [c.name for c in self.schema.columns],
+            "kinds": [c.kind for c in self.schema.columns],
+            "target": self.schema.target,
+            "standardize": self.standardize,
+            "standardize_target": self.standardize_target,
+            "vocabs": self.vocabs,
+            "target_vocab": self.target_vocab,
+            "mean": None if self.mean_ is None else self.mean_.tolist(),
+            "std": None if self.std_ is None else self.std_.tolist(),
+            "target_mean": self.target_mean_,
+            "target_std": self.target_std_,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FeaturePipeline":
+        from tpuflow.data.schema import ColumnSpec
+
+        schema = Schema(
+            columns=tuple(
+                ColumnSpec(n, k) for n, k in zip(d["names"], d["kinds"])
+            ),
+            target=d["target"],
+        )
+        pipe = cls(
+            schema,
+            standardize=d["standardize"],
+            standardize_target=d["standardize_target"],
+        )
+        pipe.vocabs = {k: list(v) for k, v in d["vocabs"].items()}
+        pipe.target_vocab = d["target_vocab"]
+        pipe.mean_ = None if d["mean"] is None else np.asarray(d["mean"], np.float32)
+        pipe.std_ = None if d["std"] is None else np.asarray(d["std"], np.float32)
+        pipe.target_mean_ = float(d["target_mean"])
+        pipe.target_std_ = float(d["target_std"])
+        pipe.fitted = True
+        return pipe
+
     @property
     def feature_dim(self) -> int:
         """Static width of the assembled feature vector."""

@@ -44,10 +44,15 @@ class ColumnSpec:
 
 @dataclass(frozen=True)
 class Schema:
-    """A full per-submission schema: ordered columns plus the target."""
+    """A full per-submission schema: ordered columns plus the target.
+
+    ``target=None`` denotes a features-only schema — unlabeled data at
+    serving time (tpuflow.api.predict), where the target column the model
+    was trained on does not exist yet.
+    """
 
     columns: tuple[ColumnSpec, ...]
-    target: str
+    target: str | None
     _by_name: dict = field(init=False, repr=False, compare=False, hash=False)
 
     def __post_init__(self):
@@ -55,7 +60,7 @@ class Schema:
         if len(set(names)) != len(names):
             dupes = sorted({n for n in names if names.count(n) > 1})
             raise ValueError(f"duplicate column names: {dupes}")
-        if self.target not in names:
+        if self.target is not None and self.target not in names:
             raise ValueError(
                 f"target column {self.target!r} not in schema columns {names}"
             )

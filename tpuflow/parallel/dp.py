@@ -101,7 +101,13 @@ def shard_batch(mesh: Mesh, *arrays):
     this).
     """
     sharding = data_sharding(mesh)
-    out = tuple(jax.device_put(np.asarray(a), sharding) for a in arrays)
+    out = tuple(
+        jax.device_put(
+            a if isinstance(a, (np.ndarray, jax.Array)) else np.asarray(a),
+            sharding,
+        )
+        for a in arrays
+    )
     return out if len(out) > 1 else out[0]
 
 

@@ -36,6 +36,15 @@ class FitConfig:
     model_name: str = "model"
     verbose: bool = True
     log_every: int = 1  # epochs between log lines
+    # Fault tolerance (SURVEY.md §5.3): full-state checkpoint cadence and
+    # resume-from-latest. Requires storage_path.
+    save_every: int = 0  # epochs between run-state checkpoints (0 = off)
+    resume: bool = False
+    # Observability: jax.profiler trace of the first profiled epoch.
+    trace_dir: str | None = None
+    # Host→device overlap: batches move to the device in a background
+    # thread, ahead of the step that consumes them.
+    prefetch: int = 2  # buffered batches (0 = synchronous feed)
 
 
 @dataclass
@@ -66,12 +75,15 @@ def fit(
     config: FitConfig = FitConfig(),
     train_step=None,
     eval_step=None,
+    batch_sharding=None,
 ) -> FitResult:
     """Train with early stopping and optional save-best checkpointing.
 
     ``train_step``/``eval_step`` may be injected (e.g. the data-parallel
     sharded steps from ``tpuflow.parallel``); defaults are the single-chip
-    jitted steps.
+    jitted steps. ``batch_sharding`` (a ``NamedSharding``) makes the
+    prefetcher land batches pre-sharded over the mesh instead of on the
+    default device — pass ``data_sharding(mesh)`` alongside DP steps.
     """
     train_step = train_step or make_train_step(config.loss)
     eval_step = eval_step or make_eval_step(config.loss)
@@ -83,19 +95,50 @@ def fit(
         if config.storage_path
         else None
     )
+    run_ckpt = None
+    start_epoch = 1
     result = FitResult(state=state)
+    if config.storage_path and (config.save_every or config.resume):
+        from tpuflow.train.resume import RunCheckpointer
+
+        run_ckpt = RunCheckpointer(config.storage_path, config.model_name)
+        if config.resume:
+            restored = run_ckpt.restore(state)
+            if restored is not None:
+                state, loop_meta = restored
+                start_epoch = int(loop_meta["epoch"]) + 1
+                stopper.best = float(loop_meta["stopper_best"])
+                stopper.bad_epochs = int(loop_meta["stopper_bad_epochs"])
+                result.best_val_loss = float(loop_meta["best_val_loss"])
+                if config.verbose:
+                    print(f"Resuming from epoch {loop_meta['epoch']}")
     samples_seen = 0
     t0 = time.time()
 
-    for epoch in range(1, config.max_epochs + 1):
+    for epoch in range(start_epoch, config.max_epochs + 1):
         te = time.time()
         train_losses = []
-        for x, y in batches(
+        epoch_batches = batches(
             train_ds, config.batch_size, seed=config.seed + epoch
-        ):
+        )
+        if config.prefetch:
+            from tpuflow.data.prefetch import device_prefetch
+
+            epoch_batches = device_prefetch(
+                epoch_batches,
+                buffer_size=config.prefetch,
+                sharding=batch_sharding,
+            )
+        tracing = config.trace_dir is not None and epoch == start_epoch
+        if tracing:
+            jax.profiler.start_trace(config.trace_dir)
+        for x, y in epoch_batches:
             state, metrics = train_step(state, x, y, rng)
             train_losses.append(metrics["loss"])
             samples_seen += len(x)
+        if tracing:
+            jax.block_until_ready(train_losses[-1] if train_losses else None)
+            jax.profiler.stop_trace()
 
         val = _eval_dataset(eval_step, state, val_ds, config.batch_size)
         train_loss = float(np.mean([float(l) for l in train_losses]))
@@ -115,6 +158,21 @@ def fit(
         should_stop = stopper.update(val["loss"])
         if ckpt is not None and stopper.improved:
             ckpt.maybe_save(epoch, state.params, val["loss"])
+        if (
+            run_ckpt is not None
+            and config.save_every
+            and epoch % config.save_every == 0
+        ):
+            run_ckpt.save(
+                epoch,
+                state,
+                {
+                    "epoch": epoch,
+                    "stopper_best": stopper.best,
+                    "stopper_bad_epochs": stopper.bad_epochs,
+                    "best_val_loss": result.best_val_loss,
+                },
+            )
         result.epochs_ran = epoch
         if should_stop:
             break
@@ -124,6 +182,8 @@ def fit(
     result.state = state
     if ckpt is not None:
         ckpt.close()
+    if run_ckpt is not None:
+        run_ckpt.close()
     return result
 
 
