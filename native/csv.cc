@@ -11,6 +11,7 @@
 // Build: make -C native   (g++ -O3 -shared -fPIC -pthread)
 
 #include <atomic>
+#include <cerrno>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -86,16 +87,21 @@ bool parse_chunk(const char* begin, const char* end, int ncols,
           --fe_trim;
         char* endp = nullptr;
         if (col.kind == 0) {
+          errno = 0;
           long v = strtol(fs, &endp, 10);
-          if (fs == fe_trim || endp != fe_trim || v < INT32_MIN ||
-              v > INT32_MAX) {
-            // Out-of-range ints error out like the NumPy fallback
-            // (np.asarray int32 OverflowError) instead of wrapping.
+          // Out-of-range ints error out like the NumPy fallback
+          // (np.asarray int32 OverflowError) instead of wrapping. The
+          // errno check catches clamping on 32-bit-long platforms where
+          // the range comparison alone cannot fire.
+          if (fs == fe_trim || endp != fe_trim || errno == ERANGE ||
+              v < INT32_MIN || v > INT32_MAX) {
             err = "bad int field";
             return false;
           }
           col.ints.push_back(static_cast<int32_t>(v));
         } else {
+          // No range check for floats: NumPy parses overflow to ±inf and
+          // underflow to 0 without error, and strtof does the same.
           float v = strtof(fs, &endp);
           if (fs == fe_trim || endp != fe_trim) {
             err = "bad float field";

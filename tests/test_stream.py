@@ -1,0 +1,77 @@
+"""Streaming CSV ingest: bounded-memory chunks → fixed-shape batches."""
+
+import numpy as np
+import pytest
+
+from tpuflow.data.schema import Schema
+from tpuflow.data.stream import (
+    fit_pipeline_on_sample,
+    stream_batches,
+    stream_csv_columns,
+)
+from tpuflow.data.synthetic import generate_wells, wells_to_table, write_csv
+
+NAMES = "pressure,choke,glr,temperature,water_cut,completion,flow"
+TYPES = "float,float,float,float,float,string,float"
+SCHEMA = Schema.from_cli(NAMES, TYPES, "flow")
+
+
+@pytest.fixture
+def big_csv(tmp_path):
+    table = wells_to_table(generate_wells(4, 256, seed=0))  # 1024 rows
+    path = str(tmp_path / "big.csv")
+    write_csv(path, table, NAMES.split(","))
+    return path, table
+
+
+class TestStreamColumns:
+    def test_chunks_cover_all_rows(self, big_csv):
+        path, table = big_csv
+        chunks = list(stream_csv_columns(path, SCHEMA, chunk_rows=100))
+        assert sum(len(c["flow"]) for c in chunks) == 1024
+        assert len(chunks) == 11  # 10 full + tail
+        got = np.concatenate([c["flow"] for c in chunks])
+        np.testing.assert_allclose(got, table["flow"], rtol=1e-5)
+
+    def test_single_chunk_when_large(self, big_csv):
+        path, _ = big_csv
+        chunks = list(stream_csv_columns(path, SCHEMA, chunk_rows=10_000))
+        assert len(chunks) == 1
+
+
+class TestStreamBatches:
+    def test_fixed_batch_shapes_across_chunk_boundaries(self, big_csv):
+        path, _ = big_csv
+        pipe = fit_pipeline_on_sample(path, SCHEMA, sample_rows=512)
+        # chunk_rows=100 not divisible by batch 64: remainder rows must
+        # carry across chunks.
+        bs = list(stream_batches(path, pipe, batch_size=64, chunk_rows=100))
+        assert len(bs) == 16  # 1024 / 64
+        assert all(x.shape == (64, pipe.feature_dim) for x, _ in bs)
+        assert all(y.shape == (64,) for _, y in bs)
+
+    def test_matches_materialized_pipeline(self, big_csv):
+        path, table = big_csv
+        pipe = fit_pipeline_on_sample(path, SCHEMA, sample_rows=2048)
+        streamed = np.concatenate(
+            [x for x, _ in stream_batches(path, pipe, 128, chunk_rows=300)]
+        )
+        np.testing.assert_allclose(
+            streamed, pipe.transform(table), rtol=1e-5, atol=1e-6
+        )
+
+    def test_keep_remainder(self, big_csv):
+        path, _ = big_csv
+        pipe = fit_pipeline_on_sample(path, SCHEMA)
+        bs = list(
+            stream_batches(path, pipe, 100, chunk_rows=333, drop_remainder=False)
+        )
+        assert sum(len(x) for x, _ in bs) == 1024
+        assert len(bs[-1][0]) == 24
+
+    def test_unfitted_pipeline_rejected(self, big_csv):
+        path, _ = big_csv
+        from tpuflow.data.features import FeaturePipeline
+
+        with pytest.raises(RuntimeError, match="fitted"):
+            next(stream_batches(path, FeaturePipeline(SCHEMA), 64))

@@ -41,6 +41,16 @@ class TrainJobConfig:
     optimizer_kwargs: dict = field(default_factory=dict)
     seed: int = 0
     verbose: bool = True
+    # Compile each epoch into one XLA program (single-chip runs): removes
+    # per-step dispatch, the big lever at the reference's batch size of 20.
+    jit_epoch: bool = False
+
+    # --- fault tolerance (SURVEY §5.3; requires storage_path) ---
+    save_every: int = 0  # epochs between full-state run checkpoints
+    resume: bool = False  # continue from the latest run checkpoint
+
+    # --- observability ---
+    trace_dir: str | None = None  # jax.profiler trace of the first epoch
 
     # --- parallelism ---
     n_devices: int | None = None  # None -> all visible devices; 1 -> no DP

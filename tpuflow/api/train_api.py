@@ -208,6 +208,10 @@ def train(config: TrainJobConfig) -> TrainReport:
         storage_path=config.storage_path,
         model_name=config.model,
         verbose=config.verbose,
+        jit_epoch=config.jit_epoch and n_dev == 1,
+        save_every=config.save_every,
+        resume=config.resume,
+        trace_dir=config.trace_dir,
     )
     result = fit(
         state,

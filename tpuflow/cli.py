@@ -39,6 +39,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--devices", type=int, default=None, help="data-parallel device count (default: all)")
     p.add_argument("--synthetic-wells", type=int, default=8)
     p.add_argument("--synthetic-steps", type=int, default=512)
+    p.add_argument("--jit-epoch", action="store_true",
+                   help="compile each epoch into one XLA program (single-chip)")
+    p.add_argument("--save-every", type=int, default=0,
+                   help="epochs between full-state run checkpoints (needs storagePath)")
+    p.add_argument("--resume", action="store_true",
+                   help="continue from the latest run checkpoint under storagePath")
+    p.add_argument("--trace-dir", default=None,
+                   help="capture a jax.profiler trace of the first epoch here")
     p.add_argument("--quiet", action="store_true")
     p.add_argument("--predict", action="store_true",
                    help="serve: load the trained artifact from storagePath and predict --data")
@@ -71,6 +79,10 @@ def main(argv=None) -> int:
         synthetic_wells=args.synthetic_wells,
         synthetic_steps=args.synthetic_steps,
         verbose=not args.quiet,
+        jit_epoch=args.jit_epoch,
+        save_every=args.save_every,
+        resume=args.resume,
+        trace_dir=args.trace_dir,
     )
     train(config)
     return 0

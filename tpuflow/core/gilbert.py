@@ -87,6 +87,35 @@ def gilbert_flow(
     )
 
 
+def fit_coefficients(
+    wellhead_pressure: jnp.ndarray,
+    choke_size: jnp.ndarray,
+    glr: jnp.ndarray,
+    flow_rate: jnp.ndarray,
+) -> ChokeCoefficients:
+    """Calibrate (a, b, c) to field data by least squares in log space.
+
+    The correlation is log-linear: ``log q = log P − log a − b·log GLR +
+    c·log S``, so the residual ``log q − log P`` is linear in
+    ``(−log a, −b, c)`` — one ``lstsq`` solve, no iteration. This is how a
+    per-field physical baseline is tuned before comparing learned models
+    against it (the reference fixes Gilbert's published 1954 constants;
+    calibration makes the baseline honest on a specific field's wells).
+    """
+    P = jnp.maximum(jnp.asarray(wellhead_pressure, jnp.float32), _EPS)
+    S = jnp.maximum(jnp.asarray(choke_size, jnp.float32), _EPS)
+    G = jnp.maximum(jnp.asarray(glr, jnp.float32), _EPS)
+    q = jnp.maximum(jnp.asarray(flow_rate, jnp.float32), _EPS)
+    y = jnp.log(q) - jnp.log(P)
+    X = jnp.stack(
+        [jnp.ones_like(y), jnp.log(G), jnp.log(S)], axis=1
+    )  # [N, 3] @ (−log a, −b, c)
+    theta, *_ = jnp.linalg.lstsq(X, y)
+    return ChokeCoefficients(
+        a=float(jnp.exp(-theta[0])), b=float(-theta[1]), c=float(theta[2])
+    )
+
+
 def gilbert_wellhead_pressure(
     flow_rate: jnp.ndarray,
     choke_size: jnp.ndarray,

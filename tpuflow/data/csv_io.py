@@ -32,21 +32,26 @@ def read_csv(path: str, schema: Schema) -> dict[str, np.ndarray]:
     return _read_csv_numpy(path, schema)
 
 
-def _read_csv_numpy(path: str, schema: Schema) -> dict[str, np.ndarray]:
+def parse_rows(
+    rows: list[tuple[int, str]], schema: Schema, source: str = "<csv>"
+) -> dict[str, np.ndarray]:
+    """Parse ``(lineno, text)`` rows into typed per-column arrays.
+
+    The single Python-side row parser — used by the whole-file fallback
+    below and by the streaming reader (tpuflow.data.stream), so field
+    validation and dtype semantics live in exactly one place (the native
+    parser in native/csv.cc mirrors them and is tested for parity).
+    """
     ncols = len(schema.columns)
     cells: list[list[str]] = [[] for _ in range(ncols)]
-    with open(path, "r", encoding="utf-8") as f:
-        for lineno, line in enumerate(f, 1):
-            line = line.rstrip("\n").rstrip("\r")
-            if not line:
-                continue
-            parts = line.split(",")
-            if len(parts) != ncols:
-                raise ValueError(
-                    f"{path}:{lineno}: expected {ncols} fields, got {len(parts)}"
-                )
-            for i, p in enumerate(parts):
-                cells[i].append(p)
+    for lineno, line in rows:
+        parts = line.split(",")
+        if len(parts) != ncols:
+            raise ValueError(
+                f"{source}:{lineno}: expected {ncols} fields, got {len(parts)}"
+            )
+        for i, p in enumerate(parts):
+            cells[i].append(p)
     out: dict[str, np.ndarray] = {}
     for spec, col in zip(schema.columns, cells):
         if spec.kind == "int":
@@ -56,3 +61,13 @@ def _read_csv_numpy(path: str, schema: Schema) -> dict[str, np.ndarray]:
         else:
             out[spec.name] = np.asarray(col, dtype=np.str_)
     return out
+
+
+def _read_csv_numpy(path: str, schema: Schema) -> dict[str, np.ndarray]:
+    rows: list[tuple[int, str]] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.rstrip("\n").rstrip("\r")
+            if line:
+                rows.append((lineno, line))
+    return parse_rows(rows, schema, source=path)

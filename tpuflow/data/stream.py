@@ -1,0 +1,88 @@
+"""Streaming CSV ingest: larger-than-memory tables → fixed-shape batches.
+
+The reference's scale story is cluster-resident HDFS data read by Spark
+executors (reference Readme.md:3, cnn.py:65). The TPU-host equivalent for
+tables that don't fit in RAM: stream the headerless CSV in bounded row
+chunks, transform each chunk with an ALREADY-FITTED feature pipeline (fit
+on a training sample — never refit mid-stream, preserving the
+fit-once-on-train discipline of SURVEY.md C6), and emit fixed-size device
+batches. Composes with ``tpuflow.data.prefetch`` for host→device overlap.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+from tpuflow.data.csv_io import parse_rows
+from tpuflow.data.features import FeaturePipeline
+from tpuflow.data.schema import Schema
+
+
+def stream_csv_columns(
+    path: str, schema: Schema, chunk_rows: int = 65536
+) -> Iterator[dict[str, np.ndarray]]:
+    """Yield the CSV as a sequence of column-dict chunks of ≤ chunk_rows.
+
+    Memory is bounded by ``chunk_rows``, not the file size. Parsing and
+    validation are shared with the whole-file reader (csv_io.parse_rows),
+    with true file line numbers in every error.
+    """
+    rows: list[tuple[int, str]] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.rstrip("\n").rstrip("\r")
+            if not line:
+                continue
+            rows.append((lineno, line))
+            if len(rows) >= chunk_rows:
+                yield parse_rows(rows, schema, source=path)
+                rows = []
+    if rows:
+        yield parse_rows(rows, schema, source=path)
+
+
+def stream_batches(
+    path: str,
+    pipeline: FeaturePipeline,
+    batch_size: int,
+    chunk_rows: int = 65536,
+    drop_remainder: bool = True,
+) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Stream fixed-size (x, y) training batches from a large CSV.
+
+    ``pipeline`` must already be fitted (on a train sample). Rows carry
+    over between chunks so every batch has exactly ``batch_size`` rows;
+    with ``drop_remainder`` the ragged tail is dropped (one XLA shape for
+    the whole stream — SURVEY.md §7's no-recompilation discipline).
+    """
+    if not pipeline.fitted:
+        raise RuntimeError("stream_batches requires a fitted pipeline")
+    x_rem: np.ndarray | None = None
+    y_rem: np.ndarray | None = None
+    for columns in stream_csv_columns(path, pipeline.schema, chunk_rows):
+        x = pipeline.transform(columns)
+        y = pipeline.transform_target(columns)
+        if x_rem is not None:
+            x = np.concatenate([x_rem, x])
+            y = np.concatenate([y_rem, y])
+        n_full = len(x) // batch_size * batch_size
+        for s in range(0, n_full, batch_size):
+            yield x[s : s + batch_size], y[s : s + batch_size]
+        x_rem, y_rem = x[n_full:], y[n_full:]
+    if not drop_remainder and x_rem is not None and len(x_rem):
+        yield x_rem, y_rem
+
+
+def fit_pipeline_on_sample(
+    path: str, schema: Schema, sample_rows: int = 100_000
+) -> FeaturePipeline:
+    """Fit the feature pipeline on the stream's head.
+
+    The streaming analog of fit-on-train: stats and vocabularies come from
+    a bounded sample instead of a full materialized split.
+    """
+    for columns in stream_csv_columns(path, schema, chunk_rows=sample_rows):
+        return FeaturePipeline(schema).fit(columns)
+    raise ValueError(f"{path}: empty CSV")
