@@ -1,0 +1,48 @@
+"""Multi-model comparison workflow (reference Readme.md:13 experiments)."""
+
+import numpy as np
+
+from tpuflow.api import TrainJobConfig, compare
+
+
+def test_compare_ranks_models():
+    report = compare(
+        models=("static_mlp", "lstm"),
+        base_config=TrainJobConfig(
+            max_epochs=2,
+            batch_size=64,
+            seed=0,
+            verbose=False,
+            n_devices=1,
+            synthetic_wells=2,
+            synthetic_steps=96,
+        ),
+    )
+    assert len(report.results) == 2
+    assert all(r.error is None for r in report.results)
+    ranked = report.ranked
+    assert ranked[0].test_mae <= ranked[1].test_mae
+    assert report.best.model == ranked[0].model
+    table = report.table()
+    assert "static_mlp" in table and "lstm" in table
+    assert np.isfinite(ranked[0].test_mae)
+
+
+def test_compare_records_failures_non_fatal():
+    report = compare(
+        models=("static_mlp", "nope_model"),
+        base_config=TrainJobConfig(
+            max_epochs=1,
+            batch_size=64,
+            seed=0,
+            verbose=False,
+            n_devices=1,
+            synthetic_wells=2,
+            synthetic_steps=64,
+        ),
+    )
+    ok = [r for r in report.results if r.error is None]
+    bad = [r for r in report.results if r.error is not None]
+    assert len(ok) == 1 and len(bad) == 1
+    assert bad[0].model == "nope_model"
+    assert "FAILED" in report.table()

@@ -1,0 +1,109 @@
+"""Multi-model comparison runs — the reference's experiment workflow.
+
+"We had to make tests on our computing services using multiple model
+types" (reference Readme.md:13): the reference system's test strategy WAS
+comparative model experiments (SURVEY.md §4). This module makes that
+workflow one call: train each model family on the same data/seed, collect
+test MAE (raw units), throughput, and the Gilbert-baseline comparison into
+one ranked report.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+
+from tpuflow.api.config import TrainJobConfig
+from tpuflow.api.train_api import train
+
+DEFAULT_MODELS = ("static_mlp", "dynamic_mlp", "cnn1d", "lstm", "stacked_lstm")
+
+
+@dataclass
+class ModelResult:
+    model: str
+    test_mae: float
+    test_loss: float
+    gilbert_mae: float | None
+    samples_per_sec: float
+    epochs_ran: int
+    time_elapsed: float
+    error: str | None = None
+
+
+@dataclass
+class ComparisonReport:
+    results: list[ModelResult] = field(default_factory=list)
+
+    @property
+    def ranked(self) -> list[ModelResult]:
+        ok = [r for r in self.results if r.error is None]
+        return sorted(ok, key=lambda r: r.test_mae)
+
+    @property
+    def best(self) -> ModelResult:
+        ranked = self.ranked
+        if not ranked:
+            raise RuntimeError("no model trained successfully")
+        return ranked[0]
+
+    def table(self) -> str:
+        """The per-model report the reference printed ad hoc, as one table."""
+        lines = [
+            f"{'model':<14} {'test MAE':>12} {'vs Gilbert':>11} "
+            f"{'samples/s':>12} {'epochs':>7} {'time':>8}"
+        ]
+        for r in self.ranked:
+            vs = (
+                f"{r.test_mae / r.gilbert_mae:.3f}x"
+                if r.gilbert_mae
+                else "n/a"
+            )
+            lines.append(
+                f"{r.model:<14} {r.test_mae:>12.2f} {vs:>11} "
+                f"{r.samples_per_sec:>12.0f} {r.epochs_ran:>7} "
+                f"{r.time_elapsed:>7.1f}s"
+            )
+        for r in self.results:
+            if r.error is not None:
+                lines.append(f"{r.model:<14} FAILED: {r.error}")
+        return "\n".join(lines)
+
+
+def compare(
+    models: tuple[str, ...] = DEFAULT_MODELS,
+    base_config: TrainJobConfig | None = None,
+) -> ComparisonReport:
+    """Train every model family on the same data and seed; rank by MAE.
+
+    ``base_config`` carries the shared data/training settings; its
+    ``model`` field is overridden per run. A failing model is recorded,
+    not fatal — the comparison is the deliverable.
+    """
+    base = base_config or TrainJobConfig(max_epochs=40, batch_size=256)
+    report = ComparisonReport()
+    for name in models:
+        config = dataclasses.replace(base, model=name)
+        try:
+            r = train(config)
+        except Exception as e:  # record and keep comparing
+            report.results.append(
+                ModelResult(
+                    model=name, test_mae=float("inf"), test_loss=float("inf"),
+                    gilbert_mae=None, samples_per_sec=0.0, epochs_ran=0,
+                    time_elapsed=0.0, error=f"{type(e).__name__}: {e}",
+                )
+            )
+            continue
+        report.results.append(
+            ModelResult(
+                model=name,
+                test_mae=r.test_mae,
+                test_loss=r.test_loss,
+                gilbert_mae=r.gilbert_mae,
+                samples_per_sec=r.samples_per_sec,
+                epochs_ran=r.result.epochs_ran,
+                time_elapsed=r.time_elapsed,
+            )
+        )
+    return report

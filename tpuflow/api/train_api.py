@@ -213,6 +213,14 @@ def train(config: TrainJobConfig) -> TrainReport:
         resume=config.resume,
         trace_dir=config.trace_dir,
     )
+    if config.jit_epoch and n_dev > 1:
+        import warnings
+
+        warnings.warn(
+            f"jit_epoch requested but {n_dev} devices are in use; falling "
+            "back to per-batch data-parallel stepping",
+            stacklevel=2,
+        )
     result = fit(
         state,
         train_ds,

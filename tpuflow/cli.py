@@ -51,6 +51,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--predict", action="store_true",
                    help="serve: load the trained artifact from storagePath and predict --data")
     p.add_argument("--out", default=None, help="with --predict: write predictions CSV here")
+    p.add_argument("--compare", default=None, metavar="M1,M2,...",
+                   help="train several model families on the same data and rank by MAE")
     return p
 
 
@@ -84,6 +86,13 @@ def main(argv=None) -> int:
         resume=args.resume,
         trace_dir=args.trace_dir,
     )
+    if args.compare:
+        from tpuflow.api import compare
+
+        names = tuple(m.strip() for m in args.compare.split(",") if m.strip())
+        report = compare(names, config)
+        print(report.table())
+        return 0 if report.ranked else 1
     train(config)
     return 0
 

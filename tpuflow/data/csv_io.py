@@ -32,15 +32,27 @@ def read_csv(path: str, schema: Schema) -> dict[str, np.ndarray]:
     return _read_csv_numpy(path, schema)
 
 
+def iter_csv_lines(path: str):
+    """Yield ``(lineno, text)`` for every non-blank line — the single
+    line-reading loop shared by the whole-file and streaming readers."""
+    with open(path, "r", encoding="utf-8") as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.rstrip("\n").rstrip("\r")
+            if line:
+                yield lineno, line
+
+
 def parse_rows(
-    rows: list[tuple[int, str]], schema: Schema, source: str = "<csv>"
+    rows, schema: Schema, source: str = "<csv>"
 ) -> dict[str, np.ndarray]:
-    """Parse ``(lineno, text)`` rows into typed per-column arrays.
+    """Parse an iterable of ``(lineno, text)`` rows into typed per-column
+    arrays.
 
     The single Python-side row parser — used by the whole-file fallback
     below and by the streaming reader (tpuflow.data.stream), so field
     validation and dtype semantics live in exactly one place (the native
     parser in native/csv.cc mirrors them and is tested for parity).
+    Consumes the iterable lazily: only the split fields are retained.
     """
     ncols = len(schema.columns)
     cells: list[list[str]] = [[] for _ in range(ncols)]
@@ -64,10 +76,4 @@ def parse_rows(
 
 
 def _read_csv_numpy(path: str, schema: Schema) -> dict[str, np.ndarray]:
-    rows: list[tuple[int, str]] = []
-    with open(path, "r", encoding="utf-8") as f:
-        for lineno, line in enumerate(f, 1):
-            line = line.rstrip("\n").rstrip("\r")
-            if line:
-                rows.append((lineno, line))
-    return parse_rows(rows, schema, source=path)
+    return parse_rows(iter_csv_lines(path), schema, source=path)

@@ -15,7 +15,7 @@ from typing import Iterator
 
 import numpy as np
 
-from tpuflow.data.csv_io import parse_rows
+from tpuflow.data.csv_io import iter_csv_lines, parse_rows
 from tpuflow.data.features import FeaturePipeline
 from tpuflow.data.schema import Schema
 
@@ -30,15 +30,11 @@ def stream_csv_columns(
     with true file line numbers in every error.
     """
     rows: list[tuple[int, str]] = []
-    with open(path, "r", encoding="utf-8") as f:
-        for lineno, raw in enumerate(f, 1):
-            line = raw.rstrip("\n").rstrip("\r")
-            if not line:
-                continue
-            rows.append((lineno, line))
-            if len(rows) >= chunk_rows:
-                yield parse_rows(rows, schema, source=path)
-                rows = []
+    for lineno, line in iter_csv_lines(path):
+        rows.append((lineno, line))
+        if len(rows) >= chunk_rows:
+            yield parse_rows(rows, schema, source=path)
+            rows = []
     if rows:
         yield parse_rows(rows, schema, source=path)
 

@@ -230,6 +230,12 @@ def _stacked_epoch(ds: ArrayDataset, batch_size: int, seed: int):
     jitted epoch scan."""
     order = np.random.default_rng(seed).permutation(ds.n)
     nb = ds.n // batch_size
+    if nb == 0:
+        raise ValueError(
+            f"jit_epoch: dataset of {ds.n} rows yields zero "
+            f"batch_size={batch_size} batches — the epoch scan would train "
+            "on nothing and report NaN loss"
+        )
     idx = order[: nb * batch_size].reshape(nb, batch_size)
     return ds.x[idx], ds.y[idx]
 
