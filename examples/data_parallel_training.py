@@ -1,0 +1,43 @@
+"""Data-parallel LSTM training across a device mesh.
+
+Runs on whatever devices are visible. To simulate a pod on CPU:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/data_parallel_training.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import jax
+
+from tpuflow.api import TrainJobConfig, train
+
+
+def main():
+    n = jax.device_count()
+    report = train(
+        TrainJobConfig(
+            model="stacked_lstm",
+            window=24,
+            max_epochs=10,
+            batch_size=32 * n,  # global batch: 32 per device
+            n_devices=n,
+            verbose=True,
+            synthetic_wells=4,
+            synthetic_steps=256,
+        )
+    )
+    print(f"\n{n}-device DP run:")
+    print(report.summary())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Fault tolerance: checkpoint every epoch, 'preempt', resume, finish.
+
+The TPU-native answer to the reference's Spark-task-retry story
+(SURVEY.md §5.3): the resumed run continues the exact trajectory.
+
+Run: python examples/resume_after_preemption.py
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+from tpuflow.api import TrainJobConfig, train
+
+
+def main():
+    storage = tempfile.mkdtemp(prefix="tpuflow_resume_")
+    base = dict(
+        model="lstm",
+        window=24,
+        batch_size=64,
+        storage_path=storage,
+        save_every=1,  # full-state checkpoint every epoch
+        verbose=False,
+        n_devices=1,
+        synthetic_wells=2,
+        synthetic_steps=128,
+    )
+
+    # Phase 1: train 4 epochs, then get "preempted".
+    r1 = train(TrainJobConfig(max_epochs=4, **base))
+    print(f"before preemption: {r1.result.epochs_ran} epochs, "
+          f"best val {r1.result.best_val_loss:.4f}")
+
+    # Phase 2: a fresh process resumes from the latest run checkpoint.
+    r2 = train(TrainJobConfig(max_epochs=10, resume=True, **base))
+    print(f"after resume:      reached epoch {r2.result.epochs_ran}, "
+          f"best val {r2.result.best_val_loss:.4f}")
+    print(r2.summary())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""End-to-end walkthrough: generate data → train → evaluate → serve.
+
+The full lifecycle the reference system implies (SURVEY.md §3.1-3.2):
+a web-triggered training job followed by the web layer reading the
+artifact to make predictions — as two in-process calls.
+
+Run: python examples/train_and_serve.py
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from tpuflow.api import Predictor, TrainJobConfig, train
+from tpuflow.data.synthetic import generate_wells, wells_to_table
+
+
+def main():
+    storage = tempfile.mkdtemp(prefix="tpuflow_example_")
+
+    # 1. Train the static ANN on synthetic wells; artifact lands in storage.
+    report = train(
+        TrainJobConfig(
+            model="static_mlp",
+            max_epochs=30,
+            batch_size=128,
+            patience=10,
+            storage_path=storage,
+            verbose=False,
+            n_devices=1,
+        )
+    )
+    print(report.summary())
+
+    # 2. Serve: load the self-contained artifact, predict unlabeled data.
+    predictor = Predictor.load(storage, "static_mlp")
+    new_wells = wells_to_table(generate_wells(n_wells=1, steps=48, seed=123))
+    true_flow = new_wells.pop("flow")  # serving data has no target
+    predictions = predictor.predict_columns(new_wells)
+
+    mae = float(np.mean(np.abs(predictions - true_flow)))
+    print(f"\nServed {len(predictions)} predictions; MAE vs held-back truth: {mae:.1f} stb/day")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,120 @@
+"""Property-based invariants (hypothesis) for the pure core + data layers."""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from tpuflow.core.gilbert import gilbert_flow, gilbert_wellhead_pressure
+from tpuflow.core.losses import mae_clip
+from tpuflow.data.schema import Schema
+from tpuflow.data.splits import random_split
+from tpuflow.data.windows import sliding_windows
+
+finite = st.floats(
+    min_value=-1e4, max_value=1e4, allow_nan=False, allow_infinity=False
+)
+
+
+class TestLossProperties:
+    @given(
+        st.lists(finite, min_size=1, max_size=64),
+        st.lists(finite, min_size=1, max_size=64),
+    )
+    @settings(max_examples=50, deadline=None)
+    def test_mae_clip_bounded(self, a, b):
+        n = min(len(a), len(b))
+        y, p = np.asarray(a[:n], np.float32), np.asarray(b[:n], np.float32)
+        loss = float(mae_clip(y, p))
+        assert 0.0 <= loss <= 6.0 + 1e-6
+
+    @given(st.lists(finite, min_size=1, max_size=64))
+    @settings(max_examples=25, deadline=None)
+    def test_mae_clip_zero_on_perfect(self, a):
+        y = np.asarray(a, np.float32)
+        assert float(mae_clip(y, y)) == 0.0
+
+
+class TestGilbertProperties:
+    pos = st.floats(min_value=1e-2, max_value=1e3, allow_nan=False)
+
+    @given(pos, pos, pos)
+    @settings(max_examples=50, deadline=None)
+    def test_flow_pressure_inverse(self, p, s, g):
+        """q(P) and P(q) are inverse maps for positive inputs."""
+        q = float(gilbert_flow(p, s, g))
+        p_back = float(gilbert_wellhead_pressure(q, s, g))
+        assert abs(p_back - p) <= 1e-3 * max(1.0, abs(p))
+
+    @given(pos, pos, pos, st.floats(min_value=1.01, max_value=3.0))
+    @settings(max_examples=50, deadline=None)
+    def test_flow_monotone_in_pressure(self, p, s, g, k):
+        assert float(gilbert_flow(p * k, s, g)) > float(gilbert_flow(p, s, g))
+
+
+class TestSplitProperties:
+    @given(st.integers(min_value=10, max_value=2000), st.integers(0, 2**31 - 1))
+    @settings(max_examples=50, deadline=None)
+    def test_split_partitions_exactly(self, n, seed):
+        tr, va, te = random_split(n, seed=seed)
+        allidx = np.concatenate([tr, va, te])
+        assert len(allidx) == n
+        assert len(np.unique(allidx)) == n  # a true partition
+
+    @given(st.integers(min_value=10, max_value=500), st.integers(0, 2**31 - 1))
+    @settings(max_examples=25, deadline=None)
+    def test_split_deterministic(self, n, seed):
+        a = random_split(n, seed=seed)
+        b = random_split(n, seed=seed)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+
+class TestWindowProperties:
+    @given(
+        st.integers(min_value=1, max_value=100),
+        st.integers(min_value=1, max_value=30),
+        st.integers(min_value=1, max_value=5),
+    )
+    @settings(max_examples=50, deadline=None)
+    def test_window_count_formula(self, T, length, stride):
+        series = np.zeros((T, 3), np.float32)
+        target = np.arange(T, dtype=np.float32)
+        x, y = sliding_windows(series, target, length=length, stride=stride)
+        expected = 0 if T < length else (T - length) // stride + 1
+        assert len(x) == expected == len(y)
+
+    @given(st.integers(min_value=24, max_value=80))
+    @settings(max_examples=25, deadline=None)
+    def test_window_targets_are_last_step(self, T):
+        series = np.zeros((T, 2), np.float32)
+        target = np.arange(T, dtype=np.float32)
+        x, y = sliding_windows(series, target, length=24)
+        np.testing.assert_array_equal(y, np.arange(23, T, dtype=np.float32))
+
+
+class TestSchemaProperties:
+    names = st.lists(
+        st.text(
+            alphabet=st.characters(whitelist_categories=("Ll",)),
+            min_size=1,
+            max_size=8,
+        ),
+        min_size=2,
+        max_size=8,
+        unique=True,
+    )
+
+    @given(names, st.data())
+    @settings(max_examples=50, deadline=None)
+    def test_cli_roundtrip(self, names, data):
+        kinds = [
+            data.draw(st.sampled_from(["int", "float", "str"]))
+            for _ in names
+        ]
+        target = data.draw(st.sampled_from(names))
+        schema = Schema.from_cli(",".join(names), ",".join(kinds), target)
+        assert schema.names == tuple(names)
+        assert [c.kind for c in schema.columns] == kinds
+        cont = {c.name for c in schema.continuous_features}
+        cat = {c.name for c in schema.categorical_features}
+        assert cont | cat == set(names) - {target}
+        assert not (cont & cat)

@@ -24,3 +24,8 @@ from tpuflow.parallel.dp import (  # noqa: F401
 )
 from tpuflow.parallel.distributed import init_distributed  # noqa: F401
 from tpuflow.parallel.sp import make_sp_forward, ring_lstm_scan  # noqa: F401
+from tpuflow.parallel.tp import (  # noqa: F401
+    column_parallel_matmul,
+    row_parallel_matmul,
+    tp_mlp_forward,
+)

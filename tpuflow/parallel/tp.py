@@ -1,0 +1,125 @@
+"""Tensor parallelism building blocks over the ``model`` mesh axis.
+
+The reference has no tensor parallelism (its models are KBs of params —
+SURVEY.md §2 "Parallelism strategies"), but SURVEY's design note requires
+the sharding API to keep TP *expressible*. This module provides the two
+canonical scaling-book shardings as explicit-collective ``shard_map``
+blocks, so a model family that outgrows one chip's HBM can shard its
+feature dimensions with the same vocabulary the DP path uses:
+
+- **column parallel**: ``W [F, H]`` sharded on H; every device computes
+  its slice of the output, no communication (activations come out
+  H-sharded).
+- **row parallel**: ``W [H, F]`` sharded on H; device-local partial
+  products are summed with ``psum`` — the matching second half, landing
+  the activations replicated again.
+
+``tp_mlp_forward`` composes the pair into the classic
+column-then-row-parallel 2-layer block (one all-reduce per block).
+
+The compiled shard_map programs are cached per (mesh, axis) — repeated
+calls dispatch, they don't retrace.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpuflow.parallel.mesh import MODEL_AXIS
+
+
+def _check_divisible(dim: int, mesh: Mesh, axis: str, what: str) -> None:
+    n = mesh.shape[axis]
+    if dim % n:
+        raise ValueError(
+            f"{what} dimension {dim} not divisible by {axis}={n}"
+        )
+
+
+@functools.lru_cache(maxsize=None)
+def _column_fn(mesh: Mesh, axis: str):
+    def body(x, w):
+        return x @ w
+
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), P(None, axis)),
+            out_specs=P(None, axis),
+            check_vma=False,
+        )
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _row_fn(mesh: Mesh, axis: str):
+    def body(x, w):
+        return lax.psum(x @ w, axis)
+
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(None, axis), P(axis, None)),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _mlp_fn(mesh: Mesh, axis: str):
+    def body(x, w1, w2):
+        h = jax.nn.relu(x @ w1)  # local H-slice, no comm
+        return lax.psum(h @ w2, axis)  # one all-reduce
+
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), P(None, axis), P(axis, None)),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+
+
+def column_parallel_matmul(
+    mesh: Mesh, x: jnp.ndarray, w: jnp.ndarray, axis: str = MODEL_AXIS
+) -> jnp.ndarray:
+    """``x [B, F] @ w [F, H]`` with ``w`` (and the output) sharded on H.
+
+    No communication: each device owns an output-column slice.
+    """
+    _check_divisible(w.shape[1], mesh, axis, "output (H)")
+    return _column_fn(mesh, axis)(x, w)
+
+
+def row_parallel_matmul(
+    mesh: Mesh, x: jnp.ndarray, w: jnp.ndarray, axis: str = MODEL_AXIS
+) -> jnp.ndarray:
+    """``x [B, H] @ w [H, F]`` with ``x``/``w`` sharded on H; output
+    replicated via ``psum`` over ICI (the block's single all-reduce)."""
+    _check_divisible(w.shape[0], mesh, axis, "contraction (H)")
+    return _row_fn(mesh, axis)(x, w)
+
+
+def tp_mlp_forward(
+    mesh: Mesh,
+    x: jnp.ndarray,
+    w1: jnp.ndarray,
+    w2: jnp.ndarray,
+    axis: str = MODEL_AXIS,
+) -> jnp.ndarray:
+    """Column→row-parallel 2-layer MLP block: ``relu(x @ w1) @ w2`` with
+    the hidden dimension sharded across the model axis and exactly one
+    ``psum`` at the block boundary (scaling-book megatron pattern)."""
+    _check_divisible(w1.shape[1], mesh, axis, "hidden (H)")
+    _check_divisible(w2.shape[0], mesh, axis, "hidden (H)")
+    return _mlp_fn(mesh, axis)(x, w1, w2)
