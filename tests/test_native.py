@@ -100,6 +100,55 @@ class TestNativeCsv:
         assert out["well"][0] == "pözo_å"
 
 
+class TestNativeFuzz:
+    def test_random_tables_match_numpy(self, tmp_path):
+        """Fuzz: arbitrary generated tables parse identically both ways."""
+        pytest.importorskip("hypothesis")
+        from hypothesis import given, settings, strategies as st
+
+        float_s = st.floats(
+            min_value=-1e6, max_value=1e6, allow_nan=False, allow_infinity=False
+        )
+        int_s = st.integers(min_value=-(2**31) + 1, max_value=2**31 - 1)
+        str_s = st.text(
+            alphabet=st.characters(
+                whitelist_categories=("Ll", "Lu", "Nd"),
+                whitelist_characters="_- ",
+            ),
+            min_size=1,
+            max_size=12,
+        ).filter(lambda s: s.strip())
+
+        @given(
+            st.lists(
+                st.tuples(float_s, int_s, float_s, str_s, float_s),
+                min_size=1,
+                max_size=40,
+            )
+        )
+        @settings(max_examples=30, deadline=None)
+        def check(rows):
+            path = tmp_path / "fuzz.csv"
+            path.write_text(
+                "\n".join(
+                    f"{a!r},{b},{c!r},{d},{e!r}" for a, b, c, d, e in rows
+                )
+                + "\n",
+                encoding="utf-8",
+            )
+            got = native.read_csv_native(str(path), SCHEMA)
+            want = _read_csv_numpy(str(path), SCHEMA)
+            for name in want:
+                if want[name].dtype.kind == "U":
+                    assert got[name].tolist() == want[name].tolist()
+                else:
+                    np.testing.assert_array_equal(
+                        got[name], want[name], err_msg=name
+                    )
+
+        check()
+
+
 class TestNativeWindows:
     @pytest.mark.parametrize("teacher_forcing", [False, True])
     @pytest.mark.parametrize("stride", [1, 3])

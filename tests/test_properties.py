@@ -1,6 +1,9 @@
 """Property-based invariants (hypothesis) for the pure core + data layers."""
 
 import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st
 
 from tpuflow.core.gilbert import gilbert_flow, gilbert_wellhead_pressure

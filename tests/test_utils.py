@@ -74,3 +74,32 @@ class TestMetricsLogger:
         rec = log.write("x", v=1)
         assert rec["v"] == 1
         log.close()
+
+    def test_fit_writes_metrics_jsonl(self, tmp_path):
+        """FitConfig.metrics_path records every epoch + a final summary."""
+        import jax
+        import jax.numpy as jnp
+
+        from tpuflow.data.pipeline import ArrayDataset
+        from tpuflow.models import StaticMLP
+        from tpuflow.train import FitConfig, create_state, fit
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((96, 4)).astype(np.float32)
+        y = x.sum(axis=1).astype(np.float32)
+        path = str(tmp_path / "m.jsonl")
+        fit(
+            create_state(
+                StaticMLP(), jax.random.PRNGKey(0), jnp.ones((2, 4), jnp.float32)
+            ),
+            ArrayDataset(x[:64], y[:64]),
+            ArrayDataset(x[64:], y[64:]),
+            FitConfig(max_epochs=3, batch_size=16, verbose=False,
+                      metrics_path=path),
+        )
+        recs = [json.loads(l) for l in open(path)]
+        epochs = [r for r in recs if r["event"] == "epoch"]
+        done = [r for r in recs if r["event"] == "fit_done"]
+        assert len(epochs) == 3
+        assert {"loss", "val_loss", "val_mae"} <= set(epochs[0])
+        assert len(done) == 1 and done[0]["epochs"] == 3

@@ -51,6 +51,7 @@ class TrainJobConfig:
 
     # --- observability ---
     trace_dir: str | None = None  # jax.profiler trace of the first epoch
+    metrics_path: str | None = None  # per-epoch JSONL metrics file
 
     # --- parallelism ---
     n_devices: int | None = None  # None -> all visible devices; 1 -> no DP

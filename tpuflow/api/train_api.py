@@ -212,6 +212,7 @@ def train(config: TrainJobConfig) -> TrainReport:
         save_every=config.save_every,
         resume=config.resume,
         trace_dir=config.trace_dir,
+        metrics_path=config.metrics_path,
     )
     if config.jit_epoch and n_dev > 1:
         import warnings

@@ -47,6 +47,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="continue from the latest run checkpoint under storagePath")
     p.add_argument("--trace-dir", default=None,
                    help="capture a jax.profiler trace of the first epoch here")
+    p.add_argument("--metrics", default=None, metavar="PATH",
+                   help="append per-epoch JSONL metric records to PATH")
     p.add_argument("--quiet", action="store_true")
     p.add_argument("--predict", action="store_true",
                    help="serve: load the trained artifact from storagePath and predict --data")
@@ -85,6 +87,7 @@ def main(argv=None) -> int:
         save_every=args.save_every,
         resume=args.resume,
         trace_dir=args.trace_dir,
+        metrics_path=args.metrics,
     )
     if args.compare:
         from tpuflow.api import compare

@@ -51,6 +51,8 @@ class FitConfig:
     # dropout streams differ from the per-batch path (per-batch-index vs
     # per-step rng folding).
     jit_epoch: bool = False
+    # Structured metrics: append per-epoch JSONL records here (SURVEY §5.5).
+    metrics_path: str | None = None
 
 
 @dataclass
@@ -138,6 +140,12 @@ def fit(
 
         epoch_step = make_epoch_step(config.loss)
 
+    mlog = None
+    if config.metrics_path:
+        from tpuflow.utils.logging import MetricsLogger
+
+        mlog = MetricsLogger(config.metrics_path)
+
     for epoch in range(start_epoch, config.max_epochs + 1):
         te = time.time()
         tracing = config.trace_dir is not None and epoch == start_epoch
@@ -184,6 +192,11 @@ def fit(
             {"epoch": epoch, "loss": train_loss, "val_loss": val["loss"],
              "val_mae": val["mae"], "time": epoch_time}
         )
+        if mlog is not None:
+            rec = dict(result.history[-1])
+            # 'time' would shadow the logger's wall-clock timestamp field.
+            rec["epoch_time"] = rec.pop("time")
+            mlog.write("epoch", model=config.model_name, **rec)
         if config.verbose and epoch % config.log_every == 0:
             print(
                 f"Epoch {epoch}/{config.max_epochs} - {epoch_time:.2f}s"
@@ -221,6 +234,16 @@ def fit(
         ckpt.close()
     if run_ckpt is not None:
         run_ckpt.close()
+    if mlog is not None:
+        mlog.write(
+            "fit_done",
+            model=config.model_name,
+            epochs=result.epochs_ran,
+            best_val_loss=result.best_val_loss,
+            time_elapsed=result.time_elapsed,
+            samples_per_sec=result.samples_per_sec,
+        )
+        mlog.close()
     return result
 
 
