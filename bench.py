@@ -1,14 +1,20 @@
 """Benchmark: LSTM-64 teacher-forced training throughput (samples/sec/chip).
 
 The BASELINE.json north-star metric: train the dynamic LSTM flow model at
->=10k samples/sec/chip. This script times the full jitted training step
+>=10k samples/sec/chip. This script times the full training step
 (fwd + bwd + SGD update) of the LSTM-64 config on the available chip and
 prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+To keep Python dispatch off the measurement, BENCH_SCAN (default 16)
+training steps are compiled into one XLA program per dispatch
+(``lax.scan`` — the same mechanism as FitConfig.jit_epoch), so the number
+reflects the chip, not the host loop.
 
 vs_baseline is value / 10_000 (the driver-set target; the reference
 publishes no numbers of its own — BASELINE.md).
 
-Env knobs: BENCH_BATCH (default 4096), BENCH_SECONDS (default 10).
+Env knobs: BENCH_BATCH (default 4096), BENCH_SECONDS (default 10),
+BENCH_SCAN (steps per dispatch, default 16; 1 = per-step dispatch).
 """
 
 from __future__ import annotations
@@ -27,9 +33,11 @@ def main() -> None:
     from tpuflow.core.losses import mae_clip
     from tpuflow.models import LSTMRegressor
     from tpuflow.train import create_state, make_train_step
+    from tpuflow.train.steps import make_epoch_step
 
     batch = int(os.environ.get("BENCH_BATCH", 4096))
     seconds = float(os.environ.get("BENCH_SECONDS", 10))
+    scan = max(int(os.environ.get("BENCH_SCAN", 16)), 1)
     window, features = 24, 5
 
     model = LSTMRegressor(hidden=64, dtype=jnp.bfloat16)
@@ -38,23 +46,33 @@ def main() -> None:
     y = jnp.asarray(rng.standard_normal((batch, window)), jnp.float32)
 
     state = create_state(model, jax.random.PRNGKey(0), x[:2])
-    step = make_train_step(mae_clip)
     key = jax.random.PRNGKey(0)
 
+    if scan > 1:
+        # K steps per dispatch; the same batch repeated is fine for a
+        # throughput measurement (identical FLOPs/bytes per step).
+        xs = jnp.broadcast_to(x, (scan,) + x.shape)
+        ys = jnp.broadcast_to(y, (scan,) + y.shape)
+        epoch_step = make_epoch_step(mae_clip)
+        step = lambda s: epoch_step(s, xs, ys, key)
+    else:
+        one_step = make_train_step(mae_clip)
+        step = lambda s: one_step(s, x, y, key)
+
     # Warmup/compile.
-    state, m = step(state, x, y, key)
-    jax.block_until_ready(m["loss"])
+    state, m = step(state)
+    jax.block_until_ready(m)
 
     # Timed run.
     t0 = time.perf_counter()
     steps = 0
     while time.perf_counter() - t0 < seconds:
-        state, m = step(state, x, y, key)
+        state, m = step(state)
         steps += 1
-    jax.block_until_ready(m["loss"])
+    jax.block_until_ready(m)
     elapsed = time.perf_counter() - t0
 
-    samples_per_sec = batch * steps / elapsed
+    samples_per_sec = batch * scan * steps / elapsed
     print(
         json.dumps(
             {

@@ -69,6 +69,49 @@ class TestStreamBatches:
         assert sum(len(x) for x, _ in bs) == 1024
         assert len(bs[-1][0]) == 24
 
+    def test_shuffle_buffer_same_rows_different_order(self, big_csv):
+        path, _ = big_csv
+        pipe = fit_pipeline_on_sample(path, SCHEMA)
+        plain = list(stream_batches(path, pipe, 64, chunk_rows=200))
+        shuf = list(
+            stream_batches(
+                path, pipe, 64, chunk_rows=200, shuffle_buffer=128, seed=1
+            )
+        )
+        assert len(shuf) == len(plain) == 16
+        assert all(x.shape == plain[0][0].shape for x, _ in shuf)
+        ys_plain = np.sort(np.concatenate([y for _, y in plain]))
+        ys_shuf = np.sort(np.concatenate([y for _, y in shuf]))
+        np.testing.assert_allclose(ys_shuf, ys_plain)  # same multiset
+        # ...but not the same order.
+        assert not np.allclose(shuf[0][1], plain[0][1])
+
+    def test_shuffle_buffer_larger_than_chunk_still_shuffles(self, big_csv):
+        """Regression: buffer >= chunk_rows must accumulate and shuffle,
+        not silently pass rows through in file order."""
+        path, _ = big_csv
+        pipe = fit_pipeline_on_sample(path, SCHEMA)
+        plain = list(stream_batches(path, pipe, 64, chunk_rows=100))
+        shuf = list(
+            stream_batches(
+                path, pipe, 64, chunk_rows=100, shuffle_buffer=300, seed=3
+            )
+        )
+        assert len(shuf) == len(plain) == 16
+        ys_plain = np.sort(np.concatenate([y for _, y in plain]))
+        ys_shuf = np.sort(np.concatenate([y for _, y in shuf]))
+        np.testing.assert_allclose(ys_shuf, ys_plain)  # same multiset
+        assert not np.allclose(shuf[0][1], plain[0][1])  # actually shuffled
+
+    def test_shuffle_deterministic_by_seed(self, big_csv):
+        path, _ = big_csv
+        pipe = fit_pipeline_on_sample(path, SCHEMA)
+        a = list(stream_batches(path, pipe, 64, shuffle_buffer=128, seed=7))
+        b = list(stream_batches(path, pipe, 64, shuffle_buffer=128, seed=7))
+        for (xa, ya), (xb, yb) in zip(a, b):
+            np.testing.assert_array_equal(xa, xb)
+            np.testing.assert_array_equal(ya, yb)
+
     def test_unfitted_pipeline_rejected(self, big_csv):
         path, _ = big_csv
         from tpuflow.data.features import FeaturePipeline

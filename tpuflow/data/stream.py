@@ -45,6 +45,8 @@ def stream_batches(
     batch_size: int,
     chunk_rows: int = 65536,
     drop_remainder: bool = True,
+    shuffle_buffer: int = 0,
+    seed: int = 0,
 ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
     """Stream fixed-size (x, y) training batches from a large CSV.
 
@@ -52,9 +54,15 @@ def stream_batches(
     over between chunks so every batch has exactly ``batch_size`` rows;
     with ``drop_remainder`` the ragged tail is dropped (one XLA shape for
     the whole stream — SURVEY.md §7's no-recompilation discipline).
+
+    ``shuffle_buffer > 0`` decorrelates the stream for SGD without
+    materializing it: rows pass through a ``shuffle_buffer``-row windowed
+    shuffle (the bounded-memory analog of a full-epoch permutation; memory
+    stays O(shuffle_buffer) regardless of file size).
     """
     if not pipeline.fitted:
         raise RuntimeError("stream_batches requires a fitted pipeline")
+    rng = np.random.default_rng(seed) if shuffle_buffer else None
     x_rem: np.ndarray | None = None
     y_rem: np.ndarray | None = None
     for columns in stream_csv_columns(path, pipeline.schema, chunk_rows):
@@ -63,12 +71,31 @@ def stream_batches(
         if x_rem is not None:
             x = np.concatenate([x_rem, x])
             y = np.concatenate([y_rem, y])
-        n_full = len(x) // batch_size * batch_size
+        if rng is not None:
+            # Windowed shuffle: permute the whole buffer, emit its head,
+            # hold back up to shuffle_buffer rows to mix with later
+            # chunks. Until the buffer exceeds shuffle_buffer nothing is
+            # emitted — rows accumulate so the window is always full.
+            perm = rng.permutation(len(x))
+            x, y = x[perm], y[perm]
+            hold = min(len(x), shuffle_buffer)
+        else:
+            hold = 0
+        n_avail = max(len(x) - hold, 0)
+        n_full = n_avail // batch_size * batch_size
         for s in range(0, n_full, batch_size):
             yield x[s : s + batch_size], y[s : s + batch_size]
         x_rem, y_rem = x[n_full:], y[n_full:]
-    if not drop_remainder and x_rem is not None and len(x_rem):
-        yield x_rem, y_rem
+    # Drain the tail (shuffled rows still held in the buffer).
+    if x_rem is not None and len(x_rem):
+        if rng is not None:
+            perm = rng.permutation(len(x_rem))
+            x_rem, y_rem = x_rem[perm], y_rem[perm]
+        n_full = len(x_rem) // batch_size * batch_size
+        for s in range(0, n_full, batch_size):
+            yield x_rem[s : s + batch_size], y_rem[s : s + batch_size]
+        if not drop_remainder and n_full < len(x_rem):
+            yield x_rem[n_full:], y_rem[n_full:]
 
 
 def fit_pipeline_on_sample(
