@@ -16,7 +16,14 @@ from dataclasses import dataclass, field
 from tpuflow.api.config import TrainJobConfig
 from tpuflow.api.train_api import train
 
-DEFAULT_MODELS = ("static_mlp", "dynamic_mlp", "cnn1d", "lstm", "stacked_lstm")
+DEFAULT_MODELS = (
+    "static_mlp",
+    "dynamic_mlp",
+    "cnn1d",
+    "lstm",
+    "stacked_lstm",
+    "gilbert_residual",
+)
 
 
 @dataclass

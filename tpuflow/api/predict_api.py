@@ -204,6 +204,13 @@ class Predictor:
         index = None
         if self.kind == "tabular":
             x = self._pipeline.transform(columns)
+            if self._meta["preprocessor"].get("append_gilbert"):
+                # Physics-informed artifact: raw Gilbert prediction rides
+                # as the last feature column (GilbertResidualMLP contract;
+                # same helper as the training pipeline).
+                from tpuflow.core.gilbert import append_gilbert_column
+
+                x = append_gilbert_column(x, columns)
         else:
             x, index = self._features_windowed(columns)
         p = self._meta["preprocessor"]

@@ -145,10 +145,20 @@ def train(config: TrainJobConfig) -> TrainReport:
             columns = read_csv(config.data_path, schema)
         else:
             columns = wells_to_table(_load_wells(config))
-        splits = prepare_tabular(schema, columns, seed=config.seed)
+        cols = {c.name for c in schema.columns}
+        physics = config.model == "gilbert_residual"
+        if physics and not {"pressure", "choke", "glr"} <= cols:
+            raise ValueError(
+                "gilbert_residual needs pressure/choke/glr columns"
+            )
+        splits = prepare_tabular(
+            schema,
+            columns,
+            seed=config.seed,
+            append_gilbert=physics,
+        )
         train_ds, val_ds, test_ds = splits.train, splits.val, splits.test
         target_std = splits.pipeline.target_std_
-        cols = {c.name for c in schema.columns}
         if {"pressure", "choke", "glr"} <= cols:
             # Recover raw test columns for the physical baseline.
             from tpuflow.data.splits import random_split
@@ -171,7 +181,13 @@ def train(config: TrainJobConfig) -> TrainReport:
             )
 
     # --- model + state (L3/L4) ---
-    model = build_model(config.model, **config.model_kwargs)
+    model_kwargs = dict(config.model_kwargs)
+    if config.model == "gilbert_residual":
+        # The physics-informed model standardizes its raw physical output
+        # with the train-split stats (see GilbertResidualMLP docstring).
+        model_kwargs.setdefault("target_mean", splits.pipeline.target_mean_)
+        model_kwargs.setdefault("target_std", splits.pipeline.target_std_)
+    model = build_model(config.model, **model_kwargs)
     tx = build_optimizer(config.optimizer, **config.optimizer_kwargs)
     state = create_state(
         model, jax.random.PRNGKey(config.seed), train_ds.x[:2], tx
@@ -264,12 +280,13 @@ def train(config: TrainJobConfig) -> TrainReport:
             kind = "windowed"
         else:
             pre = splits.pipeline.to_dict()
+            pre["append_gilbert"] = config.model == "gilbert_residual"
             kind = "tabular"
         save_artifact_meta(
             config.storage_path,
             config.model,
             config.model,
-            config.model_kwargs,
+            model_kwargs,  # resolved kwargs (incl. injected target stats)
             kind,
             pre,
             tuple(train_ds.x.shape),

@@ -116,6 +116,23 @@ def fit_coefficients(
     )
 
 
+def append_gilbert_column(features, columns, coeffs: ChokeCoefficients = GILBERT):
+    """Append the RAW Gilbert flow prediction as the last feature column.
+
+    The single source of the ``GilbertResidualMLP`` input contract, shared
+    by the training pipeline and the serving path so the appended column
+    can never drift between them. ``features`` is the assembled [N, F]
+    matrix; ``columns`` the raw per-name arrays.
+    """
+    import numpy as np
+
+    q = np.asarray(
+        gilbert_flow(columns["pressure"], columns["choke"], columns["glr"], coeffs),
+        dtype=np.float32,
+    )
+    return np.concatenate([np.asarray(features), q[:, None]], axis=1)
+
+
 def gilbert_wellhead_pressure(
     flow_rate: jnp.ndarray,
     choke_size: jnp.ndarray,

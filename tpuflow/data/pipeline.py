@@ -49,14 +49,34 @@ def prepare_tabular(
     seed: int = 0,
     fractions: Sequence[float] = DEFAULT_FRACTIONS,
     standardize: bool = True,
+    standardize_target: bool = True,
+    append_gilbert: bool = False,
 ) -> TabularSplits:
-    """Static-model path: split, fit features on train ONLY, transform all."""
+    """Static-model path: split, fit features on train ONLY, transform all.
+
+    ``append_gilbert`` adds the RAW (un-standardized) Gilbert-equation
+    prediction as the last feature column — the input contract of the
+    physics-informed ``GilbertResidualMLP``, which multiplies that column
+    by a learned correction and standardizes its own output with the
+    train-split target stats (so targets stay standardized here, keeping
+    the clip=6 loss meaningful). Requires pressure/choke/glr columns.
+    """
     n = len(next(iter(columns.values())))
     tr, va, te = (
         _take(columns, idx) for idx in random_split(n, fractions, seed)
     )
-    pipe = FeaturePipeline(schema, standardize=standardize).fit(tr)
-    mk = lambda c: ArrayDataset(pipe.transform(c), pipe.transform_target(c))
+    pipe = FeaturePipeline(
+        schema, standardize=standardize, standardize_target=standardize_target
+    ).fit(tr)
+
+    def mk(c):
+        x = pipe.transform(c)
+        if append_gilbert:
+            from tpuflow.core.gilbert import append_gilbert_column
+
+            x = append_gilbert_column(x, c)
+        return ArrayDataset(x, pipe.transform_target(c))
+
     return TabularSplits(mk(tr), mk(va), mk(te), pipe)
 
 

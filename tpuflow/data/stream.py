@@ -86,11 +86,9 @@ def stream_batches(
         for s in range(0, n_full, batch_size):
             yield x[s : s + batch_size], y[s : s + batch_size]
         x_rem, y_rem = x[n_full:], y[n_full:]
-    # Drain the tail (shuffled rows still held in the buffer).
+    # Drain the tail; rows held back by the shuffle are already the tail
+    # of a uniform permutation, so no extra shuffle is needed here.
     if x_rem is not None and len(x_rem):
-        if rng is not None:
-            perm = rng.permutation(len(x_rem))
-            x_rem, y_rem = x_rem[perm], y_rem[perm]
         n_full = len(x_rem) // batch_size * batch_size
         for s in range(0, n_full, batch_size):
             yield x_rem[s : s + batch_size], y_rem[s : s + batch_size]

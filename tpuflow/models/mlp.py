@@ -56,11 +56,20 @@ class GilbertResidualMLP(nn.Module):
     """Physics-informed MLP: Gilbert flow × learned correction.
 
     Expects the Gilbert-equation prediction as the LAST feature column
-    (un-standardized); the MLP maps the remaining features to a positive
-    correction factor via softplus, centred at 1.
+    (un-standardized raw flow); the MLP maps the remaining features to a
+    positive correction factor via softplus, centred at 1.
+
+    ``target_mean``/``target_std`` standardize the raw physical output so
+    the module trains against standardized targets like every other model
+    (keeping the clip=6 loss meaningful and SGD gradients O(1) —
+    raw-flow-unit losses blow up the reference's lr=1e-3/momentum=.99
+    optimizer). The training pipeline injects the train-split stats; at
+    init the output IS the standardized Gilbert prediction.
     """
 
     hidden: Sequence[int] = (64, 64)
+    target_mean: float = 0.0
+    target_std: float = 1.0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, deterministic: bool = True) -> jnp.ndarray:
@@ -72,4 +81,4 @@ class GilbertResidualMLP(nn.Module):
         # starts exactly at the physical model and learns deviations.
         raw = nn.Dense(1, kernel_init=nn.initializers.zeros)(h)[..., 0]
         correction = nn.softplus(raw + 0.5413)
-        return gilbert_q * correction
+        return (gilbert_q * correction - self.target_mean) / self.target_std
