@@ -1,0 +1,51 @@
+"""Extension benchmark: physics-informed GilbertResidualMLP.
+
+Beyond the five BASELINE configs: the Gilbert × learned-correction model
+(the pairing the reference's physical-model + learned-regressor design
+gestures at, reference Readme.md:7-21). Headline: how far the hybrid
+beats the plain physical baseline on held-out data.
+"""
+
+from __future__ import annotations
+
+import sys
+
+sys.path.insert(0, ".")
+
+from benchmarks.common import emit
+from tpuflow.api import TrainJobConfig, train
+
+
+def main(seed: int = 0) -> None:
+    report = train(
+        TrainJobConfig(
+            model="gilbert_residual",
+            max_epochs=60,
+            batch_size=256,
+            patience=10,
+            seed=seed,
+            verbose=False,
+            n_devices=1,
+            synthetic_wells=10,
+            synthetic_steps=512,
+        )
+    )
+    emit(
+        "gilbert_residual",
+        "well_flow_mae",
+        report.test_mae,
+        "stb/day",
+        gilbert_mae=round(report.gilbert_mae, 4),
+        improvement_over_physics=round(report.gilbert_mae / report.test_mae, 2),
+        beats_gilbert=report.test_mae <= report.gilbert_mae,
+    )
+    emit(
+        "gilbert_residual",
+        "train_throughput",
+        report.result.samples_per_sec,
+        "samples/sec/chip",
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -16,6 +16,7 @@ SCRIPTS = [
     "bench_dynamic_ann.py",
     "bench_lstm64.py",
     "bench_stacked_lstm_dp.py",
+    "bench_gilbert_residual.py",  # physics-informed extension
 ]
 
 

@@ -74,6 +74,13 @@ class TestLstmScanKernel:
         out = jax.jit(lstm_scan)(xw, wh, b)
         np.testing.assert_allclose(out, _xla_lstm_scan(xw, wh, b), atol=1e-5)
 
+    def test_lane_aligned_hidden(self):
+        """H=128: gate slices land exactly on TPU lane-tile boundaries."""
+        xw, wh, b = _random_case(T=2, B=8, H=128, seed=4)
+        np.testing.assert_allclose(
+            lstm_scan(xw, wh, b), _xla_lstm_scan(xw, wh, b), atol=1e-4
+        )
+
 
 class TestLstmPallasBackend:
     def test_model_backends_agree(self):

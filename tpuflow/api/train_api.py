@@ -185,8 +185,10 @@ def train(config: TrainJobConfig) -> TrainReport:
     if config.model == "gilbert_residual":
         # The physics-informed model standardizes its raw physical output
         # with the train-split stats (see GilbertResidualMLP docstring).
-        model_kwargs.setdefault("target_mean", splits.pipeline.target_mean_)
-        model_kwargs.setdefault("target_std", splits.pipeline.target_std_)
+        # Unconditional: user-supplied stats would desynchronize from the
+        # pipeline's target standardization and silently break the loss.
+        model_kwargs["target_mean"] = splits.pipeline.target_mean_
+        model_kwargs["target_std"] = splits.pipeline.target_std_
     model = build_model(config.model, **model_kwargs)
     tx = build_optimizer(config.optimizer, **config.optimizer_kwargs)
     state = create_state(

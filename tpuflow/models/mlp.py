@@ -77,8 +77,8 @@ class GilbertResidualMLP(nn.Module):
         h = x[..., :-1]
         for width in self.hidden:
             h = nn.relu(nn.Dense(width)(h))
-        # Zero-init head => raw=0 at init => softplus(0.5413)=1.0: training
-        # starts exactly at the physical model and learns deviations.
+        # Zero-init head => raw=0 at init => softplus(ln(e-1)) == 1:
+        # training starts exactly at the physical model, learns deviations.
         raw = nn.Dense(1, kernel_init=nn.initializers.zeros)(h)[..., 0]
-        correction = nn.softplus(raw + 0.5413)
+        correction = nn.softplus(raw + 0.5413248546129181)
         return (gilbert_q * correction - self.target_mean) / self.target_std
