@@ -1,0 +1,52 @@
+"""CLI smoke tests in a REAL subprocess — catches import-time regressions
+and argument-wiring breaks that in-process tests can mask."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=240):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # The test process env pin doesn't reach a subprocess; the CLI module
+    # itself must work under the standard env contract.
+    return subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms','cpu');"
+         "import sys; from tpuflow.cli import main; sys.exit(main())"
+         ] if args is None else
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms','cpu');"
+         "import sys; from tpuflow.cli import main;"
+         f"sys.exit(main({args!r}))"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env=env,
+        timeout=timeout,
+    )
+
+
+def test_help_exits_zero():
+    out = subprocess.run(
+        [sys.executable, "-m", "tpuflow.cli", "--help"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+    assert out.returncode == 0
+    assert "columnNames" in out.stdout
+    assert "--predict" in out.stdout
+
+
+def test_tiny_train_job_subprocess(tmp_path):
+    out = _run(
+        ["--model", "static_mlp", "--epochs", "2", "--batch-size", "64",
+         "--devices", "1", "--synthetic-wells", "2", "--synthetic-steps",
+         "64", "--quiet"]
+    )
+    assert out.returncode == 0, out.stderr[-2000:]

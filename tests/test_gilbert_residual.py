@@ -46,7 +46,6 @@ class TestGilbertResidualTraining:
         import jax
         import jax.numpy as jnp
 
-        from tpuflow.core.gilbert import gilbert_flow
         from tpuflow.models import build_model
 
         rng = np.random.default_rng(0)

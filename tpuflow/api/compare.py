@@ -13,6 +13,8 @@ from __future__ import annotations
 import dataclasses
 from dataclasses import dataclass, field
 
+import jax
+
 from tpuflow.api.config import TrainJobConfig
 from tpuflow.api.train_api import train
 
@@ -35,6 +37,7 @@ class ModelResult:
     samples_per_sec: float
     epochs_ran: int
     time_elapsed: float
+    param_count: int = 0
     error: str | None = None
 
 
@@ -57,7 +60,7 @@ class ComparisonReport:
     def table(self) -> str:
         """The per-model report the reference printed ad hoc, as one table."""
         lines = [
-            f"{'model':<14} {'test MAE':>12} {'vs Gilbert':>11} "
+            f"{'model':<16} {'params':>9} {'test MAE':>12} {'vs Gilbert':>11} "
             f"{'samples/s':>12} {'epochs':>7} {'time':>8}"
         ]
         for r in self.ranked:
@@ -67,13 +70,13 @@ class ComparisonReport:
                 else "n/a"
             )
             lines.append(
-                f"{r.model:<14} {r.test_mae:>12.2f} {vs:>11} "
+                f"{r.model:<16} {r.param_count:>9} {r.test_mae:>12.2f} {vs:>11} "
                 f"{r.samples_per_sec:>12.0f} {r.epochs_ran:>7} "
                 f"{r.time_elapsed:>7.1f}s"
             )
         for r in self.results:
             if r.error is not None:
-                lines.append(f"{r.model:<14} FAILED: {r.error}")
+                lines.append(f"{r.model:<16} FAILED: {r.error}")
         return "\n".join(lines)
 
 
@@ -102,6 +105,10 @@ def compare(
                 )
             )
             continue
+        n_params = sum(
+            int(leaf.size)
+            for leaf in jax.tree_util.tree_leaves(r.result.state.params)
+        )
         report.results.append(
             ModelResult(
                 model=name,
@@ -111,6 +118,7 @@ def compare(
                 samples_per_sec=r.samples_per_sec,
                 epochs_ran=r.result.epochs_ran,
                 time_elapsed=r.time_elapsed,
+                param_count=n_params,
             )
         )
     return report

@@ -20,6 +20,11 @@ module shards the **time axis** of the LSTM recurrence across the mesh:
 For the attention-free model family this is the honest TPU equivalent of
 ring-attention-style context parallelism: same ring topology, same
 carry-passing collective, applied to a recurrence.
+
+The ring scan is **training-capable**: it differentiates through the
+ppermute carry ring (tested against the on-chip scan's gradients). Take
+gradients inside a ``with jax.set_mesh(mesh):`` context — the transpose
+of the shard_map program needs the mesh to type its cotangents.
 """
 
 from __future__ import annotations
