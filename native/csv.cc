@@ -10,6 +10,7 @@
 //
 // Build: make -C native   (g++ -O3 -shared -fPIC -pthread)
 
+#include <algorithm>
 #include <atomic>
 #include <cerrno>
 #include <cstdint>

@@ -177,6 +177,37 @@ class TestNativeWindows:
         assert x.shape == (0, 24, 3)
         assert y.shape == (0,)
 
+    def test_zero_stride_raises(self):
+        # stride=0 would SIGFPE inside tf_window_count if it crossed into C.
+        series = np.zeros((30, 3), np.float32)
+        target = np.zeros(30, np.float32)
+        with pytest.raises(ValueError, match="stride"):
+            native.sliding_windows_native(series, target, length=24, stride=0)
+
+    def test_zero_length_raises(self):
+        # length=0 would under-read target[-1] in tf_sliding_windows.
+        series = np.zeros((30, 3), np.float32)
+        target = np.zeros(30, np.float32)
+        with pytest.raises(ValueError, match="length"):
+            native.sliding_windows_native(series, target, length=0)
+
+    def test_short_targets_raise(self):
+        # Mismatched targets would read out of bounds in tf_sliding_windows.
+        series = np.zeros((30, 3), np.float32)
+        target = np.zeros(20, np.float32)
+        with pytest.raises(ValueError, match="targets length"):
+            native.sliding_windows_native(series, target, length=24)
+
+    def test_public_api_validates_on_fallback_too(self):
+        from tpuflow.data.windows import sliding_windows, teacher_forcing_pairs
+
+        series = np.zeros((30, 3), np.float32)
+        target = np.zeros(30, np.float32)
+        with pytest.raises(ValueError, match="stride"):
+            sliding_windows(series, target, stride=0)
+        with pytest.raises(ValueError, match="targets length"):
+            teacher_forcing_pairs(series, np.zeros(10, np.float32))
+
 
 class TestPrefetch:
     def test_prefetch_order_and_completeness(self):
@@ -196,6 +227,29 @@ class TestPrefetch:
         assert next(it) == 1
         with pytest.raises(RuntimeError, match="boom"):
             list(it)
+
+    def test_abandoned_generator_stops_worker(self):
+        import threading
+        import time
+
+        from tpuflow.data.prefetch import prefetch
+
+        produced = []
+
+        def gen():
+            for i in range(1000):
+                produced.append(i)
+                yield i
+
+        before = threading.active_count()
+        it = prefetch(gen(), buffer_size=2)
+        assert next(it) == 0
+        it.close()  # consumer abandons mid-stream
+        deadline = time.time() + 5
+        while threading.active_count() > before and time.time() < deadline:
+            time.sleep(0.02)
+        assert threading.active_count() <= before, "worker thread leaked"
+        assert len(produced) < 1000  # upstream not fully drained
 
     def test_device_prefetch(self):
         import jax

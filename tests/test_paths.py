@@ -1,0 +1,138 @@
+"""Remote-storage (URI) path handling: gs:// layout must survive intact.
+
+The reference's deployment contract is writing artifacts to cluster-shared
+storage (reference cnn.py:122 — ``storagePath + "models/cnn.mdl"``;
+Readme.md:3). Round-1 mangled ``gs://`` URIs via ``os.path.abspath``;
+these tests pin the fixed behavior: URI-schemed storage paths reach Orbax
+and fsspec verbatim, and the full artifact layout (models/, runs/, meta/)
+is preserved under the remote root.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from tpuflow.utils.paths import exists, is_uri, join_path, open_file
+
+
+class TestJoinPath:
+    def test_gs_layout_survival(self):
+        assert (
+            join_path("gs://bucket/run1", "models", "lstm")
+            == "gs://bucket/run1/models/lstm"
+        )
+        assert (
+            join_path("gs://bucket/run1/", "meta", "lstm.json")
+            == "gs://bucket/run1/meta/lstm.json"
+        )
+
+    def test_local_paths_absolute(self, tmp_path):
+        out = join_path(str(tmp_path), "models", "m")
+        assert out == str(tmp_path / "models" / "m")
+        assert out.startswith("/")
+
+    def test_is_uri(self):
+        assert is_uri("gs://b/x")
+        assert is_uri("s3://b/x")
+        assert is_uri("memory://x")
+        assert not is_uri("/abs/path")
+        assert not is_uri("rel/path")
+        assert not is_uri("C:row")  # not scheme-like enough
+
+
+class TestCheckpointerDirectories:
+    """Orbax managers must receive the un-mangled URI (mocked — no GCS in
+    the test environment; layout is what's being pinned)."""
+
+    def _capture_manager(self, monkeypatch):
+        captured = {}
+
+        class FakeManager:
+            def __init__(self, directory, *a, **k):
+                captured["directory"] = str(directory)
+
+            def close(self):
+                pass
+
+        import orbax.checkpoint as ocp
+
+        monkeypatch.setattr(ocp, "CheckpointManager", FakeManager)
+        return captured
+
+    def test_best_checkpointer_gs(self, monkeypatch):
+        captured = self._capture_manager(monkeypatch)
+        from tpuflow.train.checkpoint import BestCheckpointer
+
+        ckpt = BestCheckpointer("gs://bucket/exp", "lstm64")
+        assert ckpt.directory == "gs://bucket/exp/models/lstm64"
+        assert captured["directory"] == "gs://bucket/exp/models/lstm64"
+        ckpt.close()
+
+    def test_run_checkpointer_gs(self, monkeypatch):
+        captured = self._capture_manager(monkeypatch)
+        from tpuflow.train.resume import RunCheckpointer
+
+        ckpt = RunCheckpointer("gs://bucket/exp", "lstm64")
+        assert ckpt.directory == "gs://bucket/exp/runs/lstm64"
+        assert captured["directory"] == "gs://bucket/exp/runs/lstm64"
+        ckpt.close()
+
+
+class TestRemoteSidecar:
+    """Serving sidecar + metrics land on a remote filesystem end to end
+    (fsspec ``memory://`` stands in for GCS)."""
+
+    def test_meta_roundtrip_memory_fs(self):
+        from tpuflow.api.predict_api import _meta_path, save_artifact_meta
+
+        root = "memory://tpuflow-test/exp1"
+        save_artifact_meta(
+            root,
+            "static_mlp",
+            "static_mlp",
+            {"hidden": 64},
+            "tabular",
+            {"names": ["a"], "kinds": ["float"]},
+            (128, 4),
+        )
+        path = _meta_path(root, "static_mlp")
+        assert path == "memory://tpuflow-test/exp1/meta/static_mlp.json"
+        assert exists(path)
+        with open_file(path, "r", encoding="utf-8") as f:
+            meta = json.load(f)
+        assert meta["model"] == "static_mlp"
+        assert meta["sample_shape"] == [128, 4]
+
+    def test_metrics_logger_memory_fs(self):
+        from tpuflow.utils.logging import MetricsLogger
+
+        path = "memory://tpuflow-test/exp2/metrics.jsonl"
+        with MetricsLogger(path) as log:
+            log.write("epoch", epoch=1, loss=0.5)
+            log.write("fit_done", best=0.4)
+        with open_file(path, "r") as f:
+            lines = [json.loads(x) for x in f.read().splitlines()]
+        assert [r["event"] for r in lines] == ["epoch", "fit_done"]
+        assert lines[0]["loss"] == 0.5
+
+    def test_metrics_logger_append_survives_reopen(self):
+        """Resumed runs must not erase the prior metric trail on object
+        stores (no real append there — open_file rewrites prior content)."""
+        from tpuflow.utils.logging import MetricsLogger
+
+        path = "memory://tpuflow-test/exp3/metrics.jsonl"
+        with MetricsLogger(path) as log:
+            log.write("epoch", epoch=1)
+        with MetricsLogger(path) as log:  # second run, same trail
+            log.write("epoch", epoch=2)
+        with open_file(path, "r") as f:
+            epochs = [json.loads(x)["epoch"] for x in f.read().splitlines()]
+        assert epochs == [1, 2]
+
+    def test_open_file_local_creates_parents(self, tmp_path):
+        p = str(tmp_path / "deep" / "nested" / "f.txt")
+        with open_file(p, "w") as f:
+            f.write("x")
+        with open_file(p) as f:
+            assert f.read() == "x"

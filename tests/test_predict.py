@@ -80,6 +80,16 @@ class TestTabularServing:
         y_u = predict(str(tmp_path), "static_mlp", data_path=unlabeled)
         np.testing.assert_allclose(y_u, y_l, rtol=1e-6)
 
+    def test_predict_csv_bad_field_count(self, tmp_path):
+        """A malformed CSV names both accepted field counts in its error
+        instead of being mis-parsed against the no-target schema."""
+        _train_tabular(tmp_path)
+        bad = tmp_path / "bad.csv"
+        bad.write_text("1.0,2.0,3.0\n")  # 3 fields; schema wants 7 or 6
+        pred = Predictor.load(str(tmp_path), "static_mlp")
+        with pytest.raises(ValueError, match=r"3 fields.*7.*6"):
+            pred.predict_csv(str(bad))
+
     def test_predictor_reusable(self, tmp_path):
         _train_tabular(tmp_path)
         pred = Predictor.load(str(tmp_path), "static_mlp")

@@ -22,3 +22,28 @@ Layers (bottom-to-top, mirroring SURVEY.md §1's L0-L6 map, TPU-natively):
 """
 
 __version__ = "0.1.0"
+
+
+def _honor_jax_platforms_env() -> None:
+    """Make ``JAX_PLATFORMS=cpu python -m tpuflow.cli ...`` actually work.
+
+    A force-registered out-of-tree platform plugin (e.g. the axon TPU
+    tunnel) can override the documented JAX_PLATFORMS env contract; when
+    its backend is unreachable, every jax init then hangs. Pinning the
+    config from the env var restores the contract. No-op when the var is
+    unset or jax is already initialized.
+    """
+    import os
+
+    value = os.environ.get("JAX_PLATFORMS")
+    if not value:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", value)
+    except Exception:
+        pass  # jax absent or already initialized — leave as-is
+
+
+_honor_jax_platforms_env()

@@ -158,6 +158,17 @@ def sliding_windows_native(
     series = np.ascontiguousarray(series, dtype=np.float32)
     targets = np.ascontiguousarray(targets, dtype=np.float32)
     T, F = series.shape
+    # Validate BEFORE crossing into C: stride=0 is a SIGFPE (integer
+    # divide) in tf_window_count, and short targets an out-of-bounds read
+    # in tf_sliding_windows — mirror the NumPy fallback's exceptions.
+    if stride < 1:
+        raise ValueError(f"stride must be >= 1, got {stride}")
+    if length < 1:
+        raise ValueError(f"window length must be >= 1, got {length}")
+    if targets.shape[0] != T:
+        raise ValueError(
+            f"targets length {targets.shape[0]} != series length {T}"
+        )
     n = lib.tf_window_count(T, length, stride)
     x = np.empty((n, length, F), dtype=np.float32)
     y = np.empty((n, length) if teacher_forcing else (n,), dtype=np.float32)

@@ -16,7 +16,6 @@ predicted); the column count picks the schema variant.
 from __future__ import annotations
 
 import json
-import os
 import sys
 from dataclasses import dataclass
 
@@ -29,10 +28,11 @@ from tpuflow.data.schema import ColumnSpec, Schema
 from tpuflow.models import build_model
 from tpuflow.train.checkpoint import BestCheckpointer
 from tpuflow.train.steps import make_predict
+from tpuflow.utils.paths import join_path, open_file
 
 
 def _meta_path(storage_path: str, name: str) -> str:
-    return os.path.join(storage_path, "meta", f"{name}.json")
+    return join_path(storage_path, "meta", f"{name}.json")
 
 
 def save_artifact_meta(
@@ -46,8 +46,7 @@ def save_artifact_meta(
 ) -> None:
     """Write the serving sidecar next to the checkpoint tree."""
     path = _meta_path(storage_path, name)
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, "w", encoding="utf-8") as f:
+    with open_file(path, "w", encoding="utf-8") as f:
         json.dump(
             {
                 "model": model,
@@ -90,7 +89,9 @@ class Predictor:
 
     @classmethod
     def load(cls, storage_path: str, name: str) -> "Predictor":
-        with open(_meta_path(storage_path, name), "r", encoding="utf-8") as f:
+        with open_file(
+            _meta_path(storage_path, name), "r", encoding="utf-8"
+        ) as f:
             meta = json.load(f)
         model = build_model(meta["model"], **meta["model_kwargs"])
         sample = np.zeros([2] + list(meta["sample_shape"][1:]), np.float32)
@@ -229,9 +230,17 @@ class Predictor:
             first = f.readline()
         nfields = len(first.rstrip("\n").rstrip("\r").split(","))
         full = self.schema(with_target=True)
-        schema = (
-            full if nfields == len(full.columns) else self.schema(False)
-        )
+        serving = self.schema(with_target=False)
+        if nfields == len(full.columns):
+            schema = full
+        elif nfields == len(serving.columns):
+            schema = serving
+        else:
+            raise ValueError(
+                f"{path}: first line has {nfields} fields; expected "
+                f"{len(full.columns)} (with target "
+                f"{full.target!r}) or {len(serving.columns)} (without)"
+            )
         return self.predict_columns(
             read_csv(path, schema),
             batch_size=batch_size,

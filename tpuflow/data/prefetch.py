@@ -18,28 +18,49 @@ _SENTINEL = object()
 
 
 def prefetch(iterator: Iterable, buffer_size: int = 2) -> Iterator:
-    """Run ``iterator`` in a background thread, ``buffer_size`` items ahead."""
+    """Run ``iterator`` in a background thread, ``buffer_size`` items ahead.
+
+    If the consumer abandons the generator early (``close()``, GC, or an
+    exception mid-epoch), the worker observes ``stop`` at its next bounded
+    ``put`` and exits instead of blocking forever on the full queue.
+    """
     q: queue.Queue = queue.Queue(maxsize=buffer_size)
     err: list[BaseException] = []
+    stop = threading.Event()
 
     def worker():
         try:
             for item in iterator:
-                q.put(item)
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
         except BaseException as e:  # re-raised on the consumer side
             err.append(e)
         finally:
-            q.put(_SENTINEL)
+            while not stop.is_set():
+                try:
+                    q.put(_SENTINEL, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
 
     t = threading.Thread(target=worker, daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is _SENTINEL:
-            if err:
-                raise err[0]
-            return
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        stop.set()
 
 
 def device_prefetch(

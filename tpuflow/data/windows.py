@@ -14,6 +14,18 @@ import numpy as np
 DEFAULT_WINDOW = 24
 
 
+def _validate(series, targets, length, stride):
+    if stride < 1:
+        raise ValueError(f"stride must be >= 1, got {stride}")
+    if length < 1:
+        raise ValueError(f"window length must be >= 1, got {length}")
+    if targets.shape[0] != series.shape[0]:
+        raise ValueError(
+            f"targets length {targets.shape[0]} != series length "
+            f"{series.shape[0]}"
+        )
+
+
 def _native_windows(series, targets, length, stride, teacher_forcing):
     """C++ fast path (native/csv.cc); None → use the NumPy fallback."""
     try:
@@ -45,6 +57,7 @@ def sliding_windows(
       window's **last** step — the "predict current flow from the trailing
       window" task of the dynamic models.
     """
+    _validate(series, targets, length, stride)
     T = series.shape[0]
     if T < length:
         return (
@@ -72,6 +85,7 @@ def teacher_forcing_pairs(
     Returns (windows [N, length, F], y [N, length]) — a target for *every*
     step, so the LSTM is supervised along the whole sequence.
     """
+    _validate(series, targets, length, stride)
     T = series.shape[0]
     if T < length:
         return (

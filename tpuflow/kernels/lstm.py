@@ -37,13 +37,25 @@ def _interpret() -> bool:
 
 
 def _batch_block(B: int, T: int, H: int, itemsize: int) -> int:
-    """Largest batch tile keeping the kernel's VMEM footprint under ~8 MB."""
+    """Largest batch tile keeping the kernel's VMEM footprint under ~8 MB.
+
+    On real TPU, raises when even the smallest tile (8) blows the budget —
+    whole ``[T, Bb, 4H]`` blocks are VMEM-resident, so very long T simply
+    does not fit this kernel; the XLA-scan backend handles those shapes.
+    Interpret mode (non-TPU) has no VMEM, so the cap is advisory there.
+    """
     for bb in (512, 256, 128, 64, 32, 16, 8):
         # fwd: xw[T,bb,4H] + hs/cs[T,bb,H]*2 + scratch; bwd ~2x.
         footprint = T * bb * 4 * H * itemsize * 2 + 2 * T * bb * H * itemsize * 2
         if footprint <= 8 * 1024 * 1024:
             return min(bb, max(B, 8))
-    return 8
+    if _interpret():
+        return 8
+    raise ValueError(
+        f"lstm_scan: smallest batch tile (8) exceeds the ~8MB VMEM budget "
+        f"at T={T}, H={H}, itemsize={itemsize}; use the XLA scan backend "
+        f"(backend='xla') or shorter sequence chunks for these shapes"
+    )
 
 
 def _split_gates(z: jnp.ndarray, H: int):

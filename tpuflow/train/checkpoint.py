@@ -10,11 +10,12 @@ answer to Spark's task-retry fault-tolerance story (SURVEY.md §5.3).
 
 from __future__ import annotations
 
-import os
 from typing import Any
 
 import jax
 import orbax.checkpoint as ocp
+
+from tpuflow.utils.paths import join_path
 
 
 class BestCheckpointer:
@@ -23,9 +24,8 @@ class BestCheckpointer:
     def __init__(self, storage_path: str, name: str = "model"):
         # Same artifact layout as the reference: {storagePath}/models/{name}
         # (reference cnn.py:39,122 — MDL_NAME constant + path join).
-        self.directory = os.path.abspath(
-            os.path.join(storage_path, "models", name)
-        )
+        # URI-schemed storage (gs://...) passes through to Orbax intact.
+        self.directory = join_path(storage_path, "models", name)
         self._mngr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(

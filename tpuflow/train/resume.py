@@ -14,11 +14,12 @@ granularity).
 
 from __future__ import annotations
 
-import os
 from typing import Any
 
 import jax
 import orbax.checkpoint as ocp
+
+from tpuflow.utils.paths import join_path
 
 
 class RunCheckpointer:
@@ -30,9 +31,7 @@ class RunCheckpointer:
     """
 
     def __init__(self, storage_path: str, name: str = "model", keep: int = 2):
-        self.directory = os.path.abspath(
-            os.path.join(storage_path, "runs", name)
-        )
+        self.directory = join_path(storage_path, "runs", name)
         self._mngr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(

@@ -10,9 +10,10 @@ metric trail.
 from __future__ import annotations
 
 import json
-import os
 import time
 from typing import IO
+
+from tpuflow.utils.paths import open_file
 
 
 class MetricsLogger:
@@ -29,8 +30,9 @@ class MetricsLogger:
         self.echo = echo
         self._fh: IO | None = None
         if path:
-            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-            self._fh = open(path, "a", encoding="utf-8")
+            # URI-aware (gs://, memory://, ...) via fsspec; local paths get
+            # parent dirs created as before.
+            self._fh = open_file(path, "a", encoding="utf-8")
 
     def write(self, event: str, **fields) -> dict:
         rec = {"event": event, "time": time.time(), **fields}

@@ -1,0 +1,84 @@
+"""URI-aware storage paths: local POSIX and remote (gs://, s3://, ...).
+
+The reference's whole deployment story is writing the artifact to
+cluster-shared storage (``storagePath + "models/cnn.mdl"``, reference
+cnn.py:122; Hadoop cluster per Readme.md:3). The TPU-native equivalent is
+an object store: Orbax handles ``gs://`` natively *iff* the URI reaches it
+intact. These helpers keep URI-schemed paths opaque — never ``abspath``-ed
+(which would mangle ``gs://b/x`` into ``/cwd/gs:/b/x``) — while local
+paths keep their absolute-path normalization. Sidecar file IO goes through
+``fsspec`` for URIs, so any registered filesystem (gcs, s3, memory for
+tests) works unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+import posixpath
+import re
+from typing import IO
+
+_URI_RE = re.compile(r"^[a-zA-Z][a-zA-Z0-9+.-]*://")
+
+
+def is_uri(path: str) -> bool:
+    """True for scheme-prefixed paths (gs://, s3://, memory://, ...)."""
+    return bool(_URI_RE.match(path))
+
+
+def join_path(base: str, *parts: str) -> str:
+    """Join artifact-layout components under a storage root.
+
+    Remote URIs are joined with ``/`` and returned verbatim otherwise;
+    local paths are joined and normalized to absolute, as before.
+    """
+    if is_uri(base):
+        return posixpath.join(base.rstrip("/"), *parts)
+    return os.path.abspath(os.path.join(base, *parts))
+
+
+def open_file(path: str, mode: str = "r", **kwargs) -> IO:
+    """Open a local path or any fsspec-registered URI for reading/writing.
+
+    Parent directories are created on write for both kinds (object stores
+    that have no directories simply no-op).
+    """
+    if is_uri(path):
+        import fsspec
+
+        if "w" in mode or "a" in mode or "x" in mode:
+            fs, fs_path = fsspec.core.url_to_fs(path)
+            parent = posixpath.dirname(fs_path)
+            if parent:
+                try:
+                    fs.makedirs(parent, exist_ok=True)
+                except Exception:
+                    pass  # bucket-style stores have no directories
+            if "a" in mode:
+                # Object stores have no real append: bucket backends either
+                # refuse 'ab' or silently replace the object. Emulate append
+                # by rewriting prior content into a fresh 'w' stream.
+                prior = None
+                if fs.exists(fs_path):
+                    read_mode = "rb" if "b" in mode else "r"
+                    with fsspec.open(path, read_mode, **kwargs).open() as rf:
+                        prior = rf.read()
+                f = fsspec.open(path, mode.replace("a", "w"), **kwargs).open()
+                if prior:
+                    f.write(prior)
+                return f
+        return fsspec.open(path, mode, **kwargs).open()
+    if "w" in mode or "a" in mode or "x" in mode:
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+    return open(path, mode, **kwargs)
+
+
+def exists(path: str) -> bool:
+    if is_uri(path):
+        import fsspec
+
+        fs, fs_path = fsspec.core.url_to_fs(path)
+        return fs.exists(fs_path)
+    return os.path.exists(path)
