@@ -1,69 +1,203 @@
 """Benchmark: LSTM-64 teacher-forced training throughput (samples/sec/chip).
 
 The BASELINE.json north-star metric: train the dynamic LSTM flow model at
->=10k samples/sec/chip. This script times the full training step
-(fwd + bwd + SGD update) of the LSTM-64 config on the available chip and
-prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+>=10k samples/sec/chip. Times the full training step (fwd + bwd + SGD
+update) of the LSTM-64 config on BOTH recurrence backends — the XLA
+``lax.scan`` path and the fused Pallas kernel (``tpuflow/kernels/lstm.py``)
+— and prints ONE JSON line whose ``value`` is the best of the two:
 
-To keep Python dispatch off the measurement, BENCH_SCAN (default 16)
-training steps are compiled into one XLA program per dispatch
-(``lax.scan`` — the same mechanism as FitConfig.jit_epoch), so the number
-reflects the chip, not the host loop.
+    {"metric", "value", "unit", "vs_baseline", "backends", "pallas_parity",
+     "mfu", "bound", "device", "attempts"}
 
-vs_baseline is value / 10_000 (the driver-set target; the reference
-publishes no numbers of its own — BASELINE.md).
+This is the machine-readable descendant of the reference's elapsed-time /
+test-loss report (reference cnn.py:126-134), recorded instead of lost.
+
+Robustness (the TPU backend behind this harness is reached over a flaky
+tunnel — rounds 1-2 both lost their number to one-shot RPC failures):
+
+- the measurement runs in a FRESH SUBPROCESS per attempt, because a failed
+  remote-compile RPC can poison the in-process backend client;
+- the parent retries up to BENCH_ATTEMPTS (default 3) times with backoff;
+- on final failure it still prints one parseable JSON line carrying
+  ``{"error": ..., "attempts": N}`` instead of a raw traceback;
+- nothing dispatches eagerly before the warmed-up compiled step: all
+  host-side slicing/broadcasting happens in numpy.
+
+Also embedded in the worker run:
+
+- ``pallas_parity``: a compiled-mode (not interpret, when on TPU) parity
+  check of ``lstm_scan`` fwd+bwd and ``mae_clip_pallas`` vs their jnp
+  references at LSTM-64 shapes — the proof the Mosaic-compiled kernels
+  are correct on the real chip;
+- ``mfu`` / ``bound``: a FLOPs-per-step + bytes-per-step roofline model
+  so the samples/sec number comes with "X% of peak, bound by Y".
 
 Env knobs: BENCH_BATCH (default 4096), BENCH_SECONDS (default 10),
-BENCH_SCAN (steps per dispatch, default 16; 1 = per-step dispatch).
+BENCH_SCAN (train steps fused per dispatch, default 16), BENCH_ATTEMPTS
+(default 3), BENCH_TIMEOUT (per-attempt seconds, default 600).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
+BASELINE_SPS = 10_000.0  # driver-set north star (BASELINE.md)
+METRIC = "lstm64_train_samples_per_sec_per_chip"
+# The LSTM-64 config's shapes (BASELINE.json: 24-step windows, 5 well-log
+# features, hidden 64) — shared by the measurement, the parity check, and
+# the roofline model so they always describe the same workload.
+WINDOW, FEATURES, HIDDEN = 24, 5, 64
 
-def main() -> None:
-    import jax
-    import jax.numpy as jnp
+# Per-chip peak bf16 matmul FLOP/s and HBM GB/s, keyed by substrings of
+# jax.Device.device_kind (public spec-sheet numbers).
+_CHIP_PEAKS = {
+    "v6": (918e12, 1640e9),  # v6e / Trillium
+    "v5p": (459e12, 2765e9),
+    "v5": (197e12, 819e9),  # v5e reports as "TPU v5 lite"
+    "v4": (275e12, 1228e9),
+    "v3": (123e12, 900e9),
+    "v2": (45e12, 700e9),
+}
 
+
+def _chip_peaks(device_kind: str):
+    kind = device_kind.lower()
+    for key, peaks in _CHIP_PEAKS.items():
+        if key in kind:
+            return peaks
+    return None, None
+
+
+def lstm64_flops_per_sample_step(T: int, F: int, H: int) -> float:
+    """Model FLOPs for ONE sample through one train step (fwd+bwd+update).
+
+    Matmuls (2*m*n*k each, per timestep): input projection [F,4H],
+    recurrent [H,4H], head [H,1]. Gate elementwise math ~25 flops per gate
+    element (sigmoid/tanh ~10 each plus combines). Backward of a matmul
+    costs 2x its forward (dX and dW products); elementwise bwd ~= fwd.
+    """
+    matmul_fwd = 2.0 * T * (F * 4 * H + H * 4 * H + H)
+    gates_fwd = 25.0 * T * 4 * H
+    return 3.0 * matmul_fwd + 2.0 * gates_fwd
+
+
+def lstm64_bytes_per_sample_step(T: int, F: int, H: int, itemsize: int) -> float:
+    """Rough HBM bytes for one sample through one train step.
+
+    Activation traffic dominates (weights are small and VMEM-resident
+    across the scan): read x; write+read the hoisted projection xw [T,4H];
+    write hs/cs and re-read them in backward; write dxw. Counts each
+    logical tensor's HBM round trips; XLA fusion can only shrink this.
+    """
+    xw = 4 * H * T
+    hs_cs = 2 * H * T
+    return itemsize * (T * F + 3 * xw + 3 * hs_cs)
+
+
+# --------------------------------------------------------------------------
+# Worker: one attempt, fresh process. Prints one JSON line on success.
+# --------------------------------------------------------------------------
+
+
+def _parity_check(jax, jnp) -> str:
+    """Compiled-mode parity of the Pallas kernels vs their jnp references.
+
+    On TPU this exercises the real Mosaic-compiled kernels (interpret=False
+    paths in tpuflow/kernels); elsewhere it degrades to interpret mode and
+    says so.
+    """
+    from tpuflow.core.losses import mae_clip
+    from tpuflow.kernels import lstm_scan, mae_clip_pallas
+    from tpuflow.models.lstm import lstm_step
+
+    T, B, F, H = WINDOW, 128, FEATURES, HIDDEN
+    rng = np.random.default_rng(1)
+    xw = jnp.asarray(rng.standard_normal((T, B, 4 * H)) * 0.1, jnp.float32)
+    wh = jnp.asarray(rng.standard_normal((H, 4 * H)) * 0.1, jnp.float32)
+    b = jnp.asarray(rng.standard_normal((4 * H,)) * 0.1, jnp.float32)
+
+    def ref_scan(xw, wh, b):
+        h0 = jnp.zeros((xw.shape[1], wh.shape[0]), xw.dtype)
+        _, hs = jax.lax.scan(
+            lambda carry, xw_t: lstm_step(carry, xw_t, wh, b), (h0, h0), xw
+        )
+        return hs
+
+    def loss_pallas(args):
+        return jnp.sum(jnp.square(lstm_scan(*args)))
+
+    def loss_ref(args):
+        return jnp.sum(jnp.square(ref_scan(*args)))
+
+    f_pallas = jax.jit(jax.value_and_grad(loss_pallas))
+    f_ref = jax.jit(jax.value_and_grad(loss_ref))
+    (vp, gp), (vr, gr) = f_pallas((xw, wh, b)), f_ref((xw, wh, b))
+    jax.block_until_ready((vp, vr))
+
+    def rel_err(a, b):
+        a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+        return float(np.max(np.abs(a - b)) / (np.max(np.abs(b)) + 1e-12))
+
+    errs = {
+        "fwd": rel_err(vp, vr),
+        "dxw": rel_err(gp[0], gr[0]),
+        "dwh": rel_err(gp[1], gr[1]),
+        "db": rel_err(gp[2], gr[2]),
+    }
+    tol = 5e-4
+    # mae_clip_pallas: value + grad vs the golden-tested jnp loss.
+    yt = jnp.asarray(rng.standard_normal((B, T)) * 4, jnp.float32)
+    yp = jnp.asarray(rng.standard_normal((B, T)) * 4, jnp.float32)
+    lv, lg = jax.jit(jax.value_and_grad(lambda p: mae_clip_pallas(yt, p)))(yp)
+    rv, rg = jax.jit(jax.value_and_grad(lambda p: mae_clip(yt, p)))(yp)
+    errs["loss"] = rel_err(lv, rv)
+    errs["dloss"] = rel_err(lg, rg)
+
+    bad = {k: v for k, v in errs.items() if not (v < tol)}
+    mode = "compiled" if jax.default_backend() == "tpu" else "interpret"
+    if bad:
+        return f"FAIL ({mode}): " + ", ".join(f"{k}={v:.2e}" for k, v in bad.items())
+    worst = max(errs.values())
+    return f"ok ({mode}, max_rel_err={worst:.1e})"
+
+
+def _measure_backend(jax, jnp, backend: str, batch: int, seconds: float, scan: int):
+    """Throughput of the full LSTM-64 train step for one recurrence backend."""
     from tpuflow.core.losses import mae_clip
     from tpuflow.models import LSTMRegressor
     from tpuflow.train import create_state, make_train_step
     from tpuflow.train.steps import make_epoch_step
 
-    batch = int(os.environ.get("BENCH_BATCH", 4096))
-    seconds = float(os.environ.get("BENCH_SECONDS", 10))
-    scan = max(int(os.environ.get("BENCH_SCAN", 16)), 1)
-    window, features = 24, 5
-
-    model = LSTMRegressor(hidden=64, dtype=jnp.bfloat16)
+    window, features = WINDOW, FEATURES
+    model = LSTMRegressor(hidden=HIDDEN, dtype=jnp.bfloat16, backend=backend)
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.standard_normal((batch, window, features)), jnp.float32)
-    y = jnp.asarray(rng.standard_normal((batch, window)), jnp.float32)
+    x_np = rng.standard_normal((batch, window, features)).astype(np.float32)
+    y_np = rng.standard_normal((batch, window)).astype(np.float32)
 
-    state = create_state(model, jax.random.PRNGKey(0), x[:2])
+    # All slicing/broadcasting on the host; one transfer each.
+    state = create_state(model, jax.random.PRNGKey(0), x_np[:2])
     key = jax.random.PRNGKey(0)
-
     if scan > 1:
-        # K steps per dispatch; the same batch repeated is fine for a
-        # throughput measurement (identical FLOPs/bytes per step).
-        xs = jnp.broadcast_to(x, (scan,) + x.shape)
-        ys = jnp.broadcast_to(y, (scan,) + y.shape)
+        # K steps fused into one XLA program per dispatch; repeating the
+        # same batch is fine for throughput (identical FLOPs per step).
+        xs = jnp.asarray(np.broadcast_to(x_np, (scan,) + x_np.shape))
+        ys = jnp.asarray(np.broadcast_to(y_np, (scan,) + y_np.shape))
         epoch_step = make_epoch_step(mae_clip)
         step = lambda s: epoch_step(s, xs, ys, key)
     else:
+        x, y = jnp.asarray(x_np), jnp.asarray(y_np)
         one_step = make_train_step(mae_clip)
         step = lambda s: one_step(s, x, y, key)
 
-    # Warmup/compile.
-    state, m = step(state)
+    state, m = step(state)  # warmup/compile
     jax.block_until_ready(m)
 
-    # Timed run.
     t0 = time.perf_counter()
     steps = 0
     while time.perf_counter() - t0 < seconds:
@@ -71,19 +205,150 @@ def main() -> None:
         steps += 1
     jax.block_until_ready(m)
     elapsed = time.perf_counter() - t0
+    return batch * scan * steps / elapsed
 
-    samples_per_sec = batch * scan * steps / elapsed
+
+def worker() -> None:
+    # This environment force-registers the axon TPU platform ahead of the
+    # JAX_PLATFORMS env var; honor an explicit cpu request (local testing)
+    # by pinning the config before the backend initializes.
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    batch = int(os.environ.get("BENCH_BATCH", 4096))
+    seconds = float(os.environ.get("BENCH_SECONDS", 10))
+    scan = max(int(os.environ.get("BENCH_SCAN", 16)), 1)
+    window, features, hidden = WINDOW, FEATURES, HIDDEN
+
+    dev = jax.devices()[0]
+    device_kind = getattr(dev, "device_kind", str(dev))
+
+    try:
+        parity = _parity_check(jax, jnp)
+    except Exception as e:  # parity failure is reported, not fatal
+        parity = f"ERROR: {type(e).__name__}: {str(e)[:300]}"
+
+    backends: dict[str, float | str] = {}
+    for backend in ("xla", "pallas"):
+        try:
+            backends[backend] = round(
+                _measure_backend(jax, jnp, backend, batch, seconds, scan), 1
+            )
+        except Exception as e:
+            backends[backend] = f"ERROR: {type(e).__name__}: {str(e)[:300]}"
+
+    numeric = {k: v for k, v in backends.items() if isinstance(v, float)}
+    if not numeric:
+        raise RuntimeError(f"all backends failed: {backends}")
+    best_backend, best = max(numeric.items(), key=lambda kv: kv[1])
+
+    # Roofline: is the measured number good, and what bounds it?
+    flops = lstm64_flops_per_sample_step(window, features, hidden)
+    bytes_ = lstm64_bytes_per_sample_step(window, features, hidden, itemsize=2)
+    peak_flops, peak_bw = _chip_peaks(device_kind)
+    rec = {
+        "metric": METRIC,
+        "value": best,
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(best / BASELINE_SPS, 3),
+        "backends": backends,
+        "best_backend": best_backend,
+        "pallas_parity": parity,
+        "device": device_kind,
+        "flops_per_sample": round(flops),
+        "hbm_bytes_per_sample": round(bytes_),
+    }
+    if peak_flops:
+        ai = flops / bytes_  # arithmetic intensity of the step
+        ridge = peak_flops / peak_bw
+        rec["mfu"] = round(best * flops / peak_flops, 6)
+        rec["hbm_util"] = round(best * bytes_ / peak_bw, 6)
+        rec["bound"] = "hbm" if ai < ridge else "mxu"
+    else:
+        rec["mfu"] = None
+        rec["bound"] = f"unknown chip {device_kind!r}"
+    print(json.dumps(rec), flush=True)
+
+
+# --------------------------------------------------------------------------
+# Parent: subprocess isolation + retries; always prints one JSON line.
+# --------------------------------------------------------------------------
+
+
+def _emit_failure(attempts: int, last_err: str) -> None:
     print(
         json.dumps(
             {
-                "metric": "lstm64_train_samples_per_sec_per_chip",
-                "value": round(samples_per_sec, 1),
+                "metric": METRIC,
+                "value": 0.0,
                 "unit": "samples/sec/chip",
-                "vs_baseline": round(samples_per_sec / 10_000.0, 3),
+                "vs_baseline": 0.0,
+                "attempts": attempts,
+                "error": last_err[-800:],
             }
-        )
+        ),
+        flush=True,
     )
 
 
+def main() -> None:
+    attempts = max(int(os.environ.get("BENCH_ATTEMPTS", 3)), 1)
+    timeout = float(os.environ.get("BENCH_TIMEOUT", 600))
+    last_err = ""
+
+    # A dead TPU relay makes backend init HANG rather than fail fast; if
+    # the driver loses patience and SIGTERMs us, still emit the one
+    # parseable line before dying.
+    import signal
+
+    def _on_term(signum, frame):
+        _emit_failure(0, f"killed by signal {signum} while measuring")
+        sys.exit(1)
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+    for attempt in range(1, attempts + 1):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--worker"],
+                capture_output=True,
+                text=True,
+                timeout=timeout,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+        except subprocess.TimeoutExpired:
+            last_err = f"attempt {attempt}: timed out after {timeout}s"
+            proc = None
+        if proc is not None:
+            lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+            if proc.returncode == 0 and lines:
+                try:
+                    rec = json.loads(lines[-1])
+                except json.JSONDecodeError:
+                    last_err = (
+                        f"attempt {attempt}: unparseable output: {lines[-1][:300]}"
+                    )
+                else:
+                    rec["attempts"] = attempt
+                    print(json.dumps(rec), flush=True)
+                    return
+            else:
+                tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
+                last_err = f"attempt {attempt}: rc={proc.returncode}: " + " | ".join(
+                    tail
+                )[-600:]
+        if attempt < attempts:
+            time.sleep(min(5 * 2 ** (attempt - 1), 60))  # 5, 10, 20, 40...
+    # All attempts failed: still emit one machine-readable line.
+    _emit_failure(attempts, last_err)
+
+
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv:
+        worker()
+    else:
+        main()

@@ -45,7 +45,11 @@ def _batch_block(B: int, T: int, H: int, itemsize: int) -> int:
     Interpret mode (non-TPU) has no VMEM, so the cap is advisory there.
     """
     for bb in (512, 256, 128, 64, 32, 16, 8):
-        # fwd: xw[T,bb,4H] + hs/cs[T,bb,H]*2 + scratch; bwd ~2x.
+        # fwd blocks: xw[T,bb,4H] + hs/cs[T,bb,H]*2 = 6 H-units of T-sized
+        # blocks; the *2 factor covers the bwd kernel, whose enumerated
+        # residency (xw + dxw = 8H, hs/cs/dhs = 3H → 11 H-units) sits just
+        # under the 12 H-units this budget allows, so the bound is mildly
+        # conservative for bwd, never optimistic.
         footprint = T * bb * 4 * H * itemsize * 2 + 2 * T * bb * H * itemsize * 2
         if footprint <= 8 * 1024 * 1024:
             return min(bb, max(B, 8))
