@@ -209,13 +209,9 @@ def _measure_backend(jax, jnp, backend: str, batch: int, seconds: float, scan: i
 
 
 def worker() -> None:
-    # This environment force-registers the axon TPU platform ahead of the
-    # JAX_PLATFORMS env var; honor an explicit cpu request (local testing)
-    # by pinning the config before the backend initializes.
-    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-        import jax
+    from benchmarks.common import maybe_pin_cpu
 
-        jax.config.update("jax_platforms", "cpu")
+    maybe_pin_cpu()
     import jax
     import jax.numpy as jnp
 
@@ -295,52 +291,77 @@ def _emit_failure(attempts: int, last_err: str) -> None:
     )
 
 
+def _salvage_json(stdout: str | None) -> dict | None:
+    """The trailing JSON line of a worker's output, if it printed one.
+
+    Checked even after timeouts/crashes: a worker that completes the
+    measurement and prints its record, then hangs or dies in remote-backend
+    TEARDOWN, still produced a valid number.
+    """
+    for line in reversed((stdout or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                return None
+            return rec if rec.get("metric") == METRIC else None
+    return None
+
+
 def main() -> None:
     attempts = max(int(os.environ.get("BENCH_ATTEMPTS", 3)), 1)
     timeout = float(os.environ.get("BENCH_TIMEOUT", 600))
     last_err = ""
 
     # A dead TPU relay makes backend init HANG rather than fail fast; if
-    # the driver loses patience and SIGTERMs us, still emit the one
-    # parseable line before dying.
+    # the driver loses patience and SIGTERMs us, kill the in-flight worker
+    # and still emit the one parseable line before dying.
     import signal
 
+    current: list[subprocess.Popen | None] = [None]
+
     def _on_term(signum, frame):
+        if current[0] is not None and current[0].poll() is None:
+            current[0].kill()
         _emit_failure(0, f"killed by signal {signum} while measuring")
-        sys.exit(1)
+        # os._exit: skip Popen.__exit__'s wait() on the dying worker.
+        sys.stdout.flush()
+        os._exit(1)
 
     signal.signal(signal.SIGTERM, _on_term)
     signal.signal(signal.SIGINT, _on_term)
     for attempt in range(1, attempts + 1):
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        current[0] = proc
+        timed_out = False
         try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--worker"],
-                capture_output=True,
-                text=True,
-                timeout=timeout,
-                cwd=os.path.dirname(os.path.abspath(__file__)),
-            )
-        except subprocess.TimeoutExpired:
+            out, err = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired as e:
+            timed_out = True
+            proc.kill()
+            out, err = proc.communicate()
+            out = out or (e.stdout if isinstance(e.stdout, str) else "")
+        current[0] = None
+
+        rec = _salvage_json(out)
+        if rec is not None:
+            rec["attempts"] = attempt
+            print(json.dumps(rec), flush=True)
+            return
+        if timed_out:
             last_err = f"attempt {attempt}: timed out after {timeout}s"
-            proc = None
-        if proc is not None:
-            lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
-            if proc.returncode == 0 and lines:
-                try:
-                    rec = json.loads(lines[-1])
-                except json.JSONDecodeError:
-                    last_err = (
-                        f"attempt {attempt}: unparseable output: {lines[-1][:300]}"
-                    )
-                else:
-                    rec["attempts"] = attempt
-                    print(json.dumps(rec), flush=True)
-                    return
-            else:
-                tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
-                last_err = f"attempt {attempt}: rc={proc.returncode}: " + " | ".join(
-                    tail
-                )[-600:]
+        else:
+            tail = (err or out or "").strip().splitlines()[-8:]
+            last_err = f"attempt {attempt}: rc={proc.returncode}: " + " | ".join(
+                tail
+            )[-600:]
         if attempt < attempts:
             time.sleep(min(5 * 2 ** (attempt - 1), 60))  # 5, 10, 20, 40...
     # All attempts failed: still emit one machine-readable line.

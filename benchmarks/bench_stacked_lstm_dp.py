@@ -18,9 +18,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from benchmarks.common import emit, time_train_steps
+from benchmarks.common import emit, time_steps, time_train_steps
 from tpuflow.models import LSTMRegressor
-from tpuflow.parallel import make_dp_train_step, make_mesh, shard_batch
+from tpuflow.parallel import (
+    epoch_sharding,
+    make_dp_epoch_step,
+    make_dp_train_step,
+    make_mesh,
+    shard_batch,
+)
 from tpuflow.parallel.dp import replicate
 from tpuflow.train import create_state, make_train_step
 
@@ -61,6 +67,43 @@ def main() -> None:
         n_devices=n_dev,
         total_throughput=round(total, 1),
         scaling_efficiency=round(per_chip / single, 3),
+    )
+
+    # Scanned DP epoch: K steps per dispatch, all-reduce inside the scan —
+    # the dispatch-amortized path for small batches (reference batch 20).
+    scan = int(os.environ.get("BENCH_SCAN", 16))
+    small = int(os.environ.get("BENCH_SMALL_BATCH", 256))
+    Bs = small * n_dev
+    xs = np.broadcast_to(
+        rng.standard_normal((Bs, 24, 5)).astype(np.float32), (scan, Bs, 24, 5)
+    )
+    ys = np.broadcast_to(
+        rng.standard_normal((Bs, 24)).astype(np.float32), (scan, Bs, 24)
+    )
+    ep_shard = epoch_sharding(mesh)
+    xs_d = jax.device_put(np.ascontiguousarray(xs), ep_shard)
+    ys_d = jax.device_put(np.ascontiguousarray(ys), ep_shard)
+    state = replicate(mesh, create_state(model, jax.random.PRNGKey(0), x1[:2]))
+    epoch = make_dp_epoch_step(mesh)
+    key = jax.random.PRNGKey(0)
+
+    class _Box:  # thread donated state through time_steps
+        s = state
+
+    def step():
+        _Box.s, loss = epoch(_Box.s, xs_d, ys_d, key)
+        return loss
+
+    steps, elapsed = time_steps(step, seconds=seconds, block=lambda l: l)
+    total = Bs * scan * steps / elapsed
+    emit(
+        "stacked_lstm_dp",
+        "dp_scanned_epoch_throughput_per_chip",
+        total / n_dev,
+        "samples/sec/chip",
+        n_devices=n_dev,
+        steps_per_dispatch=scan,
+        per_chip_batch=small,
     )
 
 

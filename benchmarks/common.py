@@ -12,13 +12,21 @@ import json
 import os
 import time
 
-# This environment force-registers the axon TPU platform ahead of the
-# JAX_PLATFORMS env var; honor an explicit cpu request (e.g. the 8-virtual-
-# device CI mesh) by pinning the config before the backend initializes.
-if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-    import jax
+def maybe_pin_cpu() -> None:
+    """Honor an explicit JAX_PLATFORMS=cpu request.
 
-    jax.config.update("jax_platforms", "cpu")
+    This environment force-registers the axon TPU platform ahead of the
+    JAX_PLATFORMS env var, so the env var alone does not stick; pin the
+    config too, before the backend initializes. The canonical copy of this
+    workaround — import it rather than re-implementing.
+    """
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+maybe_pin_cpu()
 
 
 def emit(config: str, metric: str, value: float, unit: str, **extra) -> dict:

@@ -38,7 +38,9 @@ from tpuflow.api.config import TrainJobConfig
 from tpuflow.models import build_model
 from tpuflow.parallel import (
     data_sharding,
+    epoch_sharding,
     init_distributed,
+    make_dp_epoch_step,
     make_dp_eval_step,
     make_dp_train_step,
     make_mesh,
@@ -197,7 +199,7 @@ def train(config: TrainJobConfig) -> TrainReport:
 
     # --- parallelism: DP over the mesh when >1 device ---
     n_dev = config.n_devices or jax.device_count()
-    train_step = eval_step = None
+    train_step = eval_step = epoch_step = None
     if n_dev > 1:
         if config.batch_size % n_dev:
             raise ValueError(
@@ -216,6 +218,18 @@ def train(config: TrainJobConfig) -> TrainReport:
             xs, ys, ms = shard_batch(mesh, x, y, mask)
             return dp_eval(state, xs, ys, ms)
 
+        if config.jit_epoch:
+            # The scanned DP program: K train steps (each with its ICI
+            # all-reduce) per dispatch — same dispatch-amortization as
+            # single-chip jit_epoch.
+            dp_epoch = make_dp_epoch_step(mesh, loss_fn)
+            ep_shard = epoch_sharding(mesh)
+
+            def epoch_step(state, xs, ys, rng):  # noqa: F811
+                xs = jax.device_put(xs, ep_shard)
+                ys = jax.device_put(ys, ep_shard)
+                return dp_epoch(state, xs, ys, rng)
+
     # --- fit (the reference's hot loop, cnn.py:126-129) ---
     fit_cfg = FitConfig(
         max_epochs=config.max_epochs,
@@ -226,20 +240,12 @@ def train(config: TrainJobConfig) -> TrainReport:
         storage_path=config.storage_path,
         model_name=config.model,
         verbose=config.verbose,
-        jit_epoch=config.jit_epoch and n_dev == 1,
+        jit_epoch=config.jit_epoch,
         save_every=config.save_every,
         resume=config.resume,
         trace_dir=config.trace_dir,
         metrics_path=config.metrics_path,
     )
-    if config.jit_epoch and n_dev > 1:
-        import warnings
-
-        warnings.warn(
-            f"jit_epoch requested but {n_dev} devices are in use; falling "
-            "back to per-batch data-parallel stepping",
-            stacklevel=2,
-        )
     result = fit(
         state,
         train_ds,
@@ -250,6 +256,7 @@ def train(config: TrainJobConfig) -> TrainReport:
         # DP runs: land prefetched batches pre-sharded over the mesh so the
         # step's shard_batch is a no-op instead of a device0 re-transfer.
         batch_sharding=(data_sharding(mesh) if n_dev > 1 else None),
+        epoch_step=epoch_step,
     )
 
     # --- final evaluation (cnn.py:132-134, working) ---

@@ -18,6 +18,8 @@ from tpuflow.parallel.collectives import (  # noqa: F401
     reduce_scatter,
 )
 from tpuflow.parallel.dp import (  # noqa: F401
+    epoch_sharding,
+    make_dp_epoch_step,
     make_dp_eval_step,
     make_dp_train_step,
     shard_batch,

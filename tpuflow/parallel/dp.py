@@ -68,6 +68,66 @@ def make_dp_train_step(
     return jax.jit(sharded, donate_argnums=(0,))
 
 
+def make_dp_epoch_step(
+    mesh: Mesh, loss_fn: LossFn = mae_clip, axis: str = DATA_AXIS
+):
+    """Jitted SPMD WHOLE-EPOCH step: (state, xs, ys, rng) -> (state, loss).
+
+    The data-parallel counterpart of ``train.steps.make_epoch_step``:
+    ``xs [n_batches, B, ...]`` / ``ys`` are the epoch's pre-batched data
+    sharded on the batch dim (dim 1) over the data axis, and the batch loop
+    is a ``lax.scan`` *inside* the shard_map body, so K train steps — each
+    with its pmean gradient all-reduce on ICI — compile into ONE XLA
+    program per dispatch. This removes the per-batch Python dispatch that
+    otherwise bounds DP throughput at small batch sizes (the reference's
+    batch of 20, cnn.py:128).
+
+    Dropout rng folds (batch index, device index) like the single-chip
+    epoch scan + the per-batch DP step combined.
+    """
+
+    def body(state, xs, ys, rng):
+        dev = lax.axis_index(axis)
+
+        def batch_step(state, batch):
+            x, y, i = batch
+            local_rng = jax.random.fold_in(jax.random.fold_in(rng, i), dev)
+
+            def loss_of(params):
+                pred = state.apply_fn(
+                    {"params": params},
+                    x,
+                    deterministic=False,
+                    rngs={"dropout": local_rng},
+                )
+                return loss_fn(y, pred)
+
+            loss, grads = jax.value_and_grad(loss_of)(state.params)
+            grads = lax.pmean(grads, axis)
+            loss = lax.pmean(loss, axis)
+            state = state.apply_gradients(grads=grads)
+            return state, loss
+
+        idx = jnp.arange(xs.shape[0])
+        state, losses = lax.scan(batch_step, state, (xs, ys, idx))
+        return state, jnp.mean(losses)
+
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(None, axis), P(None, axis), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def epoch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Sharding for stacked epoch arrays ``[n_batches, B, ...]``: the batch
+    dim (dim 1) split over the data axis."""
+    return NamedSharding(mesh, P(None, axis))
+
+
 def make_dp_eval_step(
     mesh: Mesh, loss_fn: LossFn = mae_clip, axis: str = DATA_AXIS
 ):

@@ -47,9 +47,11 @@ class FitConfig:
     prefetch: int = 2  # buffered batches (0 = synchronous feed)
     # Compile the whole epoch into one XLA program (lax.scan over batches).
     # Removes per-step Python dispatch — the throughput path for small
-    # models at the reference's batch size of 20. Single-chip steps only;
-    # dropout streams differ from the per-batch path (per-batch-index vs
-    # per-step rng folding).
+    # models at the reference's batch size of 20. Defaults to the
+    # single-chip epoch program; inject fit(epoch_step=...) (e.g.
+    # parallel.make_dp_epoch_step) for data-parallel epochs. Dropout
+    # streams differ from the per-batch path (per-batch-index vs per-step
+    # rng folding).
     jit_epoch: bool = False
     # Structured metrics: append per-epoch JSONL records here (SURVEY §5.5).
     metrics_path: str | None = None
@@ -84,6 +86,7 @@ def fit(
     train_step=None,
     eval_step=None,
     batch_sharding=None,
+    epoch_step=None,
 ) -> FitResult:
     """Train with early stopping and optional save-best checkpointing.
 
@@ -92,12 +95,17 @@ def fit(
     jitted steps. ``batch_sharding`` (a ``NamedSharding``) makes the
     prefetcher land batches pre-sharded over the mesh instead of on the
     default device — pass ``data_sharding(mesh)`` alongside DP steps.
+    ``epoch_step`` (with ``config.jit_epoch``) injects a whole-epoch
+    scanned program — e.g. ``parallel.make_dp_epoch_step`` so DP runs get
+    the same K-steps-per-dispatch path as single-chip ``jit_epoch``.
     """
-    if config.jit_epoch and (train_step is not None or batch_sharding is not None):
+    if config.jit_epoch and epoch_step is None and (
+        train_step is not None or batch_sharding is not None
+    ):
         raise ValueError(
-            "jit_epoch compiles its own single-chip epoch program and would "
-            "silently ignore the injected train_step/batch_sharding; use "
-            "per-batch stepping for data-parallel runs"
+            "jit_epoch's default epoch program is single-chip and would "
+            "silently ignore the injected train_step/batch_sharding; inject "
+            "epoch_step (parallel.make_dp_epoch_step) for data-parallel runs"
         )
     if (config.resume or config.save_every) and not config.storage_path:
         raise ValueError(
@@ -134,11 +142,13 @@ def fit(
     samples_seen = 0
     t0 = time.time()
 
-    epoch_step = None
     if config.jit_epoch:
-        from tpuflow.train.steps import make_epoch_step
+        if epoch_step is None:
+            from tpuflow.train.steps import make_epoch_step
 
-        epoch_step = make_epoch_step(config.loss)
+            epoch_step = make_epoch_step(config.loss)
+    else:
+        epoch_step = None
 
     mlog = None
     if config.metrics_path:
