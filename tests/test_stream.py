@@ -118,3 +118,113 @@ class TestStreamBatches:
 
         with pytest.raises(RuntimeError, match="fitted"):
             next(stream_batches(path, FeaturePipeline(SCHEMA), 64))
+
+
+class TestHashSplit:
+    def test_deterministic_and_chunk_invariant(self):
+        from tpuflow.data.stream import split_assignments
+
+        whole = split_assignments(0, 10_000, seed=3)
+        parts = np.concatenate(
+            [split_assignments(s, 100, seed=3) for s in range(0, 10_000, 100)]
+        )
+        np.testing.assert_array_equal(whole, parts)
+
+    def test_fractions_approximately_64_16_20(self):
+        from tpuflow.data.stream import split_assignments
+
+        a = split_assignments(0, 100_000, seed=0)
+        fracs = [np.mean(a == i) for i in range(3)]
+        assert abs(fracs[0] - 0.64) < 0.01
+        assert abs(fracs[1] - 0.16) < 0.01
+        assert abs(fracs[2] - 0.20) < 0.01
+
+    def test_splits_partition_the_stream(self, big_csv):
+        from tpuflow.data.stream import stream_split_columns
+
+        path, table = big_csv
+        rows = {
+            w: np.concatenate(
+                [
+                    c["flow"]
+                    for c in stream_split_columns(path, SCHEMA, w, seed=1, chunk_rows=97)
+                ]
+            )
+            for w in ("train", "val", "test")
+        }
+        total = sum(len(v) for v in rows.values())
+        assert total == 1024
+        merged = np.sort(np.concatenate(list(rows.values())))
+        np.testing.assert_allclose(merged, np.sort(table["flow"]), rtol=1e-5)
+
+    def test_materialize_split_caps_rows(self, big_csv):
+        from tpuflow.data.stream import materialize_split
+
+        path, _ = big_csv
+        pipe = fit_pipeline_on_sample(path, SCHEMA)
+        x, y, raw = materialize_split(path, pipe, "train", seed=1, max_rows=100)
+        assert len(x) == len(y) == 100
+        assert len(raw["flow"]) == 100
+
+
+class TestStreamingTrain:
+    def test_train_stream_end_to_end(self, big_csv):
+        """train(stream=True) over a CSV spanning many chunks: out-of-core
+        training reachable from the public entry point (VERDICT r2 #6)."""
+        from tpuflow.api import TrainJobConfig, train
+
+        path, _ = big_csv
+        report = train(
+            TrainJobConfig(
+                column_names=NAMES,
+                column_types=TYPES,
+                target="flow",
+                data_path=path,
+                model="static_mlp",
+                max_epochs=3,
+                batch_size=32,
+                verbose=False,
+                n_devices=1,
+                stream=True,
+                stream_chunk_rows=150,  # many chunks over 1024 rows
+                stream_shuffle_buffer=64,
+                stream_sample_rows=400,
+                stream_eval_rows=500,
+            )
+        )
+        assert np.isfinite(report.test_loss)
+        assert report.result.epochs_ran == 3
+        assert report.gilbert_mae is not None  # physical baseline computed
+
+    def test_stream_requires_data_path_and_tabular(self):
+        from tpuflow.api import TrainJobConfig, train
+
+        with pytest.raises(ValueError, match="needs data_path"):
+            train(TrainJobConfig(model="static_mlp", stream=True, verbose=False))
+        with pytest.raises(ValueError, match="tabular"):
+            train(
+                TrainJobConfig(
+                    model="lstm", stream=True, data_path="x.csv", verbose=False
+                )
+            )
+
+    def test_stream_jit_epoch_rejected(self, big_csv):
+        from tpuflow.api import TrainJobConfig, train
+
+        path, _ = big_csv
+        with pytest.raises(ValueError, match="bounded-memory stream"):
+            train(
+                TrainJobConfig(
+                    column_names=NAMES,
+                    column_types=TYPES,
+                    target="flow",
+                    data_path=path,
+                    model="static_mlp",
+                    max_epochs=1,
+                    batch_size=32,
+                    verbose=False,
+                    n_devices=1,
+                    stream=True,
+                    jit_epoch=True,
+                )
+            )

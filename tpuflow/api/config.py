@@ -25,6 +25,16 @@ class TrainJobConfig:
     well_column: str | None = None  # groups CSV rows into per-well logs
     synthetic_wells: int = 8
     synthetic_steps: int = 512
+    # Out-of-core ingest (tabular models): never materialize the CSV —
+    # fit the pipeline on a head sample of train-assigned rows, re-stream
+    # train batches each epoch through a windowed shuffle, and evaluate on
+    # bounded val/test samples. Memory stays O(chunk + buffers) regardless
+    # of file size (the reference's cluster-resident-data story, Readme.md:3).
+    stream: bool = False
+    stream_chunk_rows: int = 65536  # CSV rows parsed per chunk
+    stream_shuffle_buffer: int = 8192  # windowed-shuffle rows (0 = in order)
+    stream_sample_rows: int = 100_000  # pipeline-fit head sample size
+    stream_eval_rows: int = 100_000  # val/test materialization cap
 
     # --- model ---
     model: str = "lstm"  # key into tpuflow.models.MODELS

@@ -95,6 +95,11 @@ def train(config: TrainJobConfig) -> TrainReport:
 
     # --- ingest + features (L1/L2) ---
     gilbert_test = None
+    if config.stream and config.is_sequence_model:
+        raise ValueError(
+            "stream=True supports the tabular family; sequence models "
+            "window per-well and need materialized logs"
+        )
     if config.is_sequence_model:
         if config.data_path is not None:
             columns = read_csv(config.data_path, schema)
@@ -137,6 +142,71 @@ def train(config: TrainJobConfig) -> TrainReport:
                         - np.asarray(
                             gilbert_flow(
                                 raw_last[:, ip], raw_last[:, ic], raw_last[:, ig]
+                            )
+                        )
+                    )
+                )
+            )
+    elif config.stream:
+        # Out-of-core tabular ingest: the CSV is never materialized.
+        if config.data_path is None:
+            raise ValueError("stream=True needs data_path (nothing to stream)")
+        if config.model == "gilbert_residual":
+            raise ValueError(
+                "stream=True does not support gilbert_residual (the Gilbert "
+                "feature channel is appended by the in-memory pipeline); "
+                "use the materialized path"
+            )
+        from tpuflow.data.pipeline import ArrayDataset
+        from tpuflow.data.stream import (
+            fit_pipeline_on_sample,
+            materialize_splits,
+            stream_batches,
+        )
+        from tpuflow.train import StreamingSource
+
+        pipeline = fit_pipeline_on_sample(
+            config.data_path,
+            schema,
+            sample_rows=config.stream_sample_rows,
+            split="train",
+            split_seed=config.seed,
+        )
+        evals = materialize_splits(
+            config.data_path, pipeline, ("val", "test"), config.seed,
+            max_rows=config.stream_eval_rows,
+            chunk_rows=config.stream_chunk_rows,
+        )
+        vx, vy, _ = evals["val"]
+        tex, tey, raw_test = evals["test"]
+        val_ds, test_ds = ArrayDataset(vx, vy), ArrayDataset(tex, tey)
+        train_ds = StreamingSource(
+            lambda epoch: stream_batches(
+                config.data_path,
+                pipeline,
+                config.batch_size,
+                chunk_rows=config.stream_chunk_rows,
+                shuffle_buffer=config.stream_shuffle_buffer,
+                seed=config.seed + epoch,
+                split="train",
+                split_seed=config.seed,
+            )
+        )
+
+        from types import SimpleNamespace
+
+        splits = SimpleNamespace(pipeline=pipeline)  # sidecar reads .pipeline
+        target_std = pipeline.target_std_
+        if {"pressure", "choke", "glr", target} <= set(raw_test):
+            gilbert_test = float(
+                np.mean(
+                    np.abs(
+                        raw_test[target]
+                        - np.asarray(
+                            gilbert_flow(
+                                raw_test["pressure"],
+                                raw_test["choke"],
+                                raw_test["glr"],
                             )
                         )
                     )
@@ -193,9 +263,9 @@ def train(config: TrainJobConfig) -> TrainReport:
         model_kwargs["target_std"] = splits.pipeline.target_std_
     model = build_model(config.model, **model_kwargs)
     tx = build_optimizer(config.optimizer, **config.optimizer_kwargs)
-    state = create_state(
-        model, jax.random.PRNGKey(config.seed), train_ds.x[:2], tx
-    )
+    # Streaming sources have no .x; the val sample provides the init shape.
+    sample_x = val_ds.x[:2] if config.stream else train_ds.x[:2]
+    state = create_state(model, jax.random.PRNGKey(config.seed), sample_x, tx)
 
     # --- parallelism: DP over the mesh when >1 device ---
     n_dev = config.n_devices or jax.device_count()
@@ -298,7 +368,7 @@ def train(config: TrainJobConfig) -> TrainReport:
             model_kwargs,  # resolved kwargs (incl. injected target stats)
             kind,
             pre,
-            tuple(train_ds.x.shape),
+            tuple(val_ds.x.shape if config.stream else train_ds.x.shape),
         )
 
     report = TrainReport(

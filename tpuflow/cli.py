@@ -41,6 +41,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--synthetic-steps", type=int, default=512)
     p.add_argument("--jit-epoch", action="store_true",
                    help="compile each epoch into one XLA program (single-chip)")
+    p.add_argument("--stream", action="store_true",
+                   help="out-of-core ingest: never materialize the CSV "
+                        "(tabular models; bounded memory at any file size)")
+    p.add_argument("--stream-chunk-rows", type=int, default=65536)
+    p.add_argument("--stream-shuffle-buffer", type=int, default=8192)
     p.add_argument("--save-every", type=int, default=0,
                    help="epochs between full-state run checkpoints (needs storagePath)")
     p.add_argument("--resume", action="store_true",
@@ -84,6 +89,9 @@ def main(argv=None) -> int:
         synthetic_steps=args.synthetic_steps,
         verbose=not args.quiet,
         jit_epoch=args.jit_epoch,
+        stream=args.stream,
+        stream_chunk_rows=args.stream_chunk_rows,
+        stream_shuffle_buffer=args.stream_shuffle_buffer,
         save_every=args.save_every,
         resume=args.resume,
         trace_dir=args.trace_dir,

@@ -39,6 +39,122 @@ def stream_csv_columns(
         yield parse_rows(rows, schema, source=path)
 
 
+SPLIT_FRACTIONS = (0.64, 0.16, 0.20)  # train/val/test — reference cnn.py:68
+_SPLITS = ("train", "val", "test")
+_HASH_MULT = np.uint64(0x9E3779B97F4A7C15)  # Fibonacci hashing constant
+
+
+def split_assignments(
+    start: int, n: int, seed: int, fractions=SPLIT_FRACTIONS
+) -> np.ndarray:
+    """Deterministic per-row split ids (0=train, 1=val, 2=test) for global
+    rows [start, start+n).
+
+    The streaming analog of the seeded 64/16/20 permutation split
+    (``tpuflow.data.splits``): each row's assignment is a pure hash of
+    (global row index, seed), so it is identical on every pass over the
+    file and independent of chunking — a row never migrates between splits
+    across epochs or between the train stream and the eval materializer.
+    """
+    idx = np.arange(start, start + n, dtype=np.uint64)
+    # Mix the seed in Python ints (explicit 64-bit wrap): numpy SCALAR
+    # uint64 ops reject negative seeds and warn on overflow, while the
+    # array ops below wrap silently as intended.
+    seed_mix = np.uint64((seed * 0x517CC1B727220A95) % (1 << 64))
+    h = (idx + seed_mix) * _HASH_MULT
+    h ^= h >> np.uint64(31)
+    h *= _HASH_MULT
+    h ^= h >> np.uint64(29)
+    u = (h >> np.uint64(11)).astype(np.float64) / float(1 << 53)
+    bounds = np.cumsum(fractions)
+    return np.digitize(u, bounds[:-1]).astype(np.int8)
+
+
+def stream_split_columns(
+    path: str,
+    schema: Schema,
+    which: str,
+    seed: int,
+    chunk_rows: int = 65536,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Stream one split's rows as column-dict chunks (possibly ragged).
+
+    Filters each chunk to the rows ``split_assignments`` maps to ``which``
+    — bounded memory, deterministic across passes.
+    """
+    want = _SPLITS.index(which)
+    start = 0
+    for columns in stream_csv_columns(path, schema, chunk_rows):
+        n = len(next(iter(columns.values())))
+        keep = split_assignments(start, n, seed) == want
+        start += n
+        if keep.any():
+            yield {k: v[keep] for k, v in columns.items()}
+
+
+def materialize_splits(
+    path: str,
+    pipeline: FeaturePipeline,
+    whichs: tuple[str, ...],
+    seed: int,
+    max_rows: int = 100_000,
+    chunk_rows: int = 65536,
+) -> dict[str, tuple[np.ndarray, np.ndarray, dict[str, np.ndarray]]]:
+    """Materialize up to ``max_rows`` of each requested split in ONE pass:
+    ``{which: (x, y, raw_columns)}``.
+
+    Bounded-memory eval samples for streaming training: val/test metrics
+    come from these capped samples instead of the full (possibly
+    unbounded) splits. One file scan serves all requested splits — the
+    chunk's hash assignments are computed once and routed. Stops early
+    once every split hit its cap. Raw columns ride along for the
+    physical-baseline (Gilbert) MAE.
+    """
+    ids = {w: _SPLITS.index(w) for w in whichs}
+    acc = {w: {"xs": [], "ys": [], "raws": [], "got": 0} for w in whichs}
+    start = 0
+    for columns in stream_csv_columns(path, pipeline.schema, chunk_rows):
+        n = len(next(iter(columns.values())))
+        assigned = split_assignments(start, n, seed)
+        start += n
+        for w, a in acc.items():
+            if a["got"] >= max_rows:
+                continue
+            keep = assigned == ids[w]
+            if not keep.any():
+                continue
+            part = {k: v[keep] for k, v in columns.items()}
+            take = min(int(keep.sum()), max_rows - a["got"])
+            part = {k: v[:take] for k, v in part.items()}
+            a["xs"].append(pipeline.transform(part))
+            a["ys"].append(pipeline.transform_target(part))
+            a["raws"].append(part)
+            a["got"] += take
+        if all(a["got"] >= max_rows for a in acc.values()):
+            break
+    out = {}
+    for w, a in acc.items():
+        if not a["xs"]:
+            raise ValueError(f"{path}: split {w!r} has no rows")
+        raw = {k: np.concatenate([r[k] for r in a["raws"]]) for k in a["raws"][0]}
+        out[w] = (np.concatenate(a["xs"]), np.concatenate(a["ys"]), raw)
+    return out
+
+
+def materialize_split(
+    path: str,
+    pipeline: FeaturePipeline,
+    which: str,
+    seed: int,
+    max_rows: int = 100_000,
+    chunk_rows: int = 65536,
+) -> tuple[np.ndarray, np.ndarray, dict[str, np.ndarray]]:
+    """One-split convenience wrapper around ``materialize_splits``."""
+    return materialize_splits(
+        path, pipeline, (which,), seed, max_rows, chunk_rows
+    )[which]
+
+
 def stream_batches(
     path: str,
     pipeline: FeaturePipeline,
@@ -47,6 +163,8 @@ def stream_batches(
     drop_remainder: bool = True,
     shuffle_buffer: int = 0,
     seed: int = 0,
+    split: str | None = None,
+    split_seed: int = 0,
 ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
     """Stream fixed-size (x, y) training batches from a large CSV.
 
@@ -59,13 +177,23 @@ def stream_batches(
     materializing it: rows pass through a ``shuffle_buffer``-row windowed
     shuffle (the bounded-memory analog of a full-epoch permutation; memory
     stays O(shuffle_buffer) regardless of file size).
+
+    ``split`` ("train"/"val"/"test") restricts the stream to one side of
+    the deterministic hash split keyed by ``split_seed`` (see
+    ``split_assignments``) — the out-of-core 64/16/20 contract.
     """
     if not pipeline.fitted:
         raise RuntimeError("stream_batches requires a fitted pipeline")
     rng = np.random.default_rng(seed) if shuffle_buffer else None
+    if split is None:
+        source = stream_csv_columns(path, pipeline.schema, chunk_rows)
+    else:
+        source = stream_split_columns(
+            path, pipeline.schema, split, split_seed, chunk_rows
+        )
     x_rem: np.ndarray | None = None
     y_rem: np.ndarray | None = None
-    for columns in stream_csv_columns(path, pipeline.schema, chunk_rows):
+    for columns in source:
         x = pipeline.transform(columns)
         y = pipeline.transform_target(columns)
         if x_rem is not None:
@@ -97,13 +225,41 @@ def stream_batches(
 
 
 def fit_pipeline_on_sample(
-    path: str, schema: Schema, sample_rows: int = 100_000
+    path: str,
+    schema: Schema,
+    sample_rows: int = 100_000,
+    split: str | None = None,
+    split_seed: int = 0,
 ) -> FeaturePipeline:
     """Fit the feature pipeline on the stream's head.
 
     The streaming analog of fit-on-train: stats and vocabularies come from
-    a bounded sample instead of a full materialized split.
+    a bounded sample instead of a full materialized split. With
+    ``split="train"`` the sample is further restricted to train-assigned
+    rows, preserving the fit-once-on-train discipline (SURVEY.md C6) even
+    out of core.
     """
-    for columns in stream_csv_columns(path, schema, chunk_rows=sample_rows):
-        return FeaturePipeline(schema).fit(columns)
-    raise ValueError(f"{path}: empty CSV")
+    if split is None:
+        source = stream_csv_columns(path, schema, chunk_rows=sample_rows)
+    else:
+        source = stream_split_columns(
+            path, schema, split, split_seed, chunk_rows=sample_rows
+        )
+    # Accumulate until the sample is full — with a split filter each raw
+    # chunk only contributes that split's share (~64% for train), so one
+    # chunk would silently under-fill the requested sample.
+    parts: list[dict[str, np.ndarray]] = []
+    got = 0
+    for columns in source:
+        parts.append(columns)
+        got += len(next(iter(columns.values())))
+        if got >= sample_rows:
+            break
+    if not parts:
+        raise ValueError(
+            f"{path}: empty CSV" + (f" (split {split!r})" if split else "")
+        )
+    merged = {
+        k: np.concatenate([p[k] for p in parts])[:sample_rows] for k in parts[0]
+    }
+    return FeaturePipeline(schema).fit(merged)

@@ -13,4 +13,10 @@ from tpuflow.train.state import create_state  # noqa: F401
 from tpuflow.train.steps import make_train_step, make_eval_step  # noqa: F401
 from tpuflow.train.callbacks import EarlyStopping  # noqa: F401
 from tpuflow.train.checkpoint import BestCheckpointer  # noqa: F401
-from tpuflow.train.loop import FitConfig, FitResult, fit, evaluate  # noqa: F401
+from tpuflow.train.loop import (  # noqa: F401
+    FitConfig,
+    FitResult,
+    StreamingSource,
+    evaluate,
+    fit,
+)

@@ -24,6 +24,25 @@ from tpuflow.train.checkpoint import BestCheckpointer
 from tpuflow.train.steps import make_eval_step, make_train_step
 
 
+class StreamingSource:
+    """Out-of-core train source for ``fit``: a factory of per-epoch batch
+    iterators instead of in-memory arrays.
+
+    ``factory(epoch)`` must yield ``(x, y)`` numpy batches of a fixed
+    batch size (drop_remainder — one XLA shape for the run); each epoch
+    gets a fresh pass so windowed-shuffle order differs per epoch. Memory
+    stays bounded by the stream's chunk/shuffle buffers no matter the file
+    size (the reference's cluster-resident-data story, Readme.md:3, done
+    host-side).
+    """
+
+    def __init__(self, factory: Callable):
+        self.factory = factory
+
+    def epoch_batches(self, epoch: int):
+        return self.factory(epoch)
+
+
 @dataclass
 class FitConfig:
     # Reference defaults: cnn.py:121 (patience), cnn.py:128 (epochs, batch).
@@ -107,6 +126,12 @@ def fit(
             "silently ignore the injected train_step/batch_sharding; inject "
             "epoch_step (parallel.make_dp_epoch_step) for data-parallel runs"
         )
+    if config.jit_epoch and isinstance(train_ds, StreamingSource):
+        raise ValueError(
+            "jit_epoch stacks the whole epoch into device arrays and would "
+            "defeat the bounded-memory stream; use per-batch stepping for "
+            "streaming runs"
+        )
     if (config.resume or config.save_every) and not config.storage_path:
         raise ValueError(
             "resume/save_every need storage_path — without it no run "
@@ -175,9 +200,12 @@ def fit(
             last_device_value = epoch_loss
         else:
             train_losses = []
-            epoch_batches = batches(
-                train_ds, config.batch_size, seed=config.seed + epoch
-            )
+            if isinstance(train_ds, StreamingSource):
+                epoch_batches = train_ds.epoch_batches(epoch)
+            else:
+                epoch_batches = batches(
+                    train_ds, config.batch_size, seed=config.seed + epoch
+                )
             if config.prefetch:
                 from tpuflow.data.prefetch import device_prefetch
 
