@@ -1,0 +1,210 @@
+"""Job-runner service (L6/C20): spec translation + HTTP end-to-end.
+
+The reference's web component submits training jobs with per-job schemas
+and reads back the artifact/loss (reference Readme.md:4); these tests
+prove that flow works here without the caller importing Python.
+"""
+
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from tpuflow.serve import make_server, report_to_dict, spec_to_config
+
+
+class TestSpecTranslation:
+    def test_camel_case_reference_contract(self):
+        cfg = spec_to_config(
+            {
+                "columnNames": "a,b",
+                "columnTypes": "float,float",
+                "targetColumn": "b",
+                "storagePath": "/tmp/x",
+                "data": "/tmp/d.csv",
+                "epochs": 5,
+                "batchSize": 16,
+            }
+        )
+        assert cfg.column_names == "a,b"
+        assert cfg.target == "b"
+        assert cfg.storage_path == "/tmp/x"
+        assert cfg.data_path == "/tmp/d.csv"
+        assert cfg.max_epochs == 5
+        assert cfg.batch_size == 16
+        assert cfg.verbose is False  # service default
+
+    def test_snake_case_passthrough(self):
+        cfg = spec_to_config({"model": "static_mlp", "n_devices": 1})
+        assert cfg.model == "static_mlp"
+        assert cfg.n_devices == 1
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(ValueError, match="unknown job-spec field"):
+            spec_to_config({"epohcs": 5})
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture
+def server():
+    import threading
+
+    srv = make_server("127.0.0.1", 0)  # ephemeral port
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+class TestHTTPServer:
+    def test_health(self, server):
+        status, body = _get(server + "/health")
+        assert status == 200 and body == {"status": "ok"}
+
+    def test_unknown_routes_404(self, server):
+        with pytest.raises(urllib.error.HTTPError):
+            _get(server + "/nope")
+        status, body = _post(server + "/nope", {})
+        assert status == 404
+
+    def test_bad_spec_400(self, server):
+        status, body = _post(server + "/jobs", {"epohcs": 3})
+        assert status == 400
+        assert "unknown job-spec field" in body["error"]
+
+    def test_missing_job_404(self, server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(server + "/jobs/deadbeef")
+        assert e.value.code == 404
+
+    def test_train_job_end_to_end(self, server, tmp_path):
+        """Submit → poll → done; report JSON lands next to the artifact."""
+        status, body = _post(
+            server + "/jobs",
+            {
+                "model": "static_mlp",
+                "epochs": 2,
+                "batchSize": 32,
+                "storagePath": str(tmp_path),
+                "n_devices": 1,
+                "synthetic_wells": 4,
+                "synthetic_steps": 64,
+            },
+        )
+        assert status == 202
+        job_id = body["job_id"]
+
+        deadline = time.time() + 120
+        rec = None
+        while time.time() < deadline:
+            _, rec = _get(server + f"/jobs/{job_id}")
+            if rec["status"] in ("done", "failed"):
+                break
+            time.sleep(0.5)
+        assert rec is not None and rec["status"] == "done", rec
+        assert rec["report"]["epochs_ran"] == 2
+        report_path = tmp_path / "models" / "static_mlp.report.json"
+        assert report_path.exists()
+        on_disk = json.loads(report_path.read_text())
+        assert on_disk["test_loss"] == rec["report"]["test_loss"]
+
+        _, jobs = _get(server + "/jobs")
+        assert any(j["job_id"] == job_id and j["status"] == "done" for j in jobs)
+
+    def test_failed_job_reports_error(self, server):
+        status, body = _post(
+            server + "/jobs",
+            {"model": "static_mlp", "stream": True},  # stream needs data_path
+        )
+        assert status == 202
+        deadline = time.time() + 60
+        rec = None
+        while time.time() < deadline:
+            _, rec = _get(server + f"/jobs/{body['job_id']}")
+            if rec["status"] in ("done", "failed"):
+                break
+            time.sleep(0.2)
+        assert rec["status"] == "failed"
+        assert "data_path" in rec["error"]
+
+
+class TestSubprocessDaemon:
+    def test_daemon_serves_a_job(self, tmp_path):
+        """The real deployment shape: `python -m tpuflow.serve` in its own
+        process; a client submits a job over HTTP and reads the report."""
+        import os
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpuflow.serve", "--port", str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+        )
+        base = f"http://127.0.0.1:{port}"
+        try:
+            deadline = time.time() + 60
+            up = False
+            while time.time() < deadline:
+                try:
+                    if _get(base + "/health")[0] == 200:
+                        up = True
+                        break
+                except OSError:
+                    time.sleep(0.3)
+            assert up, "daemon never came up"
+
+            _, body = _post(
+                base + "/jobs",
+                {
+                    "model": "static_mlp",
+                    "epochs": 1,
+                    "batchSize": 32,
+                    "storagePath": str(tmp_path),
+                    "n_devices": 1,
+                    "synthetic_wells": 4,
+                    "synthetic_steps": 64,
+                },
+            )
+            deadline = time.time() + 180
+            rec = None
+            while time.time() < deadline:
+                _, rec = _get(base + f"/jobs/{body['job_id']}")
+                if rec["status"] in ("done", "failed"):
+                    break
+                time.sleep(0.5)
+            assert rec is not None and rec["status"] == "done", rec
+            assert (tmp_path / "models" / "static_mlp.report.json").exists()
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()

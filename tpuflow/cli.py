@@ -46,6 +46,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(tabular models; bounded memory at any file size)")
     p.add_argument("--stream-chunk-rows", type=int, default=65536)
     p.add_argument("--stream-shuffle-buffer", type=int, default=8192)
+    p.add_argument("--stream-sample-rows", type=int, default=100_000,
+                   help="rows of the head sample the feature pipeline fits on")
+    p.add_argument("--stream-eval-rows", type=int, default=100_000,
+                   help="val/test materialization cap (rows per split)")
     p.add_argument("--save-every", type=int, default=0,
                    help="epochs between full-state run checkpoints (needs storagePath)")
     p.add_argument("--resume", action="store_true",
@@ -92,6 +96,8 @@ def main(argv=None) -> int:
         stream=args.stream,
         stream_chunk_rows=args.stream_chunk_rows,
         stream_shuffle_buffer=args.stream_shuffle_buffer,
+        stream_sample_rows=args.stream_sample_rows,
+        stream_eval_rows=args.stream_eval_rows,
         save_every=args.save_every,
         resume=args.resume,
         trace_dir=args.trace_dir,

@@ -1,0 +1,246 @@
+"""Job-runner service: the reference's web-trigger layer (L6/C20), real.
+
+The reference system is "triggered by the web component of a full
+information system" which submits model-training jobs with per-job feature
+schemas to the cluster (reference Readme.md:4; the spark-submit contract,
+reference cnn.py:2). This module is the TPU-native replacement for that
+submission seam: a dependency-free HTTP daemon that accepts a JSON job
+spec, runs ``train(config)`` on the accelerator, and writes the final
+report next to the model artifact where the web component reads it
+(SURVEY.md §3.2's implied flow).
+
+API (JSON in/out):
+
+- ``POST /jobs``        — submit a job spec; returns ``{"job_id", "status"}``.
+- ``GET  /jobs``        — list all jobs (summaries).
+- ``GET  /jobs/<id>``   — one job: status, spec, report or error.
+- ``GET  /health``      — liveness probe.
+
+The spec accepts the reference's camelCase submission fields
+(``columnNames``, ``columnTypes``, ``targetColumn``, ``storagePath``,
+``data``, ``epochs``, ``batchSize``) as well as any snake_case
+``TrainJobConfig`` field. Jobs run ONE at a time on a background worker —
+the chip is a serial resource; queued jobs wait their turn.
+
+On success the report is written to ``{storagePath}/models/{model}
+.report.json`` (URI-aware — gs:// works), completing the loop where the
+reference's web layer "reads artifact / reported loss".
+
+Run: ``python -m tpuflow.serve --port 8700``; stop with SIGINT/SIGTERM.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import uuid
+from dataclasses import fields as dataclass_fields
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tpuflow.utils.paths import join_path, open_file
+
+# reference cnn.py:2 argv contract + common web-JSON spellings.
+_CAMEL_TO_CONFIG = {
+    "columnNames": "column_names",
+    "columnTypes": "column_types",
+    "targetColumn": "target",
+    "storagePath": "storage_path",
+    "data": "data_path",
+    "dataPath": "data_path",
+    "epochs": "max_epochs",
+    "maxEpochs": "max_epochs",
+    "batchSize": "batch_size",
+    "wellColumn": "well_column",
+}
+
+
+def spec_to_config(spec: dict):
+    """Translate a JSON job spec into a TrainJobConfig.
+
+    Unknown keys are rejected loudly — a typo'd field silently ignored
+    would train the wrong job.
+    """
+    from tpuflow.api.config import TrainJobConfig
+
+    valid = {f.name for f in dataclass_fields(TrainJobConfig)}
+    kwargs = {}
+    for key, value in spec.items():
+        name = _CAMEL_TO_CONFIG.get(key, key)
+        if name not in valid:
+            raise ValueError(f"unknown job-spec field {key!r}")
+        if name in kwargs:
+            raise ValueError(
+                f"job-spec field {key!r} duplicates another key for "
+                f"config field {name!r}"
+            )
+        kwargs[name] = value
+    kwargs.setdefault("verbose", False)
+    return TrainJobConfig(**kwargs)
+
+
+def report_to_dict(report) -> dict:
+    """The JSON the web layer reads: the reference's elapsed-time +
+    test-loss print (cnn.py:133-134), recorded."""
+    return {
+        "test_loss": report.test_loss,
+        "test_mae": report.test_mae,
+        "gilbert_mae": report.gilbert_mae,
+        "time_elapsed": report.time_elapsed,
+        "samples_per_sec": report.samples_per_sec,
+        "epochs_ran": report.result.epochs_ran,
+        "best_val_loss": report.result.best_val_loss,
+    }
+
+
+class JobRunner:
+    """Serial job queue + registry. One worker thread drives the chip."""
+
+    def __init__(self):
+        self._queue: queue.Queue = queue.Queue()
+        self._jobs: dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def submit(self, spec: dict) -> dict:
+        config = spec_to_config(spec)  # validate before queueing
+        job_id = uuid.uuid4().hex[:12]
+        record = {"job_id": job_id, "status": "queued", "spec": spec}
+        with self._lock:
+            self._jobs[job_id] = record
+        self._queue.put((job_id, config))
+        return {"job_id": job_id, "status": "queued"}
+
+    def get(self, job_id: str) -> dict | None:
+        with self._lock:
+            rec = self._jobs.get(job_id)
+            return dict(rec) if rec else None
+
+    def list(self) -> list[dict]:
+        with self._lock:
+            return [
+                {"job_id": r["job_id"], "status": r["status"]}
+                for r in self._jobs.values()
+            ]
+
+    def _set(self, job_id: str, **updates):
+        with self._lock:
+            self._jobs[job_id].update(updates)
+
+    def _run(self):
+        from tpuflow.api import train
+
+        while True:
+            job_id, config = self._queue.get()
+            self._set(job_id, status="running")
+            try:
+                report = train(config)
+                rep = report_to_dict(report)
+                # Inside the try: a failed report write (unwritable dir,
+                # missing gs:// backend, ...) must fail THIS job, not kill
+                # the worker thread and silently wedge the whole queue.
+                if config.storage_path:
+                    path = join_path(
+                        config.storage_path,
+                        "models",
+                        f"{config.model}.report.json",
+                    )
+                    with open_file(path, "w", encoding="utf-8") as f:
+                        json.dump(rep, f, indent=2)
+                    rep["report_path"] = path
+            except Exception as e:
+                self._set(
+                    job_id,
+                    status="failed",
+                    error=f"{type(e).__name__}: {e}",
+                )
+                continue
+            self._set(job_id, status="done", report=rep)
+
+
+def make_server(host: str = "127.0.0.1", port: int = 8700) -> ThreadingHTTPServer:
+    """Build the HTTP server (caller drives serve_forever / shutdown)."""
+    runner = JobRunner()
+
+    class Handler(BaseHTTPRequestHandler):
+        def _send(self, code: int, payload: dict | list):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _route(self) -> str:
+            # Query strings (cache-busting pollers etc.) are not routing.
+            from urllib.parse import urlsplit
+
+            return urlsplit(self.path).path.rstrip("/")
+
+        def do_GET(self):
+            route = self._route()
+            parts = route.split("/")
+            if route in ("", "/health"):
+                self._send(200, {"status": "ok"})
+            elif route == "/jobs":
+                self._send(200, runner.list())
+            elif len(parts) == 3 and parts[1] == "jobs":
+                rec = runner.get(parts[2])
+                if rec is None:
+                    self._send(404, {"error": f"no job {parts[2]!r}"})
+                else:
+                    self._send(200, rec)
+            else:
+                self._send(404, {"error": f"no route {self.path!r}"})
+
+        def do_POST(self):
+            if self._route() != "/jobs":
+                self._send(404, {"error": f"no route {self.path!r}"})
+                return
+            try:
+                # Clamp: a negative Content-Length would turn read() into
+                # read-to-EOF and hang the handler thread on keep-alive.
+                length = max(0, int(self.headers.get("Content-Length", 0)))
+                spec = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(spec, dict):
+                    raise ValueError("job spec must be a JSON object")
+                self._send(202, runner.submit(spec))
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                self._send(400, {"error": str(e)})
+
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    server.runner = runner  # for tests / callers
+    return server
+
+
+def main(argv=None) -> int:
+    import argparse
+    import signal
+
+    p = argparse.ArgumentParser(
+        prog="tpuflow.serve", description="tpuflow training job-runner service"
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8700)
+    args = p.parse_args(argv)
+
+    server = make_server(args.host, args.port)
+
+    def _stop(signum, frame):
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    print(f"tpuflow job server on http://{args.host}:{args.port}", flush=True)
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -218,8 +218,17 @@ def fit(
                 state, metrics = train_step(state, x, y, rng)
                 train_losses.append(metrics["loss"])
                 samples_seen += len(x)
+            if not train_losses:
+                if tracing:  # don't leave the profiler trace open
+                    jax.profiler.stop_trace()
+                raise ValueError(
+                    f"epoch {epoch} yielded zero batch_size="
+                    f"{config.batch_size} batches — training would be a "
+                    "silent no-op reporting NaN loss (dataset/stream split "
+                    "smaller than one batch?)"
+                )
             train_loss = float(np.mean([float(l) for l in train_losses]))
-            last_device_value = train_losses[-1] if train_losses else None
+            last_device_value = train_losses[-1]
         if tracing:
             jax.block_until_ready(last_device_value)
             jax.profiler.stop_trace()
