@@ -1,9 +1,11 @@
-"""Extension benchmark: physics-informed GilbertResidualMLP.
+"""Extension benchmark: the physics-informed Gilbert-residual family.
 
-Beyond the five BASELINE configs: the Gilbert × learned-correction model
+Beyond the five BASELINE configs: Gilbert × learned-correction models
 (the pairing the reference's physical-model + learned-regressor design
-gestures at, reference Readme.md:7-21). Headline: how far the hybrid
-beats the plain physical baseline on held-out data.
+gestures at, reference Readme.md:7-21) — the tabular MLP variant and the
+sequence LSTM variant (per-timestep Gilbert channel). Headlines: how far
+each hybrid beats the plain physical baseline, and whether the sequence
+hybrid beats the plain LSTM of the same size.
 """
 
 from __future__ import annotations
@@ -43,6 +45,37 @@ def main(seed: int = 0) -> None:
         "gilbert_residual",
         "train_throughput",
         report.result.samples_per_sec,
+        "samples/sec/chip",
+    )
+
+    # Sequence variant vs the plain LSTM-64, same data/seed/budget.
+    seq_kwargs = dict(
+        window=24,
+        max_epochs=40,
+        batch_size=256,
+        patience=10,
+        seed=seed,
+        verbose=False,
+        n_devices=1,
+        synthetic_wells=10,
+        synthetic_steps=512,
+    )
+    plain = train(TrainJobConfig(model="lstm", **seq_kwargs))
+    hybrid = train(TrainJobConfig(model="lstm_residual", **seq_kwargs))
+    emit(
+        "lstm_residual",
+        "well_flow_mae",
+        hybrid.test_mae,
+        "stb/day",
+        gilbert_mae=round(hybrid.gilbert_mae, 4),
+        plain_lstm_mae=round(plain.test_mae, 4),
+        beats_gilbert=hybrid.test_mae <= hybrid.gilbert_mae,
+        beats_plain_lstm=hybrid.test_mae <= plain.test_mae,
+    )
+    emit(
+        "lstm_residual",
+        "train_throughput",
+        hybrid.result.samples_per_sec,
         "samples/sec/chip",
     )
 

@@ -25,6 +25,7 @@ DEFAULT_MODELS = (
     "lstm",
     "stacked_lstm",
     "gilbert_residual",
+    "lstm_residual",
 )
 
 

@@ -68,9 +68,11 @@ class TrainJobConfig:
 
     @property
     def is_sequence_model(self) -> bool:
-        return self.model in ("dynamic_mlp", "cnn1d", "lstm", "stacked_lstm")
+        return self.model in (
+            "dynamic_mlp", "cnn1d", "lstm", "stacked_lstm", "lstm_residual",
+        )
 
     @property
     def teacher_forcing(self) -> bool:
         """Sequence-target training for the LSTM family (BASELINE config 4)."""
-        return self.model in ("lstm", "stacked_lstm")
+        return self.model in ("lstm", "stacked_lstm", "lstm_residual")

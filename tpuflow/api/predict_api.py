@@ -124,6 +124,14 @@ class Predictor:
         series = np.stack(
             [np.asarray(columns[n], np.float32) for n in names], axis=1
         )
+        if p.get("append_gilbert"):
+            # Physics-informed sequence artifact: the raw per-timestep
+            # Gilbert prediction rides as the last channel via the SAME
+            # helper the training pipeline used (its stored stats are
+            # identity, so the normalization below leaves it raw).
+            from tpuflow.core.gilbert import append_gilbert_channel
+
+            series = append_gilbert_channel(series, names)
         mean = np.asarray(p["mean"], np.float32)
         std = np.asarray(p["std"], np.float32)
         well_col = p.get("well_column")

@@ -117,6 +117,7 @@ def train(config: TrainJobConfig) -> TrainReport:
             "streaming runs"
         )
     if config.is_sequence_model:
+        seq_physics = config.model == "lstm_residual"
         if config.data_path is not None:
             columns = read_csv(config.data_path, schema)
             splits = prepare_windowed_table(
@@ -127,6 +128,7 @@ def train(config: TrainJobConfig) -> TrainReport:
                 stride=config.stride,
                 seed=config.seed,
                 teacher_forcing=config.teacher_forcing,
+                append_gilbert=seq_physics,
             )
         else:
             splits = prepare_windowed(
@@ -135,6 +137,7 @@ def train(config: TrainJobConfig) -> TrainReport:
                 stride=config.stride,
                 seed=config.seed,
                 teacher_forcing=config.teacher_forcing,
+                append_gilbert=seq_physics,
             )
         train_ds, val_ds, test_ds = splits.train, splits.val, splits.test
         target_std = splits.target_std
@@ -252,6 +255,10 @@ def train(config: TrainJobConfig) -> TrainReport:
         # pipeline's target standardization and silently break the loss.
         model_kwargs["target_mean"] = splits.pipeline.target_mean_
         model_kwargs["target_std"] = splits.pipeline.target_std_
+    elif config.model == "lstm_residual":
+        # Same discipline for the sequence variant (windowed-split stats).
+        model_kwargs["target_mean"] = splits.target_mean
+        model_kwargs["target_std"] = splits.target_std
     model = build_model(config.model, **model_kwargs)
     tx = build_optimizer(config.optimizer, **config.optimizer_kwargs)
     # Streaming sources have no .x; the val sample provides the init shape.
@@ -338,6 +345,7 @@ def train(config: TrainJobConfig) -> TrainReport:
                 "window": config.window,
                 "stride": config.stride,
                 "well_column": config.well_column,
+                "append_gilbert": config.model == "lstm_residual",
                 "mean": splits.norm_mean.tolist(),
                 "std": splits.norm_std.tolist(),
                 "target_mean": splits.target_mean,

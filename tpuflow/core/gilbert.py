@@ -133,6 +133,35 @@ def append_gilbert_column(features, columns, coeffs: ChokeCoefficients = GILBERT
     return np.concatenate([np.asarray(features), q[:, None]], axis=1)
 
 
+def append_gilbert_channel(
+    series, feature_names, coeffs: ChokeCoefficients = GILBERT
+):
+    """Append the RAW per-timestep Gilbert prediction as the LAST channel.
+
+    The sequence-model counterpart of ``append_gilbert_column`` and the
+    single source of the ``GilbertResidualLSTM`` input contract, shared by
+    the windowed training pipeline and the serving path so the appended
+    channel can never drift between them. ``series`` is a [T, F] per-step
+    feature matrix whose columns are named by ``feature_names``.
+    """
+    import numpy as np
+
+    missing = {"pressure", "choke", "glr"} - set(feature_names)
+    if missing:
+        raise ValueError(
+            f"append_gilbert needs pressure/choke/glr channels; "
+            f"missing {sorted(missing)}"
+        )
+    ip = feature_names.index("pressure")
+    ic = feature_names.index("choke")
+    ig = feature_names.index("glr")
+    q = np.asarray(
+        gilbert_flow(series[:, ip], series[:, ic], series[:, ig], coeffs),
+        dtype=np.float32,
+    )
+    return np.concatenate([np.asarray(series), q[:, None]], axis=1)
+
+
 def gilbert_wellhead_pressure(
     flow_rate: jnp.ndarray,
     choke_size: jnp.ndarray,

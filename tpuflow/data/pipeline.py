@@ -107,12 +107,14 @@ def prepare_windowed(
     seed: int = 0,
     fractions: Sequence[float] = DEFAULT_FRACTIONS,
     teacher_forcing: bool = False,
+    append_gilbert: bool = False,
 ) -> WindowedSplits:
     """Sequence-model path: window each well's log, then split by window.
 
     Splitting happens at the *window* level across all wells (the
     multi-well training population), with normalization stats computed from
-    the training windows only.
+    the training windows only. ``append_gilbert`` adds the RAW per-timestep
+    Gilbert prediction as the last channel (see ``_windowed_from_pairs``).
     """
     pairs = [
         (
@@ -124,7 +126,8 @@ def prepare_windowed(
         for w in wells
     ]
     return _windowed_from_pairs(
-        pairs, _SEQ_CHANNELS, window, stride, seed, fractions, teacher_forcing
+        pairs, _SEQ_CHANNELS, window, stride, seed, fractions, teacher_forcing,
+        append_gilbert,
     )
 
 
@@ -137,6 +140,7 @@ def prepare_windowed_table(
     seed: int = 0,
     fractions: Sequence[float] = DEFAULT_FRACTIONS,
     teacher_forcing: bool = False,
+    append_gilbert: bool = False,
 ) -> WindowedSplits:
     """Sequence-model path from a dynamic-schema table (CSV ingest).
 
@@ -173,7 +177,8 @@ def prepare_windowed_table(
             for rows in np.split(grouped, np.cumsum(counts)[:-1])
         ]
     return _windowed_from_pairs(
-        pairs, feature_names, window, stride, seed, fractions, teacher_forcing
+        pairs, feature_names, window, stride, seed, fractions, teacher_forcing,
+        append_gilbert,
     )
 
 
@@ -185,7 +190,21 @@ def _windowed_from_pairs(
     seed: int,
     fractions: Sequence[float],
     teacher_forcing: bool,
+    append_gilbert: bool = False,
 ) -> WindowedSplits:
+    if append_gilbert:
+        # Per-timestep RAW Gilbert prediction as the LAST channel — the
+        # input contract of GilbertResidualLSTM: computed from the raw
+        # series BEFORE normalization, and excluded from it below
+        # (mean 0 / std 1) so the model receives raw physical flow. Shared
+        # helper with the serving path (append_gilbert_channel) so the two
+        # can never drift.
+        from tpuflow.core.gilbert import append_gilbert_channel
+
+        pairs = [
+            (append_gilbert_channel(series, feature_names), target)
+            for series, target in pairs
+        ]
     xs, ys = [], []
     for series, target in pairs:
         fn = teacher_forcing_pairs if teacher_forcing else sliding_windows
@@ -204,6 +223,13 @@ def _windowed_from_pairs(
     mean = x[tr_i].reshape(-1, x.shape[-1]).mean(axis=0)
     std = x[tr_i].reshape(-1, x.shape[-1]).std(axis=0)
     std = np.where(std < 1e-8, 1.0, std).astype(np.float32)
+    if append_gilbert:
+        # The appended physical channel stays RAW (the model multiplies it
+        # by a learned correction); identity stats keep the stored
+        # mean/std aligned with the serving path's normalization.
+        mean = mean.copy()
+        mean[-1] = 0.0
+        std[-1] = 1.0
     norm = lambda a: ((a - mean) / std).astype(np.float32)
 
     t_mean = float(y[tr_i].mean())

@@ -20,6 +20,11 @@ from typing import Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
+# softplus(SOFTPLUS_ONE) == 1: with a zero-init head the learned
+# multiplicative correction starts exactly at 1, i.e. the model begins AS
+# the physical baseline and learns deviations.
+SOFTPLUS_ONE = 0.5413248546129181  # ln(e - 1)
+
 
 class StaticMLP(nn.Module):
     """3-layer MLP over tabular features: [B, F] -> [B]."""
@@ -77,8 +82,8 @@ class GilbertResidualMLP(nn.Module):
         h = x[..., :-1]
         for width in self.hidden:
             h = nn.relu(nn.Dense(width)(h))
-        # Zero-init head => raw=0 at init => softplus(ln(e-1)) == 1:
+        # Zero-init head => raw=0 at init => softplus(SOFTPLUS_ONE) == 1:
         # training starts exactly at the physical model, learns deviations.
         raw = nn.Dense(1, kernel_init=nn.initializers.zeros)(h)[..., 0]
-        correction = nn.softplus(raw + 0.5413248546129181)
+        correction = nn.softplus(raw + SOFTPLUS_ONE)
         return (gilbert_q * correction - self.target_mean) / self.target_std

@@ -9,7 +9,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from tpuflow.models.cnn import CNN1D
-from tpuflow.models.lstm import LSTMRegressor
+from tpuflow.models.lstm import GilbertResidualLSTM, LSTMRegressor
 from tpuflow.models.mlp import DynamicMLP, GilbertResidualMLP, StaticMLP
 
 MODELS: dict[str, Callable[..., nn.Module]] = {
@@ -25,8 +25,9 @@ MODELS: dict[str, Callable[..., nn.Module]] = {
     "stacked_lstm": lambda **kw: LSTMRegressor(
         **{"hidden": 64, "num_layers": 2, **kw}
     ),
-    # Physics-informed extension (Gilbert x learned correction)
+    # Physics-informed extensions (Gilbert x learned correction)
     "gilbert_residual": lambda **kw: GilbertResidualMLP(**kw),
+    "lstm_residual": lambda **kw: GilbertResidualLSTM(**{"hidden": 64, **kw}),
 }
 
 
