@@ -1,0 +1,147 @@
+"""Multi-host plumbing: init_distributed env parsing + per-process data
+path (SURVEY.md §5.8). Runs single-host; the multi-process branches are
+exercised with recorded-call fakes and explicit process ids."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from tpuflow.parallel import (
+    make_mesh,
+    process_batch_bounds,
+    shard_batch,
+)
+from tpuflow.parallel.distributed import init_distributed
+
+
+class _RecordingInit:
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, **kwargs):
+        self.calls.append(kwargs)
+
+
+@pytest.fixture
+def fake_init(monkeypatch):
+    rec = _RecordingInit()
+    monkeypatch.setattr(jax.distributed, "initialize", rec)
+    return rec
+
+
+class TestInitDistributed:
+    def test_single_process_noop(self, fake_init, monkeypatch):
+        for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"):
+            monkeypatch.delenv(var, raising=False)
+        assert init_distributed() is False
+        assert fake_init.calls == []
+
+    def test_env_vars_parsed(self, fake_init, monkeypatch):
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+        monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+        monkeypatch.setenv("JAX_PROCESS_ID", "2")
+        assert init_distributed() is True
+        assert fake_init.calls == [
+            {
+                "coordinator_address": "10.0.0.1:1234",
+                "num_processes": 4,
+                "process_id": 2,
+            }
+        ]
+
+    def test_explicit_args_win_over_env(self, fake_init, monkeypatch):
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+        monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+        monkeypatch.setenv("JAX_PROCESS_ID", "2")
+        assert (
+            init_distributed(
+                coordinator_address="10.9.9.9:999", num_processes=8, process_id=7
+            )
+            is True
+        )
+        assert fake_init.calls[0] == {
+            "coordinator_address": "10.9.9.9:999",
+            "num_processes": 8,
+            "process_id": 7,
+        }
+
+    def test_coordinator_only_env_still_initializes(self, fake_init, monkeypatch):
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+        monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+        monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+        assert init_distributed() is True
+        assert fake_init.calls[0]["coordinator_address"] == "10.0.0.1:1234"
+        assert fake_init.calls[0]["num_processes"] is None
+
+
+class TestProcessBatchBounds:
+    def test_partition_covers_global_batch(self):
+        bounds = [process_batch_bounds(256, pid, 4) for pid in range(4)]
+        assert bounds == [(0, 64), (64, 128), (128, 192), (192, 256)]
+
+    def test_defaults_to_this_process(self):
+        # Single-host: process 0 of 1 owns the whole batch.
+        assert process_batch_bounds(128) == (0, 128)
+
+    def test_indivisible_rejected(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            process_batch_bounds(100, 0, 3)
+
+
+class TestShardBatchMultiHost:
+    def test_per_process_assembly_matches_device_put_single_host(self):
+        """With one process, make_array_from_process_local_data and the
+        device_put path must produce identical global arrays — the
+        multi-host branch is the same code the pod runs, minus peers."""
+        mesh = make_mesh()
+        x = np.random.default_rng(0).standard_normal((32, 4)).astype(np.float32)
+
+        via_put = shard_batch(mesh, x)
+        from tpuflow.parallel.mesh import data_sharding
+
+        via_local = jax.make_array_from_process_local_data(
+            data_sharding(mesh), x
+        )
+        np.testing.assert_array_equal(np.asarray(via_put), np.asarray(via_local))
+        assert via_put.sharding.is_equivalent_to(via_local.sharding, x.ndim)
+
+    def test_jax_array_passthrough_never_fetched(self, monkeypatch):
+        """Prefetched pre-sharded jax.Arrays must pass through without a
+        host fetch even when process_count > 1 (np.asarray on a pod-global
+        array would crash on a real pod)."""
+        mesh = make_mesh()
+        from tpuflow.parallel.mesh import data_sharding
+
+        x = jax.device_put(np.ones((16, 3), np.float32), data_sharding(mesh))
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            jax,
+            "make_array_from_process_local_data",
+            lambda *a: pytest.fail("jax.Array routed to per-process assembly"),
+        )
+        out = shard_batch(mesh, x)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+    def test_multi_process_branch_taken(self, monkeypatch):
+        """When process_count > 1, shard_batch must route through
+        make_array_from_process_local_data (device_put of a local shard
+        would be wrong on a pod)."""
+        mesh = make_mesh()
+        called = []
+        real = jax.make_array_from_process_local_data
+
+        def spy(sharding, local):
+            called.append(local.shape)
+            return real(sharding, local)
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "make_array_from_process_local_data", spy)
+        x = np.ones((8, 3), np.float32)
+        try:
+            shard_batch(mesh, x)
+        except Exception:
+            # Assembly itself may reject the fake process_count on a
+            # single-host runtime; the routing decision is what's under test.
+            pass
+        assert called == [(8, 3)]

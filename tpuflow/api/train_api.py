@@ -44,6 +44,7 @@ from tpuflow.parallel import (
     make_dp_eval_step,
     make_dp_train_step,
     make_mesh,
+    process_batch_bounds,
     shard_batch,
 )
 from tpuflow.parallel.dp import replicate
@@ -277,13 +278,23 @@ def train(config: TrainJobConfig) -> TrainReport:
         state = replicate(mesh, state)
         dp_train = make_dp_train_step(mesh, loss_fn)
         dp_eval = make_dp_eval_step(mesh, loss_fn)
+        # Multi-host pods: every host materializes the same seeded batch
+        # order, then feeds ONLY its process_batch_bounds slice;
+        # shard_batch assembles the slices into pod-global arrays.
+        multi_host = jax.process_count() > 1
+
+        def _local(*arrays):
+            if not multi_host or isinstance(arrays[0], jax.Array):
+                return arrays
+            lo, hi = process_batch_bounds(len(arrays[0]))
+            return tuple(a[lo:hi] for a in arrays)
 
         def train_step(state, x, y, rng):  # noqa: F811
-            xs, ys = shard_batch(mesh, x, y)
+            xs, ys = shard_batch(mesh, *_local(x, y))
             return dp_train(state, xs, ys, rng)
 
         def eval_step(state, x, y, mask):  # noqa: F811
-            xs, ys, ms = shard_batch(mesh, x, y, mask)
+            xs, ys, ms = shard_batch(mesh, *_local(x, y, mask))
             return dp_eval(state, xs, ys, ms)
 
         if config.jit_epoch:
@@ -293,10 +304,16 @@ def train(config: TrainJobConfig) -> TrainReport:
             dp_epoch = make_dp_epoch_step(mesh, loss_fn)
             ep_shard = epoch_sharding(mesh)
 
+            def _put_epoch(a):
+                if multi_host and not isinstance(a, jax.Array):
+                    lo, hi = process_batch_bounds(a.shape[1])
+                    return jax.make_array_from_process_local_data(
+                        ep_shard, a[:, lo:hi]
+                    )
+                return jax.device_put(a, ep_shard)
+
             def epoch_step(state, xs, ys, rng):  # noqa: F811
-                xs = jax.device_put(xs, ep_shard)
-                ys = jax.device_put(ys, ep_shard)
-                return dp_epoch(state, xs, ys, rng)
+                return dp_epoch(state, _put_epoch(xs), _put_epoch(ys), rng)
 
     # --- fit (the reference's hot loop, cnn.py:126-129) ---
     fit_cfg = FitConfig(
@@ -323,7 +340,13 @@ def train(config: TrainJobConfig) -> TrainReport:
         eval_step,
         # DP runs: land prefetched batches pre-sharded over the mesh so the
         # step's shard_batch is a no-op instead of a device0 re-transfer.
-        batch_sharding=(data_sharding(mesh) if n_dev > 1 else None),
+        # Single-host only — a pod-global device_put from one host would
+        # fail; multi-host feeding goes through the _local slicing above.
+        batch_sharding=(
+            data_sharding(mesh)
+            if n_dev > 1 and jax.process_count() == 1
+            else None
+        ),
         epoch_step=epoch_step,
     )
 
@@ -345,7 +368,7 @@ def train(config: TrainJobConfig) -> TrainReport:
                 "window": config.window,
                 "stride": config.stride,
                 "well_column": config.well_column,
-                "append_gilbert": config.model == "lstm_residual",
+                "append_gilbert": seq_physics,
                 "mean": splits.norm_mean.tolist(),
                 "std": splits.norm_std.tolist(),
                 "target_mean": splits.target_mean,

@@ -1,10 +1,27 @@
-"""Multi-host initialization.
+"""Multi-host initialization + the pod recipe.
 
 On a multi-host pod, ``jax.distributed.initialize`` brings up the
 cross-host control plane (DCN); in-pod collectives still ride ICI. This is
 the moral equivalent of the reference's ``spark-submit`` cluster attach
 (reference Readme.md:3) — one call, environment-driven, no-op when single
 process.
+
+The full multi-host recipe (every process runs the same program):
+
+    init_distributed()                  # env-driven; no-op single-host
+    mesh = make_mesh()                  # over jax.devices() = ALL hosts' chips
+    state = replicate(mesh, create_state(...))
+    step = make_dp_train_step(mesh)     # or make_dp_epoch_step
+    lo, hi = process_batch_bounds(GLOBAL_BATCH)
+    for x, y in my_loader(rows=slice(lo, hi)):   # read ONLY this host's slice
+        xs, ys = shard_batch(mesh, x, y)  # per-process assembly on pods
+        state, metrics = step(state, xs, ys, rng)
+
+Each host loads only its ``GLOBAL_BATCH / process_count`` rows
+(``process_batch_bounds``); ``shard_batch`` assembles the per-process
+slices into one global array via ``make_array_from_process_local_data`` —
+the cluster-resident-data story with no host ever holding the global
+batch. Metrics come back replicated (pmean'd), identical on every host.
 """
 
 from __future__ import annotations

@@ -154,21 +154,62 @@ def make_dp_eval_step(
 
 
 def shard_batch(mesh: Mesh, *arrays):
-    """Device_put host arrays with leading-dim sharding over the data axis.
+    """Turn host batches into mesh-sharded global arrays (leading dim over
+    the data axis).
 
-    The batch size must divide the data-axis size (keep global batches a
+    Single-host: a plain ``device_put`` of the full global batch. On a
+    multi-host pod (``jax.process_count() > 1``) each process passes only
+    its OWN slice of the global batch — the cluster-resident-data story
+    (reference Readme.md:3): every host feeds its addressable chips, no
+    host ever materializes the global batch — and the slices are assembled
+    into one global jax.Array via ``make_array_from_process_local_data``.
+    Use ``process_batch_bounds`` to decide which rows this process loads.
+    Inputs that are already ``jax.Array``s (e.g. prefetched pre-sharded
+    batches) pass through with a no-op ``device_put``, never fetched back
+    to the host.
+
+    The global batch size must divide the data-axis size (keep batches a
     multiple of the mesh; the host pipeline's drop_remainder guarantees
     this).
     """
     sharding = data_sharding(mesh)
-    out = tuple(
-        jax.device_put(
-            a if isinstance(a, (np.ndarray, jax.Array)) else np.asarray(a),
-            sharding,
-        )
-        for a in arrays
-    )
+    multi = jax.process_count() > 1
+
+    def put(a):
+        if isinstance(a, jax.Array):
+            # Already on device (e.g. the prefetcher landed it pre-sharded):
+            # device_put to the same sharding is a no-op, and np.asarray on
+            # a pod-global array would crash — never fetch it.
+            return jax.device_put(a, sharding)
+        local = a if isinstance(a, np.ndarray) else np.asarray(a)
+        if multi:
+            return jax.make_array_from_process_local_data(sharding, local)
+        return jax.device_put(local, sharding)
+
+    out = tuple(put(a) for a in arrays)
     return out if len(out) > 1 else out[0]
+
+
+def process_batch_bounds(
+    global_batch: int,
+    process_id: int | None = None,
+    process_count: int | None = None,
+) -> tuple[int, int]:
+    """[start, stop) rows of the global batch THIS process should load.
+
+    The host-side half of the multi-host data path: each process reads
+    only its contiguous slice (matching ``shard_batch``'s per-process
+    assembly), so no host touches more than ``global_batch / processes``
+    rows — HDFS-style cluster-resident reading, TPU-native.
+    """
+    pid = jax.process_index() if process_id is None else process_id
+    n = jax.process_count() if process_count is None else process_count
+    if global_batch % n:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by {n} processes"
+        )
+    per = global_batch // n
+    return pid * per, (pid + 1) * per
 
 
 def replicate(mesh: Mesh, tree):
