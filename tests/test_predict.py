@@ -207,3 +207,54 @@ class TestWindowedServing:
         }
         with pytest.raises(ValueError, match="no full"):
             predict(str(tmp_path), "lstm", columns=cols)
+
+
+def test_multi_well_predictions_in_first_appearance_order(tmp_path):
+    """Regression for the one-pass grouping: wells interleaved/unsorted in
+    the CSV must come back in first-appearance order with per-well time
+    order preserved."""
+    import numpy as np
+
+    from tpuflow.api import TrainJobConfig, predict, train
+    from tpuflow.data.synthetic import generate_wells, wells_to_table
+
+    train(
+        TrainJobConfig(
+            model="dynamic_mlp",
+            window=8,
+            max_epochs=2,
+            batch_size=32,
+            verbose=False,
+            n_devices=1,
+            synthetic_wells=4,
+            synthetic_steps=64,
+            storage_path=str(tmp_path),
+        )
+    )
+    wells = generate_wells(3, 20, seed=3)
+    table = wells_to_table(wells)
+    n = len(table["flow"])
+    per = n // 3
+    # Interleave rows of wells "zeta" and "alpha" (ids chosen so sorted
+    # order differs from appearance order), keeping per-well time order.
+    ids = np.array(
+        ["zeta"] * per + ["alpha"] * per + ["zeta"] * (n - 2 * per)
+    )
+    columns = {k: v for k, v in table.items()}
+    columns["well"] = ids
+    columns.pop("flow")
+
+    from tpuflow.api.predict_api import Predictor
+
+    pred = Predictor.load(str(tmp_path), "dynamic_mlp")
+    pred._meta["preprocessor"]["well_column"] = "well"
+    y, idx = pred.predict_columns(columns, return_index=True)
+    # First-appearance order: all zeta windows first, then alpha.
+    first_alpha = idx.wells.index("alpha")
+    assert set(idx.wells[:first_alpha]) == {"zeta"}
+    assert set(idx.wells[first_alpha:]) == {"alpha"}
+    # Per-well time order: start rows strictly increasing within each well.
+    zeta_starts = idx.starts[:first_alpha]
+    alpha_starts = idx.starts[first_alpha:]
+    assert np.all(np.diff(zeta_starts) > 0)
+    assert np.all(np.diff(alpha_starts) > 0)

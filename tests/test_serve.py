@@ -208,3 +208,107 @@ class TestSubprocessDaemon:
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 proc.kill()
+
+
+class TestPredictEndpoint:
+    def test_train_then_predict_over_http(self, server, tmp_path):
+        """The full web-layer loop: train a job, then serve predictions
+        from the artifact — no Python on the client side."""
+        _, body = _post(
+            server + "/jobs",
+            {
+                "model": "static_mlp",
+                "epochs": 2,
+                "batchSize": 32,
+                "storagePath": str(tmp_path),
+                "n_devices": 1,
+                "synthetic_wells": 4,
+                "synthetic_steps": 64,
+            },
+        )
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            _, rec = _get(server + f"/jobs/{body['job_id']}")
+            if rec["status"] in ("done", "failed"):
+                break
+            time.sleep(0.5)
+        assert rec["status"] == "done", rec
+
+        from tpuflow.data.synthetic import generate_wells, wells_to_table
+
+        table = wells_to_table(generate_wells(1, 16, seed=9))
+        table.pop("flow")
+        status, out = _post(
+            server + "/predict",
+            {
+                "storagePath": str(tmp_path),
+                "model": "static_mlp",
+                "columns": {k: v.tolist() for k, v in table.items()},
+            },
+        )
+        assert status == 200
+        assert out["count"] == 16
+        assert all(isinstance(v, float) for v in out["predictions"])
+
+    def test_predict_missing_fields_400(self, server):
+        status, out = _post(server + "/predict", {"model": "x"})
+        assert status == 400 and "storagePath" in out["error"]
+
+    def test_predict_missing_artifact_500(self, server):
+        status, out = _post(
+            server + "/predict",
+            {"storagePath": "/nonexistent", "model": "nope", "columns": {}},
+        )
+        assert status == 500
+
+
+class TestPredictCacheInvalidation:
+    def test_retrain_evicts_cached_predictor(self, tmp_path):
+        import threading
+
+        from tpuflow.data.synthetic import generate_wells, wells_to_table
+
+        srv = make_server("127.0.0.1", 0)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        spec = {
+            "model": "static_mlp",
+            "epochs": 1,
+            "batchSize": 32,
+            "storagePath": str(tmp_path),
+            "n_devices": 1,
+            "synthetic_wells": 4,
+            "synthetic_steps": 64,
+        }
+
+        def run_job(s):
+            _, body = _post(base + "/jobs", s)
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                _, rec = _get(base + f"/jobs/{body['job_id']}")
+                if rec["status"] in ("done", "failed"):
+                    return rec
+                time.sleep(0.3)
+            raise TimeoutError(rec)
+
+        try:
+            assert run_job(spec)["status"] == "done"
+            table = wells_to_table(generate_wells(1, 8, seed=9))
+            table.pop("flow")
+            status, _ = _post(
+                base + "/predict",
+                {
+                    "storagePath": str(tmp_path),
+                    "model": "static_mlp",
+                    "columns": {k: v.tolist() for k, v in table.items()},
+                },
+            )
+            assert status == 200
+            key = (str(tmp_path), "static_mlp")
+            assert key in srv.predictor._cache  # populated by /predict
+            # Retraining the same artifact must evict the cached model.
+            assert run_job({**spec, "seed": 1})["status"] == "done"
+            assert key not in srv.predictor._cache
+        finally:
+            srv.shutdown()

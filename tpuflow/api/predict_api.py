@@ -137,11 +137,19 @@ class Predictor:
         well_col = p.get("well_column")
         if well_col and well_col in columns:
             ids = np.asarray(columns[well_col])
-            # First-appearance order, preserving row (time) order per well —
-            # predictions come out in input order, not sorted-id order.
-            _, first_idx = np.unique(ids, return_index=True)
-            well_order = ids[np.sort(first_idx)]
-            groups = [(w, np.flatnonzero(ids == w)) for w in well_order]
+            # One-pass grouping (O(n log n), not O(wells x rows)): a stable
+            # argsort of the inverse codes clusters each well's rows while
+            # preserving their original (time) order; groups are emitted in
+            # first-appearance order so predictions come out in input
+            # order, not sorted-id order.
+            uniq, first_idx, inverse, counts = np.unique(
+                ids, return_index=True, return_inverse=True, return_counts=True
+            )
+            clustered = np.argsort(inverse, kind="stable")
+            slices = np.split(clustered, np.cumsum(counts)[:-1])
+            groups = [
+                (uniq[i], slices[i]) for i in np.argsort(first_idx)
+            ]
         else:
             groups = [(None, np.arange(len(series)))]
         chunks, wells_out, starts_out = [], [], []

@@ -14,6 +14,10 @@ API (JSON in/out):
 - ``POST /jobs``        — submit a job spec; returns ``{"job_id", "status"}``.
 - ``GET  /jobs``        — list all jobs (summaries).
 - ``GET  /jobs/<id>``   — one job: status, spec, report or error.
+- ``POST /predict``     — serve a trained artifact synchronously:
+  ``{"storagePath", "model", "data": <csv path>}`` or
+  ``{"storagePath", "model", "columns": {name: [values...]}}`` →
+  ``{"predictions": [...], "count"}``. Loaded artifacts are cached.
 - ``GET  /health``      — liveness probe.
 
 The spec accepts the reference's camelCase submission fields
@@ -94,12 +98,19 @@ def report_to_dict(report) -> dict:
 
 
 class JobRunner:
-    """Serial job queue + registry. One worker thread drives the chip."""
+    """Serial job queue + registry. One worker thread drives the chip.
 
-    def __init__(self):
+    ``on_artifact_change(storage_path, model)`` is called whenever a job
+    that writes under ``storage_path`` reaches a terminal state — training
+    writes save-best checkpoints as it goes, so even a failed job may have
+    changed the artifact (the predict cache must drop it either way).
+    """
+
+    def __init__(self, on_artifact_change=None):
         self._queue: queue.Queue = queue.Queue()
         self._jobs: dict[str, dict] = {}
         self._lock = threading.Lock()
+        self._on_artifact_change = on_artifact_change
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
@@ -155,13 +166,80 @@ class JobRunner:
                     status="failed",
                     error=f"{type(e).__name__}: {e}",
                 )
+                self._notify_artifact(config)
                 continue
             self._set(job_id, status="done", report=rep)
+            self._notify_artifact(config)
+
+    def _notify_artifact(self, config):
+        if self._on_artifact_change and config.storage_path:
+            self._on_artifact_change(config.storage_path, config.model)
+
+
+class PredictService:
+    """Synchronous serving over trained artifacts, with a Predictor cache
+    (loading parses the sidecar + restores params — do it once per
+    artifact, not per request)."""
+
+    def __init__(self):
+        self._cache: dict[tuple[str, str], object] = {}
+        self._lock = threading.Lock()  # guards the dicts, never held on load
+        self._key_locks: dict[tuple[str, str], threading.Lock] = {}
+
+    def invalidate(self, storage_path: str, name: str) -> None:
+        """Drop a cached artifact (called when a job rewrites it)."""
+        with self._lock:
+            self._cache.pop((storage_path, name), None)
+
+    def _predictor(self, storage_path: str, name: str):
+        from tpuflow.api.predict_api import Predictor
+
+        key = (storage_path, name)
+        with self._lock:
+            cached = self._cache.get(key)
+            if cached is not None:
+                return cached
+            key_lock = self._key_locks.setdefault(key, threading.Lock())
+        # Load under the PER-KEY lock only: a cold (possibly seconds-long
+        # gs:// restore) load must not serialize cache hits or loads of
+        # other artifacts.
+        with key_lock:
+            with self._lock:
+                cached = self._cache.get(key)
+                if cached is not None:
+                    return cached
+            loaded = Predictor.load(storage_path, name)
+            with self._lock:
+                self._cache[key] = loaded
+            return loaded
+
+    def predict(self, spec: dict) -> dict:
+        import numpy as np
+
+        storage = spec.get("storagePath") or spec.get("storage_path")
+        name = spec.get("model") or spec.get("name")
+        if not storage or not name:
+            raise ValueError("predict needs storagePath and model")
+        pred = self._predictor(storage, name)
+        if "data" in spec:
+            y = pred.predict_csv(spec["data"])
+        elif "columns" in spec:
+            columns = {
+                k: np.asarray(v) for k, v in spec["columns"].items()
+            }
+            y = pred.predict_columns(columns)
+        else:
+            raise ValueError("predict needs data (csv path) or columns")
+        y = np.asarray(y)
+        return {"predictions": y.tolist(), "count": int(len(y))}
 
 
 def make_server(host: str = "127.0.0.1", port: int = 8700) -> ThreadingHTTPServer:
     """Build the HTTP server (caller drives serve_forever / shutdown)."""
-    runner = JobRunner()
+    predictor = PredictService()
+    # Retraining an artifact this process has served must evict the cached
+    # Predictor, or /predict would keep returning the old model forever.
+    runner = JobRunner(on_artifact_change=predictor.invalidate)
 
     class Handler(BaseHTTPRequestHandler):
         def _send(self, code: int, payload: dict | list):
@@ -194,26 +272,43 @@ def make_server(host: str = "127.0.0.1", port: int = 8700) -> ThreadingHTTPServe
             else:
                 self._send(404, {"error": f"no route {self.path!r}"})
 
+        def _read_spec(self) -> dict:
+            # Clamp: a negative Content-Length would turn read() into
+            # read-to-EOF and hang the handler thread on keep-alive.
+            length = max(0, int(self.headers.get("Content-Length", 0)))
+            spec = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(spec, dict):
+                raise ValueError("request body must be a JSON object")
+            return spec
+
         def do_POST(self):
-            if self._route() != "/jobs":
+            route = self._route()
+            if route == "/jobs":
+                try:
+                    self._send(202, runner.submit(self._read_spec()))
+                except (ValueError, TypeError, json.JSONDecodeError) as e:
+                    self._send(400, {"error": str(e)})
+            elif route == "/predict":
+                try:
+                    spec = self._read_spec()
+                except (ValueError, TypeError, json.JSONDecodeError) as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                try:
+                    self._send(200, predictor.predict(spec))
+                except ValueError as e:
+                    self._send(400, {"error": str(e)})
+                except Exception as e:  # missing artifact, bad columns, ...
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            else:
                 self._send(404, {"error": f"no route {self.path!r}"})
-                return
-            try:
-                # Clamp: a negative Content-Length would turn read() into
-                # read-to-EOF and hang the handler thread on keep-alive.
-                length = max(0, int(self.headers.get("Content-Length", 0)))
-                spec = json.loads(self.rfile.read(length) or b"{}")
-                if not isinstance(spec, dict):
-                    raise ValueError("job spec must be a JSON object")
-                self._send(202, runner.submit(spec))
-            except (ValueError, TypeError, json.JSONDecodeError) as e:
-                self._send(400, {"error": str(e)})
 
         def log_message(self, fmt, *args):  # quiet by default
             pass
 
     server = ThreadingHTTPServer((host, port), Handler)
     server.runner = runner  # for tests / callers
+    server.predictor = predictor
     return server
 
 
