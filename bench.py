@@ -2,9 +2,10 @@
 
 The BASELINE.json north-star metric: train the dynamic LSTM flow model at
 >=10k samples/sec/chip. Times the full training step (fwd + bwd + SGD
-update) of the LSTM-64 config on BOTH recurrence backends — the XLA
-``lax.scan`` path and the fused Pallas kernel (``tpuflow/kernels/lstm.py``)
-— and prints ONE JSON line whose ``value`` is the best of the two:
+update) of the LSTM-64 config on THREE recurrence variants — the XLA
+``lax.scan`` path, the same scan unrolled (BENCH_UNROLL, default 8), and
+the fused Pallas kernel (``tpuflow/kernels/lstm.py``) — and prints ONE
+JSON line whose ``value`` is the best of them:
 
     {"metric", "value", "unit", "vs_baseline", "backends", "pallas_parity",
      "mfu", "bound", "device", "attempts"}
@@ -33,7 +34,8 @@ Also embedded in the worker run:
   so the samples/sec number comes with "X% of peak, bound by Y".
 
 Env knobs: BENCH_BATCH (default 4096), BENCH_SECONDS (default 10),
-BENCH_SCAN (train steps fused per dispatch, default 16), BENCH_ATTEMPTS
+BENCH_SCAN (train steps fused per dispatch, default 16), BENCH_UNROLL
+(scan unroll factor for the unrolled variant, default 8), BENCH_ATTEMPTS
 (default 3), BENCH_TIMEOUT (per-attempt seconds, default 600).
 """
 
@@ -54,50 +56,8 @@ METRIC = "lstm64_train_samples_per_sec_per_chip"
 # the roofline model so they always describe the same workload.
 WINDOW, FEATURES, HIDDEN = 24, 5, 64
 
-# Per-chip peak bf16 matmul FLOP/s and HBM GB/s, keyed by substrings of
-# jax.Device.device_kind (public spec-sheet numbers).
-_CHIP_PEAKS = {
-    "v6": (918e12, 1640e9),  # v6e / Trillium
-    "v5p": (459e12, 2765e9),
-    "v5": (197e12, 819e9),  # v5e reports as "TPU v5 lite"
-    "v4": (275e12, 1228e9),
-    "v3": (123e12, 900e9),
-    "v2": (45e12, 700e9),
-}
-
-
-def _chip_peaks(device_kind: str):
-    kind = device_kind.lower()
-    for key, peaks in _CHIP_PEAKS.items():
-        if key in kind:
-            return peaks
-    return None, None
-
-
-def lstm64_flops_per_sample_step(T: int, F: int, H: int) -> float:
-    """Model FLOPs for ONE sample through one train step (fwd+bwd+update).
-
-    Matmuls (2*m*n*k each, per timestep): input projection [F,4H],
-    recurrent [H,4H], head [H,1]. Gate elementwise math ~25 flops per gate
-    element (sigmoid/tanh ~10 each plus combines). Backward of a matmul
-    costs 2x its forward (dX and dW products); elementwise bwd ~= fwd.
-    """
-    matmul_fwd = 2.0 * T * (F * 4 * H + H * 4 * H + H)
-    gates_fwd = 25.0 * T * 4 * H
-    return 3.0 * matmul_fwd + 2.0 * gates_fwd
-
-
-def lstm64_bytes_per_sample_step(T: int, F: int, H: int, itemsize: int) -> float:
-    """Rough HBM bytes for one sample through one train step.
-
-    Activation traffic dominates (weights are small and VMEM-resident
-    across the scan): read x; write+read the hoisted projection xw [T,4H];
-    write hs/cs and re-read them in backward; write dxw. Counts each
-    logical tensor's HBM round trips; XLA fusion can only shrink this.
-    """
-    xw = 4 * H * T
-    hs_cs = 2 * H * T
-    return itemsize * (T * F + 3 * xw + 3 * hs_cs)
+# FLOPs/bytes model + chip peaks + MFU verdict live in the library
+# (tpuflow/utils/roofline.py) so the accounting is reusable and testable.
 
 
 # --------------------------------------------------------------------------
@@ -167,15 +127,17 @@ def _parity_check(jax, jnp) -> str:
     return f"ok ({mode}, max_rel_err={worst:.1e})"
 
 
-def _measure_backend(jax, jnp, backend: str, batch: int, seconds: float, scan: int):
-    """Throughput of the full LSTM-64 train step for one recurrence backend."""
+def _measure_backend(
+    jax, jnp, model_kwargs: dict, batch: int, seconds: float, scan: int
+):
+    """Throughput of the full LSTM-64 train step for one recurrence variant."""
     from tpuflow.core.losses import mae_clip
     from tpuflow.models import LSTMRegressor
     from tpuflow.train import create_state, make_train_step
     from tpuflow.train.steps import make_epoch_step
 
     window, features = WINDOW, FEATURES
-    model = LSTMRegressor(hidden=HIDDEN, dtype=jnp.bfloat16, backend=backend)
+    model = LSTMRegressor(hidden=HIDDEN, dtype=jnp.bfloat16, **model_kwargs)
     rng = np.random.default_rng(0)
     x_np = rng.standard_normal((batch, window, features)).astype(np.float32)
     y_np = rng.standard_normal((batch, window)).astype(np.float32)
@@ -228,14 +190,17 @@ def worker() -> None:
     except Exception as e:  # parity failure is reported, not fatal
         parity = f"ERROR: {type(e).__name__}: {str(e)[:300]}"
 
+    from benchmarks.common import lstm_variants
+
+    variants = lstm_variants()
     backends: dict[str, float | str] = {}
-    for backend in ("xla", "pallas"):
+    for name, kwargs in variants.items():
         try:
-            backends[backend] = round(
-                _measure_backend(jax, jnp, backend, batch, seconds, scan), 1
+            backends[name] = round(
+                _measure_backend(jax, jnp, kwargs, batch, seconds, scan), 1
             )
         except Exception as e:
-            backends[backend] = f"ERROR: {type(e).__name__}: {str(e)[:300]}"
+            backends[name] = f"ERROR: {type(e).__name__}: {str(e)[:300]}"
 
     numeric = {k: v for k, v in backends.items() if isinstance(v, float)}
     if not numeric:
@@ -243,9 +208,14 @@ def worker() -> None:
     best_backend, best = max(numeric.items(), key=lambda kv: kv[1])
 
     # Roofline: is the measured number good, and what bounds it?
-    flops = lstm64_flops_per_sample_step(window, features, hidden)
-    bytes_ = lstm64_bytes_per_sample_step(window, features, hidden, itemsize=2)
-    peak_flops, peak_bw = _chip_peaks(device_kind)
+    from tpuflow.utils.roofline import (
+        lstm_bytes_per_sample_step,
+        lstm_flops_per_sample_step,
+        roofline_report,
+    )
+
+    flops = lstm_flops_per_sample_step(window, features, hidden)
+    bytes_ = lstm_bytes_per_sample_step(window, features, hidden, itemsize=2)
     rec = {
         "metric": METRIC,
         "value": best,
@@ -257,16 +227,8 @@ def worker() -> None:
         "device": device_kind,
         "flops_per_sample": round(flops),
         "hbm_bytes_per_sample": round(bytes_),
+        **roofline_report(best, flops, bytes_, device_kind),
     }
-    if peak_flops:
-        ai = flops / bytes_  # arithmetic intensity of the step
-        ridge = peak_flops / peak_bw
-        rec["mfu"] = round(best * flops / peak_flops, 6)
-        rec["hbm_util"] = round(best * bytes_ / peak_bw, 6)
-        rec["bound"] = "hbm" if ai < ridge else "mxu"
-    else:
-        rec["mfu"] = None
-        rec["bound"] = f"unknown chip {device_kind!r}"
     print(json.dumps(rec), flush=True)
 
 

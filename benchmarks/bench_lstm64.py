@@ -21,14 +21,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from benchmarks.common import emit, time_train_steps
+from benchmarks.common import emit, lstm_variants, time_train_steps
 from tpuflow.api import TrainJobConfig, train
 from tpuflow.models import LSTMRegressor
 from tpuflow.train import create_state, make_train_step
 
 
-def step_throughput(backend: str, batch: int, seconds: float) -> float:
-    model = LSTMRegressor(hidden=64, dtype=jnp.bfloat16, backend=backend)
+def step_throughput(model_kwargs: dict, batch: int, seconds: float) -> float:
+    model = LSTMRegressor(hidden=64, dtype=jnp.bfloat16, **model_kwargs)
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.standard_normal((batch, 24, 5)), jnp.float32)
     y = jnp.asarray(rng.standard_normal((batch, 24)), jnp.float32)
@@ -42,17 +42,16 @@ def step_throughput(backend: str, batch: int, seconds: float) -> float:
 def main(seed: int = 0) -> None:
     batch = int(os.environ.get("BENCH_BATCH", 4096))
     seconds = float(os.environ.get("BENCH_SECONDS", 5))
-
-    for backend in ("xla", "pallas"):
+    for name, kwargs in lstm_variants().items():
         try:
-            sps = step_throughput(backend, batch, seconds)
+            sps = step_throughput(kwargs, batch, seconds)
         except Exception as e:  # pallas unavailable on exotic backends
-            emit("lstm64", f"train_step_throughput_{backend}", -1.0, "samples/sec/chip",
+            emit("lstm64", f"train_step_throughput_{name}", -1.0, "samples/sec/chip",
                  error=str(e)[:200])
             continue
         emit(
             "lstm64",
-            f"train_step_throughput_{backend}",
+            f"train_step_throughput_{name}",
             sps,
             "samples/sec/chip",
             vs_north_star=round(sps / 10_000.0, 3),

@@ -29,6 +29,20 @@ def maybe_pin_cpu() -> None:
 maybe_pin_cpu()
 
 
+def lstm_variants() -> dict[str, dict]:
+    """The LSTM recurrence variants the benchmarks race: plain XLA scan,
+    the same scan unrolled (BENCH_UNROLL, default 8, clamped >= 2), and
+    the fused Pallas kernel. One definition shared by bench.py and
+    bench_lstm64.py so the north-star and per-variant benches can't drift.
+    """
+    unroll = max(int(os.environ.get("BENCH_UNROLL", 8)), 2)
+    return {
+        "xla": {},
+        f"xla_unroll{unroll}": {"unroll": unroll},
+        "pallas": {"backend": "pallas"},
+    }
+
+
 def emit(config: str, metric: str, value: float, unit: str, **extra) -> dict:
     rec = {
         "config": config,

@@ -116,3 +116,23 @@ def test_models_jit_and_grad():
 def test_registry_unknown():
     with pytest.raises(ValueError, match="unknown model"):
         build_model("nope")
+
+
+def test_lstm_unroll_matches_plain_scan():
+    """unroll is a pure scheduling knob: outputs must be bitwise-compatible
+    with the unroll=1 scan for identical params."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpuflow.models import LSTMRegressor
+
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((4, 23, 5)), jnp.float32
+    )  # T=23: not divisible by the unroll factor on purpose
+    plain = LSTMRegressor(hidden=16)
+    unrolled = LSTMRegressor(hidden=16, unroll=8)
+    params = plain.init(jax.random.PRNGKey(0), x)["params"]
+    y1 = plain.apply({"params": params}, x)
+    y2 = unrolled.apply({"params": params}, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), atol=1e-6)

@@ -60,6 +60,12 @@ class LSTMLayer(nn.Module):
     hidden: int
     dtype: Any = jnp.float32
     backend: str = "xla"  # "xla" | "pallas"
+    # lax.scan unroll factor for the XLA backend: unrolling K steps per
+    # loop iteration amortizes loop overhead and lets XLA fuse across
+    # steps — a real lever for small recurrences (H=64) where per-step
+    # work barely covers the loop cost. Compile time grows with K; T must
+    # not need to divide K (lax.scan handles the remainder).
+    unroll: int = 1
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -93,6 +99,7 @@ class LSTMLayer(nn.Module):
                 lambda carry, xw_t: lstm_step(carry, xw_t, w_h, b),
                 (h0, h0),
                 xw,
+                unroll=self.unroll,
             )
         return jnp.swapaxes(hs, 0, 1)  # back to batch-major [B, T, H]
 
@@ -122,6 +129,7 @@ class GilbertResidualLSTM(nn.Module):
     readout: str = "sequence"  # "sequence" | "last"
     dtype: Any = jnp.float32
     backend: str = "xla"  # "xla" | "pallas"
+    unroll: int = 1  # lax.scan unroll for the XLA backend (see LSTMLayer)
     target_mean: float = 0.0
     target_std: float = 1.0
 
@@ -136,6 +144,7 @@ class GilbertResidualLSTM(nn.Module):
                 self.hidden,
                 dtype=self.dtype,
                 backend=self.backend,
+                unroll=self.unroll,
                 name=f"lstm_{layer}",
             )(h)
         raw = nn.Dense(
@@ -165,6 +174,7 @@ class LSTMRegressor(nn.Module):
     readout: str = "sequence"  # "sequence" | "last"
     dtype: Any = jnp.float32
     backend: str = "xla"  # "xla" | "pallas"
+    unroll: int = 1  # lax.scan unroll for the XLA backend (see LSTMLayer)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, deterministic: bool = True) -> jnp.ndarray:
@@ -173,6 +183,7 @@ class LSTMRegressor(nn.Module):
                 self.hidden,
                 dtype=self.dtype,
                 backend=self.backend,
+                unroll=self.unroll,
                 name=f"lstm_{layer}",
             )(x)
         y = nn.Dense(1, dtype=self.dtype, name="head")(x)[..., 0]  # [B, T]

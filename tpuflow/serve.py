@@ -161,15 +161,18 @@ class JobRunner:
                         json.dump(rep, f, indent=2)
                     rep["report_path"] = path
             except Exception as e:
+                # Evict BEFORE publishing the terminal status: a client
+                # that polls to completion and immediately predicts must
+                # never see the pre-retrain cache entry.
+                self._notify_artifact(config)
                 self._set(
                     job_id,
                     status="failed",
                     error=f"{type(e).__name__}: {e}",
                 )
-                self._notify_artifact(config)
                 continue
-            self._set(job_id, status="done", report=rep)
             self._notify_artifact(config)
+            self._set(job_id, status="done", report=rep)
 
     def _notify_artifact(self, config):
         if self._on_artifact_change and config.storage_path:
@@ -185,11 +188,16 @@ class PredictService:
         self._cache: dict[tuple[str, str], object] = {}
         self._lock = threading.Lock()  # guards the dicts, never held on load
         self._key_locks: dict[tuple[str, str], threading.Lock] = {}
+        # Invalidation generation per key: a load that STARTED before an
+        # invalidate() must not re-cache its (stale) result after it.
+        self._gen: dict[tuple[str, str], int] = {}
 
     def invalidate(self, storage_path: str, name: str) -> None:
         """Drop a cached artifact (called when a job rewrites it)."""
+        key = (storage_path, name)
         with self._lock:
-            self._cache.pop((storage_path, name), None)
+            self._cache.pop(key, None)
+            self._gen[key] = self._gen.get(key, 0) + 1
 
     def _predictor(self, storage_path: str, name: str):
         from tpuflow.api.predict_api import Predictor
@@ -208,9 +216,13 @@ class PredictService:
                 cached = self._cache.get(key)
                 if cached is not None:
                     return cached
+                gen = self._gen.get(key, 0)
             loaded = Predictor.load(storage_path, name)
             with self._lock:
-                self._cache[key] = loaded
+                if self._gen.get(key, 0) == gen:
+                    self._cache[key] = loaded
+                # else: the artifact was rewritten mid-load; serve this
+                # request from what was loaded but don't poison the cache.
             return loaded
 
     def predict(self, spec: dict) -> dict:

@@ -1,0 +1,80 @@
+"""Roofline accounting: is a measured throughput good, and what bounds it?
+
+The reference records wall-clock only (reference cnn.py:126-134); a raw
+samples/sec number can't say whether it leaves 5x on the table. This
+module supplies the missing context: a FLOPs/bytes-per-sample model for
+the LSTM config, per-chip peak specs, and the MFU / HBM-utilization /
+bound-by verdict. Used by ``bench.py`` for the recorded north-star metric.
+"""
+
+from __future__ import annotations
+
+# Per-chip peak bf16 matmul FLOP/s and HBM bytes/s, keyed by substrings of
+# jax.Device.device_kind (public spec-sheet numbers). Order matters:
+# longest/most-specific keys first ("v5p" before "v5").
+CHIP_PEAKS = {
+    "v6": (918e12, 1640e9),  # v6e / Trillium
+    "v5p": (459e12, 2765e9),
+    "v5": (197e12, 819e9),  # v5e reports as "TPU v5 lite"
+    "v4": (275e12, 1228e9),
+    "v3": (123e12, 900e9),
+    "v2": (45e12, 700e9),
+}
+
+
+def chip_peaks(device_kind: str) -> tuple[float | None, float | None]:
+    """(peak bf16 FLOP/s, peak HBM bytes/s) for a device_kind, or Nones."""
+    kind = device_kind.lower()
+    for key, peaks in CHIP_PEAKS.items():
+        if key in kind:
+            return peaks
+    return None, None
+
+
+def lstm_flops_per_sample_step(T: int, F: int, H: int) -> float:
+    """Model FLOPs for ONE sample through one train step (fwd+bwd+update).
+
+    Matmuls (2*m*n*k each, per timestep): input projection [F,4H],
+    recurrent [H,4H], head [H,1]. Gate elementwise math ~25 flops per gate
+    element (sigmoid/tanh ~10 each plus combines). Backward of a matmul
+    costs 2x its forward (dX and dW products); elementwise bwd ~= fwd.
+    """
+    matmul_fwd = 2.0 * T * (F * 4 * H + H * 4 * H + H)
+    gates_fwd = 25.0 * T * 4 * H
+    return 3.0 * matmul_fwd + 2.0 * gates_fwd
+
+
+def lstm_bytes_per_sample_step(T: int, F: int, H: int, itemsize: int) -> float:
+    """Rough HBM bytes for one sample through one train step.
+
+    Activation traffic dominates (weights are small and VMEM-resident
+    across the scan): read x; write+read the hoisted projection xw [T,4H];
+    write hs/cs and re-read them in backward; write dxw. Counts each
+    logical tensor's HBM round trips; XLA fusion can only shrink this.
+    """
+    xw = 4 * H * T
+    hs_cs = 2 * H * T
+    return itemsize * (T * F + 3 * xw + 3 * hs_cs)
+
+
+def roofline_report(
+    samples_per_sec: float,
+    flops_per_sample: float,
+    bytes_per_sample: float,
+    device_kind: str,
+) -> dict:
+    """MFU, HBM utilization, and the bound-by verdict for a measurement.
+
+    Returns ``{"mfu": None, "bound": "unknown chip ..."}`` for chips
+    without a peaks entry (e.g. cpu).
+    """
+    peak_flops, peak_bw = chip_peaks(device_kind)
+    if not peak_flops:
+        return {"mfu": None, "bound": f"unknown chip {device_kind!r}"}
+    ai = flops_per_sample / bytes_per_sample  # arithmetic intensity
+    ridge = peak_flops / peak_bw
+    return {
+        "mfu": round(samples_per_sec * flops_per_sample / peak_flops, 6),
+        "hbm_util": round(samples_per_sec * bytes_per_sample / peak_bw, 6),
+        "bound": "hbm" if ai < ridge else "mxu",
+    }
