@@ -55,3 +55,25 @@ def test_losses_jittable():
     f = jax.jit(mae_clip)
     x = jnp.ones(16)
     np.testing.assert_allclose(float(f(x, x)), 0.0)
+
+
+def test_pallas_loss_selectable_from_train_config():
+    """loss="mae_clip_pallas" runs the fused kernel end to end through
+    train() (registry entry is lazy to avoid the core<->kernels cycle)."""
+    import numpy as np
+
+    from tpuflow.api import TrainJobConfig, train
+
+    report = train(
+        TrainJobConfig(
+            model="static_mlp",
+            loss="mae_clip_pallas",
+            max_epochs=2,
+            batch_size=32,
+            verbose=False,
+            n_devices=1,
+            synthetic_wells=4,
+            synthetic_steps=64,
+        )
+    )
+    assert np.isfinite(report.test_loss)

@@ -44,4 +44,20 @@ def huber(
     return jnp.mean(0.5 * quad**2 + delta * (err - quad))
 
 
-LOSSES = {"mae_clip": mae_clip, "mae": mae, "mse": mse, "huber": huber}
+def _mae_clip_pallas(y_true: jnp.ndarray, y_pred: jnp.ndarray) -> jnp.ndarray:
+    """The fused Pallas kernel variant of ``mae_clip`` (same semantics,
+    parity-tested) — selectable per job via ``TrainJobConfig.loss``.
+    Lazy import: ``tpuflow.kernels`` imports this module for CLIP_VALUE.
+    """
+    from tpuflow.kernels import mae_clip_pallas
+
+    return mae_clip_pallas(y_true, y_pred)
+
+
+LOSSES = {
+    "mae_clip": mae_clip,
+    "mae": mae,
+    "mse": mse,
+    "huber": huber,
+    "mae_clip_pallas": _mae_clip_pallas,
+}

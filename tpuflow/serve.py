@@ -176,7 +176,18 @@ class JobRunner:
 
     def _notify_artifact(self, config):
         if self._on_artifact_change and config.storage_path:
-            self._on_artifact_change(config.storage_path, config.model)
+            try:
+                self._on_artifact_change(config.storage_path, config.model)
+            except Exception as e:
+                # A crashing callback must not kill the worker thread (the
+                # job would be stuck at 'running' and the queue wedged).
+                import sys
+
+                print(
+                    f"tpuflow.serve: artifact-change callback failed: "
+                    f"{type(e).__name__}: {e}",
+                    file=sys.stderr,
+                )
 
 
 class PredictService:
