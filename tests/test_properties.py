@@ -121,3 +121,39 @@ class TestSchemaProperties:
         cat = {c.name for c in schema.categorical_features}
         assert cont | cat == set(names) - {target}
         assert not (cont & cat)
+
+
+class TestHashSplitProperties:
+    @given(
+        st.integers(min_value=-(2**31), max_value=2**63),
+        st.integers(min_value=0, max_value=5000),
+        st.integers(min_value=1, max_value=997),
+    )
+    @settings(max_examples=50, deadline=None)
+    def test_chunk_invariance_any_seed(self, seed, start, n):
+        """Assignments depend only on (global index, seed) — never on how
+        the stream was chunked — for ANY seed including negative/huge."""
+        from tpuflow.data.stream import split_assignments
+
+        whole = split_assignments(start, n, seed)
+        assert set(np.unique(whole)) <= {0, 1, 2}
+        if n >= 2:
+            cut = n // 2
+            parts = np.concatenate(
+                [
+                    split_assignments(start, cut, seed),
+                    split_assignments(start + cut, n - cut, seed),
+                ]
+            )
+            np.testing.assert_array_equal(whole, parts)
+
+    @given(st.integers(min_value=-(2**31), max_value=2**63))
+    @settings(max_examples=25, deadline=None)
+    def test_fractions_roughly_uniform_any_seed(self, seed):
+        from tpuflow.data.stream import split_assignments
+
+        a = split_assignments(0, 20_000, seed)
+        fracs = [float(np.mean(a == i)) for i in range(3)]
+        assert abs(fracs[0] - 0.64) < 0.03
+        assert abs(fracs[1] - 0.16) < 0.03
+        assert abs(fracs[2] - 0.20) < 0.03
