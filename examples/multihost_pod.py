@@ -30,18 +30,18 @@ if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
     jax.config.update("jax_platforms", "cpu")
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from tpuflow.core.losses import mae_clip
 from tpuflow.models import LSTMRegressor
 from tpuflow.parallel import (
-    epoch_sharding,
     init_distributed,
     make_dp_epoch_step,
+    make_dp_train_step,
     make_mesh,
     process_batch_bounds,
     shard_batch,
+    shard_epoch,
 )
 from tpuflow.parallel.dp import replicate
 from tpuflow.train import create_state
@@ -77,26 +77,18 @@ def main() -> None:
 
     # Per-batch path: each host feeds its slice; shard_batch assembles.
     xs, ys = shard_batch(mesh, x0, y0)
-    from tpuflow.parallel import make_dp_train_step
-
     step = make_dp_train_step(mesh, mae_clip)
     state, metrics = step(state, xs, ys, jax.random.PRNGKey(0))
     print(f"per-batch DP step: loss={float(metrics['loss']):.4f}")
 
     # Scanned path: K steps (each with its ICI all-reduce) per dispatch.
-    ep_shard = epoch_sharding(mesh)
-    stacked_x = np.stack(
-        [load_my_rows(lo, hi, seed=s)[0] for s in range(STEPS_PER_DISPATCH)]
+    # One load per step; shard_epoch does the per-process assembly.
+    pairs = [load_my_rows(lo, hi, seed=s) for s in range(STEPS_PER_DISPATCH)]
+    exs, eys = shard_epoch(
+        mesh,
+        np.stack([p[0] for p in pairs]),
+        np.stack([p[1] for p in pairs]),
     )
-    stacked_y = np.stack(
-        [load_my_rows(lo, hi, seed=s)[1] for s in range(STEPS_PER_DISPATCH)]
-    )
-    if jax.process_count() > 1:
-        exs = jax.make_array_from_process_local_data(ep_shard, stacked_x)
-        eys = jax.make_array_from_process_local_data(ep_shard, stacked_y)
-    else:
-        exs = jax.device_put(jnp.asarray(stacked_x), ep_shard)
-        eys = jax.device_put(jnp.asarray(stacked_y), ep_shard)
     epoch_step = make_dp_epoch_step(mesh, mae_clip)
     state, loss = epoch_step(state, exs, eys, jax.random.PRNGKey(1))
     print(

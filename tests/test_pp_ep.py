@@ -1,0 +1,118 @@
+"""Pipeline- and expert-parallel building blocks vs dense references.
+
+Both are beyond-parity axes (SURVEY.md §2 lists PP/EP out of scope) kept
+expressible with the same shard_map vocabulary; these tests pin their
+exact equivalence to unsharded computation on the 8-virtual-device mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuflow.parallel import make_mesh, moe_forward, pipeline_forward
+
+MODEL_AXIS = "model"
+
+
+def _stage_fn(params, x):
+    w, b = params
+    return jnp.tanh(x @ w + b)
+
+
+class TestPipelineParallel:
+    @pytest.mark.parametrize("n_micro", [1, 4, 7])
+    def test_matches_sequential_stages(self, n_micro):
+        n_stages = 4
+        mesh = make_mesh(n_data=2, n_model=n_stages)
+        rng = np.random.default_rng(0)
+        F, B = 6, 3
+        ws = jnp.asarray(rng.standard_normal((n_stages, F, F)) * 0.3, jnp.float32)
+        bs = jnp.asarray(rng.standard_normal((n_stages, F)) * 0.1, jnp.float32)
+        xs = jnp.asarray(
+            rng.standard_normal((n_micro, B, F)), jnp.float32
+        )
+
+        got = pipeline_forward(mesh, _stage_fn, (ws, bs), xs)
+
+        want = xs
+        for s in range(n_stages):
+            want = jax.vmap(lambda m: _stage_fn((ws[s], bs[s]), m))(want)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=1e-5
+        )
+
+    def test_two_stage_full_mesh(self):
+        mesh = make_mesh(n_data=4, n_model=2)
+        rng = np.random.default_rng(1)
+        ws = jnp.asarray(rng.standard_normal((2, 5, 5)) * 0.3, jnp.float32)
+        bs = jnp.zeros((2, 5), jnp.float32)
+        xs = jnp.asarray(rng.standard_normal((3, 2, 5)), jnp.float32)
+        got = pipeline_forward(mesh, _stage_fn, (ws, bs), xs)
+        want = xs
+        for s in range(2):
+            want = jax.vmap(lambda m: _stage_fn((ws[s], bs[s]), m))(want)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def _expert_fn(params, x):
+    w1, w2 = params
+    return jax.nn.relu(x @ w1) @ w2
+
+
+class TestExpertParallel:
+    def test_matches_dense_top1_moe(self):
+        n_experts = 4
+        mesh = make_mesh(n_data=2, n_model=n_experts)
+        rng = np.random.default_rng(2)
+        F, H, N = 6, 8, 10
+        w1 = jnp.asarray(
+            rng.standard_normal((n_experts, F, H)) * 0.3, jnp.float32
+        )
+        w2 = jnp.asarray(
+            rng.standard_normal((n_experts, H, F)) * 0.3, jnp.float32
+        )
+        gate_w = jnp.asarray(rng.standard_normal((F, n_experts)), jnp.float32)
+        x = jnp.asarray(rng.standard_normal((N, F)), jnp.float32)
+
+        got = moe_forward(mesh, _expert_fn, (w1, w2), gate_w, x)
+
+        logits = np.asarray(x @ gate_w)
+        probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+        choice = logits.argmax(axis=-1)
+        want = np.zeros((N, F), np.float32)
+        for i in range(N):
+            e = choice[i]
+            out = np.asarray(_expert_fn((w1[e], w2[e]), x[i : i + 1]))[0]
+            want[i] = probs[i, e] * out
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+    def test_gate_width_mismatch_rejected(self):
+        mesh = make_mesh(n_data=2, n_model=4)
+        with pytest.raises(ValueError, match="experts"):
+            moe_forward(
+                mesh,
+                _expert_fn,
+                (jnp.zeros((4, 3, 3)), jnp.zeros((4, 3, 3))),
+                jnp.zeros((3, 5)),  # 5 gate outputs != 4 experts
+                jnp.zeros((2, 3)),
+            )
+
+    def test_every_token_routed_exactly_once(self):
+        """Identity experts: the combine must return gate_weight * x for
+        every token (no drops, no double counting)."""
+        n_experts = 8
+        mesh = make_mesh(n_data=1, n_model=n_experts)
+        rng = np.random.default_rng(3)
+        F, N = 4, 64
+        eye = jnp.broadcast_to(jnp.eye(F), (n_experts, F, F))
+        gate_w = jnp.asarray(rng.standard_normal((F, n_experts)), jnp.float32)
+        x = jnp.asarray(rng.standard_normal((N, F)), jnp.float32)
+        got = moe_forward(
+            mesh, lambda p, t: t @ p[0] @ p[1], (eye, eye), gate_w, x
+        )
+        probs = jax.nn.softmax(x @ gate_w, axis=-1)
+        w = jnp.max(probs, axis=-1)  # top-1 weight per token
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(x * w[:, None]), atol=1e-5
+        )

@@ -38,7 +38,6 @@ from tpuflow.api.config import TrainJobConfig
 from tpuflow.models import build_model
 from tpuflow.parallel import (
     data_sharding,
-    epoch_sharding,
     init_distributed,
     make_dp_epoch_step,
     make_dp_eval_step,
@@ -46,6 +45,7 @@ from tpuflow.parallel import (
     make_mesh,
     process_batch_bounds,
     shard_batch,
+    shard_epoch,
 )
 from tpuflow.parallel.dp import replicate
 from tpuflow.train import FitConfig, FitResult, create_state, evaluate, fit
@@ -302,15 +302,15 @@ def train(config: TrainJobConfig) -> TrainReport:
             # all-reduce) per dispatch — same dispatch-amortization as
             # single-chip jit_epoch.
             dp_epoch = make_dp_epoch_step(mesh, loss_fn)
-            ep_shard = epoch_sharding(mesh)
 
             def _put_epoch(a):
+                # _stacked_epoch materializes the full global batches on
+                # every host; keep only this process's dim-1 slice before
+                # the shared per-process assembly.
                 if multi_host and not isinstance(a, jax.Array):
                     lo, hi = process_batch_bounds(a.shape[1])
-                    return jax.make_array_from_process_local_data(
-                        ep_shard, a[:, lo:hi]
-                    )
-                return jax.device_put(a, ep_shard)
+                    a = a[:, lo:hi]
+                return shard_epoch(mesh, a)
 
             def epoch_step(state, xs, ys, rng):  # noqa: F811
                 return dp_epoch(state, _put_epoch(xs), _put_epoch(ys), rng)

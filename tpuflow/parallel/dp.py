@@ -128,6 +128,31 @@ def epoch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
     return NamedSharding(mesh, P(None, axis))
 
 
+def shard_epoch(mesh: Mesh, *arrays, axis: str = DATA_AXIS):
+    """Assemble stacked epoch arrays ``[n_batches, B_local, ...]`` into
+    mesh-sharded globals — the epoch-scan counterpart of ``shard_batch``.
+
+    Same per-input routing: jax.Arrays pass through (no host fetch); on a
+    multi-process runtime numpy inputs are THIS process's dim-1 slice
+    (``process_batch_bounds`` over the global B) assembled via
+    ``make_array_from_process_local_data``; single-host numpy inputs are
+    device_put whole.
+    """
+    sharding = epoch_sharding(mesh, axis)
+    multi = jax.process_count() > 1
+
+    def put(a):
+        if isinstance(a, jax.Array):
+            return jax.device_put(a, sharding)
+        local = a if isinstance(a, np.ndarray) else np.asarray(a)
+        if multi:
+            return jax.make_array_from_process_local_data(sharding, local)
+        return jax.device_put(local, sharding)
+
+    out = tuple(put(a) for a in arrays)
+    return out if len(out) > 1 else out[0]
+
+
 def make_dp_eval_step(
     mesh: Mesh, loss_fn: LossFn = mae_clip, axis: str = DATA_AXIS
 ):

@@ -1,0 +1,78 @@
+"""Expert parallelism: a sharded mixture-of-experts building block.
+
+The reference has no MoE (SURVEY.md §2 lists EP as out of scope for
+parity), but the framework keeps the axis expressible with the same
+explicit-collective ``shard_map`` vocabulary as DP/TP/SP/PP. One expert
+lives on each device of the ``model`` axis; tokens are routed by a
+learned gate.
+
+Dispatch strategy: **dense (capacity-free)** — tokens are all-gathered
+across the expert axis, each expert computes only its assigned tokens'
+outputs (masked), and the weighted combine is a ``psum``. Exact (no
+token dropping, no capacity tuning), at the cost of O(global tokens)
+activation work per expert — the right trade for a building block whose
+job is correctness and expressibility; a capacity-bucketed ``all_to_all``
+dispatch is a drop-in upgrade behind the same signature.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpuflow.parallel.mesh import MODEL_AXIS
+
+
+def moe_forward(
+    mesh: Mesh,
+    expert_fn: Callable,
+    expert_params,
+    gate_w: jnp.ndarray,
+    x: jnp.ndarray,
+    axis: str = MODEL_AXIS,
+) -> jnp.ndarray:
+    """Top-1 mixture-of-experts forward with experts sharded over ``axis``.
+
+    Args:
+      mesh: mesh whose ``axis`` dimension holds one expert per device.
+      expert_fn: ``(params_one_expert, x [N, F]) -> [N, F]``.
+      expert_params: pytree of ``[E, ...]`` stacked per-expert params,
+        sharded on the leading (expert) dim.
+      gate_w: ``[F, E]`` router weights, replicated.
+      x: ``[N, F]`` tokens, replicated (shard the batch with the ``data``
+        axis outside this block; the two axes compose).
+
+    Returns:
+      ``[N, F]`` combined outputs, replicated: softmax-top-1 gate weight
+      times the chosen expert's output for every token.
+    """
+    n_experts = mesh.shape[axis]
+    if gate_w.shape[1] != n_experts:
+        raise ValueError(
+            f"gate has {gate_w.shape[1]} outputs but {axis}={n_experts} experts"
+        )
+
+    def body(params_local, gate_w, x):
+        eid = lax.axis_index(axis)
+        params_one = jax.tree_util.tree_map(lambda p: p[0], params_local)
+        logits = x @ gate_w  # [N, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        choice = jnp.argmax(logits, axis=-1)  # [N] top-1 expert ids
+        weight = jnp.take_along_axis(probs, choice[:, None], axis=1)[:, 0]
+        mine = (choice == eid).astype(x.dtype)  # [N] my tokens
+        # Dense dispatch: compute all tokens, keep mine, weighted combine.
+        out = expert_fn(params_one, x)  # [N, F]
+        return lax.psum(out * (mine * weight)[:, None], axis)
+
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return sharded(expert_params, gate_w, x)

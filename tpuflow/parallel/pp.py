@@ -1,0 +1,99 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over the mesh.
+
+The reference has no pipeline parallelism (SURVEY.md §2 lists PP as out of
+scope for parity — its models are KBs of params), but the framework keeps
+every scaling-book axis *expressible* with the same explicit-collective
+``shard_map`` vocabulary as the DP/TP/SP modules. This module is the PP
+building block:
+
+- the ``model`` axis holds one pipeline **stage** per device (each device
+  owns only its stage's params — the memory win of PP);
+- **microbatches** flow stage→stage around the device ring with
+  ``lax.ppermute`` — one [B, F] activation transfer per tick riding ICI;
+- the schedule is the classic GPipe fill/steady/drain: with S stages and
+  M microbatches the pipeline runs ``M + S - 1`` ticks, bubble fraction
+  ``(S-1)/(M+S-1)`` — raise M to amortize.
+
+All stages must share one activation shape (in_dim == out_dim), the
+standard homogeneous-stage pipeline; heterogeneous stages belong at the
+XLA level, not this building block.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpuflow.parallel.mesh import MODEL_AXIS
+
+
+def pipeline_forward(
+    mesh: Mesh,
+    stage_fn: Callable,
+    stage_params: jnp.ndarray,
+    microbatches: jnp.ndarray,
+    axis: str = MODEL_AXIS,
+) -> jnp.ndarray:
+    """Run ``stage_fn`` as an S-stage pipeline over M microbatches.
+
+    Args:
+      mesh: mesh whose ``axis`` dimension is the pipeline (S stages).
+      stage_fn: ``(params_one_stage, x [B, F]) -> [B, F]`` — one stage's
+        compute; applied by every device to its local stage params.
+      stage_params: ``[S, ...]`` stacked per-stage params, sharded on the
+        leading (stage) dim over ``axis``.
+      microbatches: ``[M, B, F]`` replicated input microbatches.
+
+    Returns:
+      ``[M, B, F]`` outputs after all S stages, replicated.
+    """
+    n_stages = mesh.shape[axis]
+    n_micro = microbatches.shape[0]
+
+    def body(params_local, xs):
+        # params_local: [1, ...] — this device's stage. xs: [M, B, F].
+        params_one = jax.tree_util.tree_map(lambda p: p[0], params_local)
+        stage = lax.axis_index(axis)
+        B, F = xs.shape[1], xs.shape[2]
+        zero = jnp.zeros((B, F), xs.dtype)
+        outputs = jnp.zeros_like(xs)
+        ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(t, carry):
+            received, outputs = carry
+            # Stage 0 injects microbatch t during the fill/steady phase;
+            # other stages consume what the ring delivered last tick.
+            inject = xs[jnp.minimum(t, n_micro - 1)]
+            feed = jnp.where((stage == 0) & (t < n_micro), inject, received)
+            out = stage_fn(params_one, feed)
+            # The LAST stage emits microbatch t-(S-1) once the pipe fills.
+            m = t - (n_stages - 1)
+            valid = (stage == n_stages - 1) & (m >= 0)
+            slot = jnp.maximum(m, 0)
+            prev = lax.dynamic_index_in_dim(outputs, slot, 0, keepdims=False)
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(valid, out, prev), slot, 0
+            )
+            received = lax.ppermute(out, axis, ring)
+            return received, outputs
+
+        (_, outputs) = lax.fori_loop(
+            0, n_micro + n_stages - 1, tick, (zero, outputs)
+        )
+        # Outputs live on the last stage only; broadcast them to every
+        # device (psum of one non-zero contribution).
+        mask = (stage == n_stages - 1).astype(xs.dtype)
+        return lax.psum(outputs * mask, axis)
+
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return sharded(stage_params, microbatches)
