@@ -13,7 +13,7 @@ import urllib.request
 
 import pytest
 
-from tpuflow.serve import make_server, report_to_dict, spec_to_config
+from tpuflow.serve import make_server, spec_to_config
 
 
 class TestSpecTranslation:

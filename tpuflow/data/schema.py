@@ -17,7 +17,6 @@ cnn.py:72).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Sequence
 
 import numpy as np
 

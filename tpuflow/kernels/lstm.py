@@ -24,7 +24,6 @@ On non-TPU backends the kernels run in Pallas interpret mode, so CI on the
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

@@ -6,7 +6,6 @@ from __future__ import annotations
 from typing import Callable
 
 import flax.linen as nn
-import jax.numpy as jnp
 
 from tpuflow.models.cnn import CNN1D
 from tpuflow.models.lstm import GilbertResidualLSTM, LSTMRegressor

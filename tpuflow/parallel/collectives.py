@@ -9,8 +9,6 @@ SPMD region (``shard_map``).
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
 from jax import lax
 
 from tpuflow.parallel.mesh import DATA_AXIS

@@ -13,7 +13,6 @@ dropout key.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable
 
 import jax
@@ -128,17 +127,16 @@ def epoch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
     return NamedSharding(mesh, P(None, axis))
 
 
-def shard_epoch(mesh: Mesh, *arrays, axis: str = DATA_AXIS):
-    """Assemble stacked epoch arrays ``[n_batches, B_local, ...]`` into
-    mesh-sharded globals — the epoch-scan counterpart of ``shard_batch``.
+def _assemble(sharding: NamedSharding, *arrays):
+    """Per-input routing shared by ``shard_batch``/``shard_epoch``:
 
-    Same per-input routing: jax.Arrays pass through (no host fetch); on a
-    multi-process runtime numpy inputs are THIS process's dim-1 slice
-    (``process_batch_bounds`` over the global B) assembled via
-    ``make_array_from_process_local_data``; single-host numpy inputs are
-    device_put whole.
+    - jax.Arrays pass through with a no-op ``device_put`` — ``np.asarray``
+      on a pod-global array would crash, never fetch it back to the host;
+    - on a multi-process runtime, numpy inputs are THIS process's local
+      slice, assembled into one pod-global array via
+      ``make_array_from_process_local_data``;
+    - single-host numpy inputs are ``device_put`` whole.
     """
-    sharding = epoch_sharding(mesh, axis)
     multi = jax.process_count() > 1
 
     def put(a):
@@ -151,6 +149,15 @@ def shard_epoch(mesh: Mesh, *arrays, axis: str = DATA_AXIS):
 
     out = tuple(put(a) for a in arrays)
     return out if len(out) > 1 else out[0]
+
+
+def shard_epoch(mesh: Mesh, *arrays, axis: str = DATA_AXIS):
+    """Assemble stacked epoch arrays ``[n_batches, B_local, ...]`` into
+    mesh-sharded globals — the epoch-scan counterpart of ``shard_batch``
+    (dim 1 is the batch dim; use ``process_batch_bounds`` over the global
+    B to pick this process's slice). Routing per ``_assemble``.
+    """
+    return _assemble(epoch_sharding(mesh, axis), *arrays)
 
 
 def make_dp_eval_step(
@@ -197,22 +204,7 @@ def shard_batch(mesh: Mesh, *arrays):
     multiple of the mesh; the host pipeline's drop_remainder guarantees
     this).
     """
-    sharding = data_sharding(mesh)
-    multi = jax.process_count() > 1
-
-    def put(a):
-        if isinstance(a, jax.Array):
-            # Already on device (e.g. the prefetcher landed it pre-sharded):
-            # device_put to the same sharding is a no-op, and np.asarray on
-            # a pod-global array would crash — never fetch it.
-            return jax.device_put(a, sharding)
-        local = a if isinstance(a, np.ndarray) else np.asarray(a)
-        if multi:
-            return jax.make_array_from_process_local_data(sharding, local)
-        return jax.device_put(local, sharding)
-
-    out = tuple(put(a) for a in arrays)
-    return out if len(out) > 1 else out[0]
+    return _assemble(data_sharding(mesh), *arrays)
 
 
 def process_batch_bounds(

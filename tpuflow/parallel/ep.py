@@ -17,6 +17,7 @@ dispatch is a drop-in upgrade behind the same signature.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable
 
 import jax
@@ -25,6 +26,34 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpuflow.parallel.mesh import MODEL_AXIS
+
+
+@functools.lru_cache(maxsize=None)
+def _moe_fn(mesh: Mesh, axis: str, expert_fn: Callable):
+    """Jitted MoE program, cached per (mesh, axis, expert_fn) — tp.py's
+    repeated-calls-dispatch-don't-retrace pattern."""
+
+    def body(params_local, gate_w, x):
+        eid = lax.axis_index(axis)
+        params_one = jax.tree_util.tree_map(lambda p: p[0], params_local)
+        logits = x @ gate_w  # [N, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        choice = jnp.argmax(logits, axis=-1)  # [N] top-1 expert ids
+        weight = jnp.take_along_axis(probs, choice[:, None], axis=1)[:, 0]
+        mine = (choice == eid).astype(x.dtype)  # [N] my tokens
+        # Dense dispatch: compute all tokens, keep mine, weighted combine.
+        out = expert_fn(params_one, x)  # [N, F]
+        return lax.psum(out * (mine * weight)[:, None], axis)
+
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(axis), P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
 
 
 def moe_forward(
@@ -39,7 +68,9 @@ def moe_forward(
 
     Args:
       mesh: mesh whose ``axis`` dimension holds one expert per device.
-      expert_fn: ``(params_one_expert, x [N, F]) -> [N, F]``.
+      expert_fn: ``(params_one_expert, x [N, F]) -> [N, F]``. Pass a
+        module-level function (not a fresh lambda per call) so the cached
+        compiled program is reused.
       expert_params: pytree of ``[E, ...]`` stacked per-expert params,
         sharded on the leading (expert) dim.
       gate_w: ``[F, E]`` router weights, replicated.
@@ -55,24 +86,4 @@ def moe_forward(
         raise ValueError(
             f"gate has {gate_w.shape[1]} outputs but {axis}={n_experts} experts"
         )
-
-    def body(params_local, gate_w, x):
-        eid = lax.axis_index(axis)
-        params_one = jax.tree_util.tree_map(lambda p: p[0], params_local)
-        logits = x @ gate_w  # [N, E]
-        probs = jax.nn.softmax(logits, axis=-1)
-        choice = jnp.argmax(logits, axis=-1)  # [N] top-1 expert ids
-        weight = jnp.take_along_axis(probs, choice[:, None], axis=1)[:, 0]
-        mine = (choice == eid).astype(x.dtype)  # [N] my tokens
-        # Dense dispatch: compute all tokens, keep mine, weighted combine.
-        out = expert_fn(params_one, x)  # [N, F]
-        return lax.psum(out * (mine * weight)[:, None], axis)
-
-    sharded = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(P(axis), P(), P()),
-        out_specs=P(),
-        check_vma=False,
-    )
-    return sharded(expert_params, gate_w, x)
+    return _moe_fn(mesh, axis, expert_fn)(expert_params, gate_w, x)

@@ -21,6 +21,7 @@ XLA level, not this building block.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable
 
 import jax
@@ -31,30 +32,15 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tpuflow.parallel.mesh import MODEL_AXIS
 
 
-def pipeline_forward(
-    mesh: Mesh,
-    stage_fn: Callable,
-    stage_params: jnp.ndarray,
-    microbatches: jnp.ndarray,
-    axis: str = MODEL_AXIS,
-) -> jnp.ndarray:
-    """Run ``stage_fn`` as an S-stage pipeline over M microbatches.
-
-    Args:
-      mesh: mesh whose ``axis`` dimension is the pipeline (S stages).
-      stage_fn: ``(params_one_stage, x [B, F]) -> [B, F]`` — one stage's
-        compute; applied by every device to its local stage params.
-      stage_params: ``[S, ...]`` stacked per-stage params, sharded on the
-        leading (stage) dim over ``axis``.
-      microbatches: ``[M, B, F]`` replicated input microbatches.
-
-    Returns:
-      ``[M, B, F]`` outputs after all S stages, replicated.
-    """
+@functools.lru_cache(maxsize=None)
+def _pipeline_fn(mesh: Mesh, axis: str, stage_fn: Callable):
+    """Jitted pipeline program, cached per (mesh, axis, stage_fn) — the
+    same repeated-calls-dispatch-don't-retrace pattern as tp.py. Shapes
+    (M, B, F) stay dynamic to jit's own shape cache."""
     n_stages = mesh.shape[axis]
-    n_micro = microbatches.shape[0]
 
     def body(params_local, xs):
+        n_micro = xs.shape[0]
         # params_local: [1, ...] — this device's stage. xs: [M, B, F].
         params_one = jax.tree_util.tree_map(lambda p: p[0], params_local)
         stage = lax.axis_index(axis)
@@ -89,11 +75,37 @@ def pipeline_forward(
         mask = (stage == n_stages - 1).astype(xs.dtype)
         return lax.psum(outputs * mask, axis)
 
-    sharded = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=P(),
-        check_vma=False,
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(axis), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
     )
-    return sharded(stage_params, microbatches)
+
+
+def pipeline_forward(
+    mesh: Mesh,
+    stage_fn: Callable,
+    stage_params,
+    microbatches: jnp.ndarray,
+    axis: str = MODEL_AXIS,
+) -> jnp.ndarray:
+    """Run ``stage_fn`` as an S-stage pipeline over M microbatches.
+
+    Args:
+      mesh: mesh whose ``axis`` dimension is the pipeline (S stages).
+      stage_fn: ``(params_one_stage, x [B, F]) -> [B, F]`` — one stage's
+        compute; applied by every device to its local stage params. Pass a
+        module-level function (not a fresh lambda per call) so the cached
+        compiled program is reused.
+      stage_params: pytree of ``[S, ...]`` stacked per-stage params,
+        sharded on the leading (stage) dim over ``axis``.
+      microbatches: ``[M, B, F]`` replicated input microbatches.
+
+    Returns:
+      ``[M, B, F]`` outputs after all S stages, replicated.
+    """
+    return _pipeline_fn(mesh, axis, stage_fn)(stage_params, microbatches)

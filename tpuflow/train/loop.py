@@ -181,116 +181,122 @@ def fit(
 
         mlog = MetricsLogger(config.metrics_path)
 
-    for epoch in range(start_epoch, config.max_epochs + 1):
-        te = time.time()
-        tracing = config.trace_dir is not None and epoch == start_epoch
-        if tracing:
-            jax.profiler.start_trace(config.trace_dir)
+    try:
+        for epoch in range(start_epoch, config.max_epochs + 1):
+            te = time.time()
+            tracing = config.trace_dir is not None and epoch == start_epoch
+            if tracing:
+                jax.profiler.start_trace(config.trace_dir)
 
-        if epoch_step is not None:
-            # Whole epoch in one compiled call (scan over batches).
-            xs, ys = _stacked_epoch(
-                train_ds, config.batch_size, config.seed + epoch
-            )
-            state, epoch_loss = epoch_step(
-                state, xs, ys, jax.random.fold_in(rng, epoch)
-            )
-            train_loss = float(epoch_loss)
-            samples_seen += xs.shape[0] * xs.shape[1]
-            last_device_value = epoch_loss
-        else:
-            train_losses = []
-            if isinstance(train_ds, StreamingSource):
-                epoch_batches = train_ds.epoch_batches(epoch)
+            if epoch_step is not None:
+                # Whole epoch in one compiled call (scan over batches).
+                xs, ys = _stacked_epoch(
+                    train_ds, config.batch_size, config.seed + epoch
+                )
+                state, epoch_loss = epoch_step(
+                    state, xs, ys, jax.random.fold_in(rng, epoch)
+                )
+                train_loss = float(epoch_loss)
+                samples_seen += xs.shape[0] * xs.shape[1]
+                last_device_value = epoch_loss
             else:
-                epoch_batches = batches(
-                    train_ds, config.batch_size, seed=config.seed + epoch
-                )
-            if config.prefetch:
-                from tpuflow.data.prefetch import device_prefetch
+                train_losses = []
+                if isinstance(train_ds, StreamingSource):
+                    epoch_batches = train_ds.epoch_batches(epoch)
+                else:
+                    epoch_batches = batches(
+                        train_ds, config.batch_size, seed=config.seed + epoch
+                    )
+                if config.prefetch:
+                    from tpuflow.data.prefetch import device_prefetch
 
-                epoch_batches = device_prefetch(
-                    epoch_batches,
-                    buffer_size=config.prefetch,
-                    sharding=batch_sharding,
-                )
-            for x, y in epoch_batches:
-                state, metrics = train_step(state, x, y, rng)
-                train_losses.append(metrics["loss"])
-                samples_seen += len(x)
-            if not train_losses:
-                if tracing:  # don't leave the profiler trace open
-                    jax.profiler.stop_trace()
-                raise ValueError(
-                    f"epoch {epoch} yielded zero batch_size="
-                    f"{config.batch_size} batches — training would be a "
-                    "silent no-op reporting NaN loss (dataset/stream split "
-                    "smaller than one batch?)"
-                )
-            train_loss = float(np.mean([float(l) for l in train_losses]))
-            last_device_value = train_losses[-1]
-        if tracing:
-            jax.block_until_ready(last_device_value)
-            jax.profiler.stop_trace()
+                    epoch_batches = device_prefetch(
+                        epoch_batches,
+                        buffer_size=config.prefetch,
+                        sharding=batch_sharding,
+                    )
+                for x, y in epoch_batches:
+                    state, metrics = train_step(state, x, y, rng)
+                    train_losses.append(metrics["loss"])
+                    samples_seen += len(x)
+                if not train_losses:
+                    if tracing:  # don't leave the profiler trace open
+                        jax.profiler.stop_trace()
+                    raise ValueError(
+                        f"epoch {epoch} yielded zero batch_size="
+                        f"{config.batch_size} batches — training would be a "
+                        "silent no-op reporting NaN loss (dataset/stream split "
+                        "smaller than one batch?)"
+                    )
+                train_loss = float(np.mean([float(l) for l in train_losses]))
+                last_device_value = train_losses[-1]
+            if tracing:
+                jax.block_until_ready(last_device_value)
+                jax.profiler.stop_trace()
 
-        val = _eval_dataset(eval_step, state, val_ds, config.batch_size)
-        epoch_time = time.time() - te
-        result.history.append(
-            {"epoch": epoch, "loss": train_loss, "val_loss": val["loss"],
-             "val_mae": val["mae"], "time": epoch_time}
-        )
+            val = _eval_dataset(eval_step, state, val_ds, config.batch_size)
+            epoch_time = time.time() - te
+            result.history.append(
+                {"epoch": epoch, "loss": train_loss, "val_loss": val["loss"],
+                 "val_mae": val["mae"], "time": epoch_time}
+            )
+            if mlog is not None:
+                rec = dict(result.history[-1])
+                # 'time' would shadow the logger's wall-clock timestamp field.
+                rec["epoch_time"] = rec.pop("time")
+                mlog.write("epoch", model=config.model_name, **rec)
+            if config.verbose and epoch % config.log_every == 0:
+                print(
+                    f"Epoch {epoch}/{config.max_epochs} - {epoch_time:.2f}s"
+                    f" - loss: {train_loss:.4f} - val_loss: {val['loss']:.4f}"
+                )
+
+            if val["loss"] < result.best_val_loss:
+                result.best_val_loss = val["loss"]
+            should_stop = stopper.update(val["loss"])
+            if ckpt is not None and stopper.improved:
+                ckpt.maybe_save(epoch, state.params, val["loss"])
+            if (
+                run_ckpt is not None
+                and config.save_every
+                and epoch % config.save_every == 0
+            ):
+                run_ckpt.save(
+                    epoch,
+                    state,
+                    {
+                        "epoch": epoch,
+                        "stopper_best": stopper.best,
+                        "stopper_bad_epochs": stopper.bad_epochs,
+                        "best_val_loss": result.best_val_loss,
+                    },
+                )
+            result.epochs_ran = epoch
+            if should_stop:
+                break
+
+        result.time_elapsed = time.time() - t0
+        result.samples_per_sec = samples_seen / max(result.time_elapsed, 1e-9)
+        result.state = state
         if mlog is not None:
-            rec = dict(result.history[-1])
-            # 'time' would shadow the logger's wall-clock timestamp field.
-            rec["epoch_time"] = rec.pop("time")
-            mlog.write("epoch", model=config.model_name, **rec)
-        if config.verbose and epoch % config.log_every == 0:
-            print(
-                f"Epoch {epoch}/{config.max_epochs} - {epoch_time:.2f}s"
-                f" - loss: {train_loss:.4f} - val_loss: {val['loss']:.4f}"
+            mlog.write(
+                "fit_done",
+                model=config.model_name,
+                epochs=result.epochs_ran,
+                best_val_loss=result.best_val_loss,
+                time_elapsed=result.time_elapsed,
+                samples_per_sec=result.samples_per_sec,
             )
-
-        if val["loss"] < result.best_val_loss:
-            result.best_val_loss = val["loss"]
-        should_stop = stopper.update(val["loss"])
-        if ckpt is not None and stopper.improved:
-            ckpt.maybe_save(epoch, state.params, val["loss"])
-        if (
-            run_ckpt is not None
-            and config.save_every
-            and epoch % config.save_every == 0
-        ):
-            run_ckpt.save(
-                epoch,
-                state,
-                {
-                    "epoch": epoch,
-                    "stopper_best": stopper.best,
-                    "stopper_bad_epochs": stopper.bad_epochs,
-                    "best_val_loss": result.best_val_loss,
-                },
-            )
-        result.epochs_ran = epoch
-        if should_stop:
-            break
-
-    result.time_elapsed = time.time() - t0
-    result.samples_per_sec = samples_seen / max(result.time_elapsed, 1e-9)
-    result.state = state
-    if ckpt is not None:
-        ckpt.close()
-    if run_ckpt is not None:
-        run_ckpt.close()
-    if mlog is not None:
-        mlog.write(
-            "fit_done",
-            model=config.model_name,
-            epochs=result.epochs_ran,
-            best_val_loss=result.best_val_loss,
-            time_elapsed=result.time_elapsed,
-            samples_per_sec=result.samples_per_sec,
-        )
-        mlog.close()
+    finally:
+        # Always drain + commit in-flight ASYNC checkpoint writes —
+        # an exception mid-epoch must not lose a save the loop
+        # already reported (close() waits before releasing).
+        if ckpt is not None:
+            ckpt.close()
+        if run_ckpt is not None:
+            run_ckpt.close()
+        if mlog is not None:
+            mlog.close()
     return result
 
 

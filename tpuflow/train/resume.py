@@ -30,12 +30,19 @@ class RunCheckpointer:
     fault-tolerance artifact, keeping the latest few full states.
     """
 
-    def __init__(self, storage_path: str, name: str = "model", keep: int = 2):
+    def __init__(
+        self,
+        storage_path: str,
+        name: str = "model",
+        keep: int = 2,
+        async_save: bool = True,
+    ):
         self.directory = join_path(storage_path, "runs", name)
+        self._async = async_save
         self._mngr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=keep, enable_async_checkpointing=False
+                max_to_keep=keep, enable_async_checkpointing=async_save
             ),
         )
 
@@ -44,7 +51,8 @@ class RunCheckpointer:
 
         ``loop`` must be JSON-serializable (epoch, early-stop counters,
         best val loss, ...). ``apply_fn``/``tx`` are code, not state — they
-        are reconstructed by the caller on restore.
+        are reconstructed by the caller on restore. With async_save the
+        write overlaps the next epoch's compute; read paths wait.
         """
         tree = {"params": state.params, "opt_state": state.opt_state,
                 "step": state.step}
@@ -55,10 +63,12 @@ class RunCheckpointer:
                 loop=ocp.args.JsonSave(loop),
             ),
         )
-        self._mngr.wait_until_finished()
+        if not self._async:
+            self._mngr.wait_until_finished()
 
     @property
     def latest_epoch(self) -> int | None:
+        self._mngr.wait_until_finished()
         return self._mngr.latest_step()
 
     def restore(self, state_template: Any) -> tuple[Any, dict] | None:
@@ -66,6 +76,7 @@ class RunCheckpointer:
 
         Returns (state, loop_metadata), or None if no checkpoint exists.
         """
+        self._mngr.wait_until_finished()
         epoch = self._mngr.latest_step()
         if epoch is None:
             return None
