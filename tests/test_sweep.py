@@ -1,0 +1,87 @@
+"""Hyperparameter sweep API: grid application, ranking, error isolation."""
+
+import numpy as np
+import pytest
+
+from tpuflow.api import TrainJobConfig
+from tpuflow.api.sweep import SweepReport, SweepResult, _apply, sweep
+
+
+class TestApply:
+    def test_plain_and_dotted_fields(self):
+        base = TrainJobConfig(model="lstm", model_kwargs={"num_layers": 2})
+        cfg = _apply(
+            base, {"batch_size": 64, "model_kwargs.hidden": 32}
+        )
+        assert cfg.batch_size == 64
+        assert cfg.model_kwargs == {"num_layers": 2, "hidden": 32}
+        # base untouched (dataclasses.replace + dict merge)
+        assert base.model_kwargs == {"num_layers": 2}
+
+    def test_unknown_fields_rejected(self):
+        with pytest.raises(ValueError, match="unknown sweep field"):
+            _apply(TrainJobConfig(), {"batchsize": 64})
+        with pytest.raises(ValueError, match="unknown sweep field"):
+            _apply(TrainJobConfig(), {"nested.thing": 1})
+
+
+class TestSweep:
+    def test_grid_trains_and_ranks(self):
+        base = TrainJobConfig(
+            model="static_mlp",
+            max_epochs=2,
+            batch_size=32,
+            verbose=False,
+            n_devices=1,
+            synthetic_wells=4,
+            synthetic_steps=64,
+        )
+        report = sweep(
+            {"model_kwargs.hidden": [(8,), (16, 16)], "seed": [0]}, base
+        )
+        assert len(report.results) == 2
+        assert all(r.error is None for r in report.results)
+        ranked = report.ranked
+        assert ranked[0].test_mae <= ranked[-1].test_mae
+        assert np.isfinite(report.best.test_mae)
+        assert "test MAE" in report.table()
+
+    def test_failing_point_recorded_not_fatal(self):
+        base = TrainJobConfig(
+            model="static_mlp",
+            max_epochs=1,
+            batch_size=32,
+            verbose=False,
+            n_devices=1,
+            synthetic_wells=4,
+            synthetic_steps=64,
+        )
+        report = sweep({"loss": ["mae", "not_a_loss"]}, base)
+        ok = [r for r in report.results if r.error is None]
+        bad = [r for r in report.results if r.error is not None]
+        assert len(ok) == 1 and len(bad) == 1
+        assert "FAILED" in report.table()
+        assert report.best.assignment == {"loss": "mae"}
+
+
+class TestReportEdgeCases:
+    def test_typo_axis_raises_before_training(self):
+        with pytest.raises(ValueError, match="unknown sweep field"):
+            sweep({"batchsize": [32, 64]}, TrainJobConfig())
+
+    def test_nan_mae_excluded_from_ranking(self):
+        rep = SweepReport(
+            results=[
+                SweepResult({"a": 1}, float("nan"), 0.1, None, 5, 1.0),
+                SweepResult({"a": 2}, 123.0, 0.1, None, 5, 1.0),
+            ]
+        )
+        assert [r.assignment for r in rep.ranked] == [{"a": 2}]
+        assert rep.best.test_mae == 123.0
+
+    def test_plain_and_dotted_same_dict_compose(self):
+        cfg = _apply(
+            TrainJobConfig(),
+            {"model_kwargs": {"hidden": 8}, "model_kwargs.num_layers": 2},
+        )
+        assert cfg.model_kwargs == {"hidden": 8, "num_layers": 2}

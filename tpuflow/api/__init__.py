@@ -11,3 +11,4 @@ from tpuflow.api.config import TrainJobConfig  # noqa: F401
 from tpuflow.api.train_api import TrainReport, train  # noqa: F401
 from tpuflow.api.predict_api import Predictor, predict  # noqa: F401
 from tpuflow.api.compare import ComparisonReport, compare  # noqa: F401
+from tpuflow.api.sweep import SweepReport, sweep  # noqa: F401

@@ -29,6 +29,34 @@ DEFAULT_MODELS = (
 )
 
 
+class RankedByMAE:
+    """Shared ranking machinery for experiment reports (compare/sweep).
+
+    Ranks successful results by held-out MAE ascending. A result whose MAE
+    is NaN (a diverged run that didn't raise) is excluded like a failure —
+    NaN keys would make the sort order arbitrary and could crown a
+    diverged run ``best``.
+    """
+
+    @property
+    def ranked(self):
+        import math
+
+        ok = [
+            r
+            for r in self.results
+            if r.error is None and not math.isnan(r.test_mae)
+        ]
+        return sorted(ok, key=lambda r: r.test_mae)
+
+    @property
+    def best(self):
+        ranked = self.ranked
+        if not ranked:
+            raise RuntimeError("nothing trained successfully")
+        return ranked[0]
+
+
 @dataclass
 class ModelResult:
     model: str
@@ -43,20 +71,8 @@ class ModelResult:
 
 
 @dataclass
-class ComparisonReport:
+class ComparisonReport(RankedByMAE):
     results: list[ModelResult] = field(default_factory=list)
-
-    @property
-    def ranked(self) -> list[ModelResult]:
-        ok = [r for r in self.results if r.error is None]
-        return sorted(ok, key=lambda r: r.test_mae)
-
-    @property
-    def best(self) -> ModelResult:
-        ranked = self.ranked
-        if not ranked:
-            raise RuntimeError("no model trained successfully")
-        return ranked[0]
 
     def table(self) -> str:
         """The per-model report the reference printed ad hoc, as one table."""
