@@ -182,17 +182,13 @@ class TestStreamingTrain:
         assert report.result.epochs_ran == 3
         assert report.gilbert_mae is not None  # physical baseline computed
 
-    def test_stream_requires_data_path_and_tabular(self):
+    def test_stream_requires_data_path(self):
         from tpuflow.api import TrainJobConfig, train
 
         with pytest.raises(ValueError, match="needs data_path"):
             train(TrainJobConfig(model="static_mlp", stream=True, verbose=False))
-        with pytest.raises(ValueError, match="tabular"):
-            train(
-                TrainJobConfig(
-                    model="lstm", stream=True, data_path="x.csv", verbose=False
-                )
-            )
+        # Streaming SEQUENCE ingest exists too, but needs a well column
+        # (covered in tests/test_stream_windows.py).
 
     def test_stream_jit_epoch_rejected(self, big_csv):
         from tpuflow.api import TrainJobConfig, train

@@ -1,0 +1,215 @@
+"""Out-of-core windowed ingest: well-hash splits, chunk-carry windowing,
+and end-to-end streaming sequence training."""
+
+import numpy as np
+import pytest
+
+from tpuflow.data.schema import Schema
+from tpuflow.data.stream_windows import (
+    _WellWindower,
+    fit_window_normalizer,
+    iter_windows,
+    materialize_window_split,
+    stream_window_batches,
+    well_split,
+)
+from tpuflow.data.synthetic import generate_wells
+from tpuflow.data.windows import teacher_forcing_pairs
+
+NAMES = "well,pressure,choke,glr,temperature,water_cut,flow"
+TYPES = "string,float,float,float,float,float,float"
+SCHEMA = Schema.from_cli(NAMES, TYPES, "flow")
+FEATURES = ("pressure", "choke", "glr", "temperature", "water_cut")
+
+
+def _write_multiwell_csv(tmp_path, n_wells=12, steps=60, interleave=False):
+    """Headerless CSV of n_wells logs; optionally row-interleaved so wells
+    span chunks non-contiguously (time order preserved per well)."""
+    wells = generate_wells(n_wells, steps, seed=0)
+    rows = []
+    for w_i, w in enumerate(wells):
+        for t in range(steps):
+            rows.append(
+                (f"well{w_i:02d}", w.pressure[t], w.choke[t], w.glr[t],
+                 w.temperature[t], w.water_cut[t], w.flow[t])
+            )
+    if interleave:  # round-robin across wells, per-well time order kept
+        rows = [
+            rows[w * steps + t]
+            for t in range(steps)
+            for w in range(n_wells)
+        ]
+    path = str(tmp_path / "mw.csv")
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(",".join(str(v) for v in r) + "\n")
+    return path, wells
+
+
+class TestWellSplit:
+    def test_deterministic_and_covers_all_splits(self):
+        a = [well_split(f"w{i}", seed=0) for i in range(300)]
+        b = [well_split(f"w{i}", seed=0) for i in range(300)]
+        assert a == b
+        fracs = [a.count(k) / len(a) for k in range(3)]
+        assert abs(fracs[0] - 0.64) < 0.1
+        assert abs(fracs[1] - 0.16) < 0.08
+        assert abs(fracs[2] - 0.20) < 0.08
+
+    def test_seed_changes_assignment(self):
+        a = [well_split(f"w{i}", seed=0) for i in range(100)]
+        b = [well_split(f"w{i}", seed=1) for i in range(100)]
+        assert a != b
+
+
+class TestWellWindower:
+    @pytest.mark.parametrize("stride", [1, 2, 3])
+    @pytest.mark.parametrize("chunk", [3, 7, 100])
+    def test_chunked_feed_matches_whole_series(self, stride, chunk):
+        rng = np.random.default_rng(0)
+        T, F, window = 41, 2, 5
+        series = rng.standard_normal((T, F)).astype(np.float32)
+        target = rng.standard_normal(T).astype(np.float32)
+        want_x, want_y = teacher_forcing_pairs(series, target, window, stride)
+
+        w = _WellWindower(window, stride)
+        xs, ys = [], []
+        for s in range(0, T, chunk):
+            out = w.feed("w", series[s : s + chunk], target[s : s + chunk])
+            if out is not None:
+                xs.append(out[0])
+                ys.append(out[1])
+        got_x = np.concatenate(xs) if xs else np.zeros((0, window, F))
+        got_y = np.concatenate(ys) if ys else np.zeros((0, window))
+        np.testing.assert_allclose(got_x, want_x, rtol=1e-6)
+        np.testing.assert_allclose(got_y, want_y, rtol=1e-6)
+
+
+class TestIterWindows:
+    @pytest.mark.parametrize("interleave", [False, True])
+    @pytest.mark.parametrize("chunk_rows", [37, 10_000])
+    def test_union_of_splits_is_all_windows(self, tmp_path, interleave, chunk_rows):
+        path, wells = _write_multiwell_csv(tmp_path, interleave=interleave)
+        window = 8
+        got = {
+            w: sum(
+                x.shape[0]
+                for x, _ in iter_windows(
+                    path, SCHEMA, "well", FEATURES, w, 0, window,
+                    chunk_rows=chunk_rows,
+                )
+            )
+            for w in ("train", "val", "test")
+        }
+        per_well = 60 - window + 1
+        assert sum(got.values()) == len(wells) * per_well
+        # Every well's window count is a multiple of per_well: a well never
+        # splits its windows across train/val/test.
+        assert all(v % per_well == 0 for v in got.values())
+
+    def test_chunk_size_invariance(self, tmp_path):
+        path, _ = _write_multiwell_csv(tmp_path)
+        a = np.concatenate(
+            [x for x, _ in iter_windows(path, SCHEMA, "well", FEATURES,
+                                        "train", 0, 8, chunk_rows=53)]
+        )
+        b = np.concatenate(
+            [x for x, _ in iter_windows(path, SCHEMA, "well", FEATURES,
+                                        "train", 0, 8, chunk_rows=9999)]
+        )
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+class TestStreamingBatchesAndEval:
+    def test_fixed_batch_shapes_and_normalization(self, tmp_path):
+        path, _ = _write_multiwell_csv(tmp_path)
+        norm = fit_window_normalizer(
+            path, SCHEMA, "well", seed=0, window=8, sample_rows=2000
+        )
+        bs = list(
+            stream_window_batches(
+                path, SCHEMA, "well", norm, batch_size=16, seed=0, window=8,
+                chunk_rows=100, shuffle_buffer=32,
+            )
+        )
+        assert bs and all(x.shape == (16, 8, len(FEATURES)) for x, _ in bs)
+        assert all(y.shape == (16, 8) for _, y in bs)
+        # Standardized: overall magnitudes are O(1).
+        allx = np.concatenate([x for x, _ in bs])
+        assert abs(float(allx.mean())) < 1.0
+
+    def test_materialize_caps_and_returns_raw(self, tmp_path):
+        path, _ = _write_multiwell_csv(tmp_path)
+        norm = fit_window_normalizer(
+            path, SCHEMA, "well", seed=0, window=8, sample_rows=2000
+        )
+        xn, yn, xr, yr = materialize_window_split(
+            path, SCHEMA, "well", norm, "test", seed=0, window=8,
+            max_windows=20,
+        )
+        assert len(xn) == len(yn) == len(xr) == len(yr) <= 20
+        np.testing.assert_allclose(norm.normalize(xr), xn, rtol=1e-6)
+
+
+class TestStreamingSequenceTrain:
+    def test_streaming_lstm_end_to_end(self, tmp_path):
+        from tpuflow.api import TrainJobConfig, train
+
+        path, _ = _write_multiwell_csv(tmp_path, n_wells=14, steps=60)
+        report = train(
+            TrainJobConfig(
+                column_names=NAMES,
+                column_types=TYPES,
+                target="flow",
+                data_path=path,
+                well_column="well",
+                model="lstm",
+                model_kwargs={"hidden": 8},
+                window=8,
+                max_epochs=3,
+                batch_size=16,
+                verbose=False,
+                n_devices=1,
+                stream=True,
+                stream_chunk_rows=100,
+                stream_shuffle_buffer=32,
+                stream_sample_rows=2000,
+                stream_eval_rows=500,
+            )
+        )
+        assert np.isfinite(report.test_loss)
+        assert report.result.epochs_ran == 3
+        assert report.gilbert_mae is not None
+
+    def test_streaming_sequence_requires_well_column(self):
+        from tpuflow.api import TrainJobConfig, train
+
+        with pytest.raises(ValueError, match="well_column"):
+            train(
+                TrainJobConfig(
+                    model="lstm", stream=True, data_path="x.csv",
+                    verbose=False,
+                )
+            )
+
+
+class TestMultiSplitMaterialization:
+    def test_one_pass_matches_per_split(self, tmp_path):
+        from tpuflow.data.stream_windows import materialize_window_splits
+
+        path, _ = _write_multiwell_csv(tmp_path)
+        norm = fit_window_normalizer(
+            path, SCHEMA, "well", seed=0, window=8, sample_rows=2000
+        )
+        both = materialize_window_splits(
+            path, SCHEMA, "well", norm, ("val", "test"), seed=0, window=8,
+            raw_for=("test",),
+        )
+        for which in ("val", "test"):
+            single = materialize_window_split(
+                path, SCHEMA, "well", norm, which, seed=0, window=8
+            )
+            np.testing.assert_allclose(both[which][0], single[0], rtol=1e-6)
+        # Raw arrays only kept where requested.
+        assert both["val"][2] is None and both["val"][3] is None
+        assert both["test"][2] is not None

@@ -11,6 +11,7 @@ one ranked report.
 from __future__ import annotations
 
 import dataclasses
+import math
 from dataclasses import dataclass, field
 
 import jax
@@ -40,8 +41,6 @@ class RankedByMAE:
 
     @property
     def ranked(self):
-        import math
-
         ok = [
             r
             for r in self.results
@@ -94,6 +93,9 @@ class ComparisonReport(RankedByMAE):
         for r in self.results:
             if r.error is not None:
                 lines.append(f"{r.model:<16} FAILED: {r.error}")
+            elif math.isnan(r.test_mae):
+                # Excluded from the ranking but must not vanish silently.
+                lines.append(f"{r.model:<16} DIVERGED (NaN MAE)")
         return "\n".join(lines)
 
 

@@ -80,10 +80,14 @@ class SweepReport(RankedByMAE):
                 f"{desc:<48} {r.test_mae:>12.2f} {r.epochs_ran:>7} "
                 f"{r.time_elapsed:>7.1f}s"
             )
+        import math
+
         for r in self.results:
+            desc = ", ".join(f"{k}={v}" for k, v in r.assignment.items())
             if r.error is not None:
-                desc = ", ".join(f"{k}={v}" for k, v in r.assignment.items())
                 lines.append(f"{desc:<48} FAILED: {r.error}")
+            elif math.isnan(r.test_mae):
+                lines.append(f"{desc:<48} DIVERGED (NaN MAE)")
         return "\n".join(lines)
 
 

@@ -105,10 +105,18 @@ def train(config: TrainJobConfig) -> TrainReport:
     # --- ingest + features (L1/L2) ---
     gilbert_test = None
     if config.stream and config.is_sequence_model:
-        raise ValueError(
-            "stream=True supports the tabular family; sequence models "
-            "window per-well and need materialized logs"
-        )
+        if config.data_path is None:
+            raise ValueError("stream=True needs data_path (nothing to stream)")
+        if config.well_column is None:
+            raise ValueError(
+                "streaming sequence ingest splits train/val/test by WELL "
+                "(windows must not straddle splits); pass well_column"
+            )
+        if config.model == "lstm_residual":
+            raise ValueError(
+                "stream=True does not support lstm_residual (the Gilbert "
+                "channel is appended by the materialized windowed pipeline)"
+            )
     if config.stream and config.jit_epoch:
         # Rejected here, before any file scans: fit() would also raise,
         # but only after the (possibly hours-long) eval materialization.
@@ -117,7 +125,87 @@ def train(config: TrainJobConfig) -> TrainReport:
             "defeat the bounded-memory stream; use per-batch stepping for "
             "streaming runs"
         )
-    if config.is_sequence_model:
+    if config.is_sequence_model and config.stream:
+        # Out-of-core WINDOWED ingest: split by well, window per well with
+        # chunk carry-over, stats from a head sample (stream_windows.py).
+        from types import SimpleNamespace
+
+        from tpuflow.data.pipeline import ArrayDataset
+        from tpuflow.data.stream_windows import (
+            fit_window_normalizer,
+            materialize_window_splits,
+            stream_window_batches,
+        )
+        from tpuflow.train import StreamingSource
+
+        norm = fit_window_normalizer(
+            config.data_path,
+            schema,
+            config.well_column,
+            seed=config.seed,
+            window=config.window,
+            stride=config.stride,
+            sample_rows=config.stream_sample_rows,
+            chunk_rows=config.stream_chunk_rows,
+        )
+
+        def _tf(y):  # teacher-forced [N, T] vs last-step [N] targets
+            return y if config.teacher_forcing else y[:, -1]
+
+        # One file scan serves both eval splits; raw copies (for the
+        # physical baseline) are kept for test only and dropped below —
+        # nothing un-normalized survives into the training phase.
+        evals = materialize_window_splits(
+            config.data_path, schema, config.well_column, norm,
+            ("val", "test"), seed=config.seed, window=config.window,
+            stride=config.stride, max_windows=config.stream_eval_rows,
+            chunk_rows=config.stream_chunk_rows, raw_for=("test",),
+        )
+        val_ds = ArrayDataset(evals["val"][0], _tf(evals["val"][1]))
+        test_ds = ArrayDataset(evals["test"][0], _tf(evals["test"][1]))
+        _, _, tex_raw, tey_raw = evals["test"]
+        del evals
+        names = norm.feature_names
+        if {"pressure", "choke", "glr"} <= set(names):
+            ip, ic, ig = (
+                names.index("pressure"),
+                names.index("choke"),
+                names.index("glr"),
+            )
+            gilbert_test = _gilbert_mae(
+                tex_raw[:, -1, ip], tex_raw[:, -1, ic], tex_raw[:, -1, ig],
+                tey_raw[:, -1],
+            )
+        del tex_raw, tey_raw
+
+        def _train_stream(epoch):
+            for x, y in stream_window_batches(
+                config.data_path,
+                schema,
+                config.well_column,
+                norm,
+                config.batch_size,
+                seed=config.seed,
+                window=config.window,
+                stride=config.stride,
+                chunk_rows=config.stream_chunk_rows,
+                shuffle_buffer=config.stream_shuffle_buffer,
+                shuffle_seed=config.seed + epoch,
+                split="train",
+            ):
+                yield x, _tf(y)
+
+        train_ds = StreamingSource(_train_stream)
+        target_std = norm.target_std
+        seq_physics = False  # lstm_residual rejected for streams above
+        splits = SimpleNamespace(  # the serving sidecar reads these
+            feature_names=norm.feature_names,
+            norm_mean=norm.mean,
+            norm_std=norm.std,
+            target_mean=norm.target_mean,
+            target_std=norm.target_std,
+        )
+    elif config.is_sequence_model:
         seq_physics = config.model == "lstm_residual"
         if config.data_path is not None:
             columns = read_csv(config.data_path, schema)

@@ -1,0 +1,342 @@
+"""Out-of-core ingest for the SEQUENCE family: stream windows, not rows.
+
+The tabular streaming path (``tpuflow.data.stream``) splits by ROW; a
+sequence model cannot — a window must come from one well's contiguous
+log, and train/val/test must not share a well (windows from the same well
+are heavily correlated). This module streams multi-well CSVs at bounded
+memory with the right invariants:
+
+- **split by WELL**: each well id hashes to train/val/test with the
+  64/16/20 fractions (deterministic, chunking-invariant) — no window ever
+  straddles a split, no well leaks across splits;
+- **per-well carry**: rows are grouped by the well column per chunk; each
+  well's trailing ``window-1`` rows carry over to the next chunk, so
+  windows crossing chunk boundaries are emitted exactly once. Memory is
+  O(active wells × window), not file size;
+- **stats from a head sample**: channel mean/std and target mean/std come
+  from the first ``sample_rows`` train-split rows (the streaming analog of
+  fit-on-train), held in a ``WindowNormalizer`` that also serves as the
+  serving-sidecar state.
+
+Rows must be time-ordered within each well (the same contract as the
+materialized ``prepare_windowed_table``); wells may interleave freely.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Iterator
+
+import numpy as np
+
+from tpuflow.data.schema import Schema
+from tpuflow.data.stream import SPLIT_FRACTIONS, stream_csv_columns
+
+_SPLITS = ("train", "val", "test")
+
+
+def well_split(well_id, seed: int, fractions=SPLIT_FRACTIONS) -> int:
+    """Deterministic split id (0=train, 1=val, 2=test) for one well.
+
+    Hash of (str(well_id), seed) — stable across runs, processes, and
+    chunk sizes (Python's builtin hash is salted per process; blake2b is
+    not).
+    """
+    digest = hashlib.blake2b(
+        f"{well_id}\x00{seed}".encode(), digest_size=8
+    ).digest()
+    u = int.from_bytes(digest, "big") / float(1 << 64)
+    bounds = np.cumsum(fractions)
+    return int(np.digitize(u, bounds[:-1]))
+
+
+@dataclass
+class WindowNormalizer:
+    """Per-channel and target standardization stats for windowed streams —
+    fit on a head sample of train wells; doubles as the serving sidecar
+    state (same fields the materialized ``WindowedSplits`` carries)."""
+
+    feature_names: tuple
+    mean: np.ndarray
+    std: np.ndarray
+    target_mean: float
+    target_std: float
+
+    def normalize(self, windows: np.ndarray) -> np.ndarray:
+        return ((windows - self.mean) / self.std).astype(np.float32)
+
+    def normalize_target(self, y: np.ndarray) -> np.ndarray:
+        return ((y - self.target_mean) / self.target_std).astype(np.float32)
+
+
+def _series_of(columns, feature_names) -> np.ndarray:
+    return np.stack(
+        [np.asarray(columns[n], np.float32) for n in feature_names], axis=1
+    )
+
+
+class _WellWindower:
+    """Per-well carry buffers → teacher-forced windows, across chunks."""
+
+    def __init__(self, window: int, stride: int):
+        self.window = window
+        self.stride = stride
+        # well id -> (feature rows carry, target rows carry, next emit offset)
+        self._carry: dict = {}
+
+    def feed(self, well, series: np.ndarray, target: np.ndarray):
+        """Append one well's new rows; return the newly-complete windows."""
+        prev_s, prev_t, offset = self._carry.get(
+            well, (np.zeros((0, series.shape[1]), np.float32),
+                   np.zeros((0,), np.float32), 0)
+        )
+        s = np.concatenate([prev_s, series])
+        t = np.concatenate([prev_t, target])
+        if len(s) < self.window:
+            # Preserve the emit offset (can be > 0 with stride > 1).
+            self._carry[well] = (s, t, offset)
+            return None
+        # Windows starting at offset, offset+stride, ... within this buffer.
+        starts = np.arange(offset, len(s) - self.window + 1, self.stride)
+        if len(starts):
+            x = np.stack([s[i : i + self.window] for i in starts])
+            y = np.stack([t[i : i + self.window] for i in starts])
+            next_start = starts[-1] + self.stride
+        else:
+            x = y = None
+            next_start = offset
+        # Keep only the tail that future windows can still reach.
+        keep_from = min(next_start, len(s) - self.window + 1)
+        keep_from = max(keep_from, 0)
+        self._carry[well] = (s[keep_from:], t[keep_from:], next_start - keep_from)
+        return (x, y) if x is not None else None
+
+
+def _iter_split_windows(
+    path: str,
+    schema: Schema,
+    well_column: str,
+    feature_names: tuple,
+    seed: int,
+    window: int,
+    stride: int = 1,
+    chunk_rows: int = 65536,
+    wanted: frozenset | None = None,
+) -> Iterator[tuple[int, int, np.ndarray, np.ndarray]]:
+    """Yield (split_id, n_source_rows, x, y) for every well's windows in
+    ONE file scan — the single engine under ``iter_windows`` and the
+    multi-split materializer. ``wanted`` restricts which splits are even
+    windowed (others are skipped without buffering).
+    """
+    windower = _WellWindower(window, stride)
+    target_col = schema.target
+    split_cache: dict = {}
+    for columns in stream_csv_columns(path, schema, chunk_rows):
+        ids = np.asarray(columns[well_column])
+        series_all = _series_of(columns, feature_names)
+        target_all = np.asarray(columns[target_col], np.float32)
+        uniq, first_idx, inverse, counts = np.unique(
+            ids, return_index=True, return_inverse=True, return_counts=True
+        )
+        clustered = np.argsort(inverse, kind="stable")
+        slices = np.split(clustered, np.cumsum(counts)[:-1])
+        for i in np.argsort(first_idx):  # first-appearance order
+            well = uniq[i]
+            sid = split_cache.get(well)
+            if sid is None:
+                sid = split_cache[well] = well_split(well, seed)
+            if wanted is not None and sid not in wanted:
+                continue
+            rows = slices[i]
+            out = windower.feed(well, series_all[rows], target_all[rows])
+            if out is not None:
+                yield sid, len(rows), out[0], out[1]
+
+
+def iter_windows(
+    path: str,
+    schema: Schema,
+    well_column: str,
+    feature_names: tuple,
+    split: str,
+    seed: int,
+    window: int,
+    stride: int = 1,
+    chunk_rows: int = 65536,
+) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Yield RAW (un-normalized) teacher-forced windows of one split.
+
+    Streams the CSV once; memory is bounded by chunk size plus the
+    per-well carry buffers.
+    """
+    want = _SPLITS.index(split)
+    for sid, _, x, y in _iter_split_windows(
+        path, schema, well_column, feature_names, seed, window, stride,
+        chunk_rows, wanted=frozenset((want,)),
+    ):
+        yield x, y
+
+
+def fit_window_normalizer(
+    path: str,
+    schema: Schema,
+    well_column: str,
+    seed: int,
+    window: int,
+    stride: int = 1,
+    sample_rows: int = 100_000,
+    chunk_rows: int = 65536,
+) -> WindowNormalizer:
+    """Fit channel/target stats on the head sample's TRAIN-well windows."""
+    feature_names = tuple(
+        c.name for c in schema.continuous_features if c.name != well_column
+    )
+    if not feature_names:
+        raise ValueError("no continuous feature columns for sequence model")
+    xs, ys, got = [], [], 0
+    for _, n_rows, x, y in _iter_split_windows(
+        path, schema, well_column, feature_names, seed, window, stride,
+        chunk_rows, wanted=frozenset((0,)),  # train wells only
+    ):
+        xs.append(x)
+        ys.append(y)
+        # Count SOURCE rows consumed, not overlapping window elements, so
+        # sample_rows means the same thing here as on the tabular path.
+        got += n_rows
+        if got >= sample_rows:
+            break
+    if not xs:
+        raise ValueError(
+            f"{path}: no full {window}-step train-well windows in the sample"
+        )
+    x = np.concatenate(xs)
+    y = np.concatenate(ys)
+    flat = x.reshape(-1, x.shape[-1])
+    mean = flat.mean(axis=0)
+    std = flat.std(axis=0)
+    std = np.where(std < 1e-8, 1.0, std).astype(np.float32)
+    t_mean = float(y.mean())
+    t_std = float(y.std()) or 1.0
+    return WindowNormalizer(
+        feature_names, mean.astype(np.float32), std, t_mean, t_std
+    )
+
+
+def stream_window_batches(
+    path: str,
+    schema: Schema,
+    well_column: str,
+    norm: WindowNormalizer,
+    batch_size: int,
+    seed: int,
+    window: int,
+    stride: int = 1,
+    chunk_rows: int = 65536,
+    shuffle_buffer: int = 0,
+    shuffle_seed: int = 0,
+    split: str = "train",
+) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Fixed-size NORMALIZED (x, y) window batches of one split.
+
+    ``shuffle_buffer > 0`` decorrelates windows through a bounded windowed
+    shuffle (same scheme as the tabular stream); batches always have
+    exactly ``batch_size`` windows (drop-remainder — one XLA shape).
+    """
+    rng = np.random.default_rng(shuffle_seed) if shuffle_buffer else None
+    x_rem = y_rem = None
+    for x, y in iter_windows(
+        path, schema, well_column, norm.feature_names, split, seed, window,
+        stride, chunk_rows,
+    ):
+        x = norm.normalize(x)
+        y = norm.normalize_target(y)
+        if x_rem is not None:
+            x = np.concatenate([x_rem, x])
+            y = np.concatenate([y_rem, y])
+        if rng is not None:
+            perm = rng.permutation(len(x))
+            x, y = x[perm], y[perm]
+            hold = min(len(x), shuffle_buffer)
+        else:
+            hold = 0
+        n_full = max(len(x) - hold, 0) // batch_size * batch_size
+        for s in range(0, n_full, batch_size):
+            yield x[s : s + batch_size], y[s : s + batch_size]
+        x_rem, y_rem = x[n_full:], y[n_full:]
+    if x_rem is not None and len(x_rem):
+        n_full = len(x_rem) // batch_size * batch_size
+        for s in range(0, n_full, batch_size):
+            yield x_rem[s : s + batch_size], y_rem[s : s + batch_size]
+
+
+def materialize_window_splits(
+    path: str,
+    schema: Schema,
+    well_column: str,
+    norm: WindowNormalizer,
+    whichs: tuple[str, ...],
+    seed: int,
+    window: int,
+    stride: int = 1,
+    max_windows: int = 50_000,
+    chunk_rows: int = 65536,
+    raw_for: tuple[str, ...] = (),
+) -> dict[str, tuple[np.ndarray, np.ndarray, np.ndarray | None, np.ndarray | None]]:
+    """Up to ``max_windows`` windows of EACH requested split in one file
+    scan: ``{which: (x_norm, y_norm, x_raw | None, y_raw | None)}``.
+
+    Bounded eval samples. Raw copies (for the Gilbert-baseline MAE) are
+    kept only for the splits in ``raw_for`` — don't retain hundreds of MB
+    of un-normalized windows on the bounded-memory path. Stops scanning
+    once every split hit its cap.
+    """
+    ids = {w: _SPLITS.index(w) for w in whichs}
+    by_id = {v: k for k, v in ids.items()}
+    acc = {w: {"xs": [], "ys": [], "got": 0} for w in whichs}
+    for sid, _, x, y in _iter_split_windows(
+        path, schema, well_column, norm.feature_names, seed, window, stride,
+        chunk_rows, wanted=frozenset(ids.values()),
+    ):
+        a = acc[by_id[sid]]
+        if a["got"] >= max_windows:
+            if all(v["got"] >= max_windows for v in acc.values()):
+                break
+            continue
+        take = min(len(x), max_windows - a["got"])
+        a["xs"].append(x[:take])
+        a["ys"].append(y[:take])
+        a["got"] += take
+    out = {}
+    for which, a in acc.items():
+        if not a["xs"]:
+            raise ValueError(f"{path}: split {which!r} has no full windows")
+        x_raw = np.concatenate(a["xs"])
+        y_raw = np.concatenate(a["ys"])
+        keep_raw = which in raw_for
+        out[which] = (
+            norm.normalize(x_raw),
+            norm.normalize_target(y_raw),
+            x_raw if keep_raw else None,
+            y_raw if keep_raw else None,
+        )
+    return out
+
+
+def materialize_window_split(
+    path: str,
+    schema: Schema,
+    well_column: str,
+    norm: WindowNormalizer,
+    split: str,
+    seed: int,
+    window: int,
+    stride: int = 1,
+    max_windows: int = 50_000,
+    chunk_rows: int = 65536,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """One-split convenience wrapper (raw copies included)."""
+    return materialize_window_splits(
+        path, schema, well_column, norm, (split,), seed, window, stride,
+        max_windows, chunk_rows, raw_for=(split,),
+    )[split]
