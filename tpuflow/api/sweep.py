@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import math
 from dataclasses import dataclass, field
 from typing import Any, Mapping, Sequence
 
@@ -80,8 +81,6 @@ class SweepReport(RankedByMAE):
                 f"{desc:<48} {r.test_mae:>12.2f} {r.epochs_ran:>7} "
                 f"{r.time_elapsed:>7.1f}s"
             )
-        import math
-
         for r in self.results:
             desc = ", ".join(f"{k}={v}" for k, v in r.assignment.items())
             if r.error is not None:

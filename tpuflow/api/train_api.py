@@ -84,6 +84,23 @@ def _gilbert_mae(pressure, choke, glr, y_raw) -> float:
     )
 
 
+def _gilbert_mae_last_step(names, raw_last, y_raw) -> float | None:
+    """Sequence-family baseline: Gilbert on each window's FINAL step.
+
+    ``raw_last [N, F]`` are the un-standardized final-step channels named
+    by ``names``; returns None when the physical channels are absent.
+    Shared by the materialized and streaming sequence branches.
+    """
+    if not {"pressure", "choke", "glr"} <= set(names):
+        return None
+    ip, ic, ig = (
+        names.index("pressure"),
+        names.index("choke"),
+        names.index("glr"),
+    )
+    return _gilbert_mae(raw_last[:, ip], raw_last[:, ic], raw_last[:, ig], y_raw)
+
+
 def _load_wells(config: TrainJobConfig) -> list[WellLog]:
     return generate_wells(
         n_wells=config.synthetic_wells,
@@ -128,7 +145,6 @@ def train(config: TrainJobConfig) -> TrainReport:
     if config.is_sequence_model and config.stream:
         # Out-of-core WINDOWED ingest: split by well, window per well with
         # chunk carry-over, stats from a head sample (stream_windows.py).
-        from types import SimpleNamespace
 
         from tpuflow.data.pipeline import ArrayDataset
         from tpuflow.data.stream_windows import (
@@ -165,17 +181,9 @@ def train(config: TrainJobConfig) -> TrainReport:
         test_ds = ArrayDataset(evals["test"][0], _tf(evals["test"][1]))
         _, _, tex_raw, tey_raw = evals["test"]
         del evals
-        names = norm.feature_names
-        if {"pressure", "choke", "glr"} <= set(names):
-            ip, ic, ig = (
-                names.index("pressure"),
-                names.index("choke"),
-                names.index("glr"),
-            )
-            gilbert_test = _gilbert_mae(
-                tex_raw[:, -1, ip], tex_raw[:, -1, ic], tex_raw[:, -1, ig],
-                tey_raw[:, -1],
-            )
+        gilbert_test = _gilbert_mae_last_step(
+            norm.feature_names, tex_raw[:, -1, :], tey_raw[:, -1]
+        )
         del tex_raw, tey_raw
 
         def _train_stream(epoch):
@@ -198,13 +206,7 @@ def train(config: TrainJobConfig) -> TrainReport:
         train_ds = StreamingSource(_train_stream)
         target_std = norm.target_std
         seq_physics = False  # lstm_residual rejected for streams above
-        splits = SimpleNamespace(  # the serving sidecar reads these
-            feature_names=norm.feature_names,
-            norm_mean=norm.mean,
-            norm_std=norm.std,
-            target_mean=norm.target_mean,
-            target_std=norm.target_std,
-        )
+        splits = norm  # WindowNormalizer carries the sidecar fields
     elif config.is_sequence_model:
         seq_physics = config.model == "lstm_residual"
         if config.data_path is not None:
@@ -230,22 +232,15 @@ def train(config: TrainJobConfig) -> TrainReport:
             )
         train_ds, val_ds, test_ds = splits.train, splits.val, splits.test
         target_std = splits.target_std
-        names = splits.feature_names
-        if {"pressure", "choke", "glr"} <= set(names):
-            # Physical baseline on the test windows' final step, from the
-            # UN-standardized channels against RAW-unit targets.
-            ip, ic, ig = (
-                names.index("pressure"),
-                names.index("choke"),
-                names.index("glr"),
-            )
-            raw_last = test_ds.x[:, -1, :] * splits.norm_std + splits.norm_mean
-            y_ref = splits.inverse_target(
-                test_ds.y[:, -1] if config.teacher_forcing else test_ds.y
-            )
-            gilbert_test = _gilbert_mae(
-                raw_last[:, ip], raw_last[:, ic], raw_last[:, ig], y_ref
-            )
+        # Physical baseline on the test windows' final step, from the
+        # UN-standardized channels against RAW-unit targets.
+        raw_last = test_ds.x[:, -1, :] * splits.norm_std + splits.norm_mean
+        y_ref = splits.inverse_target(
+            test_ds.y[:, -1] if config.teacher_forcing else test_ds.y
+        )
+        gilbert_test = _gilbert_mae_last_step(
+            splits.feature_names, raw_last, y_ref
+        )
     elif config.stream:
         # Out-of-core tabular ingest: the CSV is never materialized.
         if config.data_path is None:

@@ -100,6 +100,21 @@ class WindowedSplits:
 _SEQ_CHANNELS = ("pressure", "choke", "glr", "temperature", "water_cut")
 
 
+def sequence_feature_names(schema: Schema, well_column: str | None) -> tuple[str, ...]:
+    """The sequence-model feature channels: the schema's continuous feature
+    columns minus the well-grouping column, in schema order (the analog of
+    the reference's continuous selection, cnn.py:93). Single source for
+    the materialized and streaming windowed paths — their channel ORDER
+    must agree or a stream-trained sidecar would serve scrambled inputs.
+    """
+    names = tuple(
+        c.name for c in schema.continuous_features if c.name != well_column
+    )
+    if not names:
+        raise ValueError("no continuous feature columns for sequence model")
+    return names
+
+
 def prepare_windowed(
     wells: Sequence[WellLog],
     window: int = 24,
@@ -151,13 +166,7 @@ def prepare_windowed_table(
     order — the sequence-model analog of the reference's continuous
     selection (reference cnn.py:93).
     """
-    feature_names = tuple(
-        c.name
-        for c in schema.continuous_features
-        if c.name != well_column
-    )
-    if not feature_names:
-        raise ValueError("no continuous feature columns for sequence model")
+    feature_names = sequence_feature_names(schema, well_column)
     target = columns[schema.target].astype(np.float32)
     series_all = np.stack(
         [columns[n].astype(np.float32) for n in feature_names], axis=1

@@ -63,6 +63,17 @@ class WindowNormalizer:
     target_mean: float
     target_std: float
 
+    # WindowedSplits-compatible aliases: the serving-sidecar writer reads
+    # .norm_mean/.norm_std, so a normalizer can stand in for the
+    # materialized splits object directly.
+    @property
+    def norm_mean(self) -> np.ndarray:
+        return self.mean
+
+    @property
+    def norm_std(self) -> np.ndarray:
+        return self.std
+
     def normalize(self, windows: np.ndarray) -> np.ndarray:
         return ((windows - self.mean) / self.std).astype(np.float32)
 
@@ -134,22 +145,32 @@ def _iter_split_windows(
     split_cache: dict = {}
     for columns in stream_csv_columns(path, schema, chunk_rows):
         ids = np.asarray(columns[well_column])
-        series_all = _series_of(columns, feature_names)
-        target_all = np.asarray(columns[target_col], np.float32)
         uniq, first_idx, inverse, counts = np.unique(
             ids, return_index=True, return_inverse=True, return_counts=True
         )
-        clustered = np.argsort(inverse, kind="stable")
-        slices = np.split(clustered, np.cumsum(counts)[:-1])
+        kept_wells = []
         for i in np.argsort(first_idx):  # first-appearance order
             well = uniq[i]
             sid = split_cache.get(well)
             if sid is None:
                 sid = split_cache[well] = well_split(well, seed)
-            if wanted is not None and sid not in wanted:
-                continue
+            if wanted is None or sid in wanted:
+                kept_wells.append((i, well, sid))
+        if not kept_wells:
+            continue
+        # Convert only the kept wells' rows to float32 — a train-only scan
+        # would otherwise stack and convert the ~36% val/test rows it is
+        # about to discard (and an eval scan, the 64% train rows).
+        clustered = np.argsort(inverse, kind="stable")
+        slices = np.split(clustered, np.cumsum(counts)[:-1])
+        for i, well, sid in kept_wells:
             rows = slices[i]
-            out = windower.feed(well, series_all[rows], target_all[rows])
+            part = {k: v[rows] for k, v in columns.items()}
+            out = windower.feed(
+                well,
+                _series_of(part, feature_names),
+                np.asarray(part[target_col], np.float32),
+            )
             if out is not None:
                 yield sid, len(rows), out[0], out[1]
 
@@ -189,11 +210,9 @@ def fit_window_normalizer(
     chunk_rows: int = 65536,
 ) -> WindowNormalizer:
     """Fit channel/target stats on the head sample's TRAIN-well windows."""
-    feature_names = tuple(
-        c.name for c in schema.continuous_features if c.name != well_column
-    )
-    if not feature_names:
-        raise ValueError("no continuous feature columns for sequence model")
+    from tpuflow.data.pipeline import sequence_feature_names
+
+    feature_names = sequence_feature_names(schema, well_column)
     xs, ys, got = [], [], 0
     for _, n_rows, x, y in _iter_split_windows(
         path, schema, well_column, feature_names, seed, window, stride,
