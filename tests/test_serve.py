@@ -312,3 +312,99 @@ class TestPredictCacheInvalidation:
             assert key not in srv.predictor._cache
         finally:
             srv.shutdown()
+
+
+class TestExperimentJobs:
+    def _run_job(self, base, spec, timeout=240):
+        _, body = _post(base + "/jobs", spec)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            _, rec = _get(base + f"/jobs/{body['job_id']}")
+            if rec["status"] in ("done", "failed"):
+                return rec
+            time.sleep(0.4)
+        raise TimeoutError(rec)
+
+    def test_compare_job_over_http(self, server, tmp_path):
+        rec = self._run_job(
+            server,
+            {
+                "compare": ["static_mlp", "gilbert_residual"],
+                "epochs": 2,
+                "batchSize": 32,
+                "storagePath": str(tmp_path),
+                "n_devices": 1,
+                "synthetic_wells": 4,
+                "synthetic_steps": 64,
+            },
+        )
+        assert rec["status"] == "done", rec
+        ranked = rec["report"]["ranked"]
+        assert len(ranked) == 2
+        assert {r["model"] for r in ranked} == {"static_mlp", "gilbert_residual"}
+        assert "test MAE" in rec["report"]["table"]
+
+    def test_sweep_job_over_http(self, server, tmp_path):
+        rec = self._run_job(
+            server,
+            {
+                "sweep": {"model_kwargs.hidden": [[8], [16, 16]]},
+                "model": "static_mlp",
+                "epochs": 2,
+                "batchSize": 32,
+                "n_devices": 1,
+                "synthetic_wells": 4,
+                "synthetic_steps": 64,
+            },
+        )
+        assert rec["status"] == "done", rec
+        assert len(rec["report"]["ranked"]) == 2
+
+    def test_bad_experiment_specs_400(self, server):
+        status, body = _post(
+            server + "/jobs", {"compare": ["a"], "sweep": {"x": [1]}}
+        )
+        assert status == 400 and "not both" in body["error"]
+        status, body = _post(server + "/jobs", {"compare": []})
+        assert status == 400
+        status, body = _post(server + "/jobs", {"sweep": {"typo_axis": [1]}})
+        assert status == 400 and "unknown sweep field" in body["error"]
+
+
+class TestExperimentJobValidation:
+    def test_unknown_compare_model_400(self, server):
+        status, body = _post(server + "/jobs", {"compare": ["lsmt"]})
+        assert status == 400 and "unknown compare models" in body["error"]
+
+    def test_non_list_sweep_values_400(self, server):
+        status, body = _post(server + "/jobs", {"sweep": {"model": "lstm"}})
+        assert status == 400 and "non-empty list" in body["error"]
+
+    def test_compare_invalidates_every_compared_model(self, tmp_path):
+        """A compare job must evict cache entries for ALL models it
+        retrains, not just the base config's default model name."""
+        from tpuflow.serve import JobRunner
+
+        evicted = []
+        runner = JobRunner(
+            on_artifact_change=lambda s, m: evicted.append((s, m))
+        )
+        out = runner.submit(
+            {
+                "compare": ["static_mlp", "gilbert_residual"],
+                "epochs": 1,
+                "batchSize": 32,
+                "storagePath": str(tmp_path),
+                "n_devices": 1,
+                "synthetic_wells": 4,
+                "synthetic_steps": 64,
+            }
+        )
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            rec = runner.get(out["job_id"])
+            if rec["status"] in ("done", "failed"):
+                break
+            time.sleep(0.3)
+        assert rec["status"] == "done", rec
+        assert {m for _, m in evicted} == {"static_mlp", "gilbert_residual"}

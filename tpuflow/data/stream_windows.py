@@ -165,11 +165,16 @@ def _iter_split_windows(
         slices = np.split(clustered, np.cumsum(counts)[:-1])
         for i, well, sid in kept_wells:
             rows = slices[i]
-            part = {k: v[rows] for k, v in columns.items()}
+            # Slice only what the windower consumes — feature channels and
+            # the target — not the well ids / bookkeeping columns.
             out = windower.feed(
                 well,
-                _series_of(part, feature_names),
-                np.asarray(part[target_col], np.float32),
+                np.stack(
+                    [np.asarray(columns[n][rows], np.float32)
+                     for n in feature_names],
+                    axis=1,
+                ),
+                np.asarray(columns[target_col][rows], np.float32),
             )
             if out is not None:
                 yield sid, len(rows), out[0], out[1]

@@ -26,6 +26,14 @@ The spec accepts the reference's camelCase submission fields
 ``TrainJobConfig`` field. Jobs run ONE at a time on a background worker —
 the chip is a serial resource; queued jobs wait their turn.
 
+Two experiment job kinds ride the same queue (the reference's "tests ...
+using multiple model types" workflow, Readme.md:13, web-triggered):
+
+- ``{"compare": ["lstm", "static_mlp", ...], ...base fields}`` — train
+  each family on the same data/seed; the report carries the ranked table.
+- ``{"sweep": {"model_kwargs.hidden": [32, 64], ...}, ...base fields}``
+  — grid over config fields; the report carries the ranked assignments.
+
 On success the report is written to ``{storagePath}/models/{model}
 .report.json`` (URI-aware — gs:// works), completing the loop where the
 reference's web layer "reads artifact / reported loss".
@@ -115,12 +123,43 @@ class JobRunner:
         self._worker.start()
 
     def submit(self, spec: dict) -> dict:
-        config = spec_to_config(spec)  # validate before queueing
+        base = dict(spec)
+        compare_models = base.pop("compare", None)
+        sweep_grid = base.pop("sweep", None)
+        if compare_models is not None and sweep_grid is not None:
+            raise ValueError("a job is either 'compare' or 'sweep', not both")
+        config = spec_to_config(base)  # validate before queueing
+        if compare_models is not None:
+            if not isinstance(compare_models, list) or not compare_models:
+                raise ValueError("'compare' must be a non-empty list of models")
+            from tpuflow.models import MODELS
+
+            unknown = [m for m in compare_models if m not in MODELS]
+            if unknown:  # typos fail at submission, not as all-FAILED rows
+                raise ValueError(
+                    f"unknown compare models {unknown}; known: {sorted(MODELS)}"
+                )
+            kind = ("compare", tuple(compare_models))
+        elif sweep_grid is not None:
+            if not isinstance(sweep_grid, dict) or not sweep_grid:
+                raise ValueError("'sweep' must be a non-empty grid object")
+            from tpuflow.api.sweep import _validate_name
+
+            for name, values in sweep_grid.items():
+                _validate_name(name)  # typos fail at submission, not later
+                if not isinstance(values, list) or not values:
+                    # A bare string would be swept character-by-character.
+                    raise ValueError(
+                        f"sweep axis {name!r} must map to a non-empty list"
+                    )
+            kind = ("sweep", sweep_grid)
+        else:
+            kind = ("train", None)
         job_id = uuid.uuid4().hex[:12]
         record = {"job_id": job_id, "status": "queued", "spec": spec}
         with self._lock:
             self._jobs[job_id] = record
-        self._queue.put((job_id, config))
+        self._queue.put((job_id, kind, config))
         return {"job_id": job_id, "status": "queued"}
 
     def get(self, job_id: str) -> dict | None:
@@ -140,22 +179,19 @@ class JobRunner:
             self._jobs[job_id].update(updates)
 
     def _run(self):
-        from tpuflow.api import train
-
         while True:
-            job_id, config = self._queue.get()
+            job_id, kind, config = self._queue.get()
             self._set(job_id, status="running")
             try:
-                report = train(config)
-                rep = report_to_dict(report)
+                rep = self._execute(kind, config)
                 # Inside the try: a failed report write (unwritable dir,
                 # missing gs:// backend, ...) must fail THIS job, not kill
                 # the worker thread and silently wedge the whole queue.
                 if config.storage_path:
+                    report_name = f"{kind[0]}.{config.model}.report.json" \
+                        if kind[0] != "train" else f"{config.model}.report.json"
                     path = join_path(
-                        config.storage_path,
-                        "models",
-                        f"{config.model}.report.json",
+                        config.storage_path, "models", report_name
                     )
                     with open_file(path, "w", encoding="utf-8") as f:
                         json.dump(rep, f, indent=2)
@@ -164,20 +200,61 @@ class JobRunner:
                 # Evict BEFORE publishing the terminal status: a client
                 # that polls to completion and immediately predicts must
                 # never see the pre-retrain cache entry.
-                self._notify_artifact(config)
+                self._notify_artifact(config, kind)
                 self._set(
                     job_id,
                     status="failed",
                     error=f"{type(e).__name__}: {e}",
                 )
                 continue
-            self._notify_artifact(config)
+            self._notify_artifact(config, kind)
             self._set(job_id, status="done", report=rep)
 
-    def _notify_artifact(self, config):
+    def _execute(self, kind, config) -> dict:
+        name, arg = kind
+        if name == "train":
+            from tpuflow.api import train
+
+            return report_to_dict(train(config))
+        if name == "compare":
+            from tpuflow.api import compare
+
+            rpt = compare(arg, config)
+            return {
+                "table": rpt.table(),
+                "ranked": [
+                    {"model": r.model, "test_mae": r.test_mae,
+                     "gilbert_mae": r.gilbert_mae}
+                    for r in rpt.ranked
+                ],
+            }
+        from tpuflow.api import sweep
+
+        rpt = sweep(arg, config)
+        return {
+            "table": rpt.table(),
+            "ranked": [
+                {"assignment": r.assignment, "test_mae": r.test_mae}
+                for r in rpt.ranked
+            ],
+        }
+
+    def _models_trained(self, config, kind) -> tuple:
+        """Every model name a job (re)writes under its storage path —
+        compare jobs retrain each listed family, and a sweep whose grid
+        includes 'model' retrains each of those."""
+        name, arg = kind
+        if name == "compare":
+            return tuple(arg)
+        if name == "sweep" and "model" in arg:
+            return tuple(arg["model"])
+        return (config.model,)
+
+    def _notify_artifact(self, config, kind=("train", None)):
         if self._on_artifact_change and config.storage_path:
             try:
-                self._on_artifact_change(config.storage_path, config.model)
+                for model in self._models_trained(config, kind):
+                    self._on_artifact_change(config.storage_path, model)
             except Exception as e:
                 # A crashing callback must not kill the worker thread (the
                 # job would be stuck at 'running' and the queue wedged).
