@@ -343,6 +343,34 @@ class TestExperimentJobs:
         assert len(ranked) == 2
         assert {r["model"] for r in ranked} == {"static_mlp", "gilbert_residual"}
         assert "test MAE" in rec["report"]["table"]
+        assert rec["report"]["failed"] == []
+
+    def test_failed_rows_are_machine_readable(self):
+        """Error and NaN-divergence rows must reach the JSON report — a
+        compare where every model fails must not poll to an empty-but-
+        'done' report with the errors trapped in the human table."""
+        from tpuflow.api.compare import ComparisonReport, ModelResult
+        from tpuflow.serve import JobRunner
+
+        rpt = ComparisonReport(
+            results=[
+                ModelResult(
+                    model="lstm", test_mae=float("inf"), test_loss=float("inf"),
+                    gilbert_mae=None, samples_per_sec=0.0, epochs_ran=0,
+                    time_elapsed=0.0, error="ValueError: boom",
+                ),
+                ModelResult(
+                    model="static_mlp", test_mae=float("nan"), test_loss=1.0,
+                    gilbert_mae=None, samples_per_sec=1.0, epochs_ran=1,
+                    time_elapsed=1.0,
+                ),
+            ]
+        )
+        rows = JobRunner._failed_rows(rpt, lambda r: {"model": r.model})
+        assert rows == [
+            {"model": "lstm", "error": "ValueError: boom"},
+            {"model": "static_mlp", "error": "diverged (NaN MAE)"},
+        ]
 
     def test_sweep_job_over_http(self, server, tmp_path):
         rec = self._run_job(

@@ -49,6 +49,19 @@ class RankedByMAE:
         return sorted(ok, key=lambda r: r.test_mae)
 
     @property
+    def failed(self):
+        """(result, reason) for every run the ranking excludes — the one
+        source of truth for the failure predicate, shared by the tables
+        and the job-server JSON reports so they can't disagree."""
+        out = []
+        for r in self.results:
+            if r.error is not None:
+                out.append((r, r.error))
+            elif math.isnan(r.test_mae):
+                out.append((r, "diverged (NaN MAE)"))
+        return out
+
+    @property
     def best(self):
         ranked = self.ranked
         if not ranked:
@@ -90,12 +103,9 @@ class ComparisonReport(RankedByMAE):
                 f"{r.samples_per_sec:>12.0f} {r.epochs_ran:>7} "
                 f"{r.time_elapsed:>7.1f}s"
             )
-        for r in self.results:
-            if r.error is not None:
-                lines.append(f"{r.model:<16} FAILED: {r.error}")
-            elif math.isnan(r.test_mae):
-                # Excluded from the ranking but must not vanish silently.
-                lines.append(f"{r.model:<16} DIVERGED (NaN MAE)")
+        # Excluded from the ranking but must not vanish silently.
+        for r, reason in self.failed:
+            lines.append(f"{r.model:<16} FAILED: {reason}")
         return "\n".join(lines)
 
 

@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
-import math
 from dataclasses import dataclass, field
 from typing import Any, Mapping, Sequence
 
@@ -81,12 +80,9 @@ class SweepReport(RankedByMAE):
                 f"{desc:<48} {r.test_mae:>12.2f} {r.epochs_ran:>7} "
                 f"{r.time_elapsed:>7.1f}s"
             )
-        for r in self.results:
+        for r, reason in self.failed:
             desc = ", ".join(f"{k}={v}" for k, v in r.assignment.items())
-            if r.error is not None:
-                lines.append(f"{desc:<48} FAILED: {r.error}")
-            elif math.isnan(r.test_mae):
-                lines.append(f"{desc:<48} DIVERGED (NaN MAE)")
+            lines.append(f"{desc:<48} FAILED: {reason}")
         return "\n".join(lines)
 
 

@@ -81,12 +81,6 @@ class WindowNormalizer:
         return ((y - self.target_mean) / self.target_std).astype(np.float32)
 
 
-def _series_of(columns, feature_names) -> np.ndarray:
-    return np.stack(
-        [np.asarray(columns[n], np.float32) for n in feature_names], axis=1
-    )
-
-
 class _WellWindower:
     """Per-well carry buffers → teacher-forced windows, across chunks."""
 

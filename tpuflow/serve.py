@@ -210,6 +210,11 @@ class JobRunner:
             self._notify_artifact(config, kind)
             self._set(job_id, status="done", report=rep)
 
+    @staticmethod
+    def _failed_rows(rpt, ident) -> list[dict]:
+        # RankedByMAE.failed is the single source of the failure predicate.
+        return [{**ident(r), "error": reason} for r, reason in rpt.failed]
+
     def _execute(self, kind, config) -> dict:
         name, arg = kind
         if name == "train":
@@ -227,6 +232,10 @@ class JobRunner:
                      "gilbert_mae": r.gilbert_mae}
                     for r in rpt.ranked
                 ],
+                # Machine-readable failure rows: without these, a compare
+                # where every model fails polls to status "done" with
+                # ranked=[] and the errors live only in the human table.
+                "failed": self._failed_rows(rpt, lambda r: {"model": r.model}),
             }
         from tpuflow.api import sweep
 
@@ -237,6 +246,7 @@ class JobRunner:
                 {"assignment": r.assignment, "test_mae": r.test_mae}
                 for r in rpt.ranked
             ],
+            "failed": self._failed_rows(rpt, lambda r: {"assignment": r.assignment}),
         }
 
     def _models_trained(self, config, kind) -> tuple:
@@ -252,19 +262,21 @@ class JobRunner:
 
     def _notify_artifact(self, config, kind=("train", None)):
         if self._on_artifact_change and config.storage_path:
-            try:
-                for model in self._models_trained(config, kind):
+            for model in self._models_trained(config, kind):
+                try:
                     self._on_artifact_change(config.storage_path, model)
-            except Exception as e:
-                # A crashing callback must not kill the worker thread (the
-                # job would be stuck at 'running' and the queue wedged).
-                import sys
+                except Exception as e:
+                    # Per-model so one crashing eviction can't leave the
+                    # REMAINING models' stale cache entries alive, and a
+                    # crashing callback must not kill the worker thread
+                    # (the job would be stuck 'running', the queue wedged).
+                    import sys
 
-                print(
-                    f"tpuflow.serve: artifact-change callback failed: "
-                    f"{type(e).__name__}: {e}",
-                    file=sys.stderr,
-                )
+                    print(
+                        f"tpuflow.serve: artifact-change callback failed "
+                        f"for {model!r}: {type(e).__name__}: {e}",
+                        file=sys.stderr,
+                    )
 
 
 class PredictService:
