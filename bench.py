@@ -81,7 +81,7 @@ def bench_configs() -> list[tuple[int, int]]:
     """
     if os.environ.get("BENCH_BATCH") or os.environ.get("BENCH_SCAN"):
         return [(
-            int(os.environ.get("BENCH_BATCH", 4096)),
+            max(int(os.environ.get("BENCH_BATCH", 4096)), 1),
             max(int(os.environ.get("BENCH_SCAN", 16)), 1),
         )]
     configs = []

@@ -42,7 +42,16 @@ def step_throughput(model_kwargs: dict, batch: int, seconds: float) -> float:
 def main(seed: int = 0) -> None:
     batch = int(os.environ.get("BENCH_BATCH", 4096))
     seconds = float(os.environ.get("BENCH_SECONDS", 5))
-    for name, kwargs in lstm_variants().items():
+    try:
+        variants = lstm_variants()
+    except ValueError as e:
+        # A BENCH_VARIANTS typo must cost this config's records, not also
+        # the accuracy run below (and run_all must see a record, not a
+        # raw traceback).
+        emit("lstm64", "train_step_throughput", -1.0, "samples/sec/chip",
+             error=str(e)[:200])
+        variants = {}
+    for name, kwargs in variants.items():
         try:
             sps = step_throughput(kwargs, batch, seconds)
         except Exception as e:  # pallas unavailable on exotic backends

@@ -1,0 +1,78 @@
+"""The bench harness's env-knob parsing — the round's recorded number
+depends on these failing fast and predictably (BENCHLOG.md method notes)."""
+
+from __future__ import annotations
+
+import pytest
+
+from bench import bench_configs
+from benchmarks.common import _timed_passes, lstm_variants
+
+
+class TestBenchConfigs:
+    def test_default_grid(self, monkeypatch):
+        for var in ("BENCH_BATCH", "BENCH_SCAN", "BENCH_CONFIGS"):
+            monkeypatch.delenv(var, raising=False)
+        assert bench_configs() == [(1024, 16), (4096, 16)]
+
+    def test_pinned_by_batch_and_scan(self, monkeypatch):
+        monkeypatch.setenv("BENCH_BATCH", "64")
+        monkeypatch.setenv("BENCH_SCAN", "0")  # clamped to >= 1
+        assert bench_configs() == [(64, 1)]
+
+    def test_pinning_either_knob_overrides_grid(self, monkeypatch):
+        monkeypatch.delenv("BENCH_SCAN", raising=False)
+        monkeypatch.setenv("BENCH_CONFIGS", "8x8")
+        monkeypatch.setenv("BENCH_BATCH", "32")
+        assert bench_configs() == [(32, 16)]
+
+    def test_malformed_entry_rejected(self, monkeypatch):
+        for var in ("BENCH_BATCH", "BENCH_SCAN"):
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.setenv("BENCH_CONFIGS", "1024")
+        with pytest.raises(ValueError, match="not <batch>x<scan>"):
+            bench_configs()
+
+    def test_zero_scan_clamped_not_zero_throughput(self, monkeypatch):
+        # scan=0 would silently report batch*0*n/elapsed = 0 samples/sec.
+        for var in ("BENCH_BATCH", "BENCH_SCAN"):
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.setenv("BENCH_CONFIGS", "256x0")
+        assert bench_configs() == [(256, 1)]
+
+
+class TestLstmVariants:
+    def test_default_skips_unroll(self, monkeypatch):
+        monkeypatch.delenv("BENCH_VARIANTS", raising=False)
+        assert list(lstm_variants()) == ["xla", "pallas"]
+
+    def test_all(self, monkeypatch):
+        monkeypatch.setenv("BENCH_VARIANTS", "all")
+        monkeypatch.setenv("BENCH_UNROLL", "4")
+        assert list(lstm_variants()) == ["xla", "xla_unroll4", "pallas"]
+        assert lstm_variants()["xla_unroll4"] == {"unroll": 4}
+
+    def test_unknown_variant_rejected(self, monkeypatch):
+        monkeypatch.setenv("BENCH_VARIANTS", "xla,palas")
+        with pytest.raises(ValueError, match="palas"):
+            lstm_variants()
+
+
+class TestTimedPasses:
+    def test_grows_until_window_met(self):
+        calls = []
+
+        def run_n(n):  # pretend each step costs 0.01s
+            calls.append(n)
+            return n * 0.01
+
+        n, elapsed = _timed_passes(run_n, seconds=1.0)
+        assert elapsed >= 1.0
+        assert n == calls[-1]
+        assert calls == sorted(calls)  # monotone growth
+        # Bounded total: the sum of all passes stays ~2-3x the window.
+        assert sum(calls) * 0.01 < 3.0
+
+    def test_single_pass_when_first_is_enough(self):
+        n, elapsed = _timed_passes(lambda n: 5.0, seconds=1.0)
+        assert (n, elapsed) == (1, 5.0)
