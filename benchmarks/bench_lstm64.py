@@ -40,7 +40,7 @@ def step_throughput(model_kwargs: dict, batch: int, seconds: float) -> float:
 
 
 def main(seed: int = 0) -> None:
-    batch = int(os.environ.get("BENCH_BATCH", 4096))
+    batch = max(int(os.environ.get("BENCH_BATCH", 4096)), 1)
     seconds = float(os.environ.get("BENCH_SECONDS", 5))
     try:
         variants = lstm_variants()

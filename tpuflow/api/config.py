@@ -70,9 +70,14 @@ class TrainJobConfig:
     def is_sequence_model(self) -> bool:
         return self.model in (
             "dynamic_mlp", "cnn1d", "lstm", "stacked_lstm", "lstm_residual",
+            "attention",
         )
 
     @property
     def teacher_forcing(self) -> bool:
-        """Sequence-target training for the LSTM family (BASELINE config 4)."""
-        return self.model in ("lstm", "stacked_lstm", "lstm_residual")
+        """Sequence-target training for the recurrent/causal families
+        (BASELINE config 4; the attention model is causal, so per-step
+        targets are legitimate the same way)."""
+        return self.model in (
+            "lstm", "stacked_lstm", "lstm_residual", "attention",
+        )

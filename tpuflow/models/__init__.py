@@ -8,6 +8,7 @@ module here, shaped for the MXU: dense/conv compute in large batched
 matmuls, recurrence via an on-chip scan.
 """
 
+from tpuflow.models.attention import AttentionRegressor  # noqa: F401
 from tpuflow.models.mlp import StaticMLP, DynamicMLP, GilbertResidualMLP  # noqa: F401
 from tpuflow.models.cnn import CNN1D  # noqa: F401
 from tpuflow.models.lstm import GilbertResidualLSTM, LSTMRegressor  # noqa: F401

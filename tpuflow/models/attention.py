@@ -1,0 +1,143 @@
+"""Attention sequence regressor — the framework's long-context family.
+
+The reference family stops at the LSTM (reference Readme.md:21); its
+windows are 24 steps, comfortably on-chip. This model exists because the
+framework treats long-context as first-class: a small pre-LN transformer
+encoder whose attention runs **causal** (per-step predictions use only
+past observations, matching the LSTM's teacher-forced semantics). With
+``backend="ring"`` (+ a mesh) every block's attention runs blockwise over
+the mesh ring (``tpuflow.parallel.ring_attention``): the quadratic
+[T, T] score matrix never materializes and its compute shards across
+devices — the flash/ring-attention memory story for long logs. The O(T)
+linear activations stay replicated here; sharding those too is the
+whole-model ``shard_map`` recipe, not this module's job.
+
+TPU-first shape choices: one fused QKV projection per block ([D, 3D], a
+single MXU matmul), heads folded into the batch dimension for the
+blockwise attention primitive, bf16-friendly (dtype param like the LSTM
+family), static shapes throughout.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tpuflow.parallel.ring_attention import full_attention, ring_attention
+
+
+def _split_heads(x: jnp.ndarray, heads: int) -> jnp.ndarray:
+    """[B, T, D] -> [B*h, T, D/h] (heads folded into batch)."""
+    B, T, D = x.shape
+    x = x.reshape(B, T, heads, D // heads)
+    return x.transpose(0, 2, 1, 3).reshape(B * heads, T, D // heads)
+
+
+def _merge_heads(x: jnp.ndarray, heads: int) -> jnp.ndarray:
+    """[B*h, T, D/h] -> [B, T, D]."""
+    Bh, T, Dh = x.shape
+    x = x.reshape(Bh // heads, heads, T, Dh)
+    return x.transpose(0, 2, 1, 3).reshape(Bh // heads, T, heads * Dh)
+
+
+class EncoderBlock(nn.Module):
+    """Pre-LN block: causal MHA + MLP, residual connections.
+
+    ``backend="full"`` materializes the [T, T] scores on-chip (right for
+    the reference's 24-step windows); ``backend="ring"`` runs the same
+    exact attention blockwise over ``mesh``'s data-axis ring
+    (``tpuflow.parallel.ring_attention``) — activation memory O(T/N) for
+    logs longer than one chip. Same math, same params, interchangeable
+    checkpoints (the LSTM family's xla/pallas backend pattern).
+    """
+
+    dim: int
+    heads: int = 4
+    mlp_ratio: int = 4
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
+    backend: str = "full"  # "full" | "ring"
+    mesh: Any = None  # required for backend="ring"
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        qkv = nn.Dense(3 * self.dim, dtype=self.dtype, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (_split_heads(t, self.heads) for t in (q, k, v))
+        if self.backend == "ring":
+            if self.mesh is None:
+                raise ValueError('backend="ring" needs a mesh')
+            att = ring_attention(self.mesh, q, k, v, causal=True)
+            # The quadratic [T, T] score matrix stayed blockwise inside
+            # the ring; the O(T) output comes back replicated so the
+            # surrounding Dense/LayerNorm grads have unambiguous
+            # shardings. (Sharding the whole block over time is the
+            # shard_map recipe in examples/, not this module's job.)
+            import jax
+            from jax.sharding import PartitionSpec
+
+            att = jax.sharding.reshard(att, PartitionSpec())
+        else:
+            att = full_attention(q, k, v, causal=True)
+        att = _merge_heads(att, self.heads)
+        att = nn.Dense(self.dim, dtype=self.dtype, name="proj")(att)
+        if self.dropout_rate > 0:
+            att = nn.Dropout(self.dropout_rate, deterministic=deterministic)(att)
+        x = x + att
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.dim, dtype=self.dtype)(h)
+        if self.dropout_rate > 0:
+            h = nn.Dropout(self.dropout_rate, deterministic=deterministic)(h)
+        return x + h
+
+
+class AttentionRegressor(nn.Module):
+    """Causal transformer flow regressor: [B, T, F] -> [B, T] (or [B]).
+
+    Same interface contract as ``LSTMRegressor`` (sequence/last readout,
+    dtype, teacher-forced targets), so it drops into the same training
+    loop, comparison runs, and serving artifacts. Positions enter via a
+    learned embedding over the window (windows are fixed-length, so the
+    embedding shape is static).
+    """
+
+    dim: int = 64
+    num_layers: int = 2
+    heads: int = 4
+    readout: str = "sequence"  # "sequence" | "last"
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
+    backend: str = "full"  # "full" | "ring" (see EncoderBlock)
+    mesh: Any = None  # required for backend="ring"; T must divide its ring
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, deterministic: bool = True) -> jnp.ndarray:
+        B, T, F = x.shape
+        h = nn.Dense(self.dim, dtype=self.dtype, name="embed")(x.astype(self.dtype))
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (T, self.dim)
+        )
+        h = h + pos.astype(self.dtype)[None]
+        for i in range(self.num_layers):
+            h = EncoderBlock(
+                self.dim,
+                heads=self.heads,
+                dropout_rate=self.dropout_rate,
+                dtype=self.dtype,
+                backend=self.backend,
+                mesh=self.mesh,
+                name=f"block_{i}",
+            )(h, deterministic=deterministic)
+        h = nn.LayerNorm(dtype=self.dtype)(h)
+        y = nn.Dense(1, dtype=self.dtype, name="head")(h)[..., 0]
+        y = y.astype(jnp.float32)
+        if self.readout == "last":
+            return y[:, -1]
+        if self.readout == "sequence":
+            return y
+        raise ValueError(f"unknown readout {self.readout!r}")

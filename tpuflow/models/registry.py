@@ -7,6 +7,7 @@ from typing import Callable
 
 import flax.linen as nn
 
+from tpuflow.models.attention import AttentionRegressor
 from tpuflow.models.cnn import CNN1D
 from tpuflow.models.lstm import GilbertResidualLSTM, LSTMRegressor
 from tpuflow.models.mlp import DynamicMLP, GilbertResidualMLP, StaticMLP
@@ -27,6 +28,9 @@ MODELS: dict[str, Callable[..., nn.Module]] = {
     # Physics-informed extensions (Gilbert x learned correction)
     "gilbert_residual": lambda **kw: GilbertResidualMLP(**kw),
     "lstm_residual": lambda **kw: GilbertResidualLSTM(**{"hidden": 64, **kw}),
+    # Long-context family: causal transformer whose scale-out path is
+    # ring attention over the mesh (tpuflow.parallel.ring_attention)
+    "attention": lambda **kw: AttentionRegressor(**kw),
 }
 
 

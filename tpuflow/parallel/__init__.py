@@ -29,6 +29,10 @@ from tpuflow.parallel.dp import (  # noqa: F401
 from tpuflow.parallel.distributed import init_distributed  # noqa: F401
 from tpuflow.parallel.ep import moe_forward  # noqa: F401
 from tpuflow.parallel.pp import pipeline_forward  # noqa: F401
+from tpuflow.parallel.ring_attention import (  # noqa: F401
+    full_attention,
+    ring_attention,
+)
 from tpuflow.parallel.sp import make_sp_forward, ring_lstm_scan  # noqa: F401
 from tpuflow.parallel.tp import (  # noqa: F401
     column_parallel_matmul,

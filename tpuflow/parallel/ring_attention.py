@@ -1,0 +1,143 @@
+"""Ring attention: sequence/context parallelism for attention models.
+
+The counterpart of ``tpuflow.parallel.sp`` for the attention family: where
+the LSTM ring hands a recurrence carry around the mesh, this hands **KV
+blocks** around it. Each device owns a contiguous time chunk of Q/K/V and
+computes exact softmax attention blockwise — online-softmax accumulators
+(running max ``m``, normalizer ``l``, output ``o``) are updated as each
+KV block arrives over the ``ppermute`` ring, so no device ever
+materializes the full [T, T] score matrix or the full K/V sequence.
+Activation memory per device is O(T/N) while the result is EXACT (parity
+tested against full softmax attention, forward and gradients).
+
+The reference family has no attention (its sequences are 24-step well-log
+windows; SURVEY.md §5.7), but the framework treats long-context as
+first-class: this module is the scale-out story for the attention-based
+sequence regressor (``tpuflow.models.attention``) the same way
+``ring_lstm_scan`` is for the LSTM family. Same ring topology, same
+collective, applied to attention instead of a recurrence.
+
+Differentiation goes straight through the python-unrolled ring (N static
+rounds of jnp ops + ``ppermute``) — take gradients inside
+``with jax.set_mesh(mesh):`` like the SP ring scan.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpuflow.parallel.mesh import DATA_AXIS
+
+# Additive mask value: large-but-finite so a fully-masked score row stays
+# NaN-free through exp() (a true -inf max would make exp(-inf - -inf)).
+_NEG = -1e30
+
+
+def _block_update(q, k, v, m, l, o, allowed, scale):
+    """One online-softmax update with KV block (k, v).
+
+    q [B, Tq, D]; k, v [B, Tk, D]; m, l [B, Tq]; o [B, Tq, D];
+    ``allowed`` [Tq, Tk] bool (True = may attend). Returns updated
+    (m, l, o). Exactness: softmax(s) over the concatenation of all blocks
+    equals the rescaled running sums (the flash-attention recurrence).
+    """
+    s = jnp.einsum("bqd,bkd->bqk", q, k) * scale
+    s = jnp.where(allowed[None], s, _NEG)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # Masked entries contribute exactly 0 (explicit multiply — exp alone
+    # would give 1 when an all-masked row keeps m_new at _NEG).
+    p = jnp.exp(s - m_new[..., None]) * allowed[None]
+    correction = jnp.exp(m - m_new)
+    l = l * correction + jnp.sum(p, axis=-1)
+    o = o * correction[..., None] + jnp.einsum("bqk,bkd->bqd", p, v)
+    return m_new, l, o
+
+
+def ring_attention(
+    mesh: Mesh,
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis: str = DATA_AXIS,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Exact attention with the time axis sharded over the mesh ring.
+
+    ``q, k, v: [B, T, D]`` (heads folded into B by the caller); ``T`` must
+    divide by the axis size. Device ``i`` owns timesteps
+    ``[i*T/N, (i+1)*T/N)`` of all three tensors; each of the N ring rounds
+    attends the local Q chunk to the KV block currently held, then rotates
+    the KV block to the right neighbor. Causal masking uses global
+    positions, so the result equals single-device causal attention.
+    """
+    n = mesh.shape[axis]
+    T = q.shape[1]
+    if T % n:
+        raise ValueError(f"sequence length {T} not divisible by {axis}={n}")
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+
+    def body(q_local, k_local, v_local):
+        B, Tl, D = q_local.shape
+        idx = lax.axis_index(axis)
+        q_pos = idx * Tl + jnp.arange(Tl)  # global positions of local Q
+        m = jnp.full((B, Tl), _NEG, q_local.dtype)
+        l = jnp.zeros((B, Tl), q_local.dtype)
+        o = jnp.zeros((B, Tl, D), q_local.dtype)
+        k_cur, v_cur = k_local, v_local
+        for r in range(n):
+            # After r rotations this device holds the block that started
+            # on device (idx - r) mod n.
+            src = (idx - r) % n
+            k_pos = src * Tl + jnp.arange(Tl)
+            if causal:
+                allowed = k_pos[None, :] <= q_pos[:, None]
+            else:
+                allowed = jnp.ones((Tl, Tl), bool)
+            m, l, o = _block_update(
+                q_local, k_cur, v_cur, m, l, o, allowed, scale
+            )
+            if r + 1 < n:
+                perm = [(i, (i + 1) % n) for i in range(n)]
+                k_cur = lax.ppermute(k_cur, axis, perm)
+                v_cur = lax.ppermute(v_cur, axis, perm)
+        # Causal attention guarantees l > 0 (each position sees itself);
+        # the guard keeps a fully-masked row finite rather than NaN.
+        return o / jnp.where(l == 0, 1.0, l)[..., None]
+
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis),
+        check_vma=False,
+    )
+    return sharded(q, k, v)
+
+
+def full_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Single-device exact softmax attention — the on-chip path for short
+    windows and the parity reference for ``ring_attention``.
+
+    ``q, k, v: [B, T, D]`` (heads folded into B by the caller).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqd,bkd->bqk", q, k) * scale
+    if causal:
+        T = q.shape[1]
+        allowed = jnp.arange(T)[None, :] <= jnp.arange(T)[:, None]
+        s = jnp.where(allowed[None], s, _NEG)
+    return jnp.einsum("bqk,bkd->bqd", jax.nn.softmax(s, axis=-1), v)
