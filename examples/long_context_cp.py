@@ -1,0 +1,217 @@
+"""Long-context (context-parallel) recipe: a whole time-sharded model.
+
+``AttentionRegressor(backend="ring")`` keeps the quadratic score matrix
+blockwise but leaves the O(T) activations replicated. When even THOSE
+don't fit one chip — well logs of hundreds of thousands of steps — the
+recipe is to shard the whole model over time under one ``shard_map``:
+
+1. every activation tensor lives ``[B, T/N, ...]`` per device;
+2. locally-dense ops (projections, norms, MLPs) are per-timestep, so they
+   apply to the local chunk unchanged;
+3. the ONLY cross-chunk op is attention — supplied by
+   ``ring_attention_spmd`` (the SPMD body of ``tpuflow.parallel.
+   ring_attention``), KV blocks riding the ppermute ring;
+4. params are replicated; for training, gradients need one ``psum`` per
+   param (shown below), exactly like data parallelism's all-reduce.
+
+This file runs a 2-block causal encoder at T=4096 on the 8-virtual-device
+CPU mesh, checks it against the unsharded reference at a small T, and
+prints the per-device activation footprint ratio.
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/long_context_cp.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpuflow.parallel import make_mesh
+from tpuflow.parallel.mesh import DATA_AXIS
+from tpuflow.parallel.ring_attention import full_attention, ring_attention_spmd
+
+
+def init_params(key, dim: int, heads: int, layers: int, features: int):
+    """Plain-pytree encoder params (functional, shard_map-friendly)."""
+    ks = jax.random.split(key, 2 + 4 * layers)
+    scale = dim**-0.5
+    params = {
+        "embed": jax.random.normal(ks[0], (features, dim)) * scale,
+        "head": jax.random.normal(ks[1], (dim, 1)) * scale,
+        "blocks": [],
+    }
+    for i in range(layers):
+        k = ks[2 + 4 * i : 6 + 4 * i]
+        params["blocks"].append({
+            "qkv": jax.random.normal(k[0], (dim, 3 * dim)) * scale,
+            "proj": jax.random.normal(k[1], (dim, dim)) * scale,
+            "mlp_in": jax.random.normal(k[2], (dim, 4 * dim)) * scale,
+            "mlp_out": jax.random.normal(k[3], (4 * dim, dim)) * scale,
+        })
+    return params
+
+
+def _norm(x):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + 1e-6)
+
+
+def encoder_chunk(params, x_local, t_offset, heads: int, *, spmd: bool):
+    """The encoder on one local time chunk ``x_local [B, Tl, F]``.
+
+    Every op here is per-timestep except the attention call, which is the
+    ring body when ``spmd`` (inside shard_map) and full attention when
+    running unsharded (the parity reference). ``t_offset`` feeds the
+    sinusoidal positions their GLOBAL time index, so chunks agree with
+    the unsharded run.
+    """
+    B, Tl, F = x_local.shape
+    dim = params["embed"].shape[1]
+    h = x_local @ params["embed"]
+    # Sinusoidal positions: closed-form from the global index — nothing
+    # to shard, unlike a learned [T, dim] table.
+    t = (t_offset + jnp.arange(Tl))[:, None]
+    freqs = jnp.exp(-jnp.arange(0, dim, 2) / dim * jnp.log(10000.0))
+    pos = jnp.concatenate([jnp.sin(t * freqs), jnp.cos(t * freqs)], -1)
+    h = h + pos[None]
+    for blk in params["blocks"]:
+        hn = _norm(h)
+        q, k, v = jnp.split(hn @ blk["qkv"], 3, axis=-1)
+
+        def heads_first(z):
+            return (
+                z.reshape(B, Tl, heads, dim // heads)
+                .transpose(0, 2, 1, 3)
+                .reshape(B * heads, Tl, dim // heads)
+            )
+
+        q, k, v = heads_first(q), heads_first(k), heads_first(v)
+        if spmd:
+            att = ring_attention_spmd(q, k, v, causal=True)
+        else:
+            att = full_attention(q, k, v, causal=True)
+        att = (
+            att.reshape(B, heads, Tl, dim // heads)
+            .transpose(0, 2, 1, 3)
+            .reshape(B, Tl, dim)
+        )
+        h = h + att @ blk["proj"]
+        hn = _norm(h)
+        h = h + jax.nn.gelu(hn @ blk["mlp_in"]) @ blk["mlp_out"]
+    return (_norm(h) @ params["head"])[..., 0]  # [B, Tl]
+
+
+def cp_forward(mesh, params, x, heads: int):
+    """Whole-model context parallelism: activations [B, T/N, ...] per
+    device, params replicated, one shard_map for the entire encoder."""
+
+    def body(params, x_local):
+        Tl = x_local.shape[1]
+        t_offset = lax.axis_index(DATA_AXIS) * Tl
+        return encoder_chunk(params, x_local, t_offset, heads, spmd=True)
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(None, DATA_AXIS)),
+        out_specs=P(None, DATA_AXIS),
+        check_vma=False,
+    )(params, x)
+
+
+def cp_grads(mesh, params, x, y, heads: int):
+    """Training-shape CP: per-device grads from the local chunk's loss
+    terms, psum'd into the replicated global gradient (the same
+    all-reduce contract as data parallelism, over time instead of batch)."""
+
+    def body(params, x_local, y_local):
+        t_offset = lax.axis_index(DATA_AXIS) * x_local.shape[1]
+
+        def loss_of(p):
+            pred = encoder_chunk(p, x_local, t_offset, heads, spmd=True)
+            # SUM of local squared errors: chunk losses add up to the
+            # global sum, so psum'd grads equal the unsharded grads.
+            return jnp.sum(jnp.square(pred - y_local))
+
+        loss, grads = jax.value_and_grad(loss_of)(params)
+        return lax.psum(loss, DATA_AXIS), jax.tree_util.tree_map(
+            lambda g: lax.psum(g, DATA_AXIS), grads
+        )
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(None, DATA_AXIS), P(None, DATA_AXIS)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )(params, x, y)
+
+
+def main():
+    mesh = make_mesh()
+    n = mesh.shape[DATA_AXIS]
+    heads, dim, layers, F = 2, 16, 2, 5
+    params = init_params(jax.random.PRNGKey(0), dim, heads, layers, F)
+
+    # Parity at a small T (fits unsharded): CP == single-device.
+    T = 8 * n
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((2, T, F)), jnp.float32
+    )
+    y_cp = cp_forward(mesh, params, x, heads)
+    y_ref = encoder_chunk(params, x, 0, heads, spmd=False)
+    err = float(jnp.max(jnp.abs(y_cp - y_ref)))
+    assert err < 1e-4, f"CP forward diverges: {err}"
+
+    y = jnp.asarray(
+        np.random.default_rng(1).standard_normal((2, T)), jnp.float32
+    )
+    with jax.set_mesh(mesh):
+        loss_cp, grads_cp = cp_grads(mesh, params, x, y, heads)
+    loss_ref, grads_ref = jax.value_and_grad(
+        lambda p: jnp.sum(
+            jnp.square(encoder_chunk(p, x, 0, heads, spmd=False) - y)
+        )
+    )(params)
+    gerr = max(
+        float(jnp.max(jnp.abs(a - b)))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(grads_cp),
+            jax.tree_util.tree_leaves(grads_ref),
+        )
+    )
+    assert abs(float(loss_cp) - float(loss_ref)) < 1e-2, (loss_cp, loss_ref)
+    assert gerr < 1e-2, f"CP grads diverge: {gerr}"
+    print(f"CP parity OK at T={T}: fwd err {err:.2e}, grad err {gerr:.2e}")
+
+    # The capacity story: T=4096 with every activation 1/n-resident.
+    T_long = 4096
+    x_long = jnp.asarray(
+        np.random.default_rng(2).standard_normal((1, T_long, F)), jnp.float32
+    )
+    out = cp_forward(mesh, params, x_long, heads)
+    assert bool(jnp.all(jnp.isfinite(out)))
+    print(
+        f"long-context CP OK: T={T_long} on {n} devices — per-device "
+        f"activations are T/{n}={T_long // n} steps; the [T,T] score "
+        f"matrix ({T_long}x{T_long}) never materializes (blockwise ring)."
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -58,6 +58,7 @@ class TrainJobConfig:
     # --- fault tolerance (SURVEY §5.3; requires storage_path) ---
     save_every: int = 0  # epochs between full-state run checkpoints
     resume: bool = False  # continue from the latest run checkpoint
+    fault_epoch: int | None = None  # inject a simulated preemption (tests)
 
     # --- observability ---
     trace_dir: str | None = None  # jax.profiler trace of the first epoch

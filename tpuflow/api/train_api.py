@@ -411,6 +411,7 @@ def train(config: TrainJobConfig) -> TrainReport:
         jit_epoch=config.jit_epoch,
         save_every=config.save_every,
         resume=config.resume,
+        fault_epoch=config.fault_epoch,
         trace_dir=config.trace_dir,
         metrics_path=config.metrics_path,
     )

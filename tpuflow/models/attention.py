@@ -77,9 +77,14 @@ class EncoderBlock(nn.Module):
             # shardings. (Sharding the whole block over time is the
             # shard_map recipe in examples/, not this module's job.)
             import jax
-            from jax.sharding import PartitionSpec
+            from jax.sharding import NamedSharding, PartitionSpec
 
-            att = jax.sharding.reshard(att, PartitionSpec())
+            # NamedSharding (not a bare spec): the supplied mesh must be
+            # sufficient on its own — a bare PartitionSpec would demand
+            # an ambient jax.set_mesh context on top of the parameter.
+            att = jax.sharding.reshard(
+                att, NamedSharding(self.mesh, PartitionSpec())
+            )
         else:
             att = full_attention(q, k, v, causal=True)
         att = _merge_heads(att, self.heads)

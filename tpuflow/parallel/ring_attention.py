@@ -82,42 +82,62 @@ def ring_attention(
     if scale is None:
         scale = q.shape[-1] ** -0.5
 
-    def body(q_local, k_local, v_local):
-        B, Tl, D = q_local.shape
-        idx = lax.axis_index(axis)
-        q_pos = idx * Tl + jnp.arange(Tl)  # global positions of local Q
-        m = jnp.full((B, Tl), _NEG, q_local.dtype)
-        l = jnp.zeros((B, Tl), q_local.dtype)
-        o = jnp.zeros((B, Tl, D), q_local.dtype)
-        k_cur, v_cur = k_local, v_local
-        for r in range(n):
-            # After r rotations this device holds the block that started
-            # on device (idx - r) mod n.
-            src = (idx - r) % n
-            k_pos = src * Tl + jnp.arange(Tl)
-            if causal:
-                allowed = k_pos[None, :] <= q_pos[:, None]
-            else:
-                allowed = jnp.ones((Tl, Tl), bool)
-            m, l, o = _block_update(
-                q_local, k_cur, v_cur, m, l, o, allowed, scale
-            )
-            if r + 1 < n:
-                perm = [(i, (i + 1) % n) for i in range(n)]
-                k_cur = lax.ppermute(k_cur, axis, perm)
-                v_cur = lax.ppermute(v_cur, axis, perm)
-        # Causal attention guarantees l > 0 (each position sees itself);
-        # the guard keeps a fully-masked row finite rather than NaN.
-        return o / jnp.where(l == 0, 1.0, l)[..., None]
-
     sharded = jax.shard_map(
-        body,
+        lambda ql, kl, vl: ring_attention_spmd(
+            ql, kl, vl, axis=axis, causal=causal, scale=scale
+        ),
         mesh=mesh,
         in_specs=(P(None, axis), P(None, axis), P(None, axis)),
         out_specs=P(None, axis),
         check_vma=False,
     )
     return sharded(q, k, v)
+
+
+def ring_attention_spmd(
+    q_local: jnp.ndarray,
+    k_local: jnp.ndarray,
+    v_local: jnp.ndarray,
+    axis: str = DATA_AXIS,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """The ring-attention body, callable INSIDE an SPMD region.
+
+    For composing whole time-sharded models under one ``shard_map``
+    (``examples/long_context_cp.py``): the caller's shard_map owns the
+    time axis; the locally-dense ops (projections, norms, MLPs) apply to
+    the local chunk directly and this supplies the one cross-chunk op.
+    ``q_local, k_local, v_local: [B, T/N, D]`` — this device's chunk.
+    """
+    if scale is None:
+        scale = q_local.shape[-1] ** -0.5
+    n = lax.axis_size(axis)
+    B, Tl, D = q_local.shape
+    idx = lax.axis_index(axis)
+    q_pos = idx * Tl + jnp.arange(Tl)  # global positions of local Q
+    m = jnp.full((B, Tl), _NEG, q_local.dtype)
+    l = jnp.zeros((B, Tl), q_local.dtype)
+    o = jnp.zeros((B, Tl, D), q_local.dtype)
+    k_cur, v_cur = k_local, v_local
+    for r in range(n):
+        # After r rotations this device holds the block that started
+        # on device (idx - r) mod n.
+        src = (idx - r) % n
+        k_pos = src * Tl + jnp.arange(Tl)
+        if causal:
+            allowed = k_pos[None, :] <= q_pos[:, None]
+        else:
+            allowed = jnp.ones((Tl, Tl), bool)
+        m, l, o = _block_update(q_local, k_cur, v_cur, m, l, o, allowed, scale)
+        if r + 1 < n:
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            k_cur = lax.ppermute(k_cur, axis, perm)
+            v_cur = lax.ppermute(v_cur, axis, perm)
+    # Causal attention guarantees l > 0 (each position sees itself);
+    # the guard keeps a fully-masked row finite rather than NaN.
+    return o / jnp.where(l == 0, 1.0, l)[..., None]
 
 
 def full_attention(

@@ -20,3 +20,4 @@ from tpuflow.train.loop import (  # noqa: F401
     evaluate,
     fit,
 )
+from tpuflow.train.supervisor import SupervisedRun, supervise  # noqa: F401

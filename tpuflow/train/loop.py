@@ -74,6 +74,14 @@ class FitConfig:
     jit_epoch: bool = False
     # Structured metrics: append per-epoch JSONL records here (SURVEY §5.5).
     metrics_path: str | None = None
+    # Fault injection (SURVEY §5.3): simulate a preemption by killing the
+    # PROCESS (os._exit — no Python cleanup, like the real thing) right
+    # after this epoch's bookkeeping. A resumed run never re-fires it
+    # (the fault guard requires resume=False), so one injection means one
+    # preemption however the retry is driven. This is how the
+    # supervisor's detect-and-restart path is exercised for real
+    # (tests/test_supervisor.py).
+    fault_epoch: int | None = None
 
 
 @dataclass
@@ -272,6 +280,25 @@ def fit(
                     },
                 )
             result.epochs_ran = epoch
+            if (
+                config.fault_epoch is not None
+                and epoch == config.fault_epoch
+                and not config.resume  # a resumed run is the recovery, not
+                # the victim: never re-fire (even when save_every doesn't
+                # divide fault_epoch and the resumed run replays it)
+            ):
+                # Commit in-flight async checkpoint writes first so the
+                # simulated preemption tests resume-from-THIS-epoch
+                # deterministically (a real preemption may lose the tail
+                # write; Orbax's atomic rename just surfaces the previous
+                # checkpoint in that case).
+                if run_ckpt is not None:
+                    run_ckpt.close()
+                if ckpt is not None:
+                    ckpt.close()
+                import os
+
+                os._exit(42)
             if should_stop:
                 break
 

@@ -1,0 +1,171 @@
+"""Failure-detecting training supervisor: detect crashes, restart, resume.
+
+SURVEY.md §5.3: the reference's fault-tolerance story is Spark task retry
+at the cluster layer (reference Readme.md:3) — a worker dies, the
+scheduler notices and reruns the task. ``RunCheckpointer`` +
+``resume=True`` (tpuflow/train/resume.py) give tpuflow the deterministic
+state half of that story; this module adds the *detection and restart*
+half: the training job runs in a child process, the supervisor watches
+its exit status, and any abnormal death (segfault, OOM kill, TPU-backend
+crash, preemption) is answered by relaunching the job with
+``resume=True`` so it continues from the latest full-state checkpoint.
+Together they are the TPU-native equivalent of Spark's retry loop.
+
+The job is described by the same JSON spec the job-runner service accepts
+(``tpuflow.serve.spec_to_config`` — camelCase or snake_case fields), so a
+spec can move between ``POST /jobs`` and ``supervise()`` unchanged. The
+spec must set ``storagePath`` and ``save_every >= 1``; without run
+checkpoints a "restart" would silently start over, which the supervisor
+refuses to do.
+
+Run from a shell::
+
+    python -m tpuflow.train.supervisor spec.json --max-restarts 3
+
+or from Python::
+
+    result = supervise({"model": "lstm", "epochs": 40, "save_every": 1,
+                        "storagePath": "/data/artifacts"})
+    result.report["epochs_ran"], result.attempts
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from dataclasses import dataclass, field
+
+
+@dataclass
+class SupervisedRun:
+    """Outcome of a supervised job: the final report plus the crash log."""
+
+    report: dict
+    attempts: int  # total child launches (1 = no failures)
+    failures: list[dict] = field(default_factory=list)  # {rc, stderr_tail}
+
+
+def _validate(spec: dict) -> None:
+    storage = spec.get("storagePath") or spec.get("storage_path")
+    if not storage:
+        raise ValueError(
+            "supervise() needs storagePath in the spec — without run "
+            "checkpoints a restart would silently lose all progress"
+        )
+    if int(spec.get("save_every", 0)) < 1:
+        raise ValueError(
+            "supervise() needs save_every >= 1 in the spec — restart "
+            "recovery resumes from the periodic full-state checkpoints"
+        )
+
+
+def supervise(
+    spec: dict,
+    *,
+    max_restarts: int = 3,
+    timeout: float | None = None,
+    python: str = sys.executable,
+    verbose: bool = True,
+) -> SupervisedRun:
+    """Run the training job described by ``spec``, restarting on crashes.
+
+    Each attempt is a fresh child process; attempts after the first run
+    with ``resume=True`` so they continue from the latest run checkpoint.
+    Returns once an attempt exits cleanly; raises ``RuntimeError`` after
+    ``max_restarts`` restarts all die.
+    """
+    _validate(spec)
+    failures: list[dict] = []
+    for attempt in range(1, max_restarts + 2):
+        attempt_spec = dict(spec)
+        if attempt > 1:
+            attempt_spec["resume"] = True
+            # An injected fault is one-shot by construction (the resumed
+            # run starts past it); leaving it in the spec is harmless but
+            # dropping it keeps restart specs describing only real work.
+            attempt_spec.pop("fault_epoch", None)
+        with tempfile.TemporaryDirectory() as td:
+            spec_path = os.path.join(td, "spec.json")
+            out_path = os.path.join(td, "report.json")
+            with open(spec_path, "w", encoding="utf-8") as f:
+                json.dump(attempt_spec, f)
+            try:
+                proc = subprocess.run(
+                    [python, "-m", "tpuflow.train.supervisor",
+                     "--child", spec_path, out_path],
+                    capture_output=True,
+                    text=True,
+                    timeout=timeout,
+                    cwd=os.getcwd(),
+                )
+            except subprocess.TimeoutExpired:
+                # A hang (e.g. a dead TPU relay) is a failure mode too —
+                # subprocess.run killed the child; restart like a crash.
+                failures.append({"rc": None, "stderr_tail": "timed out"})
+                proc = None
+            if proc is not None and proc.returncode == 0:
+                with open(out_path, encoding="utf-8") as f:
+                    report = json.load(f)
+                return SupervisedRun(
+                    report=report, attempts=attempt, failures=failures
+                )
+        if proc is not None:
+            tail = "\n".join((proc.stderr or "").strip().splitlines()[-5:])
+            failures.append({"rc": proc.returncode, "stderr_tail": tail})
+        if verbose:
+            print(
+                f"supervisor: attempt {attempt} died "
+                f"rc={failures[-1]['rc']}; "
+                + (
+                    "restarting with resume=True"
+                    if attempt <= max_restarts
+                    else "giving up"
+                ),
+                file=sys.stderr,
+            )
+    raise RuntimeError(
+        f"job died {len(failures)} times (last rc="
+        f"{failures[-1]['rc']}): {failures[-1]['stderr_tail']}"
+    )
+
+
+def _child(spec_path: str, out_path: str) -> None:
+    """One attempt: run train() from the spec, write the report JSON."""
+    from tpuflow.api import train
+    from tpuflow.serve import report_to_dict, spec_to_config
+
+    with open(spec_path, encoding="utf-8") as f:
+        spec = json.load(f)
+    config = spec_to_config(spec)
+    report = train(config)
+    rep = report_to_dict(report)
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(rep, f)
+
+
+def main(argv: list[str] | None = None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "--child":
+        _child(argv[1], argv[2])
+        return
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("spec", help="JSON job-spec file (serve.py contract)")
+    ap.add_argument("--max-restarts", type=int, default=3)
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="per-attempt seconds")
+    args = ap.parse_args(argv)
+    with open(args.spec, encoding="utf-8") as f:
+        spec = json.load(f)
+    run = supervise(
+        spec, max_restarts=args.max_restarts, timeout=args.timeout
+    )
+    print(json.dumps({"attempts": run.attempts, **run.report}))
+
+
+if __name__ == "__main__":
+    main()
