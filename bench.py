@@ -151,6 +151,25 @@ def _parity_check(jax, jnp) -> str:
     rv, rg = jax.jit(jax.value_and_grad(lambda p: mae_clip(yt, p)))(yp)
     errs["loss"] = rel_err(lv, rv)
     errs["dloss"] = rel_err(lg, rg)
+    # flash_attention: fwd + grads vs full softmax attention, multi-block
+    # causal shapes — the long-context family's kernel, proven compiled.
+    from tpuflow.kernels import flash_attention
+    from tpuflow.parallel.ring_attention import full_attention
+
+    q, kk, vv = (
+        jnp.asarray(rng.standard_normal((8, 256, 32)) * 0.5, jnp.float32)
+        for _ in range(3)
+    )
+    (av, ag) = jax.jit(
+        jax.value_and_grad(lambda a: jnp.sum(jnp.square(flash_attention(*a))))
+    )((q, kk, vv))
+    (bv, bg) = jax.jit(
+        jax.value_and_grad(
+            lambda a: jnp.sum(jnp.square(full_attention(*a, causal=True)))
+        )
+    )((q, kk, vv))
+    errs["attn"] = rel_err(av, bv)
+    errs["dattn"] = max(rel_err(a, b) for a, b in zip(ag, bg))
 
     bad = {k: v for k, v in errs.items() if not (v < tol)}
     mode = "compiled" if jax.default_backend() == "tpu" else "interpret"

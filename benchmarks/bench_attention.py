@@ -1,0 +1,68 @@
+"""Long-context family: attention train-step throughput, full vs flash.
+
+Races the XLA full-softmax path against the fused Pallas flash-attention
+kernel on the causal ``AttentionRegressor`` train step across sequence
+lengths. The crossover is the point where never materializing the [T, T]
+score matrix starts paying — at the reference's 24-step windows full
+attention wins (tiny scores fit in registers); flash is built for the
+long logs.
+
+Env knobs: BENCH_BATCH (256), BENCH_SECONDS (5), BENCH_SEQ_LENS
+("24,256,1024").
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from benchmarks.common import emit, time_train_steps
+from tpuflow.models import AttentionRegressor
+from tpuflow.train import create_state, make_train_step
+
+
+def step_throughput(backend: str, batch: int, T: int, seconds: float) -> float:
+    model = AttentionRegressor(
+        dim=64, num_layers=2, heads=4, dtype=jnp.bfloat16, backend=backend
+    )
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, T, 5)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((batch, T)), jnp.float32)
+    state = create_state(model, jax.random.PRNGKey(0), x[:2])
+    steps, elapsed = time_train_steps(
+        state, make_train_step(), x, y, seconds=seconds
+    )
+    return batch * steps / elapsed
+
+
+def main() -> None:
+    batch = max(int(os.environ.get("BENCH_BATCH", 256)), 1)
+    seconds = float(os.environ.get("BENCH_SECONDS", 5))
+    seq_lens = [
+        int(t) for t in os.environ.get("BENCH_SEQ_LENS", "24,256,1024").split(",")
+    ]
+    for T in seq_lens:
+        for backend in ("full", "flash"):
+            try:
+                sps = step_throughput(backend, batch, T, seconds)
+            except Exception as e:
+                emit("attention", f"train_step_throughput_{backend}_T{T}",
+                     -1.0, "samples/sec/chip", error=str(e)[:200])
+                continue
+            emit(
+                "attention",
+                f"train_step_throughput_{backend}_T{T}",
+                sps,
+                "samples/sec/chip",
+                tokens_per_sec=round(sps * T, 1),
+            )
+
+
+if __name__ == "__main__":
+    main()

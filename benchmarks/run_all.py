@@ -17,6 +17,7 @@ SCRIPTS = [
     "bench_lstm64.py",
     "bench_stacked_lstm_dp.py",
     "bench_gilbert_residual.py",  # physics-informed extension
+    "bench_attention.py",  # long-context family: full vs flash backends
 ]
 
 

@@ -11,13 +11,18 @@ fused kernel pays off, Pallas (Mosaic) kernels:
   under a ``jax.custom_vjp``.
 - ``mae_clip_pallas`` — fused clipped-MAE loss (reference cnn.py:29-32
   semantics) as a single tiled reduction kernel.
+- ``flash_attention`` — fused causal attention for the long-context
+  family: online-softmax streaming over K/V blocks, the [T, T] score
+  matrix never materialized, fwd + dQ + dK/dV kernels under a
+  ``jax.custom_vjp``.
 
 All kernels run compiled on TPU and fall back to Pallas interpret mode on
 CPU so the same code paths are unit-testable on the 8-virtual-device CI
 mesh (SURVEY.md §4).
 """
 
+from tpuflow.kernels.attention import flash_attention
 from tpuflow.kernels.lstm import lstm_scan
 from tpuflow.kernels.losses import mae_clip_pallas
 
-__all__ = ["lstm_scan", "mae_clip_pallas"]
+__all__ = ["flash_attention", "lstm_scan", "mae_clip_pallas"]

@@ -1,0 +1,314 @@
+"""Fused causal attention (flash attention) as Pallas TPU kernels.
+
+The attention family's on-chip hot op, completing the kernel trio (fused
+LSTM recurrence, fused clipped-MAE). The XLA path
+(``tpuflow.parallel.ring_attention.full_attention``) materializes the
+[T, T] score matrix in HBM; this kernel never does:
+
+- the query axis tiles over the Pallas grid; for each query block the
+  kernel streams key/value blocks through the MXU, maintaining the
+  online-softmax running max/normalizer/accumulator in f32 — the
+  flash-attention recurrence, scores living only in VMEM/registers;
+- causal masking is applied per block from global positions, and key
+  blocks entirely above the diagonal are never visited (the work is
+  O(T^2/2), not O(T^2));
+- backward recomputes the probabilities blockwise from the saved
+  logsumexp (rematerialisation over HBM residency, as in the LSTM
+  kernel): one kernel produces dQ, a second produces dK/dV, wired via
+  ``jax.custom_vjp``.
+
+Whole K/V for one batch-head are VMEM-resident per grid cell, which caps
+this kernel at T around 10-20k for typical head dims — beyond that the
+time axis should shard across chips instead (``ring_attention`` /
+``examples/long_context_cp.py``); the two compose, ring outside, flash
+inside a chunk, but the composition is not wired here.
+
+On non-TPU backends the kernels run in Pallas interpret mode, so CI on
+the 8-virtual-CPU-device mesh exercises the identical code path
+(SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30  # finite mask value: keeps exp() NaN-free on masked rows
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block(T: int) -> int:
+    """Query/key block length: 128 MXU-friendly rows, or the (8-aligned)
+    whole sequence when it is shorter."""
+    if T >= 128:
+        return 128
+    return max(8, -(-T // 8) * 8)
+
+
+def _pad_time(x: jnp.ndarray, Bt: int) -> jnp.ndarray:
+    pad = (-x.shape[1]) % Bt
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    return x
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, Bk):
+    """One (batch-head, query-block) cell: stream causal K/V blocks."""
+    Bq, D = q_ref.shape[1], q_ref.shape[2]
+    T = k_ref.shape[1]
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [Bq, D]
+    q_pos = iq * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
+
+    m0 = jnp.full((Bq,), _NEG, jnp.float32)
+    l0 = jnp.zeros((Bq,), jnp.float32)
+    acc0 = jnp.zeros((Bq, D), jnp.float32)
+    # Causal: key blocks past this query block's last row never attend.
+    n_kb = jnp.minimum((iq + 1) * Bq + Bk - 1, T) // Bk
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(kb * Bk, Bk)].astype(jnp.float32)  # [Bk, D]
+        v_blk = v_ref[0, pl.ds(kb * Bk, Bk)].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [Bq, Bk]
+        k_pos = kb * Bk + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 1)
+        allowed = k_pos <= q_pos
+        s = jnp.where(allowed, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None]) * allowed.astype(jnp.float32)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, Bk
+):
+    """dQ for one (batch-head, query-block): dq = scale * sum_k ds @ K."""
+    Bq, D = q_ref.shape[1], q_ref.shape[2]
+    T = k_ref.shape[1]
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    q_pos = iq * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
+    n_kb = jnp.minimum((iq + 1) * Bq + Bk - 1, T) // Bk
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.ds(kb * Bk, Bk)].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * Bk, Bk)].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        k_pos = kb * Bk + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 1)
+        allowed = k_pos <= q_pos
+        p = jnp.exp(jnp.where(allowed, s, _NEG) - lse[:, None])
+        p = p * allowed.astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = jax.lax.fori_loop(0, n_kb, body, jnp.zeros((Bq, D), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, scale, Bq,
+):
+    """dK/dV for one (batch-head, key-block): loop causal query blocks."""
+    Bk, D = k_ref.shape[1], k_ref.shape[2]
+    T = q_ref.shape[1]
+    ik = pl.program_id(1)
+    k_blk = k_ref[0].astype(jnp.float32)
+    v_blk = v_ref[0].astype(jnp.float32)
+    k_pos = ik * Bk + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 1)
+    nq = T // Bq
+    first_qb = (ik * Bk) // Bq  # earlier query blocks are fully masked
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * Bq, Bq)].astype(jnp.float32) * scale
+        do = do_ref[0, pl.ds(qb * Bq, Bq)].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * Bq, Bq)]
+        delta = delta_ref[0, pl.ds(qb * Bq, Bq)]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [Bq, Bk]
+        q_pos = qb * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
+        allowed = k_pos <= q_pos
+        p = jnp.exp(jnp.where(allowed, s, _NEG) - lse[:, None])
+        p = p * allowed.astype(jnp.float32)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [Bk, D]
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [Bk, D] — note q already carries `scale`
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(
+        first_qb,
+        nq,
+        body,
+        (jnp.zeros((Bk, D), jnp.float32), jnp.zeros((Bk, D), jnp.float32)),
+    )
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _specs_btd(Bt, D, whole_T):
+    """(1, Bt, D) blocks over (batch-head, time-block) vs whole-sequence."""
+
+    def blocked(b, i):
+        return (b, i, 0)
+
+    def whole(b, i):
+        return (b, 0, 0)
+
+    return (
+        pl.BlockSpec((1, Bt, D), blocked, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, whole_T, D), whole, memory_space=pltpu.VMEM),
+    )
+
+
+def _fwd(q, k, v, scale):
+    """Returns (out, lse), BOTH truncated to the caller's T — padding is
+    private to each pallas wrapper, never part of the residuals."""
+    BH, T0, D = q.shape
+    Bt = _block(T0)
+    q_p = _pad_time(q, Bt)
+    k_p = _pad_time(k, Bt)
+    v_p = _pad_time(v, Bt)
+    T = q_p.shape[1]
+    grid = (BH, T // Bt)
+    blk, whole = _specs_btd(Bt, D, T)
+
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, Bk=Bt),
+        grid=grid,
+        in_specs=[blk, whole, whole],
+        out_specs=[
+            blk,
+            pl.BlockSpec((1, Bt), lambda b, i: (b, i), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, T), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q_p, k_p, v_p)
+    return o[:, :T0], lse[:, :T0]
+
+
+def _bwd(q, k, v, o, lse, do, scale):
+    BH, T0, D = q.shape
+    Bt = _block(T0)
+    q_p = _pad_time(q, Bt)
+    k_p = _pad_time(k, Bt)
+    v_p = _pad_time(v, Bt)
+    do_p = _pad_time(do, Bt)
+    T = q_p.shape[1]
+    # delta_i = sum_d do_i * o_i — tiny elementwise pass, jnp is the right
+    # tool; padded rows contribute zeros.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )
+    pad = T - T0
+    if pad:
+        delta = jnp.pad(delta, ((0, 0), (0, pad)))
+        # lse arrives at T0 (_fwd contract). Pad with a huge POSITIVE
+        # value so padded rows get p = exp(s - huge) = 0 exactly — a 0
+        # pad could overflow exp(s) to inf and poison ds with inf * 0.
+        lse = jnp.pad(lse, ((0, 0), (0, pad)), constant_values=-_NEG)
+    grid = (BH, T // Bt)
+    blk, whole = _specs_btd(Bt, D, T)
+    row_blk = pl.BlockSpec((1, Bt), lambda b, i: (b, i), memory_space=pltpu.VMEM)
+    row_whole = pl.BlockSpec((1, T), lambda b, i: (b, 0), memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, Bk=Bt),
+        grid=grid,
+        in_specs=[blk, whole, whole, blk, row_blk, row_blk],
+        out_specs=blk,
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        interpret=_interpret(),
+    )(q_p, k_p, v_p, do_p, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, Bq=Bt),
+        grid=grid,
+        in_specs=[whole, blk, blk, whole, row_whole, row_whole],
+        out_specs=[blk, blk],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        ],
+        interpret=_interpret(),
+    )(q_p, k_p, v_p, do_p, lse, delta)
+    return dq[:, :T0], dk[:, :T0], dv[:, :T0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def flash_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, scale: float | None = None
+) -> jnp.ndarray:
+    """Fused causal attention: ``q, k, v [BH, T, D] -> [BH, T, D]``.
+
+    Heads folded into the leading dim by the caller (the
+    ``tpuflow.models.attention`` convention). Matches
+    ``full_attention(..., causal=True)`` exactly (parity-tested, fwd and
+    grads) without ever materializing the [T, T] score matrix.
+    """
+    out, _ = _fwd(q, k, v, scale if scale is not None else q.shape[-1] ** -0.5)
+    return out
+
+
+def _flash_fwd(q, k, v, scale):
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    out, lse = _fwd(q, k, v, s)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(scale, res, do):
+    q, k, v, out, lse = res
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    return _bwd(q, k, v, out, lse, do.astype(q.dtype), s)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -46,9 +46,11 @@ class EncoderBlock(nn.Module):
     """Pre-LN block: causal MHA + MLP, residual connections.
 
     ``backend="full"`` materializes the [T, T] scores on-chip (right for
-    the reference's 24-step windows); ``backend="ring"`` runs the same
+    the reference's 24-step windows); ``backend="flash"`` swaps in the
+    fused Pallas flash-attention kernel (``tpuflow.kernels``) — scores
+    stay blockwise in VMEM, single chip; ``backend="ring"`` runs the same
     exact attention blockwise over ``mesh``'s data-axis ring
-    (``tpuflow.parallel.ring_attention``) — activation memory O(T/N) for
+    (``tpuflow.parallel.ring_attention``) — attention memory O(T/N) for
     logs longer than one chip. Same math, same params, interchangeable
     checkpoints (the LSTM family's xla/pallas backend pattern).
     """
@@ -58,7 +60,7 @@ class EncoderBlock(nn.Module):
     mlp_ratio: int = 4
     dropout_rate: float = 0.0
     dtype: Any = jnp.float32
-    backend: str = "full"  # "full" | "ring"
+    backend: str = "full"  # "full" | "flash" | "ring"
     mesh: Any = None  # required for backend="ring"
 
     @nn.compact
@@ -67,7 +69,11 @@ class EncoderBlock(nn.Module):
         qkv = nn.Dense(3 * self.dim, dtype=self.dtype, name="qkv")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q, k, v = (_split_heads(t, self.heads) for t in (q, k, v))
-        if self.backend == "ring":
+        if self.backend == "flash":
+            from tpuflow.kernels import flash_attention
+
+            att = flash_attention(q, k, v)
+        elif self.backend == "ring":
             if self.mesh is None:
                 raise ValueError('backend="ring" needs a mesh')
             att = ring_attention(self.mesh, q, k, v, causal=True)
@@ -117,7 +123,7 @@ class AttentionRegressor(nn.Module):
     readout: str = "sequence"  # "sequence" | "last"
     dropout_rate: float = 0.0
     dtype: Any = jnp.float32
-    backend: str = "full"  # "full" | "ring" (see EncoderBlock)
+    backend: str = "full"  # "full" | "flash" | "ring" (see EncoderBlock)
     mesh: Any = None  # required for backend="ring"; T must divide its ring
 
     @nn.compact
