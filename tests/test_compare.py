@@ -1,6 +1,9 @@
 """Multi-model comparison workflow (reference Readme.md:13 experiments)."""
 
+import dataclasses
+
 import numpy as np
+import pytest
 
 from tpuflow.api import TrainJobConfig, compare
 
@@ -46,3 +49,41 @@ def test_compare_records_failures_non_fatal():
     assert len(ok) == 1 and len(bad) == 1
     assert bad[0].model == "nope_model"
     assert "FAILED" in report.table()
+
+
+class TestDataCache:
+    def test_families_share_preparation(self):
+        """All teacher-forced sequence families must hit ONE prepared
+        dataset; tabular/physics/windowed-no-TF each get their own."""
+        from tpuflow.api.train_api import _prep_key
+
+        base = TrainJobConfig(max_epochs=1, batch_size=32, verbose=False,
+                              synthetic_wells=4, synthetic_steps=64,
+                              n_devices=1)
+        keys = {
+            m: _prep_key(dataclasses.replace(base, model=m))
+            for m in ("lstm", "stacked_lstm", "attention", "dynamic_mlp",
+                      "cnn1d", "static_mlp", "gilbert_residual",
+                      "lstm_residual")
+        }
+        assert keys["lstm"] == keys["stacked_lstm"] == keys["attention"]
+        assert keys["dynamic_mlp"] == keys["cnn1d"]
+        # Physics channel and family kind must NOT collide.
+        assert len({keys["lstm"], keys["dynamic_mlp"], keys["static_mlp"],
+                    keys["gilbert_residual"], keys["lstm_residual"]}) == 5
+
+    def test_cached_run_matches_uncached(self):
+        from tpuflow.api.train_api import train
+
+        base = TrainJobConfig(model="lstm", max_epochs=2, batch_size=32,
+                              verbose=False, synthetic_wells=4,
+                              synthetic_steps=64, n_devices=1)
+        cache: dict = {}
+        r_warm = train(dataclasses.replace(base, model="stacked_lstm"),
+                       _data_cache=cache)
+        assert len(cache) == 1
+        r_cached = train(base, _data_cache=cache)  # same family key: hit
+        assert len(cache) == 1
+        r_plain = train(base)
+        assert r_cached.test_mae == pytest.approx(r_plain.test_mae, rel=1e-6)
+        assert np.isfinite(r_warm.test_mae)

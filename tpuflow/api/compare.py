@@ -25,6 +25,7 @@ DEFAULT_MODELS = (
     "cnn1d",
     "lstm",
     "stacked_lstm",
+    "attention",  # after the LSTMs: shares their cached preparation
     "gilbert_residual",
     "lstm_residual",
 )
@@ -121,10 +122,15 @@ def compare(
     """
     base = base_config or TrainJobConfig(max_epochs=40, batch_size=256)
     report = ComparisonReport()
+    # One ingest+feature pass per distinct preparation, not per model:
+    # families that prepare identical data (e.g. every teacher-forced
+    # sequence model) share one _Prepared through this dict, which dies
+    # with the comparison.
+    data_cache: dict = {}
     for name in models:
         config = dataclasses.replace(base, model=name)
         try:
-            r = train(config)
+            r = train(config, _data_cache=data_cache)
         except Exception as e:  # record and keep comparing
             report.results.append(
                 ModelResult(

@@ -108,11 +108,14 @@ def sweep(
     for name in names:
         _validate_name(name)
     report = SweepReport()
+    # Grid points that don't vary the data axes (most sweeps: model
+    # width, optimizer settings) share one ingest+feature pass.
+    data_cache: dict = {}
     for values in itertools.product(*(grid[n] for n in names)):
         assignment = dict(zip(names, values))
         try:
             config = _apply(base, assignment)
-            r = train(config)
+            r = train(config, _data_cache=data_cache)
         except Exception as e:  # record and keep sweeping
             report.results.append(
                 SweepResult(
