@@ -42,12 +42,30 @@ def step_throughput(backend: str, batch: int, T: int, seconds: float) -> float:
 
 
 def main() -> None:
+    from tpuflow.utils.roofline import (
+        attention_bytes_per_sample_step,
+        attention_flops_per_sample_step,
+        roofline_report,
+    )
+
     batch = max(int(os.environ.get("BENCH_BATCH", 256)), 1)
     seconds = float(os.environ.get("BENCH_SECONDS", 5))
     seq_lens = [
         int(t) for t in os.environ.get("BENCH_SEQ_LENS", "24,256,1024").split(",")
     ]
+    device_kind = getattr(jax.devices()[0], "device_kind", "unknown")
     for T in seq_lens:
+        flops = attention_flops_per_sample_step(T, F=5, D=64, layers=2)
+        # Per-backend byte models: "full" spills per-head [T, T] scores
+        # to HBM; flash never does — so their bound verdicts differ.
+        bytes_by_backend = {
+            "full": attention_bytes_per_sample_step(
+                T, D=64, layers=2, itemsize=2, score_heads=4
+            ),
+            "flash": attention_bytes_per_sample_step(
+                T, D=64, layers=2, itemsize=2
+            ),
+        }
         for backend in ("full", "flash"):
             try:
                 sps = step_throughput(backend, batch, T, seconds)
@@ -61,6 +79,9 @@ def main() -> None:
                 sps,
                 "samples/sec/chip",
                 tokens_per_sec=round(sps * T, 1),
+                **roofline_report(
+                    sps, flops, bytes_by_backend[backend], device_kind
+                ),
             )
 
 

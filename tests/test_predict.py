@@ -128,6 +128,36 @@ class TestWindowedServing:
         assert y.shape == (41, 24)
         assert np.all(np.isfinite(y))
 
+    def test_attention_train_then_predict(self, tmp_path):
+        """The long-context family serves from its artifact like every
+        other sequence family (model_kwargs ride the sidecar)."""
+        train(
+            TrainJobConfig(
+                model="attention",
+                model_kwargs={"dim": 16, "num_layers": 1, "heads": 2},
+                window=24,
+                max_epochs=2,
+                batch_size=32,
+                seed=0,
+                verbose=False,
+                n_devices=1,
+                storage_path=str(tmp_path),
+                synthetic_wells=2,
+                synthetic_steps=96,
+            )
+        )
+        w = generate_wells(1, 64, seed=5)[0]
+        cols = {
+            "pressure": w.pressure,
+            "choke": w.choke,
+            "glr": w.glr,
+            "temperature": w.temperature,
+            "water_cut": w.water_cut,
+        }
+        y = predict(str(tmp_path), "attention", columns=cols)
+        assert y.shape == (41, 24)
+        assert np.all(np.isfinite(y))
+
     def test_window_index_input_order(self, tmp_path):
         """Wells come back in input (first-appearance) order with a usable
         prediction→row index; short wells are skipped with a warning."""

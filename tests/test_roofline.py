@@ -40,3 +40,38 @@ def test_roofline_verdict_hbm_bound_for_lstm64():
 def test_unknown_chip_reports_unknown():
     rep = roofline_report(1.0, 1.0, 1.0, "cpu")
     assert rep["mfu"] is None and "unknown chip" in rep["bound"]
+
+
+def test_attention_flops_quadratic_in_T_linear_in_layers():
+    from tpuflow.utils.roofline import attention_flops_per_sample_step
+
+    f1 = attention_flops_per_sample_step(256, 5, 64, layers=2)
+    f2 = attention_flops_per_sample_step(512, 5, 64, layers=2)
+    # Projections double, attention quadruples: ratio lands in (2, 4).
+    assert 2.0 < f2 / f1 < 4.0
+    f4 = attention_flops_per_sample_step(256, 5, 64, layers=4)
+    assert f4 / f1 > 1.9  # per-layer work dominates the embed/head terms
+
+
+def test_attention_bytes_exclude_score_matrix():
+    from tpuflow.utils.roofline import attention_bytes_per_sample_step
+
+    # Flash/ring kernels never spill [T, T]: bytes must scale ~linearly
+    # in T, far below a score-matrix write at long T.
+    b1 = attention_bytes_per_sample_step(8192, 64, layers=2, itemsize=2)
+    b2 = attention_bytes_per_sample_step(16384, 64, layers=2, itemsize=2)
+    assert abs(b2 / b1 - 2.0) < 1e-9
+    # At T=8192 even ONE bf16 [T, T] score matrix is 134MB; the whole
+    # activation byte model stays far under it.
+    assert b1 < 8192 * 8192 * 2
+
+
+def test_full_backend_score_bytes_dominate_at_long_T():
+    from tpuflow.utils.roofline import attention_bytes_per_sample_step
+
+    flash = attention_bytes_per_sample_step(1024, 64, layers=2, itemsize=2)
+    full = attention_bytes_per_sample_step(
+        1024, 64, layers=2, itemsize=2, score_heads=4
+    )
+    # 4 heads x [1024, 1024] spilled scores dwarf the [T, D] activations.
+    assert full > 3 * flash

@@ -57,6 +57,45 @@ def lstm_bytes_per_sample_step(T: int, F: int, H: int, itemsize: int) -> float:
     return itemsize * (T * F + 3 * xw + 3 * hs_cs)
 
 
+def attention_flops_per_sample_step(
+    T: int, F: int, D: int, layers: int, mlp_ratio: int = 4
+) -> float:
+    """Model FLOPs for ONE sample through one attention train step.
+
+    Per layer: qkv [D,3D] + out-proj [D,D] + MLP [D,rD]+[rD,D] projections
+    (2*m*n*k each, per timestep), plus the causal attention products
+    q@k^T and p@v — T*D each per query row, halved by causality. Embed
+    [F,D] + head [D,1] once. Backward of a matmul costs 2x its forward.
+    """
+    proj = 2.0 * T * (3 * D * D + D * D + 2 * mlp_ratio * D * D)
+    attn = 2.0 * 2.0 * (T * T // 2) * D  # s = q@k^T and p@v, causal half
+    embed = 2.0 * T * (F * D + D)
+    return 3.0 * (layers * (proj + attn) + embed)
+
+
+def attention_bytes_per_sample_step(
+    T: int,
+    D: int,
+    layers: int,
+    itemsize: int,
+    mlp_ratio: int = 4,
+    score_heads: int = 0,
+) -> float:
+    """Rough HBM bytes for one sample through one attention train step.
+
+    Per layer, the [T, D]-shaped activations (x, qkv, att out, MLP
+    hidden) each make write+read round trips fwd and bwd. With
+    ``score_heads=0`` (the flash/ring kernels) the [T, T] score matrix is
+    NOT counted — those kernels never spill it; for the materializing
+    "full" backend pass the head count, adding per-head [T, T] traffic
+    (write fwd + re-read and re-write in backward), which dominates at
+    long T and is exactly why the flash crossover exists.
+    """
+    act = T * D * (1 + 3 + 1 + mlp_ratio)
+    scores = score_heads * T * T * 3
+    return itemsize * layers * (4.0 * act + scores)
+
+
 def roofline_report(
     samples_per_sec: float,
     flops_per_sample: float,
