@@ -114,3 +114,51 @@ def test_graft_entry_single_and_multichip():
     y = jax.jit(fn)(params, x)
     assert y.shape == (256, 24)
     dryrun_multichip(8)
+
+
+def test_ring_trained_artifact_serves_with_full_backend(tmp_path):
+    """Ring-CP training writes a servable artifact: the sidecar swaps the
+    live-mesh ring backend for the checkpoint-compatible "full" one."""
+    import json
+
+    from tpuflow.api.predict_api import Predictor
+    from tpuflow.parallel import make_mesh
+
+    train(
+        TrainJobConfig(
+            model="attention",
+            model_kwargs={"backend": "ring", "mesh": make_mesh(),
+                          "dim": 16, "num_layers": 1, "heads": 2},
+            window=16,  # divides the 8-device ring
+            max_epochs=1,
+            batch_size=32,
+            storage_path=str(tmp_path),
+            verbose=False,
+            n_devices=1,
+            synthetic_wells=4,
+            synthetic_steps=64,
+        )
+    )
+    meta = json.load(open(tmp_path / "meta" / "attention.json"))
+    assert meta["model_kwargs"]["backend"] == "full"
+    assert "mesh" not in meta["model_kwargs"]
+    p = Predictor.load(str(tmp_path), "attention")
+    assert p is not None
+
+
+def test_unserializable_model_kwargs_fail_before_training(tmp_path):
+    """Anything the sidecar sanitization can't fix must be rejected up
+    front — the sidecar write would otherwise crash AFTER the whole fit."""
+    import pytest
+
+    with pytest.raises(ValueError, match="JSON-serializable"):
+        train(
+            TrainJobConfig(
+                model="static_mlp",
+                model_kwargs={"hidden": object()},
+                max_epochs=1,
+                storage_path=str(tmp_path),
+                verbose=False,
+                n_devices=1,
+            )
+        )

@@ -122,6 +122,23 @@ class _Prepared:
     seq_physics: bool
 
 
+def _sidecar_kwargs(model_kwargs: dict) -> dict:
+    """model_kwargs as the serving sidecar records them.
+
+    Ring-CP attention trains against a live Mesh, which neither
+    serializes nor exists at serving time; the artifact's checkpoints are
+    backend-interchangeable, so the sidecar swaps in the on-chip "full"
+    backend and drops the mesh — a ring-trained run still produces a
+    servable artifact. Everything else passes through (and must be
+    JSON-serializable; train() checks before fitting).
+    """
+    kwargs = dict(model_kwargs)
+    if kwargs.get("backend") == "ring":
+        kwargs["backend"] = "full"
+    kwargs.pop("mesh", None)
+    return kwargs
+
+
 def _prep_key(config: TrainJobConfig) -> tuple:
     """Cache key over every config field ``_prepare_data`` reads.
 
@@ -396,6 +413,19 @@ def train(
             "defeat the bounded-memory stream; use per-batch stepping for "
             "streaming runs"
         )
+    if config.storage_path:
+        # The serving sidecar serializes (sanitized) model_kwargs as JSON
+        # at the END of training; anything still unserializable after
+        # sanitization must fail HERE, not after the fit.
+        import json as _json
+
+        try:
+            _json.dumps(_sidecar_kwargs(config.model_kwargs))
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                f"model_kwargs must be JSON-serializable when storage_path "
+                f"is set (the serving sidecar records them): {e}"
+            ) from None
 
     if _data_cache is not None:
         key = _prep_key(config)
@@ -554,7 +584,10 @@ def train(
             config.storage_path,
             config.model,
             config.model,
-            model_kwargs,  # resolved kwargs (incl. injected target stats)
+            # Resolved kwargs (incl. injected target stats), sanitized
+            # for serving: a ring-CP training run still writes a
+            # checkpoint-compatible artifact.
+            _sidecar_kwargs(model_kwargs),
             kind,
             pre,
             tuple(val_ds.x.shape if config.stream else train_ds.x.shape),
