@@ -140,3 +140,114 @@ def test_fit_with_checkpointing(tmp_path):
         result.state.params
     )
     ck.close()
+
+
+class TestWrapOptimizer:
+    def test_accumulation_matches_big_batch(self):
+        """k micro-batches through MultiSteps == one update with the mean
+        gradient (plain SGD, no momentum/decay), the defining property."""
+        import optax
+
+        from tpuflow.train import wrap_optimizer
+
+        params = {"w": jnp.arange(4.0)}
+        g1 = {"w": jnp.array([1.0, 2.0, 3.0, 4.0])}
+        g2 = {"w": jnp.array([3.0, 2.0, 1.0, 0.0])}
+
+        tx = wrap_optimizer(optax.sgd(0.1), accumulate_steps=2)
+        st = tx.init(params)
+        p = params
+        for g in (g1, g2):
+            upd, st = tx.update(g, st, p)
+            p = optax.apply_updates(p, upd)
+
+        ref_tx = optax.sgd(0.1)
+        ref_st = ref_tx.init(params)
+        mean_g = {"w": (g1["w"] + g2["w"]) / 2}
+        upd, _ = ref_tx.update(mean_g, ref_st, params)
+        ref_p = optax.apply_updates(params, upd)
+        np.testing.assert_allclose(
+            np.asarray(p["w"]), np.asarray(ref_p["w"]), atol=1e-6
+        )
+
+    def test_params_frozen_between_accumulation_boundaries(self):
+        import optax
+
+        from tpuflow.train import wrap_optimizer
+
+        params = {"w": jnp.ones(3)}
+        tx = wrap_optimizer(optax.sgd(0.1), accumulate_steps=3)
+        st = tx.init(params)
+        upd, st = tx.update({"w": jnp.ones(3)}, st, params)
+        p = optax.apply_updates(params, upd)
+        np.testing.assert_allclose(np.asarray(p["w"]), 1.0)  # no step yet
+
+    def test_clip_norm_bounds_update(self):
+        import optax
+
+        from tpuflow.train import wrap_optimizer
+
+        params = {"w": jnp.zeros(4)}
+        tx = wrap_optimizer(optax.sgd(1.0), clip_norm=1.0)
+        st = tx.init(params)
+        upd, _ = tx.update({"w": jnp.full(4, 100.0)}, st, params)
+        norm = float(jnp.sqrt(jnp.sum(jnp.square(upd["w"]))))
+        assert norm <= 1.0 + 1e-5
+
+    def test_clip_applies_per_micro_batch(self):
+        """One spiky micro-batch must be clipped BEFORE the accumulator —
+        clip-of-the-mean would let it dominate the window."""
+        import optax
+
+        from tpuflow.train import wrap_optimizer
+
+        params = {"w": jnp.zeros(4)}
+        tx = wrap_optimizer(optax.sgd(1.0), clip_norm=1.0, accumulate_steps=2)
+        st = tx.init(params)
+        spike = {"w": jnp.full(4, 1000.0)}
+        zero = {"w": jnp.zeros(4)}
+        p = params
+        for g in (spike, zero):
+            upd, st = tx.update(g, st, p)
+            p = optax.apply_updates(p, upd)
+        # mean(clip(spike), clip(zero)) has norm 0.5; clip(mean) would be 1.
+        norm = float(jnp.sqrt(jnp.sum(jnp.square(p["w"]))))
+        assert norm <= 0.5 + 1e-5
+
+    def test_invalid_knobs_rejected(self):
+        import optax
+        import pytest
+
+        from tpuflow.train import wrap_optimizer
+
+        with pytest.raises(ValueError, match="clip_norm"):
+            wrap_optimizer(optax.sgd(0.1), clip_norm=-1.0)
+        with pytest.raises(ValueError, match="accumulate_steps"):
+            wrap_optimizer(optax.sgd(0.1), accumulate_steps=0)
+
+    def test_noop_passthrough_is_identity(self):
+        import optax
+
+        from tpuflow.train import wrap_optimizer
+
+        tx = optax.sgd(0.1)
+        assert wrap_optimizer(tx) is tx
+
+    def test_train_end_to_end_with_accumulation_and_clip(self):
+        from tpuflow.api import TrainJobConfig, train
+
+        r = train(
+            TrainJobConfig(
+                model="static_mlp",
+                model_kwargs={"hidden": [8]},
+                max_epochs=2,
+                batch_size=32,
+                accumulate_steps=2,
+                clip_norm=5.0,
+                synthetic_wells=4,
+                synthetic_steps=64,
+                verbose=False,
+                n_devices=1,
+            )
+        )
+        assert np.isfinite(r.test_mae)

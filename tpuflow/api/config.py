@@ -49,6 +49,12 @@ class TrainJobConfig:
     loss: str = "mae_clip"
     optimizer: str = "keras_sgd"
     optimizer_kwargs: dict = field(default_factory=dict)
+    clip_norm: float = 0.0  # 0 = off; optax.clip_by_global_norm otherwise
+    # >1: average k micro-batch grads per optimizer update (MultiSteps) —
+    # effective batch k*batch_size without k-times the activation memory.
+    # Size epochs to a multiple of k: a trailing partial window's grads
+    # wait in the accumulator (discarded if training ends there).
+    accumulate_steps: int = 1
     seed: int = 0
     verbose: bool = True
     # Compile each epoch into one XLA program (single-chip runs): removes

@@ -49,7 +49,7 @@ from tpuflow.parallel import (
 )
 from tpuflow.parallel.dp import replicate
 from tpuflow.train import FitConfig, FitResult, create_state, evaluate, fit
-from tpuflow.train.optim import build_optimizer
+from tpuflow.train.optim import build_optimizer, wrap_optimizer
 
 
 @dataclass
@@ -457,7 +457,11 @@ def train(
         model_kwargs["target_mean"] = splits.target_mean
         model_kwargs["target_std"] = splits.target_std
     model = build_model(config.model, **model_kwargs)
-    tx = build_optimizer(config.optimizer, **config.optimizer_kwargs)
+    tx = wrap_optimizer(
+        build_optimizer(config.optimizer, **config.optimizer_kwargs),
+        clip_norm=config.clip_norm,
+        accumulate_steps=config.accumulate_steps,
+    )
     # Streaming sources have no .x; the val sample provides the init shape.
     sample_x = val_ds.x[:2] if config.stream else train_ds.x[:2]
     state = create_state(model, jax.random.PRNGKey(config.seed), sample_x, tx)

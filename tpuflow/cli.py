@@ -35,6 +35,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--window", type=int, default=24)
     p.add_argument("--loss", default="mae_clip")
     p.add_argument("--optimizer", default="keras_sgd")
+    p.add_argument("--clip-norm", type=float, default=0.0,
+                   help="global-norm gradient clipping (0 = off)")
+    p.add_argument("--accumulate-steps", type=int, default=1,
+                   help="micro-batch gradients averaged per optimizer update")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--devices", type=int, default=None, help="data-parallel device count (default: all)")
     p.add_argument("--synthetic-wells", type=int, default=8)
@@ -87,6 +91,8 @@ def main(argv=None) -> int:
         window=args.window,
         loss=args.loss,
         optimizer=args.optimizer,
+        clip_norm=args.clip_norm,
+        accumulate_steps=args.accumulate_steps,
         seed=args.seed,
         n_devices=args.devices,
         synthetic_wells=args.synthetic_wells,

@@ -8,7 +8,11 @@ deterministic resume, structured per-step metrics, and samples/sec/chip
 accounting.
 """
 
-from tpuflow.train.optim import keras_sgd, build_optimizer  # noqa: F401
+from tpuflow.train.optim import (  # noqa: F401
+    build_optimizer,
+    keras_sgd,
+    wrap_optimizer,
+)
 from tpuflow.train.state import create_state  # noqa: F401
 from tpuflow.train.steps import make_train_step, make_eval_step  # noqa: F401
 from tpuflow.train.callbacks import EarlyStopping  # noqa: F401

@@ -33,3 +33,37 @@ def build_optimizer(name: str = "keras_sgd", **kwargs) -> optax.GradientTransfor
     if name == "adamw":
         return optax.adamw(kwargs.pop("learning_rate", 1e-3), **kwargs)
     raise ValueError(f"unknown optimizer {name!r}")
+
+
+def wrap_optimizer(
+    tx: optax.GradientTransformation,
+    clip_norm: float = 0.0,
+    accumulate_steps: int = 1,
+) -> optax.GradientTransformation:
+    """Optional global-norm gradient clipping and gradient accumulation
+    around any base optimizer.
+
+    Accumulation (``optax.MultiSteps``) averages ``accumulate_steps``
+    micro-batch gradients and applies ONE update — the standard recipe
+    for effective batches larger than device memory. Parameters change
+    only on the k-th micro-step, so size epochs to a multiple of k:
+    a trailing partial window's gradients stay in the accumulator (and
+    are discarded if training ends there). Clipping wraps OUTSIDE the
+    accumulator, so each micro-batch gradient is clipped before it
+    enters the average — one spiky micro-batch can't dominate the
+    window.
+    """
+    if clip_norm < 0:
+        # A negative max_norm would sign-flip every update in
+        # optax.clip_by_global_norm (scale = max_norm/g_norm < 0) —
+        # silent gradient ascent.
+        raise ValueError(f"clip_norm must be >= 0, got {clip_norm}")
+    if accumulate_steps < 1:
+        raise ValueError(
+            f"accumulate_steps must be >= 1, got {accumulate_steps}"
+        )
+    if accumulate_steps > 1:
+        tx = optax.MultiSteps(tx, accumulate_steps).gradient_transformation()
+    if clip_norm:
+        tx = optax.chain(optax.clip_by_global_norm(clip_norm), tx)
+    return tx
