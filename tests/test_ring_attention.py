@@ -67,6 +67,71 @@ class TestRingAttention:
         )
 
 
+class TestRingFlashComposition:
+    """impl="flash": Pallas round kernels inside the CP ring — the
+    composed long-context path (ring outside, flash inside)."""
+
+    def test_forward_matches_full(self):
+        mesh = make_mesh()
+        q, k, v = _qkv(B=2, T=16, D=8, seed=6)
+        out = ring_attention(mesh, q, k, v, impl="flash")
+        ref = full_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-5
+        )
+
+    def test_forward_matches_full_longer_chunks(self):
+        # Tl = 64/8 = 8 == the kernels' min tile: no padding path.
+        mesh = make_mesh()
+        q, k, v = _qkv(B=2, T=64, D=8, seed=7)
+        out = ring_attention(mesh, q, k, v, impl="flash")
+        ref = full_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-5
+        )
+
+    def test_gradients_match_full(self):
+        """The padded-chunk case (Tl=2 -> tile 8): padded K rows alias
+        the next block's global positions and must be masked by the
+        block's REAL length, not causality alone."""
+        mesh = make_mesh()
+        q, k, v = _qkv(B=2, T=16, D=8, seed=8)
+
+        def loss_ring(a):
+            return jnp.sum(
+                jnp.square(ring_attention(mesh, *a, impl="flash"))
+            )
+
+        with jax.set_mesh(mesh):
+            g = jax.grad(loss_ring)((q, k, v))
+        gr = jax.grad(
+            lambda a: jnp.sum(jnp.square(full_attention(*a, causal=True)))
+        )((q, k, v))
+        for a, e, name in zip(g, gr, ["dq", "dk", "dv"]):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(e), atol=1e-4, err_msg=name
+            )
+
+    def test_non_causal_rejected(self):
+        from tpuflow.parallel.ring_attention import ring_attention_spmd
+
+        with pytest.raises(ValueError, match="causal"):
+            ring_attention_spmd(
+                jnp.zeros((1, 8, 4)), jnp.zeros((1, 8, 4)),
+                jnp.zeros((1, 8, 4)), causal=False, impl="flash",
+            )
+
+    def test_unknown_impl_rejected(self):
+        """A typo'd impl must not silently fall back to the jnp path."""
+        from tpuflow.parallel.ring_attention import ring_attention_spmd
+
+        with pytest.raises(ValueError, match="unknown impl"):
+            ring_attention_spmd(
+                jnp.zeros((1, 8, 4)), jnp.zeros((1, 8, 4)),
+                jnp.zeros((1, 8, 4)), impl="pallas",
+            )
+
+
 class TestRingAttentionGradients:
     @pytest.mark.parametrize("causal", [True, False])
     def test_differentiable_matches_full(self, causal):

@@ -20,8 +20,11 @@ LSTM recurrence, fused clipped-MAE). The XLA path
 Whole K/V for one batch-head are VMEM-resident per grid cell, which caps
 this kernel at T around 10-20k for typical head dims — beyond that the
 time axis should shard across chips instead (``ring_attention`` /
-``examples/long_context_cp.py``); the two compose, ring outside, flash
-inside a chunk, but the composition is not wired here.
+``examples/long_context_cp.py``). The two COMPOSE: the ring-round
+kernels at the bottom of this file run each CP ring round's block math
+blockwise in VMEM (``ring_attention(..., impl="flash")``) — ring
+outside, flash inside. The ring's custom VJP supplies differentiation,
+so the round kernels carry none of their own.
 
 On non-TPU backends the kernels run in Pallas interpret mode, so CI on
 the 8-virtual-CPU-device mesh exercises the identical code path
@@ -59,6 +62,74 @@ def _pad_time(x: jnp.ndarray, Bt: int) -> jnp.ndarray:
     return x
 
 
+def _online_block_update(q, k_blk, v_blk, m, l, acc, allowed):
+    """The flash forward recurrence for ONE (q-tile, kv-block) pair —
+    the single source of the online-softmax math, shared by the
+    standalone kernel and the CP ring-round kernel. ``q`` arrives
+    pre-scaled; everything is f32."""
+    s = jax.lax.dot_general(
+        q, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    s = jnp.where(allowed, s, _NEG)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_new[:, None]) * allowed.astype(jnp.float32)
+    corr = jnp.exp(m - m_new)
+    l = l * corr + jnp.sum(p, axis=-1)
+    acc = acc * corr[:, None] + jax.lax.dot_general(
+        p, v_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l, acc
+
+
+def _p_block(q, k_blk, lse, allowed):
+    """Backward-pass probabilities exp(s - lse) for one block pair —
+    already FINAL softmax values (not running partials), so every
+    block's contribution is correctly normalized independently."""
+    s = jax.lax.dot_general(
+        q, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    p = jnp.exp(jnp.where(allowed, s, _NEG) - lse[:, None])
+    return p * allowed.astype(jnp.float32)
+
+
+def _dq_block(q, k_blk, v_blk, do, lse, delta, allowed):
+    """One block pair's contribution to dQ (q pre-scaled; result needs
+    the final * scale applied by the caller)."""
+    p = _p_block(q, k_blk, lse, allowed)
+    dp = jax.lax.dot_general(
+        do, v_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta[:, None])
+    return jax.lax.dot_general(
+        ds, k_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _dkv_block(q, k_blk, v_blk, do, lse, delta, allowed):
+    """One block pair's contribution to (dK, dV). ``q`` arrives
+    pre-scaled, so dK needs no extra scale factor."""
+    p = _p_block(q, k_blk, lse, allowed)
+    dv = jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp = jax.lax.dot_general(
+        do, v_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta[:, None])
+    dk = jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return dk, dv
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, Bk):
     """One (batch-head, query-block) cell: stream causal K/V blocks."""
     Bq, D = q_ref.shape[1], q_ref.shape[2]
@@ -74,25 +145,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, Bk):
     n_kb = jnp.minimum((iq + 1) * Bq + Bk - 1, T) // Bk
 
     def body(kb, carry):
-        m, l, acc = carry
         k_blk = k_ref[0, pl.ds(kb * Bk, Bk)].astype(jnp.float32)  # [Bk, D]
         v_blk = v_ref[0, pl.ds(kb * Bk, Bk)].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [Bq, Bk]
         k_pos = kb * Bk + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 1)
-        allowed = k_pos <= q_pos
-        s = jnp.where(allowed, s, _NEG)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None]) * allowed.astype(jnp.float32)
-        corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p, axis=-1)
-        acc = acc * corr[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        return _online_block_update(
+            q, k_blk, v_blk, *carry, k_pos <= q_pos
         )
-        return m_new, l, acc
 
     m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
     l_safe = jnp.where(l == 0, 1.0, l)
@@ -117,22 +175,9 @@ def _dq_kernel(
     def body(kb, dq):
         k_blk = k_ref[0, pl.ds(kb * Bk, Bk)].astype(jnp.float32)
         v_blk = v_ref[0, pl.ds(kb * Bk, Bk)].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
         k_pos = kb * Bk + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 1)
-        allowed = k_pos <= q_pos
-        p = jnp.exp(jnp.where(allowed, s, _NEG) - lse[:, None])
-        p = p * allowed.astype(jnp.float32)
-        dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta[:, None])
-        return dq + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        return dq + _dq_block(
+            q, k_blk, v_blk, do, lse, delta, k_pos <= q_pos
         )
 
     dq = jax.lax.fori_loop(0, n_kb, body, jnp.zeros((Bq, D), jnp.float32))
@@ -159,28 +204,11 @@ def _dkv_kernel(
         do = do_ref[0, pl.ds(qb * Bq, Bq)].astype(jnp.float32)
         lse = lse_ref[0, pl.ds(qb * Bq, Bq)]
         delta = delta_ref[0, pl.ds(qb * Bq, Bq)]
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [Bq, Bk]
         q_pos = qb * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
-        allowed = k_pos <= q_pos
-        p = jnp.exp(jnp.where(allowed, s, _NEG) - lse[:, None])
-        p = p * allowed.astype(jnp.float32)
-        dv = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [Bk, D]
-        dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        dk_p, dv_p = _dkv_block(
+            q, k_blk, v_blk, do, lse, delta, k_pos <= q_pos
         )
-        ds = p * (dp - delta[:, None])
-        dk = dk + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [Bk, D] — note q already carries `scale`
-        return dk, dv
+        return dk + dk_p, dv + dv_p
 
     dk, dv = jax.lax.fori_loop(
         first_qb,
@@ -312,3 +340,187 @@ def _flash_bwd(scale, res, do):
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+# --------------------------------------------------------------------------
+# Ring-round kernels: flash blockwise math INSIDE the CP ring
+# (tpuflow.parallel.ring_attention impl="flash"). Each ring round attends
+# the local Q chunk to ONE visiting KV block; global positions arrive as
+# SMEM scalars because the block's origin is a traced device index. The
+# ring's custom VJP supplies differentiation, so these kernels need none.
+# --------------------------------------------------------------------------
+
+
+def _round_fwd_kernel(
+    off_ref, q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+    m_out, l_out, acc_out, *, scale, Bk, real_len,
+):
+    """Online-softmax update of one q-tile against the visiting block."""
+    Bq, D = q_ref.shape[1], q_ref.shape[2]
+    T = k_ref.shape[1]
+    iq = pl.program_id(1)
+    q_off, k_off = off_ref[0, 0], off_ref[0, 1]
+    q = q_ref[0].astype(jnp.float32) * scale
+    q_pos = q_off + iq * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
+    m = m_ref[0].astype(jnp.float32)
+    l = l_ref[0].astype(jnp.float32)
+    acc = acc_ref[0].astype(jnp.float32)
+
+    def body(kb, carry):
+        k_blk = k_ref[0, pl.ds(kb * Bk, Bk)].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * Bk, Bk)].astype(jnp.float32)
+        k_idx = kb * Bk + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 1)
+        # Padded K rows sit at global positions that ALIAS the next
+        # block's territory — causality alone would admit them; mask by
+        # the block's real length too.
+        allowed = ((k_off + k_idx) <= q_pos) & (k_idx < real_len)
+        return _online_block_update(q, k_blk, v_blk, *carry, allowed)
+
+    # Causal early-exit: sub-blocks wholly past this q-tile's last row
+    # are never visited (~half of all device-rounds carry a fully-future
+    # block and do zero loop iterations).
+    n_kb = jnp.clip(
+        (q_off + (iq + 1) * Bq - 1 - k_off) // Bk + 1, 0, T // Bk
+    )
+    m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m, l, acc))
+    m_out[0], l_out[0], acc_out[0] = m, l, acc.astype(acc_out.dtype)
+
+
+def ring_round_fwd(q, k_blk, v_blk, m, l, acc, q_off, k_off, scale):
+    """One causal ring round: update (m, l, acc) with the visiting block.
+
+    ``q [B, Tl, D]`` local queries; ``k_blk, v_blk [B, Tl, D]`` the block
+    currently held; ``m, l [B, Tl]`` / ``acc [B, Tl, D]`` f32 running
+    stats; ``q_off, k_off`` GLOBAL start positions (traced scalars).
+    """
+    B, Tl, D = q.shape
+    Bt = _block(Tl)
+    q_p = _pad_time(q, Bt)
+    k_p = _pad_time(k_blk, Bt)
+    v_p = _pad_time(v_blk, Bt)
+    T = q_p.shape[1]
+    pad = T - Tl
+    if pad:
+        # Padded q rows must stay neutral; padded k rows are masked out
+        # by causality only if their global position exceeds every real
+        # q position — guaranteed by placing them at k_off + [Tl, T).
+        m = jnp.pad(m, ((0, 0), (0, pad)), constant_values=_NEG)
+        l = jnp.pad(l, ((0, 0), (0, pad)))
+        acc = jnp.pad(acc, ((0, 0), (0, pad), (0, 0)))
+    off = jnp.stack([q_off, k_off]).astype(jnp.int32).reshape(1, 2)
+    grid = (B, T // Bt)
+    blk, whole = _specs_btd(Bt, D, T)
+    row_blk = pl.BlockSpec((1, Bt), lambda b, i: (b, i), memory_space=pltpu.VMEM)
+    smem = pl.BlockSpec((1, 2), lambda b, i: (0, 0), memory_space=pltpu.SMEM)
+
+    m2, l2, acc2 = pl.pallas_call(
+        functools.partial(_round_fwd_kernel, scale=scale, Bk=Bt, real_len=Tl),
+        grid=grid,
+        in_specs=[smem, blk, whole, whole, row_blk, row_blk, blk],
+        out_specs=[row_blk, row_blk, blk],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, T), jnp.float32),
+            jax.ShapeDtypeStruct((B, T), jnp.float32),
+            jax.ShapeDtypeStruct((B, T, D), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(off, q_p, k_p, v_p, m, l, acc)
+    return m2[:, :Tl], l2[:, :Tl], acc2[:, :Tl]
+
+
+def _round_bwd_kernel(
+    off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref, dk_ref, dv_ref, *, scale, Bt, real_len,
+):
+    """One (b, tile) cell: this round's dq for the q-tile AND the tile's
+    dk/dv rows. dq tiles over q; dk/dv tile over the SAME index on the
+    k side (both sequences have identical padded length)."""
+    T = q_ref.shape[1]
+    D = q_ref.shape[2]
+    i = pl.program_id(1)
+    q_off, k_off = off_ref[0, 0], off_ref[0, 1]
+
+    # --- dq for q-tile i: loop k sub-blocks of the visiting block ---
+    q = q_ref[0, pl.ds(i * Bt, Bt)].astype(jnp.float32) * scale
+    do = do_ref[0, pl.ds(i * Bt, Bt)].astype(jnp.float32)
+    lse = lse_ref[0, pl.ds(i * Bt, Bt)]
+    delta = delta_ref[0, pl.ds(i * Bt, Bt)]
+    q_pos = q_off + i * Bt + jax.lax.broadcasted_iota(jnp.int32, (Bt, Bt), 0)
+
+    def dq_body(kb, dq):
+        k_blk = k_ref[0, pl.ds(kb * Bt, Bt)].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * Bt, Bt)].astype(jnp.float32)
+        k_idx = kb * Bt + jax.lax.broadcasted_iota(jnp.int32, (Bt, Bt), 1)
+        allowed = ((k_off + k_idx) <= q_pos) & (k_idx < real_len)
+        return dq + _dq_block(q, k_blk, v_blk, do, lse, delta, allowed)
+
+    n_kb = jnp.clip((q_off + (i + 1) * Bt - 1 - k_off) // Bt + 1, 0, T // Bt)
+    dq = jax.lax.fori_loop(0, n_kb, dq_body, jnp.zeros((Bt, D), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+    # --- dk/dv for k-tile i: loop q sub-blocks of the local chunk ---
+    k_t = k_ref[0, pl.ds(i * Bt, Bt)].astype(jnp.float32)
+    v_t = v_ref[0, pl.ds(i * Bt, Bt)].astype(jnp.float32)
+    k_idx_t = i * Bt + jax.lax.broadcasted_iota(jnp.int32, (Bt, Bt), 1)
+    k_valid_t = k_idx_t < real_len
+    k_pos_t = k_off + k_idx_t
+
+    def dkv_body(qb, carry):
+        dk, dv = carry
+        q_b = q_ref[0, pl.ds(qb * Bt, Bt)].astype(jnp.float32) * scale
+        do_b = do_ref[0, pl.ds(qb * Bt, Bt)].astype(jnp.float32)
+        lse_b = lse_ref[0, pl.ds(qb * Bt, Bt)]
+        delta_b = delta_ref[0, pl.ds(qb * Bt, Bt)]
+        q_pos_b = q_off + qb * Bt + jax.lax.broadcasted_iota(
+            jnp.int32, (Bt, Bt), 0
+        )
+        allowed = (k_pos_t <= q_pos_b) & k_valid_t
+        dk_p, dv_p = _dkv_block(q_b, k_t, v_t, do_b, lse_b, delta_b, allowed)
+        return dk + dk_p, dv + dv_p
+
+    # Causal early-exit: q sub-blocks wholly before this k-tile's first
+    # row contribute nothing.
+    first_qb = jnp.clip((k_off + i * Bt - q_off) // Bt, 0, T // Bt)
+    dk, dv = jax.lax.fori_loop(
+        first_qb, T // Bt, dkv_body,
+        (jnp.zeros((Bt, D), jnp.float32), jnp.zeros((Bt, D), jnp.float32)),
+    )
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def ring_round_bwd(q, k_blk, v_blk, do, lse, delta, q_off, k_off, scale):
+    """One backward ring round: (dq_partial, dk_blk, dv_blk) for the
+    visiting block, from recomputed probabilities (final ``lse``)."""
+    B, Tl, D = q.shape
+    Bt = _block(Tl)
+    q_p = _pad_time(q, Bt)
+    k_p = _pad_time(k_blk, Bt)
+    v_p = _pad_time(v_blk, Bt)
+    do_p = _pad_time(do, Bt)
+    T = q_p.shape[1]
+    pad = T - Tl
+    if pad:
+        # Huge positive lse pad => p = exp(s - huge) = 0 for padded rows
+        # (a 0 pad could overflow exp and poison ds with inf * 0).
+        lse = jnp.pad(lse, ((0, 0), (0, pad)), constant_values=-_NEG)
+        delta = jnp.pad(delta, ((0, 0), (0, pad)))
+    off = jnp.stack([q_off, k_off]).astype(jnp.int32).reshape(1, 2)
+    grid = (B, T // Bt)
+    blk, whole = _specs_btd(Bt, D, T)
+    row_whole = pl.BlockSpec((1, T), lambda b, i: (b, 0), memory_space=pltpu.VMEM)
+    smem = pl.BlockSpec((1, 2), lambda b, i: (0, 0), memory_space=pltpu.SMEM)
+
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_round_bwd_kernel, scale=scale, Bt=Bt, real_len=Tl),
+        grid=grid,
+        in_specs=[smem, whole, whole, whole, whole, row_whole, row_whole],
+        out_specs=[blk, blk, blk],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B, T, D), q.dtype),
+        ],
+        interpret=_interpret(),
+    )(off, q_p, k_p, v_p, do_p, lse, delta)
+    return dq[:, :Tl], dk[:, :Tl], dv[:, :Tl]

@@ -72,6 +72,7 @@ def ring_attention(
     *,
     causal: bool = True,
     scale: float | None = None,
+    impl: str = "jnp",
 ) -> jnp.ndarray:
     """Exact attention with the time axis sharded over the mesh ring.
 
@@ -81,6 +82,8 @@ def ring_attention(
     attends the local Q chunk to the KV block currently held, then rotates
     the KV block to the right neighbor. Causal masking uses global
     positions, so the result equals single-device causal attention.
+    ``impl="flash"`` runs each round's block math in the Pallas
+    ring-round kernels — ring outside, flash inside (causal only).
     """
     n = mesh.shape[axis]
     T = q.shape[1]
@@ -91,7 +94,7 @@ def ring_attention(
 
     sharded = jax.shard_map(
         lambda ql, kl, vl: ring_attention_spmd(
-            ql, kl, vl, axis=axis, causal=causal, scale=scale
+            ql, kl, vl, axis=axis, causal=causal, scale=scale, impl=impl
         ),
         mesh=mesh,
         in_specs=(P(None, axis), P(None, axis), P(None, axis)),
@@ -116,15 +119,38 @@ def _rotate(args, axis, n):
     return tuple(lax.ppermute(a, axis, perm) for a in args)
 
 
-def _ring_fwd_core(q_local, k_local, v_local, axis, causal, scale):
-    """Forward ring pass; returns (out, lse) with lse = m + log(l)."""
+def _ring_fwd_core(q_local, k_local, v_local, axis, causal, scale, impl="jnp"):
+    """Forward ring pass; returns (out, lse) with lse = m + log(l).
+
+    ``impl="flash"`` runs each round's block math in the Pallas
+    ring-round kernel (``tpuflow.kernels.attention.ring_round_fwd``):
+    scores stay in VMEM tiles instead of a materialized [Tl, Tl] array
+    per round — ring outside, flash inside. Causal only.
+    """
     n = lax.axis_size(axis)
     B, Tl, D = q_local.shape
     idx = lax.axis_index(axis)
+    k_cur, v_cur = k_local, v_local
+    if impl == "flash":
+        from tpuflow.kernels.attention import ring_round_fwd
+
+        m = jnp.full((B, Tl), _NEG, jnp.float32)
+        l = jnp.zeros((B, Tl), jnp.float32)
+        acc = jnp.zeros((B, Tl, D), jnp.float32)
+        q_off = idx * Tl
+        for r in range(n):
+            k_off = ((idx - r) % n) * Tl
+            m, l, acc = ring_round_fwd(
+                q_local, k_cur, v_cur, m, l, acc, q_off, k_off, scale
+            )
+            if r + 1 < n:
+                k_cur, v_cur = _rotate((k_cur, v_cur), axis, n)
+        l_safe = jnp.where(l == 0, 1.0, l)
+        out = (acc / l_safe[..., None]).astype(q_local.dtype)
+        return out, m + jnp.log(l_safe)
     m = jnp.full((B, Tl), _NEG, q_local.dtype)
     l = jnp.zeros((B, Tl), q_local.dtype)
     o = jnp.zeros((B, Tl, D), q_local.dtype)
-    k_cur, v_cur = k_local, v_local
     for r in range(n):
         allowed = _round_mask(idx, r, n, Tl, causal)
         m, l, o = _block_update(q_local, k_cur, v_cur, m, l, o, allowed, scale)
@@ -144,6 +170,7 @@ def ring_attention_spmd(
     *,
     causal: bool = True,
     scale: float | None = None,
+    impl: str = "jnp",  # "jnp" | "flash" (Pallas round kernels; causal only)
 ) -> jnp.ndarray:
     """The ring-attention body, callable INSIDE an SPMD region.
 
@@ -162,22 +189,34 @@ def ring_attention_spmd(
     """
     if scale is None:
         scale = q_local.shape[-1] ** -0.5
-    return _ring_spmd(q_local, k_local, v_local, axis, causal, scale)
+    if impl not in ("jnp", "flash"):
+        # Silent fallback would report the materialized-jnp path as the
+        # blockwise kernel path.
+        raise ValueError(f'unknown impl {impl!r}; choose "jnp" or "flash"')
+    if impl == "flash" and not causal:
+        raise ValueError('impl="flash" supports causal attention only')
+    return _ring_spmd(q_local, k_local, v_local, axis, causal, scale, impl)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _ring_spmd(q_local, k_local, v_local, axis, causal, scale):
-    out, _ = _ring_fwd_core(q_local, k_local, v_local, axis, causal, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_spmd(q_local, k_local, v_local, axis, causal, scale, impl):
+    out, _ = _ring_fwd_core(
+        q_local, k_local, v_local, axis, causal, scale, impl
+    )
     return out
 
 
-def _ring_spmd_fwd(q_local, k_local, v_local, axis, causal, scale):
-    out, lse = _ring_fwd_core(q_local, k_local, v_local, axis, causal, scale)
+def _ring_spmd_fwd(q_local, k_local, v_local, axis, causal, scale, impl):
+    out, lse = _ring_fwd_core(
+        q_local, k_local, v_local, axis, causal, scale, impl
+    )
     return out, (q_local, k_local, v_local, out, lse)
 
 
-def _ring_spmd_bwd(axis, causal, scale, res, do):
+def _ring_spmd_bwd(axis, causal, scale, impl, res, do):
     q, k, v, out, lse = res
+    if impl == "flash":
+        return _ring_flash_bwd(q, k, v, out, lse, do, axis, scale)
     n = lax.axis_size(axis)
     B, Tl, D = q.shape
     idx = lax.axis_index(axis)
@@ -212,6 +251,40 @@ def _ring_spmd_bwd(axis, causal, scale, res, do):
         else:
             # Last round: only the accumulators still need to travel —
             # one final hop rides them home to their block's owner.
+            dk_cur, dv_cur = _rotate((dk_cur, dv_cur), axis, n)
+    return dq, dk_cur, dv_cur
+
+
+def _ring_flash_bwd(q, k, v, out, lse, do, axis, scale):
+    """Backward ring with the Pallas round kernel doing the block math —
+    same accumulator-rides-the-ring schedule as the jnp path."""
+    from tpuflow.kernels.attention import ring_round_bwd
+
+    n = lax.axis_size(axis)
+    B, Tl, D = q.shape
+    idx = lax.axis_index(axis)
+    do = do.astype(q.dtype)
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )
+    q_off = idx * Tl
+    dq = jnp.zeros_like(q)
+    k_cur, v_cur = k, v
+    dk_cur = jnp.zeros_like(k)
+    dv_cur = jnp.zeros_like(v)
+    for r in range(n):
+        k_off = ((idx - r) % n) * Tl
+        dq_p, dk_p, dv_p = ring_round_bwd(
+            q, k_cur, v_cur, do, lse, delta, q_off, k_off, scale
+        )
+        dq = dq + dq_p
+        dk_cur = dk_cur + dk_p
+        dv_cur = dv_cur + dv_p
+        if r + 1 < n:
+            k_cur, v_cur, dk_cur, dv_cur = _rotate(
+                (k_cur, v_cur, dk_cur, dv_cur), axis, n
+            )
+        else:
             dk_cur, dv_cur = _rotate((dk_cur, dv_cur), axis, n)
     return dq, dk_cur, dv_cur
 
