@@ -225,6 +225,27 @@ class TestAttentionRegressor:
             g_full,
         )
 
+    def test_ring_flash_backend_matches_full(self):
+        """ring_impl="flash" (composed path) through the model: same
+        params, same output as backend="full"."""
+        from tpuflow.models import AttentionRegressor
+
+        mesh = make_mesh()
+        x = jnp.asarray(
+            np.random.default_rng(6).standard_normal((2, 16, 5)), jnp.float32
+        )
+        full = AttentionRegressor(dim=16, num_layers=1, heads=2)
+        params = full.init(jax.random.PRNGKey(0), x)["params"]
+        composed = AttentionRegressor(
+            dim=16, num_layers=1, heads=2, backend="ring", mesh=mesh,
+            ring_impl="flash",
+        )
+        np.testing.assert_allclose(
+            np.asarray(composed.apply({"params": params}, x)),
+            np.asarray(full.apply({"params": params}, x)),
+            atol=1e-5,
+        )
+
     def test_ring_backend_without_mesh_raises(self):
         from tpuflow.models import AttentionRegressor
 

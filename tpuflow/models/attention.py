@@ -62,6 +62,7 @@ class EncoderBlock(nn.Module):
     dtype: Any = jnp.float32
     backend: str = "full"  # "full" | "flash" | "ring"
     mesh: Any = None  # required for backend="ring"
+    ring_impl: str = "jnp"  # ring block math: "jnp" | "flash" (composed)
 
     @nn.compact
     def __call__(self, x, *, deterministic: bool = True):
@@ -76,7 +77,9 @@ class EncoderBlock(nn.Module):
         elif self.backend == "ring":
             if self.mesh is None:
                 raise ValueError('backend="ring" needs a mesh')
-            att = ring_attention(self.mesh, q, k, v, causal=True)
+            att = ring_attention(
+                self.mesh, q, k, v, causal=True, impl=self.ring_impl
+            )
             # The quadratic [T, T] score matrix stayed blockwise inside
             # the ring; the O(T) output comes back replicated so the
             # surrounding Dense/LayerNorm grads have unambiguous
@@ -125,6 +128,7 @@ class AttentionRegressor(nn.Module):
     dtype: Any = jnp.float32
     backend: str = "full"  # "full" | "flash" | "ring" (see EncoderBlock)
     mesh: Any = None  # required for backend="ring"; T must divide its ring
+    ring_impl: str = "jnp"  # "flash" = Pallas round kernels inside the ring
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, deterministic: bool = True) -> jnp.ndarray:
@@ -142,6 +146,7 @@ class AttentionRegressor(nn.Module):
                 dtype=self.dtype,
                 backend=self.backend,
                 mesh=self.mesh,
+                ring_impl=self.ring_impl,
                 name=f"block_{i}",
             )(h, deterministic=deterministic)
         h = nn.LayerNorm(dtype=self.dtype)(h)
