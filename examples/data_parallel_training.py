@@ -25,7 +25,7 @@ def main():
     n = jax.device_count()
     report = train(
         TrainJobConfig(
-            model="stacked_lstm",
+            model="lstm_residual",  # physics-informed: starts AT the Gilbert baseline
             window=24,
             max_epochs=10,
             batch_size=32 * n,  # global batch: 32 per device

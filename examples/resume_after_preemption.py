@@ -40,7 +40,7 @@ def main():
           f"best val {r1.result.best_val_loss:.4f}")
 
     # Phase 2: a fresh process resumes from the latest run checkpoint.
-    r2 = train(TrainJobConfig(max_epochs=10, resume=True, **base))
+    r2 = train(TrainJobConfig(max_epochs=30, resume=True, **base))
     print(f"after resume:      reached epoch {r2.result.epochs_ran}, "
           f"best val {r2.result.best_val_loss:.4f}")
     print(r2.summary())
