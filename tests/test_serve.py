@@ -436,3 +436,43 @@ class TestExperimentJobValidation:
             time.sleep(0.3)
         assert rec["status"] == "done", rec
         assert {m for _, m in evicted} == {"static_mlp", "gilbert_residual"}
+
+
+class TestMetrics:
+    def test_counters_track_jobs_and_cache(self, server, tmp_path):
+        status, m0 = _get(server + "/metrics")
+        assert status == 200
+        assert m0["jobs"]["submitted"] == m0["jobs"]["done"] + m0["jobs"][
+            "failed"
+        ] + m0["jobs"]["queued"] + m0["jobs"]["running"]
+
+        _post(
+            server + "/jobs",
+            {"model": "static_mlp", "epochs": 1, "batchSize": 32,
+             "storagePath": str(tmp_path), "n_devices": 1,
+             "synthetic_wells": 4, "synthetic_steps": 64},
+        )
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            _, m = _get(server + "/metrics")
+            if m["jobs"]["done"] > m0["jobs"]["done"]:
+                break
+            time.sleep(0.4)
+        assert m["jobs"]["submitted"] == m0["jobs"]["submitted"] + 1
+        assert m["jobs"]["done"] == m0["jobs"]["done"] + 1
+        assert m["uptime_s"] >= 0
+
+        # Two predicts over one artifact: one load, one cache hit.
+        spec = {"storagePath": str(tmp_path), "model": "static_mlp",
+                "columns": {"pressure": [1500.0], "choke": [32.0],
+                            "glr": [400.0], "temperature": [80.0],
+                            "water_cut": [0.2]}}
+        p0 = m["predict"]
+        _post(server + "/predict", spec)
+        _post(server + "/predict", spec)
+        _, m2 = _get(server + "/metrics")
+        assert m2["predict"]["requests"] == p0["requests"] + 2
+        assert m2["predict"]["loads"] == p0["loads"] + 1
+        assert m2["predict"]["cache_hits"] == p0["cache_hits"] + 1
+        # The finished train job evicted its artifact at least once.
+        assert m2["predict"]["invalidations"] >= 1

@@ -18,6 +18,9 @@ API (JSON in/out):
   ``{"storagePath", "model", "data": <csv path>}`` or
   ``{"storagePath", "model", "columns": {name: [values...]}}`` →
   ``{"predictions": [...], "count"}``. Loaded artifacts are cached.
+- ``GET  /metrics``     — service counters: jobs
+  submitted/done/failed/queued/running, predictor cache
+  hits/loads/invalidations, uptime.
 - ``GET  /health``      — liveness probe.
 
 The spec accepts the reference's camelCase submission fields
@@ -119,6 +122,7 @@ class JobRunner:
         self._jobs: dict[str, dict] = {}
         self._lock = threading.Lock()
         self._on_artifact_change = on_artifact_change
+        self.stats = {"submitted": 0, "done": 0, "failed": 0}
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
@@ -159,6 +163,7 @@ class JobRunner:
         record = {"job_id": job_id, "status": "queued", "spec": spec}
         with self._lock:
             self._jobs[job_id] = record
+            self.stats["submitted"] += 1
         self._queue.put((job_id, kind, config))
         return {"job_id": job_id, "status": "queued"}
 
@@ -177,6 +182,18 @@ class JobRunner:
     def _set(self, job_id: str, **updates):
         with self._lock:
             self._jobs[job_id].update(updates)
+
+    def metrics(self) -> dict:
+        """One consistent snapshot: counters and live-status tallies from
+        the same lock acquisition, so submitted == done + failed +
+        queued + running always holds in a /metrics response."""
+        with self._lock:
+            statuses = [r["status"] for r in self._jobs.values()]
+            return {
+                **self.stats,
+                "queued": statuses.count("queued"),
+                "running": statuses.count("running"),
+            }
 
     def _run(self):
         while True:
@@ -201,14 +218,16 @@ class JobRunner:
                 # that polls to completion and immediately predicts must
                 # never see the pre-retrain cache entry.
                 self._notify_artifact(config, kind)
-                self._set(
-                    job_id,
-                    status="failed",
-                    error=f"{type(e).__name__}: {e}",
-                )
+                with self._lock:  # status + counter move atomically
+                    self._jobs[job_id].update(
+                        status="failed", error=f"{type(e).__name__}: {e}"
+                    )
+                    self.stats["failed"] += 1
                 continue
             self._notify_artifact(config, kind)
-            self._set(job_id, status="done", report=rep)
+            with self._lock:
+                self._jobs[job_id].update(status="done", report=rep)
+                self.stats["done"] += 1
 
     @staticmethod
     def _failed_rows(rpt, ident) -> list[dict]:
@@ -288,6 +307,9 @@ class PredictService:
         self._cache: dict[tuple[str, str], object] = {}
         self._lock = threading.Lock()  # guards the dicts, never held on load
         self._key_locks: dict[tuple[str, str], threading.Lock] = {}
+        self.stats = {
+            "requests": 0, "cache_hits": 0, "loads": 0, "invalidations": 0,
+        }
         # Invalidation generation per key: a load that STARTED before an
         # invalidate() must not re-cache its (stale) result after it.
         self._gen: dict[tuple[str, str], int] = {}
@@ -298,6 +320,7 @@ class PredictService:
         with self._lock:
             self._cache.pop(key, None)
             self._gen[key] = self._gen.get(key, 0) + 1
+            self.stats["invalidations"] += 1
 
     def _predictor(self, storage_path: str, name: str):
         from tpuflow.api.predict_api import Predictor
@@ -306,6 +329,7 @@ class PredictService:
         with self._lock:
             cached = self._cache.get(key)
             if cached is not None:
+                self.stats["cache_hits"] += 1
                 return cached
             key_lock = self._key_locks.setdefault(key, threading.Lock())
         # Load under the PER-KEY lock only: a cold (possibly seconds-long
@@ -315,8 +339,10 @@ class PredictService:
             with self._lock:
                 cached = self._cache.get(key)
                 if cached is not None:
+                    self.stats["cache_hits"] += 1
                     return cached
                 gen = self._gen.get(key, 0)
+                self.stats["loads"] += 1
             loaded = Predictor.load(storage_path, name)
             with self._lock:
                 if self._gen.get(key, 0) == gen:
@@ -328,6 +354,8 @@ class PredictService:
     def predict(self, spec: dict) -> dict:
         import numpy as np
 
+        with self._lock:
+            self.stats["requests"] += 1
         storage = spec.get("storagePath") or spec.get("storage_path")
         name = spec.get("model") or spec.get("name")
         if not storage or not name:
@@ -348,6 +376,9 @@ class PredictService:
 
 def make_server(host: str = "127.0.0.1", port: int = 8700) -> ThreadingHTTPServer:
     """Build the HTTP server (caller drives serve_forever / shutdown)."""
+    import time as _time
+
+    started = _time.monotonic()  # immune to wall-clock steps
     predictor = PredictService()
     # Retraining an artifact this process has served must evict the cached
     # Predictor, or /predict would keep returning the old model forever.
@@ -375,6 +406,12 @@ def make_server(host: str = "127.0.0.1", port: int = 8700) -> ThreadingHTTPServe
                 self._send(200, {"status": "ok"})
             elif route == "/jobs":
                 self._send(200, runner.list())
+            elif route == "/metrics":
+                self._send(200, {
+                    "jobs": runner.metrics(),
+                    "predict": dict(predictor.stats),
+                    "uptime_s": round(_time.monotonic() - started, 1),
+                })
             elif len(parts) == 3 and parts[1] == "jobs":
                 rec = runner.get(parts[2])
                 if rec is None:
