@@ -162,3 +162,24 @@ def test_unserializable_model_kwargs_fail_before_training(tmp_path):
                 n_devices=1,
             )
         )
+
+
+def test_same_seed_runs_are_bit_identical():
+    """End-to-end determinism: two fresh train() runs with one seed give
+    identical metrics — the property resume's bit-identical-trajectory
+    guarantee (tpuflow/train/resume.py) is built on."""
+    cfg = dict(
+        model="lstm",
+        max_epochs=3,
+        batch_size=32,
+        seed=7,
+        verbose=False,
+        n_devices=1,
+        synthetic_wells=4,
+        synthetic_steps=96,
+    )
+    r1 = train(TrainJobConfig(**cfg))
+    r2 = train(TrainJobConfig(**cfg))
+    assert r1.test_loss == r2.test_loss
+    assert r1.test_mae == r2.test_mae
+    assert r1.result.best_val_loss == r2.result.best_val_loss

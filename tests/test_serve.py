@@ -446,17 +446,22 @@ class TestMetrics:
             "failed"
         ] + m0["jobs"]["queued"] + m0["jobs"]["running"]
 
-        _post(
+        status, sub = _post(
             server + "/jobs",
             {"model": "static_mlp", "epochs": 1, "batchSize": 32,
              "storagePath": str(tmp_path), "n_devices": 1,
              "synthetic_wells": 4, "synthetic_steps": 64},
         )
+        assert status == 202, sub
         deadline = time.time() + 240
         while time.time() < deadline:
             _, m = _get(server + "/metrics")
             if m["jobs"]["done"] > m0["jobs"]["done"]:
                 break
+            if m["jobs"]["failed"] > m0["jobs"]["failed"]:
+                # Fail fast with the actual error, not a counter mismatch.
+                _, rec = _get(server + f"/jobs/{sub['job_id']}")
+                raise AssertionError(f"job failed: {rec.get('error')}")
             time.sleep(0.4)
         assert m["jobs"]["submitted"] == m0["jobs"]["submitted"] + 1
         assert m["jobs"]["done"] == m0["jobs"]["done"] + 1

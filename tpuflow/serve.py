@@ -342,8 +342,11 @@ class PredictService:
                     self.stats["cache_hits"] += 1
                     return cached
                 gen = self._gen.get(key, 0)
-                self.stats["loads"] += 1
             loaded = Predictor.load(storage_path, name)
+            with self._lock:
+                # Counted only AFTER a successful load: a missing/corrupt
+                # artifact that raises must not inflate the loads number.
+                self.stats["loads"] += 1
             with self._lock:
                 if self._gen.get(key, 0) == gen:
                     self._cache[key] = loaded
