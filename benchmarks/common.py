@@ -91,7 +91,9 @@ def _timed_passes(run_n, seconds: float) -> tuple[int, float]:
         elapsed = run_n(n)
         if elapsed >= seconds:
             return n, elapsed
-        n = int(n * min(max(2.0, 1.3 * seconds / elapsed), 10.0))
+        # max(elapsed, 1e-9): a degenerate timer reading 0.0 must grow n
+        # (by the capped 10x factor), not raise ZeroDivisionError.
+        n = int(n * min(max(2.0, 1.3 * seconds / max(elapsed, 1e-9)), 10.0))
 
 
 def time_steps(step_fn, *args, seconds: float = 5.0, block) -> tuple[int, float]:
