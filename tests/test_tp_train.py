@@ -1,0 +1,167 @@
+"""Tensor-parallel TRAINING end-to-end (VERDICT r3 #5: a model must
+*train* with a model axis, not just pass block grad-parity).
+
+``TrainJobConfig(tp=2)`` routes train() through the GSPMD megatron
+layout (parallel/tp_train.py) on a (data, model) mesh: params sharded
+column->row across the model axis, batch sharded across the data axis,
+XLA inserting both all-reduces. Loss parity vs the single-device run is
+the proof the sharded program computes the same training trajectory.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpuflow.api import TrainJobConfig, train
+from tpuflow.parallel.mesh import MODEL_AXIS
+from tpuflow.parallel.tp_train import (
+    make_tp_mesh,
+    make_tp_train_step,
+    mlp_tp_shardings,
+    shard_state,
+)
+
+BASE = dict(
+    model="static_mlp",
+    model_kwargs={"hidden": (16, 16)},
+    max_epochs=3,
+    batch_size=32,
+    verbose=False,
+    synthetic_wells=4,
+    synthetic_steps=64,
+    seed=0,
+)
+
+
+def _state_and_mesh(n_data=2, n_model=2, hidden=(16, 16)):
+    from tpuflow.models import StaticMLP
+    from tpuflow.train import create_state
+
+    mesh = make_tp_mesh(
+        n_data=n_data, n_model=n_model,
+        devices=jax.devices()[: n_data * n_model],
+    )
+    x = np.random.default_rng(0).standard_normal((8, 6)).astype(np.float32)
+    state = create_state(StaticMLP(hidden=hidden), jax.random.PRNGKey(0), x[:2])
+    return mesh, state, x
+
+
+class TestShardings:
+    def test_megatron_layout(self):
+        mesh, state, _ = _state_and_mesh()
+        sh = mlp_tp_shardings(mesh, state.params)
+        assert sh["Dense_0"]["kernel"].spec == P(None, MODEL_AXIS)  # column
+        assert sh["Dense_0"]["bias"].spec == P(MODEL_AXIS)
+        assert sh["Dense_1"]["kernel"].spec == P(MODEL_AXIS, None)  # row
+        assert sh["Dense_1"]["bias"].spec == P()
+        assert sh["Dense_2"]["kernel"].spec == P()  # head replicated
+
+    def test_params_and_momentum_land_sharded(self):
+        mesh, state, _ = _state_and_mesh()
+        state = shard_state(mesh, state, mlp_tp_shardings(mesh, state.params))
+        k0 = state.params["Dense_0"]["kernel"]
+        assert k0.sharding.spec == P(None, MODEL_AXIS)
+        # The SGD momentum trace mirrors the param layout — a replicated
+        # trace against sharded params would all-gather every step.
+        traces = [
+            s
+            for s in jax.tree.leaves(
+                state.opt_state,
+                is_leaf=lambda t: hasattr(t, "keys")
+                and jax.tree.structure(t) == jax.tree.structure(state.params),
+            )
+            if hasattr(s, "keys")
+        ]
+        assert traces, "momentum trace not found in opt_state"
+        assert (
+            traces[0]["Dense_0"]["kernel"].sharding.spec
+            == P(None, MODEL_AXIS)
+        )
+
+    def test_indivisible_hidden_rejected(self):
+        mesh, state, _ = _state_and_mesh(hidden=(15, 16))
+        with pytest.raises(ValueError, match="not divisible"):
+            mlp_tp_shardings(mesh, state.params)
+
+    def test_non_dense_family_rejected(self):
+        from tpuflow.models import LSTMRegressor
+        from tpuflow.train import create_state
+
+        mesh = make_tp_mesh(
+            n_data=2, n_model=2, devices=jax.devices()[:4]
+        )
+        x = np.zeros((2, 8, 5), np.float32)
+        state = create_state(
+            LSTMRegressor(hidden=8), jax.random.PRNGKey(0), x
+        )
+        with pytest.raises(ValueError, match="Dense-stack"):
+            mlp_tp_shardings(mesh, state.params)
+
+
+class TestTpStep:
+    def test_step_preserves_layout_and_matches_single_device(self):
+        """One sharded step == one single-device step, and the updated
+        state keeps the megatron layout (no silent resharding)."""
+        mesh, state, x = _state_and_mesh()
+        y = np.random.default_rng(1).standard_normal((8,)).astype(np.float32)
+
+        from tpuflow.core.losses import mae_clip
+        from tpuflow.train import make_train_step
+
+        # donate=False: on the CPU backend device_put's replicated copy
+        # can share the source buffer on the origin device, so donating
+        # the original state would delete buffers tp_state still uses.
+        tp_state = shard_state(mesh, state, mlp_tp_shardings(mesh, state.params))
+        ref_state, ref_metrics = make_train_step(mae_clip, donate=False)(
+            state, x, y, jax.random.PRNGKey(2)
+        )
+        step = make_tp_train_step(tp_state, mae_clip)
+        tp_state, metrics = step(tp_state, x, y, jax.random.PRNGKey(2))
+
+        assert float(metrics["loss"]) == pytest.approx(
+            float(ref_metrics["loss"]), rel=1e-6
+        )
+        k0 = tp_state.params["Dense_0"]["kernel"]
+        assert k0.sharding.spec == P(None, MODEL_AXIS)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            ),
+            jax.tree.map(np.asarray, tp_state.params),
+            jax.tree.map(np.asarray, ref_state.params),
+        )
+
+
+class TestTrainConfigTp:
+    def test_tp_run_matches_dp_only_loss(self):
+        """train(tp=2) on a (4, 2) mesh reproduces the single-device
+        training trajectory — the model-axis run is the same math."""
+        ref = train(TrainJobConfig(**BASE, n_devices=1))
+        tp = train(TrainJobConfig(**BASE, n_devices=8, tp=2))
+        # Per-epoch loss parity, not just the endpoint: the whole fit ran
+        # through the sharded step.
+        for a, b in zip(tp.result.history, ref.result.history):
+            assert a["loss"] == pytest.approx(b["loss"], rel=1e-4)
+            assert a["val_loss"] == pytest.approx(b["val_loss"], rel=1e-4)
+        assert tp.test_mae == pytest.approx(ref.test_mae, rel=1e-4)
+
+    def test_tp_rejects_bad_division(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            train(TrainJobConfig(**BASE, n_devices=8, tp=3))
+
+    def test_tp_rejects_jit_epoch(self):
+        with pytest.raises(ValueError, match="jit_epoch"):
+            train(
+                TrainJobConfig(**BASE, n_devices=8, tp=2, jit_epoch=True)
+            )
+
+    def test_tp_rejects_non_mlp_family(self):
+        cfg = dataclasses.replace(
+            TrainJobConfig(**{**BASE, "model_kwargs": {}}, n_devices=8, tp=2),
+            model="lstm",
+        )
+        with pytest.raises(ValueError, match="Dense-stack"):
+            train(cfg)

@@ -72,6 +72,11 @@ class TrainJobConfig:
 
     # --- parallelism ---
     n_devices: int | None = None  # None -> all visible devices; 1 -> no DP
+    # Tensor parallelism: size of the model axis of the (data, model)
+    # mesh. n_devices/tp devices do DP; each replica's params are sharded
+    # megatron-style across tp devices (GSPMD; MLP families only — see
+    # parallel/tp_train.py). 1 = off.
+    tp: int = 1
 
     @property
     def is_sequence_model(self) -> bool:

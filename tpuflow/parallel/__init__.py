@@ -39,3 +39,10 @@ from tpuflow.parallel.tp import (  # noqa: F401
     row_parallel_matmul,
     tp_mlp_forward,
 )
+from tpuflow.parallel.tp_train import (  # noqa: F401
+    make_tp_eval_step,
+    make_tp_mesh,
+    make_tp_train_step,
+    mlp_tp_shardings,
+    shard_state,
+)

@@ -20,11 +20,16 @@ def make_mesh(
     n_data: int | None = None,
     n_model: int = 1,
     devices=None,
+    axis_types=None,
 ) -> Mesh:
     """Build a ``(data, model)`` mesh over the available devices.
 
     Defaults to all devices on the data axis — the reference family's only
-    parallelism (SURVEY.md §2 "Parallelism strategies").
+    parallelism (SURVEY.md §2 "Parallelism strategies"). ``axis_types``
+    passes through to ``jax.make_mesh`` (default: JAX's Explicit axes,
+    right for the shard_map paths); the GSPMD tensor-parallel trainer
+    passes Auto so the compiler propagates shardings through the model
+    (see parallel/tp_train.py).
     """
     devices = devices if devices is not None else jax.devices()
     if n_data is None:
@@ -34,7 +39,10 @@ def make_mesh(
             f"mesh {n_data}x{n_model} != {len(devices)} devices"
         )
     return jax.make_mesh(
-        (n_data, n_model), (DATA_AXIS, MODEL_AXIS), devices=devices
+        (n_data, n_model),
+        (DATA_AXIS, MODEL_AXIS),
+        axis_types=axis_types,
+        devices=devices,
     )
 
 

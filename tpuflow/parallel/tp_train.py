@@ -1,0 +1,196 @@
+"""Tensor-parallel TRAINING for the MLP families (GSPMD, megatron layout).
+
+``tp.py`` provides the explicit shard_map column->row blocks and their
+grad-parity proofs; this module makes a model family actually *train*
+with a model axis, reachable from ``train(config)`` via
+``TrainJobConfig(tp=N)``. It uses the scaling-book recipe directly: build
+a ``(data, model)`` mesh, annotate the param layout (alternating
+column/row-parallel Dense kernels — the megatron pattern of
+``tp.tp_mlp_forward``), and let XLA insert the collectives when the
+ordinary train step is jitted over the mesh:
+
+- batch sharded on ``data``  -> gradient all-reduce (DP),
+- hidden dim sharded on ``model`` -> one activation all-reduce per
+  column->row pair (TP), exactly the psum ``tp._mlp_fn`` writes by hand.
+
+The reference has no TP (SURVEY.md §2: its models are KBs), so this is a
+beyond-parity capability; it exists so a family that outgrows one chip's
+HBM shards its feature dimensions without leaving ``fit()``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpuflow.core.losses import mae_clip
+from tpuflow.parallel.mesh import MODEL_AXIS, make_mesh
+
+LossFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+
+def make_tp_mesh(n_data: int, n_model: int, devices=None):
+    """A ``(data, model)`` mesh with AUTO axis types: the trainer relies
+    on GSPMD propagating the megatron param shardings through the model
+    body (JAX 0.9's default Explicit axes would instead demand per-op
+    ``out_sharding`` annotations on the sharded contractions)."""
+    from jax.sharding import AxisType
+
+    return make_mesh(
+        n_data=n_data,
+        n_model=n_model,
+        devices=devices,
+        axis_types=(AxisType.Auto, AxisType.Auto),
+    )
+
+_DENSE = re.compile(r"^Dense_(\d+)$")
+
+
+def mlp_tp_shardings(mesh: Mesh, params, axis: str = MODEL_AXIS):
+    """Megatron layout for a Dense-stack params tree (Static/DynamicMLP).
+
+    Hidden layers alternate column-parallel (kernel ``[F, H]`` sharded on
+    H, bias sharded) and row-parallel (kernel ``[H, F]`` sharded on H,
+    bias replicated); the final Dense (the scalar head) is replicated.
+    Raises for non-Dense-stack trees — silently replicating everything
+    would "work" while quietly not being tensor parallel at all.
+    """
+    n_model = mesh.shape[axis]
+    names = list(params.keys())
+    idx = {}
+    for name in names:
+        m = _DENSE.match(name)
+        if m is None or set(params[name].keys()) - {"kernel", "bias"}:
+            raise ValueError(
+                f"tp training supports Dense-stack MLP families; got layer "
+                f"{name!r} (params: {sorted(params[name].keys()) if hasattr(params[name], 'keys') else type(params[name])})"
+            )
+        idx[name] = int(m.group(1))
+    ordered = sorted(names, key=idx.__getitem__)
+    hidden, head = ordered[:-1], ordered[-1]
+    if not hidden:
+        raise ValueError(
+            "tp training needs at least one hidden Dense layer to shard; "
+            "a head-only MLP would silently train fully replicated"
+        )
+
+    rep = NamedSharding(mesh, P())
+    out = {}
+    for pos, name in enumerate(hidden):
+        kernel = params[name]["kernel"]
+        column = pos % 2 == 0
+        dim = kernel.shape[1] if column else kernel.shape[0]
+        if dim % n_model:
+            raise ValueError(
+                f"{name} hidden dim {dim} not divisible by {axis}={n_model}"
+            )
+        if column:
+            out[name] = {
+                "kernel": NamedSharding(mesh, P(None, axis)),
+                "bias": NamedSharding(mesh, P(axis)),
+            }
+        else:
+            out[name] = {
+                "kernel": NamedSharding(mesh, P(axis, None)),
+                "bias": rep,
+            }
+    out[head] = {"kernel": rep, "bias": rep}
+    return out
+
+
+def shard_state(mesh: Mesh, state, param_shardings):
+    """Lay a TrainState out over the mesh: params (and every params-shaped
+    optimizer buffer, e.g. the SGD momentum trace) in the TP layout,
+    everything else replicated."""
+    rep = NamedSharding(mesh, P())
+    ptreedef = jax.tree.structure(state.params)
+
+    params = jax.tree.map(jax.device_put, state.params, param_shardings)
+
+    def _params_like(sub) -> bool:
+        if isinstance(sub, jax.Array) or not hasattr(sub, "keys"):
+            return False
+        try:
+            return jax.tree.structure(sub) == ptreedef
+        except TypeError:
+            return False
+
+    def _put(sub):
+        if _params_like(sub):
+            # Momentum (etc.) must shard exactly like its params: a
+            # replicated trace against sharded params would silently
+            # all-gather every step.
+            return jax.tree.map(jax.device_put, sub, param_shardings)
+        return jax.device_put(sub, rep)
+
+    opt_state = jax.tree.map(_put, state.opt_state, is_leaf=_params_like)
+    return state.replace(
+        step=jax.device_put(state.step, rep),
+        params=params,
+        opt_state=opt_state,
+    )
+
+
+def state_shardings(state):
+    """The sharding pytree of an already-laid-out TrainState (for
+    ``out_shardings``: the step must hand back the layout it received,
+    never let GSPMD re-shard mid-run)."""
+    return jax.tree.map(lambda x: x.sharding, state)
+
+
+def make_tp_train_step(state, loss_fn: LossFn = mae_clip):
+    """Jitted (state, x, y, rng) -> (state, metrics) over the state's mesh.
+
+    The body is the ordinary single-chip step — no explicit collectives.
+    GSPMD derives them from the shardings: pmean-equivalent gradient
+    all-reduce over ``data``, the megatron activation psum over ``model``
+    (the hand-written pattern in ``tp._mlp_fn``, compiler-inserted).
+    ``state`` is the already-sharded TrainState (its shardings pin the
+    output layout).
+    """
+    sh = state_shardings(state)
+    mesh = jax.tree.leaves(sh)[0].mesh
+    rep = NamedSharding(mesh, P())
+
+    def step(state, x, y, rng):
+        dropout_rng = jax.random.fold_in(rng, state.step)
+
+        def loss_of(params):
+            pred = state.apply_fn(
+                {"params": params},
+                x,
+                deterministic=False,
+                rngs={"dropout": dropout_rng},
+            )
+            return loss_fn(y, pred)
+
+        loss, grads = jax.value_and_grad(loss_of)(state.params)
+        state = state.apply_gradients(grads=grads)
+        return state, {"loss": loss}
+
+    return jax.jit(
+        step,
+        donate_argnums=(0,),
+        out_shardings=(sh, {"loss": rep}),
+    )
+
+
+def make_tp_eval_step(loss_fn: LossFn = mae_clip):
+    """Jitted masked-sum eval step (same contract as train.make_eval_step);
+    shardings propagate from the operands."""
+
+    def step(state, x, y, mask):
+        pred = state.apply_fn({"params": state.params}, x, deterministic=True)
+        per_loss = jax.vmap(loss_fn)(y, pred)
+        per_mae = jnp.abs(y - pred).reshape(y.shape[0], -1).mean(axis=1)
+        return {
+            "loss_sum": jnp.sum(per_loss * mask),
+            "mae_sum": jnp.sum(per_mae * mask),
+            "count": jnp.sum(mask),
+        }
+
+    return jax.jit(step)
