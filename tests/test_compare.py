@@ -72,9 +72,13 @@ class TestDataCache:
         assert len({keys["lstm"], keys["dynamic_mlp"], keys["static_mlp"],
                     keys["gilbert_residual"], keys["lstm_residual"]}) == 5
 
-    def test_cached_run_matches_uncached(self):
+    def test_cached_run_matches_uncached(self, monkeypatch):
         from tpuflow.api.train_api import train
 
+        # The executable _prep_key contract: every cache hit in this run
+        # recomputes the preparation and asserts equality, so a config
+        # field _prepare_data reads but _prep_key misses fails loudly.
+        monkeypatch.setenv("TPUFLOW_CHECK_PREP_CACHE", "1")
         base = TrainJobConfig(model="lstm", max_epochs=2, batch_size=32,
                               verbose=False, synthetic_wells=4,
                               synthetic_steps=64, n_devices=1)
@@ -87,3 +91,51 @@ class TestDataCache:
         r_plain = train(base)
         assert r_cached.test_mae == pytest.approx(r_plain.test_mae, rel=1e-6)
         assert np.isfinite(r_warm.test_mae)
+
+    def test_prep_cache_guard_detects_aliasing(self):
+        """_assert_prep_equivalent must actually fire on a divergent
+        preparation — the guard the _prep_key contract leans on."""
+        import copy
+
+        from tpuflow.api.train_api import (
+            _assert_prep_equivalent,
+            _prep_key,
+            _prepare_data,
+        )
+        from tpuflow.data.schema import Schema
+
+        base = TrainJobConfig(model="static_mlp", max_epochs=1,
+                              batch_size=32, verbose=False,
+                              synthetic_wells=4, synthetic_steps=64,
+                              n_devices=1)
+        from tpuflow.api.train_api import (
+            SYNTHETIC_COLUMN_NAMES,
+            SYNTHETIC_COLUMN_TYPES,
+            SYNTHETIC_TARGET,
+        )
+
+        schema = Schema.from_cli(
+            SYNTHETIC_COLUMN_NAMES, SYNTHETIC_COLUMN_TYPES, SYNTHETIC_TARGET
+        )
+        prep = _prepare_data(base, schema, SYNTHETIC_TARGET)
+        _assert_prep_equivalent(prep, prep, base)  # identical: passes
+
+        # Simulate the aliasing failure: the "cached" prep was built from
+        # different data than a fresh one would produce.
+        mutated = copy.copy(prep)
+        mutated.train_ds = prep.train_ds._replace(
+            x=np.asarray(prep.train_ds.x) + 1.0
+        )
+        with pytest.raises(AssertionError, match="_prep_key aliasing"):
+            _assert_prep_equivalent(mutated, prep, base)
+        # And a seed change produces a different preparation end-to-end.
+        other = _prepare_data(
+            dataclasses.replace(base, seed=base.seed + 1),
+            schema,
+            SYNTHETIC_TARGET,
+        )
+        assert _prep_key(base) != _prep_key(
+            dataclasses.replace(base, seed=base.seed + 1)
+        )
+        with pytest.raises(AssertionError, match="_prep_key aliasing"):
+            _assert_prep_equivalent(other, prep, base)

@@ -1,0 +1,268 @@
+"""Job-runner control plane: cancellation, per-job timeouts, bounded queue.
+
+Before these existed, a hung or runaway job wedged the whole service
+forever (the chip-serial worker loop) and the queue accepted unbounded
+backlog. Fast paths are tested at the JobRunner level with a stubbed
+``_execute``; the cooperative stop path (cancel/timeout observed between
+epochs) runs real training through the HTTP server.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpuflow.serve import JobRunner, make_server
+
+SPEC = {"model": "static_mlp", "epochs": 2}
+
+
+class _BlockingExecute:
+    """Stands in for JobRunner._execute: blocks until released, records
+    the stop_fn so tests can drive the cooperative path directly."""
+
+    def __init__(self, ignore_stop: bool = False):
+        self.release = threading.Event()
+        self.started = threading.Event()
+        self.stop_fns: list = []
+        # True models a job whose last epoch finishes before the loop
+        # would next poll stop_fn: the work completes despite the cancel.
+        self.ignore_stop = ignore_stop
+
+    def __call__(self, kind, config, stop_fn=None):
+        self.stop_fns.append(stop_fn)
+        self.started.set()
+        assert self.release.wait(timeout=30)
+        from tpuflow.train.loop import TrainingInterrupted
+
+        reason = stop_fn() if (stop_fn and not self.ignore_stop) else None
+        if reason:
+            raise TrainingInterrupted(reason)
+        return {"ok": True}
+
+
+@pytest.fixture
+def blocked_runner(monkeypatch):
+    ex = _BlockingExecute()
+    monkeypatch.setattr(JobRunner, "_execute", ex)
+    runner = JobRunner(max_queued=2)
+    yield runner, ex
+    ex.release.set()  # let the worker drain
+
+
+def _wait(predicate, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class TestCancelQueued:
+    def test_queued_job_cancels_immediately(self, blocked_runner):
+        runner, ex = blocked_runner
+        running = runner.submit(SPEC)["job_id"]
+        assert ex.started.wait(timeout=10)
+        queued = runner.submit(SPEC)["job_id"]
+
+        res = runner.cancel(queued)
+        assert res == {"job_id": queued, "status": "cancelled"}
+        assert runner.get(queued)["status"] == "cancelled"
+        assert runner.metrics()["cancelled"] == 1
+
+        # The worker skips the stale queue entry and finishes the rest.
+        ex.release.set()
+        assert _wait(lambda: runner.get(running)["status"] == "done")
+        assert runner.get(queued)["status"] == "cancelled"
+
+    def test_cancel_unknown_job_is_none(self, blocked_runner):
+        runner, _ = blocked_runner
+        assert runner.cancel("deadbeef") is None
+
+    def test_cancel_terminal_job_conflicts(self, blocked_runner):
+        runner, ex = blocked_runner
+        job = runner.submit(SPEC)["job_id"]
+        ex.release.set()
+        assert _wait(lambda: runner.get(job)["status"] == "done")
+        res = runner.cancel(job)
+        assert res["conflict"] is True and res["status"] == "done"
+
+
+class TestCancelRunning:
+    def test_running_job_cancels_cooperatively(self, blocked_runner):
+        runner, ex = blocked_runner
+        job = runner.submit(SPEC)["job_id"]
+        assert ex.started.wait(timeout=10)
+
+        res = runner.cancel(job)
+        assert res == {"job_id": job, "status": "cancelling"}
+        assert runner.get(job)["status"] == "cancelling"
+        # The stop_fn the worker handed to _execute now reports the cancel.
+        assert ex.stop_fns[0]() == "cancelled"
+
+        ex.release.set()  # _execute observes the stop and raises
+        assert _wait(lambda: runner.get(job)["status"] == "cancelled")
+        assert runner.get(job)["error"] == "cancelled while running"
+        assert runner.metrics()["cancelled"] == 1
+        assert runner.metrics()["running"] == 0
+
+    def test_cancel_after_work_finished_reports_done(self, monkeypatch):
+        # The cancel raced the last epoch and lost: the work completed
+        # before the loop observed the stop — the job reports done with
+        # its report intact (the cancel was a no-op).
+        ex = _BlockingExecute(ignore_stop=True)
+        monkeypatch.setattr(JobRunner, "_execute", ex)
+        runner = JobRunner(max_queued=2)
+        job = runner.submit(SPEC)["job_id"]
+        assert ex.started.wait(timeout=10)
+        assert runner.cancel(job)["status"] == "cancelling"
+        ex.release.set()
+        assert _wait(lambda: runner.get(job)["status"] == "done")
+        assert runner.get(job)["report"] == {"ok": True}
+        assert runner.metrics()["cancelled"] == 0
+
+
+class TestTimeouts:
+    def test_per_job_timeout_reported(self, blocked_runner):
+        runner, ex = blocked_runner
+        job = runner.submit({**SPEC, "timeoutSeconds": 0.05})["job_id"]
+        assert ex.started.wait(timeout=10)
+        # Let the budget lapse, then release: stop_fn reports the timeout.
+        assert _wait(lambda: ex.stop_fns[0]() is not None, timeout=5)
+        assert "timeout after" in ex.stop_fns[0]()
+        ex.release.set()
+        assert _wait(lambda: runner.get(job)["status"] == "failed")
+        assert "timeout after" in runner.get(job)["error"]
+        assert runner.metrics()["failed"] == 1
+
+    def test_default_timeout_applies(self, monkeypatch):
+        ex = _BlockingExecute()
+        monkeypatch.setattr(JobRunner, "_execute", ex)
+        runner = JobRunner(default_timeout=0.05)
+        runner.submit(SPEC)
+        assert ex.started.wait(timeout=10)
+        assert _wait(lambda: ex.stop_fns[0]() is not None, timeout=5)
+        assert "timeout after" in ex.stop_fns[0]()
+        ex.release.set()
+
+    def test_invalid_timeout_rejected(self, blocked_runner):
+        runner, _ = blocked_runner
+        with pytest.raises(ValueError, match="timeoutSeconds"):
+            runner.submit({**SPEC, "timeoutSeconds": 0})
+
+
+class TestBoundedQueue:
+    def test_submit_past_capacity_raises_and_rolls_back(self, blocked_runner):
+        runner, ex = blocked_runner  # max_queued=2
+        running = runner.submit(SPEC)["job_id"]
+        assert ex.started.wait(timeout=10)
+        q1 = runner.submit(SPEC)["job_id"]
+        q2 = runner.submit(SPEC)["job_id"]
+        before = runner.metrics()
+
+        with pytest.raises(queue.Full):
+            runner.submit(SPEC)
+
+        after = runner.metrics()
+        assert after == before  # no phantom job record survives the 429
+        assert {running, q1, q2} == {j["job_id"] for j in runner.list()}
+
+        # Cancelling a queued job frees its admission slot immediately —
+        # capacity is the LIVE queued count, not stale queue entries.
+        runner.cancel(q2)
+        replacement = runner.submit(SPEC)["job_id"]
+        with pytest.raises(queue.Full):
+            runner.submit(SPEC)
+        assert runner.get(replacement)["status"] == "queued"
+        ex.release.set()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+def _request(url, method, payload=None):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode() if payload is not None else None,
+        headers={"Content-Type": "application/json"},
+        method=method,
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture
+def server():
+    srv = make_server("127.0.0.1", 0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+LONG_JOB = {
+    # Effectively endless at test scale: early stopping can't fire
+    # (patience == epochs) and the budget is thousands of fast epochs.
+    "model": "static_mlp",
+    "epochs": 100000,
+    "patience": 100000,
+    "batchSize": 32,
+    "n_devices": 1,
+    "synthetic_wells": 4,
+    "synthetic_steps": 64,
+}
+
+
+@pytest.mark.slow
+class TestHTTPControlPlane:
+    def test_delete_cancels_a_real_running_job(self, server):
+        """End-to-end cooperative cancel: real training, stopped between
+        epochs by DELETE /jobs/<id>."""
+        status, body = _request(server + "/jobs", "POST", LONG_JOB)
+        assert status == 202
+        job = body["job_id"]
+        assert _wait(
+            lambda: _get(server + f"/jobs/{job}")[1]["status"] == "running",
+            timeout=60,
+        )
+        status, body = _request(server + f"/jobs/{job}", "DELETE")
+        assert status == 200
+        assert body["status"] in ("cancelling", "cancelled")
+        assert _wait(
+            lambda: _get(server + f"/jobs/{job}")[1]["status"] == "cancelled",
+            timeout=60,
+        )
+        # A second DELETE of the now-terminal job conflicts.
+        status, body = _request(server + f"/jobs/{job}", "DELETE")
+        assert status == 409
+
+    def test_timeout_fails_a_real_running_job(self, server):
+        """End-to-end per-job budget: real training, stopped between
+        epochs when timeoutSeconds lapses."""
+        status, body = _request(
+            server + "/jobs", "POST", {**LONG_JOB, "timeoutSeconds": 3}
+        )
+        assert status == 202
+        job = body["job_id"]
+        assert _wait(
+            lambda: _get(server + f"/jobs/{job}")[1]["status"] == "failed",
+            timeout=120,
+        )
+        rec = _get(server + f"/jobs/{job}")[1]
+        assert "timeout after 3" in rec["error"]
+
+    def test_delete_unknown_job_404(self, server):
+        status, _ = _request(server + "/jobs/deadbeef", "DELETE")
+        assert status == 404

@@ -113,13 +113,19 @@ class ComparisonReport(RankedByMAE):
 def compare(
     models: tuple[str, ...] = DEFAULT_MODELS,
     base_config: TrainJobConfig | None = None,
+    stop_fn=None,
 ) -> ComparisonReport:
     """Train every model family on the same data and seed; rank by MAE.
 
     ``base_config`` carries the shared data/training settings; its
     ``model`` field is overridden per run. A failing model is recorded,
-    not fatal — the comparison is the deliverable.
+    not fatal — the comparison is the deliverable. ``stop_fn`` (see
+    ``train``) aborts the whole comparison: a cancellation/timeout must
+    not be swallowed as one FAILED row while the remaining models train
+    anyway.
     """
+    from tpuflow.train.loop import TrainingInterrupted
+
     base = base_config or TrainJobConfig(max_epochs=40, batch_size=256)
     report = ComparisonReport()
     # One ingest+feature pass per distinct preparation, not per model:
@@ -130,7 +136,9 @@ def compare(
     for name in models:
         config = dataclasses.replace(base, model=name)
         try:
-            r = train(config, _data_cache=data_cache)
+            r = train(config, _data_cache=data_cache, stop_fn=stop_fn)
+        except TrainingInterrupted:
+            raise
         except Exception as e:  # record and keep comparing
             report.results.append(
                 ModelResult(

@@ -89,18 +89,24 @@ class SweepReport(RankedByMAE):
 def sweep(
     grid: Mapping[str, Sequence[Any]],
     base_config: TrainJobConfig | None = None,
+    stop_fn=None,
 ) -> SweepReport:
     """Train every combination of ``grid`` and rank by held-out MAE.
 
     ``grid`` maps field names (see ``_apply``) to candidate values; the
     cartesian product is trained with the base config's data and seed. A
     failing point is recorded, not fatal — the ranking is the deliverable.
+    ``stop_fn`` (see ``train``) aborts the whole sweep: a cancellation/
+    timeout must not be swallowed as FAILED rows while the rest of the
+    grid trains anyway.
 
     Example::
 
         sweep({"model_kwargs.hidden": [32, 64], "batch_size": [64, 256]},
               TrainJobConfig(model="lstm", max_epochs=20))
     """
+    from tpuflow.train.loop import TrainingInterrupted
+
     base = base_config or TrainJobConfig(max_epochs=40, batch_size=256)
     names = list(grid)
     # Typos fail HERE, before any training: inside the per-point
@@ -115,7 +121,9 @@ def sweep(
         assignment = dict(zip(names, values))
         try:
             config = _apply(base, assignment)
-            r = train(config, _data_cache=data_cache)
+            r = train(config, _data_cache=data_cache, stop_fn=stop_fn)
+        except TrainingInterrupted:
+            raise
         except Exception as e:  # record and keep sweeping
             report.results.append(
                 SweepResult(

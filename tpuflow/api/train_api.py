@@ -11,6 +11,7 @@ over a device mesh.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass
 
@@ -149,6 +150,15 @@ def _prep_key(config: TrainJobConfig) -> tuple:
     streaming knobs (incl. batch_size, which only the stream sources
     bake into their batch iterators) enter the key only when streaming,
     so e.g. a batch-size sweep over materialized data is one prep.
+
+    MAINTENANCE CONTRACT: any new config field read inside
+    ``_prepare_data`` (or a new model-specific branch there) MUST be
+    added to this tuple, or cache hits will silently hand one model
+    another model's data preparation. The guard is executable:
+    ``TPUFLOW_CHECK_PREP_CACHE=1`` makes every cache hit recompute the
+    preparation and compare (``_assert_prep_equivalent``) — the
+    experiment tests run with it on, so a missed field fails CI instead
+    of corrupting sweeps.
     """
     stream_fields = (
         (
@@ -168,6 +178,38 @@ def _prep_key(config: TrainJobConfig) -> tuple:
         config.is_sequence_model, config.teacher_forcing,
         config.model in ("gilbert_residual", "lstm_residual"),
     )
+
+
+def _assert_prep_equivalent(cached: _Prepared, fresh: _Prepared, config) -> None:
+    """Raise if a ``_data_cache`` hit differs from a fresh preparation.
+
+    Only run under ``TPUFLOW_CHECK_PREP_CACHE=1`` (it recomputes the whole
+    ingest+feature phase per hit). A mismatch means ``_prepare_data`` now
+    reads a config field ``_prep_key`` doesn't cover — the silent-aliasing
+    failure mode where one model trains on another model's preparation.
+    """
+
+    def _fail(what: str):
+        raise AssertionError(
+            f"_prep_key aliasing for model {config.model!r}: cached {what} "
+            "differs from a fresh preparation — _prepare_data reads a "
+            "config field _prep_key doesn't cover (see _prep_key's "
+            "maintenance contract)"
+        )
+
+    for name in ("target_std", "gilbert_test", "seq_physics"):
+        if getattr(cached, name) != getattr(fresh, name):
+            _fail(name)
+    for name in ("train_ds", "val_ds", "test_ds"):
+        c, f = getattr(cached, name), getattr(fresh, name)
+        if not (hasattr(c, "x") and hasattr(f, "x")):
+            continue  # streaming sources: per-epoch iterators, no arrays
+        cx, fx = np.asarray(c.x), np.asarray(f.x)
+        cy, fy = np.asarray(c.y), np.asarray(f.y)
+        if cx.shape != fx.shape or not np.array_equal(cx, fx):
+            _fail(f"{name}.x")
+        if cy.shape != fy.shape or not np.array_equal(cy, fy):
+            _fail(f"{name}.y")
 
 
 def _prepare_data(
@@ -386,7 +428,10 @@ def _prepare_data(
 
 
 def train(
-    config: TrainJobConfig, *, _data_cache: dict | None = None
+    config: TrainJobConfig,
+    *,
+    _data_cache: dict | None = None,
+    stop_fn=None,
 ) -> TrainReport:
     """Run the whole pipeline for one job config; see the module docstring.
 
@@ -394,8 +439,19 @@ def train(
     the ingest+feature phase across runs that prepare identical data —
     keyed by ``_prep_key``, scoped to the dict the caller passes, so
     nothing outlives the experiment that created it.
+
+    ``stop_fn`` (optional ``() -> str | None``) is polled before data
+    preparation and between epochs; a non-None string aborts the run with
+    ``TrainingInterrupted(reason)`` — the job-runner's cancellation and
+    per-job-timeout hook.
     """
     init_distributed()
+    if stop_fn is not None:
+        reason = stop_fn()
+        if reason:
+            from tpuflow.train.loop import TrainingInterrupted
+
+            raise TrainingInterrupted(reason)
     t0 = time.time()
 
     names = config.column_names or SYNTHETIC_COLUMN_NAMES
@@ -437,6 +493,13 @@ def train(
             # multiply peak host memory.
             _data_cache.clear()
             prep = _data_cache[key] = _prepare_data(config, schema, target)
+        elif os.environ.get("TPUFLOW_CHECK_PREP_CACHE"):
+            # Executable _prep_key contract (see its docstring): a hit
+            # must equal a fresh preparation, or the key is missing a
+            # field _prepare_data has started reading.
+            _assert_prep_equivalent(
+                prep, _prepare_data(config, schema, target), config
+            )
     else:
         prep = _prepare_data(config, schema, target)
     train_ds, val_ds, test_ds = prep.train_ds, prep.val_ds, prep.test_ds
@@ -466,10 +529,53 @@ def train(
     sample_x = val_ds.x[:2] if config.stream else train_ds.x[:2]
     state = create_state(model, jax.random.PRNGKey(config.seed), sample_x, tx)
 
-    # --- parallelism: DP over the mesh when >1 device ---
+    # --- parallelism: DP over the mesh when >1 device; DP x TP when
+    # config.tp > 1 (GSPMD megatron layout, parallel/tp_train.py) ---
     n_dev = config.n_devices or jax.device_count()
     train_step = eval_step = epoch_step = None
-    if n_dev > 1:
+    batch_shard = None
+    if config.tp > 1:
+        if jax.process_count() > 1:
+            # The TP path has no per-process batch slicing (the DP
+            # branch's _local/process_batch_bounds machinery); feeding a
+            # pod-global sharding from one host would crash mid-epoch.
+            raise ValueError(
+                "tp>1 is single-host for now; multi-host TP needs "
+                "per-process batch feeding (see the DP branch)"
+            )
+        if config.jit_epoch:
+            raise ValueError(
+                "tp>1 trains through the per-batch GSPMD step; jit_epoch "
+                "is not supported with tensor parallelism"
+            )
+        if n_dev % config.tp:
+            raise ValueError(
+                f"n_devices {n_dev} not divisible by tp={config.tp}"
+            )
+        if config.batch_size % (n_dev // config.tp):
+            raise ValueError(
+                f"batch_size {config.batch_size} not divisible by "
+                f"{n_dev // config.tp} data-parallel devices"
+            )
+        from tpuflow.parallel.tp_train import (
+            make_tp_eval_step,
+            make_tp_mesh,
+            make_tp_train_step,
+            mlp_tp_shardings,
+            shard_state,
+        )
+
+        mesh = make_tp_mesh(
+            n_data=n_dev // config.tp,
+            n_model=config.tp,
+            devices=jax.devices()[:n_dev],
+        )
+        # Fails loudly for non-Dense-stack families (mlp_tp_shardings).
+        state = shard_state(mesh, state, mlp_tp_shardings(mesh, state.params))
+        train_step = make_tp_train_step(state, loss_fn)
+        eval_step = make_tp_eval_step(loss_fn)
+        batch_shard = data_sharding(mesh)
+    elif n_dev > 1:
         if config.batch_size % n_dev:
             raise ValueError(
                 f"batch_size {config.batch_size} not divisible by {n_dev} devices"
@@ -515,6 +621,13 @@ def train(
             def epoch_step(state, xs, ys, rng):  # noqa: F811
                 return dp_epoch(state, _put_epoch(xs), _put_epoch(ys), rng)
 
+        # DP runs: land prefetched batches pre-sharded over the mesh so
+        # the step's shard_batch is a no-op instead of a device0
+        # re-transfer. Single-host only — a pod-global device_put from one
+        # host would fail; multi-host feeding goes through _local above.
+        if jax.process_count() == 1:
+            batch_shard = data_sharding(mesh)
+
     # --- fit (the reference's hot loop, cnn.py:126-129) ---
     fit_cfg = FitConfig(
         max_epochs=config.max_epochs,
@@ -531,6 +644,7 @@ def train(
         fault_epoch=config.fault_epoch,
         trace_dir=config.trace_dir,
         metrics_path=config.metrics_path,
+        stop_fn=stop_fn,
     )
     result = fit(
         state,
@@ -539,23 +653,23 @@ def train(
         fit_cfg,
         train_step,
         eval_step,
-        # DP runs: land prefetched batches pre-sharded over the mesh so the
-        # step's shard_batch is a no-op instead of a device0 re-transfer.
-        # Single-host only — a pod-global device_put from one host would
-        # fail; multi-host feeding goes through the _local slicing above.
-        batch_sharding=(
-            data_sharding(mesh)
-            if n_dev > 1 and jax.process_count() == 1
-            else None
-        ),
+        batch_sharding=batch_shard,
         epoch_step=epoch_step,
     )
 
     # --- final evaluation (cnn.py:132-134, working) ---
+    # Batch sizing: reuse the fit loop's eval shape (config.batch_size)
+    # whenever the test split fits in a few such batches — the eval step
+    # is already compiled at that shape, and a new 256-wide program would
+    # cost a fresh XLA compile to save microseconds. Only single-chip
+    # runs over genuinely large test splits get the wider batch.
+    eval_bs = config.batch_size
+    if n_dev == 1 and test_ds.n > 4 * config.batch_size:
+        eval_bs = max(config.batch_size, 256)
     test = evaluate(
         result.state,
         test_ds,
-        batch_size=max(config.batch_size, 256 if n_dev == 1 else config.batch_size),
+        batch_size=eval_bs,
         eval_step=eval_step,
         loss=loss_fn,
     )

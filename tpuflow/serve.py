@@ -11,9 +11,14 @@ report next to the model artifact where the web component reads it
 
 API (JSON in/out):
 
-- ``POST /jobs``        — submit a job spec; returns ``{"job_id", "status"}``.
+- ``POST /jobs``        — submit a job spec; returns ``{"job_id", "status"}``
+  (``429`` when the bounded queue is full).
 - ``GET  /jobs``        — list all jobs (summaries).
 - ``GET  /jobs/<id>``   — one job: status, spec, report or error.
+- ``DELETE /jobs/<id>`` — cancel: a queued job is cancelled immediately; a
+  running job is cancelled cooperatively at its next epoch boundary
+  (status ``cancelling`` until the worker observes it); terminal jobs
+  return ``409``.
 - ``POST /predict``     — serve a trained artifact synchronously:
   ``{"storagePath", "model", "data": <csv path>}`` or
   ``{"storagePath", "model", "columns": {name: [values...]}}`` →
@@ -27,7 +32,18 @@ The spec accepts the reference's camelCase submission fields
 (``columnNames``, ``columnTypes``, ``targetColumn``, ``storagePath``,
 ``data``, ``epochs``, ``batchSize``) as well as any snake_case
 ``TrainJobConfig`` field. Jobs run ONE at a time on a background worker —
-the chip is a serial resource; queued jobs wait their turn.
+the chip is a serial resource; queued jobs wait their turn. The queue is
+bounded (``JobRunner(max_queued=...)``, default 64): past that, POST
+/jobs returns 429 instead of accepting unbounded backlog.
+
+Per-job runtime budget: ``{"timeoutSeconds": N}`` (or
+``timeout_seconds``) in the spec caps the job's RUNNING time — measured
+from when the worker starts it, not submission — after which it fails
+with a timeout error. ``JobRunner(default_timeout=...)`` applies one to
+every job that doesn't set its own. Both cancellation and timeouts are
+cooperative (checked between training epochs, and between the runs of a
+compare/sweep): one enormous epoch or an XLA compile is not
+interruptible, but a hung job no longer wedges the service forever.
 
 Two experiment job kinds ride the same queue (the reference's "tests ...
 using multiple model types" workflow, Readme.md:13, web-triggered):
@@ -117,12 +133,25 @@ class JobRunner:
     changed the artifact (the predict cache must drop it either way).
     """
 
-    def __init__(self, on_artifact_change=None):
+    def __init__(
+        self,
+        on_artifact_change=None,
+        max_queued: int = 64,
+        default_timeout: float | None = None,
+    ):
+        # Unbounded Queue; admission control is by LIVE queued count in
+        # submit() (under the lock), not Queue(maxsize=...): a cancelled
+        # queued job leaves a stale entry in the Queue until the worker
+        # pops it, and counting those against capacity would keep
+        # returning 429 on a logically empty queue.
         self._queue: queue.Queue = queue.Queue()
+        self.max_queued = max_queued
+        self.default_timeout = default_timeout
         self._jobs: dict[str, dict] = {}
+        self._cancel_events: dict[str, threading.Event] = {}
         self._lock = threading.Lock()
         self._on_artifact_change = on_artifact_change
-        self.stats = {"submitted": 0, "done": 0, "failed": 0}
+        self.stats = {"submitted": 0, "done": 0, "failed": 0, "cancelled": 0}
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
@@ -130,6 +159,19 @@ class JobRunner:
         base = dict(spec)
         compare_models = base.pop("compare", None)
         sweep_grid = base.pop("sweep", None)
+        timeout_s = base.pop("timeoutSeconds", None)
+        if timeout_s is None:
+            timeout_s = base.pop("timeout_seconds", None)
+        else:
+            base.pop("timeout_seconds", None)
+        if timeout_s is None:
+            timeout_s = self.default_timeout
+        if timeout_s is not None:
+            timeout_s = float(timeout_s)
+            if timeout_s <= 0:
+                raise ValueError(
+                    f"timeoutSeconds must be > 0, got {timeout_s}"
+                )
         if compare_models is not None and sweep_grid is not None:
             raise ValueError("a job is either 'compare' or 'sweep', not both")
         config = spec_to_config(base)  # validate before queueing
@@ -162,10 +204,42 @@ class JobRunner:
         job_id = uuid.uuid4().hex[:12]
         record = {"job_id": job_id, "status": "queued", "spec": spec}
         with self._lock:
+            queued = sum(
+                1 for r in self._jobs.values() if r["status"] == "queued"
+            )
+            if queued >= self.max_queued:
+                raise queue.Full(
+                    f"job queue full ({queued} queued, max {self.max_queued})"
+                )
             self._jobs[job_id] = record
+            self._cancel_events[job_id] = threading.Event()
             self.stats["submitted"] += 1
-        self._queue.put((job_id, kind, config))
+        self._queue.put((job_id, kind, config, timeout_s))
         return {"job_id": job_id, "status": "queued"}
+
+    def cancel(self, job_id: str) -> dict | None:
+        """Cancel a job. Queued: cancelled immediately (the worker skips
+        the stale queue entry when it pops it). Running: the cancel event
+        is set and the job stops cooperatively at its next epoch boundary
+        (status ``cancelling`` meanwhile). Terminal: ``{"conflict": True}``
+        — there is nothing left to cancel. Unknown id: ``None``."""
+        with self._lock:
+            rec = self._jobs.get(job_id)
+            if rec is None:
+                return None
+            status = rec["status"]
+            if status == "queued":
+                rec.update(status="cancelled", error="cancelled while queued")
+                self.stats["cancelled"] += 1
+                self._cancel_events.pop(job_id, None)
+                return {"job_id": job_id, "status": "cancelled"}
+            if status in ("running", "cancelling"):
+                rec["status"] = "cancelling"
+                event = self._cancel_events.get(job_id)
+                if event is not None:
+                    event.set()
+                return {"job_id": job_id, "status": "cancelling"}
+            return {"job_id": job_id, "status": status, "conflict": True}
 
     def get(self, job_id: str) -> dict | None:
         with self._lock:
@@ -179,10 +253,6 @@ class JobRunner:
                 for r in self._jobs.values()
             ]
 
-    def _set(self, job_id: str, **updates):
-        with self._lock:
-            self._jobs[job_id].update(updates)
-
     def metrics(self) -> dict:
         """One consistent snapshot: counters and live-status tallies from
         the same lock acquisition, so submitted == done + failed +
@@ -192,15 +262,39 @@ class JobRunner:
             return {
                 **self.stats,
                 "queued": statuses.count("queued"),
-                "running": statuses.count("running"),
+                # A job being cancelled is still occupying the chip.
+                "running": statuses.count("running")
+                + statuses.count("cancelling"),
             }
 
     def _run(self):
+        import time as _time
+
+        from tpuflow.train.loop import TrainingInterrupted
+
         while True:
-            job_id, kind, config = self._queue.get()
-            self._set(job_id, status="running")
+            job_id, kind, config, timeout_s = self._queue.get()
+            with self._lock:
+                rec = self._jobs.get(job_id)
+                if rec is None or rec["status"] == "cancelled":
+                    continue  # cancelled while queued: stale entry
+                rec["status"] = "running"
+                cancel_event = self._cancel_events.setdefault(
+                    job_id, threading.Event()
+                )
+            deadline = (
+                _time.monotonic() + timeout_s if timeout_s is not None else None
+            )
+
+            def stop_fn(ev=cancel_event, deadline=deadline, t=timeout_s):
+                if ev.is_set():
+                    return "cancelled"
+                if deadline is not None and _time.monotonic() > deadline:
+                    return f"timeout after {t:g}s"
+                return None
+
             try:
-                rep = self._execute(kind, config)
+                rep = self._execute(kind, config, stop_fn)
                 # Inside the try: a failed report write (unwritable dir,
                 # missing gs:// backend, ...) must fail THIS job, not kill
                 # the worker thread and silently wedge the whole queue.
@@ -213,12 +307,30 @@ class JobRunner:
                     with open_file(path, "w", encoding="utf-8") as f:
                         json.dump(rep, f, indent=2)
                     rep["report_path"] = path
+            except TrainingInterrupted as e:
+                # Partial checkpoints may already be on disk — evict the
+                # predict cache exactly like any other terminal state.
+                self._notify_artifact(config, kind)
+                with self._lock:
+                    self._cancel_events.pop(job_id, None)
+                    if e.reason == "cancelled":
+                        self._jobs[job_id].update(
+                            status="cancelled", error="cancelled while running"
+                        )
+                        self.stats["cancelled"] += 1
+                    else:  # timeout
+                        self._jobs[job_id].update(
+                            status="failed", error=f"TrainingInterrupted: {e}"
+                        )
+                        self.stats["failed"] += 1
+                continue
             except Exception as e:
                 # Evict BEFORE publishing the terminal status: a client
                 # that polls to completion and immediately predicts must
                 # never see the pre-retrain cache entry.
                 self._notify_artifact(config, kind)
                 with self._lock:  # status + counter move atomically
+                    self._cancel_events.pop(job_id, None)
                     self._jobs[job_id].update(
                         status="failed", error=f"{type(e).__name__}: {e}"
                     )
@@ -226,6 +338,9 @@ class JobRunner:
                 continue
             self._notify_artifact(config, kind)
             with self._lock:
+                self._cancel_events.pop(job_id, None)
+                # A cancel that landed after the last epoch finished: the
+                # work is done; report it done (the cancel was a no-op).
                 self._jobs[job_id].update(status="done", report=rep)
                 self.stats["done"] += 1
 
@@ -234,16 +349,16 @@ class JobRunner:
         # RankedByMAE.failed is the single source of the failure predicate.
         return [{**ident(r), "error": reason} for r, reason in rpt.failed]
 
-    def _execute(self, kind, config) -> dict:
+    def _execute(self, kind, config, stop_fn=None) -> dict:
         name, arg = kind
         if name == "train":
             from tpuflow.api import train
 
-            return report_to_dict(train(config))
+            return report_to_dict(train(config, stop_fn=stop_fn))
         if name == "compare":
             from tpuflow.api import compare
 
-            rpt = compare(arg, config)
+            rpt = compare(arg, config, stop_fn=stop_fn)
             return {
                 "table": rpt.table(),
                 "ranked": [
@@ -258,7 +373,7 @@ class JobRunner:
             }
         from tpuflow.api import sweep
 
-        rpt = sweep(arg, config)
+        rpt = sweep(arg, config, stop_fn=stop_fn)
         return {
             "table": rpt.table(),
             "ranked": [
@@ -313,6 +428,12 @@ class PredictService:
         # Invalidation generation per key: a load that STARTED before an
         # invalidate() must not re-cache its (stale) result after it.
         self._gen: dict[tuple[str, str], int] = {}
+
+    def metrics(self) -> dict:
+        """Counter snapshot under the lock — one consistent view, matching
+        JobRunner.metrics()'s discipline."""
+        with self._lock:
+            return dict(self.stats)
 
     def invalidate(self, storage_path: str, name: str) -> None:
         """Drop a cached artifact (called when a job rewrites it)."""
@@ -377,7 +498,12 @@ class PredictService:
         return {"predictions": y.tolist(), "count": int(len(y))}
 
 
-def make_server(host: str = "127.0.0.1", port: int = 8700) -> ThreadingHTTPServer:
+def make_server(
+    host: str = "127.0.0.1",
+    port: int = 8700,
+    max_queued: int = 64,
+    default_timeout: float | None = None,
+) -> ThreadingHTTPServer:
     """Build the HTTP server (caller drives serve_forever / shutdown)."""
     import time as _time
 
@@ -385,7 +511,11 @@ def make_server(host: str = "127.0.0.1", port: int = 8700) -> ThreadingHTTPServe
     predictor = PredictService()
     # Retraining an artifact this process has served must evict the cached
     # Predictor, or /predict would keep returning the old model forever.
-    runner = JobRunner(on_artifact_change=predictor.invalidate)
+    runner = JobRunner(
+        on_artifact_change=predictor.invalidate,
+        max_queued=max_queued,
+        default_timeout=default_timeout,
+    )
 
     class Handler(BaseHTTPRequestHandler):
         def _send(self, code: int, payload: dict | list):
@@ -412,7 +542,7 @@ def make_server(host: str = "127.0.0.1", port: int = 8700) -> ThreadingHTTPServe
             elif route == "/metrics":
                 self._send(200, {
                     "jobs": runner.metrics(),
-                    "predict": dict(predictor.stats),
+                    "predict": predictor.metrics(),
                     "uptime_s": round(_time.monotonic() - started, 1),
                 })
             elif len(parts) == 3 and parts[1] == "jobs":
@@ -438,6 +568,11 @@ def make_server(host: str = "127.0.0.1", port: int = 8700) -> ThreadingHTTPServe
             if route == "/jobs":
                 try:
                     self._send(202, runner.submit(self._read_spec()))
+                except queue.Full:
+                    self._send(429, {
+                        "error": f"job queue full (max {runner.max_queued}); "
+                        "retry after a job finishes"
+                    })
                 except (ValueError, TypeError, json.JSONDecodeError) as e:
                     self._send(400, {"error": str(e)})
             elif route == "/predict":
@@ -452,6 +587,23 @@ def make_server(host: str = "127.0.0.1", port: int = 8700) -> ThreadingHTTPServe
                     self._send(400, {"error": str(e)})
                 except Exception as e:  # missing artifact, bad columns, ...
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            else:
+                self._send(404, {"error": f"no route {self.path!r}"})
+
+        def do_DELETE(self):
+            route = self._route()
+            parts = route.split("/")
+            if len(parts) == 3 and parts[1] == "jobs":
+                res = runner.cancel(parts[2])
+                if res is None:
+                    self._send(404, {"error": f"no job {parts[2]!r}"})
+                elif res.pop("conflict", False):
+                    self._send(409, {
+                        **res,
+                        "error": f"job already {res['status']}",
+                    })
+                else:
+                    self._send(200, res)
             else:
                 self._send(404, {"error": f"no route {self.path!r}"})
 
@@ -473,9 +625,22 @@ def main(argv=None) -> int:
     )
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8700)
+    p.add_argument(
+        "--max-queued", type=int, default=64,
+        help="bounded job queue size; POST /jobs returns 429 past it",
+    )
+    p.add_argument(
+        "--default-timeout", type=float, default=None,
+        help="per-job runtime budget in seconds for jobs that don't set "
+        "timeoutSeconds (cooperative, between epochs)",
+    )
     args = p.parse_args(argv)
 
-    server = make_server(args.host, args.port)
+    server = make_server(
+        args.host, args.port,
+        max_queued=args.max_queued,
+        default_timeout=args.default_timeout,
+    )
 
     def _stop(signum, frame):
         threading.Thread(target=server.shutdown, daemon=True).start()

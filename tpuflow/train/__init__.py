@@ -21,6 +21,7 @@ from tpuflow.train.loop import (  # noqa: F401
     FitConfig,
     FitResult,
     StreamingSource,
+    TrainingInterrupted,
     evaluate,
     fit,
 )

@@ -24,6 +24,21 @@ from tpuflow.train.checkpoint import BestCheckpointer
 from tpuflow.train.steps import make_eval_step, make_train_step
 
 
+class TrainingInterrupted(RuntimeError):
+    """Raised between epochs when ``FitConfig.stop_fn`` requests a stop.
+
+    ``reason`` is the stop_fn's string ("cancelled", "timeout after 60s",
+    ...). Checkpoints already written stay on disk (the fit loop's finally
+    block drains async writes), so an interrupted job's partial artifact is
+    durable — the job-runner uses this for cancellation and per-job
+    timeouts (SURVEY.md §3.2's web-trigger layer, hardened).
+    """
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
 class StreamingSource:
     """Out-of-core train source for ``fit``: a factory of per-epoch batch
     iterators instead of in-memory arrays.
@@ -82,6 +97,12 @@ class FitConfig:
     # supervisor's detect-and-restart path is exercised for real
     # (tests/test_supervisor.py).
     fault_epoch: int | None = None
+    # Cooperative cancellation/timeout: called at the top of every epoch;
+    # a non-None string stops the run by raising
+    # ``TrainingInterrupted(reason)``. Between-epoch granularity: a single
+    # enormous epoch (or a long XLA compile) is not interruptible — the
+    # job-runner documents the same.
+    stop_fn: Callable[[], str | None] | None = None
 
 
 @dataclass
@@ -191,6 +212,10 @@ def fit(
 
     try:
         for epoch in range(start_epoch, config.max_epochs + 1):
+            if config.stop_fn is not None:
+                reason = config.stop_fn()
+                if reason:
+                    raise TrainingInterrupted(reason)
             te = time.time()
             tracing = config.trace_dir is not None and epoch == start_epoch
             if tracing:
