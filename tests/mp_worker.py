@@ -1,0 +1,105 @@
+"""Worker program for the REAL multi-process DP test.
+
+Launched by ``tests/test_multiprocess.py`` as ``python mp_worker.py
+<pid> <nprocs> <port>``. Every process runs this same program — the
+multi-host recipe from ``tpuflow/parallel/distributed.py``'s docstring,
+executed for real: ``jax.distributed.initialize`` against a localhost
+coordinator (CPU backend, Gloo collectives), a mesh spanning both
+processes' devices, per-process data loading via ``process_batch_bounds``,
+global-batch assembly via ``shard_batch``'s
+``make_array_from_process_local_data`` branch, and one DP train step.
+
+The single-process reference runs INLINE in the test process on an
+identically-shaped 2-device mesh: with no dropout the DP math is
+process-count-invariant, so the 2-process run must reproduce the
+reference loss and updated params to float tolerance. (nprocs=1 also
+works here as a subprocess reference; the inline one saves a third of
+the test's wall-clock on the single-core CI machine.)
+
+Prints one JSON line: {"pid", "processes", "assembled_multi", "loss",
+"param_sum"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+TOTAL_DEVICES = 2
+
+
+def main() -> None:
+    pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+
+    # Env must be pinned BEFORE the first jax import: CPU backend with
+    # exactly TOTAL_DEVICES/nprocs local virtual devices per process
+    # (replacing any inherited xla_force_host_platform_device_count).
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={TOTAL_DEVICES // nprocs}"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from tpuflow.models import StaticMLP
+    from tpuflow.parallel.distributed import init_distributed
+    from tpuflow.parallel.dp import (
+        make_dp_train_step,
+        process_batch_bounds,
+        replicate,
+        shard_batch,
+    )
+    from tpuflow.parallel.mesh import make_mesh
+    from tpuflow.train import create_state
+
+    if nprocs > 1:
+        assert init_distributed(f"localhost:{port}", nprocs, pid)
+        assert jax.process_count() == nprocs, jax.process_count()
+    assert jax.device_count() == TOTAL_DEVICES, jax.device_count()
+
+    mesh = make_mesh()
+
+    # Every process generates the same GLOBAL dataset deterministically,
+    # then loads only its own slice — the cluster-resident-data pattern
+    # (each host reads global_batch/process_count rows). Data and model
+    # are mirrored by tests/test_multiprocess.py's inline reference.
+    global_batch, n_features = 32, 6
+    rng = np.random.default_rng(0)
+    x_global = rng.standard_normal((global_batch, n_features)).astype(np.float32)
+    y_global = rng.standard_normal((global_batch,)).astype(np.float32)
+    lo, hi = process_batch_bounds(global_batch)
+    x_local, y_local = x_global[lo:hi], y_global[lo:hi]
+
+    state = replicate(
+        mesh, create_state(StaticMLP(), jax.random.PRNGKey(0), x_global[:2])
+    )
+    step = make_dp_train_step(mesh)
+    # On a multi-process runtime this takes _assemble's
+    # make_array_from_process_local_data branch — the branch this test
+    # exists to execute for real (tpuflow/parallel/dp.py).
+    xs, ys = shard_batch(mesh, x_local, y_local)
+    state, metrics = step(state, xs, ys, jax.random.PRNGKey(1))
+
+    param_sum = float(
+        sum(float(abs(p).sum()) for p in jax.tree.leaves(state.params))
+    )
+    print(
+        json.dumps(
+            {
+                "pid": pid,
+                "processes": jax.process_count(),
+                "assembled_multi": jax.process_count() > 1,
+                "loss": float(metrics["loss"]),
+                "param_sum": param_sum,
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

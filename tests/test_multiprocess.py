@@ -1,0 +1,118 @@
+"""REAL multi-process distributed training (no fakes).
+
+Launches two actual OS processes that ``jax.distributed.initialize``
+against a localhost coordinator (CPU backend, Gloo collectives), build a
+mesh spanning both processes' devices, assemble the global batch through
+``shard_batch``'s ``make_array_from_process_local_data`` branch, and run
+one DP train step — then checks the result matches an inline
+single-process run of the same program on an identically-shaped 2-device
+mesh.
+
+This is the executed counterpart of the recorded-call fakes in
+``test_distributed.py``, and the framework's equivalent of the
+reference's actually-ran-across-Spark-executors story (reference
+Readme.md:3): the multi-host code path (``tpuflow/parallel/dp.py``
+``_assemble``, ``process_batch_bounds``) runs with a real
+``jax.process_count() > 1``, not a monkeypatched one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tests.mp_worker import TOTAL_DEVICES
+
+WORKER = os.path.join(os.path.dirname(__file__), "mp_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _launch_worker(pid: int, nprocs: int, port: int) -> subprocess.Popen:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    # ``python tests/mp_worker.py`` puts tests/ (not the repo root) on
+    # sys.path; the workers import tpuflow from the repo checkout.
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, WORKER, str(pid), str(nprocs), str(port)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+        cwd=repo_root,
+    )
+
+
+def _collect(procs: list[subprocess.Popen], timeout: float = 150.0) -> list[dict]:
+    results = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+        line = [l for l in out.splitlines() if l.startswith("{")][-1]
+        results.append(json.loads(line))
+    return results
+
+
+def _inline_reference() -> dict:
+    """mp_worker.py's program, single-process, on an identically-shaped
+    2-device submesh of this (8-virtual-device) test process. No dropout
+    anywhere, so the DP math is process-count-invariant: the distributed
+    run must reproduce these numbers."""
+    import jax
+
+    from tpuflow.models import StaticMLP
+    from tpuflow.parallel.dp import make_dp_train_step, replicate, shard_batch
+    from tpuflow.parallel.mesh import make_mesh
+    from tpuflow.train import create_state
+
+    mesh = make_mesh(devices=jax.devices()[:TOTAL_DEVICES])
+    global_batch, n_features = 32, 6
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((global_batch, n_features)).astype(np.float32)
+    y = rng.standard_normal((global_batch,)).astype(np.float32)
+    state = replicate(
+        mesh, create_state(StaticMLP(), jax.random.PRNGKey(0), x[:2])
+    )
+    step = make_dp_train_step(mesh)
+    xs, ys = shard_batch(mesh, x, y)
+    state, metrics = step(state, xs, ys, jax.random.PRNGKey(1))
+    param_sum = float(
+        sum(float(abs(p).sum()) for p in jax.tree.leaves(state.params))
+    )
+    return {"loss": float(metrics["loss"]), "param_sum": param_sum}
+
+
+def test_two_process_dp_step_matches_single_process():
+    port = _free_port()
+    procs = [_launch_worker(0, 2, port), _launch_worker(1, 2, port)]
+    # Overlap the subprocess startup (jax import + Gloo mesh) with the
+    # inline reference computation.
+    single = _inline_reference()
+    multi = _collect(procs)
+
+    # The multi-process branch really executed.
+    assert [r["processes"] for r in multi] == [2, 2]
+    assert all(r["assembled_multi"] for r in multi)
+
+    # Both processes agree with each other (replicated outputs)...
+    assert multi[0]["loss"] == pytest.approx(multi[1]["loss"], abs=0.0)
+    assert multi[0]["param_sum"] == pytest.approx(multi[1]["param_sum"], abs=0.0)
+    # ...and with the single-process reference on the same-shaped mesh.
+    assert multi[0]["loss"] == pytest.approx(single["loss"], rel=1e-6)
+    assert multi[0]["param_sum"] == pytest.approx(single["param_sum"], rel=1e-6)
