@@ -435,15 +435,20 @@ def main() -> None:
         """Forward a worker record if it's at least as good as the best so
         far (ties pass: the worker re-prints the same value with parity
         filled in). The forwarded copy carries the attempt count, so the
-        driver's tail line is always complete AND current."""
+        driver's tail line is always complete AND current.
+
+        Print BEFORE setting state["best"]: the SIGTERM handler treats a
+        non-None best as "already fully on stdout" and exits without
+        re-printing — so best must never be set while its line is still
+        buffered or mid-write (flush=True completes the write first)."""
         with lock:
             cur = state["best"]
             if cur is not None and rec.get("value", 0.0) < cur.get("value", 0.0):
                 return
             rec = dict(rec)
             rec["attempts"] = state["attempt"]
-            state["best"] = rec
             print(json.dumps(rec), flush=True)
+            state["best"] = rec
 
     def _pump_stdout(pipe) -> None:
         for line in pipe:

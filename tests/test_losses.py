@@ -68,12 +68,16 @@ def test_pallas_loss_selectable_from_train_config():
         TrainJobConfig(
             model="static_mlp",
             loss="mae_clip_pallas",
-            max_epochs=2,
+            # One epoch over a small set: the interpret-mode Pallas loss
+            # executes eagerly per dispatch on CPU, so runtime scales
+            # with step count — the wiring is what's under test, and the
+            # kernel's numerics have their own golden tests.
+            max_epochs=1,
             batch_size=32,
             verbose=False,
             n_devices=1,
-            synthetic_wells=4,
-            synthetic_steps=64,
+            synthetic_wells=2,
+            synthetic_steps=48,
         )
     )
     assert np.isfinite(report.test_loss)

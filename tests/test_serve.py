@@ -150,6 +150,7 @@ class TestHTTPServer:
 
 
 class TestSubprocessDaemon:
+    @pytest.mark.slow
     def test_daemon_serves_a_job(self, tmp_path):
         """The real deployment shape: `python -m tpuflow.serve` in its own
         process; a client submits a job over HTTP and reads the report."""

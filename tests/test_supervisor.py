@@ -50,6 +50,7 @@ class TestSupervise:
         assert isinstance(run.failures[0]["stderr_tail"], str)
         assert run.report["epochs_ran"] == 5  # resumed 4..5, not restarted
 
+    @pytest.mark.slow
     def test_clean_run_needs_no_restart(self, tmp_path):
         spec = {**_TINY, "storagePath": str(tmp_path)}
         run = supervise(spec, max_restarts=2, verbose=False)
@@ -65,6 +66,7 @@ class TestSupervise:
                 max_restarts=1,
             )
 
+    @pytest.mark.slow
     def test_gives_up_after_max_restarts(self, tmp_path):
         # A spec that dies every attempt (bad model name passes spec_to_
         # config? no — unknown model fails INSIDE train(), i.e. in the
@@ -79,6 +81,7 @@ class TestSupervise:
 
 
 class TestSupervisorCLI:
+    @pytest.mark.slow
     def test_shell_entrypoint(self, tmp_path):
         spec = {**_TINY, "storagePath": str(tmp_path), "fault_epoch": 2}
         spec_file = tmp_path / "spec.json"

@@ -91,17 +91,25 @@ def ring_attention(
         raise ValueError(f"sequence length {T} not divisible by {axis}={n}")
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    return _ring_attn_fn(mesh, axis, causal, float(scale), impl)(q, k, v)
 
-    sharded = jax.shard_map(
-        lambda ql, kl, vl: ring_attention_spmd(
-            ql, kl, vl, axis=axis, causal=causal, scale=scale, impl=impl
-        ),
-        mesh=mesh,
-        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
-        out_specs=P(None, axis),
-        check_vma=False,
+
+@functools.lru_cache(maxsize=None)
+def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float, impl: str):
+    """The jitted ring program, cached per configuration: repeated calls
+    (every training step) dispatch the compiled program instead of
+    re-tracing a fresh shard_map closure each time."""
+    return jax.jit(
+        jax.shard_map(
+            lambda ql, kl, vl: ring_attention_spmd(
+                ql, kl, vl, axis=axis, causal=causal, scale=scale, impl=impl
+            ),
+            mesh=mesh,
+            in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+            out_specs=P(None, axis),
+            check_vma=False,
+        )
     )
-    return sharded(q, k, v)
 
 
 def _round_mask(idx, r, n, Tl, causal: bool):

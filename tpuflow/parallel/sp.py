@@ -29,6 +29,7 @@ of the shard_map program needs the mesh to type its cotangents.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable
 
 import jax
@@ -72,6 +73,15 @@ def ring_lstm_scan(
     T = xw.shape[0]
     if T % n:
         raise ValueError(f"sequence length {T} not divisible by {axis}={n}")
+    return _ring_scan_fn(mesh, axis)(xw, wh, b)
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_scan_fn(mesh: Mesh, axis: str):
+    """The jitted ring-scan program, cached per (mesh, axis): repeated
+    calls (every training step) dispatch the compiled program instead of
+    re-tracing a fresh shard_map closure each time."""
+    n = mesh.shape[axis]
 
     def body(xw_local, wh, b):
         # xw_local: [T/n, B, 4H] — this device's time chunk.
@@ -102,16 +112,18 @@ def ring_lstm_scan(
             )
         return hs_out
 
-    sharded = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(P(axis), P(), P()),
-        out_specs=P(axis),
-        check_vma=False,
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(axis), P(), P()),
+            out_specs=P(axis),
+            check_vma=False,
+        )
     )
-    return sharded(xw, wh, b)
 
 
+@functools.lru_cache(maxsize=None)
 def make_sp_forward(
     mesh: Mesh, hidden: int, axis: str = DATA_AXIS
 ) -> Callable:
