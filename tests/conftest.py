@@ -25,3 +25,17 @@ os.environ["XLA_FLAGS"] = _flags
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Shared ring size for the SP / ring-attention unit tests: XLA's compile
+# time for transposed shard_map ring programs grows superlinearly in ring
+# size (an 8-device grad test cost 137s on this one-core host vs ~15s at
+# 4), and a 4-device ring exercises every ring behavior (multiple hops,
+# carry rotation, padding paths). The 8-device composition stays covered
+# by __graft_entry__.dryrun_multichip and test_api's multichip test.
+RING_DEVICES = 4
+
+
+def ring_mesh():
+    from tpuflow.parallel import make_mesh
+
+    return make_mesh(devices=jax.devices()[:RING_DEVICES])

@@ -1,13 +1,9 @@
 """Ring attention: blockwise KV-ring attention vs full softmax attention.
 
 The ppermute KV ring executes for real across fake CPU devices
-(SURVEY.md §4 strategy) — on a 4-device ring: XLA's compile time for the
-transposed shard_map ring programs grows superlinearly in ring size
-(8-device grad tests cost ~55s EACH on one CPU core vs ~15s at 4), and a
-4-device ring exercises every ring behavior (multiple hops, carry
-rotation, padding paths). The 8-device composition is still covered by
-``__graft_entry__.dryrun_multichip`` and test_api's multichip test.
-Ring attention is EXACT (online softmax), so parity tolerances are tight.
+(SURVEY.md §4 strategy) on the shared test ring (tests/conftest.py
+``ring_mesh`` — see there for the ring-size rationale). Ring attention
+is EXACT (online softmax), so parity tolerances are tight.
 """
 
 import jax
@@ -15,13 +11,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tpuflow.parallel import full_attention, make_mesh, ring_attention
+from tpuflow.parallel import full_attention, ring_attention
 
-RING_DEVICES = 4
-
-
-def ring_mesh():
-    return make_mesh(devices=jax.devices()[:RING_DEVICES])
+from tests.conftest import ring_mesh
 
 
 def _qkv(B, T, D, seed=0):
@@ -206,7 +198,7 @@ class TestAttentionRegressor:
     def test_ring_backend_matches_full(self):
         """backend="ring" is the wired scale-out path: same params, same
         output as backend="full", under jit with grads, time sharded over
-        the 8-device ring."""
+        the test ring (see tests/conftest.py ring_mesh)."""
         from tpuflow.models import AttentionRegressor
 
         mesh = ring_mesh()

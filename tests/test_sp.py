@@ -1,11 +1,8 @@
 """Sequence (context) parallelism: ring-sharded LSTM scan vs on-chip scan.
 
 The ppermute carry ring executes for real across fake CPU devices
-(SURVEY.md §4 strategy) — on a 4-device ring: XLA compile time for the
-transposed shard_map ring grows superlinearly in ring size (the 8-device
-grad test cost 137s on one CPU core vs ~15s at 4), and 4 devices
-exercise every ring behavior. The 8-device SP ring is still covered by
-``__graft_entry__.dryrun_multichip`` and test_api's multichip test.
+(SURVEY.md §4 strategy) on the shared test ring (tests/conftest.py
+``ring_mesh`` — see there for the ring-size rationale).
 """
 
 import jax
@@ -13,14 +10,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tpuflow.parallel import make_mesh, make_sp_forward, ring_lstm_scan
+from tpuflow.parallel import make_sp_forward, ring_lstm_scan
 from tpuflow.parallel.sp import _lstm_chunk_scan
 
-RING_DEVICES = 4
-
-
-def ring_mesh():
-    return make_mesh(devices=jax.devices()[:RING_DEVICES])
+from tests.conftest import ring_mesh
 
 
 def _case(T, B, H, F=None, seed=0):

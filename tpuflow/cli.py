@@ -41,6 +41,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="micro-batch gradients averaged per optimizer update")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--devices", type=int, default=None, help="data-parallel device count (default: all)")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel model-axis size of the (data, model) "
+                        "mesh (MLP families; devices/tp do data parallelism)")
     p.add_argument("--synthetic-wells", type=int, default=8)
     p.add_argument("--synthetic-steps", type=int, default=512)
     p.add_argument("--jit-epoch", action="store_true",
@@ -95,6 +98,7 @@ def main(argv=None) -> int:
         accumulate_steps=args.accumulate_steps,
         seed=args.seed,
         n_devices=args.devices,
+        tp=args.tp,
         synthetic_wells=args.synthetic_wells,
         synthetic_steps=args.synthetic_steps,
         verbose=not args.quiet,
