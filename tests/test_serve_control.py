@@ -130,6 +130,19 @@ class TestCancelRunning:
 
 
 class TestTimeouts:
+    def test_stop_fn_heartbeats_surface_in_job_record(self, blocked_runner):
+        """Each stop_fn poll (one per epoch) bumps the job's heartbeat
+        counter and running_s, so clients can see progress/liveness."""
+        runner, ex = blocked_runner
+        job = runner.submit(SPEC)["job_id"]
+        assert ex.started.wait(timeout=10)
+        for _ in range(3):
+            ex.stop_fns[0]()
+        rec = runner.get(job)
+        assert rec["heartbeats"] == 3
+        assert rec["running_s"] >= 0.0
+        ex.release.set()
+
     def test_per_job_timeout_reported(self, blocked_runner):
         runner, ex = blocked_runner
         job = runner.submit({**SPEC, "timeoutSeconds": 0.05})["job_id"]

@@ -1,0 +1,138 @@
+"""Job-journal durability: the runner's lifecycle survives restarts.
+
+The reference's Spark cluster kept job history across driver restarts;
+``JobRunner(journal_path=...)`` replays a JSONL journal at startup —
+terminal jobs return as history, never-started jobs requeue under their
+original ids, and mid-run jobs are marked lost (not silently re-run).
+These tests restart REAL JobRunner instances against one journal file,
+with ``_execute`` stubbed so lifecycle (not training) is what's tested.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from tests.test_serve_control import SPEC, _BlockingExecute, _wait
+from tpuflow.serve import JobRunner
+
+
+@pytest.fixture
+def gated(monkeypatch):
+    ex = _BlockingExecute()
+    monkeypatch.setattr(JobRunner, "_execute", ex)
+    yield ex
+    ex.release.set()
+
+
+def test_history_survives_restart(tmp_path, gated):
+    journal = str(tmp_path / "jobs.jsonl")
+    r1 = JobRunner(journal_path=journal)
+    job = r1.submit(SPEC)["job_id"]
+    gated.release.set()
+    assert _wait(lambda: r1.get(job)["status"] == "done")
+
+    r2 = JobRunner(journal_path=journal)
+    rec = r2.get(job)
+    assert rec is not None and rec["status"] == "done"
+    assert rec["report"] == {"ok": True}
+    assert r2.metrics()["done"] == 1 and r2.metrics()["submitted"] == 1
+
+
+def test_queued_job_requeues_under_original_id(tmp_path, monkeypatch):
+    # Dedicated stub: the FIRST job parks forever (it "dies with the
+    # crashed daemon" — its worker thread is daemonic and never returns,
+    # so it can't journal a bogus completion); later calls succeed.
+    park = threading.Event()  # never set
+    started = threading.Event()
+    calls = []
+
+    def fake_execute(kind, config, stop_fn=None):
+        calls.append(1)
+        if len(calls) == 1:
+            started.set()
+            park.wait()
+        return {"ok": True}
+
+    monkeypatch.setattr(JobRunner, "_execute", staticmethod(fake_execute))
+    journal = str(tmp_path / "jobs.jsonl")
+    r1 = JobRunner(journal_path=journal)
+    running = r1.submit(SPEC)["job_id"]
+    assert started.wait(timeout=10)
+    queued = r1.submit(SPEC)["job_id"]
+    # "Daemon dies" with one job running and one queued; the new runner
+    # requeues the queued job (it never started — re-running is safe)
+    # and marks the running one lost.
+    r2 = JobRunner(journal_path=journal)
+    lost = r2.get(running)
+    assert lost["status"] == "failed" and "lost" in lost["error"]
+    assert "resume" in lost["error"]
+    assert _wait(lambda: r2.get(queued)["status"] == "done")
+    # The adjudication was journaled: a THIRD replay agrees without
+    # re-deriving it.
+    r3 = JobRunner(journal_path=journal)
+    assert r3.get(running)["status"] == "failed"
+    assert r3.get(queued)["status"] == "done"
+
+
+def test_cancelled_queued_job_stays_cancelled_after_restart(tmp_path, gated):
+    journal = str(tmp_path / "jobs.jsonl")
+    r1 = JobRunner(journal_path=journal)
+    r1.submit(SPEC)["job_id"]  # occupies the worker
+    assert gated.started.wait(timeout=10)
+    victim = r1.submit(SPEC)["job_id"]
+    r1.cancel(victim)
+
+    r2 = JobRunner(journal_path=journal)
+    rec = r2.get(victim)
+    assert rec["status"] == "cancelled"
+    assert r2.metrics()["cancelled"] == 1
+
+
+def test_corrupt_tail_line_is_skipped(tmp_path, gated):
+    journal = str(tmp_path / "jobs.jsonl")
+    r1 = JobRunner(journal_path=journal)
+    job = r1.submit(SPEC)["job_id"]
+    gated.release.set()
+    assert _wait(lambda: r1.get(job)["status"] == "done")
+    with open(journal, "a") as f:
+        f.write('{"event": "submitted", "job_id": "tr')  # crash mid-write
+
+    r2 = JobRunner(journal_path=journal)
+    assert r2.get(job)["status"] == "done"
+    assert len(r2.list()) == 1
+
+
+def test_journal_write_failure_does_not_wedge_the_service(tmp_path, gated):
+    """Journal durability is best-effort: a dead journal (disk full,
+    volume gone) must not kill the worker or leave ghost queued records."""
+    journal = str(tmp_path / "jobs.jsonl")
+    r = JobRunner(journal_path=journal)
+    r._journal_file.close()  # simulate the volume disappearing
+    job = r.submit(SPEC)["job_id"]  # submit's journal write fails silently
+    gated.release.set()
+    assert _wait(lambda: r.get(job)["status"] == "done")  # worker survived
+    assert r.metrics()["queued"] == 0  # no ghost record
+
+
+def test_no_journal_means_no_file(tmp_path, gated):
+    r = JobRunner()  # journal off: purely in-memory, nothing written
+    job = r.submit(SPEC)["job_id"]
+    gated.release.set()
+    assert _wait(lambda: r.get(job)["status"] == "done")
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_journal_records_are_wellformed_jsonl(tmp_path, gated):
+    journal = str(tmp_path / "jobs.jsonl")
+    r1 = JobRunner(journal_path=journal)
+    job = r1.submit(SPEC)["job_id"]
+    gated.release.set()
+    assert _wait(lambda: r1.get(job)["status"] == "done")
+    events = [json.loads(l) for l in open(journal)]
+    assert [e["event"] for e in events] == ["submitted", "started", "terminal"]
+    assert all(e["job_id"] == job for e in events)
+    assert events[0]["spec"] == SPEC
+    assert events[2]["status"] == "done"

@@ -91,6 +91,33 @@ def test_fit_end_to_end_learns_and_reports():
     assert "Time elapsed" in result.report()
 
 
+def test_fit_stop_fn_interrupts_between_epochs():
+    """The job-runner's cancellation/timeout seam: a stop_fn returning a
+    reason aborts the run with TrainingInterrupted AFTER the epochs that
+    already completed (checkpoints drained by the finally block)."""
+    import pytest
+
+    from tpuflow.train import TrainingInterrupted
+
+    train, val = _toy_linear_data(64, 0), _toy_linear_data(64, 1)
+    model = StaticMLP(hidden=(4,))
+    state = create_state(model, jax.random.PRNGKey(0), train.x[:4])
+    calls = []
+
+    def stop_fn():
+        calls.append(1)
+        return "cancelled" if len(calls) >= 3 else None
+
+    cfg = FitConfig(
+        max_epochs=100, batch_size=32, patience=100, verbose=False,
+        stop_fn=stop_fn,
+    )
+    with pytest.raises(TrainingInterrupted) as e:
+        fit(state, train, val, cfg)
+    assert e.value.reason == "cancelled"
+    assert len(calls) == 3  # polled once per epoch, stops at the 3rd
+
+
 def test_fit_early_stops():
     """Tiny lr on converged-ish data: val loss plateaus -> stops < max_epochs."""
     train, val = _toy_linear_data(64, 0), _toy_linear_data(64, 0)

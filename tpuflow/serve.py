@@ -14,7 +14,9 @@ API (JSON in/out):
 - ``POST /jobs``        — submit a job spec; returns ``{"job_id", "status"}``
   (``429`` when the bounded queue is full).
 - ``GET  /jobs``        — list all jobs (summaries).
-- ``GET  /jobs/<id>``   — one job: status, spec, report or error.
+- ``GET  /jobs/<id>``   — one job: status, spec, report or error; running
+  jobs carry ``heartbeats`` (one per epoch boundary) and ``running_s`` —
+  a stale heartbeat means the job is hung inside an epoch.
 - ``DELETE /jobs/<id>`` — cancel: a queued job is cancelled immediately; a
   running job is cancelled cooperatively at its next epoch boundary
   (status ``cancelling`` until the worker observes it); terminal jobs
@@ -44,6 +46,15 @@ every job that doesn't set its own. Both cancellation and timeouts are
 cooperative (checked between training epochs, and between the runs of a
 compare/sweep): one enormous epoch or an XLA compile is not
 interruptible, but a hung job no longer wedges the service forever.
+
+Restart durability: ``--journal PATH`` (``JobRunner(journal_path=...)``)
+appends every lifecycle event to a JSONL journal and replays it at
+startup — terminal jobs come back as queryable history, jobs that never
+started are requeued under their original ids, and a job that was
+RUNNING when the daemon died is marked failed/lost rather than silently
+re-run (its partial checkpoints exist; resubmit with ``resume: true`` to
+continue). This is the job-history half of the ``spark-submit`` cluster
+story (reference Readme.md:3-4) the service replaces.
 
 Two experiment job kinds ride the same queue (the reference's "tests ...
 using multiple model types" workflow, Readme.md:13, web-triggered):
@@ -138,6 +149,7 @@ class JobRunner:
         on_artifact_change=None,
         max_queued: int = 64,
         default_timeout: float | None = None,
+        journal_path: str | None = None,
     ):
         # Unbounded Queue; admission control is by LIVE queued count in
         # submit() (under the lock), not Queue(maxsize=...): a cancelled
@@ -152,10 +164,145 @@ class JobRunner:
         self._lock = threading.Lock()
         self._on_artifact_change = on_artifact_change
         self.stats = {"submitted": 0, "done": 0, "failed": 0, "cancelled": 0}
+        # Journal (JSONL, append-only): job lifecycle survives daemon
+        # restarts — terminal jobs come back as history, never-started
+        # jobs are requeued, and a job that was RUNNING at the crash is
+        # marked failed/lost (re-running it could double side effects;
+        # the client decides whether to resubmit with resume=true).
+        # Replay happens before the worker starts, so requeued entries
+        # are processed like fresh submissions.
+        self._journal_file = None
+        if journal_path:
+            self._replay_journal(journal_path)
+            self._journal_file = open(journal_path, "a", encoding="utf-8")
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
-    def submit(self, spec: dict) -> dict:
+    # ---- journal ----
+
+    def _journal(self, **rec) -> None:
+        """Append one lifecycle event; caller holds the lock (or is the
+        single-threaded __init__).
+
+        NEVER raises: the journal is best-effort durability, and a write
+        failure (disk full, volume gone, a Python caller's non-JSON spec)
+        propagating out of submit() would leave a ghost queued record, or
+        out of the worker loop would kill the thread and wedge the whole
+        service — the exact failure mode this module's error discipline
+        forbids. A lost journal line means one job's history won't survive
+        a restart; the running service stays correct."""
+        if self._journal_file is None:
+            return
+        try:
+            self._journal_file.write(json.dumps(rec) + "\n")
+            self._journal_file.flush()
+        except (OSError, TypeError, ValueError) as e:
+            import sys
+
+            print(
+                f"tpuflow.serve: journal write failed "
+                f"({type(e).__name__}: {e}); continuing without it",
+                file=sys.stderr,
+            )
+
+    def _replay_journal(self, path: str) -> None:
+        import os
+
+        if not os.path.exists(path):
+            return
+        events: dict[str, dict] = {}  # job_id -> folded state
+        order: list[str] = []
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # crash-truncated tail line
+                job_id = ev.get("job_id")
+                if not job_id:
+                    continue
+                if job_id not in events:
+                    events[job_id] = {"last": None}
+                    order.append(job_id)
+                st = events[job_id]
+                kind = ev.get("event")
+                if kind == "submitted":
+                    st.update(
+                        spec=ev.get("spec"), timeout_s=ev.get("timeout_s"),
+                        last="submitted",
+                    )
+                elif kind == "started":
+                    st["last"] = "started"
+                elif kind == "terminal":
+                    st.update(
+                        last="terminal", status=ev.get("status", "failed"),
+                        error=ev.get("error"), report=ev.get("report"),
+                    )
+        lost: list[str] = []
+        for job_id in order:
+            st = events[job_id]
+            spec = st.get("spec")
+            if spec is None:
+                continue  # journal from before this job's submitted line
+            if st["last"] == "terminal":
+                rec = {"job_id": job_id, "status": st["status"], "spec": spec}
+                if st.get("error"):
+                    rec["error"] = st["error"]
+                if st.get("report") is not None:
+                    rec["report"] = st["report"]
+                self._jobs[job_id] = rec
+                self.stats["submitted"] += 1
+                self.stats[
+                    st["status"] if st["status"] in self.stats else "failed"
+                ] += 1
+            elif st["last"] == "started":
+                # Mid-run at the crash: training side effects (partial
+                # checkpoints) exist; don't silently re-run.
+                self._jobs[job_id] = {
+                    "job_id": job_id, "status": "failed", "spec": spec,
+                    "error": "lost: daemon restarted mid-run (resubmit; "
+                    "resume=true continues from the last run checkpoint)",
+                }
+                self.stats["submitted"] += 1
+                self.stats["failed"] += 1
+                lost.append(job_id)
+            else:  # submitted, never started: safe to requeue as-is
+                try:
+                    kind, config, _ = self._parse_spec(spec)
+                except Exception as e:
+                    self._jobs[job_id] = {
+                        "job_id": job_id, "status": "failed", "spec": spec,
+                        "error": f"requeue after restart failed: "
+                        f"{type(e).__name__}: {e}",
+                    }
+                    self.stats["submitted"] += 1
+                    self.stats["failed"] += 1
+                    lost.append(job_id)
+                    continue
+                self._jobs[job_id] = {
+                    "job_id": job_id, "status": "queued", "spec": spec
+                }
+                self._cancel_events[job_id] = threading.Event()
+                self.stats["submitted"] += 1
+                self._queue.put((job_id, kind, config, st.get("timeout_s")))
+        # Record the adjudications so the NEXT replay sees them terminal.
+        if lost:
+            with open(path, "a", encoding="utf-8") as f:
+                for job_id in lost:
+                    rec = self._jobs[job_id]
+                    f.write(json.dumps({
+                        "event": "terminal", "job_id": job_id,
+                        "status": rec["status"], "error": rec.get("error"),
+                    }) + "\n")
+
+    # ---- submission ----
+
+    def _parse_spec(self, spec: dict):
+        """Validate a job spec -> (kind, config, timeout_s). Raises on any
+        invalid field (typos fail at submission, not mid-queue)."""
         base = dict(spec)
         compare_models = base.pop("compare", None)
         sweep_grid = base.pop("sweep", None)
@@ -201,6 +348,10 @@ class JobRunner:
             kind = ("sweep", sweep_grid)
         else:
             kind = ("train", None)
+        return kind, config, timeout_s
+
+    def submit(self, spec: dict) -> dict:
+        kind, config, timeout_s = self._parse_spec(spec)
         job_id = uuid.uuid4().hex[:12]
         record = {"job_id": job_id, "status": "queued", "spec": spec}
         with self._lock:
@@ -214,6 +365,10 @@ class JobRunner:
             self._jobs[job_id] = record
             self._cancel_events[job_id] = threading.Event()
             self.stats["submitted"] += 1
+            self._journal(
+                event="submitted", job_id=job_id, spec=spec,
+                timeout_s=timeout_s,
+            )
         self._queue.put((job_id, kind, config, timeout_s))
         return {"job_id": job_id, "status": "queued"}
 
@@ -232,6 +387,10 @@ class JobRunner:
                 rec.update(status="cancelled", error="cancelled while queued")
                 self.stats["cancelled"] += 1
                 self._cancel_events.pop(job_id, None)
+                self._journal(
+                    event="terminal", job_id=job_id, status="cancelled",
+                    error=rec["error"],
+                )
                 return {"job_id": job_id, "status": "cancelled"}
             if status in ("running", "cancelling"):
                 rec["status"] = "cancelling"
@@ -282,11 +441,27 @@ class JobRunner:
                 cancel_event = self._cancel_events.setdefault(
                     job_id, threading.Event()
                 )
+                self._journal(event="started", job_id=job_id)
+            t_started = _time.monotonic()
             deadline = (
-                _time.monotonic() + timeout_s if timeout_s is not None else None
+                t_started + timeout_s if timeout_s is not None else None
             )
 
-            def stop_fn(ev=cancel_event, deadline=deadline, t=timeout_s):
+            def stop_fn(
+                ev=cancel_event, deadline=deadline, t=timeout_s,
+                job_id=job_id, t_started=t_started,
+            ):
+                # Polled at every epoch boundary — piggyback a heartbeat
+                # so GET /jobs/<id> shows liveness and progress, and a
+                # stale heartbeat_age exposes a job hung inside one epoch
+                # (which cooperative cancellation cannot reach).
+                with self._lock:
+                    rec = self._jobs.get(job_id)
+                    if rec is not None:
+                        rec["heartbeats"] = rec.get("heartbeats", 0) + 1
+                        rec["running_s"] = round(
+                            _time.monotonic() - t_started, 1
+                        )
                 if ev.is_set():
                     return "cancelled"
                 if deadline is not None and _time.monotonic() > deadline:
@@ -323,6 +498,11 @@ class JobRunner:
                             status="failed", error=f"TrainingInterrupted: {e}"
                         )
                         self.stats["failed"] += 1
+                    self._journal(
+                        event="terminal", job_id=job_id,
+                        status=self._jobs[job_id]["status"],
+                        error=self._jobs[job_id]["error"],
+                    )
                 continue
             except Exception as e:
                 # Evict BEFORE publishing the terminal status: a client
@@ -335,6 +515,10 @@ class JobRunner:
                         status="failed", error=f"{type(e).__name__}: {e}"
                     )
                     self.stats["failed"] += 1
+                    self._journal(
+                        event="terminal", job_id=job_id, status="failed",
+                        error=self._jobs[job_id]["error"],
+                    )
                 continue
             self._notify_artifact(config, kind)
             with self._lock:
@@ -343,6 +527,9 @@ class JobRunner:
                 # work is done; report it done (the cancel was a no-op).
                 self._jobs[job_id].update(status="done", report=rep)
                 self.stats["done"] += 1
+                self._journal(
+                    event="terminal", job_id=job_id, status="done", report=rep
+                )
 
     @staticmethod
     def _failed_rows(rpt, ident) -> list[dict]:
@@ -503,6 +690,7 @@ def make_server(
     port: int = 8700,
     max_queued: int = 64,
     default_timeout: float | None = None,
+    journal_path: str | None = None,
 ) -> ThreadingHTTPServer:
     """Build the HTTP server (caller drives serve_forever / shutdown)."""
     import time as _time
@@ -515,6 +703,7 @@ def make_server(
         on_artifact_change=predictor.invalidate,
         max_queued=max_queued,
         default_timeout=default_timeout,
+        journal_path=journal_path,
     )
 
     class Handler(BaseHTTPRequestHandler):
@@ -634,12 +823,18 @@ def main(argv=None) -> int:
         help="per-job runtime budget in seconds for jobs that don't set "
         "timeoutSeconds (cooperative, between epochs)",
     )
+    p.add_argument(
+        "--journal", default=None, metavar="PATH",
+        help="JSONL job journal: job history survives restarts, "
+        "never-started jobs are requeued, mid-run jobs marked lost",
+    )
     args = p.parse_args(argv)
 
     server = make_server(
         args.host, args.port,
         max_queued=args.max_queued,
         default_timeout=args.default_timeout,
+        journal_path=args.journal,
     )
 
     def _stop(signum, frame):
