@@ -1,0 +1,80 @@
+"""Tensor-parallel training recipe: a model sharded ACROSS chips.
+
+When a model family outgrows one chip's HBM, the scaling-book recipe is:
+pick a ``(data, model)`` mesh, annotate the param layout, and let XLA
+insert the collectives. ``tpuflow`` wires that recipe into the ordinary
+training entrypoint:
+
+1. ``TrainJobConfig(tp=2)`` (CLI ``--tp 2``) builds a
+   ``(n_devices/2, 2)`` mesh with AUTO axis types;
+2. the MLP's params are laid out megatron-style — alternating
+   column-parallel (kernel ``[F, H]`` sharded on H) and row-parallel
+   (kernel ``[H, F]`` sharded on H) Dense layers, SGD momentum sharded
+   identically (``parallel/tp_train.py``);
+3. the unmodified train step jitted over the mesh gets BOTH collectives
+   from the compiler: the data-axis gradient all-reduce (DP) and the
+   model-axis activation all-reduce at each column->row boundary — the
+   exact psum ``parallel.tp.tp_mlp_forward`` writes by hand.
+
+This file trains the same StaticMLP twice — single-device and tp=2 on a
+(4, 2) mesh — and shows the loss trajectories are identical (the sharded
+program is the same math), then prints where each param landed.
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/tp_training.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    from tpuflow.api import TrainJobConfig, train
+
+    base = dict(
+        model="static_mlp",
+        model_kwargs={"hidden": (32, 32)},
+        max_epochs=5,
+        batch_size=64,
+        verbose=False,
+        synthetic_wells=6,
+        synthetic_steps=128,
+        seed=0,
+    )
+    ref = train(TrainJobConfig(**base, n_devices=1))
+    tp = train(TrainJobConfig(**base, n_devices=8, tp=2))
+
+    print(f"{'epoch':>5} {'single-device loss':>20} {'tp=2 loss':>12}")
+    for a, b in zip(ref.result.history, tp.result.history):
+        print(f"{a['epoch']:>5} {a['loss']:>20.6f} {b['loss']:>12.6f}")
+    drift = max(
+        abs(a["loss"] - b["loss"])
+        for a, b in zip(ref.result.history, tp.result.history)
+    )
+    print(f"max per-epoch loss drift: {drift:.2e} (same math, sharded)")
+    assert drift < 1e-4, "tp run diverged from the single-device trajectory"
+
+    print("\nparam layout on the (data=4, model=2) mesh:")
+    for layer, leaves in tp.result.state.params.items():
+        for name, arr in leaves.items():
+            print(f"  {layer}.{name:<6} {str(arr.shape):<10} "
+                  f"spec={arr.sharding.spec}")
+
+
+if __name__ == "__main__":
+    main()

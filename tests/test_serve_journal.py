@@ -19,6 +19,12 @@ from tests.test_serve_control import SPEC, _BlockingExecute, _wait
 from tpuflow.serve import JobRunner
 
 
+def _die(runner: JobRunner) -> None:
+    """Simulate the daemon process dying: the journal handle (and its
+    flock) goes away; the worker thread is daemonic and irrelevant."""
+    runner._journal_file.close()
+
+
 @pytest.fixture
 def gated(monkeypatch):
     ex = _BlockingExecute()
@@ -33,6 +39,7 @@ def test_history_survives_restart(tmp_path, gated):
     job = r1.submit(SPEC)["job_id"]
     gated.release.set()
     assert _wait(lambda: r1.get(job)["status"] == "done")
+    _die(r1)
 
     r2 = JobRunner(journal_path=journal)
     rec = r2.get(job)
@@ -65,6 +72,7 @@ def test_queued_job_requeues_under_original_id(tmp_path, monkeypatch):
     # "Daemon dies" with one job running and one queued; the new runner
     # requeues the queued job (it never started — re-running is safe)
     # and marks the running one lost.
+    _die(r1)
     r2 = JobRunner(journal_path=journal)
     lost = r2.get(running)
     assert lost["status"] == "failed" and "lost" in lost["error"]
@@ -72,6 +80,7 @@ def test_queued_job_requeues_under_original_id(tmp_path, monkeypatch):
     assert _wait(lambda: r2.get(queued)["status"] == "done")
     # The adjudication was journaled: a THIRD replay agrees without
     # re-deriving it.
+    _die(r2)
     r3 = JobRunner(journal_path=journal)
     assert r3.get(running)["status"] == "failed"
     assert r3.get(queued)["status"] == "done"
@@ -84,6 +93,7 @@ def test_cancelled_queued_job_stays_cancelled_after_restart(tmp_path, gated):
     assert gated.started.wait(timeout=10)
     victim = r1.submit(SPEC)["job_id"]
     r1.cancel(victim)
+    _die(r1)
 
     r2 = JobRunner(journal_path=journal)
     rec = r2.get(victim)
@@ -97,12 +107,23 @@ def test_corrupt_tail_line_is_skipped(tmp_path, gated):
     job = r1.submit(SPEC)["job_id"]
     gated.release.set()
     assert _wait(lambda: r1.get(job)["status"] == "done")
+    _die(r1)
     with open(journal, "a") as f:
         f.write('{"event": "submitted", "job_id": "tr')  # crash mid-write
 
     r2 = JobRunner(journal_path=journal)
     assert r2.get(job)["status"] == "done"
     assert len(r2.list()) == 1
+
+
+def test_second_daemon_on_same_journal_refused(tmp_path, gated):
+    """Two daemons replaying one journal would requeue and run each
+    other's queued jobs twice — the flock guard fails the second fast."""
+    journal = str(tmp_path / "jobs.jsonl")
+    holder = JobRunner(journal_path=journal)
+    assert holder is not None
+    with pytest.raises(RuntimeError, match="locked by another"):
+        JobRunner(journal_path=journal)
 
 
 def test_journal_write_failure_does_not_wedge_the_service(tmp_path, gated):

@@ -84,6 +84,28 @@ class TestWellWindower:
         np.testing.assert_allclose(got_x, want_x, rtol=1e-6)
         np.testing.assert_allclose(got_y, want_y, rtol=1e-6)
 
+    @pytest.mark.parametrize("stride", [1, 2])
+    def test_extract_backends_agree(self, stride, monkeypatch):
+        """The C++ extractor and the stride-trick NumPy fallback produce
+        byte-identical windows through the shared engine the windower
+        delegates to (tpuflow.data.windows.teacher_forcing_pairs)."""
+        from tpuflow import _native
+        from tpuflow.data import windows as windows_mod
+
+        if not _native.native_available():
+            pytest.skip("native library not built: only one backend to test")
+        rng = np.random.default_rng(3)
+        s = rng.standard_normal((40, 3)).astype(np.float32)
+        t = rng.standard_normal(40).astype(np.float32)
+        a = windows_mod.teacher_forcing_pairs(s, t, 6, stride)
+        monkeypatch.setattr(
+            windows_mod, "_native_windows", lambda *args: None
+        )
+        b = windows_mod.teacher_forcing_pairs(s, t, 6, stride)
+        n = len(range(0, len(s) - 6 + 1, stride))
+        assert np.array_equal(a[0], b[0]) and np.array_equal(a[1], b[1])
+        assert a[0].shape == (n, 6, 3) and a[1].shape == (n, 6)
+
 
 class TestIterWindows:
     @pytest.mark.parametrize("interleave", [False, True])

@@ -102,11 +102,16 @@ class _WellWindower:
             # Preserve the emit offset (can be > 0 with stride > 1).
             self._carry[well] = (s, t, offset)
             return None
-        # Windows starting at offset, offset+stride, ... within this buffer.
+        # Windows starting at offset, offset+stride, ... within this
+        # buffer — extracted by the shared engine (tpuflow.data.windows:
+        # C++ fast path, vectorized stride-trick fallback).
         starts = np.arange(offset, len(s) - self.window + 1, self.stride)
         if len(starts):
-            x = np.stack([s[i : i + self.window] for i in starts])
-            y = np.stack([t[i : i + self.window] for i in starts])
+            from tpuflow.data.windows import teacher_forcing_pairs
+
+            x, y = teacher_forcing_pairs(
+                s[offset:], t[offset:], self.window, self.stride
+            )
             next_start = starts[-1] + self.stride
         else:
             x = y = None

@@ -172,17 +172,39 @@ class JobRunner:
         # Replay happens before the worker starts, so requeued entries
         # are processed like fresh submissions.
         self._journal_file = None
+        self._journal_lock = threading.Lock()  # serializes writes only
         if journal_path:
-            self._replay_journal(journal_path)
+            # Exclusive: two daemons replaying one journal would each
+            # requeue the other's queued jobs and run them twice.
             self._journal_file = open(journal_path, "a", encoding="utf-8")
+            try:
+                import fcntl
+
+                fcntl.flock(
+                    self._journal_file, fcntl.LOCK_EX | fcntl.LOCK_NB
+                )
+            except OSError:
+                self._journal_file.close()
+                raise RuntimeError(
+                    f"journal {journal_path!r} is locked by another "
+                    "running daemon; two daemons sharing one journal "
+                    "would re-run each other's queued jobs"
+                ) from None
+            except ImportError:  # non-POSIX: proceed without the guard
+                pass
+            self._replay_journal(journal_path)
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
     # ---- journal ----
 
     def _journal(self, **rec) -> None:
-        """Append one lifecycle event; caller holds the lock (or is the
-        single-threaded __init__).
+        """Append one lifecycle event. Writes serialize on their own lock,
+        NOT self._lock — API reads must never block behind disk I/O (an
+        NFS stall flushing a big sweep report would otherwise freeze every
+        GET). Call sites order correctly without self._lock: a job's
+        "submitted" precedes queue.put, so the worker can't emit "started"
+        first, and terminal events come only from the worker itself.
 
         NEVER raises: the journal is best-effort durability, and a write
         failure (disk full, volume gone, a Python caller's non-JSON spec)
@@ -194,8 +216,10 @@ class JobRunner:
         if self._journal_file is None:
             return
         try:
-            self._journal_file.write(json.dumps(rec) + "\n")
-            self._journal_file.flush()
+            line = json.dumps(rec) + "\n"
+            with self._journal_lock:
+                self._journal_file.write(line)
+                self._journal_file.flush()
         except (OSError, TypeError, ValueError) as e:
             import sys
 
@@ -288,15 +312,14 @@ class JobRunner:
                 self._cancel_events[job_id] = threading.Event()
                 self.stats["submitted"] += 1
                 self._queue.put((job_id, kind, config, st.get("timeout_s")))
-        # Record the adjudications so the NEXT replay sees them terminal.
-        if lost:
-            with open(path, "a", encoding="utf-8") as f:
-                for job_id in lost:
-                    rec = self._jobs[job_id]
-                    f.write(json.dumps({
-                        "event": "terminal", "job_id": job_id,
-                        "status": rec["status"], "error": rec.get("error"),
-                    }) + "\n")
+        # Record the adjudications so the NEXT replay sees them terminal
+        # (the flocked append handle is already open at this point).
+        for job_id in lost:
+            rec = self._jobs[job_id]
+            self._journal(
+                event="terminal", job_id=job_id,
+                status=rec["status"], error=rec.get("error"),
+            )
 
     # ---- submission ----
 
@@ -365,10 +388,11 @@ class JobRunner:
             self._jobs[job_id] = record
             self._cancel_events[job_id] = threading.Event()
             self.stats["submitted"] += 1
-            self._journal(
-                event="submitted", job_id=job_id, spec=spec,
-                timeout_s=timeout_s,
-            )
+        # Journal BEFORE queue.put: the worker can't see the job (so no
+        # "started" line) until its "submitted" line is down.
+        self._journal(
+            event="submitted", job_id=job_id, spec=spec, timeout_s=timeout_s
+        )
         self._queue.put((job_id, kind, config, timeout_s))
         return {"job_id": job_id, "status": "queued"}
 
@@ -387,18 +411,20 @@ class JobRunner:
                 rec.update(status="cancelled", error="cancelled while queued")
                 self.stats["cancelled"] += 1
                 self._cancel_events.pop(job_id, None)
-                self._journal(
-                    event="terminal", job_id=job_id, status="cancelled",
-                    error=rec["error"],
-                )
-                return {"job_id": job_id, "status": "cancelled"}
-            if status in ("running", "cancelling"):
+                result = {"job_id": job_id, "status": "cancelled"}
+            elif status in ("running", "cancelling"):
                 rec["status"] = "cancelling"
                 event = self._cancel_events.get(job_id)
                 if event is not None:
                     event.set()
                 return {"job_id": job_id, "status": "cancelling"}
-            return {"job_id": job_id, "status": status, "conflict": True}
+            else:
+                return {"job_id": job_id, "status": status, "conflict": True}
+        self._journal(
+            event="terminal", job_id=job_id, status="cancelled",
+            error="cancelled while queued",
+        )
+        return result
 
     def get(self, job_id: str) -> dict | None:
         with self._lock:
@@ -441,7 +467,7 @@ class JobRunner:
                 cancel_event = self._cancel_events.setdefault(
                     job_id, threading.Event()
                 )
-                self._journal(event="started", job_id=job_id)
+            self._journal(event="started", job_id=job_id)
             t_started = _time.monotonic()
             deadline = (
                 t_started + timeout_s if timeout_s is not None else None
@@ -498,11 +524,11 @@ class JobRunner:
                             status="failed", error=f"TrainingInterrupted: {e}"
                         )
                         self.stats["failed"] += 1
-                    self._journal(
-                        event="terminal", job_id=job_id,
-                        status=self._jobs[job_id]["status"],
-                        error=self._jobs[job_id]["error"],
-                    )
+                    terminal = {
+                        "status": self._jobs[job_id]["status"],
+                        "error": self._jobs[job_id]["error"],
+                    }
+                self._journal(event="terminal", job_id=job_id, **terminal)
                 continue
             except Exception as e:
                 # Evict BEFORE publishing the terminal status: a client
@@ -515,10 +541,10 @@ class JobRunner:
                         status="failed", error=f"{type(e).__name__}: {e}"
                     )
                     self.stats["failed"] += 1
-                    self._journal(
-                        event="terminal", job_id=job_id, status="failed",
-                        error=self._jobs[job_id]["error"],
-                    )
+                    err = self._jobs[job_id]["error"]
+                self._journal(
+                    event="terminal", job_id=job_id, status="failed", error=err
+                )
                 continue
             self._notify_artifact(config, kind)
             with self._lock:
@@ -527,9 +553,9 @@ class JobRunner:
                 # work is done; report it done (the cancel was a no-op).
                 self._jobs[job_id].update(status="done", report=rep)
                 self.stats["done"] += 1
-                self._journal(
-                    event="terminal", job_id=job_id, status="done", report=rep
-                )
+            self._journal(
+                event="terminal", job_id=job_id, status="done", report=rep
+            )
 
     @staticmethod
     def _failed_rows(rpt, ident) -> list[dict]:
