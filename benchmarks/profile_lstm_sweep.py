@@ -1,0 +1,132 @@
+"""Profile the LSTM-64 config sweep: WHY is the scanned epoch slower?
+
+Round-3 on-chip measurements (BENCHLOG.md) left one open mystery: the
+B=4096x16-scan epoch program measured ~3.5x lower per-sample efficiency
+than B=1024 single-step. This script closes it with data the moment a
+chip is reachable: for each ``<batch>x<scan>`` config it measures
+fully-drained throughput AND captures a ``jax.profiler`` trace, then
+prints the pairwise verdicts:
+
+- 1024x1 vs 1024x16  — same per-step FLOPs/bytes, 16x less dispatch:
+  any gap here is SCAN-PROGRAM overhead (dynamic-slice feeds, carry
+  layout, missed donation), not batch size;
+- 1024x16 vs 4096x16 — same scan depth: any gap here is the BATCH
+  effect (HBM behavior, tiling at [4096, 256] gates).
+
+Traces land under --trace-root (default /tmp/tpuflow_lstm_traces/<cfg>),
+ready for ``tensorboard --logdir`` or xprof. Runs on CPU too (the
+verdicts then describe the host backend — useful as a dry run only).
+
+Usage:
+    python benchmarks/profile_lstm_sweep.py [--configs 1024x1,1024x16,4096x16]
+        [--seconds 5] [--trace-root DIR] [--no-trace]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+from benchmarks.common import maybe_pin_cpu
+
+maybe_pin_cpu()
+
+import jax
+import numpy as np
+
+from benchmarks.common import emit, time_steps
+
+WINDOW, FEATURES, HIDDEN = 24, 5, 64
+
+
+def build_step(batch: int, scan: int):
+    """The same workload bench.py measures: full train step(s), bf16
+    compute, donated state threaded through."""
+    import jax.numpy as jnp
+
+    from tpuflow.core.losses import mae_clip
+    from tpuflow.models import LSTMRegressor
+    from tpuflow.train import create_state, make_train_step
+    from tpuflow.train.steps import make_epoch_step
+
+    model = LSTMRegressor(hidden=HIDDEN, dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    x_np = rng.standard_normal((batch, WINDOW, FEATURES)).astype(np.float32)
+    y_np = rng.standard_normal((batch, WINDOW)).astype(np.float32)
+    state = create_state(model, jax.random.PRNGKey(0), x_np[:2])
+    key = jax.random.PRNGKey(0)
+    if scan > 1:
+        xs = jnp.asarray(np.broadcast_to(x_np, (scan,) + x_np.shape))
+        ys = jnp.asarray(np.broadcast_to(y_np, (scan,) + y_np.shape))
+        epoch_step = make_epoch_step(mae_clip)
+        step = lambda s: epoch_step(s, xs, ys, key)
+    else:
+        x, y = jnp.asarray(x_np), jnp.asarray(y_np)
+        one = make_train_step(mae_clip)
+        step = lambda s: one(s, x, y, key)
+
+    class Box:
+        s = state
+
+    def timed():
+        Box.s, m = step(Box.s)
+        return m
+
+    return timed
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--configs", default="1024x1,1024x16,4096x16")
+    p.add_argument("--seconds", type=float, default=5.0)
+    p.add_argument("--trace-root", default="/tmp/tpuflow_lstm_traces")
+    p.add_argument("--no-trace", action="store_true")
+    args = p.parse_args()
+
+    device = getattr(jax.devices()[0], "device_kind", "unknown")
+    results: dict[str, float] = {}
+    for cfg in args.configs.split(","):
+        batch, scan = (int(v) for v in cfg.strip().split("x"))
+        timed = build_step(batch, scan)
+        n, elapsed = time_steps(timed, seconds=args.seconds, block=lambda m: m)
+        sps = batch * scan * n / elapsed
+        results[cfg] = sps
+        emit(
+            f"lstm64@{cfg}", "train_samples_per_sec_per_chip", sps,
+            "samples/sec", device=device,
+            per_inner_step_us=round(elapsed / (n * scan) * 1e6, 1),
+        )
+        if not args.no_trace:
+            tdir = os.path.join(args.trace_root, cfg.strip())
+            jax.profiler.start_trace(tdir)
+            out = timed()
+            jax.block_until_ready(out)
+            jax.profiler.stop_trace()
+            print(f"# trace: {tdir}", flush=True)
+
+    def ratio(a: str, b: str) -> float | None:
+        if a in results and b in results and results[b] > 0:
+            return results[a] / results[b]
+        return None
+
+    scan_overhead = ratio("1024x1", "1024x16")
+    batch_effect = ratio("1024x16", "4096x16")
+    if scan_overhead is not None:
+        print(
+            f"# scan-program overhead (1024x1 / 1024x16): "
+            f"{scan_overhead:.2f}x "
+            f"{'<- scan is the culprit' if scan_overhead > 1.5 else '(scan ok)'}"
+        )
+    if batch_effect is not None:
+        print(
+            f"# batch effect (1024x16 / 4096x16): {batch_effect:.2f}x "
+            f"{'<- large batch is the culprit' if batch_effect > 1.5 else '(batch ok)'}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

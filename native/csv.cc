@@ -122,38 +122,16 @@ bool parse_chunk(const char* begin, const char* end, int ncols,
   return true;
 }
 
-}  // namespace
-
-extern "C" {
-
-// Returns a table handle, or nullptr with *err_out filled (caller buffer).
-CsvTable* tf_csv_read(const char* path, const int* kinds, int ncols,
-                      char* err_out, int err_len) {
-  auto fail = [&](const std::string& msg) -> CsvTable* {
-    snprintf(err_out, static_cast<size_t>(err_len), "%s", msg.c_str());
-    return nullptr;
-  };
-  FILE* fp = fopen(path, "rb");
-  if (fp == nullptr) return fail(std::string("cannot open ") + path);
-  fseek(fp, 0, SEEK_END);
-  long size = ftell(fp);
-  fseek(fp, 0, SEEK_SET);
-  // +1 for a NUL terminator: files without a trailing newline would
-  // otherwise let strtol/strtof scan past the allocation.
-  std::vector<char> buf(static_cast<size_t>(size) + 1, '\0');
-  if (size > 0 && fread(buf.data(), 1, static_cast<size_t>(size), fp) !=
-                      static_cast<size_t>(size)) {
-    fclose(fp);
-    return fail("short read");
-  }
-  fclose(fp);
-
-  // Split at line boundaries into one chunk per thread.
+// Parse a whole NUL-terminated buffer [base, base+size), splitting at line
+// boundaries into one chunk per thread. Returns the assembled table or
+// nullptr with err filled — the shared engine under the whole-file reader
+// AND the streaming buffer parser.
+CsvTable* parse_all(const char* base, long size, const int* kinds, int ncols,
+                    std::string& err) {
   unsigned hw = std::thread::hardware_concurrency();
   int nthreads = static_cast<int>(hw == 0 ? 4 : hw);
-  if (size < (1 << 20)) nthreads = 1;  // small files: threading overhead loses
+  if (size < (1 << 20)) nthreads = 1;  // small inputs: threading overhead loses
   std::vector<std::pair<const char*, const char*>> chunks;
-  const char* base = buf.data();
   const char* end = base + size;
   const char* start = base;
   for (int t = 0; t < nthreads && start < end; ++t) {
@@ -186,9 +164,14 @@ CsvTable* tf_csv_read(const char* path, const int* kinds, int ncols,
   }
   for (auto& w : workers) w.join();
   if (!ok) {
-    for (auto& e : part_errs)
-      if (!e.empty()) return fail(e);
-    return fail("parse error");
+    for (auto& e : part_errs) {
+      if (!e.empty()) {
+        err = e;
+        return nullptr;
+      }
+    }
+    err = "parse error";
+    return nullptr;
   }
 
   auto* table = new CsvTable();
@@ -204,6 +187,54 @@ CsvTable* tf_csv_read(const char* path, const int* kinds, int ncols,
                         src.floats.end());
       for (auto& s : src.strs) dst.strs.emplace_back(std::move(s));
     }
+  }
+  return table;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns a table handle, or nullptr with *err_out filled (caller buffer).
+CsvTable* tf_csv_read(const char* path, const int* kinds, int ncols,
+                      char* err_out, int err_len) {
+  auto fail = [&](const std::string& msg) -> CsvTable* {
+    snprintf(err_out, static_cast<size_t>(err_len), "%s", msg.c_str());
+    return nullptr;
+  };
+  FILE* fp = fopen(path, "rb");
+  if (fp == nullptr) return fail(std::string("cannot open ") + path);
+  fseek(fp, 0, SEEK_END);
+  long size = ftell(fp);
+  fseek(fp, 0, SEEK_SET);
+  // +1 for a NUL terminator: files without a trailing newline would
+  // otherwise let strtol/strtof scan past the allocation.
+  std::vector<char> buf(static_cast<size_t>(size) + 1, '\0');
+  if (size > 0 && fread(buf.data(), 1, static_cast<size_t>(size), fp) !=
+                      static_cast<size_t>(size)) {
+    fclose(fp);
+    return fail("short read");
+  }
+  fclose(fp);
+
+  std::string err;
+  CsvTable* table = parse_all(buf.data(), size, kinds, ncols, err);
+  if (table == nullptr) return fail(err);
+  return table;
+}
+
+// Parse an in-memory text buffer (one streaming chunk) — same semantics
+// as tf_csv_read on a file with this content. The buffer need not be
+// NUL-terminated (it is copied and terminated here).
+CsvTable* tf_csv_parse(const char* data, long len, const int* kinds,
+                       int ncols, char* err_out, int err_len) {
+  std::vector<char> buf(static_cast<size_t>(len) + 1, '\0');
+  if (len > 0) memcpy(buf.data(), data, static_cast<size_t>(len));
+  std::string err;
+  CsvTable* table = parse_all(buf.data(), len, kinds, ncols, err);
+  if (table == nullptr) {
+    snprintf(err_out, static_cast<size_t>(err_len), "%s", err.c_str());
+    return nullptr;
   }
   return table;
 }

@@ -100,6 +100,66 @@ class TestNativeCsv:
         assert out["well"][0] == "pözo_å"
 
 
+class TestNativeBufferParse:
+    """tf_csv_parse: the streaming reader's per-chunk fast path."""
+
+    def test_matches_file_reader(self, csv_file):
+        if not native.native_available():
+            pytest.skip("native library not built")
+        data = open(csv_file, "rb").read()
+        got = native.parse_csv_native(data, SCHEMA)
+        assert got is not None
+        want = native.read_csv_native(csv_file, SCHEMA)
+        for name in want:
+            if want[name].dtype.kind == "U":
+                assert got[name].tolist() == want[name].tolist()
+            else:
+                np.testing.assert_array_equal(got[name], want[name])
+
+    def test_stream_chunks_match_python_fallback(self, csv_file, monkeypatch):
+        """stream_csv_columns yields identical chunks whichever backend
+        parses them — the backend-invariance the streaming path relies on."""
+        from tpuflow.data import stream as stream_mod
+
+        a = list(stream_mod.stream_csv_columns(csv_file, SCHEMA, 128))
+        monkeypatch.setattr(
+            stream_mod, "_parse_chunk",
+            lambda rows, schema, path: __import__(
+                "tpuflow.data.csv_io", fromlist=["parse_rows"]
+            ).parse_rows(rows, schema, source=path),
+        )
+        b = list(stream_mod.stream_csv_columns(csv_file, SCHEMA, 128))
+        assert len(a) == len(b)
+        for ca, cb in zip(a, b):
+            for name in ca:
+                if ca[name].dtype.kind == "U":
+                    assert ca[name].tolist() == cb[name].tolist()
+                else:
+                    np.testing.assert_array_equal(ca[name], cb[name])
+
+    def test_malformed_chunk_raises_with_source_range(self, tmp_path):
+        if not native.native_available():
+            pytest.skip("native library not built")
+        with pytest.raises(ValueError, match="chunk:1-2.*bad int"):
+            native.parse_csv_native(
+                b"1.0,2,3.0,w,4.0\n1.0,oops,3.0,w,4.0\n", SCHEMA,
+                source="chunk:1-2",
+            )
+
+    def test_empty_buffer_is_empty_table(self):
+        if not native.native_available():
+            pytest.skip("native library not built")
+        out = native.parse_csv_native(b"", SCHEMA)
+        assert out is not None and len(out["flow"]) == 0
+
+    def test_stale_library_degrades_to_none(self, monkeypatch):
+        class _OldLib:  # no tf_csv_parse attribute
+            pass
+
+        monkeypatch.setattr(native, "_load", lambda: _OldLib())
+        assert native.parse_csv_native(b"1,2\n", SCHEMA) is None
+
+
 class TestNativeFuzz:
     def test_random_tables_match_numpy(self, tmp_path):
         """Fuzz: arbitrary generated tables parse identically both ways."""

@@ -148,6 +148,30 @@ class TestTrainConfigTp:
             assert a["val_loss"] == pytest.approx(b["val_loss"], rel=1e-4)
         assert tp.test_mae == pytest.approx(ref.test_mae, rel=1e-4)
 
+    def test_tp_trained_artifact_serves_single_device(self, tmp_path):
+        """A model trained with a model axis must serve like any other:
+        Orbax restores the sharded checkpoint onto the default device and
+        the sidecar needs no TP awareness."""
+        from tpuflow.api.predict_api import Predictor
+
+        train(
+            TrainJobConfig(
+                **{**BASE, "max_epochs": 1},
+                n_devices=8, tp=2, storage_path=str(tmp_path),
+            )
+        )
+        p = Predictor.load(str(tmp_path), "static_mlp")
+        cols = {
+            "pressure": np.array([2000.0, 1500.0]),
+            "choke": np.array([30.0, 20.0]),
+            "glr": np.array([1.2, 0.8]),
+            "temperature": np.array([60.0, 55.0]),
+            "water_cut": np.array([0.2, 0.3]),
+            "completion": np.array(["A", "B"]),
+        }
+        y = np.asarray(p.predict_columns(cols))
+        assert y.shape == (2,) and np.all(np.isfinite(y))
+
     def test_tp_rejects_bad_division(self):
         with pytest.raises(ValueError, match="not divisible"):
             train(TrainJobConfig(**BASE, n_devices=8, tp=3))

@@ -81,6 +81,18 @@ def _load():
         ctypes.c_int,
     ]
     lib.tf_csv_free.argtypes = [ctypes.c_void_p]
+    # Streaming buffer parse (newer builds; absent in stale .so files —
+    # callers hasattr-check so an old library degrades to the fallback).
+    if hasattr(lib, "tf_csv_parse"):
+        lib.tf_csv_parse.restype = ctypes.c_void_p
+        lib.tf_csv_parse.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.c_int,
+            ctypes.c_char_p,
+            ctypes.c_int,
+        ]
     lib.tf_window_count.restype = ctypes.c_long
     lib.tf_window_count.argtypes = [ctypes.c_long] * 3
     lib.tf_sliding_windows.argtypes = [
@@ -105,21 +117,8 @@ def native_available() -> bool:
 _KIND_CODES = {"int": 0, "float": 1}
 
 
-def read_csv_native(path: str, schema: "Schema") -> dict[str, np.ndarray] | None:
-    """Parse a headerless CSV with the C++ library; None if unavailable."""
-    lib = _load()
-    if lib is None:
-        return None
-    kinds = [_KIND_CODES.get(c.kind, 2) for c in schema.columns]
-    ckinds = (ctypes.c_int * len(kinds))(*kinds)
-    err = ctypes.create_string_buffer(512)
-    handle = lib.tf_csv_read(
-        path.encode(), ckinds, len(kinds), err, len(err)
-    )
-    if not handle:
-        raise ValueError(
-            f"{path}: {err.value.decode(errors='replace')}"
-        )
+def _drain_table(lib, handle, schema: "Schema", kinds) -> dict[str, np.ndarray]:
+    """Copy a CsvTable handle's columns into numpy arrays and free it."""
     try:
         n = lib.tf_csv_nrows(handle)
         out: dict[str, np.ndarray] = {}
@@ -142,6 +141,47 @@ def read_csv_native(path: str, schema: "Schema") -> dict[str, np.ndarray] | None
         return out
     finally:
         lib.tf_csv_free(handle)
+
+
+def read_csv_native(path: str, schema: "Schema") -> dict[str, np.ndarray] | None:
+    """Parse a headerless CSV with the C++ library; None if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    kinds = [_KIND_CODES.get(c.kind, 2) for c in schema.columns]
+    ckinds = (ctypes.c_int * len(kinds))(*kinds)
+    err = ctypes.create_string_buffer(512)
+    handle = lib.tf_csv_read(
+        path.encode(), ckinds, len(kinds), err, len(err)
+    )
+    if not handle:
+        raise ValueError(
+            f"{path}: {err.value.decode(errors='replace')}"
+        )
+    return _drain_table(lib, handle, schema, kinds)
+
+
+def parse_csv_native(
+    data: bytes, schema: "Schema", source: str = "<buffer>"
+) -> dict[str, np.ndarray] | None:
+    """Parse one in-memory CSV chunk with the C++ library — the streaming
+    reader's fast path. None if the library (or the tf_csv_parse symbol,
+    on stale builds) is unavailable; raises ValueError on malformed rows
+    like the file reader."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tf_csv_parse"):
+        return None
+    kinds = [_KIND_CODES.get(c.kind, 2) for c in schema.columns]
+    ckinds = (ctypes.c_int * len(kinds))(*kinds)
+    err = ctypes.create_string_buffer(512)
+    handle = lib.tf_csv_parse(
+        data, len(data), ckinds, len(kinds), err, len(err)
+    )
+    if not handle:
+        raise ValueError(
+            f"{source}: {err.value.decode(errors='replace')}"
+        )
+    return _drain_table(lib, handle, schema, kinds)
 
 
 def sliding_windows_native(

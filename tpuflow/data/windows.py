@@ -82,7 +82,9 @@ def sliding_windows(
     starts = np.arange(0, T - length + 1, stride)
     windows = _strided_view(series, length, stride)
     y = targets[starts + length - 1]
-    return windows.astype(np.float32), y.astype(np.float32)
+    # copy=False: already-float32 inputs (the whole pipeline) skip a full
+    # re-materialization of the window block.
+    return windows.astype(np.float32, copy=False), y.astype(np.float32, copy=False)
 
 
 def teacher_forcing_pairs(
@@ -109,4 +111,4 @@ def teacher_forcing_pairs(
         return native
     windows = _strided_view(series, length, stride)
     y = _strided_view(targets, length, stride)
-    return windows.astype(np.float32), y.astype(np.float32)
+    return windows.astype(np.float32, copy=False), y.astype(np.float32, copy=False)

@@ -199,12 +199,15 @@ class JobRunner:
     # ---- journal ----
 
     def _journal(self, **rec) -> None:
-        """Append one lifecycle event. Writes serialize on their own lock,
-        NOT self._lock — API reads must never block behind disk I/O (an
-        NFS stall flushing a big sweep report would otherwise freeze every
-        GET). Call sites order correctly without self._lock: a job's
-        "submitted" precedes queue.put, so the worker can't emit "started"
-        first, and terminal events come only from the worker itself.
+        """Append one lifecycle event. Writes serialize on their own lock;
+        only the small "submitted" line is written under self._lock (see
+        submit() — it must precede the record becoming visible), so API
+        reads never block behind the big terminal-report flushes (an NFS
+        stall there would otherwise freeze every GET). Per-job ordering:
+        "submitted" lands before the record is reachable; "started" and
+        worker terminals are single-worker-ordered; a queued-cancel
+        terminal can only follow the job's (already written) submitted
+        line.
 
         NEVER raises: the journal is best-effort durability, and a write
         failure (disk full, volume gone, a Python caller's non-JSON spec)
@@ -385,14 +388,21 @@ class JobRunner:
                 raise queue.Full(
                     f"job queue full ({queued} queued, max {self.max_queued})"
                 )
+            # The "submitted" line is written INSIDE the lock, before the
+            # record becomes visible: a cancel() (or the worker) can only
+            # reach this job through self._jobs, so every other journal
+            # line for it is guaranteed to land after this one — replay
+            # folds in file order and a terminal-before-submitted pair
+            # would resurrect a cancelled job. (Submit lines are small;
+            # the off-lock discipline matters for the big terminal
+            # reports, which stay worker-ordered without the lock.)
+            self._journal(
+                event="submitted", job_id=job_id, spec=spec,
+                timeout_s=timeout_s,
+            )
             self._jobs[job_id] = record
             self._cancel_events[job_id] = threading.Event()
             self.stats["submitted"] += 1
-        # Journal BEFORE queue.put: the worker can't see the job (so no
-        # "started" line) until its "submitted" line is down.
-        self._journal(
-            event="submitted", job_id=job_id, spec=spec, timeout_s=timeout_s
-        )
         self._queue.put((job_id, kind, config, timeout_s))
         return {"job_id": job_id, "status": "queued"}
 
