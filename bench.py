@@ -42,6 +42,12 @@ before one full sweep finished):
   stage line, so a dead relay is distinguishable from a slow sweep;
 - the parent retries up to BENCH_ATTEMPTS (default 3) times with backoff,
   bounded by the deadline;
+- a DEAD relay makes backend init hang forever: if the worker hasn't
+  reported "backend up" within BENCH_INIT_TIMEOUT (default 90s), the
+  attempt is killed and the REMAINING attempts run with
+  JAX_PLATFORMS=cpu — the record then carries ``device: "cpu"`` and
+  ``"fallback"`` explaining why, which is honest and still infinitely
+  more useful than the ``value: 0.0`` rounds 1-3 recorded;
 - nothing dispatches eagerly before the warmed-up compiled step: all
   host-side slicing/broadcasting happens in numpy.
 
@@ -63,7 +69,9 @@ BENCH_VARIANTS (xla|unroll|pallas|all, default "xla,pallas"),
 BENCH_UNROLL (scan unroll factor for the unrolled variant, default 8),
 BENCH_ATTEMPTS (default 3), BENCH_TIMEOUT (per-attempt seconds, default
 600), BENCH_DEADLINE (overall wall-clock budget in seconds, default 210;
-caps attempts x timeout).
+caps attempts x timeout), BENCH_INIT_TIMEOUT (seconds to wait for the
+worker's backend to come up before falling back to CPU, default 90; 0
+disables the fallback).
 """
 
 from __future__ import annotations
@@ -423,12 +431,16 @@ def main() -> None:
         _emit_failure(0, f"invalid bench configuration: {e}")
         return
 
+    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", 90))
+
     lock = threading.Lock()
     state: dict = {
         "best": None,  # best complete record streamed from any worker
         "stderr": collections.deque(maxlen=8),  # worker stage trace
         "attempt": 0,
         "proc": None,
+        "backend_up": False,  # this attempt's worker resolved devices
+        "force_cpu": False,  # relay adjudicated dead: pin CPU from now on
     }
 
     def _note_record(rec: dict) -> None:
@@ -447,6 +459,12 @@ def main() -> None:
                 return
             rec = dict(rec)
             rec["attempts"] = state["attempt"]
+            if state["force_cpu"]:
+                rec["fallback"] = (
+                    "cpu: TPU backend init exceeded "
+                    f"{init_timeout:g}s (relay dead?); this is a host "
+                    "measurement, not the chip"
+                )
             print(json.dumps(rec), flush=True)
             state["best"] = rec
 
@@ -469,6 +487,8 @@ def main() -> None:
             line = line.rstrip()
             with lock:
                 state["stderr"].append(line)
+                if "backend up:" in line:
+                    state["backend_up"] = True
             print(line, file=sys.stderr, flush=True)
 
     def _stage_trace() -> str:
@@ -529,6 +549,14 @@ def main() -> None:
         att_timeout = max(min(timeout, remaining() - 5), 20)
         env = dict(os.environ)
         env["BENCH_WORKER_DEADLINE_TS"] = str(time.time() + att_timeout - 10)
+        if state["force_cpu"]:
+            # Must be in the env BEFORE the interpreter starts: the
+            # platform plugin registers itself at interpreter startup,
+            # and pinning from inside Python cannot stop a dead-relay
+            # backend init from hanging.
+            env["JAX_PLATFORMS"] = "cpu"
+        with lock:
+            state["backend_up"] = False
         proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--worker"],
             stdout=subprocess.PIPE,
@@ -545,12 +573,46 @@ def main() -> None:
         for t in pumps:
             t.start()
         timed_out = False
-        try:
-            proc.wait(timeout=att_timeout)
-        except subprocess.TimeoutExpired:
-            timed_out = True
-            proc.kill()
-            proc.wait()
+        init_killed = False
+        t_attempt = time.monotonic()
+        while True:
+            try:
+                proc.wait(timeout=2.0)
+                break
+            except subprocess.TimeoutExpired:
+                waited = time.monotonic() - t_attempt
+                if waited >= att_timeout:
+                    timed_out = True
+                    if (
+                        init_timeout > 0
+                        and not state["backend_up"]
+                        and not state["force_cpu"]
+                    ):
+                        # The WHOLE attempt elapsed without the backend
+                        # coming up (att_timeout <= init_timeout): same
+                        # dead-relay adjudication as the init check below
+                        # — otherwise every retry burns identically.
+                        init_killed = True
+                        with lock:
+                            state["force_cpu"] = True
+                    proc.kill()
+                    proc.wait()
+                    break
+                if (
+                    init_timeout > 0
+                    and not state["backend_up"]
+                    and not state["force_cpu"]
+                    and waited >= init_timeout
+                ):
+                    # Backend init is hung (dead relay): adjudicate and
+                    # spend the remaining attempts on a labeled CPU
+                    # measurement instead of burning them all the same way.
+                    init_killed = True
+                    with lock:
+                        state["force_cpu"] = True
+                    proc.kill()
+                    proc.wait()
+                    break
         state["proc"] = None
         for t in pumps:
             t.join(timeout=5)
@@ -561,7 +623,17 @@ def main() -> None:
             # The best record was already printed as the tail line the
             # moment it streamed in; nothing more to emit.
             return
-        if timed_out:
+        if init_killed:
+            will_retry = attempt < attempts_max and remaining() >= 30
+            last_err = (
+                f"attempt {attempt}: backend never came up (dead relay?); "
+                + (
+                    "falling back to JAX_PLATFORMS=cpu"
+                    if will_retry
+                    else "no attempts/deadline left for the cpu fallback"
+                )
+            )
+        elif timed_out:
             last_err = (
                 f"attempt {attempt}: timed out after {att_timeout:.0f}s; "
                 f"last stage: {_stage_trace() or '(no worker output)'}"
@@ -571,7 +643,9 @@ def main() -> None:
                 f"attempt {attempt}: rc={proc.returncode}; "
                 f"last stage: {_stage_trace() or '(no worker output)'}"
             )
-        if attempt < attempts_max:
+        if attempt < attempts_max and not init_killed:
+            # (No backoff after an init kill: the relay won't heal, and
+            # the CPU fallback attempt should start immediately.)
             time.sleep(max(min(5.0 * attempt, remaining() / 4, 30.0), 0.0))
     # All attempts failed: still emit one machine-readable line.
     _emit_failure(state["attempt"], last_err)
