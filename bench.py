@@ -423,6 +423,7 @@ def main() -> None:
     # the worker they would burn every retry (each with a full backend
     # init) on a typo that dies identically each time.
     try:
+        init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", 90))
         bench_configs()
         from benchmarks.common import lstm_variants
 
@@ -430,8 +431,6 @@ def main() -> None:
     except ValueError as e:
         _emit_failure(0, f"invalid bench configuration: {e}")
         return
-
-    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", 90))
 
     lock = threading.Lock()
     state: dict = {
@@ -461,9 +460,8 @@ def main() -> None:
             rec["attempts"] = state["attempt"]
             if state["force_cpu"]:
                 rec["fallback"] = (
-                    "cpu: TPU backend init exceeded "
-                    f"{init_timeout:g}s (relay dead?); this is a host "
-                    "measurement, not the chip"
+                    "cpu: the TPU backend never came up (relay dead?); "
+                    "this is a host measurement, not the chip"
                 )
             print(json.dumps(rec), flush=True)
             state["best"] = rec
@@ -574,6 +572,7 @@ def main() -> None:
             t.start()
         timed_out = False
         init_killed = False
+        init_waited = 0.0
         t_attempt = time.monotonic()
         while True:
             try:
@@ -581,38 +580,33 @@ def main() -> None:
                 break
             except subprocess.TimeoutExpired:
                 waited = time.monotonic() - t_attempt
-                if waited >= att_timeout:
-                    timed_out = True
-                    if (
-                        init_timeout > 0
-                        and not state["backend_up"]
-                        and not state["force_cpu"]
-                    ):
-                        # The WHOLE attempt elapsed without the backend
-                        # coming up (att_timeout <= init_timeout): same
-                        # dead-relay adjudication as the init check below
-                        # — otherwise every retry burns identically.
-                        init_killed = True
-                        with lock:
-                            state["force_cpu"] = True
-                    proc.kill()
-                    proc.wait()
-                    break
-                if (
+                hard_timeout = waited >= att_timeout
+                # Dead-relay adjudication: the backend never came up
+                # within the trusted threshold — whether that threshold
+                # is BENCH_INIT_TIMEOUT or a shorter whole-attempt
+                # budget. The 45s floor keeps a deadline-clamped late
+                # attempt (20-30s) from calling a healthy-but-slow init
+                # dead and pinning the rest of the run to CPU.
+                init_dead = (
                     init_timeout > 0
                     and not state["backend_up"]
                     and not state["force_cpu"]
-                    and waited >= init_timeout
-                ):
-                    # Backend init is hung (dead relay): adjudicate and
-                    # spend the remaining attempts on a labeled CPU
-                    # measurement instead of burning them all the same way.
+                    and waited >= min(init_timeout, att_timeout)
+                    and waited >= 45.0
+                )
+                if not (hard_timeout or init_dead):
+                    continue
+                timed_out = hard_timeout
+                if init_dead:
+                    # Spend the remaining attempts on a labeled CPU
+                    # measurement instead of burning them identically.
                     init_killed = True
+                    init_waited = waited
                     with lock:
                         state["force_cpu"] = True
-                    proc.kill()
-                    proc.wait()
-                    break
+                proc.kill()
+                proc.wait()
+                break
         state["proc"] = None
         for t in pumps:
             t.join(timeout=5)
@@ -626,7 +620,8 @@ def main() -> None:
         if init_killed:
             will_retry = attempt < attempts_max and remaining() >= 30
             last_err = (
-                f"attempt {attempt}: backend never came up (dead relay?); "
+                f"attempt {attempt}: backend never came up within "
+                f"{init_waited:.0f}s (dead relay?); "
                 + (
                     "falling back to JAX_PLATFORMS=cpu"
                     if will_retry
