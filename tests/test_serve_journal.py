@@ -146,6 +146,133 @@ def test_no_journal_means_no_file(tmp_path, gated):
     assert list(tmp_path.iterdir()) == []
 
 
+def test_journal_compacts_on_restart(tmp_path, gated):
+    """Replay cost must stay bounded by job count, not lifetime event
+    count: after a restart the journal holds ONE snapshot line per job
+    and the event history moves to the .archive file."""
+    journal = str(tmp_path / "jobs.jsonl")
+    r1 = JobRunner(journal_path=journal)
+    gated.release.set()
+    jobs = [r1.submit(SPEC)["job_id"] for _ in range(5)]
+    for job in jobs:
+        assert _wait(lambda j=job: r1.get(j)["status"] == "done")
+    assert len(open(journal).readlines()) == 15  # 3 events x 5 jobs
+    _die(r1)
+
+    r2 = JobRunner(journal_path=journal)
+    lines = [json.loads(l) for l in open(journal)]
+    assert len(lines) == 5  # one snapshot per job, history archived
+    assert all(e["event"] == "snapshot" for e in lines)
+    assert {e["job_id"] for e in lines} == set(jobs)
+    assert all(e["status"] == "done" for e in lines)
+    archived = [json.loads(l) for l in open(journal + ".archive")]
+    assert len(archived) == 15
+    # The compacted journal replays identically on the NEXT restart.
+    for job in jobs:
+        rec = r2.get(job)
+        assert rec["status"] == "done" and rec["report"] == {"ok": True}
+    _die(r2)
+    r3 = JobRunner(journal_path=journal)
+    assert all(r3.get(j)["status"] == "done" for j in jobs)
+    assert r3.metrics()["done"] == 5
+
+
+def test_compacted_queued_job_requeues_with_timeout(tmp_path, gated):
+    """A queued-at-crash job survives COMPACTION (snapshot status
+    'queued', timeout preserved) and still runs after the restart."""
+    journal = str(tmp_path / "jobs.jsonl")
+    r1 = JobRunner(journal_path=journal)
+    r1.submit(SPEC)  # occupies the worker forever (gated, never released)
+    assert gated.started.wait(timeout=10)
+    queued = r1.submit({**SPEC, "timeoutSeconds": 123})["job_id"]
+    _die(r1)
+
+    gated.release.set()  # the restarted worker's jobs complete
+    r2 = JobRunner(journal_path=journal)  # compacts: lost + queued snapshot
+    snaps = {
+        e["job_id"]: e
+        for e in map(json.loads, open(journal))
+        if e["event"] == "snapshot"  # worker may already append events
+    }
+    assert snaps[queued]["status"] == "queued"
+    assert snaps[queued]["timeout_s"] == 123.0
+    assert _wait(lambda: r2.get(queued)["status"] == "done")
+    _die(r2)
+
+
+def test_journal_survives_concurrent_load_and_midburst_restart(tmp_path):
+    """N threads submitting + cancelling while the worker churns, then a
+    crash mid-burst and a replay: every job id comes back exactly once,
+    in a valid state, with no resurrections of observed cancels and no
+    duplicated runs of terminal jobs."""
+    import random
+    import time
+    import unittest.mock
+
+    rng = random.Random(7)
+
+    def fake_execute(self, kind, config, stop_fn=None):
+        time.sleep(0.002)
+        return {"ok": True}
+
+    with unittest.mock.patch.object(JobRunner, "_execute", fake_execute):
+        journal = str(tmp_path / "jobs.jsonl")
+        r1 = JobRunner(journal_path=journal)
+        submitted: list[str] = []
+        observed_cancelled: list[str] = []
+        sub_lock = threading.Lock()
+
+        def burst():
+            for _ in range(10):
+                job = r1.submit(SPEC)["job_id"]
+                with sub_lock:
+                    submitted.append(job)
+                if rng.random() < 0.5:
+                    res = r1.cancel(job)
+                    if res and res.get("status") == "cancelled":
+                        with sub_lock:
+                            observed_cancelled.append(job)
+
+        threads = [threading.Thread(target=burst) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        _die(r1)  # crash mid-burst: some jobs queued, one maybe running
+
+    assert len(submitted) == 40
+    ex = _BlockingExecute()
+    ex.release.set()
+    with unittest.mock.patch.object(JobRunner, "_execute", ex):
+        r2 = JobRunner(journal_path=journal)
+        # No lost jobs: every submitted id is present exactly once.
+        raw = [r2.get(j) for j in submitted]
+        assert all(r is not None for r in raw)
+        recs = {r["job_id"]: r for r in raw}
+        assert len(recs) == 40
+        # No resurrection: a cancel the client SAW reported stays
+        # cancelled after replay (the flush-before-report discipline).
+        for job in observed_cancelled:
+            assert recs[job]["status"] == "cancelled", recs[job]
+        # Everything reaches a valid terminal state; requeued jobs run.
+        def settled():
+            rs = [r2.get(j)["status"] for j in submitted]
+            return all(s in ("done", "failed", "cancelled") for s in rs)
+
+        assert _wait(settled, timeout=30)
+        m = r2.metrics()
+        assert m["submitted"] == 40
+        assert m["done"] + m["failed"] + m["cancelled"] == 40
+        _die(r2)
+    # Bounded journal: the post-restart file is one snapshot per job
+    # plus only the events that ran SINCE the restart.
+    r3 = JobRunner(journal_path=journal)
+    lines = [json.loads(l) for l in open(journal)]
+    assert len(lines) == 40  # compacted again: one snapshot per job
+    assert r3.metrics()["submitted"] == 40
+    _die(r3)
+
+
 def test_journal_records_are_wellformed_jsonl(tmp_path, gated):
     journal = str(tmp_path / "jobs.jsonl")
     r1 = JobRunner(journal_path=journal)

@@ -54,11 +54,27 @@ def _parse_chunk(
             schema,
             source=f"{path}:{first}-{last}",
         )
-    except ValueError:
+    except ValueError as native_err:
         # The C++ error names the chunk, not the row; re-parse the one
         # bad chunk with the Python parser so the raised error carries
-        # the TRUE file line (error path only — no hot-loop cost).
-        return parse_rows(rows, schema, source=path)
+        # the TRUE file line (error path only — no hot-loop cost). If
+        # the Python parser ACCEPTS what the native parser rejected, the
+        # two backends disagree on row validity — surface that loudly
+        # instead of silently accepting data that a whole-file native
+        # read (tf_csv_read) would reject, which would quietly break the
+        # documented backend invariance.
+        out = parse_rows(rows, schema, source=path)
+        import warnings
+
+        warnings.warn(
+            f"CSV parser divergence at {path}:{first}-{last}: the native "
+            f"parser rejected this chunk ({native_err}) but the Python "
+            "parser accepted it; proceeding with the Python result — "
+            "report this, the two backends should agree",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return out
     if native is not None:
         return native
     return parse_rows(rows, schema, source=path)

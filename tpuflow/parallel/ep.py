@@ -28,7 +28,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tpuflow.parallel.mesh import MODEL_AXIS
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=32)
 def _moe_fn(mesh: Mesh, axis: str, expert_fn: Callable):
     """Jitted MoE program, cached per (mesh, axis, expert_fn) — tp.py's
     repeated-calls-dispatch-don't-retrace pattern."""

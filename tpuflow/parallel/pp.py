@@ -32,7 +32,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tpuflow.parallel.mesh import MODEL_AXIS
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=32)
 def _pipeline_fn(mesh: Mesh, axis: str, stage_fn: Callable):
     """Jitted pipeline program, cached per (mesh, axis, stage_fn) — the
     same repeated-calls-dispatch-don't-retrace pattern as tp.py. Shapes

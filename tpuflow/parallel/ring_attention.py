@@ -94,11 +94,14 @@ def ring_attention(
     return _ring_attn_fn(mesh, axis, causal, float(scale), impl)(q, k, v)
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=32)
 def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float, impl: str):
     """The jitted ring program, cached per configuration: repeated calls
     (every training step) dispatch the compiled program instead of
-    re-tracing a fresh shard_map closure each time."""
+    re-tracing a fresh shard_map closure each time. Bounded (LRU 32, as
+    are all mesh-keyed caches in this package): the key retains the Mesh
+    and its compiled program, and a long-lived daemon building a fresh
+    mesh per job must not grow memory without bound."""
     return jax.jit(
         jax.shard_map(
             lambda ql, kl, vl: ring_attention_spmd(

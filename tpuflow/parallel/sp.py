@@ -76,7 +76,7 @@ def ring_lstm_scan(
     return _ring_scan_fn(mesh, axis)(xw, wh, b)
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=32)
 def _ring_scan_fn(mesh: Mesh, axis: str):
     """The jitted ring-scan program, cached per (mesh, axis): repeated
     calls (every training step) dispatch the compiled program instead of
@@ -123,7 +123,7 @@ def _ring_scan_fn(mesh: Mesh, axis: str):
     )
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=32)
 def make_sp_forward(
     mesh: Mesh, hidden: int, axis: str = DATA_AXIS
 ) -> Callable:

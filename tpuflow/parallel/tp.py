@@ -41,7 +41,7 @@ def _check_divisible(dim: int, mesh: Mesh, axis: str, what: str) -> None:
         )
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=32)
 def _column_fn(mesh: Mesh, axis: str):
     def body(x, w):
         return x @ w
@@ -57,7 +57,7 @@ def _column_fn(mesh: Mesh, axis: str):
     )
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=32)
 def _row_fn(mesh: Mesh, axis: str):
     def body(x, w):
         return lax.psum(x @ w, axis)
@@ -73,7 +73,7 @@ def _row_fn(mesh: Mesh, axis: str):
     )
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=32)
 def _mlp_fn(mesh: Mesh, axis: str):
     def body(x, w1, w2):
         h = jax.nn.relu(x @ w1)  # local H-slice, no comm

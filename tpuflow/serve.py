@@ -53,8 +53,12 @@ startup — terminal jobs come back as queryable history, jobs that never
 started are requeued under their original ids, and a job that was
 RUNNING when the daemon died is marked failed/lost rather than silently
 re-run (its partial checkpoints exist; resubmit with ``resume: true`` to
-continue). This is the job-history half of the ``spark-submit`` cluster
-story (reference Readme.md:3-4) the service replaces.
+continue). After replay the journal is compacted — event history is
+archived to ``<journal>.archive`` and the live file is rewritten as one
+snapshot line per job, so replay cost stays bounded by job count, not
+by lifetime event count. This is the job-history half of the
+``spark-submit`` cluster story (reference Readme.md:3-4) the service
+replaces.
 
 Two experiment job kinds ride the same queue (the reference's "tests ...
 using multiple model types" workflow, Readme.md:13, web-triggered):
@@ -170,44 +174,89 @@ class JobRunner:
         # marked failed/lost (re-running it could double side effects;
         # the client decides whether to resubmit with resume=true).
         # Replay happens before the worker starts, so requeued entries
-        # are processed like fresh submissions.
+        # are processed like fresh submissions. At startup the replayed
+        # journal is COMPACTED: history is archived to ``<path>.archive``
+        # and the live file is rewritten as one snapshot line per job,
+        # so the journal (and replay time) stays bounded by the number
+        # of jobs, not the number of lifecycle events ever seen.
         self._journal_file = None
-        self._journal_lock = threading.Lock()  # serializes writes only
+        self._journal_lock = threading.Lock()  # serializes disk writes
+        # Ordered event buffer: events are ENQUEUED under self._lock (a
+        # cheap list append, atomic with the state change they record)
+        # and FLUSHED to disk outside it — so a stalled journal
+        # filesystem can never block GET /jobs behind self._lock, while
+        # per-job event order still matches state-change order exactly.
+        self._journal_buf: list[dict] = []
+        self._journal_buf_lock = threading.Lock()
         if journal_path:
             # Exclusive: two daemons replaying one journal would each
             # requeue the other's queued jobs and run them twice.
-            self._journal_file = open(journal_path, "a", encoding="utf-8")
-            try:
-                import fcntl
-
-                fcntl.flock(
-                    self._journal_file, fcntl.LOCK_EX | fcntl.LOCK_NB
-                )
-            except OSError:
-                self._journal_file.close()
-                raise RuntimeError(
-                    f"journal {journal_path!r} is locked by another "
-                    "running daemon; two daemons sharing one journal "
-                    "would re-run each other's queued jobs"
-                ) from None
-            except ImportError:  # non-POSIX: proceed without the guard
-                pass
+            self._journal_file = self._flocked_append(journal_path)
             self._replay_journal(journal_path)
+            self._compact_journal(journal_path)
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
     # ---- journal ----
 
-    def _journal(self, **rec) -> None:
-        """Append one lifecycle event. Writes serialize on their own lock;
-        only the small "submitted" line is written under self._lock (see
-        submit() — it must precede the record becoming visible), so API
-        reads never block behind the big terminal-report flushes (an NFS
-        stall there would otherwise freeze every GET). Per-job ordering:
-        "submitted" lands before the record is reachable; "started" and
-        worker terminals are single-worker-ordered; a queued-cancel
-        terminal can only follow the job's (already written) submitted
-        line.
+    @staticmethod
+    def _flocked_append(path: str):
+        """Open ``path`` for append holding an exclusive flock (the
+        two-daemons-one-journal guard). Open-then-flock races with
+        compaction's inode swap in another daemon: we might flock the
+        orphaned pre-compaction inode just after it was replaced and
+        released, passing the guard while the other daemon runs — so
+        after locking, verify the fd still IS ``path`` and retry."""
+        for _ in range(10):
+            f = open(path, "a", encoding="utf-8")
+            try:
+                import fcntl
+
+                fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                f.close()
+                raise RuntimeError(
+                    f"journal {path!r} is locked by another "
+                    "running daemon; two daemons sharing one journal "
+                    "would re-run each other's queued jobs"
+                ) from None
+            except ImportError:  # non-POSIX: proceed without the guard
+                return f
+            import os
+
+            try:
+                if os.fstat(f.fileno()).st_ino == os.stat(path).st_ino:
+                    return f
+            except OSError:
+                pass  # path vanished mid-swap: retry
+            f.close()  # locked a replaced inode: reopen the current one
+        raise RuntimeError(
+            f"journal {path!r} kept changing underneath the lock "
+            "(another daemon compacting?); refusing to share it"
+        )
+
+    def _journal_enqueue(self, **rec) -> None:
+        """Buffer one lifecycle event for the next flush. Call while
+        holding ``self._lock`` so buffer order == state-change order
+        (replay folds in file order; a terminal line landing before its
+        job's submitted line would resurrect a cancelled job)."""
+        if self._journal_file is None:
+            return
+        with self._journal_buf_lock:
+            self._journal_buf.append(rec)
+
+    def _journal_flush(self) -> None:
+        """Write all buffered events to disk, in enqueue order. Call
+        OUTSIDE ``self._lock``: this is the only journal code that does
+        IO, so a stalled filesystem stalls only the flushing thread.
+        Callers flush before reporting a state change to the client, so
+        a response like "cancelled" implies the terminal line was
+        (best-effort) durable first. The residual window — process death
+        after the state change but before this flush — loses the
+        buffered lines like any crash loses in-memory state; replay then
+        requeues a still-'submitted' job the client may have seen
+        cancelled. That caveat is inherent to best-effort journaling and
+        is documented here rather than papered over.
 
         NEVER raises: the journal is best-effort durability, and a write
         failure (disk full, volume gone, a Python caller's non-JSON spec)
@@ -218,19 +267,37 @@ class JobRunner:
         a restart; the running service stays correct."""
         if self._journal_file is None:
             return
-        try:
-            line = json.dumps(rec) + "\n"
-            with self._journal_lock:
-                self._journal_file.write(line)
-                self._journal_file.flush()
-        except (OSError, TypeError, ValueError) as e:
-            import sys
+        with self._journal_lock:
+            # Drain under the write lock so concurrent flushers can't
+            # interleave drained batches out of order.
+            with self._journal_buf_lock:
+                batch, self._journal_buf = self._journal_buf, []
+            if not batch:
+                return
+            for rec in batch:
+                # Per-record: one non-JSON-serializable spec (a Python
+                # caller's object) must lose only ITS line, never drop a
+                # neighboring job's terminal event from the same batch.
+                try:
+                    self._journal_file.write(json.dumps(rec) + "\n")
+                except (OSError, TypeError, ValueError) as e:
+                    import sys
 
-            print(
-                f"tpuflow.serve: journal write failed "
-                f"({type(e).__name__}: {e}); continuing without it",
-                file=sys.stderr,
-            )
+                    print(
+                        f"tpuflow.serve: journal write failed "
+                        f"({type(e).__name__}: {e}); continuing without it",
+                        file=sys.stderr,
+                    )
+            try:
+                self._journal_file.flush()
+            except (OSError, ValueError):
+                pass  # already reported per-record or reported next write
+
+    def _journal(self, **rec) -> None:
+        """Enqueue + flush one event — for single-threaded paths (startup
+        adjudication) and worker-side events already outside the lock."""
+        self._journal_enqueue(**rec)
+        self._journal_flush()
 
     def _replay_journal(self, path: str) -> None:
         import os
@@ -239,6 +306,7 @@ class JobRunner:
             return
         events: dict[str, dict] = {}  # job_id -> folded state
         order: list[str] = []
+        self._replay_saw_new_events = False
         with open(path, encoding="utf-8") as f:
             for line in f:
                 line = line.strip()
@@ -248,6 +316,8 @@ class JobRunner:
                     ev = json.loads(line)
                 except json.JSONDecodeError:
                     continue  # crash-truncated tail line
+                if ev.get("event") != "snapshot":
+                    self._replay_saw_new_events = True
                 job_id = ev.get("job_id")
                 if not job_id:
                     continue
@@ -267,6 +337,17 @@ class JobRunner:
                     st.update(
                         last="terminal", status=ev.get("status", "failed"),
                         error=ev.get("error"), report=ev.get("report"),
+                    )
+                elif kind == "snapshot":
+                    # A compacted journal: one line = one job's folded
+                    # state as of the previous restart. Later append-mode
+                    # lines (started/terminal) fold on top normally.
+                    status = ev.get("status", "failed")
+                    st.update(
+                        spec=ev.get("spec"), timeout_s=ev.get("timeout_s"),
+                        last="submitted" if status == "queued" else "terminal",
+                        status=status, error=ev.get("error"),
+                        report=ev.get("report"),
                     )
         lost: list[str] = []
         for job_id in order:
@@ -322,6 +403,105 @@ class JobRunner:
             self._journal(
                 event="terminal", job_id=job_id,
                 status=rec["status"], error=rec.get("error"),
+            )
+        self._replayed_timeouts = {
+            job_id: st.get("timeout_s")
+            for job_id, st in events.items()
+        }
+
+    def _compact_journal(self, path: str) -> None:
+        """Rewrite the replayed journal as one snapshot line per job and
+        archive the event history to ``<path>.archive``.
+
+        Replay is O(journal file); without compaction the file grows
+        with every lifecycle event across every restart forever. After
+        compaction the live journal is bounded by the number of live +
+        historical jobs, and subsequent restarts replay one line per
+        job plus whatever ran since. Best-effort like all journal IO: a
+        failure leaves the original (longer but correct) journal alone.
+        """
+        import os
+
+        if self._journal_file is None or not self._jobs:
+            return
+        if not getattr(self, "_replay_saw_new_events", True):
+            # Journal is already exactly the snapshot set (a restart with
+            # no activity since the last compaction): rewriting it would
+            # only append duplicate history to the archive every restart
+            # of a crash-looping daemon.
+            return
+        tmp = path + ".tmp"
+        new_handle = None
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                for job_id, rec in self._jobs.items():
+                    snap = {
+                        "event": "snapshot", "job_id": job_id,
+                        "status": rec["status"], "spec": rec.get("spec"),
+                    }
+                    timeout_s = getattr(self, "_replayed_timeouts", {}).get(
+                        job_id
+                    )
+                    if timeout_s is not None:
+                        snap["timeout_s"] = timeout_s
+                    if rec.get("error"):
+                        snap["error"] = rec["error"]
+                    if rec.get("report") is not None:
+                        snap["report"] = rec["report"]
+                    f.write(json.dumps(snap) + "\n")
+            # The new flocked append handle is opened on tmp (the flock
+            # rides the inode through the rename) and a READ handle on
+            # the old journal inode is taken before the promote; if
+            # anything fails up to the promote, the original journal is
+            # untouched and the old write handle stays live.
+            with open(path, encoding="utf-8") as src:
+                new_handle = self._flocked_append(tmp)
+                os.replace(tmp, path)  # the single point of no return
+                old, self._journal_file = self._journal_file, new_handle
+                old.close()
+                # Archive only AFTER a successful promote, and only the
+                # EVENT lines: snapshot lines are compaction's own
+                # output (rewritten each epoch), and re-archiving them
+                # would grow the archive by O(all historical jobs) per
+                # restart. Every epoch's event history accretes — never
+                # clobbered. Failure here is tolerable (history lost,
+                # live journal correct), so it must not trip the outer
+                # rollback of an already-promoted journal.
+                try:
+                    with open(
+                        path + ".archive", "a", encoding="utf-8"
+                    ) as dst:
+                        for line in src:
+                            try:
+                                is_snap = (
+                                    json.loads(line).get("event")
+                                    == "snapshot"
+                                )
+                            except (json.JSONDecodeError, AttributeError):
+                                is_snap = False  # keep corrupt tails
+                            if not is_snap:
+                                dst.write(line)
+                except OSError as e:
+                    import sys
+
+                    print(
+                        f"tpuflow.serve: journal history not archived "
+                        f"({type(e).__name__}: {e})",
+                        file=sys.stderr,
+                    )
+        except (OSError, RuntimeError) as e:
+            import sys
+
+            if new_handle is not None and new_handle is not self._journal_file:
+                new_handle.close()
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            print(
+                f"tpuflow.serve: journal compaction skipped "
+                f"({type(e).__name__}: {e})",
+                file=sys.stderr,
             )
 
     # ---- submission ----
@@ -388,15 +568,16 @@ class JobRunner:
                 raise queue.Full(
                     f"job queue full ({queued} queued, max {self.max_queued})"
                 )
-            # The "submitted" line is written INSIDE the lock, before the
-            # record becomes visible: a cancel() (or the worker) can only
-            # reach this job through self._jobs, so every other journal
-            # line for it is guaranteed to land after this one — replay
-            # folds in file order and a terminal-before-submitted pair
-            # would resurrect a cancelled job. (Submit lines are small;
-            # the off-lock discipline matters for the big terminal
-            # reports, which stay worker-ordered without the lock.)
-            self._journal(
+            # The "submitted" event is ENQUEUED inside the lock, before
+            # the record becomes visible: a cancel() (or the worker) can
+            # only reach this job through self._jobs, so every other
+            # journal event for it lands after this one in buffer (and
+            # therefore file) order — replay folds in file order and a
+            # terminal-before-submitted pair would resurrect a cancelled
+            # job. The disk write happens in the flush below, OUTSIDE
+            # the lock, so a stalled journal filesystem can't block
+            # every GET behind self._lock.
+            self._journal_enqueue(
                 event="submitted", job_id=job_id, spec=spec,
                 timeout_s=timeout_s,
             )
@@ -404,6 +585,7 @@ class JobRunner:
             self._cancel_events[job_id] = threading.Event()
             self.stats["submitted"] += 1
         self._queue.put((job_id, kind, config, timeout_s))
+        self._journal_flush()
         return {"job_id": job_id, "status": "queued"}
 
     def cancel(self, job_id: str) -> dict | None:
@@ -421,6 +603,13 @@ class JobRunner:
                 rec.update(status="cancelled", error="cancelled while queued")
                 self.stats["cancelled"] += 1
                 self._cancel_events.pop(job_id, None)
+                # Enqueued atomically with the state change: no later
+                # flush can ever write this job's events in an order
+                # that resurrects it on replay.
+                self._journal_enqueue(
+                    event="terminal", job_id=job_id, status="cancelled",
+                    error="cancelled while queued",
+                )
                 result = {"job_id": job_id, "status": "cancelled"}
             elif status in ("running", "cancelling"):
                 rec["status"] = "cancelling"
@@ -430,10 +619,10 @@ class JobRunner:
                 return {"job_id": job_id, "status": "cancelling"}
             else:
                 return {"job_id": job_id, "status": status, "conflict": True}
-        self._journal(
-            event="terminal", job_id=job_id, status="cancelled",
-            error="cancelled while queued",
-        )
+        # Flushed before the client sees "cancelled" — durable first,
+        # reported second (best-effort; see _journal_flush on the
+        # residual crash window).
+        self._journal_flush()
         return result
 
     def get(self, job_id: str) -> dict | None:
@@ -522,50 +711,53 @@ class JobRunner:
                 # Partial checkpoints may already be on disk — evict the
                 # predict cache exactly like any other terminal state.
                 self._notify_artifact(config, kind)
+                if e.reason == "cancelled":
+                    status, error = "cancelled", "cancelled while running"
+                else:  # timeout
+                    status, error = "failed", f"TrainingInterrupted: {e}"
+                # Durable first, visible second (the cancel() discipline):
+                # once get() reports this terminal state, the journal line
+                # is best-effort on disk — a crash right after a client
+                # polled "cancelled"/"failed" can't replay the job as
+                # lost. Per-job order is safe off-lock: this worker is
+                # the only journal writer for a running job, and its
+                # submitted/started lines are already buffered ahead.
+                self._journal(
+                    event="terminal", job_id=job_id, status=status,
+                    error=error,
+                )
                 with self._lock:
                     self._cancel_events.pop(job_id, None)
-                    if e.reason == "cancelled":
-                        self._jobs[job_id].update(
-                            status="cancelled", error="cancelled while running"
-                        )
-                        self.stats["cancelled"] += 1
-                    else:  # timeout
-                        self._jobs[job_id].update(
-                            status="failed", error=f"TrainingInterrupted: {e}"
-                        )
-                        self.stats["failed"] += 1
-                    terminal = {
-                        "status": self._jobs[job_id]["status"],
-                        "error": self._jobs[job_id]["error"],
-                    }
-                self._journal(event="terminal", job_id=job_id, **terminal)
+                    self._jobs[job_id].update(status=status, error=error)
+                    self.stats[
+                        "cancelled" if status == "cancelled" else "failed"
+                    ] += 1
                 continue
             except Exception as e:
                 # Evict BEFORE publishing the terminal status: a client
                 # that polls to completion and immediately predicts must
                 # never see the pre-retrain cache entry.
                 self._notify_artifact(config, kind)
+                error = f"{type(e).__name__}: {e}"
+                self._journal(  # durable first, visible second
+                    event="terminal", job_id=job_id, status="failed",
+                    error=error,
+                )
                 with self._lock:  # status + counter move atomically
                     self._cancel_events.pop(job_id, None)
-                    self._jobs[job_id].update(
-                        status="failed", error=f"{type(e).__name__}: {e}"
-                    )
+                    self._jobs[job_id].update(status="failed", error=error)
                     self.stats["failed"] += 1
-                    err = self._jobs[job_id]["error"]
-                self._journal(
-                    event="terminal", job_id=job_id, status="failed", error=err
-                )
                 continue
             self._notify_artifact(config, kind)
+            self._journal(  # durable first, visible second
+                event="terminal", job_id=job_id, status="done", report=rep
+            )
             with self._lock:
                 self._cancel_events.pop(job_id, None)
                 # A cancel that landed after the last epoch finished: the
                 # work is done; report it done (the cancel was a no-op).
                 self._jobs[job_id].update(status="done", report=rep)
                 self.stats["done"] += 1
-            self._journal(
-                event="terminal", job_id=job_id, status="done", report=rep
-            )
 
     @staticmethod
     def _failed_rows(rpt, ident) -> list[dict]:
