@@ -58,7 +58,12 @@ Also embedded in the worker run:
   references at LSTM-64 shapes — the proof the Mosaic-compiled kernels
   are correct on the real chip;
 - ``mfu`` / ``bound``: a FLOPs-per-step + bytes-per-step roofline model
-  so the samples/sec number comes with "X% of peak, bound by Y".
+  so the samples/sec number comes with "X% of peak, bound by Y";
+- ``attention``: on TPU, a flash-vs-XLA attention train-step timing at
+  BENCH_ATTN_T (default 1024) x BENCH_ATTN_BATCH (default 64) with
+  per-backend roofline context — run strictly AFTER the LSTM number and
+  parity are banked, so the long-context perf story lands automatically
+  on any live-relay run without ever risking the headline number.
 
 Env knobs: BENCH_CONFIGS (comma list of <batch>x<steps-per-dispatch>
 candidates swept per variant, default "1024x1,1024x16,4096x16" — 1024x1
@@ -258,6 +263,38 @@ def _measure_backend(
     return batch * scan * n / elapsed
 
 
+def _measure_attention(jax, seconds: float) -> dict:
+    """Flash-vs-XLA attention train-step timing with roofline context —
+    the long-context family's on-chip perf story, ridden on the same
+    harness so a live relay lands it automatically. TPU only: off-chip
+    the Pallas kernel runs in interpret mode and the timing is
+    meaningless (benchmarks/bench_attention.py covers the labeled CPU
+    correctness-path numbers)."""
+    from benchmarks.bench_attention import step_throughput
+    from tpuflow.utils.roofline import (
+        attention_bytes_per_sample_step,
+        attention_flops_per_sample_step,
+        roofline_report,
+    )
+
+    T = max(int(os.environ.get("BENCH_ATTN_T", 1024)), 8)
+    batch = max(int(os.environ.get("BENCH_ATTN_BATCH", 64)), 1)
+    device_kind = getattr(jax.devices()[0], "device_kind", "unknown")
+    flops = attention_flops_per_sample_step(T, F=5, D=64, layers=2)
+    out: dict = {"seq_len": T, "batch": batch}
+    for backend, score_heads in (("full", 4), ("flash", 0)):
+        sps = step_throughput(backend, batch, T, seconds)
+        bytes_ = attention_bytes_per_sample_step(
+            T, D=64, layers=2, itemsize=2, score_heads=score_heads
+        )
+        out[backend] = {
+            "samples_per_sec": round(sps, 1),
+            "tokens_per_sec": round(sps * T),
+            **roofline_report(sps, flops, bytes_, device_kind),
+        }
+    return out
+
+
 def worker() -> None:
     from benchmarks.common import maybe_pin_cpu
 
@@ -321,6 +358,7 @@ def worker() -> None:
 
     backends: dict[str, float | str] = {}
     parity = "pending"
+    attention: dict | str = "pending"
     best: float | None = None
     best_backend = ""
 
@@ -333,6 +371,7 @@ def worker() -> None:
             "backends": dict(backends),
             "best_backend": best_backend,
             "pallas_parity": parity,
+            "attention": attention,
             "device": device_kind,
             "flops_per_sample": round(flops),
             "hbm_bytes_per_sample": round(bytes_),
@@ -380,6 +419,19 @@ def worker() -> None:
 
     if best is None:
         raise RuntimeError(f"all backends failed: {backends}")
+    # Attention timing rides LAST: strictly after the LSTM number and
+    # parity are banked (its flash compile is another of the risky
+    # remote-compile RPCs), budget-guarded like everything else.
+    if jax.default_backend() != "tpu":
+        attention = "SKIPPED: off-chip (see benchmarks/results.json)"
+    elif time_left() > 4 * seconds + 30:
+        try:
+            attention = _measure_attention(jax, seconds)
+        except Exception as e:
+            attention = f"ERROR: {type(e).__name__}: {str(e)[:300]}"
+    else:
+        attention = "SKIPPED: worker deadline"
+    progress(f"attention: {attention}")
     emit_record(partial=False)
 
 

@@ -1,6 +1,11 @@
 """Run the five BASELINE-config benchmarks; write benchmarks/results.json.
 
-Usage: python benchmarks/run_all.py [--quick]
+Usage: python benchmarks/run_all.py [--quick] [script.py ...]
+
+With script names, only those benchmarks run and their records are
+MERGED into the existing results.json (rows with the same
+config+metric are replaced, everything else is kept) — re-measuring
+one family doesn't discard the others' recorded numbers.
 """
 
 from __future__ import annotations
@@ -25,12 +30,17 @@ def main() -> None:
     here = os.path.dirname(os.path.abspath(__file__))
     root = os.path.dirname(here)
     env = dict(os.environ)
+    args = [a for a in sys.argv[1:] if a != "--quick"]
     if "--quick" in sys.argv:
         env.setdefault("BENCH_SECONDS", "2")
         env.setdefault("BENCH_BATCH", "1024")
+    selected = args or SCRIPTS
+    unknown = [s for s in selected if s not in SCRIPTS]
+    if unknown:
+        sys.exit(f"[run_all] unknown benchmark(s) {unknown}; known: {SCRIPTS}")
     records = []
     failed = []
-    for script in SCRIPTS:
+    for script in selected:
         proc = subprocess.run(
             [sys.executable, os.path.join(here, script)],
             capture_output=True,
@@ -47,6 +57,15 @@ def main() -> None:
             failed.append(script)
             print(f"[run_all] {script} FAILED:\n{proc.stderr[-2000:]}", file=sys.stderr)
     out = os.path.join(here, "results.json")
+    if args and os.path.exists(out):
+        # Partial run: merge over the prior file instead of discarding it.
+        fresh = {(r.get("config"), r.get("metric")) for r in records}
+        with open(out, encoding="utf-8") as f:
+            kept = [
+                r for r in json.load(f)
+                if (r.get("config"), r.get("metric")) not in fresh
+            ]
+        records = kept + records
     with open(out, "w", encoding="utf-8") as f:
         json.dump(records, f, indent=2)
     print(f"[run_all] wrote {len(records)} records to {out}", file=sys.stderr)

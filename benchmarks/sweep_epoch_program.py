@@ -1,0 +1,153 @@
+"""Measure the per-batch vs scanned-epoch crossover; record it for auto.
+
+Races the fit loop's two epoch programs (tpuflow/train/loop.py) on the
+LSTM-64 workload over a batch-size grid, on whatever backend is up, and
+writes the crossover batch to ``benchmarks/program_sweep.json`` keyed by
+device kind. ``train(config)`` with ``jit_epoch=None`` (the default)
+reads that file through ``tpuflow.train.autotune`` — so the production
+default follows the measurement, not a guess (the reference's batch-20
+semantics, cnn.py:128, ride whichever program measured faster).
+
+Per batch size B the two programs do identical samples/step work:
+
+- ``per_batch``: K dispatches of the jitted train step (K = SCAN);
+- ``jit_epoch``: ONE dispatch of the scanned K-step epoch program.
+
+Env knobs: BENCH_BATCHES ("20,64,256,1024"), BENCH_SCAN (16),
+BENCH_SECONDS (5). Emits one JSON line per (program, batch) plus the
+crossover record; merges into program_sweep.json (per device kind).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+from benchmarks.common import emit, maybe_pin_cpu
+
+maybe_pin_cpu()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+WINDOW, FEATURES, HIDDEN = 24, 5, 64
+
+
+def throughput(program: str, batch: int, scan: int, seconds: float) -> float:
+    """Samples/sec of K train steps as K dispatches vs one scanned one."""
+    from benchmarks.common import time_steps
+    from tpuflow.core.losses import mae_clip
+    from tpuflow.models import LSTMRegressor
+    from tpuflow.train import create_state, make_train_step
+    from tpuflow.train.steps import make_epoch_step
+
+    model = LSTMRegressor(hidden=HIDDEN, dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    x_np = rng.standard_normal((batch, WINDOW, FEATURES)).astype(np.float32)
+    y_np = rng.standard_normal((batch, WINDOW)).astype(np.float32)
+    state = create_state(model, jax.random.PRNGKey(0), x_np[:2])
+    key = jax.random.PRNGKey(0)
+
+    if program == "jit_epoch":
+        xs = jnp.asarray(np.broadcast_to(x_np, (scan,) + x_np.shape))
+        ys = jnp.asarray(np.broadcast_to(y_np, (scan,) + y_np.shape))
+        epoch_step = make_epoch_step(mae_clip)
+        step = lambda s: epoch_step(s, xs, ys, key)
+    else:
+        x, y = jnp.asarray(x_np), jnp.asarray(y_np)
+        one = make_train_step(mae_clip)
+
+        def step(s):
+            m = None
+            for _ in range(scan):
+                s, m = one(s, x, y, key)
+            return s, m
+
+    class _Box:
+        s = state
+
+    def timed():
+        _Box.s, m = step(_Box.s)
+        return m
+
+    n, elapsed = time_steps(timed, seconds=seconds, block=lambda m: m)
+    return batch * scan * n / elapsed
+
+
+def main() -> None:
+    batches = [
+        max(int(b), 1)
+        for b in os.environ.get("BENCH_BATCHES", "20,64,256,1024").split(",")
+    ]
+    scan = max(int(os.environ.get("BENCH_SCAN", 16)), 1)
+    seconds = float(os.environ.get("BENCH_SECONDS", 5))
+    device_kind = getattr(
+        jax.devices()[0], "device_kind", jax.default_backend()
+    )
+
+    rows = []
+    for batch in sorted(batches):
+        sps = {}
+        for program in ("jit_epoch", "per_batch"):
+            try:
+                sps[program] = throughput(program, batch, scan, seconds)
+            except Exception as e:
+                sps[program] = None
+                emit("epoch_program", f"{program}_B{batch}", -1.0,
+                     "samples/sec/chip", error=str(e)[:200])
+                continue
+            emit("epoch_program", f"{program}_B{batch}", sps[program],
+                 "samples/sec/chip", device=device_kind, scan=scan)
+        if sps.get("jit_epoch") and sps.get("per_batch"):
+            rows.append(
+                {"batch": batch, "jit_epoch": round(sps["jit_epoch"], 1),
+                 "per_batch": round(sps["per_batch"], 1)}
+            )
+
+    if not rows:
+        sys.exit("[sweep_epoch_program] no complete (batch) rows measured")
+    # Crossover: the smallest measured batch where per-batch stepping
+    # beats the scanned epoch by a real margin (>3% — backends where the
+    # two are within noise must not flap the committed choice between
+    # runs; ties scan, which also amortizes dispatch in production jobs
+    # where the per-step Python overhead exceeds this tight loop's).
+    # Batches below it scan; batches at/above it step. If scanning wins
+    # everywhere measured, record scan_always instead of inventing a
+    # finite crossover no measurement supports.
+    crossover = None
+    for row in rows:
+        if row["per_batch"] > 1.03 * row["jit_epoch"]:
+            crossover = row["batch"]
+            break
+    record = {
+        "crossover_batch": crossover,
+        "scan_always": crossover is None,
+        "scan": scan,
+        "rows": rows,
+    }
+    emit("epoch_program", "crossover_batch",
+         -1.0 if crossover is None else crossover, "samples",
+         device=device_kind, scan_always=crossover is None)
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "program_sweep.json")
+    sweep = {}
+    if os.path.exists(out):
+        try:
+            with open(out, encoding="utf-8") as f:
+                sweep = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            sweep = {}
+    sweep[device_kind] = record
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(sweep, f, indent=2)
+    print(f"[sweep_epoch_program] wrote {device_kind!r} -> {out}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,153 @@
+"""Epoch-program auto-selection (tpuflow/train/autotune.py).
+
+``train(config)`` with the default ``jit_epoch=None`` must pick its
+epoch program (per-batch stepping vs the scanned ``jit_epoch``) from
+the measured sweep for the running device — not a static default — and
+report the choice on ``TrainReport.epoch_program`` (round-4 verdict
+item 2; the reference's batch-20 jobs, cnn.py:128, ride the fast path
+automatically).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tpuflow.train.autotune import (
+    HEURISTIC_CROSSOVER_BATCH,
+    ProgramChoice,
+    choose_epoch_program,
+    load_measured_crossover,
+)
+
+
+class TestConstraints:
+    def test_stream_forces_per_batch(self):
+        c = choose_epoch_program(20, stream=True)
+        assert not c.jit_epoch and c.source == "constraint"
+
+    def test_tp_forces_per_batch(self):
+        c = choose_epoch_program(20, tp=2)
+        assert not c.jit_epoch and c.source == "constraint"
+
+    def test_multi_host_forces_per_batch(self):
+        c = choose_epoch_program(20, multi_host=True)
+        assert not c.jit_epoch and c.source == "constraint"
+
+
+class TestHeuristic:
+    def test_small_batch_scans_large_batch_steps(self):
+        small = choose_epoch_program(20, device_kind="never-measured-chip")
+        large = choose_epoch_program(4096, device_kind="never-measured-chip")
+        assert small.jit_epoch and small.source == "heuristic"
+        assert not large.jit_epoch and large.source == "heuristic"
+        assert str(HEURISTIC_CROSSOVER_BATCH) in small.reason
+
+    def test_choice_name(self):
+        assert ProgramChoice(True, "r", "heuristic").name == "jit_epoch"
+        assert ProgramChoice(False, "r", "heuristic").name == "per_batch"
+
+
+class TestMeasured:
+    @pytest.fixture
+    def sweep_file(self, tmp_path, monkeypatch):
+        path = tmp_path / "program_sweep.json"
+        monkeypatch.setenv("TPUFLOW_PROGRAM_SWEEP", str(path))
+        return path
+
+    def test_measured_crossover_decides(self, sweep_file):
+        sweep_file.write_text(json.dumps(
+            {"fake-chip": {"crossover_batch": 64, "rows": []}}
+        ))
+        below = choose_epoch_program(63, device_kind="fake-chip")
+        at = choose_epoch_program(64, device_kind="fake-chip")
+        assert below.jit_epoch and below.source == "measured"
+        assert not at.jit_epoch and at.source == "measured"
+        # A batch-20 job on a device whose sweep measured per-batch
+        # faster even at 20 steps per-batch — the measurement, not the
+        # heuristic, wins.
+        sweep_file.write_text(json.dumps(
+            {"fake-chip": {"crossover_batch": 8, "rows": []}}
+        ))
+        c = choose_epoch_program(20, device_kind="fake-chip")
+        assert not c.jit_epoch and c.source == "measured"
+
+    def test_scan_always_scans_at_any_batch(self, sweep_file):
+        # A sweep where the scanned program won at every measured batch
+        # records scan_always — auto then scans even huge batches rather
+        # than inventing a finite crossover no measurement supports.
+        sweep_file.write_text(json.dumps(
+            {"fake-chip": {"crossover_batch": None, "scan_always": True}}
+        ))
+        c = choose_epoch_program(100_000, device_kind="fake-chip")
+        assert c.jit_epoch and c.source == "measured"
+        assert "every swept batch" in c.reason
+
+    def test_unmatched_device_falls_back(self, sweep_file):
+        sweep_file.write_text(json.dumps(
+            {"other-chip": {"crossover_batch": 64}}
+        ))
+        c = choose_epoch_program(20, device_kind="fake-chip")
+        assert c.source == "heuristic"
+
+    def test_corrupt_sweep_falls_back(self, sweep_file):
+        sweep_file.write_text("{not json")
+        assert load_measured_crossover("fake-chip") is None
+        c = choose_epoch_program(20, device_kind="fake-chip")
+        assert c.source == "heuristic" and c.jit_epoch
+
+    def test_bogus_crossover_ignored(self, sweep_file):
+        sweep_file.write_text(json.dumps(
+            {"fake-chip": {"crossover_batch": -5}}
+        ))
+        assert load_measured_crossover("fake-chip") is None
+
+
+class TestTrainIntegration:
+    """train(config) resolves auto and reports the chosen program."""
+
+    def _config(self, **kw):
+        from tpuflow.api.config import TrainJobConfig
+
+        return TrainJobConfig(
+            model="static_mlp", max_epochs=2, synthetic_wells=2,
+            synthetic_steps=40, verbose=False, n_devices=1, **kw,
+        )
+
+    def test_batch20_auto_resolves_to_jit_epoch(self, monkeypatch, tmp_path):
+        # Point at an empty sweep: the heuristic decides (batch 20 scans).
+        monkeypatch.setenv(
+            "TPUFLOW_PROGRAM_SWEEP", str(tmp_path / "none.json")
+        )
+        from tpuflow.api import train
+
+        report = train(self._config(batch_size=20))
+        assert report.epoch_program == "jit_epoch"
+        assert "heuristic" in report.epoch_program_reason
+
+    def test_measured_sweep_drives_train(self, monkeypatch, tmp_path):
+        # A sweep for THIS device kind that says per-batch wins at 20.
+        import jax
+
+        kind = getattr(
+            jax.devices()[0], "device_kind", jax.default_backend()
+        )
+        path = tmp_path / "program_sweep.json"
+        path.write_text(json.dumps({kind: {"crossover_batch": 8}}))
+        monkeypatch.setenv("TPUFLOW_PROGRAM_SWEEP", str(path))
+        from tpuflow.api import train
+
+        report = train(self._config(batch_size=20))
+        assert report.epoch_program == "per_batch"
+        assert "measured" in report.epoch_program_reason
+
+    def test_explicit_false_respected(self, monkeypatch, tmp_path):
+        monkeypatch.setenv(
+            "TPUFLOW_PROGRAM_SWEEP", str(tmp_path / "none.json")
+        )
+        from tpuflow.api import train
+
+        report = train(self._config(batch_size=20, jit_epoch=False))
+        assert report.epoch_program == "per_batch"
+        assert "explicit" in report.epoch_program_reason

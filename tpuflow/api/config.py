@@ -57,9 +57,13 @@ class TrainJobConfig:
     accumulate_steps: int = 1
     seed: int = 0
     verbose: bool = True
-    # Compile each epoch into one XLA program (single-chip runs): removes
-    # per-step dispatch, the big lever at the reference's batch size of 20.
-    jit_epoch: bool = False
+    # Epoch program: True compiles each epoch into one XLA program
+    # (removes per-step dispatch, the big lever at the reference's batch
+    # size of 20); False steps per-batch (measured faster at bench-scale
+    # batches). None = AUTO: resolved from the measured program sweep
+    # for the running device (tpuflow/train/autotune.py), so production
+    # jobs ride whichever program measured faster.
+    jit_epoch: bool | None = None
 
     # --- fault tolerance (SURVEY §5.3; requires storage_path) ---
     save_every: int = 0  # epochs between full-state run checkpoints

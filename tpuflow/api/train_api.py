@@ -61,6 +61,10 @@ class TrainReport:
     gilbert_mae: float | None  # physical-baseline MAE on the same test rows
     time_elapsed: float
     samples_per_sec: float
+    # Which epoch program the run used and why — "jit_epoch"/"per_batch",
+    # resolved by tpuflow.train.autotune when config.jit_epoch is None.
+    epoch_program: str = ""
+    epoch_program_reason: str = ""
 
     def summary(self) -> str:
         lines = [
@@ -69,6 +73,8 @@ class TrainReport:
             f"Testing set MAE: {self.test_mae:.4f}",
             f"Throughput: {self.samples_per_sec:.0f} samples/sec/chip",
         ]
+        if self.epoch_program:
+            lines.append(f"Epoch program: {self.epoch_program}")
         if self.gilbert_mae is not None:
             beat = "beats" if self.test_mae <= self.gilbert_mae else "trails"
             lines.append(
@@ -460,7 +466,27 @@ def train(
     schema = Schema.from_cli(names, types, target)
     loss_fn = LOSSES[config.loss]
 
-    if config.stream and config.jit_epoch:
+    # Epoch-program resolution: explicit True/False is respected (and
+    # validated); None = AUTO picks per-batch vs jit_epoch from the
+    # measured sweep for this device (tpuflow/train/autotune.py) — the
+    # reference's batch-20 jobs (cnn.py:128) ride the measured-fastest
+    # program without the submitter knowing the knob exists.
+    from tpuflow.train.autotune import ProgramChoice, choose_epoch_program
+
+    if config.jit_epoch is None:
+        program = choose_epoch_program(
+            config.batch_size,
+            stream=config.stream,
+            tp=config.tp,
+            multi_host=jax.process_count() > 1,
+        )
+    else:
+        program = ProgramChoice(
+            bool(config.jit_epoch), "explicitly set in config", "explicit"
+        )
+    jit_epoch = program.jit_epoch
+
+    if config.stream and jit_epoch:
         # Rejected before any file scans (fit() would also raise, but only
         # after the possibly hours-long eval materialization) and OUTSIDE
         # _prepare_data, which must read only _prep_key-covered fields.
@@ -543,7 +569,7 @@ def train(
                 "tp>1 is single-host for now; multi-host TP needs "
                 "per-process batch feeding (see the DP branch)"
             )
-        if config.jit_epoch:
+        if jit_epoch:
             raise ValueError(
                 "tp>1 trains through the per-batch GSPMD step; jit_epoch "
                 "is not supported with tensor parallelism"
@@ -603,7 +629,7 @@ def train(
             xs, ys, ms = shard_batch(mesh, *_local(x, y, mask))
             return dp_eval(state, xs, ys, ms)
 
-        if config.jit_epoch:
+        if jit_epoch:
             # The scanned DP program: K train steps (each with its ICI
             # all-reduce) per dispatch — same dispatch-amortization as
             # single-chip jit_epoch.
@@ -638,7 +664,7 @@ def train(
         storage_path=config.storage_path,
         model_name=config.model,
         verbose=config.verbose,
-        jit_epoch=config.jit_epoch,
+        jit_epoch=jit_epoch,
         save_every=config.save_every,
         resume=config.resume,
         fault_epoch=config.fault_epoch,
@@ -720,6 +746,8 @@ def train(
         gilbert_mae=gilbert_test,
         time_elapsed=time.time() - t0,
         samples_per_sec=result.samples_per_sec / max(n_dev, 1),
+        epoch_program=program.name,
+        epoch_program_reason=f"{program.source}: {program.reason}",
     )
     if config.verbose:
         print(report.summary())

@@ -46,8 +46,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "mesh (MLP families; devices/tp do data parallelism)")
     p.add_argument("--synthetic-wells", type=int, default=8)
     p.add_argument("--synthetic-steps", type=int, default=512)
-    p.add_argument("--jit-epoch", action="store_true",
-                   help="compile each epoch into one XLA program (single-chip)")
+    p.add_argument("--jit-epoch", action="store_true", default=None,
+                   dest="jit_epoch",
+                   help="compile each epoch into one XLA program; default "
+                        "AUTO picks the measured-fastest program for this "
+                        "device and batch size (tpuflow/train/autotune.py)")
+    p.add_argument("--no-jit-epoch", action="store_false", dest="jit_epoch",
+                   help="force per-batch stepping (disable the epoch scan)")
     p.add_argument("--stream", action="store_true",
                    help="out-of-core ingest: never materialize the CSV "
                         "(tabular models; bounded memory at any file size)")

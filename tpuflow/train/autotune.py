@@ -1,0 +1,151 @@
+"""Epoch-program auto-selection: measured sweep data over static defaults.
+
+The fit loop has two epoch programs (tpuflow/train/loop.py): per-batch
+stepping (one XLA dispatch per minibatch) and ``jit_epoch`` (the whole
+epoch scanned into one compiled program). Which one is faster depends on
+the batch size: at the reference's production batch size of 20 (reference
+cnn.py:128) a step is microseconds of device work under ~57us of Python
+dispatch over the relay, so the scanned program wins by an order of
+magnitude; at bench-scale batches (1024+) the per-batch path has measured
+FASTER on-chip than the scanned program (BENCHLOG.md round-3: 17.7M
+samples/s per-batch vs 5.0M scanned). A single static default is
+therefore wrong at one end or the other — ``train(config)`` resolves
+``jit_epoch=None`` ("auto") through :func:`choose_epoch_program` instead.
+
+The decision source, in order:
+
+1. **Constraints** — streaming ingest, tensor parallelism, and multi-host
+   runs require per-batch stepping (the scanned program would defeat
+   bounded-memory streaming / isn't wired for the TP GSPMD step).
+2. **Measured sweep** — ``benchmarks/sweep_epoch_program.py`` races both
+   programs over a batch-size grid on the CURRENT backend and records
+   the crossover to ``benchmarks/program_sweep.json``; when that file
+   exists and matches the running device kind, its crossover decides.
+   (Override the location with ``TPUFLOW_PROGRAM_SWEEP``.)
+3. **Heuristic fallback** — no measurement for this device: scan the
+   epoch when ``batch_size < 256`` (the dispatch-bound regime on every
+   backend measured so far), step per-batch otherwise.
+
+The choice is reported on ``TrainReport.epoch_program`` so a job's
+program is observable, and tested by ``tests/test_autotune.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+# Batch sizes below this are dispatch-bound: the scanned epoch program
+# wins. The default is the unmeasured-device fallback; a measured sweep
+# (benchmarks/sweep_epoch_program.py) replaces it per device kind.
+HEURISTIC_CROSSOVER_BATCH = 256
+
+
+@dataclass(frozen=True)
+class ProgramChoice:
+    """The resolved epoch program and why it was chosen."""
+
+    jit_epoch: bool
+    reason: str
+    # "constraint" | "measured" | "heuristic" from choose_epoch_program;
+    # "explicit" when train() honors a caller-set jit_epoch instead.
+    source: str
+
+    @property
+    def name(self) -> str:
+        return "jit_epoch" if self.jit_epoch else "per_batch"
+
+
+def _sweep_path() -> str:
+    env = os.environ.get("TPUFLOW_PROGRAM_SWEEP")
+    if env:
+        return env
+    # Repo-relative default: tpuflow/train/autotune.py -> repo root.
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    return os.path.join(root, "benchmarks", "program_sweep.json")
+
+
+def load_measured_crossover(device_kind: str) -> tuple[float, str] | None:
+    """The measured crossover batch for ``device_kind``, if a sweep for
+    that device kind has been recorded; ``(crossover, source_desc)``.
+    ``inf`` means the sweep measured the scanned program faster at every
+    batch (``scan_always``)."""
+    path = _sweep_path()
+    try:
+        with open(path, encoding="utf-8") as f:
+            sweep = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(sweep, dict):
+        return None
+    rec = sweep.get(device_kind)
+    if not isinstance(rec, dict):
+        return None
+    if rec.get("scan_always") is True:
+        return float("inf"), f"{path} [{device_kind}]"
+    crossover = rec.get("crossover_batch")
+    if not isinstance(crossover, (int, float)) or crossover <= 0:
+        return None
+    return float(crossover), f"{path} [{device_kind}]"
+
+
+def choose_epoch_program(
+    batch_size: int,
+    *,
+    stream: bool = False,
+    tp: int = 1,
+    multi_host: bool = False,
+    device_kind: str | None = None,
+) -> ProgramChoice:
+    """Resolve ``jit_epoch=None`` ("auto") for one training job."""
+    if stream:
+        return ProgramChoice(
+            False, "streaming ingest requires per-batch stepping "
+            "(bounded memory)", "constraint",
+        )
+    if tp > 1:
+        return ProgramChoice(
+            False, "tensor parallelism trains through the per-batch "
+            "GSPMD step", "constraint",
+        )
+    if multi_host:
+        # The multi-host scanned path exists (fit(epoch_step=...)), but
+        # auto never picks a program that depends on every host slicing
+        # identically — explicit jit_epoch=True opts in.
+        return ProgramChoice(
+            False, "multi-host runs default to per-batch stepping; pass "
+            "jit_epoch=True to opt in to the scanned program",
+            "constraint",
+        )
+    if device_kind is None:
+        import jax
+
+        device_kind = getattr(
+            jax.devices()[0], "device_kind", jax.default_backend()
+        )
+    measured = load_measured_crossover(device_kind)
+    if measured is not None:
+        crossover, source = measured
+        jit = batch_size < crossover
+        if crossover == float("inf"):
+            desc = (
+                f"scanned program measured faster at every swept batch "
+                f"on {device_kind!r}"
+            )
+        else:
+            desc = (
+                f"batch_size {batch_size} {'<' if jit else '>='} measured "
+                f"crossover {int(crossover)} for {device_kind!r}"
+            )
+        return ProgramChoice(jit, desc, "measured")
+    jit = batch_size < HEURISTIC_CROSSOVER_BATCH
+    return ProgramChoice(
+        jit,
+        f"batch_size {batch_size} {'<' if jit else '>='} heuristic "
+        f"crossover {HEURISTIC_CROSSOVER_BATCH} (no sweep recorded for "
+        f"{device_kind!r}; run benchmarks/sweep_epoch_program.py)",
+        "heuristic",
+    )
