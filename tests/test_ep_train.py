@@ -1,0 +1,192 @@
+"""Expert-parallel TRAINING end-to-end (round-4 verdict item 4: EP must
+*train* with an expert axis, not just pass block router-grad parity).
+
+``TrainJobConfig(ep=2)`` routes train() through the expert-parallel
+step (parallel/ep_train.py) on a (data, model) mesh: the moe_mlp
+family's stacked expert bank shards experts-per-device over the model
+axis, routing is dense capacity-free top-1 with one psum combine, the
+token dim shards over the data axis in the same program, and router
+gradients flow through the softmax gate weight. Loss parity vs the
+single-device run proves the sharded program computes the same
+training trajectory.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpuflow.api import TrainJobConfig, train
+from tpuflow.parallel.mesh import MODEL_AXIS
+from tpuflow.parallel.ep_train import (
+    ep_forward,
+    ep_shardings,
+    make_ep_eval_step,
+    make_ep_mesh,
+    make_ep_train_step,
+    shard_state,
+)
+
+BASE = dict(
+    model="moe_mlp",
+    model_kwargs={"experts": 4, "hidden": 16, "ffn": 32},
+    max_epochs=3,
+    batch_size=32,
+    verbose=False,
+    synthetic_wells=4,
+    synthetic_steps=64,
+    seed=0,
+)
+
+
+def _state_and_mesh(n_data=2, n_model=2, experts=4):
+    from tpuflow.models import MoEMLP
+    from tpuflow.train import create_state
+
+    mesh = make_ep_mesh(
+        n_data=n_data, n_model=n_model,
+        devices=jax.devices()[: n_data * n_model],
+    )
+    x = np.random.default_rng(0).standard_normal((16, 6)).astype(np.float32)
+    state = create_state(
+        MoEMLP(experts=experts, hidden=16, ffn=32), jax.random.PRNGKey(0),
+        x[:2],
+    )
+    return mesh, state, x
+
+
+class TestShardings:
+    def test_expert_bank_shards_rest_replicates(self):
+        mesh, state, _ = _state_and_mesh()
+        sh = ep_shardings(mesh, state.params)
+        assert sh["expert_w1"].spec == P(MODEL_AXIS, None, None)
+        assert sh["expert_w2"].spec == P(MODEL_AXIS, None, None)
+        assert sh["gate"].spec == P()
+        assert sh["embed"]["kernel"].spec == P()
+
+    def test_indivisible_experts_rejected(self):
+        mesh, state, _ = _state_and_mesh(experts=3)
+        with pytest.raises(ValueError, match="not divisible"):
+            ep_shardings(mesh, state.params)
+
+    def test_non_moe_family_rejected(self):
+        from tpuflow.models import StaticMLP
+        from tpuflow.train import create_state
+
+        mesh, _, _ = _state_and_mesh()
+        state = create_state(
+            StaticMLP(), jax.random.PRNGKey(0), np.zeros((2, 6), np.float32)
+        )
+        with pytest.raises(ValueError, match="moe_mlp"):
+            ep_shardings(mesh, state.params)
+
+
+class TestEpStep:
+    def test_forward_matches_dense_apply(self):
+        from tpuflow.models import MoEMLP
+
+        mesh, state, x = _state_and_mesh()
+        estate = shard_state(mesh, state, ep_shardings(mesh, state.params))
+        ref = MoEMLP(experts=4, hidden=16, ffn=32).apply(
+            {"params": state.params}, x
+        )
+        got = ep_forward(mesh, estate.params, x)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=1e-5
+        )
+
+    def test_step_preserves_layout_and_matches_single_device(self):
+        """One expert-parallel step == one single-device step (router
+        grads included), and the updated state keeps the expert layout."""
+        from tpuflow.core.losses import mae_clip
+        from tpuflow.train import make_train_step
+
+        mesh, state, x = _state_and_mesh()
+        y = np.random.default_rng(1).standard_normal((16,)).astype(np.float32)
+        estate = shard_state(mesh, state, ep_shardings(mesh, state.params))
+        ref_state, ref_metrics = make_train_step(mae_clip, donate=False)(
+            state, x, y, jax.random.PRNGKey(2)
+        )
+        step = make_ep_train_step(estate, mae_clip)
+        estate, metrics = step(estate, x, y, jax.random.PRNGKey(2))
+
+        assert float(metrics["loss"]) == pytest.approx(
+            float(ref_metrics["loss"]), rel=1e-6
+        )
+        assert estate.params["expert_w1"].sharding.spec == P(
+            MODEL_AXIS, None, None
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5
+            ),
+            jax.tree.map(np.asarray, estate.params),
+            jax.tree.map(np.asarray, ref_state.params),
+        )
+
+    def test_eval_step_masked_sums(self):
+        from tpuflow.core.losses import mae_clip
+
+        mesh, state, x = _state_and_mesh()
+        estate = shard_state(mesh, state, ep_shardings(mesh, state.params))
+        y = np.zeros((16,), np.float32)
+        mask = np.ones((16,), np.float32)
+        mask[10:] = 0.0
+        out = make_ep_eval_step(mesh, mae_clip)(estate, x, y, mask)
+        assert float(out["count"]) == 10.0
+        assert np.isfinite(float(out["loss_sum"]))
+
+
+class TestTrainConfigEp:
+    def test_ep_run_matches_single_device_loss(self):
+        """train(ep=2) on a (4, 2) mesh reproduces the single-device
+        training trajectory — the expert-parallel run is the same math."""
+        ref = train(TrainJobConfig(**BASE, n_devices=1, jit_epoch=False))
+        ep = train(TrainJobConfig(**BASE, n_devices=8, ep=2))
+        assert ep.epoch_program == "per_batch"
+        assert "constraint" in ep.epoch_program_reason
+        for a, b in zip(ep.result.history, ref.result.history):
+            assert a["loss"] == pytest.approx(b["loss"], rel=1e-4)
+            assert a["val_loss"] == pytest.approx(b["val_loss"], rel=1e-4)
+        assert ep.test_mae == pytest.approx(ref.test_mae, rel=1e-4)
+
+    def test_ep_trained_artifact_serves_single_device(self, tmp_path):
+        from tpuflow.api.predict_api import Predictor
+
+        train(
+            TrainJobConfig(
+                **{**BASE, "max_epochs": 1},
+                n_devices=8, ep=2, storage_path=str(tmp_path),
+            )
+        )
+        p = Predictor.load(str(tmp_path), "moe_mlp")
+        cols = {
+            "pressure": np.array([2000.0, 1500.0]),
+            "choke": np.array([30.0, 20.0]),
+            "glr": np.array([1.2, 0.8]),
+            "temperature": np.array([60.0, 55.0]),
+            "water_cut": np.array([0.2, 0.3]),
+            "completion": np.array(["A", "B"]),
+        }
+        y = np.asarray(p.predict_columns(cols))
+        assert y.shape == (2,) and np.all(np.isfinite(y))
+
+    def test_ep_rejects_bad_division(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            train(TrainJobConfig(**BASE, n_devices=8, ep=3))
+
+    def test_ep_rejects_non_moe_family(self):
+        cfg = dataclasses.replace(
+            TrainJobConfig(
+                **{**BASE, "model_kwargs": {}}, n_devices=8, ep=2
+            ),
+            model="static_mlp",
+        )
+        with pytest.raises(ValueError, match="moe_mlp"):
+            train(cfg)
+
+    def test_model_axis_strategies_exclusive(self):
+        with pytest.raises(ValueError, match="cannot be combined"):
+            train(TrainJobConfig(**BASE, n_devices=8, ep=2, pp=2))

@@ -1,0 +1,223 @@
+"""Pipeline-parallel TRAINING end-to-end (round-4 verdict item 4: PP
+must *train* via a GPipe schedule, not just pass block grad-parity).
+
+``TrainJobConfig(pp=2)`` routes train() through the pipelined step
+(parallel/pp_train.py) on a (data, model) mesh: the pipeline_mlp
+family's stacked stage params shard one-chunk-per-device over the model
+axis, microbatches ride the ppermute ring (GPipe fill/steady/drain),
+the batch dim shards over the data axis in the same program, and
+jax.grad through the schedule IS the microbatch gradient accumulation.
+Loss parity vs the single-device run proves the pipelined program
+computes the same training trajectory.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpuflow.api import TrainJobConfig, train
+from tpuflow.parallel.mesh import MODEL_AXIS
+from tpuflow.parallel.pp_train import (
+    make_pp_eval_step,
+    make_pp_mesh,
+    make_pp_train_step,
+    pp_forward,
+    pp_shardings,
+    shard_state,
+)
+
+BASE = dict(
+    model="pipeline_mlp",
+    model_kwargs={"stages": 4, "hidden": 16},
+    max_epochs=3,
+    batch_size=32,
+    verbose=False,
+    synthetic_wells=4,
+    synthetic_steps=64,
+    seed=0,
+)
+
+
+def _state_and_mesh(n_data=2, n_model=2, stages=4, hidden=16):
+    from tpuflow.models import PipelineMLP
+    from tpuflow.train import create_state
+
+    mesh = make_pp_mesh(
+        n_data=n_data, n_model=n_model,
+        devices=jax.devices()[: n_data * n_model],
+    )
+    x = np.random.default_rng(0).standard_normal((16, 6)).astype(np.float32)
+    state = create_state(
+        PipelineMLP(stages=stages, hidden=hidden), jax.random.PRNGKey(0),
+        x[:2],
+    )
+    return mesh, state, x
+
+
+class TestShardings:
+    def test_stage_chunks_shard_embed_head_replicate(self):
+        mesh, state, _ = _state_and_mesh()
+        sh = pp_shardings(mesh, state.params)
+        assert sh["stage_kernels"].spec == P(MODEL_AXIS, None, None)
+        assert sh["stage_biases"].spec == P(MODEL_AXIS, None)
+        assert sh["embed"]["kernel"].spec == P()
+        assert sh["head"]["kernel"].spec == P()
+
+    def test_indivisible_stages_rejected(self):
+        mesh, state, _ = _state_and_mesh(stages=3)
+        with pytest.raises(ValueError, match="not divisible"):
+            pp_shardings(mesh, state.params)
+
+    def test_non_pipeline_family_rejected(self):
+        from tpuflow.models import StaticMLP
+        from tpuflow.train import create_state
+
+        mesh, _, _ = _state_and_mesh()
+        x = np.zeros((2, 6), np.float32)
+        state = create_state(StaticMLP(), jax.random.PRNGKey(0), x)
+        with pytest.raises(ValueError, match="pipeline_mlp"):
+            pp_shardings(mesh, state.params)
+
+
+class TestPpStep:
+    def test_forward_matches_sequential_apply(self):
+        from tpuflow.models import PipelineMLP
+
+        mesh, state, x = _state_and_mesh()
+        pstate = shard_state(mesh, state, pp_shardings(mesh, state.params))
+        ref = PipelineMLP(stages=4, hidden=16).apply(
+            {"params": state.params}, x
+        )
+        got = pp_forward(mesh, pstate.params, x, n_micro=4)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=1e-5
+        )
+
+    def test_step_preserves_layout_and_matches_single_device(self):
+        """One pipelined step == one single-device step (microbatch grad
+        accumulation via AD), and the updated state keeps the stage
+        layout (no silent resharding)."""
+        from tpuflow.core.losses import mae_clip
+        from tpuflow.train import make_train_step
+
+        mesh, state, x = _state_and_mesh()
+        y = np.random.default_rng(1).standard_normal((16,)).astype(np.float32)
+        # donate=False: on the CPU backend device_put's replicated copy
+        # can share the source buffer on the origin device.
+        pstate = shard_state(mesh, state, pp_shardings(mesh, state.params))
+        ref_state, ref_metrics = make_train_step(mae_clip, donate=False)(
+            state, x, y, jax.random.PRNGKey(2)
+        )
+        step = make_pp_train_step(pstate, mae_clip, n_micro=4)
+        pstate, metrics = step(pstate, x, y, jax.random.PRNGKey(2))
+
+        assert float(metrics["loss"]) == pytest.approx(
+            float(ref_metrics["loss"]), rel=1e-6
+        )
+        assert pstate.params["stage_kernels"].sharding.spec == P(
+            MODEL_AXIS, None, None
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5
+            ),
+            jax.tree.map(np.asarray, pstate.params),
+            jax.tree.map(np.asarray, ref_state.params),
+        )
+
+    def test_eval_step_masked_sums(self):
+        from tpuflow.core.losses import mae_clip
+
+        mesh, state, x = _state_and_mesh()
+        pstate = shard_state(mesh, state, pp_shardings(mesh, state.params))
+        y = np.zeros((16,), np.float32)
+        mask = np.ones((16,), np.float32)
+        mask[12:] = 0.0
+        out = make_pp_eval_step(mesh, mae_clip, n_micro=4)(pstate, x, y, mask)
+        assert float(out["count"]) == 12.0
+        assert np.isfinite(float(out["loss_sum"]))
+
+
+class TestTrainConfigPp:
+    def test_pp_run_matches_single_device_loss(self):
+        """train(pp=2) on a (4, 2) mesh reproduces the single-device
+        training trajectory — the pipelined run is the same math. The
+        reference run pins per-batch stepping (auto may pick jit_epoch
+        for it; the PP constraint always steps per-batch)."""
+        ref = train(TrainJobConfig(**BASE, n_devices=1, jit_epoch=False))
+        pp = train(TrainJobConfig(**BASE, n_devices=8, pp=2))
+        assert pp.epoch_program == "per_batch"
+        assert "constraint" in pp.epoch_program_reason
+        # Per-epoch loss parity, not just the endpoint: the whole fit
+        # ran through the pipelined step.
+        for a, b in zip(pp.result.history, ref.result.history):
+            assert a["loss"] == pytest.approx(b["loss"], rel=1e-4)
+            assert a["val_loss"] == pytest.approx(b["val_loss"], rel=1e-4)
+        assert pp.test_mae == pytest.approx(ref.test_mae, rel=1e-4)
+
+    def test_pp_trained_artifact_serves_single_device(self, tmp_path):
+        """A pipeline-trained model must serve like any other: Orbax
+        restores the sharded checkpoint onto the default device and the
+        sidecar needs no PP awareness (sequential __call__)."""
+        from tpuflow.api.predict_api import Predictor
+
+        train(
+            TrainJobConfig(
+                **{**BASE, "max_epochs": 1},
+                n_devices=8, pp=2, storage_path=str(tmp_path),
+            )
+        )
+        p = Predictor.load(str(tmp_path), "pipeline_mlp")
+        cols = {
+            "pressure": np.array([2000.0, 1500.0]),
+            "choke": np.array([30.0, 20.0]),
+            "glr": np.array([1.2, 0.8]),
+            "temperature": np.array([60.0, 55.0]),
+            "water_cut": np.array([0.2, 0.3]),
+            "completion": np.array(["A", "B"]),
+        }
+        y = np.asarray(p.predict_columns(cols))
+        assert y.shape == (2,) and np.all(np.isfinite(y))
+
+    def test_pp_rejects_bad_division(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            train(TrainJobConfig(**BASE, n_devices=8, pp=3))
+
+    def test_pp_rejects_jit_epoch(self):
+        with pytest.raises(ValueError, match="jit_epoch"):
+            train(
+                TrainJobConfig(**BASE, n_devices=8, pp=2, jit_epoch=True)
+            )
+
+    def test_pp_rejects_non_pipeline_family(self):
+        cfg = dataclasses.replace(
+            TrainJobConfig(
+                **{**BASE, "model_kwargs": {}}, n_devices=8, pp=2
+            ),
+            model="static_mlp",
+        )
+        with pytest.raises(ValueError, match="pipeline_mlp"):
+            train(cfg)
+
+    def test_pp_and_tp_exclusive(self):
+        with pytest.raises(ValueError, match="cannot be combined"):
+            train(TrainJobConfig(**BASE, n_devices=8, pp=2, tp=2))
+
+    def test_microbatches_without_pp_rejected(self):
+        """pp_microbatches with pp=1 would silently train with no
+        microbatching at all while the user believes GPipe accumulation
+        is active — reject it loudly, and before any data is read."""
+        with pytest.raises(ValueError, match="pipeline knob"):
+            train(TrainJobConfig(**BASE, n_devices=8, pp_microbatches=8))
+
+    def test_pp_rejects_indivisible_microbatch(self):
+        with pytest.raises(ValueError, match="microbatches"):
+            train(
+                TrainJobConfig(
+                    **{**BASE, "batch_size": 30}, n_devices=8, pp=2,
+                    pp_microbatches=7,
+                )
+            )

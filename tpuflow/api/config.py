@@ -81,6 +81,19 @@ class TrainJobConfig:
     # megatron-style across tp devices (GSPMD; MLP families only — see
     # parallel/tp_train.py). 1 = off.
     tp: int = 1
+    # Pipeline parallelism: stage count of the GPipe microbatch pipeline
+    # over the model axis (pipeline_mlp family only — see
+    # parallel/pp_train.py). n_devices/pp device columns do DP in the
+    # same program. 1 = off; mutually exclusive with tp.
+    pp: int = 1
+    # Microbatches per pipelined step (GPipe M; bubble fraction
+    # (pp-1)/(M+pp-1), raise M to amortize). 0 = auto (= pp).
+    pp_microbatches: int = 0
+    # Expert parallelism: device count of the expert axis (moe_mlp
+    # family only — see parallel/ep_train.py). The stacked expert bank
+    # shards experts-per-device; n_devices/ep device columns do DP in
+    # the same program. 1 = off; mutually exclusive with tp/pp.
+    ep: int = 1
 
     @property
     def is_sequence_model(self) -> bool:

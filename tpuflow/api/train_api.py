@@ -433,6 +433,62 @@ def _prepare_data(
     )
 
 
+
+def _validate_model_axis(config, jit_epoch: bool, n_dev: int) -> None:
+    """Config-only model-axis validation, run BEFORE data preparation:
+    a misconfigured tp/pp/ep job must fail in milliseconds, not after a
+    possibly hours-long ingest+feature phase (the same early-rejection
+    discipline as the stream+jit_epoch check)."""
+    if sum(n > 1 for n in (config.tp, config.pp, config.ep)) > 1:
+        raise ValueError(
+            "tp, pp, and ep cannot be combined yet; pick one model-axis "
+            "strategy per job"
+        )
+    if config.pp_microbatches and config.pp <= 1:
+        raise ValueError(
+            "pp_microbatches is a pipeline knob; set pp>1 (a value "
+            "silently ignored would fake GPipe accumulation)"
+        )
+    for name, n in (("tp", config.tp), ("pp", config.pp), ("ep", config.ep)):
+        if n <= 1:
+            continue
+        if jax.process_count() > 1:
+            # No per-process batch slicing on these paths (the DP
+            # branch's _local/process_batch_bounds machinery); feeding
+            # a pod-global sharding from one host would crash mid-epoch.
+            raise ValueError(
+                f"{name}>1 is single-host for now; multi-host {name.upper()} "
+                "needs per-process batch feeding (see the DP branch)"
+            )
+        if jit_epoch:
+            raise ValueError(
+                f"{name}>1 trains through its per-batch sharded step; "
+                f"jit_epoch is not supported with {name}"
+            )
+        if n_dev % n:
+            raise ValueError(
+                f"n_devices {n_dev} not divisible by {name}={n}"
+            )
+    if config.pp > 1:
+        n_micro = config.pp_microbatches or config.pp
+        if config.batch_size % n_micro:
+            raise ValueError(
+                f"batch_size {config.batch_size} not divisible by "
+                f"{n_micro} pipeline microbatches"
+            )
+        if (config.batch_size // n_micro) % (n_dev // config.pp):
+            raise ValueError(
+                f"microbatch {config.batch_size // n_micro} not divisible "
+                f"by {n_dev // config.pp} data-parallel devices"
+            )
+    for name, n in (("tp", config.tp), ("ep", config.ep)):
+        if n > 1 and config.batch_size % (n_dev // n):
+            raise ValueError(
+                f"batch_size {config.batch_size} not divisible by "
+                f"{n_dev // n} data-parallel devices"
+            )
+
+
 def train(
     config: TrainJobConfig,
     *,
@@ -478,6 +534,8 @@ def train(
             config.batch_size,
             stream=config.stream,
             tp=config.tp,
+            pp=config.pp,
+            ep=config.ep,
             multi_host=jax.process_count() > 1,
         )
     else:
@@ -495,6 +553,8 @@ def train(
             "defeat the bounded-memory stream; use per-batch stepping for "
             "streaming runs"
         )
+    n_dev = config.n_devices or jax.device_count()
+    _validate_model_axis(config, jit_epoch, n_dev)
     if config.storage_path:
         # The serving sidecar serializes (sanitized) model_kwargs as JSON
         # at the END of training; anything still unserializable after
@@ -557,32 +617,11 @@ def train(
 
     # --- parallelism: DP over the mesh when >1 device; DP x TP when
     # config.tp > 1 (GSPMD megatron layout, parallel/tp_train.py) ---
-    n_dev = config.n_devices or jax.device_count()
+    # (model-axis configs were validated by _validate_model_axis before
+    # data preparation; the branches below only build the sharded state)
     train_step = eval_step = epoch_step = None
     batch_shard = None
     if config.tp > 1:
-        if jax.process_count() > 1:
-            # The TP path has no per-process batch slicing (the DP
-            # branch's _local/process_batch_bounds machinery); feeding a
-            # pod-global sharding from one host would crash mid-epoch.
-            raise ValueError(
-                "tp>1 is single-host for now; multi-host TP needs "
-                "per-process batch feeding (see the DP branch)"
-            )
-        if jit_epoch:
-            raise ValueError(
-                "tp>1 trains through the per-batch GSPMD step; jit_epoch "
-                "is not supported with tensor parallelism"
-            )
-        if n_dev % config.tp:
-            raise ValueError(
-                f"n_devices {n_dev} not divisible by tp={config.tp}"
-            )
-        if config.batch_size % (n_dev // config.tp):
-            raise ValueError(
-                f"batch_size {config.batch_size} not divisible by "
-                f"{n_dev // config.tp} data-parallel devices"
-            )
         from tpuflow.parallel.tp_train import (
             make_tp_eval_step,
             make_tp_mesh,
@@ -600,6 +639,45 @@ def train(
         state = shard_state(mesh, state, mlp_tp_shardings(mesh, state.params))
         train_step = make_tp_train_step(state, loss_fn)
         eval_step = make_tp_eval_step(loss_fn)
+        batch_shard = data_sharding(mesh)
+    elif config.pp > 1:
+        n_micro = config.pp_microbatches or config.pp
+        from tpuflow.parallel.pp_train import (
+            make_pp_eval_step,
+            make_pp_mesh,
+            make_pp_train_step,
+            pp_shardings,
+            shard_state,
+        )
+
+        mesh = make_pp_mesh(
+            n_data=n_dev // config.pp,
+            n_model=config.pp,
+            devices=jax.devices()[:n_dev],
+        )
+        # Fails loudly for non-pipeline families (pp_shardings).
+        state = shard_state(mesh, state, pp_shardings(mesh, state.params))
+        train_step = make_pp_train_step(state, loss_fn, n_micro)
+        eval_step = make_pp_eval_step(mesh, loss_fn, n_micro)
+        batch_shard = data_sharding(mesh)
+    elif config.ep > 1:
+        from tpuflow.parallel.ep_train import (
+            ep_shardings,
+            make_ep_eval_step,
+            make_ep_mesh,
+            make_ep_train_step,
+            shard_state,
+        )
+
+        mesh = make_ep_mesh(
+            n_data=n_dev // config.ep,
+            n_model=config.ep,
+            devices=jax.devices()[:n_dev],
+        )
+        # Fails loudly for non-MoE families (ep_shardings).
+        state = shard_state(mesh, state, ep_shardings(mesh, state.params))
+        train_step = make_ep_train_step(state, loss_fn)
+        eval_step = make_ep_eval_step(mesh, loss_fn)
         batch_shard = data_sharding(mesh)
     elif n_dev > 1:
         if config.batch_size % n_dev:

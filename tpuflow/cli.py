@@ -44,6 +44,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tp", type=int, default=1,
                    help="tensor-parallel model-axis size of the (data, model) "
                         "mesh (MLP families; devices/tp do data parallelism)")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline-parallel stage count over the model axis "
+                        "(pipeline_mlp family; GPipe microbatch schedule; "
+                        "devices/pp do data parallelism)")
+    p.add_argument("--pp-microbatches", type=int, default=0,
+                   help="microbatches per pipelined step (0 = auto, = pp)")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel device count over the model axis "
+                        "(moe_mlp family; devices/ep do data parallelism)")
     p.add_argument("--synthetic-wells", type=int, default=8)
     p.add_argument("--synthetic-steps", type=int, default=512)
     p.add_argument("--jit-epoch", action="store_true", default=None,
@@ -104,6 +113,9 @@ def main(argv=None) -> int:
         seed=args.seed,
         n_devices=args.devices,
         tp=args.tp,
+        pp=args.pp,
+        pp_microbatches=args.pp_microbatches,
+        ep=args.ep,
         synthetic_wells=args.synthetic_wells,
         synthetic_steps=args.synthetic_steps,
         verbose=not args.quiet,

@@ -9,7 +9,13 @@ matmuls, recurrence via an on-chip scan.
 """
 
 from tpuflow.models.attention import AttentionRegressor  # noqa: F401
-from tpuflow.models.mlp import StaticMLP, DynamicMLP, GilbertResidualMLP  # noqa: F401
+from tpuflow.models.mlp import (  # noqa: F401
+    DynamicMLP,
+    GilbertResidualMLP,
+    MoEMLP,
+    PipelineMLP,
+    StaticMLP,
+)
 from tpuflow.models.cnn import CNN1D  # noqa: F401
 from tpuflow.models.lstm import GilbertResidualLSTM, LSTMRegressor  # noqa: F401
 from tpuflow.models.registry import MODELS, build_model  # noqa: F401

@@ -87,3 +87,87 @@ class GilbertResidualMLP(nn.Module):
         raw = nn.Dense(1, kernel_init=nn.initializers.zeros)(h)[..., 0]
         correction = nn.softplus(raw + SOFTPLUS_ONE)
         return (gilbert_q * correction - self.target_mean) / self.target_std
+
+
+class PipelineMLP(nn.Module):
+    """Homogeneous-stage MLP built for pipeline parallelism: [B, F] -> [B].
+
+    ``embed`` Dense -> ``stages`` identical ``tanh(h @ W_s + b_s)``
+    blocks whose params are STACKED on a leading stage dim (so a
+    pipeline trainer shards them one-or-more-stages-per-device) -> a
+    scalar ``head``. This single-device ``__call__`` applies the stages
+    sequentially — it is the parity oracle for the GPipe trainer
+    (tpuflow/parallel/pp_train.py) and the serving path (an artifact
+    trained with a pipeline axis restores and predicts off-mesh like any
+    other model). The reference has no PP (SURVEY.md §2: out of scope
+    for parity); this family exists so the framework's pipeline axis is
+    training-capable end to end, not just a block.
+    """
+
+    stages: int = 4
+    hidden: int = 32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, deterministic: bool = True) -> jnp.ndarray:
+        import jax.nn.initializers as init
+
+        h = nn.relu(nn.Dense(self.hidden, name="embed")(x))
+        wk = self.param(
+            "stage_kernels", init.lecun_normal(),
+            (self.stages, self.hidden, self.hidden),
+        )
+        bk = self.param(
+            "stage_biases", init.zeros, (self.stages, self.hidden)
+        )
+        for s in range(self.stages):
+            h = jnp.tanh(h @ wk[s] + bk[s])
+        return nn.Dense(1, name="head")(h)[..., 0]
+
+
+class MoEMLP(nn.Module):
+    """Top-1 mixture-of-experts MLP built for expert parallelism:
+    [B, F] -> [B].
+
+    ``embed`` Dense -> a router (``gate``) picks one expert per token
+    from a STACKED bank of per-expert FFNs (params stacked on a leading
+    expert dim, so an expert-parallel trainer shards them
+    experts-per-device) -> residual add -> scalar ``head``. This
+    single-device ``__call__`` loops the experts densely — the parity
+    oracle for the EP trainer (tpuflow/parallel/ep_train.py) and the
+    serving path. The residual keeps the model trainable even when the
+    router's early routing is poor. The reference has no MoE (SURVEY.md
+    §2: out of scope for parity); this family exists so the expert axis
+    is training-capable end to end.
+    """
+
+    experts: int = 4
+    hidden: int = 32
+    ffn: int = 64
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, deterministic: bool = True) -> jnp.ndarray:
+        import jax
+        import jax.nn.initializers as init
+
+        h = nn.relu(nn.Dense(self.hidden, name="embed")(x))
+        gate = self.param(
+            "gate", init.lecun_normal(), (self.hidden, self.experts)
+        )
+        w1 = self.param(
+            "expert_w1", init.lecun_normal(),
+            (self.experts, self.hidden, self.ffn),
+        )
+        w2 = self.param(
+            "expert_w2", init.lecun_normal(),
+            (self.experts, self.ffn, self.hidden),
+        )
+        logits = h @ gate
+        probs = jax.nn.softmax(logits, axis=-1)
+        choice = jnp.argmax(logits, axis=-1)
+        weight = jnp.take_along_axis(probs, choice[:, None], axis=1)[:, 0]
+        moe = sum(
+            ((choice == e).astype(h.dtype) * weight)[:, None]
+            * (nn.relu(h @ w1[e]) @ w2[e])
+            for e in range(self.experts)
+        )
+        return nn.Dense(1, name="head")(h + moe)[..., 0]

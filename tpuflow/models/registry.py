@@ -10,7 +10,13 @@ import flax.linen as nn
 from tpuflow.models.attention import AttentionRegressor
 from tpuflow.models.cnn import CNN1D
 from tpuflow.models.lstm import GilbertResidualLSTM, LSTMRegressor
-from tpuflow.models.mlp import DynamicMLP, GilbertResidualMLP, StaticMLP
+from tpuflow.models.mlp import (
+    DynamicMLP,
+    GilbertResidualMLP,
+    MoEMLP,
+    PipelineMLP,
+    StaticMLP,
+)
 
 MODELS: dict[str, Callable[..., nn.Module]] = {
     # BASELINE config 1: "Static ANN: 3-layer MLP single-well regressor"
@@ -31,6 +37,13 @@ MODELS: dict[str, Callable[..., nn.Module]] = {
     # Long-context family: causal transformer whose scale-out path is
     # ring attention over the mesh (tpuflow.parallel.ring_attention)
     "attention": lambda **kw: AttentionRegressor(**kw),
+    # Pipeline-parallel family: homogeneous stages trained as a GPipe
+    # microbatch pipeline via TrainJobConfig(pp=N) (parallel/pp_train.py)
+    "pipeline_mlp": lambda **kw: PipelineMLP(**kw),
+    # Expert-parallel family: top-1 routed expert bank trained with
+    # experts sharded over the model axis via TrainJobConfig(ep=N)
+    # (parallel/ep_train.py)
+    "moe_mlp": lambda **kw: MoEMLP(**kw),
 }
 
 

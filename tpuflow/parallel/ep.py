@@ -28,6 +28,21 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tpuflow.parallel.mesh import MODEL_AXIS
 
 
+def top1_gate(x: jnp.ndarray, gate_w: jnp.ndarray):
+    """THE top-1 router, shared by the forward block below and the
+    trainer (``ep_train``): softmax over the gate logits, argmax picks
+    the expert, the picked prob is the combine weight (the path router
+    gradients flow through). One routing rule, one place — a routing
+    change (e.g. the capacity-bucketed ``all_to_all`` upgrade) lands in
+    every expert-parallel user at once. Returns ``(choice [N], weight
+    [N])``."""
+    logits = x @ gate_w  # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    choice = jnp.argmax(logits, axis=-1)  # [N] top-1 expert ids
+    weight = jnp.take_along_axis(probs, choice[:, None], axis=1)[:, 0]
+    return choice, weight
+
+
 @functools.lru_cache(maxsize=32)
 def _moe_fn(mesh: Mesh, axis: str, expert_fn: Callable):
     """Jitted MoE program, cached per (mesh, axis, expert_fn) — tp.py's
@@ -36,10 +51,7 @@ def _moe_fn(mesh: Mesh, axis: str, expert_fn: Callable):
     def body(params_local, gate_w, x):
         eid = lax.axis_index(axis)
         params_one = jax.tree_util.tree_map(lambda p: p[0], params_local)
-        logits = x @ gate_w  # [N, E]
-        probs = jax.nn.softmax(logits, axis=-1)
-        choice = jnp.argmax(logits, axis=-1)  # [N] top-1 expert ids
-        weight = jnp.take_along_axis(probs, choice[:, None], axis=1)[:, 0]
+        choice, weight = top1_gate(x, gate_w)
         mine = (choice == eid).astype(x.dtype)  # [N] my tokens
         # Dense dispatch: compute all tokens, keep mine, weighted combine.
         out = expert_fn(params_one, x)  # [N, F]

@@ -1,0 +1,170 @@
+"""Expert-parallel TRAINING (top-1 MoE, experts sharded over the mesh).
+
+``ep.py`` provides the one-expert-per-device MoE building block and its
+router-gradient proofs; this module makes the ``moe_mlp`` family
+actually *train* with an expert axis, reachable from ``train(config)``
+via ``TrainJobConfig(ep=N)`` — the same block→trainer promotion as
+``tp_train.py`` (model axis) and ``pp_train.py`` (pipeline axis).
+
+Layout, TPU-first:
+
+- the mesh is ``(data, model)``; each device column owns a CONTIGUOUS
+  chunk of the stacked expert FFN bank (``P(model)`` on the expert dim
+  — the memory win of EP: a device holds experts/N of the bank);
+- routing is dense capacity-free top-1 (the block's strategy): every
+  device computes its experts' outputs for all local tokens, masks to
+  the tokens routed to it, and the weighted combine is one ``psum``
+  over the expert axis — exact, no token dropping;
+- the batch (token) dim is sharded over the data axis inside the same
+  ``shard_map`` — DPxEP in one program;
+- router gradients flow through the softmax gate weight (argmax picks
+  the expert, the prob weights it), and shard_map's transpose inserts
+  the data-axis psum for replicated params — no hand-written backward.
+
+The reference has no MoE (SURVEY.md §2: its models are KBs); this
+exists so the framework's expert axis is training-capable end to end.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpuflow.core.losses import mae_clip
+from tpuflow.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from tpuflow.parallel.tp_train import make_tp_mesh, shard_state, state_shardings
+
+LossFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+# EP rides the same AUTO-axis (data, model) mesh as TP/PP training.
+make_ep_mesh = make_tp_mesh
+
+_EP_TREE = {"embed", "gate", "expert_w1", "expert_w2", "head"}
+
+
+def ep_shardings(mesh: Mesh, params, axis: str = MODEL_AXIS):
+    """Expert layout for an ``MoEMLP`` params tree: the stacked expert
+    bank sharded on the leading (expert) dim over ``axis`` — device d
+    owns the contiguous experts [d*k, (d+1)*k) — embed/gate/head
+    replicated. Raises for other families: silently replicating
+    everything would "work" while quietly not being expert parallel.
+    """
+    keys = set(params.keys()) if hasattr(params, "keys") else set()
+    if keys != _EP_TREE:
+        raise ValueError(
+            "ep training supports the moe_mlp family (stacked expert "
+            f"bank); got params {sorted(keys) or type(params)}"
+        )
+    n_dev = mesh.shape[axis]
+    E = params["expert_w1"].shape[0]
+    if E % n_dev:
+        raise ValueError(
+            f"moe_mlp experts={E} not divisible by ep={n_dev} devices "
+            "(each device owns an equal contiguous expert chunk)"
+        )
+    rep = NamedSharding(mesh, P())
+    return {
+        "embed": {"kernel": rep, "bias": rep},
+        "head": {"kernel": rep, "bias": rep},
+        "gate": rep,
+        "expert_w1": NamedSharding(mesh, P(axis, None, None)),
+        "expert_w2": NamedSharding(mesh, P(axis, None, None)),
+    }
+
+
+@functools.lru_cache(maxsize=32)
+def _moe_body_fn(mesh: Mesh, axis: str, data_axis: str):
+    """The routed expert program, cached per mesh: k experts per device,
+    dense capacity-free top-1 dispatch, one psum combine over the expert
+    axis; tokens sharded over the data axis (DPxEP in one shard_map)."""
+
+    from tpuflow.parallel.ep import top1_gate
+
+    def body(w1_local, w2_local, gate_w, h_local):
+        # w1_local: [k, H, Ff], w2_local: [k, Ff, H] — this device's
+        # contiguous expert chunk. h_local: [n_local, H].
+        k = w1_local.shape[0]
+        e0 = lax.axis_index(axis) * k
+        choice, weight = top1_gate(h_local, gate_w)
+        out = jnp.zeros_like(h_local)
+        for i in range(k):  # static: experts-per-device chunk
+            mine = (choice == e0 + i).astype(h_local.dtype)
+            expert = jax.nn.relu(h_local @ w1_local[i]) @ w2_local[i]
+            out = out + expert * (mine * weight)[:, None]
+        return lax.psum(out, axis)
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(), P(data_axis)),
+        out_specs=P(data_axis),
+        check_vma=False,
+    )
+
+
+def ep_forward(
+    mesh: Mesh,
+    params,
+    x: jnp.ndarray,
+    axis: str = MODEL_AXIS,
+    data_axis: str = DATA_AXIS,
+) -> jnp.ndarray:
+    """The MoEMLP forward with its expert bank run expert-parallel:
+    embed/gate/head are plain GSPMD ops; the routed FFNs run in the
+    sharded program. Numerically identical to the module's dense
+    ``__call__`` (same routing, same residual)."""
+    h = jax.nn.relu(x @ params["embed"]["kernel"] + params["embed"]["bias"])
+    moe = _moe_body_fn(mesh, axis, data_axis)(
+        params["expert_w1"], params["expert_w2"], params["gate"], h
+    )
+    h = h + moe
+    return (h @ params["head"]["kernel"] + params["head"]["bias"])[..., 0]
+
+
+def make_ep_train_step(state, loss_fn: LossFn = mae_clip):
+    """Jitted (state, x, y, rng) -> (state, metrics) over the state's
+    mesh; ``state`` is the already-sharded TrainState (its shardings pin
+    the output layout, as in tp_train/pp_train)."""
+    sh = state_shardings(state)
+    mesh = jax.tree.leaves(sh)[0].mesh
+    rep = NamedSharding(mesh, P())
+
+    def step(state, x, y, rng):
+        def loss_of(params):
+            pred = ep_forward(mesh, params, x)
+            return loss_fn(y, pred)
+
+        loss, grads = jax.value_and_grad(loss_of)(state.params)
+        state = state.apply_gradients(grads=grads)
+        return state, {"loss": loss}
+
+    return jax.jit(
+        step,
+        donate_argnums=(0,),
+        out_shardings=(sh, {"loss": rep}),
+    )
+
+
+def make_ep_eval_step(mesh: Mesh, loss_fn: LossFn = mae_clip):
+    """Jitted masked-sum eval step (the shared ``make_masked_eval_step``
+    aggregation) running the same expert-parallel forward as training."""
+    from tpuflow.parallel.tp_train import make_masked_eval_step
+
+    return make_masked_eval_step(
+        lambda state, x: ep_forward(mesh, state.params, x), loss_fn
+    )
+
+
+__all__ = [
+    "make_ep_mesh",
+    "ep_shardings",
+    "ep_forward",
+    "make_ep_train_step",
+    "make_ep_eval_step",
+    "shard_state",
+]

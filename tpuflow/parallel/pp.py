@@ -32,6 +32,48 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tpuflow.parallel.mesh import MODEL_AXIS
 
 
+def gpipe_schedule(axis: str, n_stages: int, chunk_fn: Callable, xs):
+    """THE GPipe fill/steady/drain tick loop, shared by the forward block
+    below and the trainer (``pp_train``): runs ``chunk_fn`` (this
+    device's stage compute, already closed over its local params) for
+    ``M + S - 1`` ticks, injecting microbatches at stage 0, banking the
+    last stage's outputs, and rotating activations around the ring. One
+    schedule, one place — a drain/fill fix here fixes every pipeline
+    user. Call inside ``shard_map`` over ``axis``; ``xs`` is the local
+    ``[M, B, F]`` microbatch stack; returns the last stage's outputs
+    broadcast to every device of the ring (psum of one non-zero
+    contribution)."""
+    n_micro = xs.shape[0]
+    stage = lax.axis_index(axis)
+    zero = jnp.zeros(xs.shape[1:], xs.dtype)
+    outputs = jnp.zeros_like(xs)
+    ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(t, carry):
+        received, outputs = carry
+        # Stage 0 injects microbatch t during the fill/steady phase;
+        # other stages consume what the ring delivered last tick.
+        inject = xs[jnp.minimum(t, n_micro - 1)]
+        feed = jnp.where((stage == 0) & (t < n_micro), inject, received)
+        out = chunk_fn(feed)
+        # The LAST stage emits microbatch t-(S-1) once the pipe fills.
+        m = t - (n_stages - 1)
+        valid = (stage == n_stages - 1) & (m >= 0)
+        slot = jnp.maximum(m, 0)
+        prev = lax.dynamic_index_in_dim(outputs, slot, 0, keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(valid, out, prev), slot, 0
+        )
+        received = lax.ppermute(out, axis, ring)
+        return received, outputs
+
+    (_, outputs) = lax.fori_loop(
+        0, n_micro + n_stages - 1, tick, (zero, outputs)
+    )
+    mask = (stage == n_stages - 1).astype(xs.dtype)
+    return lax.psum(outputs * mask, axis)
+
+
 @functools.lru_cache(maxsize=32)
 def _pipeline_fn(mesh: Mesh, axis: str, stage_fn: Callable):
     """Jitted pipeline program, cached per (mesh, axis, stage_fn) — the
@@ -40,40 +82,11 @@ def _pipeline_fn(mesh: Mesh, axis: str, stage_fn: Callable):
     n_stages = mesh.shape[axis]
 
     def body(params_local, xs):
-        n_micro = xs.shape[0]
         # params_local: [1, ...] — this device's stage. xs: [M, B, F].
         params_one = jax.tree_util.tree_map(lambda p: p[0], params_local)
-        stage = lax.axis_index(axis)
-        B, F = xs.shape[1], xs.shape[2]
-        zero = jnp.zeros((B, F), xs.dtype)
-        outputs = jnp.zeros_like(xs)
-        ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-
-        def tick(t, carry):
-            received, outputs = carry
-            # Stage 0 injects microbatch t during the fill/steady phase;
-            # other stages consume what the ring delivered last tick.
-            inject = xs[jnp.minimum(t, n_micro - 1)]
-            feed = jnp.where((stage == 0) & (t < n_micro), inject, received)
-            out = stage_fn(params_one, feed)
-            # The LAST stage emits microbatch t-(S-1) once the pipe fills.
-            m = t - (n_stages - 1)
-            valid = (stage == n_stages - 1) & (m >= 0)
-            slot = jnp.maximum(m, 0)
-            prev = lax.dynamic_index_in_dim(outputs, slot, 0, keepdims=False)
-            outputs = lax.dynamic_update_index_in_dim(
-                outputs, jnp.where(valid, out, prev), slot, 0
-            )
-            received = lax.ppermute(out, axis, ring)
-            return received, outputs
-
-        (_, outputs) = lax.fori_loop(
-            0, n_micro + n_stages - 1, tick, (zero, outputs)
+        return gpipe_schedule(
+            axis, n_stages, lambda h: stage_fn(params_one, h), xs
         )
-        # Outputs live on the last stage only; broadcast them to every
-        # device (psum of one non-zero contribution).
-        mask = (stage == n_stages - 1).astype(xs.dtype)
-        return lax.psum(outputs * mask, axis)
 
     return jax.jit(
         jax.shard_map(

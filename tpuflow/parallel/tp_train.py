@@ -179,12 +179,15 @@ def make_tp_train_step(state, loss_fn: LossFn = mae_clip):
     )
 
 
-def make_tp_eval_step(loss_fn: LossFn = mae_clip):
-    """Jitted masked-sum eval step (same contract as train.make_eval_step);
-    shardings propagate from the operands."""
+def make_masked_eval_step(forward: Callable, loss_fn: LossFn = mae_clip):
+    """THE masked-sum eval step (same contract as train.make_eval_step),
+    shared by every model-axis trainer: ``forward(state, x) -> pred``
+    plugs in the strategy's forward (GSPMD apply for TP, the pipelined
+    program for PP, the routed program for EP); the masked aggregation
+    is written once."""
 
     def step(state, x, y, mask):
-        pred = state.apply_fn({"params": state.params}, x, deterministic=True)
+        pred = forward(state, x)
         per_loss = jax.vmap(loss_fn)(y, pred)
         per_mae = jnp.abs(y - pred).reshape(y.shape[0], -1).mean(axis=1)
         return {
@@ -194,3 +197,15 @@ def make_tp_eval_step(loss_fn: LossFn = mae_clip):
         }
 
     return jax.jit(step)
+
+
+def make_tp_eval_step(loss_fn: LossFn = mae_clip):
+    """Jitted masked-sum eval step; shardings propagate from the
+    operands (GSPMD apply — the megatron layout needs no explicit
+    collectives at eval either)."""
+    return make_masked_eval_step(
+        lambda state, x: state.apply_fn(
+            {"params": state.params}, x, deterministic=True
+        ),
+        loss_fn,
+    )

@@ -97,6 +97,8 @@ def choose_epoch_program(
     *,
     stream: bool = False,
     tp: int = 1,
+    pp: int = 1,
+    ep: int = 1,
     multi_host: bool = False,
     device_kind: str | None = None,
 ) -> ProgramChoice:
@@ -110,6 +112,16 @@ def choose_epoch_program(
         return ProgramChoice(
             False, "tensor parallelism trains through the per-batch "
             "GSPMD step", "constraint",
+        )
+    if pp > 1:
+        return ProgramChoice(
+            False, "pipeline parallelism trains through the per-batch "
+            "GPipe step", "constraint",
+        )
+    if ep > 1:
+        return ProgramChoice(
+            False, "expert parallelism trains through the per-batch "
+            "routed step", "constraint",
         )
     if multi_host:
         # The multi-host scanned path exists (fit(epoch_step=...)), but
