@@ -1,23 +1,34 @@
-"""Worker program for the REAL multi-process DP test.
+"""Worker program for the REAL multi-process DP tests.
 
 Launched by ``tests/test_multiprocess.py`` as ``python mp_worker.py
-<pid> <nprocs> <port>``. Every process runs this same program — the
-multi-host recipe from ``tpuflow/parallel/distributed.py``'s docstring,
-executed for real: ``jax.distributed.initialize`` against a localhost
-coordinator (CPU backend, Gloo collectives), a mesh spanning both
-processes' devices, per-process data loading via ``process_batch_bounds``,
-global-batch assembly via ``shard_batch``'s
-``make_array_from_process_local_data`` branch, and one DP train step.
+<pid> <nprocs> <port> [mode]``. Every process runs this same program —
+the multi-host recipe from ``tpuflow/parallel/distributed.py``'s
+docstring, executed for real: ``jax.distributed.initialize`` against a
+localhost coordinator (CPU backend, Gloo collectives), a mesh spanning
+every process's devices, per-process data loading via
+``process_batch_bounds``, global-batch assembly via ``shard_batch``'s
+``make_array_from_process_local_data`` branch.
+
+Modes:
+
+- ``step`` (default): ONE DP train step (the original 2-process test).
+- ``epoch``: one SCANNED-DP epoch step (``make_dp_epoch_step`` — K
+  steps per dispatch with the pmean inside ``lax.scan``), each process
+  feeding only its dim-1 slice — the production ``jit_epoch`` DP path
+  run on a real multi-process runtime.
+- ``fit``: a small ``train(config)`` run — the whole fit loop on the
+  multi-host runtime, with optional fault injection / resume driven by
+  env vars (``MP_STORAGE``, ``MP_FAULT_EPOCH``, ``MP_RESUME``): the
+  kill-one-process → gang-restart → resume-from-checkpoint cycle of
+  SURVEY.md §5.3, executed for real by
+  ``test_four_process_kill_and_resume_cycle``.
 
 The single-process reference runs INLINE in the test process on an
-identically-shaped 2-device mesh: with no dropout the DP math is
-process-count-invariant, so the 2-process run must reproduce the
-reference loss and updated params to float tolerance. (nprocs=1 also
-works here as a subprocess reference; the inline one saves a third of
-the test's wall-clock on the single-core CI machine.)
+identically-shaped mesh: with no dropout the DP math is
+process-count-invariant, so the multi-process run must reproduce the
+reference loss and updated params to float tolerance.
 
-Prints one JSON line: {"pid", "processes", "assembled_multi", "loss",
-"param_sum"}.
+Prints one JSON line per mode (always includes {"pid", "processes"}).
 """
 
 from __future__ import annotations
@@ -29,15 +40,23 @@ import sys
 TOTAL_DEVICES = 2
 
 
+def total_devices(nprocs: int) -> int:
+    """Mesh size for an nprocs gang: 1 device per process past the
+    original 2-process/2-device shape."""
+    return max(TOTAL_DEVICES, nprocs)
+
+
 def main() -> None:
     pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    mode = sys.argv[4] if len(sys.argv) > 4 else "step"
+    total = total_devices(nprocs)
 
     # Env must be pinned BEFORE the first jax import: CPU backend with
-    # exactly TOTAL_DEVICES/nprocs local virtual devices per process
+    # exactly total/nprocs local virtual devices per process
     # (replacing any inherited xla_force_host_platform_device_count).
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = (
-        f"--xla_force_host_platform_device_count={TOTAL_DEVICES // nprocs}"
+        f"--xla_force_host_platform_device_count={total // nprocs}"
     )
     import jax
 
@@ -59,7 +78,11 @@ def main() -> None:
     if nprocs > 1:
         assert init_distributed(f"localhost:{port}", nprocs, pid)
         assert jax.process_count() == nprocs, jax.process_count()
-    assert jax.device_count() == TOTAL_DEVICES, jax.device_count()
+    assert jax.device_count() == total, jax.device_count()
+
+    if mode == "fit":
+        _fit_mode(pid)
+        return
 
     mesh = make_mesh()
 
@@ -81,6 +104,41 @@ def main() -> None:
     # On a multi-process runtime this takes _assemble's
     # make_array_from_process_local_data branch — the branch this test
     # exists to execute for real (tpuflow/parallel/dp.py).
+    if mode == "epoch":
+        # The scanned-DP epoch program on the real multi-process
+        # runtime: stack nb batches, feed only this process's dim-1
+        # slice, assemble via shard_epoch (the train_api _put_epoch
+        # pattern), run ONE dispatch covering nb steps.
+        from tpuflow.parallel.dp import make_dp_epoch_step, shard_epoch
+
+        nb = 2
+        exs = np.stack([x_global, x_global[::-1]])  # [nb, B, F]
+        eys = np.stack([y_global, y_global[::-1]])
+        exs_l, eys_l = exs[:, lo:hi], eys[:, lo:hi]
+        epoch_step = make_dp_epoch_step(mesh)
+        state, epoch_loss = epoch_step(
+            state,
+            shard_epoch(mesh, exs_l),
+            shard_epoch(mesh, eys_l),
+            jax.random.PRNGKey(1),
+        )
+        param_sum = float(
+            sum(float(abs(p).sum()) for p in jax.tree.leaves(state.params))
+        )
+        print(
+            json.dumps(
+                {
+                    "pid": pid,
+                    "processes": jax.process_count(),
+                    "mode": "epoch",
+                    "loss": float(epoch_loss),
+                    "param_sum": param_sum,
+                }
+            ),
+            flush=True,
+        )
+        return
+
     xs, ys = shard_batch(mesh, x_local, y_local)
     state, metrics = step(state, xs, ys, jax.random.PRNGKey(1))
 
@@ -95,6 +153,61 @@ def main() -> None:
                 "assembled_multi": jax.process_count() > 1,
                 "loss": float(metrics["loss"]),
                 "param_sum": param_sum,
+            }
+        ),
+        flush=True,
+    )
+
+
+def _fit_mode(pid: int) -> None:
+    """A small train(config) run on the already-initialized multi-host
+    runtime — the whole reference fit loop (epochs, early stopping,
+    checkpoints) across real processes, with optional fault injection
+    and resume for the kill → gang-restart → resume cycle.
+
+    The gang checkpoints to ONE shared MP_STORAGE dir — the real
+    multi-host Orbax contract (a shared filesystem; process 0 writes
+    the replicated state, every process joins the barriers). The gang
+    stays in lockstep because data, seeds, and the DP math are
+    identical on every host.
+    """
+    import jax
+
+    from tpuflow.api import TrainJobConfig, train
+
+    storage = os.environ["MP_STORAGE"]
+    fault = os.environ.get("MP_FAULT_EPOCH")
+    resume = os.environ.get("MP_RESUME") == "1"
+    config = TrainJobConfig(
+        model="static_mlp",
+        max_epochs=4,
+        batch_size=16,
+        synthetic_wells=2,
+        synthetic_steps=48,
+        seed=0,
+        verbose=True,  # the test asserts the "Resuming from epoch" line
+        jit_epoch=False,
+        storage_path=storage,
+        save_every=1,
+        resume=resume,
+        fault_epoch=int(fault) if (fault and pid == 0) else None,
+        # Hard fault: a preemption runs no cleanup; the soft fault's
+        # commit barrier would deadlock against survivors stuck in a
+        # training collective (see FitConfig.fault_hard). Synchronous
+        # checkpointing: async saves' cross-process barriers racing the
+        # asymmetric fault can wedge the gang's coordination service.
+        fault_hard=True,
+        ckpt_async=False,
+    )
+    report = train(config)
+    print(
+        json.dumps(
+            {
+                "pid": pid,
+                "processes": jax.process_count(),
+                "mode": "fit",
+                "epochs_ran": report.result.epochs_ran,
+                "loss": float(report.test_loss),
             }
         ),
         flush=True,

@@ -1,0 +1,53 @@
+"""The disk-CSV end-to-end trace (examples/csv_to_serving.py), executed.
+
+Round-4 verdict item 7: the full reference deployment trace (SURVEY.md
+§3.2) on DISK-RESIDENT data — CSV file -> CLI ``--stream`` training ->
+artifact -> serving daemon -> HTTP predictions — run for real as three
+separate processes (CLI, daemon, this test) and asserted on the
+predicted values, not just exit codes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLE = os.path.join(REPO, "examples", "csv_to_serving.py")
+
+
+@pytest.mark.slow
+def test_csv_to_serving_end_to_end(tmp_path):
+    # No CSV_SERVE_PORT pin: the example picks an ephemeral free port,
+    # which is the whole defense against leftover-daemon collisions.
+    env = dict(os.environ)
+    proc = subprocess.run(
+        [sys.executable, EXAMPLE, str(tmp_path)],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr[-2500:]
+    # The example's tail line is its machine-readable result; the
+    # byte-identical HTTP-vs-library prediction check already ran
+    # inside (np.testing.assert_array_equal), so a zero exit plus this
+    # record is the full assertion chain.
+    rec = json.loads(
+        [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+    )
+    assert rec["n"] == 512  # 4 wells x 128 steps, every CSV row predicted
+    assert rec["sidecar_exists"]
+    assert np.isfinite(rec["model_mae"])
+    # The streamed-CSV-trained model must be a real model, not noise:
+    # strictly better than the physical baseline even at demo budget.
+    assert rec["model_mae"] < rec["gilbert_mae"]
+    # The artifact layout the web layer reads (SURVEY.md §3.2).
+    assert (tmp_path / "models").is_dir()
+    assert (tmp_path / "meta" / "static_mlp.json").exists()

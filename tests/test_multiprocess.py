@@ -27,7 +27,7 @@ import sys
 import numpy as np
 import pytest
 
-from tests.mp_worker import TOTAL_DEVICES
+from tests.mp_worker import TOTAL_DEVICES, total_devices
 
 WORKER = os.path.join(os.path.dirname(__file__), "mp_worker.py")
 
@@ -38,34 +38,68 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch_worker(pid: int, nprocs: int, port: int) -> subprocess.Popen:
+def _launch_worker(
+    pid: int, nprocs: int, port: int, mode: str = "step", extra_env=None,
+    log_dir: str | None = None,
+) -> subprocess.Popen:
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     # ``python tests/mp_worker.py`` puts tests/ (not the repo root) on
     # sys.path; the workers import tpuflow from the repo checkout.
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    return subprocess.Popen(
-        [sys.executable, WORKER, str(pid), str(nprocs), str(port)],
-        stdout=subprocess.PIPE,
-        stderr=subprocess.PIPE,
+    env["PYTHONUNBUFFERED"] = "1"
+    env.update(extra_env or {})
+    # Workers log to FILES, never pipes: a gang test waits on ONE member
+    # while the others keep writing — an undrained 64KB pipe buffer
+    # would block a worker mid-write and hang the whole gang (this
+    # exact flake). The launcher reads the files after the processes
+    # settle.
+    import tempfile
+
+    log_dir = log_dir or tempfile.mkdtemp(prefix="mpworker")
+    os.makedirs(log_dir, exist_ok=True)
+    log = open(os.path.join(log_dir, f"worker{pid}.log"), "w+")
+    proc = subprocess.Popen(
+        [sys.executable, WORKER, str(pid), str(nprocs), str(port), mode],
+        stdout=log,
+        stderr=subprocess.STDOUT,
         text=True,
         env=env,
         cwd=repo_root,
     )
+    proc.log_file = log
+    return proc
+
+
+def _read_log(p: subprocess.Popen) -> str:
+    p.log_file.flush()
+    p.log_file.seek(0)
+    return p.log_file.read()
+
+
+def _kill_gang(procs: list[subprocess.Popen]) -> None:
+    """Kill every still-live worker and close its log handle — the
+    cleanup for ANY wait timeout (a hung gang member left alive would
+    block in its collective forever, holding the core and the
+    coordinator port for the rest of the CI session)."""
+    for q in procs:
+        if q.poll() is None:
+            q.kill()
+            q.wait()
+        q.log_file.close()
 
 
 def _collect(procs: list[subprocess.Popen], timeout: float = 150.0) -> list[dict]:
     results = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
-        line = [l for l in out.splitlines() if l.startswith("{")][-1]
-        results.append(json.loads(line))
+    try:
+        for p in procs:
+            p.wait(timeout=timeout)
+            out = _read_log(p)
+            assert p.returncode == 0, f"worker failed:\n{out[-2000:]}"
+            line = [l for l in out.splitlines() if l.startswith("{")][-1]
+            results.append(json.loads(line))
+    finally:
+        _kill_gang(procs)
     return results
 
 
@@ -98,9 +132,12 @@ def _inline_reference() -> dict:
     return {"loss": float(metrics["loss"]), "param_sum": param_sum}
 
 
-def test_two_process_dp_step_matches_single_process():
+def test_two_process_dp_step_matches_single_process(tmp_path):
     port = _free_port()
-    procs = [_launch_worker(0, 2, port), _launch_worker(1, 2, port)]
+    procs = [
+        _launch_worker(0, 2, port, log_dir=str(tmp_path)),
+        _launch_worker(1, 2, port, log_dir=str(tmp_path)),
+    ]
     # Overlap the subprocess startup (jax import + Gloo mesh) with the
     # inline reference computation.
     single = _inline_reference()
@@ -116,3 +153,132 @@ def test_two_process_dp_step_matches_single_process():
     # ...and with the single-process reference on the same-shaped mesh.
     assert multi[0]["loss"] == pytest.approx(single["loss"], rel=1e-6)
     assert multi[0]["param_sum"] == pytest.approx(single["param_sum"], rel=1e-6)
+
+
+def _inline_epoch_reference(n_devices: int) -> dict:
+    """The scanned-DP epoch program (mp_worker mode=epoch), run
+    single-process on an identically-shaped n-device submesh."""
+    import jax
+
+    from tpuflow.models import StaticMLP
+    from tpuflow.parallel.dp import make_dp_epoch_step, replicate, shard_epoch
+    from tpuflow.parallel.mesh import make_mesh
+    from tpuflow.train import create_state
+
+    mesh = make_mesh(devices=jax.devices()[:n_devices])
+    global_batch, n_features = 32, 6
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((global_batch, n_features)).astype(np.float32)
+    y = rng.standard_normal((global_batch,)).astype(np.float32)
+    exs = np.stack([x, x[::-1]])
+    eys = np.stack([y, y[::-1]])
+    state = replicate(
+        mesh, create_state(StaticMLP(), jax.random.PRNGKey(0), x[:2])
+    )
+    state, epoch_loss = make_dp_epoch_step(mesh)(
+        state,
+        shard_epoch(mesh, exs),
+        shard_epoch(mesh, eys),
+        jax.random.PRNGKey(1),
+    )
+    param_sum = float(
+        sum(float(abs(p).sum()) for p in jax.tree.leaves(state.params))
+    )
+    return {"loss": float(epoch_loss), "param_sum": param_sum}
+
+
+@pytest.mark.slow
+def test_four_process_scanned_epoch_matches_single_process(tmp_path):
+    """The PRODUCTION scanned-DP epoch program (jit_epoch's multi-host
+    path: per-process dim-1 slices, shard_epoch assembly, K steps per
+    dispatch with the pmean inside lax.scan) runs on FOUR real
+    processes and reproduces the single-process trajectory."""
+    nprocs = 4
+    port = _free_port()
+    procs = [
+        _launch_worker(i, nprocs, port, mode="epoch", log_dir=str(tmp_path))
+        for i in range(nprocs)
+    ]
+    single = _inline_epoch_reference(total_devices(nprocs))
+    multi = _collect(procs, timeout=480)
+
+    assert [r["processes"] for r in multi] == [nprocs] * nprocs
+    losses = {r["loss"] for r in multi}
+    sums = {r["param_sum"] for r in multi}
+    assert len(losses) == 1 and len(sums) == 1  # replicated agreement
+    assert multi[0]["loss"] == pytest.approx(single["loss"], rel=1e-6)
+    assert multi[0]["param_sum"] == pytest.approx(single["param_sum"], rel=1e-6)
+
+
+@pytest.mark.slow
+def test_four_process_kill_and_resume_cycle(tmp_path):
+    """The multi-host fault story (SURVEY.md §5.3), executed for real:
+    a 4-process training gang loses one process mid-run (fault
+    injection = os._exit, no Python cleanup — a preemption), the
+    launcher kills the rest of the gang (what any cluster manager does
+    on a lost member), and the RESTARTED gang resumes from the last
+    full-state checkpoint and completes the run."""
+    nprocs = 4
+    storage = str(tmp_path)
+    env = {"MP_STORAGE": storage, "MP_FAULT_EPOCH": "2"}
+
+    port = _free_port()
+    procs = [
+        _launch_worker(
+            i, nprocs, port, mode="fit", extra_env=env,
+            log_dir=str(tmp_path / "gang1"),
+        )
+        for i in range(nprocs)
+    ]
+    # Process 0 dies at epoch 2 (rc=42, the fit loop's injected
+    # preemption). Survivors block on the next collective — kill the
+    # WHOLE gang once the failure is observed (including procs[0] if
+    # the wait itself timed out).
+    try:
+        assert procs[0].wait(timeout=480) == 42, _read_log(procs[0])[-1500:]
+    finally:
+        _kill_gang(procs)
+
+    # The epoch-2 run-state checkpoint exists before the crash: the
+    # workers checkpoint SYNCHRONOUSLY (ckpt_async=False), so the
+    # epoch-2 save and its cross-process commit completed inside the
+    # epoch, before the hard fault fired.
+    assert os.path.isdir(os.path.join(storage, "runs")), os.listdir(storage)
+
+    # Gang restart with resume: every process restores epoch 2 and
+    # finishes the 4-epoch run.
+    port = _free_port()
+    env2 = {"MP_STORAGE": storage, "MP_RESUME": "1"}
+    procs = [
+        _launch_worker(
+            i, nprocs, port, mode="fit", extra_env=env2,
+            log_dir=str(tmp_path / "gang2"),
+        )
+        for i in range(nprocs)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            p.wait(timeout=480)
+            out = _read_log(p)
+            assert p.returncode == 0, f"resume worker failed:\n{out[-2000:]}"
+            outs.append(out)
+    finally:
+        _kill_gang(procs)
+    import re
+
+    resumed_from = set()
+    for pid, out in enumerate(outs):
+        m = re.search(r"Resuming from epoch (\d+)", out)
+        assert m, f"pid {pid} never resumed:\n{out[-1500:]}"
+        resumed_from.add(int(m.group(1)))
+        rec = json.loads(
+            [l for l in out.splitlines() if l.startswith("{")][-1]
+        )
+        assert rec["processes"] == nprocs
+        assert rec["epochs_ran"] == 4
+        assert np.isfinite(rec["loss"])
+    # Every process restored the SAME committed checkpoint — and with
+    # synchronous saves that MUST be epoch 2 (the save committed before
+    # the fault fired); restoring epoch 1 would be a resume regression.
+    assert resumed_from == {2}, resumed_from

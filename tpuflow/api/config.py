@@ -69,6 +69,8 @@ class TrainJobConfig:
     save_every: int = 0  # epochs between full-state run checkpoints
     resume: bool = False  # continue from the latest run checkpoint
     fault_epoch: int | None = None  # inject a simulated preemption (tests)
+    fault_hard: bool = False  # preempt WITHOUT committing async ckpt writes
+    ckpt_async: bool = True  # False: synchronous checkpoint writes
 
     # --- observability ---
     trace_dir: str | None = None  # jax.profiler trace of the first epoch

@@ -449,6 +449,19 @@ def _validate_model_axis(config, jit_epoch: bool, n_dev: int) -> None:
             "pp_microbatches is a pipeline knob; set pp>1 (a value "
             "silently ignored would fake GPipe accumulation)"
         )
+    # Family gates for the single-family strategies (tp's Dense-stack
+    # check is structural and stays in mlp_tp_shardings): the sharding
+    # builders would also raise, but only AFTER data preparation.
+    if config.pp > 1 and config.model != "pipeline_mlp":
+        raise ValueError(
+            f"pp>1 training supports the pipeline_mlp family; got model "
+            f"{config.model!r}"
+        )
+    if config.ep > 1 and config.model != "moe_mlp":
+        raise ValueError(
+            f"ep>1 training supports the moe_mlp family; got model "
+            f"{config.model!r}"
+        )
     for name, n in (("tp", config.tp), ("pp", config.pp), ("ep", config.ep)):
         if n <= 1:
             continue
@@ -746,6 +759,8 @@ def train(
         save_every=config.save_every,
         resume=config.resume,
         fault_epoch=config.fault_epoch,
+        fault_hard=config.fault_hard,
+        ckpt_async=config.ckpt_async,
         trace_dir=config.trace_dir,
         metrics_path=config.metrics_path,
         stop_fn=stop_fn,

@@ -161,10 +161,14 @@ class MoEMLP(nn.Module):
             "expert_w2", init.lecun_normal(),
             (self.experts, self.ffn, self.hidden),
         )
-        logits = h @ gate
-        probs = jax.nn.softmax(logits, axis=-1)
-        choice = jnp.argmax(logits, axis=-1)
-        weight = jnp.take_along_axis(probs, choice[:, None], axis=1)[:, 0]
+        # THE shared top-1 router (tpuflow.parallel.ep.top1_gate): this
+        # dense __call__ is the EP trainer's parity oracle AND the
+        # serving path, so a routing change must reach all of them at
+        # once. (Lazy import: models must stay importable without the
+        # parallel package's jax.sharding machinery.)
+        from tpuflow.parallel.ep import top1_gate
+
+        choice, weight = top1_gate(h, gate)
         moe = sum(
             ((choice == e).astype(h.dtype) * weight)[:, None]
             * (nn.relu(h @ w1[e]) @ w2[e])

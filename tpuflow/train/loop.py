@@ -97,6 +97,22 @@ class FitConfig:
     # supervisor's detect-and-restart path is exercised for real
     # (tests/test_supervisor.py).
     fault_epoch: int | None = None
+    # ckpt_async: background (async) checkpoint writes, the default.
+    # False = synchronous saves: every process completes the write (and
+    # any cross-process Orbax barrier) INSIDE the epoch — required for
+    # multi-process fault drills, where an async save's barrier racing
+    # an asymmetric fault can wedge the coordination service (see
+    # tests/mp_worker.py), and a legitimate choice when save latency
+    # matters less than determinism.
+    ckpt_async: bool = True
+    # fault_hard: exit WITHOUT committing in-flight async checkpoint
+    # writes — the truthful preemption (the tail write may be lost;
+    # Orbax's atomic commit surfaces the previous checkpoint). The soft
+    # default commits first so single-process resume tests are
+    # epoch-deterministic; hard is REQUIRED for multi-process fault
+    # tests, where the commit's cross-process barrier would deadlock
+    # against surviving processes stuck in a training collective.
+    fault_hard: bool = False
     # Cooperative cancellation/timeout: called at the top of every epoch;
     # a non-None string stops the run by raising
     # ``TrainingInterrupted(reason)``. Between-epoch granularity: a single
@@ -172,7 +188,10 @@ def fit(
 
     stopper = EarlyStopping(patience=config.patience)
     ckpt = (
-        BestCheckpointer(config.storage_path, config.model_name)
+        BestCheckpointer(
+            config.storage_path, config.model_name,
+            async_save=config.ckpt_async,
+        )
         if config.storage_path
         else None
     )
@@ -182,7 +201,10 @@ def fit(
     if config.storage_path and (config.save_every or config.resume):
         from tpuflow.train.resume import RunCheckpointer
 
-        run_ckpt = RunCheckpointer(config.storage_path, config.model_name)
+        run_ckpt = RunCheckpointer(
+            config.storage_path, config.model_name,
+            async_save=config.ckpt_async,
+        )
         if config.resume:
             restored = run_ckpt.restore(state)
             if restored is not None:
@@ -316,11 +338,13 @@ def fit(
                 # simulated preemption tests resume-from-THIS-epoch
                 # deterministically (a real preemption may lose the tail
                 # write; Orbax's atomic rename just surfaces the previous
-                # checkpoint in that case).
-                if run_ckpt is not None:
-                    run_ckpt.close()
-                if ckpt is not None:
-                    ckpt.close()
+                # checkpoint in that case). fault_hard skips the commit —
+                # see its FitConfig comment.
+                if not config.fault_hard:
+                    if run_ckpt is not None:
+                        run_ckpt.close()
+                    if ckpt is not None:
+                        ckpt.close()
                 import os
 
                 os._exit(42)
