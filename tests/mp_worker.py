@@ -16,6 +16,10 @@ Modes:
   steps per dispatch with the pmean inside ``lax.scan``), each process
   feeding only its dim-1 slice — the production ``jit_epoch`` DP path
   run on a real multi-process runtime.
+- ``tp``: one tensor-parallel train step through ``train(config)``'s
+  own multi-host feeding path primitives — a (data, model) mesh
+  spanning the processes, megatron-sharded params, per-process batch
+  slices assembled over the data axis.
 - ``fit``: a small ``train(config)`` run — the whole fit loop on the
   multi-host runtime, with optional fault injection / resume driven by
   env vars (``MP_STORAGE``, ``MP_FAULT_EPOCH``, ``MP_RESUME``): the
@@ -40,16 +44,20 @@ import sys
 TOTAL_DEVICES = 2
 
 
-def total_devices(nprocs: int) -> int:
+def total_devices(nprocs: int, mode: str = "step") -> int:
     """Mesh size for an nprocs gang: 1 device per process past the
-    original 2-process/2-device shape."""
+    original 2-process/2-device shape; the TP mode needs 2 devices per
+    process (each process must cover whole data rows of a model=2
+    mesh)."""
+    if mode == "tp":
+        return 2 * nprocs
     return max(TOTAL_DEVICES, nprocs)
 
 
 def main() -> None:
     pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
     mode = sys.argv[4] if len(sys.argv) > 4 else "step"
-    total = total_devices(nprocs)
+    total = total_devices(nprocs, mode)
 
     # Env must be pinned BEFORE the first jax import: CPU backend with
     # exactly total/nprocs local virtual devices per process
@@ -82,6 +90,9 @@ def main() -> None:
 
     if mode == "fit":
         _fit_mode(pid)
+        return
+    if mode == "tp":
+        _tp_mode(pid, total)
         return
 
     mesh = make_mesh()
@@ -153,6 +164,46 @@ def main() -> None:
                 "assembled_multi": jax.process_count() > 1,
                 "loss": float(metrics["loss"]),
                 "param_sum": param_sum,
+            }
+        ),
+        flush=True,
+    )
+
+
+def _tp_mode(pid: int, total: int) -> None:
+    """Multi-host TENSOR-PARALLEL training through train(config) itself:
+    the TP branch's per-process feeding recipe (process_batch_bounds
+    slices assembled over the TP mesh's data axis) runs the WHOLE fit
+    loop with jax.process_count() > 1 and megatron-sharded params
+    spanning the processes — the product path, not just primitives."""
+    import jax
+
+    from tpuflow.api import TrainJobConfig, train
+
+    report = train(
+        TrainJobConfig(
+            model="static_mlp",
+            model_kwargs={"hidden": (16, 16)},
+            max_epochs=2,
+            batch_size=32,
+            synthetic_wells=2,
+            synthetic_steps=48,
+            seed=0,
+            verbose=False,
+            jit_epoch=False,
+            n_devices=total,
+            tp=2,
+        )
+    )
+    print(
+        json.dumps(
+            {
+                "pid": pid,
+                "processes": jax.process_count(),
+                "mode": "tp",
+                "losses": [h["loss"] for h in report.result.history],
+                "val_losses": [h["val_loss"] for h in report.result.history],
+                "test_loss": float(report.test_loss),
             }
         ),
         flush=True,

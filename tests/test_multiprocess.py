@@ -210,6 +210,61 @@ def test_four_process_scanned_epoch_matches_single_process(tmp_path):
     assert multi[0]["param_sum"] == pytest.approx(single["param_sum"], rel=1e-6)
 
 
+def _inline_tp_reference(total: int) -> dict:
+    """mp_worker mode=tp single-process: the same train(config) TP run
+    on this process's identically-shaped mesh — the multi-host run must
+    reproduce the whole trajectory."""
+    from tpuflow.api import TrainJobConfig, train
+
+    report = train(
+        TrainJobConfig(
+            model="static_mlp",
+            model_kwargs={"hidden": (16, 16)},
+            max_epochs=2,
+            batch_size=32,
+            synthetic_wells=2,
+            synthetic_steps=48,
+            seed=0,
+            verbose=False,
+            jit_epoch=False,
+            n_devices=total,
+            tp=2,
+        )
+    )
+    return {
+        "losses": [h["loss"] for h in report.result.history],
+        "val_losses": [h["val_loss"] for h in report.result.history],
+        "test_loss": float(report.test_loss),
+    }
+
+
+@pytest.mark.slow
+def test_two_process_tp_train_matches_single_process(tmp_path):
+    """Multi-host TENSOR-PARALLEL training through train(config),
+    executed for real: two processes, each owning one whole data-axis
+    row of a (2, 2) mesh, feed per-process batch slices assembled over
+    the data axis while the megatron-sharded params span both processes
+    — per-epoch trajectory parity with the single-process TP run."""
+    nprocs = 2
+    port = _free_port()
+    procs = [
+        _launch_worker(i, nprocs, port, mode="tp", log_dir=str(tmp_path))
+        for i in range(nprocs)
+    ]
+    single = _inline_tp_reference(total_devices(nprocs, "tp"))
+    multi = _collect(procs, timeout=480)
+
+    assert [r["processes"] for r in multi] == [nprocs] * nprocs
+    assert multi[0]["losses"] == multi[1]["losses"]  # replicated agreement
+    for a, b in zip(multi[0]["losses"], single["losses"]):
+        assert a == pytest.approx(b, rel=1e-5)
+    for a, b in zip(multi[0]["val_losses"], single["val_losses"]):
+        assert a == pytest.approx(b, rel=1e-5)
+    assert multi[0]["test_loss"] == pytest.approx(
+        single["test_loss"], rel=1e-5
+    )
+
+
 @pytest.mark.slow
 def test_four_process_kill_and_resume_cycle(tmp_path):
     """The multi-host fault story (SURVEY.md §5.3), executed for real:

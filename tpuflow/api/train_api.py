@@ -44,6 +44,7 @@ from tpuflow.parallel import (
     make_dp_eval_step,
     make_dp_train_step,
     make_mesh,
+    make_process_fed_steps,
     process_batch_bounds,
     shard_batch,
     shard_epoch,
@@ -465,13 +466,14 @@ def _validate_model_axis(config, jit_epoch: bool, n_dev: int) -> None:
     for name, n in (("tp", config.tp), ("pp", config.pp), ("ep", config.ep)):
         if n <= 1:
             continue
-        if jax.process_count() > 1:
-            # No per-process batch slicing on these paths (the DP
-            # branch's _local/process_batch_bounds machinery); feeding
-            # a pod-global sharding from one host would crash mid-epoch.
+        if name != "tp" and jax.process_count() > 1:
+            # No per-process batch slicing on these paths yet (TP has
+            # it — the DP branch's _local/process_batch_bounds
+            # machinery); feeding a pod-global sharding from one host
+            # would crash mid-epoch.
             raise ValueError(
                 f"{name}>1 is single-host for now; multi-host {name.upper()} "
-                "needs per-process batch feeding (see the DP branch)"
+                "needs per-process batch feeding (see the DP/TP branches)"
             )
         if jit_epoch:
             raise ValueError(
@@ -499,6 +501,25 @@ def _validate_model_axis(config, jit_epoch: bool, n_dev: int) -> None:
             raise ValueError(
                 f"batch_size {config.batch_size} not divisible by "
                 f"{n_dev // n} data-parallel devices"
+            )
+    if config.tp > 1 and jax.process_count() > 1:
+        if n_dev != jax.device_count():
+            # A submesh would leave some processes with ZERO mesh
+            # devices while process_batch_bounds still hands them batch
+            # rows — make_array_from_process_local_data then crashes on
+            # the first batch, after data preparation.
+            raise ValueError(
+                f"multi-host tp needs the full pod: n_devices {n_dev} "
+                f"!= device_count {jax.device_count()}"
+            )
+        if jax.local_device_count() % config.tp:
+            # Every process's devices must cover WHOLE data-axis rows,
+            # or per-process batch slices would split a model group
+            # across hosts.
+            raise ValueError(
+                f"multi-host tp={config.tp} needs the "
+                f"{jax.local_device_count()} local devices per process "
+                "to be a multiple of tp"
             )
 
 
@@ -650,9 +671,18 @@ def train(
         )
         # Fails loudly for non-Dense-stack families (mlp_tp_shardings).
         state = shard_state(mesh, state, mlp_tp_shardings(mesh, state.params))
-        train_step = make_tp_train_step(state, loss_fn)
-        eval_step = make_tp_eval_step(loss_fn)
-        batch_shard = data_sharding(mesh)
+        tp_train = make_tp_train_step(state, loss_fn)
+        tp_eval = make_tp_eval_step(loss_fn)
+        if jax.process_count() > 1:
+            # Multi-host: every host materializes the same seeded batch
+            # order and feeds only its slice — THE shared recipe
+            # (parallel.dp.make_process_fed_steps), identical to DP.
+            train_step, eval_step = make_process_fed_steps(
+                mesh, tp_train, tp_eval
+            )
+        else:
+            train_step, eval_step = tp_train, tp_eval
+            batch_shard = data_sharding(mesh)
     elif config.pp > 1:
         n_micro = config.pp_microbatches or config.pp
         from tpuflow.parallel.pp_train import (
@@ -702,23 +732,12 @@ def train(
         dp_train = make_dp_train_step(mesh, loss_fn)
         dp_eval = make_dp_eval_step(mesh, loss_fn)
         # Multi-host pods: every host materializes the same seeded batch
-        # order, then feeds ONLY its process_batch_bounds slice;
-        # shard_batch assembles the slices into pod-global arrays.
+        # order and feeds only its slice — THE shared recipe
+        # (parallel.dp.make_process_fed_steps).
         multi_host = jax.process_count() > 1
-
-        def _local(*arrays):
-            if not multi_host or isinstance(arrays[0], jax.Array):
-                return arrays
-            lo, hi = process_batch_bounds(len(arrays[0]))
-            return tuple(a[lo:hi] for a in arrays)
-
-        def train_step(state, x, y, rng):  # noqa: F811
-            xs, ys = shard_batch(mesh, *_local(x, y))
-            return dp_train(state, xs, ys, rng)
-
-        def eval_step(state, x, y, mask):  # noqa: F811
-            xs, ys, ms = shard_batch(mesh, *_local(x, y, mask))
-            return dp_eval(state, xs, ys, ms)
+        train_step, eval_step = make_process_fed_steps(
+            mesh, dp_train, dp_eval
+        )
 
         if jit_epoch:
             # The scanned DP program: K train steps (each with its ICI

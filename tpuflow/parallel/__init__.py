@@ -22,6 +22,7 @@ from tpuflow.parallel.dp import (  # noqa: F401
     make_dp_epoch_step,
     make_dp_eval_step,
     make_dp_train_step,
+    make_process_fed_steps,
     process_batch_bounds,
     shard_batch,
     shard_epoch,

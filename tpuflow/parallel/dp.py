@@ -207,6 +207,34 @@ def shard_batch(mesh: Mesh, *arrays):
     return _assemble(data_sharding(mesh), *arrays)
 
 
+def make_process_fed_steps(mesh: Mesh, train_fn, eval_fn):
+    """Wrap per-device (state, xs, ys, ...) step fns with THE per-process
+    feeding recipe, shared by every multi-host-capable strategy branch
+    (DP and TP today; PP/EP when they grow multi-host): single-host
+    passes batches through whole; on a multi-process runtime each host
+    slices its ``process_batch_bounds`` rows and ``shard_batch``
+    assembles the slices into pod-global arrays over the mesh's data
+    axis. Already-global ``jax.Array`` inputs (prefetched pre-sharded
+    batches) pass through unsliced."""
+    multi = jax.process_count() > 1
+
+    def _local(*arrays):
+        if not multi or isinstance(arrays[0], jax.Array):
+            return arrays
+        lo, hi = process_batch_bounds(len(arrays[0]))
+        return tuple(a[lo:hi] for a in arrays)
+
+    def train_step(state, x, y, rng):
+        xs, ys = shard_batch(mesh, *_local(x, y))
+        return train_fn(state, xs, ys, rng)
+
+    def eval_step(state, x, y, mask):
+        xs, ys, ms = shard_batch(mesh, *_local(x, y, mask))
+        return eval_fn(state, xs, ys, ms)
+
+    return train_step, eval_step
+
+
 def process_batch_bounds(
     global_batch: int,
     process_id: int | None = None,

@@ -15,7 +15,11 @@ ordinary train step is jitted over the mesh:
 
 The reference has no TP (SURVEY.md §2: its models are KBs), so this is a
 beyond-parity capability; it exists so a family that outgrows one chip's
-HBM shards its feature dimensions without leaving ``fit()``.
+HBM shards its feature dimensions without leaving ``fit()``. Multi-host:
+``train(config)`` feeds per-process batch slices over the TP mesh's data
+axis (the DP branch's recipe), provided every process's devices cover
+whole data-axis rows (local device count divisible by tp); exercised by
+a real 2-process run in ``tests/test_multiprocess.py``.
 """
 
 from __future__ import annotations
