@@ -37,8 +37,20 @@ def main(seed: int = 0) -> None:
     p = jnp.asarray(np.tile(table["pressure"], 16))
     c = jnp.asarray(np.tile(table["choke"], 16))
     g = jnp.asarray(np.tile(table["glr"], 16))
-    f = jax.jit(gilbert_flow)
-    steps, elapsed = time_steps(f, p, c, g, seconds=2.0, block=lambda o: o)
+    # Chain each dispatch on the previous result (`+ 0*prev`, free next to
+    # the transcendentals) so the final drain transitively drains the
+    # whole pass — time_steps' contract; an unchained pure fn would leave
+    # n-1 dispatches un-synced on the relay backend.
+    f = jax.jit(lambda p, c, g, prev: gilbert_flow(p, c, g) + 0.0 * prev)
+
+    class _Box:
+        out = jnp.zeros_like(p)
+
+    def step():
+        _Box.out = f(p, c, g, _Box.out)
+        return _Box.out
+
+    steps, elapsed = time_steps(step, seconds=2.0, block=lambda o: o)
     emit(
         "gilbert_baseline",
         "predict_throughput",

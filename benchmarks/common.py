@@ -96,20 +96,42 @@ def _timed_passes(run_n, seconds: float) -> tuple[int, float]:
         n = int(n * min(max(2.0, 1.3 * seconds / max(elapsed, 1e-9)), 10.0))
 
 
-def time_steps(step_fn, *args, seconds: float = 5.0, block) -> tuple[int, float]:
-    """Time ``step_fn(*args)`` after a warmup call; returns (steps,
-    elapsed) of one bounded, fully-drained pass. ``block`` extracts a
-    value to block_until_ready on from the step's result."""
+def drain(value) -> None:
+    """Synchronize with the device by TRANSFERRING ``value`` to the host.
+
+    ``jax.block_until_ready`` is NOT a synchronization point on the axon
+    relay backend: measured on-chip (round 5), it returned after 14ms for
+    a 32-step chain whose true drained time — exposed by ``float(loss)``
+    — was 3.8s. Every timed pass in this tree must therefore end with a
+    real device->host transfer of a value data-dependent on the last
+    step; the chained state dependency then drains the whole pass. The
+    transferred value is a scalar or small dict, so the extra roundtrip
+    is noise over a multi-second pass.
+    """
     import jax
 
+    jax.device_get(value)
+
+
+def time_steps(step_fn, *args, seconds: float = 5.0, block) -> tuple[int, float]:
+    """Time ``step_fn(*args)`` after a warmup call; returns (steps,
+    elapsed) of one bounded, fully-drained pass. ``block`` extracts the
+    value (data-dependent on the step) that ``drain`` transfers to force
+    completion.
+
+    CONTRACT: only the LAST step's value is transferred, so each
+    ``step_fn`` call must be data-dependent on the previous one (thread
+    a carry/state through, like time_train_steps does) — otherwise the
+    first n-1 dispatches of a pass are never synced and the timing is
+    bogus on backends where block_until_ready lies (see ``drain``)."""
     out = step_fn(*args)
-    jax.block_until_ready(block(out))
+    drain(block(out))
 
     def run_n(n: int) -> float:
         t0 = time.perf_counter()
         for _ in range(n):
             out = step_fn(*args)
-        jax.block_until_ready(block(out))
+        drain(block(out))
         return time.perf_counter() - t0
 
     return _timed_passes(run_n, seconds)
@@ -122,7 +144,7 @@ def time_train_steps(state, step, x, y, seconds: float = 5.0):
 
     key = jax.random.PRNGKey(0)
     state, m = step(state, x, y, key)
-    jax.block_until_ready(m["loss"])
+    drain(m["loss"])
     carry = [state]
 
     def run_n(n: int) -> float:
@@ -130,7 +152,7 @@ def time_train_steps(state, step, x, y, seconds: float = 5.0):
         t0 = time.perf_counter()
         for _ in range(n):
             state, m = step(state, x, y, key)
-        jax.block_until_ready(m["loss"])
+        drain(m["loss"])
         carry[0] = state
         return time.perf_counter() - t0
 

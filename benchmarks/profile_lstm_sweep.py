@@ -37,7 +37,7 @@ maybe_pin_cpu()
 import jax
 import numpy as np
 
-from benchmarks.common import emit, time_steps
+from benchmarks.common import drain, emit, time_steps
 
 WINDOW, FEATURES, HIDDEN = 24, 5, 64
 
@@ -103,7 +103,7 @@ def main() -> int:
             tdir = os.path.join(args.trace_root, cfg.strip())
             jax.profiler.start_trace(tdir)
             out = timed()
-            jax.block_until_ready(out)
+            drain(out)
             jax.profiler.stop_trace()
             print(f"# trace: {tdir}", flush=True)
 

@@ -170,6 +170,27 @@ def main() -> None:
     )
 
 
+def tp_job_config(total: int):
+    """The TP parity workload, shared by the multi-host workers AND the
+    single-process reference (tests/test_multiprocess.py) so the parity
+    comparison can never drift into config skew."""
+    from tpuflow.api import TrainJobConfig
+
+    return TrainJobConfig(
+        model="static_mlp",
+        model_kwargs={"hidden": (16, 16)},
+        max_epochs=2,
+        batch_size=32,
+        synthetic_wells=2,
+        synthetic_steps=48,
+        seed=0,
+        verbose=False,
+        jit_epoch=False,
+        n_devices=total,
+        tp=2,
+    )
+
+
 def _tp_mode(pid: int, total: int) -> None:
     """Multi-host TENSOR-PARALLEL training through train(config) itself:
     the TP branch's per-process feeding recipe (process_batch_bounds
@@ -178,23 +199,9 @@ def _tp_mode(pid: int, total: int) -> None:
     spanning the processes — the product path, not just primitives."""
     import jax
 
-    from tpuflow.api import TrainJobConfig, train
+    from tpuflow.api import train
 
-    report = train(
-        TrainJobConfig(
-            model="static_mlp",
-            model_kwargs={"hidden": (16, 16)},
-            max_epochs=2,
-            batch_size=32,
-            synthetic_wells=2,
-            synthetic_steps=48,
-            seed=0,
-            verbose=False,
-            jit_epoch=False,
-            n_devices=total,
-            tp=2,
-        )
-    )
+    report = train(tp_job_config(total))
     print(
         json.dumps(
             {

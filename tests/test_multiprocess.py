@@ -213,24 +213,13 @@ def test_four_process_scanned_epoch_matches_single_process(tmp_path):
 def _inline_tp_reference(total: int) -> dict:
     """mp_worker mode=tp single-process: the same train(config) TP run
     on this process's identically-shaped mesh — the multi-host run must
-    reproduce the whole trajectory."""
-    from tpuflow.api import TrainJobConfig, train
+    reproduce the whole trajectory. The config comes from the SAME
+    factory the workers use (tests.mp_worker.tp_job_config), so parity
+    failures can only mean runtime divergence, never config skew."""
+    from tests.mp_worker import tp_job_config
+    from tpuflow.api import train
 
-    report = train(
-        TrainJobConfig(
-            model="static_mlp",
-            model_kwargs={"hidden": (16, 16)},
-            max_epochs=2,
-            batch_size=32,
-            synthetic_wells=2,
-            synthetic_steps=48,
-            seed=0,
-            verbose=False,
-            jit_epoch=False,
-            n_devices=total,
-            tp=2,
-        )
-    )
+    report = train(tp_job_config(total))
     return {
         "losses": [h["loss"] for h in report.result.history],
         "val_losses": [h["val_loss"] for h in report.result.history],

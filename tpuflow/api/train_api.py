@@ -46,7 +46,6 @@ from tpuflow.parallel import (
     make_mesh,
     make_process_fed_steps,
     process_batch_bounds,
-    shard_batch,
     shard_epoch,
 )
 from tpuflow.parallel.dp import replicate

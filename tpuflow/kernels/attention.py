@@ -42,6 +42,22 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG = -1e30  # finite mask value: keeps exp() NaN-free on masked rows
 
+# Per-row stats (lse, delta, ring m/l) cannot travel as bare [BH, T]
+# arrays with (1, Bt) blocks: Mosaic requires the last two block dims to
+# be divisible by (8, 128) or equal to the array dims, and a row block's
+# sublane dim of 1 violates that the moment the kernel compiles on a real
+# chip (interpret mode never enforces it). So row stats travel as
+# [BH, T, _LANES] with the value broadcast across the trailing lanes —
+# the official TPU flash kernel's layout trick, at 8 lanes instead of 128
+# so the HBM cost stays negligible next to q/k/v (the 8-wide last dim is
+# legal because it EQUALS the array's last dim).
+_LANES = 8
+
+
+def _rows_to_lanes(x: jnp.ndarray) -> jnp.ndarray:
+    """[..., T] row stats -> [..., T, _LANES] lane-broadcast layout."""
+    return jnp.broadcast_to(x[..., None], (*x.shape, _LANES))
+
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -155,7 +171,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, Bk):
     m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
     l_safe = jnp.where(l == 0, 1.0, l)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l_safe)
+    lse_ref[0] = _rows_to_lanes(m + jnp.log(l_safe))
 
 
 def _dq_kernel(
@@ -167,8 +183,8 @@ def _dq_kernel(
     iq = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0][:, 0]
+    delta = delta_ref[0][:, 0]
     q_pos = iq * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
     n_kb = jnp.minimum((iq + 1) * Bq + Bk - 1, T) // Bk
 
@@ -202,8 +218,8 @@ def _dkv_kernel(
         dk, dv = carry
         q = q_ref[0, pl.ds(qb * Bq, Bq)].astype(jnp.float32) * scale
         do = do_ref[0, pl.ds(qb * Bq, Bq)].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qb * Bq, Bq)]
-        delta = delta_ref[0, pl.ds(qb * Bq, Bq)]
+        lse = lse_ref[0, pl.ds(qb * Bq, Bq), 0]
+        delta = delta_ref[0, pl.ds(qb * Bq, Bq), 0]
         q_pos = qb * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
         dk_p, dv_p = _dkv_block(
             q, k_blk, v_blk, do, lse, delta, k_pos <= q_pos
@@ -235,6 +251,19 @@ def _specs_btd(Bt, D, whole_T):
     )
 
 
+def _row_specs(Bt, whole_T):
+    """Lane-broadcast row-stat blocks: per-q-tile vs whole-sequence."""
+    return (
+        pl.BlockSpec(
+            (1, Bt, _LANES), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM
+        ),
+        pl.BlockSpec(
+            (1, whole_T, _LANES), lambda b, i: (b, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+    )
+
+
 def _fwd(q, k, v, scale):
     """Returns (out, lse), BOTH truncated to the caller's T — padding is
     private to each pallas wrapper, never part of the residuals."""
@@ -247,21 +276,20 @@ def _fwd(q, k, v, scale):
     grid = (BH, T // Bt)
     blk, whole = _specs_btd(Bt, D, T)
 
+    row_blk, _ = _row_specs(Bt, T)
+
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, Bk=Bt),
         grid=grid,
         in_specs=[blk, whole, whole],
-        out_specs=[
-            blk,
-            pl.BlockSpec((1, Bt), lambda b, i: (b, i), memory_space=pltpu.VMEM),
-        ],
+        out_specs=[blk, row_blk],
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, T), jnp.float32),
+            jax.ShapeDtypeStruct((BH, T, _LANES), jnp.float32),
         ],
         interpret=_interpret(),
     )(q_p, k_p, v_p)
-    return o[:, :T0], lse[:, :T0]
+    return o[:, :T0], lse[:, :T0, 0]
 
 
 def _bwd(q, k, v, o, lse, do, scale):
@@ -286,8 +314,9 @@ def _bwd(q, k, v, o, lse, do, scale):
         lse = jnp.pad(lse, ((0, 0), (0, pad)), constant_values=-_NEG)
     grid = (BH, T // Bt)
     blk, whole = _specs_btd(Bt, D, T)
-    row_blk = pl.BlockSpec((1, Bt), lambda b, i: (b, i), memory_space=pltpu.VMEM)
-    row_whole = pl.BlockSpec((1, T), lambda b, i: (b, 0), memory_space=pltpu.VMEM)
+    row_blk, row_whole = _row_specs(Bt, T)
+    lse_l = _rows_to_lanes(lse)
+    delta_l = _rows_to_lanes(delta)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, Bk=Bt),
@@ -296,7 +325,7 @@ def _bwd(q, k, v, o, lse, do, scale):
         out_specs=blk,
         out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
         interpret=_interpret(),
-    )(q_p, k_p, v_p, do_p, lse, delta)
+    )(q_p, k_p, v_p, do_p, lse_l, delta_l)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, Bq=Bt),
@@ -308,7 +337,7 @@ def _bwd(q, k, v, o, lse, do, scale):
             jax.ShapeDtypeStruct((BH, T, D), q.dtype),
         ],
         interpret=_interpret(),
-    )(q_p, k_p, v_p, do_p, lse, delta)
+    )(q_p, k_p, v_p, do_p, lse_l, delta_l)
     return dq[:, :T0], dk[:, :T0], dv[:, :T0]
 
 
@@ -362,8 +391,8 @@ def _round_fwd_kernel(
     q_off, k_off = off_ref[0, 0], off_ref[0, 1]
     q = q_ref[0].astype(jnp.float32) * scale
     q_pos = q_off + iq * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
-    m = m_ref[0].astype(jnp.float32)
-    l = l_ref[0].astype(jnp.float32)
+    m = m_ref[0][:, 0].astype(jnp.float32)
+    l = l_ref[0][:, 0].astype(jnp.float32)
     acc = acc_ref[0].astype(jnp.float32)
 
     def body(kb, carry):
@@ -383,7 +412,9 @@ def _round_fwd_kernel(
         (q_off + (iq + 1) * Bq - 1 - k_off) // Bk + 1, 0, T // Bk
     )
     m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m, l, acc))
-    m_out[0], l_out[0], acc_out[0] = m, l, acc.astype(acc_out.dtype)
+    m_out[0] = _rows_to_lanes(m)
+    l_out[0] = _rows_to_lanes(l)
+    acc_out[0] = acc.astype(acc_out.dtype)
 
 
 def ring_round_fwd(q, k_blk, v_blk, m, l, acc, q_off, k_off, scale):
@@ -410,7 +441,7 @@ def ring_round_fwd(q, k_blk, v_blk, m, l, acc, q_off, k_off, scale):
     off = jnp.stack([q_off, k_off]).astype(jnp.int32).reshape(1, 2)
     grid = (B, T // Bt)
     blk, whole = _specs_btd(Bt, D, T)
-    row_blk = pl.BlockSpec((1, Bt), lambda b, i: (b, i), memory_space=pltpu.VMEM)
+    row_blk, _ = _row_specs(Bt, T)
     smem = pl.BlockSpec((1, 2), lambda b, i: (0, 0), memory_space=pltpu.SMEM)
 
     m2, l2, acc2 = pl.pallas_call(
@@ -419,13 +450,13 @@ def ring_round_fwd(q, k_blk, v_blk, m, l, acc, q_off, k_off, scale):
         in_specs=[smem, blk, whole, whole, row_blk, row_blk, blk],
         out_specs=[row_blk, row_blk, blk],
         out_shape=[
-            jax.ShapeDtypeStruct((B, T), jnp.float32),
-            jax.ShapeDtypeStruct((B, T), jnp.float32),
+            jax.ShapeDtypeStruct((B, T, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((B, T, _LANES), jnp.float32),
             jax.ShapeDtypeStruct((B, T, D), jnp.float32),
         ],
         interpret=_interpret(),
-    )(off, q_p, k_p, v_p, m, l, acc)
-    return m2[:, :Tl], l2[:, :Tl], acc2[:, :Tl]
+    )(off, q_p, k_p, v_p, _rows_to_lanes(m), _rows_to_lanes(l), acc)
+    return m2[:, :Tl, 0], l2[:, :Tl, 0], acc2[:, :Tl]
 
 
 def _round_bwd_kernel(
@@ -443,8 +474,8 @@ def _round_bwd_kernel(
     # --- dq for q-tile i: loop k sub-blocks of the visiting block ---
     q = q_ref[0, pl.ds(i * Bt, Bt)].astype(jnp.float32) * scale
     do = do_ref[0, pl.ds(i * Bt, Bt)].astype(jnp.float32)
-    lse = lse_ref[0, pl.ds(i * Bt, Bt)]
-    delta = delta_ref[0, pl.ds(i * Bt, Bt)]
+    lse = lse_ref[0, pl.ds(i * Bt, Bt), 0]
+    delta = delta_ref[0, pl.ds(i * Bt, Bt), 0]
     q_pos = q_off + i * Bt + jax.lax.broadcasted_iota(jnp.int32, (Bt, Bt), 0)
 
     def dq_body(kb, dq):
@@ -469,8 +500,8 @@ def _round_bwd_kernel(
         dk, dv = carry
         q_b = q_ref[0, pl.ds(qb * Bt, Bt)].astype(jnp.float32) * scale
         do_b = do_ref[0, pl.ds(qb * Bt, Bt)].astype(jnp.float32)
-        lse_b = lse_ref[0, pl.ds(qb * Bt, Bt)]
-        delta_b = delta_ref[0, pl.ds(qb * Bt, Bt)]
+        lse_b = lse_ref[0, pl.ds(qb * Bt, Bt), 0]
+        delta_b = delta_ref[0, pl.ds(qb * Bt, Bt), 0]
         q_pos_b = q_off + qb * Bt + jax.lax.broadcasted_iota(
             jnp.int32, (Bt, Bt), 0
         )
@@ -508,7 +539,7 @@ def ring_round_bwd(q, k_blk, v_blk, do, lse, delta, q_off, k_off, scale):
     off = jnp.stack([q_off, k_off]).astype(jnp.int32).reshape(1, 2)
     grid = (B, T // Bt)
     blk, whole = _specs_btd(Bt, D, T)
-    row_whole = pl.BlockSpec((1, T), lambda b, i: (b, 0), memory_space=pltpu.VMEM)
+    _, row_whole = _row_specs(Bt, T)
     smem = pl.BlockSpec((1, 2), lambda b, i: (0, 0), memory_space=pltpu.SMEM)
 
     dq, dk, dv = pl.pallas_call(
@@ -522,5 +553,5 @@ def ring_round_bwd(q, k_blk, v_blk, do, lse, delta, q_off, k_off, scale):
             jax.ShapeDtypeStruct((B, T, D), q.dtype),
         ],
         interpret=_interpret(),
-    )(off, q_p, k_p, v_p, do_p, lse, delta)
+    )(off, q_p, k_p, v_p, do_p, _rows_to_lanes(lse), _rows_to_lanes(delta))
     return dq[:, :Tl], dk[:, :Tl], dv[:, :Tl]

@@ -2,15 +2,16 @@
 
 The fit loop has two epoch programs (tpuflow/train/loop.py): per-batch
 stepping (one XLA dispatch per minibatch) and ``jit_epoch`` (the whole
-epoch scanned into one compiled program). Which one is faster depends on
-the batch size: at the reference's production batch size of 20 (reference
-cnn.py:128) a step is microseconds of device work under ~57us of Python
-dispatch over the relay, so the scanned program wins by an order of
-magnitude; at bench-scale batches (1024+) the per-batch path has measured
-FASTER on-chip than the scanned program (BENCHLOG.md round-3: 17.7M
-samples/s per-batch vs 5.0M scanned). A single static default is
-therefore wrong at one end or the other — ``train(config)`` resolves
-``jit_epoch=None`` ("auto") through :func:`choose_epoch_program` instead.
+epoch scanned into one compiled program). Which one is faster is a
+per-backend measurement, not a guess: on the relay-attached TPU a single
+dispatch costs ~700us of round-trip, so the scanned program wins at
+EVERY batch measured (round 5, transfer-drained timing: 9.36M samples/s
+scanned vs 1.47M per-batch at B=1024 — round 3's contrary 17.7M
+per-batch reading was a sync artifact of ``block_until_ready`` on the
+relay backend, see BENCHLOG.md). On other backends the ordering can
+differ, so ``train(config)`` resolves ``jit_epoch=None`` ("auto")
+through :func:`choose_epoch_program` from recorded sweeps instead of a
+static default.
 
 The decision source, in order:
 

@@ -286,7 +286,9 @@ def fit(
                 train_loss = float(np.mean([float(l) for l in train_losses]))
                 last_device_value = train_losses[-1]
             if tracing:
-                jax.block_until_ready(last_device_value)
+                # device_get: block_until_ready is not a reliable sync
+                # point on the relay backend (benchmarks/common.py::drain).
+                jax.device_get(last_device_value)
                 jax.profiler.stop_trace()
 
             val = _eval_dataset(eval_step, state, val_ds, config.batch_size)

@@ -43,7 +43,11 @@ class StepTimer:
         import jax
 
         if block_on is not None:
-            jax.block_until_ready(block_on)
+            # device_get, not block_until_ready: on the axon relay backend
+            # block_until_ready can return before the device work finishes
+            # (measured round 5 — see benchmarks/common.py::drain); only a
+            # real transfer of a data-dependent value is a sync point.
+            jax.device_get(block_on)
         dt = time.perf_counter() - (self._t0 or time.perf_counter())
         self.times.append(dt)
         return dt
