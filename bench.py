@@ -70,7 +70,7 @@ candidates swept per variant, default "1024x1,1024x16,4096x16" — 1024x1
 is the best config measured on-chip, ~17.7M samples/sec in round 3, AND
 the cheapest to compile, so it goes first; setting BENCH_BATCH and/or
 BENCH_SCAN pins a single config instead), BENCH_SECONDS (default 5),
-BENCH_VARIANTS (xla|unroll|pallas|all, default "xla,pallas"),
+BENCH_VARIANTS (xla|remat|unroll|pallas|all, default "xla,remat,pallas"),
 BENCH_UNROLL (scan unroll factor for the unrolled variant, default 8),
 BENCH_ATTEMPTS (default 3), BENCH_TIMEOUT (per-attempt seconds, default
 600), BENCH_DEADLINE (overall wall-clock budget in seconds, default 210;
@@ -257,21 +257,14 @@ def _measure_backend(
         one_step = make_train_step(mae_clip)
         step = lambda s: one_step(s, x, y, key)
 
-    # Bounded timing passes (benchmarks.common.time_steps) — never an
-    # "enqueue for N wall-clock seconds, then block" loop: dispatch
-    # enqueue is far cheaper than device execution here, so wall-bounded
-    # submission can queue minutes of device work and the trailing
-    # block_until_ready blows the round's timeout (round 2 died to this).
-    from benchmarks.common import time_steps
+    # Bounded timing passes (benchmarks.common.time_carried_steps) —
+    # never an "enqueue for N wall-clock seconds, then block" loop:
+    # dispatch enqueue is far cheaper than device execution here, so
+    # wall-bounded submission can queue minutes of device work and the
+    # trailing drain blows the round's timeout (round 2 died to this).
+    from benchmarks.common import time_carried_steps
 
-    class _Box:  # thread donated state through time_steps
-        s = state
-
-    def timed_step():
-        _Box.s, m = step(_Box.s)
-        return m
-
-    n, elapsed = time_steps(timed_step, seconds=seconds, block=lambda m: m)
+    n, elapsed = time_carried_steps(step, state, seconds)
     return batch * scan * n / elapsed
 
 

@@ -14,7 +14,7 @@ sys.path.insert(0, ".")
 import jax
 import numpy as np
 
-from benchmarks.common import emit, time_steps
+from benchmarks.common import emit, time_carried_steps
 from tpuflow.core.gilbert import gilbert_flow
 from tpuflow.data.splits import random_split
 from tpuflow.data.synthetic import generate_wells, wells_to_table
@@ -39,18 +39,15 @@ def main(seed: int = 0) -> None:
     g = jnp.asarray(np.tile(table["glr"], 16))
     # Chain each dispatch on the previous result (`+ 0*prev`, free next to
     # the transcendentals) so the final drain transitively drains the
-    # whole pass — time_steps' contract; an unchained pure fn would leave
-    # n-1 dispatches un-synced on the relay backend.
+    # whole pass; an unchained pure fn would leave n-1 dispatches
+    # un-synced on the relay backend (see common.time_carried_steps).
     f = jax.jit(lambda p, c, g, prev: gilbert_flow(p, c, g) + 0.0 * prev)
 
-    class _Box:
-        out = jnp.zeros_like(p)
+    def step(prev):
+        out = f(p, c, g, prev)
+        return out, out
 
-    def step():
-        _Box.out = f(p, c, g, _Box.out)
-        return _Box.out
-
-    steps, elapsed = time_steps(step, seconds=2.0, block=lambda o: o)
+    steps, elapsed = time_carried_steps(step, jnp.zeros_like(p), 2.0)
     emit(
         "gilbert_baseline",
         "predict_throughput",

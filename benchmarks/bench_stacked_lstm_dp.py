@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from benchmarks.common import emit, time_steps, time_train_steps
+from benchmarks.common import emit, time_carried_steps, time_train_steps
 from tpuflow.models import LSTMRegressor
 from tpuflow.parallel import (
     epoch_sharding,
@@ -87,14 +87,9 @@ def main() -> None:
     epoch = make_dp_epoch_step(mesh)
     key = jax.random.PRNGKey(0)
 
-    class _Box:  # thread donated state through time_steps
-        s = state
-
-    def step():
-        _Box.s, loss = epoch(_Box.s, xs_d, ys_d, key)
-        return loss
-
-    steps, elapsed = time_steps(step, seconds=seconds, block=lambda l: l)
+    steps, elapsed = time_carried_steps(
+        lambda s: epoch(s, xs_d, ys_d, key), state, seconds
+    )
     total = Bs * scan * steps / elapsed
     emit(
         "stacked_lstm_dp",

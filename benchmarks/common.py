@@ -31,23 +31,29 @@ maybe_pin_cpu()
 
 def lstm_variants() -> dict[str, dict]:
     """The LSTM recurrence variants the benchmarks race: plain XLA scan,
-    the same scan unrolled (BENCH_UNROLL, default 8, clamped >= 2), and
-    the fused Pallas kernel. One definition shared by bench.py and
-    bench_lstm64.py so the north-star and per-variant benches can't drift.
+    the gate-remat scan, the same scan unrolled (BENCH_UNROLL, default 8,
+    clamped >= 2), and the fused Pallas kernel. One definition shared by
+    bench.py and bench_lstm64.py so the north-star and per-variant
+    benches can't drift.
 
-    BENCH_VARIANTS selects which ones run (comma list of xla|unroll|pallas,
-    or "all"). The default skips the unrolled scan: on the remote-compile
-    TPU backend its 16-step-scan x unrolled-recurrence program costs
-    minutes of compile and has measured slower than the plain scan — a
-    risk to the round's timeout, not a contender.
+    BENCH_VARIANTS selects which ones run (comma list of
+    xla|remat|unroll|pallas, or "all"). The default skips the unrolled
+    scan: on the remote-compile TPU backend its 16-step-scan x
+    unrolled-recurrence program costs minutes of compile and has
+    measured slower than the plain scan — a risk to the round's timeout,
+    not a contender.
     """
     unroll = max(int(os.environ.get("BENCH_UNROLL", 8)), 2)
     all_variants = {
         "xla": {},
+        # Gate-remat scan: recompute gate activations in backward instead
+        # of storing them — the direct lever on the measured HBM bound
+        # (round 5: 13.6% MFU at 63% HBM util on the plain scan).
+        "remat": {"remat": True},
         "unroll": {"unroll": unroll},
         "pallas": {"backend": "pallas"},
     }
-    sel = os.environ.get("BENCH_VARIANTS", "xla,pallas").strip()
+    sel = os.environ.get("BENCH_VARIANTS", "xla,remat,pallas").strip()
     if sel == "all":
         names = list(all_variants)
     else:
@@ -113,25 +119,30 @@ def drain(value) -> None:
     jax.device_get(value)
 
 
-def time_steps(step_fn, *args, seconds: float = 5.0, block) -> tuple[int, float]:
-    """Time ``step_fn(*args)`` after a warmup call; returns (steps,
-    elapsed) of one bounded, fully-drained pass. ``block`` extracts the
-    value (data-dependent on the step) that ``drain`` transfers to force
-    completion.
+def time_carried_steps(step, init_carry, seconds: float = 5.0, block=None):
+    """Time ``carry, out = step(carry)`` passes; returns (steps, elapsed)
+    of one bounded pass, drained via a real transfer of the LAST step's
+    ``block(out)`` (default: ``out`` itself).
 
-    CONTRACT: only the LAST step's value is transferred, so each
-    ``step_fn`` call must be data-dependent on the previous one (thread
-    a carry/state through, like time_train_steps does) — otherwise the
-    first n-1 dispatches of a pass are never synced and the timing is
-    bogus on backends where block_until_ready lies (see ``drain``)."""
-    out = step_fn(*args)
+    The carry is threaded by construction, so every step in a pass is
+    data-dependent on the previous one and the final ``drain`` provably
+    synchronizes the whole pass — the only drain that works on backends
+    where block_until_ready lies (see ``drain``). This is the ONE timing
+    entry point; don't time unchained pure dispatches.
+    """
+    if block is None:
+        block = lambda out: out
+    carry, out = step(init_carry)  # warmup (compile) outside the timing
     drain(block(out))
+    box = [carry]
 
     def run_n(n: int) -> float:
+        carry = box[0]
         t0 = time.perf_counter()
         for _ in range(n):
-            out = step_fn(*args)
+            carry, out = step(carry)
         drain(block(out))
+        box[0] = carry
         return time.perf_counter() - t0
 
     return _timed_passes(run_n, seconds)
@@ -143,17 +154,6 @@ def time_train_steps(state, step, x, y, seconds: float = 5.0):
     import jax
 
     key = jax.random.PRNGKey(0)
-    state, m = step(state, x, y, key)
-    drain(m["loss"])
-    carry = [state]
-
-    def run_n(n: int) -> float:
-        state = carry[0]
-        t0 = time.perf_counter()
-        for _ in range(n):
-            state, m = step(state, x, y, key)
-        drain(m["loss"])
-        carry[0] = state
-        return time.perf_counter() - t0
-
-    return _timed_passes(run_n, seconds)
+    return time_carried_steps(
+        lambda s: step(s, x, y, key), state, seconds, block=lambda m: m["loss"]
+    )

@@ -37,7 +37,7 @@ maybe_pin_cpu()
 import jax
 import numpy as np
 
-from benchmarks.common import drain, emit, time_steps
+from benchmarks.common import drain, emit, time_carried_steps
 
 WINDOW, FEATURES, HIDDEN = 24, 5, 64
 
@@ -56,7 +56,6 @@ def build_step(batch: int, scan: int):
     rng = np.random.default_rng(0)
     x_np = rng.standard_normal((batch, WINDOW, FEATURES)).astype(np.float32)
     y_np = rng.standard_normal((batch, WINDOW)).astype(np.float32)
-    state = create_state(model, jax.random.PRNGKey(0), x_np[:2])
     key = jax.random.PRNGKey(0)
     if scan > 1:
         xs = jnp.asarray(np.broadcast_to(x_np, (scan,) + x_np.shape))
@@ -68,14 +67,12 @@ def build_step(batch: int, scan: int):
         one = make_train_step(mae_clip)
         step = lambda s: one(s, x, y, key)
 
-    class Box:
-        s = state
+    def fresh_state():
+        # Fresh state per timing/trace run: the train step donates its
+        # state buffers, so a consumed carry must never be reused.
+        return create_state(model, jax.random.PRNGKey(0), x_np[:2])
 
-    def timed():
-        Box.s, m = step(Box.s)
-        return m
-
-    return timed
+    return step, fresh_state
 
 
 def main() -> int:
@@ -90,8 +87,8 @@ def main() -> int:
     results: dict[str, float] = {}
     for cfg in args.configs.split(","):
         batch, scan = (int(v) for v in cfg.strip().split("x"))
-        timed = build_step(batch, scan)
-        n, elapsed = time_steps(timed, seconds=args.seconds, block=lambda m: m)
+        step, fresh_state = build_step(batch, scan)
+        n, elapsed = time_carried_steps(step, fresh_state(), args.seconds)
         sps = batch * scan * n / elapsed
         results[cfg] = sps
         emit(
@@ -102,7 +99,7 @@ def main() -> int:
         if not args.no_trace:
             tdir = os.path.join(args.trace_root, cfg.strip())
             jax.profiler.start_trace(tdir)
-            out = timed()
+            _, out = step(fresh_state())
             drain(out)
             jax.profiler.stop_trace()
             print(f"# trace: {tdir}", flush=True)

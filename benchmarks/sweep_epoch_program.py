@@ -39,7 +39,7 @@ WINDOW, FEATURES, HIDDEN = 24, 5, 64
 
 def throughput(program: str, batch: int, scan: int, seconds: float) -> float:
     """Samples/sec of K train steps as K dispatches vs one scanned one."""
-    from benchmarks.common import time_steps
+    from benchmarks.common import time_carried_steps
     from tpuflow.core.losses import mae_clip
     from tpuflow.models import LSTMRegressor
     from tpuflow.train import create_state, make_train_step
@@ -67,14 +67,7 @@ def throughput(program: str, batch: int, scan: int, seconds: float) -> float:
                 s, m = one(s, x, y, key)
             return s, m
 
-    class _Box:
-        s = state
-
-    def timed():
-        _Box.s, m = step(_Box.s)
-        return m
-
-    n, elapsed = time_steps(timed, seconds=seconds, block=lambda m: m)
+    n, elapsed = time_carried_steps(step, state, seconds)
     return batch * scan * n / elapsed
 
 

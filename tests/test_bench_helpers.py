@@ -44,12 +44,15 @@ class TestBenchConfigs:
 class TestLstmVariants:
     def test_default_skips_unroll(self, monkeypatch):
         monkeypatch.delenv("BENCH_VARIANTS", raising=False)
-        assert list(lstm_variants()) == ["xla", "pallas"]
+        assert list(lstm_variants()) == ["xla", "remat", "pallas"]
+        assert lstm_variants()["remat"] == {"remat": True}
 
     def test_all(self, monkeypatch):
         monkeypatch.setenv("BENCH_VARIANTS", "all")
         monkeypatch.setenv("BENCH_UNROLL", "4")
-        assert list(lstm_variants()) == ["xla", "xla_unroll4", "pallas"]
+        assert list(lstm_variants()) == [
+            "xla", "remat", "xla_unroll4", "pallas"
+        ]
         assert lstm_variants()["xla_unroll4"] == {"unroll": 4}
 
     def test_unknown_variant_rejected(self, monkeypatch):

@@ -136,3 +136,37 @@ def test_lstm_unroll_matches_plain_scan():
     y1 = plain.apply({"params": params}, x)
     y2 = unrolled.apply({"params": params}, x)
     np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), atol=1e-6)
+
+
+def test_lstm_remat_matches_plain_scan_and_grads():
+    """remat is a pure scheduling knob (recompute vs store in backward):
+    forward outputs and parameter gradients must match the plain scan."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpuflow.models import LSTMRegressor
+
+    x = jnp.asarray(
+        np.random.default_rng(1).standard_normal((4, 23, 5)), jnp.float32
+    )
+    y = jnp.asarray(
+        np.random.default_rng(2).standard_normal((4, 23)), jnp.float32
+    )
+    plain = LSTMRegressor(hidden=16)
+    remat = LSTMRegressor(hidden=16, remat=True)
+    params = plain.init(jax.random.PRNGKey(0), x)["params"]
+
+    def loss(model, p):
+        return jnp.mean((model.apply({"params": p}, x) - y) ** 2)
+
+    v1, g1 = jax.value_and_grad(lambda p: loss(plain, p))(params)
+    v2, g2 = jax.value_and_grad(lambda p: loss(remat, p))(params)
+    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6
+        ),
+        g1,
+        g2,
+    )

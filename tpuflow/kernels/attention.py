@@ -64,10 +64,18 @@ def _interpret() -> bool:
 
 
 def _block(T: int) -> int:
-    """Query/key block length: 128 MXU-friendly rows, or the (8-aligned)
-    whole sequence when it is shorter."""
-    if T >= 128:
-        return 128
+    """Query/key block length, or the (8-aligned) whole sequence when it
+    is shorter. Default 256: the round-5 on-chip timing showed the
+    128-row kernel neither HBM- nor MXU-bound (2.7% HBM util, 1.7% MFU)
+    — serialization-bound on too-small inner matmuls — so bigger tiles
+    put more arithmetic on the MXU per online-softmax iteration.
+    TPUFLOW_FLASH_BLOCK overrides for on-chip sweeps."""
+    import os
+
+    blk = max(int(os.environ.get("TPUFLOW_FLASH_BLOCK", 256)), 8)
+    blk = -(-blk // 8) * 8  # Mosaic sublane rule: blocks must be 8-aligned
+    if T >= blk:
+        return blk
     return max(8, -(-T // 8) * 8)
 
 
@@ -78,12 +86,18 @@ def _pad_time(x: jnp.ndarray, Bt: int) -> jnp.ndarray:
     return x
 
 
-def _online_block_update(q, k_blk, v_blk, m, l, acc, allowed):
+def _online_block_update(q, k_blk, v_blk, scale, m, l, acc, allowed):
     """The flash forward recurrence for ONE (q-tile, kv-block) pair —
     the single source of the online-softmax math, shared by the
-    standalone kernel and the CP ring-round kernel. ``q`` arrives
-    pre-scaled; everything is f32."""
-    s = jax.lax.dot_general(
+    standalone kernel and the CP ring-round kernel.
+
+    ``q``/``k_blk``/``v_blk`` stay in their NATIVE dtype so bf16 inputs
+    ride the MXU's native mode with f32 accumulation (an all-f32 operand
+    matmul costs multiple MXU passes — the round-5 on-chip timing showed
+    the f32-everything kernel serialization-bound). ``scale`` applies to
+    the f32 scores, which keeps the softmax math and the VJP exact
+    regardless of operand dtype. Stats/accumulator are f32."""
+    s = scale * jax.lax.dot_general(
         q, k_blk, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
@@ -93,17 +107,17 @@ def _online_block_update(q, k_blk, v_blk, m, l, acc, allowed):
     corr = jnp.exp(m - m_new)
     l = l * corr + jnp.sum(p, axis=-1)
     acc = acc * corr[:, None] + jax.lax.dot_general(
-        p, v_blk, (((1,), (0,)), ((), ())),
+        p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
     return m_new, l, acc
 
 
-def _p_block(q, k_blk, lse, allowed):
+def _p_block(q, k_blk, scale, lse, allowed):
     """Backward-pass probabilities exp(s - lse) for one block pair —
     already FINAL softmax values (not running partials), so every
     block's contribution is correctly normalized independently."""
-    s = jax.lax.dot_general(
+    s = scale * jax.lax.dot_general(
         q, k_blk, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
@@ -111,27 +125,27 @@ def _p_block(q, k_blk, lse, allowed):
     return p * allowed.astype(jnp.float32)
 
 
-def _dq_block(q, k_blk, v_blk, do, lse, delta, allowed):
-    """One block pair's contribution to dQ (q pre-scaled; result needs
-    the final * scale applied by the caller)."""
-    p = _p_block(q, k_blk, lse, allowed)
+def _dq_block(q, k_blk, v_blk, do, scale, lse, delta, allowed):
+    """One block pair's contribution to dQ (the final * scale is applied
+    by the caller, once, outside the accumulation loop)."""
+    p = _p_block(q, k_blk, scale, lse, allowed)
     dp = jax.lax.dot_general(
         do, v_blk, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
     ds = p * (dp - delta[:, None])
     return jax.lax.dot_general(
-        ds, k_blk, (((1,), (0,)), ((), ())),
+        ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
 
 
-def _dkv_block(q, k_blk, v_blk, do, lse, delta, allowed):
-    """One block pair's contribution to (dK, dV). ``q`` arrives
-    pre-scaled, so dK needs no extra scale factor."""
-    p = _p_block(q, k_blk, lse, allowed)
+def _dkv_block(q, k_blk, v_blk, do, scale, lse, delta, allowed):
+    """One block pair's contribution to (dK, dV). dK carries the score
+    scale (dS/dK = scale * Q)."""
+    p = _p_block(q, k_blk, scale, lse, allowed)
     dv = jax.lax.dot_general(
-        p, do, (((0,), (0,)), ((), ())),
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
     dp = jax.lax.dot_general(
@@ -139,8 +153,8 @@ def _dkv_block(q, k_blk, v_blk, do, lse, delta, allowed):
         preferred_element_type=jnp.float32,
     )
     ds = p * (dp - delta[:, None])
-    dk = jax.lax.dot_general(
-        ds, q, (((0,), (0,)), ((), ())),
+    dk = scale * jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
     return dk, dv
@@ -151,7 +165,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, Bk):
     Bq, D = q_ref.shape[1], q_ref.shape[2]
     T = k_ref.shape[1]
     iq = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale  # [Bq, D]
+    q = q_ref[0]  # [Bq, D], native dtype (scale applies to the scores)
     q_pos = iq * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
 
     m0 = jnp.full((Bq,), _NEG, jnp.float32)
@@ -161,11 +175,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, Bk):
     n_kb = jnp.minimum((iq + 1) * Bq + Bk - 1, T) // Bk
 
     def body(kb, carry):
-        k_blk = k_ref[0, pl.ds(kb * Bk, Bk)].astype(jnp.float32)  # [Bk, D]
-        v_blk = v_ref[0, pl.ds(kb * Bk, Bk)].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(kb * Bk, Bk)]  # [Bk, D]
+        v_blk = v_ref[0, pl.ds(kb * Bk, Bk)]
         k_pos = kb * Bk + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 1)
         return _online_block_update(
-            q, k_blk, v_blk, *carry, k_pos <= q_pos
+            q, k_blk, v_blk, scale, *carry, k_pos <= q_pos
         )
 
     m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
@@ -181,19 +195,19 @@ def _dq_kernel(
     Bq, D = q_ref.shape[1], q_ref.shape[2]
     T = k_ref.shape[1]
     iq = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0][:, 0]
     delta = delta_ref[0][:, 0]
     q_pos = iq * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
     n_kb = jnp.minimum((iq + 1) * Bq + Bk - 1, T) // Bk
 
     def body(kb, dq):
-        k_blk = k_ref[0, pl.ds(kb * Bk, Bk)].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * Bk, Bk)].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(kb * Bk, Bk)]
+        v_blk = v_ref[0, pl.ds(kb * Bk, Bk)]
         k_pos = kb * Bk + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 1)
         return dq + _dq_block(
-            q, k_blk, v_blk, do, lse, delta, k_pos <= q_pos
+            q, k_blk, v_blk, do, scale, lse, delta, k_pos <= q_pos
         )
 
     dq = jax.lax.fori_loop(0, n_kb, body, jnp.zeros((Bq, D), jnp.float32))
@@ -208,21 +222,21 @@ def _dkv_kernel(
     Bk, D = k_ref.shape[1], k_ref.shape[2]
     T = q_ref.shape[1]
     ik = pl.program_id(1)
-    k_blk = k_ref[0].astype(jnp.float32)
-    v_blk = v_ref[0].astype(jnp.float32)
+    k_blk = k_ref[0]
+    v_blk = v_ref[0]
     k_pos = ik * Bk + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 1)
     nq = T // Bq
     first_qb = (ik * Bk) // Bq  # earlier query blocks are fully masked
 
     def body(qb, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(qb * Bq, Bq)].astype(jnp.float32) * scale
-        do = do_ref[0, pl.ds(qb * Bq, Bq)].astype(jnp.float32)
+        q = q_ref[0, pl.ds(qb * Bq, Bq)]
+        do = do_ref[0, pl.ds(qb * Bq, Bq)]
         lse = lse_ref[0, pl.ds(qb * Bq, Bq), 0]
         delta = delta_ref[0, pl.ds(qb * Bq, Bq), 0]
         q_pos = qb * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
         dk_p, dv_p = _dkv_block(
-            q, k_blk, v_blk, do, lse, delta, k_pos <= q_pos
+            q, k_blk, v_blk, do, scale, lse, delta, k_pos <= q_pos
         )
         return dk + dk_p, dv + dv_p
 
@@ -389,21 +403,21 @@ def _round_fwd_kernel(
     T = k_ref.shape[1]
     iq = pl.program_id(1)
     q_off, k_off = off_ref[0, 0], off_ref[0, 1]
-    q = q_ref[0].astype(jnp.float32) * scale
+    q = q_ref[0]
     q_pos = q_off + iq * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
     m = m_ref[0][:, 0].astype(jnp.float32)
     l = l_ref[0][:, 0].astype(jnp.float32)
     acc = acc_ref[0].astype(jnp.float32)
 
     def body(kb, carry):
-        k_blk = k_ref[0, pl.ds(kb * Bk, Bk)].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * Bk, Bk)].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(kb * Bk, Bk)]
+        v_blk = v_ref[0, pl.ds(kb * Bk, Bk)]
         k_idx = kb * Bk + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 1)
         # Padded K rows sit at global positions that ALIAS the next
         # block's territory — causality alone would admit them; mask by
         # the block's real length too.
         allowed = ((k_off + k_idx) <= q_pos) & (k_idx < real_len)
-        return _online_block_update(q, k_blk, v_blk, *carry, allowed)
+        return _online_block_update(q, k_blk, v_blk, scale, *carry, allowed)
 
     # Causal early-exit: sub-blocks wholly past this q-tile's last row
     # are never visited (~half of all device-rounds carry a fully-future
@@ -472,41 +486,43 @@ def _round_bwd_kernel(
     q_off, k_off = off_ref[0, 0], off_ref[0, 1]
 
     # --- dq for q-tile i: loop k sub-blocks of the visiting block ---
-    q = q_ref[0, pl.ds(i * Bt, Bt)].astype(jnp.float32) * scale
-    do = do_ref[0, pl.ds(i * Bt, Bt)].astype(jnp.float32)
+    q = q_ref[0, pl.ds(i * Bt, Bt)]
+    do = do_ref[0, pl.ds(i * Bt, Bt)]
     lse = lse_ref[0, pl.ds(i * Bt, Bt), 0]
     delta = delta_ref[0, pl.ds(i * Bt, Bt), 0]
     q_pos = q_off + i * Bt + jax.lax.broadcasted_iota(jnp.int32, (Bt, Bt), 0)
 
     def dq_body(kb, dq):
-        k_blk = k_ref[0, pl.ds(kb * Bt, Bt)].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * Bt, Bt)].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(kb * Bt, Bt)]
+        v_blk = v_ref[0, pl.ds(kb * Bt, Bt)]
         k_idx = kb * Bt + jax.lax.broadcasted_iota(jnp.int32, (Bt, Bt), 1)
         allowed = ((k_off + k_idx) <= q_pos) & (k_idx < real_len)
-        return dq + _dq_block(q, k_blk, v_blk, do, lse, delta, allowed)
+        return dq + _dq_block(q, k_blk, v_blk, do, scale, lse, delta, allowed)
 
     n_kb = jnp.clip((q_off + (i + 1) * Bt - 1 - k_off) // Bt + 1, 0, T // Bt)
     dq = jax.lax.fori_loop(0, n_kb, dq_body, jnp.zeros((Bt, D), jnp.float32))
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
     # --- dk/dv for k-tile i: loop q sub-blocks of the local chunk ---
-    k_t = k_ref[0, pl.ds(i * Bt, Bt)].astype(jnp.float32)
-    v_t = v_ref[0, pl.ds(i * Bt, Bt)].astype(jnp.float32)
+    k_t = k_ref[0, pl.ds(i * Bt, Bt)]
+    v_t = v_ref[0, pl.ds(i * Bt, Bt)]
     k_idx_t = i * Bt + jax.lax.broadcasted_iota(jnp.int32, (Bt, Bt), 1)
     k_valid_t = k_idx_t < real_len
     k_pos_t = k_off + k_idx_t
 
     def dkv_body(qb, carry):
         dk, dv = carry
-        q_b = q_ref[0, pl.ds(qb * Bt, Bt)].astype(jnp.float32) * scale
-        do_b = do_ref[0, pl.ds(qb * Bt, Bt)].astype(jnp.float32)
+        q_b = q_ref[0, pl.ds(qb * Bt, Bt)]
+        do_b = do_ref[0, pl.ds(qb * Bt, Bt)]
         lse_b = lse_ref[0, pl.ds(qb * Bt, Bt), 0]
         delta_b = delta_ref[0, pl.ds(qb * Bt, Bt), 0]
         q_pos_b = q_off + qb * Bt + jax.lax.broadcasted_iota(
             jnp.int32, (Bt, Bt), 0
         )
         allowed = (k_pos_t <= q_pos_b) & k_valid_t
-        dk_p, dv_p = _dkv_block(q_b, k_t, v_t, do_b, lse_b, delta_b, allowed)
+        dk_p, dv_p = _dkv_block(
+            q_b, k_t, v_t, do_b, scale, lse_b, delta_b, allowed
+        )
         return dk + dk_p, dv + dv_p
 
     # Causal early-exit: q sub-blocks wholly before this k-tile's first

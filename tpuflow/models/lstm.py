@@ -65,6 +65,13 @@ class LSTMLayer(nn.Module):
     # work barely covers the loop cost. Compile time grows with K; T must
     # not need to divide K (lax.scan handles the remainder).
     unroll: int = 1
+    # Rematerialize the gate math in backward instead of storing it: the
+    # train step measured HBM-BOUND on v5e (round 5: 13.6% MFU at 63% HBM
+    # util), and the stored per-step gate activations are the bulk of the
+    # residual traffic. jax.checkpoint on the scan body saves only the
+    # (h, c) carry per step and recomputes z/gates from it in backward —
+    # trading idle MXU FLOPs (~86% idle) for the saturated resource.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -93,13 +100,13 @@ class LSTMLayer(nn.Module):
 
             hs = lstm_scan(xw, w_h, b)
         else:
+            import jax
+
             h0 = jnp.zeros((B, H), dtype=dt)
-            (_, _), hs = lax.scan(
-                lambda carry, xw_t: lstm_step(carry, xw_t, w_h, b),
-                (h0, h0),
-                xw,
-                unroll=self.unroll,
-            )
+            step = lambda carry, xw_t: lstm_step(carry, xw_t, w_h, b)
+            if self.remat:
+                step = jax.checkpoint(step)
+            (_, _), hs = lax.scan(step, (h0, h0), xw, unroll=self.unroll)
         return jnp.swapaxes(hs, 0, 1)  # back to batch-major [B, T, H]
 
 
@@ -129,6 +136,7 @@ class GilbertResidualLSTM(nn.Module):
     dtype: Any = jnp.float32
     backend: str = "xla"  # "xla" | "pallas"
     unroll: int = 1  # lax.scan unroll for the XLA backend (see LSTMLayer)
+    remat: bool = False  # rematerialize gate math in backward (see LSTMLayer)
     target_mean: float = 0.0
     target_std: float = 1.0
 
@@ -144,6 +152,7 @@ class GilbertResidualLSTM(nn.Module):
                 dtype=self.dtype,
                 backend=self.backend,
                 unroll=self.unroll,
+                remat=self.remat,
                 name=f"lstm_{layer}",
             )(h)
         raw = nn.Dense(
@@ -174,6 +183,7 @@ class LSTMRegressor(nn.Module):
     dtype: Any = jnp.float32
     backend: str = "xla"  # "xla" | "pallas"
     unroll: int = 1  # lax.scan unroll for the XLA backend (see LSTMLayer)
+    remat: bool = False  # rematerialize gate math in backward (see LSTMLayer)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, deterministic: bool = True) -> jnp.ndarray:
@@ -183,6 +193,7 @@ class LSTMRegressor(nn.Module):
                 dtype=self.dtype,
                 backend=self.backend,
                 unroll=self.unroll,
+                remat=self.remat,
                 name=f"lstm_{layer}",
             )(x)
         y = nn.Dense(1, dtype=self.dtype, name="head")(x)[..., 0]  # [B, T]
