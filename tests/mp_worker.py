@@ -94,6 +94,9 @@ def main() -> None:
     if mode == "tp":
         _tp_mode(pid, total)
         return
+    if mode == "sp":
+        _sp_mode(pid, total)
+        return
 
     mesh = make_mesh()
 
@@ -164,6 +167,61 @@ def main() -> None:
                 "assembled_multi": jax.process_count() > 1,
                 "loss": float(metrics["loss"]),
                 "param_sum": param_sum,
+            }
+        ),
+        flush=True,
+    )
+
+
+def sp_problem(total: int):
+    """The ring-attention parity workload (q, k, v as numpy), shared by
+    the multi-process workers AND the single-process full-attention
+    reference so parity failures can only mean runtime divergence."""
+    import numpy as np
+
+    B, T, D = 2, 8 * total, 8  # T divides by the ring size
+    rng = np.random.default_rng(3)
+    return tuple(
+        (rng.standard_normal((B, T, D)) * 0.5).astype(np.float32)
+        for _ in range(3)
+    )
+
+
+def _sp_mode(pid: int, total: int) -> None:
+    """Ring attention (context parallelism) on the real multi-process
+    runtime: the time axis sharded across the processes' devices, KV
+    blocks riding ``ppermute`` across the PROCESS boundary each round,
+    and the ring's custom VJP carrying dK/dV home the same way — the
+    long-context story (SURVEY.md §5, ring/SP axis) executed with
+    ``jax.process_count() > 1``, value AND gradients."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from tpuflow.parallel import ring_attention
+    from tpuflow.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    axis = mesh.axis_names[0]
+    q, k, v = sp_problem(total)
+    sh = NamedSharding(mesh, P(None, axis, None))
+    qd, kd, vd = (jax.device_put(a, sh) for a in (q, k, v))
+
+    def loss(args):
+        return jnp.mean(jnp.square(ring_attention(mesh, *args)))
+
+    with jax.set_mesh(mesh):
+        val, grads = jax.value_and_grad(loss)((qd, kd, vd))
+        grad_sum = float(sum(jnp.sum(jnp.abs(g)) for g in grads))
+    print(
+        json.dumps(
+            {
+                "pid": pid,
+                "processes": jax.process_count(),
+                "mode": "sp",
+                "loss": float(val),
+                "grad_sum": grad_sum,
             }
         ),
         flush=True,
