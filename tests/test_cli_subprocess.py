@@ -50,3 +50,18 @@ def test_tiny_train_job_subprocess(tmp_path):
          "64", "--quiet"]
     )
     assert out.returncode == 0, out.stderr[-2000:]
+
+
+def test_model_kwargs_flag(tmp_path):
+    """--model-kwargs forwards a JSON dict to the model family; invalid
+    JSON fails fast with rc=2 before any data prep."""
+    out = _run(
+        ["--model", "static_mlp", "--model-kwargs", '{"hidden": [8, 8]}',
+         "--epochs", "1", "--batch-size", "64", "--devices", "1",
+         "--synthetic-wells", "2", "--synthetic-steps", "64", "--quiet"]
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+
+    bad = _run(["--model-kwargs", "{bad", "--quiet"])
+    assert bad.returncode == 2
+    assert "not valid JSON" in bad.stderr

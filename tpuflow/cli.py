@@ -29,6 +29,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data", default=None, help="headerless CSV data path (omit for synthetic wells)")
     p.add_argument("--well-column", default=None, help="column grouping CSV rows into per-well logs (sequence models)")
     p.add_argument("--model", default="lstm", help="static_mlp|dynamic_mlp|cnn1d|lstm|stacked_lstm")
+    p.add_argument("--model-kwargs", default=None, metavar="JSON",
+                   help='JSON dict forwarded to the model family, e.g. '
+                        '\'{"hidden": 128, "backend": "pallas", '
+                        '"remat": true}\'')
     p.add_argument("--epochs", type=int, default=1000)
     p.add_argument("--batch-size", type=int, default=20)
     p.add_argument("--patience", type=int, default=10)
@@ -94,6 +98,19 @@ def main(argv=None) -> int:
         return _predict_main(args)
     from tpuflow.api import TrainJobConfig, train
 
+    model_kwargs = {}
+    if args.model_kwargs:
+        import json
+
+        try:
+            model_kwargs = json.loads(args.model_kwargs)
+        except json.JSONDecodeError as e:
+            print(f"--model-kwargs is not valid JSON: {e}", file=sys.stderr)
+            return 2
+        if not isinstance(model_kwargs, dict):
+            print("--model-kwargs must be a JSON object", file=sys.stderr)
+            return 2
+
     config = TrainJobConfig(
         column_names=args.columnNames,
         column_types=args.columnTypes,
@@ -102,6 +119,7 @@ def main(argv=None) -> int:
         data_path=args.data,
         well_column=args.well_column,
         model=args.model,
+        model_kwargs=model_kwargs,
         max_epochs=args.epochs,
         batch_size=args.batch_size,
         patience=args.patience,
