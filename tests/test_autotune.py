@@ -151,3 +151,25 @@ class TestTrainIntegration:
         report = train(self._config(batch_size=20, jit_epoch=False))
         assert report.epoch_program == "per_batch"
         assert "explicit" in report.epoch_program_reason
+
+
+class TestCommittedSweepEntries:
+    """The REAL benchmarks/program_sweep.json (no fixture override): the
+    committed entries must stay schema-valid, or autotune silently falls
+    back to the heuristic on the devices we measured."""
+
+    def test_cpu_entry_resolves(self, monkeypatch):
+        monkeypatch.delenv("TPUFLOW_PROGRAM_SWEEP", raising=False)
+        measured = load_measured_crossover("cpu")
+        assert measured is not None
+        assert measured[0] == float("inf")  # scan_always on cpu
+
+    def test_tpu_v5lite_entry_resolves(self, monkeypatch):
+        """The round-5 on-chip entry: scanning wins at every batch on
+        'TPU v5 lite' (the running device kind over the relay)."""
+        monkeypatch.delenv("TPUFLOW_PROGRAM_SWEEP", raising=False)
+        measured = load_measured_crossover("TPU v5 lite")
+        assert measured is not None
+        assert measured[0] == float("inf")
+        c = choose_epoch_program(1024, device_kind="TPU v5 lite")
+        assert c.source == "measured" and c.jit_epoch

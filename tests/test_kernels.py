@@ -160,8 +160,8 @@ class TestFlashAttentionKernel:
 
     @pytest.mark.parametrize("T", [16, 24, 200])
     def test_forward_matches_full_attention(self, T):
-        """Exact causal parity, incl. multi-block (T=200 > 128) and
-        pad-to-block (T=24, 200) paths."""
+        """Exact causal parity, incl. the pad-to-block (T=24, 200)
+        paths (multi-block streaming: test_multi_block_streaming_path)."""
         from tpuflow.kernels import flash_attention
         from tpuflow.parallel.ring_attention import full_attention
 
@@ -174,8 +174,9 @@ class TestFlashAttentionKernel:
 
     @pytest.mark.parametrize("T", [32, 200])
     def test_gradients_match_full_attention(self, T):
-        """Incl. T=200: the padded multi-block backward path (dq/dkv
-        kernels run on 256 padded rows with lse/delta padding)."""
+        """Incl. T=200: the padded backward path (dq/dkv kernels run
+        on 256 padded rows with lse/delta padding; multi-block streaming
+        coverage lives in test_multi_block_streaming_path)."""
         from tpuflow.kernels import flash_attention
         from tpuflow.parallel.ring_attention import full_attention
 
@@ -187,6 +188,35 @@ class TestFlashAttentionKernel:
         for a, e, name in zip(g, gr, ["dq", "dk", "dv"]):
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(e), atol=1e-4, err_msg=name
+            )
+
+    def test_multi_block_streaming_path(self, monkeypatch):
+        """Force nk > 1 (TPUFLOW_FLASH_BLOCK=8, T=28): the 3D-grid
+        streaming path — scratch init at j=0, accumulation across KV/q
+        iterations, finalize at the last grid step — plus padding, for
+        forward AND all three gradients. The default 256-row block makes
+        every other test in this class single-block, so this is the only
+        coverage of the cross-iteration scratch persistence."""
+        import jax
+
+        from tpuflow.kernels import flash_attention
+        from tpuflow.parallel.ring_attention import full_attention
+
+        monkeypatch.setenv("TPUFLOW_FLASH_BLOCK", "8")
+        q, k, v = self._qkv(T=28, seed=5)
+
+        def loss_flash(a):
+            return jnp.sum(jnp.square(flash_attention(*a)))
+
+        def loss_full(a):
+            return jnp.sum(jnp.square(full_attention(*a, causal=True)))
+
+        vf, gf = jax.value_and_grad(loss_flash)((q, k, v))
+        vr, gr = jax.value_and_grad(loss_full)((q, k, v))
+        np.testing.assert_allclose(float(vf), float(vr), rtol=1e-5)
+        for a, b, name in zip(gf, gr, ["dq", "dk", "dv"]):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-4, err_msg=name
             )
 
     def test_padded_backward_with_extreme_scores_stays_finite(self):

@@ -5,22 +5,27 @@ LSTM recurrence, fused clipped-MAE). The XLA path
 (``tpuflow.parallel.ring_attention.full_attention``) materializes the
 [T, T] score matrix in HBM; this kernel never does:
 
-- the query axis tiles over the Pallas grid; for each query block the
-  kernel streams key/value blocks through the MXU, maintaining the
-  online-softmax running max/normalizer/accumulator in f32 — the
-  flash-attention recurrence, scores living only in VMEM/registers;
-- causal masking is applied per block from global positions, and key
-  blocks entirely above the diagonal are never visited (the work is
-  O(T^2/2), not O(T^2));
+- the (query-block, KV-block) pairs tile over a 3D Pallas grid with the
+  KV axis INNERMOST, so Pallas streams K/V tiles with double-buffered
+  DMA overlapped against compute; the online-softmax running
+  max/normalizer/accumulator lives in f32 VMEM scratch across the KV
+  iterations of one q-block — the flash-attention recurrence, scores
+  living only in VMEM/registers;
+- matmul operands stay in their NATIVE dtype (bf16 rides the MXU's
+  native mode) with f32 accumulation; the softmax scale applies to the
+  f32 scores, so the math is exact for any operand dtype;
+- causal masking is applied per block from global positions, and KV
+  blocks entirely above the diagonal skip compute (the compute is
+  O(T^2/2); their DMA still streams — the price of a static grid);
 - backward recomputes the probabilities blockwise from the saved
   logsumexp (rematerialisation over HBM residency, as in the LSTM
-  kernel): one kernel produces dQ, a second produces dK/dV, wired via
-  ``jax.custom_vjp``.
+  kernel): one kernel produces dQ (KV streaming), a second produces
+  dK/dV (q-side streaming), wired via ``jax.custom_vjp``.
 
-Whole K/V for one batch-head are VMEM-resident per grid cell, which caps
-this kernel at T around 10-20k for typical head dims — beyond that the
-time axis should shard across chips instead (``ring_attention`` /
-``examples/long_context_cp.py``). The two COMPOSE: the ring-round
+Only per-tile blocks are VMEM-resident, so the standalone kernel scales
+to long T on one chip; past one chip's HBM the time axis shards across
+chips instead (``ring_attention`` / ``examples/long_context_cp.py``).
+The two COMPOSE: the ring-round
 kernels at the bottom of this file run each CP ring round's block math
 blockwise in VMEM (``ring_attention(..., impl="flash")``) — ring
 outside, flash inside. The ring's custom VJP supplies differentiation,
@@ -57,6 +62,26 @@ _LANES = 8
 def _rows_to_lanes(x: jnp.ndarray) -> jnp.ndarray:
     """[..., T] row stats -> [..., T, _LANES] lane-broadcast layout."""
     return jnp.broadcast_to(x[..., None], (*x.shape, _LANES))
+
+
+def _tile_i(b, i, j):
+    """3D-grid index map: this operand rides the q-/k-side dim (1)."""
+    return (b, i, 0)
+
+
+def _tile_j(b, i, j):
+    """3D-grid index map: this operand STREAMS with the innermost dim."""
+    return (b, j, 0)
+
+
+def _btd(Bt, D, index):
+    """[*, Bt, D] tile spec for the 3D streaming grids."""
+    return pl.BlockSpec((1, Bt, D), index, memory_space=pltpu.VMEM)
+
+
+def _rows(Bt, index):
+    """[*, Bt, _LANES] lane-broadcast row-stat spec for the 3D grids."""
+    return pl.BlockSpec((1, Bt, _LANES), index, memory_space=pltpu.VMEM)
 
 
 def _interpret() -> bool:
@@ -160,94 +185,114 @@ def _dkv_block(q, k_blk, v_blk, do, scale, lse, delta, allowed):
     return dk, dv
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, Bk):
-    """One (batch-head, query-block) cell: stream causal K/V blocks."""
-    Bq, D = q_ref.shape[1], q_ref.shape[2]
-    T = k_ref.shape[1]
-    iq = pl.program_id(1)
-    q = q_ref[0]  # [Bq, D], native dtype (scale applies to the scores)
-    q_pos = iq * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, scale, nk,
+):
+    """One (batch-head, q-block, KV-block) grid cell.
 
-    m0 = jnp.full((Bq,), _NEG, jnp.float32)
-    l0 = jnp.zeros((Bq,), jnp.float32)
-    acc0 = jnp.zeros((Bq, D), jnp.float32)
-    # Causal: key blocks past this query block's last row never attend.
-    n_kb = jnp.minimum((iq + 1) * Bq + Bk - 1, T) // Bk
+    The KV axis is the INNERMOST grid dimension, so Pallas streams the
+    K/V blocks with double-buffered DMA overlapped against the
+    online-softmax compute — the serial in-kernel ``fori_loop`` version
+    this replaces measured neither HBM- nor MXU-bound on-chip (round 5),
+    i.e. stalled, with nothing overlapped. The running (m, l, acc) state
+    lives in VMEM scratch across the KV iterations of one q-block;
+    outputs are written on the last KV iteration. KV blocks wholly above
+    the causal diagonal skip compute (their DMA still streams — the
+    price of a static grid)."""
+    Bq = q_ref.shape[1]
+    Bk = k_ref.shape[1]
+    i, j = pl.program_id(1), pl.program_id(2)
 
-    def body(kb, carry):
-        k_blk = k_ref[0, pl.ds(kb * Bk, Bk)]  # [Bk, D]
-        v_blk = v_ref[0, pl.ds(kb * Bk, Bk)]
-        k_pos = kb * Bk + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 1)
-        return _online_block_update(
-            q, k_blk, v_blk, scale, *carry, k_pos <= q_pos
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: only KV blocks whose first row is <= this q-block's last.
+    @pl.when(j * Bk <= (i + 1) * Bq - 1)
+    def _compute():
+        q_pos = i * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
+        k_pos = j * Bk + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 1)
+        m, l, acc = _online_block_update(
+            q_ref[0], k_ref[0], v_ref[0], scale,
+            m_scr[:, 0], l_scr[:, 0], acc_scr[:, :],
+            k_pos <= q_pos,
         )
+        m_scr[:] = _rows_to_lanes(m)
+        l_scr[:] = _rows_to_lanes(l)
+        acc_scr[:] = acc
 
-    m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
-    l_safe = jnp.where(l == 0, 1.0, l)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = _rows_to_lanes(m + jnp.log(l_safe))
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_scr[:, 0]
+        l_safe = jnp.where(l == 0, 1.0, l)
+        o_ref[0] = (acc_scr[:, :] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = _rows_to_lanes(m_scr[:, 0] + jnp.log(l_safe))
 
 
 def _dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, Bk
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    *, scale, nk,
 ):
-    """dQ for one (batch-head, query-block): dq = scale * sum_k ds @ K."""
-    Bq, D = q_ref.shape[1], q_ref.shape[2]
-    T = k_ref.shape[1]
-    iq = pl.program_id(1)
-    q = q_ref[0]
-    do = do_ref[0]
-    lse = lse_ref[0][:, 0]
-    delta = delta_ref[0][:, 0]
-    q_pos = iq * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
-    n_kb = jnp.minimum((iq + 1) * Bq + Bk - 1, T) // Bk
+    """dQ, one (batch-head, q-block, STREAMED kv-block) grid cell:
+    dq = scale * sum_k ds @ K, accumulated in VMEM scratch across the
+    pipelined KV iterations (same streaming layout as the forward)."""
+    Bq = q_ref.shape[1]
+    Bk = k_ref.shape[1]
+    i, j = pl.program_id(1), pl.program_id(2)
 
-    def body(kb, dq):
-        k_blk = k_ref[0, pl.ds(kb * Bk, Bk)]
-        v_blk = v_ref[0, pl.ds(kb * Bk, Bk)]
-        k_pos = kb * Bk + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 1)
-        return dq + _dq_block(
-            q, k_blk, v_blk, do, scale, lse, delta, k_pos <= q_pos
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    @pl.when(j * Bk <= (i + 1) * Bq - 1)  # causal skip
+    def _compute():
+        q_pos = i * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
+        k_pos = j * Bk + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 1)
+        dq_scr[:] += _dq_block(
+            q_ref[0], k_ref[0], v_ref[0], do_ref[0], scale,
+            lse_ref[0][:, 0], delta_ref[0][:, 0], k_pos <= q_pos,
         )
 
-    dq = jax.lax.fori_loop(0, n_kb, body, jnp.zeros((Bq, D), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0] = (dq_scr[:, :] * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, scale, Bq,
+    dk_scr, dv_scr, *, scale, nq,
 ):
-    """dK/dV for one (batch-head, key-block): loop causal query blocks."""
-    Bk, D = k_ref.shape[1], k_ref.shape[2]
-    T = q_ref.shape[1]
-    ik = pl.program_id(1)
-    k_blk = k_ref[0]
-    v_blk = v_ref[0]
-    k_pos = ik * Bk + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 1)
-    nq = T // Bq
-    first_qb = (ik * Bk) // Bq  # earlier query blocks are fully masked
+    """dK/dV, one (batch-head, k-block, STREAMED q-block) grid cell:
+    the q/do/lse/delta tiles stream through the innermost grid dim while
+    (dk, dv) accumulate in VMEM scratch."""
+    Bk = k_ref.shape[1]
+    Bq = q_ref.shape[1]
+    i, j = pl.program_id(1), pl.program_id(2)  # i: k-tile, j: q-tile
 
-    def body(qb, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(qb * Bq, Bq)]
-        do = do_ref[0, pl.ds(qb * Bq, Bq)]
-        lse = lse_ref[0, pl.ds(qb * Bq, Bq), 0]
-        delta = delta_ref[0, pl.ds(qb * Bq, Bq), 0]
-        q_pos = qb * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
+    @pl.when(j == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    # Causal: q-tiles wholly before this k-tile contribute nothing.
+    @pl.when((j + 1) * Bq - 1 >= i * Bk)
+    def _compute():
+        k_pos = i * Bk + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 1)
+        q_pos = j * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
         dk_p, dv_p = _dkv_block(
-            q, k_blk, v_blk, do, scale, lse, delta, k_pos <= q_pos
+            q_ref[0], k_ref[0], v_ref[0], do_ref[0], scale,
+            lse_ref[0][:, 0], delta_ref[0][:, 0], k_pos <= q_pos,
         )
-        return dk + dk_p, dv + dv_p
+        dk_scr[:] += dk_p
+        dv_scr[:] += dv_p
 
-    dk, dv = jax.lax.fori_loop(
-        first_qb,
-        nq,
-        body,
-        (jnp.zeros((Bk, D), jnp.float32), jnp.zeros((Bk, D), jnp.float32)),
-    )
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(j == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:, :].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:, :].astype(dv_ref.dtype)
 
 
 def _specs_btd(Bt, D, whole_T):
@@ -287,19 +332,25 @@ def _fwd(q, k, v, scale):
     k_p = _pad_time(k, Bt)
     v_p = _pad_time(v, Bt)
     T = q_p.shape[1]
-    grid = (BH, T // Bt)
-    blk, whole = _specs_btd(Bt, D, T)
-
-    row_blk, _ = _row_specs(Bt, T)
+    nk = T // Bt
+    grid = (BH, T // Bt, nk)  # (batch-head, q-block, STREAMED kv-block)
+    q_blk = _btd(Bt, D, _tile_i)
+    kv_blk = _btd(Bt, D, _tile_j)
+    lse_blk = _rows(Bt, _tile_i)
 
     o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, Bk=Bt),
+        functools.partial(_fwd_kernel, scale=scale, nk=nk),
         grid=grid,
-        in_specs=[blk, whole, whole],
-        out_specs=[blk, row_blk],
+        in_specs=[q_blk, kv_blk, kv_blk],
+        out_specs=[q_blk, lse_blk],
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, D), q.dtype),
             jax.ShapeDtypeStruct((BH, T, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((Bt, _LANES), jnp.float32),
+            pltpu.VMEM((Bt, _LANES), jnp.float32),
+            pltpu.VMEM((Bt, D), jnp.float32),
         ],
         interpret=_interpret(),
     )(q_p, k_p, v_p)
@@ -326,29 +377,44 @@ def _bwd(q, k, v, o, lse, do, scale):
         # value so padded rows get p = exp(s - huge) = 0 exactly — a 0
         # pad could overflow exp(s) to inf and poison ds with inf * 0.
         lse = jnp.pad(lse, ((0, 0), (0, pad)), constant_values=-_NEG)
-    grid = (BH, T // Bt)
-    blk, whole = _specs_btd(Bt, D, T)
-    row_blk, row_whole = _row_specs(Bt, T)
+    n_t = T // Bt
     lse_l = _rows_to_lanes(lse)
     delta_l = _rows_to_lanes(delta)
+    btd = functools.partial(_btd, Bt, D)
+    rows = functools.partial(_rows, Bt)
 
+    # dQ: grid (batch-head, q-block, streamed kv-block) — q-side tiles
+    # ride dim 1, KV tiles stream through dim 2.
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, Bk=Bt),
-        grid=grid,
-        in_specs=[blk, whole, whole, blk, row_blk, row_blk],
-        out_specs=blk,
+        functools.partial(_dq_kernel, scale=scale, nk=n_t),
+        grid=(BH, n_t, n_t),
+        in_specs=[
+            btd(_tile_i), btd(_tile_j), btd(_tile_j), btd(_tile_i),
+            rows(_tile_i), rows(_tile_i),
+        ],
+        out_specs=btd(_tile_i),
         out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((Bt, D), jnp.float32)],
         interpret=_interpret(),
     )(q_p, k_p, v_p, do_p, lse_l, delta_l)
 
+    # dK/dV: grid (batch-head, k-block, streamed q-block) — k-side tiles
+    # ride dim 1, q-side tiles (q, do, lse, delta) stream through dim 2.
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, Bq=Bt),
-        grid=grid,
-        in_specs=[whole, blk, blk, whole, row_whole, row_whole],
-        out_specs=[blk, blk],
+        functools.partial(_dkv_kernel, scale=scale, nq=n_t),
+        grid=(BH, n_t, n_t),
+        in_specs=[
+            btd(_tile_j), btd(_tile_i), btd(_tile_i), btd(_tile_j),
+            rows(_tile_j), rows(_tile_j),
+        ],
+        out_specs=[btd(_tile_i), btd(_tile_i)],
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, D), q.dtype),
             jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((Bt, D), jnp.float32),
+            pltpu.VMEM((Bt, D), jnp.float32),
         ],
         interpret=_interpret(),
     )(q_p, k_p, v_p, do_p, lse_l, delta_l)
