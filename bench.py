@@ -59,11 +59,13 @@ Also embedded in the worker run:
   are correct on the real chip;
 - ``mfu`` / ``bound``: a FLOPs-per-step + bytes-per-step roofline model
   so the samples/sec number comes with "X% of peak, bound by Y";
-- ``attention``: on TPU, a flash-vs-XLA attention train-step timing at
-  BENCH_ATTN_T (default 1024) x BENCH_ATTN_BATCH (default 64) with
-  per-backend roofline context — run strictly AFTER the LSTM number and
-  parity are banked, so the long-context perf story lands automatically
-  on any live-relay run without ever risking the headline number.
+- ``attention``: on TPU, flash-vs-XLA attention train-step timings over
+  the BENCH_ATTN_T comma list (default "1024,4096", batch scaled to keep
+  tokens/step constant from BENCH_ATTN_BATCH at T=1024) with per-backend
+  roofline context — run strictly AFTER the LSTM number and parity are
+  banked, so the long-context perf story (including the flash-vs-full
+  crossover) lands automatically on any live-relay run without ever
+  risking the headline number.
 
 Env knobs: BENCH_CONFIGS (comma list of <batch>x<steps-per-dispatch>
 candidates swept per variant, default "1024x1,1024x16,4096x16" — 1024x1
@@ -268,13 +270,20 @@ def _measure_backend(
     return batch * scan * n / elapsed
 
 
-def _measure_attention(jax, seconds: float) -> dict:
+def _measure_attention(jax, seconds: float, time_left) -> dict:
     """Flash-vs-XLA attention train-step timing with roofline context —
     the long-context family's on-chip perf story, ridden on the same
     harness so a live relay lands it automatically. TPU only: off-chip
     the Pallas kernel runs in interpret mode and the timing is
     meaningless (benchmarks/bench_attention.py covers the labeled CPU
-    correctness-path numbers)."""
+    correctness-path numbers).
+
+    BENCH_ATTN_T is a comma list (default "1024,4096"): the flash-vs-full
+    crossover is the long-context family's actual claim, so one T can't
+    tell the story. The total token count per step is held roughly
+    constant by shrinking the batch as T grows (BENCH_ATTN_BATCH sets the
+    batch at T=1024); later entries are budget-guarded, so a short
+    deadline still banks the first T."""
     from benchmarks.bench_attention import step_throughput
     from tpuflow.utils.roofline import (
         attention_bytes_per_sample_step,
@@ -282,21 +291,35 @@ def _measure_attention(jax, seconds: float) -> dict:
         roofline_report,
     )
 
-    T = max(int(os.environ.get("BENCH_ATTN_T", 1024)), 8)
-    batch = max(int(os.environ.get("BENCH_ATTN_BATCH", 64)), 1)
+    seq_lens = [
+        max(int(t), 8)
+        for t in os.environ.get("BENCH_ATTN_T", "1024,4096").split(",")
+    ]
+    batch_at_1024 = max(int(os.environ.get("BENCH_ATTN_BATCH", 64)), 1)
     device_kind = getattr(jax.devices()[0], "device_kind", "unknown")
-    flops = attention_flops_per_sample_step(T, F=5, D=64, layers=2)
-    out: dict = {"seq_len": T, "batch": batch}
-    for backend, score_heads in (("full", 4), ("flash", 0)):
-        sps = step_throughput(backend, batch, T, seconds)
-        bytes_ = attention_bytes_per_sample_step(
-            T, D=64, layers=2, itemsize=2, score_heads=score_heads
-        )
-        out[backend] = {
-            "samples_per_sec": round(sps, 1),
-            "tokens_per_sec": round(sps * T),
-            **roofline_report(sps, flops, bytes_, device_kind),
-        }
+    out: dict = {}
+    for T in seq_lens:
+        if out and time_left() < 4 * seconds + 30:
+            out[f"T{T}"] = "SKIPPED: worker deadline"
+            continue
+        batch = max(batch_at_1024 * 1024 // T, 1)
+        flops = attention_flops_per_sample_step(T, F=5, D=64, layers=2)
+        entry: dict = {"batch": batch}
+        for backend, score_heads in (("full", 4), ("flash", 0)):
+            try:
+                sps = step_throughput(backend, batch, T, seconds)
+            except Exception as e:
+                entry[backend] = f"ERROR: {type(e).__name__}: {str(e)[:200]}"
+                continue
+            bytes_ = attention_bytes_per_sample_step(
+                T, D=64, layers=2, itemsize=2, score_heads=score_heads
+            )
+            entry[backend] = {
+                "samples_per_sec": round(sps, 1),
+                "tokens_per_sec": round(sps * T),
+                **roofline_report(sps, flops, bytes_, device_kind),
+            }
+        out[f"T{T}"] = entry
     return out
 
 
@@ -441,7 +464,7 @@ def worker() -> None:
         attention = "SKIPPED: off-chip (see benchmarks/results.json)"
     elif time_left() > 4 * seconds + 30:
         try:
-            attention = _measure_attention(jax, seconds)
+            attention = _measure_attention(jax, seconds, time_left)
         except Exception as e:
             attention = f"ERROR: {type(e).__name__}: {str(e)[:300]}"
     else:

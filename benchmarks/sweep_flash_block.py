@@ -1,0 +1,101 @@
+"""On-chip flash-attention block-size sweep: pick TPUFLOW_FLASH_BLOCK.
+
+Races the flash backend's train-step throughput across block sizes
+(default 128,256,512) and sequence lengths (default 1024,4096), with the
+XLA full-softmax backend timed once per T as the yardstick. Each block
+size runs in a FRESH SUBPROCESS: ``tpuflow.kernels.attention._block``
+reads TPUFLOW_FLASH_BLOCK at trace time, but jax.jit caches compiled
+programs by shapes only — an in-process sweep would silently reuse the
+first block's program for every "different" setting.
+
+Emits one JSON line per (T, block) and merges a summary into
+``benchmarks/results.json`` via benchmarks.common.emit records on
+stdout (pipe through ``benchmarks/run_all.py --only sweep_flash_block``
+to merge, or read the lines directly). TPU only by design: interpret
+mode timings are meaningless.
+
+Usage:
+    python benchmarks/sweep_flash_block.py [--blocks 128,256,512]
+        [--seq-lens 1024,4096] [--batch-at-1024 64] [--seconds 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, ".")
+
+
+def worker(T: int, batch: int, backend: str, seconds: float) -> None:
+    """One measurement in this process's env (TPUFLOW_FLASH_BLOCK set by
+    the parent for flash runs)."""
+    import jax
+
+    from benchmarks.bench_attention import step_throughput
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"error": "not on TPU; refusing to time "
+                          "interpret-mode Pallas"}), flush=True)
+        return
+    sps = step_throughput(backend, batch, T, seconds)
+    print(json.dumps({"samples_per_sec": round(sps, 1),
+                      "tokens_per_sec": round(sps * T)}), flush=True)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--blocks", default="128,256,512")
+    p.add_argument("--seq-lens", default="1024,4096")
+    p.add_argument("--batch-at-1024", type=int, default=64)
+    p.add_argument("--seconds", type=float, default=4.0)
+    p.add_argument("--timeout", type=float, default=420.0,
+                   help="per-subprocess kill timeout (a wedged relay "
+                        "must not hang the whole sweep)")
+    args = p.parse_args()
+
+    from benchmarks.common import emit
+
+    def run_one(T: int, batch: int, backend: str, block: int | None):
+        env = dict(os.environ)
+        if block is not None:
+            env["TPUFLOW_FLASH_BLOCK"] = str(block)
+        cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+               str(T), str(batch), backend, str(args.seconds)]
+        try:
+            out = subprocess.run(
+                cmd, capture_output=True, text=True, env=env,
+                timeout=args.timeout,
+                cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            )
+            line = [l for l in out.stdout.splitlines() if l.startswith("{")]
+            return json.loads(line[-1]) if line else {
+                "error": f"rc={out.returncode}: {out.stderr[-200:]}"}
+        except subprocess.TimeoutExpired:
+            return {"error": f"timeout after {args.timeout:.0f}s"}
+
+    for T in (int(t) for t in args.seq_lens.split(",")):
+        batch = max(args.batch_at_1024 * 1024 // T, 1)
+        full = run_one(T, batch, "full", None)
+        emit("flash_block_sweep", f"full_T{T}",
+             full.get("samples_per_sec", -1.0), "samples/sec/chip",
+             batch=batch, **({"error": full["error"]} if "error" in full else {}))
+        for block in (int(b) for b in args.blocks.split(",")):
+            rec = run_one(T, batch, "flash", block)
+            emit("flash_block_sweep", f"flash_T{T}_B{block}",
+                 rec.get("samples_per_sec", -1.0), "samples/sec/chip",
+                 batch=batch, block=block,
+                 **({"error": rec["error"]} if "error" in rec else {}))
+    return 0
+
+
+if __name__ == "__main__":
+    if "--worker" in sys.argv:
+        i = sys.argv.index("--worker")
+        worker(int(sys.argv[i + 1]), int(sys.argv[i + 2]),
+               sys.argv[i + 3], float(sys.argv[i + 4]))
+    else:
+        sys.exit(main())
