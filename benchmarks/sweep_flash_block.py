@@ -8,11 +8,10 @@ reads TPUFLOW_FLASH_BLOCK at trace time, but jax.jit caches compiled
 programs by shapes only — an in-process sweep would silently reuse the
 first block's program for every "different" setting.
 
-Emits one JSON line per (T, block) and merges a summary into
-``benchmarks/results.json`` via benchmarks.common.emit records on
-stdout (pipe through ``benchmarks/run_all.py --only sweep_flash_block``
-to merge, or read the lines directly). TPU only by design: interpret
-mode timings are meaningless.
+Emits one benchmarks.common.emit JSON line per (T, block) on stdout —
+read them directly (this tool is not in run_all.py's merge set; its
+records are a tuning aid, not an accuracy/perf baseline). TPU only by
+design: interpret mode timings are meaningless.
 
 Usage:
     python benchmarks/sweep_flash_block.py [--blocks 128,256,512]

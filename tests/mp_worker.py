@@ -16,10 +16,14 @@ Modes:
   steps per dispatch with the pmean inside ``lax.scan``), each process
   feeding only its dim-1 slice — the production ``jit_epoch`` DP path
   run on a real multi-process runtime.
-- ``tp``: one tensor-parallel train step through ``train(config)``'s
-  own multi-host feeding path primitives — a (data, model) mesh
-  spanning the processes, megatron-sharded params, per-process batch
-  slices assembled over the data axis.
+- ``tp`` / ``pp`` / ``ep``: a full model-axis ``train(config)`` run —
+  a (data, model) mesh spanning the processes, model-sharded params
+  (megatron columns / pipeline stages / expert banks), per-process
+  batch slices assembled over the data axis, the whole fit loop with
+  ``jax.process_count() > 1``.
+- ``sp``: ring attention with gradients, the time axis sharded across
+  the processes' devices (KV blocks ppermute over the process
+  boundary).
 - ``fit``: a small ``train(config)`` run — the whole fit loop on the
   multi-host runtime, with optional fault injection / resume driven by
   env vars (``MP_STORAGE``, ``MP_FAULT_EPOCH``, ``MP_RESUME``): the
@@ -46,10 +50,10 @@ TOTAL_DEVICES = 2
 
 def total_devices(nprocs: int, mode: str = "step") -> int:
     """Mesh size for an nprocs gang: 1 device per process past the
-    original 2-process/2-device shape; the TP mode needs 2 devices per
-    process (each process must cover whole data rows of a model=2
-    mesh)."""
-    if mode == "tp":
+    original 2-process/2-device shape; the model-axis modes (tp/pp/ep)
+    need 2 devices per process (each process must cover whole data rows
+    of a model=2 mesh)."""
+    if mode in ("tp", "pp", "ep"):
         return 2 * nprocs
     return max(TOTAL_DEVICES, nprocs)
 
@@ -91,8 +95,8 @@ def main() -> None:
     if mode == "fit":
         _fit_mode(pid)
         return
-    if mode == "tp":
-        _tp_mode(pid, total)
+    if mode in ("tp", "pp", "ep"):
+        _model_axis_mode(pid, total, mode)
         return
     if mode == "sp":
         _sp_mode(pid, total)
@@ -228,15 +232,23 @@ def _sp_mode(pid: int, total: int) -> None:
     )
 
 
-def tp_job_config(total: int):
-    """The TP parity workload, shared by the multi-host workers AND the
-    single-process reference (tests/test_multiprocess.py) so the parity
-    comparison can never drift into config skew."""
+def axis_job_config(total: int, mode: str):
+    """The model-axis parity workload (tp/pp/ep), shared by the
+    multi-host workers AND the single-process reference
+    (tests/test_multiprocess.py) so the parity comparison can never
+    drift into config skew. Each mode uses its strategy's model family
+    on an identical training recipe."""
     from tpuflow.api import TrainJobConfig
 
+    family = {
+        "tp": ("static_mlp", {"hidden": (16, 16)}),
+        "pp": ("pipeline_mlp", {"stages": 2, "hidden": 16}),
+        "ep": ("moe_mlp", {"experts": 4, "hidden": 16, "ffn": 32}),
+    }
+    model, model_kwargs = family[mode]
     return TrainJobConfig(
-        model="static_mlp",
-        model_kwargs={"hidden": (16, 16)},
+        model=model,
+        model_kwargs=model_kwargs,
         max_epochs=2,
         batch_size=32,
         synthetic_wells=2,
@@ -245,27 +257,33 @@ def tp_job_config(total: int):
         verbose=False,
         jit_epoch=False,
         n_devices=total,
-        tp=2,
+        **{mode: 2},
     )
 
 
-def _tp_mode(pid: int, total: int) -> None:
-    """Multi-host TENSOR-PARALLEL training through train(config) itself:
-    the TP branch's per-process feeding recipe (process_batch_bounds
-    slices assembled over the TP mesh's data axis) runs the WHOLE fit
-    loop with jax.process_count() > 1 and megatron-sharded params
-    spanning the processes — the product path, not just primitives."""
+def tp_job_config(total: int):
+    """Back-compat alias for the TP workload."""
+    return axis_job_config(total, "tp")
+
+
+def _model_axis_mode(pid: int, total: int, mode: str) -> None:
+    """Multi-host model-axis training (tp/pp/ep) through train(config)
+    itself: the strategy branch's per-process feeding recipe
+    (process_batch_bounds slices assembled over the mesh's data axis)
+    runs the WHOLE fit loop with jax.process_count() > 1 and
+    model-sharded params spanning the processes — the product path, not
+    just primitives."""
     import jax
 
     from tpuflow.api import train
 
-    report = train(tp_job_config(total))
+    report = train(axis_job_config(total, mode))
     print(
         json.dumps(
             {
                 "pid": pid,
                 "processes": jax.process_count(),
-                "mode": "tp",
+                "mode": mode,
                 "losses": [h["loss"] for h in report.result.history],
                 "val_losses": [h["val_loss"] for h in report.result.history],
                 "test_loss": float(report.test_loss),

@@ -210,16 +210,17 @@ def test_four_process_scanned_epoch_matches_single_process(tmp_path):
     assert multi[0]["param_sum"] == pytest.approx(single["param_sum"], rel=1e-6)
 
 
-def _inline_tp_reference(total: int) -> dict:
-    """mp_worker mode=tp single-process: the same train(config) TP run
-    on this process's identically-shaped mesh — the multi-host run must
-    reproduce the whole trajectory. The config comes from the SAME
-    factory the workers use (tests.mp_worker.tp_job_config), so parity
-    failures can only mean runtime divergence, never config skew."""
-    from tests.mp_worker import tp_job_config
+def _inline_axis_reference(total: int, mode: str) -> dict:
+    """mp_worker's model-axis mode, single-process: the same
+    train(config) run on this process's identically-shaped mesh — the
+    multi-host run must reproduce the whole trajectory. The config comes
+    from the SAME factory the workers use
+    (tests.mp_worker.axis_job_config), so parity failures can only mean
+    runtime divergence, never config skew."""
+    from tests.mp_worker import axis_job_config
     from tpuflow.api import train
 
-    report = train(tp_job_config(total))
+    report = train(axis_job_config(total, mode))
     return {
         "losses": [h["loss"] for h in report.result.history],
         "val_losses": [h["val_loss"] for h in report.result.history],
@@ -228,19 +229,24 @@ def _inline_tp_reference(total: int) -> dict:
 
 
 @pytest.mark.slow
-def test_two_process_tp_train_matches_single_process(tmp_path):
-    """Multi-host TENSOR-PARALLEL training through train(config),
-    executed for real: two processes, each owning one whole data-axis
-    row of a (2, 2) mesh, feed per-process batch slices assembled over
-    the data axis while the megatron-sharded params span both processes
-    — per-epoch trajectory parity with the single-process TP run."""
+@pytest.mark.parametrize("mode", ["tp", "pp", "ep"])
+def test_two_process_model_axis_train_matches_single_process(
+    tmp_path, mode
+):
+    """Multi-host MODEL-AXIS training through train(config), executed
+    for real for every strategy: two processes, each owning one whole
+    data-axis row of a (2, 2) mesh, feed per-process batch slices
+    assembled over the data axis while the model-sharded params
+    (megatron columns / pipeline stages / expert banks) span both
+    processes — per-epoch trajectory parity with the single-process
+    run."""
     nprocs = 2
     port = _free_port()
     procs = [
-        _launch_worker(i, nprocs, port, mode="tp", log_dir=str(tmp_path))
+        _launch_worker(i, nprocs, port, mode=mode, log_dir=str(tmp_path))
         for i in range(nprocs)
     ]
-    single = _inline_tp_reference(total_devices(nprocs, "tp"))
+    single = _inline_axis_reference(total_devices(nprocs, mode), mode)
     multi = _collect(procs, timeout=480)
 
     assert [r["processes"] for r in multi] == [nprocs] * nprocs

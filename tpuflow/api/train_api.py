@@ -465,15 +465,6 @@ def _validate_model_axis(config, jit_epoch: bool, n_dev: int) -> None:
     for name, n in (("tp", config.tp), ("pp", config.pp), ("ep", config.ep)):
         if n <= 1:
             continue
-        if name != "tp" and jax.process_count() > 1:
-            # No per-process batch slicing on these paths yet (TP has
-            # it — the DP branch's _local/process_batch_bounds
-            # machinery); feeding a pod-global sharding from one host
-            # would crash mid-epoch.
-            raise ValueError(
-                f"{name}>1 is single-host for now; multi-host {name.upper()} "
-                "needs per-process batch feeding (see the DP/TP branches)"
-            )
         if jit_epoch:
             raise ValueError(
                 f"{name}>1 trains through its per-batch sharded step; "
@@ -501,24 +492,30 @@ def _validate_model_axis(config, jit_epoch: bool, n_dev: int) -> None:
                 f"batch_size {config.batch_size} not divisible by "
                 f"{n_dev // n} data-parallel devices"
             )
-    if config.tp > 1 and jax.process_count() > 1:
+    # tp/pp/ep all ride the same (data, model) mesh layout, so the
+    # multi-host shape constraints are identical across them.
+    model_axis = max(config.tp, config.pp, config.ep)
+    axis_name = (
+        "tp" if config.tp > 1 else "pp" if config.pp > 1 else "ep"
+    )
+    if model_axis > 1 and jax.process_count() > 1:
         if n_dev != jax.device_count():
             # A submesh would leave some processes with ZERO mesh
             # devices while process_batch_bounds still hands them batch
             # rows — make_array_from_process_local_data then crashes on
             # the first batch, after data preparation.
             raise ValueError(
-                f"multi-host tp needs the full pod: n_devices {n_dev} "
-                f"!= device_count {jax.device_count()}"
+                f"multi-host {axis_name} needs the full pod: n_devices "
+                f"{n_dev} != device_count {jax.device_count()}"
             )
-        if jax.local_device_count() % config.tp:
+        if jax.local_device_count() % model_axis:
             # Every process's devices must cover WHOLE data-axis rows,
             # or per-process batch slices would split a model group
             # across hosts.
             raise ValueError(
-                f"multi-host tp={config.tp} needs the "
+                f"multi-host {axis_name}={model_axis} needs the "
                 f"{jax.local_device_count()} local devices per process "
-                "to be a multiple of tp"
+                f"to be a multiple of {axis_name}"
             )
 
 
@@ -654,6 +651,21 @@ def train(
     # data preparation; the branches below only build the sharded state)
     train_step = eval_step = epoch_step = None
     batch_shard = None
+
+    def _wire_axis_steps(mesh, train_fn, eval_fn):
+        """The one multi-host-vs-single-host wiring for every model-axis
+        strategy (tp/pp/ep): on a multi-process runtime wrap the step fns
+        with THE shared per-process feeding recipe
+        (parallel.dp.make_process_fed_steps); single-host, pass them
+        through and let prefetch land batches pre-sharded over the data
+        axis. Returns (train_step, eval_step, batch_shard)."""
+        if jax.process_count() > 1:
+            fed_train, fed_eval = make_process_fed_steps(
+                mesh, train_fn, eval_fn
+            )
+            return fed_train, fed_eval, None
+        return train_fn, eval_fn, data_sharding(mesh)
+
     if config.tp > 1:
         from tpuflow.parallel.tp_train import (
             make_tp_eval_step,
@@ -670,18 +682,10 @@ def train(
         )
         # Fails loudly for non-Dense-stack families (mlp_tp_shardings).
         state = shard_state(mesh, state, mlp_tp_shardings(mesh, state.params))
-        tp_train = make_tp_train_step(state, loss_fn)
-        tp_eval = make_tp_eval_step(loss_fn)
-        if jax.process_count() > 1:
-            # Multi-host: every host materializes the same seeded batch
-            # order and feeds only its slice — THE shared recipe
-            # (parallel.dp.make_process_fed_steps), identical to DP.
-            train_step, eval_step = make_process_fed_steps(
-                mesh, tp_train, tp_eval
-            )
-        else:
-            train_step, eval_step = tp_train, tp_eval
-            batch_shard = data_sharding(mesh)
+        train_step, eval_step, batch_shard = _wire_axis_steps(
+            mesh, make_tp_train_step(state, loss_fn),
+            make_tp_eval_step(loss_fn),
+        )
     elif config.pp > 1:
         n_micro = config.pp_microbatches or config.pp
         from tpuflow.parallel.pp_train import (
@@ -699,9 +703,10 @@ def train(
         )
         # Fails loudly for non-pipeline families (pp_shardings).
         state = shard_state(mesh, state, pp_shardings(mesh, state.params))
-        train_step = make_pp_train_step(state, loss_fn, n_micro)
-        eval_step = make_pp_eval_step(mesh, loss_fn, n_micro)
-        batch_shard = data_sharding(mesh)
+        train_step, eval_step, batch_shard = _wire_axis_steps(
+            mesh, make_pp_train_step(state, loss_fn, n_micro),
+            make_pp_eval_step(mesh, loss_fn, n_micro),
+        )
     elif config.ep > 1:
         from tpuflow.parallel.ep_train import (
             ep_shardings,
@@ -718,9 +723,10 @@ def train(
         )
         # Fails loudly for non-MoE families (ep_shardings).
         state = shard_state(mesh, state, ep_shardings(mesh, state.params))
-        train_step = make_ep_train_step(state, loss_fn)
-        eval_step = make_ep_eval_step(mesh, loss_fn)
-        batch_shard = data_sharding(mesh)
+        train_step, eval_step, batch_shard = _wire_axis_steps(
+            mesh, make_ep_train_step(state, loss_fn),
+            make_ep_eval_step(mesh, loss_fn),
+        )
     elif n_dev > 1:
         if config.batch_size % n_dev:
             raise ValueError(
