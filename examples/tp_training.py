@@ -31,7 +31,11 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard-set, not setdefault: this demo builds a virtual CPU mesh by
+# design, and an inherited JAX_PLATFORMS=axon (the TPU relay) would
+# otherwise win the pin-race inside `import tpuflow` and hang every
+# jax init when the relay is unreachable.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
