@@ -1,0 +1,47 @@
+"""The committed examples must actually run — as subprocesses, with the
+inherited environment, the way a user would launch them.
+
+Regression target: an inherited ``JAX_PLATFORMS=axon`` (the TPU relay
+env) once survived the examples' env setup, won the pin-race inside
+``import tpuflow``, and hung every jax init whenever the relay was
+unreachable — the examples "worked" only under the exact documented
+prefix. Running them here WITHOUT scrubbing the inherited env keeps that
+class of trap caught. Slow tier: each example trains several tiny jobs
+on the single CI core.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(name: str, timeout: float = 900.0):
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    return subprocess.run(
+        [sys.executable, os.path.join("examples", name)],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env=env,
+        timeout=timeout,
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "name, expect",
+    [
+        ("tp_training.py", "max per-epoch loss drift"),
+        ("pp_ep_training.py", "expert parallel"),
+    ],
+)
+def test_mesh_example_runs(name, expect):
+    out = _run_example(name)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert expect in out.stdout
