@@ -68,10 +68,11 @@ Also embedded in the worker run:
   risking the headline number.
 
 Env knobs: BENCH_CONFIGS (comma list of <batch>x<steps-per-dispatch>
-candidates swept per variant, default "1024x1,1024x16,4096x16" — 1024x1
-is the best config measured on-chip, ~17.7M samples/sec in round 3, AND
-the cheapest to compile, so it goes first; setting BENCH_BATCH and/or
-BENCH_SCAN pins a single config instead), BENCH_SECONDS (default 5),
+candidates swept per variant, default "1024x1,1024x16,2048x16,4096x16"
+— cheapest-to-compile first so a number banks fast; 1024x16 is the best
+measured config, 9.36M samples/sec round 5, and 2048x16 probes the
+middle of the 1.8x batch effect; setting BENCH_BATCH and/or BENCH_SCAN
+pins a single config instead), BENCH_SECONDS (default 5),
 BENCH_VARIANTS (xla|remat|unroll|pallas|all, default "xla,remat,pallas"),
 BENCH_UNROLL (scan unroll factor for the unrolled variant, default 8),
 BENCH_ATTEMPTS (default 3), BENCH_TIMEOUT (per-attempt seconds, default
@@ -122,7 +123,9 @@ def bench_configs() -> list[tuple[int, int]]:
             max(int(os.environ.get("BENCH_SCAN", 16)), 1),
         )]
     configs = []
-    for c in os.environ.get("BENCH_CONFIGS", "1024x1,1024x16,4096x16").split(","):
+    default = "1024x1,1024x16,2048x16,4096x16"  # 2048: the unmeasured
+    # middle of the 1.8x batch effect between 1024 (best) and 4096
+    for c in os.environ.get("BENCH_CONFIGS", default).split(","):
         parts = c.strip().split("x")
         if len(parts) != 2:
             raise ValueError(f"BENCH_CONFIGS entry {c!r} is not <batch>x<scan>")
