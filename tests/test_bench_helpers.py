@@ -13,7 +13,9 @@ class TestBenchConfigs:
     def test_default_grid(self, monkeypatch):
         for var in ("BENCH_BATCH", "BENCH_SCAN", "BENCH_CONFIGS"):
             monkeypatch.delenv(var, raising=False)
-        assert bench_configs() == [(1024, 1), (1024, 16), (4096, 16)]
+        assert bench_configs() == [
+            (1024, 1), (1024, 16), (2048, 16), (4096, 16)
+        ]
 
     def test_pinned_by_batch_and_scan(self, monkeypatch):
         monkeypatch.setenv("BENCH_BATCH", "64")
