@@ -219,6 +219,17 @@ class TestFlashAttentionKernel:
                 np.asarray(a), np.asarray(b), atol=1e-4, err_msg=name
             )
 
+    def test_flash_block_env_is_8_aligned(self, monkeypatch):
+        """A sloppy TPUFLOW_FLASH_BLOCK (e.g. 100) must round up to a
+        Mosaic-legal multiple of 8, not produce an illegal block shape
+        that only fails compiled on the real chip."""
+        from tpuflow.kernels.attention import _block
+
+        monkeypatch.setenv("TPUFLOW_FLASH_BLOCK", "100")
+        assert _block(1024) == 104
+        monkeypatch.setenv("TPUFLOW_FLASH_BLOCK", "1")
+        assert _block(1024) == 8
+
     def test_padded_backward_with_extreme_scores_stays_finite(self):
         """Padded lse rows must force p=0, not overflow exp() to inf and
         poison dk/dv with inf * 0 = NaN."""
