@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpuflow.parallel.collectives import ppermute_ring
 from tpuflow.parallel.mesh import DATA_AXIS
 
 # Additive mask value: large-but-finite so a fully-masked score row stays
@@ -126,8 +127,11 @@ def _round_mask(idx, r, n, Tl, causal: bool):
 
 
 def _rotate(args, axis, n):
-    perm = [(i, (i + 1) % n) for i in range(n)]
-    return tuple(lax.ppermute(a, axis, perm) for a in args)
+    """Rotate every array one hop around the ring — the framework's
+    named ``ppermute_ring`` collective, applied to a tuple. (``n`` kept
+    for call-site readability; the ring size is implied by the axis.)"""
+    del n
+    return tuple(ppermute_ring(a, axis) for a in args)
 
 
 def _ring_fwd_core(q_local, k_local, v_local, axis, causal, scale, impl="jnp"):

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpuflow.parallel.collectives import ppermute_ring
 from tpuflow.parallel.mesh import DATA_AXIS
 
 
@@ -106,9 +107,8 @@ def _ring_scan_fn(mesh: Mesh, axis: str):
             active = idx == r
             hs_out = jnp.where(active, hs, hs_out)
             # Hand the active device's end-carry to its right neighbor.
-            perm = [(i, (i + 1) % n) for i in range(n)]
             received = jax.tree_util.tree_map(
-                lambda t: lax.ppermute(t, axis, perm), (h_end, c_end)
+                lambda t: ppermute_ring(t, axis), (h_end, c_end)
             )
         return hs_out
 
