@@ -94,10 +94,9 @@ import numpy as np
 
 BASELINE_SPS = 10_000.0  # driver-set north star (BASELINE.md)
 METRIC = "lstm64_train_samples_per_sec_per_chip"
-# The LSTM-64 config's shapes (BASELINE.json: 24-step windows, 5 well-log
-# features, hidden 64) — shared by the measurement, the parity check, and
-# the roofline model so they always describe the same workload.
-WINDOW, FEATURES, HIDDEN = 24, 5, 64
+# The LSTM-64 config's shapes — the one shared definition, used by the
+# measurement, the parity check, and the roofline model alike.
+from benchmarks.common import FEATURES, HIDDEN, WINDOW  # noqa: E402
 
 # FLOPs/bytes model + chip peaks + MFU verdict live in the library
 # (tpuflow/utils/roofline.py) so the accounting is reusable and testable.

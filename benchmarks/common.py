@@ -28,6 +28,12 @@ def maybe_pin_cpu() -> None:
 
 maybe_pin_cpu()
 
+# The LSTM-64 north-star workload's shapes (BASELINE.json: 24-step
+# windows, 5 well-log features, hidden 64) — ONE definition shared by
+# bench.py, the profile/sweep tools, and the roofline calls so every
+# harness describes the same workload.
+WINDOW, FEATURES, HIDDEN = 24, 5, 64
+
 
 def lstm_variants() -> dict[str, dict]:
     """The LSTM recurrence variants the benchmarks race: plain XLA scan,

@@ -39,7 +39,7 @@ import numpy as np
 
 from benchmarks.common import drain, emit, time_carried_steps
 
-WINDOW, FEATURES, HIDDEN = 24, 5, 64
+from benchmarks.common import FEATURES, HIDDEN, WINDOW  # noqa: E402
 
 
 def build_step(batch: int, scan: int):

@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-WINDOW, FEATURES, HIDDEN = 24, 5, 64
+from benchmarks.common import FEATURES, HIDDEN, WINDOW  # noqa: E402
 
 
 def throughput(program: str, batch: int, scan: int, seconds: float) -> float:

@@ -24,7 +24,7 @@ import sys
 
 sys.path.insert(0, ".")
 
-from benchmarks.common import emit, maybe_pin_cpu
+from benchmarks.common import FEATURES, HIDDEN, WINDOW, emit, maybe_pin_cpu
 
 maybe_pin_cpu()
 
@@ -48,7 +48,8 @@ def main() -> None:
     report = train(
         TrainJobConfig(
             model="lstm",
-            model_kwargs={"hidden": 64, "dtype": "bfloat16"},
+            model_kwargs={"hidden": HIDDEN, "dtype": "bfloat16"},
+            window=WINDOW,
             max_epochs=epochs,
             patience=epochs,  # no early stop mid-measurement
             batch_size=batch,
@@ -69,8 +70,8 @@ def main() -> None:
     steady = hist[1:]
     best = max(rows_per_epoch / h["time"] for h in steady if h["time"] > 0)
     n_train = round(rows_per_epoch)
-    flops = lstm_flops_per_sample_step(24, 5, 64)
-    bytes_ = lstm_bytes_per_sample_step(24, 5, 64, itemsize=2)
+    flops = lstm_flops_per_sample_step(WINDOW, FEATURES, HIDDEN)
+    bytes_ = lstm_bytes_per_sample_step(WINDOW, FEATURES, HIDDEN, itemsize=2)
     emit(
         "train_config",
         "train_samples_per_sec_per_chip",

@@ -261,11 +261,6 @@ def axis_job_config(total: int, mode: str):
     )
 
 
-def tp_job_config(total: int):
-    """Back-compat alias for the TP workload."""
-    return axis_job_config(total, "tp")
-
-
 def _model_axis_mode(pid: int, total: int, mode: str) -> None:
     """Multi-host model-axis training (tp/pp/ep) through train(config)
     itself: the strategy branch's per-process feeding recipe

@@ -41,6 +41,15 @@ def _honor_jax_platforms_env() -> None:
     try:
         import jax
 
+        current = jax.config.jax_platforms
+        if current and not current.startswith("axon"):
+            # A script already pinned the config explicitly (e.g. a
+            # virtual-CPU-mesh demo that ran jax.config.update("cpu")
+            # before importing tpuflow) — its choice outranks the
+            # inherited env var. The force-registering plugin's own
+            # "axon,cpu" preset is NOT a user pin (it is exactly what
+            # this function exists to override), hence the startswith.
+            return
         jax.config.update("jax_platforms", value)
     except Exception:
         pass  # jax absent or already initialized — leave as-is

@@ -126,11 +126,9 @@ def _round_mask(idx, r, n, Tl, causal: bool):
     return k_pos[None, :] <= q_pos[:, None]
 
 
-def _rotate(args, axis, n):
+def _rotate(args, axis):
     """Rotate every array one hop around the ring — the framework's
-    named ``ppermute_ring`` collective, applied to a tuple. (``n`` kept
-    for call-site readability; the ring size is implied by the axis.)"""
-    del n
+    named ``ppermute_ring`` collective, applied to a tuple."""
     return tuple(ppermute_ring(a, axis) for a in args)
 
 
@@ -159,7 +157,7 @@ def _ring_fwd_core(q_local, k_local, v_local, axis, causal, scale, impl="jnp"):
                 q_local, k_cur, v_cur, m, l, acc, q_off, k_off, scale
             )
             if r + 1 < n:
-                k_cur, v_cur = _rotate((k_cur, v_cur), axis, n)
+                k_cur, v_cur = _rotate((k_cur, v_cur), axis)
         l_safe = jnp.where(l == 0, 1.0, l)
         out = (acc / l_safe[..., None]).astype(q_local.dtype)
         return out, m + jnp.log(l_safe)
@@ -170,7 +168,7 @@ def _ring_fwd_core(q_local, k_local, v_local, axis, causal, scale, impl="jnp"):
         allowed = _round_mask(idx, r, n, Tl, causal)
         m, l, o = _block_update(q_local, k_cur, v_cur, m, l, o, allowed, scale)
         if r + 1 < n:
-            k_cur, v_cur = _rotate((k_cur, v_cur), axis, n)
+            k_cur, v_cur = _rotate((k_cur, v_cur), axis)
     # Causal attention guarantees l > 0 (each position sees itself);
     # the guard keeps a fully-masked row finite rather than NaN.
     l_safe = jnp.where(l == 0, 1.0, l)
@@ -261,12 +259,12 @@ def _ring_spmd_bwd(axis, causal, scale, impl, res, do):
         dv_cur = dv_cur + jnp.einsum("bqk,bqd->bkd", p, do)
         if r + 1 < n:
             k_cur, v_cur, dk_cur, dv_cur = _rotate(
-                (k_cur, v_cur, dk_cur, dv_cur), axis, n
+                (k_cur, v_cur, dk_cur, dv_cur), axis
             )
         else:
             # Last round: only the accumulators still need to travel —
             # one final hop rides them home to their block's owner.
-            dk_cur, dv_cur = _rotate((dk_cur, dv_cur), axis, n)
+            dk_cur, dv_cur = _rotate((dk_cur, dv_cur), axis)
     return dq, dk_cur, dv_cur
 
 
@@ -297,10 +295,10 @@ def _ring_flash_bwd(q, k, v, out, lse, do, axis, scale):
         dv_cur = dv_cur + dv_p
         if r + 1 < n:
             k_cur, v_cur, dk_cur, dv_cur = _rotate(
-                (k_cur, v_cur, dk_cur, dv_cur), axis, n
+                (k_cur, v_cur, dk_cur, dv_cur), axis
             )
         else:
-            dk_cur, dv_cur = _rotate((dk_cur, dv_cur), axis, n)
+            dk_cur, dv_cur = _rotate((dk_cur, dv_cur), axis)
     return dq, dk_cur, dv_cur
 
 
