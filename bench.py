@@ -223,7 +223,10 @@ def _parity_check(jax, jnp) -> str:
     mode = "compiled" if jax.default_backend() == "tpu" else "interpret"
     tols = {k: tol for k in errs}
     if mode == "compiled":
-        tols["attn"] = tols["dattn"] = 2e-2
+        # Measured 8e-3 on v5e; 1e-2 keeps headroom over the bf16-pass
+        # rounding noise while a 2x error growth (a real lowering
+        # regression) still fails the gate.
+        tols["attn"] = tols["dattn"] = 1e-2
     bad = {k: v for k, v in errs.items() if not (v < tols[k])}
     if bad:
         return f"FAIL ({mode}): " + ", ".join(f"{k}={v:.2e}" for k, v in bad.items())

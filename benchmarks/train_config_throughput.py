@@ -67,8 +67,25 @@ def main() -> None:
         res.samples_per_sec * res.time_elapsed / max(len(hist), 1)
     )
     # First epoch carries the compiles; time the steady state.
-    steady = hist[1:]
-    best = max(rows_per_epoch / h["time"] for h in steady if h["time"] > 0)
+    steady = [h for h in hist[1:] if h["time"] > 0]
+    if not steady:
+        # A sub-resolution-clock host (or a one-epoch run) would crash
+        # max() on an empty sequence; a benchmark harness must emit a
+        # LABELED error record instead — a missing number that says why
+        # beats a stack trace that says nothing.
+        emit(
+            "train_config",
+            "train_samples_per_sec_per_chip",
+            0.0,
+            "samples/sec/chip",
+            device=device_kind,
+            batch=batch,
+            epochs_seen=len(hist),
+            error="no steady-state epoch reported positive time "
+            "(need >= 2 epochs and a clock that resolves an epoch)",
+        )
+        return
+    best = max(rows_per_epoch / h["time"] for h in steady)
     n_train = round(rows_per_epoch)
     flops = lstm_flops_per_sample_step(WINDOW, FEATURES, HIDDEN)
     bytes_ = lstm_bytes_per_sample_step(WINDOW, FEATURES, HIDDEN, itemsize=2)

@@ -1,0 +1,544 @@
+"""Resilience subsystem: fault registry, retry policy, wired sites,
+degraded serving (docs/resilience.md).
+
+Failure here is an INPUT: every drill arms a deterministic fault spec
+and asserts the system's contracted response — absorbed, contained, or
+degraded — then that the drill is reproducible (same spec, same firing).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+from tpuflow.resilience import (
+    SITES,
+    FaultInjected,
+    FaultSpec,
+    RetryPolicy,
+    TransientFault,
+    arm,
+    armed,
+    clear_faults,
+    fault_point,
+    fired_log,
+    parse_fault_spec,
+    retry_call,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry(monkeypatch):
+    """Every test starts and ends with nothing armed, fast retries, and
+    no env faults leaking between tests."""
+    monkeypatch.delenv("TPUFLOW_FAULTS", raising=False)
+    monkeypatch.setenv("TPUFLOW_RETRY_BASE", "0.001")
+    monkeypatch.setenv("TPUFLOW_RETRY_MAX", "0.002")
+    clear_faults()
+    yield
+    clear_faults()
+
+
+class TestSpecGrammar:
+    def test_parse_full_entry(self):
+        s = parse_fault_spec("checkpoint.save,at=3,mode=exit,code=43")
+        assert s.site == "checkpoint.save"
+        assert s.at == 3 and s.mode == "exit" and s.code == 43
+
+    def test_parse_probabilistic(self):
+        s = parse_fault_spec("stream.read,p=0.25,seed=7")
+        assert s.p == 0.25 and s.seed == 7
+
+    def test_unknown_site_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault site"):
+            parse_fault_spec("checkpoint.svae,nth=1")
+
+    def test_unknown_option_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault spec option"):
+            parse_fault_spec("csv.read,nht=1")
+
+    def test_never_firing_spec_rejected(self):
+        with pytest.raises(ValueError, match="never fires"):
+            parse_fault_spec("csv.read")
+
+    def test_bad_mode_rejected(self):
+        with pytest.raises(ValueError, match="raise|exit|hang"):
+            parse_fault_spec("csv.read,nth=1,mode=explode")
+
+    def test_at_on_indexless_site_rejected(self):
+        # csv.read's fault_point passes no index: an at= spec there
+        # could never fire — a drill that silently never fires fakes a
+        # pass, so arming it must fail loudly.
+        with pytest.raises(ValueError, match="passes no index"):
+            parse_fault_spec("csv.read,at=3")
+
+
+class TestRegistry:
+    def test_nth_is_one_shot_by_count(self):
+        arm(parse_fault_spec("csv.read,nth=2"))
+        fault_point("csv.read")  # hit 1: no fire
+        with pytest.raises(FaultInjected):
+            fault_point("csv.read")  # hit 2: fires
+        fault_point("csv.read")  # disarmed: never double-fires
+        assert armed() == []
+        assert len(fired_log()) == 1
+
+    def test_at_matches_index_one_shot(self):
+        arm(parse_fault_spec("train.epoch_start,at=3"))
+        fault_point("train.epoch_start", index=1)
+        fault_point("train.epoch_start", index=2)
+        with pytest.raises(FaultInjected, match="index=3"):
+            fault_point("train.epoch_start", index=3)
+        fault_point("train.epoch_start", index=3)  # one-shot
+        assert armed() == []
+
+    def test_probabilistic_is_seed_deterministic(self):
+        def firing_pattern(seed):
+            clear_faults()
+            arm(FaultSpec(site="stream.read", p=0.5, seed=seed))
+            pattern = []
+            for _ in range(20):
+                try:
+                    fault_point("stream.read")
+                    pattern.append(0)
+                except FaultInjected:
+                    pattern.append(1)
+            return pattern
+
+        a, b = firing_pattern(7), firing_pattern(7)
+        assert a == b  # the same drill replays identically
+        assert firing_pattern(8) != a  # and the seed is actually used
+        assert sum(a) > 0  # p=0.5 over 20 calls: fires
+
+    def test_transient_flag_selects_retryable_type(self):
+        arm(parse_fault_spec("csv.read,nth=1,transient=1"))
+        with pytest.raises(TransientFault):
+            fault_point("csv.read")
+
+    def test_env_arming_and_resync(self, monkeypatch):
+        monkeypatch.setenv("TPUFLOW_FAULTS", "csv.read,nth=1")
+        with pytest.raises(FaultInjected):
+            fault_point("csv.read")
+        # Changing the env re-arms without any install call.
+        monkeypatch.setenv("TPUFLOW_FAULTS", "stream.read,nth=1")
+        fault_point("csv.read")  # old env spec gone
+        with pytest.raises(FaultInjected):
+            fault_point("stream.read")
+
+    def test_env_typo_arms_nothing_and_keeps_failing_loud(
+        self, monkeypatch
+    ):
+        # A typo ANYWHERE in TPUFLOW_FAULTS arms NOTHING (parse-all-
+        # before-arm) and raises at every fault_point until fixed —
+        # never a partial drill that fakes a pass.
+        monkeypatch.setenv(
+            "TPUFLOW_FAULTS", "checkpoint.save,nth=1;typo.site,nth=1"
+        )
+        with pytest.raises(ValueError, match="unknown fault site"):
+            fault_point("checkpoint.save", index=1)
+        assert armed() == []
+        with pytest.raises(ValueError, match="unknown fault site"):
+            fault_point("csv.read")  # still loud, any site, any call
+
+    def test_clear_then_same_env_value_rearms(self, monkeypatch):
+        monkeypatch.setenv("TPUFLOW_FAULTS", "csv.read,nth=1")
+        with pytest.raises(FaultInjected):
+            fault_point("csv.read")
+        clear_faults()
+        # Byte-identical env value after a clear must still arm (the
+        # cache is reset by clear_faults, not just the spec list).
+        monkeypatch.setenv("TPUFLOW_FAULTS", "csv.read,nth=1")
+        with pytest.raises(FaultInjected):
+            fault_point("csv.read")
+
+    def test_unregistered_site_fails_loudly(self):
+        with pytest.raises(RuntimeError, match="not in the SITES catalog"):
+            fault_point("no.such.site")
+
+
+class TestRetryPolicy:
+    def _policy(self, **kw):
+        kw.setdefault("base_delay", 0.001)
+        kw.setdefault("max_delay", 0.002)
+        kw.setdefault("deadline", 5.0)
+        return RetryPolicy(**kw)
+
+    def test_absorbs_transient_then_succeeds(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise TransientFault("flaky", "csv.read")
+            return "ok"
+
+        assert retry_call(self._policy(), flaky) == "ok"
+        assert len(calls) == 3
+
+    def test_oserror_is_transient_by_default(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) == 1:
+                raise OSError("mount blip")
+            return 42
+
+        assert retry_call(self._policy(), flaky) == 42
+
+    def test_deterministic_failure_not_retried(self):
+        calls = []
+
+        def broken():
+            calls.append(1)
+            raise ValueError("malformed row")
+
+        with pytest.raises(ValueError):
+            retry_call(self._policy(), broken)
+        assert len(calls) == 1  # retrying a parse bug is pure latency
+
+    def test_deterministic_oserrors_not_retried(self):
+        # A typo'd path replays identically: FileNotFoundError (and
+        # kin) must not be treated as the transient OSError class.
+        for exc in (FileNotFoundError, PermissionError, IsADirectoryError):
+            calls = []
+
+            def broken(exc=exc):
+                calls.append(1)
+                raise exc("/no/such/path")
+
+            with pytest.raises(exc):
+                retry_call(self._policy(), broken)
+            assert len(calls) == 1
+
+    def test_attempts_exhausted_raises_last_with_count(self):
+        def always():
+            raise OSError("down")
+
+        with pytest.raises(OSError) as e:
+            retry_call(self._policy(max_attempts=3), always)
+        assert e.value.retry_attempts == 3
+
+    def test_deadline_bounds_total_wait(self):
+        slept = []
+
+        def always():
+            raise OSError("down")
+
+        with pytest.raises(OSError) as e:
+            retry_call(
+                self._policy(
+                    max_attempts=100, base_delay=10.0, max_delay=10.0,
+                    deadline=0.5, sleep=slept.append,
+                ),
+                always,
+            )
+        # First retry's 10s delay already blows the 0.5s deadline.
+        assert slept == [] and e.value.retry_attempts == 1
+
+    def test_backoff_grows_exponentially_with_seeded_jitter(self):
+        slept = []
+
+        def always():
+            raise OSError("down")
+
+        pol = self._policy(
+            max_attempts=4, base_delay=0.1, max_delay=10.0,
+            multiplier=2.0, jitter=0.0, sleep=slept.append, seed=0,
+        )
+        with pytest.raises(OSError):
+            retry_call(pol, always)
+        assert slept == pytest.approx([0.1, 0.2, 0.4])
+
+
+@pytest.mark.faultdrill
+class TestWiredSites:
+    """One injected fault per registry site, against the real code."""
+
+    def test_checkpoint_save_transient_absorbed(self, tmp_path):
+        from tpuflow.train.checkpoint import BestCheckpointer
+
+        arm(parse_fault_spec("checkpoint.save,nth=1,transient=1"))
+        ckpt = BestCheckpointer(str(tmp_path), "m", async_save=False)
+        try:
+            assert ckpt.maybe_save(1, {"w": np.ones(3)}, 0.5)  # retried
+            assert ckpt.best_step == 1
+        finally:
+            ckpt.close()
+        assert fired_log()[0]["site"] == "checkpoint.save"
+
+    def test_checkpoint_restore_fatal_fault_propagates(self, tmp_path):
+        from tpuflow.train.checkpoint import BestCheckpointer
+
+        ckpt = BestCheckpointer(str(tmp_path), "m", async_save=False)
+        try:
+            ckpt.maybe_save(1, {"w": np.ones(3)}, 0.5)
+            arm(parse_fault_spec("checkpoint.restore,nth=1"))
+            with pytest.raises(FaultInjected):
+                ckpt.restore_best()
+            # One-shot: the next restore (the operator's retry) works.
+            assert ckpt.restore_best()["w"].shape == (3,)
+        finally:
+            ckpt.close()
+
+    def test_csv_read_transient_absorbed(self, tmp_path):
+        from tpuflow.data.csv_io import read_csv
+        from tpuflow.data.schema import Schema
+
+        p = tmp_path / "d.csv"
+        p.write_text("1.0,2.0\n3.0,4.0\n")
+        schema = Schema.from_cli("a,b", "float,float", "b")
+        arm(parse_fault_spec("csv.read,nth=1,transient=1"))
+        out = read_csv(str(p), schema)
+        assert out["a"].tolist() == [1.0, 3.0]
+        assert fired_log()[0]["site"] == "csv.read"
+
+    def test_stream_read_transient_absorbed_mid_stream(self, tmp_path):
+        from tpuflow.data.schema import Schema
+        from tpuflow.data.stream import stream_csv_columns
+
+        p = tmp_path / "d.csv"
+        p.write_text("".join(f"{i}.0,{i}.5\n" for i in range(10)))
+        schema = Schema.from_cli("a,b", "float,float", "b")
+        # Fault on the SECOND chunk: absorbed without losing chunk 1.
+        arm(parse_fault_spec("stream.read,nth=2,transient=1"))
+        chunks = list(stream_csv_columns(str(p), schema, chunk_rows=4))
+        assert [len(c["a"]) for c in chunks] == [4, 4, 2]
+        total = np.concatenate([c["a"] for c in chunks])
+        assert total.tolist() == [float(i) for i in range(10)]
+
+    def test_serve_execute_fault_fails_job_not_service(self, tmp_path):
+        from tpuflow.serve import JobRunner
+
+        arm(parse_fault_spec("serve.execute,nth=1"))
+        runner = JobRunner()
+        tiny = {
+            "model": "static_mlp", "model_kwargs": {"hidden": [4]},
+            "epochs": 1, "batchSize": 32, "n_devices": 1,
+            "synthetic_wells": 2, "synthetic_steps": 64,
+        }
+        job = runner.submit(tiny)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            rec = runner.get(job["job_id"])
+            if rec["status"] in ("done", "failed"):
+                break
+            time.sleep(0.05)
+        assert rec["status"] == "failed"
+        assert "FaultInjected" in rec["error"]
+        # Containment: the worker survived; the next job runs clean.
+        job2 = runner.submit(tiny)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            rec2 = runner.get(job2["job_id"])
+            if rec2["status"] in ("done", "failed"):
+                break
+            time.sleep(0.05)
+        assert rec2["status"] == "done"
+
+
+class TestCatalogSelfCheck:
+    """Docs and code cannot drift: the SITES catalog, the installed
+    fault_point() calls, and the docs/resilience.md table must all name
+    the same sites."""
+
+    def test_every_installed_hook_is_catalogued(self):
+        found = set()
+        pkg = os.path.join(REPO, "tpuflow")
+        for root, _, files in os.walk(pkg):
+            for name in files:
+                if not name.endswith(".py"):
+                    continue
+                with open(os.path.join(root, name), encoding="utf-8") as f:
+                    found |= set(
+                        re.findall(r'fault_point\(\s*"([a-z_.]+)"', f.read())
+                    )
+        assert found == set(SITES), (
+            "fault_point() call sites and the SITES catalog disagree — "
+            "update tpuflow/resilience/faults.py"
+        )
+
+    def test_docs_catalog_matches_sites(self):
+        doc = os.path.join(REPO, "docs", "resilience.md")
+        with open(doc, encoding="utf-8") as f:
+            text = f.read()
+        # The doc may name other identifiers; the CATALOG section is
+        # delimited so the drift check is exact, and site names are the
+        # only fully-backticked dotted lowercase tokens inside it.
+        section = re.search(
+            r"<!-- fault-site-catalog -->(.*?)<!-- /fault-site-catalog -->",
+            text,
+            re.S,
+        )
+        assert section, "docs/resilience.md lost its fault-site-catalog markers"
+        documented = set(
+            re.findall(r"`([a-z_]+\.[a-z_]+)`", section.group(1))
+        )
+        assert documented == set(SITES), (
+            "docs/resilience.md fault-site catalog and faults.SITES "
+            f"disagree: doc-only={documented - set(SITES)}, "
+            f"code-only={set(SITES) - documented}"
+        )
+
+
+NAMES = "pressure,choke,glr,temperature,water_cut,completion,flow"
+TYPES = "float,float,float,float,float,string,float"
+
+
+def _train_artifact(tmp_path):
+    from tpuflow.api import TrainJobConfig, train
+
+    return train(
+        TrainJobConfig(
+            model="static_mlp",
+            model_kwargs={"hidden": [8]},
+            max_epochs=2,
+            batch_size=32,
+            seed=0,
+            verbose=False,
+            n_devices=1,
+            storage_path=str(tmp_path),
+            synthetic_wells=2,
+            synthetic_steps=96,
+        )
+    )
+
+
+@pytest.mark.faultdrill
+class TestDegradedServing:
+    """Acceptance drill: corrupt checkpoint -> Gilbert fallback with
+    degraded:true -> /healthz shows it -> retrain recovers."""
+
+    def _corrupt_checkpoint(self, tmp_path):
+        # Weights gone, sidecar intact: the partial-corruption case.
+        shutil.rmtree(tmp_path / "models" / "static_mlp")
+
+    def test_fallback_serves_gilbert_with_flag(self, tmp_path):
+        from tpuflow.core.gilbert import gilbert_flow
+        from tpuflow.data.synthetic import generate_wells, wells_to_table
+        from tpuflow.serve import PredictService
+
+        _train_artifact(tmp_path)
+        self._corrupt_checkpoint(tmp_path)
+        svc = PredictService()
+        table = wells_to_table(generate_wells(1, 16, seed=3))
+        out = svc.predict({
+            "storagePath": str(tmp_path), "model": "static_mlp",
+            "columns": {k: v.tolist() for k, v in table.items()
+                        if k != "completion"},
+        })
+        assert out["degraded"] is True
+        assert out["fallback"] == "gilbert"
+        assert out["count"] == 16
+        expect = np.asarray(gilbert_flow(
+            table["pressure"], table["choke"], table["glr"]
+        ))
+        np.testing.assert_allclose(out["predictions"], expect, rtol=1e-5)
+        # Surfaced for operators, not just per-response.
+        deg = svc.degraded()
+        assert len(deg) == 1 and deg[0]["model"] == "static_mlp"
+        assert svc.metrics()["degraded_requests"] == 1
+        assert svc.metrics()["fallback_loads"] == 1
+
+    def test_degraded_csv_uses_sidecar_schema(self, tmp_path):
+        from tpuflow.data.synthetic import (
+            generate_wells, wells_to_table, write_csv,
+        )
+        from tpuflow.serve import PredictService
+
+        _train_artifact(tmp_path)
+        self._corrupt_checkpoint(tmp_path)
+        table = wells_to_table(generate_wells(1, 8, seed=4))
+        csv = str(tmp_path / "serve.csv")
+        write_csv(csv, table, NAMES.split(","))
+        svc = PredictService()
+        out = svc.predict({
+            "storagePath": str(tmp_path), "model": "static_mlp",
+            "data": csv,
+        })
+        assert out["degraded"] is True and out["count"] == 8
+
+    def test_retrain_recovers_from_degraded(self, tmp_path):
+        from tpuflow.data.synthetic import generate_wells, wells_to_table
+        from tpuflow.serve import PredictService
+
+        _train_artifact(tmp_path)
+        self._corrupt_checkpoint(tmp_path)
+        svc = PredictService()
+        table = wells_to_table(generate_wells(1, 8, seed=5))
+        # The FULL column set: the degraded path needs only the physical
+        # three, but the recovered (real) predictor needs every trained
+        # feature, categoricals included.
+        cols = {k: v.tolist() for k, v in table.items()}
+        spec = {
+            "storagePath": str(tmp_path), "model": "static_mlp",
+            "columns": cols,
+        }
+        assert svc.predict(spec)["degraded"] is True
+        # The job-runner's artifact-change callback is invalidate():
+        # a retrain rewrites the weights and evicts the fallback.
+        _train_artifact(tmp_path)
+        svc.invalidate(str(tmp_path), "static_mlp")
+        out = svc.predict(spec)
+        assert "degraded" not in out
+        assert svc.degraded() == []
+
+    def test_degraded_ttl_reprobes_real_artifact(self, tmp_path):
+        """A fallback cached during a TRANSIENT outage must expire: once
+        the TTL passes, the next request re-probes and loads the real
+        model — degradation heals without any retrain."""
+        from tpuflow.data.synthetic import generate_wells, wells_to_table
+        from tpuflow.serve import PredictService
+
+        _train_artifact(tmp_path)
+        ckpt_dir = tmp_path / "models" / "static_mlp"
+        hidden = tmp_path / "hidden_static_mlp"
+        # Simulate "storage briefly unreachable": move the checkpoint
+        # away, degrade, move it back, wait out the TTL.
+        ckpt_dir.rename(hidden)
+        svc = PredictService(degraded_retry_seconds=0.2)
+        table = wells_to_table(generate_wells(1, 8, seed=6))
+        spec = {
+            "storagePath": str(tmp_path), "model": "static_mlp",
+            "columns": {k: v.tolist() for k, v in table.items()},
+        }
+        assert svc.predict(spec)["degraded"] is True
+        hidden.rename(ckpt_dir)  # the outage ends
+        assert svc.predict(spec)["degraded"] is True  # TTL not up: cached
+        time.sleep(0.25)
+        out = svc.predict(spec)  # TTL expired: re-probe finds the model
+        assert "degraded" not in out
+        assert svc.degraded() == []
+
+    def test_never_existing_artifact_still_fails_loudly(self, tmp_path):
+        from tpuflow.serve import PredictService
+
+        svc = PredictService()
+        with pytest.raises(FileNotFoundError):
+            svc.predict({
+                "storagePath": str(tmp_path), "model": "typo_model",
+                "columns": {"pressure": [1.0], "choke": [32.0],
+                            "glr": [1.0]},
+            })
+        assert svc.degraded() == []
+
+    def test_fallback_disabled_propagates(self, tmp_path):
+        from tpuflow.serve import PredictService
+
+        _train_artifact(tmp_path)
+        self._corrupt_checkpoint(tmp_path)
+        svc = PredictService(gilbert_fallback=False)
+        with pytest.raises(Exception):
+            svc.predict({
+                "storagePath": str(tmp_path), "model": "static_mlp",
+                "columns": {"pressure": [1.0], "choke": [32.0],
+                            "glr": [1.0]},
+            })

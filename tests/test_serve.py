@@ -79,8 +79,12 @@ def server():
 
 class TestHTTPServer:
     def test_health(self, server):
-        status, body = _get(server + "/health")
-        assert status == 200 and body == {"status": "ok"}
+        for route in ("/health", "/healthz"):
+            status, body = _get(server + route)
+            assert status == 200
+            assert body["status"] == "ok"
+            assert body["degraded"] is False
+            assert body["degraded_artifacts"] == []
 
     def test_unknown_routes_404(self, server):
         with pytest.raises(urllib.error.HTTPError):

@@ -71,6 +71,16 @@ class TrainJobConfig:
     fault_epoch: int | None = None  # inject a simulated preemption (tests)
     fault_hard: bool = False  # preempt WITHOUT committing async ckpt writes
     ckpt_async: bool = True  # False: synchronous checkpoint writes
+    # --- resilience drills (tpuflow/resilience; docs/resilience.md) ---
+    # Fault specs armed for THIS run only ("site,at=3,mode=exit", ...);
+    # the registry grammar of resilience/faults.py. The supervisor drops
+    # them on restart attempts (a drill is one-shot; the recovery runs
+    # clean) — use TPUFLOW_FAULTS for faults that must survive restarts.
+    faults: list = field(default_factory=list)
+    # Liveness file overwritten after every completed epoch ({"epoch": N,
+    # "time": ...}); the supervisor injects its own path here so its
+    # stall watchdog can tell hung from slow-but-alive.
+    progress_path: str | None = None
 
     # --- observability ---
     trace_dir: str | None = None  # jax.profiler trace of the first epoch

@@ -536,7 +536,39 @@ def train(
     preparation and between epochs; a non-None string aborts the run with
     ``TrainingInterrupted(reason)`` — the job-runner's cancellation and
     per-job-timeout hook.
+
+    ``config.faults`` arms resilience-registry fault specs for exactly
+    this run (armed before ingest so data-path sites are covered,
+    disarmed on the way out so nothing leaks into a later run in the
+    same process).
     """
+    fault_handles = []
+    if config.faults:
+        from tpuflow.resilience import arm, parse_fault_spec
+
+        # Parse EVERY entry before arming ANY: a typo in the second spec
+        # must not leave the first one armed process-wide (the finally
+        # below can only disarm handles that were recorded).
+        specs = [parse_fault_spec(s) for s in config.faults]
+        fault_handles = [arm(s) for s in specs]
+    try:
+        return _train_impl(
+            config, _data_cache=_data_cache, stop_fn=stop_fn
+        )
+    finally:
+        if fault_handles:
+            from tpuflow.resilience import disarm
+
+            for spec in fault_handles:
+                disarm(spec)
+
+
+def _train_impl(
+    config: TrainJobConfig,
+    *,
+    _data_cache: dict | None = None,
+    stop_fn=None,
+) -> TrainReport:
     init_distributed()
     if stop_fn is not None:
         reason = stop_fn()
@@ -785,6 +817,7 @@ def train(
         fault_epoch=config.fault_epoch,
         fault_hard=config.fault_hard,
         ckpt_async=config.ckpt_async,
+        progress_path=config.progress_path,
         trace_dir=config.trace_dir,
         metrics_path=config.metrics_path,
         stop_fn=stop_fn,

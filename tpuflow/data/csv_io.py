@@ -14,28 +14,46 @@ from __future__ import annotations
 import numpy as np
 
 from tpuflow.data.schema import Schema
+from tpuflow.resilience import fault_point, io_policy, retry_call
 
 
 def read_csv(path: str, schema: Schema) -> dict[str, np.ndarray]:
     """Read a headerless CSV into per-column arrays, typed by the schema.
 
     Returns a dict: column name -> 1-D array (int32 / float32 / unicode).
+    Transient I/O errors (EIO, timeouts, stale-handle OSErrors) retry
+    under the shared policy; the read is idempotent so a retry re-reads
+    from scratch. Deterministic failures propagate immediately: a
+    malformed CSV's ValueError, and the ENOENT/EACCES-shaped OSErrors a
+    typo'd path produces (see ``retry.NON_TRANSIENT_OSERRORS`` — the
+    cost is that an outage which manifests as ENOENT also fails fast).
+    ``csv.read`` is a registered fault site.
     """
-    try:
-        from tpuflow._native import read_csv_native  # built lazily
 
-        out = read_csv_native(path, schema)
-        if out is not None:
-            return out
-    except ImportError:
-        pass
-    return _read_csv_numpy(path, schema)
+    def _read():
+        fault_point("csv.read")
+        try:
+            from tpuflow._native import read_csv_native  # built lazily
+
+            out = read_csv_native(path, schema)
+            if out is not None:
+                return out
+        except ImportError:
+            pass
+        return _read_csv_numpy(path, schema)
+
+    return retry_call(io_policy(), _read)
 
 
 def iter_csv_lines(path: str):
     """Yield ``(lineno, text)`` for every non-blank line — the single
-    line-reading loop shared by the whole-file and streaming readers."""
-    with open(path, "r", encoding="utf-8") as f:
+    line-reading loop shared by the whole-file and streaming readers.
+    The open retries transient OSErrors (idempotent; the streaming
+    reader may be hours into a file when the next pass's open hits an
+    EIO/ESTALE blip — absorbed instead of killing the epoch). ENOENT/
+    EACCES-shaped errors fail fast as deterministic (a typo'd path
+    replays identically; see ``retry.NON_TRANSIENT_OSERRORS``)."""
+    with retry_call(io_policy(), open, path, "r", encoding="utf-8") as f:
         for lineno, raw in enumerate(f, 1):
             line = raw.rstrip("\n").rstrip("\r")
             if line:

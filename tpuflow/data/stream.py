@@ -18,6 +18,7 @@ import numpy as np
 from tpuflow.data.csv_io import iter_csv_lines, parse_rows
 from tpuflow.data.features import FeaturePipeline
 from tpuflow.data.schema import Schema
+from tpuflow.resilience import fault_point, io_policy, retry_call
 
 
 def stream_csv_columns(
@@ -36,10 +37,26 @@ def stream_csv_columns(
     for lineno, line in iter_csv_lines(path):
         rows.append((lineno, line))
         if len(rows) >= chunk_rows:
-            yield _parse_chunk(rows, schema, path)
+            yield _chunk_with_retry(rows, schema, path)
             rows = []
     if rows:
-        yield _parse_chunk(rows, schema, path)
+        yield _chunk_with_retry(rows, schema, path)
+
+
+def _chunk_with_retry(
+    rows: list[tuple[int, str]], schema: Schema, path: str
+) -> dict[str, np.ndarray]:
+    """One chunk parse under the transient-I/O retry policy: the rows are
+    already in memory, so a retry is pure recompute — which is exactly
+    what absorbs an injected transient at the ``stream.read`` site (the
+    flaky-storage drill) without losing the epoch. Real parse errors
+    (ValueError) propagate immediately."""
+
+    def _one():
+        fault_point("stream.read")
+        return _parse_chunk(rows, schema, path)
+
+    return retry_call(io_policy(), _one)
 
 
 def _parse_chunk(

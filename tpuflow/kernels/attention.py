@@ -88,16 +88,36 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# Upper bound for TPUFLOW_FLASH_BLOCK: at 1024 the kernel's VMEM-resident
+# working set (q/k/v tiles plus the [blk, blk] f32 score tile — 4 MB at
+# 1024, 16 MB at 2048) is still comfortably inside a core's ~16 MB VMEM;
+# past it Mosaic fails at lowering time with an opaque allocation error,
+# so the bound is enforced HERE with an error naming the env var.
+_MAX_BLOCK = 1024
+
+
 def _block(T: int) -> int:
     """Query/key block length, or the (8-aligned) whole sequence when it
     is shorter. Default 256: the round-5 on-chip timing showed the
     128-row kernel neither HBM- nor MXU-bound (2.7% HBM util, 1.7% MFU)
     — serialization-bound on too-small inner matmuls — so bigger tiles
     put more arithmetic on the MXU per online-softmax iteration.
-    TPUFLOW_FLASH_BLOCK overrides for on-chip sweeps."""
+    TPUFLOW_FLASH_BLOCK overrides for on-chip sweeps, clamped to
+    [8, _MAX_BLOCK] — an oversized block fails here, by name, not
+    on-chip as an opaque Mosaic error."""
     import os
 
-    blk = max(int(os.environ.get("TPUFLOW_FLASH_BLOCK", 256)), 8)
+    raw = os.environ.get("TPUFLOW_FLASH_BLOCK", 256)
+    blk = int(raw)
+    if blk > _MAX_BLOCK:
+        raise ValueError(
+            f"TPUFLOW_FLASH_BLOCK={blk} exceeds the {_MAX_BLOCK} upper "
+            f"bound: the kernel keeps a [block, block] f32 score tile in "
+            f"VMEM (~{blk * blk * 4 / 2**20:.0f} MB at {blk}) and Mosaic "
+            "would fail allocation on-chip with an opaque error; use "
+            f"8 <= TPUFLOW_FLASH_BLOCK <= {_MAX_BLOCK}"
+        )
+    blk = max(blk, 8)
     blk = -(-blk // 8) * 8  # Mosaic sublane rule: blocks must be 8-aligned
     if T >= blk:
         return blk

@@ -1,0 +1,57 @@
+"""Resilience subsystem: failures as a first-class, testable input.
+
+Three legs (see docs/resilience.md):
+
+- ``faults``  — deterministic, seedable fault injection at named sites
+  (``fault_point``), armed per-spec or via ``TPUFLOW_FAULTS``.
+- ``retry``   — exponential backoff + jitter + deadline for transient
+  I/O (``retry_call`` / ``io_policy``), applied at checkpoint storage
+  and CSV/stream reads.
+- ``degraded``— the Gilbert-equation baseline standing in for a
+  missing/corrupt learned artifact in the serve path.
+
+The supervisor's restart backoff, crash-loop classification, and stall
+watchdog live with the supervisor (``tpuflow/train/supervisor.py``) and
+are drilled through this package's fault sites.
+"""
+
+from tpuflow.resilience.degraded import GilbertFallbackPredictor, try_fallback
+from tpuflow.resilience.faults import (
+    SITES,
+    FaultInjected,
+    FaultSpec,
+    TransientFault,
+    arm,
+    armed,
+    clear_faults,
+    disarm,
+    fault_point,
+    fired_log,
+    parse_fault_spec,
+)
+from tpuflow.resilience.retry import (
+    RetryPolicy,
+    io_policy,
+    retry_call,
+    retryable,
+)
+
+__all__ = [
+    "SITES",
+    "FaultInjected",
+    "FaultSpec",
+    "GilbertFallbackPredictor",
+    "RetryPolicy",
+    "TransientFault",
+    "arm",
+    "armed",
+    "clear_faults",
+    "disarm",
+    "fault_point",
+    "fired_log",
+    "io_policy",
+    "parse_fault_spec",
+    "retry_call",
+    "retryable",
+    "try_fallback",
+]

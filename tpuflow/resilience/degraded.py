@@ -1,0 +1,113 @@
+"""Graceful degradation for serving: the Gilbert baseline as fallback.
+
+The paper's own accuracy baseline — the closed-form Gilbert choke
+correlation (``core/gilbert.py``, reference Readme.md:7-8) — does double
+duty here as the degraded-mode model: when a trained artifact's
+CHECKPOINT is missing or corrupt, ``PredictService`` answers from
+physics instead of returning 500s, flagged ``degraded: true`` so the
+caller knows the answer's provenance. A baseline the learned models are
+judged against is by construction an acceptable worst-case stand-in for
+them.
+
+The gate is the schema sidecar (``{storage}/meta/{name}.json``): if it
+is readable, the artifact demonstrably existed and only its weights are
+gone — degrade. If even the sidecar is unreadable, the "artifact" most
+likely never existed (a typo'd model name must NOT be silently answered
+by physics) — ``try_fallback`` returns None and the original load error
+propagates. The fallback itself needs only the three physical columns
+(pressure, choke, glr), which ride every schema this system trains on.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+
+def try_fallback(storage_path: str, name: str, reason: str):
+    """Build a degraded predictor for a partially-lost artifact, or None.
+
+    None when the schema sidecar is unreadable too — then nothing proves
+    the artifact ever existed, and degrading would mask a caller error.
+    """
+    try:
+        from tpuflow.api.predict_api import _meta_path
+        from tpuflow.utils.paths import open_file
+
+        with open_file(
+            _meta_path(storage_path, name), "r", encoding="utf-8"
+        ) as f:
+            meta = json.load(f)
+    except Exception:
+        return None
+    return GilbertFallbackPredictor(name, meta, reason)
+
+
+class GilbertFallbackPredictor:
+    """Duck-types the ``Predictor`` serving surface (``predict_columns`` /
+    ``predict_csv``) over the closed-form baseline. ``degraded`` marks it
+    for the service layer; per-row physics predictions stand in for the
+    learned model's (windowed models' per-window shape is NOT preserved —
+    a degraded answer is a different, simpler model, and says so)."""
+
+    degraded = True
+    _NEEDED = ("pressure", "choke", "glr")
+
+    def __init__(self, name: str, meta: dict, reason: str):
+        self.model_name = name
+        self.reason = reason  # why the real artifact failed to load
+        self._meta = meta
+
+    def predict_columns(self, columns: dict) -> np.ndarray:
+        from tpuflow.core.gilbert import gilbert_flow
+
+        missing = [n for n in self._NEEDED if n not in columns]
+        if missing:
+            raise ValueError(
+                f"degraded (Gilbert-fallback) serving needs raw "
+                f"{list(self._NEEDED)} columns; missing {missing}"
+            )
+        return np.asarray(
+            gilbert_flow(
+                np.asarray(columns["pressure"], np.float32),
+                np.asarray(columns["choke"], np.float32),
+                np.asarray(columns["glr"], np.float32),
+            ),
+            dtype=np.float32,
+        )
+
+    def _schema(self, with_target: bool):
+        from tpuflow.data.schema import ColumnSpec, Schema
+
+        p = self._meta["preprocessor"]
+        if self._meta["kind"] == "tabular":
+            cols = list(zip(p["names"], p["kinds"]))
+        else:
+            cols = [(c["name"], c["kind"]) for c in p["schema_columns"]]
+        target = p["target"]
+        if not with_target:
+            cols = [(n, k) for n, k in cols if n != target]
+            target = None
+        return Schema(
+            columns=tuple(ColumnSpec(n, k) for n, k in cols), target=target
+        )
+
+    def predict_csv(self, path: str) -> np.ndarray:
+        from tpuflow.data.csv_io import read_csv
+
+        with open(path, "r", encoding="utf-8") as f:
+            first = f.readline()
+        nfields = len(first.rstrip("\n").rstrip("\r").split(","))
+        full = self._schema(with_target=True)
+        serving = self._schema(with_target=False)
+        if nfields == len(full.columns):
+            schema = full
+        elif nfields == len(serving.columns):
+            schema = serving
+        else:
+            raise ValueError(
+                f"{path}: first line has {nfields} fields; expected "
+                f"{len(full.columns)} or {len(serving.columns)}"
+            )
+        return self.predict_columns(read_csv(path, schema))

@@ -1,0 +1,297 @@
+"""Deterministic, seedable fault injection: named sites, reproducible drills.
+
+The reference system's only fault story is Spark task retry at the cluster
+layer (SURVEY.md §5.3) — failures happen TO it, never AS an input. Here
+failure is a first-class, testable input: code paths that can die in
+production declare a **named fault site** (``fault_point("checkpoint.save",
+index=epoch)``), and a drill arms a :class:`FaultSpec` against that site —
+programmatically (``arm``), through the job spec (``TrainJobConfig.faults``),
+or through the ``TPUFLOW_FAULTS`` environment variable (which child
+processes inherit, so supervisor drills need no plumbing).
+
+Every firing rule is deterministic:
+
+- ``nth=K``    — fire on the K-th call to the site, once (one-shot by count).
+- ``at=K``     — fire when the site's ``index`` equals K, once (one-shot by
+  index — e.g. "the epoch-3 checkpoint write").
+- ``p=F,seed=S`` — fire each call with probability F from a private
+  ``random.Random(S)`` stream, so a probabilistic soak replays identically.
+
+Fire modes:
+
+- ``mode=raise`` (default) — raise :class:`FaultInjected`; with
+  ``transient=1`` raise :class:`TransientFault` instead, which the I/O
+  retry policy (``resilience/retry.py``) absorbs like a flaky disk.
+- ``mode=exit`` — ``os._exit(code)``: a preemption/OOM-kill stand-in with
+  no Python cleanup (the supervisor's detect-and-restart drill).
+- ``mode=hang`` — sleep forever at the site: a wedged I/O backend, the
+  supervisor's stall-watchdog drill.
+
+The text grammar (one entry per ``;`` in ``TPUFLOW_FAULTS``, or one string
+per ``TrainJobConfig.faults`` element)::
+
+    site[,key=value...]
+    e.g.  checkpoint.save,at=3,mode=exit
+          stream.read,nth=2,transient=1
+          serve.execute,p=0.25,seed=7
+
+``SITES`` is the canonical catalog; arming an unknown site fails loudly (a
+typo'd drill that silently never fires would fake a passing drill), and a
+tier-1 self-check asserts the catalog, the installed ``fault_point`` calls,
+and the docs/resilience.md table all agree.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+# The canonical fault-site catalog: name -> where it is installed.
+# tests/test_resilience.py asserts this dict, the fault_point() calls in
+# the source tree, and the docs/resilience.md catalog all name the same
+# sites — docs and code cannot drift.
+SITES: dict[str, str] = {
+    "checkpoint.save": "train/checkpoint.py + train/resume.py: every "
+    "Orbax save (best-params and full-run-state); index = epoch",
+    "checkpoint.restore": "train/checkpoint.py + train/resume.py: every "
+    "Orbax restore (serving load and resume)",
+    "csv.read": "data/csv_io.py: whole-file CSV ingest",
+    "stream.read": "data/stream.py: one streamed CSV chunk parse",
+    "serve.execute": "serve.py JobRunner._execute: start of every "
+    "train/compare/sweep job",
+    "train.epoch_start": "train/loop.py: top of each epoch, before any "
+    "work (a crash here REPLAYS the epoch after resume); index = epoch",
+    "train.epoch_end": "train/loop.py: after an epoch's bookkeeping "
+    "(the legacy fault_epoch point); index = epoch",
+}
+
+# Sites whose fault_point() passes an index (the at= reproducibility
+# key). An at= spec on any other site could never fire — rejected at
+# arm time, per this module's fail-loud promise.
+INDEXED_SITES = frozenset({
+    "checkpoint.save", "checkpoint.restore",
+    "train.epoch_start", "train.epoch_end",
+})
+
+
+class FaultInjected(RuntimeError):
+    """An armed fault fired. ``site`` names where; ``spec`` is the spec."""
+
+    def __init__(self, message: str, site: str):
+        super().__init__(message)
+        self.site = site
+
+
+class TransientFault(FaultInjected):
+    """A fault the I/O retry policy treats as retryable (a flaky disk, a
+    dropped connection) — absorbed by ``retry_call`` instead of killing
+    the attempt."""
+
+
+@dataclass
+class FaultSpec:
+    """One armed fault: where, when, and how it fires."""
+
+    site: str
+    nth: int | None = None  # fire on the nth call (1-based), one-shot
+    at: int | None = None  # fire when index == at, one-shot
+    p: float = 0.0  # fire probability per call (persistent)
+    seed: int = 0  # seeds the private probability stream
+    mode: str = "raise"  # raise | exit | hang
+    code: int = 42  # exit code for mode=exit
+    transient: bool = False  # raise TransientFault (retryable) instead
+    on_fire: Callable | None = None  # called just before exit/raise
+    # internal state
+    hits: int = 0
+    fired: int = 0
+    _rng: random.Random | None = field(default=None, repr=False)
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(
+                f"unknown fault site {self.site!r}; known: {sorted(SITES)}"
+            )
+        if self.mode not in ("raise", "exit", "hang"):
+            raise ValueError(
+                f"fault mode must be raise|exit|hang, got {self.mode!r}"
+            )
+        if self.nth is None and self.at is None and not self.p:
+            raise ValueError(
+                f"fault spec for {self.site!r} never fires: set nth=, at=, "
+                "or p="
+            )
+        if self.at is not None and self.site not in INDEXED_SITES:
+            raise ValueError(
+                f"fault site {self.site!r} passes no index, so at="
+                f"{self.at} could never fire (a drill that silently never "
+                f"fires fakes a pass); use nth= or p= here — at= works on "
+                f"{sorted(INDEXED_SITES)}"
+            )
+        if self.p:
+            self._rng = random.Random(self.seed)
+
+    def describe(self) -> str:
+        when = (
+            f"nth={self.nth}" if self.nth is not None
+            else f"at={self.at}" if self.at is not None
+            else f"p={self.p},seed={self.seed}"
+        )
+        return f"{self.site},{when},mode={self.mode}"
+
+
+def parse_fault_spec(text: str) -> FaultSpec:
+    """Parse one ``site[,key=value...]`` entry into a FaultSpec."""
+    parts = [p.strip() for p in text.strip().split(",") if p.strip()]
+    if not parts:
+        raise ValueError("empty fault spec")
+    kwargs: dict = {"site": parts[0]}
+    casts = {
+        "nth": int, "at": int, "p": float, "seed": int, "code": int,
+        "mode": str, "transient": lambda v: bool(int(v)),
+    }
+    for opt in parts[1:]:
+        if "=" not in opt:
+            raise ValueError(
+                f"fault spec option {opt!r} must be key=value "
+                f"(in {text!r})"
+            )
+        key, value = opt.split("=", 1)
+        key = key.strip()
+        if key not in casts:
+            raise ValueError(
+                f"unknown fault spec option {key!r} (in {text!r}); "
+                f"known: {sorted(casts)}"
+            )
+        kwargs[key] = casts[key](value.strip())
+    return FaultSpec(**kwargs)
+
+
+_LOCK = threading.Lock()
+_ARMED: dict[str, list[FaultSpec]] = {}
+_FIRED_LOG: list[dict] = []  # {site, spec, index} per firing — for tests
+_ENV_CACHE: str | None = None  # last TPUFLOW_FAULTS value parsed
+_ENV_SPECS: list[FaultSpec] = []
+
+
+def arm(spec: FaultSpec) -> FaultSpec:
+    """Activate a fault spec; returns it (the handle for ``disarm``)."""
+    with _LOCK:
+        _ARMED.setdefault(spec.site, []).append(spec)
+    return spec
+
+
+def disarm(spec: FaultSpec) -> None:
+    with _LOCK:
+        specs = _ARMED.get(spec.site, [])
+        if spec in specs:
+            specs.remove(spec)
+
+
+def clear_faults() -> None:
+    """Disarm everything. The env cache is reset too, so a TPUFLOW_FAULTS
+    value re-set after a clear re-arms at the next fault_point — even
+    when the value is byte-identical to the one just cleared."""
+    global _ENV_CACHE
+    with _LOCK:
+        _ARMED.clear()
+        _FIRED_LOG.clear()
+        _ENV_SPECS.clear()
+        _ENV_CACHE = None
+
+
+def armed() -> list[FaultSpec]:
+    with _LOCK:
+        return [s for specs in _ARMED.values() for s in specs]
+
+
+def fired_log() -> list[dict]:
+    with _LOCK:
+        return list(_FIRED_LOG)
+
+
+def _sync_env_locked() -> None:
+    """(Re)arm the TPUFLOW_FAULTS specs whenever the env value changes —
+    so a test's monkeypatch.setenv takes effect without any install call,
+    and child processes inherit drills through the environment alone."""
+    global _ENV_CACHE
+    value = os.environ.get("TPUFLOW_FAULTS", "")
+    if value == _ENV_CACHE:
+        return
+    for spec in _ENV_SPECS:
+        specs = _ARMED.get(spec.site, [])
+        if spec in specs:
+            specs.remove(spec)
+    _ENV_SPECS.clear()
+    # Parse EVERY entry before arming ANY, and update the cache only
+    # after a clean parse: a typo'd second entry must not leave the
+    # first one armed with the rest silently dropped — and because the
+    # cache stays stale on failure, EVERY subsequent fault_point keeps
+    # raising until the env is fixed (fail-loud, not fail-once).
+    new_specs = [
+        parse_fault_spec(entry)
+        for entry in value.split(";")
+        if entry.strip()
+    ]
+    _ENV_CACHE = value
+    for spec in new_specs:
+        _ARMED.setdefault(spec.site, []).append(spec)
+        _ENV_SPECS.append(spec)
+
+
+def fault_point(site: str, index: int | None = None) -> None:
+    """Declare a named injection site; fires any armed spec that matches.
+
+    ``index`` is the site's reproducibility key (the epoch for training
+    sites, the checkpoint step for save sites) — what ``at=`` matches.
+    A site with nothing armed costs one env-string compare and one dict
+    lookup; hot loops can afford it.
+    """
+    if site not in SITES:
+        raise RuntimeError(
+            f"fault_point({site!r}) is not in the SITES catalog — add it "
+            "to tpuflow/resilience/faults.py and docs/resilience.md"
+        )
+    to_fire: FaultSpec | None = None
+    with _LOCK:
+        _sync_env_locked()
+        specs = _ARMED.get(site)
+        if not specs:
+            return
+        for spec in specs:
+            spec.hits += 1
+            fire = False
+            if spec.nth is not None:
+                fire = spec.hits == spec.nth
+            elif spec.at is not None:
+                fire = index is not None and index == spec.at
+            elif spec.p:
+                fire = spec._rng.random() < spec.p
+            if fire:
+                spec.fired += 1
+                _FIRED_LOG.append(
+                    {"site": site, "spec": spec.describe(), "index": index}
+                )
+                if spec.nth is not None or spec.at is not None:
+                    specs.remove(spec)  # one-shot: never double-fires
+                to_fire = spec
+                break
+    if to_fire is None:
+        return
+    if to_fire.on_fire is not None:
+        to_fire.on_fire()
+    message = (
+        f"injected fault at {site!r} (spec {to_fire.describe()}, "
+        f"index={index})"
+    )
+    if to_fire.mode == "exit":
+        os._exit(to_fire.code)
+    if to_fire.mode == "hang":
+        while True:  # a wedged backend: only a kill gets out
+            time.sleep(3600)
+    if to_fire.transient:
+        raise TransientFault(message, site)
+    raise FaultInjected(message, site)

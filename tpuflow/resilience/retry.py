@@ -1,0 +1,136 @@
+"""Retry with exponential backoff + jitter + deadline for transient I/O.
+
+The reference absorbed transient failure at the cluster layer: a Spark
+task that died on a flaky HDFS read was simply rerun (SURVEY.md §5.3).
+tpuflow's equivalent has to live at the I/O call sites — checkpoint
+storage writes/restores and CSV/stream reads — where a transient error
+(NFS hiccup, gs:// 503, a briefly-missing mount) should cost a short
+sleep, not the whole training attempt.
+
+``retry_call(policy, fn)`` retries ``fn`` on :class:`TransientFault`
+(injected drills) and the policy's ``retry_on`` exception types (OSError
+by default — the real-world transient class). Everything else — a
+malformed CSV's ValueError, a real bug — propagates immediately:
+retrying a deterministic failure just triples its latency.
+
+Delays follow ``min(base * multiplier**attempt, max_delay)`` with
+``±jitter`` proportional noise (decorrelates fleet-wide retry storms)
+and a total ``deadline``; with ``seed`` set the jitter stream is
+deterministic, so a drill's timing replays exactly. ``sleep`` is
+injectable for zero-wall-clock tests.
+
+Env knobs (read by :func:`io_policy`, the policy every built-in site
+uses): ``TPUFLOW_RETRY_ATTEMPTS`` (default 4), ``TPUFLOW_RETRY_BASE``
+(seconds, default 0.05), ``TPUFLOW_RETRY_MAX`` (default 2.0),
+``TPUFLOW_RETRY_DEADLINE`` (default 30).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+from tpuflow.resilience.faults import TransientFault
+
+# OSError subclasses that are DETERMINISTIC in practice — a typo'd path
+# or a permissions misconfiguration replays identically on every
+# attempt, so retrying only adds latency and misleading "transient ...
+# retrying" log lines. Never treated as transient (an explicit injected
+# TransientFault still retries, whatever it subclasses).
+NON_TRANSIENT_OSERRORS = (
+    FileNotFoundError,
+    IsADirectoryError,
+    NotADirectoryError,
+    PermissionError,
+)
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff + jitter + deadline."""
+
+    max_attempts: int = 4
+    base_delay: float = 0.05  # seconds before the first retry
+    multiplier: float = 2.0
+    max_delay: float = 2.0
+    jitter: float = 0.5  # ± fraction of the delay
+    deadline: float | None = 30.0  # total budget across attempts, seconds
+    retry_on: tuple = (OSError,)  # TransientFault is always retryable
+    seed: int | None = None  # deterministic jitter stream when set
+    sleep: Callable[[float], None] = field(default=time.sleep, repr=False)
+
+    def is_transient(self, exc: BaseException) -> bool:
+        if isinstance(exc, TransientFault):
+            return True
+        if isinstance(exc, NON_TRANSIENT_OSERRORS):
+            return False
+        return isinstance(exc, self.retry_on)
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        """Backoff before retry number ``attempt`` (1-based)."""
+        base = min(
+            self.base_delay * self.multiplier ** (attempt - 1),
+            self.max_delay,
+        )
+        if not self.jitter:
+            return base
+        return base * (1.0 + self.jitter * (2.0 * rng.random() - 1.0))
+
+
+def retry_call(policy: RetryPolicy, fn: Callable, *args, **kwargs):
+    """Call ``fn`` under ``policy``; returns its result or raises the
+    last transient error once attempts/deadline are exhausted (tagged
+    with ``retry_attempts`` so the failure names how hard it tried)."""
+    rng = random.Random(policy.seed) if policy.seed is not None else random
+    start = time.monotonic()
+    for attempt in range(1, policy.max_attempts + 1):
+        try:
+            return fn(*args, **kwargs)
+        except BaseException as e:
+            if not policy.is_transient(e) or attempt == policy.max_attempts:
+                e.retry_attempts = attempt
+                raise
+            delay = policy.delay(attempt, rng)
+            if (
+                policy.deadline is not None
+                and time.monotonic() - start + delay > policy.deadline
+            ):
+                e.retry_attempts = attempt
+                raise
+            print(
+                f"tpuflow.resilience: transient {type(e).__name__} "
+                f"(attempt {attempt}/{policy.max_attempts}), retrying in "
+                f"{delay:.3f}s: {e}",
+                file=sys.stderr,
+            )
+            policy.sleep(delay)
+
+
+def retryable(policy: RetryPolicy):
+    """Decorator form of ``retry_call``."""
+
+    def wrap(fn):
+        def inner(*args, **kwargs):
+            return retry_call(policy, fn, *args, **kwargs)
+
+        inner.__name__ = getattr(fn, "__name__", "retryable")
+        inner.__doc__ = fn.__doc__
+        return inner
+
+    return wrap
+
+
+def io_policy() -> RetryPolicy:
+    """The shared policy for the built-in I/O sites, env-tunable (see the
+    module docstring). Built per call so a test's env tweak applies
+    without reloads — construction is a few float parses."""
+    return RetryPolicy(
+        max_attempts=max(int(os.environ.get("TPUFLOW_RETRY_ATTEMPTS", 4)), 1),
+        base_delay=float(os.environ.get("TPUFLOW_RETRY_BASE", 0.05)),
+        max_delay=float(os.environ.get("TPUFLOW_RETRY_MAX", 2.0)),
+        deadline=float(os.environ.get("TPUFLOW_RETRY_DEADLINE", 30.0)),
+    )

@@ -25,10 +25,15 @@ API (JSON in/out):
   ``{"storagePath", "model", "data": <csv path>}`` or
   ``{"storagePath", "model", "columns": {name: [values...]}}`` →
   ``{"predictions": [...], "count"}``. Loaded artifacts are cached.
+  When the artifact's checkpoint is missing/corrupt, answers degrade to
+  the Gilbert physical baseline with ``degraded: true`` in the response
+  (docs/resilience.md — the degraded-serving contract).
 - ``GET  /metrics``     — service counters: jobs
   submitted/done/failed/queued/running, predictor cache
-  hits/loads/invalidations, uptime.
-- ``GET  /health``      — liveness probe.
+  hits/loads/invalidations (+ degraded_requests/fallback_loads), uptime.
+- ``GET  /health``      — liveness + degradation (``/healthz`` alias):
+  ``status`` is ``ok`` or ``degraded``, with the artifacts currently
+  served by the fallback.
 
 The spec accepts the reference's camelCase submission fields
 (``columnNames``, ``columnTypes``, ``targetColumn``, ``storagePath``,
@@ -765,6 +770,12 @@ class JobRunner:
         return [{**ident(r), "error": reason} for r, reason in rpt.failed]
 
     def _execute(self, kind, config, stop_fn=None) -> dict:
+        from tpuflow.resilience import fault_point
+
+        # Registered fault site: a drill armed here fails THE JOB through
+        # the worker's normal error path (status "failed", queue alive) —
+        # proving job-level failure containment without a real crash.
+        fault_point("serve.execute")
         name, arg = kind
         if name == "train":
             from tpuflow.api import train
@@ -831,18 +842,42 @@ class JobRunner:
 class PredictService:
     """Synchronous serving over trained artifacts, with a Predictor cache
     (loading parses the sidecar + restores params — do it once per
-    artifact, not per request)."""
+    artifact, not per request).
 
-    def __init__(self):
+    Graceful degradation (``gilbert_fallback=True``, the default): when
+    an artifact fails to LOAD — checkpoint missing, corrupt, storage
+    gone — requests are answered by the paper's own physical baseline
+    (``resilience/degraded.py``: the Gilbert choke equation) instead of
+    500s, with ``degraded: true`` in every response and the artifact
+    listed in ``/healthz``. Two recovery paths: a retrain that rewrites
+    the artifact invalidates the cache entry immediately, and every
+    cached fallback expires after ``degraded_retry_seconds`` (30) so a
+    TRANSIENT load failure (storage briefly unreachable) re-probes the
+    real artifact on its own instead of serving physics forever.
+    Request-shaped errors (bad columns, malformed specs) still fail
+    loudly; only load failures degrade."""
+
+    def __init__(
+        self,
+        gilbert_fallback: bool = True,
+        degraded_retry_seconds: float = 30.0,
+    ):
         self._cache: dict[tuple[str, str], object] = {}
         self._lock = threading.Lock()  # guards the dicts, never held on load
         self._key_locks: dict[tuple[str, str], threading.Lock] = {}
+        self.gilbert_fallback = gilbert_fallback
         self.stats = {
             "requests": 0, "cache_hits": 0, "loads": 0, "invalidations": 0,
+            "degraded_requests": 0, "fallback_loads": 0,
         }
         # Invalidation generation per key: a load that STARTED before an
         # invalidate() must not re-cache its (stale) result after it.
         self._gen: dict[tuple[str, str], int] = {}
+        self.degraded_retry_seconds = degraded_retry_seconds
+        # Artifacts currently served degraded: key -> load-failure reason.
+        self._degraded: dict[tuple[str, str], str] = {}
+        # When each fallback entry was cached (monotonic), for the TTL.
+        self._degraded_at: dict[tuple[str, str], float] = {}
 
     def metrics(self) -> dict:
         """Counter snapshot under the lock — one consistent view, matching
@@ -851,19 +886,50 @@ class PredictService:
             return dict(self.stats)
 
     def invalidate(self, storage_path: str, name: str) -> None:
-        """Drop a cached artifact (called when a job rewrites it)."""
+        """Drop a cached artifact (called when a job rewrites it) —
+        including a degraded fallback entry, so a successful retrain is
+        the recovery path out of degraded mode."""
         key = (storage_path, name)
         with self._lock:
             self._cache.pop(key, None)
+            self._degraded.pop(key, None)
+            self._degraded_at.pop(key, None)
             self._gen[key] = self._gen.get(key, 0) + 1
             self.stats["invalidations"] += 1
+
+    def degraded(self) -> list[dict]:
+        """Artifacts currently answering in degraded (Gilbert) mode."""
+        with self._lock:
+            return [
+                {"storage_path": sp, "model": name, "reason": reason}
+                for (sp, name), reason in self._degraded.items()
+            ]
+
+    def _cached_locked(self, key):
+        """Cache lookup under ``self._lock`` (caller holds it). A
+        degraded entry past its TTL reads as a miss — and is evicted —
+        so the next load re-probes the real artifact: a fallback cached
+        during a transient storage outage must not outlive the outage."""
+        cached = self._cache.get(key)
+        if cached is None:
+            return None
+        if getattr(cached, "degraded", False):
+            import time as _time
+
+            at = self._degraded_at.get(key, 0.0)
+            if _time.monotonic() - at > self.degraded_retry_seconds:
+                self._cache.pop(key, None)
+                self._degraded.pop(key, None)
+                self._degraded_at.pop(key, None)
+                return None
+        return cached
 
     def _predictor(self, storage_path: str, name: str):
         from tpuflow.api.predict_api import Predictor
 
         key = (storage_path, name)
         with self._lock:
-            cached = self._cache.get(key)
+            cached = self._cached_locked(key)
             if cached is not None:
                 self.stats["cache_hits"] += 1
                 return cached
@@ -873,12 +939,47 @@ class PredictService:
         # other artifacts.
         with key_lock:
             with self._lock:
-                cached = self._cache.get(key)
+                cached = self._cached_locked(key)
                 if cached is not None:
                     self.stats["cache_hits"] += 1
                     return cached
                 gen = self._gen.get(key, 0)
-            loaded = Predictor.load(storage_path, name)
+            try:
+                loaded = Predictor.load(storage_path, name)
+            except Exception as e:
+                # Checkpoint missing/corrupt/unreachable — the
+                # degradation trigger. try_fallback returns None when
+                # the sidecar is gone too (nothing proves the artifact
+                # ever existed; a typo'd model name must keep failing
+                # loudly, not be silently answered by physics).
+                if not self.gilbert_fallback:
+                    raise
+                from tpuflow.resilience import try_fallback
+
+                reason = f"{type(e).__name__}: {e}"
+                loaded = try_fallback(storage_path, name, reason)
+                if loaded is None:
+                    raise
+                import sys
+
+                print(
+                    f"tpuflow.serve: artifact {name!r} failed to load "
+                    f"({reason}); serving DEGRADED (Gilbert baseline)",
+                    file=sys.stderr,
+                )
+                import time as _time
+
+                with self._lock:
+                    self.stats["fallback_loads"] += 1
+                    if self._gen.get(key, 0) == gen:
+                        # Cache the fallback too (no per-request load
+                        # storm against dead storage); evicted by any
+                        # retrain (invalidate) or by the degraded TTL —
+                        # the two recovery paths.
+                        self._cache[key] = loaded
+                        self._degraded[key] = reason
+                        self._degraded_at[key] = _time.monotonic()
+                return loaded
             with self._lock:
                 # Counted only AFTER a successful load: a missing/corrupt
                 # artifact that raises must not inflate the loads number.
@@ -910,7 +1011,16 @@ class PredictService:
         else:
             raise ValueError("predict needs data (csv path) or columns")
         y = np.asarray(y)
-        return {"predictions": y.tolist(), "count": int(len(y))}
+        out = {"predictions": y.tolist(), "count": int(len(y))}
+        if getattr(pred, "degraded", False):
+            # The caller must be able to tell physics-fallback answers
+            # from model answers — degraded mode is honest, not silent.
+            out["degraded"] = True
+            out["fallback"] = "gilbert"
+            out["degraded_reason"] = pred.reason
+            with self._lock:
+                self.stats["degraded_requests"] += 1
+        return out
 
 
 def make_server(
@@ -952,8 +1062,17 @@ def make_server(
         def do_GET(self):
             route = self._route()
             parts = route.split("/")
-            if route in ("", "/health"):
-                self._send(200, {"status": "ok"})
+            if route in ("", "/health", "/healthz"):
+                # Liveness plus degradation: a load-balancer health poll
+                # sees "degraded" (still 200 — the service IS answering,
+                # from the physical baseline) and which artifacts fell
+                # back, so degraded serving is operable, not invisible.
+                deg = predictor.degraded()
+                self._send(200, {
+                    "status": "degraded" if deg else "ok",
+                    "degraded": bool(deg),
+                    "degraded_artifacts": deg,
+                })
             elif route == "/jobs":
                 self._send(200, runner.list())
             elif route == "/metrics":

@@ -15,6 +15,7 @@ from typing import Any
 import jax
 import orbax.checkpoint as ocp
 
+from tpuflow.resilience import fault_point, io_policy, retry_call
 from tpuflow.utils.paths import join_path
 
 
@@ -51,12 +52,25 @@ class BestCheckpointer:
 
         The keep/drop decision is made synchronously from ``val_loss``;
         with async_save only the array write happens in the background.
+        The shared I/O retry policy wraps the ``save`` call, so with
+        ``async_save=False`` (where Orbax writes synchronously inside
+        ``save``) transient storage errors are fully absorbed; with
+        async saves only the enqueue is covered — a background-write
+        failure surfaces at the next wait point (``best_step``/
+        ``close``), where Orbax's atomic commit means the PREVIOUS
+        checkpoint is still intact. ``checkpoint.save`` is a registered
+        fault site keyed by the step.
         """
-        saved = self._mngr.save(
-            step,
-            args=ocp.args.StandardSave(params),
-            metrics={"val_loss": float(val_loss)},
-        )
+
+        def _save():
+            fault_point("checkpoint.save", index=step)
+            return self._mngr.save(
+                step,
+                args=ocp.args.StandardSave(params),
+                metrics={"val_loss": float(val_loss)},
+            )
+
+        saved = retry_call(io_policy(), _save)
         if not self._async:
             self._mngr.wait_until_finished()
         return bool(saved)
@@ -67,19 +81,27 @@ class BestCheckpointer:
         return self._mngr.best_step()
 
     def restore_best(self, params_like: Any | None = None) -> Any:
-        """Restore the best params (optionally into an example structure)."""
+        """Restore the best params (optionally into an example structure).
+
+        Transient read errors retry under the shared I/O policy;
+        ``checkpoint.restore`` is a registered fault site."""
         self._mngr.wait_until_finished()
         step = self._mngr.best_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
-        if params_like is not None:
-            abstract = jax.tree_util.tree_map(
-                ocp.utils.to_shape_dtype_struct, params_like
-            )
-            return self._mngr.restore(
-                step, args=ocp.args.StandardRestore(abstract)
-            )
-        return self._mngr.restore(step)
+
+        def _restore():
+            fault_point("checkpoint.restore", index=step)
+            if params_like is not None:
+                abstract = jax.tree_util.tree_map(
+                    ocp.utils.to_shape_dtype_struct, params_like
+                )
+                return self._mngr.restore(
+                    step, args=ocp.args.StandardRestore(abstract)
+                )
+            return self._mngr.restore(step)
+
+        return retry_call(io_policy(), _restore)
 
     def close(self):
         self._mngr.close()

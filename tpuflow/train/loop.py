@@ -19,6 +19,7 @@ import numpy as np
 
 from tpuflow.core.losses import mae_clip
 from tpuflow.data.pipeline import ArrayDataset, batches
+from tpuflow.resilience import fault_point
 from tpuflow.train.callbacks import EarlyStopping
 from tpuflow.train.checkpoint import BestCheckpointer
 from tpuflow.train.steps import make_eval_step, make_train_step
@@ -92,10 +93,11 @@ class FitConfig:
     # Fault injection (SURVEY §5.3): simulate a preemption by killing the
     # PROCESS (os._exit — no Python cleanup, like the real thing) right
     # after this epoch's bookkeeping. A resumed run never re-fires it
-    # (the fault guard requires resume=False), so one injection means one
-    # preemption however the retry is driven. This is how the
-    # supervisor's detect-and-restart path is exercised for real
-    # (tests/test_supervisor.py).
+    # (arming requires resume=False), so one injection means one
+    # preemption however the retry is driven. Now a thin alias over the
+    # resilience fault registry: fit() arms it as an exit fault at the
+    # ``train.epoch_end`` site (tpuflow/resilience/faults.py), the same
+    # machinery every TPUFLOW_FAULTS / config.faults drill rides.
     fault_epoch: int | None = None
     # ckpt_async: background (async) checkpoint writes, the default.
     # False = synchronous saves: every process completes the write (and
@@ -119,6 +121,13 @@ class FitConfig:
     # enormous epoch (or a long XLA compile) is not interruptible — the
     # job-runner documents the same.
     stop_fn: Callable[[], str | None] | None = None
+    # Liveness: overwrite this file with {"epoch": N, "time": ...} after
+    # every completed epoch. The supervisor's stall watchdog reads it —
+    # a child whose progress file stops changing is killed and restarted,
+    # which a whole-attempt timeout cannot distinguish from slow-but-
+    # alive. Best-effort: a failing write logs once and never kills
+    # training.
+    progress_path: str | None = None
 
 
 @dataclass
@@ -232,12 +241,48 @@ def fit(
 
         mlog = MetricsLogger(config.metrics_path)
 
+    # The legacy fault_epoch knob, re-expressed as a registry drill: an
+    # exit fault at the train.epoch_end site. Soft (default) commits
+    # in-flight async checkpoint writes first so single-process resume
+    # drills are epoch-deterministic; fault_hard skips the commit — the
+    # truthful preemption (see the FitConfig comments). Never armed on a
+    # resumed run (the recovery is not the victim), and armed LAST,
+    # immediately before the try whose finally disarms it: a setup
+    # failure in between would leak a process-global exit fault into a
+    # later job in the same process.
+    armed_faults = []
+    if config.fault_epoch is not None and not config.resume:
+        from tpuflow.resilience import FaultSpec, arm
+
+        def _commit_before_exit():
+            if not config.fault_hard:
+                if run_ckpt is not None:
+                    run_ckpt.close()
+                if ckpt is not None:
+                    ckpt.close()
+
+        armed_faults.append(
+            arm(
+                FaultSpec(
+                    site="train.epoch_end",
+                    at=config.fault_epoch,
+                    mode="exit",
+                    code=42,
+                    on_fire=_commit_before_exit,
+                )
+            )
+        )
     try:
         for epoch in range(start_epoch, config.max_epochs + 1):
             if config.stop_fn is not None:
                 reason = config.stop_fn()
                 if reason:
                     raise TrainingInterrupted(reason)
+            # Before any work: a crash armed here REPLAYS this epoch
+            # after resume — the deterministic same-epoch crash-loop the
+            # supervisor classifies (vs train.epoch_end, whose crash is
+            # survived by this epoch's checkpoint).
+            fault_point("train.epoch_start", index=epoch)
             te = time.time()
             tracing = config.trace_dir is not None and epoch == start_epoch
             if tracing:
@@ -329,27 +374,11 @@ def fit(
                     },
                 )
             result.epochs_ran = epoch
-            if (
-                config.fault_epoch is not None
-                and epoch == config.fault_epoch
-                and not config.resume  # a resumed run is the recovery, not
-                # the victim: never re-fire (even when save_every doesn't
-                # divide fault_epoch and the resumed run replays it)
-            ):
-                # Commit in-flight async checkpoint writes first so the
-                # simulated preemption tests resume-from-THIS-epoch
-                # deterministically (a real preemption may lose the tail
-                # write; Orbax's atomic rename just surfaces the previous
-                # checkpoint in that case). fault_hard skips the commit —
-                # see its FitConfig comment.
-                if not config.fault_hard:
-                    if run_ckpt is not None:
-                        run_ckpt.close()
-                    if ckpt is not None:
-                        ckpt.close()
-                import os
-
-                os._exit(42)
+            if config.progress_path:
+                _write_progress(config.progress_path, epoch)
+            # The legacy fault_epoch fires here (armed above as an exit
+            # spec); env/spec drills at this site ride the same call.
+            fault_point("train.epoch_end", index=epoch)
             if should_stop:
                 break
 
@@ -375,7 +404,38 @@ def fit(
             run_ckpt.close()
         if mlog is not None:
             mlog.close()
+        # An unfired fault_epoch spec (early stop before the fault, or a
+        # max_epochs below it) must not leak into a later fit() in this
+        # process.
+        if armed_faults:
+            from tpuflow.resilience import disarm
+
+            for spec in armed_faults:
+                disarm(spec)
     return result
+
+
+def _write_progress(path: str, epoch: int) -> None:
+    """Overwrite the liveness file with this epoch's progress record —
+    atomically (tmp + rename), so the supervisor's watchdog never reads
+    a torn write. Best-effort: progress is observability, and an
+    unwritable progress file must not kill a healthy training run."""
+    import json
+    import os
+
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"epoch": epoch, "time": time.time()}, f)
+        os.replace(tmp, path)
+    except OSError as e:
+        import sys
+
+        print(
+            f"tpuflow.train: progress write to {path!r} failed "
+            f"({type(e).__name__}: {e}); continuing without liveness",
+            file=sys.stderr,
+        )
 
 
 def _stacked_epoch(ds: ArrayDataset, batch_size: int, seed: int):

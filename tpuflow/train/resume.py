@@ -19,6 +19,7 @@ from typing import Any
 import jax
 import orbax.checkpoint as ocp
 
+from tpuflow.resilience import fault_point, io_policy, retry_call
 from tpuflow.utils.paths import join_path
 
 
@@ -56,13 +57,24 @@ class RunCheckpointer:
         """
         tree = {"params": state.params, "opt_state": state.opt_state,
                 "step": state.step}
-        self._mngr.save(
-            epoch,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(tree),
-                loop=ocp.args.JsonSave(loop),
-            ),
-        )
+
+        def _save():
+            # Shared ``checkpoint.save`` fault site + transient-I/O retry
+            # (Orbax's atomic commit makes a retried save safe). As in
+            # BestCheckpointer.maybe_save: sync saves are fully covered;
+            # async saves cover the enqueue, and a background-write
+            # failure surfaces at the next wait with the previous
+            # checkpoint still intact.
+            fault_point("checkpoint.save", index=epoch)
+            self._mngr.save(
+                epoch,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(tree),
+                    loop=ocp.args.JsonSave(loop),
+                ),
+            )
+
+        retry_call(io_policy(), _save)
         if not self._async:
             self._mngr.wait_until_finished()
 
@@ -86,13 +98,18 @@ class RunCheckpointer:
             "step": state_template.step,
         }
         abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, tree)
-        out = self._mngr.restore(
-            epoch,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract),
-                loop=ocp.args.JsonRestore(),
-            ),
-        )
+
+        def _restore():
+            fault_point("checkpoint.restore", index=epoch)
+            return self._mngr.restore(
+                epoch,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(abstract),
+                    loop=ocp.args.JsonRestore(),
+                ),
+            )
+
+        out = retry_call(io_policy(), _restore)
         state = state_template.replace(
             params=out["state"]["params"],
             opt_state=out["state"]["opt_state"],

@@ -6,10 +6,35 @@ scheduler notices and reruns the task. ``RunCheckpointer`` +
 ``resume=True`` (tpuflow/train/resume.py) give tpuflow the deterministic
 state half of that story; this module adds the *detection and restart*
 half: the training job runs in a child process, the supervisor watches
-its exit status, and any abnormal death (segfault, OOM kill, TPU-backend
-crash, preemption) is answered by relaunching the job with
-``resume=True`` so it continues from the latest full-state checkpoint.
-Together they are the TPU-native equivalent of Spark's retry loop.
+its exit status AND its liveness, and any abnormal death (segfault, OOM
+kill, TPU-backend crash, preemption) is answered by relaunching the job
+with ``resume=True`` so it continues from the latest full-state
+checkpoint. Together they are the TPU-native equivalent of Spark's retry
+loop — hardened three ways beyond rerun-on-death:
+
+- **Restart backoff.** Attempts are separated by exponential backoff
+  with jitter (``backoff_base``/``backoff_max``/``backoff_jitter``): a
+  dying dependency gets time to recover instead of a restart storm.
+- **Crash-loop classification.** The child writes a progress file after
+  every completed epoch (``FitConfig.progress_path``; the supervisor
+  injects the path). When ``crash_loop_threshold`` consecutive attempts
+  die at the SAME progress epoch, the failure is deterministic — a bug,
+  not bad luck — and the supervisor aborts early with
+  :class:`CrashLoopError` naming the epoch, instead of burning the
+  remaining restarts on a foregone conclusion.
+- **Stall watchdog.** ``stall_timeout`` bounds the time between progress
+  updates (not the whole attempt): a child making steady progress can
+  run for hours, while one wedged inside an epoch — a hung collective, a
+  dead storage backend — is killed and restarted. The whole-attempt
+  ``timeout`` cannot make that distinction; both remain available.
+
+Fault drills (tpuflow/resilience): spec-armed faults (``"faults": [...]``
+in the job spec) run on the FIRST attempt only — the supervisor drops
+them (and ``fault_epoch``) from restart specs, so one injection means
+one failure and the recovery runs clean. Faults armed via the
+``TPUFLOW_FAULTS`` environment variable are inherited by every child
+attempt — the deterministic-crash simulation the crash-loop classifier
+is drilled with.
 
 The job is described by the same JSON spec the job-runner service accepts
 (``tpuflow.serve.spec_to_config`` — camelCase or snake_case fields), so a
@@ -20,7 +45,8 @@ refuses to do.
 
 Run from a shell::
 
-    python -m tpuflow.train.supervisor spec.json --max-restarts 3
+    python -m tpuflow.train.supervisor spec.json --max-restarts 3 \
+        --stall-timeout 900
 
 or from Python::
 
@@ -33,10 +59,25 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import subprocess
 import sys
 import tempfile
+import time
 from dataclasses import dataclass, field
+
+from tpuflow.resilience.retry import RetryPolicy
+
+
+class CrashLoopError(RuntimeError):
+    """The same epoch died ``threshold`` consecutive times: the failure
+    is deterministic, restarts cannot fix it. ``epoch`` is the last
+    completed epoch at each death (None = died before the first)."""
+
+    def __init__(self, message: str, epoch: int | None, failures: list):
+        super().__init__(message)
+        self.epoch = epoch
+        self.failures = failures
 
 
 @dataclass
@@ -45,7 +86,9 @@ class SupervisedRun:
 
     report: dict
     attempts: int  # total child launches (1 = no failures)
-    failures: list[dict] = field(default_factory=list)  # {rc, stderr_tail}
+    # {rc, stderr_tail, kind: crash|stall|timeout, progress_epoch}
+    failures: list[dict] = field(default_factory=list)
+    backoffs: list[float] = field(default_factory=list)  # restart delays
 
 
 def _validate(spec: dict) -> None:
@@ -62,70 +105,213 @@ def _validate(spec: dict) -> None:
         )
 
 
+def _read_progress(path: str):
+    """The child's last progress record, or None (no epoch completed /
+    torn write — the write side is atomic, so torn means 'not yet')."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _tail(text: str, n: int = 5) -> str:
+    return "\n".join((text or "").strip().splitlines()[-n:])
+
+
+def _run_attempt(
+    cmd: list[str],
+    out_dir: str,
+    progress_path: str,
+    timeout: float | None,
+    stall_timeout: float | None,
+    poll_interval: float,
+) -> tuple[int | None, str, str]:
+    """One child attempt under the watchdog.
+
+    Returns ``(returncode, stderr_text, kind)`` where kind is "" for a
+    natural exit, "timeout" for the whole-attempt cap, "stall" for a
+    progress watchdog kill. Child stdout/stderr go to files (a pipe the
+    supervisor isn't draining would block a chatty child at the 64KB
+    buffer — the watchdog must never cause the hang it watches for).
+    """
+    stdout_path = os.path.join(out_dir, "stdout.log")
+    stderr_path = os.path.join(out_dir, "stderr.log")
+    start = time.monotonic()
+    with open(stdout_path, "w") as out_f, open(stderr_path, "w") as err_f:
+        proc = subprocess.Popen(
+            cmd, stdout=out_f, stderr=err_f, cwd=os.getcwd()
+        )
+        kind = ""
+        if timeout is None and stall_timeout is None:
+            # Nothing to watch for: block like subprocess.run would,
+            # instead of spinning an hours-long training at
+            # poll_interval.
+            rc = proc.wait()
+        else:
+            # Stall clock: starts at launch (compile time counts — pick
+            # a stall_timeout above the first-epoch compile) and resets
+            # on every progress-file change, INCLUDING content inherited
+            # from the previous attempt (we track change, not absolute
+            # epoch). The file is only read when a stall watchdog is
+            # armed.
+            last_progress = _read_progress(progress_path)
+            last_change = start
+            while True:
+                rc = proc.poll()
+                if rc is not None:
+                    break
+                now = time.monotonic()
+                if timeout is not None and now - start > timeout:
+                    kind = "timeout"
+                elif stall_timeout is not None:
+                    cur = _read_progress(progress_path)
+                    if cur != last_progress:
+                        last_progress, last_change = cur, now
+                    elif now - last_change > stall_timeout:
+                        kind = "stall"
+                if kind:
+                    proc.kill()
+                    proc.wait()
+                    rc = None  # killed by the supervisor, not a child exit
+                    break
+                time.sleep(poll_interval)
+    with open(stderr_path, encoding="utf-8") as f:
+        stderr_text = f.read()
+    return rc, stderr_text, kind
+
+
 def supervise(
     spec: dict,
     *,
     max_restarts: int = 3,
     timeout: float | None = None,
+    stall_timeout: float | None = None,
+    backoff_base: float = 1.0,
+    backoff_max: float = 60.0,
+    backoff_jitter: float = 0.25,
+    backoff_seed: int | None = None,
+    crash_loop_threshold: int = 3,
+    poll_interval: float = 0.05,
     python: str = sys.executable,
     verbose: bool = True,
+    sleep=time.sleep,
 ) -> SupervisedRun:
     """Run the training job described by ``spec``, restarting on crashes.
 
     Each attempt is a fresh child process; attempts after the first run
-    with ``resume=True`` so they continue from the latest run checkpoint.
-    Returns once an attempt exits cleanly; raises ``RuntimeError`` after
-    ``max_restarts`` restarts all die.
+    with ``resume=True`` so they continue from the latest run checkpoint,
+    after an exponential-backoff delay. Returns once an attempt exits
+    cleanly. Raises :class:`CrashLoopError` when ``crash_loop_threshold``
+    consecutive attempts die at the same progress epoch (deterministic
+    failure — restarts are futile), or ``RuntimeError`` after
+    ``max_restarts`` restarts all die. ``stall_timeout`` kills an attempt
+    whose progress file stops changing for that many seconds; ``timeout``
+    caps the whole attempt. ``sleep`` is injectable for tests.
     """
     _validate(spec)
     failures: list[dict] = []
-    for attempt in range(1, max_restarts + 2):
-        attempt_spec = dict(spec)
-        if attempt > 1:
-            attempt_spec["resume"] = True
-            # An injected fault is one-shot by construction (the resumed
-            # run starts past it); leaving it in the spec is harmless but
-            # dropping it keeps restart specs describing only real work.
-            attempt_spec.pop("fault_epoch", None)
-        with tempfile.TemporaryDirectory() as td:
-            spec_path = os.path.join(td, "spec.json")
-            out_path = os.path.join(td, "report.json")
+    backoffs: list[float] = []
+    rng = random.Random(backoff_seed) if backoff_seed is not None else random
+    backoff_policy = RetryPolicy(
+        base_delay=backoff_base, max_delay=backoff_max,
+        jitter=backoff_jitter,
+    )
+    with tempfile.TemporaryDirectory() as run_dir:
+        # ONE progress file across attempts: crash-loop classification
+        # compares the last-completed epoch at consecutive deaths, and a
+        # resumed attempt that dies before completing anything must read
+        # as "same epoch again", not "no progress file".
+        progress_path = os.path.join(run_dir, "progress.json")
+        for attempt in range(1, max_restarts + 2):
+            attempt_spec = dict(spec)
+            attempt_spec["progress_path"] = progress_path
+            if attempt > 1:
+                attempt_spec["resume"] = True
+                # Spec-armed fault drills are one-shot by design: the
+                # restart is the recovery, and it runs clean. Faults that
+                # must persist across restarts (the deterministic-crash
+                # simulation) go through TPUFLOW_FAULTS, which children
+                # inherit from the environment.
+                attempt_spec.pop("fault_epoch", None)
+                attempt_spec.pop("faults", None)
+            attempt_dir = os.path.join(run_dir, f"attempt{attempt}")
+            os.makedirs(attempt_dir, exist_ok=True)
+            spec_path = os.path.join(attempt_dir, "spec.json")
+            out_path = os.path.join(attempt_dir, "report.json")
             with open(spec_path, "w", encoding="utf-8") as f:
                 json.dump(attempt_spec, f)
-            try:
-                proc = subprocess.run(
-                    [python, "-m", "tpuflow.train.supervisor",
-                     "--child", spec_path, out_path],
-                    capture_output=True,
-                    text=True,
-                    timeout=timeout,
-                    cwd=os.getcwd(),
-                )
-            except subprocess.TimeoutExpired:
-                # A hang (e.g. a dead TPU relay) is a failure mode too —
-                # subprocess.run killed the child; restart like a crash.
-                failures.append({"rc": None, "stderr_tail": "timed out"})
-                proc = None
-            if proc is not None and proc.returncode == 0:
+            rc, stderr_text, kind = _run_attempt(
+                [python, "-m", "tpuflow.train.supervisor",
+                 "--child", spec_path, out_path],
+                attempt_dir,
+                progress_path,
+                timeout,
+                stall_timeout,
+                poll_interval,
+            )
+            if rc == 0:
                 with open(out_path, encoding="utf-8") as f:
                     report = json.load(f)
                 return SupervisedRun(
-                    report=report, attempts=attempt, failures=failures
+                    report=report, attempts=attempt, failures=failures,
+                    backoffs=backoffs,
                 )
-        if proc is not None:
-            tail = "\n".join((proc.stderr or "").strip().splitlines()[-5:])
-            failures.append({"rc": proc.returncode, "stderr_tail": tail})
-        if verbose:
-            print(
-                f"supervisor: attempt {attempt} died "
-                f"rc={failures[-1]['rc']}; "
-                + (
-                    "restarting with resume=True"
-                    if attempt <= max_restarts
-                    else "giving up"
+            progress = _read_progress(progress_path)
+            progress_epoch = progress["epoch"] if progress else None
+            failures.append({
+                "rc": rc,
+                "kind": kind or "crash",
+                "stderr_tail": (
+                    "timed out" if kind == "timeout"
+                    else f"stalled: no progress for {stall_timeout:g}s"
+                    if kind == "stall"
+                    else _tail(stderr_text)
                 ),
-                file=sys.stderr,
-            )
+                "progress_epoch": progress_epoch,
+            })
+            # Crash-loop: the SAME last-completed epoch at N consecutive
+            # deaths means the failure replays deterministically; more
+            # restarts only burn the budget. Classified and aborted with
+            # a labeled reason instead.
+            recent = failures[-crash_loop_threshold:]
+            if (
+                len(recent) == crash_loop_threshold
+                and len({f["progress_epoch"] for f in recent}) == 1
+                and all(f["kind"] == "crash" for f in recent)
+            ):
+                where = (
+                    f"after epoch {progress_epoch}"
+                    if progress_epoch is not None
+                    else "before the first epoch completed"
+                )
+                raise CrashLoopError(
+                    f"crash-loop: {crash_loop_threshold} consecutive "
+                    f"attempts died {where} (deterministic failure — "
+                    f"aborting instead of burning restarts); last "
+                    f"stderr: {failures[-1]['stderr_tail']}",
+                    progress_epoch,
+                    failures,
+                )
+            if verbose:
+                print(
+                    f"supervisor: attempt {attempt} died "
+                    f"rc={failures[-1]['rc']} "
+                    f"kind={failures[-1]['kind']}; "
+                    + (
+                        "restarting with resume=True"
+                        if attempt <= max_restarts
+                        else "giving up"
+                    ),
+                    file=sys.stderr,
+                )
+            if attempt <= max_restarts:
+                # The ONE backoff formula (resilience/retry.py): restart
+                # delays and I/O retry delays share exponential growth +
+                # proportional jitter by construction.
+                delay = backoff_policy.delay(attempt, rng)
+                backoffs.append(delay)
+                sleep(delay)
     raise RuntimeError(
         f"job died {len(failures)} times (last rc="
         f"{failures[-1]['rc']}): {failures[-1]['stderr_tail']}"
@@ -157,12 +343,28 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("spec", help="JSON job-spec file (serve.py contract)")
     ap.add_argument("--max-restarts", type=int, default=3)
     ap.add_argument("--timeout", type=float, default=None,
-                    help="per-attempt seconds")
+                    help="per-attempt seconds (whole attempt)")
+    ap.add_argument("--stall-timeout", type=float, default=None,
+                    help="seconds without progress before an attempt is "
+                    "killed as stalled (must exceed first-epoch compile)")
+    ap.add_argument("--backoff-base", type=float, default=1.0,
+                    help="first restart delay, seconds (doubles per "
+                    "restart up to --backoff-max)")
+    ap.add_argument("--backoff-max", type=float, default=60.0)
+    ap.add_argument("--crash-loop-threshold", type=int, default=3,
+                    help="same-epoch consecutive deaths before aborting "
+                    "as a deterministic crash loop")
     args = ap.parse_args(argv)
     with open(args.spec, encoding="utf-8") as f:
         spec = json.load(f)
     run = supervise(
-        spec, max_restarts=args.max_restarts, timeout=args.timeout
+        spec,
+        max_restarts=args.max_restarts,
+        timeout=args.timeout,
+        stall_timeout=args.stall_timeout,
+        backoff_base=args.backoff_base,
+        backoff_max=args.backoff_max,
+        crash_loop_threshold=args.crash_loop_threshold,
     )
     print(json.dumps({"attempts": run.attempts, **run.report}))
 
