@@ -1,0 +1,489 @@
+"""Preflight static analysis (tpuflow/analysis): one unit class per pass,
+the fail-fast wiring, and the self-lint gate that keeps the framework
+itself clean against the lint rule catalog."""
+
+import json
+import textwrap
+
+import pytest
+
+from tpuflow.analysis import PreflightError, ensure_preflight, preflight
+from tpuflow.analysis.artifact import check_artifact_meta
+from tpuflow.analysis.linter import lint_file, lint_package
+from tpuflow.analysis.plan import check_plan
+from tpuflow.analysis.shapes import abstract_batch, shape_dryrun
+from tpuflow.analysis.spec import validate_spec
+from tpuflow.api.config import TrainJobConfig
+
+
+def _codes(diags):
+    return [d.code for d in diags]
+
+
+def _errors(diags):
+    return [d for d in diags if d.severity == "error"]
+
+
+class TestSpecPass:
+    def test_clean_default_config(self):
+        assert _errors(validate_spec(TrainJobConfig())) == []
+
+    def test_unknown_registry_keys_each_carry_choices(self):
+        diags = validate_spec(TrainJobConfig(
+            model="resnet", loss="xent", optimizer="lion"
+        ))
+        codes = _codes(diags)
+        assert "spec.model.unknown" in codes
+        assert "spec.loss.unknown" in codes
+        assert "spec.optimizer.unknown" in codes
+        by_code = {d.code: d for d in diags}
+        assert "static_mlp" in by_code["spec.model.unknown"].choices
+        assert "mae_clip" in by_code["spec.loss.unknown"].choices
+        assert "keras_sgd" in by_code["spec.optimizer.unknown"].choices
+        # every finding names its config field
+        assert by_code["spec.model.unknown"].where == "model"
+
+    def test_schema_count_mismatch(self):
+        diags = validate_spec(TrainJobConfig(
+            column_names="a,b,c", column_types="float,float", target="a"
+        ))
+        assert "spec.schema.invalid" in _codes(diags)
+
+    def test_window_exceeding_synthetic_steps(self):
+        diags = validate_spec(TrainJobConfig(
+            model="lstm", window=100, synthetic_steps=64
+        ))
+        assert "spec.window.empty" in _codes(diags)
+        # tabular families don't window: same knobs, no finding
+        assert "spec.window.empty" not in _codes(validate_spec(
+            TrainJobConfig(model="static_mlp", window=100,
+                           synthetic_steps=64)
+        ))
+
+    def test_stream_knob_sanity(self):
+        diags = validate_spec(TrainJobConfig(
+            model="lstm", stream=True, jit_epoch=True
+        ))
+        codes = _codes(diags)
+        assert "spec.stream.data_path" in codes
+        assert "spec.stream.well_column" in codes
+        assert "spec.stream.jit_epoch" in codes
+
+    def test_bad_fault_spec_lists_site_catalog(self):
+        diags = validate_spec(TrainJobConfig(
+            faults=["chekpoint.save,at=3,mode=exit"]
+        ))
+        (d,) = [d for d in diags if d.code == "spec.faults.invalid"]
+        assert "chekpoint.save" in d.message
+        assert "checkpoint.save" in d.choices
+
+    def test_env_faults_validated(self, monkeypatch):
+        monkeypatch.setenv("TPUFLOW_FAULTS", "no.such.site,nth=1")
+        diags = validate_spec(TrainJobConfig())
+        (d,) = [d for d in diags if d.code == "spec.faults.env"]
+        assert d.where == "TPUFLOW_FAULTS"
+        assert "site[,key=value...]" in d.message
+
+    def test_unserializable_model_kwargs_with_storage(self, tmp_path):
+        diags = validate_spec(TrainJobConfig(
+            model="static_mlp", storage_path=str(tmp_path),
+            model_kwargs={"hidden": object()},
+        ))
+        (d,) = [d for d in diags if d.code == "spec.model_kwargs.json"]
+        assert "JSON-serializable" in d.message
+
+    def test_scalar_ranges(self):
+        codes = _codes(validate_spec(TrainJobConfig(
+            batch_size=0, window=0, patience=-1
+        )))
+        assert "spec.batch_size.range" in codes
+        assert "spec.window.range" in codes
+        assert "spec.patience.range" in codes
+
+
+class TestPlanPass:
+    def test_clean_dp_plan(self):
+        assert _errors(check_plan(
+            TrainJobConfig(model="static_mlp", batch_size=32),
+            device_count=8,
+        )) == []
+
+    def test_non_dividing_tp(self):
+        codes = _codes(check_plan(
+            TrainJobConfig(model="static_mlp", tp=3, batch_size=32),
+            device_count=8,
+        ))
+        assert "plan.tp.devices" in codes
+
+    def test_combined_axes_rejected(self):
+        codes = _codes(check_plan(
+            TrainJobConfig(model="static_mlp", tp=2, pp=2),
+            device_count=8,
+        ))
+        assert codes == ["plan.axis.combined"]
+
+    def test_tp_family_and_hidden_divisibility(self):
+        codes = _codes(check_plan(
+            TrainJobConfig(model="lstm", tp=2, batch_size=32),
+            device_count=8,
+        ))
+        assert "plan.tp.family" in codes
+        codes = _codes(check_plan(
+            TrainJobConfig(model="static_mlp", tp=4, batch_size=32,
+                           model_kwargs={"hidden": (6, 8)}),
+            device_count=8,
+        ))
+        assert "plan.tp.hidden" in codes  # 6 % 4 != 0 (even-index layer)
+
+    def test_pp_stage_and_microbatch_balance(self):
+        cfg = TrainJobConfig(
+            model="pipeline_mlp", pp=3, batch_size=32,
+            model_kwargs={"stages": 4},
+        )
+        codes = _codes(check_plan(cfg, device_count=6))
+        assert "plan.pp.stages" in codes  # 4 stages % 3 devices
+        cfg = TrainJobConfig(
+            model="pipeline_mlp", pp=2, pp_microbatches=3, batch_size=32,
+        )
+        assert "plan.pp.batch" in _codes(check_plan(cfg, device_count=8))
+
+    def test_ep_expert_balance(self):
+        codes = _codes(check_plan(
+            TrainJobConfig(model="moe_mlp", ep=4, batch_size=32,
+                           model_kwargs={"experts": 6}),
+            device_count=8,
+        ))
+        assert "plan.ep.experts" in codes
+
+    def test_dp_batch_divisibility(self):
+        codes = _codes(check_plan(
+            TrainJobConfig(model="static_mlp", batch_size=20),
+            device_count=8,
+        ))
+        assert "plan.dp.batch" in codes
+
+    def test_unknown_device_count_is_only_a_warning(self):
+        diags = check_plan(TrainJobConfig(model="static_mlp", tp=4,
+                                          batch_size=32))
+        assert _errors(diags) == []
+        assert "plan.devices.unknown" in _codes(diags)
+
+    def test_ill_typed_model_kwargs_do_not_crash_the_pass(self):
+        # "never raises" is the contract: a JSON spec can put a list
+        # where the kwargs dict belongs; the pass must keep collecting.
+        for cfg in (
+            TrainJobConfig(model="static_mlp", tp=2, batch_size=32,
+                           model_kwargs=["x"]),
+            TrainJobConfig(model="pipeline_mlp", pp=2, batch_size=32,
+                           model_kwargs={"stages": "four"}),
+            TrainJobConfig(model="moe_mlp", ep=2, batch_size=32,
+                           model_kwargs={"experts": None}),
+            TrainJobConfig(model="static_mlp", tp=2, batch_size=32,
+                           model_kwargs={"hidden": "wide"}),
+        ):
+            check_plan(cfg, device_count=8)  # must not raise
+
+    def test_multihost_constraints(self):
+        cfg = TrainJobConfig(model="static_mlp", tp=4, n_devices=8,
+                             batch_size=32)
+        codes = _codes(check_plan(
+            cfg, device_count=16, local_device_count=2, process_count=8,
+        ))
+        assert "plan.multihost.submesh" in codes  # 8 != 16
+        assert "plan.multihost.local" in codes  # 2 % 4
+
+
+class TestShapePass:
+    def test_clean_sequence_model(self):
+        assert shape_dryrun(TrainJobConfig(model="lstm")) == []
+
+    def test_abstract_batch_shapes(self):
+        x, y = abstract_batch(TrainJobConfig(model="lstm", batch_size=4,
+                                             window=12))
+        assert x.shape == (4, 12, 5)  # 5 continuous synthetic channels
+        assert y.shape == (4, 12)  # teacher-forced: a target per step
+        x, y = abstract_batch(TrainJobConfig(model="static_mlp",
+                                             batch_size=4))
+        assert x.shape == (4, 7)  # 6 continuous + 2-wide one-hot - target
+        assert y.shape == (4,)
+
+    def test_unknown_kwarg_is_a_construction_finding(self):
+        (d,) = shape_dryrun(TrainJobConfig(
+            model="lstm", model_kwargs={"hiden": 64}
+        ))
+        assert d.code == "shape.model_kwargs"
+        assert "hiden" in d.message
+
+    def test_shape_mismatched_kwargs_caught_in_init(self):
+        (d,) = shape_dryrun(TrainJobConfig(
+            model="lstm", model_kwargs={"hidden": "sixty-four"}
+        ))
+        assert d.code == "shape.init"
+
+    def test_unknown_model_skips_with_warning(self):
+        (d,) = shape_dryrun(TrainJobConfig(model="resnet"))
+        assert d.code == "shape.skipped" and d.severity == "warning"
+
+    def test_residual_families_get_injected_stats(self):
+        # Without the dummy target stats the dry-run itself would crash;
+        # with them, the physics channel rides as the last feature.
+        assert shape_dryrun(TrainJobConfig(model="gilbert_residual")) == []
+        assert shape_dryrun(TrainJobConfig(model="lstm_residual")) == []
+
+
+class TestLinter:
+    def _lint_source(self, tmp_path, source):
+        f = tmp_path / "mod.py"
+        f.write_text(textwrap.dedent(source))
+        return lint_file(str(f))
+
+    def test_host_sync_and_random_in_jitted_fn(self, tmp_path):
+        diags = self._lint_source(tmp_path, """
+            import random
+            import numpy as np
+            import jax
+
+            def step(state, x):
+                v = float(x.mean())
+                w = x.sum().item()
+                r = random.random()
+                z = np.asarray(x)
+                return v + w + r + z
+
+            train_step = jax.jit(step)
+        """)
+        codes = _codes(diags)
+        assert codes.count("TPF001") == 3
+        assert codes.count("TPF002") == 1
+
+    def test_unjitted_fn_not_flagged(self, tmp_path):
+        assert self._lint_source(tmp_path, """
+            def report(x):
+                return float(x)
+        """) == []
+
+    def test_noqa_suppression(self, tmp_path):
+        assert self._lint_source(tmp_path, """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)  # noqa: TPF001
+        """) == []
+
+    def test_mutable_defaults(self, tmp_path):
+        diags = self._lint_source(tmp_path, """
+            from dataclasses import dataclass
+
+            def f(xs=[]):
+                return xs
+
+            @dataclass
+            class Cfg:
+                knobs: dict = {}
+        """)
+        assert _codes(diags) == ["TPF003", "TPF003"]
+
+    def test_unknown_fault_site_literal(self, tmp_path):
+        diags = self._lint_source(tmp_path, """
+            from tpuflow.resilience import fault_point
+
+            def save():
+                fault_point("checkpoint.sav")
+        """)
+        assert _codes(diags) == ["TPF004"]
+        # a cataloged site is fine
+        assert self._lint_source(tmp_path, """
+            from tpuflow.resilience import fault_point
+
+            def save():
+                fault_point("checkpoint.save", index=3)
+        """) == []
+
+    def test_self_lint_gate_package_is_clean(self):
+        """The gate: the whole tpuflow package obeys its own lint rules.
+        New framework code that host-syncs inside jit, uses untraced
+        randomness, ships a mutable default, or names a nonexistent
+        fault site fails the tier-1 suite right here."""
+        findings = lint_package()
+        assert findings == [], "\n".join(d.render() for d in findings)
+
+
+class TestFailFastWiring:
+    def test_train_reports_every_spec_error_at_once(self):
+        from tpuflow.api import train
+
+        with pytest.raises(PreflightError) as e:
+            train(TrainJobConfig(
+                model="resnet", loss="xent",
+                faults=["bad.site,nth=1"], verbose=False,
+            ))
+        msg = str(e.value)
+        assert "unknown model 'resnet'" in msg
+        assert "unknown loss 'xent'" in msg
+        assert "unknown fault site" in msg
+
+    def test_supervisor_rejects_bad_spec_before_any_child(self):
+        from tpuflow.train.supervisor import supervise
+
+        with pytest.raises(ValueError, match="unknown model"):
+            supervise({"model": "nope", "storagePath": "/tmp/x",
+                       "save_every": 1})
+
+    def test_malformed_env_faults_name_the_env_var(self, monkeypatch):
+        from tpuflow.resilience import clear_faults, fault_point
+
+        clear_faults()
+        monkeypatch.setenv("TPUFLOW_FAULTS", "no.such.site,nth=1")
+        try:
+            with pytest.raises(ValueError) as e:
+                fault_point("csv.read")
+            assert "TPUFLOW_FAULTS" in str(e.value)
+            assert "site[,key=value...]" in str(e.value)
+            assert "unknown fault site" in str(e.value)
+        finally:
+            monkeypatch.delenv("TPUFLOW_FAULTS")
+            clear_faults()
+
+
+class TestArtifactCompat:
+    GOOD = {
+        "model": "static_mlp", "model_kwargs": {}, "kind": "tabular",
+        "preprocessor": {}, "sample_shape": [2, 7],
+    }
+
+    def test_good_meta_clean(self):
+        assert check_artifact_meta(dict(self.GOOD)) == []
+
+    def test_missing_keys(self):
+        (d,) = check_artifact_meta({"model": "static_mlp"})
+        assert d.code == "artifact.keys.missing"
+
+    def test_non_dict_meta_is_a_finding_not_a_typeerror(self):
+        # A sidecar holding 'null' or '42' is valid JSON but no object;
+        # must stay inside the diagnostics contract (ValueError from
+        # ensure_artifact_meta, None from try_fallback — not TypeError).
+        for meta in (None, 42, ["x"]):
+            (d,) = check_artifact_meta(meta)
+            assert d.code == "artifact.meta.type"
+
+    def test_unknown_model_and_kind(self):
+        codes = _codes(check_artifact_meta(
+            {**self.GOOD, "model": "resnet", "kind": "frobnicated"}
+        ))
+        assert "artifact.model.unknown" in codes
+        assert "artifact.kind.unknown" in codes
+
+    def test_kind_family_mismatch(self):
+        (d,) = check_artifact_meta({**self.GOOD, "model": "lstm"})
+        assert d.code == "artifact.kind.mismatch"
+
+    def test_bad_kwargs_fail_abstract_init(self):
+        (d,) = check_artifact_meta(
+            {**self.GOOD, "model_kwargs": {"hiden": 3}}
+        )
+        assert d.code == "artifact.init"
+
+    def test_predictor_load_rejects_bad_sidecar(self, tmp_path):
+        from tpuflow.api.predict_api import Predictor, save_artifact_meta
+
+        save_artifact_meta(
+            str(tmp_path), "static_mlp", "static_mlp", {"hiden": 3},
+            "tabular", {}, (2, 7),
+        )
+        with pytest.raises(ValueError, match="incompatible serving sidecar"):
+            Predictor.load(str(tmp_path), "static_mlp")
+
+
+class TestAnalysisMain:
+    """The acceptance drill: ``python -m tpuflow.analysis`` over
+    deliberately broken specs reports all four error classes — unknown
+    model, non-dividing tp, bad fault site, shape-mismatched
+    model_kwargs — without compiling anything."""
+
+    def _main(self, argv):
+        from tpuflow.analysis.__main__ import main
+
+        return main(argv)
+
+    def test_broken_specs_report_all_four_classes(self, tmp_path, capsys):
+        a = tmp_path / "a.json"
+        a.write_text(json.dumps({
+            "model": "resnet50", "tp": 3, "batchSize": 32,
+            "faults": ["chekpoint.save,at=3,mode=exit"],
+        }))
+        b = tmp_path / "b.json"
+        b.write_text(json.dumps({
+            "model": "lstm", "model_kwargs": {"hidden": "sixty-four"},
+        }))
+        rc = self._main([str(a), str(b), "--devices", "8"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "unknown model 'resnet50'" in out  # class 1: spec
+        assert "not divisible by tp=3" in out  # class 2: plan
+        assert "unknown fault site 'chekpoint.save'" in out  # class 3
+        assert "shape.init" in out  # class 4: shape dry-run
+
+    def test_clean_spec_exits_zero(self, tmp_path, capsys):
+        spec = tmp_path / "ok.json"
+        spec.write_text(json.dumps({
+            "model": "static_mlp", "epochs": 2, "batchSize": 32,
+        }))
+        assert self._main([str(spec), "--devices", "8"]) == 0
+        assert "preflight OK" in capsys.readouterr().out
+
+    def test_lint_flag_runs_package_gate(self, capsys):
+        assert self._main(["--lint"]) == 0
+        assert "lint OK" in capsys.readouterr().out
+
+    def test_unreadable_spec_exits_two_but_keeps_going(self, tmp_path,
+                                                       capsys):
+        missing = tmp_path / "nope.json"
+        broken = tmp_path / "broken.json"
+        broken.write_text(json.dumps({"model": "resnet50"}))
+        rc = self._main([str(missing), str(broken), "--devices", "8"])
+        captured = capsys.readouterr()
+        assert rc == 2  # unreadable input wins the exit code...
+        assert "unreadable spec" in captured.err
+        # ...but the later spec was still fully analyzed and reported
+        assert "unknown model 'resnet50'" in captured.out
+
+    def test_ill_typed_fields_become_findings_not_tracebacks(self, capsys):
+        report = preflight(TrainJobConfig(
+            model="static_mlp", window="24", model_kwargs=["x"],
+            faults=[3], batch_size=32,
+        ), device_count=8)
+        assert not report.ok  # findings, with no exception escaping
+        codes = [d.code for d in report.diagnostics]
+        assert "spec.faults.type" in codes
+        assert "spec.model_kwargs.type" in codes
+        assert any("unusable_config" in c for c in codes)
+
+    def test_invalid_sidecar_does_not_degrade_to_physics(self, tmp_path):
+        # Degradation is for lost checkpoints behind a HEALTHY sidecar;
+        # a structurally broken sidecar must fail loudly, not be masked
+        # by Gilbert answers.
+        from tpuflow.resilience.degraded import try_fallback
+
+        from tpuflow.api.predict_api import save_artifact_meta
+
+        save_artifact_meta(
+            str(tmp_path), "static_mlp", "static_mlp", {"hiden": 3},
+            "tabular", {}, (2, 7),
+        )
+        assert try_fallback(str(tmp_path), "static_mlp", "x") is None
+        save_artifact_meta(
+            str(tmp_path), "static_mlp", "static_mlp", {},
+            "tabular", {}, (2, 7),
+        )
+        assert try_fallback(str(tmp_path), "static_mlp", "x") is not None
+
+    def test_preflight_report_renders_counts(self):
+        report = preflight(
+            TrainJobConfig(model="resnet"), passes=("spec",),
+        )
+        assert not report.ok
+        assert "error(s)" in report.render()
+        with pytest.raises(PreflightError):
+            ensure_preflight(TrainJobConfig(model="resnet"),
+                             passes=("spec",))

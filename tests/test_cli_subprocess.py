@@ -8,9 +8,10 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(args, timeout=240):
+def _run(args, timeout=240, extra_env=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra_env or {})
     # The test process env pin doesn't reach a subprocess; the CLI module
     # itself must work under the standard env contract.
     return subprocess.run(
@@ -65,3 +66,52 @@ def test_model_kwargs_flag(tmp_path):
     bad = _run(["--model-kwargs", "{bad", "--quiet"])
     assert bad.returncode == 2
     assert "not valid JSON" in bad.stderr
+    # the error names the flag AND shows the offending string, not a
+    # bare json.JSONDecodeError traceback
+    assert "--model-kwargs" in bad.stderr
+    assert "{bad" in bad.stderr
+    assert "Traceback" not in bad.stderr
+
+
+def test_unknown_model_exits_2_listing_catalog():
+    """An unknown --model dies at parse time with the valid names in the
+    error — not minutes later as a KeyError deep in training."""
+    out = _run(["--model", "resnet50", "--quiet"])
+    assert out.returncode == 2
+    assert "unknown model 'resnet50'" in out.stderr
+    assert "static_mlp" in out.stderr and "lstm" in out.stderr
+    assert "Traceback" not in out.stderr
+
+
+def test_preflight_rejects_bad_spec_before_training():
+    """Preflight-by-default: a non-dividing tp AND a typo'd TPUFLOW_FAULTS
+    site are BOTH reported in one run, exit 2, before any data prep."""
+    out = _run(
+        ["--model", "static_mlp", "--tp", "3", "--devices", "8",
+         "--batch-size", "32", "--quiet"],
+        extra_env={"TPUFLOW_FAULTS": "chekpoint.save,at=3,mode=exit"},
+    )
+    assert out.returncode == 2
+    assert "preflight" in out.stderr
+    assert "not divisible by tp=3" in out.stderr
+    assert "chekpoint.save" in out.stderr  # env fault typo, same run
+    assert "TPUFLOW_FAULTS" in out.stderr
+
+
+def test_analysis_module_entry_rejects_broken_spec(tmp_path):
+    """python -m tpuflow.analysis: the CI entry point exits non-zero on a
+    broken spec and prints the preflight diagnostic."""
+    import json
+
+    spec = tmp_path / "bad.json"
+    spec.write_text(json.dumps({"model": "resnet50", "tp": 3}))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "tpuflow.analysis", str(spec),
+         "--devices", "8"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=240,
+    )
+    assert out.returncode == 1
+    assert "unknown model 'resnet50'" in out.stdout
+    assert "not divisible by tp=3" in out.stdout

@@ -1,0 +1,107 @@
+"""Preflight static analysis: validate a whole job WITHOUT running it.
+
+Four passes over a :class:`~tpuflow.api.config.TrainJobConfig` (and one
+over the framework itself), each collecting :class:`Diagnostic` records
+instead of raising, so one preflight reports every problem in a spec:
+
+1. **spec** (:mod:`tpuflow.analysis.spec`) — cross-field config checks:
+   registry keys (model/loss/optimizer), schema, windowing vs synthetic
+   length, stream knobs, fault-spec grammar (incl. ``TPUFLOW_FAULTS``).
+2. **shape** (:mod:`tpuflow.analysis.shapes`) — ``jax.eval_shape``
+   abstract interpretation through schema → windowing → model
+   init/apply → loss: shape/dtype bugs in milliseconds, no compile.
+3. **plan** (:mod:`tpuflow.analysis.plan`) — mesh/divisibility checks
+   for dp/tp/pp/ep (shared with the training path's own validation).
+4. **lint** (:mod:`tpuflow.analysis.linter`) — AST rules over the
+   ``tpuflow`` package itself (host syncs in jit, untraced randomness,
+   mutable defaults, unknown fault sites); tier-1 runs it as a gate.
+
+Entry points: ``python -m tpuflow.analysis spec.json`` for CI,
+``tpuflow.cli --preflight`` (on by default; ``--no-preflight`` escapes),
+and ``train()``/``supervise()``/``serve`` fail-fast on submission.
+"""
+
+from __future__ import annotations
+
+from tpuflow.analysis.diagnostics import (  # noqa: F401
+    Diagnostic,
+    PreflightError,
+    PreflightReport,
+)
+
+DEFAULT_PASSES = ("spec", "plan", "shape")
+
+
+def preflight(
+    config,
+    *,
+    passes: tuple = DEFAULT_PASSES,
+    device_count: int | None = None,
+    local_device_count: int | None = None,
+    process_count: int = 1,
+) -> PreflightReport:
+    """Run the requested analysis passes over one job config.
+
+    Never raises on a bad job — returns the aggregated report (use
+    :func:`ensure_preflight` for the raising flavor). Pass order is
+    fixed spec → plan → shape so the cheap pure-Python passes report
+    before the abstract interpreter runs.
+    """
+    report = PreflightReport(passes_run=tuple(passes))
+
+    def _run(pass_name, fn):
+        # Per-pass safety net: a config broken enough to crash one
+        # pass's arithmetic (a string where an int belongs) must become
+        # a finding, not a traceback that hides every other finding.
+        try:
+            report.extend(fn())
+        except Exception as e:  # noqa: BLE001 — the net IS the contract
+            report.extend([Diagnostic(
+                pass_name=pass_name, code=f"{pass_name}.unusable_config",
+                message=f"{pass_name} pass could not run on this config "
+                f"({type(e).__name__}: {e}) — a field has an unusable "
+                "type or value",
+            )])
+
+    if "spec" in passes:
+        from tpuflow.analysis.spec import validate_spec
+
+        _run("spec", lambda: validate_spec(config))
+    if "plan" in passes:
+        from tpuflow.analysis.plan import check_plan
+
+        _run("plan", lambda: check_plan(
+            config,
+            device_count=device_count,
+            local_device_count=local_device_count,
+            process_count=process_count,
+        ))
+    if "shape" in passes:
+        from tpuflow.analysis.shapes import shape_dryrun
+
+        _run("shape", lambda: shape_dryrun(config))
+    if "lint" in passes:
+        from tpuflow.analysis.linter import lint_package
+
+        _run("lint", lambda: lint_package())
+    return report
+
+
+def ensure_preflight(config, **kwargs) -> PreflightReport:
+    """Run :func:`preflight` and raise :class:`PreflightError` (a
+    ``ValueError``) when any pass found errors — the fail-fast flavor
+    every submission seam (train/supervise/serve) calls."""
+    report = preflight(config, **kwargs)
+    if not report.ok:
+        raise PreflightError(report)
+    return report
+
+
+__all__ = [
+    "DEFAULT_PASSES",
+    "Diagnostic",
+    "PreflightError",
+    "PreflightReport",
+    "ensure_preflight",
+    "preflight",
+]

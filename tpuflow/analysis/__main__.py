@@ -1,0 +1,105 @@
+"""``python -m tpuflow.analysis`` — the CI entry point for preflight.
+
+Usage::
+
+    python -m tpuflow.analysis spec.json [spec2.json ...] [--devices N]
+    python -m tpuflow.analysis --lint [PATH]
+    python -m tpuflow.analysis spec.json --lint     # both
+
+Each positional argument is a JSON job spec in the job-runner contract
+(``tpuflow.serve.spec_to_config`` — camelCase or snake_case fields); the
+spec, plan, and shape passes run over each and EVERY finding is printed
+(one run reports all the errors, not the first). ``--devices`` supplies
+the target device count for plan checking without touching a backend —
+nothing here compiles, allocates, or initializes accelerator state.
+``--lint`` runs the framework linter over ``tpuflow`` (or PATH).
+
+Exit status: 0 when no pass reported an error, 1 otherwise, 2 for
+unusable inputs (missing/unparseable spec file).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpuflow.analysis",
+        description="preflight static analysis for tpuflow job specs",
+    )
+    ap.add_argument("specs", nargs="*", metavar="SPEC.json",
+                    help="job-spec files (tpuflow.serve contract)")
+    ap.add_argument("--devices", type=int, default=None,
+                    help="target device count for plan checking "
+                         "(default: the config's n_devices, else skipped)")
+    ap.add_argument("--no-shape", action="store_true",
+                    help="skip the eval_shape dry-run pass")
+    ap.add_argument("--lint", nargs="?", const="", default=None,
+                    metavar="PATH",
+                    help="run the framework linter over PATH "
+                         "(default: the tpuflow package)")
+    args = ap.parse_args(argv)
+    if not args.specs and args.lint is None:
+        ap.print_usage(sys.stderr)
+        print(
+            "error: pass at least one spec file and/or --lint",
+            file=sys.stderr,
+        )
+        return 2
+
+    failed = False
+    unreadable = False
+    for path in args.specs:
+        try:
+            with open(path, encoding="utf-8") as f:
+                spec = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            # Keep going: one missing/typo'd file must not hide the
+            # findings of every later spec (and the lint pass) — the
+            # submit-fix-resubmit loop this tool exists to kill.
+            print(f"{path}: unreadable spec: {e}", file=sys.stderr)
+            unreadable = True
+            continue
+        from tpuflow.analysis import preflight
+        from tpuflow.serve import spec_to_config
+
+        try:
+            config = spec_to_config(spec)
+        except (ValueError, TypeError) as e:
+            # An unknown/duplicate field never reaches the passes; it is
+            # itself the (whole) finding for this spec.
+            print(f"{path}: {e}")
+            failed = True
+            continue
+        passes = ("spec", "plan") if args.no_shape else (
+            "spec", "plan", "shape"
+        )
+        report = preflight(
+            config, passes=passes, device_count=args.devices
+        )
+        print(f"{path}: {report.render()}")
+        failed = failed or not report.ok
+
+    if args.lint is not None:
+        from tpuflow.analysis.linter import lint_package
+
+        findings = lint_package(args.lint or None)
+        errors = [d for d in findings if d.severity == "error"]
+        target = args.lint or "tpuflow"
+        if findings:
+            print(f"lint: {len(findings)} finding(s) in {target}")
+            for d in findings:
+                print(f"  {d.render()}")
+        else:
+            print(f"lint OK: {target} is clean")
+        failed = failed or bool(errors)
+    if unreadable:
+        return 2
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

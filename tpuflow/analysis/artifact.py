@@ -1,0 +1,139 @@
+"""Artifact/config compatibility: static checks for the serving sidecar.
+
+``Predictor.load`` reads a JSON sidecar (``save_artifact_meta``) and
+rebuilds the model it describes. A sidecar hand-edited, written by an
+older build, or pointed at the wrong model family used to die deep in
+Orbax restore with a pytree mismatch; these checks reject it at load
+with the field that is wrong. Same discipline as job preflight: collect
+every finding, let the caller decide to raise.
+"""
+
+from __future__ import annotations
+
+from tpuflow.analysis.diagnostics import Diagnostic
+
+_PASS = "artifact"
+
+_REQUIRED_KEYS = ("model", "model_kwargs", "kind", "preprocessor",
+                  "sample_shape")
+_KINDS = ("tabular", "windowed")
+
+
+def _expected_kind(model: str) -> str:
+    """The sidecar kind a model family serves from: sequence families
+    (``TrainJobConfig.is_sequence_model`` — the one source of that
+    classification) train through the windowed pipeline and serve from a
+    "windowed" sidecar; everything else is tabular."""
+    from tpuflow.api.config import TrainJobConfig
+
+    seq = TrainJobConfig(model=model).is_sequence_model
+    return "windowed" if seq else "tabular"
+
+
+def _diag(code, message, where=None, choices=()):
+    return Diagnostic(
+        pass_name=_PASS, code=code, message=message, where=where,
+        choices=tuple(choices),
+    )
+
+
+def check_artifact_meta(meta: dict) -> list[Diagnostic]:
+    """Validate a serving sidecar dict; returns ALL findings."""
+    from tpuflow.models import MODELS
+
+    if not isinstance(meta, dict):
+        # A sidecar file holding valid-but-non-object JSON ('null', a
+        # number) must be a finding, not a TypeError that escapes the
+        # never-raises contract (and the callers' ValueError mapping).
+        return [_diag(
+            "artifact.meta.type",
+            f"sidecar must be a JSON object, got "
+            f"{type(meta).__name__}: {meta!r}",
+            where="meta",
+        )]
+    out = []
+    missing = [k for k in _REQUIRED_KEYS if k not in meta]
+    if missing:
+        return [_diag(
+            "artifact.keys.missing",
+            f"sidecar is missing required keys {missing}",
+            where="meta", choices=_REQUIRED_KEYS,
+        )]
+    model = meta["model"]
+    if model not in MODELS:
+        out.append(_diag(
+            "artifact.model.unknown",
+            f"sidecar names unknown model {model!r}",
+            where="model", choices=sorted(MODELS),
+        ))
+    if meta["kind"] not in _KINDS:
+        out.append(_diag(
+            "artifact.kind.unknown",
+            f"sidecar kind {meta['kind']!r} is not a serving kind",
+            where="kind", choices=_KINDS,
+        ))
+    elif model in MODELS:
+        expect = _expected_kind(model)
+        if meta["kind"] != expect:
+            out.append(_diag(
+                "artifact.kind.mismatch",
+                f"model {model!r} serves from a {expect!r} sidecar, got "
+                f"kind {meta['kind']!r} (sidecar and checkpoint describe "
+                "different artifacts)",
+                where="kind",
+            ))
+    if not isinstance(meta["model_kwargs"], dict):
+        out.append(_diag(
+            "artifact.model_kwargs.type",
+            f"sidecar model_kwargs must be a dict, got "
+            f"{type(meta['model_kwargs']).__name__}",
+            where="model_kwargs",
+        ))
+    shape = meta["sample_shape"]
+    if (
+        not isinstance(shape, (list, tuple))
+        or not shape
+        or not all(isinstance(d, int) and d > 0 for d in shape)
+    ):
+        out.append(_diag(
+            "artifact.sample_shape.invalid",
+            f"sidecar sample_shape must be a non-empty list of positive "
+            f"ints, got {shape!r}",
+            where="sample_shape",
+        ))
+    if out:
+        return out
+
+    # Abstract end-to-end: the recorded kwargs must actually build the
+    # recorded model and init at the recorded sample shape — eval_shape,
+    # so no weights are materialized and nothing compiles.
+    import jax
+    import jax.numpy as jnp
+
+    from tpuflow.models import build_model
+
+    try:
+        model_obj = build_model(model, **meta["model_kwargs"])
+        rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        x = jax.ShapeDtypeStruct((2, *shape[1:]), jnp.float32)
+        jax.eval_shape(model_obj.init, rng, x)
+    except Exception as e:  # noqa: BLE001 — any init failure IS the finding
+        out.append(_diag(
+            "artifact.init",
+            f"sidecar model {model!r} with kwargs {meta['model_kwargs']!r} "
+            f"does not init at sample_shape {list(shape)}: "
+            f"{type(e).__name__}: {e}",
+            where="model_kwargs",
+        ))
+    return out
+
+
+def ensure_artifact_meta(meta: dict, where: str = "artifact") -> None:
+    """Raise ``ValueError`` naming every sidecar problem (the raising
+    flavor ``Predictor.load`` calls before touching the checkpoint)."""
+    findings = check_artifact_meta(meta)
+    if findings:
+        raise ValueError(
+            f"{where}: incompatible serving sidecar — "
+            + "; ".join(d.render() for d in findings)
+        )

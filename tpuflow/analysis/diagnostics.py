@@ -1,0 +1,88 @@
+"""Diagnostics: the one result type every static-analysis pass emits.
+
+A pass never raises on a bad job — it *collects* :class:`Diagnostic`
+records, so one preflight run reports EVERY problem in a spec instead of
+the first one (the submit-fix-resubmit loop a fail-fast validator forces
+is exactly the cluster-time waste preflight exists to kill). Raising is
+the *caller's* policy: entry points that must fail fast wrap the
+collected errors in :class:`PreflightError`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One finding from one analysis pass.
+
+    ``where`` names the config field for spec/plan/shape findings and
+    ``file:line`` for lint findings; ``choices`` carries the valid
+    alternatives when the finding is a bad enum-like value (the error a
+    user can act on without opening the source).
+    """
+
+    pass_name: str  # "spec" | "shape" | "plan" | "lint"
+    code: str  # stable machine key, e.g. "spec.model.unknown", "TPF001"
+    message: str
+    where: str | None = None
+    choices: tuple = ()
+    severity: str = "error"  # "error" | "warning"
+
+    def render(self) -> str:
+        loc = f" [{self.where}]" if self.where else ""
+        text = f"{self.pass_name}:{loc} {self.code}: {self.message}"
+        if self.choices:
+            text += f" (valid: {', '.join(str(c) for c in self.choices)})"
+        return text
+
+
+@dataclass
+class PreflightReport:
+    """Aggregated diagnostics from every pass that ran."""
+
+    diagnostics: list[Diagnostic] = field(default_factory=list)
+    passes_run: tuple = ()
+
+    @property
+    def errors(self) -> list[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "error"]
+
+    @property
+    def warnings(self) -> list[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "warning"]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def extend(self, diags) -> None:
+        self.diagnostics.extend(diags)
+
+    def render(self) -> str:
+        if not self.diagnostics:
+            return (
+                f"preflight OK ({', '.join(self.passes_run)}): "
+                "no findings"
+            )
+        lines = [
+            f"preflight: {len(self.errors)} error(s), "
+            f"{len(self.warnings)} warning(s) "
+            f"({', '.join(self.passes_run)})"
+        ]
+        lines += [f"  {d.render()}" for d in self.diagnostics]
+        return "\n".join(lines)
+
+
+class PreflightError(ValueError):
+    """A preflight found errors and the caller asked to fail fast.
+
+    Subclasses ``ValueError`` so every existing submission seam that
+    already maps ``ValueError`` to "bad request / exit 2" keeps working
+    unchanged. ``report`` carries the full structured findings.
+    """
+
+    def __init__(self, report: PreflightReport):
+        self.report = report
+        super().__init__(report.render())

@@ -1,0 +1,275 @@
+"""Pass 4 — framework linter: AST rules for JAX-correctness over tpuflow.
+
+The other three passes check a *job*; this one checks the *framework
+itself*. Each rule encodes a bug class that type checkers and pytest
+both miss because the code "works" while silently being wrong (a host
+sync per step, a drill that can never fire):
+
+- **TPF001** — host sync inside a jitted function: ``float(...)``,
+  ``bool(...)``, ``.item()``, ``np.asarray``/``np.array`` on a traced
+  value force a device→host transfer per call (or crash under jit).
+- **TPF002** — Python ``random`` / ``np.random`` inside a jitted
+  function: untraced host randomness is frozen at trace time, so every
+  execution replays the SAME "random" numbers (use ``jax.random``).
+- **TPF003** — mutable default argument (list/dict/set literal) on a
+  function or a dataclass field: shared across calls/instances (for
+  dataclasses, use ``field(default_factory=...)``).
+- **TPF004** — fault-site string literal not in the resilience catalog:
+  a ``fault_point``/``parse_fault_spec`` literal that names an unknown
+  site is a drill that can never fire (the catalog is
+  ``tpuflow.resilience.faults.SITES``).
+
+"Jitted function" means a function decorated with ``jit``/``jax.jit``/
+``partial(jax.jit, ...)`` or passed to a ``jax.jit(...)`` call reachable
+in the same module (this repo's dominant idiom: ``return jax.jit(step)``).
+Nested functions inherit jitted-ness — a closure's body is traced with
+its parent.
+
+Suppression: a ``# noqa: TPF00x`` comment on the offending line, for the
+rare construct that is trace-time-constant and provably fine.
+
+A tier-1 test runs this linter over the whole ``tpuflow`` package (the
+self-lint gate), so new framework code violating a rule fails the suite.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from tpuflow.analysis.diagnostics import Diagnostic
+
+_PASS = "lint"
+
+RULES = {
+    "TPF001": "host sync (float()/bool()/.item()/np.asarray()) inside a "
+              "jitted function",
+    "TPF002": "Python random/np.random inside a jitted function "
+              "(untraced host randomness; use jax.random)",
+    "TPF003": "mutable default argument (list/dict/set literal); use "
+              "field(default_factory=...) / None",
+    "TPF004": "fault-site string literal not in the resilience SITES "
+              "catalog (a drill against it can never fire)",
+}
+
+_HOST_SYNC_NAMES = {"float", "bool"}
+_HOST_SYNC_NP_ATTRS = {"asarray", "array"}
+_RANDOM_BASES = {"random"}  # bare `random.` — jax.random is Attribute-based
+_NP_NAMES = {"np", "numpy"}
+
+
+def _noqa_lines(source: str) -> dict[int, set[str]]:
+    """line -> suppressed rule codes (``# noqa: TPF001[,TPF002]``)."""
+    out: dict[int, set[str]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = re.search(r"#\s*noqa:\s*([A-Z0-9, ]+)", line)
+        if m:
+            out[i] = {c.strip() for c in m.group(1).split(",") if c.strip()}
+    return out
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """``jit`` / ``jax.jit`` / ``nn.jit`` / ``partial(jax.jit, ...)``."""
+    if isinstance(node, ast.Name):
+        return node.id == "jit"
+    if isinstance(node, ast.Attribute):
+        return node.attr == "jit"
+    if isinstance(node, ast.Call):  # partial(jax.jit, ...) decorator form
+        if (
+            isinstance(node.func, (ast.Name, ast.Attribute))
+            and (getattr(node.func, "id", None) == "partial"
+                 or getattr(node.func, "attr", None) == "partial")
+        ):
+            return any(_is_jit_expr(a) for a in node.args)
+    return False
+
+
+def _collect_jitted_names(tree: ast.AST) -> set[str]:
+    """Function NAMES passed to a jit call anywhere in the module —
+    catches ``return jax.jit(step)`` and ``f = jax.jit(g)``."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_expr(node.func):
+            for arg in node.args[:1]:  # jit's fun is the first positional
+                if isinstance(arg, ast.Name):
+                    names.add(arg.id)
+    return names
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, source: str, sites: dict):
+        self.path = path
+        self.sites = sites
+        self.noqa = _noqa_lines(source)
+        self.tree = ast.parse(source, filename=path)
+        self.jitted_names = _collect_jitted_names(self.tree)
+        self.findings: list[Diagnostic] = []
+        self._jit_depth = 0
+
+    def run(self) -> list[Diagnostic]:
+        self.visit(self.tree)
+        return self.findings
+
+    def _emit(self, code: str, node: ast.AST, detail: str) -> None:
+        if code in self.noqa.get(node.lineno, ()):
+            return
+        self.findings.append(Diagnostic(
+            pass_name=_PASS, code=code,
+            message=f"{detail} — {RULES[code]}",
+            where=f"{self.path}:{node.lineno}",
+        ))
+
+    # --- jitted-scope tracking ---
+
+    def _is_jitted_def(self, node) -> bool:
+        if any(_is_jit_expr(d) for d in node.decorator_list):
+            return True
+        return node.name in self.jitted_names
+
+    def _visit_function(self, node) -> None:
+        self._check_defaults(node)
+        entered = self._jit_depth > 0 or self._is_jitted_def(node)
+        self._jit_depth += 1 if entered else 0
+        self.generic_visit(node)
+        self._jit_depth -= 1 if entered else 0
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def visit_Lambda(self, node) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    # --- TPF003: mutable defaults ---
+
+    def _check_defaults(self, node) -> None:
+        args = node.args
+        for default in list(args.defaults) + [
+            d for d in args.kw_defaults if d is not None
+        ]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                self._emit(
+                    "TPF003", default,
+                    f"mutable default in {getattr(node, 'name', '<lambda>')}()",
+                )
+
+    def visit_ClassDef(self, node) -> None:
+        # Dataclass-style configs: a bare mutable literal as a class-level
+        # field default is shared across instances (and for @dataclass,
+        # a runtime error only once the class is actually instantiated).
+        for stmt in node.body:
+            value = None
+            if isinstance(stmt, ast.AnnAssign):
+                value = stmt.value
+            elif isinstance(stmt, ast.Assign):
+                value = stmt.value
+            if isinstance(value, (ast.List, ast.Dict, ast.Set)):
+                self._emit(
+                    "TPF003", value,
+                    f"mutable class-level default in {node.name}",
+                )
+        self.generic_visit(node)
+
+    # --- TPF001 / TPF002 / TPF004: calls ---
+
+    def visit_Call(self, node) -> None:
+        func = node.func
+        if self._jit_depth > 0:
+            if (
+                isinstance(func, ast.Name)
+                and func.id in _HOST_SYNC_NAMES
+            ):
+                self._emit("TPF001", node, f"{func.id}(...) call")
+            if isinstance(func, ast.Attribute):
+                if func.attr == "item":
+                    self._emit("TPF001", node, ".item() call")
+                if (
+                    func.attr in _HOST_SYNC_NP_ATTRS
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in _NP_NAMES
+                ):
+                    self._emit(
+                        "TPF001", node,
+                        f"{func.value.id}.{func.attr}(...) call",
+                    )
+                base = func.value
+                if (
+                    isinstance(base, ast.Name)
+                    and base.id in _RANDOM_BASES
+                ) or (
+                    isinstance(base, ast.Attribute)
+                    and base.attr == "random"
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id in _NP_NAMES
+                ):
+                    self._emit(
+                        "TPF002", node,
+                        f"{ast.unparse(func)}(...) call",
+                    )
+        self._check_fault_site(node)
+        self.generic_visit(node)
+
+    def _check_fault_site(self, node: ast.Call) -> None:
+        func = node.func
+        name = (
+            func.id if isinstance(func, ast.Name)
+            else func.attr if isinstance(func, ast.Attribute)
+            else None
+        )
+        if name == "fault_point" and node.args:
+            arg = node.args[0]
+            if (
+                isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)
+                and arg.value not in self.sites
+            ):
+                self._emit(
+                    "TPF004", node,
+                    f"fault_point({arg.value!r})",
+                )
+        if name == "parse_fault_spec" and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                site = arg.value.split(",", 1)[0].strip()
+                if site and site not in self.sites:
+                    self._emit(
+                        "TPF004", node,
+                        f"parse_fault_spec({arg.value!r}) site {site!r}",
+                    )
+
+
+def lint_file(path: str, sites: dict | None = None) -> list[Diagnostic]:
+    """Lint one Python file; returns findings (syntax errors included)."""
+    if sites is None:
+        from tpuflow.resilience.faults import SITES as sites
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        return _Linter(path, source, sites).run()
+    except SyntaxError as e:
+        return [Diagnostic(
+            pass_name=_PASS, code="TPF000",
+            message=f"syntax error: {e.msg}",
+            where=f"{path}:{e.lineno}",
+        )]
+
+
+def lint_package(root: str | None = None) -> list[Diagnostic]:
+    """Lint every ``.py`` file under ``root`` (default: the installed
+    ``tpuflow`` package directory) — the self-lint gate's entry point."""
+    if root is None:
+        import tpuflow
+
+        root = os.path.dirname(os.path.abspath(tpuflow.__file__))
+    from tpuflow.resilience.faults import SITES
+
+    findings: list[Diagnostic] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d != "__pycache__"
+        )
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                findings += lint_file(os.path.join(dirpath, fn), SITES)
+    return findings

@@ -1,0 +1,278 @@
+"""Pass 3 — plan checking: mesh/divisibility validation for dp/tp/pp/ep.
+
+The static half of parallel-plan validation, shared by preflight and the
+training path: ``tpuflow.api.train_api`` delegates its pre-ingest
+model-axis validation here (one rule set, two callers), so a plan bug
+rejected at submission and a plan bug rejected before ingest are the
+same rule with the same message. Axis semantics follow
+``tpuflow/parallel/mesh.py``: a ``(data, model)`` mesh where tp/pp/ep
+size the model axis and the remaining devices do data parallelism.
+
+All checks are arithmetic over the config plus the device topology
+numbers the caller passes in (``device_count``, ``local_device_count``,
+``process_count``) — nothing here queries a backend, so preflight can
+check an 8-chip plan from a loginless CI node by passing
+``device_count=8``.
+"""
+
+from __future__ import annotations
+
+from tpuflow.analysis.diagnostics import Diagnostic
+
+_PASS = "plan"
+
+# Families whose params form the Dense stack mlp_tp_shardings can shard
+# megatron-style (tpuflow/parallel/tp_train.py's structural check, made
+# name-static so a bad plan fails at submission, not after ingest).
+TP_FAMILIES = ("static_mlp", "dynamic_mlp", "gilbert_residual")
+
+
+def _default_hidden(model_name: str):
+    """The family's default hidden widths, read off the registry's own
+    module instance — not a parallel table that could go stale and turn
+    this pre-ingest gate into the wrong authority."""
+    from tpuflow.models import build_model
+
+    return build_model(model_name).hidden
+
+
+def _diag(code, message, where=None, severity="error"):
+    return Diagnostic(
+        pass_name=_PASS, code=code, message=message, where=where,
+        severity=severity,
+    )
+
+
+def _kwargs(config) -> dict:
+    """model_kwargs if it IS a dict, else {} — an ill-typed value is the
+    spec pass's finding; this pass must keep collecting, not crash."""
+    kw = config.model_kwargs
+    return kw if isinstance(kw, dict) else {}
+
+
+def _check_tp_family(config) -> list[Diagnostic]:
+    from tpuflow.models import MODELS
+
+    if config.model not in MODELS:
+        return []  # the spec pass owns unknown-model findings
+    if config.model not in TP_FAMILIES:
+        return [_diag(
+            "plan.tp.family",
+            f"tp training supports Dense-stack MLP families "
+            f"{list(TP_FAMILIES)}; got model {config.model!r}",
+            where="tp",
+        )]
+    out = []
+    hidden = _kwargs(config).get("hidden")
+    if hidden is None:
+        hidden = _default_hidden(config.model)
+    if not isinstance(hidden, int) and not (
+        isinstance(hidden, (list, tuple))
+        and all(isinstance(h, int) for h in hidden)
+    ):
+        return out  # ill-typed hidden: the shape pass owns that finding
+    hidden = (hidden,) if isinstance(hidden, int) else tuple(hidden)
+    # Megatron alternation (mlp_tp_shardings): even-indexed hidden
+    # layers are column-parallel and the following row-parallel layer
+    # splits the same width, so the even-indexed widths must divide.
+    for i in range(0, len(hidden), 2):
+        if hidden[i] % config.tp:
+            out.append(_diag(
+                "plan.tp.hidden",
+                f"hidden dim {hidden[i]} (layer {i}) not divisible by "
+                f"tp={config.tp}",
+                where="model_kwargs.hidden",
+            ))
+    return out
+
+
+def check_plan(
+    config,
+    *,
+    device_count: int | None = None,
+    local_device_count: int | None = None,
+    process_count: int = 1,
+    jit_epoch: bool | None = None,
+) -> list[Diagnostic]:
+    """Validate the parallel plan; returns ALL findings, never raises.
+
+    ``device_count`` is the visible device total (``jax.device_count()``
+    on a live runtime, or the target topology when checking offline);
+    ``jit_epoch=None`` means "not yet resolved" and only an explicit
+    ``config.jit_epoch=True`` is held against the model axes.
+    """
+    out = []
+    if jit_epoch is None:
+        jit_epoch = config.jit_epoch is True
+    for name in ("tp", "pp", "ep"):
+        if getattr(config, name) < 1:
+            out.append(_diag(
+                f"plan.{name}.range",
+                f"{name} must be >= 1, got {getattr(config, name)}",
+                where=name,
+            ))
+            return out  # the divisibility arithmetic below needs >= 1
+    if sum(n > 1 for n in (config.tp, config.pp, config.ep)) > 1:
+        out.append(_diag(
+            "plan.axis.combined",
+            "tp, pp, and ep cannot be combined yet; pick one model-axis "
+            "strategy per job",
+            where="tp/pp/ep",
+        ))
+        return out  # per-axis arithmetic is meaningless on a bad combo
+    if config.pp_microbatches and config.pp <= 1:
+        out.append(_diag(
+            "plan.pp.microbatches",
+            "pp_microbatches is a pipeline knob; set pp>1 (a value "
+            "silently ignored would fake GPipe accumulation)",
+            where="pp_microbatches",
+        ))
+    if config.pp > 1 and config.model != "pipeline_mlp":
+        out.append(_diag(
+            "plan.pp.family",
+            f"pp>1 training supports the pipeline_mlp family; got model "
+            f"{config.model!r}",
+            where="pp",
+        ))
+    if config.ep > 1 and config.model != "moe_mlp":
+        out.append(_diag(
+            "plan.ep.family",
+            f"ep>1 training supports the moe_mlp family; got model "
+            f"{config.model!r}",
+            where="ep",
+        ))
+    if config.tp > 1:
+        out += _check_tp_family(config)
+    if config.pp > 1 and config.model == "pipeline_mlp":
+        stages = _kwargs(config).get("stages")
+        if stages is None:
+            from tpuflow.models import build_model
+
+            stages = build_model("pipeline_mlp").stages
+        if isinstance(stages, int) and stages % config.pp:
+            out.append(_diag(
+                "plan.pp.stages",
+                f"pipeline_mlp stages={stages} not divisible by "
+                f"pp={config.pp} devices (each device owns an equal "
+                "contiguous stage chunk)",
+                where="model_kwargs.stages",
+            ))
+    if config.ep > 1 and config.model == "moe_mlp":
+        experts = _kwargs(config).get("experts")
+        if experts is None:
+            from tpuflow.models import build_model
+
+            experts = build_model("moe_mlp").experts
+        if isinstance(experts, int) and experts % config.ep:
+            out.append(_diag(
+                "plan.ep.experts",
+                f"moe_mlp experts={experts} not divisible by "
+                f"ep={config.ep} devices (each device owns an equal "
+                "contiguous expert chunk)",
+                where="model_kwargs.experts",
+            ))
+
+    n_dev = config.n_devices or device_count
+    if n_dev is None:
+        out.append(_diag(
+            "plan.devices.unknown", severity="warning",
+            message="device count unknown (no n_devices in the config and "
+            "no --devices given): divisibility checks skipped",
+            where="n_devices",
+        ))
+        return out
+    if n_dev < 1:
+        out.append(_diag(
+            "plan.devices.range",
+            f"n_devices must be >= 1, got {n_dev}", where="n_devices",
+        ))
+        return out
+    if (
+        config.n_devices
+        and device_count is not None
+        and config.n_devices > device_count
+    ):
+        out.append(_diag(
+            "plan.devices.visible",
+            f"n_devices {config.n_devices} > {device_count} visible "
+            "devices",
+            where="n_devices",
+        ))
+
+    model_axis = 1
+    for name in ("tp", "pp", "ep"):
+        n = getattr(config, name)
+        if n <= 1:
+            continue
+        model_axis = n
+        if jit_epoch:
+            out.append(_diag(
+                f"plan.{name}.jit_epoch",
+                f"{name}>1 trains through its per-batch sharded step; "
+                f"jit_epoch is not supported with {name}",
+                where="jit_epoch",
+            ))
+        if n_dev % n:
+            out.append(_diag(
+                f"plan.{name}.devices",
+                f"n_devices {n_dev} not divisible by {name}={n}",
+                where=name,
+            ))
+    if out and any(d.code.endswith(".devices") for d in out):
+        return out  # dp-size arithmetic below would divide by air
+    if config.pp > 1:
+        n_micro = config.pp_microbatches or config.pp
+        if config.batch_size % n_micro:
+            out.append(_diag(
+                "plan.pp.batch",
+                f"batch_size {config.batch_size} not divisible by "
+                f"{n_micro} pipeline microbatches",
+                where="batch_size",
+            ))
+        elif (config.batch_size // n_micro) % (n_dev // config.pp):
+            out.append(_diag(
+                "plan.pp.microbatch_dp",
+                f"microbatch {config.batch_size // n_micro} not divisible "
+                f"by {n_dev // config.pp} data-parallel devices",
+                where="batch_size",
+            ))
+    for name in ("tp", "ep"):
+        n = getattr(config, name)
+        if n > 1 and config.batch_size % (n_dev // n):
+            out.append(_diag(
+                f"plan.{name}.batch",
+                f"batch_size {config.batch_size} not divisible by "
+                f"{n_dev // n} data-parallel devices",
+                where="batch_size",
+            ))
+    if model_axis == 1 and n_dev > 1 and config.batch_size % n_dev:
+        out.append(_diag(
+            "plan.dp.batch",
+            f"batch_size {config.batch_size} not divisible by {n_dev} "
+            "devices",
+            where="batch_size",
+        ))
+
+    # Multi-host shape constraints (identical across tp/pp/ep — they ride
+    # the same (data, model) mesh layout).
+    if model_axis > 1 and process_count > 1:
+        axis_name = (
+            "tp" if config.tp > 1 else "pp" if config.pp > 1 else "ep"
+        )
+        total = device_count if device_count is not None else n_dev
+        if n_dev != total:
+            out.append(_diag(
+                "plan.multihost.submesh",
+                f"multi-host {axis_name} needs the full pod: n_devices "
+                f"{n_dev} != device_count {total}",
+                where="n_devices",
+            ))
+        if local_device_count is not None and local_device_count % model_axis:
+            out.append(_diag(
+                "plan.multihost.local",
+                f"multi-host {axis_name}={model_axis} needs the "
+                f"{local_device_count} local devices per process to be a "
+                f"multiple of {axis_name}",
+                where=axis_name,
+            ))
+    return out

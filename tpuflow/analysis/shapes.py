@@ -1,0 +1,185 @@
+"""Pass 2 — shape dry-run: abstract interpretation of the whole job.
+
+``jax.eval_shape`` traces data schema → windowing → model init/apply →
+loss with :class:`jax.ShapeDtypeStruct` stand-ins: every shape/dtype
+mismatch a real run would hit minutes in (after ingest and an XLA
+compile) surfaces in milliseconds, with ZERO compilation and zero
+device memory — eval_shape never touches a backend, so this runs on a
+login node that has no accelerator at all.
+
+The dry-run mirrors the training path's data contract
+(``tpuflow.api.train_api._prepare_data``):
+
+- sequence families see ``x [B, window, F]`` where ``F`` is the schema's
+  continuous feature channels (minus the well column); teacher-forced
+  families train against ``y [B, window]``, the rest against ``y [B]``;
+- tabular families see ``x [B, F]`` with ``F`` = continuous features +
+  one-hot blocks (categorical vocabularies are unknown before ingest, so
+  each contributes a placeholder width of 2 — models are width-agnostic
+  past the first Dense, which is what makes the placeholder sound);
+- the residual families get the extra Gilbert channel and dummy target
+  stats injected exactly like the training path injects the real ones.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpuflow.analysis.diagnostics import Diagnostic
+
+_PASS = "shape"
+
+# Placeholder one-hot width per categorical column: the real width is the
+# training split's vocabulary size, unknowable before ingest. Any value
+# >= 1 exercises the same dtype/rank contract.
+_PLACEHOLDER_VOCAB = 2
+
+
+def _diag(code, message, where=None, severity="error"):
+    return Diagnostic(
+        pass_name=_PASS, code=code, message=message, where=where,
+        severity=severity,
+    )
+
+
+def _schema(config):
+    from tpuflow.data.schema import Schema
+    from tpuflow.data.synthetic import (
+        SYNTHETIC_COLUMN_NAMES,
+        SYNTHETIC_COLUMN_TYPES,
+        SYNTHETIC_TARGET,
+    )
+
+    return Schema.from_cli(
+        config.column_names or SYNTHETIC_COLUMN_NAMES,
+        config.column_types or SYNTHETIC_COLUMN_TYPES,
+        config.target or SYNTHETIC_TARGET,
+    )
+
+
+def _feature_dim(config, schema) -> int:
+    if config.is_sequence_model:
+        from tpuflow.data.pipeline import sequence_feature_names
+
+        dim = len(sequence_feature_names(schema, config.well_column))
+    else:
+        dim = len(schema.continuous_features)
+        dim += _PLACEHOLDER_VOCAB * len(schema.categorical_features)
+    if config.model in ("gilbert_residual", "lstm_residual"):
+        dim += 1  # the appended raw Gilbert prediction channel
+    return dim
+
+
+def abstract_batch(config, schema=None):
+    """The (x, y) ShapeDtypeStructs one training batch would carry."""
+    schema = schema if schema is not None else _schema(config)
+    feat = _feature_dim(config, schema)
+    b = config.batch_size
+    if config.is_sequence_model:
+        x = jax.ShapeDtypeStruct((b, config.window, feat), jnp.float32)
+        y_shape = (b, config.window) if config.teacher_forcing else (b,)
+    else:
+        x = jax.ShapeDtypeStruct((b, feat), jnp.float32)
+        y_shape = (b,)
+    return x, jax.ShapeDtypeStruct(y_shape, jnp.float32)
+
+
+def shape_dryrun(config) -> list[Diagnostic]:
+    """Abstractly run schema → batch → init → apply → loss; collect every
+    mismatch. Skips (with a warning) when the model/loss name itself is
+    unknown — that is the spec pass's finding, not a shape finding."""
+    from tpuflow.core.losses import LOSSES, mae_clip
+    from tpuflow.models import MODELS, build_model
+
+    if config.model not in MODELS:
+        return [_diag(
+            "shape.skipped",
+            f"shape dry-run skipped: unknown model {config.model!r} "
+            "(see the spec pass finding)",
+            where="model", severity="warning",
+        )]
+    try:
+        schema = _schema(config)
+        x, y = abstract_batch(config, schema)
+    except ValueError as e:
+        return [_diag(
+            "shape.skipped",
+            f"shape dry-run skipped: no abstract batch ({e})",
+            where="column_names", severity="warning",
+        )]
+
+    # An ill-typed model_kwargs is the spec pass's finding; dry-run the
+    # family at its defaults so the REST of the job still gets checked.
+    model_kwargs = (
+        dict(config.model_kwargs)
+        if isinstance(config.model_kwargs, dict) else {}
+    )
+    if config.model in ("gilbert_residual", "lstm_residual"):
+        # The training path injects the train split's target stats; any
+        # finite placeholder exercises the same shape contract.
+        model_kwargs.setdefault("target_mean", 0.0)
+        model_kwargs.setdefault("target_std", 1.0)
+    try:
+        model = build_model(config.model, **model_kwargs)
+    except Exception as e:  # noqa: BLE001 — any constructor failure IS the finding
+        return [_diag(
+            "shape.model_kwargs",
+            f"model {config.model!r} rejected model_kwargs "
+            f"{model_kwargs!r}: {type(e).__name__}: {e}",
+            where="model_kwargs",
+        )]
+
+    rng = jax.ShapeDtypeStruct((2,), jnp.uint32)  # PRNGKey stand-in
+    try:
+        variables = jax.eval_shape(model.init, rng, x)
+    except Exception as e:  # noqa: BLE001
+        return [_diag(
+            "shape.init",
+            f"model.init failed on abstract batch x{tuple(x.shape)}: "
+            f"{type(e).__name__}: {e}",
+            where="model_kwargs",
+        )]
+    try:
+        pred = jax.eval_shape(
+            lambda v, xx: model.apply(v, xx, deterministic=True),
+            variables, x,
+        )
+    except Exception as e:  # noqa: BLE001
+        return [_diag(
+            "shape.apply",
+            f"model.apply failed on abstract batch x{tuple(x.shape)}: "
+            f"{type(e).__name__}: {e}",
+            where="model",
+        )]
+
+    out = []
+    if tuple(pred.shape) != tuple(y.shape):
+        out.append(_diag(
+            "shape.target_mismatch",
+            f"model output {tuple(pred.shape)} != target {tuple(y.shape)} "
+            f"(teacher_forcing={config.teacher_forcing}); the loss would "
+            "silently broadcast or crash mid-epoch",
+            where="model",
+        ))
+    # mae_clip_pallas lowers a kernel; shape semantics match mae_clip.
+    loss_fn = LOSSES.get(config.loss, mae_clip)
+    if config.loss == "mae_clip_pallas":
+        loss_fn = mae_clip
+    try:
+        loss = jax.eval_shape(loss_fn, y, pred)
+        if loss.shape != ():
+            out.append(_diag(
+                "shape.loss_rank",
+                f"loss {config.loss!r} returned shape {tuple(loss.shape)}, "
+                "expected a scalar",
+                where="loss",
+            ))
+    except Exception as e:  # noqa: BLE001
+        out.append(_diag(
+            "shape.loss",
+            f"loss {config.loss!r} failed on (y{tuple(y.shape)}, "
+            f"pred{tuple(pred.shape)}): {type(e).__name__}: {e}",
+            where="loss",
+        ))
+    return out

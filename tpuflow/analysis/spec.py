@@ -1,0 +1,300 @@
+"""Pass 1 — job-spec validation: cross-field checks on ``TrainJobConfig``.
+
+Every check here is a pure function of the config (plus the process
+environment for ``TPUFLOW_FAULTS``): no data is read, no model is built,
+no device is touched. Each finding names the offending field and, for
+enum-like fields, the valid choices — the reference system's
+submit-and-wait-for-the-cluster-traceback loop (PAPERS.md: SparkNet,
+BigDL) replaced by a millisecond rejection at the door.
+
+Error texts for conditions the training path also guards keep the
+training path's exact phrasing (``needs data_path``, ``bounded-memory
+stream``, ``JSON-serializable``, ...) so a caller that matched on the
+late error keeps matching on the early one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from tpuflow.analysis.diagnostics import Diagnostic
+
+_PASS = "spec"
+
+# The fields whose values are registry keys, and where the registry lives.
+_RESIDUAL_MODELS = ("gilbert_residual", "lstm_residual")
+
+
+def _diag(code, message, where=None, choices=(), severity="error"):
+    return Diagnostic(
+        pass_name=_PASS, code=code, message=message, where=where,
+        choices=tuple(choices), severity=severity,
+    )
+
+
+def _check_registries(config) -> list[Diagnostic]:
+    from tpuflow.core.losses import LOSSES
+    from tpuflow.models import MODELS
+    from tpuflow.train.optim import OPTIMIZERS
+
+    out = []
+    if config.model not in MODELS:
+        out.append(_diag(
+            "spec.model.unknown",
+            f"unknown model {config.model!r}",
+            where="model", choices=sorted(MODELS),
+        ))
+    if config.loss not in LOSSES:
+        out.append(_diag(
+            "spec.loss.unknown",
+            f"unknown loss {config.loss!r}",
+            where="loss", choices=sorted(LOSSES),
+        ))
+    if config.optimizer not in OPTIMIZERS:
+        out.append(_diag(
+            "spec.optimizer.unknown",
+            f"unknown optimizer {config.optimizer!r}",
+            where="optimizer", choices=sorted(OPTIMIZERS),
+        ))
+    return out
+
+
+def _check_schema(config) -> list[Diagnostic]:
+    from tpuflow.data.schema import Schema
+    from tpuflow.data.synthetic import (
+        SYNTHETIC_COLUMN_NAMES,
+        SYNTHETIC_COLUMN_TYPES,
+        SYNTHETIC_TARGET,
+    )
+
+    names = config.column_names or SYNTHETIC_COLUMN_NAMES
+    types = config.column_types or SYNTHETIC_COLUMN_TYPES
+    target = config.target or SYNTHETIC_TARGET
+    out = []
+    try:
+        schema = Schema.from_cli(names, types, target)
+    except ValueError as e:
+        return [_diag(
+            "spec.schema.invalid", str(e),
+            where="column_names/column_types/target",
+        )]
+    if not schema.feature_columns:
+        out.append(_diag(
+            "spec.schema.no_features",
+            "schema has no feature columns (every column is the target)",
+            where="column_names",
+        ))
+    if config.well_column and config.well_column not in schema.names:
+        out.append(_diag(
+            "spec.well_column.unknown",
+            f"well_column {config.well_column!r} is not a schema column",
+            where="well_column", choices=schema.names,
+        ))
+    if config.model in _RESIDUAL_MODELS:
+        missing = {"pressure", "choke", "glr"} - set(schema.names)
+        if missing:
+            out.append(_diag(
+                "spec.schema.physics_columns",
+                f"{config.model} needs pressure/choke/glr columns; "
+                f"schema is missing {sorted(missing)}",
+                where="column_names",
+            ))
+    return out
+
+
+def _check_scalars(config) -> list[Diagnostic]:
+    out = []
+    positive = (
+        ("batch_size", config.batch_size),
+        ("max_epochs", config.max_epochs),
+        ("window", config.window),
+        ("stride", config.stride),
+        ("accumulate_steps", config.accumulate_steps),
+        ("synthetic_wells", config.synthetic_wells),
+        ("synthetic_steps", config.synthetic_steps),
+        ("stream_chunk_rows", config.stream_chunk_rows),
+        ("stream_sample_rows", config.stream_sample_rows),
+        ("stream_eval_rows", config.stream_eval_rows),
+    )
+    for name, value in positive:
+        if value < 1:
+            out.append(_diag(
+                f"spec.{name}.range",
+                f"{name} must be >= 1, got {value}", where=name,
+            ))
+    non_negative = (
+        ("patience", config.patience),
+        ("clip_norm", config.clip_norm),
+        ("save_every", config.save_every),
+        ("stream_shuffle_buffer", config.stream_shuffle_buffer),
+        ("pp_microbatches", config.pp_microbatches),
+    )
+    for name, value in non_negative:
+        if value < 0:
+            out.append(_diag(
+                f"spec.{name}.range",
+                f"{name} must be >= 0, got {value}", where=name,
+            ))
+    return out
+
+
+def _check_windowing(config) -> list[Diagnostic]:
+    from tpuflow.models import MODELS
+
+    if config.model not in MODELS or not config.is_sequence_model:
+        return []
+    if config.data_path is None and config.window > config.synthetic_steps:
+        return [_diag(
+            "spec.window.empty",
+            f"window {config.window} > synthetic_steps "
+            f"{config.synthetic_steps}: every synthetic well yields ZERO "
+            "windows (no training data)",
+            where="window",
+        )]
+    return []
+
+
+def _check_stream(config) -> list[Diagnostic]:
+    out = []
+    if not config.stream:
+        return out
+    if config.data_path is None:
+        out.append(_diag(
+            "spec.stream.data_path",
+            "stream=True needs data_path (nothing to stream)",
+            where="data_path",
+        ))
+    if config.is_sequence_model and config.well_column is None:
+        out.append(_diag(
+            "spec.stream.well_column",
+            "streaming sequence ingest splits train/val/test by WELL "
+            "(windows must not straddle splits); pass well_column",
+            where="well_column",
+        ))
+    if config.model in _RESIDUAL_MODELS:
+        out.append(_diag(
+            "spec.stream.residual",
+            f"stream=True does not support {config.model} (the Gilbert "
+            "channel is appended by the materialized pipeline)",
+            where="model",
+        ))
+    if config.jit_epoch is True:
+        out.append(_diag(
+            "spec.stream.jit_epoch",
+            "jit_epoch stacks the whole epoch into device arrays and "
+            "would defeat the bounded-memory stream; use per-batch "
+            "stepping for streaming runs",
+            where="jit_epoch",
+        ))
+    return out
+
+
+def _check_storage(config) -> list[Diagnostic]:
+    out = []
+    if config.save_every and not config.storage_path:
+        out.append(_diag(
+            "spec.save_every.storage", severity="warning",
+            message=f"save_every={config.save_every} without storage_path: "
+            "no run checkpoints will be written",
+            where="save_every",
+        ))
+    if config.resume and not config.storage_path:
+        out.append(_diag(
+            "spec.resume.storage", severity="warning",
+            message="resume=True without storage_path: there is no "
+            "checkpoint tree to resume from",
+            where="resume",
+        ))
+    if not isinstance(config.model_kwargs, dict):
+        out.append(_diag(
+            "spec.model_kwargs.type",
+            f"model_kwargs must be a dict, got "
+            f"{type(config.model_kwargs).__name__}",
+            where="model_kwargs",
+        ))
+    elif config.storage_path:
+        from tpuflow.api.train_api import _sidecar_kwargs
+
+        try:
+            json.dumps(_sidecar_kwargs(config.model_kwargs))
+        except (TypeError, ValueError) as e:
+            out.append(_diag(
+                "spec.model_kwargs.json",
+                f"model_kwargs must be JSON-serializable when storage_path "
+                f"is set (the serving sidecar records them): {e}",
+                where="model_kwargs",
+            ))
+    if not isinstance(config.optimizer_kwargs, dict):
+        out.append(_diag(
+            "spec.optimizer_kwargs.type",
+            f"optimizer_kwargs must be a dict, got "
+            f"{type(config.optimizer_kwargs).__name__}",
+            where="optimizer_kwargs",
+        ))
+    return out
+
+
+def _check_faults(config) -> list[Diagnostic]:
+    from tpuflow.resilience.faults import SITES, parse_fault_spec
+
+    out = []
+    for i, entry in enumerate(config.faults or ()):
+        if not isinstance(entry, str):
+            out.append(_diag(
+                "spec.faults.type",
+                f"faults[{i}] must be a 'site[,key=value...]' string, "
+                f"got {type(entry).__name__}: {entry!r}",
+                where=f"faults[{i}]",
+            ))
+            continue
+        try:
+            parse_fault_spec(entry)
+        except (ValueError, TypeError) as e:
+            out.append(_diag(
+                "spec.faults.invalid",
+                f"faults[{i}] {entry!r}: {e}",
+                where=f"faults[{i}]", choices=sorted(SITES),
+            ))
+    from tpuflow.resilience.faults import (
+        FAULTS_ENV_GRAMMAR,
+        parse_fault_entries,
+    )
+
+    # The SAME parse loop the runtime arms with: a value that preflights
+    # clean here is by construction a value fault_point will accept.
+    _, errors = parse_fault_entries(os.environ.get("TPUFLOW_FAULTS", ""))
+    for entry, msg in errors:
+        out.append(_diag(
+            "spec.faults.env",
+            f"TPUFLOW_FAULTS entry {entry!r}: {msg} "
+            f"(expected {FAULTS_ENV_GRAMMAR})",
+            where="TPUFLOW_FAULTS", choices=sorted(SITES),
+        ))
+    return out
+
+
+def validate_spec(config) -> list[Diagnostic]:
+    """Cross-field validation of a ``TrainJobConfig``; returns ALL
+    findings, never raises on a bad spec.
+
+    Each sub-check runs behind a safety net: a config field with an
+    unusable TYPE (a JSON spec can put a string where an int belongs)
+    must surface as a finding against that check, not abort the whole
+    preflight with a traceback and hide every other finding.
+    """
+    out = []
+    for check in (
+        _check_registries, _check_schema, _check_scalars,
+        _check_windowing, _check_stream, _check_storage, _check_faults,
+    ):
+        try:
+            out += check(config)
+        except Exception as e:  # noqa: BLE001 — the net IS the contract
+            out.append(_diag(
+                "spec.unusable_config",
+                f"{check.__name__.lstrip('_')} could not run on this "
+                f"config ({type(e).__name__}: {e}) — a field has an "
+                "unusable type or value",
+            ))
+    return out

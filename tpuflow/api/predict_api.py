@@ -93,6 +93,12 @@ class Predictor:
             _meta_path(storage_path, name), "r", encoding="utf-8"
         ) as f:
             meta = json.load(f)
+        # Static sidecar/config compatibility BEFORE touching the
+        # checkpoint: a stale or hand-edited sidecar fails here naming
+        # the bad field, not deep in Orbax restore as a pytree mismatch.
+        from tpuflow.analysis.artifact import ensure_artifact_meta
+
+        ensure_artifact_meta(meta, where=_meta_path(storage_path, name))
         model = build_model(meta["model"], **meta["model_kwargs"])
         sample = np.zeros([2] + list(meta["sample_shape"][1:]), np.float32)
         template = model.init(jax.random.PRNGKey(0), sample)["params"]

@@ -438,85 +438,26 @@ def _validate_model_axis(config, jit_epoch: bool, n_dev: int) -> None:
     """Config-only model-axis validation, run BEFORE data preparation:
     a misconfigured tp/pp/ep job must fail in milliseconds, not after a
     possibly hours-long ingest+feature phase (the same early-rejection
-    discipline as the stream+jit_epoch check)."""
-    if sum(n > 1 for n in (config.tp, config.pp, config.ep)) > 1:
-        raise ValueError(
-            "tp, pp, and ep cannot be combined yet; pick one model-axis "
-            "strategy per job"
-        )
-    if config.pp_microbatches and config.pp <= 1:
-        raise ValueError(
-            "pp_microbatches is a pipeline knob; set pp>1 (a value "
-            "silently ignored would fake GPipe accumulation)"
-        )
-    # Family gates for the single-family strategies (tp's Dense-stack
-    # check is structural and stays in mlp_tp_shardings): the sharding
-    # builders would also raise, but only AFTER data preparation.
-    if config.pp > 1 and config.model != "pipeline_mlp":
-        raise ValueError(
-            f"pp>1 training supports the pipeline_mlp family; got model "
-            f"{config.model!r}"
-        )
-    if config.ep > 1 and config.model != "moe_mlp":
-        raise ValueError(
-            f"ep>1 training supports the moe_mlp family; got model "
-            f"{config.model!r}"
-        )
-    for name, n in (("tp", config.tp), ("pp", config.pp), ("ep", config.ep)):
-        if n <= 1:
-            continue
-        if jit_epoch:
-            raise ValueError(
-                f"{name}>1 trains through its per-batch sharded step; "
-                f"jit_epoch is not supported with {name}"
-            )
-        if n_dev % n:
-            raise ValueError(
-                f"n_devices {n_dev} not divisible by {name}={n}"
-            )
-    if config.pp > 1:
-        n_micro = config.pp_microbatches or config.pp
-        if config.batch_size % n_micro:
-            raise ValueError(
-                f"batch_size {config.batch_size} not divisible by "
-                f"{n_micro} pipeline microbatches"
-            )
-        if (config.batch_size // n_micro) % (n_dev // config.pp):
-            raise ValueError(
-                f"microbatch {config.batch_size // n_micro} not divisible "
-                f"by {n_dev // config.pp} data-parallel devices"
-            )
-    for name, n in (("tp", config.tp), ("ep", config.ep)):
-        if n > 1 and config.batch_size % (n_dev // n):
-            raise ValueError(
-                f"batch_size {config.batch_size} not divisible by "
-                f"{n_dev // n} data-parallel devices"
-            )
-    # tp/pp/ep all ride the same (data, model) mesh layout, so the
-    # multi-host shape constraints are identical across them.
-    model_axis = max(config.tp, config.pp, config.ep)
-    axis_name = (
-        "tp" if config.tp > 1 else "pp" if config.pp > 1 else "ep"
+    discipline as the stream+jit_epoch check). The rule set itself lives
+    in ``tpuflow.analysis.plan`` — one ruleset shared with preflight, so
+    a plan rejected at submission and a plan rejected here are the same
+    rule with the same message."""
+    import dataclasses
+
+    from tpuflow.analysis.plan import check_plan
+
+    diags = check_plan(
+        # n_dev is already resolved (config.n_devices or device_count);
+        # pin it so the checker sees exactly the mesh this run would use.
+        dataclasses.replace(config, n_devices=n_dev),
+        device_count=jax.device_count(),
+        local_device_count=jax.local_device_count(),
+        process_count=jax.process_count(),
+        jit_epoch=jit_epoch,
     )
-    if model_axis > 1 and jax.process_count() > 1:
-        if n_dev != jax.device_count():
-            # A submesh would leave some processes with ZERO mesh
-            # devices while process_batch_bounds still hands them batch
-            # rows — make_array_from_process_local_data then crashes on
-            # the first batch, after data preparation.
-            raise ValueError(
-                f"multi-host {axis_name} needs the full pod: n_devices "
-                f"{n_dev} != device_count {jax.device_count()}"
-            )
-        if jax.local_device_count() % model_axis:
-            # Every process's devices must cover WHOLE data-axis rows,
-            # or per-process batch slices would split a model group
-            # across hosts.
-            raise ValueError(
-                f"multi-host {axis_name}={model_axis} needs the "
-                f"{jax.local_device_count()} local devices per process "
-                f"to be a multiple of {axis_name}"
-            )
+    errors = [d for d in diags if d.severity == "error"]
+    if errors:
+        raise ValueError("; ".join(d.message for d in errors))
 
 
 def train(
@@ -542,6 +483,15 @@ def train(
     disarmed on the way out so nothing leaks into a later run in the
     same process).
     """
+    # Fail-fast on submission: the spec pass of the preflight analyzer
+    # (registry keys, schema, windowing, stream knobs, fault grammar)
+    # rejects a malformed job in milliseconds, before ANY ingest — and
+    # reports every problem at once, not the first one hit. Plan/mesh
+    # arithmetic runs just below via _validate_model_axis (which shares
+    # the analyzer's rule set); the shape dry-run is preflight-only.
+    from tpuflow.analysis import ensure_preflight
+
+    ensure_preflight(config, passes=("spec",))
     fault_handles = []
     if config.faults:
         from tpuflow.resilience import arm, parse_fault_spec
@@ -617,19 +567,9 @@ def _train_impl(
         )
     n_dev = config.n_devices or jax.device_count()
     _validate_model_axis(config, jit_epoch, n_dev)
-    if config.storage_path:
-        # The serving sidecar serializes (sanitized) model_kwargs as JSON
-        # at the END of training; anything still unserializable after
-        # sanitization must fail HERE, not after the fit.
-        import json as _json
-
-        try:
-            _json.dumps(_sidecar_kwargs(config.model_kwargs))
-        except (TypeError, ValueError) as e:
-            raise ValueError(
-                f"model_kwargs must be JSON-serializable when storage_path "
-                f"is set (the serving sidecar records them): {e}"
-            ) from None
+    # (model_kwargs JSON-serializability under storage_path is enforced
+    # by train()'s preflight spec pass — tpuflow/analysis/spec.py
+    # _check_storage, which reuses _sidecar_kwargs — before we get here.)
 
     if _data_cache is not None:
         key = _prep_key(config)

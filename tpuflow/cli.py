@@ -84,6 +84,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics", default=None, metavar="PATH",
                    help="append per-epoch JSONL metric records to PATH")
     p.add_argument("--quiet", action="store_true")
+    p.add_argument("--preflight", action="store_true", default=True,
+                   dest="preflight",
+                   help="static-analyze the job before running it "
+                        "(spec/plan/shape passes; the default)")
+    p.add_argument("--no-preflight", action="store_false", dest="preflight",
+                   help="skip preflight static analysis (a bad spec then "
+                        "fails wherever the runtime first hits it)")
     p.add_argument("--predict", action="store_true",
                    help="serve: load the trained artifact from storagePath and predict --data")
     p.add_argument("--out", default=None, help="with --predict: write predictions CSV here")
@@ -96,6 +103,37 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.predict:
         return _predict_main(args)
+    # Registry-backed parse-time validation: an unknown family dies HERE
+    # with the catalog in hand, not minutes later as a KeyError deep in
+    # training (kept out of argparse choices= so --help stays import-free).
+    from tpuflow.models import MODELS
+
+    if args.model not in MODELS:
+        print(
+            f"--model: unknown model {args.model!r}; valid: "
+            f"{', '.join(sorted(MODELS))}",
+            file=sys.stderr,
+        )
+        return 2
+    compare_names = ()
+    if args.compare:
+        compare_names = tuple(
+            m.strip() for m in args.compare.split(",") if m.strip()
+        )
+        unknown = [m for m in compare_names if m not in MODELS]
+        if unknown:
+            # Name typos fail at submission with the catalog in hand —
+            # the job-runner's documented contract for compare specs
+            # (serve.py: "typos fail at submission, not as all-FAILED
+            # rows"). Candidates with VALID names that fail deeper
+            # preflight are different: those fall through to compare()'s
+            # record-failures-and-continue handling below.
+            print(
+                f"--compare: unknown models {unknown}; valid: "
+                f"{', '.join(sorted(MODELS))}",
+                file=sys.stderr,
+            )
+            return 2
     from tpuflow.api import TrainJobConfig, train
 
     model_kwargs = {}
@@ -105,10 +143,18 @@ def main(argv=None) -> int:
         try:
             model_kwargs = json.loads(args.model_kwargs)
         except json.JSONDecodeError as e:
-            print(f"--model-kwargs is not valid JSON: {e}", file=sys.stderr)
+            print(
+                f"--model-kwargs: {args.model_kwargs!r} is not valid "
+                f"JSON: {e}",
+                file=sys.stderr,
+            )
             return 2
         if not isinstance(model_kwargs, dict):
-            print("--model-kwargs must be a JSON object", file=sys.stderr)
+            print(
+                f"--model-kwargs must be a JSON object, got "
+                f"{args.model_kwargs!r}",
+                file=sys.stderr,
+            )
             return 2
 
     config = TrainJobConfig(
@@ -148,11 +194,51 @@ def main(argv=None) -> int:
         trace_dir=args.trace_dir,
         metrics_path=args.metrics,
     )
+    if args.preflight:
+        # Preflight-by-default: the whole job is statically analyzed —
+        # spec cross-checks, mesh/plan arithmetic, and an eval_shape
+        # dry-run — before ANY ingest or compile. --no-preflight escapes
+        # (the runtime's own later guards still apply).
+        import dataclasses
+
+        import jax
+
+        from tpuflow.analysis import preflight
+
+        failed = 0
+        candidates = (
+            [dataclasses.replace(config, model=m) for m in compare_names]
+            if compare_names else [config]
+        )
+        for cfg in candidates:
+            report = preflight(
+                cfg,
+                device_count=jax.device_count(),
+                local_device_count=jax.local_device_count(),
+                process_count=jax.process_count(),
+            )
+            if not report.ok:
+                print(report.render(), file=sys.stderr)
+                failed += 1
+        # A compare is all-candidates-or-nothing ONLY when every family
+        # fails preflight: compare()'s contract is record-failures-and-
+        # continue (the comparison is the deliverable), so a candidate
+        # with a valid name but a failing spec/plan/shape is reported
+        # here and then recorded as a FAILED row by compare's own
+        # handling — the healthy families still train. (Unknown NAMES
+        # were already rejected at parse time above, the serve.py
+        # submission contract.)
+        if failed == len(candidates):
+            print(
+                "preflight failed: the job was rejected before any data "
+                "was read or program compiled (--no-preflight to bypass)",
+                file=sys.stderr,
+            )
+            return 2
     if args.compare:
         from tpuflow.api import compare
 
-        names = tuple(m.strip() for m in args.compare.split(",") if m.strip())
-        report = compare(names, config)
+        report = compare(compare_names, config)
         print(report.table())
         return 0 if report.ranked else 1
     train(config)

@@ -30,6 +30,11 @@ def try_fallback(storage_path: str, name: str, reason: str):
 
     None when the schema sidecar is unreadable too — then nothing proves
     the artifact ever existed, and degrading would mask a caller error.
+    Same verdict when the sidecar parses but is structurally INVALID
+    (``tpuflow.analysis.artifact``): degradation exists for lost
+    checkpoints behind a healthy description, and answering a corrupt
+    description with physics would bury the named-field diagnostic the
+    load failure just raised.
     """
     try:
         from tpuflow.api.predict_api import _meta_path
@@ -41,6 +46,10 @@ def try_fallback(storage_path: str, name: str, reason: str):
             meta = json.load(f)
     except Exception:
         return None
+    from tpuflow.analysis.artifact import check_artifact_meta
+
+    if check_artifact_meta(meta):
+        return None  # broken sidecar: fail loudly, do not mask it
     return GilbertFallbackPredictor(name, meta, reason)
 
 

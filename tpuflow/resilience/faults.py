@@ -143,6 +143,33 @@ class FaultSpec:
         return f"{self.site},{when},mode={self.mode}"
 
 
+# The one statement of the multi-entry grammar, shared by the arming
+# error below and the preflight spec pass (tpuflow/analysis/spec.py) —
+# the validator and the runtime must describe the SAME language.
+FAULTS_ENV_GRAMMAR = (
+    "';'-separated entries of the form 'site[,key=value...]' "
+    "(e.g. 'checkpoint.save,at=3,mode=exit')"
+)
+
+
+def parse_fault_entries(value: str) -> tuple[list, list]:
+    """Parse a ``;``-separated multi-spec value (the ``TPUFLOW_FAULTS``
+    format). Returns ``(specs, errors)`` where ``errors`` is a list of
+    ``(entry, message)`` pairs — never raises, so a validator can report
+    EVERY bad entry while the arming path turns any error into its own
+    fail-loud raise. One parse loop for both: the language the preflight
+    validates is by construction the language the runtime arms."""
+    specs, errors = [], []
+    for entry in value.split(";"):
+        if not entry.strip():
+            continue
+        try:
+            specs.append(parse_fault_spec(entry))
+        except ValueError as e:
+            errors.append((entry.strip(), str(e)))
+    return specs, errors
+
+
 def parse_fault_spec(text: str) -> FaultSpec:
     """Parse one ``site[,key=value...]`` entry into a FaultSpec."""
     parts = [p.strip() for p in text.strip().split(",") if p.strip()]
@@ -230,12 +257,17 @@ def _sync_env_locked() -> None:
     # after a clean parse: a typo'd second entry must not leave the
     # first one armed with the rest silently dropped — and because the
     # cache stays stale on failure, EVERY subsequent fault_point keeps
-    # raising until the env is fixed (fail-loud, not fail-once).
-    new_specs = [
-        parse_fault_spec(entry)
-        for entry in value.split(";")
-        if entry.strip()
-    ]
+    # raising until the env is fixed (fail-loud, not fail-once). The
+    # re-raise names the env var and the grammar: this error surfaces
+    # inside whatever code path hit the fault_point, far from where the
+    # operator exported the variable.
+    new_specs, errors = parse_fault_entries(value)
+    if errors:
+        detail = "; ".join(f"{entry!r}: {msg}" for entry, msg in errors)
+        raise ValueError(
+            f"malformed TPUFLOW_FAULTS entry — {detail} — expected "
+            f"{FAULTS_ENV_GRAMMAR}; nothing was armed"
+        )
     _ENV_CACHE = value
     for spec in new_specs:
         _ARMED.setdefault(spec.site, []).append(spec)

@@ -533,6 +533,12 @@ class JobRunner:
         if compare_models is not None and sweep_grid is not None:
             raise ValueError("a job is either 'compare' or 'sweep', not both")
         config = spec_to_config(base)  # validate before queueing
+        # NOTE: deeper spec preflight deliberately does NOT run here —
+        # the submission contract accepts any well-formed spec (202) and
+        # reports semantic errors through the job's own lifecycle. The
+        # worker's train() preflights on startup, so a malformed job
+        # still fails in milliseconds with the full diagnostic in its
+        # error field, without ever reading data or compiling.
         if compare_models is not None:
             if not isinstance(compare_models, list) or not compare_models:
                 raise ValueError("'compare' must be a non-empty list of models")

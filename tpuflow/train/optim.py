@@ -25,14 +25,30 @@ def keras_sgd(
     return optax.sgd(schedule, momentum=momentum, nesterov=nesterov)
 
 
+def _adam(**kwargs) -> optax.GradientTransformation:
+    return optax.adam(kwargs.pop("learning_rate", 1e-3), **kwargs)
+
+
+def _adamw(**kwargs) -> optax.GradientTransformation:
+    return optax.adamw(kwargs.pop("learning_rate", 1e-3), **kwargs)
+
+
+# name -> builder: the registry preflight (tpuflow/analysis) validates
+# TrainJobConfig.optimizer against, same shape as models.MODELS and
+# core.losses.LOSSES.
+OPTIMIZERS = {
+    "keras_sgd": keras_sgd,
+    "adam": _adam,
+    "adamw": _adamw,
+}
+
+
 def build_optimizer(name: str = "keras_sgd", **kwargs) -> optax.GradientTransformation:
-    if name == "keras_sgd":
-        return keras_sgd(**kwargs)
-    if name == "adam":
-        return optax.adam(kwargs.pop("learning_rate", 1e-3), **kwargs)
-    if name == "adamw":
-        return optax.adamw(kwargs.pop("learning_rate", 1e-3), **kwargs)
-    raise ValueError(f"unknown optimizer {name!r}")
+    if name not in OPTIMIZERS:
+        raise ValueError(
+            f"unknown optimizer {name!r}; known: {sorted(OPTIMIZERS)}"
+        )
+    return OPTIMIZERS[name](**kwargs)
 
 
 def wrap_optimizer(

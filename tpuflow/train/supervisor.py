@@ -103,6 +103,16 @@ def _validate(spec: dict) -> None:
             "supervise() needs save_every >= 1 in the spec — restart "
             "recovery resumes from the periodic full-state checkpoints"
         )
+    # Fail-fast preflight (spec pass only): a malformed job must die at
+    # submission in THIS process, not after a child launch + jax startup
+    # per restart attempt — a deterministic spec error would otherwise
+    # burn the whole restart budget before surfacing. The spec pass
+    # touches no accelerator state, so the supervisor parent stays off
+    # the chip; plan/shape run inside the child's own train() preflight.
+    from tpuflow.analysis import ensure_preflight
+    from tpuflow.serve import spec_to_config
+
+    ensure_preflight(spec_to_config(spec), passes=("spec",))
 
 
 def _read_progress(path: str):
