@@ -47,7 +47,11 @@ before one full sweep finished):
   attempt is killed and the REMAINING attempts run with
   JAX_PLATFORMS=cpu — the record then carries ``device: "cpu"`` and
   ``"fallback"`` explaining why, which is honest and still infinitely
-  more useful than the ``value: 0.0`` rounds 1-3 recorded;
+  more useful than the ``value: 0.0`` rounds 1-3 recorded; CPU-fallback
+  and failure tails additionally embed the newest committed
+  ``BENCH_r*_session.json`` as a provenance-labeled ``last_on_chip``
+  field, so the round artifact keeps the real chip number even when the
+  relay is dead;
 - nothing dispatches eagerly before the warmed-up compiled step: all
   host-side slicing/broadcasting happens in numpy.
 
@@ -485,20 +489,70 @@ def worker() -> None:
 # --------------------------------------------------------------------------
 
 
-def _emit_failure(attempts: int, last_err: str) -> None:
-    print(
-        json.dumps(
-            {
-                "metric": METRIC,
-                "value": 0.0,
-                "unit": "samples/sec/chip",
-                "vs_baseline": 0.0,
-                "attempts": attempts,
-                "error": last_err[-800:],
-            }
-        ),
-        flush=True,
+def _last_on_chip(root: str | None = None) -> dict | None:
+    """The newest committed on-chip session record, provenance-labeled.
+
+    Rounds that lose the relay should still carry the real chip story:
+    a CPU-fallback (or outright failure) tail line embeds the freshest
+    ``BENCH_r*_session.json`` under ``last_on_chip``, so a dead relay
+    can never again reduce the round artifact to a bare 0.39x CPU
+    number (round 5's VERDICT ask 1b). Newest round first; a corrupt or
+    value-less file falls through to the next-newest. None when no
+    usable session record exists — the field is then simply absent.
+    """
+    import glob
+    import re
+
+    if root is None:
+        root = os.path.dirname(os.path.abspath(__file__))
+
+    def round_num(path: str) -> int:
+        m = re.search(r"BENCH_r(\d+)_session\.json$", os.path.basename(path))
+        return int(m.group(1)) if m else -1
+
+    candidates = sorted(
+        (p for p in glob.glob(os.path.join(root, "BENCH_r*_session.json"))
+         if round_num(p) >= 0),
+        key=round_num,
+        reverse=True,
     )
+    for path in candidates:
+        try:
+            with open(path, encoding="utf-8") as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not isinstance(rec, dict):
+            continue
+        if not isinstance(rec.get("value"), (int, float)) or rec["value"] <= 0:
+            continue
+        # Labels AFTER the spread: a session record carrying its own
+        # "source"/"provenance" keys must never overwrite the
+        # not-measured-by-this-run guard this field exists to provide.
+        return {
+            **rec,
+            "source": os.path.basename(path),
+            "provenance": (
+                "committed on-chip session record from a prior round; "
+                "NOT measured by this run"
+            ),
+        }
+    return None
+
+
+def _emit_failure(attempts: int, last_err: str) -> None:
+    rec = {
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": "samples/sec/chip",
+        "vs_baseline": 0.0,
+        "attempts": attempts,
+        "error": last_err[-800:],
+    }
+    on_chip = _last_on_chip()
+    if on_chip is not None:
+        rec["last_on_chip"] = on_chip
+    print(json.dumps(rec), flush=True)
 
 
 def main() -> None:
@@ -558,6 +612,11 @@ def main() -> None:
                     "cpu: the TPU backend never came up (relay dead?); "
                     "this is a host measurement, not the chip"
                 )
+                on_chip = _last_on_chip()
+                if on_chip is not None:
+                    # The round artifact keeps the real chip story even
+                    # when the relay dies (VERDICT ask 1b).
+                    rec["last_on_chip"] = on_chip
             print(json.dumps(rec), flush=True)
             state["best"] = rec
 

@@ -1,0 +1,249 @@
+"""Benchmark: serving fast path — batched vs unbatched /predict over HTTP.
+
+Drives the REAL HTTP server (``tpuflow.serve.make_server``, in-process
+on an ephemeral port) with N closed-loop concurrent clients hammering
+``POST /predict`` against one trained artifact, and reports requests/sec
+plus client-observed latency percentiles for two same-process modes:
+
+- ``unbatched`` — today's thread-per-request path: every request runs
+  its own jitted forward;
+- ``batched``   — the cross-request micro-batcher + bucket warmup
+  (``tpuflow/microbatch.py``): concurrent forwards coalesce into shared
+  pow-2-padded dispatches.
+
+The win this measures is amortized per-dispatch overhead — the same
+lever SparkNet/BigDL pull (PAPERS.md) — so it is demonstrable under
+``JAX_PLATFORMS=cpu``: no flaky TPU relay required. One JSON record per
+(mode, client-count) plus a speedup record per client-count, and the
+whole comparison is also written to ``benchmarks/serving_results.json``
+(the committed evidence for the round).
+
+Env knobs: BENCH_SERVE_CLIENTS (comma list of concurrent client counts,
+default "8,16"), BENCH_SERVE_SECONDS (measure window per mode, default
+4), BENCH_SERVE_ROWS (rows per request, default 8), BENCH_SERVE_MAX_BATCH
+(batcher row cap, default 256), BENCH_SERVE_WAIT_MS (coalescing window,
+default 2.0), BENCH_SERVE_WARMUP (pow-2 buckets pre-compiled at load,
+default 4).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, ".")
+
+from benchmarks.common import emit, maybe_pin_cpu  # noqa: E402
+
+maybe_pin_cpu()
+
+import numpy as np  # noqa: E402
+
+
+def _client_counts() -> list[int]:
+    raw = os.environ.get("BENCH_SERVE_CLIENTS", "8,16")
+    counts = [int(c) for c in raw.split(",") if c.strip()]
+    if not counts or any(c < 1 for c in counts):
+        raise ValueError(f"BENCH_SERVE_CLIENTS={raw!r} needs positive ints")
+    return counts
+
+
+def _train_artifact(storage: str) -> None:
+    """One tiny tabular artifact — the forward under test, not the
+    training, is what's measured; keep this as small as a real artifact
+    gets."""
+    from tpuflow.api import TrainJobConfig, train
+
+    train(
+        TrainJobConfig(
+            model="static_mlp",
+            max_epochs=1,
+            batch_size=32,
+            seed=0,
+            verbose=False,
+            n_devices=1,
+            storage_path=storage,
+            synthetic_wells=4,
+            synthetic_steps=64,
+        )
+    )
+
+
+def _payload(storage: str, rows: int) -> bytes:
+    """One /predict body, reused by every request (the clients measure
+    serving, not JSON construction). Columns come from the same synthetic
+    generator the artifact trained on, so the full schema — including the
+    categorical ``completion`` column — is present."""
+    from tpuflow.data.synthetic import generate_wells, wells_to_table
+
+    table = wells_to_table(generate_wells(1, max(rows, 2), seed=9))
+    table.pop("flow")  # serving is unlabeled
+    columns = {
+        k: np.asarray(v)[:rows].tolist() for k, v in table.items()
+    }
+    return json.dumps(
+        {"storagePath": storage, "model": "static_mlp", "columns": columns}
+    ).encode()
+
+
+def _post(url: str, body: bytes) -> dict:
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def _drive(base: str, body: bytes, clients: int, seconds: float) -> dict:
+    """Closed-loop load: ``clients`` threads each issue the next request
+    as soon as the previous answer lands; returns req/s + latency
+    percentiles over the timed window."""
+    stop = time.monotonic() + seconds
+    barrier = threading.Barrier(clients + 1)
+    lat: list[list[float]] = [[] for _ in range(clients)]
+    errors: list[str] = []
+
+    def client(i: int) -> None:
+        barrier.wait()
+        while time.monotonic() < stop:
+            t0 = time.perf_counter()
+            try:
+                out = _post(base + "/predict", body)
+            except Exception as e:  # one bad request fails the bench run
+                errors.append(f"{type(e).__name__}: {e}")
+                return
+            if "predictions" not in out or out.get("degraded"):
+                errors.append(f"bad response: {out}")
+                return
+            lat[i].append(time.perf_counter() - t0)
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t_start = time.monotonic()
+    for t in threads:
+        t.join(timeout=seconds + 60)
+    elapsed = time.monotonic() - t_start
+    if errors:
+        raise RuntimeError(f"client errors: {errors[:3]}")
+    all_lat = np.asarray([v for per in lat for v in per], np.float64)
+    if len(all_lat) == 0:
+        raise RuntimeError("no requests completed inside the window")
+    return {
+        "requests": int(len(all_lat)),
+        "requests_per_sec": round(len(all_lat) / elapsed, 1),
+        "p50_ms": round(float(np.percentile(all_lat, 50)) * 1000, 3),
+        "p99_ms": round(float(np.percentile(all_lat, 99)) * 1000, 3),
+        "mean_ms": round(float(all_lat.mean()) * 1000, 3),
+    }
+
+
+def _measure_mode(
+    storage: str, body: bytes, batched: bool, clients: int, seconds: float
+) -> dict:
+    """One (mode, client-count) measurement against a fresh server (fresh
+    PredictService: per-mode counters and caches don't bleed across
+    modes; jit's in-process compile cache persisting across modes is fine
+    — both modes benefit equally after their warm lap)."""
+    from tpuflow.serve import make_server
+
+    srv = make_server(
+        "127.0.0.1", 0,
+        batch_predicts=batched,
+        batch_max_rows=int(os.environ.get("BENCH_SERVE_MAX_BATCH", 256)),
+        batch_max_wait_ms=float(os.environ.get("BENCH_SERVE_WAIT_MS", 2.0)),
+        warmup_buckets=(
+            int(os.environ.get("BENCH_SERVE_WARMUP", 4)) if batched else 0
+        ),
+    )
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        # Warm lap OUTSIDE the window: artifact load + XLA compiles land
+        # here, so the timed window measures steady-state serving.
+        for _ in range(max(clients, 4)):
+            _post(base + "/predict", body)
+        res = _drive(base, body, clients, seconds)
+        metrics = json.loads(
+            urllib.request.urlopen(base + "/metrics", timeout=10).read()
+        )["predict"]
+        res["server_latency_ms"] = metrics["latency_ms"]
+        res["batching"] = metrics["batching"]
+        return res
+    finally:
+        srv.shutdown()
+        srv.predictor.close()
+
+
+def main() -> None:
+    seconds = float(os.environ.get("BENCH_SERVE_SECONDS", 4))
+    rows = int(os.environ.get("BENCH_SERVE_ROWS", 8))
+    counts = _client_counts()
+    with tempfile.TemporaryDirectory(prefix="tpuflow_bench_serve_") as storage:
+        print("[bench_serving] training the artifact...", file=sys.stderr)
+        _train_artifact(storage)
+        body = _payload(storage, rows)
+        results: dict = {
+            "rows_per_request": rows,
+            "seconds_per_mode": seconds,
+            "device": os.environ.get("JAX_PLATFORMS") or "default",
+            "by_clients": {},
+        }
+        for clients in counts:
+            per = {}
+            for mode, batched in (("unbatched", False), ("batched", True)):
+                print(
+                    f"[bench_serving] {mode} @ {clients} clients...",
+                    file=sys.stderr,
+                )
+                per[mode] = _measure_mode(storage, body, batched, clients, seconds)
+                extra = {
+                    "clients": clients,
+                    "rows_per_request": rows,
+                    "p50_ms": per[mode]["p50_ms"],
+                    "p99_ms": per[mode]["p99_ms"],
+                }
+                if batched:
+                    b = per[mode]["batching"]
+                    extra["coalesced_dispatches"] = b["coalesced_dispatches"]
+                    extra["batch_size_hist"] = b["batch_size_hist"]
+                emit(
+                    f"serve_{mode}@c{clients}",
+                    "predict_requests_per_sec",
+                    per[mode]["requests_per_sec"],
+                    "req/s",
+                    **extra,
+                )
+            speedup = (
+                per["batched"]["requests_per_sec"]
+                / max(per["unbatched"]["requests_per_sec"], 1e-9)
+            )
+            per["batched_speedup"] = round(speedup, 3)
+            emit(
+                f"serve_speedup@c{clients}",
+                "batched_over_unbatched_rps",
+                speedup,
+                "x",
+                clients=clients,
+            )
+            results["by_clients"][str(clients)] = per
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "serving_results.json")
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(results, f, indent=2)
+    print(f"[bench_serving] wrote {out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,7 @@ SCRIPTS = [
     "bench_stacked_lstm_dp.py",
     "bench_gilbert_residual.py",  # physics-informed extension
     "bench_attention.py",  # long-context family: full vs flash backends
+    "bench_serving.py",  # HTTP serving: batched vs unbatched /predict
 ]
 
 
@@ -34,6 +35,9 @@ def main() -> None:
     if "--quick" in sys.argv:
         env.setdefault("BENCH_SECONDS", "2")
         env.setdefault("BENCH_BATCH", "1024")
+        # Serving bench: one small client count, short window.
+        env.setdefault("BENCH_SERVE_CLIENTS", "8")
+        env.setdefault("BENCH_SERVE_SECONDS", "2")
     selected = args or SCRIPTS
     unknown = [s for s in selected if s not in SCRIPTS]
     if unknown:

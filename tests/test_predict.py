@@ -288,3 +288,42 @@ def test_multi_well_predictions_in_first_appearance_order(tmp_path):
     alpha_starts = idx.starts[first_alpha:]
     assert np.all(np.diff(zeta_starts) > 0)
     assert np.all(np.diff(alpha_starts) > 0)
+
+
+class TestServingFastPathPredictor:
+    def test_warmup_donation_and_prepare_forward_split(self, tmp_path):
+        """The serving fast path's Predictor surface: warmup pre-compiles
+        the top pow-2 buckets (largest first), the prepare/forward split
+        composes to exactly predict_columns, and a donated-input forward
+        predicts the same numbers as the default one."""
+        _train_tabular(tmp_path)
+        pred = Predictor.load(str(tmp_path), "static_mlp")
+        table = wells_to_table(generate_wells(1, 16, seed=3))
+        table.pop("flow")
+        baseline = pred.predict_columns(table)
+
+        # prepare + forward == predict_columns (the micro-batcher seam).
+        x, index = pred.prepare_columns(table)
+        assert index is None and len(x) == 16
+        np.testing.assert_allclose(
+            pred.forward_prepared(x), baseline, rtol=1e-6
+        )
+
+        # Warmup: top-3 pow-2 buckets under a non-pow-2 cap, largest
+        # first; predictions are unchanged afterwards.
+        assert pred.warmup(top=3, max_rows=100) == [64, 32, 16]
+        assert pred.warm_buckets == (64, 32, 16)
+        np.testing.assert_allclose(
+            pred.predict_columns(table), baseline, rtol=1e-6
+        )
+
+        # Donation changes buffer ownership, never the numbers.
+        donated = Predictor.load(
+            str(tmp_path), "static_mlp", donate_forward=True
+        )
+        np.testing.assert_allclose(
+            donated.predict_columns(table), baseline, rtol=1e-5
+        )
+
+        # Zero prepared rows short-circuit without a device call.
+        assert len(pred.forward_prepared(x[:0])) == 0

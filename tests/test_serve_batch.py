@@ -1,0 +1,435 @@
+"""Serving fast path: cross-request micro-batching semantics.
+
+The coalescing contract (docs/serving.md): concurrent /predict requests
+for one artifact share jitted dispatches, while (1) degraded Gilbert
+answers are never coalesced into model batches, (2) a retrain mid-flight
+never scatters stale predictions (the batcher groups by predictor
+INSTANCE), (3) a failing forward fails exactly its dispatch group, and
+(4) the coalescing is observable — batch-size histogram, latency
+percentiles — through ``PredictService.metrics()`` and ``/metrics``.
+
+Fast-path mechanics run against stub predictors (no training, no jit);
+one end-to-end test drives a REAL trained artifact over HTTP under
+concurrent load — the tier-1 smoke proving a coalesced dispatch actually
+happens (histogram entry > 1).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpuflow.microbatch import LatencyStats, MicroBatcher
+from tpuflow.serve import PredictService, make_server
+
+KEY = ("/artifacts", "m")
+SPEC = {"storagePath": KEY[0], "model": KEY[1]}
+
+
+class _StubPredictor:
+    """Duck-types the coalescable Predictor surface: prepare + forward.
+    ``scale`` tags which instance produced a prediction — the stale-
+    scatter tests read it back out of the results."""
+
+    degraded = False
+
+    def __init__(self, scale: float = 1.0, delay_s: float = 0.0):
+        self.scale = scale
+        self.delay_s = delay_s
+        self.forward_calls: list[int] = []  # rows per dispatch
+
+    def prepare_columns(self, columns):
+        return np.asarray(columns["x"], np.float32).reshape(-1, 1), None
+
+    def forward_prepared(self, x, batch_size: int = 4096):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        self.forward_calls.append(len(x))
+        return x[:, 0] * self.scale
+
+    def predict_columns(self, columns):  # unbatched path (degraded stubs)
+        x, _ = self.prepare_columns(columns)
+        return self.forward_prepared(x)
+
+
+def _service(**kwargs) -> PredictService:
+    kwargs.setdefault("batch_predicts", True)
+    kwargs.setdefault("batch_max_rows", 64)
+    kwargs.setdefault("batch_max_wait_ms", 60.0)  # wide coalescing window
+    kwargs.setdefault("warmup_buckets", 0)
+    return PredictService(**kwargs)
+
+
+def _concurrent_predicts(svc, specs: list[dict]) -> list[dict]:
+    """Fire the specs concurrently (barrier start) and return responses
+    in spec order; raises the first worker exception if any."""
+    out: list = [None] * len(specs)
+    errors: list = []
+    barrier = threading.Barrier(len(specs))
+
+    def call(i: int) -> None:
+        barrier.wait()
+        try:
+            out[i] = svc.predict(specs[i])
+        except Exception as e:
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=call, args=(i,)) for i in range(len(specs))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    if errors:
+        raise errors[0]
+    return out
+
+
+class TestCoalescing:
+    def test_concurrent_requests_share_one_dispatch(self):
+        svc = _service()
+        stub = _StubPredictor(scale=2.0)
+        svc._cache[KEY] = stub
+        try:
+            specs = [
+                {**SPEC, "columns": {"x": [float(i)] * 4}} for i in range(8)
+            ]
+            out = _concurrent_predicts(svc, specs)
+            for i, res in enumerate(out):
+                assert res["predictions"] == [2.0 * i] * 4
+                assert res["count"] == 4
+            m = svc.metrics()["batching"]
+            assert m["enabled"] is True
+            # The smoke assertion: coalescing actually happened.
+            assert m["coalesced_dispatches"] >= 1
+            assert max(m["batch_size_hist"]) > 1
+            assert sum(
+                k * v for k, v in m["batch_size_hist"].items()
+            ) == 8  # every request dispatched exactly once
+            # Fewer device calls than requests — the point of the path.
+            assert len(stub.forward_calls) < 8
+            assert sum(stub.forward_calls) == 32  # no row lost or doubled
+        finally:
+            svc.close()
+
+    def test_max_rows_triggers_dispatch_before_wait(self):
+        svc = _service(batch_max_rows=8, batch_max_wait_ms=10_000.0)
+        svc._cache[KEY] = _StubPredictor()
+        try:
+            t0 = time.monotonic()
+            out = _concurrent_predicts(
+                svc,
+                [{**SPEC, "columns": {"x": [1.0] * 4}} for _ in range(4)],
+            )
+            # 16 rows against max 8: row pressure dispatched well before
+            # the (absurd) 10s window.
+            assert time.monotonic() - t0 < 5.0
+            assert all(r["count"] == 4 for r in out)
+        finally:
+            svc.close()
+
+    def test_hot_key_does_not_starve_other_artifacts(self):
+        """A key under sustained row pressure is ALWAYS due; the
+        dispatcher must still serve other artifacts (oldest-waiting due
+        key wins), not time their requests out behind the hot one."""
+        hot_stop = time.monotonic() + 1.5
+
+        def run_batch(pred, x):
+            time.sleep(0.005)  # keep the dispatcher busy with A
+            return x[:, 0]
+
+        mb = MicroBatcher(run_batch, max_batch_rows=8, max_wait_ms=5.0,
+                          submit_timeout=10.0)
+        pred = object()
+        errors: list = []
+
+        def hot_client() -> None:
+            while time.monotonic() < hot_stop:
+                try:
+                    mb.submit(("A",), pred, np.ones((8, 1), np.float32))
+                except Exception as e:
+                    errors.append(e)
+                    return
+
+        try:
+            hot = [threading.Thread(target=hot_client) for _ in range(4)]
+            for t in hot:
+                t.start()
+            time.sleep(0.1)  # A's queue is hot and permanently "due"
+            t0 = time.monotonic()
+            y = mb.submit(("B",), pred, np.full((1, 1), 3.0, np.float32))
+            cold_latency = time.monotonic() - t0
+            assert y.tolist() == [3.0]
+            # Far under submit_timeout: B waited its turn, not forever.
+            assert cold_latency < 2.0, cold_latency
+            for t in hot:
+                t.join(timeout=10)
+            assert not errors
+        finally:
+            mb.close()
+
+    def test_single_caller_unaffected_when_batching_off(self):
+        svc = PredictService(batch_predicts=False)
+        svc._cache[KEY] = _StubPredictor(scale=3.0)
+        out = svc.predict({**SPEC, "columns": {"x": [2.0]}})
+        assert out["predictions"] == [6.0]
+        assert svc.metrics()["batching"] == {"enabled": False}
+
+
+class TestRetrainMidFlight:
+    def test_no_stale_scatter_across_invalidation(self):
+        """Requests that resolved the old predictor and requests that
+        resolved the post-retrain one may share a drain window, but they
+        must land in SEPARATE dispatches, each answered by exactly the
+        params it resolved."""
+        svc = _service(batch_max_wait_ms=150.0)
+        old = _StubPredictor(scale=1.0)
+        svc._cache[KEY] = old
+        try:
+            results: dict[str, list] = {}
+            started = threading.Barrier(3)
+
+            def call(tag: str, value: float) -> None:
+                started.wait()
+                res = svc.predict({**SPEC, "columns": {"x": [value] * 2}})
+                results[tag] = res["predictions"]
+
+            t1 = threading.Thread(target=call, args=("a", 5.0))
+            t2 = threading.Thread(target=call, args=("b", 7.0))
+            t3 = threading.Thread(target=started.wait)  # releases a+b
+            for t in (t1, t2, t3):
+                t.start()
+            t3.join(timeout=10)
+            time.sleep(0.03)  # a+b are now enqueued, window still open
+            # The retrain: eviction + a new generation behind the key.
+            svc.invalidate(*KEY)
+            new = _StubPredictor(scale=10.0)
+            svc._cache[KEY] = new
+            res = svc.predict({**SPEC, "columns": {"x": [9.0] * 2}})
+            t1.join(timeout=10)
+            t2.join(timeout=10)
+            # The late request got the NEW model's numbers, never the
+            # batch-mate's stale ones...
+            assert res["predictions"] == [90.0] * 2
+            # ...and the early requests got the OLD model they resolved.
+            assert results["a"] == [5.0] * 2
+            assert results["b"] == [7.0] * 2
+            # Both instances really ran (separate dispatches).
+            assert sum(old.forward_calls) == 4
+            assert sum(new.forward_calls) == 2
+        finally:
+            svc.close()
+
+
+class TestDegradedNeverCoalesced:
+    def test_degraded_predictor_bypasses_batcher(self):
+        svc = _service()
+        stub = _StubPredictor(scale=4.0)
+        stub.degraded = True
+        stub.reason = "checkpoint eaten by a drill"
+        svc._cache[KEY] = stub
+        svc._degraded[KEY] = stub.reason
+        svc._degraded_at[KEY] = time.monotonic()
+        try:
+            out = _concurrent_predicts(
+                svc,
+                [{**SPEC, "columns": {"x": [1.0, 2.0]}} for _ in range(4)],
+            )
+            for res in out:
+                assert res["degraded"] is True
+                assert res["fallback"] == "gilbert"
+                assert res["predictions"] == [4.0, 8.0]
+            m = svc.metrics()
+            assert m["degraded_requests"] == 4
+            # The contract: degraded answers never enter a model batch.
+            assert m["batching"]["requests"] == 0
+            assert m["batching"]["dispatches"] == 0
+        finally:
+            svc.close()
+
+
+class TestErrorHandling:
+    def test_forward_failure_fails_its_group_and_batcher_survives(self):
+        svc = _service(batch_max_wait_ms=40.0)
+
+        class Exploding(_StubPredictor):
+            def forward_prepared(self, x, batch_size=4096):
+                raise RuntimeError("device fell over")
+
+        svc._cache[KEY] = Exploding()
+        try:
+            with pytest.raises(RuntimeError, match="device fell over"):
+                svc.predict({**SPEC, "columns": {"x": [1.0]}})
+            # The dispatcher survived: a healthy predictor still serves.
+            svc.invalidate(*KEY)
+            svc._cache[KEY] = _StubPredictor(scale=2.0)
+            out = svc.predict({**SPEC, "columns": {"x": [3.0]}})
+            assert out["predictions"] == [6.0]
+        finally:
+            svc.close()
+
+    def test_queue_full_rejects_loudly(self):
+        done = threading.Event()
+
+        def run_batch(pred, x):
+            done.wait(5)
+            return x[:, 0]
+
+        mb = MicroBatcher(run_batch, max_batch_rows=4, max_wait_ms=0.0,
+                          max_queue_rows=4)
+        try:
+            slow = threading.Thread(
+                target=mb.submit,
+                args=(KEY, object(), np.ones((4, 1), np.float32)),
+            )
+            slow.start()
+            time.sleep(0.05)  # first batch now occupies the dispatcher
+            with pytest.raises(RuntimeError, match="queue full"):
+                # 4 pending rows is the cap; 5 more must be refused.
+                mb.submit(KEY, object(), np.ones((5, 1), np.float32))
+            assert mb.metrics()["rejected"] == 1
+        finally:
+            done.set()
+            slow.join(timeout=5)
+            mb.close()
+
+    def test_row_count_mismatch_is_an_error(self):
+        mb = MicroBatcher(lambda pred, x: x[:1], max_wait_ms=0.0)
+        try:
+            with pytest.raises(RuntimeError, match="returned 1 rows"):
+                mb.submit(KEY, object(), np.ones((3, 1), np.float32))
+        finally:
+            mb.close()
+
+
+class TestLatencyAccounting:
+    def test_percentiles_and_counters(self):
+        svc = PredictService(batch_predicts=False)
+        svc._cache[KEY] = _StubPredictor(delay_s=0.002)
+        for _ in range(5):
+            svc.predict({**SPEC, "columns": {"x": [1.0]}})
+        lat = svc.metrics()["latency_ms"]
+        assert lat["count"] == 5
+        assert lat["p50_ms"] >= 2.0  # the stub's 2ms floor is visible
+        assert lat["p50_ms"] <= lat["p99_ms"] <= lat["max_ms"]
+
+    def test_failed_requests_are_counted_too(self):
+        svc = PredictService(batch_predicts=False)
+        with pytest.raises(ValueError):
+            svc.predict({"model": "m"})  # no storagePath
+        assert svc.metrics()["latency_ms"]["count"] == 1
+
+    def test_stats_window_is_bounded(self):
+        stats = LatencyStats(window=8)
+        for i in range(100):
+            stats.record(0.001 * (i + 1))
+        snap = stats.snapshot()
+        assert snap["count"] == 100 and snap["window"] == 8
+        # Percentiles describe the recent window, not all 100 samples.
+        assert snap["p50_ms"] >= 93.0
+        assert snap["max_ms"] == 100.0
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=15) as r:
+        return r.status, json.loads(r.read())
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestEndToEndHTTP:
+    def test_concurrent_http_predicts_coalesce_on_a_real_artifact(
+        self, tmp_path
+    ):
+        """The tier-1 smoke: train a real artifact, serve it with the
+        fast path on (batching + bucket warmup), hammer /predict from
+        concurrent HTTP clients, and observe a coalesced dispatch in the
+        /metrics batch-size histogram — plus latency percentiles."""
+        srv = make_server(
+            "127.0.0.1", 0,
+            batch_predicts=True,
+            batch_max_rows=64,
+            batch_max_wait_ms=60.0,
+            warmup_buckets=2,
+        )
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        try:
+            status, body = _post(
+                base + "/jobs",
+                {"model": "static_mlp", "epochs": 1, "batchSize": 32,
+                 "storagePath": str(tmp_path), "n_devices": 1,
+                 "synthetic_wells": 4, "synthetic_steps": 64},
+            )
+            assert status == 202
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                _, rec = _get(base + f"/jobs/{body['job_id']}")
+                if rec["status"] in ("done", "failed"):
+                    break
+                time.sleep(0.3)
+            assert rec["status"] == "done", rec
+
+            from tpuflow.data.synthetic import generate_wells, wells_to_table
+
+            table = wells_to_table(generate_wells(1, 8, seed=9))
+            table.pop("flow")
+            spec = {
+                "storagePath": str(tmp_path), "model": "static_mlp",
+                "columns": {k: v.tolist() for k, v in table.items()},
+            }
+            _post(base + "/predict", spec)  # load + warm out of band
+
+            results: list = [None] * 8
+            barrier = threading.Barrier(8)
+
+            def client(i: int) -> None:
+                barrier.wait()
+                results[i] = _post(base + "/predict", spec)
+
+            clients = [
+                threading.Thread(target=client, args=(i,)) for i in range(8)
+            ]
+            for c in clients:
+                c.start()
+            for c in clients:
+                c.join(timeout=60)
+            assert all(r is not None and r[0] == 200 for r in results)
+            first = results[0][1]["predictions"]
+            for _, out in results:
+                assert out["count"] == 8
+                assert out["predictions"] == first  # same rows, same answer
+                assert "degraded" not in out
+
+            _, metrics = _get(base + "/metrics")
+            batching = metrics["predict"]["batching"]
+            assert batching["enabled"] is True
+            # A coalesced dispatch actually happened under concurrent load.
+            assert batching["coalesced_dispatches"] >= 1
+            assert any(
+                int(k) > 1 for k in batching["batch_size_hist"]
+            ), batching
+            lat = metrics["predict"]["latency_ms"]
+            assert lat["count"] >= 9
+            assert lat["p50_ms"] is not None and lat["p99_ms"] is not None
+            # Warmup pre-compiled buckets at load time (behind the flag).
+            assert metrics["predict"]["warmed_buckets"] >= 1
+        finally:
+            srv.shutdown()
+            srv.predictor.close()

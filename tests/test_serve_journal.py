@@ -273,6 +273,125 @@ def test_journal_survives_concurrent_load_and_midburst_restart(tmp_path):
     _die(r3)
 
 
+def test_torn_flush_does_not_resurrect_observed_cancel(tmp_path, gated):
+    """Kill -9 between a state change and its journal flush (VERDICT ask
+    6), simulated precisely: after the client OBSERVES a cancel (which
+    the cancel() discipline flushed before reporting), a LATER state
+    change's flush never reaches disk and the kill lands mid-write,
+    leaving a torn tail line. Replay must skip the torn line, keep the
+    observed cancel cancelled — never requeue or re-run it — and
+    adjudicate the mid-run job lost."""
+    journal = str(tmp_path / "jobs.jsonl")
+    r1 = JobRunner(journal_path=journal)
+    running = r1.submit(SPEC)["job_id"]
+    assert gated.started.wait(timeout=10)
+    victim = r1.submit(SPEC)["job_id"]
+    res = r1.cancel(victim)
+    assert res["status"] == "cancelled"  # the client SAW this reported
+    # From here on, flushes stop reaching disk — the kill -9 window
+    # between the in-memory state change and its journal write.
+    r1._journal_flush = lambda: None
+    gated.release.set()
+    assert _wait(lambda: r1.get(running)["status"] == "done")
+    _die(r1)  # the "done" terminal line was never written
+    with open(journal, "a") as f:  # the flush the kill tore mid-write
+        f.write('{"event": "terminal", "job_id": "%s", "sta' % running)
+
+    r2 = JobRunner(journal_path=journal)
+    # The observed cancel is not resurrected: still cancelled, never
+    # requeued (the worker would have re-run it via gated._execute).
+    assert r2.get(victim)["status"] == "cancelled"
+    assert r2.metrics()["cancelled"] == 1 and r2.metrics()["queued"] == 0
+    assert len(gated.stop_fns) == 1  # only the original run ever executed
+    # The mid-run job's completion was torn away: adjudicated lost, not
+    # silently re-run and not reported done.
+    rec = r2.get(running)
+    assert rec["status"] == "failed" and "lost" in rec["error"]
+    assert len(r2.list()) == 2
+
+
+def test_kill9_daemon_replay_preserves_cancel(tmp_path):
+    """The real deployment shape of the same drill: SIGKILL the serve
+    daemon mid-run after a client observed a cancel; a fresh replay of
+    the journal keeps the cancel cancelled and marks the mid-run job
+    lost instead of re-running it."""
+    import os
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import time as _time
+    import unittest.mock
+
+    from tests.test_serve import _get, _post
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    journal = str(tmp_path / "jobs.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpuflow.serve", "--port", str(port),
+         "--journal", journal],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        env=env,
+    )
+    base = f"http://127.0.0.1:{port}"
+    try:
+        deadline = _time.time() + 90
+        up = False
+        while _time.time() < deadline:
+            try:
+                if _get(base + "/health")[0] == 200:
+                    up = True
+                    break
+            except OSError:
+                _time.sleep(0.3)
+        assert up, "daemon never came up"
+        spec = {
+            "model": "static_mlp", "epochs": 100000, "batchSize": 32,
+            "n_devices": 1, "synthetic_wells": 4, "synthetic_steps": 64,
+            "storagePath": str(tmp_path / "art"),
+        }
+        _, a = _post(base + "/jobs", spec)
+        deadline = _time.time() + 90
+        while _time.time() < deadline:
+            _, rec = _get(base + f"/jobs/{a['job_id']}")
+            if rec["status"] == "running":
+                break
+            _time.sleep(0.2)
+        assert rec["status"] == "running", rec
+        _, b = _post(base + "/jobs", {**spec, "epochs": 1})
+        # DELETE /jobs/<id>: the 200 response means the terminal line was
+        # flushed BEFORE the report (the durable-first discipline).
+        import urllib.request
+
+        req = urllib.request.Request(
+            base + f"/jobs/{b['job_id']}", method="DELETE"
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert json.loads(r.read())["status"] == "cancelled"
+        os.kill(proc.pid, signal.SIGKILL)  # mid-run, no shutdown grace
+        proc.wait(timeout=10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+    ex = _BlockingExecute()
+    ex.release.set()
+    with unittest.mock.patch.object(JobRunner, "_execute", ex):
+        r2 = JobRunner(journal_path=journal)
+        assert r2.get(b["job_id"])["status"] == "cancelled"
+        lost = r2.get(a["job_id"])
+        assert lost["status"] == "failed" and "lost" in lost["error"]
+        assert r2.metrics()["cancelled"] == 1
+        assert len(ex.stop_fns) == 0  # neither job re-ran after replay
+        _die(r2)
+
+
 def test_journal_records_are_wellformed_jsonl(tmp_path, gated):
     journal = str(tmp_path / "jobs.jsonl")
     r1 = JobRunner(journal_path=journal)

@@ -86,9 +86,15 @@ class Predictor:
     _params: object
     _meta: dict
     _pipeline: FeaturePipeline | None = None  # tabular only, cached
+    warm_buckets: tuple = ()  # pow-2 batch sizes pre-compiled by warmup()
 
     @classmethod
-    def load(cls, storage_path: str, name: str) -> "Predictor":
+    def load(
+        cls, storage_path: str, name: str, donate_forward: bool = False
+    ) -> "Predictor":
+        """``donate_forward=True`` donates the input batch buffer to the
+        jitted forward (serving fast path: each padded batch is built
+        fresh per dispatch and never reused after the call)."""
         with open_file(
             _meta_path(storage_path, name), "r", encoding="utf-8"
         ) as f:
@@ -113,7 +119,7 @@ class Predictor:
         return cls(
             model_name=name,
             kind=meta["kind"],
-            _predict_fn=make_predict(model.apply),
+            _predict_fn=make_predict(model.apply, donate_input=donate_forward),
             _params=params,
             _meta=meta,
             _pipeline=pipeline,
@@ -213,6 +219,60 @@ class Predictor:
             outs.append(pred[:n])
         return np.concatenate(outs, axis=0)
 
+    def prepare_columns(
+        self, columns: dict[str, np.ndarray]
+    ) -> tuple[np.ndarray, WindowIndex | None]:
+        """Raw input columns -> model-ready feature rows (the per-request
+        half of serving; the forward half can then be coalesced across
+        requests by the service's micro-batcher). Request-shaped errors
+        (missing columns, short windows) surface HERE, before any batch
+        a request might have joined."""
+        if self.kind == "tabular":
+            x = self._pipeline.transform(columns)
+            if self._meta["preprocessor"].get("append_gilbert"):
+                # Physics-informed artifact: raw Gilbert prediction rides
+                # as the last feature column (GilbertResidualMLP contract;
+                # same helper as the training pipeline).
+                from tpuflow.core.gilbert import append_gilbert_column
+
+                x = append_gilbert_column(x, columns)
+            return x, None
+        return self._features_windowed(columns)
+
+    def forward_prepared(
+        self, x: np.ndarray, batch_size: int = 4096
+    ) -> np.ndarray:
+        """Jitted forward over prepared feature rows, denormalized to raw
+        target units — one output row per input row."""
+        if len(x) == 0:
+            return np.zeros((0,), np.float32)
+        p = self._meta["preprocessor"]
+        y = self._forward_batched(x, batch_size)
+        return y * float(p["target_std"]) + float(p["target_mean"])
+
+    def warmup(self, top: int = 2, max_rows: int = 4096) -> list[int]:
+        """Pre-compile the ``top`` largest pow-2 forward buckets <=
+        ``max_rows``, largest first, so the first requests after a cold
+        load (or a post-retrain reload) don't eat an XLA compile each.
+
+        Runs the real jitted forward on zeros (populating jit's actual
+        call cache, which ``lower().compile()`` would not) and blocks
+        until each compile lands. Returns the warmed bucket sizes; also
+        recorded on ``self.warm_buckets`` for metrics."""
+        buckets: list[int] = []
+        b = _next_pow2(max(max_rows, 1))
+        if b > max_rows:  # max_rows not itself pow-2: start below it
+            b >>= 1
+        while b >= 1 and len(buckets) < max(top, 0):
+            buckets.append(b)
+            b >>= 1
+        tail = list(self._meta["sample_shape"][1:])
+        for size in buckets:
+            zeros = np.zeros([size] + tail, np.float32)
+            jax.block_until_ready(self._predict_fn(self._params, zeros))
+        self.warm_buckets = tuple(buckets)
+        return buckets
+
     def predict_columns(
         self,
         columns: dict[str, np.ndarray],
@@ -224,30 +284,15 @@ class Predictor:
         For windowed models, ``return_index=True`` additionally returns a
         ``WindowIndex`` mapping each prediction to its well + start row.
         """
-        index = None
-        if self.kind == "tabular":
-            x = self._pipeline.transform(columns)
-            if self._meta["preprocessor"].get("append_gilbert"):
-                # Physics-informed artifact: raw Gilbert prediction rides
-                # as the last feature column (GilbertResidualMLP contract;
-                # same helper as the training pipeline).
-                from tpuflow.core.gilbert import append_gilbert_column
-
-                x = append_gilbert_column(x, columns)
-        else:
-            x, index = self._features_windowed(columns)
-        p = self._meta["preprocessor"]
-        y = self._forward_batched(x, batch_size)
-        y = y * float(p["target_std"]) + float(p["target_mean"])
+        x, index = self.prepare_columns(columns)
+        y = self.forward_prepared(x, batch_size)
         if return_index:
             return y, index
         return y
 
-    def predict_csv(
-        self, path: str, batch_size: int = 4096, return_index: bool = False
-    ):
-        """Predict from a headerless CSV — with or without the target column
-        (field count selects the schema variant)."""
+    def columns_from_csv(self, path: str) -> dict[str, np.ndarray]:
+        """Read a headerless CSV into raw columns — with or without the
+        target column (field count selects the schema variant)."""
         with open(path, "r", encoding="utf-8") as f:
             first = f.readline()
         nfields = len(first.rstrip("\n").rstrip("\r").split(","))
@@ -263,8 +308,15 @@ class Predictor:
                 f"{len(full.columns)} (with target "
                 f"{full.target!r}) or {len(serving.columns)} (without)"
             )
+        return read_csv(path, schema)
+
+    def predict_csv(
+        self, path: str, batch_size: int = 4096, return_index: bool = False
+    ):
+        """Predict from a headerless CSV — with or without the target column
+        (field count selects the schema variant)."""
         return self.predict_columns(
-            read_csv(path, schema),
+            self.columns_from_csv(path),
             batch_size=batch_size,
             return_index=return_index,
         )

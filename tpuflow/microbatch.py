@@ -1,0 +1,302 @@
+"""Cross-request micro-batching for the serving fast path.
+
+The reference lineage's throughput lever is batching: SparkNet and BigDL
+(PAPERS.md) both win by amortizing fixed per-dispatch overhead across
+many rows of work. The serving path had none of it — every ``POST
+/predict`` made its own jitted device call against the same params, so
+N concurrent callers paid N dispatch overheads (and, on first touch, N
+chances at an XLA compile) for work one dispatch could carry.
+
+``MicroBatcher`` is the coalescing seam: requests for the same artifact
+key enqueue their ALREADY feature-transformed row arrays; a single
+dispatcher thread drains a key's queue once ``max_wait_ms`` has passed
+since its oldest entry (or sooner, when ``max_batch_rows`` accumulate),
+concatenates the rows, runs ONE forward through the caller-supplied
+``run_batch`` hook, and scatters the result rows back to the waiting
+callers.
+
+Correctness constraints the dispatcher enforces (docs/serving.md):
+
+- **No stale scatter across a retrain.** Every entry carries the
+  predictor INSTANCE it resolved at enqueue time; a drain is grouped by
+  instance, never just by key. When a retrain invalidates the cache
+  mid-flight, requests that resolved the old predictor and requests
+  that resolved the new one land in SEPARATE dispatches — each caller
+  gets predictions from exactly the params it resolved, exactly as the
+  unbatched path would have answered it.
+- **Errors scatter too.** A failing forward fails every request in its
+  dispatch group (and only that group); the dispatcher thread survives.
+- **Bounded queue.** Past ``max_queue_rows`` pending rows, ``submit``
+  raises instead of accepting unbounded backlog (the JobRunner 429
+  discipline, applied to predicts).
+
+Degraded (Gilbert-fallback) answers must never be coalesced into model
+batches — that gate lives in ``PredictService.predict``, which bypasses
+this module entirely for degraded predictors.
+
+``LatencyStats`` is the per-request latency accounting that rides along:
+a bounded reservoir of recent request latencies, snapshotted into
+p50/p99 for ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+
+class LatencyStats:
+    """Bounded reservoir of recent request latencies (seconds in,
+    milliseconds out). ``window`` bounds memory and keeps the
+    percentiles describing RECENT traffic, not the whole process
+    lifetime."""
+
+    def __init__(self, window: int = 2048):
+        self._lock = threading.Lock()
+        self._samples: deque[float] = deque(maxlen=window)
+        self._count = 0
+        self._total = 0.0
+        self._max = 0.0
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self._samples.append(seconds)
+            self._count += 1
+            self._total += seconds
+            if seconds > self._max:
+                self._max = seconds
+
+    def snapshot(self) -> dict:
+        """One consistent view: counters plus percentiles over the
+        current window, all in milliseconds."""
+        with self._lock:
+            samples = list(self._samples)
+            count, total, worst = self._count, self._total, self._max
+        out = {
+            "count": count,
+            "window": len(samples),
+            "p50_ms": None,
+            "p99_ms": None,
+            "mean_ms": None,
+            "max_ms": round(worst * 1000.0, 3) if count else None,
+        }
+        if samples:
+            arr = np.asarray(samples, np.float64) * 1000.0
+            out["p50_ms"] = round(float(np.percentile(arr, 50)), 3)
+            out["p99_ms"] = round(float(np.percentile(arr, 99)), 3)
+            out["mean_ms"] = round(total / count * 1000.0, 3)
+        return out
+
+
+class _Pending:
+    """One waiting request: its transformed rows, the predictor instance
+    it resolved (the anti-stale-scatter token), and the rendezvous."""
+
+    __slots__ = ("pred", "x", "event", "result", "error", "t_enqueued")
+
+    def __init__(self, pred, x):
+        self.pred = pred
+        self.x = x
+        self.event = threading.Event()
+        self.result = None
+        self.error: BaseException | None = None
+        self.t_enqueued = time.monotonic()
+
+
+class MicroBatcher:
+    """Coalesces concurrent ``submit`` calls per artifact key into shared
+    forward dispatches. ``run_batch(pred, x)`` is the one hook: it must
+    return one output row per input row (the service passes the
+    predictor's denormalizing forward)."""
+
+    def __init__(
+        self,
+        run_batch,
+        max_batch_rows: int = 128,
+        max_wait_ms: float = 2.0,
+        max_queue_rows: int = 8192,
+        submit_timeout: float = 60.0,
+    ):
+        if max_batch_rows < 1:
+            raise ValueError(f"max_batch_rows must be >= 1, got {max_batch_rows}")
+        if max_wait_ms < 0:
+            raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
+        self._run_batch = run_batch
+        self.max_batch_rows = max_batch_rows
+        self.max_wait_ms = max_wait_ms
+        self.max_queue_rows = max_queue_rows
+        self.submit_timeout = submit_timeout
+        self._cond = threading.Condition()
+        self._pending: dict[tuple, list[_Pending]] = {}
+        self._queued_rows = 0
+        self._stop = False
+        # Counters (guarded by self._cond's lock): dispatches = device
+        # calls made; coalesced_dispatches = those carrying > 1 request;
+        # batch_size_hist = requests-per-dispatch histogram — the
+        # observable proof coalescing actually happens under load.
+        self.stats = {
+            "requests": 0,
+            "rejected": 0,
+            "dispatches": 0,
+            "coalesced_dispatches": 0,
+            "rows_dispatched": 0,
+            "max_queue_depth_rows": 0,
+        }
+        self._hist: dict[int, int] = {}
+        self._thread = threading.Thread(
+            target=self._loop, name="tpuflow-microbatch", daemon=True
+        )
+        self._thread.start()
+
+    # ---- caller side ----
+
+    def submit(self, key: tuple, pred, x) -> np.ndarray:
+        """Enqueue ``x`` (rows already feature-transformed for ``pred``)
+        and block until the dispatcher scatters this request's slice
+        back. Raises the dispatch group's exception if the forward
+        failed, and RuntimeError on a full queue or a closed batcher."""
+        entry = _Pending(pred, x)
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("predict micro-batcher is closed")
+            if self._queued_rows + len(x) > self.max_queue_rows:
+                self.stats["rejected"] += 1
+                raise RuntimeError(
+                    f"predict micro-batch queue full "
+                    f"({self._queued_rows} rows pending, max "
+                    f"{self.max_queue_rows}); retry shortly"
+                )
+            self.stats["requests"] += 1
+            self._pending.setdefault(key, []).append(entry)
+            self._queued_rows += len(x)
+            if self._queued_rows > self.stats["max_queue_depth_rows"]:
+                self.stats["max_queue_depth_rows"] = self._queued_rows
+            self._cond.notify_all()
+        if not entry.event.wait(timeout=self.submit_timeout):
+            raise RuntimeError(
+                f"predict micro-batch dispatch timed out after "
+                f"{self.submit_timeout:g}s (dispatcher wedged?)"
+            )
+        if entry.error is not None:
+            raise entry.error
+        return entry.result
+
+    def metrics(self) -> dict:
+        """Counter snapshot under the lock — one consistent view."""
+        with self._cond:
+            return {
+                "enabled": True,
+                **self.stats,
+                "queue_depth_rows": self._queued_rows,
+                "batch_size_hist": dict(sorted(self._hist.items())),
+                "max_batch_rows": self.max_batch_rows,
+                "max_wait_ms": self.max_wait_ms,
+            }
+
+    def close(self) -> None:
+        """Stop the dispatcher; pending entries are drained first so no
+        in-flight caller is abandoned mid-wait."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout=10)
+
+    # ---- dispatcher side ----
+
+    def _due_key_locked(self, now: float):
+        """(key, seconds-until-next-deadline): among keys whose oldest
+        entry has aged past max_wait_ms or whose rows hit max_batch_rows,
+        the one whose oldest entry has waited LONGEST — dict order would
+        starve every other artifact behind one hot key that is always
+        due (it never fully drains, so it never loses its slot). If none
+        is due: how long the dispatcher may sleep before one will."""
+        due_key, due_age, next_due = None, -1.0, None
+        for key, entries in self._pending.items():
+            rows = sum(len(e.x) for e in entries)
+            age = now - entries[0].t_enqueued
+            if rows >= self.max_batch_rows or age * 1000.0 >= self.max_wait_ms:
+                if age > due_age:
+                    due_key, due_age = key, age
+                continue
+            remaining = self.max_wait_ms / 1000.0 - age
+            if next_due is None or remaining < next_due:
+                next_due = remaining
+        if due_key is not None:
+            return due_key, 0.0
+        return None, next_due
+
+    def _drain_locked(self, key: tuple) -> list[_Pending]:
+        """Take entries for ``key`` up to max_batch_rows (leaving the
+        rest queued with their original enqueue times)."""
+        entries = self._pending[key]
+        taken, rows = [], 0
+        while entries and rows < self.max_batch_rows:
+            taken.append(entries.pop(0))
+            rows += len(taken[-1].x)
+        if not entries:
+            del self._pending[key]
+        self._queued_rows -= rows
+        return taken
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending:
+                    if self._stop:
+                        return
+                    self._cond.wait()
+                key, wait_s = self._due_key_locked(time.monotonic())
+                if key is None and self._stop:
+                    # Closing: drain promptly, don't sit out max_wait_ms.
+                    key = next(iter(self._pending))
+                if key is None:
+                    # Nothing due yet: sleep until the earliest deadline
+                    # (or an arrival/notify), then re-scan.
+                    self._cond.wait(timeout=wait_s)
+                    continue
+                taken = self._drain_locked(key)
+            self._dispatch(taken)
+
+    def _dispatch(self, taken: list[_Pending]) -> None:
+        # Group by predictor INSTANCE: entries at one key can straddle a
+        # cache invalidation (retrain mid-flight), and a single forward
+        # mixing old and new params would scatter stale predictions to
+        # whichever side didn't match the batch. One dispatch per
+        # distinct instance, in arrival order.
+        groups: dict[int, list[_Pending]] = {}
+        for e in taken:
+            groups.setdefault(id(e.pred), []).append(e)
+        for group in groups.values():
+            rows = sum(len(e.x) for e in group)
+            try:
+                # Concatenate inside the try: even a pathological shape
+                # mismatch must fail THIS group, never kill the
+                # dispatcher thread and wedge every later caller.
+                xs = [e.x for e in group]
+                x = np.concatenate(xs, axis=0) if len(xs) > 1 else xs[0]
+                y = np.asarray(self._run_batch(group[0].pred, x))
+                if len(y) != len(x):
+                    raise RuntimeError(
+                        f"micro-batch forward returned {len(y)} rows "
+                        f"for {len(x)} inputs"
+                    )
+                offset = 0
+                for e in group:
+                    n = len(e.x)
+                    e.result = y[offset : offset + n]
+                    offset += n
+            except BaseException as exc:  # scatter the failure, stay alive
+                for e in group:
+                    e.error = exc
+            finally:
+                with self._cond:
+                    self.stats["dispatches"] += 1
+                    self.stats["rows_dispatched"] += rows
+                    if len(group) > 1:
+                        self.stats["coalesced_dispatches"] += 1
+                    self._hist[len(group)] = self._hist.get(len(group), 0) + 1
+                for e in group:
+                    e.event.set()

@@ -30,7 +30,18 @@ API (JSON in/out):
   (docs/resilience.md — the degraded-serving contract).
 - ``GET  /metrics``     — service counters: jobs
   submitted/done/failed/queued/running, predictor cache
-  hits/loads/invalidations (+ degraded_requests/fallback_loads), uptime.
+  hits/loads/invalidations (+ degraded_requests/fallback_loads), uptime,
+  per-request latency percentiles (p50/p99), and — with batching on —
+  the coalesced-dispatch counters and batch-size histogram.
+
+Concurrent /predict traffic can take the serving fast path (off by
+default; ``--batch-predicts``, ``--warmup-buckets``,
+``--donate-forward``, or the ``TPUFLOW_SERVE_*`` env vars): requests
+for one artifact coalesce into shared pow-2-padded jitted dispatches,
+with compiled-forward buckets pre-warmed at artifact load. Degraded
+answers are never coalesced into model batches, and a retrain mid-flight
+never scatters stale predictions (PredictService docstring;
+docs/serving.md).
 - ``GET  /health``      — liveness + degradation (``/healthz`` alias):
   ``status`` is ``ok`` or ``degraded``, with the artifacts currently
   served by the fallback.
@@ -83,6 +94,7 @@ Run: ``python -m tpuflow.serve --port 8700``; stop with SIGINT/SIGTERM.
 from __future__ import annotations
 
 import json
+import os
 import queue
 import threading
 import uuid
@@ -845,6 +857,25 @@ class JobRunner:
                     )
 
 
+def _env_flag(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    return raw.strip().lower() not in ("0", "false", "no", "off")
+
+
+def _env_num(name: str, default, cast):
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return cast(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not a valid {cast.__name__}"
+        ) from None
+
+
 class PredictService:
     """Synchronous serving over trained artifacts, with a Predictor cache
     (loading parses the sidecar + restores params — do it once per
@@ -861,12 +892,38 @@ class PredictService:
     TRANSIENT load failure (storage briefly unreachable) re-probes the
     real artifact on its own instead of serving physics forever.
     Request-shaped errors (bad columns, malformed specs) still fail
-    loudly; only load failures degrade."""
+    loudly; only load failures degrade.
+
+    Serving fast path (docs/serving.md), OFF by default so single-caller
+    workloads keep today's semantics and latency:
+
+    - ``batch_predicts=True`` coalesces concurrent requests per artifact
+      into shared jitted dispatches (``tpuflow/microbatch.py``): each
+      request's feature transform stays per-request, the forwards merge.
+      Degraded (Gilbert) answers are NEVER coalesced into model batches,
+      and a retrain mid-flight never scatters stale predictions — the
+      batcher groups by predictor instance, not just artifact key.
+    - ``warmup_buckets=N`` pre-compiles the N largest pow-2 forward
+      buckets at artifact load time, so the first requests after a cold
+      load or retrain don't each eat an XLA compile.
+    - ``donate_forward=True`` donates the input batch buffer to the
+      jitted forward (safe on this path: batches are built fresh per
+      dispatch and never reused).
+
+    Knob resolution: explicit argument > env var (``TPUFLOW_SERVE_BATCH``,
+    ``TPUFLOW_SERVE_MAX_BATCH``, ``TPUFLOW_SERVE_MAX_WAIT_MS``,
+    ``TPUFLOW_SERVE_WARMUP``, ``TPUFLOW_SERVE_DONATE``) > default (off).
+    """
 
     def __init__(
         self,
         gilbert_fallback: bool = True,
         degraded_retry_seconds: float = 30.0,
+        batch_predicts: bool | None = None,
+        batch_max_rows: int | None = None,
+        batch_max_wait_ms: float | None = None,
+        warmup_buckets: int | None = None,
+        donate_forward: bool | None = None,
     ):
         self._cache: dict[tuple[str, str], object] = {}
         self._lock = threading.Lock()  # guards the dicts, never held on load
@@ -875,6 +932,7 @@ class PredictService:
         self.stats = {
             "requests": 0, "cache_hits": 0, "loads": 0, "invalidations": 0,
             "degraded_requests": 0, "fallback_loads": 0,
+            "warmed_buckets": 0,
         }
         # Invalidation generation per key: a load that STARTED before an
         # invalidate() must not re-cache its (stale) result after it.
@@ -884,12 +942,59 @@ class PredictService:
         self._degraded: dict[tuple[str, str], str] = {}
         # When each fallback entry was cached (monotonic), for the TTL.
         self._degraded_at: dict[tuple[str, str], float] = {}
+        # ---- fast-path knobs (argument > env > off) ----
+        if batch_predicts is None:
+            batch_predicts = _env_flag("TPUFLOW_SERVE_BATCH", False)
+        if batch_max_rows is None:
+            batch_max_rows = _env_num("TPUFLOW_SERVE_MAX_BATCH", 256, int)
+        if batch_max_wait_ms is None:
+            batch_max_wait_ms = _env_num(
+                "TPUFLOW_SERVE_MAX_WAIT_MS", 2.0, float
+            )
+        if warmup_buckets is None:
+            warmup_buckets = _env_num("TPUFLOW_SERVE_WARMUP", 0, int)
+        if donate_forward is None:
+            donate_forward = _env_flag("TPUFLOW_SERVE_DONATE", False)
+        self.warmup_buckets = int(warmup_buckets)
+        self.donate_forward = bool(donate_forward)
+        self.batch_max_rows = int(batch_max_rows)
+        from tpuflow.microbatch import LatencyStats
+
+        self._latency = LatencyStats()
+        self._batcher = None
+        if batch_predicts:
+            from tpuflow.microbatch import MicroBatcher
+
+            self._batcher = MicroBatcher(
+                self._run_forward,
+                max_batch_rows=self.batch_max_rows,
+                max_wait_ms=float(batch_max_wait_ms),
+            )
+
+    @staticmethod
+    def _run_forward(pred, x):
+        # The batcher's one hook: a denormalized forward over prepared
+        # rows (one output row per input row; pow-2 padded inside).
+        return pred.forward_prepared(x)
+
+    def close(self) -> None:
+        """Stop the dispatcher thread (tests / benchmark hygiene)."""
+        if self._batcher is not None:
+            self._batcher.close()
 
     def metrics(self) -> dict:
         """Counter snapshot under the lock — one consistent view, matching
-        JobRunner.metrics()'s discipline."""
+        JobRunner.metrics()'s discipline — plus the latency percentiles
+        and (when batching is on) the coalescing counters."""
         with self._lock:
-            return dict(self.stats)
+            out = dict(self.stats)
+        out["latency_ms"] = self._latency.snapshot()
+        out["batching"] = (
+            self._batcher.metrics()
+            if self._batcher is not None
+            else {"enabled": False}
+        )
+        return out
 
     def invalidate(self, storage_path: str, name: str) -> None:
         """Drop a cached artifact (called when a job rewrites it) —
@@ -951,7 +1056,9 @@ class PredictService:
                     return cached
                 gen = self._gen.get(key, 0)
             try:
-                loaded = Predictor.load(storage_path, name)
+                loaded = Predictor.load(
+                    storage_path, name, donate_forward=self.donate_forward
+                )
             except Exception as e:
                 # Checkpoint missing/corrupt/unreachable — the
                 # degradation trigger. try_fallback returns None when
@@ -986,11 +1093,34 @@ class PredictService:
                         self._degraded[key] = reason
                         self._degraded_at[key] = _time.monotonic()
                 return loaded
+            warmed = 0
+            if self.warmup_buckets > 0:
+                # Pre-compile the top pow-2 forward buckets while still
+                # under the per-key lock (other artifacts stay servable):
+                # the first requests after this cold load — including the
+                # reload after a retrain eviction — hit compiled code.
+                # Best-effort: a warmup failure must not fail the load.
+                try:
+                    warmed = len(loaded.warmup(
+                        top=self.warmup_buckets, max_rows=self.batch_max_rows
+                    ))
+                except Exception as e:
+                    import sys
+
+                    print(
+                        f"tpuflow.serve: bucket warmup for {name!r} failed "
+                        f"({type(e).__name__}: {e}); serving without it",
+                        file=sys.stderr,
+                    )
             with self._lock:
-                # Counted only AFTER a successful load: a missing/corrupt
-                # artifact that raises must not inflate the loads number.
+                # ONE acquisition for the counter and the cache insert:
+                # a concurrent metrics() snapshot must never see the
+                # loads counter bumped while the entry is still missing
+                # (or vice versa). Counted only AFTER a successful load —
+                # a missing/corrupt artifact that raises must not inflate
+                # the loads number.
                 self.stats["loads"] += 1
-            with self._lock:
+                self.stats["warmed_buckets"] += warmed
                 if self._gen.get(key, 0) == gen:
                     self._cache[key] = loaded
                 # else: the artifact was rewritten mid-load; serve this
@@ -998,6 +1128,18 @@ class PredictService:
             return loaded
 
     def predict(self, spec: dict) -> dict:
+        """One request, end to end; wall time (including any micro-batch
+        queue wait) is recorded into the latency reservoir whether the
+        request succeeds or raises — p99 must not hide the failures."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            return self._predict(spec)
+        finally:
+            self._latency.record(_time.perf_counter() - t0)
+
+    def _predict(self, spec: dict) -> dict:
         import numpy as np
 
         with self._lock:
@@ -1007,13 +1149,29 @@ class PredictService:
         if not storage or not name:
             raise ValueError("predict needs storagePath and model")
         pred = self._predictor(storage, name)
+        # Degraded answers are NEVER coalesced into model batches: the
+        # fallback has no jitted forward to share, and mixing physics
+        # rows into a model dispatch would scatter baseline numbers to
+        # callers expecting model predictions. The fallback path is the
+        # plain per-request one, still flagged per response below.
+        coalesce = self._batcher is not None and not getattr(
+            pred, "degraded", False
+        )
         if "data" in spec:
-            y = pred.predict_csv(spec["data"])
+            if coalesce:
+                y = self._predict_coalesced(
+                    storage, name, pred, pred.columns_from_csv(spec["data"])
+                )
+            else:
+                y = pred.predict_csv(spec["data"])
         elif "columns" in spec:
             columns = {
                 k: np.asarray(v) for k, v in spec["columns"].items()
             }
-            y = pred.predict_columns(columns)
+            if coalesce:
+                y = self._predict_coalesced(storage, name, pred, columns)
+            else:
+                y = pred.predict_columns(columns)
         else:
             raise ValueError("predict needs data (csv path) or columns")
         y = np.asarray(y)
@@ -1028,6 +1186,16 @@ class PredictService:
                 self.stats["degraded_requests"] += 1
         return out
 
+    def _predict_coalesced(self, storage, name, pred, columns):
+        # Transform per-request (request-shaped errors fail HERE, before
+        # the batch), coalesce only the forward. The predictor instance
+        # rides with the entry so a retrain mid-flight can't scatter
+        # another generation's predictions to this caller.
+        x, _ = pred.prepare_columns(columns)
+        if len(x) == 0:
+            return pred.forward_prepared(x)
+        return self._batcher.submit((storage, name), pred, x)
+
 
 def make_server(
     host: str = "127.0.0.1",
@@ -1035,12 +1203,27 @@ def make_server(
     max_queued: int = 64,
     default_timeout: float | None = None,
     journal_path: str | None = None,
+    batch_predicts: bool | None = None,
+    batch_max_rows: int | None = None,
+    batch_max_wait_ms: float | None = None,
+    warmup_buckets: int | None = None,
+    donate_forward: bool | None = None,
 ) -> ThreadingHTTPServer:
-    """Build the HTTP server (caller drives serve_forever / shutdown)."""
+    """Build the HTTP server (caller drives serve_forever / shutdown).
+
+    The ``batch_*`` / ``warmup_buckets`` / ``donate_forward`` knobs are
+    the serving fast path (PredictService docstring; docs/serving.md);
+    ``None`` defers to the ``TPUFLOW_SERVE_*`` env vars, default off."""
     import time as _time
 
     started = _time.monotonic()  # immune to wall-clock steps
-    predictor = PredictService()
+    predictor = PredictService(
+        batch_predicts=batch_predicts,
+        batch_max_rows=batch_max_rows,
+        batch_max_wait_ms=batch_max_wait_ms,
+        warmup_buckets=warmup_buckets,
+        donate_forward=donate_forward,
+    )
     # Retraining an artifact this process has served must evict the cached
     # Predictor, or /predict would keep returning the old model forever.
     runner = JobRunner(
@@ -1152,7 +1335,15 @@ def make_server(
         def log_message(self, fmt, *args):  # quiet by default
             pass
 
-    server = ThreadingHTTPServer((host, port), Handler)
+    class Server(ThreadingHTTPServer):
+        # http.server's default listen backlog is 5: under bursty
+        # concurrent clients (each urllib request is a fresh TCP
+        # connection) the 6th simultaneous connect gets RST. A deeper
+        # accept queue is the first thing any fronting proxy would
+        # assume; 128 matches common server defaults.
+        request_queue_size = 128
+
+    server = Server((host, port), Handler)
     server.runner = runner  # for tests / callers
     server.predictor = predictor
     return server
@@ -1181,6 +1372,35 @@ def main(argv=None) -> int:
         help="JSONL job journal: job history survives restarts, "
         "never-started jobs are requeued, mid-run jobs marked lost",
     )
+    p.add_argument(
+        # BooleanOptionalAction: --no-batch-predicts must be able to
+        # override TPUFLOW_SERVE_BATCH=1 back to off (argument > env).
+        "--batch-predicts", action=argparse.BooleanOptionalAction,
+        default=None,
+        help="coalesce concurrent /predict requests per artifact into "
+        "shared jitted dispatches (also TPUFLOW_SERVE_BATCH=1)",
+    )
+    p.add_argument(
+        "--batch-max-rows", type=int, default=None, metavar="N",
+        help="dispatch a coalesced batch once N rows accumulate "
+        "(default 256; also TPUFLOW_SERVE_MAX_BATCH)",
+    )
+    p.add_argument(
+        "--batch-max-wait-ms", type=float, default=None, metavar="MS",
+        help="max time a request waits to be coalesced before its batch "
+        "dispatches anyway (default 2.0; also TPUFLOW_SERVE_MAX_WAIT_MS)",
+    )
+    p.add_argument(
+        "--warmup-buckets", type=int, default=None, metavar="K",
+        help="pre-compile the K largest pow-2 forward buckets at artifact "
+        "load time (default 0 = off; also TPUFLOW_SERVE_WARMUP)",
+    )
+    p.add_argument(
+        "--donate-forward", action=argparse.BooleanOptionalAction,
+        default=None,
+        help="donate the input batch buffer to the jitted forward "
+        "(also TPUFLOW_SERVE_DONATE=1)",
+    )
     args = p.parse_args(argv)
 
     server = make_server(
@@ -1188,6 +1408,11 @@ def main(argv=None) -> int:
         max_queued=args.max_queued,
         default_timeout=args.default_timeout,
         journal_path=args.journal,
+        batch_predicts=args.batch_predicts,
+        batch_max_rows=args.batch_max_rows,
+        batch_max_wait_ms=args.batch_max_wait_ms,
+        warmup_buckets=args.warmup_buckets,
+        donate_forward=args.donate_forward,
     )
 
     def _stop(signum, frame):

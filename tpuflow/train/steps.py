@@ -99,12 +99,20 @@ def make_eval_step(loss_fn: LossFn = mae_clip):
     return jax.jit(step)
 
 
-def make_predict(model_apply):
-    """Jitted deterministic forward pass."""
+def make_predict(model_apply, donate_input: bool = False):
+    """Jitted deterministic forward pass.
+
+    ``donate_input=True`` donates the input batch's device buffer to the
+    call (serving fast path: the padded batch is freshly built per
+    dispatch and never reused, so XLA may overwrite it in place). Off by
+    default — callers that reuse ``x`` after the call must not donate.
+    """
 
     def predict(params, x):
         return model_apply({"params": params}, x, deterministic=True)
 
+    if donate_input:
+        return jax.jit(predict, donate_argnums=(1,))
     return jax.jit(predict)
 
 
