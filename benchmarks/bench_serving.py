@@ -187,6 +187,12 @@ def _measure_mode(
 
 
 def main() -> None:
+    # --quick: one small client count, short window — the regression
+    # gate shape (same knobs run_all.py --quick sets via env; explicit
+    # env values still win so CI can tune either way).
+    if "--quick" in sys.argv[1:]:
+        os.environ.setdefault("BENCH_SERVE_CLIENTS", "8")
+        os.environ.setdefault("BENCH_SERVE_SECONDS", "2")
     seconds = float(os.environ.get("BENCH_SERVE_SECONDS", 4))
     rows = int(os.environ.get("BENCH_SERVE_ROWS", 8))
     counts = _client_counts()

@@ -98,6 +98,43 @@ def test_preflight_rejects_bad_spec_before_training():
     assert "TPUFLOW_FAULTS" in out.stderr
 
 
+def test_obs_summary_subprocess(tmp_path):
+    """python -m tpuflow.obs summary: the log-reading CLI works as a real
+    subprocess (no jax needed) and aggregates a metrics trail."""
+    import json
+
+    trail = tmp_path / "metrics.jsonl"
+    trail.write_text("\n".join(json.dumps(rec) for rec in [
+        {"event": "epoch", "time": 1.0, "epoch": 1, "val_loss": 0.5},
+        {"event": "epoch", "time": 2.0, "epoch": 2, "val_loss": 0.25},
+        {"event": "span", "time": 2.5, "name": "step", "duration_s": 0.5},
+        {"event": "fit_done", "time": 3.0, "epochs": 2},
+    ]) + "\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "summary", str(trail)],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "4 events" in out.stdout
+    assert "epochs: 2" in out.stdout
+    assert "step:" in out.stdout
+
+    tail = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "tail", str(trail), "-n", "1"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert tail.returncode == 0
+    assert json.loads(tail.stdout)["event"] == "fit_done"
+
+    missing = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "summary",
+         str(tmp_path / "nope.jsonl")],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert missing.returncode == 2
+    assert "nope.jsonl" in missing.stderr
+
+
 def test_analysis_module_entry_rejects_broken_spec(tmp_path):
     """python -m tpuflow.analysis: the CI entry point exits non-zero on a
     broken spec and prints the preflight diagnostic."""

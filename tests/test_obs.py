@@ -1,0 +1,434 @@
+"""Unified telemetry (tpuflow/obs): registry, Prometheus exposition,
+trace propagation, forensics, and the docs/code drift gates.
+
+The contracts under test:
+
+- counters/gauges/histograms/summaries render as VALID Prometheus text
+  exposition, and the serve daemon serves it at
+  ``GET /metrics?format=prometheus`` while the JSON view keeps its keys;
+- every fault-site firing increments ``faults_injected_total{site=...}``
+  and the label set is exactly the SITES catalog (parity gate);
+- a ``/predict`` trace ID rides into the coalesced dispatch's span event
+  and comes back in the response;
+- a training run's metrics JSONL carries ingest/step/checkpoint spans
+  with durations, and an unhandled training failure dumps the forensics
+  ring next to the artifacts;
+- the ``/metrics`` JSON keys documented in docs/serving.md match what
+  the services actually return (schema-drift gate).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpuflow.obs import (
+    Registry,
+    clear_events,
+    default_registry,
+    recent_events,
+    render_prometheus,
+    use_trace,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# One Prometheus sample line: name{labels} value  (labels optional;
+# NaN/+Inf/-Inf are the format's non-finite spellings).
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? (NaN|[+-]Inf|[-0-9eE+.]+)$"
+)
+
+
+def _assert_valid_exposition(text: str) -> dict[str, str]:
+    """Validate exposition shape; returns {family: TYPE}."""
+    types: dict[str, str] = {}
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert kind in ("counter", "gauge", "histogram", "summary"), line
+            assert name not in types, f"duplicate family {name}"
+            types[name] = kind
+        else:
+            assert _SAMPLE_RE.match(line), f"malformed sample line: {line!r}"
+    return types
+
+
+class TestRegistry:
+    def test_counter_gauge_histogram_summary_render(self):
+        reg = Registry(namespace="t")
+        c = reg.counter("reqs_total", "requests")
+        c.inc()
+        c.inc(2, site="a.b")
+        g = reg.gauge("depth", "queue depth")
+        g.set(7)
+        h = reg.histogram("size", "batch size", buckets=[1, 4, 16])
+        for v in (1, 3, 100):
+            h.observe(v)
+        reg.summary(
+            "lat_ms", "latency",
+            fn=lambda: {"quantiles": {0.5: 1.5, 0.99: 9.0},
+                        "sum": 30.0, "count": 10},
+        )
+        text = render_prometheus(reg)
+        types = _assert_valid_exposition(text)
+        assert types == {
+            "t_reqs_total": "counter", "t_depth": "gauge",
+            "t_size": "histogram", "t_lat_ms": "summary",
+        }
+        assert 't_reqs_total{site="a.b"} 2' in text
+        assert "t_reqs_total 1" in text.splitlines()
+        assert 't_size_bucket{le="+Inf"} 3' in text
+        assert "t_size_sum 104" in text
+        assert 't_lat_ms{quantile="0.99"} 9' in text
+
+    def test_get_or_create_returns_same_family(self):
+        reg = Registry()
+        assert reg.counter("x_total") is reg.counter("x_total")
+
+    def test_kind_mismatch_fails_loudly(self):
+        reg = Registry()
+        reg.counter("x_total")
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("x_total")
+
+    def test_counter_rejects_decrease(self):
+        with pytest.raises(ValueError, match="cannot decrease"):
+            Registry().counter("x_total").inc(-1)
+
+    def test_callback_gauge_reads_at_collect_time(self):
+        reg = Registry()
+        state = {"v": 1.0}
+        reg.gauge("live", fn=lambda: state["v"])
+        assert "tpuflow_live 1" in render_prometheus(reg)
+        state["v"] = 5.0
+        assert "tpuflow_live 5" in render_prometheus(reg)
+
+    def test_non_finite_values_render_not_raise(self):
+        reg = Registry()
+        reg.gauge("weird").set(float("nan"))
+        reg.gauge("hot").set(float("inf"))
+        text = render_prometheus(reg)
+        _assert_valid_exposition(text)
+        assert "tpuflow_weird NaN" in text
+        assert "tpuflow_hot +Inf" in text
+
+    def test_same_kind_different_config_fails_loudly(self):
+        reg = Registry()
+        reg.summary("lat", fn=lambda: {})
+        with pytest.raises(ValueError, match="different callback"):
+            reg.summary("lat", fn=lambda: {})
+        reg.histogram("h", buckets=[1, 2])
+        with pytest.raises(ValueError, match="different callback/bucket"):
+            reg.histogram("h", buckets=[1, 2, 4])
+        assert reg.histogram("h", buckets=[2, 1]) is not None  # same edges
+
+    def test_duplicate_family_across_registries_first_wins(self):
+        a, b = Registry(), Registry()
+        a.counter("dup_total").inc(1)
+        b.counter("dup_total").inc(9)
+        text = render_prometheus(a, b)
+        assert text.count("# TYPE tpuflow_dup_total counter") == 1
+        assert "tpuflow_dup_total 1" in text
+        assert "tpuflow_dup_total 9" not in text
+
+
+class TestFaultCounterParity:
+    def test_every_site_fires_into_the_labeled_counter(self):
+        """Site-catalog/metric-label parity: arming + firing a raise-mode
+        fault at EVERY catalogued site increments
+        ``faults_injected_total{site=...}``, and the label set observed
+        equals the SITES catalog exactly."""
+        from tpuflow.resilience import (
+            SITES,
+            FaultInjected,
+            FaultSpec,
+            arm,
+            clear_faults,
+            fault_point,
+        )
+
+        counter = default_registry().counter("faults_injected_total")
+        before = {
+            d["site"]: counter.value(site=d["site"])
+            for d in counter.labels_seen()
+        }
+        clear_faults()
+        try:
+            for site in SITES:
+                arm(FaultSpec(site=site, nth=1))
+                with pytest.raises(FaultInjected):
+                    fault_point(site, index=1)
+        finally:
+            clear_faults()
+        seen = {d["site"] for d in counter.labels_seen()}
+        assert seen == set(SITES), (
+            "faults_injected_total labels and the SITES catalog disagree: "
+            f"label-only={seen - set(SITES)}, "
+            f"catalog-only={set(SITES) - seen}"
+        )
+        for site in SITES:
+            assert counter.value(site=site) == before.get(site, 0.0) + 1
+
+
+class TestForensicsRings:
+    def test_hot_serving_spans_do_not_evict_run_trail(self):
+        """Per-dispatch serving spans go to a separate bounded ring: a
+        busy daemon must not evict a crashed job's lifecycle trail."""
+        from tpuflow.obs import record_event, record_span
+
+        clear_events()
+        record_event("fault_injected", site="x")  # the run trail
+        for _ in range(2000):  # way past both ring capacities
+            record_span("predict.dispatch", 0.001, hot=True)
+        events = recent_events()
+        assert any(e["event"] == "fault_injected" for e in events)
+        hot = [e for e in events if e.get("name") == "predict.dispatch"]
+        assert 0 < len(hot) <= 256  # bounded, newest kept
+
+
+class _StubPredictor:
+    degraded = False
+
+    def prepare_columns(self, columns):
+        return np.asarray(columns["x"], np.float32).reshape(-1, 1), None
+
+    def forward_prepared(self, x):
+        return x[:, 0] * 2.0
+
+    def predict_columns(self, columns):
+        x, _ = self.prepare_columns(columns)
+        return self.forward_prepared(x)
+
+
+KEY = ("/artifacts", "m")
+SPEC = {"storagePath": KEY[0], "model": KEY[1]}
+
+
+class TestTracePropagation:
+    def test_trace_id_echoed_and_visible_in_dispatch_span(self):
+        from tpuflow.serve import PredictService
+
+        clear_events()
+        svc = PredictService(
+            batch_predicts=True, batch_max_rows=64, batch_max_wait_ms=30.0
+        )
+        svc._cache[KEY] = _StubPredictor()
+        try:
+            with use_trace("feedfacecafe0001") as tid:
+                out = svc.predict({**SPEC, "columns": {"x": [1.0, 2.0]}})
+            assert out["trace_id"] == tid
+            assert out["predictions"] == [2.0, 4.0]
+            spans = [
+                e for e in recent_events()
+                if e.get("event") == "span"
+                and e.get("name") == "predict.dispatch"
+            ]
+            assert spans, "no coalesced-dispatch span recorded"
+            assert any(tid in (s.get("trace_ids") or []) for s in spans)
+            assert all(s["duration_s"] >= 0 for s in spans)
+        finally:
+            svc.close()
+
+    def test_fresh_trace_id_when_caller_has_none(self):
+        from tpuflow.serve import PredictService
+
+        svc = PredictService(batch_predicts=False)
+        svc._cache[KEY] = _StubPredictor()
+        out = svc.predict({**SPEC, "columns": {"x": [3.0]}})
+        assert re.fullmatch(r"[0-9a-f]{16}", out["trace_id"])
+
+
+def _get_text(url: str) -> tuple[int, str, str]:
+    with urllib.request.urlopen(url, timeout=15) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read().decode()
+
+
+class TestPrometheusEndpoint:
+    def test_exposition_covers_the_acceptance_families(self):
+        """/metrics?format=prometheus is valid exposition text covering
+        serving latency percentiles, the batch-size histogram, job
+        counters, and fault-injection counters — while the JSON view
+        keeps its keys."""
+        from tpuflow.resilience import (
+            FaultInjected,
+            FaultSpec,
+            arm,
+            clear_faults,
+            fault_point,
+        )
+        from tpuflow.serve import make_server
+
+        # Ensure at least one fault firing exists in the process-wide
+        # registry (the serve scrape must include it).
+        clear_faults()
+        arm(FaultSpec(site="serve.execute", nth=1))
+        with pytest.raises(FaultInjected):
+            fault_point("serve.execute")
+        clear_faults()
+
+        srv = make_server("127.0.0.1", 0, batch_predicts=True,
+                          batch_max_wait_ms=5.0)
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        try:
+            srv.predictor._cache[KEY] = _StubPredictor()
+            body = json.dumps(
+                {**SPEC, "columns": {"x": [1.0, 2.0]}}
+            ).encode()
+            req = urllib.request.Request(
+                base + "/predict", data=body,
+                headers={"Content-Type": "application/json",
+                         "X-Trace-Id": "cafebabe00000001"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=15) as r:
+                res = json.loads(r.read())
+            assert res["trace_id"] == "cafebabe00000001"
+
+            status, ctype, text = _get_text(
+                base + "/metrics?format=prometheus"
+            )
+            assert status == 200
+            assert ctype.startswith("text/plain")
+            types = _assert_valid_exposition(text)
+            # The acceptance families, by kind:
+            assert types["tpuflow_predict_latency_ms"] == "summary"
+            assert types["tpuflow_predict_batch_size"] == "histogram"
+            assert types["tpuflow_jobs_submitted_total"] == "counter"
+            assert types["tpuflow_jobs_queued"] == "gauge"
+            assert types["tpuflow_faults_injected_total"] == "counter"
+            assert 'tpuflow_predict_latency_ms{quantile="0.5"}' in text
+            assert 'tpuflow_faults_injected_total{site="serve.execute"}' \
+                in text
+            assert "tpuflow_predict_requests_total 1" in text
+            assert "tpuflow_uptime_seconds" in types
+
+            # The JSON view is unchanged in shape.
+            status, _, js = _get_text(base + "/metrics")
+            metrics = json.loads(js)
+            assert set(metrics) == {"jobs", "predict", "uptime_s"}
+            assert metrics["predict"]["requests"] == 1
+        finally:
+            srv.shutdown()
+            srv.predictor.close()
+
+
+class TestMetricsKeysDocDrift:
+    """docs/serving.md documents the /metrics JSON keys inside delimited
+    markers; the documented sets must equal what the services return."""
+
+    @staticmethod
+    def _documented(section: str) -> set[str]:
+        doc = os.path.join(REPO, "docs", "serving.md")
+        with open(doc, encoding="utf-8") as f:
+            text = f.read()
+        block = re.search(
+            rf"<!-- metrics-keys:{section} -->(.*?)"
+            rf"<!-- /metrics-keys:{section} -->",
+            text, re.S,
+        )
+        assert block, f"docs/serving.md lost its metrics-keys:{section} markers"
+        return set(re.findall(r"`([a-z_]+)`", block.group(1)))
+
+    def test_predict_metrics_keys_match_docs(self):
+        from tpuflow.serve import PredictService
+
+        svc = PredictService(batch_predicts=False)
+        assert self._documented("predict") == set(svc.metrics())
+
+    def test_jobs_metrics_keys_match_docs(self):
+        from tpuflow.serve import JobRunner
+
+        runner = JobRunner()
+        assert self._documented("jobs") == set(runner.metrics())
+
+
+class TestTrainRunSpans:
+    def test_metrics_jsonl_carries_ingest_step_checkpoint_spans(
+        self, tmp_path
+    ):
+        from tpuflow.api import TrainJobConfig, train
+
+        metrics_path = str(tmp_path / "metrics.jsonl")
+        train(TrainJobConfig(
+            model="static_mlp", max_epochs=2, batch_size=32, seed=0,
+            verbose=False, n_devices=1, synthetic_wells=2,
+            synthetic_steps=64, storage_path=str(tmp_path / "art"),
+            metrics_path=metrics_path,
+        ))
+        recs = [json.loads(l) for l in open(metrics_path)]
+        spans = [r for r in recs if r["event"] == "span"]
+        names = {s["name"] for s in spans}
+        assert {"ingest", "step", "eval", "checkpoint"} <= names, names
+        assert all(s["duration_s"] >= 0 for s in spans)
+        # One run-scoped trace ID across the run's spans.
+        tids = {s.get("trace_id") for s in spans}
+        assert len(tids) == 1 and None not in tids
+        # Satellite: every record carries seq (monotonic) and ISO ts.
+        assert [r["seq"] for r in recs if "seq" in r]
+        assert all("ts" in r and "time" in r for r in recs)
+
+
+class TestForensicsDump:
+    def test_unhandled_train_failure_dumps_ring(self, tmp_path):
+        from tpuflow.api import TrainJobConfig, train
+        from tpuflow.resilience import FaultInjected
+
+        storage = str(tmp_path / "art")
+        with pytest.raises(FaultInjected):
+            train(TrainJobConfig(
+                model="static_mlp", max_epochs=2, batch_size=32, seed=0,
+                verbose=False, n_devices=1, synthetic_wells=2,
+                synthetic_steps=64, storage_path=storage,
+                faults=["train.epoch_start,at=2"],
+            ))
+        dump = os.path.join(storage, "forensics.jsonl")
+        assert os.path.exists(dump)
+        recs = [json.loads(l) for l in open(dump)]
+        assert recs[-1]["event"] == "forensics_dump"
+        assert "failed" in recs[-1]["reason"]
+        kinds = {r["event"] for r in recs}
+        assert "fault_injected" in kinds  # the firing is in the trail
+        assert "span" in kinds  # ...alongside what the run was doing
+
+
+class TestObsCli:
+    def test_summary_aggregates_events_and_spans(self, tmp_path, capsys):
+        from tpuflow.obs.__main__ import main
+        from tpuflow.utils.logging import MetricsLogger
+
+        path = str(tmp_path / "m.jsonl")
+        with MetricsLogger(path) as log:
+            log.write("epoch", epoch=1, val_loss=0.5)
+            log.write("epoch", epoch=2, val_loss=0.25)
+            log.write("span", name="step", duration_s=0.125)
+            log.write("fit_done", epochs=2, best_val_loss=0.25)
+        assert main(["summary", path]) == 0
+        out = capsys.readouterr().out
+        assert "4 events" in out
+        assert "epochs: 2" in out and "best=0.2500" in out
+        assert "step: n=1" in out
+
+    def test_tail_prints_newest_n(self, tmp_path, capsys):
+        from tpuflow.obs.__main__ import main
+        from tpuflow.utils.logging import MetricsLogger
+
+        path = str(tmp_path / "m.jsonl")
+        with MetricsLogger(path) as log:
+            for i in range(5):
+                log.write("tick", i=i)
+        assert main(["tail", path, "-n", "2"]) == 0
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert len(lines) == 2
+        assert json.loads(lines[-1])["i"] == 4

@@ -11,6 +11,20 @@ from tpuflow.utils import MetricsLogger, StepTimer, check_finite, finite_or_rais
 
 
 class TestStepTimer:
+    def test_stop_before_start_raises(self):
+        t = StepTimer()
+        with pytest.raises(RuntimeError, match="before start"):
+            t.stop()
+        assert t.times == []  # nothing ~0.0 was silently recorded
+
+    def test_double_stop_raises(self):
+        t = StepTimer()
+        t.start()
+        t.stop()
+        with pytest.raises(RuntimeError, match="before start"):
+            t.stop()
+        assert len(t.times) == 1
+
     def test_accumulates_steps(self):
         t = StepTimer()
         x = jnp.ones((64, 64))
@@ -73,6 +87,31 @@ class TestMetricsLogger:
         log = MetricsLogger()
         rec = log.write("x", v=1)
         assert rec["v"] == 1
+        log.close()
+
+    def test_seq_monotonic_and_iso_ts(self, tmp_path):
+        path = str(tmp_path / "m.jsonl")
+        with MetricsLogger(path) as log:
+            log.write("a")
+            log.write("b")
+        recs = [json.loads(l) for l in open(path)]
+        assert [r["seq"] for r in recs] == [1, 2]
+        # ISO-8601 UTC alongside the epoch-seconds 'time'.
+        assert all(r["ts"].endswith("+00:00") for r in recs)
+        assert all("time" in r for r in recs)
+
+    def test_closed_handle_warns_once_and_drops(self, tmp_path, capsys):
+        path = str(tmp_path / "m.jsonl")
+        log = MetricsLogger(path)
+        log.write("before")
+        log._fh.close()  # simulate a handle dying mid-run
+        rec = log.write("after", v=2)  # must NOT raise
+        assert rec["v"] == 2
+        log.write("again")  # second drop: no second warning
+        err = capsys.readouterr().err
+        assert err.count("dropping records that fail to write") == 1
+        lines = [json.loads(l) for l in open(path)]
+        assert [r["event"] for r in lines] == ["before"]
         log.close()
 
     def test_fit_writes_metrics_jsonl(self, tmp_path):

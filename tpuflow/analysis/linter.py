@@ -18,6 +18,12 @@ sync per step, a drill that can never fire):
   a ``fault_point``/``parse_fault_spec`` literal that names an unknown
   site is a drill that can never fire (the catalog is
   ``tpuflow.resilience.faults.SITES``).
+- **TPF005** — metrics/trace recording inside a jitted function:
+  ``.inc(...)``/``.observe(...)`` (the obs registry's recording calls)
+  or ``record_event``/``record_span`` under jit either freezes at trace
+  time (recording once, at compile) or forces a host sync per step —
+  record OUTSIDE the jitted program, on already-transferred host values
+  (the ``tpuflow.obs`` contract).
 
 "Jitted function" means a function decorated with ``jit``/``jax.jit``/
 ``partial(jax.jit, ...)`` or passed to a ``jax.jit(...)`` call reachable
@@ -51,12 +57,20 @@ RULES = {
               "field(default_factory=...) / None",
     "TPF004": "fault-site string literal not in the resilience SITES "
               "catalog (a drill against it can never fire)",
+    "TPF005": "metrics/trace recording inside a jitted function (frozen "
+              "at trace time or a host sync per step; record outside jit "
+              "— the tpuflow.obs contract)",
 }
 
 _HOST_SYNC_NAMES = {"float", "bool"}
 _HOST_SYNC_NP_ATTRS = {"asarray", "array"}
 _RANDOM_BASES = {"random"}  # bare `random.` — jax.random is Attribute-based
 _NP_NAMES = {"np", "numpy"}
+# The obs registry's recording surface: method names on Counter/Gauge/
+# Histogram plus the module-level event/span recorders. ``.set`` is
+# deliberately absent (far too generic a method name to flag).
+_METRIC_RECORD_ATTRS = {"inc", "observe"}
+_METRIC_RECORD_NAMES = {"record_event", "record_span"}
 
 
 def _noqa_lines(source: str) -> dict[int, set[str]]:
@@ -181,9 +195,16 @@ class _Linter(ast.NodeVisitor):
                 and func.id in _HOST_SYNC_NAMES
             ):
                 self._emit("TPF001", node, f"{func.id}(...) call")
+            if (
+                isinstance(func, ast.Name)
+                and func.id in _METRIC_RECORD_NAMES
+            ):
+                self._emit("TPF005", node, f"{func.id}(...) call")
             if isinstance(func, ast.Attribute):
                 if func.attr == "item":
                     self._emit("TPF001", node, ".item() call")
+                if func.attr in _METRIC_RECORD_ATTRS:
+                    self._emit("TPF005", node, f".{func.attr}(...) call")
                 if (
                     func.attr in _HOST_SYNC_NP_ATTRS
                     and isinstance(func.value, ast.Name)

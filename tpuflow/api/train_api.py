@@ -218,6 +218,29 @@ def _assert_prep_equivalent(cached: _Prepared, fresh: _Prepared, config) -> None
             _fail(f"{name}.y")
 
 
+def _prepared_with_span(
+    config: TrainJobConfig, schema: Schema, target: str
+) -> _Prepared:
+    """``_prepare_data`` wrapped in the run's "ingest" span: the whole
+    ingest+feature phase lands in the run's metrics JSONL (when
+    ``metrics_path`` is set) and the forensics ring with a duration —
+    for CSV jobs this phase can dominate wall-clock, and without a span
+    it is invisible time."""
+    from tpuflow.obs import span
+
+    mlog = None
+    if config.metrics_path:
+        from tpuflow.utils.logging import MetricsLogger
+
+        mlog = MetricsLogger(config.metrics_path)
+    try:
+        with span("ingest", logger=mlog, model=config.model):
+            return _prepare_data(config, schema, target)
+    finally:
+        if mlog is not None:
+            mlog.close()
+
+
 def _prepare_data(
     config: TrainJobConfig, schema: Schema, target: str
 ) -> _Prepared:
@@ -501,10 +524,32 @@ def train(
         # below can only disarm handles that were recorded).
         specs = [parse_fault_spec(s) for s in config.faults]
         fault_handles = [arm(s) for s in specs]
+    from tpuflow.obs import dump_forensics, use_trace
+    from tpuflow.train.loop import TrainingInterrupted
+
     try:
-        return _train_impl(
-            config, _data_cache=_data_cache, stop_fn=stop_fn
-        )
+        # One run-scoped trace ID for the whole job: the fit loop's
+        # ingest/step/eval/checkpoint spans all carry it, so a run's
+        # JSONL (and a crash dump) is filterable to this run.
+        with use_trace():
+            return _train_impl(
+                config, _data_cache=_data_cache, stop_fn=stop_fn
+            )
+    except TrainingInterrupted:
+        raise  # a cooperative stop is an outcome, not a failure
+    except BaseException:
+        # Crash forensics: the recent-event ring (spans, fault firings,
+        # retries) dumped next to the artifacts — the "what was it doing
+        # just before?" trail. Best-effort; never masks the original
+        # failure.
+        if config.storage_path:
+            from tpuflow.utils.paths import join_path
+
+            dump_forensics(
+                join_path(config.storage_path, "forensics.jsonl"),
+                reason=f"train({config.model}) failed",
+            )
+        raise
     finally:
         if fault_handles:
             from tpuflow.resilience import disarm
@@ -580,7 +625,9 @@ def _train_impl(
             # preparation of a data-axis sweep alive at once could
             # multiply peak host memory.
             _data_cache.clear()
-            prep = _data_cache[key] = _prepare_data(config, schema, target)
+            prep = _data_cache[key] = _prepared_with_span(
+                config, schema, target
+            )
         elif os.environ.get("TPUFLOW_CHECK_PREP_CACHE"):
             # Executable _prep_key contract (see its docstring): a hit
             # must equal a fresh preparation, or the key is missing a
@@ -589,7 +636,7 @@ def _train_impl(
                 prep, _prepare_data(config, schema, target), config
             )
     else:
-        prep = _prepare_data(config, schema, target)
+        prep = _prepared_with_span(config, schema, target)
     train_ds, val_ds, test_ds = prep.train_ds, prep.val_ds, prep.test_ds
     splits, target_std = prep.splits, prep.target_std
     gilbert_test, seq_physics = prep.gilbert_test, prep.seq_physics

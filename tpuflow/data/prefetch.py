@@ -49,15 +49,41 @@ def prefetch(iterator: Iterable, buffer_size: int = 2) -> Iterator:
                 except queue.Full:
                     continue
 
+    # Registry-backed feed accounting (process-wide): total batches fed
+    # and how often the CONSUMER found the buffer empty — the signal
+    # that the host pipeline, not the device, is the bottleneck.
+    # Recorded outside any jitted code (TPF005).
+    from tpuflow.obs import default_registry
+
+    reg = default_registry()
+    fed = reg.counter(
+        "data_prefetch_batches_total", "batches handed to the consumer"
+    )
+    starved = reg.counter(
+        "data_prefetch_starvation_total",
+        "consumer arrivals that found the prefetch buffer empty",
+    )
+
     t = threading.Thread(target=worker, daemon=True)
     t.start()
     try:
+        yielded = False
         while True:
+            empty_on_arrival = q.empty()
             item = q.get()
             if item is _SENTINEL:
                 if err:
                     raise err[0]
                 return
+            # Starvation = the consumer found the buffer empty MID-epoch
+            # and the wait produced a real batch. The first get (worker
+            # just started) and the end-of-stream sentinel are inherent
+            # empties, not a host-pipeline bottleneck — counting them
+            # would flag every healthy epoch.
+            if empty_on_arrival and yielded:
+                starved.inc()
+            fed.inc()
+            yielded = True
             yield item
     finally:
         stop.set()

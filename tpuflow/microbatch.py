@@ -69,41 +69,70 @@ class LatencyStats:
             if seconds > self._max:
                 self._max = seconds
 
+    @staticmethod
+    def _percentiles_ms(samples: list[float]) -> dict[float, float | None]:
+        """p50/p99 in milliseconds over one locked copy of the window —
+        THE one percentile computation, shared by the JSON snapshot and
+        the Prometheus summary so the two views cannot drift."""
+        if not samples:
+            return {0.5: None, 0.99: None}
+        arr = np.asarray(samples, np.float64) * 1000.0
+        return {
+            0.5: round(float(np.percentile(arr, 50)), 3),
+            0.99: round(float(np.percentile(arr, 99)), 3),
+        }
+
     def snapshot(self) -> dict:
         """One consistent view: counters plus percentiles over the
         current window, all in milliseconds."""
         with self._lock:
             samples = list(self._samples)
             count, total, worst = self._count, self._total, self._max
-        out = {
+        pcts = self._percentiles_ms(samples)
+        return {
             "count": count,
             "window": len(samples),
-            "p50_ms": None,
-            "p99_ms": None,
-            "mean_ms": None,
+            "p50_ms": pcts[0.5],
+            "p99_ms": pcts[0.99],
+            "mean_ms": round(total / count * 1000.0, 3) if count else None,
             "max_ms": round(worst * 1000.0, 3) if count else None,
         }
-        if samples:
-            arr = np.asarray(samples, np.float64) * 1000.0
-            out["p50_ms"] = round(float(np.percentile(arr, 50)), 3)
-            out["p99_ms"] = round(float(np.percentile(arr, 99)), 3)
-            out["mean_ms"] = round(total / count * 1000.0, 3)
-        return out
+
+    def summary(self) -> dict:
+        """The reservoir reshaped for a registry Summary (the Prometheus
+        quantile exposition): window percentiles + lifetime sum/count —
+        all from ONE lock acquisition, so the exported sum never
+        includes a sample the count excludes."""
+        with self._lock:
+            samples = list(self._samples)
+            count, total = self._count, self._total
+        return {
+            "quantiles": self._percentiles_ms(samples),
+            "sum": round(total * 1000.0, 3),
+            "count": count,
+        }
 
 
 class _Pending:
     """One waiting request: its transformed rows, the predictor instance
-    it resolved (the anti-stale-scatter token), and the rendezvous."""
+    it resolved (the anti-stale-scatter token), the trace ID bound when
+    it was submitted (the dispatcher thread has no request context — the
+    ID must ride the entry), and the rendezvous."""
 
-    __slots__ = ("pred", "x", "event", "result", "error", "t_enqueued")
+    __slots__ = (
+        "pred", "x", "event", "result", "error", "t_enqueued", "trace_id"
+    )
 
     def __init__(self, pred, x):
+        from tpuflow.obs import current_trace_id
+
         self.pred = pred
         self.x = x
         self.event = threading.Event()
         self.result = None
         self.error: BaseException | None = None
         self.t_enqueued = time.monotonic()
+        self.trace_id = current_trace_id()
 
 
 class MicroBatcher:
@@ -119,7 +148,10 @@ class MicroBatcher:
         max_wait_ms: float = 2.0,
         max_queue_rows: int = 8192,
         submit_timeout: float = 60.0,
+        registry=None,
     ):
+        from tpuflow.obs import DEFAULT_COUNT_BUCKETS, Registry
+
         if max_batch_rows < 1:
             raise ValueError(f"max_batch_rows must be >= 1, got {max_batch_rows}")
         if max_wait_ms < 0:
@@ -133,18 +165,45 @@ class MicroBatcher:
         self._pending: dict[tuple, list[_Pending]] = {}
         self._queued_rows = 0
         self._stop = False
-        # Counters (guarded by self._cond's lock): dispatches = device
+        # Registry-backed counters (tpuflow/obs): dispatches = device
         # calls made; coalesced_dispatches = those carrying > 1 request;
-        # batch_size_hist = requests-per-dispatch histogram — the
-        # observable proof coalescing actually happens under load.
-        self.stats = {
-            "requests": 0,
-            "rejected": 0,
-            "dispatches": 0,
-            "coalesced_dispatches": 0,
-            "rows_dispatched": 0,
-            "max_queue_depth_rows": 0,
+        # the batch-size histogram is the observable proof coalescing
+        # actually happens under load. Increments happen under
+        # self._cond's lock exactly where the old dict writes did, so
+        # metrics() keeps its one-consistent-view discipline; the same
+        # registry renders straight into /metrics?format=prometheus.
+        # Default: a private run-scoped Registry, so parallel batchers
+        # (tests, benchmarks) never bleed counts into each other.
+        self.registry = registry if registry is not None else Registry()
+        self._counters = {
+            name: self.registry.counter(
+                f"predict_batch_{name}_total", help
+            )
+            for name, help in (
+                ("requests", "requests entering the micro-batch queue"),
+                ("rejected", "submissions refused on a full queue"),
+                ("dispatches", "device dispatches made"),
+                ("coalesced_dispatches", "dispatches carrying > 1 request"),
+                ("rows_dispatched", "total rows sent to the device"),
+            )
         }
+        self._depth_gauge = self.registry.gauge(
+            "predict_batch_queue_depth_rows",
+            "rows currently waiting to be coalesced",
+            fn=lambda: self._queued_rows,
+        )
+        self._max_depth_gauge = self.registry.gauge(
+            "predict_batch_max_queue_depth_rows",
+            "high-water mark of rows waiting to be coalesced",
+        )
+        self._max_depth = 0
+        self._size_hist = self.registry.histogram(
+            "predict_batch_size",
+            "requests coalesced per dispatch",
+            buckets=DEFAULT_COUNT_BUCKETS,
+        )
+        # Exact requests-per-dispatch tallies for the JSON view (the
+        # fixed-bucket registry histogram backs the Prometheus one).
         self._hist: dict[int, int] = {}
         self._thread = threading.Thread(
             target=self._loop, name="tpuflow-microbatch", daemon=True
@@ -163,17 +222,18 @@ class MicroBatcher:
             if self._stop:
                 raise RuntimeError("predict micro-batcher is closed")
             if self._queued_rows + len(x) > self.max_queue_rows:
-                self.stats["rejected"] += 1
+                self._counters["rejected"].inc()
                 raise RuntimeError(
                     f"predict micro-batch queue full "
                     f"({self._queued_rows} rows pending, max "
                     f"{self.max_queue_rows}); retry shortly"
                 )
-            self.stats["requests"] += 1
+            self._counters["requests"].inc()
             self._pending.setdefault(key, []).append(entry)
             self._queued_rows += len(x)
-            if self._queued_rows > self.stats["max_queue_depth_rows"]:
-                self.stats["max_queue_depth_rows"] = self._queued_rows
+            if self._queued_rows > self._max_depth:
+                self._max_depth = self._queued_rows
+                self._max_depth_gauge.set(self._max_depth)
             self._cond.notify_all()
         if not entry.event.wait(timeout=self.submit_timeout):
             raise RuntimeError(
@@ -185,11 +245,17 @@ class MicroBatcher:
         return entry.result
 
     def metrics(self) -> dict:
-        """Counter snapshot under the lock — one consistent view."""
+        """Counter snapshot under the lock — one consistent view, built
+        from the registry counters (the JSON keys are unchanged; the
+        Prometheus view renders the same registry)."""
         with self._cond:
             return {
                 "enabled": True,
-                **self.stats,
+                **{
+                    name: int(c.value())
+                    for name, c in self._counters.items()
+                },
+                "max_queue_depth_rows": self._max_depth,
                 "queue_depth_rows": self._queued_rows,
                 "batch_size_hist": dict(sorted(self._hist.items())),
                 "max_batch_rows": self.max_batch_rows,
@@ -266,11 +332,15 @@ class MicroBatcher:
         # mixing old and new params would scatter stale predictions to
         # whichever side didn't match the batch. One dispatch per
         # distinct instance, in arrival order.
+        from tpuflow.obs import record_span
+
         groups: dict[int, list[_Pending]] = {}
         for e in taken:
             groups.setdefault(id(e.pred), []).append(e)
         for group in groups.values():
             rows = sum(len(e.x) for e in group)
+            t0 = time.perf_counter()
+            failed = False
             try:
                 # Concatenate inside the try: even a pathological shape
                 # mismatch must fail THIS group, never kill the
@@ -289,14 +359,31 @@ class MicroBatcher:
                     e.result = y[offset : offset + n]
                     offset += n
             except BaseException as exc:  # scatter the failure, stay alive
+                failed = True
                 for e in group:
                     e.error = exc
             finally:
                 with self._cond:
-                    self.stats["dispatches"] += 1
-                    self.stats["rows_dispatched"] += rows
+                    self._counters["dispatches"].inc()
+                    self._counters["rows_dispatched"].inc(rows)
                     if len(group) > 1:
-                        self.stats["coalesced_dispatches"] += 1
+                        self._counters["coalesced_dispatches"].inc()
+                    self._size_hist.observe(len(group))
                     self._hist[len(group)] = self._hist.get(len(group), 0) + 1
+                # The coalesced-dispatch span: every trace ID this device
+                # call answered, so one caller's request is linkable to
+                # the shared dispatch that served it (forensics ring +
+                # any test reading obs.recent_events()).
+                record_span(
+                    "predict.dispatch",
+                    time.perf_counter() - t0,
+                    hot=True,  # per-dispatch rate: the forensics hot ring
+                    requests=len(group),
+                    rows=rows,
+                    ok=not failed,
+                    trace_ids=[
+                        e.trace_id for e in group if e.trace_id
+                    ],
+                )
                 for e in group:
                     e.event.set()

@@ -1,0 +1,66 @@
+"""Unified telemetry: metrics registry, span tracing, Prometheus, forensics.
+
+Four legs (docs/observability.md), replacing the four disconnected
+fragments that grew ad hoc (``utils/logging.MetricsLogger``,
+``utils/profiling.StepTimer``, the hand-rolled ``/metrics`` dicts in
+``serve.py``, the supervisor's progress file — all of which remain, now
+wired into one substrate):
+
+- ``metrics``    — :class:`Registry` of counters/gauges/histograms/
+  summaries; a process-wide default (framework signals) plus run-scoped
+  instances (services). Lock-cheap; never record inside jit (TPF005).
+- ``tracing``    — run/trace IDs + ``span(...)`` events, propagated
+  from a ``/predict`` request through the MicroBatcher's coalesced
+  dispatch and from ``train()`` through the fit loop's JSONL.
+- ``prometheus`` — ``render_prometheus(*registries)`` text exposition,
+  served at ``GET /metrics?format=prometheus``.
+- ``forensics``  — bounded event ring dumped to ``forensics.jsonl`` on
+  unhandled failure / crash-loop classification;
+  ``python -m tpuflow.obs tail|summary <file>`` reads any event trail.
+"""
+
+from tpuflow.obs.forensics import (
+    clear_events,
+    dump_forensics,
+    recent_events,
+    record_event,
+)
+from tpuflow.obs.metrics import (
+    DEFAULT_COUNT_BUCKETS,
+    DEFAULT_TIME_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    Summary,
+    default_registry,
+)
+from tpuflow.obs.prometheus import render_prometheus
+from tpuflow.obs.tracing import (
+    current_trace_id,
+    new_trace_id,
+    record_span,
+    span,
+    use_trace,
+)
+
+__all__ = [
+    "DEFAULT_COUNT_BUCKETS",
+    "DEFAULT_TIME_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "Summary",
+    "clear_events",
+    "current_trace_id",
+    "default_registry",
+    "dump_forensics",
+    "new_trace_id",
+    "recent_events",
+    "record_event",
+    "record_span",
+    "render_prometheus",
+    "span",
+    "use_trace",
+]

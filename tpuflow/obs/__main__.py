@@ -1,0 +1,141 @@
+"""``python -m tpuflow.obs`` — read any run's event trail from the shell.
+
+Usage::
+
+    python -m tpuflow.obs tail    <metrics.jsonl> [-n N]
+    python -m tpuflow.obs summary <metrics.jsonl>
+
+Both subcommands read the JSONL event format every tpuflow sink writes —
+a training run's ``metrics.jsonl`` (``--metrics`` / ``metrics_path``),
+a crash dump's ``forensics.jsonl``, or a serve journal. ``tail`` prints
+the newest N records (default 20), one per line, newest last. ``summary``
+aggregates the whole trail: events by type, epoch-loss trajectory, span
+time by name, and the wall-clock window covered — the two-second answer
+to "what did this run do and where did the time go".
+
+Deliberately dependency-light (no jax import): usable on a machine that
+only has the log files.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _read_events(path: str) -> tuple[list[dict], int]:
+    """Parse a JSONL trail; returns (events, skipped_lines). Corrupt
+    lines (crash-truncated tails) are counted, not fatal."""
+    events, skipped = [], 0
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                skipped += 1
+                continue
+            if isinstance(rec, dict):
+                events.append(rec)
+            else:
+                skipped += 1
+    return events, skipped
+
+
+def _tail(path: str, n: int) -> int:
+    events, skipped = _read_events(path)
+    for rec in events[-n:]:
+        print(json.dumps(rec))
+    if skipped:
+        print(f"({skipped} unparseable line(s) skipped)", file=sys.stderr)
+    return 0
+
+
+def _fmt_seconds(s: float) -> str:
+    return f"{s:.3f}s" if s < 120 else f"{s / 60:.1f}m"
+
+
+def _summary(path: str) -> int:
+    events, skipped = _read_events(path)
+    if not events:
+        print(f"{path}: no events" + (f" ({skipped} unparseable)" if skipped else ""))
+        return 1
+    by_type: dict[str, int] = {}
+    for rec in events:
+        kind = str(rec.get("event", "?"))
+        by_type[kind] = by_type.get(kind, 0) + 1
+    print(f"{path}: {len(events)} events"
+          + (f" ({skipped} unparseable line(s) skipped)" if skipped else ""))
+    times = [rec["time"] for rec in events if isinstance(rec.get("time"), (int, float))]
+    if times:
+        print(f"  window: {_fmt_seconds(max(times) - min(times))} "
+              f"({min(times):.0f} .. {max(times):.0f} epoch-seconds)")
+    print("  by event: " + ", ".join(
+        f"{k}={v}" for k, v in sorted(by_type.items())
+    ))
+    # Epoch trajectory (the fit loop's per-epoch records).
+    epochs = [rec for rec in events if rec.get("event") == "epoch"]
+    if epochs:
+        losses = [rec.get("val_loss") for rec in epochs
+                  if isinstance(rec.get("val_loss"), (int, float))]
+        line = f"  epochs: {len(epochs)}"
+        if losses:
+            line += (f"; val_loss first={losses[0]:.4f} "
+                     f"last={losses[-1]:.4f} best={min(losses):.4f}")
+        print(line)
+    # Span time by name — where the run's time actually went.
+    spans: dict[str, tuple[int, float]] = {}
+    for rec in events:
+        if rec.get("event") != "span":
+            continue
+        name = str(rec.get("name", "?"))
+        dur = rec.get("duration_s")
+        if not isinstance(dur, (int, float)):
+            continue
+        n, total = spans.get(name, (0, 0.0))
+        spans[name] = (n + 1, total + float(dur))
+    if spans:
+        print("  spans:")
+        for name, (n, total) in sorted(
+            spans.items(), key=lambda kv: -kv[1][1]
+        ):
+            print(f"    {name}: n={n} total={_fmt_seconds(total)} "
+                  f"mean={total / n * 1000:.1f}ms")
+    done = [rec for rec in events if rec.get("event") == "fit_done"]
+    if done:
+        rec = done[-1]
+        print(f"  fit_done: epochs={rec.get('epochs')} "
+              f"best_val_loss={rec.get('best_val_loss')} "
+              f"samples_per_sec={rec.get('samples_per_sec')}")
+    dumps = [rec for rec in events if rec.get("event") == "forensics_dump"]
+    if dumps:
+        print(f"  forensics dump: reason={dumps[-1].get('reason')!r}")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpuflow.obs",
+        description="summarize/tail a tpuflow JSONL event trail",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_tail = sub.add_parser("tail", help="print the newest N records")
+    p_tail.add_argument("file")
+    p_tail.add_argument("-n", type=int, default=20)
+    p_sum = sub.add_parser("summary", help="aggregate the whole trail")
+    p_sum.add_argument("file")
+    args = ap.parse_args(argv)
+    try:
+        if args.cmd == "tail":
+            return _tail(args.file, args.n)
+        return _summary(args.file)
+    except OSError as e:
+        print(f"{args.file}: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,111 @@
+"""Crash forensics: a bounded ring of recent events, dumped on failure.
+
+When a run dies, the question is "what was it doing just before?" — and
+the answer is usually gone with the process's stdout buffer. This module
+keeps a bounded in-memory ring of recent observability events (spans,
+fault firings, supervisor attempts, dispatch records); on unhandled
+failure the ring is dumped to ``<run_dir>/forensics.jsonl`` — the last
+~512 events, newest last, each with a wall-clock timestamp and whatever
+trace ID was bound when it was recorded.
+
+Dump triggers installed elsewhere:
+
+- ``tpuflow.api.train``: any exception escaping a run with a
+  ``storage_path`` dumps to ``{storage_path}/forensics.jsonl``.
+- ``tpuflow.train.supervisor``: crash-loop classification and
+  restart-budget exhaustion dump next to the job's storage path.
+
+Reading a dump (or any run's ``metrics.jsonl``):
+``python -m tpuflow.obs tail|summary <file>``.
+
+The ring is process-global and append-cheap (deque under a lock); it is
+deliberately NOT the metrics registry — counters aggregate, the ring
+remembers order.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+
+DEFAULT_CAPACITY = 512
+HOT_CAPACITY = 256
+
+_LOCK = threading.Lock()
+# TWO rings: run-lifecycle events (spans, fault firings, attempt
+# deaths) and the HOT ring for high-rate serving events (per-dispatch
+# spans — tens per second under load). Without the split, a serve
+# daemon's dispatch spans would evict a crashed train job's entire
+# trail from a single shared ring minutes before the dump fires.
+_RING: deque = deque(maxlen=DEFAULT_CAPACITY)
+_HOT_RING: deque = deque(maxlen=HOT_CAPACITY)
+
+
+def record_event(event: str, hot: bool = False, **fields) -> dict:
+    """Append one event to the ring (``hot=True`` for high-rate serving
+    events, which get their own bounded ring). Never raises — forensics
+    must not fail the code path it observes."""
+    rec = {"event": event, "time": time.time(), **fields}
+    try:
+        with _LOCK:
+            (_HOT_RING if hot else _RING).append(rec)
+    except Exception:
+        pass
+    return rec
+
+
+def recent_events(n: int | None = None) -> list[dict]:
+    """The newest ``n`` events across both rings (all, when None),
+    oldest first (merged by recording time)."""
+    with _LOCK:
+        events = sorted(
+            [*_RING, *_HOT_RING], key=lambda r: r.get("time", 0.0)
+        )
+    return events if n is None else events[-n:]
+
+
+def clear_events() -> None:
+    """Empty the rings (tests and fresh-run hygiene)."""
+    with _LOCK:
+        _RING.clear()
+        _HOT_RING.clear()
+
+
+def dump_forensics(path: str, reason: str = "") -> str | None:
+    """Write the ring to ``path`` as JSONL (oldest first), ending with a
+    ``forensics_dump`` marker naming the reason. Returns the path on
+    success, None on failure — best-effort by contract: a full disk at
+    crash time must not mask the original failure."""
+    events = recent_events()
+    events.append(
+        {
+            "event": "forensics_dump",
+            "time": time.time(),
+            "reason": reason,
+            "events": len(events),
+        }
+    )
+    try:
+        from tpuflow.utils.paths import open_file
+
+        with open_file(path, "w", encoding="utf-8") as f:
+            for rec in events:
+                try:
+                    f.write(json.dumps(rec) + "\n")
+                except (TypeError, ValueError):
+                    # One unserializable field loses ITS line only.
+                    f.write(json.dumps(
+                        {"event": "unserializable", "time": rec.get("time")}
+                    ) + "\n")
+        return path
+    except Exception as e:
+        import sys
+
+        print(
+            f"tpuflow.obs: forensics dump to {path!r} failed "
+            f"({type(e).__name__}: {e})",
+            file=sys.stderr,
+        )
+        return None

@@ -1,0 +1,294 @@
+"""The metrics registry: counters, gauges, histograms, summaries.
+
+The reference's only observability is a lost print of wall-clock and
+final loss (SURVEY.md §5.5, reference cnn.py:126-134); the distributed
+lineage (SparkNet/BigDL, PAPERS.md) treats per-node throughput and
+straggler visibility as first-class. This module is the shared substrate
+the rest of tpuflow records into: one :class:`Registry` per scope — a
+process-wide default for framework-level signals (fault injections, I/O
+retries, train-loop throughput) plus run-scoped instances for services
+that must not bleed counts across instances (each ``PredictService`` /
+``JobRunner`` owns one).
+
+Design constraints:
+
+- **Lock-cheap.** One ``threading.Lock`` per metric family; a counter
+  increment is a lock + dict add. Cheap enough for per-batch paths
+  (prefetch, micro-batch dispatch), NOT cheap enough for inside-jit.
+- **Never inside jit.** Recording forces host work; a ``.inc()`` on a
+  traced value would also be a host sync. Record OUTSIDE jitted code —
+  enforced by the TPF005 lint rule (``tpuflow/analysis/linter.py``).
+- **Pull-consistent.** Gauges may carry a callback evaluated at
+  collect time, so "queued jobs right now" is read under the owner's
+  own lock instead of being pushed on every transition.
+
+Rendering to Prometheus text exposition lives in
+``tpuflow/obs/prometheus.py``; :meth:`Registry.collect` is the seam.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable
+
+# Fixed default buckets (seconds) for latency-ish histograms: a pow-2
+# ladder wide enough for both micro-batch dispatches and whole epochs.
+DEFAULT_TIME_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0,
+)
+# Fixed buckets for request-count histograms (batch sizes coalesce on
+# pow-2 padding, so pow-2 edges describe the real dispatch shapes).
+DEFAULT_COUNT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+_NO_LABELS = ()
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+class _Family:
+    """Shared base: name, help text, a lock, and per-labelset values."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._values: dict[tuple, float] = {}
+
+    def labels_seen(self) -> list[dict]:
+        with self._lock:
+            return [dict(k) for k in self._values]
+
+    def collect(self) -> list[tuple[str, dict, float]]:
+        """``(suffix, labels, value)`` samples; suffix appended to the
+        family name (histograms/summaries emit ``_sum``/``_count``)."""
+        with self._lock:
+            return [("", dict(k), v) for k, v in sorted(self._values.items())]
+
+
+class Counter(_Family):
+    """Monotonic counter, optionally labeled: ``c.inc()`` or
+    ``c.inc(3, site="csv.read")``."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name} cannot decrease ({amount})")
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+
+class Gauge(_Family):
+    """Point-in-time value. ``set``/``inc``/``dec`` for pushed values, or
+    construct with ``fn`` for a pull gauge evaluated at collect time
+    (e.g. "queued jobs", read under the owning runner's lock)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str, fn: Callable[[], float] | None = None):
+        super().__init__(name, help)
+        self._fn = fn
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels) -> float:
+        if self._fn is not None:
+            return float(self._fn())
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def collect(self) -> list[tuple[str, dict, float]]:
+        if self._fn is not None:
+            # Callback gauges never throw the whole scrape away — but a
+            # dead callback must OMIT its sample, not report a
+            # legitimate-looking 0.0: "jobs_queued 0" during an incident
+            # would suppress the exact alert the gauge exists to fire
+            # (Prometheus treats a missing sample as stale, which is
+            # honest).
+            try:
+                return [("", {}, float(self._fn()))]
+            except Exception:
+                return []
+        return super().collect()
+
+
+class Histogram(_Family):
+    """Fixed-bucket histogram (cumulative ``le`` exposition). Buckets are
+    fixed at construction — no re-bucketing, no per-observe allocation."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str, buckets: Iterable[float]):
+        super().__init__(name, help)
+        edges = tuple(sorted(float(b) for b in buckets))
+        if not edges:
+            raise ValueError(f"histogram {self.name} needs at least one bucket")
+        self.buckets = edges
+        self._counts = [0] * (len(edges) + 1)  # + overflow (+Inf)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self._sum += value
+            self._count += 1
+            for i, edge in enumerate(self.buckets):
+                if value <= edge:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        cumulative, acc = [], 0
+        for c in counts:
+            acc += c
+            cumulative.append(acc)
+        return {
+            "buckets": list(self.buckets),
+            "cumulative": cumulative,  # last entry == count (+Inf bucket)
+            "sum": s,
+            "count": total,
+        }
+
+    def collect(self) -> list[tuple[str, dict, float]]:
+        snap = self.snapshot()
+        out = []
+        for edge, cum in zip(snap["buckets"], snap["cumulative"]):
+            le = f"{edge:g}"
+            out.append(("_bucket", {"le": le}, float(cum)))
+        out.append(("_bucket", {"le": "+Inf"}, float(snap["count"])))
+        out.append(("_sum", {}, snap["sum"]))
+        out.append(("_count", {}, float(snap["count"])))
+        return out
+
+
+class Summary(_Family):
+    """Pull-style quantile summary: ``fn`` returns ``{"quantiles":
+    {0.5: v, 0.99: v}, "sum": s, "count": n}`` at collect time — the
+    bridge from an existing reservoir (``microbatch.LatencyStats``) to
+    exposition without double-recording every sample."""
+
+    kind = "summary"
+
+    def __init__(self, name: str, help: str, fn: Callable[[], dict]):
+        super().__init__(name, help)
+        self._fn = fn
+
+    def collect(self) -> list[tuple[str, dict, float]]:
+        try:
+            snap = self._fn() or {}
+        except Exception:
+            snap = {}
+        out = []
+        for q, v in sorted((snap.get("quantiles") or {}).items()):
+            if v is not None:
+                out.append(("", {"quantile": f"{q:g}"}, float(v)))
+        out.append(("_sum", {}, float(snap.get("sum") or 0.0)))
+        out.append(("_count", {}, float(snap.get("count") or 0)))
+        return out
+
+
+class Registry:
+    """A namespace of metric families. Get-or-create semantics: asking
+    for an existing name returns the existing family (so module-level
+    helpers don't need to coordinate), but a kind mismatch — or a
+    same-kind re-registration with a DIFFERENT callback/bucket config —
+    fails loudly: two subsystems silently sharing one name is a
+    scrape-corruption bug either way (the second registrant's values
+    would silently never be scraped)."""
+
+    def __init__(self, namespace: str = "tpuflow"):
+        self.namespace = namespace
+        self._lock = threading.Lock()
+        self._families: dict[str, _Family] = {}
+
+    def _get_or_create(self, cls, name: str, *args, check=None, **kwargs):
+        full = f"{self.namespace}_{name}" if self.namespace else name
+        with self._lock:
+            fam = self._families.get(full)
+            if fam is not None:
+                if not isinstance(fam, cls):
+                    raise ValueError(
+                        f"metric {full!r} already registered as "
+                        f"{fam.kind}, not {cls.kind}"
+                    )
+                if check is not None and not check(fam):
+                    raise ValueError(
+                        f"metric {full!r} already registered with a "
+                        "different callback/bucket configuration — the "
+                        "new registrant's values would silently never "
+                        "be scraped (give it its own Registry or name)"
+                    )
+                return fam
+            fam = cls(full, *args, **kwargs)
+            self._families[full] = fam
+            return fam
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "", fn=None) -> Gauge:
+        return self._get_or_create(
+            Gauge, name, help, fn,
+            check=(None if fn is None else lambda fam: fam._fn is fn),
+        )
+
+    def histogram(
+        self, name: str, help: str = "",
+        buckets: Iterable[float] = DEFAULT_TIME_BUCKETS,
+    ) -> Histogram:
+        edges = tuple(sorted(float(b) for b in buckets))
+        return self._get_or_create(
+            Histogram, name, help, edges,
+            check=lambda fam: fam.buckets == edges,
+        )
+
+    def summary(self, name: str, help: str = "", fn=None) -> Summary:
+        return self._get_or_create(
+            Summary, name, help, fn,
+            check=(None if fn is None else lambda fam: fam._fn is fn),
+        )
+
+    def collect(self) -> list[_Family]:
+        with self._lock:
+            return sorted(self._families.values(), key=lambda f: f.name)
+
+    def reset(self) -> None:
+        """Drop every family (tests only — production registries are
+        append-only for the life of their scope)."""
+        with self._lock:
+            self._families.clear()
+
+
+# The process-wide default registry: framework-level signals (fault
+# injections, I/O retries, train-loop throughput, prefetch depth).
+# Services that must not bleed counts across instances (PredictService,
+# JobRunner, MicroBatcher) construct their own run-scoped Registry.
+_DEFAULT = Registry()
+
+
+def default_registry() -> Registry:
+    return _DEFAULT

@@ -1,0 +1,78 @@
+"""Prometheus text exposition (text/plain; version=0.0.4) rendering.
+
+``render_prometheus(*registries)`` turns one or more
+:class:`~tpuflow.obs.metrics.Registry` instances into the exposition
+format any Prometheus-compatible scraper ingests::
+
+    # HELP tpuflow_predict_requests_total /predict requests served
+    # TYPE tpuflow_predict_requests_total counter
+    tpuflow_predict_requests_total 42
+
+The serve daemon exposes it at ``GET /metrics?format=prometheus``
+(docs/observability.md has the scrape config); the JSON ``/metrics``
+view is unchanged. Families from later registries with a name already
+rendered are skipped (first wins) — the serve endpoint renders its
+run-scoped registry first, then the process-wide default registry, so
+a name collision can't produce a duplicate family in one scrape.
+"""
+
+from __future__ import annotations
+
+import math
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(value: str) -> str:
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace("\n", "\\n")
+        .replace('"', '\\"')
+    )
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    # Non-finite first: int(nan)/int(inf) raise, and one poisoned value
+    # must not kill the whole scrape (Prometheus spells these NaN/+Inf).
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_prometheus(*registries) -> str:
+    """Render registries to exposition text (trailing newline included,
+    as the format requires)."""
+    lines: list[str] = []
+    seen: set[str] = set()
+    for registry in registries:
+        for family in registry.collect():
+            if family.name in seen:
+                continue
+            seen.add(family.name)
+            lines.append(
+                f"# HELP {family.name} {_escape_help(family.help or family.name)}"
+            )
+            lines.append(f"# TYPE {family.name} {family.kind}")
+            for suffix, labels, value in family.collect():
+                if labels:
+                    label_str = ",".join(
+                        f'{k}="{_escape_label(v)}"'
+                        for k, v in sorted(labels.items())
+                    )
+                    lines.append(
+                        f"{family.name}{suffix}{{{label_str}}} "
+                        f"{_fmt_value(value)}"
+                    )
+                else:
+                    lines.append(
+                        f"{family.name}{suffix} {_fmt_value(value)}"
+                    )
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,119 @@
+"""Span tracing: run/trace IDs propagated end-to-end, spans as events.
+
+Two propagation paths share this module:
+
+- **Serving.** ``POST /predict`` resolves a trace ID (the caller's
+  ``X-Trace-Id`` header, else a fresh one), binds it for the handler
+  thread (``use_trace``), and echoes it in the response. The
+  MicroBatcher captures ``current_trace_id()`` at enqueue time, so the
+  coalesced-dispatch span event names every trace it answered — the
+  observable link between one caller's request and the shared device
+  dispatch that served it.
+- **Training.** ``train()`` binds a run-scoped trace ID; ``fit`` emits
+  ingest/step/eval/checkpoint spans to the run's ``metrics.jsonl``
+  (via the extended ``MetricsLogger``) with durations, each carrying
+  the run's trace ID.
+
+Every span is also recorded into the crash-forensics ring
+(``tpuflow/obs/forensics.py``), so the last ~N spans survive into
+``forensics.jsonl`` on an unhandled failure.
+
+Context propagation uses ``contextvars``: thread-safe (HTTP handler
+threads don't share state) and cheap. The dispatcher thread of the
+MicroBatcher does NOT inherit a request's context — that's why entries
+carry their trace ID explicitly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import random
+import threading
+import time
+
+_TRACE: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "tpuflow_trace_id", default=None
+)
+
+# urandom-seeded PRNG, not uuid4: trace IDs are generated per /predict
+# request on the serving hot path, and getrandbits is ~5x cheaper than
+# a UUID while still collision-safe at 64 bits per process.
+_ID_RNG = random.Random(int.from_bytes(os.urandom(8), "big"))
+_ID_LOCK = threading.Lock()
+
+
+def new_trace_id() -> str:
+    """16 hex chars: unique enough per process fleet, cheap to log."""
+    with _ID_LOCK:
+        return f"{_ID_RNG.getrandbits(64):016x}"
+
+
+def current_trace_id() -> str | None:
+    """The trace ID bound to this thread/context, if any."""
+    return _TRACE.get()
+
+
+@contextlib.contextmanager
+def use_trace(trace_id: str | None = None):
+    """Bind ``trace_id`` (fresh if None) for the enclosed block; yields
+    the bound ID. Nesting restores the outer binding on exit."""
+    tid = trace_id or new_trace_id()
+    token = _TRACE.set(tid)
+    try:
+        yield tid
+    finally:
+        _TRACE.reset(token)
+
+
+@contextlib.contextmanager
+def span(name: str, logger=None, **fields):
+    """Time the enclosed block as one span event.
+
+    The event ``{"event": "span", "name": name, "duration_s": ...,
+    "trace_id": <bound id>}`` is recorded into the forensics ring
+    always, and appended to ``logger`` (a ``MetricsLogger``) when one
+    is given. Never raises from the recording itself — observability
+    must not fail the work it observes. The block's own exception
+    propagates, with the span recorded as ``ok: false`` first.
+    """
+    t0 = time.perf_counter()
+    ok = True
+    try:
+        yield
+    except BaseException:
+        ok = False
+        raise
+    finally:
+        _emit(name, time.perf_counter() - t0, ok, logger, fields)
+
+
+def record_span(
+    name: str, duration_s: float, logger=None, hot: bool = False, **fields
+) -> None:
+    """Record an already-measured span (for callers that time blocks
+    themselves, e.g. the dispatcher's per-group timing). ``hot=True``
+    routes it to the forensics hot ring — for per-dispatch-rate spans
+    that must not evict a run's lifecycle trail."""
+    _emit(name, duration_s, True, logger, fields, hot=hot)
+
+
+def _emit(name, duration_s, ok, logger, fields, hot=False) -> None:
+    rec = {
+        "name": name,
+        "duration_s": round(float(duration_s), 6),
+        "trace_id": current_trace_id(),
+        **fields,
+    }
+    if not ok:
+        rec["ok"] = False
+    try:
+        from tpuflow.obs.forensics import record_event
+
+        record_event("span", hot=hot, **rec)
+        if logger is not None:
+            logger.write("span", **rec)
+    except Exception:
+        # A closed logger / full disk must not fail training or serving.
+        pass

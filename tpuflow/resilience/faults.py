@@ -313,6 +313,23 @@ def fault_point(site: str, index: int | None = None) -> None:
                 break
     if to_fire is None:
         return
+    # Every firing is observable: a labeled counter in the process-wide
+    # registry (scraped via /metrics?format=prometheus) plus a forensics
+    # ring event, so a drill's blast radius shows up in the same trail
+    # as the spans it interrupted. The label set is exactly the SITES
+    # catalog — tests/test_obs.py asserts the parity.
+    try:
+        from tpuflow.obs import default_registry, record_event
+
+        default_registry().counter(
+            "faults_injected_total",
+            "armed fault-injection firings by site",
+        ).inc(site=site)
+        record_event(
+            "fault_injected", site=site, spec=to_fire.describe(), index=index
+        )
+    except Exception:
+        pass  # observability never blocks the drill itself
     if to_fire.on_fire is not None:
         to_fire.on_fire()
     message = (

@@ -107,6 +107,17 @@ def retry_call(policy: RetryPolicy, fn: Callable, *args, **kwargs):
                 f"{delay:.3f}s: {e}",
                 file=sys.stderr,
             )
+            try:
+                # Fleet-visible retry pressure: a flaky storage backend
+                # shows up as a rising counter, not just stderr noise.
+                from tpuflow.obs import default_registry
+
+                default_registry().counter(
+                    "io_retries_total",
+                    "transient-I/O retry sleeps by exception type",
+                ).inc(error=type(e).__name__)
+            except Exception:
+                pass
             policy.sleep(delay)
 
 

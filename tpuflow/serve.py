@@ -33,6 +33,8 @@ API (JSON in/out):
   hits/loads/invalidations (+ degraded_requests/fallback_loads), uptime,
   per-request latency percentiles (p50/p99), and — with batching on —
   the coalesced-dispatch counters and batch-size histogram.
+  ``?format=prometheus`` returns the same registry as Prometheus text
+  exposition (tpuflow/obs; docs/observability.md has the scrape config).
 
 Concurrent /predict traffic can take the serving fast path (off by
 default; ``--batch-predicts``, ``--warmup-buckets``,
@@ -171,7 +173,33 @@ class JobRunner:
         max_queued: int = 64,
         default_timeout: float | None = None,
         journal_path: str | None = None,
+        registry=None,
     ):
+        from tpuflow.obs import Registry
+
+        # Run-scoped metrics registry (tpuflow/obs): the job counters
+        # live here and render into /metrics?format=prometheus; the
+        # JSON metrics() view reads the same counters (keys unchanged).
+        # Own instance by default so parallel runners (tests) never
+        # bleed counts into each other.
+        self.registry = registry if registry is not None else Registry()
+        self._counters = {
+            name: self.registry.counter(f"jobs_{name}_total", help)
+            for name, help in (
+                ("submitted", "jobs accepted into the queue"),
+                ("done", "jobs finished successfully"),
+                ("failed", "jobs that errored or timed out"),
+                ("cancelled", "jobs cancelled while queued or running"),
+            )
+        }
+        self.registry.gauge(
+            "jobs_queued", "jobs waiting for the worker",
+            fn=lambda: self._count_statuses()[0],
+        )
+        self.registry.gauge(
+            "jobs_running", "jobs occupying the chip (incl. cancelling)",
+            fn=lambda: self._count_statuses()[1],
+        )
         # Unbounded Queue; admission control is by LIVE queued count in
         # submit() (under the lock), not Queue(maxsize=...): a cancelled
         # queued job leaves a stale entry in the Queue until the worker
@@ -184,7 +212,7 @@ class JobRunner:
         self._cancel_events: dict[str, threading.Event] = {}
         self._lock = threading.Lock()
         self._on_artifact_change = on_artifact_change
-        self.stats = {"submitted": 0, "done": 0, "failed": 0, "cancelled": 0}
+        self._status_cache: tuple[float, tuple[int, int]] = (0.0, (0, 0))
         # Journal (JSONL, append-only): job lifecycle survives daemon
         # restarts — terminal jobs come back as history, never-started
         # jobs are requeued, and a job that was RUNNING at the crash is
@@ -379,10 +407,10 @@ class JobRunner:
                 if st.get("report") is not None:
                     rec["report"] = st["report"]
                 self._jobs[job_id] = rec
-                self.stats["submitted"] += 1
-                self.stats[
-                    st["status"] if st["status"] in self.stats else "failed"
-                ] += 1
+                self._counters["submitted"].inc()
+                self._counters.get(
+                    st["status"], self._counters["failed"]
+                ).inc()
             elif st["last"] == "started":
                 # Mid-run at the crash: training side effects (partial
                 # checkpoints) exist; don't silently re-run.
@@ -391,8 +419,8 @@ class JobRunner:
                     "error": "lost: daemon restarted mid-run (resubmit; "
                     "resume=true continues from the last run checkpoint)",
                 }
-                self.stats["submitted"] += 1
-                self.stats["failed"] += 1
+                self._counters["submitted"].inc()
+                self._counters["failed"].inc()
                 lost.append(job_id)
             else:  # submitted, never started: safe to requeue as-is
                 try:
@@ -403,15 +431,15 @@ class JobRunner:
                         "error": f"requeue after restart failed: "
                         f"{type(e).__name__}: {e}",
                     }
-                    self.stats["submitted"] += 1
-                    self.stats["failed"] += 1
+                    self._counters["submitted"].inc()
+                    self._counters["failed"].inc()
                     lost.append(job_id)
                     continue
                 self._jobs[job_id] = {
                     "job_id": job_id, "status": "queued", "spec": spec
                 }
                 self._cancel_events[job_id] = threading.Event()
-                self.stats["submitted"] += 1
+                self._counters["submitted"].inc()
                 self._queue.put((job_id, kind, config, st.get("timeout_s")))
         # Record the adjudications so the NEXT replay sees them terminal
         # (the flocked append handle is already open at this point).
@@ -606,7 +634,7 @@ class JobRunner:
             )
             self._jobs[job_id] = record
             self._cancel_events[job_id] = threading.Event()
-            self.stats["submitted"] += 1
+            self._counters["submitted"].inc()
         self._queue.put((job_id, kind, config, timeout_s))
         self._journal_flush()
         return {"job_id": job_id, "status": "queued"}
@@ -624,7 +652,7 @@ class JobRunner:
             status = rec["status"]
             if status == "queued":
                 rec.update(status="cancelled", error="cancelled while queued")
-                self.stats["cancelled"] += 1
+                self._counters["cancelled"].inc()
                 self._cancel_events.pop(job_id, None)
                 # Enqueued atomically with the state change: no later
                 # flush can ever write this job's events in an order
@@ -660,19 +688,46 @@ class JobRunner:
                 for r in self._jobs.values()
             ]
 
+    @staticmethod
+    def _tally(statuses: list[str]) -> tuple[int, int]:
+        """(queued, running) from a status list — THE one place the
+        status semantics live, shared by the JSON metrics() view and
+        the registry's pull gauges (a new status classified here shows
+        up in both, never one). A job being cancelled is still
+        occupying the chip."""
+        return (
+            statuses.count("queued"),
+            statuses.count("running") + statuses.count("cancelling"),
+        )
+
+    def _count_statuses(self) -> tuple[int, int]:
+        # Briefly memoized: the two pull gauges both call this per
+        # Prometheus scrape, and _jobs keeps every terminal job for the
+        # daemon's lifetime — one lock + one scan should serve both.
+        # 0.25s of staleness is nothing against a scrape interval.
+        import time as _time
+
+        now = _time.monotonic()
+        ts, tallies = self._status_cache
+        if now - ts > 0.25:
+            with self._lock:
+                statuses = [r["status"] for r in self._jobs.values()]
+            tallies = self._tally(statuses)
+            self._status_cache = (now, tallies)
+        return tallies
+
     def metrics(self) -> dict:
         """One consistent snapshot: counters and live-status tallies from
         the same lock acquisition, so submitted == done + failed +
-        queued + running always holds in a /metrics response."""
+        queued + running always holds in a /metrics response (counter
+        increments happen under this same lock)."""
         with self._lock:
             statuses = [r["status"] for r in self._jobs.values()]
-            return {
-                **self.stats,
-                "queued": statuses.count("queued"),
-                # A job being cancelled is still occupying the chip.
-                "running": statuses.count("running")
-                + statuses.count("cancelling"),
+            counters = {
+                name: int(c.value()) for name, c in self._counters.items()
             }
+        queued, running = self._tally(statuses)
+        return {**counters, "queued": queued, "running": running}
 
     def _run(self):
         import time as _time
@@ -752,9 +807,9 @@ class JobRunner:
                 with self._lock:
                     self._cancel_events.pop(job_id, None)
                     self._jobs[job_id].update(status=status, error=error)
-                    self.stats[
+                    self._counters[
                         "cancelled" if status == "cancelled" else "failed"
-                    ] += 1
+                    ].inc()
                 continue
             except Exception as e:
                 # Evict BEFORE publishing the terminal status: a client
@@ -769,7 +824,7 @@ class JobRunner:
                 with self._lock:  # status + counter move atomically
                     self._cancel_events.pop(job_id, None)
                     self._jobs[job_id].update(status="failed", error=error)
-                    self.stats["failed"] += 1
+                    self._counters["failed"].inc()
                 continue
             self._notify_artifact(config, kind)
             self._journal(  # durable first, visible second
@@ -780,7 +835,7 @@ class JobRunner:
                 # A cancel that landed after the last epoch finished: the
                 # work is done; report it done (the cancel was a no-op).
                 self._jobs[job_id].update(status="done", report=rep)
-                self.stats["done"] += 1
+                self._counters["done"].inc()
 
     @staticmethod
     def _failed_rows(rpt, ident) -> list[dict]:
@@ -857,6 +912,21 @@ class JobRunner:
                     )
 
 
+def _clean_trace_id(raw: str | None) -> str | None:
+    """Clamp a client-supplied X-Trace-Id: tokens only, bounded length.
+    A 64KB header retained per entry in the process-global forensics
+    ring (and echoed into span events) would pin attacker-controlled
+    memory; anything non-token-ish gets a fresh id instead (None)."""
+    if not raw:
+        return None
+    raw = raw.strip()
+    if 0 < len(raw) <= 64 and all(
+        c.isalnum() or c in "-_." for c in raw
+    ):
+        return raw
+    return None
+
+
 def _env_flag(name: str, default: bool) -> bool:
     raw = os.environ.get(name)
     if raw is None or not raw.strip():
@@ -924,15 +994,30 @@ class PredictService:
         batch_max_wait_ms: float | None = None,
         warmup_buckets: int | None = None,
         donate_forward: bool | None = None,
+        registry=None,
     ):
+        from tpuflow.obs import Registry
+
         self._cache: dict[tuple[str, str], object] = {}
         self._lock = threading.Lock()  # guards the dicts, never held on load
         self._key_locks: dict[tuple[str, str], threading.Lock] = {}
         self.gilbert_fallback = gilbert_fallback
-        self.stats = {
-            "requests": 0, "cache_hits": 0, "loads": 0, "invalidations": 0,
-            "degraded_requests": 0, "fallback_loads": 0,
-            "warmed_buckets": 0,
+        # Run-scoped metrics registry (tpuflow/obs): the JSON metrics()
+        # keys are unchanged but now read registry counters, and the
+        # same registry renders into /metrics?format=prometheus. Own
+        # instance by default so parallel services never share counts.
+        self.registry = registry if registry is not None else Registry()
+        self._counters = {
+            name: self.registry.counter(f"predict_{name}_total", help)
+            for name, help in (
+                ("requests", "/predict requests served (incl. failed)"),
+                ("cache_hits", "predictor cache hits"),
+                ("loads", "artifact loads (successful)"),
+                ("invalidations", "cache evictions after artifact rewrites"),
+                ("degraded_requests", "requests answered by the fallback"),
+                ("fallback_loads", "loads that fell back to Gilbert"),
+                ("warmed_buckets", "forward buckets pre-compiled at load"),
+            )
         }
         # Invalidation generation per key: a load that STARTED before an
         # invalidate() must not re-cache its (stale) result after it.
@@ -961,6 +1046,13 @@ class PredictService:
         from tpuflow.microbatch import LatencyStats
 
         self._latency = LatencyStats()
+        # Pull-style summary: the existing reservoir renders into the
+        # Prometheus view without double-recording every sample.
+        self.registry.summary(
+            "predict_latency_ms",
+            "per-request /predict latency (ms)",
+            fn=self._latency.summary,
+        )
         self._batcher = None
         if batch_predicts:
             from tpuflow.microbatch import MicroBatcher
@@ -969,6 +1061,7 @@ class PredictService:
                 self._run_forward,
                 max_batch_rows=self.batch_max_rows,
                 max_wait_ms=float(batch_max_wait_ms),
+                registry=self.registry,
             )
 
     @staticmethod
@@ -985,9 +1078,12 @@ class PredictService:
     def metrics(self) -> dict:
         """Counter snapshot under the lock — one consistent view, matching
         JobRunner.metrics()'s discipline — plus the latency percentiles
-        and (when batching is on) the coalescing counters."""
+        and (when batching is on) the coalescing counters. The same
+        registry backs the Prometheus exposition; JSON keys unchanged."""
         with self._lock:
-            out = dict(self.stats)
+            out = {
+                name: int(c.value()) for name, c in self._counters.items()
+            }
         out["latency_ms"] = self._latency.snapshot()
         out["batching"] = (
             self._batcher.metrics()
@@ -1006,7 +1102,7 @@ class PredictService:
             self._degraded.pop(key, None)
             self._degraded_at.pop(key, None)
             self._gen[key] = self._gen.get(key, 0) + 1
-            self.stats["invalidations"] += 1
+            self._counters["invalidations"].inc()
 
     def degraded(self) -> list[dict]:
         """Artifacts currently answering in degraded (Gilbert) mode."""
@@ -1042,7 +1138,7 @@ class PredictService:
         with self._lock:
             cached = self._cached_locked(key)
             if cached is not None:
-                self.stats["cache_hits"] += 1
+                self._counters["cache_hits"].inc()
                 return cached
             key_lock = self._key_locks.setdefault(key, threading.Lock())
         # Load under the PER-KEY lock only: a cold (possibly seconds-long
@@ -1052,7 +1148,7 @@ class PredictService:
             with self._lock:
                 cached = self._cached_locked(key)
                 if cached is not None:
-                    self.stats["cache_hits"] += 1
+                    self._counters["cache_hits"].inc()
                     return cached
                 gen = self._gen.get(key, 0)
             try:
@@ -1083,7 +1179,7 @@ class PredictService:
                 import time as _time
 
                 with self._lock:
-                    self.stats["fallback_loads"] += 1
+                    self._counters["fallback_loads"].inc()
                     if self._gen.get(key, 0) == gen:
                         # Cache the fallback too (no per-request load
                         # storm against dead storage); evicted by any
@@ -1119,8 +1215,8 @@ class PredictService:
                 # (or vice versa). Counted only AFTER a successful load —
                 # a missing/corrupt artifact that raises must not inflate
                 # the loads number.
-                self.stats["loads"] += 1
-                self.stats["warmed_buckets"] += warmed
+                self._counters["loads"].inc()
+                self._counters["warmed_buckets"].inc(warmed)
                 if self._gen.get(key, 0) == gen:
                     self._cache[key] = loaded
                 # else: the artifact was rewritten mid-load; serve this
@@ -1130,20 +1226,32 @@ class PredictService:
     def predict(self, spec: dict) -> dict:
         """One request, end to end; wall time (including any micro-batch
         queue wait) is recorded into the latency reservoir whether the
-        request succeeds or raises — p99 must not hide the failures."""
+        request succeeds or raises — p99 must not hide the failures.
+
+        Trace propagation: the caller's bound trace ID (the HTTP handler
+        binds ``X-Trace-Id``; Python callers may ``use_trace`` their
+        own) — or a fresh one — rides the request into the micro-batch
+        dispatch and is echoed back as ``trace_id`` in the response, so
+        one caller's answer is linkable to the coalesced device dispatch
+        that produced it."""
         import time as _time
 
+        from tpuflow.obs import current_trace_id, use_trace
+
         t0 = _time.perf_counter()
-        try:
-            return self._predict(spec)
-        finally:
-            self._latency.record(_time.perf_counter() - t0)
+        with use_trace(current_trace_id()) as trace_id:
+            try:
+                out = self._predict(spec)
+                out["trace_id"] = trace_id
+                return out
+            finally:
+                self._latency.record(_time.perf_counter() - t0)
 
     def _predict(self, spec: dict) -> dict:
         import numpy as np
 
         with self._lock:
-            self.stats["requests"] += 1
+            self._counters["requests"].inc()
         storage = spec.get("storagePath") or spec.get("storage_path")
         name = spec.get("model") or spec.get("name")
         if not storage or not name:
@@ -1183,7 +1291,7 @@ class PredictService:
             out["fallback"] = "gilbert"
             out["degraded_reason"] = pred.reason
             with self._lock:
-                self.stats["degraded_requests"] += 1
+                self._counters["degraded_requests"].inc()
         return out
 
     def _predict_coalesced(self, storage, name, pred, columns):
@@ -1216,13 +1324,25 @@ def make_server(
     ``None`` defers to the ``TPUFLOW_SERVE_*`` env vars, default off."""
     import time as _time
 
+    from tpuflow.obs import Registry, use_trace
+
     started = _time.monotonic()  # immune to wall-clock steps
+    # ONE run-scoped registry for the whole daemon: predictor, batcher,
+    # and job-runner counters render in a single Prometheus scrape
+    # (GET /metrics?format=prometheus), alongside the process-wide
+    # default registry (fault injections, I/O retries, train loop).
+    registry = Registry()
+    registry.gauge(
+        "uptime_seconds", "seconds since the daemon started",
+        fn=lambda: _time.monotonic() - started,
+    )
     predictor = PredictService(
         batch_predicts=batch_predicts,
         batch_max_rows=batch_max_rows,
         batch_max_wait_ms=batch_max_wait_ms,
         warmup_buckets=warmup_buckets,
         donate_forward=donate_forward,
+        registry=registry,
     )
     # Retraining an artifact this process has served must evict the cached
     # Predictor, or /predict would keep returning the old model forever.
@@ -1231,6 +1351,7 @@ def make_server(
         max_queued=max_queued,
         default_timeout=default_timeout,
         journal_path=journal_path,
+        registry=registry,
     )
 
     class Handler(BaseHTTPRequestHandler):
@@ -1265,6 +1386,33 @@ def make_server(
             elif route == "/jobs":
                 self._send(200, runner.list())
             elif route == "/metrics":
+                # ?format=prometheus: text exposition over the daemon's
+                # run-scoped registry plus the process-wide default one
+                # (fault-injection and I/O-retry counters). The JSON
+                # view — and its keys — are unchanged.
+                from urllib.parse import parse_qs, urlsplit
+
+                fmt = parse_qs(urlsplit(self.path).query).get(
+                    "format", [""]
+                )[0]
+                if fmt == "prometheus":
+                    from tpuflow.obs import (
+                        default_registry,
+                        render_prometheus,
+                    )
+
+                    body = render_prometheus(
+                        registry, default_registry()
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 self._send(200, {
                     "jobs": runner.metrics(),
                     "predict": predictor.metrics(),
@@ -1306,12 +1454,23 @@ def make_server(
                 except (ValueError, TypeError, json.JSONDecodeError) as e:
                     self._send(400, {"error": str(e)})
                     return
-                try:
-                    self._send(200, predictor.predict(spec))
-                except ValueError as e:
-                    self._send(400, {"error": str(e)})
-                except Exception as e:  # missing artifact, bad columns, ...
-                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                # The caller's X-Trace-Id (fresh when absent or not a
+                # bounded token — see _clean_trace_id) rides the request
+                # into the coalesced dispatch and back out as trace_id
+                # in EVERY response — the failures are the responses one
+                # most wants to correlate.
+                with use_trace(
+                    _clean_trace_id(self.headers.get("X-Trace-Id"))
+                ) as tid:
+                    try:
+                        self._send(200, predictor.predict(spec))
+                    except ValueError as e:
+                        self._send(400, {"error": str(e), "trace_id": tid})
+                    except Exception as e:  # missing artifact, bad columns
+                        self._send(500, {
+                            "error": f"{type(e).__name__}: {e}",
+                            "trace_id": tid,
+                        })
             else:
                 self._send(404, {"error": f"no route {self.path!r}"})
 

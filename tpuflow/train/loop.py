@@ -225,6 +225,7 @@ def fit(
                 if config.verbose:
                     print(f"Resuming from epoch {loop_meta['epoch']}")
     samples_seen = 0
+    samples_counted = 0  # high-water mark already added to the registry
     t0 = time.time()
 
     if config.jit_epoch:
@@ -240,6 +241,23 @@ def fit(
         from tpuflow.utils.logging import MetricsLogger
 
         mlog = MetricsLogger(config.metrics_path)
+
+    # Registry-backed throughput signals (process-wide; tpuflow/obs) +
+    # span events for where each epoch's time went. Recording happens
+    # OUTSIDE the jitted step/epoch programs — values observed are
+    # already host floats (TPF005's contract).
+    from tpuflow.obs import default_registry, record_span
+
+    _reg = default_registry()
+    _epochs_total = _reg.counter(
+        "train_epochs_total", "training epochs completed"
+    )
+    _samples_total = _reg.counter(
+        "train_samples_total", "training samples consumed"
+    )
+    _epoch_seconds = _reg.histogram(
+        "train_epoch_seconds", "wall-clock per completed epoch"
+    )
 
     # The legacy fault_epoch knob, re-expressed as a registry drill: an
     # exit fault at the train.epoch_end site. Soft (default) commits
@@ -336,7 +354,18 @@ def fit(
                 jax.device_get(last_device_value)
                 jax.profiler.stop_trace()
 
+            # The "step" span: this epoch's training phase (all batches),
+            # measured before validation starts — with the eval span
+            # below it answers "train or eval?" for a slow epoch.
+            record_span(
+                "step", time.time() - te, logger=mlog, epoch=epoch
+            )
+            t_eval = time.perf_counter()
             val = _eval_dataset(eval_step, state, val_ds, config.batch_size)
+            record_span(
+                "eval", time.perf_counter() - t_eval, logger=mlog,
+                epoch=epoch,
+            )
             epoch_time = time.time() - te
             result.history.append(
                 {"epoch": epoch, "loss": train_loss, "val_loss": val["loss"],
@@ -357,12 +386,18 @@ def fit(
                 result.best_val_loss = val["loss"]
             should_stop = stopper.update(val["loss"])
             if ckpt is not None and stopper.improved:
+                t_ckpt = time.perf_counter()
                 ckpt.maybe_save(epoch, state.params, val["loss"])
+                record_span(
+                    "checkpoint", time.perf_counter() - t_ckpt,
+                    logger=mlog, epoch=epoch, kind="best",
+                )
             if (
                 run_ckpt is not None
                 and config.save_every
                 and epoch % config.save_every == 0
             ):
+                t_ckpt = time.perf_counter()
                 run_ckpt.save(
                     epoch,
                     state,
@@ -373,7 +408,18 @@ def fit(
                         "best_val_loss": result.best_val_loss,
                     },
                 )
+                record_span(
+                    "checkpoint", time.perf_counter() - t_ckpt,
+                    logger=mlog, epoch=epoch, kind="run_state",
+                )
             result.epochs_ran = epoch
+            _epochs_total.inc()
+            # Per-epoch delta, not a bulk add at fit end: a scrape
+            # mid-run must see live throughput, and a crashed run must
+            # still have counted the samples it consumed.
+            _samples_total.inc(samples_seen - samples_counted)
+            samples_counted = samples_seen
+            _epoch_seconds.observe(epoch_time)
             if config.progress_path:
                 _write_progress(config.progress_path, epoch)
             # The legacy fault_epoch fires here (armed above as an exit

@@ -220,6 +220,32 @@ def supervise(
     caps the whole attempt. ``sleep`` is injectable for tests.
     """
     _validate(spec)
+    from tpuflow.obs import default_registry, dump_forensics, record_event
+
+    _reg = default_registry()
+    _restarts = _reg.counter(
+        "supervisor_restarts_total", "child attempts relaunched after death"
+    )
+    _crash_loops = _reg.counter(
+        "supervisor_crash_loops_total",
+        "runs aborted by crash-loop classification",
+    )
+    storage = spec.get("storagePath") or spec.get("storage_path")
+
+    def _dump(reason: str) -> None:
+        # Crash forensics next to the artifacts: the attempt trail
+        # (deaths, kinds, progress epochs, backoffs) survives the
+        # supervisor's TemporaryDirectory. A DISTINCT filename: each
+        # crashed child's train() already dumped its own (richer) ring
+        # to forensics.jsonl at the same storage path, and overwriting
+        # it here would erase the child's last-moments trail at the
+        # exact moment it's needed. Best-effort by contract.
+        if storage:
+            dump_forensics(
+                os.path.join(storage, "forensics-supervisor.jsonl"),
+                reason=reason,
+            )
+
     failures: list[dict] = []
     backoffs: list[float] = []
     rng = random.Random(backoff_seed) if backoff_seed is not None else random
@@ -269,6 +295,10 @@ def supervise(
                 )
             progress = _read_progress(progress_path)
             progress_epoch = progress["epoch"] if progress else None
+            record_event(
+                "supervisor_attempt_died", attempt=attempt, rc=rc,
+                kind=kind or "crash", progress_epoch=progress_epoch,
+            )
             failures.append({
                 "rc": rc,
                 "kind": kind or "crash",
@@ -294,6 +324,10 @@ def supervise(
                     f"after epoch {progress_epoch}"
                     if progress_epoch is not None
                     else "before the first epoch completed"
+                )
+                _crash_loops.inc()
+                _dump(
+                    f"crash-loop classified at epoch {progress_epoch}"
                 )
                 raise CrashLoopError(
                     f"crash-loop: {crash_loop_threshold} consecutive "
@@ -321,7 +355,9 @@ def supervise(
                 # proportional jitter by construction.
                 delay = backoff_policy.delay(attempt, rng)
                 backoffs.append(delay)
+                _restarts.inc()
                 sleep(delay)
+    _dump(f"restart budget exhausted after {len(failures)} deaths")
     raise RuntimeError(
         f"job died {len(failures)} times (last rc="
         f"{failures[-1]['rc']}): {failures[-1]['stderr_tail']}"

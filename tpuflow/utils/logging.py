@@ -9,15 +9,31 @@ metric trail.
 
 from __future__ import annotations
 
+import datetime
 import json
+import threading
 import time
 from typing import IO
 
 from tpuflow.utils.paths import open_file
 
+# seq continuity across loggers on one path within a process: the
+# ingest-phase writer and the fit loop's writer append to the SAME
+# metrics file, and a seq that restarted at 1 per logger would make
+# "order by seq" ambiguous for exactly the trail it exists to order.
+_SEQ_LOCK = threading.Lock()
+_SEQ_BY_PATH: dict[str, int] = {}
+
 
 class MetricsLogger:
     """Append-only JSONL metrics writer.
+
+    Every record carries the epoch-seconds ``time`` (sortable,
+    arithmetic-friendly), an ISO-8601 UTC ``ts`` (human- and
+    log-aggregator-friendly), and a monotonic ``seq`` — shared across
+    every logger writing the same path in this process, so a trail that
+    interleaves writers, or crosses a wall-clock step, still has a
+    total order.
 
     Usage::
 
@@ -29,17 +45,55 @@ class MetricsLogger:
         self.path = path
         self.echo = echo
         self._fh: IO | None = None
+        self._seq = 0
+        self._warned_closed = False
         if path:
             # URI-aware (gs://, memory://, ...) via fsspec; local paths get
             # parent dirs created as before.
             self._fh = open_file(path, "a", encoding="utf-8")
 
     def write(self, event: str, **fields) -> dict:
-        rec = {"event": event, "time": time.time(), **fields}
+        now = time.time()
+        if self.path:
+            with _SEQ_LOCK:
+                self._seq = _SEQ_BY_PATH[self.path] = (
+                    _SEQ_BY_PATH.get(self.path, 0) + 1
+                )
+        else:
+            self._seq += 1
+        rec = {
+            "event": event,
+            "time": now,
+            "ts": datetime.datetime.fromtimestamp(
+                now, datetime.timezone.utc
+            ).isoformat(timespec="milliseconds"),
+            "seq": self._seq,
+            **fields,
+        }
         line = json.dumps(rec)
         if self._fh:
-            self._fh.write(line + "\n")
-            self._fh.flush()
+            # A broken write drops THIS line (warn once) instead of
+            # raising mid-train: losing metric records is strictly
+            # better than killing an hours-in training run over its
+            # log. A transient OSError (ENOSPC blip, NFS hiccup) keeps
+            # the handle so later writes can succeed again; a closed
+            # handle (ValueError) is gone for good and is released.
+            try:
+                self._fh.write(line + "\n")
+                self._fh.flush()
+            except (OSError, ValueError) as e:
+                if not self._warned_closed:
+                    self._warned_closed = True
+                    import sys
+
+                    print(
+                        f"tpuflow.utils.logging: metrics write to "
+                        f"{self.path!r} failed ({type(e).__name__}: {e}); "
+                        "dropping records that fail to write",
+                        file=sys.stderr,
+                    )
+                if isinstance(e, ValueError):
+                    self._fh = None
         if self.echo:
             print(line)
         return rec

@@ -42,13 +42,22 @@ class StepTimer:
     def stop(self, block_on=None) -> float:
         import jax
 
+        if self._t0 is None:
+            # A stop() with no matching start() used to record ~0.0 —
+            # a silently-wrong sample that drags the mean toward zero
+            # and inflates samples_per_sec. Fail loudly instead.
+            raise RuntimeError(
+                "StepTimer.stop() called before start() — the ~0.0 it "
+                "would record is not a measurement"
+            )
         if block_on is not None:
             # device_get, not block_until_ready: on the axon relay backend
             # block_until_ready can return before the device work finishes
             # (measured round 5 — see benchmarks/common.py::drain); only a
             # real transfer of a data-dependent value is a sync point.
             jax.device_get(block_on)
-        dt = time.perf_counter() - (self._t0 or time.perf_counter())
+        dt = time.perf_counter() - self._t0
+        self._t0 = None  # a second stop() without a new start() also fails
         self.times.append(dt)
         return dt
 
