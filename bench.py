@@ -400,11 +400,20 @@ def worker() -> None:
     best_backend = ""
 
     def emit_record(partial: bool) -> None:
+        # The north-star ratio is only meaningful against the chip the
+        # baseline was set on: a device without a roofline peaks entry
+        # (cpu) is a host measurement, and its ratio is null, not a
+        # fake regression (mark_host_only has the parent-side variant).
+        from tpuflow.utils.roofline import chip_peaks
+
+        on_chip_device = chip_peaks(device_kind)[0] is not None
         rec = {
             "metric": METRIC,
             "value": best,
             "unit": "samples/sec/chip",
-            "vs_baseline": round(best / BASELINE_SPS, 3),
+            "vs_baseline": (
+                round(best / BASELINE_SPS, 3) if on_chip_device else None
+            ),
             "backends": dict(backends),
             "best_backend": best_backend,
             "pallas_parity": parity,
@@ -414,6 +423,8 @@ def worker() -> None:
             "hbm_bytes_per_sample": round(bytes_),
             **roofline_report(best, flops, bytes_, device_kind),
         }
+        if not on_chip_device:
+            rec["host_only"] = True
         if partial:
             rec["partial"] = True
         print(json.dumps(rec), flush=True)
@@ -540,6 +551,26 @@ def _last_on_chip(root: str | None = None) -> dict | None:
     return None
 
 
+def mark_host_only(rec: dict) -> dict:
+    """Label a CPU-fallback record as a HOST measurement, in place.
+
+    ``vs_baseline`` is the chip north-star ratio; a host number divided
+    by the chip baseline reads as a catastrophic regression (BENCH_r05:
+    ``vs_baseline: 0.39`` with ``device: "cpu"`` — a healthy host run
+    masquerading as a 61%% chip loss). On the fallback path the ratio is
+    meaningless, so it becomes null and ``host_only: true`` says why;
+    the raw ``value`` stays (it is still a real measurement — of the
+    wrong hardware).
+    """
+    rec["vs_baseline"] = None
+    rec["host_only"] = True
+    rec["fallback"] = (
+        "cpu: the TPU backend never came up (relay dead?); "
+        "this is a host measurement, not the chip"
+    )
+    return rec
+
+
 def _emit_failure(attempts: int, last_err: str) -> None:
     rec = {
         "metric": METRIC,
@@ -608,10 +639,10 @@ def main() -> None:
             rec = dict(rec)
             rec["attempts"] = state["attempt"]
             if state["force_cpu"]:
-                rec["fallback"] = (
-                    "cpu: the TPU backend never came up (relay dead?); "
-                    "this is a host measurement, not the chip"
-                )
+                # A host measurement must never read as a chip
+                # regression: vs_baseline becomes null, host_only says
+                # why (see mark_host_only).
+                mark_host_only(rec)
                 on_chip = _last_on_chip()
                 if on_chip is not None:
                     # The round artifact keeps the real chip story even

@@ -300,13 +300,96 @@ class TestLinter:
                 fault_point("checkpoint.save", index=3)
         """) == []
 
+    def test_per_step_aux_host_sync_in_batch_loop(self, tmp_path):
+        """TPF006: float()/.item()/np.asarray on a train-step result
+        inside the SAME batch loop — the per-step device sync the
+        numerics watchdog's post-epoch contract exists to prevent."""
+        diags = self._lint_source(tmp_path, """
+            import numpy as np
+
+            def fit(train_step, epoch_batches, state, rng):
+                losses = []
+                for x, y in epoch_batches:
+                    state, metrics = train_step(state, x, y, rng)
+                    losses.append(float(metrics["loss"]))
+                    g = metrics["grad_norm"].item()
+                    a = np.asarray(metrics["loss"])
+                return losses
+        """)
+        assert _codes(diags).count("TPF006") == 3
+
+    def test_post_epoch_conversion_not_flagged(self, tmp_path):
+        # The blessed pattern (train/loop.py): device references inside
+        # the loop, ONE host conversion after it.
+        assert self._lint_source(tmp_path, """
+            def fit(train_step, epoch_batches, state, rng):
+                losses = []
+                for x, y in epoch_batches:
+                    state, metrics = train_step(state, x, y, rng)
+                    losses.append(metrics["loss"])
+                return [float(l) for l in losses]
+        """) == []
+
+    def test_epoch_step_result_exempt(self, tmp_path):
+        # One conversion per SCANNED epoch is the post-epoch read, not
+        # a per-step sync.
+        assert self._lint_source(tmp_path, """
+            def fit(epoch_step, state, rng, epochs):
+                for epoch in range(epochs):
+                    state, loss = epoch_step(state, rng)
+                    train_loss = float(loss)
+                return train_loss
+        """) == []
+
+    def test_nested_loops_single_finding_per_line(self, tmp_path):
+        """The realistic shape — epoch loop wrapping the batch loop —
+        must yield ONE finding for the per-step conversion (not one per
+        enclosing loop), and the blessed conversion AFTER the batch loop
+        (outer body) must stay clean: each visit analyzes one loop level."""
+        diags = self._lint_source(tmp_path, """
+            def fit(train_step, epochs, epoch_batches, state, rng):
+                for epoch in range(epochs):
+                    losses = []
+                    for x, y in epoch_batches:
+                        state, metrics = train_step(state, x, y, rng)
+                        losses.append(float(metrics["loss"]))
+                    last = float(metrics["loss"])  # post-loop: blessed
+                return last
+        """)
+        assert _codes(diags) == ["TPF006"]
+        assert diags[0].where.endswith(":7")  # the per-step line only
+
+    def test_tpf006_noqa_suppression(self, tmp_path):
+        assert self._lint_source(tmp_path, """
+            def fit(train_step, epoch_batches, state, rng):
+                for x, y in epoch_batches:
+                    state, metrics = train_step(state, x, y, rng)
+                    v = float(metrics["loss"])  # noqa: TPF006
+                return v
+        """) == []
+
     def test_self_lint_gate_package_is_clean(self):
         """The gate: the whole tpuflow package obeys its own lint rules.
         New framework code that host-syncs inside jit, uses untraced
-        randomness, ships a mutable default, or names a nonexistent
-        fault site fails the tier-1 suite right here."""
+        randomness, ships a mutable default, names a nonexistent fault
+        site, or float()s per-step aux inside the batch loop fails the
+        tier-1 suite right here."""
         findings = lint_package()
         assert findings == [], "\n".join(d.render() for d in findings)
+
+    def test_unknown_health_policy_is_a_spec_finding(self):
+        from tpuflow.analysis.spec import validate_spec
+
+        diags = validate_spec(TrainJobConfig(health="explode"))
+        codes = [d.code for d in diags]
+        assert "spec.health.unknown" in codes
+        (d,) = [d for d in diags if d.code == "spec.health.unknown"]
+        assert "halve_lr" in d.choices and "abort" in d.choices
+        for ok in ("warn", "abort", "halve_lr", "off", None):
+            assert not [
+                d for d in validate_spec(TrainJobConfig(health=ok))
+                if d.code == "spec.health.unknown"
+            ]
 
 
 class TestFailFastWiring:

@@ -83,6 +83,36 @@ class TestTimedPasses:
         assert (n, elapsed) == (1, 5.0)
 
 
+class TestMarkHostOnly:
+    """A CPU-fallback record must never read as a chip regression:
+    BENCH_r05 recorded vs_baseline 0.39 with device "cpu" — a healthy
+    host measurement masquerading as a 61% chip loss (ISSUE 5)."""
+
+    def test_vs_baseline_nulled_and_labeled(self):
+        from bench import mark_host_only
+
+        rec = {
+            "metric": "m", "value": 3896.6, "vs_baseline": 0.39,
+            "device": "cpu",
+        }
+        out = mark_host_only(rec)
+        assert out is rec  # in place, like _note_record uses it
+        assert rec["vs_baseline"] is None
+        assert rec["host_only"] is True
+        assert "host measurement" in rec["fallback"]
+        # The raw value survives: it IS a real measurement (of the
+        # wrong hardware).
+        assert rec["value"] == 3896.6
+
+    def test_marked_record_is_json_clean(self):
+        import json
+
+        from bench import mark_host_only
+
+        rec = json.loads(json.dumps(mark_host_only({"value": 1.0})))
+        assert rec["vs_baseline"] is None and rec["host_only"] is True
+
+
 class TestLastOnChip:
     """A dead relay must never again reduce the round artifact to a bare
     CPU number: CPU-fallback/failure tails embed the newest committed

@@ -135,6 +135,50 @@ def test_obs_summary_subprocess(tmp_path):
     assert "nope.jsonl" in missing.stderr
 
 
+def test_obs_timeline_subprocess(tmp_path):
+    """python -m tpuflow.obs timeline: span trail -> Chrome trace-event
+    JSON in a real subprocess (no jax needed), torn lines tolerated."""
+    import json
+
+    trail = tmp_path / "metrics.jsonl"
+    with open(trail, "wb") as f:
+        for rec in [
+            {"event": "span", "name": "ingest", "time": 10.0,
+             "duration_s": 2.0},
+            {"event": "span", "name": "step", "time": 13.0,
+             "duration_s": 0.5, "epoch": 1},
+            {"event": "span", "name": "predict.dispatch", "time": 13.2,
+             "duration_s": 0.01},
+        ]:
+            f.write(json.dumps(rec).encode() + b"\n")
+        f.write(b'{"event": "span", "torn mid-wr')  # crash-truncated tail
+    out = tmp_path / "trace.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "timeline", str(trail),
+         "-o", str(out)],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "skipped_lines: 1" in proc.stdout
+    doc = json.loads(out.read_text())
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == 3
+    ts = [e["ts"] for e in xs]
+    assert ts == sorted(ts) and all(t >= 0 for t in ts)
+    assert all(e["dur"] >= 0 for e in xs)
+    # The serving span landed in its own lane.
+    lanes = {e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M"}
+    assert {"train", "serving"} <= lanes
+
+    empty = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "timeline",
+         str(tmp_path / "none.jsonl"), "-o", str(out)],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert empty.returncode == 2  # missing file is an OSError exit
+
+
 def test_analysis_module_entry_rejects_broken_spec(tmp_path):
     """python -m tpuflow.analysis: the CI entry point exits non-zero on a
     broken spec and prints the preflight diagnostic."""

@@ -1,0 +1,483 @@
+"""Training health monitor (tpuflow/obs/health.py + timeline.py): the
+numerics watchdog policy matrix, recompile detection, live roofline
+gauges, the Perfetto timeline export, and torn-trail tolerance.
+
+The acceptance drill: a synthetic diverging run (LR spiked via config,
+unclipped loss) trips the watchdog within 2 epochs —
+``train_numerics_anomalies_total`` > 0, a forensics trail on disk, the
+``abort`` policy raising the typed ``NumericsDivergence`` — and the
+``obs timeline`` output from a real smoke run validates against the
+Chrome trace-event schema (sorted ts, complete X events).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from tpuflow.obs import NumericsDivergence, default_registry
+from tpuflow.obs.health import NumericsWatchdog, RecompileDetector
+
+# A run that genuinely diverges in float32 within one epoch: unclipped
+# mse loss (mae_clip saturates at 6 and zeroes the gradient — no NaN
+# ever) plus an absurd learning rate.
+_DIVERGING = dict(
+    model="static_mlp",
+    model_kwargs={"hidden": [8]},
+    max_epochs=6,
+    batch_size=32,
+    seed=0,
+    verbose=False,
+    n_devices=1,
+    synthetic_wells=2,
+    synthetic_steps=64,
+    loss="mse",
+    optimizer_kwargs={"learning_rate": 1e12},
+)
+
+
+def _anomaly_count(**labels) -> float:
+    return default_registry().counter(
+        "train_numerics_anomalies_total"
+    ).value(**labels)
+
+
+class TestWatchdogUnit:
+    """The detection matrix on synthetic aux — no jax, no training."""
+
+    def test_nan_and_inf_are_anomalies(self):
+        w = NumericsWatchdog("warn", verbose=False)
+        w.observe_epoch(1, [0.5, float("nan")], [1.0])
+        w.observe_epoch(2, [0.5], [float("inf")])
+        kinds = [a["kind"] for a in w.anomalies]
+        assert kinds == ["nan_loss", "inf_grad"]
+
+    def test_spike_needs_a_healthy_baseline(self):
+        w = NumericsWatchdog(
+            "warn", verbose=False, warmup_epochs=1, spike_factor=10.0
+        )
+        w.observe_epoch(1, [1.0], [1.0])  # seeds the EWMA
+        w.observe_epoch(2, [1.1], [0.9])  # healthy
+        w.observe_epoch(3, [50.0], [1.0])  # 10x the loss EWMA
+        assert [a["kind"] for a in w.anomalies] == ["spike_loss"]
+
+    def test_spike_does_not_poison_its_own_baseline(self):
+        w = NumericsWatchdog("warn", verbose=False, warmup_epochs=1)
+        w.observe_epoch(1, [1.0], [1.0])
+        w.observe_epoch(2, [100.0], [1.0])  # spike: EWMA must NOT absorb it
+        w.observe_epoch(3, [100.0], [1.0])  # still 100x the healthy EWMA
+        assert [a["kind"] for a in w.anomalies] == [
+            "spike_loss", "spike_loss"
+        ]
+
+    def test_first_epoch_nonfinite_fires_without_warmup(self):
+        # Warmup gates SPIKE detection only — NaN on epoch 1 is never
+        # ambiguous and must fire immediately (the within-2-epochs bound).
+        w = NumericsWatchdog("abort", verbose=False)
+        with pytest.raises(NumericsDivergence) as e:
+            w.observe_epoch(1, [float("inf")])
+        assert e.value.epoch == 1
+
+    def test_unknown_policy_rejected(self):
+        with pytest.raises(ValueError, match="unknown health policy"):
+            NumericsWatchdog("explode")
+
+
+class TestWatchdogPolicyMatrix:
+    """warn continues; abort raises the typed error; halve_lr actually
+    changes the optimizer LR (through the with_lr_scale leaf)."""
+
+    @staticmethod
+    def _linear_state(lr: float = 0.1):
+        import jax
+        import jax.numpy as jnp
+        from flax.training.train_state import TrainState
+
+        from tpuflow.train.optim import keras_sgd, wrap_optimizer
+
+        tx = wrap_optimizer(
+            keras_sgd(learning_rate=lr, momentum=0.0, decay=0.0)
+        )
+        return TrainState.create(
+            apply_fn=lambda *a, **k: None,
+            params={"w": jnp.ones(3)},
+            tx=tx,
+        ), jax
+
+    def test_warn_continues_and_counts(self):
+        before = _anomaly_count(kind="nan_loss")
+        w = NumericsWatchdog("warn", verbose=False)
+        out = w.observe_epoch(3, [float("nan")], state="sentinel")
+        assert out == "sentinel"  # unchanged, run continues
+        assert _anomaly_count(kind="nan_loss") == before + 1
+
+    def test_abort_raises_typed_error_with_trail(self):
+        w = NumericsWatchdog("abort", verbose=False)
+        w.observe_epoch(1, [1.0], [1.0])
+        with pytest.raises(NumericsDivergence) as e:
+            w.observe_epoch(2, [float("nan")], [2.0])
+        assert e.value.epoch == 2
+        assert [a["kind"] for a in e.value.anomalies] == ["nan_loss"]
+
+    def test_halve_lr_actually_changes_the_update(self):
+        state, _jax = self._linear_state(lr=0.1)
+        g = {"w": np.ones(3, np.float32)}
+        full = state.apply_gradients(grads=g)
+        w = NumericsWatchdog("halve_lr", verbose=False)
+        halved_state = w.observe_epoch(1, [float("inf")], state=state)
+        assert w.halvings == 1
+        halved = halved_state.apply_gradients(grads=g)
+        d_full = float(state.params["w"][0] - full.params["w"][0])
+        d_half = float(state.params["w"][0] - halved.params["w"][0])
+        assert d_half == pytest.approx(d_full / 2, rel=1e-5)
+
+    def test_halve_lr_compounds_and_caps(self):
+        state, _ = self._linear_state()
+        w = NumericsWatchdog("halve_lr", verbose=False, max_halvings=2)
+        for epoch in range(1, 5):
+            state = w.observe_epoch(epoch, [float("inf")], state=state)
+        assert w.halvings == 2  # capped; later epochs warn instead
+        scale = float(state.opt_state.lr_scale)
+        assert scale == pytest.approx(0.25)
+
+    def test_halve_lr_without_scale_leaf_degrades_to_warn(self):
+        import optax
+        from flax.training.train_state import TrainState
+
+        import jax.numpy as jnp
+
+        state = TrainState.create(
+            apply_fn=None, params={"w": jnp.ones(2)}, tx=optax.sgd(0.1)
+        )
+        w = NumericsWatchdog("halve_lr", verbose=False)
+        out = w.observe_epoch(1, [float("nan")], state=state)
+        assert out is state and w.halvings == 0
+
+
+class TestRecompileDetector:
+    def test_first_compile_free_then_recompiles_counted(self):
+        det = RecompileDetector()
+        calls = []
+
+        def step(state, x):
+            calls.append(np.asarray(x).shape)
+            return state
+
+        wrapped = det.wrap(step, "train_step")
+        det.epoch = 1
+        wrapped(None, np.zeros((4, 2)))
+        wrapped(None, np.zeros((4, 2)))  # same signature: no event
+        assert det.events == []
+        det.epoch = 3
+        wrapped(None, np.zeros((8, 2)))  # churn
+        assert len(det.events) == 1
+        assert det.events[0]["epoch"] == 3
+        assert "8" in det.events[0]["signature"]
+        assert len(calls) == 3  # the wrapper never swallows calls
+
+    def test_summary_flags_steady_state_only(self):
+        det = RecompileDetector()
+        wrapped = det.wrap(lambda s, x: s, "train_step")
+        det.epoch = 1
+        wrapped(None, np.zeros((4,)))
+        wrapped(None, np.zeros((8,)))  # recompile, but warmup epoch
+        s = det.summary(steady_after=1)
+        assert s["recompiles"] == 1 and s["steady_state"] == 0
+        assert "diagnostic" not in s
+        det.epoch = 5
+        wrapped(None, np.zeros((16,)))
+        s = det.summary(steady_after=1)
+        assert s["steady_state"] == 1
+        assert "shape churn" in s["diagnostic"]
+
+    def test_gauge_tracks_count(self):
+        det = RecompileDetector()
+        wrapped = det.wrap(lambda s, x: s, "train_step")
+        wrapped(None, np.zeros((2,)))
+        wrapped(None, np.zeros((3,)))
+        assert default_registry().gauge("train_recompiles").value() == 1.0
+
+    def test_no_recompiles_is_none_summary(self):
+        assert RecompileDetector().summary() is None
+
+
+class TestLiveRoofline:
+    def test_gauges_published_for_known_chip(self):
+        from tpuflow.obs import publish_roofline
+        from tpuflow.utils.roofline import (
+            lstm_bytes_per_sample_step,
+            lstm_flops_per_sample_step,
+        )
+
+        flops = lstm_flops_per_sample_step(64, 8, 64)
+        bytes_ = lstm_bytes_per_sample_step(64, 8, 64, 2)
+        rep = publish_roofline(10_000.0, flops, bytes_, "TPU v5 lite")
+        reg = default_registry()
+        assert reg.gauge("train_mfu").value() == rep["mfu"] > 0
+        assert reg.gauge("train_bound").value(bound=rep["bound"]) == 1.0
+        other = "mxu" if rep["bound"] == "hbm" else "hbm"
+        assert reg.gauge("train_bound").value(bound=other) == 0.0
+
+    def test_unknown_chip_logs_but_skips_gauges(self, tmp_path):
+        from tpuflow.obs import publish_roofline
+        from tpuflow.utils.logging import MetricsLogger
+
+        path = str(tmp_path / "m.jsonl")
+        with MetricsLogger(path) as log:
+            rep = publish_roofline(
+                100.0, 1e6, 1e3, "cpu", logger=log, epoch=4
+            )
+        assert rep["mfu"] is None
+        rec = json.loads(open(path).read().strip())
+        assert rec["event"] == "roofline" and rec["epoch"] == 4
+        assert "unknown chip" in rec["bound"]
+
+    def test_model_cost_covers_sequence_families_only(self):
+        from tpuflow.utils.roofline import model_cost_per_sample
+
+        lstm = model_cost_per_sample("lstm", window=24, features=6)
+        stacked = model_cost_per_sample(
+            "stacked_lstm", window=24, features=6
+        )
+        attn = model_cost_per_sample("attention", window=24, features=6)
+        assert lstm and stacked and attn
+        # stacked_lstm defaults to 2 layers: strictly more work.
+        assert stacked[0] > lstm[0] and stacked[1] > lstm[1]
+        assert model_cost_per_sample(
+            "static_mlp", window=24, features=6
+        ) is None
+
+
+@pytest.mark.usefixtures("tmp_path")
+class TestDivergingRunAcceptance:
+    """The ISSUE acceptance drill, end-to-end through train(config)."""
+
+    def test_abort_policy_trips_within_two_epochs(self, tmp_path):
+        from tpuflow.api import TrainJobConfig, train
+
+        storage = str(tmp_path / "art")
+        before = _anomaly_count(kind="inf_loss") + _anomaly_count(
+            kind="nan_loss"
+        )
+        with pytest.raises(NumericsDivergence) as e:
+            train(TrainJobConfig(
+                **_DIVERGING, health="abort", storage_path=storage,
+            ))
+        assert e.value.epoch is not None and e.value.epoch <= 2
+        after = _anomaly_count(kind="inf_loss") + _anomaly_count(
+            kind="nan_loss"
+        )
+        assert after > before
+        # Forensics trail written next to the artifacts, anomaly inside.
+        dump = os.path.join(storage, "forensics.jsonl")
+        assert os.path.exists(dump)
+        recs = [json.loads(l) for l in open(dump)]
+        assert any(r["event"] == "numerics_anomaly" for r in recs)
+
+    def test_warn_policy_survives_the_whole_budget(self, tmp_path):
+        from tpuflow.api import TrainJobConfig, train
+        from tpuflow.serve import report_to_dict
+
+        r = train(TrainJobConfig(
+            **_DIVERGING, health="warn",
+            storage_path=str(tmp_path / "art"),
+        ))
+        assert r.result.epochs_ran == _DIVERGING["max_epochs"]
+        assert r.anomalies  # detected, reported, not fatal
+        assert "Numerics anomalies" in r.summary()
+        # The job report an operator reads carries the anomalies too —
+        # as VALID json (inf values stringified, never an Infinity
+        # token).
+        rep = report_to_dict(r)
+        assert rep["numerics_anomalies"]
+        assert "Infinity" not in json.dumps(rep)
+
+    def test_off_disables_the_watchdog(self, tmp_path):
+        from tpuflow.api import TrainJobConfig, train
+
+        r = train(TrainJobConfig(**_DIVERGING, health="off"))
+        assert r.result.epochs_ran == _DIVERGING["max_epochs"]
+        assert r.anomalies == []
+
+    def test_abort_under_profiler_trace_does_not_leak_the_trace(
+        self, tmp_path
+    ):
+        """The watchdog fires AFTER the first epoch's profiler stop: an
+        abort raised mid-trace would leave jax.profiler open and crash
+        the NEXT run in this process with 'trace already started'."""
+        import jax
+
+        from tpuflow.api import TrainJobConfig, train
+
+        with pytest.raises(NumericsDivergence):
+            train(TrainJobConfig(
+                **_DIVERGING, health="abort",
+                trace_dir=str(tmp_path / "trace"),
+            ))
+        # Provable closure: starting a fresh trace raises if one leaked.
+        jax.profiler.start_trace(str(tmp_path / "probe"))
+        jax.profiler.stop_trace()
+
+
+class TestTimelineExport:
+    def test_spans_become_sorted_complete_events(self, tmp_path):
+        from tpuflow.obs.timeline import to_trace_events
+
+        events = [
+            {"event": "span", "name": "ingest", "time": 100.0,
+             "duration_s": 2.0, "trace_id": "t1"},
+            {"event": "span", "name": "step", "time": 103.0,
+             "duration_s": 0.5, "epoch": 1},
+            {"event": "span", "name": "predict.dispatch", "time": 103.2,
+             "duration_s": 0.01},
+            {"event": "span", "name": "xla.compile", "time": 102.5,
+             "duration_s": 1.0},
+            {"event": "numerics_anomaly", "time": 103.4,
+             "kind": "nan_loss", "epoch": 2},
+            {"event": "epoch", "time": 104.0},  # not a span: dropped
+        ]
+        doc = to_trace_events(events)
+        assert doc["displayTimeUnit"] == "ms"
+        evs = doc["traceEvents"]
+        xs = [e for e in evs if e["ph"] == "X"]
+        assert len(xs) == 4
+        ts = [e["ts"] for e in xs]
+        assert ts == sorted(ts) and all(t >= 0 for t in ts)
+        assert all(e["dur"] >= 0 for e in xs)
+        # ts is start time: the ingest span (end 100, dur 2) starts at 0.
+        ingest = next(e for e in xs if e["name"] == "ingest")
+        assert ingest["ts"] == 0.0 and ingest["dur"] == 2_000_000.0
+        assert ingest["args"]["trace_id"] == "t1"
+        # Lanes: train / serving / xla, named by metadata rows.
+        lanes = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+        assert {"train", "serving", "xla"} <= lanes
+        marks = [e for e in evs if e["ph"] == "i"]
+        assert marks and marks[0]["name"] == "numerics_anomaly"
+
+    def test_real_smoke_run_validates_against_the_schema(self, tmp_path):
+        from tpuflow.api import TrainJobConfig, train
+        from tpuflow.obs.timeline import export_timeline
+
+        trail = str(tmp_path / "metrics.jsonl")
+        train(TrainJobConfig(
+            model="static_mlp", model_kwargs={"hidden": [8]},
+            max_epochs=2, batch_size=32, seed=0, verbose=False,
+            n_devices=1, synthetic_wells=2, synthetic_steps=64,
+            storage_path=str(tmp_path / "art"), metrics_path=trail,
+        ))
+        out = str(tmp_path / "trace.json")
+        stats = export_timeline(trail, out)
+        assert stats["spans"] > 0 and stats["skipped_lines"] == 0
+        doc = json.load(open(out))
+        names = set()
+        last_ts = -math.inf
+        for e in doc["traceEvents"]:
+            assert e["ph"] in ("X", "i", "M")
+            if e["ph"] == "M":
+                continue
+            assert e["ts"] >= last_ts >= -math.inf or e["ts"] >= 0
+            assert e["ts"] >= 0
+            last_ts = max(last_ts, e["ts"])
+            if e["ph"] == "X":
+                assert e["dur"] >= 0
+                names.add(e["name"])
+        assert {"ingest", "step", "eval", "checkpoint"} <= names
+
+    def test_empty_trail_yields_empty_document(self, tmp_path):
+        from tpuflow.obs.timeline import export_timeline
+
+        trail = tmp_path / "empty.jsonl"
+        trail.write_text("")
+        stats = export_timeline(str(trail), str(tmp_path / "t.json"))
+        assert stats == {"events": 0, "spans": 0, "skipped_lines": 0}
+
+    def test_nonfinite_values_never_reach_the_json(self, tmp_path):
+        """An inf_loss anomaly's VALUE is infinity; json.dump's default
+        would write a bare ``Infinity`` token — invalid RFC-8259 JSON
+        that Perfetto rejects, exactly when the anomaly marks matter.
+        Non-finite arg values become strings; a NaN span envelope is
+        dropped entirely."""
+        from tpuflow.obs.timeline import export_timeline
+
+        trail = tmp_path / "t.jsonl"
+        trail.write_text("\n".join([
+            json.dumps({"event": "span", "name": "step", "time": 2.0,
+                        "duration_s": 1.0}),
+            # python json accepts these on input; the export must not
+            # emit them on output.
+            '{"event": "numerics_anomaly", "time": 2.5,'
+            ' "kind": "inf_loss", "value": Infinity}',
+            '{"event": "span", "name": "eval", "time": 3.0,'
+            ' "duration_s": NaN}',
+        ]) + "\n")
+        out = tmp_path / "trace.json"
+        export_timeline(str(trail), str(out))
+        text = out.read_text()
+        assert "Infinity" not in text and "NaN" not in text
+        doc = json.loads(text)
+        (mark,) = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+        assert mark["args"]["value"] == "inf"
+        assert sum(
+            1 for e in doc["traceEvents"] if e["ph"] == "X"
+        ) == 1  # the NaN-duration span is dropped, not poisoned
+
+
+class TestTornTrailTolerance:
+    """A crash-truncated trail is data loss to report, not an exception:
+    bad lines are skipped and counted as skipped_lines."""
+
+    def test_truncated_and_binary_lines_are_skipped(self, tmp_path):
+        from tpuflow.obs.trail import read_events
+
+        path = tmp_path / "torn.jsonl"
+        good = json.dumps({"event": "span", "name": "step",
+                           "time": 1.0, "duration_s": 0.5})
+        with open(path, "wb") as f:
+            f.write((good + "\n").encode())
+            f.write(b'{"event": "span", "na')  # torn mid-line
+            f.write(b"\n")
+            f.write(b'\xff\xfe{"event": torn-mid-utf8\n')  # invalid UTF-8
+            f.write(b'[1, 2, 3]\n')  # valid JSON, not an object
+            f.write((good + "\n").encode())
+        events, skipped = read_events(str(path))
+        assert len(events) == 2 and skipped == 3
+
+    def test_summary_and_tail_report_skipped_lines(self, tmp_path, capsys):
+        from tpuflow.obs.__main__ import main
+
+        path = tmp_path / "torn.jsonl"
+        with open(path, "wb") as f:
+            f.write(json.dumps(
+                {"event": "epoch", "time": 1.0, "epoch": 1,
+                 "val_loss": 0.5}
+            ).encode() + b"\n")
+            f.write(b'{"event": "ep\xff\n')
+        assert main(["summary", str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "skipped_lines: 1" in out
+        assert main(["tail", str(path), "-n", "5"]) == 0
+        captured = capsys.readouterr()
+        assert "skipped_lines: 1" in captured.err
+        assert json.loads(captured.out)["event"] == "epoch"
+
+    def test_timeline_cli_tolerates_torn_trail(self, tmp_path, capsys):
+        from tpuflow.obs.__main__ import main
+
+        path = tmp_path / "torn.jsonl"
+        with open(path, "wb") as f:
+            f.write(json.dumps(
+                {"event": "span", "name": "step", "time": 2.0,
+                 "duration_s": 1.0}
+            ).encode() + b"\n")
+            f.write(b'{"torn...\n')
+        out = tmp_path / "trace.json"
+        assert main(["timeline", str(path), "-o", str(out)]) == 0
+        assert "skipped_lines: 1" in capsys.readouterr().out
+        doc = json.load(open(out))
+        assert sum(
+            1 for e in doc["traceEvents"] if e["ph"] == "X"
+        ) == 1

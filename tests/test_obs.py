@@ -132,6 +132,40 @@ class TestRegistry:
             reg.histogram("h", buckets=[1, 2, 4])
         assert reg.histogram("h", buckets=[2, 1]) is not None  # same edges
 
+    def test_label_values_escaped_per_exposition_format(self):
+        """`"`/`\\`/newline in label values must escape per the text
+        exposition format — faults_injected_total{site=...} and friends
+        take arbitrary strings, and a raw quote would tear the sample
+        line apart for every scraper."""
+        reg = Registry()
+        c = reg.counter("faults_injected_total", "fault firings")
+        c.inc(site='check"point.save')
+        c.inc(site="a\\b")
+        c.inc(site="line1\nline2")
+        text = render_prometheus(reg)
+        _assert_valid_exposition(text)
+        assert (
+            'tpuflow_faults_injected_total{site="check\\"point.save"} 1'
+            in text
+        )
+        assert (
+            'tpuflow_faults_injected_total{site="a\\\\b"} 1' in text
+        )
+        assert (
+            'tpuflow_faults_injected_total{site="line1\\nline2"} 1'
+            in text
+        )
+        # No raw newline survived into the body: every line is either a
+        # comment or a full sample (the validator above also enforces it).
+        assert "line1\nline2" not in text
+
+    def test_help_text_escaped(self):
+        reg = Registry()
+        reg.counter("x_total", "first\nsecond \\ backslash").inc()
+        text = render_prometheus(reg)
+        _assert_valid_exposition(text)
+        assert "# HELP tpuflow_x_total first\\nsecond \\\\ backslash" in text
+
     def test_duplicate_family_across_registries_first_wins(self):
         a, b = Registry(), Registry()
         a.counter("dup_total").inc(1)

@@ -55,6 +55,49 @@ class TestRunCheckpointer:
         assert ck.restore(_fresh_state()) is None
         ck.close()
 
+    def test_legacy_checkpoint_without_lr_scale_restores(self, tmp_path):
+        """Back-compat: wrap_optimizer now always installs the
+        with_lr_scale leaf, but a checkpoint written BEFORE that change
+        carries the unwrapped opt_state — restore must rewrap it with a
+        fresh scale (1.0; an old run never touched it), not crash on the
+        structure mismatch."""
+        from flax.training.train_state import TrainState
+
+        from tpuflow.models import StaticMLP
+        from tpuflow.train.optim import (
+            LrScaleState,
+            keras_sgd,
+            wrap_optimizer,
+        )
+
+        model = StaticMLP()
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.ones((2, 6), jnp.float32)
+        )["params"]
+        # The pre-change shape: raw optimizer, no LrScaleState wrapper.
+        legacy = TrainState.create(
+            apply_fn=model.apply, params=params, tx=keras_sgd()
+        )
+        ck = RunCheckpointer(str(tmp_path), "m", async_save=False)
+        ck.save(4, legacy, {"epoch": 4, "stopper_best": 0.5,
+                            "stopper_bad_epochs": 0, "best_val_loss": 0.5})
+        ck.close()
+
+        template = TrainState.create(
+            apply_fn=model.apply, params=params,
+            tx=wrap_optimizer(keras_sgd()),
+        )
+        ck2 = RunCheckpointer(str(tmp_path), "m", async_save=False)
+        restored, meta = ck2.restore(template)
+        ck2.close()
+        assert meta["epoch"] == 4
+        assert isinstance(restored.opt_state, LrScaleState)
+        assert float(restored.opt_state.lr_scale) == 1.0
+        jax.tree_util.tree_map(
+            lambda a, e: np.testing.assert_array_equal(a, e),
+            restored.params, legacy.params,
+        )
+
 
 class TestFitResume:
     def test_resume_matches_uninterrupted(self, tmp_path):

@@ -20,7 +20,11 @@ import textwrap
 
 import pytest
 
-from tpuflow.train.supervisor import CrashLoopError, supervise
+from tpuflow.train.supervisor import (
+    NUMERICS_EXIT_CODE,
+    CrashLoopError,
+    supervise,
+)
 
 _TINY = {
     "model": "static_mlp",
@@ -156,6 +160,45 @@ class TestCrashLoop:
         assert "crash-loop" in str(e.value)
         assert "epoch 2" in str(e.value)
         assert all(f["rc"] == 41 for f in e.value.failures)
+
+
+class TestNumericsDivergenceClassification:
+    """A numerics-watchdog abort is TERMINAL on the first death: the
+    child exits with the dedicated code, the supervisor raises the typed
+    NumericsDivergence without burning a single restart-backoff attempt
+    (a diverged run replays deterministically), and the trail is dumped
+    next to the artifacts."""
+
+    def test_watchdog_abort_is_terminal_without_restarts(self, tmp_path):
+        from tpuflow.obs.health import NumericsDivergence
+
+        slept = []
+        spec = {
+            **_TINY,
+            "storagePath": str(tmp_path),
+            # Unclipped loss + absurd LR: inf within the first epoch
+            # (mae_clip saturates at 6 and zeroes the gradient — the
+            # run would never go non-finite under it).
+            "loss": "mse",
+            "optimizer_kwargs": {"learning_rate": 1e12},
+            "health": "abort",
+        }
+        with pytest.raises(NumericsDivergence, match="restarting would"):
+            supervise(
+                spec, max_restarts=3, verbose=False,
+                backoff_base=0.01, backoff_jitter=0.0, sleep=slept.append,
+            )
+        # Terminal on the FIRST death: no backoff sleeps, no restarts.
+        assert slept == []
+        # Both the child's rich trail and the supervisor's attempt trail
+        # survive, side by side (distinct filenames by contract).
+        assert (tmp_path / "forensics.jsonl").exists()
+        assert (tmp_path / "forensics-supervisor.jsonl").exists()
+
+    def test_exit_code_is_reserved_for_the_classifier(self):
+        # The fault drills use 41-43; the numerics code must stay
+        # distinct or a drill would read as a divergence.
+        assert NUMERICS_EXIT_CODE not in (0, 41, 42, 43)
 
 
 class TestStallWatchdog:

@@ -252,13 +252,27 @@ class TestWrapOptimizer:
         with pytest.raises(ValueError, match="accumulate_steps"):
             wrap_optimizer(optax.sgd(0.1), accumulate_steps=0)
 
-    def test_noop_passthrough_is_identity(self):
+    def test_noop_wrap_preserves_updates_and_carries_lr_scale(self):
+        """With no knobs set the wrap changes NOTHING numerically, but
+        always installs the with_lr_scale leaf (scale 1.0) — the seam
+        the numerics watchdog's halve_lr policy turns without a
+        recompile (tpuflow/obs/health.py)."""
         import optax
 
         from tpuflow.train import wrap_optimizer
+        from tpuflow.train.optim import LrScaleState
 
-        tx = optax.sgd(0.1)
-        assert wrap_optimizer(tx) is tx
+        params = {"w": jnp.arange(4.0)}
+        g = {"w": jnp.array([1.0, -2.0, 3.0, -4.0])}
+        tx = wrap_optimizer(optax.sgd(0.1))
+        st = tx.init(params)
+        assert isinstance(st, LrScaleState)
+        assert float(st.lr_scale) == 1.0
+        upd, _ = tx.update(g, st, params)
+        ref_upd, _ = optax.sgd(0.1).update(g, optax.sgd(0.1).init(params), params)
+        np.testing.assert_array_equal(
+            np.asarray(upd["w"]), np.asarray(ref_upd["w"])
+        )
 
     def test_train_end_to_end_with_accumulation_and_clip(self):
         from tpuflow.api import TrainJobConfig, train

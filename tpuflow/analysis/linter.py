@@ -24,6 +24,14 @@ sync per step, a drill that can never fire):
   time (recording once, at compile) or forces a host sync per step —
   record OUTSIDE the jitted program, on already-transferred host values
   (the ``tpuflow.obs`` contract).
+- **TPF006** — host-side float conversion of per-step train aux inside
+  the epoch batch loop: ``float(...)``/``.item()``/``np.asarray`` on a
+  name bound from a ``*train_step(...)`` call, in the same ``for`` body,
+  syncs the device once per step and serializes async dispatch. Collect
+  device references, convert once post-epoch — which is exactly where
+  the numerics watchdog reads them (``tpuflow/obs/health.py``).
+  ``epoch_step`` results are exempt: converting the scanned epoch's one
+  result IS the post-epoch read.
 
 "Jitted function" means a function decorated with ``jit``/``jax.jit``/
 ``partial(jax.jit, ...)`` or passed to a ``jax.jit(...)`` call reachable
@@ -60,6 +68,11 @@ RULES = {
     "TPF005": "metrics/trace recording inside a jitted function (frozen "
               "at trace time or a host sync per step; record outside jit "
               "— the tpuflow.obs contract)",
+    "TPF006": "host-side float conversion of per-step train aux inside "
+              "the epoch batch loop (float()/.item()/np.asarray() on the "
+              "step's result syncs the device once per step and "
+              "serializes async dispatch; collect device references and "
+              "convert ONCE post-epoch — the numerics-watchdog contract)",
 }
 
 _HOST_SYNC_NAMES = {"float", "bool"}
@@ -184,6 +197,99 @@ class _Linter(ast.NodeVisitor):
                     f"mutable class-level default in {node.name}",
                 )
         self.generic_visit(node)
+
+    # --- TPF006: per-step host sync in the epoch batch loop ---
+
+    def visit_For(self, node) -> None:
+        self._check_step_aux_loop(node)
+        self.generic_visit(node)
+
+    @staticmethod
+    def _call_name(func) -> str | None:
+        if isinstance(func, ast.Name):
+            return func.id
+        if isinstance(func, ast.Attribute):
+            return func.attr
+        return None
+
+    @staticmethod
+    def _walk_same_loop(node: ast.For):
+        """``node``'s subtree WITHOUT descending into nested loops or
+        function definitions: each ``visit_For`` analyzes exactly one
+        loop level, so an epoch loop wrapping a batch loop neither
+        double-reports the inner loop's findings nor flags the blessed
+        post-batch-loop conversion (which sits in the OUTER body while
+        the step assignment sits in the inner — different levels)."""
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            sub = stack.pop()
+            yield sub
+            if isinstance(sub, (
+                ast.For, ast.AsyncFor, ast.FunctionDef,
+                ast.AsyncFunctionDef, ast.Lambda,
+            )):
+                continue
+            stack.extend(ast.iter_child_nodes(sub))
+
+    def _check_step_aux_loop(self, node: ast.For) -> None:
+        """Names bound from a ``*train_step(...)`` call at THIS loop
+        level must not be host-converted at the same level — the
+        per-batch sync that makes the watchdog contract explicit: aux is
+        collected as device references, converted once post-epoch.
+        (``epoch_step`` results are exempt: one conversion per scanned
+        epoch IS the post-epoch read.)"""
+        aux_names: set[str] = set()
+        for sub in self._walk_same_loop(node):
+            if isinstance(sub, ast.Assign) and isinstance(
+                sub.value, ast.Call
+            ):
+                fname = self._call_name(sub.value.func)
+                if fname and fname.endswith("train_step"):
+                    for target in sub.targets:
+                        elts = (
+                            target.elts
+                            if isinstance(target, ast.Tuple)
+                            else [target]
+                        )
+                        aux_names |= {
+                            e.id for e in elts if isinstance(e, ast.Name)
+                        }
+        if not aux_names:
+            return
+
+        def mentions_aux(expr: ast.AST) -> bool:
+            return any(
+                isinstance(n, ast.Name) and n.id in aux_names
+                for n in ast.walk(expr)
+            )
+
+        for sub in self._walk_same_loop(node):
+            if not isinstance(sub, ast.Call) or not sub.args:
+                continue
+            func = sub.func
+            converted = (
+                (isinstance(func, ast.Name)
+                 and func.id in _HOST_SYNC_NAMES)
+                or (isinstance(func, ast.Attribute)
+                    and func.attr in _HOST_SYNC_NP_ATTRS
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in _NP_NAMES)
+            )
+            if converted and any(mentions_aux(a) for a in sub.args):
+                self._emit(
+                    "TPF006", sub,
+                    f"{ast.unparse(func)}(...) on per-step aux",
+                )
+        for sub in self._walk_same_loop(node):
+            # .item() is argument-less, so it needs its own scan over
+            # the attribute's BASE expression.
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "item"
+                and mentions_aux(sub.func.value)
+            ):
+                self._emit("TPF006", sub, ".item() on per-step aux")
 
     # --- TPF001 / TPF002 / TPF004: calls ---
 

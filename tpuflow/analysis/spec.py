@@ -235,6 +235,20 @@ def _check_storage(config) -> list[Diagnostic]:
     return out
 
 
+def _check_health(config) -> list[Diagnostic]:
+    from tpuflow.obs.health import HEALTH_OFF, HEALTH_POLICIES
+
+    policy = config.health
+    if policy in HEALTH_OFF or policy in HEALTH_POLICIES:
+        return []
+    return [_diag(
+        "spec.health.unknown",
+        f"unknown health policy {policy!r}",
+        where="health",
+        choices=sorted(HEALTH_POLICIES) + ["off"],
+    )]
+
+
 def _check_faults(config) -> list[Diagnostic]:
     from tpuflow.resilience.faults import SITES, parse_fault_spec
 
@@ -286,7 +300,8 @@ def validate_spec(config) -> list[Diagnostic]:
     out = []
     for check in (
         _check_registries, _check_schema, _check_scalars,
-        _check_windowing, _check_stream, _check_storage, _check_faults,
+        _check_windowing, _check_stream, _check_storage, _check_health,
+        _check_faults,
     ):
         try:
             out += check(config)

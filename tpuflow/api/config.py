@@ -85,6 +85,15 @@ class TrainJobConfig:
     # --- observability ---
     trace_dir: str | None = None  # jax.profiler trace of the first epoch
     metrics_path: str | None = None  # per-epoch JSONL metrics file
+    # Numerics-watchdog policy (tpuflow/obs/health.py): each epoch the
+    # loss/grad_norm aux is checked host-side for NaN/Inf and EWMA
+    # spikes; anomalies count into train_numerics_anomalies_total and
+    # dump a forensics trail. "warn" (default) logs and continues;
+    # "halve_lr" scales the optimizer LR by 0.5 per anomalous epoch;
+    # "abort" raises the typed NumericsDivergence, which the supervisor
+    # classifies as terminal (no restart-backoff churn — a diverged run
+    # replays deterministically). "off"/None disables the watchdog.
+    health: str | None = "warn"
 
     # --- parallelism ---
     n_devices: int | None = None  # None -> all visible devices; 1 -> no DP

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import os
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import jax
 import numpy as np
@@ -65,6 +65,11 @@ class TrainReport:
     # resolved by tpuflow.train.autotune when config.jit_epoch is None.
     epoch_program: str = ""
     epoch_program_reason: str = ""
+    # Health monitor outcomes (tpuflow/obs/health.py): the numerics
+    # watchdog's anomaly trail and the recompile detector's summary —
+    # both surfaced in summary() as preflight-style diagnostics.
+    anomalies: list = field(default_factory=list)
+    recompiles: dict | None = None
 
     def summary(self) -> str:
         lines = [
@@ -80,6 +85,17 @@ class TrainReport:
             lines.append(
                 f"Gilbert-baseline MAE: {self.gilbert_mae:.4f} (model {beat} baseline)"
             )
+        if self.anomalies:
+            kinds: dict[str, int] = {}
+            for a in self.anomalies:
+                kinds[a["kind"]] = kinds.get(a["kind"], 0) + 1
+            lines.append(
+                "Numerics anomalies: "
+                + ", ".join(f"{k}={v}" for k, v in sorted(kinds.items()))
+                + " (train_numerics_anomalies_total; see forensics.jsonl)"
+            )
+        if self.recompiles and self.recompiles.get("diagnostic"):
+            lines.append(f"Recompile churn: {self.recompiles['diagnostic']}")
         return "\n".join(lines)
 
 
@@ -788,6 +804,30 @@ def _train_impl(
         if jax.process_count() == 1:
             batch_shard = data_sharding(mesh)
 
+    # --- live roofline context (tpuflow/obs/health.py publish leg) ---
+    # The sequence families have a FLOPs/bytes cost model; the fit loop
+    # publishes train_mfu / train_bound from it each epoch. Families
+    # without a model get no MFU gauge — honest absence over noise.
+    from tpuflow.utils.roofline import model_cost_per_sample
+
+    roofline_cfg = None
+    if config.is_sequence_model:
+        feat_dim = (
+            val_ds.x.shape[-1] if config.stream else train_ds.x.shape[-1]
+        )
+        cost = model_cost_per_sample(
+            config.model,
+            window=config.window,
+            features=int(feat_dim),
+            model_kwargs=model_kwargs,
+        )
+        if cost is not None:
+            roofline_cfg = {
+                "flops_per_sample": cost[0],
+                "bytes_per_sample": cost[1],
+                "n_chips": n_dev,
+            }
+
     # --- fit (the reference's hot loop, cnn.py:126-129) ---
     fit_cfg = FitConfig(
         max_epochs=config.max_epochs,
@@ -808,6 +848,8 @@ def _train_impl(
         trace_dir=config.trace_dir,
         metrics_path=config.metrics_path,
         stop_fn=stop_fn,
+        health=config.health,
+        roofline=roofline_cfg,
     )
     result = fit(
         state,
@@ -885,6 +927,8 @@ def _train_impl(
         samples_per_sec=result.samples_per_sec / max(n_dev, 1),
         epoch_program=program.name,
         epoch_program_reason=f"{program.source}: {program.reason}",
+        anomalies=result.anomalies,
+        recompiles=result.recompiles,
     )
     if config.verbose:
         print(report.summary())

@@ -83,6 +83,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="capture a jax.profiler trace of the first epoch here")
     p.add_argument("--metrics", default=None, metavar="PATH",
                    help="append per-epoch JSONL metric records to PATH")
+    p.add_argument("--health", default="warn",
+                   choices=["warn", "abort", "halve_lr", "off"],
+                   help="numerics-watchdog policy on NaN/Inf/spike "
+                        "anomalies: warn and continue (default), abort "
+                        "the run (typed NumericsDivergence), halve the "
+                        "optimizer LR, or off")
     p.add_argument("--quiet", action="store_true")
     p.add_argument("--preflight", action="store_true", default=True,
                    dest="preflight",
@@ -193,6 +199,7 @@ def main(argv=None) -> int:
         resume=args.resume,
         trace_dir=args.trace_dir,
         metrics_path=args.metrics,
+        health=args.health,
     )
     if args.preflight:
         # Preflight-by-default: the whole job is statically analyzed —

@@ -17,6 +17,17 @@ wired into one substrate):
 - ``forensics``  — bounded event ring dumped to ``forensics.jsonl`` on
   unhandled failure / crash-loop classification;
   ``python -m tpuflow.obs tail|summary <file>`` reads any event trail.
+
+Plus the interpretation layer on top of the substrate:
+
+- ``health``     — numerics watchdog (NaN/Inf/spike over per-epoch
+  loss/grad aux; warn|abort|halve_lr policies, the typed
+  :class:`NumericsDivergence` the supervisor treats as terminal),
+  recompile detector (per-step signature churn + the process-wide
+  ``jax.monitoring`` compile counter), live MFU/roofline gauges.
+- ``timeline``   — Chrome trace-event export of any span trail
+  (``python -m tpuflow.obs timeline <jsonl> -o trace.json``), loadable
+  in Perfetto.
 """
 
 from tpuflow.obs.forensics import (
@@ -24,6 +35,14 @@ from tpuflow.obs.forensics import (
     dump_forensics,
     recent_events,
     record_event,
+)
+from tpuflow.obs.health import (
+    HEALTH_POLICIES,
+    NumericsDivergence,
+    NumericsWatchdog,
+    RecompileDetector,
+    install_compile_listener,
+    publish_roofline,
 )
 from tpuflow.obs.metrics import (
     DEFAULT_COUNT_BUCKETS,
@@ -47,16 +66,22 @@ from tpuflow.obs.tracing import (
 __all__ = [
     "DEFAULT_COUNT_BUCKETS",
     "DEFAULT_TIME_BUCKETS",
+    "HEALTH_POLICIES",
     "Counter",
     "Gauge",
     "Histogram",
+    "NumericsDivergence",
+    "NumericsWatchdog",
+    "RecompileDetector",
     "Registry",
     "Summary",
     "clear_events",
     "current_trace_id",
     "default_registry",
     "dump_forensics",
+    "install_compile_listener",
     "new_trace_id",
+    "publish_roofline",
     "recent_events",
     "record_event",
     "record_span",

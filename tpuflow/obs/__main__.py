@@ -2,16 +2,23 @@
 
 Usage::
 
-    python -m tpuflow.obs tail    <metrics.jsonl> [-n N]
-    python -m tpuflow.obs summary <metrics.jsonl>
+    python -m tpuflow.obs tail     <metrics.jsonl> [-n N]
+    python -m tpuflow.obs summary  <metrics.jsonl>
+    python -m tpuflow.obs timeline <metrics.jsonl> -o trace.json
 
-Both subcommands read the JSONL event format every tpuflow sink writes —
+All subcommands read the JSONL event format every tpuflow sink writes —
 a training run's ``metrics.jsonl`` (``--metrics`` / ``metrics_path``),
 a crash dump's ``forensics.jsonl``, or a serve journal. ``tail`` prints
 the newest N records (default 20), one per line, newest last. ``summary``
 aggregates the whole trail: events by type, epoch-loss trajectory, span
 time by name, and the wall-clock window covered — the two-second answer
-to "what did this run do and where did the time go".
+to "what did this run do and where did the time go". ``timeline``
+exports the trail's spans as Chrome trace-event JSON, loadable in
+Perfetto (https://ui.perfetto.dev) — "where did the time go", drawn.
+
+Torn trails are data, not errors: corrupt/truncated lines (a forensics
+dump written during a crash can end mid-line, even mid-UTF-8-sequence)
+are skipped and reported as ``skipped_lines: N``, never raised on.
 
 Deliberately dependency-light (no jax import): usable on a machine that
 only has the log files.
@@ -23,26 +30,7 @@ import argparse
 import json
 import sys
 
-
-def _read_events(path: str) -> tuple[list[dict], int]:
-    """Parse a JSONL trail; returns (events, skipped_lines). Corrupt
-    lines (crash-truncated tails) are counted, not fatal."""
-    events, skipped = [], 0
-    with open(path, encoding="utf-8") as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                skipped += 1
-                continue
-            if isinstance(rec, dict):
-                events.append(rec)
-            else:
-                skipped += 1
-    return events, skipped
+from tpuflow.obs.trail import read_events as _read_events
 
 
 def _tail(path: str, n: int) -> int:
@@ -50,7 +38,7 @@ def _tail(path: str, n: int) -> int:
     for rec in events[-n:]:
         print(json.dumps(rec))
     if skipped:
-        print(f"({skipped} unparseable line(s) skipped)", file=sys.stderr)
+        print(f"skipped_lines: {skipped}", file=sys.stderr)
     return 0
 
 
@@ -61,14 +49,15 @@ def _fmt_seconds(s: float) -> str:
 def _summary(path: str) -> int:
     events, skipped = _read_events(path)
     if not events:
-        print(f"{path}: no events" + (f" ({skipped} unparseable)" if skipped else ""))
+        print(f"{path}: no events"
+              + (f" (skipped_lines: {skipped})" if skipped else ""))
         return 1
     by_type: dict[str, int] = {}
     for rec in events:
         kind = str(rec.get("event", "?"))
         by_type[kind] = by_type.get(kind, 0) + 1
     print(f"{path}: {len(events)} events"
-          + (f" ({skipped} unparseable line(s) skipped)" if skipped else ""))
+          + (f" (skipped_lines: {skipped})" if skipped else ""))
     times = [rec["time"] for rec in events if isinstance(rec.get("time"), (int, float))]
     if times:
         print(f"  window: {_fmt_seconds(max(times) - min(times))} "
@@ -110,16 +99,42 @@ def _summary(path: str) -> int:
         print(f"  fit_done: epochs={rec.get('epochs')} "
               f"best_val_loss={rec.get('best_val_loss')} "
               f"samples_per_sec={rec.get('samples_per_sec')}")
+    anomalies = [
+        rec for rec in events if rec.get("event") == "numerics_anomaly"
+    ]
+    if anomalies:
+        kinds: dict[str, int] = {}
+        for rec in anomalies:
+            k = str(rec.get("kind", "?"))
+            kinds[k] = kinds.get(k, 0) + 1
+        print("  numerics anomalies: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(kinds.items())
+        ))
     dumps = [rec for rec in events if rec.get("event") == "forensics_dump"]
     if dumps:
         print(f"  forensics dump: reason={dumps[-1].get('reason')!r}")
     return 0
 
 
+def _timeline(path: str, out: str) -> int:
+    from tpuflow.obs.timeline import export_timeline
+
+    stats = export_timeline(path, out)
+    line = (f"{out}: {stats['events']} trace events "
+            f"({stats['spans']} spans)")
+    if stats["skipped_lines"]:
+        line += f"; skipped_lines: {stats['skipped_lines']}"
+    print(line)
+    if not stats["spans"]:
+        print(f"{path}: no span records to draw", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tpuflow.obs",
-        description="summarize/tail a tpuflow JSONL event trail",
+        description="summarize/tail/export a tpuflow JSONL event trail",
     )
     sub = ap.add_subparsers(dest="cmd", required=True)
     p_tail = sub.add_parser("tail", help="print the newest N records")
@@ -127,10 +142,18 @@ def main(argv: list[str] | None = None) -> int:
     p_tail.add_argument("-n", type=int, default=20)
     p_sum = sub.add_parser("summary", help="aggregate the whole trail")
     p_sum.add_argument("file")
+    p_tl = sub.add_parser(
+        "timeline",
+        help="export spans as Chrome trace-event JSON (Perfetto-loadable)",
+    )
+    p_tl.add_argument("file")
+    p_tl.add_argument("-o", "--out", default="trace.json")
     args = ap.parse_args(argv)
     try:
         if args.cmd == "tail":
             return _tail(args.file, args.n)
+        if args.cmd == "timeline":
+            return _timeline(args.file, args.out)
         return _summary(args.file)
     except OSError as e:
         print(f"{args.file}: {e}", file=sys.stderr)

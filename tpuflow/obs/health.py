@@ -1,0 +1,469 @@
+"""Training health: numerics watchdog, recompile detector, live roofline.
+
+PR 4 gave tpuflow a telemetry substrate (registry, spans, forensics);
+this module *interprets* a run while it is still running — the MFU
+accounting of PaLM (Chowdhery et al., 2022) and the always-on fleet
+profiling of Kanev et al. (PAPERS.md), scaled down to one training job:
+
+- :class:`NumericsWatchdog` — NaN/Inf and EWMA-spike detection over the
+  per-epoch ``loss``/``grad_norm`` aux the train steps already return.
+  Strictly host-side and strictly POST-epoch: the fit loop hands it
+  host floats after the epoch's device work is done, never per-step
+  inside the scanned program (the TPF006 lint contract). Anomalies
+  increment ``train_numerics_anomalies_total{kind=...}``, land in the
+  forensics ring (and a dump next to the artifacts), and — per the
+  configured policy — warn, halve the optimizer LR, or abort the run
+  with the typed :class:`NumericsDivergence` the supervisor classifies
+  as terminal (a diverged run replays deterministically; restarting it
+  burns the whole backoff budget on a foregone conclusion).
+
+- :class:`RecompileDetector` — counts XLA compilations per step
+  function by argument signature (shapes/dtypes of the data args). A
+  compile after the first signature is a *recompile*; a recompile after
+  the warmup epoch is *steady-state shape churn* — the failure mode
+  that looks exactly like slow hardware from the outside. Each one is
+  recorded as an ``xla.compile`` span (with the offending shapes), the
+  ``train_recompiles`` gauge tracks the count, and the run summary
+  carries a preflight-style diagnostic. :func:`install_compile_listener`
+  additionally counts every backend compile process-wide via
+  ``jax.monitoring``, where the running jax exposes it.
+
+- :func:`publish_roofline` — the live MFU leg: given this epoch's
+  samples/sec/chip and the model's FLOPs/bytes-per-sample
+  (``tpuflow/utils/roofline.py``), publishes ``train_mfu`` /
+  ``train_hbm_util`` / ``train_bound{bound=...}`` into the registry
+  (scraped at ``GET /metrics?format=prometheus``) and a ``roofline``
+  record into the run's metrics JSONL.
+
+Import-light by design: no jax at module import (the supervisor parent
+classifies :class:`NumericsDivergence` without touching a chip);
+``jax.monitoring`` is reached lazily and best-effort.
+"""
+
+from __future__ import annotations
+
+import math
+import sys
+import time
+
+from tpuflow.obs.forensics import dump_forensics, record_event
+from tpuflow.obs.metrics import default_registry
+from tpuflow.obs.tracing import record_span
+
+# The watchdog's policy vocabulary — validated by the preflight spec
+# pass (tpuflow/analysis/spec.py) so a typo'd policy dies at submission.
+HEALTH_POLICIES = ("warn", "abort", "halve_lr")
+# Values that disable the watchdog entirely.
+HEALTH_OFF = (None, "", "off", "none")
+
+
+class NumericsDivergence(RuntimeError):
+    """The numerics watchdog aborted a diverging run (``policy="abort"``).
+
+    ``epoch`` is the epoch the fatal anomaly landed on; ``anomalies`` is
+    the run's full anomaly trail (``{"epoch", "kind", "value"}`` dicts).
+    Deliberately distinct from ``CrashLoopError``: the supervisor treats
+    it as terminal WITHOUT burning restart-backoff attempts — a diverged
+    optimizer state replays deterministically from the checkpoint.
+    """
+
+    def __init__(self, message: str, epoch: int | None = None, anomalies=()):
+        super().__init__(message)
+        self.epoch = epoch
+        self.anomalies = list(anomalies)
+
+
+class NumericsWatchdog:
+    """Per-epoch numeric-health checks over already-host loss/grad aux.
+
+    The fit loop calls :meth:`observe_epoch` once per epoch with the
+    epoch's batch losses and grad norms as HOST floats (it converts
+    them post-epoch anyway for the epoch-mean log line — the watchdog
+    adds no device syncs and nothing inside jit). Detection:
+
+    - ``nan_loss`` / ``inf_loss`` / ``nan_grad`` / ``inf_grad``: any
+      non-finite value in the epoch's aux — the unambiguous signals.
+    - ``spike_loss`` / ``spike_grad``: the epoch mean exceeds
+      ``spike_factor`` x the EWMA of previous healthy epochs, after
+      ``warmup_epochs`` healthy epochs have seeded the EWMA. Anomalous
+      epochs never update the EWMA (a spike must not raise its own bar).
+
+    Policies: ``warn`` logs and continues; ``halve_lr`` scales the
+    optimizer's LR by 0.5 through the ``with_lr_scale`` leaf in the
+    optimizer state (up to ``max_halvings`` times, then warns);
+    ``abort`` raises :class:`NumericsDivergence`.
+    """
+
+    def __init__(
+        self,
+        policy: str = "warn",
+        *,
+        ewma_alpha: float = 0.3,
+        spike_factor: float = 10.0,
+        warmup_epochs: int = 1,
+        max_halvings: int = 4,
+        storage_path: str | None = None,
+        model_name: str = "model",
+        logger=None,
+        registry=None,
+        verbose: bool = True,
+    ):
+        if policy not in HEALTH_POLICIES:
+            raise ValueError(
+                f"unknown health policy {policy!r}; "
+                f"valid: {', '.join(HEALTH_POLICIES)}"
+            )
+        self.policy = policy
+        self.ewma_alpha = float(ewma_alpha)
+        self.spike_factor = float(spike_factor)
+        self.warmup_epochs = int(warmup_epochs)
+        self.max_halvings = int(max_halvings)
+        self.storage_path = storage_path
+        self.model_name = model_name
+        self.logger = logger
+        self.verbose = verbose
+        self.anomalies: list[dict] = []
+        self.halvings = 0
+        self._ewma_loss: float | None = None
+        self._ewma_grad: float | None = None
+        self._healthy_epochs = 0
+        self._dumped = False
+        self._counter = (registry or default_registry()).counter(
+            "train_numerics_anomalies_total",
+            "numerics anomalies (NaN/Inf/spike) detected by the training "
+            "watchdog, by kind",
+        )
+
+    # --- detection -----------------------------------------------------
+
+    @staticmethod
+    def _classify(values, nan_kind: str, inf_kind: str):
+        """(anomaly kind or None, representative value, finite mean)."""
+        finite, bad_kind, bad_value = [], None, None
+        for v in values:
+            v = float(v)
+            if math.isnan(v):
+                bad_kind, bad_value = nan_kind, v
+            elif math.isinf(v):
+                if bad_kind != nan_kind:  # nan outranks inf in the report
+                    bad_kind, bad_value = inf_kind, v
+            else:
+                finite.append(v)
+        mean = sum(finite) / len(finite) if finite else None
+        return bad_kind, bad_value, mean
+
+    def _spike(self, mean: float | None, ewma: float | None) -> bool:
+        if mean is None or ewma is None:
+            return False
+        if self._healthy_epochs < self.warmup_epochs:
+            return False
+        # The epsilon keeps a near-zero converged EWMA from flagging
+        # ordinary float noise as a 10x "spike".
+        return mean > self.spike_factor * max(ewma, 1e-12)
+
+    def observe_epoch(self, epoch: int, losses, grad_norms=None, state=None):
+        """Check one epoch's host-float aux; returns the (possibly
+        LR-halved) train state. Raises :class:`NumericsDivergence` under
+        ``policy="abort"``. ``losses``/``grad_norms`` are sequences of
+        host floats — convert device aux ONCE, after the epoch's batch
+        loop (TPF006)."""
+        found: list[dict] = []
+        kind, value, loss_mean = self._classify(
+            losses, "nan_loss", "inf_loss"
+        )
+        if kind:
+            found.append({"kind": kind, "value": value})
+        if grad_norms:
+            gkind, gvalue, grad_mean = self._classify(
+                grad_norms, "nan_grad", "inf_grad"
+            )
+            if gkind:
+                found.append({"kind": gkind, "value": gvalue})
+        else:
+            grad_mean = None
+        if not kind and self._spike(loss_mean, self._ewma_loss):
+            found.append({"kind": "spike_loss", "value": loss_mean})
+        if grad_norms and not any(
+            a["kind"] in ("nan_grad", "inf_grad") for a in found
+        ) and self._spike(grad_mean, self._ewma_grad):
+            found.append({"kind": "spike_grad", "value": grad_mean})
+
+        if not found:
+            # Healthy epoch: seed/advance the EWMAs.
+            a = self.ewma_alpha
+            if loss_mean is not None:
+                self._ewma_loss = (
+                    loss_mean if self._ewma_loss is None
+                    else a * loss_mean + (1 - a) * self._ewma_loss
+                )
+            if grad_mean is not None:
+                self._ewma_grad = (
+                    grad_mean if self._ewma_grad is None
+                    else a * grad_mean + (1 - a) * self._ewma_grad
+                )
+            self._healthy_epochs += 1
+            return state
+
+        for a in found:
+            a["epoch"] = epoch
+            self.anomalies.append(a)
+            self._counter.inc(kind=a["kind"])
+            record_event("numerics_anomaly", **a)
+            if self.logger is not None:
+                self.logger.write("numerics_anomaly", **a)
+        self._dump_once(found)
+        return self._apply_policy(epoch, found, state)
+
+    # --- response ------------------------------------------------------
+
+    def _dump_once(self, found: list[dict]) -> None:
+        """First anomaly dumps the forensics ring next to the artifacts —
+        even under ``warn``, the trail of what led up to the divergence
+        is the evidence the policy decision gets judged by later."""
+        if self._dumped or not self.storage_path:
+            return
+        self._dumped = True
+        from tpuflow.utils.paths import join_path
+
+        kinds = ",".join(a["kind"] for a in found)
+        dump_forensics(
+            join_path(self.storage_path, "forensics.jsonl"),
+            reason=f"numerics watchdog: {kinds} in {self.model_name}",
+        )
+
+    def _warn(self, message: str) -> None:
+        if self.verbose:
+            print(f"tpuflow.obs.health: {message}", file=sys.stderr)
+
+    def _apply_policy(self, epoch: int, found: list[dict], state):
+        kinds = ", ".join(f"{a['kind']}={a['value']:g}" for a in found)
+        if self.policy == "abort":
+            raise NumericsDivergence(
+                f"numerics watchdog aborting {self.model_name} at epoch "
+                f"{epoch}: {kinds} (policy=abort; a diverged run replays "
+                "deterministically — restarts cannot fix it)",
+                epoch=epoch,
+                anomalies=self.anomalies,
+            )
+        if self.policy == "halve_lr" and state is not None:
+            if self.halvings >= self.max_halvings:
+                self._warn(
+                    f"epoch {epoch}: {kinds}; LR already halved "
+                    f"{self.halvings}x (max_halvings reached) — continuing"
+                )
+                return state
+            from tpuflow.train.optim import scale_lr_in_state
+
+            scaled = scale_lr_in_state(state, 0.5)
+            if scaled is None:
+                self._warn(
+                    f"epoch {epoch}: {kinds}; policy=halve_lr but the "
+                    "optimizer state carries no with_lr_scale leaf "
+                    "(custom optimizer?) — warning instead"
+                )
+                return state
+            self.halvings += 1
+            record_event(
+                "lr_halved", epoch=epoch, halvings=self.halvings
+            )
+            if self.logger is not None:
+                self.logger.write(
+                    "lr_halved", epoch=epoch, halvings=self.halvings
+                )
+            self._warn(
+                f"epoch {epoch}: {kinds}; halving LR "
+                f"(x{0.5 ** self.halvings:g} total)"
+            )
+            return scaled
+        self._warn(f"epoch {epoch}: {kinds} (policy=warn; continuing)")
+        return state
+
+
+# --- recompile detection ----------------------------------------------
+
+
+def _arg_signature(args, kwargs) -> tuple:
+    """Shape/dtype fingerprint of a step call's data arguments. Array
+    leaves contribute ``(shape, dtype)``; everything else its type name
+    (a changed python-scalar VALUE is not a retrace — same shape/dtype
+    hits the same executable)."""
+    sig = []
+    for a in list(args) + sorted(kwargs.items()):
+        if isinstance(a, tuple) and len(a) == 2 and isinstance(a[0], str):
+            name, a = a
+            sig.append(name)
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is not None and dtype is not None:
+            sig.append((tuple(shape), str(dtype)))
+        else:
+            sig.append(type(a).__name__)
+    return tuple(sig)
+
+
+class RecompileDetector:
+    """Counts XLA compilations per wrapped step fn by data-arg signature.
+
+    ``wrap(fn, name)`` returns ``fn`` behind a signature check over its
+    NON-state arguments (the state's shapes are fixed for a run; the
+    batch args are where churn comes from). The first signature per
+    step is the expected compile; every later one is a recompile —
+    timed (the compile happens inside that call) and recorded as an
+    ``xla.compile`` span naming the offending shapes. The
+    ``train_recompiles`` gauge tracks the running count;
+    :meth:`summary` renders the run-report diagnostic, with recompiles
+    after ``steady_after`` flagged as steady-state shape churn.
+    """
+
+    def __init__(self, *, registry=None, logger=None):
+        self.events: list[dict] = []
+        self.epoch = 0
+        self.logger = logger
+        self._signatures: dict[str, set] = {}
+        self._gauge = (registry or default_registry()).gauge(
+            "train_recompiles",
+            "XLA recompilations observed by the current run (signature "
+            "churn on wrapped step functions)",
+        )
+        self._gauge.set(0.0)
+
+    def wrap(self, fn, name: str):
+        if fn is None:
+            return None
+        seen = self._signatures.setdefault(name, set())
+
+        def wrapped(*args, **kwargs):
+            sig = _arg_signature(args[1:], kwargs)
+            if sig in seen:
+                return fn(*args, **kwargs)
+            first = not seen
+            seen.add(sig)
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            if not first:
+                dur = time.perf_counter() - t0
+                event = {
+                    "epoch": self.epoch,
+                    "step": name,
+                    "signature": repr(sig),
+                }
+                self.events.append(event)
+                self._gauge.set(float(len(self.events)))
+                record_span(
+                    "xla.compile", dur, logger=self.logger, **event
+                )
+            return out
+
+        return wrapped
+
+    def summary(self, steady_after: int = 1) -> dict | None:
+        """The run-report diagnostic, or None when no recompiles fired.
+        ``steady_after``: recompiles at epochs strictly beyond it are
+        steady-state churn (the first epoch's compiles are the price of
+        admission; later ones mean the run never reaches a fixed set of
+        programs)."""
+        if not self.events:
+            return None
+        steady = [e for e in self.events if e["epoch"] > steady_after]
+        rec = {
+            "recompiles": len(self.events),
+            "steady_state": len(steady),
+            "by_step": sorted({e["step"] for e in self.events}),
+            "last_signature": self.events[-1]["signature"],
+        }
+        if steady:
+            rec["diagnostic"] = (
+                f"{len(steady)} steady-state XLA recompile(s) after epoch "
+                f"{steady_after} (steps: {', '.join(rec['by_step'])}; last "
+                f"shapes {rec['last_signature']}) — shape churn makes a "
+                "run look like slow hardware; pad/bucket batch shapes"
+            )
+        return rec
+
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_LISTENER = {"installed": False}
+
+
+def install_compile_listener(registry=None) -> bool:
+    """Count every XLA backend compile process-wide into
+    ``xla_compilations_total`` via ``jax.monitoring``, where available.
+    Idempotent and best-effort: returns False (and stays silent) on a
+    jax without the monitoring surface — the per-run
+    :class:`RecompileDetector` wrapper is the portable fallback."""
+    if _LISTENER["installed"]:
+        return True
+    try:
+        from jax import monitoring
+    except Exception:
+        return False
+    counter = (registry or default_registry()).counter(
+        "xla_compilations_total",
+        "XLA backend compilations in this process (jax.monitoring)",
+    )
+
+    def _on_event(name: str, duration: float, **_kw) -> None:
+        if name == _COMPILE_EVENT:
+            counter.inc()
+
+    try:
+        monitoring.register_event_duration_secs_listener(_on_event)
+    except Exception:
+        return False
+    _LISTENER["installed"] = True
+    return True
+
+
+# --- live MFU / roofline ----------------------------------------------
+
+
+def publish_roofline(
+    samples_per_sec_per_chip: float,
+    flops_per_sample: float,
+    bytes_per_sample: float,
+    device_kind: str,
+    *,
+    registry=None,
+    logger=None,
+    epoch: int | None = None,
+) -> dict:
+    """One live roofline reading: MFU/HBM-util/bound for the epoch just
+    measured, published as ``train_mfu`` / ``train_hbm_util`` /
+    ``train_bound{bound=...}`` gauges (rendered by
+    ``GET /metrics?format=prometheus`` via the default registry) and a
+    ``roofline`` record in the run's metrics JSONL. On a chip without a
+    peaks entry (cpu) the gauges are left untouched — an MFU of 0.0 for
+    "unknown chip" would read as a real measurement — but the JSONL
+    record still lands, carrying the verdict string."""
+    from tpuflow.utils.roofline import roofline_report
+
+    rep = roofline_report(
+        samples_per_sec_per_chip, flops_per_sample, bytes_per_sample,
+        device_kind,
+    )
+    reg = registry or default_registry()
+    if rep.get("mfu") is not None:
+        reg.gauge(
+            "train_mfu",
+            "model FLOPs utilization of the last measured epoch",
+        ).set(rep["mfu"])
+        reg.gauge(
+            "train_hbm_util",
+            "HBM bandwidth utilization of the last measured epoch",
+        ).set(rep["hbm_util"])
+        bound = reg.gauge(
+            "train_bound",
+            "what bounds the run: the bound=... label with value 1",
+        )
+        for b in ("hbm", "mxu"):
+            bound.set(1.0 if rep["bound"] == b else 0.0, bound=b)
+    if logger is not None:
+        logger.write(
+            "roofline",
+            epoch=epoch,
+            samples_per_sec_per_chip=round(
+                float(samples_per_sec_per_chip), 3
+            ),
+            **rep,
+        )
+    return rep

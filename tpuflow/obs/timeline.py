@@ -1,0 +1,168 @@
+"""Chrome trace-event export: any tpuflow span trail, loadable in Perfetto.
+
+``python -m tpuflow.obs timeline <trail.jsonl> -o trace.json`` converts
+the span records every tpuflow sink writes — a training run's
+``metrics.jsonl`` (ingest/step/eval/checkpoint spans, xla.compile
+recompile spans), a crash dump's ``forensics.jsonl``, a serve journal's
+``predict.dispatch`` spans — into the Chrome trace-event JSON format
+(https://ui.perfetto.dev loads it directly; chrome://tracing too).
+
+Span records carry an END wall-clock ``time`` and a ``duration_s``
+(they are emitted when the timed block finishes), so each becomes one
+complete ``"ph": "X"`` event at ``ts = time - duration_s``, normalized
+to the trail's earliest span start. Point events worth seeing on the
+timeline (``numerics_anomaly``, ``lr_halved``, ``fault_injected``,
+``forensics_dump``) become instant ``"ph": "i"`` marks. Events are
+sorted by ``ts``; thread-name metadata rows group spans into train /
+serving / xla lanes.
+
+Deliberately dependency-light (no jax import): usable on a machine that
+only has the log files.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+from tpuflow.obs.trail import read_events
+
+# Span-name prefix -> (tid, lane name). Longest match wins; unmatched
+# names land in the "other" lane rather than being dropped.
+_LANES = (
+    ("predict", 2, "serving"),
+    ("serve", 2, "serving"),
+    ("xla", 3, "xla"),
+)
+_TRAIN_TID, _OTHER_TID = 1, 9
+_TRAIN_NAMES = {"ingest", "step", "eval", "checkpoint"}
+_INSTANT_EVENTS = {
+    "numerics_anomaly", "lr_halved", "fault_injected", "forensics_dump",
+    "supervisor_attempt_died",
+}
+_PID = 1
+
+
+def _lane(name: str) -> tuple[int, str]:
+    if name in _TRAIN_NAMES:
+        return _TRAIN_TID, "train"
+    for prefix, tid, lane in _LANES:
+        if name.startswith(prefix):
+            return tid, lane
+    return _OTHER_TID, "other"
+
+
+def _finite(v):
+    """Non-finite floats become strings: an inf_loss anomaly's value IS
+    infinity, and ``json.dump`` would write a bare ``Infinity`` token —
+    invalid per RFC 8259, rejected by Perfetto, exactly when the anomaly
+    marks are the thing the user opened the trace to see."""
+    if isinstance(v, float) and not math.isfinite(v):
+        return repr(v)
+    return v
+
+
+def _args(rec: dict) -> dict:
+    """Everything the record carries beyond the envelope, for Perfetto's
+    detail pane (epoch, trace_id, shapes, ...)."""
+    return {
+        k: _finite(v) for k, v in rec.items()
+        if k not in ("event", "time", "ts", "seq", "name", "duration_s")
+        and v is not None
+    }
+
+
+def to_trace_events(events: list[dict]) -> dict:
+    """Convert parsed trail records into a Chrome trace-event document:
+    ``{"traceEvents": [...], "displayTimeUnit": "ms"}``. Spans become
+    complete ``X`` events (microsecond ``ts``/``dur``, sorted by
+    ``ts``); known point events become instant ``i`` marks; metadata
+    ``M`` rows (emitted first) name the lanes."""
+    spans, instants = [], []
+    for rec in events:
+        kind = rec.get("event")
+        t = rec.get("time")
+        # Finite-only envelope: a NaN time/duration would poison ts/dur
+        # into tokens JSON cannot carry (anomaly VALUES may be non-finite
+        # — _finite stringifies those in args).
+        if not isinstance(t, (int, float)) or not math.isfinite(t):
+            continue
+        dur = rec.get("duration_s")
+        if kind == "span" and isinstance(dur, (int, float)) and (
+            math.isfinite(dur)
+        ):
+            spans.append(rec)
+        elif kind in _INSTANT_EVENTS:
+            instants.append(rec)
+    if not spans and not instants:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    starts = [r["time"] - r["duration_s"] for r in spans]
+    starts += [r["time"] for r in instants]
+    base = min(starts)
+
+    out: list[dict] = []
+    lanes_used: dict[int, str] = {}
+    for rec in spans:
+        name = str(rec.get("name", "span"))
+        tid, lane = _lane(name)
+        lanes_used[tid] = lane
+        out.append({
+            "name": name,
+            "cat": lane,
+            "ph": "X",
+            "ts": round((rec["time"] - rec["duration_s"] - base) * 1e6, 3),
+            "dur": round(float(rec["duration_s"]) * 1e6, 3),
+            "pid": _PID,
+            "tid": tid,
+            "args": _args(rec),
+        })
+    for rec in instants:
+        # Marks follow their subject: a fault injected at a serving
+        # site must line up with the dispatch spans it interrupted,
+        # not sit in the train lane.
+        site = str(rec.get("site", ""))
+        tid, lane = (
+            _lane(site) if site else (_TRAIN_TID, "train")
+        )
+        if lane == "other":
+            tid, lane = _TRAIN_TID, "train"
+        lanes_used.setdefault(tid, lane)
+        out.append({
+            "name": str(rec["event"]),
+            "cat": "marker",
+            "ph": "i",
+            "s": "p",  # process-scoped mark: visible across the lanes
+            "ts": round((rec["time"] - base) * 1e6, 3),
+            "pid": _PID,
+            "tid": tid,
+            "args": _args(rec),
+        })
+    out.sort(key=lambda e: (e["ts"], e.get("dur", 0.0)))
+    meta = [
+        {
+            "name": "thread_name",
+            "ph": "M",
+            "pid": _PID,
+            "tid": tid,
+            "args": {"name": lane},
+        }
+        for tid, lane in sorted(lanes_used.items())
+    ]
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+
+
+def export_timeline(trail_path: str, out_path: str) -> dict:
+    """Read ``trail_path`` (tolerantly — torn lines are skipped, not
+    fatal) and write the trace-event JSON to ``out_path``. Returns
+    ``{"events", "spans", "skipped_lines"}`` for the caller's report."""
+    events, skipped = read_events(trail_path)
+    doc = to_trace_events(events)
+    n_spans = sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+        f.write("\n")
+    return {
+        "events": len(doc["traceEvents"]),
+        "spans": n_spans,
+        "skipped_lines": skipped,
+    }

@@ -1,0 +1,42 @@
+"""Tolerant JSONL trail reading, shared by every log-consuming tool.
+
+A trail written during a crash (a forensics dump racing a dying
+process, a metrics file on a preempted VM) can end mid-line — or
+mid-UTF-8-sequence. Every reader of the format (``python -m tpuflow.obs
+tail|summary|timeline``) must treat that as data loss to REPORT, not an
+exception to die on: the whole point of the trail is to be readable
+after something went wrong.
+
+Deliberately dependency-light (no jax import): usable on a machine that
+only has the log files.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def read_events(path: str) -> tuple[list[dict], int]:
+    """Parse a JSONL trail; returns ``(events, skipped_lines)``.
+
+    Corrupt lines — crash-truncated tails, torn multi-byte sequences,
+    non-object records — are counted, never fatal. ``errors="replace"``
+    on the decode: a line torn mid-UTF-8-sequence must skip THAT line,
+    not raise ``UnicodeDecodeError`` over the readable rest of the file.
+    """
+    events, skipped = [], 0
+    with open(path, encoding="utf-8", errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                skipped += 1
+                continue
+            if isinstance(rec, dict):
+                events.append(rec)
+            else:
+                skipped += 1
+    return events, skipped

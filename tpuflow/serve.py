@@ -34,7 +34,12 @@ API (JSON in/out):
   per-request latency percentiles (p50/p99), and — with batching on —
   the coalesced-dispatch counters and batch-size histogram.
   ``?format=prometheus`` returns the same registry as Prometheus text
-  exposition (tpuflow/obs; docs/observability.md has the scrape config).
+  exposition (tpuflow/obs; docs/observability.md has the scrape config),
+  plus the process-wide default registry — including the training
+  health-monitor families (``train_numerics_anomalies_total``,
+  ``train_recompiles``, ``train_mfu``/``train_bound``) when this
+  process also trains (the job-runner's children train out-of-process;
+  their anomalies surface in the job report and forensics instead).
 
 Concurrent /predict traffic can take the serving fast path (off by
 default; ``--batch-predicts``, ``--warmup-buckets``,
@@ -144,10 +149,33 @@ def spec_to_config(spec: dict):
     return TrainJobConfig(**kwargs)
 
 
+def _json_finite(value):
+    """Stringify non-finite floats, recursively: a DIVERGED run's report
+    is exactly where inf/nan losses appear (best_val_loss=inf when no
+    epoch ever improved, an inf_loss anomaly's value), and ``json.dumps``
+    would write RFC-8259-invalid ``Infinity``/``NaN`` tokens that break
+    every strict reader of the job report."""
+    import math
+
+    if isinstance(value, float) and not math.isfinite(value):
+        return repr(value)
+    if isinstance(value, dict):
+        return {k: _json_finite(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_json_finite(v) for v in value]
+    return value
+
+
 def report_to_dict(report) -> dict:
     """The JSON the web layer reads: the reference's elapsed-time +
-    test-loss print (cnn.py:133-134), recorded."""
-    return {
+    test-loss print (cnn.py:133-134), recorded — plus the health
+    monitor's outcomes (a job that diverged under ``health="warn"`` or
+    churned recompiles must say so in the report an operator reads, not
+    only in the forensics file). Health keys are additive and
+    getattr-guarded so a minimal report object (tests) still serializes;
+    every value is JSON-finite (non-finite floats become strings).
+    """
+    out = {
         "test_loss": report.test_loss,
         "test_mae": report.test_mae,
         "gilbert_mae": report.gilbert_mae,
@@ -156,6 +184,13 @@ def report_to_dict(report) -> dict:
         "epochs_ran": report.result.epochs_ran,
         "best_val_loss": report.result.best_val_loss,
     }
+    anomalies = getattr(report, "anomalies", None)
+    if anomalies:
+        out["numerics_anomalies"] = list(anomalies)
+    recompiles = getattr(report, "recompiles", None)
+    if recompiles:
+        out["recompiles"] = dict(recompiles)
+    return _json_finite(out)
 
 
 class JobRunner:

@@ -128,6 +128,21 @@ class FitConfig:
     # alive. Best-effort: a failing write logs once and never kills
     # training.
     progress_path: str | None = None
+    # Numerics watchdog (tpuflow/obs/health.py): per-epoch host-side
+    # NaN/Inf + EWMA-spike checks over the loss/grad_norm aux the steps
+    # already return, read POST-epoch (never per-step inside the scanned
+    # body — TPF006). "warn" | "halve_lr" | "abort"; None/"off" disables.
+    health: str | None = "warn"
+    # Live roofline context: {"flops_per_sample", "bytes_per_sample",
+    # "n_chips"} for the model being trained (tpuflow/utils/roofline.py
+    # model_cost_per_sample). When set, every epoch publishes train_mfu /
+    # train_hbm_util / train_bound gauges and a "roofline" JSONL record.
+    roofline: dict | None = None
+    # Recompile detection: wrap the step fns in a data-arg signature
+    # check; steady-state signature churn (recompiles after the first
+    # epoch) is surfaced as xla.compile spans, the train_recompiles
+    # gauge, and a diagnostic in FitResult.recompiles.
+    detect_recompiles: bool = True
 
 
 @dataclass
@@ -140,6 +155,11 @@ class FitResult:
     best_val_loss: float = float("inf")
     epochs_ran: int = 0
     samples_per_sec: float = 0.0
+    # Health monitor outcomes (tpuflow/obs/health.py): the watchdog's
+    # anomaly trail ({"epoch","kind","value"} dicts; empty = healthy)
+    # and the recompile detector's summary (None = no recompiles).
+    anomalies: list = field(default_factory=list)
+    recompiles: dict | None = None
 
     def report(self) -> str:
         """The reference's final report (cnn.py:133-134), working and extended."""
@@ -246,7 +266,15 @@ def fit(
     # span events for where each epoch's time went. Recording happens
     # OUTSIDE the jitted step/epoch programs — values observed are
     # already host floats (TPF005's contract).
-    from tpuflow.obs import default_registry, record_span
+    from tpuflow.obs import (
+        NumericsWatchdog,
+        RecompileDetector,
+        default_registry,
+        install_compile_listener,
+        publish_roofline,
+        record_span,
+    )
+    from tpuflow.obs.health import HEALTH_OFF
 
     _reg = default_registry()
     _epochs_total = _reg.counter(
@@ -257,6 +285,30 @@ def fit(
     )
     _epoch_seconds = _reg.histogram(
         "train_epoch_seconds", "wall-clock per completed epoch"
+    )
+
+    # --- the health monitor (tpuflow/obs/health.py) ---
+    watchdog = None
+    if config.health not in HEALTH_OFF:
+        watchdog = NumericsWatchdog(
+            config.health,
+            storage_path=config.storage_path,
+            model_name=config.model_name,
+            logger=mlog,
+            verbose=config.verbose,
+        )
+    detector = None
+    if config.detect_recompiles:
+        install_compile_listener()  # process-wide count, best-effort
+        detector = RecompileDetector(logger=mlog)
+        train_step = detector.wrap(train_step, "train_step")
+        eval_step = detector.wrap(eval_step, "eval_step")
+        epoch_step = detector.wrap(epoch_step, "epoch_step")
+    # Live MFU context: the chip this run dispatches to (roofline peaks
+    # are keyed by device_kind; "cpu" reports honestly as unknown).
+    _device_kind = (
+        getattr(jax.devices()[0], "device_kind", "unknown")
+        if config.roofline else None
     )
 
     # The legacy fault_epoch knob, re-expressed as a registry drill: an
@@ -301,6 +353,8 @@ def fit(
             # supervisor classifies (vs train.epoch_end, whose crash is
             # survived by this epoch's checkpoint).
             fault_point("train.epoch_start", index=epoch)
+            if detector is not None:
+                detector.epoch = epoch
             te = time.time()
             tracing = config.trace_dir is not None and epoch == start_epoch
             if tracing:
@@ -315,10 +369,13 @@ def fit(
                     state, xs, ys, jax.random.fold_in(rng, epoch)
                 )
                 train_loss = float(epoch_loss)
+                epoch_losses_host = [train_loss]
+                epoch_grads_host = []  # the scanned program returns no aux
                 samples_seen += xs.shape[0] * xs.shape[1]
                 last_device_value = epoch_loss
             else:
                 train_losses = []
+                grad_norms = []
                 if isinstance(train_ds, StreamingSource):
                     epoch_batches = train_ds.epoch_batches(epoch)
                 else:
@@ -334,8 +391,15 @@ def fit(
                         sharding=batch_sharding,
                     )
                 for x, y in epoch_batches:
+                    # Device references only inside the batch loop: a
+                    # float() here would sync the device once per step
+                    # and serialize the dispatch pipeline — host
+                    # conversion happens ONCE, post-epoch (TPF006).
                     state, metrics = train_step(state, x, y, rng)
                     train_losses.append(metrics["loss"])
+                    g = metrics.get("grad_norm")
+                    if g is not None:
+                        grad_norms.append(g)
                     samples_seen += len(x)
                 if not train_losses:
                     if tracing:  # don't leave the profiler trace open
@@ -346,20 +410,37 @@ def fit(
                         "silent no-op reporting NaN loss (dataset/stream split "
                         "smaller than one batch?)"
                     )
-                train_loss = float(np.mean([float(l) for l in train_losses]))
+                epoch_losses_host = [float(l) for l in train_losses]
+                epoch_grads_host = [float(g) for g in grad_norms]
+                train_loss = float(np.mean(epoch_losses_host))
                 last_device_value = train_losses[-1]
             if tracing:
                 # device_get: block_until_ready is not a reliable sync
                 # point on the relay backend (benchmarks/common.py::drain).
                 jax.device_get(last_device_value)
                 jax.profiler.stop_trace()
+            if watchdog is not None:
+                # Post-epoch, host floats only — and strictly AFTER the
+                # profiler stop above: an abort raised mid-trace would
+                # leak the open trace into the next fit() in this
+                # process. abort raises the typed NumericsDivergence out
+                # of the loop (the finally still drains checkpoints);
+                # halve_lr hands back a state whose optimizer LR-scale
+                # leaf was halved — same pytree structure, no recompile.
+                state = watchdog.observe_epoch(
+                    epoch, epoch_losses_host, epoch_grads_host, state
+                )
+                result.anomalies = watchdog.anomalies
 
             # The "step" span: this epoch's training phase (all batches),
             # measured before validation starts — with the eval span
-            # below it answers "train or eval?" for a slow epoch.
-            record_span(
-                "step", time.time() - te, logger=mlog, epoch=epoch
-            )
+            # below it answers "train or eval?" for a slow epoch. The
+            # same duration feeds the live-MFU math below: the roofline
+            # divides TRAIN samples, so it must divide train time, not
+            # train+eval (an inflated denominator would understate MFU
+            # against the bench.py numbers it is documented to match).
+            train_time = time.time() - te
+            record_span("step", train_time, logger=mlog, epoch=epoch)
             t_eval = time.perf_counter()
             val = _eval_dataset(eval_step, state, val_ds, config.batch_size)
             record_span(
@@ -414,6 +495,22 @@ def fit(
                 )
             result.epochs_ran = epoch
             _epochs_total.inc()
+            if config.roofline:
+                # Live MFU: this epoch's measured samples/sec/chip
+                # against the model's FLOPs/bytes cost — the roofline
+                # math bench.py runs offline, published mid-run through
+                # the registry (GET /metrics?format=prometheus) and the
+                # run's metrics JSONL.
+                publish_roofline(
+                    (samples_seen - samples_counted)
+                    / max(train_time, 1e-9)
+                    / max(int(config.roofline.get("n_chips", 1)), 1),
+                    config.roofline["flops_per_sample"],
+                    config.roofline["bytes_per_sample"],
+                    _device_kind,
+                    logger=mlog,
+                    epoch=epoch,
+                )
             # Per-epoch delta, not a bulk add at fit end: a scrape
             # mid-run must see live throughput, and a crashed run must
             # still have counted the samples it consumed.
@@ -431,6 +528,11 @@ def fit(
         result.time_elapsed = time.time() - t0
         result.samples_per_sec = samples_seen / max(result.time_elapsed, 1e-9)
         result.state = state
+        if detector is not None:
+            # Steady state starts after the run's first epoch: its
+            # compiles are the price of admission; recompiles beyond it
+            # are shape churn (the run-summary diagnostic).
+            result.recompiles = detector.summary(steady_after=start_epoch)
         if mlog is not None:
             mlog.write(
                 "fit_done",

@@ -8,7 +8,80 @@ The reference's optimizer (SURVEY.md C11, reference cnn.py:117-118):
 
 from __future__ import annotations
 
+from typing import Any, NamedTuple
+
 import optax
+
+
+class LrScaleState(NamedTuple):
+    """State of :func:`with_lr_scale`: the wrapped optimizer's state plus
+    a multiplicative LR scale as a REAL pytree leaf — host code can
+    replace it between epochs (the numerics watchdog's ``halve_lr``
+    policy) without retracing the jitted step, because it is data, not
+    a static closure constant."""
+
+    inner: Any
+    lr_scale: Any
+
+
+def with_lr_scale(tx: optax.GradientTransformation) -> optax.GradientTransformation:
+    """Wrap ``tx`` so its final updates are multiplied by a runtime LR
+    scale carried in the optimizer state (initially 1.0 — a no-op).
+
+    Outermost by construction in :func:`wrap_optimizer`: the scale
+    applies to whatever update the clip/accumulate/base chain produced,
+    so halving the scale halves the effective learning rate exactly.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def init(params):
+        return LrScaleState(
+            inner=tx.init(params), lr_scale=jnp.asarray(1.0, jnp.float32)
+        )
+
+    def update(grads, state, params=None):
+        updates, inner = tx.update(grads, state.inner, params)
+        scaled = jax.tree_util.tree_map(
+            lambda u: u * state.lr_scale.astype(u.dtype), updates
+        )
+        return scaled, LrScaleState(inner=inner, lr_scale=state.lr_scale)
+
+    return optax.GradientTransformation(init, update)
+
+
+def scale_lr_in_state(state, factor: float):
+    """Multiply the ``lr_scale`` leaf inside a TrainState's optimizer
+    state by ``factor``; returns the new state, or None when the
+    optimizer was not built through :func:`wrap_optimizer` (no
+    :class:`LrScaleState` anywhere — e.g. a hand-rolled optax chain).
+    Pure host-side pytree surgery: same leaf shapes/dtypes, so the next
+    jitted step reuses its compiled executable."""
+    found = [False]
+
+    def visit(node):
+        if isinstance(node, LrScaleState):
+            found[0] = True
+            return LrScaleState(
+                inner=visit(node.inner),
+                lr_scale=node.lr_scale * factor,
+            )
+        if isinstance(node, tuple):
+            rebuilt = [visit(c) for c in node]
+            return (
+                type(node)(*rebuilt) if hasattr(node, "_fields")
+                else tuple(rebuilt)
+            )
+        if isinstance(node, list):
+            return [visit(c) for c in node]
+        if isinstance(node, dict):
+            return {k: visit(v) for k, v in node.items()}
+        return node
+
+    new_opt_state = visit(state.opt_state)
+    if not found[0]:
+        return None
+    return state.replace(opt_state=new_opt_state)
 
 
 def keras_sgd(
@@ -68,6 +141,11 @@ def wrap_optimizer(
     accumulator, so each micro-batch gradient is clipped before it
     enters the average — one spiky micro-batch can't dominate the
     window.
+
+    The whole chain is wrapped OUTERMOST in :func:`with_lr_scale`, a
+    runtime LR multiplier (1.0 until touched) living in the optimizer
+    state — the seam the numerics watchdog's ``halve_lr`` policy turns
+    without recompiling the step.
     """
     if clip_norm < 0:
         # A negative max_norm would sign-flip every update in
@@ -82,4 +160,4 @@ def wrap_optimizer(
         tx = optax.MultiSteps(tx, accumulate_steps).gradient_transformation()
     if clip_norm:
         tx = optax.chain(optax.clip_by_global_norm(clip_norm), tx)
-    return tx
+    return with_lr_scale(tx)

@@ -97,22 +97,55 @@ class RunCheckpointer:
             "opt_state": state_template.opt_state,
             "step": state_template.step,
         }
-        abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, tree)
 
-        def _restore():
-            fault_point("checkpoint.restore", index=epoch)
-            return self._mngr.restore(
-                epoch,
-                args=ocp.args.Composite(
-                    state=ocp.args.StandardRestore(abstract),
-                    loop=ocp.args.JsonRestore(),
-                ),
+        def _restore_with(target_tree):
+            abstract = jax.tree_util.tree_map(
+                ocp.utils.to_shape_dtype_struct, target_tree
             )
 
-        out = retry_call(io_policy(), _restore)
+            def _restore():
+                fault_point("checkpoint.restore", index=epoch)
+                return self._mngr.restore(
+                    epoch,
+                    args=ocp.args.Composite(
+                        state=ocp.args.StandardRestore(abstract),
+                        loop=ocp.args.JsonRestore(),
+                    ),
+                )
+
+            return retry_call(io_policy(), _restore)
+
+        try:
+            out = _restore_with(tree)
+            opt_state = out["state"]["opt_state"]
+        except (ValueError, KeyError, TypeError) as primary:
+            # Pre-LR-scale checkpoint compat: wrap_optimizer now always
+            # installs the with_lr_scale leaf, so a checkpoint written
+            # before that change carries the UNWRAPPED opt_state
+            # structure. Retry the restore against the inner template
+            # and rewrap with the template's fresh scale (1.0 — an old
+            # run never touched it). If the legacy attempt ALSO fails,
+            # the checkpoint's problem was never the wrapper — re-raise
+            # the PRIMARY error (a corrupt new-format checkpoint must
+            # report its own corruption, not the fallback's structure
+            # complaint).
+            from tpuflow.train.optim import LrScaleState
+
+            if not isinstance(state_template.opt_state, LrScaleState):
+                raise
+            try:
+                out = _restore_with(
+                    dict(tree, opt_state=state_template.opt_state.inner)
+                )
+            except Exception:
+                raise primary from None
+            opt_state = LrScaleState(
+                inner=out["state"]["opt_state"],
+                lr_scale=state_template.opt_state.lr_scale,
+            )
         state = state_template.replace(
             params=out["state"]["params"],
-            opt_state=out["state"]["opt_state"],
+            opt_state=opt_state,
             step=out["state"]["step"],
         )
         return state, dict(out["loop"])

@@ -36,6 +36,10 @@ def make_train_step(loss_fn: LossFn = mae_clip, donate: bool = True):
         loss, grads = jax.value_and_grad(loss_of)(state.params)
         state = state.apply_gradients(grads=grads)
         gnorm = optax_global_norm(grads)
+        # The aux CONTRACT: loss/grad_norm stay device values through
+        # the epoch's batch loop and feed the numerics watchdog as host
+        # floats only post-epoch (tpuflow/obs/health.py; lint TPF006) —
+        # a float() per step here would serialize async dispatch.
         return state, {"loss": loss, "grad_norm": gnorm}
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())

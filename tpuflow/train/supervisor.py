@@ -66,7 +66,16 @@ import tempfile
 import time
 from dataclasses import dataclass, field
 
+from tpuflow.obs.health import NumericsDivergence
 from tpuflow.resilience.retry import RetryPolicy
+
+# The child's exit code when the numerics watchdog aborts a diverging
+# run (policy="abort"). A dedicated code because the parent must CLASSIFY
+# it: a diverged optimizer replays deterministically from the checkpoint,
+# so restart-backoff would burn the whole budget re-diverging — the
+# supervisor raises NumericsDivergence immediately instead (terminal,
+# like CrashLoopError but without needing N deaths to prove itself).
+NUMERICS_EXIT_CODE = 86
 
 
 class CrashLoopError(RuntimeError):
@@ -214,8 +223,10 @@ def supervise(
     after an exponential-backoff delay. Returns once an attempt exits
     cleanly. Raises :class:`CrashLoopError` when ``crash_loop_threshold``
     consecutive attempts die at the same progress epoch (deterministic
-    failure — restarts are futile), or ``RuntimeError`` after
-    ``max_restarts`` restarts all die. ``stall_timeout`` kills an attempt
+    failure — restarts are futile), :class:`NumericsDivergence` the
+    moment a child exits with ``NUMERICS_EXIT_CODE`` (the numerics
+    watchdog's abort — terminal on the first death, no restart churn),
+    or ``RuntimeError`` after ``max_restarts`` restarts all die. ``stall_timeout`` kills an attempt
     whose progress file stops changing for that many seconds; ``timeout``
     caps the whole attempt. ``sleep`` is injectable for tests.
     """
@@ -229,6 +240,10 @@ def supervise(
     _crash_loops = _reg.counter(
         "supervisor_crash_loops_total",
         "runs aborted by crash-loop classification",
+    )
+    _numerics_aborts = _reg.counter(
+        "supervisor_numerics_aborts_total",
+        "runs classified terminal after a numerics-watchdog abort",
     )
     storage = spec.get("storagePath") or spec.get("storage_path")
 
@@ -295,6 +310,28 @@ def supervise(
                 )
             progress = _read_progress(progress_path)
             progress_epoch = progress["epoch"] if progress else None
+            if rc == NUMERICS_EXIT_CODE:
+                # The watchdog's abort is a CLASSIFICATION, not a crash:
+                # the child examined its own numerics and declared the
+                # run doomed. Terminal on the FIRST death — no
+                # restart-backoff churn, no N-deaths crash-loop proof.
+                _numerics_aborts.inc()
+                record_event(
+                    "supervisor_numerics_divergence", attempt=attempt,
+                    progress_epoch=progress_epoch,
+                )
+                _dump(
+                    f"numerics divergence at epoch {progress_epoch} "
+                    "(watchdog abort; terminal)"
+                )
+                raise NumericsDivergence(
+                    "numerics watchdog aborted the run (policy=abort): "
+                    "a diverged run replays deterministically — "
+                    "restarting would burn the backoff budget "
+                    "re-diverging; last stderr: "
+                    f"{_tail(stderr_text)}",
+                    epoch=progress_epoch,
+                )
             record_event(
                 "supervisor_attempt_died", attempt=attempt, rc=rc,
                 kind=kind or "crash", progress_epoch=progress_epoch,
@@ -365,14 +402,24 @@ def supervise(
 
 
 def _child(spec_path: str, out_path: str) -> None:
-    """One attempt: run train() from the spec, write the report JSON."""
+    """One attempt: run train() from the spec, write the report JSON.
+
+    A :class:`NumericsDivergence` escaping train() exits with the
+    dedicated ``NUMERICS_EXIT_CODE`` so the parent can classify the
+    death as terminal instead of restart-worthy — the message rides
+    stderr like any other failure (the parent's ``stderr_tail``).
+    """
     from tpuflow.api import train
     from tpuflow.serve import report_to_dict, spec_to_config
 
     with open(spec_path, encoding="utf-8") as f:
         spec = json.load(f)
     config = spec_to_config(spec)
-    report = train(config)
+    try:
+        report = train(config)
+    except NumericsDivergence as e:
+        print(f"NumericsDivergence: {e}", file=sys.stderr)
+        sys.exit(NUMERICS_EXIT_CODE)
     rep = report_to_dict(report)
     with open(out_path, "w", encoding="utf-8") as f:
         json.dump(rep, f)

@@ -96,6 +96,59 @@ def attention_bytes_per_sample_step(
     return itemsize * layers * (4.0 * act + scores)
 
 
+def model_cost_per_sample(
+    model: str,
+    *,
+    window: int,
+    features: int,
+    model_kwargs: dict | None = None,
+    itemsize: int = 4,
+) -> tuple[float, float] | None:
+    """(FLOPs, HBM bytes) per sample per train step for the model
+    families with a cost model — the live-MFU feed (the fit loop
+    publishes ``train_mfu``/``train_bound`` from this each epoch).
+
+    Covers the sequence families whose arithmetic the module already
+    models: the LSTM stack (per layer, the first layer consuming
+    ``features`` and deeper layers ``hidden``) and the causal
+    transformer. Returns None for families without a model (MLPs, the
+    residual-MLP hybrids) — an absent MFU is honest, a guessed one is
+    noise. Defaults mirror the model registry (hidden 64; stacked_lstm
+    2 layers; attention dim 64 x 2 layers); ``itemsize`` defaults to 4
+    (the models' float32 default — bench.py passes 2 for its bf16
+    sweeps).
+    """
+    kw = model_kwargs or {}
+    if model in ("lstm", "stacked_lstm", "lstm_residual"):
+        hidden = int(kw.get("hidden", 64))
+        layers = int(
+            kw.get("num_layers", 2 if model == "stacked_lstm" else 1)
+        )
+        flops = bytes_ = 0.0
+        for i in range(layers):
+            f_in = features if i == 0 else hidden
+            flops += lstm_flops_per_sample_step(window, f_in, hidden)
+            bytes_ += lstm_bytes_per_sample_step(
+                window, f_in, hidden, itemsize
+            )
+        return flops, bytes_
+    if model == "attention":
+        dim = int(kw.get("dim", 64))
+        layers = int(kw.get("num_layers", 2))
+        score_heads = (
+            int(kw.get("heads", 4))
+            if kw.get("backend", "full") == "full"
+            else 0
+        )
+        return (
+            attention_flops_per_sample_step(window, features, dim, layers),
+            attention_bytes_per_sample_step(
+                window, dim, layers, itemsize, score_heads=score_heads
+            ),
+        )
+    return None
+
+
 def roofline_report(
     samples_per_sec: float,
     flops_per_sample: float,
