@@ -368,12 +368,77 @@ class TestLinter:
                 return v
         """) == []
 
+    def test_unbounded_poll_loop_flagged(self, tmp_path):
+        """TPF007: a while-True loop that sleeps each iteration but
+        mentions no deadline/timeout/stop identifier waits on a dead
+        peer forever — the wedge the elastic eviction deadline exists
+        to prevent."""
+        diags = self._lint_source(tmp_path, """
+            import time
+
+            def watch(path):
+                while True:
+                    if changed(path):
+                        handle(path)
+                    time.sleep(0.5)
+        """)
+        assert _codes(diags) == ["TPF007"]
+
+    def test_bounded_poll_loops_pass(self, tmp_path):
+        # A deadline compare bounds the wait.
+        assert self._lint_source(tmp_path, """
+            import time
+
+            def watch(path, deadline):
+                while True:
+                    if time.time() > deadline:
+                        return None
+                    time.sleep(0.5)
+        """) == []
+        # A stop event bounds it too.
+        assert self._lint_source(tmp_path, """
+            import time
+
+            def watch(path, stop_event):
+                while True:
+                    if stop_event.is_set():
+                        return
+                    time.sleep(0.5)
+        """) == []
+        # No sleep -> a blocking consumer, not a poll loop.
+        assert self._lint_source(tmp_path, """
+            def drain(q):
+                while True:
+                    item = q.get()
+                    if item is None:
+                        return
+        """) == []
+        # A real loop condition IS the exit discipline.
+        assert self._lint_source(tmp_path, """
+            import time
+
+            def watch(path, live):
+                while live(path):
+                    time.sleep(0.5)
+        """) == []
+
+    def test_deliberate_hang_suppressed_with_noqa(self, tmp_path):
+        # The faults.py mode=hang idiom: an intentional wedge, opted out
+        # on its own line.
+        assert self._lint_source(tmp_path, """
+            import time
+
+            def hang():
+                while True:  # noqa: TPF007
+                    time.sleep(3600)
+        """) == []
+
     def test_self_lint_gate_package_is_clean(self):
         """The gate: the whole tpuflow package obeys its own lint rules.
         New framework code that host-syncs inside jit, uses untraced
         randomness, ships a mutable default, names a nonexistent fault
-        site, or float()s per-step aux inside the batch loop fails the
-        tier-1 suite right here."""
+        site, float()s per-step aux inside the batch loop, or spins an
+        unbounded poll loop fails the tier-1 suite right here."""
         findings = lint_package()
         assert findings == [], "\n".join(d.render() for d in findings)
 

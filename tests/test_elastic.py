@@ -1,0 +1,821 @@
+"""Elastic data-parallel training: churn drills, averaging, membership.
+
+Three layers, mirroring the subsystem (tpuflow/elastic; docs/elastic.md):
+
+- **Unit drills with an injectable clock** (no wall-clock waits): the
+  param exchange's push/average/adopt file protocol, heartbeat
+  classification, and the coordinator's evict-on-deadline /
+  rejoin-on-fresh-heartbeat / round-deadline behaviors, each driven
+  ``step()`` by ``step()`` under a fake clock.
+- **2-worker in-process gangs** (tier-1): real ``train()`` loops as
+  threads sharing one coordinator — fixed-membership averaging, and
+  fault drills at the new ``elastic.push`` / ``elastic.join`` /
+  ``elastic.heartbeat`` sites proving one worker's death never takes
+  the gang down.
+- **The churn acceptance drill** (tier-1): 3 supervised worker
+  PROCESSES; one is killed mid-epoch by a registry-armed exit fault
+  (``os._exit`` — the no-cleanup SIGKILL stand-in the supervisor drills
+  standardize on). The run must evict it on the heartbeat deadline,
+  keep averaging over the survivors, readmit the restarted worker, and
+  land final averaged params matching a fixed-membership reference
+  gang to float tolerance, with converged losses and no NaNs.
+
+≥4-worker gangs and the repeated kill-and-rejoin soak are ``slow``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from tpuflow.elastic import (
+    ELASTIC_DEFAULTS,
+    exchange,
+    resolve_elastic,
+    validate_elastic_block,
+)
+from tpuflow.elastic.coordinator import Coordinator, read_coordinator_state
+from tpuflow.elastic.membership import (
+    classify_members,
+    read_members,
+    write_heartbeat,
+)
+from tpuflow.elastic.runner import run_elastic, worker_spec
+
+# The acceptance job: a LINEAR model (static_mlp with no hidden layers)
+# under mse is near-convex, so local-SGD averaging converges to the same
+# neighborhood whatever the transient membership — which is exactly what
+# the float-tolerance parity assertion needs to be meaningful.
+TINY = {
+    "model": "static_mlp",
+    "model_kwargs": {"hidden": []},
+    "epochs": 4,
+    "batchSize": 32,
+    "patience": 100,  # elastic gangs run fixed epochs; no early stop
+    "loss": "mse",
+    "optimizer_kwargs": {"learning_rate": 0.1},
+    "synthetic_wells": 4,
+    "synthetic_steps": 64,
+    "n_devices": 1,
+    "verbose": False,
+}
+
+# Children must see the CPU pin (conftest sets it for THIS process only).
+_ENV_KEYS = ("JAX_PLATFORMS", "XLA_FLAGS")
+
+
+@pytest.fixture(autouse=True)
+def _pass_platform_env(monkeypatch):
+    for k in _ENV_KEYS:
+        if os.environ.get(k):
+            monkeypatch.setenv(k, os.environ[k])
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _params(seed: float):
+    return {"w": np.full((2, 3), seed, np.float32),
+            "b": np.full((3,), seed, np.float32)}
+
+
+# ---------------------------------------------------------------------
+# unit: the file exchange
+# ---------------------------------------------------------------------
+
+
+class TestExchange:
+    def test_push_average_roundtrip(self, tmp_path):
+        gang = str(tmp_path)
+        exchange.push_params(gang, 1, 0, _params(1.0))
+        exchange.push_params(gang, 1, 1, _params(3.0))
+        assert exchange.pushed_ids(gang, 1) == {0, 1}
+        leaves, used = exchange.average_pushes(gang, 1)
+        assert used == [0, 1]
+        for leaf in leaves:
+            np.testing.assert_allclose(leaf, 2.0)
+        exchange.publish_average(gang, 1, leaves)
+        got = exchange.read_average(gang, 1)
+        assert got is not None and len(got) == 2
+        round_, latest = exchange.latest_average(gang)
+        assert round_ == 1
+        np.testing.assert_allclose(latest[0], 2.0)
+
+    def test_average_respects_include_set(self, tmp_path):
+        gang = str(tmp_path)
+        exchange.push_params(gang, 2, 0, _params(1.0))
+        exchange.push_params(gang, 2, 1, _params(9.0))
+        leaves, used = exchange.average_pushes(gang, 2, include={0})
+        assert used == [0]
+        np.testing.assert_allclose(leaves[0], 1.0)
+
+    def test_unflatten_rejects_mismatched_structure(self, tmp_path):
+        template = _params(0.0)
+        leaves = exchange.flatten_params(_params(5.0))
+        restored = exchange.unflatten_like(template, leaves)
+        np.testing.assert_allclose(restored["w"], 5.0)
+        with pytest.raises(ValueError, match="leaves"):
+            exchange.unflatten_like(template, leaves[:1])
+        bad = [np.zeros((4, 4), np.float32), leaves[1]]
+        with pytest.raises(ValueError, match="shape"):
+            exchange.unflatten_like(template, bad)
+
+    def test_missing_round_reads_as_none(self, tmp_path):
+        gang = str(tmp_path)
+        assert exchange.read_average(gang, 7) is None
+        assert exchange.latest_average(gang) is None
+        assert exchange.average_pushes(gang, 7) == (None, [])
+
+
+# ---------------------------------------------------------------------
+# unit: heartbeats + classification (fake clock — no wall-clock waits)
+# ---------------------------------------------------------------------
+
+
+class TestMembership:
+    def test_live_then_stale_then_rejoin(self, tmp_path):
+        gang, clock = str(tmp_path), FakeClock()
+        write_heartbeat(gang, 0, epoch=2, clock=clock)
+        view = classify_members(gang, 5.0, clock())
+        assert view.live_ids == {0} and not view.stale
+        clock.advance(6.0)
+        view = classify_members(gang, 5.0, clock())
+        assert view.stale_ids == {0} and not view.live
+        write_heartbeat(gang, 0, epoch=3, clock=clock)  # the rejoin
+        view = classify_members(gang, 5.0, clock())
+        assert view.live_ids == {0}
+
+    def test_terminal_status_never_waited_on(self, tmp_path):
+        gang, clock = str(tmp_path), FakeClock()
+        write_heartbeat(gang, 0, status="done", clock=clock)
+        write_heartbeat(gang, 1, status="failed", clock=clock)
+        clock.advance(100.0)  # age never matters for terminal members
+        view = classify_members(gang, 5.0, clock())
+        assert not view.live and not view.stale
+        assert {m.worker_id for m in view.finished} == {0, 1}
+
+    def test_unknown_status_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="status"):
+            write_heartbeat(str(tmp_path), 0, status="zombie")
+
+    def test_torn_or_alien_member_files_skipped(self, tmp_path):
+        gang, clock = str(tmp_path), FakeClock()
+        write_heartbeat(gang, 0, clock=clock)
+        (tmp_path / "members" / "1.json").write_text('{"worker_id": 1, "tim')
+        # Valid JSON that isn't a heartbeat record (a stray operator
+        # note, a list) must be skipped too, not crash every scan.
+        (tmp_path / "members" / "notes.json").write_text('["x"]')
+        (tmp_path / "members" / "2.json").write_text('"hello"')
+        assert [m.worker_id for m in read_members(gang)] == [0]
+
+
+# ---------------------------------------------------------------------
+# unit: coordinator rounds (fake clock, step()-driven)
+# ---------------------------------------------------------------------
+
+
+def _coordinator(tmp_path, clock, **kw):
+    kw.setdefault("heartbeat_timeout", 5.0)
+    kw.setdefault("round_timeout", 30.0)
+    return Coordinator(str(tmp_path), clock=clock, sleep=lambda _: None, **kw)
+
+
+class TestCoordinator:
+    def test_waits_for_live_set_then_publishes(self, tmp_path):
+        gang, clock = str(tmp_path), FakeClock()
+        coord = _coordinator(tmp_path, clock)
+        write_heartbeat(gang, 0, round=1, clock=clock)
+        write_heartbeat(gang, 1, round=1, clock=clock)
+        exchange.push_params(gang, 1, 0, _params(1.0))
+        assert coord.step() is False  # worker 1 is live: hold the round
+        exchange.push_params(gang, 1, 1, _params(3.0))
+        assert coord.step() is True
+        assert coord.rounds[1] == [0, 1]
+        np.testing.assert_allclose(exchange.read_average(gang, 1)[0], 2.0)
+        assert coord.round == 2
+
+    def test_eviction_unblocks_the_round(self, tmp_path):
+        gang, clock = str(tmp_path), FakeClock()
+        coord = _coordinator(tmp_path, clock)
+        write_heartbeat(gang, 0, round=1, clock=clock)
+        write_heartbeat(gang, 1, round=1, clock=clock)
+        exchange.push_params(gang, 1, 0, _params(1.0))
+        assert coord.step() is False
+        clock.advance(4.0)
+        write_heartbeat(gang, 0, round=1, clock=clock)  # 0 stays fresh
+        clock.advance(2.0)  # worker 1's heartbeat is now 6s old (> 5s)
+        assert coord.step() is True  # evicted -> survivors cover the set
+        assert coord.evicted == {1}
+        assert coord.rounds[1] == [0]
+        state = read_coordinator_state(gang)
+        assert state["evicted"] == [1]
+
+    def test_rejoin_readmits_and_counts(self, tmp_path):
+        gang, clock = str(tmp_path), FakeClock()
+        coord = _coordinator(tmp_path, clock)
+        write_heartbeat(gang, 0, clock=clock)
+        write_heartbeat(gang, 1, clock=clock)
+        clock.advance(6.0)
+        write_heartbeat(gang, 0, clock=clock)
+        coord.step()
+        assert coord.evicted == {1}
+        write_heartbeat(gang, 1, clock=clock)  # back from the dead
+        coord.step()
+        assert coord.evicted == set() and coord.rejoins == 1
+
+    def test_round_deadline_drops_live_stragglers(self, tmp_path):
+        # A worker that heartbeats but never pushes (wedged between
+        # progress writes) must not hold a round past round_timeout.
+        gang, clock = str(tmp_path), FakeClock()
+        coord = _coordinator(tmp_path, clock, round_timeout=10.0)
+        write_heartbeat(gang, 0, clock=clock)
+        write_heartbeat(gang, 1, clock=clock)
+        exchange.push_params(gang, 1, 0, _params(1.0))
+        assert coord.step() is False
+        clock.advance(11.0)
+        write_heartbeat(gang, 0, clock=clock)
+        write_heartbeat(gang, 1, clock=clock)  # live, just not pushing
+        assert coord.step() is True
+        assert coord.rounds[1] == [0]
+        assert coord.evicted == set()  # straggling is not eviction
+
+    def test_late_push_from_dead_worker_still_averaged(self, tmp_path):
+        # Push-then-die: the params are legitimate round data even though
+        # the worker missed every heartbeat since.
+        gang, clock = str(tmp_path), FakeClock()
+        coord = _coordinator(tmp_path, clock)
+        write_heartbeat(gang, 0, clock=clock)
+        write_heartbeat(gang, 1, clock=clock)
+        exchange.push_params(gang, 1, 1, _params(3.0))
+        clock.advance(6.0)  # worker 1 dies right after its push
+        write_heartbeat(gang, 0, clock=clock)
+        exchange.push_params(gang, 1, 0, _params(1.0))
+        assert coord.step() is True
+        assert coord.rounds[1] == [0, 1]  # both pushes averaged
+
+    def test_min_round_interval_paces_publication(self, tmp_path):
+        gang, clock = str(tmp_path), FakeClock()
+        coord = _coordinator(tmp_path, clock, min_round_interval=10.0)
+        write_heartbeat(gang, 0, clock=clock)
+        exchange.push_params(gang, 1, 0, _params(1.0))
+        assert coord.step() is True  # first round: no previous publish
+        exchange.push_params(gang, 2, 0, _params(1.0))
+        write_heartbeat(gang, 0, clock=clock)
+        assert coord.step() is False  # paced
+        clock.advance(11.0)
+        write_heartbeat(gang, 0, clock=clock)
+        assert coord.step() is True
+
+    def test_rounds_pruned_behind_the_gang(self, tmp_path):
+        # Disk bound: old push dirs + averages go away once they are
+        # behind BOTH keep_rounds and the slowest live member.
+        gang, clock = str(tmp_path), FakeClock()
+        coord = _coordinator(tmp_path, clock, keep_rounds=2)
+        for r in range(1, 6):
+            write_heartbeat(gang, 0, round=r, clock=clock)
+            exchange.push_params(gang, r, 0, _params(float(r)))
+            assert coord.step() is True
+        # After round 5: prune below min(member_round=5, 6-2=4) = 4.
+        assert exchange.read_average(gang, 3) is None
+        assert exchange.pushed_ids(gang, 3) == set()
+        assert exchange.read_average(gang, 4) is not None
+        assert exchange.read_average(gang, 5) is not None
+        assert exchange.latest_round(gang) == 5
+
+    def test_lagging_member_neither_waited_on_nor_pruned_past(self, tmp_path):
+        # A live catch-up worker (reported round behind the gang's)
+        # must not hold rounds hostage to round_timeout — it only
+        # adopts history — but its historic averages must survive
+        # pruning until it catches up.
+        gang, clock = str(tmp_path), FakeClock()
+        coord = _coordinator(
+            tmp_path, clock, keep_rounds=1, min_round=10
+        )
+        # The history worker 1 is still replaying.
+        exchange.publish_average(
+            gang, 3, exchange.flatten_params(_params(0.0))
+        )
+        for r in range(10, 14):
+            exchange.push_params(gang, r, 0, _params(float(r)))
+            write_heartbeat(gang, 0, round=r, clock=clock)
+            # Worker 1 stays live but far behind (catching up at 3).
+            write_heartbeat(gang, 1, round=3, clock=clock)
+            # Publishes immediately: the catch-up member is excluded
+            # from the waiting set, no round_timeout crawl.
+            assert coord.step() is True
+        assert coord.evicted == set()
+        # Worker 1's historic average must survive pruning until it
+        # catches up (prune stays behind the slowest live member).
+        assert exchange.read_average(gang, 3) is not None
+
+    def test_failed_goodbye_does_not_end_the_gang(self, tmp_path):
+        # A 'failed' heartbeat may be followed by a supervisor restart
+        # (the goodbye races the backoff window) — only 'done' workers
+        # end the gang naturally; permanently-failed gangs are ended by
+        # the runner's stop event.
+        gang, clock = str(tmp_path), FakeClock()
+        coord = _coordinator(tmp_path, clock)
+        write_heartbeat(gang, 0, status="done", clock=clock)
+        write_heartbeat(gang, 1, status="failed", clock=clock)
+        coord.step()
+        assert coord.all_finished() is False
+        write_heartbeat(gang, 1, status="running", clock=clock)  # restart
+        coord.step()
+        assert coord.all_finished() is False
+        write_heartbeat(gang, 1, status="done", clock=clock)
+        assert coord.all_finished() is True
+
+    def test_mixed_shapes_in_one_round_rejected(self, tmp_path):
+        gang = str(tmp_path)
+        exchange.push_params(gang, 1, 0, _params(1.0))
+        exchange.push_params(
+            gang, 1, 1,
+            {"w": np.ones((1, 3), np.float32), "b": np.ones(3, np.float32)},
+        )
+        with pytest.raises(ValueError, match="mixed model configs"):
+            exchange.average_pushes(gang, 1)
+
+    def test_publication_waits_for_gang_assembly(self, tmp_path):
+        # Launch stagger: a fast worker's round-1 push must not publish
+        # before every expected worker has been SEEN once — early
+        # rounds would otherwise average over a subset of a healthy
+        # gang.
+        gang, clock = str(tmp_path), FakeClock()
+        coord = _coordinator(tmp_path, clock, expected_workers=2)
+        write_heartbeat(gang, 0, round=1, clock=clock)
+        exchange.push_params(gang, 1, 0, _params(1.0))
+        assert coord.step() is False  # worker 1 never seen yet
+        write_heartbeat(gang, 1, round=1, clock=clock)
+        assert coord.step() is False  # seen: now waited on for a push
+        exchange.push_params(gang, 1, 1, _params(3.0))
+        assert coord.step() is True
+        assert coord.rounds[1] == [0, 1]
+
+    def test_assembly_gate_is_deadline_bounded(self, tmp_path):
+        # A worker that never shows up costs one assembly window, not
+        # the whole run's averaging.
+        gang, clock = str(tmp_path), FakeClock()
+        coord = _coordinator(
+            tmp_path, clock, expected_workers=3, assembly_timeout=20.0,
+        )
+        write_heartbeat(gang, 0, round=1, clock=clock)
+        exchange.push_params(gang, 1, 0, _params(1.0))
+        assert coord.step() is False  # workers 1-2 never seen
+        clock.advance(21.0)
+        write_heartbeat(gang, 0, round=1, clock=clock)
+        assert coord.step() is True  # window expired: proceed anyway
+        assert coord.rounds[1] == [0]
+
+    def test_expected_workers_gates_natural_end(self, tmp_path):
+        # A fast first worker finishing before its siblings' first
+        # heartbeat must not end the gang under them.
+        gang, clock = str(tmp_path), FakeClock()
+        coord = _coordinator(tmp_path, clock, expected_workers=2)
+        write_heartbeat(gang, 0, status="done", clock=clock)
+        coord.step()
+        assert coord.all_finished() is False  # worker 1 never seen yet
+        write_heartbeat(gang, 1, status="running", clock=clock)
+        coord.step()
+        assert coord.all_finished() is False
+        write_heartbeat(gang, 1, status="done", clock=clock)
+        assert coord.all_finished() is True
+
+    def test_all_finished_ends_run(self, tmp_path):
+        gang, clock = str(tmp_path), FakeClock()
+        coord = _coordinator(tmp_path, clock)
+        write_heartbeat(gang, 0, status="done", clock=clock)
+        write_heartbeat(gang, 1, status="running", clock=clock)
+        coord.step()
+        assert coord.all_finished() is False
+        write_heartbeat(gang, 1, status="done", clock=clock)
+        assert coord.all_finished() is True
+        # run() with everything done returns immediately (no stop event
+        # needed), leaving the state file behind.
+        state = coord.run(stop=None)
+        assert sorted(state["ever_seen"]) == [0, 1]
+
+
+# ---------------------------------------------------------------------
+# the elastic config block (spec grammar + preflight integration)
+# ---------------------------------------------------------------------
+
+
+class TestElasticSpec:
+    def test_defaults_merge_and_validate(self):
+        block = {"dir": "/g", "worker_id": 0, "n_workers": 2}
+        cfg = resolve_elastic(block)
+        assert cfg["sync_every"] == ELASTIC_DEFAULTS["sync_every"]
+        assert cfg["dir"] == "/g"
+
+    def test_every_problem_reported(self):
+        msgs = validate_elastic_block(
+            {"worker_id": 3, "n_workers": 2, "sync_every": 0, "bogus": 1}
+        )
+        text = "; ".join(msgs)
+        assert "elastic.dir is required" in text
+        assert "outside the gang" in text
+        assert "sync_every" in text
+        assert "bogus" in text
+        with pytest.raises(ValueError, match="invalid elastic config"):
+            resolve_elastic({"dir": "", "worker_id": 0, "n_workers": 1})
+
+    def test_preflight_spec_pass_rejects_bad_blocks(self):
+        from tpuflow.analysis.spec import validate_spec
+        from tpuflow.api import TrainJobConfig
+
+        ok_block = {"dir": "/g", "worker_id": 0, "n_workers": 2}
+        diags = validate_spec(
+            TrainJobConfig(elastic={"worker_id": 9, "n_workers": 2})
+        )
+        assert any(d.code == "spec.elastic.invalid" for d in diags)
+        diags = validate_spec(
+            TrainJobConfig(elastic=ok_block, stream=True,
+                           data_path="/d.csv", model="static_mlp")
+        )
+        assert any(d.code == "spec.elastic.stream" for d in diags)
+        diags = validate_spec(TrainJobConfig(elastic=ok_block, tp=2))
+        assert any(d.code == "spec.elastic.model_axis" for d in diags)
+        diags = validate_spec(TrainJobConfig(elastic=ok_block, n_devices=4))
+        assert any(
+            d.code == "spec.elastic.n_devices" and d.severity == "error"
+            for d in diags
+        )
+        # Runner-built blocks (n_devices=1) preflight clean of elastic
+        # diagnostics.
+        diags = validate_spec(TrainJobConfig(elastic=ok_block, n_devices=1))
+        assert not [d for d in diags if d.code.startswith("spec.elastic")]
+
+    def test_worker_spec_builds_disjoint_trees(self, tmp_path):
+        spec = worker_spec(
+            {**TINY, "storagePath": str(tmp_path)}, "/gang", 1, 3,
+        )
+        assert spec["storagePath"] == os.path.join(str(tmp_path), "worker1")
+        assert spec["save_every"] == 1 and spec["n_devices"] == 1
+        assert spec["elastic"]["worker_id"] == 1
+        assert spec["elastic"]["n_workers"] == 3
+        # asdict-style specs carry explicit Nones/zeros; still fixed up.
+        spec = worker_spec(
+            {**TINY, "storage_path": str(tmp_path), "save_every": 0,
+             "n_devices": None},
+            "/gang", 0, 2,
+        )
+        assert spec["save_every"] == 1 and spec["n_devices"] == 1
+
+    def test_stale_gang_dir_refused(self, tmp_path):
+        # Reusing a previous gang's dir would end the new gang
+        # instantly (old 'done' heartbeats) and warm-start workers into
+        # rounds nobody collects — refuse loudly instead.
+        spec = {**TINY, "epochs": 2, "storagePath": str(tmp_path)}
+        r = run_elastic(spec, 1, mode="inprocess", heartbeat_timeout=120.0)
+        assert r.ok
+        with pytest.raises(ValueError, match="previous gang's state"):
+            run_elastic(spec, 1, mode="inprocess")
+
+    def test_bad_knobs_rejected_at_submission(self, tmp_path):
+        # A bad knob must die HERE, not as N child launches each dying
+        # in train()'s preflight until the restart budget burns.
+        spec = {**TINY, "storagePath": str(tmp_path)}
+        with pytest.raises(ValueError, match="sync_every"):
+            run_elastic(spec, 2, mode="inprocess", sync_every=0)
+        with pytest.raises(ValueError, match="heartbeat_timeout"):
+            run_elastic(spec, 2, mode="inprocess", heartbeat_timeout=-1.0)
+
+    def test_inprocess_rejects_process_killing_faults(self, tmp_path):
+        # In-process workers are THREADS: an exit/hang fault would take
+        # down the coordinator and every worker (and the test runner).
+        spec = {**TINY, "storagePath": str(tmp_path)}
+        with pytest.raises(ValueError, match="kill or wedge"):
+            run_elastic(
+                spec, 2, mode="inprocess",
+                worker_faults={1: ["train.epoch_start,at=1,mode=exit"]},
+            )
+
+    def test_catch_up_skips_pruned_rounds_without_waiting(self, tmp_path):
+        # A returning worker whose historic round was pruned must not
+        # burn pull_timeout on a file that cannot appear.
+        from tpuflow.elastic.worker import ElasticWorkerClient
+
+        gang = str(tmp_path)
+        exchange.publish_average(
+            gang, 5, exchange.flatten_params(_params(1.0))
+        )
+        exchange.prune_rounds(gang, 5)
+        slept = []
+        client = ElasticWorkerClient(
+            {"dir": gang, "worker_id": 0, "n_workers": 2,
+             "pull_timeout": 60.0},
+            clock=FakeClock(), sleep=slept.append,
+        )
+        assert client._wait_for_average(2) is None  # pruned history
+        assert slept == []  # decided on the first scan, no waiting
+        got = client._wait_for_average(5)  # the kept round still reads
+        assert got is not None
+
+    def test_round_offset_survives_restart(self, tmp_path):
+        # A late joiner's round offset must come back after a
+        # supervisor restart, or its rounds would misalign with the
+        # gang's forever (adopting R-rounds-stale averages every sync).
+        from tpuflow.elastic.worker import ElasticWorkerClient
+
+        class _State:
+            def __init__(self, params):
+                self.params = params
+
+            def replace(self, params):
+                return _State(params)
+
+        gang = str(tmp_path)
+        exchange.publish_average(
+            gang, 7, exchange.flatten_params(_params(2.0))
+        )
+        block = {"dir": gang, "worker_id": 3, "n_workers": 4}
+        fresh = ElasticWorkerClient(block)
+        state = fresh.join(_State(_params(0.0)))
+        assert fresh.round_offset == 7
+        np.testing.assert_allclose(state.params["w"], 2.0)  # warm start
+        fresh.finish(failed=True)  # "crash": no final push
+        restarted = ElasticWorkerClient(block, resuming=True)
+        restarted.join(_State(_params(0.0)))
+        assert restarted.round_offset == 7  # persisted, not reset to 0
+        restarted.finish(failed=True)
+
+    def test_shard_rows_disjoint_and_covering(self):
+        from tpuflow.data.pipeline import ArrayDataset
+        from tpuflow.elastic.worker import shard_rows
+
+        ds = ArrayDataset(np.arange(10, dtype=np.float32).reshape(10, 1),
+                          np.arange(10, dtype=np.float32))
+        shards = [shard_rows(ds, i, 3) for i in range(3)]
+        seen = np.sort(np.concatenate([s.y for s in shards]))
+        np.testing.assert_array_equal(seen, ds.y)  # disjoint + covering
+        with pytest.raises(ValueError, match="empty train shard"):
+            shard_rows(ArrayDataset(ds.x[:2], ds.y[:2]), 2, 3)
+
+
+# ---------------------------------------------------------------------
+# in-process gangs (tier-1; real train() loops as threads)
+# ---------------------------------------------------------------------
+
+
+def _finite(x) -> bool:
+    return x is not None and not isinstance(x, str) and math.isfinite(x)
+
+
+class TestInProcessGang:
+    def test_two_worker_gang_averages_every_round(self, tmp_path):
+        spec = {**TINY, "storagePath": str(tmp_path)}
+        r = run_elastic(
+            spec, 2, mode="inprocess", heartbeat_timeout=120.0,
+        )
+        assert r.ok, [w.error for w in r.workers]
+        assert all(w.report["epochs_ran"] == TINY["epochs"] for w in r.workers)
+        assert r.coordinator["round"] - 1 == TINY["epochs"]
+        # Every round averaged over BOTH workers (fixed membership).
+        assert all(ids == [0, 1] for ids in r.coordinator["rounds"].values())
+        assert r.final_worker_ids == [0, 1]
+        assert os.path.exists(r.final_path)
+        # The final averaged params ARE the last round's rebroadcast:
+        # every worker's closing sync adopted avg(last), so the final
+        # pushes agree with it bit-for-bit.
+        last = exchange.read_average(str(tmp_path) + "/elastic",
+                                     TINY["epochs"])
+        for a, b in zip(r.final_params, last):
+            np.testing.assert_allclose(a, b, rtol=1e-6)
+        assert all(_finite(w.report["best_val_loss"]) for w in r.workers)
+
+    @pytest.mark.faultdrill
+    def test_single_armed_push_fault_leaves_survivor_running(self, tmp_path):
+        spec = {**TINY, "storagePath": str(tmp_path)}
+        r = run_elastic(
+            spec, 2, mode="inprocess", heartbeat_timeout=120.0,
+            round_timeout=5.0,
+            worker_faults={1: ["elastic.push,at=1"]},
+        )
+        # at=1 fires on whichever worker pushes round 1 first while the
+        # spec is armed — worker 1 armed it, but the registry is
+        # process-global in-process. Either way: exactly one worker
+        # died at the site, the other finished every epoch, and the
+        # coordinator kept publishing rounds for the survivor.
+        errors = [w for w in r.workers if w.error]
+        survivors = [w for w in r.workers if not w.error]
+        assert len(errors) == 1 and len(survivors) == 1
+        assert "injected fault" in errors[0].error
+        assert survivors[0].report["epochs_ran"] == TINY["epochs"]
+        assert r.coordinator["round"] - 1 == TINY["epochs"]
+        # The dead worker said goodbye (status=failed) or was evicted;
+        # either way the final average exists over the survivor.
+        assert r.final_worker_ids == [survivors[0].worker_id]
+
+    @pytest.mark.faultdrill
+    def test_join_fault_fails_fast_and_labeled(self, tmp_path):
+        from tpuflow.resilience import clear_faults
+
+        spec = {**TINY, "storagePath": str(tmp_path), "epochs": 2}
+        r = run_elastic(
+            spec, 1, mode="inprocess", heartbeat_timeout=120.0,
+            worker_faults={0: ["elastic.join,nth=1"]},
+        )
+        clear_faults()
+        assert not r.ok
+        assert "injected fault" in r.workers[0].error
+        assert "elastic.join" in r.workers[0].error
+
+    @pytest.mark.faultdrill
+    def test_heartbeat_fault_fires_at_the_site(self, tmp_path):
+        from tpuflow.resilience import (
+            FaultInjected,
+            FaultSpec,
+            arm,
+            clear_faults,
+        )
+
+        arm(FaultSpec(site="elastic.heartbeat", nth=1))
+        try:
+            with pytest.raises(FaultInjected, match="elastic.heartbeat"):
+                write_heartbeat(str(tmp_path), 0)
+        finally:
+            clear_faults()
+        # The write never happened — a half-written heartbeat would be
+        # worse than none.
+        assert read_members(str(tmp_path)) == []
+
+    def test_warm_start_adopts_latest_average(self, tmp_path, capfd):
+        # A late joiner with no checkpoint starts from gang progress:
+        # run a 1-worker gang, then start a NEW worker id against the
+        # same gang dir and assert it adopted the published average
+        # before its first epoch (train/resume.py::apply_params).
+        gang = str(tmp_path / "elastic")
+        spec = {**TINY, "epochs": 2, "storagePath": str(tmp_path)}
+        r = run_elastic(
+            spec, 1, mode="inprocess", gang_dir=gang,
+            heartbeat_timeout=120.0,
+        )
+        assert r.ok
+        latest_round, _ = exchange.latest_average(gang)
+        assert latest_round == 2
+        late = worker_spec(
+            {**TINY, "epochs": 3, "storagePath": str(tmp_path / "late")},
+            gang, 1, 2, elastic_overrides={"pull_timeout": 2.0},
+        )
+        from tpuflow.api import train
+        from tpuflow.serve import spec_to_config
+
+        capfd.readouterr()
+        train(spec_to_config(late))
+        err = capfd.readouterr().err
+        assert f"warm-started from round {latest_round}'s average" in err
+        # ... and its rounds CONTINUE from the join point (a round-1
+        # push would adopt the gang's ancient round-1 average and
+        # clobber the warm start it just did).
+        assert not os.path.exists(
+            os.path.join(exchange.push_dir(gang, 1), "1.npz")
+        )
+        assert os.path.exists(
+            os.path.join(exchange.push_dir(gang, latest_round + 1), "1.npz")
+        )
+
+
+# ---------------------------------------------------------------------
+# the acceptance drill: kill, evict, keep averaging, readmit, converge
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.faultdrill
+class TestChurnAcceptance:
+    def test_three_workers_survive_mid_epoch_kill(self, tmp_path):
+        """ISSUE 6 acceptance: 3 supervised workers; worker 1 dies at
+        the top of epoch 3 via a registry-armed exit fault (os._exit,
+        no Python cleanup — the SIGKILL stand-in). End-to-end through
+        the real coordinator and fault registry:
+
+        - the dead worker is EVICTED on the heartbeat deadline and at
+          least one round is averaged over exactly the survivors;
+        - its supervisor restarts it (attempt 2) with resume=True and a
+          fresh heartbeat READMITS it (rejoins >= 1);
+        - every worker finishes all epochs, losses converge, no NaNs;
+        - the final averaged params match a fixed-membership reference
+          gang (same job, no faults) to float tolerance.
+        """
+        base = {**TINY, "epochs": 12}
+        churn = run_elastic(
+            {**base, "storagePath": str(tmp_path / "churn")}, 3,
+            mode="supervised",
+            heartbeat_timeout=1.0,
+            heartbeat_interval=0.2,
+            round_timeout=10.0,
+            min_round_interval=1.2,  # rounds keep flowing while it's gone
+            pull_timeout=300.0,
+            max_restarts=2,
+            backoff_base=3.0,  # hold the restart out past the eviction
+            worker_faults={1: ["train.epoch_start,at=3,mode=exit,code=42"]},
+        )
+        assert churn.ok, [w.error for w in churn.workers]
+        # The kill happened and was answered by a restart (the fault
+        # registry's exit fault = rc 42 on attempt 1).
+        victim = churn.workers[1]
+        assert victim.attempts == 2
+        assert victim.failures and victim.failures[0]["rc"] == 42
+        assert victim.failures[0]["kind"] == "crash"
+        # Everyone finished the whole job.
+        for w in churn.workers:
+            assert w.report["epochs_ran"] == base["epochs"]
+            assert _finite(w.report["best_val_loss"])
+            assert w.report["best_val_loss"] < 0.5  # converged, no NaNs
+        # Eviction: averaging proceeded over the survivors — at least
+        # one round excludes the dead worker (usually exactly [0, 2];
+        # stated as exclusion so a scheduler-noise spurious eviction of
+        # a survivor can't flake the drill).
+        rounds = churn.coordinator["rounds"]
+        assert any(1 not in ids for ids in rounds.values()), rounds
+        # Readmission: the restarted worker's heartbeat brought it back.
+        assert churn.coordinator["rejoins"] >= 1
+        assert 1 not in churn.coordinator["evicted"]
+        # All twelve rounds were published despite the churn.
+        assert churn.coordinator["round"] - 1 == base["epochs"]
+        assert churn.final_worker_ids == [0, 1, 2]
+
+        # Fixed-membership reference: same job, no faults, in-process
+        # (same averaging code path, no supervisors needed).
+        ref = run_elastic(
+            {**base, "storagePath": str(tmp_path / "ref")}, 3,
+            mode="inprocess", heartbeat_timeout=300.0,
+        )
+        assert ref.ok, [w.error for w in ref.workers]
+        assert all(
+            ids == [0, 1, 2] for ids in ref.coordinator["rounds"].values()
+        )
+        # Float-tolerance parity (measured deltas ~0.003-0.02 for the
+        # linear model; 0.12 gives ~6x headroom for scheduler noise in
+        # how many rounds the victim missed).
+        for got, want in zip(churn.final_params, ref.final_params):
+            np.testing.assert_allclose(got, want, atol=0.12)
+
+
+# ---------------------------------------------------------------------
+# big gangs + soak (slow)
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestBigGangs:
+    def test_four_worker_gang(self, tmp_path):
+        spec = {**TINY, "storagePath": str(tmp_path)}
+        r = run_elastic(spec, 4, mode="inprocess", heartbeat_timeout=120.0)
+        assert r.ok, [w.error for w in r.workers]
+        assert all(
+            ids == [0, 1, 2, 3] for ids in r.coordinator["rounds"].values()
+        )
+        assert r.final_worker_ids == [0, 1, 2, 3]
+
+    @pytest.mark.faultdrill
+    def test_kill_and_rejoin_soak_two_victims(self, tmp_path):
+        # Two different workers die at different epochs; both restart,
+        # both rejoin, the gang still lands every round.
+        base = {**TINY, "epochs": 14}
+        r = run_elastic(
+            {**base, "storagePath": str(tmp_path)}, 3,
+            mode="supervised",
+            heartbeat_timeout=1.0, heartbeat_interval=0.2,
+            round_timeout=10.0, min_round_interval=1.0,
+            pull_timeout=300.0, max_restarts=2, backoff_base=2.0,
+            worker_faults={
+                1: ["train.epoch_start,at=3,mode=exit,code=42"],
+                2: ["train.epoch_start,at=6,mode=exit,code=42"],
+            },
+        )
+        assert r.ok, [w.error for w in r.workers]
+        assert r.workers[1].attempts == 2 and r.workers[2].attempts == 2
+        assert r.coordinator["rejoins"] >= 2
+        assert r.coordinator["round"] - 1 == base["epochs"]
+        for w in r.workers:
+            assert w.report["epochs_ran"] == base["epochs"]
+            assert _finite(w.report["best_val_loss"])
+
+    def test_shell_entrypoint(self, tmp_path):
+        import subprocess
+        import sys
+
+        spec_file = tmp_path / "spec.json"
+        spec_file.write_text(
+            json.dumps({**TINY, "epochs": 2, "storagePath": str(tmp_path)})
+        )
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpuflow.elastic", str(spec_file),
+             "--workers", "2", "--mode", "inprocess", "--quiet"],
+            capture_output=True, text=True, timeout=600,
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert out["ok"] is True and out["rounds"] == 2

@@ -259,6 +259,78 @@ class TestRetryPolicy:
         assert slept == pytest.approx([0.1, 0.2, 0.4])
 
 
+class TestRetryEnvValidation:
+    """Satellite: the ``TPUFLOW_RETRY_*`` knobs are validated at read
+    time — a typo'd or negative value raises a ValueError naming the
+    env var and the expected form (the TPUFLOW_FAULTS precedent),
+    instead of a bare float() traceback or a silent clamp."""
+
+    _VARS = (
+        "TPUFLOW_RETRY_ATTEMPTS", "TPUFLOW_RETRY_BASE",
+        "TPUFLOW_RETRY_MAX", "TPUFLOW_RETRY_DEADLINE",
+    )
+
+    def test_defaults_when_unset_or_empty(self, monkeypatch):
+        from tpuflow.resilience.retry import io_policy
+
+        for var in self._VARS:
+            monkeypatch.delenv(var, raising=False)
+        policy = io_policy()
+        assert policy.max_attempts == 4 and policy.deadline == 30.0
+        monkeypatch.setenv("TPUFLOW_RETRY_BASE", "")
+        assert io_policy().base_delay == 0.05
+
+    def test_valid_overrides_apply(self, monkeypatch):
+        from tpuflow.resilience.retry import io_policy
+
+        monkeypatch.setenv("TPUFLOW_RETRY_ATTEMPTS", "7")
+        monkeypatch.setenv("TPUFLOW_RETRY_BASE", "0.5")
+        policy = io_policy()
+        assert policy.max_attempts == 7 and policy.base_delay == 0.5
+
+    @pytest.mark.parametrize("var", _VARS)
+    def test_non_numeric_names_the_var_and_form(self, monkeypatch, var):
+        from tpuflow.resilience.retry import io_policy
+
+        monkeypatch.setenv(var, "fast")
+        with pytest.raises(ValueError, match=var) as e:
+            io_policy()
+        assert "expected" in str(e.value)
+
+    def test_negative_rejected(self, monkeypatch):
+        from tpuflow.resilience.retry import io_policy
+
+        monkeypatch.setenv("TPUFLOW_RETRY_MAX", "-1")
+        with pytest.raises(ValueError, match="TPUFLOW_RETRY_MAX"):
+            io_policy()
+
+    def test_nan_and_inf_rejected(self, monkeypatch):
+        # 'nan' survives a < comparison and 'inf' would sleep forever —
+        # both must fail the validation, not the eventual time.sleep.
+        from tpuflow.resilience.retry import io_policy
+
+        monkeypatch.setenv("TPUFLOW_RETRY_BASE", "nan")
+        with pytest.raises(ValueError, match="TPUFLOW_RETRY_BASE"):
+            io_policy()
+        monkeypatch.setenv("TPUFLOW_RETRY_BASE", "0.05")
+        monkeypatch.setenv("TPUFLOW_RETRY_DEADLINE", "inf")
+        with pytest.raises(ValueError, match="TPUFLOW_RETRY_DEADLINE"):
+            io_policy()
+
+    def test_zero_or_fractional_attempts_rejected(self, monkeypatch):
+        from tpuflow.resilience.retry import io_policy
+
+        monkeypatch.setenv("TPUFLOW_RETRY_ATTEMPTS", "0")
+        with pytest.raises(
+            ValueError, match="TPUFLOW_RETRY_ATTEMPTS"
+        ) as e:
+            io_policy()
+        assert "integer attempt count >= 1" in str(e.value)
+        monkeypatch.setenv("TPUFLOW_RETRY_ATTEMPTS", "2.5")
+        with pytest.raises(ValueError, match="TPUFLOW_RETRY_ATTEMPTS"):
+            io_policy()
+
+
 @pytest.mark.faultdrill
 class TestWiredSites:
     """One injected fault per registry site, against the real code."""

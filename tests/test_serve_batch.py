@@ -308,6 +308,74 @@ class TestErrorHandling:
             mb.close()
 
 
+@pytest.mark.faultdrill
+class TestCoalescedDispatchFaultDrill:
+    """Satellite: the ``serve.execute`` fault site fires inside the
+    micro-batcher's forward hook — an injected fault during a COALESCED
+    dispatch must fail exactly that dispatch's requests (every caller it
+    carried, no one else) and leave the MicroBatcher healthy for the
+    next batch."""
+
+    def test_injected_fault_fails_one_dispatch_then_heals(self):
+        from tpuflow.resilience import (
+            FaultInjected,
+            FaultSpec,
+            arm,
+            clear_faults,
+        )
+
+        svc = _service()
+        stub = _StubPredictor(scale=2.0)
+        svc._cache[KEY] = stub
+        specs = [{**SPEC, "columns": {"x": [float(i)] * 4}} for i in range(4)]
+        results: list = [None] * 4
+        errors: dict[int, BaseException] = {}
+        barrier = threading.Barrier(4)
+
+        def call(i: int) -> None:
+            barrier.wait()
+            try:
+                results[i] = svc.predict(specs[i])
+            except BaseException as e:
+                errors[i] = e
+
+        try:
+            arm(FaultSpec(site="serve.execute", nth=1))
+            threads = [
+                threading.Thread(target=call, args=(i,)) for i in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            # The armed fault fired on the FIRST dispatch: every request
+            # that dispatch carried failed with the injected fault, and
+            # any request that landed in a later dispatch succeeded —
+            # the failure's blast radius is exactly one device call.
+            assert errors, "the armed serve.execute fault never fired"
+            assert all(
+                isinstance(e, FaultInjected) for e in errors.values()
+            )
+            assert len(errors) + sum(r is not None for r in results) == 4
+            for i, res in enumerate(results):
+                if res is not None:
+                    assert res["predictions"] == [2.0 * i] * 4
+            # The fault never reached the device hook itself.
+            first_wave_calls = list(stub.forward_calls)
+            # Healed: the next wave coalesces and answers cleanly.
+            out = _concurrent_predicts(
+                svc,
+                [{**SPEC, "columns": {"x": [5.0] * 4}} for _ in range(4)],
+            )
+            assert all(r["predictions"] == [10.0] * 4 for r in out)
+            assert len(stub.forward_calls) > len(first_wave_calls)
+            m = svc.metrics()["batching"]
+            assert m["dispatches"] >= 2  # failed dispatch + healthy ones
+        finally:
+            clear_faults()
+            svc.close()
+
+
 class TestLatencyAccounting:
     def test_percentiles_and_counters(self):
         svc = PredictService(batch_predicts=False)

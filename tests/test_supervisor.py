@@ -61,6 +61,9 @@ class TestSupervise:
         assert len(run.failures) == 1
         assert run.failures[0]["rc"] == 42
         assert run.failures[0]["kind"] == "crash"
+        # A child death (not a watchdog kill) carries killed_by=None —
+        # the field distinguishes supervisor kills from crashes.
+        assert run.failures[0]["killed_by"] is None
         # The crash landed after epoch 3's bookkeeping + progress write.
         assert run.failures[0]["progress_epoch"] == 3
         assert isinstance(run.failures[0]["stderr_tail"], str)
@@ -252,7 +255,7 @@ class TestStallWatchdog:
         }
         run = supervise(
             spec, max_restarts=2, verbose=False,
-            stall_timeout=15.0, poll_interval=0.05,
+            stall_timeout=15.0, poll_interval=0.05, term_grace=10.0,
             backoff_base=0.01, backoff_jitter=0.0, sleep=lambda _: None,
         )
         assert run.attempts == 2
@@ -260,7 +263,73 @@ class TestStallWatchdog:
         assert run.failures[0]["rc"] is None  # killed, not exited
         assert run.failures[0]["progress_epoch"] == 2
         assert run.report["epochs_ran"] == 5
+        # The graceful kill reached the child's SIGTERM handler: its
+        # teardown ran, so the stalled child's forensics ring — which
+        # an immediate SIGKILL could never flush — is on disk.
+        assert run.failures[0]["killed_by"] == "sigterm"
+        assert (tmp_path / "forensics.jsonl").exists()
 
+
+class TestGracefulShutdown:
+    """Watchdog kills are SIGTERM -> term_grace -> SIGKILL (satellite):
+    a cooperative child gets to flush its teardown (forensics rings,
+    async checkpoint commits) and is recorded ``killed_by: sigterm``; a
+    child that ignores the grace period is axed and recorded
+    ``killed_by: sigkill``."""
+
+    _CHILD = """
+        import json, os, sys, time
+        {prelude}
+        spec = json.load(open(sys.argv[-2]))
+        prog = spec["progress_path"]
+        if os.path.exists(prog):
+            # attempt 2: the progress file survived attempt 1 — finish
+            # cleanly so the failure record is inspectable on the run.
+            json.dump({{"epochs_ran": 1}}, open(sys.argv[-1], "w"))
+            sys.exit(0)
+        with open(prog, "w") as f:
+            json.dump({{"epoch": 1, "time": 0}}, f)
+        time.sleep(3600)
+    """
+
+    def _run(self, tmp_path, prelude: str, **kw):
+        child = tmp_path / "child.py"
+        child.write_text(
+            textwrap.dedent(self._CHILD).format(prelude=prelude)
+        )
+        fake_python = tmp_path / "fake_python"
+        fake_python.write_text(
+            f"#!/bin/sh\nexec {sys.executable} {child} \"$@\"\n"
+        )
+        fake_python.chmod(fake_python.stat().st_mode | stat.S_IEXEC)
+        spec = {**_TINY, "storagePath": str(tmp_path)}
+        return supervise(
+            spec, max_restarts=1, verbose=False,
+            python=str(fake_python),
+            stall_timeout=0.4, poll_interval=0.02,
+            backoff_base=0.01, backoff_jitter=0.0, sleep=lambda _: None,
+            **kw,
+        )
+
+    def test_cooperative_child_ends_on_sigterm(self, tmp_path):
+        run = self._run(tmp_path, "", term_grace=5.0)
+        assert run.attempts == 2
+        assert run.failures[0]["kind"] == "stall"
+        assert run.failures[0]["rc"] is None
+        # Python's default SIGTERM handling exited within the grace
+        # window: no SIGKILL was needed, teardown got to run.
+        assert run.failures[0]["killed_by"] == "sigterm"
+
+    def test_sigterm_ignoring_child_gets_sigkilled(self, tmp_path):
+        run = self._run(
+            tmp_path,
+            "import signal\n"
+            "signal.signal(signal.SIGTERM, signal.SIG_IGN)",
+            term_grace=0.3,
+        )
+        assert run.attempts == 2
+        assert run.failures[0]["kind"] == "stall"
+        assert run.failures[0]["killed_by"] == "sigkill"
 
 class TestSupervisorCLI:
     @pytest.mark.slow

@@ -32,6 +32,14 @@ sync per step, a drill that can never fire):
   the numerics watchdog reads them (``tpuflow/obs/health.py``).
   ``epoch_step`` results are exempt: converting the scanned epoch's one
   result IS the post-epoch read.
+- **TPF007** — unbounded ``while True:`` poll loop: a loop that sleeps
+  each iteration but mentions no deadline/timeout/stop identifier waits
+  on its peer FOREVER when the peer dies — exactly the wedge the
+  elastic coordinator's eviction deadline and the worker's pull timeout
+  exist to prevent, so those loops must pass this rule by construction.
+  The bound check is identifier-based (``deadline``/``timeout``/
+  ``stop``/``until``/``budget``/...): the rule catches loops with no
+  exit discipline at all, not arithmetic mistakes in ones that have it.
 
 "Jitted function" means a function decorated with ``jit``/``jax.jit``/
 ``partial(jax.jit, ...)`` or passed to a ``jax.jit(...)`` call reachable
@@ -73,6 +81,11 @@ RULES = {
               "step's result syncs the device once per step and "
               "serializes async dispatch; collect device references and "
               "convert ONCE post-epoch — the numerics-watchdog contract)",
+    "TPF007": "unbounded while-True poll loop: sleeps every iteration "
+              "but checks no deadline/timeout/stop condition, so a dead "
+              "peer (an evicted worker, an absent coordinator, a wedged "
+              "backend) parks it forever — bound the wait against a "
+              "deadline, a stop event, or a give-up budget",
 }
 
 _HOST_SYNC_NAMES = {"float", "bool"}
@@ -84,6 +97,15 @@ _NP_NAMES = {"np", "numpy"}
 # deliberately absent (far too generic a method name to flag).
 _METRIC_RECORD_ATTRS = {"inc", "observe"}
 _METRIC_RECORD_NAMES = {"record_event", "record_span"}
+# TPF007: an identifier in the loop containing any of these substrings
+# counts as evidence the wait is bounded (a deadline compare, a stop
+# event, a timeout knob, a give-up budget). Deliberately generous — the
+# rule exists to catch loops with NO exit discipline at all, not to
+# audit the arithmetic of ones that have it.
+_POLL_BOUND_WORDS = (
+    "deadline", "timeout", "stop", "until", "budget", "give_up",
+    "remaining", "expires",
+)
 
 
 def _noqa_lines(source: str) -> dict[int, set[str]]:
@@ -203,6 +225,52 @@ class _Linter(ast.NodeVisitor):
     def visit_For(self, node) -> None:
         self._check_step_aux_loop(node)
         self.generic_visit(node)
+
+    # --- TPF007: unbounded while-True poll loops ---
+
+    @staticmethod
+    def _walk_no_funcs(node: ast.AST):
+        """``node``'s subtree without descending into nested function
+        definitions (a nested def's sleep belongs to that function's own
+        callers, not to this loop's iteration)."""
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (
+                ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+            )):
+                continue
+            yield sub
+            stack.extend(ast.iter_child_nodes(sub))
+
+    def visit_While(self, node) -> None:
+        self._check_unbounded_poll(node)
+        self.generic_visit(node)
+
+    def _check_unbounded_poll(self, node: ast.While) -> None:
+        test = node.test
+        if not (isinstance(test, ast.Constant) and test.value is True):
+            return  # a real condition IS the loop's exit discipline
+        sleeps = bounded = False
+        for sub in self._walk_no_funcs(node):
+            if (
+                isinstance(sub, ast.Call)
+                and self._call_name(sub.func) == "sleep"
+            ):
+                sleeps = True
+            name = (
+                sub.id if isinstance(sub, ast.Name)
+                else sub.attr if isinstance(sub, ast.Attribute)
+                else sub.arg if isinstance(sub, ast.keyword)
+                else None
+            )
+            if name and any(w in name.lower() for w in _POLL_BOUND_WORDS):
+                bounded = True
+        if sleeps and not bounded:
+            self._emit(
+                "TPF007", node,
+                "while True: loop sleeps but never checks a bound",
+            )
 
     @staticmethod
     def _call_name(func) -> str | None:

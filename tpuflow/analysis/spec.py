@@ -288,6 +288,51 @@ def _check_faults(config) -> list[Diagnostic]:
     return out
 
 
+def _check_elastic(config) -> list[Diagnostic]:
+    from tpuflow.elastic import validate_elastic_block
+
+    block = config.elastic
+    if block is None:
+        return []
+    out = [
+        _diag("spec.elastic.invalid", msg, where="elastic")
+        for msg in validate_elastic_block(block)
+    ]
+    if config.stream:
+        out.append(_diag(
+            "spec.elastic.stream",
+            "elastic workers shard the materialized training rows; "
+            "stream=True has no arrays to shard",
+            where="elastic",
+        ))
+    for axis in ("tp", "pp", "ep"):
+        if getattr(config, axis, 1) > 1:
+            out.append(_diag(
+                "spec.elastic.model_axis",
+                f"elastic is process-level data parallelism; {axis}="
+                f"{getattr(config, axis)} (an in-worker model axis) is "
+                "not supported inside an elastic worker",
+                where=axis,
+            ))
+    if config.n_devices is not None and config.n_devices > 1:
+        out.append(_diag(
+            "spec.elastic.n_devices",
+            f"elastic workers are single-device processes; n_devices="
+            f"{config.n_devices} would nest a device mesh inside each "
+            "worker",
+            where="n_devices",
+        ))
+    elif config.n_devices is None:
+        out.append(_diag(
+            "spec.elastic.n_devices", severity="warning",
+            message="elastic with n_devices unset defaults to ALL "
+            "visible devices inside every worker; set n_devices=1 "
+            "(runner-built specs do)",
+            where="n_devices",
+        ))
+    return out
+
+
 def validate_spec(config) -> list[Diagnostic]:
     """Cross-field validation of a ``TrainJobConfig``; returns ALL
     findings, never raises on a bad spec.
@@ -301,7 +346,7 @@ def validate_spec(config) -> list[Diagnostic]:
     for check in (
         _check_registries, _check_schema, _check_scalars,
         _check_windowing, _check_stream, _check_storage, _check_health,
-        _check_faults,
+        _check_faults, _check_elastic,
     ):
         try:
             out += check(config)

@@ -81,6 +81,15 @@ class TrainJobConfig:
     # "time": ...}); the supervisor injects its own path here so its
     # stall watchdog can tell hung from slow-but-alive.
     progress_path: str | None = None
+    # --- elastic data-parallel membership (tpuflow/elastic) ---
+    # When set, this run is ONE worker of an elastic gang: it trains on
+    # its disjoint row shard and syncs params with the coordinator every
+    # sync_every epochs. Required keys: dir (shared gang directory),
+    # worker_id, n_workers; knobs and defaults in
+    # tpuflow/elastic/__init__.py (ELASTIC_DEFAULTS). Spec-validated by
+    # the preflight spec pass; normally assembled by
+    # tpuflow.elastic.runner.worker_spec, not by hand.
+    elastic: dict | None = None
 
     # --- observability ---
     trace_dir: str | None = None  # jax.profiler trace of the first epoch

@@ -657,6 +657,25 @@ def _train_impl(
     splits, target_std = prep.splits, prep.target_std
     gilbert_test, seq_physics = prep.gilbert_test, prep.seq_physics
 
+    # --- elastic gang membership (tpuflow/elastic) ---
+    # This run is one worker of an elastic data-parallel gang: train on
+    # a disjoint row shard; the sync hook below pushes params and adopts
+    # the coordinator's average every sync round. Sharding happens AFTER
+    # the (cacheable) preparation — every worker prepares identical
+    # data, shards differ only by slice, and _prep_key stays untouched.
+    elastic_client = None
+    if config.elastic is not None:
+        from tpuflow.elastic.worker import ElasticWorkerClient, shard_rows
+
+        elastic_client = ElasticWorkerClient(
+            config.elastic,
+            resuming=bool(config.resume),
+            progress_path=config.progress_path,
+        )
+        train_ds = shard_rows(
+            train_ds, elastic_client.worker_id, elastic_client.n_workers
+        )
+
     # --- model + state (L3/L4) ---
     model_kwargs = dict(config.model_kwargs)
     if config.model == "gilbert_residual":
@@ -850,17 +869,38 @@ def _train_impl(
         stop_fn=stop_fn,
         health=config.health,
         roofline=roofline_cfg,
+        sync_fn=elastic_client.sync if elastic_client is not None else None,
     )
-    result = fit(
-        state,
-        train_ds,
-        val_ds,
-        fit_cfg,
-        train_step,
-        eval_step,
-        batch_sharding=batch_shard,
-        epoch_step=epoch_step,
-    )
+    if elastic_client is not None:
+        # Register with the gang: heartbeat thread + (for a fresh late
+        # joiner) warm-start from the latest published average; a
+        # RESUMING worker skips the warm start — its own checkpoint,
+        # restored inside fit(), is the right starting point. Adjacent
+        # to the try below so any failure after the heartbeat thread
+        # starts reaches the finish(failed=True) goodbye.
+        state = elastic_client.join(state)
+    try:
+        result = fit(
+            state,
+            train_ds,
+            val_ds,
+            fit_cfg,
+            train_step,
+            eval_step,
+            batch_sharding=batch_shard,
+            epoch_step=epoch_step,
+        )
+    except BaseException:
+        if elastic_client is not None:
+            # Say goodbye so the coordinator stops waiting on this
+            # worker immediately (the eviction deadline would get there
+            # anyway; a terminal heartbeat is just faster and labeled).
+            elastic_client.finish(failed=True)
+        raise
+    if elastic_client is not None:
+        # Final push: the runner averages every worker's last params
+        # into the gang's deliverable after all workers return.
+        elastic_client.finish(result.state)
 
     # --- final evaluation (cnn.py:132-134, working) ---
     # Batch sizing: reuse the fit loop's eval shape (config.batch_size)

@@ -97,6 +97,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-preflight", action="store_false", dest="preflight",
                    help="skip preflight static analysis (a bad spec then "
                         "fails wherever the runtime first hits it)")
+    p.add_argument("--elastic", type=int, default=0, metavar="N",
+                   help="run N elastic data-parallel workers under a "
+                        "coordinator (tpuflow/elastic): each worker "
+                        "trains the job on a disjoint shard, params are "
+                        "averaged every --elastic-sync-every epochs, and "
+                        "dead workers are evicted/restarted/rejoined "
+                        "(needs storagePath)")
+    p.add_argument("--elastic-sync-every", type=int, default=1,
+                   help="epochs between elastic averaging rounds")
+    p.add_argument("--elastic-heartbeat-timeout", type=float, default=30.0,
+                   help="stale-heartbeat eviction deadline, seconds")
+    p.add_argument("--elastic-max-restarts", type=int, default=2,
+                   help="per-worker supervisor restart budget")
+    p.add_argument("--elastic-stall-timeout", type=float, default=None,
+                   help="per-worker progress watchdog, seconds: a "
+                        "worker wedged mid-epoch (not dead — the "
+                        "heartbeat eviction can't end its process) is "
+                        "killed and restarted; set above first-epoch "
+                        "compile time")
     p.add_argument("--predict", action="store_true",
                    help="serve: load the trained artifact from storagePath and predict --data")
     p.add_argument("--out", default=None, help="with --predict: write predictions CSV here")
@@ -248,6 +267,36 @@ def main(argv=None) -> int:
         report = compare(compare_names, config)
         print(report.table())
         return 0 if report.ranked else 1
+    if args.elastic:
+        if not config.storage_path:
+            print(
+                "--elastic needs storagePath (workers checkpoint under "
+                "{storagePath}/workerN; restarts resume from there)",
+                file=sys.stderr,
+            )
+            return 2
+        import dataclasses
+        import json as _json
+
+        from tpuflow.elastic.runner import run_elastic
+
+        try:
+            result = run_elastic(
+                dataclasses.asdict(config),
+                args.elastic,
+                sync_every=args.elastic_sync_every,
+                heartbeat_timeout=args.elastic_heartbeat_timeout,
+                max_restarts=args.elastic_max_restarts,
+                stall_timeout=args.elastic_stall_timeout,
+                verbose=not args.quiet,
+            )
+        except ValueError as e:
+            # e.g. a stale gang dir from a previous --elastic run under
+            # the same storagePath: a submission error, not a traceback.
+            print(f"--elastic: {e}", file=sys.stderr)
+            return 2
+        print(_json.dumps(result.summary()))
+        return 0 if result.ok else 1
     train(config)
     return 0
 

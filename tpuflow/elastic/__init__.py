@@ -1,0 +1,127 @@
+"""Elastic data-parallel training: local SGD + coordinated averaging.
+
+ROADMAP item 4, in the SparkNet/DeepSpark mold (PAPERS.md): N worker
+processes each run the ordinary single-process ``train()`` loop on a
+disjoint shard of the training rows; a small coordinator periodically
+averages their parameters and rebroadcasts the mean. The exchange is
+deliberately file-based (``exchange.py``) — it needs no collective
+runtime, so it works despite the broken ``make_mesh`` on the installed
+jax and, more importantly, tolerates membership churn by construction:
+
+- **Heartbeats + eviction** (``membership.py``): a worker whose
+  heartbeat goes stale past the deadline is evicted; averaging proceeds
+  over the live set.
+- **Restart + rejoin** (``runner.py``): each worker runs under its own
+  ``train/supervisor.py`` attempt loop — a SIGKILLed worker is
+  relaunched with ``resume=True``, replays from its run checkpoint, and
+  is readmitted the moment its heartbeat reappears.
+- **Warm start** (``worker.py``): a late joiner with no checkpoint
+  adopts the latest published average before its first epoch
+  (``train/resume.py::apply_params``), so it starts from gang progress,
+  not from init.
+
+Drillable end to end through the resilience registry: the
+``elastic.heartbeat`` / ``elastic.push`` / ``elastic.join`` fault sites
+(docs/elastic.md has the recipes).
+
+A worker is configured by the spec-validated ``elastic`` block of
+``TrainJobConfig`` (``analysis/spec.py`` rejects malformed blocks at
+submission)::
+
+    {"dir": "/shared/gang", "worker_id": 0, "n_workers": 3,
+     "sync_every": 1, "heartbeat_interval": 0.25,
+     "heartbeat_timeout": 30.0, "pull_timeout": 120.0,
+     "warm_start": true}
+
+``run_elastic`` (``runner.py``) builds those blocks, launches the
+coordinator plus the per-worker supervisors, and averages the workers'
+final pushes into the gang's deliverable.
+"""
+
+from __future__ import annotations
+
+# Per-knob defaults and validation for the ``elastic`` config block.
+# Kept import-light: the preflight spec pass reads these without pulling
+# jax-heavy worker machinery.
+ELASTIC_DEFAULTS: dict = {
+    "sync_every": 1,           # epochs between averaging rounds
+    "heartbeat_interval": 0.25,  # seconds between heartbeat writes
+    "heartbeat_timeout": 30.0,  # stale-heartbeat eviction deadline
+    "round_timeout": 60.0,     # coordinator wait per round
+    "pull_timeout": 120.0,     # worker wait for a round's average
+    "poll_interval": 0.05,     # file-polling cadence (worker + coord)
+    "warm_start": True,        # late joiners adopt the latest average
+}
+
+_REQUIRED = ("dir", "worker_id", "n_workers")
+
+
+def validate_elastic_block(block) -> list[str]:
+    """Every problem with an ``elastic`` config block, as messages
+    (empty = valid). Never raises — the preflight spec pass reports all
+    findings at once; ``resolve_elastic`` turns them into the fail-loud
+    raise for runtime callers."""
+    if not isinstance(block, dict):
+        return [
+            f"elastic must be a dict config block, got "
+            f"{type(block).__name__}"
+        ]
+    out = []
+    known = set(_REQUIRED) | set(ELASTIC_DEFAULTS)
+    unknown = sorted(set(block) - known)
+    if unknown:
+        out.append(
+            f"unknown elastic keys {unknown}; known: {sorted(known)}"
+        )
+    for key in _REQUIRED:
+        if key not in block:
+            out.append(f"elastic.{key} is required")
+    if not isinstance(block.get("dir", "x"), str) or block.get("dir") == "":
+        out.append("elastic.dir must be a non-empty path string")
+    wid, n = block.get("worker_id"), block.get("n_workers")
+    if wid is not None and (not isinstance(wid, int) or wid < 0):
+        out.append(f"elastic.worker_id must be an int >= 0, got {wid!r}")
+    if n is not None and (not isinstance(n, int) or n < 1):
+        out.append(f"elastic.n_workers must be an int >= 1, got {n!r}")
+    if (
+        isinstance(wid, int) and isinstance(n, int)
+        and 0 <= wid and 1 <= n and wid >= n
+    ):
+        out.append(
+            f"elastic.worker_id {wid} is outside the gang "
+            f"(n_workers={n}; ids are 0-based)"
+        )
+    if not isinstance(block.get("sync_every", 1), int) or (
+        block.get("sync_every", 1) < 1
+    ):
+        out.append(
+            f"elastic.sync_every must be an int >= 1, got "
+            f"{block.get('sync_every')!r}"
+        )
+    for key in (
+        "heartbeat_interval", "heartbeat_timeout", "round_timeout",
+        "pull_timeout", "poll_interval",
+    ):
+        value = block.get(key, 1.0)
+        if not isinstance(value, (int, float)) or value <= 0:
+            out.append(
+                f"elastic.{key} must be a positive number (seconds), "
+                f"got {value!r}"
+            )
+    if not isinstance(block.get("warm_start", True), bool):
+        out.append(
+            f"elastic.warm_start must be a bool, got "
+            f"{block.get('warm_start')!r}"
+        )
+    return out
+
+
+def resolve_elastic(block: dict) -> dict:
+    """Defaults-merged, validated copy of an ``elastic`` block; raises
+    ``ValueError`` listing every problem."""
+    problems = validate_elastic_block(block)
+    if problems:
+        raise ValueError(
+            "invalid elastic config block: " + "; ".join(problems)
+        )
+    return {**ELASTIC_DEFAULTS, **block}

@@ -1,0 +1,71 @@
+"""Shell entry for an elastic gang::
+
+    python -m tpuflow.elastic spec.json --workers 3 --sync-every 1
+
+The spec is the same JSON job spec ``POST /jobs`` and ``supervise()``
+accept (it must set ``storagePath``); the runner adds the per-worker
+``elastic`` blocks and checkpoint trees itself.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tpuflow.elastic.runner import MODES, run_elastic
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpuflow.elastic",
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument("spec", help="JSON job-spec file (serve.py contract)")
+    ap.add_argument("--workers", type=int, default=2, metavar="N",
+                    help="gang size (worker processes)")
+    ap.add_argument("--mode", choices=MODES, default="supervised",
+                    help="supervised: child processes under the restart "
+                    "loop (default); inprocess: threads, no restarts")
+    ap.add_argument("--gang-dir", default=None,
+                    help="shared coordination dir "
+                    "(default {storagePath}/elastic)")
+    ap.add_argument("--sync-every", type=int, default=1,
+                    help="epochs between averaging rounds")
+    ap.add_argument("--heartbeat-timeout", type=float, default=30.0,
+                    help="stale-heartbeat eviction deadline, seconds")
+    ap.add_argument("--round-timeout", type=float, default=60.0,
+                    help="coordinator wait per averaging round, seconds")
+    ap.add_argument("--max-restarts", type=int, default=2,
+                    help="per-worker supervisor restart budget")
+    ap.add_argument("--stall-timeout", type=float, default=None,
+                    help="per-worker progress watchdog, seconds")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+    with open(args.spec, encoding="utf-8") as f:
+        spec = json.load(f)
+    try:
+        result = run_elastic(
+            spec,
+            args.workers,
+            gang_dir=args.gang_dir,
+            mode=args.mode,
+            sync_every=args.sync_every,
+            heartbeat_timeout=args.heartbeat_timeout,
+            round_timeout=args.round_timeout,
+            max_restarts=args.max_restarts,
+            stall_timeout=args.stall_timeout,
+            verbose=not args.quiet,
+        )
+    except ValueError as e:
+        # e.g. a stale gang dir from a previous run under the same
+        # storagePath: a submission error, not a traceback — the same
+        # UX as cli.py --elastic.
+        print(f"tpuflow.elastic: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(result.summary()))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,334 @@
+"""The averaging coordinator: collect pushes, evict the dead, rebroadcast.
+
+The driver role from SparkNet/BigDL (PAPERS.md), reduced to its
+essentials and hardened for churn: per round it (1) classifies gang
+membership against the heartbeat deadline (``membership.py``), (2) waits
+— bounded by ``round_timeout`` — for the live set's parameter pushes,
+(3) averages whatever arrived and publishes the result, then opens the
+next round. Everything is observation over shared files; the coordinator
+holds no connection to any worker, so a worker dying at ANY point costs
+at most one round-timeout of waiting, after which its stale heartbeat
+evicts it and averaging proceeds over the survivors.
+
+Rejoin is symmetric and handshake-free: a restarted worker's fresh
+heartbeat readmits it to the live set, and its pushes count again the
+moment its round counter catches up with the gang's (historic rounds it
+replays resolve instantly against the already-published averages).
+
+Structured as ``step()`` (one scan, non-blocking, returns what changed)
+driven by ``run(stop)`` — so tier-1 drills call ``step()`` directly
+under a fake clock and never wait on the wall.
+
+State is continuously checkpointed to ``{gang_dir}/coordinator.json``
+and, on an abort, dumped to forensics
+(``{gang_dir}/forensics-coordinator.jsonl``) alongside the event ring —
+the "what was the gang doing?" trail for a dead coordinator.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from tpuflow.elastic import exchange
+from tpuflow.elastic.membership import classify_members
+
+STATE_FILE = "coordinator.json"
+
+
+class Coordinator:
+    """See the module docstring. ``clock``/``sleep`` are injectable for
+    zero-wall-clock drills; metrics go to the process-wide registry
+    (``elastic_workers`` gauge, eviction/rejoin/round counters,
+    ``elastic.round`` spans)."""
+
+    def __init__(
+        self,
+        gang_dir: str,
+        *,
+        heartbeat_timeout: float = 30.0,
+        round_timeout: float = 60.0,
+        poll_interval: float = 0.05,
+        min_round_interval: float = 0.0,
+        min_round: int = 1,
+        keep_rounds: int = 16,
+        expected_workers: int = 0,
+        assembly_timeout: float = 60.0,
+        clock=time.time,
+        sleep=time.sleep,
+        verbose: bool = False,
+    ):
+        from tpuflow.obs import default_registry
+
+        self.gang_dir = gang_dir
+        self.heartbeat_timeout = heartbeat_timeout
+        self.round_timeout = round_timeout
+        self.poll_interval = poll_interval
+        # Floor on the publication cadence (0 = as fast as pushes
+        # arrive). A paced gang gives a briefly-absent worker rounds to
+        # rejoin INTO instead of a fait accompli — and gives churn
+        # drills a deterministic window to observe eviction + rejoin.
+        self.min_round_interval = min_round_interval
+        self._last_publish: float | None = None
+        # Disk bound: after each publication, push dirs and averages
+        # for rounds older than BOTH keep_rounds and the slowest live
+        # member's round are pruned — a long gang must not write one
+        # param copy per worker per round forever. 0 disables pruning.
+        self.keep_rounds = keep_rounds
+        # How many workers the gang was launched with (0 = unknown):
+        # all_finished() must not declare a natural end before every
+        # expected worker has even been SEEN — a fast first worker
+        # finishing its tiny job before slower siblings' first
+        # heartbeat would otherwise end the coordinator under them.
+        self.expected_workers = expected_workers
+        # The assembly gate must itself be deadline-bounded (the TPF007
+        # discipline): a worker that permanently fails before its first
+        # heartbeat must cost one assembly window, not disable
+        # averaging for the whole run.
+        self.assembly_timeout = assembly_timeout
+        self._first_step: float | None = None
+        self.clock = clock
+        self.sleep = sleep
+        self.verbose = verbose
+        self.round = min_round  # the round currently being collected
+        self.evicted: set[int] = set()
+        self.rejoins = 0
+        self.rounds: dict[int, list[int]] = {}  # round -> ids averaged
+        self.ever_seen: set[int] = set()
+        self._round_opened: float | None = None  # first push observed at
+        self._last_view = None  # step()'s scan, reused by run()
+        reg = default_registry()
+        self._workers_gauge = reg.gauge(
+            "elastic_workers", "live elastic workers at the last scan"
+        )
+        self._evictions = reg.counter(
+            "elastic_evictions_total",
+            "workers evicted on a stale heartbeat deadline",
+        )
+        self._rejoins = reg.counter(
+            "elastic_rejoins_total",
+            "evicted workers readmitted by a fresh heartbeat",
+        )
+        self._rounds = reg.counter(
+            "elastic_rounds_total", "averaging rounds published"
+        )
+        os.makedirs(gang_dir, exist_ok=True)
+
+    # ---- one scan ----
+
+    def step(self) -> bool:
+        """One non-blocking scan: update membership accounting, publish
+        the current round if it is ready (live set covered, or the round
+        deadline expired with at least one push). Returns True when a
+        round was published."""
+        from tpuflow.obs import record_event, record_span
+
+        now = self.clock()
+        if self._first_step is None:
+            self._first_step = now
+        view = classify_members(self.gang_dir, self.heartbeat_timeout, now)
+        self._last_view = view  # reused by run()'s end-of-gang check
+        self.ever_seen |= view.live_ids | view.stale_ids
+        self.ever_seen |= {m.worker_id for m in view.finished}
+        changed = False
+        for wid in sorted(view.stale_ids - self.evicted):
+            self.evicted.add(wid)
+            self._evictions.inc()
+            record_event(
+                "elastic_worker_evicted", worker_id=wid, round=self.round,
+            )
+            changed = True
+            if self.verbose:
+                print(
+                    f"elastic: evicted worker {wid} (heartbeat older "
+                    f"than {self.heartbeat_timeout:g}s) at round "
+                    f"{self.round}"
+                )
+        for wid in sorted(view.live_ids & self.evicted):
+            self.evicted.discard(wid)
+            self.rejoins += 1
+            self._rejoins.inc()
+            record_event(
+                "elastic_worker_rejoined", worker_id=wid, round=self.round,
+            )
+            changed = True
+            if self.verbose:
+                print(f"elastic: worker {wid} rejoined at round {self.round}")
+        self._workers_gauge.set(len(view.live))
+
+        pushed = exchange.pushed_ids(self.gang_dir, self.round)
+        published = False
+        if pushed:
+            if self._round_opened is None:
+                self._round_opened = now
+            # Wait only for live RUNNING workers AT this round:
+            # "joining" members are warm-starting (not pushing rounds
+            # yet), finished members said goodbye, evicted members are
+            # exactly who this deadline exists to stop waiting for —
+            # and a rejoined catch-up worker (reported round lagging
+            # the gang's) only ADOPTS history, so waiting on it would
+            # collapse cadence to round_timeout per round until it
+            # caught up. A healthy member reports round or round-1
+            # (mid-epoch), so lag of one is still waited on; anything
+            # older is catching up. An EMPTY waiting set publishes
+            # immediately: nobody current is expected to push more.
+            waiting = {
+                m.worker_id
+                for m in view.live
+                if m.status == "running" and m.round >= self.round - 1
+            }
+            deadline_passed = now - self._round_opened > self.round_timeout
+            paced = (
+                self._last_publish is None
+                or now - self._last_publish >= self.min_round_interval
+            )
+            # Launch stagger: a fast worker can push round 1 before its
+            # siblings' first heartbeat even lands (they are invisible
+            # to the waiting set) — hold publication until every
+            # expected worker has been SEEN at least once, or early
+            # rounds average over a subset of a perfectly healthy gang.
+            # Bounded by assembly_timeout: a worker that never shows up
+            # must not disable averaging forever.
+            assembled = (
+                len(self.ever_seen) >= self.expected_workers
+                or now - self._first_step > self.assembly_timeout
+            )
+            if (
+                paced and assembled
+                and (waiting <= pushed or deadline_passed)
+            ):
+                published = self._publish(now, record_span)
+        if published and self.keep_rounds:
+            # Prune only behind the slowest LIVE member: a lagging
+            # catch-up worker's historic rounds stay readable; an
+            # evicted worker that returns needing even older ones
+            # skips them (worker-side latest_round check).
+            min_live = min(
+                (m.round for m in view.live), default=self.round
+            )
+            below = min(min_live, self.round - self.keep_rounds)
+            if below > 0:
+                exchange.prune_rounds(self.gang_dir, below)
+        if changed or published:
+            self._write_state(now)
+        return published
+
+    def _publish(self, now: float, record_span) -> bool:
+        # Average EVERY readable push for the round — including one from
+        # a worker that pushed and then died: its params are legitimate
+        # round data; eviction only stops the *waiting*.
+        leaves, used = exchange.average_pushes(self.gang_dir, self.round)
+        if leaves is None:
+            return False
+        exchange.publish_average(
+            self.gang_dir, self.round, leaves, clock=self.clock
+        )
+        opened = self._round_opened if self._round_opened is not None else now
+        record_span(
+            "elastic.round", max(now - opened, 0.0),
+            round=self.round, workers=len(used), worker_ids=used,
+        )
+        self.rounds[self.round] = used
+        # The mirrored per-round membership is a diagnostic window, not
+        # an archive: unbounded it would grow one entry per round and
+        # make every state-file rewrite O(rounds) — quadratic
+        # cumulative I/O over a long gang.
+        cap = max(self.keep_rounds * 4, 64) if self.keep_rounds else 0
+        while cap and len(self.rounds) > cap:
+            del self.rounds[min(self.rounds)]
+        self._rounds.inc()
+        if self.verbose:
+            print(
+                f"elastic: published round {self.round} averaged over "
+                f"workers {used}"
+            )
+        self.round += 1
+        self._round_opened = None
+        self._last_publish = now
+        return True
+
+    # ---- lifecycle ----
+
+    def all_finished(self, view=None) -> bool:
+        """True once every worker ever seen has said ``done`` — the
+        natural end of a gang. A ``failed`` goodbye is deliberately NOT
+        terminal here: under the supervised runner the worker's restart
+        loop may be mid-backoff, and a coordinator that exited on the
+        failure would leave the resurrected worker pushing into a void
+        (blocking ``pull_timeout`` per round for averages that never
+        come). Permanently-failed gangs are ended by ``run``'s stop
+        event — the runner, which watches the worker threads, owns that
+        decision. ``view`` reuses a scan the caller already did;
+        without it the membership dir is re-read."""
+        if view is None:
+            view = classify_members(
+                self.gang_dir, self.heartbeat_timeout, self.clock()
+            )
+        if len(self.ever_seen) < self.expected_workers:
+            return False  # launched workers haven't all checked in yet
+        done = {
+            m.worker_id for m in view.finished if m.status == "done"
+        }
+        return bool(self.ever_seen) and self.ever_seen <= done
+
+    def run(self, stop=None) -> dict:
+        """Drive ``step()`` until ``stop`` is set or every worker has
+        finished. On an unexpected abort the coordinator state and the
+        recent-event ring are dumped next to the gang files before the
+        error propagates."""
+        from tpuflow.obs import dump_forensics, record_event
+
+        try:
+            while stop is None or not stop.is_set():
+                self.step()
+                if self.all_finished(self._last_view):
+                    break
+                self.sleep(self.poll_interval)
+            self._write_state(self.clock())
+            return self.state()
+        except BaseException as e:
+            record_event(
+                "elastic_coordinator_abort",
+                round=self.round,
+                error=f"{type(e).__name__}: {e}",
+            )
+            try:
+                self._write_state(self.clock())
+            except OSError:
+                pass
+            dump_forensics(
+                os.path.join(self.gang_dir, "forensics-coordinator.jsonl"),
+                reason=f"elastic coordinator aborted at round {self.round}",
+            )
+            raise
+
+    # ---- state ----
+
+    def state(self) -> dict:
+        return {
+            "round": self.round,
+            "evicted": sorted(self.evicted),
+            "rejoins": self.rejoins,
+            "rounds": {str(r): ids for r, ids in sorted(self.rounds.items())},
+            "ever_seen": sorted(self.ever_seen),
+        }
+
+    def _write_state(self, now: float) -> None:
+        from tpuflow.utils.paths import atomic_write_json
+
+        try:
+            atomic_write_json(
+                os.path.join(self.gang_dir, STATE_FILE),
+                {**self.state(), "time": now},
+            )
+        except OSError:
+            pass  # state mirroring is observability, never the run
+
+
+def read_coordinator_state(gang_dir: str) -> dict | None:
+    try:
+        with open(os.path.join(gang_dir, STATE_FILE), encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None

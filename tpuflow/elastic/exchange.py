@@ -1,0 +1,310 @@
+"""File-based parameter exchange: worker pushes, averaged rebroadcasts.
+
+SparkNet's training strategy (PAPERS.md, arXiv:1511.06051) is local SGD
+with driver-coordinated parameter averaging: workers train independently
+for a fixed number of steps, the driver averages their parameters and
+broadcasts the average back. The transport here is deliberately the
+filesystem — NOT ``jax.distributed`` collectives — for two load-bearing
+reasons:
+
+- **It works today.** The installed jax's mesh construction is broken
+  (ROADMAP item 1); a collective-based exchange would be dead on
+  arrival. Files need nothing but a shared directory.
+- **It tolerates churn by construction.** A collective has a fixed
+  communicator: one dead rank wedges everyone. A directory of
+  ``push/r000007/{worker_id}.npz`` files has no membership baked in —
+  the coordinator averages whichever files the live set produced, and a
+  worker that died mid-push left only an invisible temp file.
+
+Layout under the gang dir::
+
+    push/r{round:06d}/{worker_id}.npz   one worker's params for a round
+    push/final/{worker_id}.npz          a finished worker's last params
+    avg/r{round:06d}.npz                the averaged rebroadcast
+    avg/LATEST                          JSON {round, path, time}
+
+Params ride as their flattened pytree leaves (``arr_0..arr_{n-1}`` in
+tree-flatten order) plus a leaf count; the reader restores against the
+live state's own treedef, so structure mismatches fail loudly instead of
+silently mis-zipping leaves. Every write is atomic (tmp + rename): a
+reader never sees a torn file, only a missing one — "not pushed yet" and
+"crashed mid-push" are deliberately the same observation.
+
+The ``elastic.push`` fault site fires inside every push (index = round,
+so ``at=K`` drills "the worker that dies pushing round K").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+from tpuflow.resilience import fault_point
+
+PUSH_DIR = "push"
+AVG_DIR = "avg"
+FINAL_ROUND = "final"
+LATEST = "LATEST"
+
+
+def _round_name(round) -> str:
+    return round if round == FINAL_ROUND else f"r{int(round):06d}"
+
+
+def push_dir(gang_dir: str, round) -> str:
+    return os.path.join(gang_dir, PUSH_DIR, _round_name(round))
+
+
+def avg_path(gang_dir: str, round: int) -> str:
+    return os.path.join(gang_dir, AVG_DIR, _round_name(round) + ".npz")
+
+
+def flatten_params(params) -> list[np.ndarray]:
+    """Params pytree -> host numpy leaves in tree-flatten order."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(params)
+    return [np.asarray(leaf) for leaf in leaves]
+
+
+def unflatten_like(params, leaves: list[np.ndarray]):
+    """Leaves (tree-flatten order) -> a pytree shaped like ``params``.
+
+    Leaf count and per-leaf shapes are checked against the template — a
+    file from a differently-configured model must fail loudly, not
+    silently mis-assign weights.
+    """
+    import jax
+
+    template_leaves, treedef = jax.tree_util.tree_flatten(params)
+    if len(leaves) != len(template_leaves):
+        raise ValueError(
+            f"param exchange file carries {len(leaves)} leaves; this "
+            f"model has {len(template_leaves)} — different model/config?"
+        )
+    cast = []
+    for i, (got, want) in enumerate(zip(leaves, template_leaves)):
+        want_shape = tuple(np.shape(want))
+        if tuple(got.shape) != want_shape:
+            raise ValueError(
+                f"param exchange leaf {i} has shape {tuple(got.shape)}; "
+                f"this model expects {want_shape} — different "
+                "model/config?"
+            )
+        # .dtype, not np.asarray(want).dtype: the template leaves are
+        # the LIVE device params, and asarray would pull every one of
+        # them to host just to read a dtype — doubling host transfer
+        # per adopt.
+        cast.append(np.asarray(got, dtype=getattr(want, "dtype", None)))
+    return jax.tree_util.tree_unflatten(treedef, cast)
+
+
+def _write_npz(path: str, leaves: list[np.ndarray]) -> None:
+    import threading
+
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    # (pid, thread)-unique like utils.paths.atomic_write_json: the
+    # in-process runner mode runs workers as threads of one pid.
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "wb") as f:
+        np.savez(f, n_leaves=np.int64(len(leaves)),
+                 **{f"arr_{i}": leaf for i, leaf in enumerate(leaves)})
+    os.replace(tmp, path)
+
+
+def _read_npz(path: str) -> list[np.ndarray]:
+    with np.load(path) as z:
+        n = int(z["n_leaves"])
+        return [z[f"arr_{i}"] for i in range(n)]
+
+
+def write_leaves(path: str, leaves: list[np.ndarray]) -> str:
+    """Atomically write a leaves file outside the push/avg layout (the
+    runner's final-average deliverable)."""
+    _write_npz(path, leaves)
+    return path
+
+
+def push_params(gang_dir: str, round, worker_id: int, params) -> str:
+    """Write this worker's params for ``round`` (atomic); returns the
+    path. ``round`` may be the string ``"final"`` for the end-of-run
+    push the runner's final average reads."""
+    index = None if round == FINAL_ROUND else int(round)
+    fault_point("elastic.push", index=index)
+    path = os.path.join(push_dir(gang_dir, round), f"{worker_id}.npz")
+    _write_npz(path, flatten_params(params))
+    return path
+
+
+def pushed_ids(gang_dir: str, round) -> set[int]:
+    """Worker IDs that have completed a push for ``round``."""
+    try:
+        names = os.listdir(push_dir(gang_dir, round))
+    except OSError:
+        return set()
+    out = set()
+    for name in names:
+        stem, ext = os.path.splitext(name)
+        if ext == ".npz" and stem.isdigit():
+            out.add(int(stem))
+    return out
+
+
+def average_pushes(
+    gang_dir: str, round, include: set[int] | None = None
+) -> tuple[list[np.ndarray] | None, list[int]]:
+    """Mean of the pushed leaves for ``round`` over ``include`` (None =
+    every completed push). Returns ``(leaves, worker_ids_averaged)``;
+    leaves is None when nothing (readable) was pushed. A torn/corrupt
+    file is skipped — the push side is atomic, so unreadable means a
+    concurrent replace, and averaging must proceed over the live set
+    rather than wedge the round."""
+    ids = sorted(pushed_ids(gang_dir, round))
+    if include is not None:
+        ids = [i for i in ids if i in include]
+    acc: list[np.ndarray] | None = None
+    used: list[int] = []
+    for wid in ids:
+        path = os.path.join(push_dir(gang_dir, round), f"{wid}.npz")
+        try:
+            leaves = _read_npz(path)
+        except (OSError, ValueError, KeyError):
+            continue
+        if acc is None:
+            acc = [np.asarray(leaf, np.float64) for leaf in leaves]
+        else:
+            if len(leaves) != len(acc):
+                raise ValueError(
+                    f"worker {wid}'s push for round {round} has "
+                    f"{len(leaves)} leaves; others pushed {len(acc)} — "
+                    "mixed model configs in one gang"
+                )
+            for i, (a, leaf) in enumerate(zip(acc, leaves)):
+                # Shape-checked like the adopt side (unflatten_like):
+                # same depth + different widths would otherwise either
+                # crash with a bare numpy broadcast error or — worse —
+                # broadcast INTO the accumulator and publish a silently
+                # wrong average for every worker to adopt.
+                if tuple(np.shape(leaf)) != tuple(a.shape):
+                    raise ValueError(
+                        f"worker {wid}'s push for round {round} leaf "
+                        f"{i} has shape {tuple(np.shape(leaf))}; others "
+                        f"pushed {tuple(a.shape)} — mixed model configs "
+                        "in one gang"
+                    )
+                a += leaf
+        used.append(wid)
+    if acc is None:
+        return None, []
+    return [np.asarray(a / len(used), np.float32) for a in acc], used
+
+
+def publish_average(
+    gang_dir: str, round: int, leaves: list[np.ndarray],
+    clock=time.time,
+) -> str:
+    """Write the averaged params for ``round`` and repoint LATEST
+    (average first, pointer second — a crash in between leaves the old
+    pointer valid)."""
+    path = avg_path(gang_dir, round)
+    _write_npz(path, leaves)
+    from tpuflow.utils.paths import atomic_write_json
+
+    # The pointer is gang_dir-RELATIVE: workers on other hosts may see
+    # the same share under a different mount point, and an absolute
+    # coordinator-side path would silently break their warm start.
+    atomic_write_json(
+        os.path.join(gang_dir, AVG_DIR, LATEST),
+        {
+            "round": int(round),
+            "path": os.path.join(AVG_DIR, _round_name(round) + ".npz"),
+            "time": clock(),
+        },
+    )
+    return path
+
+
+def read_average(gang_dir: str, round: int) -> list[np.ndarray] | None:
+    """The averaged leaves for ``round``, or None if not published yet."""
+    try:
+        return _read_npz(avg_path(gang_dir, round))
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def latest_round(gang_dir: str) -> int | None:
+    """The newest published round NUMBER (pointer read only, no array
+    load) — the cheap check catch-up workers poll with."""
+    try:
+        with open(
+            os.path.join(gang_dir, AVG_DIR, LATEST), encoding="utf-8"
+        ) as f:
+            return int(json.load(f)["round"])
+    except (OSError, ValueError, TypeError, KeyError,
+            json.JSONDecodeError):
+        return None
+
+
+def _parse_round(name: str) -> int | None:
+    if (
+        len(name) == 7 and name.startswith("r") and name[1:].isdigit()
+    ):
+        return int(name[1:])
+    return None
+
+
+def prune_rounds(gang_dir: str, below: int) -> int:
+    """Best-effort delete of push dirs and averaged files for rounds
+    < ``below`` (never ``final`` or ``LATEST``). Without pruning a long
+    gang writes one full copy of the params per worker per round
+    forever; the coordinator calls this behind the slowest live
+    member's round, and a catch-up worker that finds a historic round
+    pruned just skips it (``latest_round`` is newer — see
+    worker._wait_for_average)."""
+    import shutil
+
+    removed = 0
+    push_root = os.path.join(gang_dir, PUSH_DIR)
+    try:
+        names = os.listdir(push_root)
+    except OSError:
+        names = []
+    for name in names:
+        r = _parse_round(name)
+        if r is not None and r < below:
+            shutil.rmtree(os.path.join(push_root, name), ignore_errors=True)
+            removed += 1
+    avg_root = os.path.join(gang_dir, AVG_DIR)
+    try:
+        names = os.listdir(avg_root)
+    except OSError:
+        names = []
+    for name in names:
+        if not name.endswith(".npz"):
+            continue
+        r = _parse_round(name[: -len(".npz")])
+        if r is not None and r < below:
+            try:
+                os.remove(os.path.join(avg_root, name))
+                removed += 1
+            except OSError:
+                pass
+    return removed
+
+
+def latest_average(gang_dir: str) -> tuple[int, list[np.ndarray]] | None:
+    """The newest published average as ``(round, leaves)``, or None when
+    no round has ever been published — the late joiner's warm-start
+    source."""
+    latest = os.path.join(gang_dir, AVG_DIR, LATEST)
+    try:
+        with open(latest, encoding="utf-8") as f:
+            rec = json.load(f)
+        path = os.path.join(gang_dir, rec["path"])  # pointer is relative
+        return int(rec["round"]), _read_npz(path)
+    except (OSError, ValueError, TypeError, KeyError,
+            json.JSONDecodeError):
+        return None

@@ -1,0 +1,177 @@
+"""Gang membership: per-worker heartbeat files + deadline-based liveness.
+
+SparkNet/DeepSpark (PAPERS.md) tolerate worker loss because the driver's
+view of the gang is *observed*, not assumed: a worker that stops talking
+is simply no longer part of the next averaging step. This module is that
+observation layer, deliberately file-based — it works on any shared
+filesystem today (the same transport the param exchange uses), needs no
+collective runtime to be healthy, and survives arbitrary membership
+churn because membership IS just the set of files whose mtimes are
+fresh.
+
+Each worker overwrites ``{gang_dir}/members/{worker_id}.json`` with a
+small heartbeat record (atomic tmp+rename, so a reader never sees a torn
+write)::
+
+    {"worker_id": 2, "time": <clock>, "epoch": 7, "round": 7,
+     "status": "running", "pid": 12345}
+
+The coordinator classifies each member against ``heartbeat_timeout``:
+
+- **live** — heartbeat age <= timeout and status != "done"/"failed".
+- **stale** — age > timeout: the worker is presumed dead and EVICTED
+  from averaging (it keeps its file; a fresh heartbeat readmits it — the
+  rejoin path, no registration handshake needed).
+- **done/failed** — the worker said goodbye; never waited on again.
+
+Clocks and sleeps are injectable everywhere so eviction/rejoin logic is
+drilled in tier-1 with a fake clock — no wall-clock waits.
+
+The ``elastic.heartbeat`` fault site fires inside every heartbeat write:
+arming it (``mode=raise`` kills the heartbeat thread, ``mode=exit`` the
+worker) is the reproducible "worker goes silent" drill the eviction
+deadline exists for.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass
+
+from tpuflow.resilience import fault_point
+from tpuflow.utils.paths import atomic_write_json
+
+MEMBERS_DIR = "members"
+
+# Heartbeat states a worker reports about itself. "joining" covers the
+# warm-start window (the worker is alive but not yet pushing rounds);
+# terminal states tell the coordinator to stop waiting on this worker
+# without any eviction deadline.
+STATUSES = ("joining", "running", "done", "failed")
+TERMINAL_STATUSES = ("done", "failed")
+
+
+@dataclass
+class Member:
+    """One worker's last heartbeat, as the coordinator reads it."""
+
+    worker_id: int
+    time: float
+    epoch: int = 0
+    round: int = 0
+    status: str = "joining"
+    pid: int | None = None
+
+    def age(self, now: float) -> float:
+        return now - self.time
+
+
+def members_dir(gang_dir: str) -> str:
+    return os.path.join(gang_dir, MEMBERS_DIR)
+
+
+def heartbeat_path(gang_dir: str, worker_id: int) -> str:
+    return os.path.join(members_dir(gang_dir), f"{worker_id}.json")
+
+
+def write_heartbeat(
+    gang_dir: str,
+    worker_id: int,
+    *,
+    epoch: int = 0,
+    round: int = 0,
+    status: str = "running",
+    clock=time.time,
+) -> None:
+    """Overwrite this worker's heartbeat file (atomic tmp+rename).
+
+    Raises on an unknown status — a typo'd terminal state would leave
+    the coordinator waiting on a worker that thinks it said goodbye.
+    """
+    if status not in STATUSES:
+        raise ValueError(
+            f"unknown heartbeat status {status!r}; valid: {STATUSES}"
+        )
+    fault_point("elastic.heartbeat")
+    os.makedirs(members_dir(gang_dir), exist_ok=True)
+    # atomic_write_json's tmp name is unique per (process, thread): the
+    # worker's heartbeat thread and its main-thread sync beats write
+    # this path concurrently.
+    atomic_write_json(
+        heartbeat_path(gang_dir, worker_id),
+        {
+            "worker_id": worker_id,
+            "time": clock(),
+            "epoch": epoch,
+            "round": round,
+            "status": status,
+            "pid": os.getpid(),
+        },
+    )
+
+
+def read_members(gang_dir: str) -> list[Member]:
+    """Every member file, torn/corrupt ones skipped (the write side is
+    atomic, so unreadable means "being replaced right now" — the next
+    scan sees it)."""
+    d = members_dir(gang_dir)
+    out: list[Member] = []
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return out
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(d, name), encoding="utf-8") as f:
+                rec = json.load(f)
+            if not isinstance(rec, dict):
+                continue  # stray JSON that isn't a heartbeat record
+            out.append(Member(
+                worker_id=int(rec["worker_id"]),
+                time=float(rec["time"]),
+                epoch=int(rec.get("epoch", 0)),
+                round=int(rec.get("round", 0)),
+                status=str(rec.get("status", "running")),
+                pid=rec.get("pid"),
+            ))
+        except (OSError, ValueError, TypeError, KeyError,
+                json.JSONDecodeError):
+            continue  # torn/corrupt/alien file: the next scan decides
+    return out
+
+
+@dataclass
+class MembershipView:
+    """One scan's classification of the gang (see module docstring)."""
+
+    live: list[Member]
+    stale: list[Member]
+    finished: list[Member]
+
+    @property
+    def live_ids(self) -> set[int]:
+        return {m.worker_id for m in self.live}
+
+    @property
+    def stale_ids(self) -> set[int]:
+        return {m.worker_id for m in self.stale}
+
+
+def classify_members(
+    gang_dir: str, heartbeat_timeout: float, now: float
+) -> MembershipView:
+    """Partition the gang into live / stale (evictable) / finished
+    against the eviction deadline, at observation time ``now``."""
+    live, stale, finished = [], [], []
+    for m in read_members(gang_dir):
+        if m.status in TERMINAL_STATUSES:
+            finished.append(m)
+        elif m.age(now) > heartbeat_timeout:
+            stale.append(m)
+        else:
+            live.append(m)
+    return MembershipView(live=live, stale=stale, finished=finished)

@@ -1,0 +1,364 @@
+"""Gang runner: coordinator + N supervised workers, one call.
+
+``run_elastic(spec, n_workers)`` is the cluster layer the paper's system
+got from Spark, built from tpuflow's own resilience parts:
+
+- the **coordinator** (``coordinator.py``) runs in a thread of this
+  process, averaging rounds over the live set;
+- each **worker** is a child process running the ordinary ``train()``
+  on its shard, driven by its own ``train/supervisor.py`` attempt loop
+  (``mode="supervised"``) — a worker that dies is backed off,
+  relaunched with ``resume=True``, and rejoins the gang; crash-loop /
+  stall / numerics classification all apply per worker;
+- ``mode="inprocess"`` runs the workers as threads calling ``train()``
+  directly — no restart loop, but no per-worker process launch either:
+  the fast path for tier-1 drills and fixed-membership reference runs.
+
+Each worker checkpoints under ``{storagePath}/worker{N}`` (disjoint
+trees — supervisor restarts resume the right worker), and the gang's
+shared files live under ``gang_dir`` (default
+``{storagePath}/elastic``). After every worker returns, the runner
+averages their *final* pushes into ``{gang_dir}/avg/final.npz`` — the
+gang's deliverable, well-defined even when workers finished rounds at
+different times.
+
+Shell entry (see ``python -m tpuflow.elastic --help``)::
+
+    python -m tpuflow.elastic spec.json --workers 3 --sync-every 1
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+
+from tpuflow.elastic import exchange
+from tpuflow.elastic.coordinator import Coordinator
+
+MODES = ("supervised", "inprocess")
+
+
+@dataclass
+class WorkerOutcome:
+    """One worker's end state: its job report (None if it never
+    finished), the supervisor's attempt/failure trail, or the error
+    that exhausted it."""
+
+    worker_id: int
+    report: dict | None = None
+    attempts: int = 0
+    failures: list = field(default_factory=list)
+    error: str | None = None
+
+
+@dataclass
+class ElasticRunResult:
+    gang_dir: str
+    workers: list[WorkerOutcome]
+    coordinator: dict
+    final_params: list | None  # averaged leaves over the final pushes
+    final_worker_ids: list[int]
+    final_path: str | None
+
+    @property
+    def ok(self) -> bool:
+        # A crashed coordinator means no averaging happened — a run
+        # like that must not report success just because the workers
+        # (training solo on local params) all returned.
+        return (
+            bool(self.workers)
+            and all(w.error is None for w in self.workers)
+            and "error" not in self.coordinator
+        )
+
+    def summary(self) -> dict:
+        # _json_finite: a diverged worker's report is exactly where
+        # inf/nan best_val_loss appears, and raw json.dumps would emit
+        # RFC-8259-invalid Infinity/NaN tokens to the CLI's stdout.
+        from tpuflow.serve import _json_finite
+
+        return _json_finite({
+            "ok": self.ok,
+            "coordinator_error": self.coordinator.get("error"),
+            "workers": [
+                {
+                    "worker_id": w.worker_id,
+                    "attempts": w.attempts,
+                    "error": w.error,
+                    "epochs_ran": (w.report or {}).get("epochs_ran"),
+                    "best_val_loss": (w.report or {}).get("best_val_loss"),
+                }
+                for w in self.workers
+            ],
+            "rounds": self.coordinator.get("round", 1) - 1,
+            "evicted": self.coordinator.get("evicted", []),
+            "rejoins": self.coordinator.get("rejoins", 0),
+            "final_averaged_over": self.final_worker_ids,
+            "final_path": self.final_path,
+        })
+
+
+def worker_spec(
+    base_spec: dict,
+    gang_dir: str,
+    worker_id: int,
+    n_workers: int,
+    *,
+    sync_every: int = 1,
+    elastic_overrides: dict | None = None,
+) -> dict:
+    """One worker's job spec: the base job plus its ``elastic`` block,
+    a per-worker checkpoint tree, and the supervisor's preconditions
+    (``save_every >= 1`` so restarts resume instead of restart-over;
+    ``n_devices=1`` — elastic parallelism is across processes, not an
+    in-worker device mesh)."""
+    spec = dict(base_spec)
+    storage = spec.pop("storagePath", None) or spec.pop("storage_path", None)
+    spec.pop("storage_path", None)
+    if not storage:
+        raise ValueError(
+            "run_elastic needs storagePath in the spec — workers "
+            "checkpoint under {storagePath}/workerN and restarts resume "
+            "from there"
+        )
+    spec["storagePath"] = os.path.join(storage, f"worker{worker_id}")
+    # Explicit None (dataclasses.asdict specs) counts as unset too.
+    if not spec.get("save_every"):
+        spec["save_every"] = 1
+    if spec.get("n_devices") is None:
+        spec["n_devices"] = 1
+    spec["elastic"] = {
+        "dir": gang_dir,
+        "worker_id": worker_id,
+        "n_workers": n_workers,
+        "sync_every": sync_every,
+        **(elastic_overrides or {}),
+    }
+    return spec
+
+
+def _ensure_fresh_gang_dir(gang_dir: str) -> None:
+    """Refuse a gang_dir that holds a previous gang's state. Reusing it
+    would be silently catastrophic: the old ``done`` heartbeats satisfy
+    ``all_finished`` before the new workers even launch (the
+    coordinator exits instantly), and the stale ``avg/LATEST``
+    warm-starts every worker into rounds nobody is collecting — N solo
+    trainings reporting themselves as an elastic gang."""
+    from tpuflow.elastic.membership import MEMBERS_DIR
+
+    stale = [
+        sub
+        for sub in (MEMBERS_DIR, exchange.PUSH_DIR, exchange.AVG_DIR)
+        if os.path.isdir(os.path.join(gang_dir, sub))
+        and os.listdir(os.path.join(gang_dir, sub))
+    ]
+    if stale:
+        raise ValueError(
+            f"gang_dir {gang_dir!r} holds a previous gang's state "
+            f"({', '.join(s + '/' for s in stale)}) — stale heartbeats "
+            "would end the new gang instantly and its workers would "
+            "warm-start into rounds nobody collects; remove the old "
+            "state or pass a fresh gang_dir"
+        )
+
+
+def run_elastic(
+    spec: dict,
+    n_workers: int,
+    *,
+    gang_dir: str | None = None,
+    mode: str = "supervised",
+    sync_every: int = 1,
+    heartbeat_interval: float = 0.25,
+    heartbeat_timeout: float = 30.0,
+    round_timeout: float = 60.0,
+    min_round_interval: float = 0.0,
+    pull_timeout: float = 120.0,
+    poll_interval: float = 0.05,
+    max_restarts: int = 2,
+    stall_timeout: float | None = None,
+    term_grace: float = 5.0,
+    backoff_base: float = 0.05,
+    backoff_jitter: float = 0.0,
+    worker_faults: dict | None = None,
+    verbose: bool = False,
+) -> ElasticRunResult:
+    """Run one elastic gang to completion; see the module docstring.
+
+    ``worker_faults`` maps worker_id -> a ``faults`` spec list for that
+    worker's job (the churn drills: kill worker 1 at epoch 3, watch the
+    gang absorb it). Targeting is exact only under ``supervised`` mode
+    (each worker is its own process with its own registry); in
+    ``inprocess`` mode the fault registry is process-global, so a spec
+    may fire in whichever worker thread hits the site first — and
+    exit/hang modes, which would kill or wedge the WHOLE process, are
+    rejected there. Worker failures never raise out of here — they
+    land in the per-worker ``WorkerOutcome.error`` so a partial gang
+    still reports what the survivors produced.
+    """
+    if n_workers < 1:
+        raise ValueError(f"n_workers must be >= 1, got {n_workers}")
+    if mode not in MODES:
+        raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+    if worker_faults and mode == "inprocess":
+        from tpuflow.resilience import parse_fault_spec
+
+        for wid, entries in worker_faults.items():
+            for entry in entries:
+                if parse_fault_spec(entry).mode in ("exit", "hang"):
+                    raise ValueError(
+                        f"worker_faults[{wid}]={entry!r}: mode="
+                        f"{parse_fault_spec(entry).mode} under "
+                        "mode='inprocess' would kill or wedge the whole "
+                        "process (workers are threads); use "
+                        "mode='supervised' for kill drills"
+                    )
+    storage = spec.get("storagePath") or spec.get("storage_path")
+    if not storage:
+        raise ValueError(
+            "run_elastic needs storagePath in the spec — workers "
+            "checkpoint under {storagePath}/workerN and restarts resume "
+            "from there"
+        )
+    gang_dir = gang_dir or os.path.join(storage, "elastic")
+    _ensure_fresh_gang_dir(gang_dir)
+    os.makedirs(gang_dir, exist_ok=True)
+    overrides = {
+        "heartbeat_interval": heartbeat_interval,
+        "heartbeat_timeout": heartbeat_timeout,
+        "pull_timeout": pull_timeout,
+        "poll_interval": poll_interval,
+    }
+    # Fail at submission, not N jax-import-heavy worker launches
+    # later: a bad knob (sync_every=0, negative timeout) or a bad base
+    # job (stream=True, typo'd model) must die HERE, in this process,
+    # with the validator's message.
+    from tpuflow.analysis import ensure_preflight
+    from tpuflow.elastic import resolve_elastic
+    from tpuflow.serve import spec_to_config
+
+    resolve_elastic({
+        "dir": gang_dir, "worker_id": 0, "n_workers": n_workers,
+        "sync_every": sync_every, "round_timeout": round_timeout,
+        **overrides,
+    })
+    if min_round_interval < 0:
+        raise ValueError(
+            f"min_round_interval must be >= 0 (seconds), got "
+            f"{min_round_interval}"
+        )
+    ensure_preflight(
+        spec_to_config(worker_spec(
+            spec, gang_dir, 0, n_workers,
+            sync_every=sync_every, elastic_overrides=overrides,
+        )),
+        passes=("spec",),
+    )
+    coordinator = Coordinator(
+        gang_dir,
+        heartbeat_timeout=heartbeat_timeout,
+        round_timeout=round_timeout,
+        min_round_interval=min_round_interval,
+        poll_interval=poll_interval,
+        expected_workers=n_workers,
+        verbose=verbose,
+    )
+    stop = threading.Event()
+    coord_outcome: dict = {}
+
+    def _coordinate():
+        try:
+            coord_outcome["state"] = coordinator.run(stop)
+        except BaseException as e:  # surfaced in the result, not lost
+            coord_outcome["error"] = f"{type(e).__name__}: {e}"
+
+    outcomes = [WorkerOutcome(worker_id=i) for i in range(n_workers)]
+
+    def _work(i: int):
+        wspec = worker_spec(
+            spec, gang_dir, i, n_workers,
+            sync_every=sync_every, elastic_overrides=overrides,
+        )
+        if worker_faults and i in worker_faults:
+            wspec["faults"] = list(worker_faults[i])
+        try:
+            if mode == "supervised":
+                from tpuflow.train.supervisor import supervise
+
+                run = supervise(
+                    wspec,
+                    max_restarts=max_restarts,
+                    stall_timeout=stall_timeout,
+                    term_grace=term_grace,
+                    backoff_base=backoff_base,
+                    backoff_jitter=backoff_jitter,
+                    verbose=verbose,
+                )
+                outcomes[i].report = run.report
+                outcomes[i].attempts = run.attempts
+                outcomes[i].failures = run.failures
+            else:
+                from tpuflow.api import train
+                from tpuflow.serve import report_to_dict, spec_to_config
+
+                outcomes[i].report = report_to_dict(
+                    train(spec_to_config(wspec))
+                )
+                outcomes[i].attempts = 1
+        except BaseException as e:
+            outcomes[i].error = f"{type(e).__name__}: {e}"
+            # CrashLoopError / budget-exhaustion RuntimeError carry the
+            # supervisor's attempt trail — keep it, or the summary
+            # would show attempts=0 for the worker that burned the
+            # whole restart budget.
+            trail = getattr(e, "failures", None)
+            if trail:
+                outcomes[i].failures = list(trail)
+                outcomes[i].attempts = len(trail)
+
+    coord_thread = threading.Thread(
+        target=_coordinate, name="tpuflow-elastic-coordinator", daemon=True
+    )
+    coord_thread.start()
+    workers = [
+        threading.Thread(
+            target=_work, args=(i,), name=f"tpuflow-elastic-w{i}",
+            daemon=True,
+        )
+        for i in range(n_workers)
+    ]
+    for t in workers:
+        t.start()
+    for t in workers:
+        t.join()
+    stop.set()
+    coord_thread.join(timeout=30)
+
+    final_leaves, final_ids = exchange.average_pushes(
+        gang_dir, exchange.FINAL_ROUND
+    )
+    final_path = None
+    if final_leaves is not None:
+        final_path = os.path.join(gang_dir, exchange.AVG_DIR, "final.npz")
+        exchange.write_leaves(final_path, final_leaves)
+    coord_state = coord_outcome.get("state") or coordinator.state()
+    if coord_thread.is_alive():
+        # The join timed out: the coordinator is wedged (slow shared
+        # FS, a stuck scan). A run whose rounds were never driven to
+        # completion must not report ok=True.
+        coord_outcome.setdefault(
+            "error",
+            "coordinator thread still running after the stop join "
+            "timeout (wedged scan?)",
+        )
+    if "error" in coord_outcome:
+        coord_state = {**coord_state, "error": coord_outcome["error"]}
+    return ElasticRunResult(
+        gang_dir=gang_dir,
+        workers=outcomes,
+        coordinator=coord_state,
+        final_params=final_leaves,
+        final_worker_ids=final_ids,
+        final_path=final_path,
+    )

@@ -1,0 +1,335 @@
+"""Worker-side elastic client: heartbeats, push/adopt sync, warm start.
+
+One instance lives inside each worker's ``train()`` call (wired by
+``api/train_api.py`` when ``TrainJobConfig.elastic`` is set):
+
+- ``join(state)`` — pre-fit: registers the worker (``elastic.join``
+  fault site), warm-starts a late joiner from the latest published
+  average (``train/resume.py::apply_params`` — a resumed restart's own
+  run checkpoint, restored later inside ``fit``, takes precedence), and
+  starts the heartbeat thread.
+- ``sync(epoch, state)`` — the ``FitConfig.sync_fn`` hook, called after
+  each epoch's bookkeeping: every ``sync_every``-th epoch it pushes the
+  worker's params for round ``epoch // sync_every`` and blocks (bounded
+  by ``pull_timeout``) for the coordinator's average, which it adopts.
+  A round whose average never appears is *skipped*, not fatal — the
+  worker continues on local params and re-syncs next round, so a slow
+  or briefly-absent coordinator degrades cadence, never the run.
+- ``finish(state)`` — post-fit: pushes the final params (the runner's
+  end-of-gang average reads these), reports a terminal heartbeat
+  status, and stops the thread.
+
+A restarted worker needs no special rejoin path: ``resume=True``
+restores its checkpoint, its next syncs replay *historic* rounds whose
+averages already exist (adopted instantly — the catch-up fast path),
+and its fresh heartbeats readmit it to the live set.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+from tpuflow.elastic import exchange, resolve_elastic
+from tpuflow.elastic.membership import write_heartbeat
+from tpuflow.resilience import fault_point
+
+
+def shard_rows(ds, worker_id: int, n_workers: int):
+    """This worker's disjoint row shard (round-robin by row index — the
+    SparkNet partitioning, cheap and deterministic for any N)."""
+    if n_workers == 1:
+        return ds
+    x, y = ds.x[worker_id::n_workers], ds.y[worker_id::n_workers]
+    if len(x) == 0:
+        raise ValueError(
+            f"elastic worker {worker_id}/{n_workers} got an empty train "
+            f"shard ({ds.n} rows round-robined {n_workers} ways) — "
+            "fewer rows than workers"
+        )
+    return type(ds)(x, y)
+
+
+class ElasticWorkerClient:
+    """See the module docstring. ``clock``/``sleep`` injectable for
+    drills; counters go to the process-wide registry."""
+
+    def __init__(
+        self, block: dict, *, resuming: bool = False,
+        progress_path: str | None = None,
+        clock=time.time, sleep=time.sleep,
+    ):
+        from tpuflow.obs import default_registry
+
+        cfg = resolve_elastic(block)
+        # A RESUMING worker keeps epoch-aligned rounds (its checkpoint
+        # belongs to the gang's history; replaying old rounds against
+        # the published averages is the catch-up fast path). A FRESH
+        # late joiner instead offsets its rounds to the join point —
+        # otherwise its epoch-1 sync would adopt the gang's ancient
+        # round-1 average and clobber the warm start it just did.
+        self.resuming = bool(resuming)
+        self.round_offset = 0
+        # The supervisor's stall watchdog reads the fit loop's progress
+        # file, which never changes while this worker blocks in
+        # _wait_for_average — so the wait itself pings it (same epoch,
+        # changing wait-timestamp), or a coordinator slower than
+        # stall_timeout would get healthy workers killed as stalled.
+        self.progress_path = progress_path
+        self.gang_dir = cfg["dir"]
+        self.worker_id = int(cfg["worker_id"])
+        self.n_workers = int(cfg["n_workers"])
+        self.sync_every = int(cfg["sync_every"])
+        self.heartbeat_interval = float(cfg["heartbeat_interval"])
+        self.pull_timeout = float(cfg["pull_timeout"])
+        self.poll_interval = float(cfg["poll_interval"])
+        self.warm_start = bool(cfg["warm_start"])
+        self.clock = clock
+        self.sleep = sleep
+        self.epoch = 0
+        self.round = 0
+        self._stop = threading.Event()
+        self._terminal = False  # set before the goodbye beat: a laggy
+        # heartbeat-thread write should not overwrite the terminal
+        # status with a stale "running" record after finish() returns.
+        # This narrows the window to a beat already INSIDE its blocked
+        # write when finish() runs (no rename-level CAS exists to close
+        # that); the residual overwrite costs one eviction deadline,
+        # not correctness — the coordinator evicts the stale record.
+        self._thread: threading.Thread | None = None
+        reg = default_registry()
+        self._pushes = reg.counter(
+            "elastic_pushes_total", "parameter pushes to the coordinator"
+        )
+        self._adopts = reg.counter(
+            "elastic_adopts_total", "averaged rebroadcasts adopted"
+        )
+        self._missed = reg.counter(
+            "elastic_missed_rounds_total",
+            "sync rounds skipped because no average appeared in time",
+        )
+
+    # ---- lifecycle ----
+
+    def join(self, state):
+        """Register with the gang and warm-start (see module docstring);
+        returns the state to train from."""
+        fault_point("elastic.join")
+        self._beat(status="joining")
+        if self.resuming:
+            # A restart must rejoin at the SAME offset its first
+            # incarnation recorded (0 for an original member): an
+            # in-memory-only offset would reset on restart and leave a
+            # late joiner permanently misaligned with the gang's
+            # rounds — adopting R-rounds-stale averages every sync.
+            self.round_offset, found = self._read_offset()
+            if not found:
+                # Every first incarnation writes the file at join, so a
+                # missing one means it died before then. An original
+                # member is fine at 0; a warm-started late joiner is
+                # now misaligned — say so rather than train solo
+                # silently.
+                print(
+                    f"elastic: worker {self.worker_id} resuming with no "
+                    "recorded round offset (first incarnation died "
+                    "before join completed); assuming 0 — a late "
+                    "joiner's rounds may lag the gang",
+                    file=sys.stderr,
+                )
+        elif self.warm_start:
+            latest = exchange.latest_average(self.gang_dir)
+            if latest is not None:
+                round, leaves = latest
+                state = self._adopt(state, leaves)
+                self.round_offset = round
+                print(
+                    f"elastic: worker {self.worker_id} warm-started from "
+                    f"round {round}'s average",
+                    file=sys.stderr,
+                )
+        if not self.resuming:
+            self._write_offset()
+        self._beat(status="running")
+        self._thread = threading.Thread(
+            target=self._heartbeat_loop,
+            name=f"tpuflow-elastic-hb-{self.worker_id}",
+            daemon=True,
+        )
+        self._thread.start()
+        return state
+
+    def finish(self, state=None, failed: bool = False) -> None:
+        """Terminal heartbeat + final push; idempotent, never raises
+        into the caller's (possibly already failing) exit path."""
+        self._stop.set()
+        self._terminal = True
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        try:
+            if state is not None and not failed:
+                exchange.push_params(
+                    self.gang_dir, exchange.FINAL_ROUND, self.worker_id,
+                    state.params,
+                )
+            self._beat(status="failed" if failed else "done")
+        except BaseException as e:
+            print(
+                f"elastic: worker {self.worker_id} goodbye failed "
+                f"({type(e).__name__}: {e}); the eviction deadline "
+                "covers it",
+                file=sys.stderr,
+            )
+
+    # ---- the FitConfig.sync_fn hook ----
+
+    def sync(self, epoch: int, state):
+        self.epoch = epoch
+        if epoch % self.sync_every:
+            self._beat()
+            return state
+        round = self.round_offset + epoch // self.sync_every
+        self.round = round
+        self._beat()
+        published = exchange.read_average(self.gang_dir, round)
+        if published is not None:
+            # Catch-up fast path: the round is already averaged and
+            # rebroadcast (this worker is replaying history after a
+            # restart) — pushing a full param file nobody will ever
+            # read wastes shared-FS I/O; just adopt and move on.
+            return self._adopt(state, published)
+        if self._gang_moved_past(round):
+            # The round's average is gone (pruned history): nothing to
+            # adopt — and nothing to push, since the round will never
+            # be re-averaged.
+            self._missed.inc()
+            return state
+        exchange.push_params(self.gang_dir, round, self.worker_id, state.params)
+        self._pushes.inc()
+        leaves = self._wait_for_average(round)
+        if leaves is None:
+            self._missed.inc()
+            if not self._gang_moved_past(round):
+                print(
+                    f"elastic: worker {self.worker_id} saw no average "
+                    f"for round {round} within {self.pull_timeout:g}s; "
+                    "continuing on local params",
+                    file=sys.stderr,
+                )
+            return state
+        return self._adopt(state, leaves)
+
+    def _adopt(self, state, leaves):
+        """Replace the live params with a rebroadcast's leaves — THE
+        one adoption path (warm start, catch-up, and per-round sync all
+        ride it), structure-checked by ``apply_params``."""
+        from tpuflow.train.resume import apply_params
+
+        state = apply_params(
+            state, exchange.unflatten_like(state.params, leaves)
+        )
+        self._adopts.inc()
+        return state
+
+    def _gang_moved_past(self, round: int) -> bool:
+        """True when the gang's newest published round is beyond
+        ``round`` while ``round``'s own average is absent — i.e. the
+        history this worker is replaying was pruned."""
+        latest = exchange.latest_round(self.gang_dir)
+        return latest is not None and latest > round
+
+    def _wait_for_average(self, round: int):
+        deadline = self.clock() + self.pull_timeout
+        last_ping = self.clock()
+        while True:
+            leaves = exchange.read_average(self.gang_dir, round)
+            if leaves is not None:
+                return leaves
+            if self._gang_moved_past(round):
+                # Skipping a pruned historic round immediately beats
+                # burning pull_timeout on a file that cannot appear.
+                return None
+            if self.clock() > deadline:
+                return None
+            if (
+                self.progress_path is not None
+                and self.clock() - last_ping >= 1.0
+            ):
+                self._ping_progress(round)
+                last_ping = self.clock()
+            self.sleep(self.poll_interval)
+
+    def _ping_progress(self, round: int) -> None:
+        """Touch the supervisor's progress file during a sync wait —
+        same completed-epoch number (the wait runs BEFORE this epoch's
+        run checkpoint, so epoch-1 is the last durable one), changing
+        timestamp, so the stall watchdog sees liveness. Delegates to
+        the fit loop's one progress writer; single-threaded with it by
+        construction (sync runs inside the fit loop's own thread)."""
+        from tpuflow.train.loop import _write_progress
+
+        _write_progress(
+            self.progress_path, max(self.epoch - 1, 0),
+            elastic_wait_round=round,
+        )
+
+    # ---- the persisted round offset (survives restarts) ----
+
+    def _offset_path(self) -> str:
+        # Deliberately NOT *.json: the membership scanner globs
+        # members/*.json and this file is not a heartbeat.
+        import os
+
+        return os.path.join(
+            self.gang_dir, "members", f"{self.worker_id}.offset"
+        )
+
+    def _write_offset(self) -> None:
+        import os
+
+        from tpuflow.utils.paths import atomic_write_json
+
+        path = self._offset_path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        atomic_write_json(path, {"round_offset": self.round_offset})
+
+    def _read_offset(self) -> tuple[int, bool]:
+        """``(offset, found)`` — found=False means no readable record
+        (the caller decides whether the 0 fallback is benign)."""
+        import json
+
+        try:
+            with open(self._offset_path(), encoding="utf-8") as f:
+                return int(json.load(f)["round_offset"]), True
+        except (OSError, ValueError, TypeError, KeyError,
+                json.JSONDecodeError):
+            return 0, False
+
+    # ---- heartbeats ----
+
+    def _beat(self, status: str = "running") -> None:
+        write_heartbeat(
+            self.gang_dir, self.worker_id,
+            epoch=self.epoch, round=self.round, status=status,
+            clock=self.clock,
+        )
+
+    def _heartbeat_loop(self) -> None:
+        # Covers liveness through long compiles and slow epochs; an
+        # injected elastic.heartbeat fault (or a genuinely dead
+        # filesystem) stops the beats — which IS the eviction drill —
+        # rather than crashing the training thread.
+        while not self._stop.wait(self.heartbeat_interval):
+            if self._terminal:
+                return  # never overwrite the goodbye with "running"
+            try:
+                self._beat()
+            except BaseException as e:
+                print(
+                    f"elastic: worker {self.worker_id} heartbeat thread "
+                    f"dying ({type(e).__name__}: {e}); the worker will "
+                    "be evicted on the stale-heartbeat deadline",
+                    file=sys.stderr,
+                )
+                return

@@ -61,12 +61,19 @@ SITES: dict[str, str] = {
     "Orbax restore (serving load and resume)",
     "csv.read": "data/csv_io.py: whole-file CSV ingest",
     "stream.read": "data/stream.py: one streamed CSV chunk parse",
-    "serve.execute": "serve.py JobRunner._execute: start of every "
-    "train/compare/sweep job",
+    "serve.execute": "serve.py JobRunner._execute (start of every "
+    "train/compare/sweep job) AND PredictService._run_forward (the "
+    "micro-batcher's coalesced dispatch) — one hit counter spans both",
     "train.epoch_start": "train/loop.py: top of each epoch, before any "
     "work (a crash here REPLAYS the epoch after resume); index = epoch",
     "train.epoch_end": "train/loop.py: after an epoch's bookkeeping "
     "(the legacy fault_epoch point); index = epoch",
+    "elastic.heartbeat": "elastic/membership.py: every worker heartbeat "
+    "write (a firing silences the worker — the eviction drill)",
+    "elastic.push": "elastic/exchange.py: every parameter push to the "
+    "coordinator; index = averaging round",
+    "elastic.join": "elastic/worker.py: worker registration/warm-start, "
+    "before the first epoch",
 }
 
 # Sites whose fault_point() passes an index (the at= reproducibility
@@ -74,7 +81,7 @@ SITES: dict[str, str] = {
 # arm time, per this module's fail-loud promise.
 INDEXED_SITES = frozenset({
     "checkpoint.save", "checkpoint.restore",
-    "train.epoch_start", "train.epoch_end",
+    "train.epoch_start", "train.epoch_end", "elastic.push",
 })
 
 
@@ -339,7 +346,7 @@ def fault_point(site: str, index: int | None = None) -> None:
     if to_fire.mode == "exit":
         os._exit(to_fire.code)
     if to_fire.mode == "hang":
-        while True:  # a wedged backend: only a kill gets out
+        while True:  # noqa: TPF007 (a DELIBERATE wedge: only a kill gets out)
             time.sleep(3600)
     if to_fire.transient:
         raise TransientFault(message, site)

@@ -22,7 +22,10 @@ injectable for zero-wall-clock tests.
 Env knobs (read by :func:`io_policy`, the policy every built-in site
 uses): ``TPUFLOW_RETRY_ATTEMPTS`` (default 4), ``TPUFLOW_RETRY_BASE``
 (seconds, default 0.05), ``TPUFLOW_RETRY_MAX`` (default 2.0),
-``TPUFLOW_RETRY_DEADLINE`` (default 30).
+``TPUFLOW_RETRY_DEADLINE`` (default 30). Values are validated at read
+time: a non-numeric or negative value (or a zero attempt count) raises
+a ValueError naming the variable and the expected form — the
+``TPUFLOW_FAULTS`` fail-loud precedent.
 """
 
 from __future__ import annotations
@@ -135,13 +138,58 @@ def retryable(policy: RetryPolicy):
     return wrap
 
 
+def _env_number(name: str, default: float, *, cast, minimum, form: str):
+    """One validated ``TPUFLOW_RETRY_*`` read. A typo'd or negative
+    value raises a ValueError naming the env var and the expected form
+    (the ``TPUFLOW_FAULTS`` precedent: this error surfaces deep inside
+    whatever I/O path built the policy, far from where the operator
+    exported the variable — it must say exactly what to fix). The old
+    behavior silently clamped/crashed with a bare float() traceback."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        value = cast(raw)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"invalid {name}={raw!r}: expected {form}"
+        ) from None
+    import math
+
+    if not math.isfinite(value):
+        # 'nan' survives the < comparison below and 'inf' would sleep
+        # forever — both are exactly the far-from-the-shell breakage
+        # this validation exists to prevent.
+        raise ValueError(f"invalid {name}={raw!r}: expected {form}")
+    if value < minimum:
+        raise ValueError(
+            f"invalid {name}={raw!r}: expected {form}, got a value below "
+            f"{minimum}"
+        )
+    return value
+
+
 def io_policy() -> RetryPolicy:
     """The shared policy for the built-in I/O sites, env-tunable (see the
     module docstring). Built per call so a test's env tweak applies
-    without reloads — construction is a few float parses."""
+    without reloads — construction is a few float parses. Malformed env
+    knobs (non-numeric, negative, zero attempts) raise a ValueError
+    naming the variable and the expected form."""
     return RetryPolicy(
-        max_attempts=max(int(os.environ.get("TPUFLOW_RETRY_ATTEMPTS", 4)), 1),
-        base_delay=float(os.environ.get("TPUFLOW_RETRY_BASE", 0.05)),
-        max_delay=float(os.environ.get("TPUFLOW_RETRY_MAX", 2.0)),
-        deadline=float(os.environ.get("TPUFLOW_RETRY_DEADLINE", 30.0)),
+        max_attempts=_env_number(
+            "TPUFLOW_RETRY_ATTEMPTS", 4, cast=int, minimum=1,
+            form="an integer attempt count >= 1",
+        ),
+        base_delay=_env_number(
+            "TPUFLOW_RETRY_BASE", 0.05, cast=float, minimum=0.0,
+            form="a non-negative number of seconds",
+        ),
+        max_delay=_env_number(
+            "TPUFLOW_RETRY_MAX", 2.0, cast=float, minimum=0.0,
+            form="a non-negative number of seconds",
+        ),
+        deadline=_env_number(
+            "TPUFLOW_RETRY_DEADLINE", 30.0, cast=float, minimum=0.0,
+            form="a non-negative number of seconds",
+        ),
     )

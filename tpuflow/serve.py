@@ -1103,6 +1103,13 @@ class PredictService:
     def _run_forward(pred, x):
         # The batcher's one hook: a denormalized forward over prepared
         # rows (one output row per input row; pow-2 padded inside).
+        # The serve.execute fault site fires here too (the coalesced-
+        # dispatch drill): an injected failure must fail exactly this
+        # dispatch's requests and leave the batcher serving the next —
+        # the MicroBatcher's errors-scatter-too contract, made testable.
+        from tpuflow.resilience import fault_point
+
+        fault_point("serve.execute")
         return pred.forward_prepared(x)
 
     def close(self) -> None:

@@ -143,6 +143,13 @@ class FitConfig:
     # epoch) is surfaced as xla.compile spans, the train_recompiles
     # gauge, and a diagnostic in FitResult.recompiles.
     detect_recompiles: bool = True
+    # Elastic parameter-sync hook (tpuflow/elastic): called with
+    # (epoch, state) after each epoch's bookkeeping — BEFORE the
+    # run-state checkpoint, so a checkpoint captures the post-averaging
+    # state and a restarted worker resumes already synced. Returns the
+    # state to continue with (the worker client swaps in the gang's
+    # averaged params on sync rounds).
+    sync_fn: Callable | None = None
 
 
 @dataclass
@@ -473,6 +480,16 @@ def fit(
                     "checkpoint", time.perf_counter() - t_ckpt,
                     logger=mlog, epoch=epoch, kind="best",
                 )
+            if config.sync_fn is not None:
+                # Elastic averaging round (tpuflow/elastic): push local
+                # params, adopt the gang average. Before the run-state
+                # save below, so checkpoints hold the synced state.
+                t_sync = time.perf_counter()
+                state = config.sync_fn(epoch, state)
+                record_span(
+                    "elastic.sync", time.perf_counter() - t_sync,
+                    logger=mlog, epoch=epoch,
+                )
             if (
                 run_ckpt is not None
                 and config.save_every
@@ -563,19 +580,20 @@ def fit(
     return result
 
 
-def _write_progress(path: str, epoch: int) -> None:
+def _write_progress(path: str, epoch: int, **extra) -> None:
     """Overwrite the liveness file with this epoch's progress record —
     atomically (tmp + rename), so the supervisor's watchdog never reads
     a torn write. Best-effort: progress is observability, and an
-    unwritable progress file must not kill a healthy training run."""
-    import json
-    import os
+    unwritable progress file must not kill a healthy training run.
+    ``extra`` fields ride along (the elastic sync wait pings liveness
+    through THIS writer — one owner of the record the supervisor
+    parses); ``epoch`` must stay the last COMPLETED epoch."""
+    from tpuflow.utils.paths import atomic_write_json
 
     try:
-        tmp = path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"epoch": epoch, "time": time.time()}, f)
-        os.replace(tmp, path)
+        atomic_write_json(
+            path, {"epoch": epoch, "time": time.time(), **extra}
+        )
     except OSError as e:
         import sys
 

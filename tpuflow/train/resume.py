@@ -23,6 +23,39 @@ from tpuflow.resilience import fault_point, io_policy, retry_call
 from tpuflow.utils.paths import join_path
 
 
+def apply_params(state, params):
+    """Overlay externally-sourced params onto a live TrainState — the
+    warm-start half of resumability that needs no Orbax tree on disk.
+
+    The elastic runner (tpuflow/elastic) uses it two ways: a late joiner
+    adopts the gang's latest published average before its first epoch,
+    and every synced worker adopts each round's rebroadcast. Structure
+    is checked leaf-for-leaf against the live state: averaging a
+    differently-shaped model into a run must fail loudly, never
+    mis-assign weights. Optimizer state and step counter are deliberately
+    kept — SparkNet-style averaging replaces the *parameters* mid-
+    trajectory, not the trajectory's bookkeeping.
+    """
+    treedef = jax.tree_util.tree_structure(state.params)
+    new_def = jax.tree_util.tree_structure(params)
+    if treedef != new_def:
+        raise ValueError(
+            f"warm-start params tree structure {new_def} does not match "
+            f"the live state's {treedef} — different model/config?"
+        )
+    for got, want in zip(
+        jax.tree_util.tree_leaves(params),
+        jax.tree_util.tree_leaves(state.params),
+    ):
+        if tuple(got.shape) != tuple(want.shape):
+            raise ValueError(
+                f"warm-start params leaf shape {tuple(got.shape)} does "
+                f"not match the live state's {tuple(want.shape)} — "
+                "different model/config?"
+            )
+    return state.replace(params=params)
+
+
 class RunCheckpointer:
     """Full-run state checkpoints under ``{storage_path}/runs/{name}``.
 

@@ -138,6 +138,30 @@ def _tail(text: str, n: int = 5) -> str:
     return "\n".join((text or "").strip().splitlines()[-n:])
 
 
+def _terminate_gracefully(proc, term_grace: float) -> str:
+    """SIGTERM, a bounded grace period, then SIGKILL.
+
+    An immediate SIGKILL would deny a stalled-but-salvageable child its
+    exit path — the forensics ring dump, Orbax's async-checkpoint
+    commit, the elastic goodbye heartbeat all run on teardown. SIGTERM
+    first gives Python's default handler (and any atexit/finally
+    machinery) ``term_grace`` seconds to flush; only a child that
+    ignores it gets the axe. Returns which signal actually ended it
+    ("sigterm" | "sigkill"; "sigkill" directly when term_grace <= 0) so
+    the failure record says whether teardown ran.
+    """
+    if term_grace > 0:
+        proc.terminate()
+        try:
+            proc.wait(timeout=term_grace)
+            return "sigterm"
+        except subprocess.TimeoutExpired:
+            pass
+    proc.kill()
+    proc.wait()
+    return "sigkill"
+
+
 def _run_attempt(
     cmd: list[str],
     out_dir: str,
@@ -145,12 +169,16 @@ def _run_attempt(
     timeout: float | None,
     stall_timeout: float | None,
     poll_interval: float,
-) -> tuple[int | None, str, str]:
+    term_grace: float = 5.0,
+) -> tuple[int | None, str, str, str | None]:
     """One child attempt under the watchdog.
 
-    Returns ``(returncode, stderr_text, kind)`` where kind is "" for a
-    natural exit, "timeout" for the whole-attempt cap, "stall" for a
-    progress watchdog kill. Child stdout/stderr go to files (a pipe the
+    Returns ``(returncode, stderr_text, kind, killed_by)`` where kind is
+    "" for a natural exit, "timeout" for the whole-attempt cap, "stall"
+    for a progress watchdog kill; ``killed_by`` records which signal a
+    watchdog kill took ("sigterm" after a graceful exit within
+    ``term_grace`` seconds, "sigkill" for a child that ignored it; None
+    for natural exits). Child stdout/stderr go to files (a pipe the
     supervisor isn't draining would block a chatty child at the 64KB
     buffer — the watchdog must never cause the hang it watches for).
     """
@@ -162,6 +190,7 @@ def _run_attempt(
             cmd, stdout=out_f, stderr=err_f, cwd=os.getcwd()
         )
         kind = ""
+        killed_by = None
         if timeout is None and stall_timeout is None:
             # Nothing to watch for: block like subprocess.run would,
             # instead of spinning an hours-long training at
@@ -190,14 +219,13 @@ def _run_attempt(
                     elif now - last_change > stall_timeout:
                         kind = "stall"
                 if kind:
-                    proc.kill()
-                    proc.wait()
+                    killed_by = _terminate_gracefully(proc, term_grace)
                     rc = None  # killed by the supervisor, not a child exit
                     break
                 time.sleep(poll_interval)
     with open(stderr_path, encoding="utf-8") as f:
         stderr_text = f.read()
-    return rc, stderr_text, kind
+    return rc, stderr_text, kind, killed_by
 
 
 def supervise(
@@ -212,6 +240,7 @@ def supervise(
     backoff_seed: int | None = None,
     crash_loop_threshold: int = 3,
     poll_interval: float = 0.05,
+    term_grace: float = 5.0,
     python: str = sys.executable,
     verbose: bool = True,
     sleep=time.sleep,
@@ -228,7 +257,10 @@ def supervise(
     watchdog's abort — terminal on the first death, no restart churn),
     or ``RuntimeError`` after ``max_restarts`` restarts all die. ``stall_timeout`` kills an attempt
     whose progress file stops changing for that many seconds; ``timeout``
-    caps the whole attempt. ``sleep`` is injectable for tests.
+    caps the whole attempt. Watchdog kills are graceful: SIGTERM, then
+    ``term_grace`` seconds for the child to flush (forensics, async
+    checkpoint commits), then SIGKILL — the failure record's
+    ``killed_by`` says which it took. ``sleep`` is injectable for tests.
     """
     _validate(spec)
     from tpuflow.obs import default_registry, dump_forensics, record_event
@@ -292,7 +324,7 @@ def supervise(
             out_path = os.path.join(attempt_dir, "report.json")
             with open(spec_path, "w", encoding="utf-8") as f:
                 json.dump(attempt_spec, f)
-            rc, stderr_text, kind = _run_attempt(
+            rc, stderr_text, kind, killed_by = _run_attempt(
                 [python, "-m", "tpuflow.train.supervisor",
                  "--child", spec_path, out_path],
                 attempt_dir,
@@ -300,6 +332,7 @@ def supervise(
                 timeout,
                 stall_timeout,
                 poll_interval,
+                term_grace,
             )
             if rc == 0:
                 with open(out_path, encoding="utf-8") as f:
@@ -324,7 +357,14 @@ def supervise(
                     f"numerics divergence at epoch {progress_epoch} "
                     "(watchdog abort; terminal)"
                 )
-                raise NumericsDivergence(
+                failures.append({
+                    "rc": rc,
+                    "kind": "numerics",
+                    "killed_by": killed_by,
+                    "stderr_tail": _tail(stderr_text),
+                    "progress_epoch": progress_epoch,
+                })
+                err = NumericsDivergence(
                     "numerics watchdog aborted the run (policy=abort): "
                     "a diverged run replays deterministically — "
                     "restarting would burn the backoff budget "
@@ -332,13 +372,24 @@ def supervise(
                     f"{_tail(stderr_text)}",
                     epoch=progress_epoch,
                 )
+                # The attempt trail rides terminal classifications (as
+                # on CrashLoopError / budget exhaustion), so callers
+                # supervising many jobs keep the diagnostics.
+                err.failures = failures
+                raise err
             record_event(
                 "supervisor_attempt_died", attempt=attempt, rc=rc,
                 kind=kind or "crash", progress_epoch=progress_epoch,
+                killed_by=killed_by,
             )
             failures.append({
                 "rc": rc,
                 "kind": kind or "crash",
+                # Which signal a watchdog kill took: "sigterm" = the
+                # child flushed its teardown within term_grace;
+                # "sigkill" = it ignored the grace period. None for
+                # natural exits.
+                "killed_by": killed_by,
                 "stderr_tail": (
                     "timed out" if kind == "timeout"
                     else f"stalled: no progress for {stall_timeout:g}s"
@@ -395,10 +446,15 @@ def supervise(
                 _restarts.inc()
                 sleep(delay)
     _dump(f"restart budget exhausted after {len(failures)} deaths")
-    raise RuntimeError(
+    err = RuntimeError(
         f"job died {len(failures)} times (last rc="
         f"{failures[-1]['rc']}): {failures[-1]['stderr_tail']}"
     )
+    # The attempt trail rides the exception (as on CrashLoopError):
+    # callers that supervise many jobs (the elastic runner) keep the
+    # per-attempt diagnostics even when the budget is exhausted.
+    err.failures = failures
+    raise err
 
 
 def _child(spec_path: str, out_path: str) -> None:
@@ -409,6 +465,17 @@ def _child(spec_path: str, out_path: str) -> None:
     death as terminal instead of restart-worthy — the message rides
     stderr like any other failure (the parent's ``stderr_tail``).
     """
+    import signal
+
+    # The graceful-kill contract (SIGTERM -> term_grace -> SIGKILL) is
+    # only worth anything if SIGTERM actually runs teardown: Python's
+    # DEFAULT disposition terminates with no finally/atexit, i.e. the
+    # same data loss as SIGKILL. Raise SystemExit instead, so the
+    # watchdog's SIGTERM drains checkpoint writes, dumps the forensics
+    # ring, and sends the elastic goodbye heartbeat on the way out. A
+    # child wedged inside C code never delivers the signal — that is
+    # exactly what the SIGKILL after term_grace is for.
+    signal.signal(signal.SIGTERM, lambda signum, frame: sys.exit(143))
     from tpuflow.api import train
     from tpuflow.serve import report_to_dict, spec_to_config
 
@@ -447,6 +514,10 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--crash-loop-threshold", type=int, default=3,
                     help="same-epoch consecutive deaths before aborting "
                     "as a deterministic crash loop")
+    ap.add_argument("--term-grace", type=float, default=5.0,
+                    help="seconds between a watchdog's SIGTERM and the "
+                    "SIGKILL for a child that ignores it (0 = immediate "
+                    "SIGKILL)")
     args = ap.parse_args(argv)
     with open(args.spec, encoding="utf-8") as f:
         spec = json.load(f)
@@ -458,6 +529,7 @@ def main(argv: list[str] | None = None) -> None:
         backoff_base=args.backoff_base,
         backoff_max=args.backoff_max,
         crash_loop_threshold=args.crash_loop_threshold,
+        term_grace=args.term_grace,
     )
     print(json.dumps({"attempts": run.attempts, **run.report}))
 

@@ -82,3 +82,21 @@ def exists(path: str) -> bool:
         fs, fs_path = fsspec.core.url_to_fs(path)
         return fs.exists(fs_path)
     return os.path.exists(path)
+
+
+def atomic_write_json(path: str, obj) -> None:
+    """Write ``obj`` as JSON via tmp + ``os.replace``: a concurrent
+    reader sees the old file or the new one, never a torn write. The
+    tmp name is unique per (process, thread), so concurrent writers of
+    the SAME path (e.g. an elastic worker's heartbeat thread racing its
+    main-thread beat) cannot yank each other's tmp mid-write. Local
+    filesystem only — the one shared owner of the rename idiom the
+    elastic gang files, progress records, and state mirrors all rely
+    on. Raises OSError; callers own their best-effort policy."""
+    import json
+    import threading
+
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
