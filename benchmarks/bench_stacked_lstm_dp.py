@@ -4,7 +4,17 @@ Measures the sharded train step (psum gradient all-reduce over ICI) across
 all visible devices and reports per-chip throughput plus the DP scaling
 factor vs the single-device step. On a one-chip runner this degenerates to
 DP=1; run with XLA_FLAGS=--xla_force_host_platform_device_count=8
-JAX_PLATFORMS=cpu to exercise 8-way DP on host devices (SURVEY.md §4).
+JAX_PLATFORMS=cpu to exercise 8-way DP on host devices (SURVEY.md §4,
+recipe in docs/parallel.md).
+
+Beyond the JSON-line records every benchmark emits, the DP numbers are
+published as ``parallel_*`` gauges through the obs registry
+(``parallel_dp_throughput_per_chip`` / ``parallel_dp_total_throughput`` /
+``parallel_dp_scaling_factor`` / ``parallel_dp_devices``) and routed
+through the PR-5 live-roofline leg (``publish_roofline``) with the
+stacked-LSTM cost model — on a known chip the sharded step lands
+``train_mfu``/``train_bound`` exactly like a fit-loop epoch; on an
+unknown chip (cpu) the MFU gauges stay honestly absent.
 """
 
 from __future__ import annotations
@@ -30,17 +40,70 @@ from tpuflow.parallel import (
 from tpuflow.parallel.dp import replicate
 from tpuflow.train import create_state, make_train_step
 
+WINDOW, FEATURES, HIDDEN, LAYERS = 24, 5, 64, 2
+
+
+def _publish_parallel_gauges(
+    per_chip: float, total: float, scaling: float, n_dev: int
+) -> None:
+    """The sharded step's throughput in the same registry the serving
+    daemon renders at ``GET /metrics?format=prometheus`` — DP runs are
+    first-class obs citizens, not just a JSON line in a bench log."""
+    from tpuflow.obs import default_registry
+
+    reg = default_registry()
+    reg.gauge(
+        "parallel_dp_throughput_per_chip",
+        "samples/sec/chip of the last measured DP train step",
+    ).set(per_chip)
+    reg.gauge(
+        "parallel_dp_total_throughput",
+        "samples/sec across the whole DP mesh",
+    ).set(total)
+    reg.gauge(
+        "parallel_dp_scaling_factor",
+        "DP total throughput over the single-device step's throughput",
+    ).set(scaling)
+    reg.gauge(
+        "parallel_dp_devices", "devices in the measured DP mesh"
+    ).set(n_dev)
+
+
+def _publish_dp_roofline(per_chip: float) -> None:
+    """Route the sharded step through the live MFU/roofline leg (PR 5):
+    same cost model the fit loop publishes train_mfu from, so a DP bench
+    on a known chip lands the same gauges a training epoch would."""
+    from tpuflow.obs.health import publish_roofline
+    from tpuflow.utils.roofline import model_cost_per_sample
+
+    cost = model_cost_per_sample(
+        "lstm",
+        window=WINDOW,
+        features=FEATURES,
+        model_kwargs={"hidden": HIDDEN, "num_layers": LAYERS},
+        itemsize=2,  # the benchmarked model trains in bfloat16
+    )
+    if cost is None:
+        return
+    publish_roofline(
+        per_chip, cost[0], cost[1], jax.devices()[0].device_kind
+    )
+
 
 def main() -> None:
     per_chip_batch = int(os.environ.get("BENCH_BATCH", 2048))
     seconds = float(os.environ.get("BENCH_SECONDS", 5))
     n_dev = jax.device_count()
-    model = LSTMRegressor(hidden=64, num_layers=2, dtype=jnp.bfloat16)
+    model = LSTMRegressor(hidden=HIDDEN, num_layers=LAYERS, dtype=jnp.bfloat16)
     rng = np.random.default_rng(0)
 
-    # Single-device reference.
-    x1 = jnp.asarray(rng.standard_normal((per_chip_batch, 24, 5)), jnp.float32)
-    y1 = jnp.asarray(rng.standard_normal((per_chip_batch, 24)), jnp.float32)
+    # Single-device reference — the DP=1 step the scaling factor divides by.
+    x1 = jnp.asarray(
+        rng.standard_normal((per_chip_batch, WINDOW, FEATURES)), jnp.float32
+    )
+    y1 = jnp.asarray(
+        rng.standard_normal((per_chip_batch, WINDOW)), jnp.float32
+    )
     state = create_state(model, jax.random.PRNGKey(0), x1[:2])
     steps, elapsed = time_train_steps(
         state, make_train_step(), x1, y1, seconds=seconds
@@ -50,8 +113,10 @@ def main() -> None:
 
     # DP across the full mesh, same per-chip batch.
     B = per_chip_batch * n_dev
-    x = np.asarray(rng.standard_normal((B, 24, 5)), np.float32)
-    y = np.asarray(rng.standard_normal((B, 24)), np.float32)
+    x = np.asarray(
+        rng.standard_normal((B, WINDOW, FEATURES)), np.float32
+    )
+    y = np.asarray(rng.standard_normal((B, WINDOW)), np.float32)
     mesh = make_mesh(n_data=n_dev)
     state = replicate(mesh, create_state(model, jax.random.PRNGKey(0), x1[:2]))
     dp_step = make_dp_train_step(mesh)
@@ -59,6 +124,7 @@ def main() -> None:
     steps, elapsed = time_train_steps(state, dp_step, xs, ys, seconds=seconds)
     total = B * steps / elapsed
     per_chip = total / n_dev
+    scaling = total / single  # > 1.0 is the point of the mesh
     emit(
         "stacked_lstm_dp",
         "dp_throughput_per_chip",
@@ -68,6 +134,15 @@ def main() -> None:
         total_throughput=round(total, 1),
         scaling_efficiency=round(per_chip / single, 3),
     )
+    emit(
+        "stacked_lstm_dp",
+        "dp_scaling_factor",
+        scaling,
+        "x vs DP=1 step",
+        n_devices=n_dev,
+    )
+    _publish_parallel_gauges(per_chip, total, scaling, n_dev)
+    _publish_dp_roofline(per_chip)
 
     # Scanned DP epoch: K steps per dispatch, all-reduce inside the scan —
     # the dispatch-amortized path for small batches (reference batch 20).
@@ -75,10 +150,12 @@ def main() -> None:
     small = int(os.environ.get("BENCH_SMALL_BATCH", 256))
     Bs = small * n_dev
     xs = np.broadcast_to(
-        rng.standard_normal((Bs, 24, 5)).astype(np.float32), (scan, Bs, 24, 5)
+        rng.standard_normal((Bs, WINDOW, FEATURES)).astype(np.float32),
+        (scan, Bs, WINDOW, FEATURES),
     )
     ys = np.broadcast_to(
-        rng.standard_normal((Bs, 24)).astype(np.float32), (scan, Bs, 24)
+        rng.standard_normal((Bs, WINDOW)).astype(np.float32),
+        (scan, Bs, WINDOW),
     )
     ep_shard = epoch_sharding(mesh)
     xs_d = jax.device_put(np.ascontiguousarray(xs), ep_shard)

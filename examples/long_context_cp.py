@@ -40,7 +40,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from tpuflow.parallel import make_mesh
+from tpuflow.parallel import make_mesh, set_mesh, shard_map
 from tpuflow.parallel.mesh import DATA_AXIS
 from tpuflow.parallel.ring_attention import full_attention, ring_attention_spmd
 
@@ -127,7 +127,7 @@ def cp_forward(mesh, params, x, heads: int, ring_impl: str = "jnp"):
         return encoder_chunk(params, x_local, t_offset, heads, spmd=True,
                              ring_impl=ring_impl)
 
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(P(), P(None, DATA_AXIS)),
@@ -155,7 +155,7 @@ def cp_grads(mesh, params, x, y, heads: int):
             lambda g: lax.psum(g, DATA_AXIS), grads
         )
 
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(P(), P(None, DATA_AXIS), P(None, DATA_AXIS)),
@@ -188,7 +188,7 @@ def main():
     y = jnp.asarray(
         np.random.default_rng(1).standard_normal((2, T)), jnp.float32
     )
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         loss_cp, grads_cp = cp_grads(mesh, params, x, y, heads)
     loss_ref, grads_ref = jax.value_and_grad(
         lambda p: jnp.sum(

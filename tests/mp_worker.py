@@ -73,6 +73,13 @@ def main() -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # Cross-process collectives on the CPU backend need the Gloo
+    # transport; without it the computation build fails with
+    # "Multiprocess computations aren't implemented on the CPU backend".
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass  # newer lines select a CPU transport automatically
 
     import numpy as np
 
@@ -203,7 +210,7 @@ def _sp_mode(pid: int, total: int) -> None:
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
-    from tpuflow.parallel import ring_attention
+    from tpuflow.parallel import ring_attention, set_mesh
     from tpuflow.parallel.mesh import make_mesh
 
     mesh = make_mesh()
@@ -215,7 +222,7 @@ def _sp_mode(pid: int, total: int) -> None:
     def loss(args):
         return jnp.mean(jnp.square(ring_attention(mesh, *args)))
 
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         val, grads = jax.value_and_grad(loss)((qd, kd, vd))
         grad_sum = float(sum(jnp.sum(jnp.abs(g)) for g in grads))
     print(

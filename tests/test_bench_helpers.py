@@ -170,3 +170,26 @@ class TestLastOnChip:
         assert rec["value"] == 0.0 and rec["error"] == "relay dead"
         assert rec["last_on_chip"]["value"] > 0
         assert rec["last_on_chip"]["source"].startswith("BENCH_r")
+
+
+class TestParallelGauges:
+    def test_dp_bench_publishes_parallel_gauges(self):
+        """BASELINE config 5's numbers land in the obs registry (the
+        `parallel_*` gauges the serving daemon renders at /metrics), so
+        sharded-step throughput is a first-class obs citizen."""
+        from benchmarks.bench_stacked_lstm_dp import _publish_parallel_gauges
+        from tpuflow.obs import default_registry
+
+        _publish_parallel_gauges(1000.0, 8000.0, 6.5, 8)
+        reg = default_registry()
+        assert reg.gauge("parallel_dp_throughput_per_chip").value() == 1000.0
+        assert reg.gauge("parallel_dp_total_throughput").value() == 8000.0
+        assert reg.gauge("parallel_dp_scaling_factor").value() == 6.5
+        assert reg.gauge("parallel_dp_devices").value() == 8
+
+    def test_dp_bench_roofline_leg_no_crash_on_unknown_chip(self):
+        """On an unknown chip (cpu) the roofline leg must neither crash
+        nor fake an MFU of 0.0 — the PR-5 honest-absence contract."""
+        from benchmarks.bench_stacked_lstm_dp import _publish_dp_roofline
+
+        _publish_dp_roofline(1234.5)

@@ -180,6 +180,49 @@ class TestMembership:
         (tmp_path / "members" / "2.json").write_text('"hello"')
         assert [m.worker_id for m in read_members(gang)] == [0]
 
+    def test_goodbye_is_sticky_against_late_beats(self, tmp_path):
+        """The wedged-heartbeat-thread drill: once ``done`` is written,
+        a late in-flight ``running`` beat can never overwrite it — the
+        write is suppressed (compare-before-write) and the coordinator
+        keeps seeing the goodbye."""
+        gang, clock = str(tmp_path), FakeClock()
+        write_heartbeat(gang, 0, status="running", clock=clock)
+        assert write_heartbeat(gang, 0, status="done", clock=clock)
+        # The wedged thread's beat, landing after finish(): suppressed.
+        assert write_heartbeat(gang, 0, status="running", clock=clock) is False
+        [m] = read_members(gang)
+        assert m.status == "done"
+        view = classify_members(gang, 5.0, clock())
+        assert not view.live and {m.worker_id for m in view.finished} == {0}
+
+    def test_goodbye_overrides_a_racing_rename_at_read_time(self, tmp_path):
+        """Even a beat whose rename slips PAST the compare-before-write
+        check (simulated by forging the heartbeat file directly) is
+        overridden by the standing goodbye marker when read."""
+        from tpuflow.elastic.membership import heartbeat_path
+
+        gang, clock = str(tmp_path), FakeClock()
+        write_heartbeat(gang, 0, status="failed", clock=clock)
+        (tmp_path / "members").mkdir(exist_ok=True)
+        with open(heartbeat_path(gang, 0), "w", encoding="utf-8") as f:
+            json.dump(
+                {"worker_id": 0, "time": clock(), "status": "running"}, f
+            )
+        [m] = read_members(gang)
+        assert m.status == "failed"
+
+    def test_joining_beat_revokes_the_goodbye(self, tmp_path):
+        """A restarted incarnation's ``joining`` hello must readmit the
+        worker — stickiness binds late beats of the DEAD incarnation,
+        not the supervised restart+rejoin path."""
+        gang, clock = str(tmp_path), FakeClock()
+        write_heartbeat(gang, 0, status="failed", clock=clock)
+        assert write_heartbeat(gang, 0, status="joining", clock=clock)
+        assert write_heartbeat(gang, 0, status="running", clock=clock)
+        [m] = read_members(gang)
+        assert m.status == "running"
+        assert classify_members(gang, 5.0, clock()).live_ids == {0}
+
 
 # ---------------------------------------------------------------------
 # unit: coordinator rounds (fake clock, step()-driven)
@@ -418,6 +461,34 @@ class TestElasticSpec:
         cfg = resolve_elastic(block)
         assert cfg["sync_every"] == ELASTIC_DEFAULTS["sync_every"]
         assert cfg["dir"] == "/g"
+
+    def test_poll_interval_derived_from_heartbeat_cadence(self):
+        """Unset poll_interval scales with heartbeat_interval (a fixed
+        20 Hz scan is needless metadata load on NFS-class gang dirs);
+        an explicit value is honored unchanged."""
+        from tpuflow.elastic import POLL_BEATS, derive_poll_interval
+
+        base = {"dir": "/g", "worker_id": 0, "n_workers": 2}
+        slow = resolve_elastic({**base, "heartbeat_interval": 5.0})
+        assert slow["poll_interval"] == pytest.approx(5.0 / POLL_BEATS)
+        # The drill default derives the old 0.05 s cadence exactly.
+        assert resolve_elastic(base)["poll_interval"] == pytest.approx(
+            derive_poll_interval(ELASTIC_DEFAULTS["heartbeat_interval"])
+        )
+        pinned = resolve_elastic({**base, "poll_interval": 0.5})
+        assert pinned["poll_interval"] == 0.5
+
+    def test_coordinator_poll_derives_from_heartbeat_interval(self, tmp_path):
+        from tpuflow.elastic import derive_poll_interval
+
+        coord = Coordinator(str(tmp_path), heartbeat_interval=2.0)
+        assert coord.poll_interval == pytest.approx(
+            derive_poll_interval(2.0)
+        )
+        pinned = Coordinator(
+            str(tmp_path), heartbeat_interval=2.0, poll_interval=0.01
+        )
+        assert pinned.poll_interval == 0.01
 
     def test_every_problem_reported(self):
         msgs = validate_elastic_block(

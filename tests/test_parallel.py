@@ -21,6 +21,7 @@ from tpuflow.parallel import (
     psum,
     reduce_scatter,
     shard_batch,
+    shard_map,
 )
 from tpuflow.parallel.dp import replicate
 from tpuflow.train import create_state, make_eval_step, make_train_step
@@ -55,7 +56,7 @@ def test_collectives_in_shard_map():
     s, m, g, rs, pp = map(
         np.asarray,
         jax.jit(
-            jax.shard_map(
+            shard_map(
                 body,
                 mesh=mesh,
                 in_specs=P("data"),

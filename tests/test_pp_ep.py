@@ -10,7 +10,12 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tpuflow.parallel import make_mesh, moe_forward, pipeline_forward
+from tpuflow.parallel import (
+    make_mesh,
+    moe_forward,
+    pipeline_forward,
+    set_mesh,
+)
 
 MODEL_AXIS = "model"
 
@@ -144,7 +149,7 @@ class TestPipelineGradients:
         def loss_ref(params):
             return jnp.sum(jnp.square(_sequential_ref(*params, xs)))
 
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             g = jax.grad(loss_pp)((ws, bs))
         gr = jax.grad(loss_ref)((ws, bs))
         for a, e, name in zip(g, gr, ["dws", "dbs"]):
@@ -188,7 +193,7 @@ class TestMoEGradients:
             )
             return jnp.sum(jnp.square(out))
 
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             g = jax.grad(loss_ep)((ps, gate, x))
         gr = jax.grad(loss_ref)((ps, gate, x))
         for a, e, name in zip(g, gr, ["dps", "dgate", "dx"]):

@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tpuflow.parallel import full_attention, ring_attention
+from tpuflow.parallel import full_attention, ring_attention, set_mesh
 
 from tests.conftest import ring_mesh
 
@@ -105,7 +105,7 @@ class TestRingFlashComposition:
                 jnp.square(ring_attention(mesh, *a, impl="flash"))
             )
 
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             g = jax.grad(loss_ring)((q, k, v))
         gr = jax.grad(
             lambda a: jnp.sum(jnp.square(full_attention(*a, causal=True)))
@@ -152,7 +152,7 @@ class TestRingAttentionGradients:
         def loss_full(q, k, v):
             return jnp.sum(jnp.square(full_attention(q, k, v, causal=causal)))
 
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
         g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
         for a, e, name in zip(g_ring, g_full, ["dq", "dk", "dv"]):
@@ -216,7 +216,7 @@ class TestAttentionRegressor:
                 jnp.square(model.apply({"params": p}, x))
             )
 
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             l_ring, g_ring = jax.jit(jax.value_and_grad(loss_of(ring)))(params, x)
         l_full, g_full = jax.jit(jax.value_and_grad(loss_of(full)))(params, x)
         np.testing.assert_allclose(float(l_ring), float(l_full), atol=1e-5)

@@ -10,7 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tpuflow.parallel import make_sp_forward, ring_lstm_scan
+from tpuflow.parallel import make_sp_forward, ring_lstm_scan, set_mesh
 from tpuflow.parallel.sp import _lstm_chunk_scan
 
 from tests.conftest import ring_mesh
@@ -66,7 +66,7 @@ class TestSpGradients:
         T, B, H = 16, 4, 8
         xw, wh, b = _case(T, B, H, seed=5)
 
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             g_ring = jax.grad(
                 lambda xw, wh, b: jnp.sum(
                     jnp.tanh(ring_lstm_scan(mesh, xw, wh, b))

@@ -3,7 +3,7 @@
 import jax.numpy as jnp
 import numpy as np
 
-from tpuflow.parallel import make_mesh
+from tpuflow.parallel import make_mesh, set_mesh
 from tpuflow.parallel.tp import (
     column_parallel_matmul,
     row_parallel_matmul,
@@ -79,7 +79,7 @@ class TestTensorParallelGradients:
             x, w1, w2 = a
             return jnp.sum(jnp.square(jax.nn.relu(x @ w1) @ w2))
 
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             g = jax.grad(loss_tp)((x, w1, w2))
         gr = jax.grad(loss_ref)((x, w1, w2))
         for a, e, name in zip(g, gr, ["dx", "dw1", "dw2"]):

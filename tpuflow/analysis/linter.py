@@ -86,6 +86,11 @@ RULES = {
               "peer (an evicted worker, an absent coordinator, a wedged "
               "backend) parks it forever — bound the wait against a "
               "deadline, a stop event, or a give-up budget",
+    "TPF008": "direct jax.make_mesh / jax.shard_map / jax.set_mesh use "
+              "or a raw shard_map import outside "
+              "tpuflow/parallel/compat.py — these APIs move across jax "
+              "releases (the 74-failure make_mesh TypeError family); go "
+              "through the compat layer's version-probed wrappers",
 }
 
 _HOST_SYNC_NAMES = {"float", "bool"}
@@ -146,6 +151,13 @@ def _collect_jitted_names(tree: ast.AST) -> set[str]:
     return names
 
 
+# TPF008: the jax attribute names the compat layer owns. ``jax.<attr>``
+# references to these (and raw shard_map imports) are version-portability
+# hazards everywhere EXCEPT the compat module itself.
+_COMPAT_OWNED_JAX_ATTRS = {"make_mesh", "shard_map", "set_mesh"}
+_COMPAT_MODULE_SUFFIX = "parallel/compat.py"
+
+
 class _Linter(ast.NodeVisitor):
     def __init__(self, path: str, source: str, sites: dict):
         self.path = path
@@ -155,6 +167,9 @@ class _Linter(ast.NodeVisitor):
         self.jitted_names = _collect_jitted_names(self.tree)
         self.findings: list[Diagnostic] = []
         self._jit_depth = 0
+        self._is_compat = path.replace(os.sep, "/").endswith(
+            _COMPAT_MODULE_SUFFIX
+        )
 
     def run(self) -> list[Diagnostic]:
         self.visit(self.tree)
@@ -358,6 +373,53 @@ class _Linter(ast.NodeVisitor):
                 and mentions_aux(sub.func.value)
             ):
                 self._emit("TPF006", sub, ".item() on per-step aux")
+
+    # --- TPF008: jax portability APIs outside the compat layer ---
+
+    def visit_Attribute(self, node) -> None:
+        if (
+            not self._is_compat
+            and node.attr in _COMPAT_OWNED_JAX_ATTRS
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "jax"
+        ):
+            self._emit("TPF008", node, f"jax.{node.attr} reference")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node) -> None:
+        if not self._is_compat and node.module:
+            names = {a.name for a in node.names}
+            raw_shard_map_import = (
+                node.module.startswith("jax.experimental.shard_map")
+                or (node.module == "jax.experimental"
+                    and "shard_map" in names)
+                or (node.module == "jax"
+                    and names & _COMPAT_OWNED_JAX_ATTRS)
+            )
+            if raw_shard_map_import:
+                # Name only the offending imports: `from jax import jit,
+                # make_mesh` is a make_mesh finding, not a jit one.
+                offending = (
+                    names & _COMPAT_OWNED_JAX_ATTRS
+                    if node.module == "jax"
+                    else names
+                )
+                self._emit(
+                    "TPF008", node,
+                    f"from {node.module} import "
+                    f"{', '.join(sorted(offending))}",
+                )
+        self.generic_visit(node)
+
+    def visit_Import(self, node) -> None:
+        # The bypass spelling: ``import jax.experimental.shard_map as m``
+        # then ``m.shard_map(...)`` — neither a from-import nor a
+        # ``jax.<attr>`` attribute chain, so it needs its own check.
+        if not self._is_compat:
+            for alias in node.names:
+                if alias.name.startswith("jax.experimental.shard_map"):
+                    self._emit("TPF008", node, f"import {alias.name}")
+        self.generic_visit(node)
 
     # --- TPF001 / TPF002 / TPF004: calls ---
 

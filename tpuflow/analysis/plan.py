@@ -199,6 +199,11 @@ def check_plan(
             where="n_devices",
         ))
 
+    # The mesh-tiling arithmetic is the mesh factory's own rule
+    # (tpuflow/parallel/mesh.py data_axis_size): a plan rejected here
+    # and a mesh rejected at construction are the same check.
+    from tpuflow.parallel.mesh import data_axis_size
+
     model_axis = 1
     for name in ("tp", "pp", "ep"):
         n = getattr(config, name)
@@ -212,7 +217,9 @@ def check_plan(
                 f"jit_epoch is not supported with {name}",
                 where="jit_epoch",
             ))
-        if n_dev % n:
+        try:
+            data_axis_size(n_dev, n)
+        except ValueError:
             out.append(_diag(
                 f"plan.{name}.devices",
                 f"n_devices {n_dev} not divisible by {name}={n}",

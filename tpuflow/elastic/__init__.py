@@ -5,8 +5,9 @@ processes each run the ordinary single-process ``train()`` loop on a
 disjoint shard of the training rows; a small coordinator periodically
 averages their parameters and rebroadcasts the mean. The exchange is
 deliberately file-based (``exchange.py``) — it needs no collective
-runtime, so it works despite the broken ``make_mesh`` on the installed
-jax and, more importantly, tolerates membership churn by construction:
+runtime at all (the in-worker device mesh, now alive again via
+``tpuflow/parallel/compat.py``, is orthogonal) and, more importantly,
+tolerates membership churn by construction:
 
 - **Heartbeats + eviction** (``membership.py``): a worker whose
   heartbeat goes stale past the deadline is evicted; averaging proceeds
@@ -49,9 +50,28 @@ ELASTIC_DEFAULTS: dict = {
     "heartbeat_timeout": 30.0,  # stale-heartbeat eviction deadline
     "round_timeout": 60.0,     # coordinator wait per round
     "pull_timeout": 120.0,     # worker wait for a round's average
-    "poll_interval": 0.05,     # file-polling cadence (worker + coord)
+    "poll_interval": None,     # file-polling cadence (worker + coord);
+    # None = derived from heartbeat_interval (derive_poll_interval) —
+    # a fixed 20 Hz directory scan is needless metadata load on
+    # NFS-class gang dirs when the gang only beats every few seconds.
     "warm_start": True,        # late joiners adopt the latest average
 }
+
+# Polls per heartbeat interval when poll_interval is derived: a scan a
+# few times per beat observes every membership/average transition within
+# a fraction of a beat, and the scan rate falls automatically as the
+# heartbeat cadence relaxes (production gangs on shared filesystems).
+# The drill default (heartbeat_interval=0.25) derives the same 0.05 s
+# the old hard-coded constant gave — and the drills mostly inject fake
+# clocks/sleeps anyway, so they stay wall-clock-free regardless.
+POLL_BEATS = 5
+
+
+def derive_poll_interval(heartbeat_interval: float) -> float:
+    """The file-poll cadence for a gang that beats every
+    ``heartbeat_interval`` seconds (see ``POLL_BEATS``)."""
+    return float(heartbeat_interval) / POLL_BEATS
+
 
 _REQUIRED = ("dir", "worker_id", "n_workers")
 
@@ -103,6 +123,8 @@ def validate_elastic_block(block) -> list[str]:
         "pull_timeout", "poll_interval",
     ):
         value = block.get(key, 1.0)
+        if key == "poll_interval" and value is None:
+            continue  # None = derive from heartbeat_interval
         if not isinstance(value, (int, float)) or value <= 0:
             out.append(
                 f"elastic.{key} must be a positive number (seconds), "
@@ -118,10 +140,17 @@ def validate_elastic_block(block) -> list[str]:
 
 def resolve_elastic(block: dict) -> dict:
     """Defaults-merged, validated copy of an ``elastic`` block; raises
-    ``ValueError`` listing every problem."""
+    ``ValueError`` listing every problem. An unset (or explicit None)
+    ``poll_interval`` resolves to ``derive_poll_interval`` of the
+    resolved heartbeat cadence."""
     problems = validate_elastic_block(block)
     if problems:
         raise ValueError(
             "invalid elastic config block: " + "; ".join(problems)
         )
-    return {**ELASTIC_DEFAULTS, **block}
+    out = {**ELASTIC_DEFAULTS, **block}
+    if out["poll_interval"] is None:
+        out["poll_interval"] = derive_poll_interval(
+            out["heartbeat_interval"]
+        )
+    return out

@@ -48,8 +48,9 @@ class Coordinator:
         gang_dir: str,
         *,
         heartbeat_timeout: float = 30.0,
+        heartbeat_interval: float = 0.25,
         round_timeout: float = 60.0,
-        poll_interval: float = 0.05,
+        poll_interval: float | None = None,
         min_round_interval: float = 0.0,
         min_round: int = 1,
         keep_rounds: int = 16,
@@ -64,7 +65,17 @@ class Coordinator:
         self.gang_dir = gang_dir
         self.heartbeat_timeout = heartbeat_timeout
         self.round_timeout = round_timeout
-        self.poll_interval = poll_interval
+        # Poll cadence derives from the gang's heartbeat cadence unless
+        # pinned: a fixed fast default would hammer NFS-class gang dirs
+        # with metadata scans a slow-beating production gang never needs
+        # (drills stay wall-clock-free via the injectable clock/sleep).
+        from tpuflow.elastic import derive_poll_interval
+
+        self.poll_interval = (
+            derive_poll_interval(heartbeat_interval)
+            if poll_interval is None
+            else poll_interval
+        )
         # Floor on the publication cadence (0 = as fast as pushes
         # arrive). A paced gang gives a briefly-absent worker rounds to
         # rejoin INTO instead of a fait accompli — and gives churn

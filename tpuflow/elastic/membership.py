@@ -76,6 +76,23 @@ def heartbeat_path(gang_dir: str, worker_id: int) -> str:
     return os.path.join(members_dir(gang_dir), f"{worker_id}.json")
 
 
+def goodbye_path(gang_dir: str, worker_id: int) -> str:
+    """The sticky-terminal marker (deliberately not ``*.json`` — the
+    member scanner globs heartbeats; this file is a flag, not one)."""
+    return os.path.join(members_dir(gang_dir), f"{worker_id}.goodbye")
+
+
+def read_goodbye(gang_dir: str, worker_id: int) -> str | None:
+    """The marker's terminal status, or None when no goodbye stands."""
+    try:
+        with open(goodbye_path(gang_dir, worker_id), encoding="utf-8") as f:
+            status = json.load(f).get("status")
+    except (OSError, ValueError, TypeError, AttributeError,
+            json.JSONDecodeError):
+        return None
+    return status if status in TERMINAL_STATUSES else None
+
+
 def write_heartbeat(
     gang_dir: str,
     worker_id: int,
@@ -84,11 +101,21 @@ def write_heartbeat(
     round: int = 0,
     status: str = "running",
     clock=time.time,
-) -> None:
-    """Overwrite this worker's heartbeat file (atomic tmp+rename).
+) -> bool:
+    """Overwrite this worker's heartbeat file (atomic tmp+rename);
+    returns False when a standing goodbye suppressed the write.
 
     Raises on an unknown status — a typo'd terminal state would leave
     the coordinator waiting on a worker that thinks it said goodbye.
+
+    **Terminal statuses are sticky.** A ``done``/``failed`` beat also
+    drops the goodbye-marker file; once it exists, a late ``running``
+    beat from a wedged heartbeat thread is (1) skipped here
+    (compare-before-write) and (2) even if its rename races past the
+    check, overridden at read time — ``read_members`` folds the marker
+    back into the record. Only an explicit ``joining`` beat (a NEW
+    incarnation announcing itself at ``join()``) clears the marker, so
+    the supervised restart+rejoin path is unaffected.
     """
     if status not in STATUSES:
         raise ValueError(
@@ -96,6 +123,14 @@ def write_heartbeat(
         )
     fault_point("elastic.heartbeat")
     os.makedirs(members_dir(gang_dir), exist_ok=True)
+    marker = goodbye_path(gang_dir, worker_id)
+    if status == "joining":
+        try:  # a new incarnation's hello revokes the old goodbye
+            os.remove(marker)
+        except OSError:
+            pass
+    elif status not in TERMINAL_STATUSES and os.path.exists(marker):
+        return False  # the goodbye stands; never beat over it
     # atomic_write_json's tmp name is unique per (process, thread): the
     # worker's heartbeat thread and its main-thread sync beats write
     # this path concurrently.
@@ -110,6 +145,9 @@ def write_heartbeat(
             "pid": os.getpid(),
         },
     )
+    if status in TERMINAL_STATUSES:
+        atomic_write_json(marker, {"status": status})
+    return True
 
 
 def read_members(gang_dir: str) -> list[Member]:
@@ -122,6 +160,12 @@ def read_members(gang_dir: str) -> list[Member]:
         names = sorted(os.listdir(d))
     except OSError:
         return out
+    # One directory listing serves both the heartbeat scan and the
+    # goodbye-marker probe: in the steady state (no goodbyes) no extra
+    # per-member open() is issued — this scan runs every poll_interval,
+    # and doubling its metadata ops would cost exactly what deriving
+    # the poll cadence from heartbeat_interval saves.
+    goodbyes = {n for n in names if n.endswith(".goodbye")}
     for name in names:
         if not name.endswith(".json"):
             continue
@@ -130,12 +174,23 @@ def read_members(gang_dir: str) -> list[Member]:
                 rec = json.load(f)
             if not isinstance(rec, dict):
                 continue  # stray JSON that isn't a heartbeat record
+            status = str(rec.get("status", "running"))
+            if (
+                status not in TERMINAL_STATUSES
+                and f"{rec.get('worker_id')}.goodbye" in goodbyes
+            ):
+                # Sticky goodbye: a standing marker overrides whatever a
+                # racing late beat managed to rename into place (the
+                # read-side half of write_heartbeat's terminal contract).
+                goodbye = read_goodbye(gang_dir, int(rec["worker_id"]))
+                if goodbye is not None:
+                    status = goodbye
             out.append(Member(
                 worker_id=int(rec["worker_id"]),
                 time=float(rec["time"]),
                 epoch=int(rec.get("epoch", 0)),
                 round=int(rec.get("round", 0)),
-                status=str(rec.get("status", "running")),
+                status=status,
                 pid=rec.get("pid"),
             ))
         except (OSError, ValueError, TypeError, KeyError,

@@ -175,7 +175,7 @@ def run_elastic(
     round_timeout: float = 60.0,
     min_round_interval: float = 0.0,
     pull_timeout: float = 120.0,
-    poll_interval: float = 0.05,
+    poll_interval: float | None = None,
     max_restarts: int = 2,
     stall_timeout: float | None = None,
     term_grace: float = 5.0,
@@ -258,6 +258,7 @@ def run_elastic(
     coordinator = Coordinator(
         gang_dir,
         heartbeat_timeout=heartbeat_timeout,
+        heartbeat_interval=heartbeat_interval,
         round_timeout=round_timeout,
         min_round_interval=min_round_interval,
         poll_interval=poll_interval,

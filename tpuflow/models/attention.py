@@ -85,13 +85,14 @@ class EncoderBlock(nn.Module):
             # surrounding Dense/LayerNorm grads have unambiguous
             # shardings. (Sharding the whole block over time is the
             # shard_map recipe in examples/, not this module's job.)
-            import jax
             from jax.sharding import NamedSharding, PartitionSpec
+
+            from tpuflow.parallel.compat import reshard
 
             # NamedSharding (not a bare spec): the supplied mesh must be
             # sufficient on its own — a bare PartitionSpec would demand
-            # an ambient jax.set_mesh context on top of the parameter.
-            att = jax.sharding.reshard(
+            # an ambient set_mesh context on top of the parameter.
+            att = reshard(
                 att, NamedSharding(self.mesh, PartitionSpec())
             )
         else:

@@ -9,7 +9,18 @@ steps compiled with ``shard_map``/``jit``, gradient all-reduce as
 DCN. No JVM, no shuffle service, no executor processes.
 """
 
-from tpuflow.parallel.mesh import make_mesh, data_sharding, replicated  # noqa: F401
+from tpuflow.parallel.compat import (  # noqa: F401
+    AxisType,
+    reshard,
+    set_mesh,
+    shard_map,
+)
+from tpuflow.parallel.mesh import (  # noqa: F401
+    data_axis_size,
+    data_sharding,
+    make_mesh,
+    replicated,
+)
 from tpuflow.parallel.collectives import (  # noqa: F401
     all_gather,
     pmean,

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from jax import lax
 
+from tpuflow.parallel.compat import axis_size
 from tpuflow.parallel.mesh import DATA_AXIS
 
 
@@ -35,6 +36,6 @@ def reduce_scatter(x, axis: str = DATA_AXIS):
 def ppermute_ring(x, axis: str = DATA_AXIS, shift: int = 1):
     """Rotate shards around the mesh axis ring — the primitive under ring
     attention and pipeline schedules."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)

@@ -4,7 +4,8 @@ The TPU-native equivalent of the reference's Spark-executor data
 parallelism (SURVEY.md §2, §5.8): each device holds a replica of the
 params and a shard of the batch; gradients are all-reduced with
 ``lax.pmean`` over the ``data`` mesh axis inside one compiled step. The
-SPMD region is expressed with ``jax.shard_map`` — collectives are explicit
+SPMD region is expressed with ``shard_map`` (the compat layer's
+version-probed wrapper) — collectives are explicit
 and auditable — then jitted, so XLA lays the all-reduce on ICI.
 
 Per-device RNG is decorrelated by folding the device's axis index into the
@@ -22,6 +23,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpuflow.core.losses import mae_clip
+from tpuflow.parallel.compat import shard_map
 from tpuflow.parallel.mesh import DATA_AXIS, data_sharding
 
 LossFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
@@ -57,7 +59,7 @@ def make_dp_train_step(
         state = state.apply_gradients(grads=grads)
         return state, {"loss": loss}
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         body,
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis), P()),
@@ -111,7 +113,7 @@ def make_dp_epoch_step(
         state, losses = lax.scan(batch_step, state, (xs, ys, idx))
         return state, jnp.mean(losses)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         body,
         mesh=mesh,
         in_specs=(P(), P(None, axis), P(None, axis), P()),
@@ -175,7 +177,7 @@ def make_dp_eval_step(
             "count": lax.psum(jnp.sum(mask), axis),
         }
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         body,
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis), P(axis)),
@@ -258,6 +260,21 @@ def process_batch_bounds(
 
 
 def replicate(mesh: Mesh, tree):
-    """Replicate a pytree (e.g. TrainState) across the mesh."""
+    """Replicate a pytree (e.g. TrainState) across the mesh.
+
+    Single-host: a plain ``device_put``. On a multi-process runtime the
+    mesh spans devices this process cannot address, which ``device_put``
+    rejects — each process instead contributes its (identical, same-seed
+    SPMD program) full copy through the per-process assembly path, the
+    same route ``shard_batch`` uses for batch shards.
+    """
     sharding = NamedSharding(mesh, P())
-    return jax.device_put(tree, sharding)
+    if jax.process_count() == 1:
+        return jax.device_put(tree, sharding)
+
+    def put(leaf):
+        return jax.make_array_from_process_local_data(
+            sharding, np.asarray(leaf)
+        )
+
+    return jax.tree_util.tree_map(put, tree)

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpuflow.parallel.compat import shard_map
 from tpuflow.parallel.mesh import MODEL_AXIS
 
 
@@ -58,7 +59,7 @@ def _moe_fn(mesh: Mesh, axis: str, expert_fn: Callable):
         return lax.psum(out * (mine * weight)[:, None], axis)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             body,
             mesh=mesh,
             in_specs=(P(axis), P(), P()),

@@ -36,6 +36,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpuflow.core.losses import mae_clip
+from tpuflow.parallel.compat import shard_map
 from tpuflow.parallel.mesh import DATA_AXIS, MODEL_AXIS
 from tpuflow.parallel.tp_train import make_tp_mesh, shard_state, state_shardings
 
@@ -98,7 +99,7 @@ def _moe_body_fn(mesh: Mesh, axis: str, data_axis: str):
             out = out + expert * (mine * weight)[:, None]
         return lax.psum(out, axis)
 
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(), P(data_axis)),

@@ -5,6 +5,14 @@ Axis conventions (scaling-book style):
 - ``model`` — reserved tensor-parallel axis (size 1 for the flow models,
   which are far below the per-chip HBM limit, but the API keeps it
   expressible per SURVEY.md §2's TP note).
+
+``make_mesh`` here is THE mesh factory: every strategy module (dp/tp/pp/
+ep/sp, ring attention) and ``analysis/plan.py``'s divisibility rules
+build on it (the arithmetic half is ``data_axis_size``, shared so a plan
+rejected at preflight and a mesh rejected at construction are the same
+rule). Version differences in the underlying jax API are absorbed by
+``tpuflow.parallel.compat`` — nothing else in the package talks to
+``jax.make_mesh`` directly (lint rule TPF008).
 """
 
 from __future__ import annotations
@@ -12,8 +20,28 @@ from __future__ import annotations
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpuflow.parallel import compat
+
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+
+
+def data_axis_size(n_devices: int, n_model: int = 1) -> int:
+    """Data-axis size of a ``(data, model)`` mesh over ``n_devices``.
+
+    The one divisibility rule shared by ``make_mesh`` and the preflight
+    plan checker (``analysis/plan.py``): the device count must tile the
+    model axis exactly.
+    """
+    if n_model < 1:
+        raise ValueError(f"model axis must be >= 1, got {n_model}")
+    if n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+    if n_devices % n_model:
+        raise ValueError(
+            f"n_devices {n_devices} not divisible by model axis {n_model}"
+        )
+    return n_devices // n_model
 
 
 def make_mesh(
@@ -26,19 +54,21 @@ def make_mesh(
 
     Defaults to all devices on the data axis — the reference family's only
     parallelism (SURVEY.md §2 "Parallelism strategies"). ``axis_types``
-    passes through to ``jax.make_mesh`` (default: JAX's Explicit axes,
-    right for the shard_map paths); the GSPMD tensor-parallel trainer
-    passes Auto so the compiler propagates shardings through the model
-    (see parallel/tp_train.py).
+    passes through to the compat layer's mesh constructor (advisory: on a
+    jax with explicit axis types it selects them; on one without, every
+    mesh runs in the default GSPMD/auto mode and the hint is dropped —
+    see ``tpuflow/parallel/compat.py``'s policy). The GSPMD
+    tensor-parallel trainer passes Auto so the compiler propagates
+    shardings through the model (see parallel/tp_train.py).
     """
     devices = devices if devices is not None else jax.devices()
     if n_data is None:
-        n_data = len(devices) // n_model
+        n_data = data_axis_size(len(devices), n_model)
     if n_data * n_model != len(devices):
         raise ValueError(
             f"mesh {n_data}x{n_model} != {len(devices)} devices"
         )
-    return jax.make_mesh(
+    return compat.make_mesh(
         (n_data, n_model),
         (DATA_AXIS, MODEL_AXIS),
         axis_types=axis_types,

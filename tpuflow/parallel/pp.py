@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpuflow.parallel.compat import shard_map
 from tpuflow.parallel.mesh import MODEL_AXIS
 
 
@@ -89,7 +90,7 @@ def _pipeline_fn(mesh: Mesh, axis: str, stage_fn: Callable):
         )
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             body,
             mesh=mesh,
             in_specs=(P(axis), P()),

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpuflow.core.losses import mae_clip
+from tpuflow.parallel.compat import shard_map
 from tpuflow.parallel.mesh import DATA_AXIS, MODEL_AXIS
 from tpuflow.parallel.tp_train import make_tp_mesh, shard_state, state_shardings
 
@@ -102,7 +103,7 @@ def _pipeline_body_fn(mesh: Mesh, axis: str, data_axis: str):
 
         return gpipe_schedule(axis, n_stages, chunk, xs_local)
 
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(None, data_axis)),

@@ -22,7 +22,8 @@ Training-capable with flash-grade memory: a custom VJP saves only
 probabilities from the logsumexp while dK/dV accumulators ride the same
 ppermute ring home — residuals are O(T/N), not the O(T^2/N) score blocks
 plain autodiff through the unrolled ring would stash. Take gradients of
-the ``ring_attention`` wrapper inside ``with jax.set_mesh(mesh):`` like
+the ``ring_attention`` wrapper inside ``with set_mesh(mesh):``
+(``tpuflow.parallel.set_mesh``) like
 the SP ring scan (``ring_attention_spmd`` works directly inside your own
 shard_map).
 """
@@ -36,6 +37,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpuflow.parallel.compat import axis_size, shard_map
 from tpuflow.parallel.collectives import ppermute_ring
 from tpuflow.parallel.mesh import DATA_AXIS
 
@@ -104,7 +106,7 @@ def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float, impl: str):
     and its compiled program, and a long-lived daemon building a fresh
     mesh per job must not grow memory without bound."""
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda ql, kl, vl: ring_attention_spmd(
                 ql, kl, vl, axis=axis, causal=causal, scale=scale, impl=impl
             ),
@@ -140,7 +142,7 @@ def _ring_fwd_core(q_local, k_local, v_local, axis, causal, scale, impl="jnp"):
     scores stay in VMEM tiles instead of a materialized [Tl, Tl] array
     per round — ring outside, flash inside. Causal only.
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     B, Tl, D = q_local.shape
     idx = lax.axis_index(axis)
     k_cur, v_cur = k_local, v_local
@@ -230,7 +232,7 @@ def _ring_spmd_bwd(axis, causal, scale, impl, res, do):
     q, k, v, out, lse = res
     if impl == "flash":
         return _ring_flash_bwd(q, k, v, out, lse, do, axis, scale)
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     B, Tl, D = q.shape
     idx = lax.axis_index(axis)
     do = do.astype(q.dtype)
@@ -273,7 +275,7 @@ def _ring_flash_bwd(q, k, v, out, lse, do, axis, scale):
     same accumulator-rides-the-ring schedule as the jnp path."""
     from tpuflow.kernels.attention import ring_round_bwd
 
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     B, Tl, D = q.shape
     idx = lax.axis_index(axis)
     do = do.astype(q.dtype)

@@ -23,7 +23,7 @@ carry-passing collective, applied to a recurrence.
 
 The ring scan is **training-capable**: it differentiates through the
 ppermute carry ring (tested against the on-chip scan's gradients). Take
-gradients inside a ``with jax.set_mesh(mesh):`` context — the transpose
+gradients inside a ``with set_mesh(mesh):`` context (``tpuflow.parallel.set_mesh``) — the transpose
 of the shard_map program needs the mesh to type its cotangents.
 """
 
@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpuflow.parallel.compat import shard_map
 from tpuflow.parallel.collectives import ppermute_ring
 from tpuflow.parallel.mesh import DATA_AXIS
 
@@ -113,7 +114,7 @@ def _ring_scan_fn(mesh: Mesh, axis: str):
         return hs_out
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             body,
             mesh=mesh,
             in_specs=(P(axis), P(), P()),

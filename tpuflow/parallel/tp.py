@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpuflow.parallel.compat import shard_map
 from tpuflow.parallel.mesh import MODEL_AXIS
 
 
@@ -47,7 +48,7 @@ def _column_fn(mesh: Mesh, axis: str):
         return x @ w
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             body,
             mesh=mesh,
             in_specs=(P(), P(None, axis)),
@@ -63,7 +64,7 @@ def _row_fn(mesh: Mesh, axis: str):
         return lax.psum(x @ w, axis)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             body,
             mesh=mesh,
             in_specs=(P(None, axis), P(axis, None)),
@@ -80,7 +81,7 @@ def _mlp_fn(mesh: Mesh, axis: str):
         return lax.psum(h @ w2, axis)  # one all-reduce
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             body,
             mesh=mesh,
             in_specs=(P(), P(None, axis), P(axis, None)),

@@ -40,9 +40,13 @@ LossFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
 def make_tp_mesh(n_data: int, n_model: int, devices=None):
     """A ``(data, model)`` mesh with AUTO axis types: the trainer relies
     on GSPMD propagating the megatron param shardings through the model
-    body (JAX 0.9's default Explicit axes would instead demand per-op
-    ``out_sharding`` annotations on the sharded contractions)."""
-    from jax.sharding import AxisType
+    body (a jax line whose default is Explicit axes would instead demand
+    per-op ``out_sharding`` annotations on the sharded contractions).
+    ``AxisType`` comes from the compat layer — on a jax without explicit
+    axis types the hint is dropped and the mesh runs in the default
+    GSPMD/auto mode, which is the same behavior this function asks for.
+    """
+    from tpuflow.parallel.compat import AxisType
 
     return make_mesh(
         n_data=n_data,
