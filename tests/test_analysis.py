@@ -433,6 +433,79 @@ class TestLinter:
                     time.sleep(3600)
         """) == []
 
+    def test_blocking_calls_in_async_def_flagged(self, tmp_path):
+        """TPF009: a blocking call under an async def parks the whole
+        event loop — every connection the serving control plane owns
+        stalls behind it."""
+        diags = self._lint_source(tmp_path, """
+            import time
+            import requests
+
+            async def handler(request):
+                time.sleep(0.1)
+                requests.get("http://upstream/x")
+                body = open("/tmp/f").read()
+                return body
+        """)
+        assert _codes(diags) == ["TPF009", "TPF009", "TPF009"]
+        assert "time.sleep" in diags[0].message
+
+    def test_async_equivalents_and_executor_pattern_pass(self, tmp_path):
+        # asyncio.sleep is the async equivalent; a blocking call inside
+        # a NESTED sync def belongs to its caller's context — the
+        # run_in_executor pattern must lint clean by construction.
+        assert self._lint_source(tmp_path, """
+            import asyncio
+            import time
+
+            async def handler(loop, pool):
+                await asyncio.sleep(0.1)
+
+                def blocking():
+                    time.sleep(0.1)
+                    return open("/tmp/f").read()
+
+                return await loop.run_in_executor(None, blocking)
+        """) == []
+
+    def test_sync_def_blocking_calls_not_flagged(self, tmp_path):
+        assert self._lint_source(tmp_path, """
+            import time
+
+            def worker():
+                time.sleep(0.1)
+                return open("/tmp/f")
+        """) == []
+
+    def test_tpf009_socket_and_urlopen_flagged(self, tmp_path):
+        diags = self._lint_source(tmp_path, """
+            import socket
+            from urllib.request import urlopen
+
+            async def probe(url):
+                s = socket.socket()
+                return urlopen(url)
+        """)
+        assert _codes(diags) == ["TPF009", "TPF009"]
+
+    def test_tpf009_dotted_urlopen_flagged(self, tmp_path):
+        # The common full spelling is a THREE-segment attribute chain;
+        # matching only two-segment forms missed it entirely.
+        diags = self._lint_source(tmp_path, """
+            import urllib.request
+
+            async def fetch(url):
+                return urllib.request.urlopen(url)
+        """)
+        assert _codes(diags) == ["TPF009"]
+        assert "urllib.request.urlopen" in diags[0].message
+
+    def test_tpf009_noqa_suppression(self, tmp_path):
+        assert self._lint_source(tmp_path, """
+            async def read_config(path):
+                return open(path).read()  # noqa: TPF009
+        """) == []
+
     def test_self_lint_gate_package_is_clean(self):
         """The gate: the whole tpuflow package obeys its own lint rules.
         New framework code that host-syncs inside jit, uses untraced

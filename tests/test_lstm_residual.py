@@ -108,8 +108,19 @@ class TestGilbertResidualLSTM:
 
 class TestServingRoundtrip:
     def test_artifact_roundtrip_beats_physics(self, tmp_path):
+        """Save → load → predict on UNSEEN wells must still beat the
+        physical baseline.
+
+        Evaluated over 4 held-out wells, not 1: a single 64-step well is
+        one draw from the synthetic generator, and one unlucky draw
+        (seed=11's lone well sits above the training wells' flow range)
+        made this assertion flap for several PRs while every other seed
+        passed with ≥2x margin. Averaging 4 wells keeps the assertion
+        about the ARTIFACT (roundtrip fidelity + generalization), not
+        about one well's regime: measured margins across probe seeds
+        1–17 are 2–7x, incl. 2.5x at this exact seed (ISSUE 8 probe)."""
         train(_config(tmp_path))
-        table = wells_to_table(generate_wells(1, 64, seed=11))
+        table = wells_to_table(generate_wells(4, 64, seed=11))
         truth = table.pop("flow")
         y, idx = predict(
             str(tmp_path), "lstm_residual", columns=table, return_index=True

@@ -373,7 +373,7 @@ class TestMetricsKeysDocDrift:
             text, re.S,
         )
         assert block, f"docs/serving.md lost its metrics-keys:{section} markers"
-        return set(re.findall(r"`([a-z_]+)`", block.group(1)))
+        return set(re.findall(r"`([a-z0-9_]+)`", block.group(1)))
 
     def test_predict_metrics_keys_match_docs(self):
         from tpuflow.serve import PredictService
@@ -386,6 +386,23 @@ class TestMetricsKeysDocDrift:
 
         runner = JobRunner()
         assert self._documented("jobs") == set(runner.metrics())
+
+    def test_serving_metrics_keys_match_docs(self):
+        """The async control plane's `serving` section (admission/shed/
+        hedge counters) is documented in the same marker-block pattern."""
+        from tpuflow.serve import PredictService
+        from tpuflow.serve_async import AsyncServer
+
+        srv = AsyncServer(
+            "127.0.0.1", 0, enable_jobs=False,
+            service=PredictService(batch_predicts=False),
+        )
+        try:
+            assert self._documented("serving") == set(
+                srv.metrics()["serving"]
+            )
+        finally:
+            srv.shutdown()
 
 
 class TestTrainRunSpans:

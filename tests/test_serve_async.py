@@ -1,0 +1,840 @@
+"""Async serving control plane: admission, shedding, deadlines, lanes.
+
+The contract under test (docs/serving.md, tpuflow/serve_async.py):
+
+- admission is an explicit bounded resource: past ``max_inflight``
+  concurrent requests the server sheds 503 (capacity) while staying
+  responsive; a client past its token-bucket quota sheds 429 (its
+  fault, not the server's) — the split is load-bearing for retry
+  policy;
+- a request whose deadline passes while queued sheds 504 and NEVER
+  occupies a dispatch slot;
+- the continuous batcher admits rows into the next in-flight dispatch
+  the moment the previous one returns (no wait timer), per artifact
+  lane, with the micro-batcher's stale-scatter/error-scatter contracts
+  intact;
+- all of it is observable: queue-depth / in-flight-dispatch gauges,
+  shed counters, admission spans, in JSON and Prometheus.
+
+Batcher and server mechanics run against stub predictors (no jit); the
+flood drill is the tier-1 acceptance: under way-over-capacity offered
+load the daemon answers health probes, sheds with the right codes, and
+its gauges tell the story.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpuflow.microbatch import ContinuousBatcher, DeadlineExpired
+from tpuflow.serve import PredictService, env_flag, env_num
+from tpuflow.serve_async import AsyncServer, TokenBuckets
+
+KEY = ("/artifacts", "m")
+SPEC = {"storagePath": KEY[0], "model": KEY[1]}
+
+
+class StubPredictor:
+    """Duck-types the coalescable Predictor surface; records every
+    dispatch's row count (the never-occupies-a-slot assertions read
+    it)."""
+
+    degraded = False
+
+    def __init__(self, scale: float = 1.0, delay_s: float = 0.0):
+        self.scale = scale
+        self.delay_s = delay_s
+        self.forward_calls: list[int] = []
+        self.fail_next = 0
+
+    def prepare_columns(self, columns):
+        return np.asarray(columns["x"], np.float32).reshape(-1, 1), None
+
+    def forward_prepared(self, x, batch_size: int = 4096):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise RuntimeError("injected forward failure")
+        self.forward_calls.append(len(x))
+        return x[:, 0] * self.scale
+
+    def predict_columns(self, columns):
+        x, _ = self.prepare_columns(columns)
+        return self.forward_prepared(x)
+
+
+def _server(stub=None, **kwargs) -> AsyncServer:
+    """A started AsyncServer over a continuous-batching service whose
+    cache is pre-seeded with ``stub`` (no artifact on disk needed)."""
+    svc = PredictService(
+        batch_predicts=True, batch_mode="continuous", warmup_buckets=0
+    )
+    if stub is not None:
+        svc._cache[KEY] = stub
+    kwargs.setdefault("enable_jobs", False)
+    srv = AsyncServer("127.0.0.1", 0, service=svc, **kwargs)
+    return srv.start()
+
+
+def _post(base: str, spec: dict, headers: dict | None = None, timeout=20):
+    req = urllib.request.Request(
+        base + "/predict", data=json.dumps(spec).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(base: str, path: str, timeout=10):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestTokenBuckets:
+    def test_burst_then_refill(self):
+        clock = [0.0]
+        tb = TokenBuckets(rate=2.0, burst=3.0, clock=lambda: clock[0])
+        assert [tb.allow("a") for _ in range(4)] == [True] * 3 + [False]
+        clock[0] += 0.5  # one token back at 2/s
+        assert tb.allow("a") is True
+        assert tb.allow("a") is False
+
+    def test_rate_zero_disables(self):
+        tb = TokenBuckets(rate=0.0, burst=1.0, clock=lambda: 0.0)
+        assert all(tb.allow("a") for _ in range(100))
+
+    def test_clients_are_independent(self):
+        tb = TokenBuckets(rate=1.0, burst=1.0, clock=lambda: 0.0)
+        assert tb.allow("a") and not tb.allow("a")
+        assert tb.allow("b")  # a's exhaustion never touches b
+
+    def test_client_table_bounded(self):
+        clock = [0.0]
+        tb = TokenBuckets(
+            rate=1.0, burst=1.0, max_clients=8, clock=lambda: clock[0]
+        )
+        for i in range(64):
+            clock[0] += 0.001
+            tb.allow(f"c{i}")
+        assert len(tb._buckets) <= 8
+
+    def test_burst_below_one_rejected(self):
+        with pytest.raises(ValueError, match="burst"):
+            TokenBuckets(rate=1.0, burst=0.5)
+
+
+class TestEnvKnobValidation:
+    """Every TPUFLOW_SERVE_* env value is validated at read time with an
+    error naming the variable and the expected form (the TPUFLOW_RETRY_*
+    precedent, satellite of ISSUE 8)."""
+
+    def test_non_numeric_names_var_and_form(self, monkeypatch):
+        monkeypatch.setenv("TPUFLOW_SERVE_ADMIT_MAX", "pizza")
+        with pytest.raises(ValueError) as e:
+            env_num(
+                "TPUFLOW_SERVE_ADMIT_MAX", 256, int, minimum=1,
+                form="an integer in-flight bound >= 1",
+            )
+        assert "TPUFLOW_SERVE_ADMIT_MAX" in str(e.value)
+        assert "pizza" in str(e.value)
+        assert "integer in-flight bound" in str(e.value)
+
+    def test_below_minimum_and_non_finite_rejected(self, monkeypatch):
+        monkeypatch.setenv("TPUFLOW_SERVE_QUOTA_RPS", "-3")
+        with pytest.raises(ValueError, match="TPUFLOW_SERVE_QUOTA_RPS"):
+            env_num("TPUFLOW_SERVE_QUOTA_RPS", 0.0, float)
+        monkeypatch.setenv("TPUFLOW_SERVE_DEADLINE_MS", "inf")
+        with pytest.raises(ValueError, match="TPUFLOW_SERVE_DEADLINE_MS"):
+            env_num("TPUFLOW_SERVE_DEADLINE_MS", 0.0, float)
+
+    def test_valid_values_parse(self, monkeypatch):
+        monkeypatch.setenv("TPUFLOW_SERVE_ADMIT_MAX", "32")
+        assert env_num("TPUFLOW_SERVE_ADMIT_MAX", 256, int, minimum=1) == 32
+        monkeypatch.delenv("TPUFLOW_SERVE_ADMIT_MAX")
+        assert env_num("TPUFLOW_SERVE_ADMIT_MAX", 256, int, minimum=1) == 256
+
+    def test_malformed_flag_names_var(self, monkeypatch):
+        monkeypatch.setenv("TPUFLOW_SERVE_BATCH", "ture")
+        with pytest.raises(ValueError, match="TPUFLOW_SERVE_BATCH"):
+            env_flag("TPUFLOW_SERVE_BATCH", False)
+
+    def test_server_reads_env_at_construction(self, monkeypatch):
+        monkeypatch.setenv("TPUFLOW_SERVE_ADMIT_MAX", "not-a-number")
+        with pytest.raises(ValueError, match="TPUFLOW_SERVE_ADMIT_MAX"):
+            AsyncServer("127.0.0.1", 0, enable_jobs=False,
+                        service=PredictService(batch_predicts=False))
+
+    def test_async_daemon_honors_batch_env(self, monkeypatch):
+        """TPUFLOW_SERVE_BATCH=0 must actually disable the fast path on
+        the async daemon (not be silently ignored), and the default —
+        env unset — is batching ON, continuous engine."""
+        monkeypatch.setenv("TPUFLOW_SERVE_BATCH", "0")
+        srv = AsyncServer("127.0.0.1", 0, enable_jobs=False)
+        try:
+            assert srv.service.batcher is None
+        finally:
+            srv.shutdown()
+        monkeypatch.delenv("TPUFLOW_SERVE_BATCH")
+        srv = AsyncServer("127.0.0.1", 0, enable_jobs=False)
+        try:
+            assert srv.service.batch_mode == "continuous"
+            assert srv.service.batcher is not None
+        finally:
+            srv.shutdown()
+
+    def test_malformed_batch_mode_names_var(self, monkeypatch):
+        monkeypatch.setenv("TPUFLOW_SERVE_BATCH_MODE", "warp")
+        with pytest.raises(ValueError, match="TPUFLOW_SERVE_BATCH_MODE"):
+            PredictService(batch_predicts=True)
+
+
+class TestContinuousBatcher:
+    def test_followers_join_next_inflight_dispatch(self):
+        """The continuous contract: requests arriving while a dispatch
+        is in flight ALL land in the next one — no wait timer."""
+        calls = []
+        gate = threading.Event()
+
+        def run(pred, x):
+            calls.append(len(x))
+            if len(calls) == 1:
+                gate.wait(5)  # hold the first dispatch in flight
+            return x
+
+        cb = ContinuousBatcher(run, max_batch_rows=64)
+        results = [None] * 6
+
+        def go(i):
+            results[i] = cb.submit(KEY, "P", np.full((2, 1), i, np.float32))
+
+        t0 = threading.Thread(target=go, args=(0,))
+        t0.start()
+        for _ in range(100):
+            if calls:
+                break
+            time.sleep(0.01)
+        followers = [
+            threading.Thread(target=go, args=(i,)) for i in range(1, 6)
+        ]
+        for t in followers:
+            t.start()
+        time.sleep(0.1)  # followers enqueue behind the held dispatch
+        gate.set()
+        t0.join(10)
+        for t in followers:
+            t.join(10)
+        assert calls == [2, 10]  # 1 leader, then ALL 5 followers at once
+        for i, r in enumerate(results):
+            assert np.all(np.asarray(r) == i)
+        m = cb.metrics()
+        assert m["mode"] == "continuous"
+        assert m["dispatches"] == 2 and m["coalesced_dispatches"] == 1
+        cb.close()
+
+    def test_lone_request_dispatches_immediately(self):
+        cb = ContinuousBatcher(lambda p, x: x, max_batch_rows=64)
+        t0 = time.perf_counter()
+        cb.submit(KEY, "P", np.ones((1, 1), np.float32))
+        assert time.perf_counter() - t0 < 0.5  # no max_wait_ms floor
+        cb.close()
+
+    def test_expired_entry_never_occupies_a_dispatch_slot(self):
+        rows_seen = []
+        gate = threading.Event()
+
+        def run(pred, x):
+            rows_seen.append(x[:, 0].tolist())
+            if len(rows_seen) == 1:
+                gate.wait(5)
+            return x
+
+        cb = ContinuousBatcher(run, max_batch_rows=64)
+        t1 = threading.Thread(
+            target=lambda: cb.submit(KEY, "P", np.full((1, 1), 1.0))
+        )
+        t1.start()
+        for _ in range(100):
+            if rows_seen:
+                break
+            time.sleep(0.01)
+        # Queued behind the held dispatch with an already-short deadline.
+        with pytest.raises(DeadlineExpired):
+            cb.submit(
+                KEY, "P", np.full((1, 1), 7.0),
+                deadline=time.monotonic() + 0.05,
+            )
+        gate.set()
+        t1.join(10)
+        # One follower keeps the lane alive after the expiry drain.
+        cb.submit(KEY, "P", np.full((1, 1), 2.0))
+        assert all(7.0 not in rows for rows in rows_seen), rows_seen
+        assert cb.metrics()["expired"] == 1
+        cb.close()
+
+    def test_instance_grouping_never_mixes_predictors(self):
+        gate = threading.Event()
+        calls = []
+
+        def run(pred, x):
+            calls.append((pred, len(x)))
+            if len(calls) == 1:
+                gate.wait(5)
+            return x * pred
+
+        cb = ContinuousBatcher(run, max_batch_rows=64)
+        outs = {}
+
+        def go(tag, pred):
+            outs[tag] = cb.submit(KEY, pred, np.ones((2, 1), np.float32))
+
+        t0 = threading.Thread(target=go, args=("warm", 1.0))
+        t0.start()
+        for _ in range(100):
+            if calls:
+                break
+            time.sleep(0.01)
+        ts = [
+            threading.Thread(target=go, args=(f"old{i}", 10.0))
+            for i in range(2)
+        ] + [
+            threading.Thread(target=go, args=(f"new{i}", 100.0))
+            for i in range(2)
+        ]
+        for t in ts:
+            t.start()
+        time.sleep(0.1)
+        gate.set()
+        t0.join(10)
+        for t in ts:
+            t.join(10)
+        # The 4 followers drained together but dispatched per instance.
+        assert sorted(c for p, c in calls) == [2, 4, 4]
+        assert np.all(np.asarray(outs["old0"]) == 10.0)
+        assert np.all(np.asarray(outs["new1"]) == 100.0)
+        cb.close()
+
+    def test_failing_dispatch_fails_exactly_its_group(self):
+        def run(pred, x):
+            if pred == "bad":
+                raise RuntimeError("boom")
+            return x
+
+        cb = ContinuousBatcher(run, max_batch_rows=64)
+        with pytest.raises(RuntimeError, match="boom"):
+            cb.submit(KEY, "bad", np.ones((1, 1), np.float32))
+        out = cb.submit(KEY, "good", np.ones((1, 1), np.float32))
+        assert np.all(np.asarray(out) == 1.0)  # lane survived
+        cb.close()
+
+    def test_bounded_rows_reject(self):
+        gate = threading.Event()
+
+        def run(pred, x):
+            gate.wait(5)
+            return x
+
+        cb = ContinuousBatcher(run, max_batch_rows=4, max_queue_rows=8)
+        t = threading.Thread(
+            target=lambda: cb.submit(KEY, "P", np.ones((4, 1), np.float32))
+        )
+        t.start()
+        time.sleep(0.1)
+        t2 = threading.Thread(
+            target=lambda: cb.submit(KEY, "P", np.ones((8, 1), np.float32))
+        )
+        t2.start()
+        time.sleep(0.1)
+        with pytest.raises(RuntimeError, match="queue full"):
+            cb.submit(KEY, "P", np.ones((4, 1), np.float32))
+        assert cb.metrics()["rejected"] == 1
+        gate.set()
+        t.join(10)
+        t2.join(10)
+        cb.close()
+
+    def test_lane_bound_rejects_new_keys(self):
+        cb = ContinuousBatcher(lambda p, x: x, max_lanes=2)
+        cb.submit(("a", "1"), "P", np.ones((1, 1), np.float32))
+        cb.submit(("a", "2"), "P", np.ones((1, 1), np.float32))
+        with pytest.raises(RuntimeError, match="lane"):
+            cb.submit(("a", "3"), "P", np.ones((1, 1), np.float32))
+        cb.close()
+
+    def test_rejected_submit_never_leaks_a_lane(self):
+        """A full-queue rejection for a NEW key must not open (and
+        permanently pin) an empty lane: lanes leaked on rejection count
+        against max_lanes forever and park a thread each."""
+        gate = threading.Event()
+
+        def run(pred, x):
+            gate.wait(5)
+            return x
+
+        cb = ContinuousBatcher(
+            run, max_batch_rows=4, max_queue_rows=8, max_lanes=8
+        )
+        t = threading.Thread(
+            target=lambda: cb.submit(KEY, "P", np.ones((4, 1), np.float32))
+        )
+        t.start()
+        time.sleep(0.1)
+        t2 = threading.Thread(
+            target=lambda: cb.submit(KEY, "P", np.ones((8, 1), np.float32))
+        )
+        t2.start()
+        time.sleep(0.1)
+        for i in range(3):  # queue full: new keys rejected, no lane
+            with pytest.raises(RuntimeError, match="queue full"):
+                cb.submit(("a", str(i)), "P", np.ones((4, 1), np.float32))
+        assert cb.metrics()["lanes"] == 1, "rejections leaked lanes"
+        gate.set()
+        t.join(10)
+        t2.join(10)
+        out = cb.submit(("a", "0"), "P", np.full((1, 1), 2.0, np.float32))
+        assert np.all(np.asarray(out) == 2.0)  # key usable after drain
+        cb.close()
+
+    def test_idle_lane_retires_itself(self):
+        """The lane table self-heals: a lane idle past lane_idle_s with
+        an empty queue retires without any upstream eviction, so 'no
+        free dispatch lane ... retry shortly' is an honest promise."""
+        cb = ContinuousBatcher(lambda p, x: x, lane_idle_s=0.15)
+        cb.submit(KEY, "P", np.ones((1, 1), np.float32))
+        assert cb.metrics()["lanes"] == 1
+        for _ in range(100):
+            if cb.metrics()["lanes"] == 0:
+                break
+            time.sleep(0.02)
+        assert cb.metrics()["lanes"] == 0, "idle lane never retired"
+        out = cb.submit(KEY, "P", np.full((1, 1), 5.0, np.float32))
+        assert np.all(np.asarray(out) == 5.0)  # fresh lane, same key
+        cb.close()
+
+    def test_close_lane_retires_then_reopens(self):
+        cb = ContinuousBatcher(lambda p, x: x)
+        cb.submit(KEY, "P", np.ones((1, 1), np.float32))
+        assert cb.metrics()["lanes"] == 1
+        cb.close_lane(KEY)
+        for _ in range(100):
+            if cb.metrics()["lanes"] == 0:
+                break
+            time.sleep(0.01)
+        assert cb.metrics()["lanes"] == 0
+        out = cb.submit(KEY, "P", np.full((1, 1), 3.0, np.float32))
+        assert np.all(np.asarray(out) == 3.0)  # fresh lane, same key
+        cb.close()
+
+
+class TestAsyncServerEndToEnd:
+    def test_predict_roundtrip_and_trace_echo(self):
+        srv = _server(StubPredictor(scale=2.0))
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            status, out = _post(
+                base, {**SPEC, "columns": {"x": [1, 2, 3]}},
+                headers={"X-Trace-Id": "drill-42"},
+            )
+            assert status == 200
+            assert out["predictions"] == [2.0, 4.0, 6.0]
+            assert out["count"] == 3
+            assert out["trace_id"] == "drill-42"
+        finally:
+            srv.shutdown()
+
+    def test_request_shaped_errors_are_400(self):
+        srv = _server(StubPredictor())
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            assert _post(base, {"columns": {"x": [1]}})[0] == 400
+            assert _post(base, {**SPEC})[0] == 400  # no data/columns
+        finally:
+            srv.shutdown()
+
+    def test_oversized_body_answers_413(self):
+        """A body past the cap gets an HTTP answer it can act on, not a
+        bare connection reset (no payload is actually sent — the
+        Content-Length alone is rejected)."""
+        import socket as socket_mod
+
+        srv = _server(StubPredictor())
+        try:
+            with socket_mod.create_connection(
+                ("127.0.0.1", srv.port), timeout=10
+            ) as s:
+                s.sendall(
+                    b"POST /predict HTTP/1.1\r\n"
+                    b"Content-Length: 999999999999\r\n\r\n"
+                )
+                resp = s.recv(65536).decode()
+            assert resp.startswith("HTTP/1.1 413"), resp[:80]
+            assert "cap" in resp
+        finally:
+            srv.shutdown()
+
+    def test_keepalive_connection_reuse(self):
+        # urllib sends Connection: close; drive keep-alive raw instead.
+        import http.client
+
+        srv = _server(StubPredictor())
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+            for i in range(3):
+                conn.request(
+                    "POST", "/predict",
+                    body=json.dumps({**SPEC, "columns": {"x": [i]}}),
+                    headers={"Content-Type": "application/json"},
+                )
+                r = conn.getresponse()
+                assert r.status == 200
+                assert json.loads(r.read())["count"] == 1
+            conn.close()
+        finally:
+            srv.shutdown()
+
+    def test_health_and_metrics_schema(self):
+        srv = _server(StubPredictor())
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            _post(base, {**SPEC, "columns": {"x": [1]}})
+            status, health = _get(base, "/healthz")
+            assert status == 200 and health["status"] == "ok"
+            status, m = _get(base, "/metrics")
+            assert set(m) == {"jobs", "predict", "serving", "uptime_s"}
+            assert m["serving"]["admitted"] == 1
+            assert m["predict"]["batching"]["mode"] == "continuous"
+            with urllib.request.urlopen(
+                base + "/metrics?format=prometheus", timeout=10
+            ) as r:
+                text = r.read().decode()
+            for family in (
+                "tpuflow_serving_admitted_total",
+                "tpuflow_serving_shed_total",
+                "tpuflow_serving_inflight_requests",
+                "tpuflow_predict_batch_queue_depth_rows",
+                "tpuflow_predict_batch_inflight_dispatches",
+            ):
+                assert family in text, family
+        finally:
+            srv.shutdown()
+
+    def test_degraded_stub_answers_unbatched(self):
+        stub = StubPredictor()
+        stub.degraded = True
+        stub.reason = "checkpoint gone"
+        srv = _server(stub)
+        # A seeded degraded entry needs its TTL stamp, or the cache
+        # treats it as an expired fallback and re-probes the (absent)
+        # artifact.
+        srv.service._degraded_at[KEY] = time.monotonic()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            status, out = _post(base, {**SPEC, "columns": {"x": [1.0]}})
+            assert status == 200
+            assert out["degraded"] is True and out["fallback"] == "gilbert"
+        finally:
+            srv.shutdown()
+
+
+class TestLoadShedding:
+    """The tier-1 flood drill (ISSUE 8 acceptance): way-over-capacity
+    offered load → the daemon stays responsive, sheds with the right
+    codes, keeps every queue bounded, and its gauges say so."""
+
+    def test_flood_sheds_503_and_stays_responsive(self):
+        stub = StubPredictor(delay_s=0.05)
+        srv = _server(stub, max_inflight=8)
+        base = f"http://127.0.0.1:{srv.port}"
+        spec = {**SPEC, "columns": {"x": [1.0, 2.0]}}
+        statuses: list[int] = []
+        lock = threading.Lock()
+
+        def client():
+            for _ in range(4):
+                s, _out = _post(base, spec)
+                with lock:
+                    statuses.append(s)
+
+        try:
+            _post(base, spec)  # warm: lane + cache resolved
+            threads = [
+                threading.Thread(target=client) for _ in range(32)
+            ]
+            for t in threads:
+                t.start()
+            # Mid-flood: liveness answers fast (the event loop is not
+            # wedged behind the backlog) and the admission gauge never
+            # exceeds its bound.
+            time.sleep(0.1)
+            t0 = time.perf_counter()
+            status, health = _get(base, "/healthz", timeout=5)
+            assert status == 200
+            assert time.perf_counter() - t0 < 2.0
+            status, m = _get(base, "/metrics", timeout=5)
+            assert m["serving"]["inflight"] <= 8
+            for t in threads:
+                t.join(60)
+            counts = {s: statuses.count(s) for s in set(statuses)}
+            assert set(counts) <= {200, 503}, counts
+            assert counts.get(200, 0) > 0, counts
+            assert counts.get(503, 0) > 0, counts  # real shedding happened
+            status, m = _get(base, "/metrics")
+            assert m["serving"]["shed_503"] == counts[503]
+            assert m["serving"]["shed_429"] == 0
+            assert m["serving"]["inflight"] == 0
+            # Bounded memory: the batcher's high-water mark respected
+            # its row bound and the admission bound capped the house.
+            assert (
+                m["predict"]["batching"]["max_queue_depth_rows"]
+                <= srv.service.batcher.max_queue_rows
+            )
+            assert m["serving"]["admitted"] == counts.get(200, 0) + 1
+        finally:
+            srv.shutdown()
+
+    def test_quota_sheds_429_for_the_noisy_client_only(self):
+        srv = _server(StubPredictor(), quota_rps=1.0, quota_burst=2.0)
+        base = f"http://127.0.0.1:{srv.port}"
+        spec = {**SPEC, "columns": {"x": [1.0]}}
+        try:
+            noisy = [
+                _post(base, spec, headers={"X-Client-Id": "noisy"})[0]
+                for _ in range(6)
+            ]
+            assert noisy.count(429) >= 3, noisy  # burst 2, then shed
+            assert noisy.count(200) >= 1
+            polite, _ = _post(
+                base, spec, headers={"X-Client-Id": "polite"}
+            )
+            assert polite == 200  # quotas are per client, not global
+            _status, m = _get(base, "/metrics")
+            assert m["serving"]["shed_429"] == noisy.count(429)
+        finally:
+            srv.shutdown()
+
+    def test_deadline_expired_sheds_504_without_dispatching(self):
+        stub = StubPredictor(delay_s=0.3)
+        srv = _server(stub)
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            _post(base, {**SPEC, "columns": {"x": [1.0]}})  # warm
+            stub.forward_calls.clear()
+            blocker = threading.Thread(
+                target=_post, args=(base, {**SPEC, "columns": {"x": [1.0]}})
+            )
+            blocker.start()
+            time.sleep(0.1)  # the lane is now mid-dispatch
+            status, out = _post(
+                base,
+                {**SPEC, "columns": {"x": [7.0]}, "deadlineMs": 50},
+            )
+            blocker.join(30)
+            assert status == 504, out
+            assert out["shed"] == "deadline"
+            # The expired request's row never reached the device: only
+            # the blocker's single row was ever dispatched.
+            assert sum(stub.forward_calls) == 1, stub.forward_calls
+            _status, m = _get(base, "/metrics")
+            assert m["serving"]["shed_504"] == 1
+            assert m["predict"]["batching"]["expired"] == 1
+        finally:
+            srv.shutdown()
+
+    def test_wedged_dispatch_times_out_and_frees_the_admission_slot(self):
+        """A dispatch that never answers must NOT park its request (and
+        admission slot) forever: the async path keeps the threaded
+        path's submit_timeout wedge guard — the caller gets a 500 and
+        inflight returns to zero."""
+        stub = StubPredictor(delay_s=1.2)  # longer than the guard below
+        srv = _server(stub)
+        srv.service.batcher.submit_timeout = 0.25
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            t0 = time.perf_counter()
+            status, out = _post(base, {**SPEC, "columns": {"x": [1.0]}})
+            assert status == 500, out
+            assert "wedged" in out["error"]
+            assert time.perf_counter() - t0 < 1.0  # didn't wait it out
+            _status, m = _get(base, "/metrics")
+            assert m["serving"]["inflight"] == 0  # slot released
+        finally:
+            time.sleep(1.2)  # let the stub's dispatch drain
+            srv.shutdown()
+
+    def test_injected_micro_mode_service_still_coalesces(self):
+        """The embedding path: AsyncServer(service=...) with the micro
+        (wait-timer) engine — the server must fall back to blocking
+        submits on the executor, not AttributeError on .enqueue."""
+        svc = PredictService(
+            batch_predicts=True, batch_mode="micro", warmup_buckets=0
+        )
+        stub = StubPredictor(scale=2.0)
+        svc._cache[KEY] = stub
+        srv = AsyncServer(
+            "127.0.0.1", 0, service=svc, enable_jobs=False
+        ).start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            status, out = _post(base, {**SPEC, "columns": {"x": [3.0]}})
+            assert status == 200, out
+            assert out["predictions"] == [6.0]
+            _status, m = _get(base, "/metrics")
+            assert m["predict"]["batching"]["mode"] == "micro"
+        finally:
+            srv.shutdown()
+
+    def test_hedge_beats_a_straggling_dispatch(self):
+        """The point of hedging: a STRAGGLING (not failing) dispatch no
+        longer defines the tail. The hedge runs outside the lane — a
+        hedge queued behind the straggler in the same lane could never
+        win — so the request answers at ~hedge_ms, not straggler time."""
+        calls: list[int] = []
+        lock = threading.Lock()
+
+        class Straggler(StubPredictor):
+            def forward_prepared(self, x, batch_size: int = 4096):
+                with lock:
+                    i = len(calls)
+                    calls.append(i)
+                if i == 0:
+                    time.sleep(0.8)  # the cold-compile/GC straggler
+                self.forward_calls.append(len(x))
+                return x[:, 0] * self.scale
+
+        srv = _server(Straggler(scale=2.0), hedge_ms=50.0)
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            t0 = time.perf_counter()
+            status, out = _post(base, {**SPEC, "columns": {"x": [2.0]}})
+            took = time.perf_counter() - t0
+            assert status == 200, out
+            assert out["predictions"] == [4.0]
+            assert took < 0.6, f"hedge never won ({took:.2f}s)"
+            _status, m = _get(base, "/metrics")
+            assert m["serving"]["hedges"] >= 1
+            assert m["serving"]["hedge_wins"] >= 1
+        finally:
+            time.sleep(0.8)  # let the straggling dispatch drain
+            srv.shutdown()
+
+    def test_hedged_redispatch_recovers_a_failed_dispatch(self):
+        stub = StubPredictor()
+        stub.fail_next = 1  # first dispatch fails, its hedge succeeds
+        srv = _server(stub, hedge_ms=1.0)
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            # The failing dispatch resolves (error) before the hedge
+            # window on a fast path would — hold the lane busy first so
+            # the hedge timer actually fires while the original waits.
+            stub.delay_s = 0.15
+            status, out = _post(base, {**SPEC, "columns": {"x": [3.0]}})
+            assert status == 200, out
+            assert out["predictions"] == [3.0]
+            _status, m = _get(base, "/metrics")
+            assert m["serving"]["hedges"] >= 1
+            assert m["serving"]["hedge_wins"] >= 1
+        finally:
+            srv.shutdown()
+
+
+class TestPlacementPolicy:
+    def test_lru_spill_past_max_resident(self, monkeypatch):
+        loads = []
+
+        @classmethod
+        def fake_load(cls, storage, name, donate_forward=False):
+            loads.append((storage, name))
+            return StubPredictor()
+
+        from tpuflow.api.predict_api import Predictor
+
+        monkeypatch.setattr(Predictor, "load", fake_load)
+        svc = PredictService(
+            batch_predicts=True, batch_mode="continuous", max_resident=2
+        )
+        for name in ("a", "b", "c"):
+            svc.predict({
+                "storagePath": "/arts", "model": name,
+                "columns": {"x": [1.0]},
+            })
+        m = svc.metrics()
+        assert m["loads"] == 3
+        assert m["spills"] == 1  # 'a' spilled when 'c' loaded
+        assert len(svc._cache) == 2
+        assert ("/arts", "a") not in svc._cache
+        # The per-key bookkeeping is bounded too: a spill prunes the
+        # key's lock + generation (a rotating long tail must not leak
+        # an entry per artifact ever touched).
+        assert ("/arts", "a") not in svc._key_locks
+        # The spilled artifact re-loads on return — and its retired
+        # dispatch lane reopens.
+        svc.predict({
+            "storagePath": "/arts", "model": "a", "columns": {"x": [1.0]},
+        })
+        assert svc.metrics()["loads"] == 4
+        assert loads.count(("/arts", "a")) == 2
+        svc.close()
+
+    def test_spill_closes_the_lane(self, monkeypatch):
+        @classmethod
+        def fake_load(cls, storage, name, donate_forward=False):
+            return StubPredictor()
+
+        from tpuflow.api.predict_api import Predictor
+
+        monkeypatch.setattr(Predictor, "load", fake_load)
+        svc = PredictService(
+            batch_predicts=True, batch_mode="continuous", max_resident=1
+        )
+        svc.predict({
+            "storagePath": "/arts", "model": "a", "columns": {"x": [1.0]},
+        })
+        assert svc.batcher.metrics()["lanes"] == 1
+        svc.predict({
+            "storagePath": "/arts", "model": "b", "columns": {"x": [1.0]},
+        })
+        # a's lane retires (asynchronously) after the spill.
+        for _ in range(100):
+            lanes = svc.batcher.metrics()["lanes"]
+            if lanes == 1:
+                break
+            time.sleep(0.01)
+        assert svc.batcher.metrics()["lanes"] == 1
+        svc.close()
+
+
+class TestCliDelegation:
+    def test_cli_serve_subcommand_routes_to_async_main(self, monkeypatch):
+        import tpuflow.cli as cli
+        import tpuflow.serve_async as sa
+
+        seen = {}
+        monkeypatch.setattr(
+            sa, "main", lambda argv: (seen.setdefault("argv", argv), 0)[1]
+        )
+        assert cli.main(["serve", "--port", "0"]) == 0
+        assert seen["argv"] == ["--port", "0"]
+
+    def test_cli_serve_threaded_flag_routes_to_threaded_main(
+        self, monkeypatch
+    ):
+        import tpuflow.cli as cli
+        import tpuflow.serve as serve
+
+        seen = {}
+        monkeypatch.setattr(
+            serve, "main", lambda argv: (seen.setdefault("argv", argv), 0)[1]
+        )
+        assert cli.main(["serve", "--threaded", "--port", "0"]) == 0
+        assert seen["argv"] == ["--port", "0"]

@@ -91,6 +91,12 @@ RULES = {
               "tpuflow/parallel/compat.py — these APIs move across jax "
               "releases (the 74-failure make_mesh TypeError family); go "
               "through the compat layer's version-probed wrappers",
+    "TPF009": "blocking call (time.sleep / requests.* / urlopen / open / "
+              "socket.socket) inside an async def: it parks the WHOLE "
+              "event loop — every connection the serving control plane "
+              "owns stalls behind it. Run blocking work on an executor "
+              "(loop.run_in_executor) or use the async equivalent "
+              "(asyncio.sleep); the tpuflow/serve_async.py contract",
 }
 
 _HOST_SYNC_NAMES = {"float", "bool"}
@@ -111,6 +117,22 @@ _POLL_BOUND_WORDS = (
     "deadline", "timeout", "stop", "until", "budget", "give_up",
     "remaining", "expires",
 )
+# TPF009: blocking-call shapes inside ``async def``. Name-call forms
+# (``open(...)``, ``urlopen(...)``), attribute chains matched on their
+# LAST TWO segments (``time.sleep``, ``socket.socket``,
+# ``request.urlopen`` — which also catches the full
+# ``urllib.request.urlopen`` spelling), and any call rooted at a
+# blocking base module (``requests.<anything>``). ``asyncio.sleep``
+# never matches; a blocking call inside a NESTED sync def or lambda is
+# not flagged — that function's callers own its context (the
+# run_in_executor pattern), mirroring TPF007's nested-def rationale.
+_ASYNC_BLOCKING_NAMES = {"open", "urlopen"}
+_ASYNC_BLOCKING_ATTRS = {
+    ("time", "sleep"),
+    ("socket", "socket"),
+    ("request", "urlopen"),
+}
+_ASYNC_BLOCKING_BASES = {"requests"}
 
 
 def _noqa_lines(source: str) -> dict[int, set[str]]:
@@ -167,6 +189,7 @@ class _Linter(ast.NodeVisitor):
         self.jitted_names = _collect_jitted_names(self.tree)
         self.findings: list[Diagnostic] = []
         self._jit_depth = 0
+        self._async_depth = 0
         self._is_compat = path.replace(os.sep, "/").endswith(
             _COMPAT_MODULE_SUFFIX
         )
@@ -195,7 +218,16 @@ class _Linter(ast.NodeVisitor):
         self._check_defaults(node)
         entered = self._jit_depth > 0 or self._is_jitted_def(node)
         self._jit_depth += 1 if entered else 0
+        # TPF009 scope: an ``async def`` body runs on the event loop; a
+        # nested SYNC def does not (its callers choose the thread — the
+        # run_in_executor pattern), so it resets the flag for its body.
+        prev_async = self._async_depth
+        if isinstance(node, ast.AsyncFunctionDef):
+            self._async_depth += 1
+        else:
+            self._async_depth = 0
         self.generic_visit(node)
+        self._async_depth = prev_async
         self._jit_depth -= 1 if entered else 0
 
     visit_FunctionDef = _visit_function
@@ -203,7 +235,11 @@ class _Linter(ast.NodeVisitor):
 
     def visit_Lambda(self, node) -> None:
         self._check_defaults(node)
+        # A lambda's body is deferred like a nested def's: its caller
+        # owns the execution context (TPF009 scope reset).
+        prev_async, self._async_depth = self._async_depth, 0
         self.generic_visit(node)
+        self._async_depth = prev_async
 
     # --- TPF003: mutable defaults ---
 
@@ -425,6 +461,8 @@ class _Linter(ast.NodeVisitor):
 
     def visit_Call(self, node) -> None:
         func = node.func
+        if self._async_depth > 0:
+            self._check_async_blocking(node, func)
         if self._jit_depth > 0:
             if (
                 isinstance(func, ast.Name)
@@ -466,6 +504,33 @@ class _Linter(ast.NodeVisitor):
                     )
         self._check_fault_site(node)
         self.generic_visit(node)
+
+    def _check_async_blocking(self, node: ast.Call, func) -> None:
+        """TPF009: blocking-call shapes under an ``async def``."""
+        if isinstance(func, ast.Name) and func.id in _ASYNC_BLOCKING_NAMES:
+            self._emit("TPF009", node, f"{func.id}(...) in async def")
+            return
+        if isinstance(func, ast.Attribute):
+            # Walk the whole attribute chain so the common dotted
+            # spelling (``urllib.request.urlopen``) matches, not just
+            # two-segment forms.
+            parts: list[str] = []
+            head = func
+            while isinstance(head, ast.Attribute):
+                parts.append(head.attr)
+                head = head.value
+            if not isinstance(head, ast.Name):
+                return
+            parts.append(head.id)
+            parts.reverse()
+            dotted = ".".join(parts)
+            if parts[0] in _ASYNC_BLOCKING_BASES or (
+                len(parts) >= 2
+                and tuple(parts[-2:]) in _ASYNC_BLOCKING_ATTRS
+            ):
+                self._emit(
+                    "TPF009", node, f"{dotted}(...) in async def"
+                )
 
     def _check_fault_site(self, node: ast.Call) -> None:
         func = node.func

@@ -9,6 +9,14 @@ Positional args are the reference's exact four: comma-separated column
 names, comma-separated types (int|float|anything-else=categorical), the
 target column, and the artifact storage path (reference cnn.py:41-44).
 With no positional args, the synthetic well schema is used end-to-end.
+
+Daemon mode: ``python -m tpuflow.cli serve [...]`` launches the async
+serving control plane (``tpuflow/serve_async.py`` — admission control,
+continuous batching, deadlines; docs/serving.md) with the remaining
+args; ``serve --threaded`` launches the legacy threaded front end
+(``tpuflow/serve.py``) instead. The subcommand is intercepted before
+the training parser so the reference's positional contract is
+untouched.
 """
 
 from __future__ import annotations
@@ -125,6 +133,20 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "serve":
+        # Daemon subcommand, intercepted ahead of argparse (the four
+        # reference positionals would swallow "serve" as columnNames).
+        rest = list(argv[1:])
+        if "--threaded" in rest:
+            rest.remove("--threaded")
+            from tpuflow import serve as _serve
+
+            return _serve.main(rest)
+        from tpuflow import serve_async as _serve_async
+
+        return _serve_async.main(rest)
     args = build_parser().parse_args(argv)
     if args.predict:
         return _predict_main(args)

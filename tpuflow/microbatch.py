@@ -1,4 +1,4 @@
-"""Cross-request micro-batching for the serving fast path.
+"""Cross-request batching for the serving fast path: micro + continuous.
 
 The reference lineage's throughput lever is batching: SparkNet and BigDL
 (PAPERS.md) both win by amortizing fixed per-dispatch overhead across
@@ -7,15 +7,27 @@ many rows of work. The serving path had none of it — every ``POST
 N concurrent callers paid N dispatch overheads (and, on first touch, N
 chances at an XLA compile) for work one dispatch could carry.
 
-``MicroBatcher`` is the coalescing seam: requests for the same artifact
-key enqueue their ALREADY feature-transformed row arrays; a single
-dispatcher thread drains a key's queue once ``max_wait_ms`` has passed
-since its oldest entry (or sooner, when ``max_batch_rows`` accumulate),
-concatenates the rows, runs ONE forward through the caller-supplied
-``run_batch`` hook, and scatters the result rows back to the waiting
-callers.
+Two batchers share one coalescing contract:
 
-Correctness constraints the dispatcher enforces (docs/serving.md):
+- ``MicroBatcher`` — the wait-then-dispatch original: a single
+  dispatcher thread drains a key's queue once ``max_wait_ms`` has
+  passed since its oldest entry (or sooner, when ``max_batch_rows``
+  accumulate). Simple, but the timer is a latency floor: every request
+  pays up to ``max_wait_ms`` of deliberate waiting even when the device
+  is idle.
+- ``ContinuousBatcher`` — the async control plane's dispatch engine
+  (docs/serving.md): one dispatch **lane** (thread) per artifact key,
+  double-buffered — while a dispatch is in flight on the device, new
+  rows accumulate in the lane's queue, and the moment the dispatch
+  returns the lane drains EVERYTHING that arrived meanwhile into the
+  next one. No timer: an idle lane dispatches a lone request
+  immediately; a busy lane coalesces exactly as much as the device's
+  own latency allows. Entries may carry a **deadline** (monotonic
+  seconds): a request whose deadline passed while queued is failed with
+  :class:`DeadlineExpired` at drain time and NEVER occupies a dispatch
+  slot — shed load must not also waste device time.
+
+Correctness constraints both dispatchers enforce (docs/serving.md):
 
 - **No stale scatter across a retrain.** Every entry carries the
   predictor INSTANCE it resolved at enqueue time; a drain is grouped by
@@ -113,17 +125,35 @@ class LatencyStats:
         }
 
 
+class DeadlineExpired(RuntimeError):
+    """A request's deadline passed before its dispatch began. Raised to
+    the submitting caller; the request never occupied a dispatch slot."""
+
+
+class QueueFull(RuntimeError):
+    """A bounded-capacity rejection — the row queue or the lane table is
+    full. Capacity shedding, not caller error: HTTP front ends map this
+    to 503 retry-with-backoff semantics (a typed seam, so an unrelated
+    error whose message happens to contain "full" is never misreported
+    as a shed)."""
+
+
 class _Pending:
     """One waiting request: its transformed rows, the predictor instance
     it resolved (the anti-stale-scatter token), the trace ID bound when
     it was submitted (the dispatcher thread has no request context — the
-    ID must ride the entry), and the rendezvous."""
+    ID must ride the entry), an optional deadline (monotonic seconds;
+    expired entries are shed at drain time, never dispatched), and the
+    rendezvous — a threading.Event for blocking callers plus an optional
+    ``on_done`` callback for event-loop callers (the asyncio front end
+    bridges it to a Future instead of parking a thread)."""
 
     __slots__ = (
-        "pred", "x", "event", "result", "error", "t_enqueued", "trace_id"
+        "pred", "x", "event", "result", "error", "t_enqueued", "trace_id",
+        "deadline", "on_done",
     )
 
-    def __init__(self, pred, x):
+    def __init__(self, pred, x, deadline: float | None = None, on_done=None):
         from tpuflow.obs import current_trace_id
 
         self.pred = pred
@@ -133,36 +163,57 @@ class _Pending:
         self.error: BaseException | None = None
         self.t_enqueued = time.monotonic()
         self.trace_id = current_trace_id()
+        self.deadline = deadline
+        self.on_done = on_done
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
+
+    def signal(self) -> None:
+        """Publish result/error: wake the blocking waiter and fire the
+        event-loop callback (guarded — a dead loop must not kill the
+        dispatcher)."""
+        self.event.set()
+        if self.on_done is not None:
+            try:
+                self.on_done(self)
+            except Exception:
+                pass
+
+    def wait(self, timeout: float):
+        """Block until signalled; returns the result or raises the
+        dispatch group's error (the blocking-caller half of the
+        rendezvous, shared by both batchers' ``submit``)."""
+        if not self.event.wait(timeout=timeout):
+            raise RuntimeError(
+                f"predict batch dispatch timed out after "
+                f"{timeout:g}s (dispatcher wedged?)"
+            )
+        if self.error is not None:
+            raise self.error
+        return self.result
 
 
-class MicroBatcher:
-    """Coalesces concurrent ``submit`` calls per artifact key into shared
-    forward dispatches. ``run_batch(pred, x)`` is the one hook: it must
-    return one output row per input row (the service passes the
-    predictor's denormalizing forward)."""
+class _BatcherBase:
+    """Shared substrate of the two batchers: the obs surface (counters,
+    depth gauges, batch-size histogram — one family-name set, so either
+    batcher renders identically into /metrics), the bounded-queue
+    bookkeeping, and the instance-grouped dispatch+scatter. Subclasses
+    own the draining policy — WHEN a dispatch happens and what it
+    takes."""
 
-    def __init__(
-        self,
-        run_batch,
-        max_batch_rows: int = 128,
-        max_wait_ms: float = 2.0,
-        max_queue_rows: int = 8192,
-        submit_timeout: float = 60.0,
-        registry=None,
-    ):
+    def __init__(self, run_batch, max_batch_rows, max_queue_rows,
+                 submit_timeout, registry):
         from tpuflow.obs import DEFAULT_COUNT_BUCKETS, Registry
 
         if max_batch_rows < 1:
             raise ValueError(f"max_batch_rows must be >= 1, got {max_batch_rows}")
-        if max_wait_ms < 0:
-            raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
         self._run_batch = run_batch
         self.max_batch_rows = max_batch_rows
-        self.max_wait_ms = max_wait_ms
         self.max_queue_rows = max_queue_rows
         self.submit_timeout = submit_timeout
-        self._cond = threading.Condition()
-        self._pending: dict[tuple, list[_Pending]] = {}
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
         self._queued_rows = 0
         self._stop = False
         # Registry-backed counters (tpuflow/obs): dispatches = device
@@ -180,11 +231,13 @@ class MicroBatcher:
                 f"predict_batch_{name}_total", help
             )
             for name, help in (
-                ("requests", "requests entering the micro-batch queue"),
+                ("requests", "requests entering the batch queue"),
                 ("rejected", "submissions refused on a full queue"),
                 ("dispatches", "device dispatches made"),
                 ("coalesced_dispatches", "dispatches carrying > 1 request"),
                 ("rows_dispatched", "total rows sent to the device"),
+                ("expired", "requests shed at drain time on a passed "
+                            "deadline (never dispatched)"),
             )
         }
         self._depth_gauge = self.registry.gauge(
@@ -197,6 +250,12 @@ class MicroBatcher:
             "high-water mark of rows waiting to be coalesced",
         )
         self._max_depth = 0
+        self._inflight = 0
+        self._inflight_gauge = self.registry.gauge(
+            "predict_batch_inflight_dispatches",
+            "device dispatches currently executing",
+            fn=lambda: self._inflight,
+        )
         self._size_hist = self.registry.histogram(
             "predict_batch_size",
             "requests coalesced per dispatch",
@@ -205,6 +264,138 @@ class MicroBatcher:
         # Exact requests-per-dispatch tallies for the JSON view (the
         # fixed-bucket registry histogram backs the Prometheus one).
         self._hist: dict[int, int] = {}
+
+    def _admit_locked(self, entry: _Pending, what: str) -> None:
+        """Bounded-queue admission under ``self._cond`` (caller holds
+        it): raises on a closed batcher or a full queue, else counts the
+        entry in."""
+        if self._stop:
+            raise RuntimeError(f"predict {what} is closed")
+        if self._queued_rows + len(entry.x) > self.max_queue_rows:
+            self._counters["rejected"].inc()
+            raise QueueFull(
+                f"predict batch queue full "
+                f"({self._queued_rows} rows pending, max "
+                f"{self.max_queue_rows}); retry shortly"
+            )
+        self._counters["requests"].inc()
+        self._queued_rows += len(entry.x)
+        if self._queued_rows > self._max_depth:
+            self._max_depth = self._queued_rows
+            self._max_depth_gauge.set(self._max_depth)
+
+    def _metrics_locked(self) -> dict:
+        return {
+            "enabled": True,
+            **{
+                name: int(c.value())
+                for name, c in self._counters.items()
+            },
+            "max_queue_depth_rows": self._max_depth,
+            "queue_depth_rows": self._queued_rows,
+            "inflight_dispatches": self._inflight,
+            "batch_size_hist": dict(sorted(self._hist.items())),
+            "max_batch_rows": self.max_batch_rows,
+        }
+
+    def _shed_expired(self, expired: list[_Pending]) -> None:
+        """Fail deadline-expired entries to their callers (outside the
+        lock — signal() may run an event-loop callback). Their rows were
+        already uncounted by the drain; the device never sees them."""
+        for e in expired:
+            waited = time.monotonic() - e.t_enqueued
+            e.error = DeadlineExpired(
+                f"request deadline expired after {waited * 1000:.1f}ms "
+                "in the batch queue (never dispatched)"
+            )
+            e.signal()
+
+    def _dispatch(self, taken: list[_Pending]) -> None:
+        # Group by predictor INSTANCE: entries at one key can straddle a
+        # cache invalidation (retrain mid-flight), and a single forward
+        # mixing old and new params would scatter stale predictions to
+        # whichever side didn't match the batch. One dispatch per
+        # distinct instance, in arrival order.
+        from tpuflow.obs import record_span
+
+        groups: dict[int, list[_Pending]] = {}
+        for e in taken:
+            groups.setdefault(id(e.pred), []).append(e)
+        for group in groups.values():
+            rows = sum(len(e.x) for e in group)
+            t0 = time.perf_counter()
+            failed = False
+            try:
+                # Concatenate inside the try: even a pathological shape
+                # mismatch must fail THIS group, never kill the
+                # dispatcher thread and wedge every later caller.
+                xs = [e.x for e in group]
+                x = np.concatenate(xs, axis=0) if len(xs) > 1 else xs[0]
+                y = np.asarray(self._run_batch(group[0].pred, x))
+                if len(y) != len(x):
+                    raise RuntimeError(
+                        f"batched forward returned {len(y)} rows "
+                        f"for {len(x)} inputs"
+                    )
+                offset = 0
+                for e in group:
+                    n = len(e.x)
+                    e.result = y[offset : offset + n]
+                    offset += n
+            except BaseException as exc:  # scatter the failure, stay alive
+                failed = True
+                for e in group:
+                    e.error = exc
+            finally:
+                with self._cond:
+                    self._counters["dispatches"].inc()
+                    self._counters["rows_dispatched"].inc(rows)
+                    if len(group) > 1:
+                        self._counters["coalesced_dispatches"].inc()
+                    self._size_hist.observe(len(group))
+                    self._hist[len(group)] = self._hist.get(len(group), 0) + 1
+                # The coalesced-dispatch span: every trace ID this device
+                # call answered, so one caller's request is linkable to
+                # the shared dispatch that served it (forensics ring +
+                # any test reading obs.recent_events()).
+                record_span(
+                    "predict.dispatch",
+                    time.perf_counter() - t0,
+                    hot=True,  # per-dispatch rate: the forensics hot ring
+                    requests=len(group),
+                    rows=rows,
+                    ok=not failed,
+                    trace_ids=[
+                        e.trace_id for e in group if e.trace_id
+                    ],
+                )
+                for e in group:
+                    e.signal()
+
+
+class MicroBatcher(_BatcherBase):
+    """Coalesces concurrent ``submit`` calls per artifact key into shared
+    forward dispatches on a ``max_wait_ms`` timer. ``run_batch(pred, x)``
+    is the one hook: it must return one output row per input row (the
+    service passes the predictor's denormalizing forward)."""
+
+    def __init__(
+        self,
+        run_batch,
+        max_batch_rows: int = 128,
+        max_wait_ms: float = 2.0,
+        max_queue_rows: int = 8192,
+        submit_timeout: float = 60.0,
+        registry=None,
+    ):
+        if max_wait_ms < 0:
+            raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
+        super().__init__(
+            run_batch, max_batch_rows, max_queue_rows, submit_timeout,
+            registry,
+        )
+        self.max_wait_ms = max_wait_ms
+        self._pending: dict[tuple, list[_Pending]] = {}
         self._thread = threading.Thread(
             target=self._loop, name="tpuflow-microbatch", daemon=True
         )
@@ -219,30 +410,10 @@ class MicroBatcher:
         failed, and RuntimeError on a full queue or a closed batcher."""
         entry = _Pending(pred, x)
         with self._cond:
-            if self._stop:
-                raise RuntimeError("predict micro-batcher is closed")
-            if self._queued_rows + len(x) > self.max_queue_rows:
-                self._counters["rejected"].inc()
-                raise RuntimeError(
-                    f"predict micro-batch queue full "
-                    f"({self._queued_rows} rows pending, max "
-                    f"{self.max_queue_rows}); retry shortly"
-                )
-            self._counters["requests"].inc()
+            self._admit_locked(entry, "micro-batcher")
             self._pending.setdefault(key, []).append(entry)
-            self._queued_rows += len(x)
-            if self._queued_rows > self._max_depth:
-                self._max_depth = self._queued_rows
-                self._max_depth_gauge.set(self._max_depth)
             self._cond.notify_all()
-        if not entry.event.wait(timeout=self.submit_timeout):
-            raise RuntimeError(
-                f"predict micro-batch dispatch timed out after "
-                f"{self.submit_timeout:g}s (dispatcher wedged?)"
-            )
-        if entry.error is not None:
-            raise entry.error
-        return entry.result
+        return entry.wait(self.submit_timeout)
 
     def metrics(self) -> dict:
         """Counter snapshot under the lock — one consistent view, built
@@ -250,15 +421,8 @@ class MicroBatcher:
         Prometheus view renders the same registry)."""
         with self._cond:
             return {
-                "enabled": True,
-                **{
-                    name: int(c.value())
-                    for name, c in self._counters.items()
-                },
-                "max_queue_depth_rows": self._max_depth,
-                "queue_depth_rows": self._queued_rows,
-                "batch_size_hist": dict(sorted(self._hist.items())),
-                "max_batch_rows": self.max_batch_rows,
+                **self._metrics_locked(),
+                "mode": "micro",
                 "max_wait_ms": self.max_wait_ms,
             }
 
@@ -324,66 +488,234 @@ class MicroBatcher:
                     self._cond.wait(timeout=wait_s)
                     continue
                 taken = self._drain_locked(key)
-            self._dispatch(taken)
-
-    def _dispatch(self, taken: list[_Pending]) -> None:
-        # Group by predictor INSTANCE: entries at one key can straddle a
-        # cache invalidation (retrain mid-flight), and a single forward
-        # mixing old and new params would scatter stale predictions to
-        # whichever side didn't match the batch. One dispatch per
-        # distinct instance, in arrival order.
-        from tpuflow.obs import record_span
-
-        groups: dict[int, list[_Pending]] = {}
-        for e in taken:
-            groups.setdefault(id(e.pred), []).append(e)
-        for group in groups.values():
-            rows = sum(len(e.x) for e in group)
-            t0 = time.perf_counter()
-            failed = False
+                self._inflight += 1
             try:
-                # Concatenate inside the try: even a pathological shape
-                # mismatch must fail THIS group, never kill the
-                # dispatcher thread and wedge every later caller.
-                xs = [e.x for e in group]
-                x = np.concatenate(xs, axis=0) if len(xs) > 1 else xs[0]
-                y = np.asarray(self._run_batch(group[0].pred, x))
-                if len(y) != len(x):
-                    raise RuntimeError(
-                        f"micro-batch forward returned {len(y)} rows "
-                        f"for {len(x)} inputs"
-                    )
-                offset = 0
-                for e in group:
-                    n = len(e.x)
-                    e.result = y[offset : offset + n]
-                    offset += n
-            except BaseException as exc:  # scatter the failure, stay alive
-                failed = True
-                for e in group:
-                    e.error = exc
+                self._dispatch(taken)
             finally:
                 with self._cond:
-                    self._counters["dispatches"].inc()
-                    self._counters["rows_dispatched"].inc(rows)
-                    if len(group) > 1:
-                        self._counters["coalesced_dispatches"].inc()
-                    self._size_hist.observe(len(group))
-                    self._hist[len(group)] = self._hist.get(len(group), 0) + 1
-                # The coalesced-dispatch span: every trace ID this device
-                # call answered, so one caller's request is linkable to
-                # the shared dispatch that served it (forensics ring +
-                # any test reading obs.recent_events()).
-                record_span(
-                    "predict.dispatch",
-                    time.perf_counter() - t0,
-                    hot=True,  # per-dispatch rate: the forensics hot ring
-                    requests=len(group),
-                    rows=rows,
-                    ok=not failed,
-                    trace_ids=[
-                        e.trace_id for e in group if e.trace_id
-                    ],
+                    self._inflight -= 1
+
+
+class _Lane:
+    """One artifact's dispatch lane: its queue of pending entries, the
+    thread that drives its double-buffered dispatch loop, and a
+    per-lane condition (sharing the batcher's one lock, so every
+    invariant still holds under it) — an enqueue wakes exactly the
+    lane it fed, not every resident lane."""
+
+    __slots__ = ("entries", "thread", "closing", "cond")
+
+    def __init__(self, lock: threading.Lock):
+        self.entries: list[_Pending] = []
+        self.thread: threading.Thread | None = None
+        self.closing = False
+        self.cond = threading.Condition(lock)
+
+
+class ContinuousBatcher(_BatcherBase):
+    """Continuous (double-buffered) batching: one dispatch lane per
+    artifact key. A lane dispatches the moment it is free and its queue
+    is non-empty — no ``max_wait_ms`` timer — so rows that arrive while
+    a device dispatch is in flight are admitted into the NEXT dispatch
+    the instant the previous one returns. Lone requests on an idle lane
+    ship immediately (no deliberate latency floor); coalescing emerges
+    exactly when the device is the bottleneck, which is the only time it
+    helps.
+
+    Deadlines: ``submit``/``enqueue`` accept a monotonic ``deadline``;
+    entries whose deadline passed while queued are failed with
+    :class:`DeadlineExpired` at drain time and never occupy a dispatch
+    slot (counted by ``predict_batch_expired_total``).
+
+    Lanes are bounded (``max_lanes``): past that many distinct artifact
+    keys, submissions for NEW keys are refused — the thread-count
+    analogue of the bounded row queue. ``close_lane(key)`` retires one
+    lane (the LRU-spill hook: the service closes an artifact's lane when
+    it evicts the artifact); its queued entries still drain first. A
+    lane idle for ``lane_idle_s`` with an empty queue retires ITSELF —
+    the table self-heals without an eviction policy upstream, so a
+    long-tail of once-touched artifacts can never pin all ``max_lanes``
+    slots (and their parked threads) forever.
+    """
+
+    def __init__(
+        self,
+        run_batch,
+        max_batch_rows: int = 256,
+        max_queue_rows: int = 8192,
+        max_lanes: int = 32,
+        lane_idle_s: float = 60.0,
+        submit_timeout: float = 60.0,
+        registry=None,
+    ):
+        super().__init__(
+            run_batch, max_batch_rows, max_queue_rows, submit_timeout,
+            registry,
+        )
+        if max_lanes < 1:
+            raise ValueError(f"max_lanes must be >= 1, got {max_lanes}")
+        if lane_idle_s <= 0:
+            raise ValueError(f"lane_idle_s must be > 0, got {lane_idle_s}")
+        self.max_lanes = max_lanes
+        self.lane_idle_s = lane_idle_s
+        self._lanes: dict[tuple, _Lane] = {}
+        self._lanes_gauge = self.registry.gauge(
+            "predict_batch_lanes",
+            "artifact dispatch lanes currently resident",
+            fn=lambda: len(self._lanes),
+        )
+
+    # ---- caller side ----
+
+    def enqueue(
+        self, key: tuple, pred, x, deadline: float | None = None,
+        on_done=None,
+    ) -> _Pending:
+        """Admit one request into ``key``'s lane without blocking on the
+        result: returns the entry, whose ``event`` fires (and ``on_done``
+        runs) when the dispatch scatters back. The asyncio front end's
+        seam — it bridges ``on_done`` to a Future instead of parking an
+        event-loop thread. Raises RuntimeError when the row queue or the
+        lane table is full (load shedding, not backlog)."""
+        entry = _Pending(pred, x, deadline=deadline, on_done=on_done)
+        with self._cond:
+            lane = self._lanes.get(key)
+            # A closing lane's key reuses its table slot, so only a
+            # genuinely NEW key can overflow the table. "retry shortly"
+            # is honest: idle lanes retire after lane_idle_s.
+            if lane is None and len(self._lanes) >= self.max_lanes:
+                self._counters["rejected"].inc()
+                raise QueueFull(
+                    f"no free dispatch lane ({len(self._lanes)} "
+                    f"artifact lanes resident, max {self.max_lanes}); "
+                    "retry shortly"
                 )
-                for e in group:
-                    e.event.set()
+            # Admit BEFORE opening a lane: a full-queue rejection must
+            # not leak an empty lane (+ its parked thread) that counts
+            # against max_lanes forever.
+            self._admit_locked(entry, "continuous batcher")
+            if lane is None or lane.closing:
+                lane = self._open_lane_locked(key)
+            lane.entries.append(entry)
+            # Wake only THIS lane's thread: notify_all on the shared
+            # condition is O(resident lanes) context switches per
+            # request — on the exact path whose p99 this module exists
+            # to protect.
+            lane.cond.notify()
+        return entry
+
+    def submit(
+        self, key: tuple, pred, x, deadline: float | None = None
+    ) -> np.ndarray:
+        """Blocking enqueue-and-wait (the MicroBatcher-compatible shape
+        PredictService calls). Raises the dispatch group's exception,
+        :class:`DeadlineExpired` on a shed deadline, and RuntimeError on
+        a full queue or a closed batcher."""
+        return self.enqueue(key, pred, x, deadline=deadline).wait(
+            self.submit_timeout
+        )
+
+    def metrics(self) -> dict:
+        with self._cond:
+            return {
+                **self._metrics_locked(),
+                "mode": "continuous",
+                "lanes": len(self._lanes),
+            }
+
+    def close_lane(self, key: tuple) -> None:
+        """Retire one artifact's lane (after the service evicts the
+        artifact): queued entries still drain, then the thread exits.
+        A later submit for the same key opens a fresh lane."""
+        with self._cond:
+            lane = self._lanes.get(key)
+            if lane is not None:
+                lane.closing = True
+                lane.cond.notify_all()
+
+    def close(self) -> None:
+        """Stop every lane; queued entries are drained first so no
+        in-flight caller is abandoned mid-wait."""
+        with self._cond:
+            self._stop = True
+            threads = []
+            for lane in self._lanes.values():
+                lane.cond.notify_all()
+                if lane.thread is not None:
+                    threads.append(lane.thread)
+        for t in threads:
+            t.join(timeout=10)
+
+    # ---- lane side ----
+
+    def _open_lane_locked(self, key: tuple) -> _Lane:
+        lane = _Lane(self._lock)
+        lane.thread = threading.Thread(
+            target=self._lane_loop, args=(key, lane),
+            name=f"tpuflow-lane-{'/'.join(str(k) for k in key)}"[:48],
+            daemon=True,
+        )
+        self._lanes[key] = lane
+        lane.thread.start()
+        return lane
+
+    def _drain_lane_locked(
+        self, lane: _Lane, now: float
+    ) -> tuple[list[_Pending], list[_Pending]]:
+        """Take up to ``max_batch_rows`` live rows (leaving the rest
+        queued, original enqueue order) plus EVERY expired entry seen on
+        the way — expired entries are uncounted here and never reach a
+        dispatch."""
+        taken: list[_Pending] = []
+        expired: list[_Pending] = []
+        rows = 0
+        while lane.entries and rows < self.max_batch_rows:
+            e = lane.entries[0]
+            if e.expired(now):
+                lane.entries.pop(0)
+                self._queued_rows -= len(e.x)
+                self._counters["expired"].inc()
+                expired.append(e)
+                continue
+            if taken and rows + len(e.x) > self.max_batch_rows:
+                break  # keep the lone-oversize-request case dispatchable
+            lane.entries.pop(0)
+            self._queued_rows -= len(e.x)
+            taken.append(e)
+            rows += len(e.x)
+        return taken, expired
+
+    def _lane_loop(self, key: tuple, lane: _Lane) -> None:
+        while True:
+            with self._cond:
+                while not lane.entries and not (lane.closing or self._stop):
+                    notified = lane.cond.wait(timeout=self.lane_idle_s)
+                    if not notified and not lane.entries and not (
+                        lane.closing or self._stop
+                    ):
+                        # Idle past lane_idle_s with nothing queued:
+                        # retire (under the lock, so no enqueue can be
+                        # appending concurrently). The next submit for
+                        # this key opens a fresh lane.
+                        if self._lanes.get(key) is lane:
+                            del self._lanes[key]
+                        return
+                if not lane.entries and (lane.closing or self._stop):
+                    # Drained and retiring: drop the table entry only if
+                    # it is still OURS (a fresh lane may have replaced a
+                    # closing one under the same key).
+                    if self._lanes.get(key) is lane:
+                        del self._lanes[key]
+                    return
+                taken, expired = self._drain_lane_locked(
+                    lane, time.monotonic()
+                )
+                if taken:
+                    self._inflight += 1
+            self._shed_expired(expired)
+            if taken:
+                try:
+                    self._dispatch(taken)
+                finally:
+                    with self._cond:
+                        self._inflight -= 1

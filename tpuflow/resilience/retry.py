@@ -138,35 +138,12 @@ def retryable(policy: RetryPolicy):
     return wrap
 
 
-def _env_number(name: str, default: float, *, cast, minimum, form: str):
-    """One validated ``TPUFLOW_RETRY_*`` read. A typo'd or negative
-    value raises a ValueError naming the env var and the expected form
-    (the ``TPUFLOW_FAULTS`` precedent: this error surfaces deep inside
-    whatever I/O path built the policy, far from where the operator
-    exported the variable — it must say exactly what to fix). The old
-    behavior silently clamped/crashed with a bare float() traceback."""
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        value = cast(raw)
-    except (TypeError, ValueError):
-        raise ValueError(
-            f"invalid {name}={raw!r}: expected {form}"
-        ) from None
-    import math
-
-    if not math.isfinite(value):
-        # 'nan' survives the < comparison below and 'inf' would sleep
-        # forever — both are exactly the far-from-the-shell breakage
-        # this validation exists to prevent.
-        raise ValueError(f"invalid {name}={raw!r}: expected {form}")
-    if value < minimum:
-        raise ValueError(
-            f"invalid {name}={raw!r}: expected {form}, got a value below "
-            f"{minimum}"
-        )
-    return value
+# One validated ``TPUFLOW_RETRY_*`` read (the ``TPUFLOW_FAULTS``
+# precedent: the error surfaces deep inside whatever I/O path built the
+# policy, far from the shell that exported the variable, so it must say
+# exactly what to fix). The implementation is shared with the
+# ``TPUFLOW_SERVE_*`` family — tpuflow/utils/env.py is the one copy.
+from tpuflow.utils.env import env_number as _env_number  # noqa: E402
 
 
 def io_policy() -> RetryPolicy:

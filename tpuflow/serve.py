@@ -107,6 +107,7 @@ import threading
 import uuid
 from dataclasses import fields as dataclass_fields
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from time import monotonic as _monotonic
 
 from tpuflow.utils.paths import join_path, open_file
 
@@ -962,23 +963,64 @@ def _clean_trace_id(raw: str | None) -> str | None:
     return None
 
 
-def _env_flag(name: str, default: bool) -> bool:
+_FLAG_TRUE = ("1", "true", "yes", "on")
+_FLAG_FALSE = ("0", "false", "no", "off")
+
+
+def env_flag(name: str, default: bool) -> bool:
+    """One validated boolean ``TPUFLOW_SERVE_*`` read. An unrecognized
+    token raises a ValueError naming the variable and the accepted
+    spellings (the ``TPUFLOW_RETRY_*`` fail-loud precedent): a typo'd
+    ``TPUFLOW_SERVE_BATCH=ture`` silently enabling (or worse, silently
+    NOT disabling) the fast path is exactly the far-from-the-shell
+    breakage read-time validation exists to prevent."""
     raw = os.environ.get(name)
     if raw is None or not raw.strip():
         return default
-    return raw.strip().lower() not in ("0", "false", "no", "off")
+    token = raw.strip().lower()
+    if token in _FLAG_TRUE:
+        return True
+    if token in _FLAG_FALSE:
+        return False
+    raise ValueError(
+        f"invalid {name}={raw!r}: expected one of "
+        f"{'/'.join(_FLAG_TRUE)} or {'/'.join(_FLAG_FALSE)}"
+    )
 
 
-def _env_num(name: str, default, cast):
+def env_num(name: str, default, cast, *, minimum=0, form: str | None = None):
+    """One validated numeric ``TPUFLOW_SERVE_*`` read — the same
+    fail-loud contract as the ``TPUFLOW_RETRY_*`` family, and literally
+    the same implementation (``tpuflow/utils/env.py``): a non-numeric,
+    non-finite, or below-minimum value raises a ValueError naming the
+    variable and the expected form — the error surfaces wherever the
+    daemon reads its knobs, far from the shell that exported them, so it
+    must say exactly what to fix."""
+    from tpuflow.utils.env import env_number
+
     raw = os.environ.get(name)
     if raw is None or not raw.strip():
         return default
-    try:
-        return cast(raw)
-    except ValueError:
+    if form is None:
+        form = (
+            f"an integer >= {minimum}" if cast is int
+            else f"a number >= {minimum:g}"
+        )
+    return env_number(name, default, cast=cast, minimum=minimum, form=form)
+
+
+def env_choice(name: str, default: str, choices: tuple) -> str:
+    """One validated enum ``TPUFLOW_SERVE_*`` read (same fail-loud
+    contract as :func:`env_num`)."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    token = raw.strip().lower()
+    if token not in choices:
         raise ValueError(
-            f"{name}={raw!r} is not a valid {cast.__name__}"
-        ) from None
+            f"invalid {name}={raw!r}: expected one of {', '.join(choices)}"
+        )
+    return token
 
 
 class PredictService:
@@ -1008,16 +1050,29 @@ class PredictService:
       Degraded (Gilbert) answers are NEVER coalesced into model batches,
       and a retrain mid-flight never scatters stale predictions — the
       batcher groups by predictor instance, not just artifact key.
+    - ``batch_mode`` picks the coalescing engine: ``"micro"`` (the
+      wait-then-dispatch timer) or ``"continuous"`` (per-artifact
+      dispatch lanes, admit-into-next-in-flight-dispatch, deadline
+      shedding — the async control plane's engine; docs/serving.md).
     - ``warmup_buckets=N`` pre-compiles the N largest pow-2 forward
       buckets at artifact load time, so the first requests after a cold
       load or retrain don't each eat an XLA compile.
     - ``donate_forward=True`` donates the input batch buffer to the
       jitted forward (safe on this path: batches are built fresh per
       dispatch and never reused).
+    - ``max_resident=N`` bounds the predictor cache (the multi-artifact
+      placement policy): past N resident artifacts the least-recently-
+      used one is spilled (cache evicted + its dispatch lane retired;
+      ``spills`` counts them) — the next request for it re-loads. 0 =
+      unbounded (the single-artifact workloads' historical behavior).
 
     Knob resolution: explicit argument > env var (``TPUFLOW_SERVE_BATCH``,
-    ``TPUFLOW_SERVE_MAX_BATCH``, ``TPUFLOW_SERVE_MAX_WAIT_MS``,
-    ``TPUFLOW_SERVE_WARMUP``, ``TPUFLOW_SERVE_DONATE``) > default (off).
+    ``TPUFLOW_SERVE_BATCH_MODE``, ``TPUFLOW_SERVE_MAX_BATCH``,
+    ``TPUFLOW_SERVE_MAX_WAIT_MS``, ``TPUFLOW_SERVE_WARMUP``,
+    ``TPUFLOW_SERVE_DONATE``, ``TPUFLOW_SERVE_RESIDENT``) > default
+    (off). Env values are validated at read time — a malformed value
+    raises a ValueError naming the variable and the expected form
+    (:func:`env_num`; the ``TPUFLOW_RETRY_*`` precedent).
     """
 
     def __init__(
@@ -1025,10 +1080,12 @@ class PredictService:
         gilbert_fallback: bool = True,
         degraded_retry_seconds: float = 30.0,
         batch_predicts: bool | None = None,
+        batch_mode: str | None = None,
         batch_max_rows: int | None = None,
         batch_max_wait_ms: float | None = None,
         warmup_buckets: int | None = None,
         donate_forward: bool | None = None,
+        max_resident: int | None = None,
         registry=None,
     ):
         from tpuflow.obs import Registry
@@ -1049,6 +1106,7 @@ class PredictService:
                 ("cache_hits", "predictor cache hits"),
                 ("loads", "artifact loads (successful)"),
                 ("invalidations", "cache evictions after artifact rewrites"),
+                ("spills", "LRU cache evictions past max_resident"),
                 ("degraded_requests", "requests answered by the fallback"),
                 ("fallback_loads", "loads that fell back to Gilbert"),
                 ("warmed_buckets", "forward buckets pre-compiled at load"),
@@ -1064,20 +1122,39 @@ class PredictService:
         self._degraded_at: dict[tuple[str, str], float] = {}
         # ---- fast-path knobs (argument > env > off) ----
         if batch_predicts is None:
-            batch_predicts = _env_flag("TPUFLOW_SERVE_BATCH", False)
+            batch_predicts = env_flag("TPUFLOW_SERVE_BATCH", False)
+        if batch_mode is None:
+            batch_mode = env_choice(
+                "TPUFLOW_SERVE_BATCH_MODE", "micro", ("micro", "continuous")
+            )
+        if batch_mode not in ("micro", "continuous"):
+            raise ValueError(
+                f"batch_mode must be 'micro' or 'continuous', "
+                f"got {batch_mode!r}"
+            )
         if batch_max_rows is None:
-            batch_max_rows = _env_num("TPUFLOW_SERVE_MAX_BATCH", 256, int)
+            batch_max_rows = env_num(
+                "TPUFLOW_SERVE_MAX_BATCH", 256, int, minimum=1
+            )
         if batch_max_wait_ms is None:
-            batch_max_wait_ms = _env_num(
+            batch_max_wait_ms = env_num(
                 "TPUFLOW_SERVE_MAX_WAIT_MS", 2.0, float
             )
         if warmup_buckets is None:
-            warmup_buckets = _env_num("TPUFLOW_SERVE_WARMUP", 0, int)
+            warmup_buckets = env_num("TPUFLOW_SERVE_WARMUP", 0, int)
         if donate_forward is None:
-            donate_forward = _env_flag("TPUFLOW_SERVE_DONATE", False)
+            donate_forward = env_flag("TPUFLOW_SERVE_DONATE", False)
+        if max_resident is None:
+            max_resident = env_num("TPUFLOW_SERVE_RESIDENT", 0, int)
         self.warmup_buckets = int(warmup_buckets)
         self.donate_forward = bool(donate_forward)
         self.batch_max_rows = int(batch_max_rows)
+        self.batch_mode = batch_mode
+        # Placement policy: 0 = unbounded; past the bound the LRU
+        # artifact spills (cache + lane). _last_used is touched on every
+        # hit/load under self._lock.
+        self.max_resident = int(max_resident)
+        self._last_used: dict[tuple[str, str], float] = {}
         from tpuflow.microbatch import LatencyStats
 
         self._latency = LatencyStats()
@@ -1089,7 +1166,24 @@ class PredictService:
             fn=self._latency.summary,
         )
         self._batcher = None
-        if batch_predicts:
+        if batch_predicts and batch_mode == "continuous":
+            from tpuflow.microbatch import ContinuousBatcher
+
+            # Lane bound: at least the residency bound (every resident
+            # artifact must be able to hold a lane), floor 32, operator
+            # override via TPUFLOW_SERVE_MAX_LANES — a deployment with
+            # 40 active artifacts must not shed the last 8 forever.
+            self._batcher = ContinuousBatcher(
+                self._run_forward,
+                max_batch_rows=self.batch_max_rows,
+                max_lanes=env_num(
+                    "TPUFLOW_SERVE_MAX_LANES",
+                    max(32, self.max_resident), int, minimum=1,
+                    form="an integer lane bound >= 1",
+                ),
+                registry=self.registry,
+            )
+        elif batch_predicts:
             from tpuflow.microbatch import MicroBatcher
 
             self._batcher = MicroBatcher(
@@ -1143,8 +1237,54 @@ class PredictService:
             self._cache.pop(key, None)
             self._degraded.pop(key, None)
             self._degraded_at.pop(key, None)
+            self._last_used.pop(key, None)
             self._gen[key] = self._gen.get(key, 0) + 1
             self._counters["invalidations"].inc()
+        self._close_lane(key)
+
+    def _close_lane(self, key: tuple[str, str]) -> None:
+        """Retire an evicted artifact's dispatch lane (continuous mode
+        only — the micro-batcher has one shared dispatcher). In-flight
+        entries still drain; a later request reopens the lane."""
+        if self._batcher is not None and hasattr(self._batcher, "close_lane"):
+            self._batcher.close_lane(key)
+
+    def _spill_lru_locked(self) -> list[tuple[str, str]]:
+        """Evict least-recently-used cache entries past ``max_resident``
+        (caller holds ``self._lock``). Returns the spilled keys so the
+        caller can retire their lanes OUTSIDE the lock. Spills don't
+        bump the invalidation generation — the artifact on disk is
+        unchanged, so a load already in flight for a spilled key may
+        still cache its (current) result."""
+        if self.max_resident <= 0:
+            return []
+        spilled = []
+        while len(self._cache) > self.max_resident:
+            key = min(
+                self._cache, key=lambda k: self._last_used.get(k, 0.0)
+            )
+            self._cache.pop(key, None)
+            self._degraded.pop(key, None)
+            self._degraded_at.pop(key, None)
+            self._last_used.pop(key, None)
+            # Bound the per-key bookkeeping too: a rotating long tail of
+            # once-touched artifacts must not leak a Lock + generation
+            # per key for the process lifetime. A key lock currently
+            # held by an in-flight load stays (with its generation, so
+            # that load's cache-if-unchanged check still works); it is
+            # pruned the next time the key spills idle.
+            # Benign race: a loader that setdefault'd this lock but has
+            # not acquired it yet may end up duplicating a cold load
+            # against a fresh lock — the generation check keeps the
+            # cache consistent either way; a rare wasted load is the
+            # price of the bound.
+            lock = self._key_locks.get(key)
+            if lock is not None and not lock.locked():
+                del self._key_locks[key]
+                self._gen.pop(key, None)
+            self._counters["spills"].inc()
+            spilled.append(key)
+        return spilled
 
     def degraded(self) -> list[dict]:
         """Artifacts currently answering in degraded (Gilbert) mode."""
@@ -1170,6 +1310,11 @@ class PredictService:
                 self._cache.pop(key, None)
                 self._degraded.pop(key, None)
                 self._degraded_at.pop(key, None)
+                # Keep the per-key bookkeeping bounded here too (the
+                # spill/invalidate paths already do): a long tail of
+                # once-degraded artifacts must not pin a timestamp per
+                # key forever.
+                self._last_used.pop(key, None)
                 return None
         return cached
 
@@ -1181,6 +1326,7 @@ class PredictService:
             cached = self._cached_locked(key)
             if cached is not None:
                 self._counters["cache_hits"].inc()
+                self._last_used[key] = _monotonic()
                 return cached
             key_lock = self._key_locks.setdefault(key, threading.Lock())
         # Load under the PER-KEY lock only: a cold (possibly seconds-long
@@ -1191,6 +1337,7 @@ class PredictService:
                 cached = self._cached_locked(key)
                 if cached is not None:
                     self._counters["cache_hits"].inc()
+                    self._last_used[key] = _monotonic()
                     return cached
                 gen = self._gen.get(key, 0)
             try:
@@ -1218,8 +1365,7 @@ class PredictService:
                     f"({reason}); serving DEGRADED (Gilbert baseline)",
                     file=sys.stderr,
                 )
-                import time as _time
-
+                spilled = []
                 with self._lock:
                     self._counters["fallback_loads"].inc()
                     if self._gen.get(key, 0) == gen:
@@ -1229,7 +1375,11 @@ class PredictService:
                         # the two recovery paths.
                         self._cache[key] = loaded
                         self._degraded[key] = reason
-                        self._degraded_at[key] = _time.monotonic()
+                        self._degraded_at[key] = _monotonic()
+                        self._last_used[key] = _monotonic()
+                        spilled = self._spill_lru_locked()
+                for sk in spilled:
+                    self._close_lane(sk)
                 return loaded
             warmed = 0
             if self.warmup_buckets > 0:
@@ -1250,6 +1400,7 @@ class PredictService:
                         f"({type(e).__name__}: {e}); serving without it",
                         file=sys.stderr,
                     )
+            spilled = []
             with self._lock:
                 # ONE acquisition for the counter and the cache insert:
                 # a concurrent metrics() snapshot must never see the
@@ -1261,8 +1412,15 @@ class PredictService:
                 self._counters["warmed_buckets"].inc(warmed)
                 if self._gen.get(key, 0) == gen:
                     self._cache[key] = loaded
+                    self._last_used[key] = _monotonic()
+                    # The placement policy: inserting past max_resident
+                    # spills the LRU artifact(s); their lanes retire
+                    # outside the lock.
+                    spilled = self._spill_lru_locked()
                 # else: the artifact was rewritten mid-load; serve this
                 # request from what was loaded but don't poison the cache.
+            for sk in spilled:
+                self._close_lane(sk)
             return loaded
 
     def predict(self, spec: dict) -> dict:
@@ -1289,7 +1447,17 @@ class PredictService:
             finally:
                 self._latency.record(_time.perf_counter() - t0)
 
-    def _predict(self, spec: dict) -> dict:
+    # ---- the request pipeline, split so the async front end can run
+    # ---- each blocking half on an executor with the coalesced forward
+    # ---- awaited in between (tpuflow/serve_async.py)
+
+    def begin_request(self, spec: dict):
+        """Blocking first half of one /predict: count it, validate the
+        spec shape, resolve the predictor (cache hit, cold load, or
+        Gilbert fallback). Returns ``(key, pred, payload)`` where
+        payload is ``("data", path)`` or ``("columns", {name: array})``;
+        request-shaped errors raise ValueError here, before any batch
+        the request might have joined."""
         import numpy as np
 
         with self._lock:
@@ -1298,32 +1466,48 @@ class PredictService:
         name = spec.get("model") or spec.get("name")
         if not storage or not name:
             raise ValueError("predict needs storagePath and model")
-        pred = self._predictor(storage, name)
-        # Degraded answers are NEVER coalesced into model batches: the
-        # fallback has no jitted forward to share, and mixing physics
-        # rows into a model dispatch would scatter baseline numbers to
-        # callers expecting model predictions. The fallback path is the
-        # plain per-request one, still flagged per response below.
-        coalesce = self._batcher is not None and not getattr(
-            pred, "degraded", False
-        )
         if "data" in spec:
-            if coalesce:
-                y = self._predict_coalesced(
-                    storage, name, pred, pred.columns_from_csv(spec["data"])
-                )
-            else:
-                y = pred.predict_csv(spec["data"])
+            payload = ("data", spec["data"])
         elif "columns" in spec:
-            columns = {
-                k: np.asarray(v) for k, v in spec["columns"].items()
-            }
-            if coalesce:
-                y = self._predict_coalesced(storage, name, pred, columns)
-            else:
-                y = pred.predict_columns(columns)
+            payload = (
+                "columns",
+                {k: np.asarray(v) for k, v in spec["columns"].items()},
+            )
         else:
             raise ValueError("predict needs data (csv path) or columns")
+        pred = self._predictor(storage, name)
+        return (storage, name), pred, payload
+
+    @staticmethod
+    def coalescable(pred) -> bool:
+        """Degraded answers are NEVER coalesced into model batches: the
+        fallback has no jitted forward to share, and mixing physics rows
+        into a model dispatch would scatter baseline numbers to callers
+        expecting model predictions."""
+        return not getattr(pred, "degraded", False)
+
+    @staticmethod
+    def transform_request(pred, payload):
+        """The per-request feature transform (blocking, CPU): raw
+        payload -> model-ready rows for the coalesced forward."""
+        kind, value = payload
+        columns = pred.columns_from_csv(value) if kind == "data" else value
+        x, _ = pred.prepare_columns(columns)
+        return x
+
+    @staticmethod
+    def answer_unbatched(pred, payload):
+        """The per-request path (degraded predictors, batching off):
+        transform + forward in one blocking call."""
+        kind, value = payload
+        if kind == "data":
+            return pred.predict_csv(value)
+        return pred.predict_columns(value)
+
+    def finish_response(self, pred, y) -> dict:
+        """Shape the response dict (+ the degraded honesty flags)."""
+        import numpy as np
+
         y = np.asarray(y)
         out = {"predictions": y.tolist(), "count": int(len(y))}
         if getattr(pred, "degraded", False):
@@ -1336,15 +1520,32 @@ class PredictService:
                 self._counters["degraded_requests"].inc()
         return out
 
-    def _predict_coalesced(self, storage, name, pred, columns):
-        # Transform per-request (request-shaped errors fail HERE, before
-        # the batch), coalesce only the forward. The predictor instance
-        # rides with the entry so a retrain mid-flight can't scatter
-        # another generation's predictions to this caller.
-        x, _ = pred.prepare_columns(columns)
-        if len(x) == 0:
-            return pred.forward_prepared(x)
-        return self._batcher.submit((storage, name), pred, x)
+    @property
+    def batcher(self):
+        """The coalescing engine (None with batching off) — the async
+        front end enqueues into it directly, with deadlines."""
+        return self._batcher
+
+    def record_latency(self, seconds: float) -> None:
+        """Record one request's wall time into the shared reservoir
+        (the async front end's requests must show up in the same
+        ``latency_ms`` percentiles the threaded ones do)."""
+        self._latency.record(seconds)
+
+    def _predict(self, spec: dict) -> dict:
+        key, pred, payload = self.begin_request(spec)
+        if self._batcher is not None and self.coalescable(pred):
+            x = self.transform_request(pred, payload)
+            if len(x) == 0:
+                y = pred.forward_prepared(x)
+            else:
+                # The predictor instance rides with the entry so a
+                # retrain mid-flight can't scatter another generation's
+                # predictions to this caller.
+                y = self._batcher.submit(key, pred, x)
+        else:
+            y = self.answer_unbatched(pred, payload)
+        return self.finish_response(pred, y)
 
 
 def make_server(
@@ -1354,10 +1555,12 @@ def make_server(
     default_timeout: float | None = None,
     journal_path: str | None = None,
     batch_predicts: bool | None = None,
+    batch_mode: str | None = None,
     batch_max_rows: int | None = None,
     batch_max_wait_ms: float | None = None,
     warmup_buckets: int | None = None,
     donate_forward: bool | None = None,
+    max_resident: int | None = None,
 ) -> ThreadingHTTPServer:
     """Build the HTTP server (caller drives serve_forever / shutdown).
 
@@ -1366,6 +1569,7 @@ def make_server(
     ``None`` defers to the ``TPUFLOW_SERVE_*`` env vars, default off."""
     import time as _time
 
+    from tpuflow.microbatch import QueueFull
     from tpuflow.obs import Registry, use_trace
 
     started = _time.monotonic()  # immune to wall-clock steps
@@ -1380,10 +1584,12 @@ def make_server(
     )
     predictor = PredictService(
         batch_predicts=batch_predicts,
+        batch_mode=batch_mode,
         batch_max_rows=batch_max_rows,
         batch_max_wait_ms=batch_max_wait_ms,
         warmup_buckets=warmup_buckets,
         donate_forward=donate_forward,
+        max_resident=max_resident,
         registry=registry,
     )
     # Retraining an artifact this process has served must evict the cached
@@ -1508,6 +1714,14 @@ def make_server(
                         self._send(200, predictor.predict(spec))
                     except ValueError as e:
                         self._send(400, {"error": str(e), "trace_id": tid})
+                    except QueueFull as e:
+                        # Backpressure shed, not a server bug: the same
+                        # 503 retry-with-backoff contract the async
+                        # front end answers (microbatch.QueueFull).
+                        self._send(503, {
+                            "error": str(e), "shed": "queue",
+                            "trace_id": tid,
+                        })
                     except Exception as e:  # missing artifact, bad columns
                         self._send(500, {
                             "error": f"{type(e).__name__}: {e}",

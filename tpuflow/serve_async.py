@@ -1,0 +1,1044 @@
+"""Async serving control plane: admission → continuous batching → dispatch.
+
+The threaded front end (``tpuflow/serve.py``) spends a whole OS thread
+per connection and a deliberate ``max_wait_ms`` timer per coalesced
+dispatch — fine at 16 closed-loop clients, a liability at the "heavy
+traffic from millions of users" scale the north star names (ROADMAP
+item 2; MMLSpark's Spark Serving and BigDL both treat low-latency
+serving as its own concurrency model, PAPERS.md). This module is the
+event-loop replacement: ONE thread parses every connection, admission
+is an explicit bounded resource, and the device is driven by the
+continuous batcher (``tpuflow/microbatch.py``) so coalescing emerges
+from device latency instead of a timer.
+
+The request pipeline (docs/serving.md has the full diagram)::
+
+    accept → parse (non-blocking, event loop)
+           → admission     [token-bucket per client → 429]
+                           [bounded in-flight count  → 503]
+           → prepare       (executor thread: resolve artifact,
+                            per-request feature transform)
+           → enqueue       [deadline attached; full queue/lanes → 503]
+           → dispatch      (ContinuousBatcher lane: double-buffered
+                            device dispatches; expired entries shed —
+                            a dead request never occupies a slot → 504)
+           → respond       (event loop; latency recorded either way)
+
+Load shedding is split by meaning, and the split is load-bearing for
+clients: **429** = YOUR quota (retry after your bucket refills), **503**
+= MY capacity (retry with backoff, any client), **504** = this request's
+deadline passed while it waited (a retry may still make it). All three
+are counted (``serving_shed_total{code=...}``) and the admission
+pressure is visible live (``serving_inflight_requests``, the batcher's
+queue-depth and in-flight-dispatch gauges) in ``GET /metrics`` — JSON
+and Prometheus both, the same registry the threaded daemon renders.
+
+Optional hedged re-dispatch: with ``hedge_ms`` set, a coalesced forward
+that hasn't answered within the hedge window runs a duplicate forward
+on an executor thread — OUTSIDE the artifact's dispatch lane, whose
+single thread is busy running the straggler itself — with the same
+predictor instance and rows, and the first completion wins: the
+classic tail-latency trade (a straggling dispatch behind a cold
+compile or a GC pause no longer defines p99, at the cost of duplicate
+device work). Off by default; ``serving_hedges_total`` /
+``serving_hedge_wins_total`` make the trade observable.
+
+Jobs endpoints (``POST /jobs`` etc.) ride along unchanged: the same
+``JobRunner`` serves them, called on executor threads so its journal
+I/O never stalls the event loop. A deployment that only predicts can
+pass ``enable_jobs=False``.
+
+Knobs resolve argument > env > default, and every ``TPUFLOW_SERVE_*``
+env value is validated at read time with an error naming the variable
+and the expected form (``tpuflow.serve.env_num``; the
+``TPUFLOW_RETRY_*`` precedent): ``TPUFLOW_SERVE_ADMIT_MAX`` (in-flight
+bound, default 256), ``TPUFLOW_SERVE_QUOTA_RPS`` /
+``TPUFLOW_SERVE_QUOTA_BURST`` (per-client token bucket, 0 = off),
+``TPUFLOW_SERVE_DEADLINE_MS`` (default per-request deadline, 0 = off),
+``TPUFLOW_SERVE_HEDGE_MS`` (hedged re-dispatch, 0 = off),
+``TPUFLOW_SERVE_PREP_WORKERS`` (executor width), plus the
+``PredictService`` fast-path family (``TPUFLOW_SERVE_BATCH*``,
+``TPUFLOW_SERVE_RESIDENT``...).
+
+Run: ``python -m tpuflow.serve_async --port 8700`` (or
+``python -m tpuflow.cli serve``); stop with SIGINT/SIGTERM.
+Benchmarked against the threaded front end by
+``benchmarks/bench_serving.py --open-loop`` (Poisson arrivals, hundreds
+of clients; committed numbers in ``benchmarks/serving_results.json``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from tpuflow.microbatch import DeadlineExpired, QueueFull
+from tpuflow.serve import (
+    JobRunner,
+    PredictService,
+    _clean_trace_id,
+    env_choice,
+    env_flag,
+    env_num,
+)
+
+_MAX_HEADERS = 64
+_MAX_BODY = 64 * 1024 * 1024  # a 64MB body cap: parse errors, not OOM
+
+_STATUS_TEXT = {
+    200: "OK", 202: "Accepted", 400: "Bad Request", 404: "Not Found",
+    409: "Conflict", 413: "Payload Too Large", 429: "Too Many Requests",
+    500: "Internal Server Error", 501: "Not Implemented",
+    503: "Service Unavailable", 504: "Gateway Timeout",
+}
+
+
+class _RequestError(ValueError):
+    """A request the HTTP layer itself rejects (malformed line/headers,
+    oversized body): carries the status to answer with before the
+    connection closes — a client over the body cap gets a 413 it can
+    act on, not a bare connection reset."""
+
+    def __init__(self, status: int, msg: str):
+        super().__init__(msg)
+        self.status = status
+
+
+class TokenBuckets:
+    """Per-client token buckets: ``rate`` tokens/s refill up to
+    ``burst``; one token per request. The client table is bounded —
+    past ``max_clients`` the stalest bucket is dropped (it re-admits as
+    full on return, which only ever errs in the client's favor), so an
+    attacker cycling client IDs can't pin memory. ``clock`` is
+    injectable for zero-wall-clock tests.
+
+    Runs entirely on the event-loop thread — no lock. ``rate <= 0``
+    disables quotas (every ``allow`` is True)."""
+
+    def __init__(
+        self, rate: float, burst: float, max_clients: int = 4096,
+        clock=time.monotonic,
+    ):
+        if burst < 1:
+            raise ValueError(f"quota burst must be >= 1, got {burst}")
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.max_clients = max_clients
+        self._clock = clock
+        self._buckets: dict[str, list[float]] = {}  # id -> [tokens, t]
+
+    def allow(self, client: str) -> bool:
+        if self.rate <= 0:
+            return True
+        now = self._clock()
+        bucket = self._buckets.get(client)
+        if bucket is None:
+            if len(self._buckets) >= self.max_clients:
+                stalest = min(
+                    self._buckets, key=lambda c: self._buckets[c][1]
+                )
+                del self._buckets[stalest]
+            bucket = self._buckets[client] = [self.burst, now]
+        tokens = min(self.burst, bucket[0] + (now - bucket[1]) * self.rate)
+        bucket[1] = now
+        if tokens < 1.0:
+            bucket[0] = tokens
+            return False
+        bucket[0] = tokens - 1.0
+        return True
+
+
+class _Admission:
+    """The bounded front door: at most ``max_inflight`` requests past
+    admission at once (parsing done, response not yet written) — the
+    explicit backlog bound every downstream queue inherits — plus the
+    per-client quota gate. Event-loop-thread only; the counters are the
+    observable 429/503 split."""
+
+    def __init__(self, max_inflight: int, buckets: TokenBuckets, registry):
+        self.max_inflight = max_inflight
+        self.buckets = buckets
+        self.inflight = 0
+        self._shed = registry.counter(
+            "serving_shed_total",
+            "requests shed at admission or in the queue, by status code",
+        )
+        self._admitted = registry.counter(
+            "serving_admitted_total", "requests past admission control"
+        )
+        registry.gauge(
+            "serving_inflight_requests",
+            "requests admitted and not yet answered (the admission "
+            "queue depth; the bound is max_inflight)",
+            fn=lambda: self.inflight,
+        )
+
+    def try_admit(self, client: str) -> int | None:
+        """None = admitted (caller MUST release()); else the shed status
+        code. The admission span records the decision either way — the
+        shed path is the one an operator most wants to see."""
+        from tpuflow.obs import record_span
+
+        if not self.buckets.allow(client):
+            self._shed.inc(code="429")
+            record_span("serve.admission", 0.0, hot=True,
+                        outcome="shed_quota", client=client)
+            return 429
+        if self.inflight >= self.max_inflight:
+            self._shed.inc(code="503")
+            record_span("serve.admission", 0.0, hot=True,
+                        outcome="shed_capacity", inflight=self.inflight)
+            return 503
+        self.inflight += 1
+        self._admitted.inc()
+        record_span("serve.admission", 0.0, hot=True,
+                    outcome="admitted", inflight=self.inflight)
+        return None
+
+    def shed_deadline(self) -> None:
+        self._shed.inc(code="504")
+
+    def shed_queue(self) -> None:
+        """A batcher-capacity (QueueFull) shed: counted with the same
+        503 label as an admission-bound shed — both are 'my capacity,
+        back off' to the client."""
+        self._shed.inc(code="503")
+
+    def release(self) -> None:
+        self.inflight -= 1
+
+    def metrics(self) -> dict:
+        return {
+            "admitted": int(self._admitted.value()),
+            "shed_429": int(self._shed.value(code="429")),
+            "shed_503": int(self._shed.value(code="503")),
+            "shed_504": int(self._shed.value(code="504")),
+            "inflight": self.inflight,
+            "max_inflight": self.max_inflight,
+            "quota_rps": self.buckets.rate,
+        }
+
+
+class AsyncServer:
+    """The asyncio daemon. Construct, then either ``serve_forever()``
+    (foreground, ``main()``'s path) or ``start()`` / ``shutdown()``
+    (background thread — tests and benchmarks embed it exactly like
+    ``make_server``'s ThreadingHTTPServer)."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8700,
+        *,
+        max_inflight: int | None = None,
+        quota_rps: float | None = None,
+        quota_burst: float | None = None,
+        deadline_ms: float | None = None,
+        hedge_ms: float | None = None,
+        prep_workers: int | None = None,
+        batch_predicts: bool | None = None,
+        batch_max_rows: int | None = None,
+        warmup_buckets: int | None = None,
+        donate_forward: bool | None = None,
+        max_resident: int | None = None,
+        enable_jobs: bool = True,
+        max_queued: int = 64,
+        default_timeout: float | None = None,
+        journal_path: str | None = None,
+        service: PredictService | None = None,
+    ):
+        from tpuflow.obs import Registry
+
+        self.host, self.port = host, port
+        if max_inflight is None:
+            max_inflight = env_num(
+                "TPUFLOW_SERVE_ADMIT_MAX", 256, int, minimum=1,
+                form="an integer in-flight bound >= 1",
+            )
+        if quota_rps is None:
+            quota_rps = env_num(
+                "TPUFLOW_SERVE_QUOTA_RPS", 0.0, float,
+                form="a non-negative requests-per-second rate (0 = off)",
+            )
+        if quota_burst is None:
+            quota_burst = env_num(
+                "TPUFLOW_SERVE_QUOTA_BURST", 16.0, float, minimum=1,
+                form="a burst size >= 1",
+            )
+        if deadline_ms is None:
+            deadline_ms = env_num(
+                "TPUFLOW_SERVE_DEADLINE_MS", 0.0, float,
+                form="a non-negative deadline in milliseconds (0 = off)",
+            )
+        if hedge_ms is None:
+            hedge_ms = env_num(
+                "TPUFLOW_SERVE_HEDGE_MS", 0.0, float,
+                form="a non-negative hedge delay in milliseconds (0 = off)",
+            )
+        if prep_workers is None:
+            prep_workers = env_num(
+                "TPUFLOW_SERVE_PREP_WORKERS", 4, int, minimum=1,
+                form="an integer worker count >= 1",
+            )
+        self.deadline_ms = float(deadline_ms)
+        self.hedge_ms = float(hedge_ms)
+        self._started = time.monotonic()
+        # ONE run-scoped registry for the whole daemon (the make_server
+        # discipline): admission, batcher, predictor, and job counters
+        # render in a single Prometheus scrape. An injected service
+        # (tests, embedding) brings its own registry — adopt it, so its
+        # batcher families still land in this daemon's exposition.
+        if service is not None:
+            # The service-construction knobs belong to the injected
+            # service's own constructor — accepting and dropping them
+            # here would be silent misconfiguration (hedge/deadline/
+            # admission knobs ARE honored, which makes the asymmetry
+            # easy to miss), so conflicting kwargs fail loudly.
+            conflicting = sorted(
+                k for k, v in {
+                    "batch_predicts": batch_predicts,
+                    "batch_max_rows": batch_max_rows,
+                    "warmup_buckets": warmup_buckets,
+                    "donate_forward": donate_forward,
+                    "max_resident": max_resident,
+                }.items() if v is not None
+            )
+            if conflicting:
+                raise ValueError(
+                    f"service was injected; pass {conflicting} to "
+                    "PredictService(...) instead"
+                )
+            self.service = service
+            self.registry = service.registry
+        else:
+            # The env family applies here too, with async-appropriate
+            # DEFAULTS (batching on, continuous engine) — an operator's
+            # TPUFLOW_SERVE_BATCH=0 or BATCH_MODE=micro is honored, not
+            # silently ignored.
+            if batch_predicts is None:
+                batch_predicts = env_flag("TPUFLOW_SERVE_BATCH", True)
+            self.registry = Registry()
+            self.service = PredictService(
+                batch_predicts=batch_predicts,
+                batch_mode=env_choice(
+                    "TPUFLOW_SERVE_BATCH_MODE", "continuous",
+                    ("micro", "continuous"),
+                ),
+                batch_max_rows=batch_max_rows,
+                warmup_buckets=warmup_buckets,
+                donate_forward=donate_forward,
+                max_resident=max_resident,
+                registry=self.registry,
+            )
+        self.registry.gauge(
+            "uptime_seconds", "seconds since the daemon started",
+            fn=lambda: time.monotonic() - self._started,
+        )
+        self.admission = _Admission(
+            int(max_inflight),
+            TokenBuckets(float(quota_rps), float(quota_burst)),
+            self.registry,
+        )
+        self._hedges = self.registry.counter(
+            "serving_hedges_total", "duplicate dispatches enqueued by "
+            "the hedge timer",
+        )
+        self._hedge_wins = self.registry.counter(
+            "serving_hedge_wins_total", "requests answered by their "
+            "hedge dispatch first",
+        )
+        self.runner = None
+        if enable_jobs:
+            self.runner = JobRunner(
+                on_artifact_change=self.service.invalidate,
+                max_queued=max_queued,
+                default_timeout=default_timeout,
+                journal_path=journal_path,
+                registry=self.registry,
+            )
+        # Bounded-width executor for every blocking step (artifact
+        # loads, feature transforms, unbatched forwards, job-journal
+        # I/O). Its backlog is bounded BY ADMISSION — at most
+        # max_inflight requests can be queued behind it.
+        self._pool = ThreadPoolExecutor(
+            max_workers=int(prep_workers), thread_name_prefix="tpuflow-prep"
+        )
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._aserver = None
+        self._thread: threading.Thread | None = None
+        self._ready = threading.Event()
+        self._announce = False  # main() flips it: print URL post-bind
+        self._boot_error: BaseException | None = None
+
+    # ---- request pipeline ----
+
+    async def _predict(self, spec: dict, headers: dict) -> tuple[int, dict]:
+        from tpuflow.obs import current_trace_id
+
+        svc = self.service
+        loop = asyncio.get_running_loop()
+        t0 = time.perf_counter()
+        trace_id = current_trace_id()
+        deadline_ms = spec.pop("deadlineMs", None)
+        if deadline_ms is None:
+            deadline_ms = headers.get("x-deadline-ms") or self.deadline_ms
+        try:
+            deadline_ms = float(deadline_ms)
+        except (TypeError, ValueError):
+            return 400, {
+                "error": f"deadlineMs={deadline_ms!r} is not a number",
+                "trace_id": trace_id,
+            }
+        deadline = (
+            time.monotonic() + deadline_ms / 1000.0 if deadline_ms > 0
+            else None
+        )
+        try:
+            key, pred, payload = await loop.run_in_executor(
+                self._pool, svc.begin_request, spec
+            )
+            if svc.batcher is None or not svc.coalescable(pred):
+                # Degraded (Gilbert) answers and batching-off configs
+                # take the per-request path on an executor thread. The
+                # deadline contract holds here too: a request whose
+                # (possibly seconds-long cold) artifact resolve already
+                # blew its deadline sheds 504 instead of running.
+                if deadline is not None and time.monotonic() > deadline:
+                    raise DeadlineExpired(
+                        f"request deadline ({deadline_ms:g}ms) expired "
+                        "during prepare"
+                    )
+                y = await loop.run_in_executor(
+                    self._pool, svc.answer_unbatched, pred, payload
+                )
+            else:
+                x = await loop.run_in_executor(
+                    self._pool, svc.transform_request, pred, payload
+                )
+                if deadline is not None and time.monotonic() > deadline:
+                    # Expired during prepare: shed before it can occupy
+                    # a dispatch slot (the batcher would shed it at
+                    # drain time anyway; this is just sooner).
+                    raise DeadlineExpired(
+                        f"request deadline ({deadline_ms:g}ms) expired "
+                        "during prepare"
+                    )
+                if len(x) == 0:
+                    y = await loop.run_in_executor(
+                        self._pool, pred.forward_prepared, x
+                    )
+                elif hasattr(svc.batcher, "enqueue"):
+                    y = await self._forward_coalesced(key, pred, x, deadline)
+                else:
+                    # Injected micro-engine service (the embedding
+                    # path): blocking submit on an executor thread —
+                    # still coalesced; no drain-time deadline shedding
+                    # (the micro engine has no deadline hook; the
+                    # pre-enqueue expiry check above still applies).
+                    y = await loop.run_in_executor(
+                        self._pool, svc.batcher.submit, key, pred, x
+                    )
+
+            def shape_response():
+                # Response shaping is O(rows) numpy→list conversion plus
+                # the JSON encode — blocking work like any other, so it
+                # runs on the executor, not the loop (a 64MB-body batch
+                # must not stall every other connection). _respond
+                # passes bytes through verbatim.
+                out = svc.finish_response(pred, y)
+                out["trace_id"] = trace_id
+                return json.dumps(out).encode()
+
+            return 200, await loop.run_in_executor(self._pool, shape_response)
+        except DeadlineExpired as e:
+            self.admission.shed_deadline()
+            return 504, {
+                "error": str(e), "shed": "deadline", "trace_id": trace_id,
+            }
+        except ValueError as e:
+            return 400, {"error": str(e), "trace_id": trace_id}
+        except QueueFull as e:
+            # The batcher's bounded queue/lanes: capacity, not caller
+            # error — 503 with retry semantics, counted as shed.
+            self.admission.shed_queue()
+            return 503, {
+                "error": str(e), "shed": "queue", "trace_id": trace_id,
+            }
+        except Exception as e:  # missing artifact, bad columns
+            return 500, {
+                "error": f"{type(e).__name__}: {e}", "trace_id": trace_id,
+            }
+        finally:
+            svc.record_latency(time.perf_counter() - t0)
+
+    async def _forward_coalesced(self, key, pred, x, deadline):
+        """Enqueue into the continuous batcher and await the scatter —
+        the event loop parks a Future, not a thread. With ``hedge_ms``
+        set, a dispatch that hasn't answered inside the window enqueues
+        a duplicate and the first completion wins."""
+        loop = asyncio.get_running_loop()
+        fut = self._enqueue(loop, key, pred, x, deadline)
+        if self.hedge_ms <= 0:
+            return await self._await_entry(fut)
+        try:
+            done = await asyncio.wait_for(
+                asyncio.shield(fut), timeout=self.hedge_ms / 1000.0
+            )
+            if done.error is not None:
+                raise done.error
+            return done.result
+        except asyncio.TimeoutError:
+            pass
+        # Hedge: duplicate forward OUTSIDE the lane — the lane's single
+        # thread is busy running the straggler itself, so a hedge
+        # queued behind it could never win. An executor thread races
+        # the original with the same predictor instance and rows (the
+        # stale-scatter contract holds: the answer comes from exactly
+        # the params this request resolved).
+        self._hedges.inc()
+        hedge_fut = loop.run_in_executor(
+            self._pool, self.service._run_forward, pred, x
+        )
+        futs = {fut, hedge_fut}
+        while futs:
+            finished, futs = await asyncio.wait(
+                futs, return_when=asyncio.FIRST_COMPLETED,
+                timeout=self._wedge_timeout(),
+            )
+            if not finished:
+                raise self._wedged_error()
+            for f in finished:
+                if f is hedge_fut:
+                    try:
+                        y = f.result()
+                    except Exception:
+                        continue  # hedge failed; the original may answer
+                    self._hedge_wins.inc()
+                    return y
+                e = f.result()
+                if e.error is None:
+                    return e.result
+                if isinstance(e.error, DeadlineExpired):
+                    # The request is DEAD — a hedge rescuing it would
+                    # return a 200 past the declared deadline and spend
+                    # a full duplicate forward on it. Shed now; the
+                    # in-flight hedge's result is discarded.
+                    raise e.error
+                # Original failed (non-deadline); the hedge may answer.
+        # Both failed: surface the ORIGINAL's error (the hedge's is a
+        # duplicate of the same dispatch conditions).
+        raise fut.result().error
+
+    def _wedge_timeout(self) -> float:
+        return float(getattr(self.service.batcher, "submit_timeout", 60.0))
+
+    def _wedged_error(self) -> RuntimeError:
+        return RuntimeError(
+            f"predict batch dispatch timed out after "
+            f"{self._wedge_timeout():g}s (dispatcher wedged?)"
+        )
+
+    async def _await_entry(self, fut):
+        """Await one batcher entry and unwrap it — the result, or the
+        dispatch group's error — with the threaded path's wedge guard
+        (``_Pending.wait(submit_timeout)``): a dispatch that answers
+        nothing inside the window raises instead of parking this
+        request — and its admission slot — forever. Shielded: a timeout
+        must not cancel the entry a lane thread will still signal."""
+        try:
+            done = await asyncio.wait_for(
+                asyncio.shield(fut), timeout=self._wedge_timeout()
+            )
+        except asyncio.TimeoutError:
+            raise self._wedged_error() from None
+        if done.error is not None:
+            raise done.error
+        return done.result
+
+    def _enqueue(self, loop, key, pred, x, deadline):
+        """Enqueue one batcher entry; returns a Future resolving to the
+        completed entry (the on_done → call_soon_threadsafe bridge: the
+        lane thread signals, the event loop wakes)."""
+        fut = loop.create_future()
+
+        def bridge(entry, fut=fut, loop=loop):
+            loop.call_soon_threadsafe(_resolve, fut, entry)
+
+        self.service.batcher.enqueue(
+            key, pred, x, deadline=deadline, on_done=bridge
+        )
+        return fut
+
+    # ---- HTTP layer ----
+
+    async def _handle_conn(self, reader, writer):
+        try:
+            while True:
+                req = await self._read_request(reader)
+                if req is None:
+                    break
+                method, path, headers, body = req
+                keep = (
+                    headers.get("connection", "keep-alive").lower()
+                    != "close"
+                )
+                res = await self._route(
+                    method, path, headers, body, writer
+                )
+                status, payload, ctype = res[:3]
+                try:
+                    await self._respond(writer, status, payload, ctype, keep)
+                finally:
+                    # Post-respond hooks (admission release rides here):
+                    # the in-flight bound covers the response WRITE too,
+                    # so slow readers holding big serialized bodies
+                    # still count against max_inflight.
+                    for hook in res[3:]:
+                        hook()
+                if not keep:
+                    break
+        except _RequestError as e:
+            # HTTP-layer rejection: answer with the status (best
+            # effort — the writer may already be torn), then close.
+            try:
+                await self._respond(
+                    writer, e.status, {"error": str(e)},
+                    "application/json", keep=False,
+                )
+            except Exception:
+                pass
+        except (
+            ConnectionError, asyncio.IncompleteReadError,
+            asyncio.LimitOverrunError, ValueError,
+        ):
+            pass  # torn/malformed connection: drop it, stay serving
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    @staticmethod
+    async def _read_request(reader):
+        line = await reader.readline()
+        if not line:
+            return None
+        parts = line.decode("latin-1").rstrip("\r\n").split(" ")
+        if len(parts) != 3:
+            raise _RequestError(400, f"malformed request line {line!r}")
+        method, path, _version = parts
+        headers: dict[str, str] = {}
+        for i in range(_MAX_HEADERS + 1):
+            hline = await reader.readline()
+            if hline in (b"\r\n", b"\n", b""):
+                break
+            if i >= _MAX_HEADERS:  # the cap is inclusive: 64 headers OK
+                raise _RequestError(
+                    400, f"too many headers (max {_MAX_HEADERS})"
+                )
+            name, _, value = hline.decode("latin-1").partition(":")
+            headers[name.strip().lower()] = value.strip()
+        if "chunked" in headers.get("transfer-encoding", "").lower():
+            # The hand-rolled parser is Content-Length-only; reading a
+            # chunked body as length-0 would desynchronize the
+            # keep-alive stream (the chunk sizes parse as the next
+            # request line). Fail actionably instead.
+            raise _RequestError(
+                501, "Transfer-Encoding: chunked is not supported; "
+                "send Content-Length",
+            )
+        raw_length = headers.get("content-length", 0) or 0
+        try:
+            length = int(raw_length)
+        except ValueError:
+            raise _RequestError(
+                400, f"bad Content-Length {raw_length!r}"
+            ) from None
+        if length < 0:
+            raise _RequestError(400, f"bad Content-Length {length}")
+        if length > _MAX_BODY:
+            raise _RequestError(
+                413, f"body of {length} bytes exceeds the "
+                f"{_MAX_BODY}-byte cap"
+            )
+        body = await reader.readexactly(length) if length else b""
+        return method, path, headers, body
+
+    async def _respond(self, writer, status, payload, ctype, keep):
+        body = (
+            payload if isinstance(payload, (bytes, bytearray))
+            else json.dumps(payload).encode()
+        )
+        head = (
+            f"HTTP/1.1 {status} {_STATUS_TEXT.get(status, 'OK')}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: {'keep-alive' if keep else 'close'}\r\n\r\n"
+        )
+        writer.write(head.encode("latin-1") + body)
+        await writer.drain()
+
+    async def _route(self, method, path, headers, body, writer):
+        from urllib.parse import parse_qs, urlsplit
+
+        from tpuflow.obs import use_trace
+
+        split = urlsplit(path)
+        route = split.path.rstrip("/")
+        json_ct = "application/json"
+        if method == "GET":
+            if route in ("", "/health", "/healthz"):
+                deg = self.service.degraded()
+                return 200, {
+                    "status": "degraded" if deg else "ok",
+                    "degraded": bool(deg),
+                    "degraded_artifacts": deg,
+                }, json_ct
+            if route == "/metrics":
+                fmt = parse_qs(split.query).get("format", [""])[0]
+                if fmt == "prometheus":
+                    from tpuflow.obs import (
+                        default_registry,
+                        render_prometheus,
+                    )
+
+                    text = render_prometheus(
+                        self.registry, default_registry()
+                    )
+                    return 200, text.encode(), (
+                        "text/plain; version=0.0.4; charset=utf-8"
+                    )
+                return 200, self.metrics(), json_ct
+            if route == "/jobs" and self.runner is not None:
+                return 200, self.runner.list(), json_ct
+            parts = route.split("/")
+            if (
+                len(parts) == 3 and parts[1] == "jobs"
+                and self.runner is not None
+            ):
+                rec = self.runner.get(parts[2])
+                if rec is None:
+                    return 404, {"error": f"no job {parts[2]!r}"}, json_ct
+                return 200, rec, json_ct
+            return 404, {"error": f"no route {path!r}"}, json_ct
+        if method == "POST" and route == "/predict":
+            client = headers.get("x-client-id") or (
+                (writer.get_extra_info("peername") or ("?",))[0]
+            )
+            shed = self.admission.try_admit(str(client))
+            if shed == 429:
+                return 429, {
+                    "error": "per-client quota exceeded; retry after "
+                    "your token bucket refills", "shed": "quota",
+                }, json_ct
+            if shed == 503:
+                return 503, {
+                    "error": f"admission queue full "
+                    f"({self.admission.max_inflight} in flight); "
+                    "retry with backoff", "shed": "admission",
+                }, json_ct
+            try:
+                try:
+                    spec = await self._parse_body(body)
+                except (ValueError, json.JSONDecodeError) as e:
+                    return 400, {"error": str(e)}, json_ct, \
+                        self.admission.release
+                with use_trace(
+                    _clean_trace_id(headers.get("x-trace-id"))
+                ):
+                    status, payload = await self._predict(spec, headers)
+                # The slot is released AFTER the response is written
+                # (the caller runs trailing hooks post-_respond): the
+                # in-flight bound must also cover a serialized body
+                # parked behind a slow reader.
+                return status, payload, json_ct, self.admission.release
+            except BaseException:
+                self.admission.release()
+                raise
+        if method == "POST" and route == "/jobs" and self.runner is not None:
+            import queue as _queue
+
+            loop = asyncio.get_running_loop()
+            try:
+                spec = await self._parse_body(body)
+                # Executor: submit() flushes the journal (disk I/O) —
+                # a stalled journal filesystem must not stall the loop.
+                res = await loop.run_in_executor(
+                    self._pool, self.runner.submit, spec
+                )
+                return 202, res, json_ct
+            except _queue.Full:
+                return 429, {
+                    "error": f"job queue full (max "
+                    f"{self.runner.max_queued}); retry after a job "
+                    "finishes"
+                }, json_ct
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                return 400, {"error": str(e)}, json_ct
+        if method == "DELETE" and self.runner is not None:
+            parts = route.split("/")
+            if len(parts) == 3 and parts[1] == "jobs":
+                loop = asyncio.get_running_loop()
+                res = await loop.run_in_executor(
+                    self._pool, self.runner.cancel, parts[2]
+                )
+                if res is None:
+                    return 404, {"error": f"no job {parts[2]!r}"}, json_ct
+                if res.pop("conflict", False):
+                    return 409, {
+                        **res, "error": f"job already {res['status']}",
+                    }, json_ct
+                return 200, res, json_ct
+        return 404, {"error": f"no route {path!r}"}, json_ct
+
+    async def _parse_body(self, body: bytes) -> dict:
+        """Parse a JSON request body — on the executor past a size
+        threshold: json.loads of a body near the 64MB cap takes loop-
+        stalling time, and inbound parse deserves the same discipline
+        the outbound ``shape_response`` already follows. Small bodies
+        (the overwhelmingly common case) parse inline; the executor
+        hop would cost more than it saves."""
+        def parse():
+            spec = json.loads(body or b"{}")
+            if not isinstance(spec, dict):
+                raise ValueError("request body must be a JSON object")
+            return spec
+
+        if len(body) < 64 * 1024:
+            return parse()
+        return await asyncio.get_running_loop().run_in_executor(
+            self._pool, parse
+        )
+
+    def metrics(self) -> dict:
+        """The /metrics JSON view: the threaded daemon's schema plus the
+        ``serving`` section (admission + shed + hedge counters). Keys
+        are drift-tested against docs/serving.md's marker block."""
+        out = {
+            "jobs": self.runner.metrics() if self.runner is not None else {},
+            "predict": self.service.metrics(),
+            "serving": {
+                **self.admission.metrics(),
+                "hedges": int(self._hedges.value()),
+                "hedge_wins": int(self._hedge_wins.value()),
+                "deadline_ms": self.deadline_ms,
+                "hedge_ms": self.hedge_ms,
+            },
+            "uptime_s": round(time.monotonic() - self._started, 1),
+        }
+        return out
+
+    # ---- lifecycle ----
+
+    async def _amain(self):
+        self._aserver = await asyncio.start_server(
+            self._handle_conn, self.host, self.port, limit=1 << 16,
+            backlog=512,
+        )
+        self.port = self._aserver.sockets[0].getsockname()[1]
+        if self._announce:
+            # Post-bind, so --port 0 prints the REAL ephemeral port and
+            # a failed bind never prints a success line.
+            print(
+                f"tpuflow async serving on http://{self.host}:{self.port}",
+                flush=True,
+            )
+        self._ready.set()
+        async with self._aserver:
+            await self._aserver.serve_forever()
+
+    def _run_loop(self):
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        try:
+            self._loop.run_until_complete(self._amain())
+        except asyncio.CancelledError:
+            pass
+        except BaseException as e:
+            # Pre-bind failure (EADDRINUSE, EACCES): hand the REAL
+            # error to the thread parked in start() instead of letting
+            # it wait out the 30s and raise something generic.
+            self._boot_error = e
+            self._ready.set()
+            raise
+        finally:
+            try:
+                self._loop.run_until_complete(
+                    self._loop.shutdown_asyncgens()
+                )
+            finally:
+                self._loop.close()
+
+    def start(self) -> "AsyncServer":
+        """Serve on a background thread; returns once the socket is
+        bound (``self.port`` is then the real ephemeral port). A bind
+        failure re-raises here with its real cause."""
+        self._thread = threading.Thread(
+            target=self._run_loop, name="tpuflow-serve-async", daemon=True
+        )
+        self._thread.start()
+        if not self._ready.wait(timeout=30):
+            raise RuntimeError("async server failed to bind within 30s")
+        if self._boot_error is not None:
+            raise RuntimeError(
+                f"async server failed to start: {self._boot_error}"
+            ) from self._boot_error
+        return self
+
+    def serve_forever(self) -> None:
+        """Foreground serving (``main()``): blocks until ``shutdown``."""
+        self._run_loop()
+
+    def shutdown(self) -> None:
+        """Stop accepting, cancel the serve task, close the batcher and
+        executor. Idempotent; callable from any thread."""
+        loop = self._loop
+        if loop is not None and not loop.is_closed():
+
+            def _stop():
+                if self._aserver is not None:
+                    self._aserver.close()
+                for task in asyncio.all_tasks(loop):
+                    task.cancel()
+
+            try:
+                loop.call_soon_threadsafe(_stop)
+            except RuntimeError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self.service.close()
+        self._pool.shutdown(wait=False)
+
+
+def _resolve(fut, entry) -> None:
+    if not fut.done():
+        fut.set_result(entry)
+
+
+def make_async_server(host: str = "127.0.0.1", port: int = 0, **kwargs):
+    """Build-and-start convenience for tests/benchmarks: returns a
+    RUNNING AsyncServer with ``.port`` resolved (the ``make_server`` +
+    ``serve_forever``-thread idiom, one call)."""
+    return AsyncServer(host, port, **kwargs).start()
+
+
+def main(argv=None) -> int:
+    import argparse
+    import signal
+
+    p = argparse.ArgumentParser(
+        prog="tpuflow.serve_async",
+        description="tpuflow async serving control plane (asyncio front "
+        "end + continuous batching + admission control)",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8700)
+    p.add_argument(
+        "--max-inflight", type=int, default=None, metavar="N",
+        help="admission bound: requests in flight past admission "
+        "(default 256; also TPUFLOW_SERVE_ADMIT_MAX); past it /predict "
+        "sheds 503",
+    )
+    p.add_argument(
+        "--quota-rps", type=float, default=None, metavar="R",
+        help="per-client token-bucket refill rate, requests/sec "
+        "(default 0 = off; also TPUFLOW_SERVE_QUOTA_RPS); past it the "
+        "client sheds 429",
+    )
+    p.add_argument(
+        "--quota-burst", type=float, default=None, metavar="B",
+        help="per-client token-bucket size (default 16; also "
+        "TPUFLOW_SERVE_QUOTA_BURST)",
+    )
+    p.add_argument(
+        "--deadline-ms", type=float, default=None, metavar="MS",
+        help="default per-request deadline (0 = off; also "
+        "TPUFLOW_SERVE_DEADLINE_MS; per-request deadlineMs/X-Deadline-Ms "
+        "override); an expired request sheds 504 and never occupies a "
+        "dispatch slot",
+    )
+    p.add_argument(
+        "--hedge-ms", type=float, default=None, metavar="MS",
+        help="hedged re-dispatch window (0 = off; also "
+        "TPUFLOW_SERVE_HEDGE_MS): a coalesced forward slower than this "
+        "enqueues a duplicate and the first completion wins",
+    )
+    p.add_argument(
+        "--prep-workers", type=int, default=None, metavar="N",
+        help="executor threads for blocking work (artifact loads, "
+        "feature transforms; default 4; also TPUFLOW_SERVE_PREP_WORKERS)",
+    )
+    p.add_argument(
+        "--batch-max-rows", type=int, default=None, metavar="N",
+        help="max rows per coalesced dispatch (default 256; also "
+        "TPUFLOW_SERVE_MAX_BATCH)",
+    )
+    p.add_argument(
+        "--no-batch-predicts", action="store_const", const=False,
+        dest="batch_predicts", default=None,
+        help="disable continuous batching (every request runs its own "
+        "forward on an executor thread; default on, also "
+        "TPUFLOW_SERVE_BATCH)",
+    )
+    p.add_argument(
+        "--warmup-buckets", type=int, default=None, metavar="K",
+        help="pre-compile the K largest pow-2 forward buckets at "
+        "artifact load (default 0; also TPUFLOW_SERVE_WARMUP)",
+    )
+    p.add_argument(
+        "--donate-forward", action="store_true", default=None,
+        help="donate the input batch buffer to the jitted forward "
+        "(also TPUFLOW_SERVE_DONATE=1)",
+    )
+    p.add_argument(
+        "--max-resident", type=int, default=None, metavar="N",
+        help="artifact placement bound: predictors resident before LRU "
+        "spill (default 0 = unbounded; also TPUFLOW_SERVE_RESIDENT)",
+    )
+    p.add_argument(
+        "--no-jobs", action="store_false", dest="enable_jobs", default=True,
+        help="serve /predict only (no job queue)",
+    )
+    p.add_argument("--max-queued", type=int, default=64)
+    p.add_argument("--default-timeout", type=float, default=None)
+    p.add_argument("--journal", default=None, metavar="PATH")
+    args = p.parse_args(argv)
+
+    server = AsyncServer(
+        args.host, args.port,
+        max_inflight=args.max_inflight,
+        quota_rps=args.quota_rps,
+        quota_burst=args.quota_burst,
+        deadline_ms=args.deadline_ms,
+        hedge_ms=args.hedge_ms,
+        prep_workers=args.prep_workers,
+        batch_predicts=args.batch_predicts,
+        batch_max_rows=args.batch_max_rows,
+        warmup_buckets=args.warmup_buckets,
+        donate_forward=args.donate_forward,
+        max_resident=args.max_resident,
+        enable_jobs=args.enable_jobs,
+        max_queued=args.max_queued,
+        default_timeout=args.default_timeout,
+        journal_path=args.journal,
+    )
+
+    def _stop(signum, frame):
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    server._announce = True
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

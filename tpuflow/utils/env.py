@@ -1,0 +1,43 @@
+"""Validated environment-variable reads — THE one copy.
+
+Both knob families that read numbers from the environment
+(``TPUFLOW_RETRY_*`` in resilience/retry.py, ``TPUFLOW_SERVE_*`` in
+serve.py) share one contract: a typo'd, non-finite, or below-minimum
+value raises a ValueError naming the variable and the expected form,
+because the error surfaces deep inside whatever path read the knob —
+far from the shell that exported it — and must say exactly what to
+fix. Two hand-rolled copies of that contract had already drifted
+subtly; this module is the single implementation they both call.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+
+
+def env_number(name: str, default, *, cast, minimum, form: str):
+    """One validated numeric env read. Unset (or, for historical
+    compatibility with the retry family, empty-string) values return
+    ``default``; anything else must cast, be finite, and clear
+    ``minimum`` — or the error names the variable and ``form``."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        value = cast(raw)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"invalid {name}={raw!r}: expected {form}"
+        ) from None
+    if not math.isfinite(value):
+        # 'nan' survives < comparisons and 'inf' would sleep/queue
+        # forever — exactly the far-from-the-shell breakage this
+        # validation exists to prevent.
+        raise ValueError(f"invalid {name}={raw!r}: expected {form}")
+    if value < minimum:
+        raise ValueError(
+            f"invalid {name}={raw!r}: expected {form}, got a value below "
+            f"{minimum}"
+        )
+    return value
