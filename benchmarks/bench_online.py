@@ -1,0 +1,205 @@
+"""Swap-downtime benchmark: closed-loop clients hammer a live daemon
+across a hot artifact swap (tpuflow/online).
+
+The online loop's zero-downtime claim, measured: N closed-loop client
+threads POST ``/predict`` continuously against the async daemon while a
+candidate artifact is promoted (``online/swap.py::promote_candidate``)
+and the daemon is nudged over ``POST /artifacts/reload`` mid-run. The
+headline numbers:
+
+- **dropped** — non-200 responses across the whole run (MUST be 0: the
+  instance-grouped batcher finishes in-flight requests against the old
+  predictor; the reload only redirects future loads);
+- **p99 during the swap window** — latency in the ±1s around the reload
+  vs the steady-state p99 (the reload's cost is one cold artifact load
+  + bucket re-warmup, paid once, off the request path's fast case).
+
+Usage::
+
+    python benchmarks/bench_online.py [--clients 8] [--seconds 8]
+        [--out benchmarks/online_results.json]
+
+CPU-host results are labeled ``host_only`` like every other bench run
+off-chip (bench.py ``mark_host_only`` discipline).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.common import maybe_pin_cpu  # noqa: E402
+
+maybe_pin_cpu()
+
+import numpy as np  # noqa: E402
+
+NAMES = "pressure,choke,glr,temperature,water_cut,completion,flow"
+TYPES = "float,float,float,float,float,string,float"
+_COLS = NAMES.split(",")
+SWAP_WINDOW_S = 1.0  # +/- around the reload for the swap-window p99
+
+
+def _write_csv(path, cols, scale=1.0):
+    with open(path, "w", encoding="utf-8") as f:
+        for i in range(len(cols["flow"])):
+            row = []
+            for c in _COLS:
+                v = cols[c][i]
+                if c in ("pressure", "flow"):
+                    v = float(v) * scale
+                row.append(str(v))
+            f.write(",".join(row) + "\n")
+
+
+def _train(storage, csv_path, warm_start=None, epochs=10):
+    from tpuflow.api import TrainJobConfig, train
+
+    return train(TrainJobConfig(
+        column_names=NAMES, column_types=TYPES, target="flow",
+        storage_path=storage, data_path=csv_path, model="static_mlp",
+        model_kwargs={"hidden": [16]}, max_epochs=epochs, patience=5,
+        batch_size=64, verbose=False, health="off", warm_start=warm_start,
+    ))
+
+
+def _percentile(values, q):
+    return float(np.percentile(np.asarray(values), q)) if values else None
+
+
+def run_bench(clients: int, seconds: float) -> dict:
+    from tpuflow.data import wells_to_table
+    from tpuflow.data.synthetic import generate_wells
+    from tpuflow.online.swap import notify_daemons, promote_candidate
+    from tpuflow.serve_async import make_async_server
+
+    tmp = tempfile.mkdtemp(prefix="bench-online-")
+    cols = wells_to_table(generate_wells(n_wells=6, steps=300, seed=7))
+    base_csv = os.path.join(tmp, "a.csv")
+    shift_csv = os.path.join(tmp, "b.csv")
+    _write_csv(base_csv, cols)
+    _write_csv(shift_csv, cols, scale=3.0)
+
+    storage = os.path.join(tmp, "art")
+    _train(storage, base_csv)
+    candidate = os.path.join(tmp, "cand")
+    _train(candidate, shift_csv, warm_start=storage)
+
+    server = make_async_server(port=0, enable_jobs=False)
+    url = f"http://{server.host}:{server.port}"
+    probe = {
+        c: [float(v) if c != "completion" else str(v)
+            for v in np.asarray(cols[c][:32])]
+        for c in _COLS if c != "flow"
+    }
+    body = json.dumps({
+        "storagePath": storage, "model": "static_mlp", "columns": probe,
+    }).encode()
+
+    samples: list[tuple[float, int, float]] = []  # (t, status, latency_s)
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            req = urllib.request.Request(
+                url + "/predict", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            t0 = time.monotonic()
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    status = resp.status
+                    resp.read()
+            except urllib.error.HTTPError as e:
+                status = e.code
+            except Exception:
+                status = -1
+            with lock:
+                samples.append((t0, status, time.monotonic() - t0))
+
+    threads = [threading.Thread(target=hammer) for _ in range(clients)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(seconds / 2)
+        t_swap = time.monotonic()
+        promote_candidate(storage, "static_mlp", candidate)
+        notify_daemons(url, storage, "static_mlp")
+        time.sleep(seconds / 2)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        server.shutdown()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    lat_all = [s[2] * 1000 for s in samples]
+    lat_swap = [
+        s[2] * 1000 for s in samples
+        if abs(s[0] - t_swap) <= SWAP_WINDOW_S
+    ]
+    lat_steady = [
+        s[2] * 1000 for s in samples
+        if abs(s[0] - t_swap) > SWAP_WINDOW_S
+    ]
+    dropped = [s for s in samples if s[1] != 200]
+    import jax
+
+    return {
+        "benchmark": "online_swap_downtime",
+        "device": jax.devices()[0].device_kind,
+        "host_only": jax.default_backend() == "cpu",
+        "vs_baseline": None,
+        "clients": clients,
+        "seconds": seconds,
+        "requests": len(samples),
+        "dropped": len(dropped),
+        "dropped_statuses": sorted({s[1] for s in dropped}),
+        "p50_ms": round(_percentile(lat_all, 50), 2),
+        "p99_ms": round(_percentile(lat_all, 99), 2),
+        "swap_window_s": SWAP_WINDOW_S,
+        "swap_window_requests": len(lat_swap),
+        "swap_window_p99_ms": round(_percentile(lat_swap, 99), 2)
+        if lat_swap else None,
+        "steady_p99_ms": round(_percentile(lat_steady, 99), 2)
+        if lat_steady else None,
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--clients", type=int, default=8)
+    p.add_argument("--seconds", type=float, default=8.0)
+    p.add_argument(
+        "--out", default=os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "online_results.json",
+        ),
+    )
+    args = p.parse_args()
+    result = run_bench(args.clients, args.seconds)
+    print(json.dumps(result, indent=2))
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    if result["dropped"]:
+        print(
+            f"FAIL: {result['dropped']} dropped requests across the swap",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -196,3 +196,73 @@ def test_analysis_module_entry_rejects_broken_spec(tmp_path):
     assert out.returncode == 1
     assert "unknown model 'resnet50'" in out.stdout
     assert "not divisible by tp=3" in out.stdout
+
+
+def test_online_module_smoke(tmp_path):
+    """python -m tpuflow.online spec.json --max-windows N: the
+    continuous-training sidecar runs bounded as a REAL subprocess —
+    scores windows against a trained artifact's sidecar stats and prints
+    the loop summary JSON. (The retrain/swap machinery is covered in
+    tests/test_online.py; the huge threshold here keeps the smoke to
+    scoring only.) A bad spec exits 2 with a message, not a traceback."""
+    import json
+
+    import numpy as np
+
+    from tpuflow.api import TrainJobConfig, train
+    from tpuflow.data import wells_to_table
+    from tpuflow.data.synthetic import generate_wells
+
+    names = "pressure,choke,glr,temperature,water_cut,completion,flow"
+    cols = wells_to_table(generate_wells(n_wells=2, steps=200, seed=0))
+    csv_path = tmp_path / "d.csv"
+    with open(csv_path, "w") as f:
+        for i in range(len(cols["flow"])):
+            f.write(",".join(
+                str(cols[c][i]) for c in names.split(",")
+            ) + "\n")
+    storage = str(tmp_path / "art")
+    train(TrainJobConfig(
+        column_names=names,
+        column_types="float,float,float,float,float,string,float",
+        target="flow", storage_path=storage, data_path=str(csv_path),
+        model="static_mlp", model_kwargs={"hidden": [4]},
+        max_epochs=2, batch_size=64, verbose=False, health="off",
+    ))
+    spec = tmp_path / "spec.json"
+    spec.write_text(json.dumps({
+        "columnNames": names,
+        "columnTypes": "float,float,float,float,float,string,float",
+        "targetColumn": "flow", "storagePath": storage,
+        "data": str(csv_path), "model": "static_mlp",
+        "model_kwargs": {"hidden": [4]},
+        "online": {"window_rows": 100, "threshold": 1e9,
+                   "warmup_windows": 0},
+    }))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "tpuflow.online", str(spec),
+         "--max-windows", "3"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=240,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    assert summary["windows"] == 3
+    assert summary["swaps"] == 0
+
+    bad = subprocess.run(
+        [sys.executable, "-m", "tpuflow.online", str(spec), "--help"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120,
+    )
+    assert bad.returncode == 0 and "--max-windows" in bad.stdout
+
+    broken = tmp_path / "broken.json"
+    broken.write_text(json.dumps({"model": "static_mlp",
+                                  "online": {"mode": "bogus"}}))
+    out = subprocess.run(
+        [sys.executable, "-m", "tpuflow.online", str(broken)],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=240,
+    )
+    assert out.returncode == 2
+    assert "online" in out.stderr

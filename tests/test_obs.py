@@ -132,6 +132,54 @@ class TestRegistry:
             reg.histogram("h", buckets=[1, 2, 4])
         assert reg.histogram("h", buckets=[2, 1]) is not None  # same edges
 
+    def test_online_drift_and_swap_families_render(self, tmp_path):
+        """The online loop's families (tpuflow/online) land in the same
+        exposition: per-feature drift-score gauges, drift-event
+        counters by kind, and the swap/rollback counters."""
+        import json
+
+        import numpy as np
+
+        from tpuflow.online.drift import DataDriftWatchdog, ReferenceStats
+        from tpuflow.online.swap import promote_candidate, rollback_artifact
+
+        reg = Registry()
+        ref = ReferenceStats(
+            ("pressure",), np.zeros(1), np.ones(1), 0.0, 1.0
+        )
+        wd = DataDriftWatchdog(
+            ref, warmup_windows=0, threshold=1.0, registry=reg
+        )
+        wd.observe_window({"pressure": np.full(8, 9.0)})
+
+        # Promotion/rollback move paths, never load them — fabricated
+        # artifact trees are enough to drive the counters.
+        def fabricate(root, tag):
+            os.makedirs(os.path.join(root, "models", "m"), exist_ok=True)
+            os.makedirs(os.path.join(root, "meta"), exist_ok=True)
+            with open(os.path.join(root, "models", "m", "w.bin"), "w") as f:
+                f.write(tag)
+            with open(os.path.join(root, "meta", "m.json"), "w") as f:
+                json.dump({"tag": tag}, f)
+
+        serving, cand = str(tmp_path / "s"), str(tmp_path / "c")
+        fabricate(serving, "incumbent")
+        fabricate(cand, "candidate")
+        promote_candidate(serving, "m", cand, registry=reg)
+        rollback_artifact(serving, "m", registry=reg)
+
+        text = render_prometheus(reg)
+        types = _assert_valid_exposition(text)
+        assert types["tpuflow_online_drift_score"] == "gauge"
+        assert types["tpuflow_online_drift_events_total"] == "counter"
+        assert 'tpuflow_online_drift_score{feature="pressure"} 9' in text
+        assert (
+            'tpuflow_online_drift_events_total{kind="feature_shift"} 1'
+            in text
+        )
+        assert "tpuflow_online_swaps_total 1" in text
+        assert "tpuflow_online_rollbacks_total 1" in text
+
     def test_label_values_escaped_per_exposition_format(self):
         """`"`/`\\`/newline in label values must escape per the text
         exposition format — faults_injected_total{site=...} and friends

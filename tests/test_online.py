@@ -1,0 +1,718 @@
+"""Online learning loop (tpuflow/online): drift watchdog, env knobs,
+swap/rollback mechanics, warm start, and the end-to-end regime-shift
+drill — a simulated well whose flow regime shifts mid-stream is
+detected, retrained on via warm start, shadow-eval gated, and hot-swapped
+into a live daemon with zero dropped requests; an injected-regression
+candidate is rejected via the ``online.swap`` fault site.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpuflow.obs import Registry
+from tpuflow.online import ONLINE_DEFAULTS, resolve_online, validate_online_block
+from tpuflow.online.drift import (
+    DataDriftWatchdog,
+    DriftDetected,
+    ReferenceStats,
+    reference_stats_from_sidecar,
+)
+from tpuflow.resilience import clear_faults, fired_log
+
+NAMES = "pressure,choke,glr,temperature,water_cut,completion,flow"
+TYPES = "float,float,float,float,float,string,float"
+_COLS = NAMES.split(",")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    clear_faults()
+    yield
+    clear_faults()
+
+
+def _ref(n=2):
+    return ReferenceStats(
+        feature_names=tuple(f"f{i}" for i in range(n)),
+        mean=np.zeros(n),
+        std=np.ones(n),
+        target_mean=0.0,
+        target_std=1.0,
+    )
+
+
+def _healthy(rng, n=128):
+    return {"f0": rng.normal(0, 1, n), "f1": rng.normal(0, 1, n)}
+
+
+class TestDriftWatchdog:
+    def test_warmup_gates_its_own_baseline(self):
+        """Shifted data inside the warmup window never trips — the
+        detector must not trip on the windows that seed it."""
+        wd = DataDriftWatchdog(
+            _ref(), warmup_windows=3, threshold=2.0, registry=Registry()
+        )
+        rng = np.random.default_rng(0)
+        for _ in range(3):
+            shifted = {"f0": rng.normal(50, 1, 64), "f1": rng.normal(0, 1, 64)}
+            assert wd.observe_window(shifted) == []
+        assert wd.windows_scored == 3
+
+    def test_feature_shift_detected_after_warmup(self):
+        reg = Registry()
+        wd = DataDriftWatchdog(
+            _ref(), warmup_windows=1, threshold=3.0, registry=reg
+        )
+        rng = np.random.default_rng(1)
+        assert wd.observe_window(_healthy(rng)) == []
+        found = wd.observe_window(
+            {"f0": rng.normal(8, 1, 128), "f1": rng.normal(0, 1, 128)}
+        )
+        kinds = {a["kind"] for a in found}
+        assert "feature_shift" in kinds
+        [shift] = [a for a in found if a["kind"] == "feature_shift"]
+        assert shift["feature"] == "f0" and shift["score"] > 3.0
+        # The gauge carries the score per feature, tripped or not.
+        assert reg.counter(
+            "online_drift_events_total", ""
+        ).value(kind="feature_shift") >= 1
+
+    def test_variance_shift_detected(self):
+        wd = DataDriftWatchdog(
+            _ref(), warmup_windows=1, threshold=50.0, var_factor=4.0,
+            registry=Registry(),
+        )
+        rng = np.random.default_rng(2)
+        wd.observe_window(_healthy(rng))
+        found = wd.observe_window(
+            {"f0": rng.normal(0, 10, 256), "f1": rng.normal(0, 1, 256)}
+        )
+        assert {a["kind"] for a in found} == {"feature_variance"}
+
+    def test_residual_degradation_ewma_never_poisoned(self):
+        """Residual spikes trip AND never raise their own baseline: a
+        second identical spike still trips."""
+        wd = DataDriftWatchdog(
+            _ref(), warmup_windows=2, threshold=100.0,
+            residual_factor=3.0, registry=Registry(),
+        )
+        rng = np.random.default_rng(3)
+        for _ in range(3):
+            assert wd.observe_window(
+                _healthy(rng), residuals=np.full(64, 0.1)
+            ) == []
+        baseline = wd.residual_baseline
+        for _ in range(2):  # both spikes trip; EWMA untouched
+            found = wd.observe_window(
+                _healthy(rng), residuals=np.full(64, 2.0)
+            )
+            assert {a["kind"] for a in found} == {"residual_degradation"}
+        assert wd.residual_baseline == pytest.approx(baseline)
+
+    def test_target_shift_and_typed_raise(self):
+        wd = DataDriftWatchdog(
+            _ref(), warmup_windows=0, threshold=3.0, registry=Registry()
+        )
+        with pytest.raises(DriftDetected) as exc:
+            wd.observe_window(
+                _healthy(np.random.default_rng(4)),
+                y=np.full(64, 25.0),
+                raise_on_drift=True,
+            )
+        assert exc.value.window == 0
+        assert any(
+            a["kind"] == "target_shift" for a in exc.value.anomalies
+        )
+
+    @pytest.mark.faultdrill
+    def test_online_drift_fault_site(self, monkeypatch):
+        """An armed online.drift fault fails that window's scoring —
+        at= matches the window index (the site is indexed)."""
+        monkeypatch.setenv("TPUFLOW_FAULTS", "online.drift,at=2")
+        wd = DataDriftWatchdog(_ref(), registry=Registry())
+        rng = np.random.default_rng(5)
+        wd.observe_window(_healthy(rng), index=0)
+        wd.observe_window(_healthy(rng), index=1)
+        from tpuflow.resilience import FaultInjected
+
+        with pytest.raises(FaultInjected):
+            wd.observe_window(_healthy(rng), index=2)
+        assert any(f["site"] == "online.drift" for f in fired_log())
+
+    def test_window_array_input(self):
+        """A [N, T, F] window array scores like its flattened columns."""
+        wd = DataDriftWatchdog(
+            _ref(), warmup_windows=0, threshold=3.0, registry=Registry()
+        )
+        x = np.zeros((4, 8, 2))
+        x[..., 0] = 9.0
+        found = wd.observe_window(x)
+        assert any(
+            a["kind"] == "feature_shift" and a["feature"] == "f0"
+            for a in found
+        )
+        with pytest.raises(ValueError, match="expected 2 features"):
+            wd.observe_window(np.zeros((4, 8, 5)))
+
+
+class TestOnlineKnobs:
+    def test_defaults_resolve(self):
+        knobs = resolve_online(None)
+        assert knobs == ONLINE_DEFAULTS
+
+    def test_block_overrides_env_overrides_default(self, monkeypatch):
+        monkeypatch.setenv("TPUFLOW_ONLINE_THRESHOLD", "7.5")
+        monkeypatch.setenv("TPUFLOW_ONLINE_REPLAY", "9")
+        knobs = resolve_online({"replay_windows": 3})
+        assert knobs["threshold"] == 7.5     # env beats default
+        assert knobs["replay_windows"] == 3  # block beats env
+
+    @pytest.mark.parametrize("var,value", [
+        ("TPUFLOW_ONLINE_WINDOW_ROWS", "zero"),
+        ("TPUFLOW_ONLINE_WINDOW_ROWS", "0"),
+        ("TPUFLOW_ONLINE_THRESHOLD", "nan"),
+        ("TPUFLOW_ONLINE_REPLAY", "-3"),
+        ("TPUFLOW_ONLINE_RETRAIN_EPOCHS", "2.5"),
+        ("TPUFLOW_ONLINE_MARGIN", "-0.1"),
+        ("TPUFLOW_ONLINE_MODE", "subprocess"),
+        ("TPUFLOW_ONLINE_ROLLBACK", "ture"),
+    ])
+    def test_every_env_knob_validated_at_read(self, monkeypatch, var, value):
+        """Satellite contract: every TPUFLOW_ONLINE_* knob is validated
+        at read time via the shared utils/env.py helpers — the error
+        names the variable (the TPUFLOW_SERVE_*/RETRY_* precedent)."""
+        monkeypatch.setenv(var, value)
+        with pytest.raises(ValueError, match=var):
+            resolve_online(None)
+
+    def test_block_validation_reports_every_problem(self):
+        msgs = validate_online_block(
+            {"threshold": -1.0, "mode": "bogus", "unknown_knob": 1}
+        )
+        text = "\n".join(msgs)
+        assert "unknown_knob" in text
+        assert "threshold" in text
+        assert "mode" in text
+        assert validate_online_block({"window_rows": 128}) == []
+
+    def test_spec_preflight_covers_online_block(self):
+        from tpuflow.analysis.spec import validate_spec
+        from tpuflow.api import TrainJobConfig
+
+        diags = validate_spec(TrainJobConfig(
+            online={"mode": "bogus"}, data_path=None, storage_path=None,
+        ))
+        codes = {d.code for d in diags}
+        assert "spec.online.invalid" in codes
+        assert "spec.online.storage" in codes
+        assert "spec.online.data_path" in codes
+        diags = validate_spec(TrainJobConfig(warm_start=42))
+        assert "spec.warm_start.type" in {d.code for d in diags}
+
+
+# --- swap mechanics on fabricated artifacts (no Orbax needed: the swap
+# --- moves paths, it never loads them) ---------------------------------
+
+
+def _fabricate_artifact(root, name="m", tag="gen0"):
+    ckpt = os.path.join(root, "models", name)
+    os.makedirs(ckpt, exist_ok=True)
+    with open(os.path.join(ckpt, "weights.bin"), "w") as f:
+        f.write(tag)
+    meta = os.path.join(root, "meta")
+    os.makedirs(meta, exist_ok=True)
+    with open(os.path.join(meta, f"{name}.json"), "w") as f:
+        json.dump({"kind": "tabular", "tag": tag, "preprocessor": {}}, f)
+
+
+def _artifact_tag(root, name="m"):
+    with open(os.path.join(root, "meta", f"{name}.json")) as f:
+        return json.load(f)["tag"]
+
+
+class TestSwapMechanics:
+    def test_promote_retains_incumbent_and_swaps_sidecar(self, tmp_path):
+        from tpuflow.online.swap import promote_candidate
+
+        serving = str(tmp_path / "serving")
+        cand = str(tmp_path / "cand")
+        _fabricate_artifact(serving, tag="incumbent")
+        _fabricate_artifact(cand, tag="candidate")
+        reg = Registry()
+        rec = promote_candidate(serving, "m", cand, registry=reg)
+        assert rec["promoted"]
+        assert _artifact_tag(serving) == "candidate"
+        prev = os.path.join(serving, "online", "prev")
+        assert _artifact_tag(prev) == "incumbent"
+        assert reg.counter("online_swaps_total", "").value() == 1
+
+    def test_rollback_restores_prev_and_keeps_rejected(self, tmp_path):
+        from tpuflow.online.swap import promote_candidate, rollback_artifact
+
+        serving = str(tmp_path / "serving")
+        cand = str(tmp_path / "cand")
+        _fabricate_artifact(serving, tag="incumbent")
+        _fabricate_artifact(cand, tag="bad-candidate")
+        promote_candidate(serving, "m", cand)
+        reg = Registry()
+        rec = rollback_artifact(serving, "m", registry=reg)
+        assert rec["rolled_back"]
+        assert _artifact_tag(serving) == "incumbent"
+        rejected = os.path.join(serving, "online", "rejected")
+        assert _artifact_tag(rejected) == "bad-candidate"
+        assert reg.counter("online_rollbacks_total", "").value() == 1
+
+    def test_rollback_without_prev_fails_loudly(self, tmp_path):
+        from tpuflow.online.swap import rollback_artifact
+
+        serving = str(tmp_path / "serving")
+        _fabricate_artifact(serving, tag="only")
+        with pytest.raises(FileNotFoundError, match="rollback target"):
+            rollback_artifact(serving, "m")
+        assert _artifact_tag(serving) == "only"
+
+    def test_promote_refuses_incomplete_candidate(self, tmp_path):
+        from tpuflow.online.swap import promote_candidate
+
+        serving = str(tmp_path / "serving")
+        _fabricate_artifact(serving, tag="incumbent")
+        with pytest.raises(FileNotFoundError, match="candidate"):
+            promote_candidate(serving, "m", str(tmp_path / "nope"))
+        assert _artifact_tag(serving) == "incumbent"
+
+    def test_promote_refuses_remote_uris(self, tmp_path):
+        from tpuflow.online.swap import promote_candidate
+
+        with pytest.raises(ValueError, match="local storage paths"):
+            promote_candidate("gs://bucket/x", "m", str(tmp_path))
+
+    @pytest.mark.faultdrill
+    def test_injected_swap_fault_leaves_serving_untouched(
+        self, tmp_path, monkeypatch
+    ):
+        """online.swap fires BEFORE any file moves: the candidate is
+        rejected, the serving artifact is byte-identical."""
+        from tpuflow.online.swap import promote_candidate
+        from tpuflow.resilience import FaultInjected
+
+        serving = str(tmp_path / "serving")
+        cand = str(tmp_path / "cand")
+        _fabricate_artifact(serving, tag="incumbent")
+        _fabricate_artifact(cand, tag="candidate")
+        monkeypatch.setenv("TPUFLOW_FAULTS", "online.swap,nth=1")
+        with pytest.raises(FaultInjected):
+            promote_candidate(serving, "m", cand)
+        assert _artifact_tag(serving) == "incumbent"
+        assert _artifact_tag(cand) == "candidate"
+        assert not os.path.exists(os.path.join(serving, "online", "prev"))
+
+    @pytest.mark.faultdrill
+    def test_injected_rollback_fault(self, tmp_path, monkeypatch):
+        from tpuflow.online.swap import promote_candidate, rollback_artifact
+        from tpuflow.resilience import FaultInjected
+
+        serving = str(tmp_path / "serving")
+        cand = str(tmp_path / "cand")
+        _fabricate_artifact(serving, tag="incumbent")
+        _fabricate_artifact(cand, tag="candidate")
+        promote_candidate(serving, "m", cand)
+        monkeypatch.setenv("TPUFLOW_FAULTS", "online.rollback,nth=1")
+        with pytest.raises(FaultInjected):
+            rollback_artifact(serving, "m")
+        # The bad swap is still in place (rollback never started) and
+        # the prev is still retained for a retried rollback.
+        assert _artifact_tag(serving) == "candidate"
+        monkeypatch.delenv("TPUFLOW_FAULTS")
+        clear_faults()
+        rollback_artifact(serving, "m")
+        assert _artifact_tag(serving) == "incumbent"
+
+
+# --- warm start (TrainJobConfig.warm_start -> apply_params) ------------
+
+
+def _table_rows(cols, scale=1.0):
+    out = []
+    for i in range(len(cols["flow"])):
+        row = []
+        for c in _COLS:
+            v = cols[c][i]
+            if c in ("pressure", "flow"):
+                v = float(v) * scale
+            row.append(str(v))
+        out.append(",".join(row))
+    return out
+
+
+def _write_csv(path, lines):
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+@pytest.fixture(scope="module")
+def well_table():
+    from tpuflow.data import wells_to_table
+    from tpuflow.data.synthetic import generate_wells
+
+    return wells_to_table(generate_wells(n_wells=6, steps=300, seed=3))
+
+
+def _base_config(storage, data, **over):
+    from tpuflow.api import TrainJobConfig
+
+    kw = dict(
+        column_names=NAMES, column_types=TYPES, target="flow",
+        storage_path=storage, data_path=data, model="static_mlp",
+        model_kwargs={"hidden": [8]}, max_epochs=15, patience=5,
+        batch_size=64, verbose=False, health="off",
+    )
+    kw.update(over)
+    return TrainJobConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def trained_artifact(tmp_path_factory, well_table):
+    """One regime-A artifact shared by the warm-start and e2e tests."""
+    from tpuflow.api import train
+
+    root = tmp_path_factory.mktemp("online-artifact")
+    csv_path = str(root / "base.csv")
+    _write_csv(csv_path, _table_rows(well_table))
+    storage = str(root / "art")
+    report = train(_base_config(storage, csv_path))
+    return {"storage": storage, "csv": csv_path, "report": report}
+
+
+class TestWarmStart:
+    def test_warm_start_overlays_artifact_params(
+        self, tmp_path, trained_artifact, well_table
+    ):
+        """A warm-started 1-epoch run starts FROM the artifact: its test
+        MAE lands near the incumbent's, not at a fresh init's."""
+        from tpuflow.api import train
+
+        csv_path = str(tmp_path / "d.csv")
+        _write_csv(csv_path, _table_rows(well_table))
+        warm = train(_base_config(
+            str(tmp_path / "cand"), csv_path,
+            warm_start=trained_artifact["storage"], max_epochs=1,
+        ))
+        cold = train(_base_config(
+            str(tmp_path / "cold"), csv_path, max_epochs=1,
+        ))
+        base = trained_artifact["report"].test_mae
+        # Warm continues the incumbent (within 50%); cold from a fresh
+        # init is far worse after one epoch.
+        assert warm.test_mae < 1.5 * base
+        assert cold.test_mae > 2.0 * base
+
+    def test_warm_start_mismatch_names_leaf_paths(
+        self, tmp_path, trained_artifact, well_table
+    ):
+        """The most likely online failure — warm-starting a different
+        architecture — names the first mismatching leaf paths."""
+        from tpuflow.api import train
+
+        csv_path = str(tmp_path / "d.csv")
+        _write_csv(csv_path, _table_rows(well_table))
+        with pytest.raises(ValueError) as exc:
+            train(_base_config(
+                str(tmp_path / "cand"), csv_path,
+                warm_start=trained_artifact["storage"],
+                model_kwargs={"hidden": [8, 8]},  # extra layer
+                max_epochs=1,
+            ))
+        msg = str(exc.value)
+        assert "warm-start params" in msg
+        assert "Dense" in msg  # a NAMED leaf path, not an opaque treedef
+
+    def test_apply_params_shape_mismatch_names_path(self):
+        from tpuflow.train.resume import check_params_match
+
+        live = {"layer": {"kernel": np.zeros((4, 2))}}
+        with pytest.raises(ValueError, match=r"\['layer'\]\['kernel'\]"):
+            check_params_match(
+                live, {"layer": {"kernel": np.zeros((4, 3))}}
+            )
+        with pytest.raises(ValueError, match="missing from the incoming"):
+            check_params_match(live, {"layer": {}})
+
+
+# --- the controller ----------------------------------------------------
+
+
+class TestControllerUnits:
+    def test_reference_stats_from_tabular_sidecar(self, trained_artifact):
+        ref = reference_stats_from_sidecar(
+            trained_artifact["storage"], "static_mlp"
+        )
+        # Continuous feature columns only, in schema order; completion
+        # (categorical) and flow (target) excluded.
+        assert ref.feature_names == (
+            "pressure", "choke", "glr", "temperature", "water_cut"
+        )
+        assert len(ref.mean) == 5 and ref.target_std > 0
+
+    def test_missing_artifact_fails_at_the_door(self, tmp_path):
+        from tpuflow.online.controller import OnlineTrainer
+
+        cfg = _base_config(str(tmp_path / "nope"), str(tmp_path / "d.csv"))
+        with pytest.raises(FileNotFoundError):
+            OnlineTrainer(cfg, notify=lambda *a: None)
+
+    def test_replay_bounded_and_eval_held_back(self, trained_artifact):
+        """Replay never exceeds its bound; every eval_every-th chunk is
+        held back from replay (the shadow gate's un-trained-on slice)."""
+        from tpuflow.online.controller import OnlineTrainer
+
+        rng = np.random.default_rng(0)
+        chunks = [
+            {c: (rng.normal(0, 1, 40) if c != "completion"
+                 else np.array(["open"] * 40)) for c in _COLS}
+            for _ in range(12)
+        ]
+        cfg = _base_config(
+            trained_artifact["storage"], trained_artifact["csv"],
+            online={"replay_windows": 3, "eval_every": 4,
+                    "threshold": 1e9, "warmup_windows": 0},
+        )
+        tr = OnlineTrainer(
+            cfg, source=iter(chunks), registry=Registry(),
+            notify=lambda *a: None,
+        )
+        summary = tr.run()
+        assert summary["windows"] == 12
+        assert len(tr.replay) == 3          # bounded
+        assert len(tr.eval_chunks) == 3     # chunks 0,4,8 (bounded at 4)
+        assert summary["retrains"] == 0     # threshold huge: no drift
+
+
+REGIME_SHIFT = 3.0
+
+
+@pytest.mark.faultdrill
+class TestRegimeShiftEndToEnd:
+    """The acceptance drill: regime shift mid-stream → drift detected →
+    warm-start retrain → shadow-eval gate → hot swap into a LIVE async
+    daemon with zero dropped requests — and an injected-regression
+    candidate (online.swap fault) is rejected with the serving artifact
+    untouched."""
+
+    def _online_config(self, storage, stream_csv, **over):
+        online = {
+            "window_rows": 200, "warmup_windows": 2, "threshold": 3.0,
+            "replay_windows": 6, "eval_every": 4, "retrain_epochs": 15,
+            "margin": 0.25, "min_retrain_gap": 3,
+        }
+        online.update(over)
+        return _base_config(storage, stream_csv, online=online)
+
+    def test_drill(self, tmp_path, trained_artifact, well_table):
+        from tpuflow.online.controller import OnlineTrainer
+        from tpuflow.serve_async import make_async_server
+
+        # The drill owns a COPY of the shared artifact (it swaps it).
+        storage = str(tmp_path / "art")
+        shutil.copytree(trained_artifact["storage"], storage)
+        a_rows = _table_rows(well_table)
+        b_rows = _table_rows(well_table, REGIME_SHIFT)
+        stream_csv = str(tmp_path / "stream.csv")
+        _write_csv(stream_csv, a_rows + b_rows)
+
+        server = make_async_server(port=0, enable_jobs=False)
+        url = f"http://{server.host}:{server.port}"
+        # A regime-B payload the hammer asks about throughout.
+        probe = {
+            c: [float(v) if c != "completion" else v
+                for v in np.asarray(well_table[c][:40])]
+            for c in _COLS if c != "flow"
+        }
+        if "pressure" in probe:
+            probe["pressure"] = [v * REGIME_SHIFT for v in probe["pressure"]]
+        truth_b = np.asarray(well_table["flow"][:40], np.float64) \
+            * REGIME_SHIFT
+        spec = json.dumps({
+            "storagePath": storage, "model": "static_mlp",
+            "columns": probe,
+        }).encode()
+
+        def ask():
+            req = urllib.request.Request(
+                url + "/predict", data=spec,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, json.loads(resp.read())
+
+        statuses: list[int] = []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    status, _ = ask()
+                except urllib.error.HTTPError as e:
+                    status = e.code
+                statuses.append(status)
+                time.sleep(0.01)
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        try:
+            _, before = ask()
+            mae_before = float(np.abs(
+                np.asarray(before["predictions"], np.float64) - truth_b
+            ).mean())
+            for t in threads:
+                t.start()
+            cfg = self._online_config(storage, stream_csv)
+            reg = Registry()
+            tr = OnlineTrainer(
+                cfg, registry=reg,
+                notify=lambda s, m: server.service.invalidate(s, m),
+            )
+            summary = tr.run()
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            server.shutdown()
+
+        # The loop detected the shift, retrained, gated, and swapped.
+        assert summary["anomalies"] > 0
+        assert summary["retrains"] >= 1
+        assert summary["swaps"] >= 1
+        assert reg.counter("online_swaps_total", "").value() \
+            == summary["swaps"]
+        # ZERO dropped requests across every hot swap: the daemon
+        # answered 200 to every single closed-loop request.
+        assert statuses, "hammer never got a request through"
+        assert set(statuses) == {200}, (
+            f"dropped/failed requests during swap: "
+            f"{[s for s in statuses if s != 200][:10]}"
+        )
+        # The SERVED model adapted: a fresh load of the serving path
+        # answers the regime-B probe far better than the incumbent did.
+        from tpuflow.online.swap import artifact_mae
+
+        probe_cols = {
+            **{k: np.asarray(v) for k, v in probe.items()},
+            "flow": truth_b,
+        }
+        mae_after = artifact_mae(storage, "static_mlp", probe_cols, "flow")
+        assert mae_after < 0.5 * mae_before
+        # The incumbent is retained for rollback.
+        assert os.path.exists(
+            os.path.join(storage, "online", "prev", "meta",
+                         "static_mlp.json")
+        )
+
+    def test_injected_regression_candidate_is_rejected(
+        self, tmp_path, trained_artifact, well_table, monkeypatch
+    ):
+        """online.swap armed: every promotion attempt fails BEFORE any
+        file moves — candidates are rejected (counted), the serving
+        sidecar is byte-identical, and the loop survives."""
+        from tpuflow.online.controller import OnlineTrainer
+
+        storage = str(tmp_path / "art")
+        shutil.copytree(trained_artifact["storage"], storage)
+        stream_csv = str(tmp_path / "stream.csv")
+        _write_csv(
+            stream_csv,
+            _table_rows(well_table) + _table_rows(well_table, REGIME_SHIFT),
+        )
+        meta_path = os.path.join(storage, "meta", "static_mlp.json")
+        with open(meta_path) as f:
+            sidecar_before = f.read()
+        monkeypatch.setenv("TPUFLOW_FAULTS", "online.swap,p=1.0,seed=1")
+        notified = []
+        tr = OnlineTrainer(
+            self._online_config(storage, stream_csv),
+            registry=Registry(),
+            notify=lambda s, m: notified.append((s, m)),
+        )
+        summary = tr.run()
+        assert summary["swaps"] == 0
+        assert summary["candidates_rejected"] >= 1
+        assert any(
+            f["stage"] == "swap" and "online.swap" in f["error"]
+            for f in summary["failures"]
+        )
+        assert any(f["site"] == "online.swap" for f in fired_log())
+        assert notified == []  # no swap, no daemon nudge
+        with open(meta_path) as f:
+            assert f.read() == sidecar_before
+
+    def test_bad_swap_rolls_back_on_serving_residuals(
+        self, tmp_path, trained_artifact, well_table
+    ):
+        """Rollback drill: a regressing artifact is swapped in
+        out-of-band; the armed rollback watch sees the serving-side
+        residuals blow past the incumbent's baseline and restores the
+        retained artifact, asserted on counters and served predictions."""
+        from tpuflow.online.controller import OnlineTrainer
+        from tpuflow.online.swap import artifact_mae, promote_candidate
+
+        storage = str(tmp_path / "art")
+        shutil.copytree(trained_artifact["storage"], storage)
+        # A "bad" candidate: the regime-A artifact retrained on regime-B
+        # LABELS with regime-A features (nonsense mapping).
+        from tpuflow.api import train
+
+        bad_rows = [
+            ",".join(
+                v if i != len(_COLS) - 1 else str(float(v) * 10.0)
+                for i, v in enumerate(r.split(","))
+            )
+            for r in _table_rows(well_table)
+        ]
+        bad_csv = str(tmp_path / "bad.csv")
+        _write_csv(bad_csv, bad_rows)
+        cand = str(tmp_path / "cand")
+        train(_base_config(cand, bad_csv, max_epochs=10))
+
+        stream_csv = str(tmp_path / "stream.csv")
+        _write_csv(stream_csv, _table_rows(well_table))
+        cfg = self._online_config(
+            storage, stream_csv, threshold=1e9, warmup_windows=0,
+            rollback_windows=6,
+        )
+        notified = []
+        reg = Registry()
+        tr = OnlineTrainer(
+            cfg, registry=reg, notify=lambda s, m: notified.append((s, m))
+        )
+        # Seed the healthy-residual baseline on a few regime-A windows.
+        chunks = list(tr._chunks())
+        for i, c in enumerate(chunks[:3]):
+            tr.watchdog.observe_window(
+                c, y=c["flow"], residuals=tr._residuals(c), index=i
+            )
+        baseline = tr.watchdog.residual_baseline
+        assert baseline is not None
+        # Out-of-band bad swap, then arm the watch (the operator path).
+        promote_candidate(storage, "static_mlp", cand, registry=reg)
+        tr._reload_generation()
+        tr.arm_rollback_watch(baseline)
+        good_mae = None
+        for i, c in enumerate(chunks[3:6]):
+            if tr._maybe_rollback(3 + i, tr._residuals(c)):
+                break
+        else:
+            pytest.fail("rollback watch never fired on a 10x-residual swap")
+        assert tr.rollbacks == 1
+        assert reg.counter("online_rollbacks_total", "").value() == 1
+        assert notified, "rollback must nudge the daemons"
+        # The serving path answers like the retained (good) artifact.
+        probe = {c: np.asarray(well_table[c][:200]) for c in _COLS}
+        good_mae = artifact_mae(storage, "static_mlp", probe, "flow")
+        assert good_mae < trained_artifact["report"].test_mae * 3

@@ -97,6 +97,13 @@ RULES = {
               "owns stalls behind it. Run blocking work on an executor "
               "(loop.run_in_executor) or use the async equivalent "
               "(asyncio.sleep); the tpuflow/serve_async.py contract",
+    "TPF010": "jax/jnp call inside a streaming-window consumer loop in "
+              "tpuflow/online/: drift scoring must stay host-side numpy "
+              "— a device call (and its sync) per window stalls ingest "
+              "behind the accelerator. Score with numpy at loop level; "
+              "device work (the retrain) belongs in a helper the loop "
+              "calls, where it runs once per retrain, not once per "
+              "window",
 }
 
 _HOST_SYNC_NAMES = {"float", "bool"}
@@ -179,6 +186,17 @@ def _collect_jitted_names(tree: ast.AST) -> set[str]:
 _COMPAT_OWNED_JAX_ATTRS = {"make_mesh", "shard_map", "set_mesh"}
 _COMPAT_MODULE_SUFFIX = "parallel/compat.py"
 
+# TPF010: scope and trigger. The rule fires only in the online package
+# (the one place a per-window device sync stalls a live ingest loop);
+# a "streaming-window consumer loop" is a for-loop whose ITERABLE
+# mentions one of these words (the stream/window/chunk sources the
+# package consumes). jax/jnp attribute roots inside such a loop's body
+# — without descending into nested defs, whose callers own their
+# context — are findings.
+_ONLINE_PATH_FRAGMENT = "tpuflow/online/"
+_STREAM_ITER_WORDS = ("window", "stream", "chunk", "batch", "source")
+_DEVICE_ROOTS = {"jax", "jnp"}
+
 
 class _Linter(ast.NodeVisitor):
     def __init__(self, path: str, source: str, sites: dict):
@@ -190,9 +208,9 @@ class _Linter(ast.NodeVisitor):
         self.findings: list[Diagnostic] = []
         self._jit_depth = 0
         self._async_depth = 0
-        self._is_compat = path.replace(os.sep, "/").endswith(
-            _COMPAT_MODULE_SUFFIX
-        )
+        norm = path.replace(os.sep, "/")
+        self._is_compat = norm.endswith(_COMPAT_MODULE_SUFFIX)
+        self._is_online = _ONLINE_PATH_FRAGMENT in norm
 
     def run(self) -> list[Diagnostic]:
         self.visit(self.tree)
@@ -275,7 +293,59 @@ class _Linter(ast.NodeVisitor):
 
     def visit_For(self, node) -> None:
         self._check_step_aux_loop(node)
+        self._check_online_consumer_loop(node)
         self.generic_visit(node)
+
+    # --- TPF010: device calls in online streaming consumer loops ---
+
+    @staticmethod
+    def _mentions_stream_word(expr: ast.AST) -> bool:
+        for sub in ast.walk(expr):
+            name = (
+                sub.id if isinstance(sub, ast.Name)
+                else sub.attr if isinstance(sub, ast.Attribute)
+                else None
+            )
+            if name and any(
+                w in name.lower() for w in _STREAM_ITER_WORDS
+            ):
+                return True
+        return False
+
+    def _walk_one_consumer_loop(self, node: ast.AST):
+        """Subtree without nested function defs (their callers own the
+        context) and without nested loops that are THEMSELVES consumer
+        loops — those get their own visit_For, and descending into them
+        here would report each finding once per enclosing loop. Nested
+        non-consumer loops (``for _ in range(k)``) stay in scope: their
+        bodies still run once per streamed window."""
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (
+                ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+            )):
+                continue
+            if isinstance(sub, (ast.For, ast.AsyncFor)) \
+                    and self._mentions_stream_word(sub.iter):
+                continue
+            yield sub
+            stack.extend(ast.iter_child_nodes(sub))
+
+    def _check_online_consumer_loop(self, node: ast.For) -> None:
+        if not self._is_online or not self._mentions_stream_word(node.iter):
+            return
+        for sub in self._walk_one_consumer_loop(node):
+            if (
+                isinstance(sub, ast.Attribute)
+                and isinstance(sub.value, ast.Name)
+                and sub.value.id in _DEVICE_ROOTS
+            ):
+                self._emit(
+                    "TPF010", sub,
+                    f"{sub.value.id}.{sub.attr} in a streaming-window "
+                    "consumer loop",
+                )
 
     # --- TPF007: unbounded while-True poll loops ---
 

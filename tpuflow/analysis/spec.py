@@ -333,6 +333,43 @@ def _check_elastic(config) -> list[Diagnostic]:
     return out
 
 
+def _check_online(config) -> list[Diagnostic]:
+    out = []
+    ws = getattr(config, "warm_start", None)
+    if ws is not None and not isinstance(ws, str):
+        out.append(_diag(
+            "spec.warm_start.type",
+            f"warm_start must be an artifact storage_path string or "
+            f"null, got {type(ws).__name__}",
+            where="warm_start",
+        ))
+    block = getattr(config, "online", None)
+    if block is None:
+        return out
+    from tpuflow.online import validate_online_block
+
+    out += [
+        _diag("spec.online.invalid", msg, where="online")
+        for msg in validate_online_block(block)
+    ]
+    if not config.storage_path:
+        out.append(_diag(
+            "spec.online.storage",
+            "online training needs storage_path (the serving artifact "
+            "is the loop's anchor — warm starts resume from it, swaps "
+            "promote into it)",
+            where="storage_path",
+        ))
+    if config.data_path is None:
+        out.append(_diag(
+            "spec.online.data_path",
+            "online training needs data_path (the stream to score and "
+            "retrain on)",
+            where="data_path",
+        ))
+    return out
+
+
 def validate_spec(config) -> list[Diagnostic]:
     """Cross-field validation of a ``TrainJobConfig``; returns ALL
     findings, never raises on a bad spec.
@@ -346,7 +383,7 @@ def validate_spec(config) -> list[Diagnostic]:
     for check in (
         _check_registries, _check_schema, _check_scalars,
         _check_windowing, _check_stream, _check_storage, _check_health,
-        _check_faults, _check_elastic,
+        _check_faults, _check_elastic, _check_online,
     ):
         try:
             out += check(config)

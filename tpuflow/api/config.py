@@ -68,6 +68,14 @@ class TrainJobConfig:
     # --- fault tolerance (SURVEY §5.3; requires storage_path) ---
     save_every: int = 0  # epochs between full-state run checkpoints
     resume: bool = False  # continue from the latest run checkpoint
+    # Warm start: storage_path of an EXISTING artifact whose best params
+    # are overlaid onto the freshly-built state via
+    # train/resume.py::apply_params before fitting — the online loop's
+    # retrain resumes from the SERVING artifact this way (not from a run
+    # checkpoint: the serving artifact is the state the fleet actually
+    # answers with). The artifact must be the same model/model_kwargs;
+    # a mismatch fails loudly naming the first mismatching leaf paths.
+    warm_start: str | None = None
     fault_epoch: int | None = None  # inject a simulated preemption (tests)
     fault_hard: bool = False  # preempt WITHOUT committing async ckpt writes
     ckpt_async: bool = True  # False: synchronous checkpoint writes
@@ -90,6 +98,18 @@ class TrainJobConfig:
     # the preflight spec pass; normally assembled by
     # tpuflow.elastic.runner.worker_spec, not by hand.
     elastic: dict | None = None
+    # --- online continuous training (tpuflow/online) ---
+    # When set, `python -m tpuflow.online` / `cli --online` runs this
+    # job as a continuous loop: streaming windows of data_path are
+    # scored against the serving artifact's reference stats (drift
+    # watchdog), drift (or a scheduled cadence) triggers a warm-start
+    # retrain on a bounded replay of recent windows, and a
+    # non-regressing candidate is hot-swapped into the serving artifact
+    # path with rollback on post-swap regression. Knobs and defaults in
+    # tpuflow/online/__init__.py (ONLINE_DEFAULTS); every knob also has
+    # a TPUFLOW_ONLINE_* env spelling. Spec-validated by the preflight
+    # spec pass. {} enables the loop with defaults.
+    online: dict | None = None
 
     # --- observability ---
     trace_dir: str | None = None  # jax.profiler trace of the first epoch

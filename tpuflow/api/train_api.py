@@ -699,6 +699,29 @@ def _train_impl(
     sample_x = val_ds.x[:2] if config.stream else train_ds.x[:2]
     state = create_state(model, jax.random.PRNGKey(config.seed), sample_x, tx)
 
+    if config.warm_start:
+        # Warm start from an ARTIFACT's best params (the online loop's
+        # retrain-from-the-serving-artifact path): overlay via
+        # apply_params so a model/config mismatch fails loudly naming
+        # the first mismatching leaf paths, before any epoch runs.
+        # Optimizer state stays fresh — the warm start transfers the
+        # weights, not a previous run's trajectory bookkeeping.
+        from tpuflow.train.checkpoint import BestCheckpointer
+        from tpuflow.train.resume import apply_params, check_params_match
+
+        ws = BestCheckpointer(config.warm_start, config.model)
+        try:
+            # Compatibility first, against the checkpoint's METADATA: a
+            # structurally-different artifact fails here with the first
+            # mismatching leaf paths named (check_params_match), not
+            # inside Orbax's template matching as an opaque pytree
+            # error. Only a compatible artifact pays for the restore.
+            check_params_match(state.params, ws.best_structure())
+            warm = ws.restore_best(state.params)
+        finally:
+            ws.close()
+        state = apply_params(state, warm)
+
     # --- parallelism: DP over the mesh when >1 device; DP x TP when
     # config.tp > 1 (GSPMD megatron layout, parallel/tp_train.py) ---
     # (model-axis configs were validated by _validate_model_axis before

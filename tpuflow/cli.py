@@ -124,6 +124,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "heartbeat eviction can't end its process) is "
                         "killed and restarted; set above first-epoch "
                         "compile time")
+    p.add_argument("--online", action="store_true",
+                   help="run the continuous-training loop (tpuflow/online) "
+                        "as a sidecar instead of one batch train: stream "
+                        "--data, detect drift against the serving artifact "
+                        "under storagePath, warm-start retrain on drift, "
+                        "and hot-swap non-regressing candidates (knobs via "
+                        "TPUFLOW_ONLINE_*; docs/online.md)")
+    p.add_argument("--online-max-windows", type=int, default=None,
+                   metavar="N",
+                   help="with --online: stop after N streaming windows "
+                        "(default: run the stream out)")
+    p.add_argument("--online-daemon", default=None, metavar="URL",
+                   help="with --online: serving daemon(s) to POST "
+                        "/artifacts/reload after a swap (comma-separated)")
     p.add_argument("--predict", action="store_true",
                    help="serve: load the trained artifact from storagePath and predict --data")
     p.add_argument("--out", default=None, help="with --predict: write predictions CSV here")
@@ -283,6 +297,32 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 2
+    if args.online:
+        if not config.storage_path or not config.data_path:
+            print(
+                "--online needs storagePath (the serving artifact the "
+                "loop warm-starts from and swaps into) and --data (the "
+                "stream to score)",
+                file=sys.stderr,
+            )
+            return 2
+        import json as _json
+
+        from tpuflow.online import run_online
+
+        try:
+            summary = run_online(
+                config,
+                max_windows=args.online_max_windows,
+                daemon_url=args.online_daemon,
+            )
+        except (ValueError, FileNotFoundError) as e:
+            # Submission-shaped: a missing artifact or bad online block
+            # is a message, not a traceback.
+            print(f"--online: {e}", file=sys.stderr)
+            return 2
+        print(_json.dumps(summary))
+        return 0
     if args.compare:
         from tpuflow.api import compare
 

@@ -1,0 +1,236 @@
+"""Online learning loop: streaming drift detection → warm-start retrain
+→ zero-downtime artifact swap (ROADMAP item 5; docs/online.md).
+
+The paper's system is a live well-monitoring service, not a batch
+trainer — yet until this subsystem tpuflow trained once and served
+forever, going *degraded* (the Gilbert fallback) rather than *adaptive*
+when the world changed. This package wires four existing ingredients
+into a continuous-training control loop:
+
+- :mod:`tpuflow.online.drift` — a windowed **data watchdog** in the mold
+  of ``obs/health.py::NumericsWatchdog``: reference feature/label
+  statistics captured at artifact-build time (they live in the serving
+  sidecar), streaming windows scored against them host-side, a
+  Gilbert-residual/serving-residual degradation tracker, warmup-gated so
+  the detector never trips on its own baseline. Anomalies publish
+  ``online_drift_score{feature=...}`` gauges, forensics events, and the
+  typed :class:`~tpuflow.online.drift.DriftDetected`.
+- :mod:`tpuflow.online.controller` — :class:`OnlineTrainer`: consumes
+  the bounded-memory CSV stream (``data/stream.py``), maintains a
+  bounded replay of recent windows plus a held-back eval slice, and on
+  drift (or a scheduled cadence) launches a warm-start retrain — resume
+  from the *serving* artifact via ``train/resume.py::apply_params``
+  (``TrainJobConfig.warm_start``), train on the replay, emit a candidate
+  artifact — optionally under the existing supervisor so crash-loop /
+  divergence classification applies.
+- :mod:`tpuflow.online.swap` — promotion with a **shadow-eval gate**
+  (candidate vs incumbent on the held-back slice; only a non-regressing
+  candidate is promoted), atomic-rename promotion next to the serving
+  checkpoint with the previous artifact retained, and **rollback** on
+  post-swap regression (tracked via serving-side residuals).
+- Serving integration: both daemons accept ``POST /artifacts/reload``
+  and reload through the instance-grouped batcher path, so in-flight
+  requests finish against the old artifact and no request is dropped.
+
+Fault sites ``online.drift`` / ``online.retrain`` / ``online.swap`` /
+``online.rollback`` make the loop drillable (docs/resilience.md).
+
+Run: ``python -m tpuflow.online spec.json`` or
+``python -m tpuflow.cli ... --online``.
+"""
+
+from __future__ import annotations
+
+# Knob catalog: the ``TrainJobConfig.online`` block's keys, their
+# defaults, and (via resolve_online) their TPUFLOW_ONLINE_* env
+# spellings. Resolution order: block value > env var > default — the
+# block is the job's explicit intent; env is the operator's fleet-wide
+# dial. Every env read is validated at read time through the shared
+# tpuflow/utils/env.py helpers (the TPUFLOW_SERVE_*/TPUFLOW_RETRY_*
+# precedent).
+ONLINE_DEFAULTS: dict = {
+    # Streaming/scoring
+    "window_rows": 256,       # rows per scored drift window
+    "threshold": 4.0,         # standardized mean-shift trip point (z)
+    "var_factor": 4.0,        # variance-ratio trip point (x or 1/x)
+    "residual_factor": 3.0,   # residual-degradation trip point (x EWMA)
+    "warmup_windows": 3,      # windows before the detector may trip
+    # Replay / eval holdback
+    "replay_windows": 16,     # bounded replay of recent windows
+    "eval_every": 5,          # every Nth window held back for shadow eval
+    "eval_windows": 4,        # bound on retained eval windows
+    # Retrain policy
+    "retrain_every": 0,       # scheduled cadence in windows (0 = drift-only)
+    "retrain_epochs": 20,     # max_epochs of each warm-start retrain
+    "min_retrain_gap": 2,     # windows between consecutive retrains
+    "mode": "inprocess",      # "inprocess" | "supervised" (subprocess +
+                              # crash-loop/divergence classification)
+    "max_restarts": 1,        # supervised mode's restart budget
+    # Promotion / rollback
+    "margin": 0.05,           # shadow-eval non-regression margin (frac)
+    "rollback": True,         # auto-rollback on post-swap regression
+    "rollback_windows": 8,    # post-swap regression watch budget
+    "daemon_url": None,       # serving daemon(s) to notify, comma-sep
+}
+
+_MODES = ("inprocess", "supervised")
+
+# env var name per knob (daemon_url included: a sidecar deployment sets
+# the fleet's daemon address once, in the environment).
+_ENV_NAMES = {
+    "window_rows": "TPUFLOW_ONLINE_WINDOW_ROWS",
+    "threshold": "TPUFLOW_ONLINE_THRESHOLD",
+    "var_factor": "TPUFLOW_ONLINE_VAR_FACTOR",
+    "residual_factor": "TPUFLOW_ONLINE_RESIDUAL_FACTOR",
+    "warmup_windows": "TPUFLOW_ONLINE_WARMUP",
+    "replay_windows": "TPUFLOW_ONLINE_REPLAY",
+    "eval_every": "TPUFLOW_ONLINE_EVAL_EVERY",
+    "eval_windows": "TPUFLOW_ONLINE_EVAL_WINDOWS",
+    "retrain_every": "TPUFLOW_ONLINE_RETRAIN_EVERY",
+    "retrain_epochs": "TPUFLOW_ONLINE_RETRAIN_EPOCHS",
+    "min_retrain_gap": "TPUFLOW_ONLINE_MIN_RETRAIN_GAP",
+    "mode": "TPUFLOW_ONLINE_MODE",
+    "max_restarts": "TPUFLOW_ONLINE_MAX_RESTARTS",
+    "margin": "TPUFLOW_ONLINE_MARGIN",
+    "rollback": "TPUFLOW_ONLINE_ROLLBACK",
+    "rollback_windows": "TPUFLOW_ONLINE_ROLLBACK_WINDOWS",
+    "daemon_url": "TPUFLOW_ONLINE_DAEMON_URL",
+}
+
+# (cast, minimum) per numeric knob — shared by the env reads and the
+# block validation so the two paths cannot drift.
+_INT_KNOBS = {
+    "window_rows": 1, "warmup_windows": 0, "replay_windows": 1,
+    "eval_every": 1, "eval_windows": 0, "retrain_every": 0,
+    "retrain_epochs": 1, "min_retrain_gap": 0, "max_restarts": 0,
+    "rollback_windows": 0,
+}
+_FLOAT_KNOBS = {
+    "threshold": 0.0, "var_factor": 1.0, "residual_factor": 1.0,
+    "margin": 0.0,
+}
+
+
+def _env_overrides() -> dict:
+    """The TPUFLOW_ONLINE_* values present in the environment, validated
+    at read time (a malformed value raises a ValueError naming the
+    variable and the expected form — the shared utils/env.py contract)."""
+    import os
+
+    from tpuflow.utils.env import env_choice, env_flag, env_num
+
+    out: dict = {}
+    for knob, minimum in _INT_KNOBS.items():
+        name = _ENV_NAMES[knob]
+        if os.environ.get(name, "").strip():
+            out[knob] = env_num(name, None, int, minimum=minimum)
+    for knob, minimum in _FLOAT_KNOBS.items():
+        name = _ENV_NAMES[knob]
+        if os.environ.get(name, "").strip():
+            out[knob] = env_num(name, None, float, minimum=minimum)
+            if knob == "threshold" and out[knob] == 0:
+                # The watchdog requires a strictly positive trip point;
+                # env_num's minimum is inclusive.
+                raise ValueError(
+                    f"invalid {name}={os.environ[name]!r}: expected "
+                    "a number > 0"
+                )
+    if os.environ.get(_ENV_NAMES["mode"], "").strip():
+        out["mode"] = env_choice(_ENV_NAMES["mode"], "inprocess", _MODES)
+    if os.environ.get(_ENV_NAMES["rollback"], "").strip():
+        out["rollback"] = env_flag(_ENV_NAMES["rollback"], True)
+    raw_url = os.environ.get(_ENV_NAMES["daemon_url"], "").strip()
+    if raw_url:
+        out["daemon_url"] = raw_url
+    return out
+
+
+def validate_online_block(block) -> list[str]:
+    """Validation messages for a ``TrainJobConfig.online`` block (empty =
+    valid). Never raises — the preflight spec pass turns each message
+    into a Diagnostic so one submission reports every problem at once."""
+    if not isinstance(block, dict):
+        return [
+            f"online must be a dict of knobs, got {type(block).__name__}"
+        ]
+    msgs = []
+    unknown = sorted(set(block) - set(ONLINE_DEFAULTS))
+    if unknown:
+        msgs.append(
+            f"unknown online knob(s) {unknown}; known: "
+            f"{sorted(ONLINE_DEFAULTS)}"
+        )
+    for knob, minimum in _INT_KNOBS.items():
+        if knob not in block:
+            continue
+        v = block[knob]
+        if not isinstance(v, int) or isinstance(v, bool) or v < minimum:
+            msgs.append(
+                f"online.{knob} must be an integer >= {minimum}, "
+                f"got {v!r}"
+            )
+    for knob, minimum in _FLOAT_KNOBS.items():
+        if knob not in block:
+            continue
+        v = block[knob]
+        if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                or v < minimum:
+            msgs.append(
+                f"online.{knob} must be a number >= {minimum:g}, "
+                f"got {v!r}"
+            )
+        elif knob == "threshold" and v == 0:
+            # The watchdog's trip point is strictly positive — a zero
+            # threshold would flag every window as drifted.
+            msgs.append(f"online.threshold must be a number > 0, got {v!r}")
+    if "mode" in block and block["mode"] not in _MODES:
+        msgs.append(
+            f"online.mode must be one of {', '.join(_MODES)}, "
+            f"got {block['mode']!r}"
+        )
+    if "rollback" in block and not isinstance(block["rollback"], bool):
+        msgs.append(
+            f"online.rollback must be a bool, got {block['rollback']!r}"
+        )
+    if "daemon_url" in block and block["daemon_url"] is not None \
+            and not isinstance(block["daemon_url"], str):
+        msgs.append(
+            f"online.daemon_url must be a string URL (comma-separated "
+            f"for several daemons) or null, got {block['daemon_url']!r}"
+        )
+    return msgs
+
+
+def resolve_online(block: dict | None) -> dict:
+    """The loop's effective knobs: defaults, overlaid by the validated
+    TPUFLOW_ONLINE_* environment, overlaid by the job's explicit block.
+    A malformed block raises ValueError with every message (callers that
+    preflighted never see it)."""
+    block = block or {}
+    msgs = validate_online_block(block)
+    if msgs:
+        raise ValueError("invalid online block: " + "; ".join(msgs))
+    knobs = dict(ONLINE_DEFAULTS)
+    knobs.update(_env_overrides())
+    knobs.update(block)
+    return knobs
+
+
+def __getattr__(name: str):
+    # Lazy re-exports: the spec preflight imports validate_online_block
+    # without paying for jax/predictor imports in the controller.
+    if name in ("DriftDetected", "DataDriftWatchdog", "ReferenceStats",
+                "reference_stats_from_sidecar"):
+        from tpuflow.online import drift
+
+        return getattr(drift, name)
+    if name in ("OnlineTrainer", "run_online"):
+        from tpuflow.online import controller
+
+        return getattr(controller, name)
+    if name in ("shadow_eval", "promote_candidate", "rollback_artifact",
+                "notify_daemons", "serving_residuals"):
+        from tpuflow.online import swap
+
+        return getattr(swap, name)
+    raise AttributeError(f"module 'tpuflow.online' has no attribute {name!r}")

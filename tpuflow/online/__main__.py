@@ -1,0 +1,77 @@
+"""CLI sidecar entry: ``python -m tpuflow.online spec.json``.
+
+Runs the online learning loop (docs/online.md) against the job spec's
+``data_path`` stream and serving artifact. The spec is the same JSON the
+job-runner and supervisor accept (``tpuflow.serve.spec_to_config`` —
+camelCase or snake_case fields); the loop's knobs come from the spec's
+``online`` block and/or the ``TPUFLOW_ONLINE_*`` environment.
+
+Typical sidecar deployment: the serving daemon runs
+``python -m tpuflow.cli serve`` while this process tails the live data
+feed next to it and nudges it over ``POST /artifacts/reload`` after
+every promotion::
+
+    python -m tpuflow.online spec.json --daemon-url http://127.0.0.1:8700
+
+``--max-windows N`` bounds the pass (drills, backfills, smoke tests);
+the summary JSON lands on stdout either way.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="tpuflow.online",
+        description="continuous-training sidecar: drift detection -> "
+        "warm-start retrain -> zero-downtime artifact swap",
+    )
+    p.add_argument("spec", help="job spec JSON file (serve/supervisor format)")
+    p.add_argument(
+        "--max-windows", type=int, default=None, metavar="N",
+        help="stop after N streaming windows (default: run the stream out)",
+    )
+    p.add_argument(
+        "--daemon-url", default=None, metavar="URL",
+        help="serving daemon(s) to POST /artifacts/reload after a swap "
+        "(comma-separated; also online.daemon_url / "
+        "TPUFLOW_ONLINE_DAEMON_URL)",
+    )
+    args = p.parse_args(argv)
+
+    from tpuflow.serve import spec_to_config
+
+    try:
+        with open(args.spec, encoding="utf-8") as f:
+            config = spec_to_config(json.load(f))
+    except (OSError, json.JSONDecodeError, ValueError, TypeError) as e:
+        print(f"tpuflow.online: bad spec {args.spec!r}: {e}", file=sys.stderr)
+        return 2
+
+    from tpuflow.analysis import ensure_preflight
+
+    try:
+        ensure_preflight(config, passes=("spec",))
+        from tpuflow.online.controller import run_online
+
+        summary = run_online(
+            config,
+            max_windows=args.max_windows,
+            daemon_url=args.daemon_url,
+        )
+    except (ValueError, FileNotFoundError) as e:
+        # Submission-shaped errors (bad online block, missing artifact,
+        # missing stream): a message, not a traceback.
+        print(f"tpuflow.online: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,541 @@
+"""The online control loop: stream → score → replay → retrain → swap.
+
+:class:`OnlineTrainer` closes the loop the seed system never had
+(ROADMAP item 5): it consumes the bounded-memory CSV stream
+(``data/stream.py::stream_csv_columns`` — the same chunked ingest the
+streaming trainer rides), scores every window against the serving
+artifact's reference stats (``online/drift.py``), and keeps two bounded
+buffers:
+
+- a **replay window** of the most recent raw chunks (the retrain
+  corpus: the world as it looks NOW), and
+- a held-back **eval slice** (every ``eval_every``-th chunk — excluded
+  from replay so the shadow-eval gate never scores a candidate on its
+  own training data).
+
+On drift (or a scheduled ``retrain_every`` cadence) it launches a
+warm-start retrain: the replay is spilled to a headerless CSV in the
+job's schema order, and the job's own ``train()`` runs against it with
+``warm_start`` pointed at the SERVING artifact — so the candidate
+resumes from the weights the fleet is answering with, via
+``train/resume.py::apply_params``, and inherits every production
+guardrail (preflight, numerics watchdog, forensics). ``mode:
+"supervised"`` runs the retrain under ``train/supervisor.py::supervise``
+instead, so crash-loop and divergence classification apply to the
+continuous loop exactly as they do to batch jobs; ``"inprocess"`` (the
+default, and the drills' mode — the elastic runner's precedent) calls
+``train()`` directly, where the numerics watchdog's typed
+``NumericsDivergence`` still classifies a diverging retrain.
+
+A finished candidate faces the **shadow-eval gate** (``online/swap.py``)
+against the incumbent on the held-back slice; only a non-regressing
+candidate is promoted (atomic renames, previous artifact retained), the
+serving daemons are nudged over ``POST /artifacts/reload``, and for the
+next ``rollback_windows`` windows the loop watches the NEW artifact's
+serving-side residuals against the incumbent's pre-swap baseline — a
+post-swap regression triggers automatic rollback to the retained
+artifact. A failed or rejected retrain is counted, recorded, and
+survived: a continuous loop must outlive one bad candidate.
+
+Fault sites: ``online.retrain`` (indexed by retrain number) at launch,
+``online.drift`` per scored window (in the watchdog), ``online.swap`` /
+``online.rollback`` in the swap module.
+"""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+import os
+import shutil
+import time
+from collections import deque
+
+import numpy as np
+
+from tpuflow.online import resolve_online
+from tpuflow.online.drift import (
+    DataDriftWatchdog,
+    reference_stats_from_sidecar,
+)
+from tpuflow.online.swap import (
+    _require_local,
+    notify_daemons,
+    promote_candidate,
+    rollback_artifact,
+    serving_residuals,
+    shadow_eval,
+)
+from tpuflow.obs.forensics import record_event
+from tpuflow.obs.metrics import default_registry
+from tpuflow.resilience import fault_point
+from tpuflow.utils.paths import join_path
+
+# Drift kinds that justify a retrain. feature_variance alone is advisory
+# (a noisy sensor widens without the relationship moving); the shift and
+# degradation kinds mean the model is answering a different world.
+_RETRAIN_KINDS = frozenset(
+    {"feature_shift", "target_shift", "residual_degradation"}
+)
+
+
+class OnlineTrainer:
+    """One continuous-training loop for one serving artifact.
+
+    ``config`` is the job's :class:`~tpuflow.api.config.TrainJobConfig`
+    — ``storage_path`` anchors the serving artifact, ``data_path`` is
+    the stream, and ``config.online`` carries the loop knobs
+    (``tpuflow.online.ONLINE_DEFAULTS``; every knob also reads a
+    ``TPUFLOW_ONLINE_*`` env spelling). ``source`` (tests) overrides the
+    stream with any iterator of column-dict chunks; ``notify`` (tests)
+    overrides daemon notification with a callable ``(storage, model)``.
+    """
+
+    def __init__(self, config, *, source=None, registry=None, notify=None):
+        if not config.storage_path:
+            raise ValueError(
+                "online training needs storage_path (the serving "
+                "artifact is the loop's anchor — warm starts resume "
+                "from it, swaps promote into it)"
+            )
+        if source is None and not config.data_path:
+            raise ValueError(
+                "online training needs data_path (the stream to score "
+                "and retrain on)"
+            )
+        # Local storage only, enforced AT THE DOOR: promote_candidate
+        # would reject a gs:// path anyway, but only after a full
+        # retrain — and the replay spill would mkdir a literal local
+        # './gs:/...' tree on the way there.
+        _require_local(config.storage_path)
+        self.config = config
+        self.knobs = resolve_online(config.online)
+        self.storage = config.storage_path
+        self.model = config.model
+        self._source = source
+        self._notify = notify
+        self.registry = registry or default_registry()
+
+        from tpuflow.data.schema import Schema
+        from tpuflow.data.synthetic import (
+            SYNTHETIC_COLUMN_NAMES,
+            SYNTHETIC_COLUMN_TYPES,
+            SYNTHETIC_TARGET,
+        )
+
+        self.schema = Schema.from_cli(
+            config.column_names or SYNTHETIC_COLUMN_NAMES,
+            config.column_types or SYNTHETIC_COLUMN_TYPES,
+            config.target or SYNTHETIC_TARGET,
+        )
+        self.target = self.schema.target
+        # The serving artifact's reference stats ARE the drift baseline
+        # (captured at artifact build time, stored in the sidecar) —
+        # a missing artifact fails here, at the door.
+        self.ref = reference_stats_from_sidecar(self.storage, self.model)
+        self.watchdog = self._new_watchdog()
+
+        self.replay: deque = deque(maxlen=int(self.knobs["replay_windows"]))
+        self.eval_chunks: deque = deque(
+            maxlen=max(int(self.knobs["eval_windows"]), 1)
+        )
+        self._predictor = None
+        self.windows_seen = 0
+        self.anomaly_count = 0
+        self.retrains = 0
+        self.swaps = 0
+        self.rollbacks = 0
+        self.rejected = 0
+        self.failures: list[dict] = []
+        self._last_retrain_window = None
+        # Post-swap regression watch: windows remaining and the
+        # incumbent's healthy-residual baseline snapshotted at swap time.
+        self._watch_left = 0
+        self._resid_baseline: float | None = None
+
+        self._counters = {
+            name: self.registry.counter(f"online_{name}_total", help)
+            for name, help in (
+                ("windows", "streaming windows consumed by the loop"),
+                ("retrains", "warm-start retrains launched"),
+                ("swaps_notified", "daemon reload nudges sent"),
+                ("candidates_rejected",
+                 "candidates rejected (shadow-eval gate, retrain "
+                 "failure, or injected fault)"),
+            )
+        }
+        self._replay_gauge = self.registry.gauge(
+            "online_replay_rows", "rows currently held in the replay window"
+        )
+
+    # --- plumbing ------------------------------------------------------
+
+    def _new_watchdog(self) -> DataDriftWatchdog:
+        return DataDriftWatchdog(
+            self.ref,
+            threshold=self.knobs["threshold"],
+            var_factor=self.knobs["var_factor"],
+            residual_factor=self.knobs["residual_factor"],
+            warmup_windows=self.knobs["warmup_windows"],
+            registry=self.registry,
+            model_name=self.model,
+        )
+
+    def _chunks(self):
+        if self._source is not None:
+            return self._source
+        from tpuflow.data.stream import stream_csv_columns
+
+        return stream_csv_columns(
+            self.config.data_path, self.schema,
+            chunk_rows=int(self.knobs["window_rows"]),
+        )
+
+    def _serving_predictor(self):
+        """The CURRENT serving artifact, loaded once per generation —
+        dropped on every swap/rollback exactly like the daemons drop
+        their cache on /artifacts/reload."""
+        if self._predictor is None:
+            from tpuflow.api.predict_api import Predictor
+
+            self._predictor = Predictor.load(self.storage, self.model)
+        return self._predictor
+
+    def _reload_generation(self) -> None:
+        """Adopt a new serving generation: fresh predictor, fresh
+        reference stats from the new sidecar, fresh (warmup-gated)
+        watchdog — the new baseline never inherits the old regime's
+        EWMAs, so the detector cannot trip on its own swap."""
+        self._predictor = None
+        self.ref = reference_stats_from_sidecar(self.storage, self.model)
+        self.watchdog = self._new_watchdog()
+
+    def _residuals(self, columns) -> np.ndarray | None:
+        """Serving-side residuals of the current artifact on one chunk —
+        best-effort: drift scoring must survive a mid-swap predictor
+        load failure (degraded serving is the daemons' answer; skipping
+        one residual window is the loop's)."""
+        if self.target not in columns:
+            return None
+        try:
+            pred = self._serving_predictor()
+            return serving_residuals(pred, dict(columns), self.target)
+        except Exception as e:  # noqa: BLE001 — scoring must outlive loads
+            record_event(
+                "online_residuals_skipped",
+                error=f"{type(e).__name__}: {e}",
+            )
+            return None
+
+    def _replay_rows(self) -> int:
+        return sum(
+            len(next(iter(c.values()))) for c in self.replay
+        ) if self.replay else 0
+
+    # --- the loop ------------------------------------------------------
+
+    def run(self, max_windows: int | None = None) -> dict:
+        """Consume the stream (bounded by ``max_windows`` when set);
+        returns the loop summary. One pass over a finite file is a
+        drill/backfill; a sidecar deployment points ``source`` at a
+        growing log and never returns."""
+        eval_every = max(int(self.knobs["eval_every"]), 1)
+        retrain_every = int(self.knobs["retrain_every"])
+        min_gap = int(self.knobs["min_retrain_gap"])
+        for idx, columns in enumerate(self._chunks()):
+            if max_windows is not None and idx >= max_windows:
+                break
+            self._counters["windows"].inc()
+            self.windows_seen += 1
+            y = columns.get(self.target)
+            residuals = self._residuals(columns)
+            anomalies = self.watchdog.observe_window(
+                columns, y=y, residuals=residuals, index=idx
+            )
+            # Loop-level tallies: the watchdog is replaced on every
+            # generation change (fresh baseline), so ITS counts reset.
+            self.anomaly_count += len(anomalies)
+
+            if self._maybe_rollback(idx, residuals):
+                continue  # this window judged the old swap, not the data
+
+            held_back = idx % eval_every == 0
+            if held_back:
+                self.eval_chunks.append(columns)
+            else:
+                self.replay.append(columns)
+            self._replay_gauge.set(float(self._replay_rows()))
+
+            drifted = any(a["kind"] in _RETRAIN_KINDS for a in anomalies)
+            scheduled = retrain_every > 0 and idx > 0 \
+                and idx % retrain_every == 0
+            gap_ok = (
+                self._last_retrain_window is None
+                or idx - self._last_retrain_window >= min_gap
+            )
+            if (drifted or scheduled) and gap_ok and self.replay:
+                self._retrain_and_swap(idx, reason=(
+                    "drift" if drifted else "scheduled"
+                ))
+        return self.summary()
+
+    def summary(self) -> dict:
+        return {
+            "model": self.model,
+            "storage_path": self.storage,
+            "windows": self.windows_seen,
+            "anomalies": self.anomaly_count,
+            "retrains": self.retrains,
+            "swaps": self.swaps,
+            "rollbacks": self.rollbacks,
+            "candidates_rejected": self.rejected,
+            "failures": list(self.failures),
+        }
+
+    # --- rollback watch ------------------------------------------------
+
+    def arm_rollback_watch(self, baseline: float | None) -> None:
+        """Start (or re-start) the post-swap regression watch against a
+        healthy-residual ``baseline`` — called internally after every
+        promotion; callable by an operator after an out-of-band swap."""
+        if not self.knobs["rollback"] or baseline is None:
+            self._watch_left = 0
+            self._resid_baseline = None
+            return
+        self._watch_left = int(self.knobs["rollback_windows"])
+        self._resid_baseline = float(baseline)
+
+    def _maybe_rollback(self, idx: int, residuals) -> bool:
+        """Post-swap regression check: within the watch budget, a window
+        whose mean serving residual exceeds ``residual_factor`` x the
+        pre-swap healthy baseline rolls the swap back."""
+        if self._watch_left <= 0 or self._resid_baseline is None:
+            return False
+        self._watch_left -= 1
+        if residuals is None or not len(residuals):
+            return False
+        mean_resid = float(np.abs(np.asarray(residuals)).mean())
+        factor = float(self.knobs["residual_factor"])
+        if mean_resid <= factor * max(self._resid_baseline, 1e-12):
+            return False
+        try:
+            rollback_artifact(
+                self.storage, self.model, registry=self.registry
+            )
+        except Exception as e:  # noqa: BLE001 — the loop must survive
+            self.failures.append({
+                "window": idx, "stage": "rollback",
+                "error": f"{type(e).__name__}: {e}",
+            })
+            record_event(
+                "online_rollback_failed", window=idx,
+                error=f"{type(e).__name__}: {e}",
+            )
+            self._watch_left = 0
+            return False
+        self.rollbacks += 1
+        record_event(
+            "online_rollback", window=idx, mean_residual=mean_resid,
+            baseline=self._resid_baseline, factor=factor,
+        )
+        self._watch_left = 0
+        self._resid_baseline = None
+        self._notify_swap()
+        self._reload_generation()
+        return True
+
+    # --- retrain → gate → swap -----------------------------------------
+
+    def _retrain_and_swap(self, idx: int, reason: str) -> None:
+        n = self.retrains + 1
+        try:
+            fault_point("online.retrain", index=n)
+            candidate = self._train_candidate(idx, n)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:  # noqa: BLE001 — one bad retrain is survivable
+            self.rejected += 1
+            self._counters["candidates_rejected"].inc()
+            self.failures.append({
+                "window": idx, "stage": "retrain",
+                "error": f"{type(e).__name__}: {e}",
+            })
+            record_event(
+                "online_retrain_failed", window=idx, retrain=n,
+                reason=reason, error=f"{type(e).__name__}: {e}",
+            )
+            self._last_retrain_window = idx
+            return
+        self.retrains = n
+        self._counters["retrains"].inc()
+        self._last_retrain_window = idx
+
+        gate = None
+        try:
+            if self.eval_chunks:
+                ev = _merge_chunks(list(self.eval_chunks))
+                gate = shadow_eval(
+                    self.storage, candidate, self.model, ev, self.target,
+                    margin=float(self.knobs["margin"]),
+                )
+            if gate is None or not gate["accept"]:
+                self.rejected += 1
+                self._counters["candidates_rejected"].inc()
+                record_event(
+                    "online_candidate_rejected", window=idx, retrain=n,
+                    reason=(
+                        "no held-back eval slice" if gate is None
+                        else "shadow-eval regression"
+                    ),
+                    **(gate or {}),
+                )
+                return
+            baseline = self.watchdog.residual_baseline
+            promote_candidate(
+                self.storage, self.model, candidate,
+                registry=self.registry,
+            )
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:  # noqa: BLE001 — incl. injected online.swap
+            self.rejected += 1
+            self._counters["candidates_rejected"].inc()
+            self.failures.append({
+                "window": idx, "stage": "swap",
+                "error": f"{type(e).__name__}: {e}",
+            })
+            record_event(
+                "online_swap_failed", window=idx, retrain=n,
+                error=f"{type(e).__name__}: {e}",
+            )
+            return
+        self.swaps += 1
+        record_event(
+            "online_swap", window=idx, retrain=n, reason=reason, **gate
+        )
+        self._notify_swap()
+        self.arm_rollback_watch(baseline)
+        self._reload_generation()
+
+    def _notify_swap(self) -> None:
+        if self._notify is not None:
+            self._notify(self.storage, self.model)
+            self._counters["swaps_notified"].inc()
+            return
+        url = self.knobs.get("daemon_url")
+        if url:
+            for res in notify_daemons(url, self.storage, self.model):
+                # Count only nudges that LANDED: the metric exists so a
+                # dashboard can tell "swaps happen but no daemon hears
+                # about them" from healthy operation.
+                if res.get("ok"):
+                    self._counters["swaps_notified"].inc()
+                record_event("online_daemon_notified", **res)
+
+    def _train_candidate(self, idx: int, n: int) -> str:
+        """Spill the replay to CSV and train the candidate artifact —
+        warm-started from the serving artifact — under
+        ``{storage}/online/candidate``. Returns the candidate storage
+        root."""
+        online_root = join_path(self.storage, "online")
+        replay_csv = os.path.join(online_root, f"replay-{n}.csv")
+        self._spill_replay(replay_csv)
+        candidate = join_path(online_root, "candidate")
+        shutil.rmtree(candidate, ignore_errors=True)
+        os.makedirs(candidate, exist_ok=True)
+
+        supervised = self.knobs["mode"] == "supervised"
+        cand_config = dataclasses.replace(
+            self.config,
+            data_path=replay_csv,
+            storage_path=candidate,
+            warm_start=self.storage,
+            max_epochs=int(self.knobs["retrain_epochs"]),
+            resume=False,
+            stream=False,
+            online=None,
+            verbose=False,
+            faults=[],
+            save_every=1 if supervised else 0,
+            progress_path=None,
+        )
+        record_event(
+            "online_retrain", window=idx, retrain=n,
+            replay_rows=self._replay_rows(), mode=self.knobs["mode"],
+        )
+        t0 = time.monotonic()
+        if supervised:
+            from tpuflow.train.supervisor import supervise
+
+            # The existing supervisor owns the child: restart backoff,
+            # crash-loop classification, terminal NumericsDivergence —
+            # the continuous loop gets batch training's whole failure
+            # taxonomy for free.
+            supervise(
+                dataclasses.asdict(cand_config),
+                max_restarts=int(self.knobs["max_restarts"]),
+                verbose=False,
+            )
+        else:
+            from tpuflow.api import train
+
+            train(cand_config)
+        record_event(
+            "online_retrain_done", window=idx, retrain=n,
+            seconds=round(time.monotonic() - t0, 3),
+        )
+        try:
+            os.remove(replay_csv)
+        except OSError:
+            pass
+        return candidate
+
+    def _spill_replay(self, path: str) -> None:
+        """The replay window as a headerless CSV in schema column order
+        — exactly the on-disk shape ``train()``'s ingest reads."""
+        names = [c.name for c in self.schema.columns]
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8", newline="") as f:
+            writer = csv.writer(f)
+            for chunk in self.replay:
+                missing = [n for n in names if n not in chunk]
+                if missing:
+                    raise ValueError(
+                        f"replay chunk is missing schema column(s) "
+                        f"{missing} — cannot spill a retrain corpus"
+                    )
+                cols = [np.asarray(chunk[n]) for n in names]
+                for row in zip(*cols):
+                    writer.writerow([_cell(v) for v in row])
+
+
+def _cell(value) -> str:
+    """One CSV cell: floats in full precision, everything else str()."""
+    if isinstance(value, (np.floating, float)):
+        return repr(float(value))
+    return str(value)
+
+
+def _merge_chunks(chunks: list[dict]) -> dict:
+    keys = chunks[0].keys()
+    return {
+        k: np.concatenate([np.asarray(c[k]) for c in chunks])
+        for k in keys
+    }
+
+
+def run_online(
+    config,
+    *,
+    max_windows: int | None = None,
+    daemon_url: str | None = None,
+    registry=None,
+) -> dict:
+    """One-call entry: build the trainer and run the loop. ``daemon_url``
+    overrides the knob/env spelling (the CLI's ``--online-daemon``)."""
+    if daemon_url:
+        online = dict(config.online or {})
+        online["daemon_url"] = daemon_url
+        config = dataclasses.replace(config, online=online)
+    trainer = OnlineTrainer(config, registry=registry)
+    return trainer.run(max_windows=max_windows)

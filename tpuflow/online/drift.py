@@ -1,0 +1,341 @@
+"""Streaming data watchdog: windowed drift scoring against the serving
+artifact's reference statistics.
+
+The mold is ``obs/health.py::NumericsWatchdog`` — host-side, post-hoc,
+warmup-gated, policy-light — pointed at the DATA instead of the
+optimizer. The reference statistics are the ones the artifact already
+carries: every serving sidecar records the feature means/stds and target
+mean/std the preprocessor was fitted with at artifact-build time
+(``api/predict_api.py::save_artifact_meta``), so "what the model was
+trained on" needs no extra bookkeeping — a retrained-and-swapped
+artifact automatically refreshes the baseline.
+
+Scoring is strictly host-side numpy: the watchdog sits INSIDE the
+streaming-window consumer loop, and a device sync per window would stall
+ingest (the executable TPF010 lint contract — see
+``tpuflow/analysis/linter.py``). Per window it computes:
+
+- **feature_shift** — per-feature standardized mean shift
+  ``|mean(win) - ref_mean| / ref_std`` (a z-score of the window mean's
+  location against the training distribution);
+- **feature_variance** — the window-variance / reference-variance ratio
+  (a regime can shift its spread without moving its mean);
+- **target_shift** — the same standardized shift for the label column;
+- **residual_degradation** — the mean of a caller-supplied residual
+  array (serving-side ``|prediction - y|``, or the Gilbert-physics
+  residual when no predictor is on hand) against an EWMA of previous
+  healthy windows. Anomalous windows never update the EWMA — a
+  degradation must not raise its own bar — and ``warmup_windows``
+  healthy windows must seed it first, so the detector never trips on
+  its own baseline.
+
+Every anomaly increments ``online_drift_events_total{kind=...}``, lands
+in the forensics ring, and the per-feature scores publish as
+``online_drift_score{feature=...}`` gauges regardless of whether they
+trip (the dashboards want the scores BEFORE they cross the line).
+``observe_window(..., raise_on_drift=True)`` raises the typed
+:class:`DriftDetected`; the online controller consumes the returned
+anomaly list instead.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+import numpy as np
+
+from tpuflow.obs.forensics import record_event
+from tpuflow.obs.metrics import default_registry
+from tpuflow.resilience import fault_point
+
+# Numeric column kinds in the tabular sidecar's schema vocabulary —
+# the columns the fitted pipeline standardized (features.py contract).
+_NUMERIC_KINDS = ("int", "float")
+
+
+class DriftDetected(RuntimeError):
+    """The data watchdog flagged a drifted window.
+
+    ``window`` is the window index the anomaly landed on; ``anomalies``
+    is the trail of ``{"kind", "feature"?, "score", "window"}`` dicts.
+    Typed (like ``NumericsDivergence``) so callers can classify it:
+    drift is a *signal* to adapt, not a failure to restart through.
+    """
+
+    def __init__(self, message: str, window: int | None = None,
+                 anomalies=()):
+        super().__init__(message)
+        self.window = window
+        self.anomalies = list(anomalies)
+
+
+@dataclass
+class ReferenceStats:
+    """What the serving artifact was trained on: per-feature mean/std
+    plus target mean/std, as recorded in the artifact sidecar at build
+    time."""
+
+    feature_names: tuple
+    mean: np.ndarray
+    std: np.ndarray
+    target_mean: float
+    target_std: float
+    target: str | None = None  # label column name, when the sidecar has it
+
+
+def reference_stats_from_sidecar(storage_path: str, name: str) -> ReferenceStats:
+    """Read the drift baseline out of the artifact sidecar.
+
+    Works for both artifact kinds: windowed sidecars carry explicit
+    channel stats (``feature_names``/``mean``/``std``); tabular sidecars
+    carry the fitted pipeline's numeric-column stats. Raises a ValueError
+    naming the sidecar when the stats are absent (an artifact with no
+    numeric features has nothing to score drift against).
+    """
+    from tpuflow.utils.paths import join_path, open_file
+
+    path = join_path(storage_path, "meta", f"{name}.json")
+    with open_file(path, "r", encoding="utf-8") as f:
+        meta = json.load(f)
+    p = meta.get("preprocessor") or {}
+    if meta.get("kind") == "windowed":
+        names = tuple(p["feature_names"])
+        mean = np.asarray(p["mean"], np.float64)
+        std = np.asarray(p["std"], np.float64)
+        target = p.get("target")
+    else:
+        # Tabular sidecar: the fitted pipeline's mean/std cover the
+        # ASSEMBLED feature vector — one-hot blocks first, then the
+        # continuous columns in schema order (features.py::_assemble) —
+        # so the continuous columns' stats are the TAIL of mean/std.
+        target = p.get("target")
+        names = tuple(
+            n for n, k in zip(p.get("names", ()), p.get("kinds", ()))
+            if k in _NUMERIC_KINDS and n != target
+        )
+        if p.get("mean") is None or not names:
+            raise ValueError(
+                f"{path}: sidecar carries no numeric feature stats "
+                "(mean/std) — nothing to score drift against"
+            )
+        mean = np.asarray(p["mean"], np.float64)[-len(names):]
+        std = np.asarray(p["std"], np.float64)[-len(names):]
+    if len(names) != len(mean) or len(mean) != len(std):
+        raise ValueError(
+            f"{path}: sidecar stats are inconsistent — "
+            f"{len(names)} feature names vs {len(mean)} means / "
+            f"{len(std)} stds"
+        )
+    return ReferenceStats(
+        feature_names=names,
+        mean=mean,
+        std=np.where(std < 1e-12, 1.0, std),
+        target_mean=float(p.get("target_mean", 0.0)),
+        target_std=float(p.get("target_std", 1.0)) or 1.0,
+        target=target,
+    )
+
+
+class DataDriftWatchdog:
+    """Windowed drift scoring against :class:`ReferenceStats`.
+
+    Call :meth:`observe_window` once per streaming window with the
+    window's raw feature columns (and optionally the label column and a
+    residual array). Returns the window's anomaly list (empty =
+    healthy). All arithmetic is host-side numpy (TPF010).
+    """
+
+    def __init__(
+        self,
+        ref: ReferenceStats,
+        *,
+        threshold: float = 4.0,
+        var_factor: float = 4.0,
+        residual_factor: float = 3.0,
+        warmup_windows: int = 3,
+        ewma_alpha: float = 0.3,
+        registry=None,
+        logger=None,
+        model_name: str = "model",
+    ):
+        if threshold <= 0:
+            raise ValueError(f"threshold must be > 0, got {threshold}")
+        if var_factor < 1.0 or residual_factor < 1.0:
+            raise ValueError(
+                "var_factor/residual_factor are ratios and must be >= 1, "
+                f"got {var_factor}/{residual_factor}"
+            )
+        self.ref = ref
+        self.threshold = float(threshold)
+        self.var_factor = float(var_factor)
+        self.residual_factor = float(residual_factor)
+        self.warmup_windows = int(warmup_windows)
+        self.ewma_alpha = float(ewma_alpha)
+        self.logger = logger
+        self.model_name = model_name
+        self.windows_scored = 0
+        self.anomalies: list[dict] = []
+        self._ewma_residual: float | None = None
+        self._healthy_windows = 0
+        reg = registry or default_registry()
+        self._score = reg.gauge(
+            "online_drift_score",
+            "standardized per-feature mean shift of the last scored "
+            "window vs the serving artifact's reference stats "
+            "(feature label; 'target' for the label column)",
+        )
+        self._events = reg.counter(
+            "online_drift_events_total",
+            "drift anomalies flagged by the data watchdog, by kind",
+        )
+
+    @property
+    def residual_baseline(self) -> float | None:
+        """The healthy-residual EWMA (None until seeded) — the
+        controller snapshots it before a swap to judge the NEW
+        artifact's post-swap residuals against the incumbent's."""
+        return self._ewma_residual
+
+    @property
+    def warmed(self) -> bool:
+        return self.windows_scored >= self.warmup_windows
+
+    # --- scoring -------------------------------------------------------
+
+    def _feature_columns(self, columns) -> list[tuple[int, str, np.ndarray]]:
+        """(ref index, name, values) for each scoreable feature. Accepts
+        a column dict (names matched against the reference) or a 2D
+        ``[rows, features]`` array ordered like ``ref.feature_names``."""
+        if isinstance(columns, dict):
+            out = []
+            for i, name in enumerate(self.ref.feature_names):
+                if name in columns:
+                    v = np.asarray(columns[name], np.float64)
+                    if v.dtype.kind in "fiu":
+                        out.append((i, name, v.reshape(-1)))
+            return out
+        x = np.asarray(columns, np.float64)
+        if x.ndim < 2 or x.shape[-1] != len(self.ref.feature_names):
+            raise ValueError(
+                f"window array has trailing dim {x.shape[-1:]}, expected "
+                f"{len(self.ref.feature_names)} features "
+                f"({self.ref.feature_names})"
+            )
+        flat = x.reshape(-1, x.shape[-1])
+        return [
+            (i, name, flat[:, i])
+            for i, name in enumerate(self.ref.feature_names)
+        ]
+
+    def observe_window(
+        self,
+        columns,
+        y=None,
+        residuals=None,
+        *,
+        index: int | None = None,
+        raise_on_drift: bool = False,
+    ) -> list[dict]:
+        """Score one window; returns its anomalies (empty = healthy).
+
+        ``columns``: raw feature values (dict of columns, or an array
+        ordered like the reference). ``y``: the raw label column when
+        the stream carries it. ``residuals``: per-row ``|prediction -
+        truth|`` (serving-side, or Gilbert-physics) for the degradation
+        tracker. ``index`` is the window's reproducibility key (the
+        ``online.drift`` fault site's ``at=`` match and the anomaly
+        record's ``window``).
+        """
+        idx = self.windows_scored if index is None else int(index)
+        fault_point("online.drift", index=idx)
+        warmed = self.warmed
+        found: list[dict] = []
+
+        for i, name, values in self._feature_columns(columns):
+            if not len(values):
+                continue
+            z = abs(float(values.mean()) - self.ref.mean[i]) / self.ref.std[i]
+            self._score.set(z, feature=name)
+            if warmed and z > self.threshold:
+                found.append({
+                    "kind": "feature_shift", "feature": name,
+                    "score": round(float(z), 4),
+                })
+            ref_var = self.ref.std[i] ** 2
+            vr = float(values.var()) / max(ref_var, 1e-12)
+            if warmed and (
+                vr > self.var_factor or vr < 1.0 / self.var_factor
+            ):
+                found.append({
+                    "kind": "feature_variance", "feature": name,
+                    "score": round(float(vr), 4),
+                })
+
+        if y is not None:
+            yv = np.asarray(y, np.float64).reshape(-1)
+            if len(yv):
+                z = abs(float(yv.mean()) - self.ref.target_mean) \
+                    / self.ref.target_std
+                self._score.set(z, feature="target")
+                if warmed and z > self.threshold:
+                    found.append({
+                        "kind": "target_shift", "feature": "target",
+                        "score": round(float(z), 4),
+                    })
+
+        if residuals is not None:
+            rv = np.asarray(residuals, np.float64).reshape(-1)
+            if len(rv):
+                mean_resid = float(np.abs(rv).mean())
+                self._score.set(
+                    mean_resid / max(self.ref.target_std, 1e-12),
+                    feature="residual",
+                )
+                degraded = (
+                    warmed
+                    and self._healthy_windows >= self.warmup_windows
+                    and self._ewma_residual is not None
+                    and mean_resid > self.residual_factor
+                    * max(self._ewma_residual, 1e-12)
+                )
+                if degraded:
+                    found.append({
+                        "kind": "residual_degradation", "feature": "residual",
+                        "score": round(
+                            mean_resid / max(self._ewma_residual, 1e-12), 4
+                        ),
+                    })
+                else:
+                    # Healthy (or still warming): seed/advance the EWMA.
+                    # An anomalous window never updates it — a
+                    # degradation must not raise its own bar.
+                    a = self.ewma_alpha
+                    self._ewma_residual = (
+                        mean_resid if self._ewma_residual is None
+                        else a * mean_resid + (1 - a) * self._ewma_residual
+                    )
+
+        self.windows_scored += 1
+        if not found:
+            self._healthy_windows += 1
+            return found
+        for anomaly in found:
+            anomaly["window"] = idx
+            self.anomalies.append(anomaly)
+            self._events.inc(kind=anomaly["kind"])
+            record_event("drift_anomaly", model=self.model_name, **anomaly)
+            if self.logger is not None:
+                self.logger.write("drift_anomaly", **anomaly)
+        if raise_on_drift:
+            kinds = ", ".join(
+                f"{a['kind']}({a.get('feature')})={a['score']:g}"
+                for a in found
+            )
+            raise DriftDetected(
+                f"data watchdog flagged window {idx} of "
+                f"{self.model_name}: {kinds}",
+                window=idx,
+                anomalies=self.anomalies,
+            )
+        return found

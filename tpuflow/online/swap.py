@@ -1,0 +1,259 @@
+"""Artifact promotion with a shadow-eval gate, retained-previous
+rollback, and daemon notification.
+
+An artifact is two on-disk pieces (api/predict_api.py): the Orbax
+best-params tree under ``{storage}/models/{name}`` and the JSON sidecar
+at ``{storage}/meta/{name}.json``. Promotion swaps BOTH from a candidate
+storage root into the serving root:
+
+1. the incumbent is moved aside to ``{storage}/online/prev`` (rename —
+   same filesystem, no copy) and retained as the rollback target;
+2. the candidate's checkpoint tree is renamed into place;
+3. the sidecar is rewritten atomically (tmp + ``os.replace``).
+
+The window between steps 1 and 2 is two renames wide. It is invisible to
+serving because the daemons never read the disk per request: a loaded
+``Predictor`` pins the incumbent's params in memory, the batchers group
+by predictor INSTANCE (a swap mid-flight never scatters another
+generation's predictions — the docs/serving.md contract), and a reload
+happens only when the loop POSTs ``/artifacts/reload`` AFTER the swap
+completed. A daemon that does race a load into the gap degrades to the
+Gilbert fallback for one TTL rather than erroring — the documented
+degraded-serving behavior, not a new failure mode.
+
+``promote_candidate`` fires the ``online.swap`` fault site BEFORE any
+file moves, so an injected fault rejects the candidate with the serving
+artifact untouched; ``rollback_artifact`` mirrors it with
+``online.rollback``. Local filesystems only — renames are the atomicity
+primitive; an object-store (gs://) swap needs a pointer indirection this
+module does not implement (docs/online.md lists it as a follow-up).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import numpy as np
+
+from tpuflow.obs.forensics import record_event
+from tpuflow.obs.metrics import default_registry
+from tpuflow.resilience import fault_point
+from tpuflow.utils.paths import atomic_write_json, is_uri, join_path
+
+
+def _artifact_paths(storage: str, name: str) -> tuple[str, str]:
+    return (
+        join_path(storage, "models", name),
+        join_path(storage, "meta", f"{name}.json"),
+    )
+
+
+def _require_local(*paths: str) -> None:
+    remote = [p for p in paths if is_uri(p)]
+    if remote:
+        raise ValueError(
+            f"online artifact swap needs local storage paths (renames are "
+            f"the atomicity primitive); got URI(s) {remote} — object-store "
+            "promotion needs a pointer indirection (docs/online.md)"
+        )
+
+
+def _require_artifact(ckpt: str, meta: str, what: str) -> None:
+    missing = [p for p in (ckpt, meta) if not os.path.exists(p)]
+    if missing:
+        raise FileNotFoundError(
+            f"{what} artifact is incomplete: missing {missing}"
+        )
+
+
+# --- shadow evaluation -------------------------------------------------
+
+
+def serving_residuals(pred, columns: dict, target: str) -> np.ndarray:
+    """Per-row ``|prediction - truth|`` of one predictor on raw columns
+    — THE serving-side residual used by both the shadow-eval gate and
+    the post-swap regression tracker.
+
+    Tabular predictors answer row-for-row. Windowed predictors answer
+    per WINDOW; each window's prediction (its final step, for
+    teacher-forced families) is compared against the truth at the
+    window's final source row via the returned ``WindowIndex``.
+    """
+    y = np.asarray(columns[target], np.float64).reshape(-1)
+    feats = {k: v for k, v in columns.items() if k != target}
+    if pred.kind == "tabular":
+        out = np.asarray(pred.predict_columns(feats), np.float64)
+        out = out.reshape(len(out), -1)[:, -1]
+        return np.abs(out - y[: len(out)])
+    out, idx = pred.predict_columns(feats, return_index=True)
+    out = np.asarray(out, np.float64)
+    if out.ndim > 1:  # teacher-forced: [windows, steps] -> final step
+        out = out[:, -1]
+    window = int(pred._meta["preprocessor"]["window"])
+    truth = y[np.asarray(idx.starts) + window - 1]
+    return np.abs(out - truth)
+
+
+def artifact_mae(storage: str, name: str, columns: dict, target: str) -> float:
+    """One artifact's MAE on raw labeled columns (fresh load, no cache)."""
+    from tpuflow.api.predict_api import Predictor
+
+    pred = Predictor.load(storage, name)
+    return float(serving_residuals(pred, columns, target).mean())
+
+
+def shadow_eval(
+    incumbent_storage: str,
+    candidate_storage: str,
+    name: str,
+    columns: dict,
+    target: str,
+    margin: float = 0.05,
+) -> dict:
+    """Score candidate vs incumbent on the held-back eval slice.
+
+    ``accept`` iff the candidate's MAE is within ``(1 + margin)`` of the
+    incumbent's — a candidate must NOT regress to be promoted; it does
+    not have to win (the usual reason to retrain is that the incumbent
+    is stale, so it usually wins anyway).
+    """
+    inc = artifact_mae(incumbent_storage, name, columns, target)
+    cand = artifact_mae(candidate_storage, name, columns, target)
+    return {
+        "incumbent_mae": inc,
+        "candidate_mae": cand,
+        "margin": float(margin),
+        "rows": int(len(np.asarray(columns[target]).reshape(-1))),
+        "accept": bool(cand <= inc * (1.0 + margin)),
+    }
+
+
+# --- promotion / rollback ----------------------------------------------
+
+
+def promote_candidate(
+    storage: str,
+    name: str,
+    candidate_storage: str,
+    *,
+    registry=None,
+) -> dict:
+    """Atomically promote a candidate artifact into the serving path,
+    retaining the incumbent under ``{storage}/online/prev`` for
+    rollback. See the module docstring for the swap discipline."""
+    fault_point("online.swap")
+    ckpt, meta = _artifact_paths(storage, name)
+    cand_ckpt, cand_meta = _artifact_paths(candidate_storage, name)
+    _require_local(storage, candidate_storage)
+    _require_artifact(cand_ckpt, cand_meta, "candidate")
+    _require_artifact(ckpt, meta, "incumbent (serving)")
+
+    prev_root = join_path(storage, "online", "prev")
+    prev_ckpt, prev_meta = _artifact_paths(prev_root, name)
+    # One retained generation: clear the older prev, then move the
+    # incumbent aside (renames — same filesystem).
+    shutil.rmtree(prev_root, ignore_errors=True)
+    os.makedirs(os.path.dirname(prev_ckpt), exist_ok=True)
+    os.makedirs(os.path.dirname(prev_meta), exist_ok=True)
+    os.rename(ckpt, prev_ckpt)
+    os.rename(meta, prev_meta)
+    # Candidate in: checkpoint tree by rename, sidecar atomically.
+    os.rename(cand_ckpt, ckpt)
+    with open(cand_meta, encoding="utf-8") as f:
+        atomic_write_json(meta, json.load(f))
+    (registry or default_registry()).counter(
+        "online_swaps_total",
+        "candidate artifacts promoted into the serving path",
+    ).inc()
+    rec = {
+        "promoted": True,
+        "model": name,
+        "storage_path": storage,
+        "candidate": candidate_storage,
+        "prev_retained": prev_root,
+    }
+    record_event("artifact_swap", **rec)
+    return rec
+
+
+def rollback_artifact(storage: str, name: str, *, registry=None) -> dict:
+    """Restore the retained previous artifact into the serving path; the
+    regressed artifact is kept under ``{storage}/online/rejected`` for
+    forensics. Raises FileNotFoundError when no previous artifact was
+    retained (nothing to roll back to)."""
+    fault_point("online.rollback")
+    ckpt, meta = _artifact_paths(storage, name)
+    prev_root = join_path(storage, "online", "prev")
+    prev_ckpt, prev_meta = _artifact_paths(prev_root, name)
+    _require_local(storage)
+    _require_artifact(
+        prev_ckpt, prev_meta, "retained previous (rollback target)"
+    )
+
+    rejected_root = join_path(storage, "online", "rejected")
+    rej_ckpt, rej_meta = _artifact_paths(rejected_root, name)
+    shutil.rmtree(rejected_root, ignore_errors=True)
+    os.makedirs(os.path.dirname(rej_ckpt), exist_ok=True)
+    os.makedirs(os.path.dirname(rej_meta), exist_ok=True)
+    if os.path.exists(ckpt):
+        os.rename(ckpt, rej_ckpt)
+    if os.path.exists(meta):
+        os.rename(meta, rej_meta)
+    os.rename(prev_ckpt, ckpt)
+    with open(prev_meta, encoding="utf-8") as f:
+        atomic_write_json(meta, json.load(f))
+    os.remove(prev_meta)
+    (registry or default_registry()).counter(
+        "online_rollbacks_total",
+        "post-swap regressions rolled back to the retained artifact",
+    ).inc()
+    rec = {
+        "rolled_back": True,
+        "model": name,
+        "storage_path": storage,
+        "rejected_retained": rejected_root,
+    }
+    record_event("artifact_rollback", **rec)
+    return rec
+
+
+# --- daemon notification -----------------------------------------------
+
+
+def notify_daemons(
+    daemon_url: str | None, storage: str, name: str, timeout: float = 5.0
+) -> list[dict]:
+    """POST ``/artifacts/reload`` to each comma-separated daemon URL so
+    a running daemon drops its cached predictor and reloads the swapped
+    artifact on the next request (in-flight requests finish against the
+    old instance — the instance-grouped batcher contract). Best-effort
+    by design: the swap already landed on disk, and a daemon that
+    missed the nudge picks the new artifact up at its next cold load /
+    restart. Returns one ``{"url", "ok", ...}`` record per daemon."""
+    import urllib.request
+
+    results = []
+    for url in [u.strip() for u in (daemon_url or "").split(",") if u.strip()]:
+        body = json.dumps(
+            {"storagePath": storage, "model": name}
+        ).encode()
+        req = urllib.request.Request(
+            url.rstrip("/") + "/artifacts/reload",
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                results.append({
+                    "url": url, "ok": resp.status == 200,
+                    "status": resp.status,
+                })
+        except Exception as e:  # noqa: BLE001 — best-effort by contract
+            results.append({
+                "url": url, "ok": False,
+                "error": f"{type(e).__name__}: {e}",
+            })
+    return results

@@ -74,6 +74,16 @@ SITES: dict[str, str] = {
     "coordinator; index = averaging round",
     "elastic.join": "elastic/worker.py: worker registration/warm-start, "
     "before the first epoch",
+    "online.drift": "online/drift.py: scoring of one streaming window "
+    "against the artifact's reference stats; index = window number",
+    "online.retrain": "online/controller.py: launch of one warm-start "
+    "retrain (before the replay spill / candidate train); index = "
+    "retrain number",
+    "online.swap": "online/swap.py: candidate promotion into the serving "
+    "artifact path, before any file is moved (a firing rejects the "
+    "candidate cleanly)",
+    "online.rollback": "online/swap.py: rollback to the retained "
+    "previous artifact, before any file is moved",
 }
 
 # Sites whose fault_point() passes an index (the at= reproducibility
@@ -82,6 +92,7 @@ SITES: dict[str, str] = {
 INDEXED_SITES = frozenset({
     "checkpoint.save", "checkpoint.restore",
     "train.epoch_start", "train.epoch_end", "elastic.push",
+    "online.drift", "online.retrain",
 })
 
 

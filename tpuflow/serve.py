@@ -28,6 +28,10 @@ API (JSON in/out):
   When the artifact's checkpoint is missing/corrupt, answers degrade to
   the Gilbert physical baseline with ``degraded: true`` in the response
   (docs/resilience.md — the degraded-serving contract).
+- ``POST /artifacts/reload`` — ``{"storagePath", "model"}``: drop the
+  cached predictor so the next request loads the artifact fresh — the
+  online loop's zero-downtime swap signal (tpuflow/online;
+  docs/online.md). In-flight requests finish against the old instance.
 - ``GET  /metrics``     — service counters: jobs
   submitted/done/failed/queued/running, predictor cache
   hits/loads/invalidations (+ degraded_requests/fallback_loads), uptime,
@@ -963,64 +967,10 @@ def _clean_trace_id(raw: str | None) -> str | None:
     return None
 
 
-_FLAG_TRUE = ("1", "true", "yes", "on")
-_FLAG_FALSE = ("0", "false", "no", "off")
-
-
-def env_flag(name: str, default: bool) -> bool:
-    """One validated boolean ``TPUFLOW_SERVE_*`` read. An unrecognized
-    token raises a ValueError naming the variable and the accepted
-    spellings (the ``TPUFLOW_RETRY_*`` fail-loud precedent): a typo'd
-    ``TPUFLOW_SERVE_BATCH=ture`` silently enabling (or worse, silently
-    NOT disabling) the fast path is exactly the far-from-the-shell
-    breakage read-time validation exists to prevent."""
-    raw = os.environ.get(name)
-    if raw is None or not raw.strip():
-        return default
-    token = raw.strip().lower()
-    if token in _FLAG_TRUE:
-        return True
-    if token in _FLAG_FALSE:
-        return False
-    raise ValueError(
-        f"invalid {name}={raw!r}: expected one of "
-        f"{'/'.join(_FLAG_TRUE)} or {'/'.join(_FLAG_FALSE)}"
-    )
-
-
-def env_num(name: str, default, cast, *, minimum=0, form: str | None = None):
-    """One validated numeric ``TPUFLOW_SERVE_*`` read — the same
-    fail-loud contract as the ``TPUFLOW_RETRY_*`` family, and literally
-    the same implementation (``tpuflow/utils/env.py``): a non-numeric,
-    non-finite, or below-minimum value raises a ValueError naming the
-    variable and the expected form — the error surfaces wherever the
-    daemon reads its knobs, far from the shell that exported them, so it
-    must say exactly what to fix."""
-    from tpuflow.utils.env import env_number
-
-    raw = os.environ.get(name)
-    if raw is None or not raw.strip():
-        return default
-    if form is None:
-        form = (
-            f"an integer >= {minimum}" if cast is int
-            else f"a number >= {minimum:g}"
-        )
-    return env_number(name, default, cast=cast, minimum=minimum, form=form)
-
-
-def env_choice(name: str, default: str, choices: tuple) -> str:
-    """One validated enum ``TPUFLOW_SERVE_*`` read (same fail-loud
-    contract as :func:`env_num`)."""
-    raw = os.environ.get(name)
-    if raw is None or not raw.strip():
-        return default
-    token = raw.strip().lower()
-    if token not in choices:
-        raise ValueError(
-            f"invalid {name}={raw!r}: expected one of {', '.join(choices)}"
-        )
-    return token
+# One validated env-knob implementation for every TPUFLOW_* family
+# (tpuflow/utils/env.py); re-exported here because the serving stack and
+# its tests historically import them from tpuflow.serve.
+from tpuflow.utils.env import env_choice, env_flag, env_num  # noqa: F401, E402
 
 
 class PredictService:
@@ -1727,6 +1677,29 @@ def make_server(
                             "error": f"{type(e).__name__}: {e}",
                             "trace_id": tid,
                         })
+            elif route == "/artifacts/reload":
+                # The online loop's swap signal (tpuflow/online;
+                # docs/online.md): drop the cached predictor so the next
+                # request loads the just-promoted artifact. In-flight
+                # requests finish against the old instance — the
+                # batchers group by predictor INSTANCE — so a reload
+                # never drops or cross-wires a request.
+                try:
+                    spec = self._read_spec()
+                except (ValueError, TypeError, json.JSONDecodeError) as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                storage = spec.get("storagePath") or spec.get("storage_path")
+                name = spec.get("model") or spec.get("name")
+                if not storage or not name:
+                    self._send(400, {
+                        "error": "reload needs storagePath and model"
+                    })
+                    return
+                predictor.invalidate(storage, name)
+                self._send(200, {
+                    "reloaded": True, "storage_path": storage, "model": name,
+                })
             else:
                 self._send(404, {"error": f"no route {self.path!r}"})
 

@@ -759,6 +759,30 @@ class AsyncServer:
             except BaseException:
                 self.admission.release()
                 raise
+        if method == "POST" and route == "/artifacts/reload":
+            # The online loop's swap signal (tpuflow/online): drop the
+            # cached predictor; the next request loads the promoted
+            # artifact. In-flight entries drain against the predictor
+            # INSTANCE they enqueued with (the batcher contract), so a
+            # reload never drops a request. On the executor: invalidate
+            # takes the service lock and retires a dispatch lane.
+            try:
+                spec = await self._parse_body(body)
+            except (ValueError, json.JSONDecodeError) as e:
+                return 400, {"error": str(e)}, json_ct
+            storage = spec.get("storagePath") or spec.get("storage_path")
+            name = spec.get("model") or spec.get("name")
+            if not storage or not name:
+                return 400, {
+                    "error": "reload needs storagePath and model"
+                }, json_ct
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                self._pool, self.service.invalidate, storage, name
+            )
+            return 200, {
+                "reloaded": True, "storage_path": storage, "model": name,
+            }, json_ct
         if method == "POST" and route == "/jobs" and self.runner is not None:
             import queue as _queue
 

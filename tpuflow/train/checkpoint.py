@@ -80,6 +80,25 @@ class BestCheckpointer:
         self._mngr.wait_until_finished()
         return self._mngr.best_step()
 
+    def best_structure(self):
+        """The best checkpoint's tree of per-leaf METADATA (shapes and
+        dtypes, no array data). Warm-start compatibility checks
+        (``train/resume.py::check_params_match``) read this to fail with
+        named leaf paths BEFORE paying for a restore — a structurally
+        incompatible artifact would otherwise die inside Orbax's
+        template matching as an opaque pytree error."""
+        self._mngr.wait_until_finished()
+        step = self._mngr.best_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        # Read the step's item directly (shapes/dtypes only, no array
+        # data): the manager's own item_metadata answers None — with a
+        # handler-registry warning — on a manager freshly opened over an
+        # existing tree, which is exactly the warm-start case.
+        return ocp.StandardCheckpointer().metadata(
+            join_path(self.directory, str(step), "default")
+        )
+
     def restore_best(self, params_like: Any | None = None) -> Any:
         """Restore the best params (optionally into an example structure).
 

@@ -23,36 +23,79 @@ from tpuflow.resilience import fault_point, io_policy, retry_call
 from tpuflow.utils.paths import join_path
 
 
+def _leaf_paths(tree) -> list[str]:
+    """Human-readable key paths of every leaf, in flatten order."""
+    leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [jax.tree_util.keystr(path) for path, _ in leaves]
+
+
+def check_params_match(live_params, incoming) -> None:
+    """Raise a ValueError naming the first mismatching leaf PATHS when
+    ``incoming`` cannot overlay ``live_params`` (different tree
+    structure, or a leaf with a different shape).
+
+    ``incoming``'s leaves only need a ``.shape`` — real arrays and
+    checkpoint METADATA leaves (``BestCheckpointer.best_structure``)
+    both qualify, so a warm start can fail with a readable diagnosis
+    BEFORE paying for the restore.
+    """
+    treedef = jax.tree_util.tree_structure(live_params)
+    new_def = jax.tree_util.tree_structure(incoming)
+    if treedef != new_def:
+        want = _leaf_paths(live_params)
+        got = _leaf_paths(incoming)
+        missing = sorted(set(want) - set(got))
+        unexpected = sorted(set(got) - set(want))
+        details = []
+        if missing:
+            head = ", ".join(missing[:3])
+            more = f" (+{len(missing) - 3} more)" if len(missing) > 3 else ""
+            details.append(f"missing from the incoming tree: {head}{more}")
+        if unexpected:
+            head = ", ".join(unexpected[:3])
+            more = (
+                f" (+{len(unexpected) - 3} more)"
+                if len(unexpected) > 3 else ""
+            )
+            details.append(f"unexpected in the incoming tree: {head}{more}")
+        if not details:
+            # Same leaf-path SET but different structure (e.g. a list
+            # where a tuple lives): the treedefs are all there is to show.
+            details.append(f"incoming {new_def} vs live {treedef}")
+        raise ValueError(
+            "warm-start params tree structure does not match the live "
+            f"state's — different model/config? {'; '.join(details)}"
+        )
+    want_leaves, _ = jax.tree_util.tree_flatten_with_path(live_params)
+    got_leaves, _ = jax.tree_util.tree_flatten_with_path(incoming)
+    for (path, got), (_, want) in zip(got_leaves, want_leaves):
+        if tuple(got.shape) != tuple(want.shape):
+            raise ValueError(
+                f"warm-start params leaf "
+                f"{jax.tree_util.keystr(path)} has shape "
+                f"{tuple(got.shape)} but the live state's is "
+                f"{tuple(want.shape)} — different model/config?"
+            )
+
+
 def apply_params(state, params):
     """Overlay externally-sourced params onto a live TrainState — the
     warm-start half of resumability that needs no Orbax tree on disk.
 
-    The elastic runner (tpuflow/elastic) uses it two ways: a late joiner
-    adopts the gang's latest published average before its first epoch,
-    and every synced worker adopts each round's rebroadcast. Structure
-    is checked leaf-for-leaf against the live state: averaging a
-    differently-shaped model into a run must fail loudly, never
-    mis-assign weights. Optimizer state and step counter are deliberately
-    kept — SparkNet-style averaging replaces the *parameters* mid-
-    trajectory, not the trajectory's bookkeeping.
+    Two subsystems ride it: the elastic runner (tpuflow/elastic — a late
+    joiner adopts the gang's latest published average, every synced
+    worker adopts each round's rebroadcast) and the online loop
+    (tpuflow/online — each retrain resumes from the SERVING artifact's
+    params). Structure is checked leaf-for-leaf against the live state:
+    overlaying a differently-shaped model must fail loudly, never
+    mis-assign weights — and because a mismatched warm start is the
+    online loop's most likely user-facing failure (a stale artifact, a
+    changed model_kwargs), the error names the first mismatching leaf
+    PATHS, not just the opaque treedefs. Optimizer state and step
+    counter are deliberately kept — SparkNet-style averaging replaces
+    the *parameters* mid-trajectory, not the trajectory's bookkeeping.
     """
-    treedef = jax.tree_util.tree_structure(state.params)
-    new_def = jax.tree_util.tree_structure(params)
-    if treedef != new_def:
-        raise ValueError(
-            f"warm-start params tree structure {new_def} does not match "
-            f"the live state's {treedef} — different model/config?"
-        )
-    for got, want in zip(
-        jax.tree_util.tree_leaves(params),
-        jax.tree_util.tree_leaves(state.params),
-    ):
-        if tuple(got.shape) != tuple(want.shape):
-            raise ValueError(
-                f"warm-start params leaf shape {tuple(got.shape)} does "
-                f"not match the live state's {tuple(want.shape)} — "
-                "different model/config?"
-            )
+    check_params_match(state.params, params)
     return state.replace(params=params)
 
 

@@ -1,13 +1,17 @@
 """Validated environment-variable reads — THE one copy.
 
-Both knob families that read numbers from the environment
+Every knob family that reads configuration from the environment
 (``TPUFLOW_RETRY_*`` in resilience/retry.py, ``TPUFLOW_SERVE_*`` in
-serve.py) share one contract: a typo'd, non-finite, or below-minimum
-value raises a ValueError naming the variable and the expected form,
-because the error surfaces deep inside whatever path read the knob —
-far from the shell that exported it — and must say exactly what to
-fix. Two hand-rolled copies of that contract had already drifted
-subtly; this module is the single implementation they both call.
+serve.py / serve_async.py, ``TPUFLOW_ONLINE_*`` in tpuflow/online)
+shares one contract: a typo'd, non-finite, or below-minimum value
+raises a ValueError naming the variable and the expected form, because
+the error surfaces deep inside whatever path read the knob — far from
+the shell that exported it — and must say exactly what to fix.
+Hand-rolled copies of that contract had already drifted subtly; this
+module is the single implementation they all call: :func:`env_number`
+(the raw numeric read), plus the three knob-shaped wrappers
+:func:`env_num`, :func:`env_flag`, and :func:`env_choice` that
+serve.py re-exports for compatibility.
 """
 
 from __future__ import annotations
@@ -41,3 +45,57 @@ def env_number(name: str, default, *, cast, minimum, form: str):
             f"{minimum}"
         )
     return value
+
+
+_FLAG_TRUE = ("1", "true", "yes", "on")
+_FLAG_FALSE = ("0", "false", "no", "off")
+
+
+def env_flag(name: str, default: bool) -> bool:
+    """One validated boolean env read. An unrecognized token raises a
+    ValueError naming the variable and the accepted spellings: a typo'd
+    ``TPUFLOW_SERVE_BATCH=ture`` silently enabling (or worse, silently
+    NOT disabling) a fast path is exactly the far-from-the-shell
+    breakage read-time validation exists to prevent."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    token = raw.strip().lower()
+    if token in _FLAG_TRUE:
+        return True
+    if token in _FLAG_FALSE:
+        return False
+    raise ValueError(
+        f"invalid {name}={raw!r}: expected one of "
+        f"{'/'.join(_FLAG_TRUE)} or {'/'.join(_FLAG_FALSE)}"
+    )
+
+
+def env_num(name: str, default, cast, *, minimum=0, form: str | None = None):
+    """One validated numeric knob read — :func:`env_number` with the
+    knob families' default form text. A non-numeric, non-finite, or
+    below-minimum value raises a ValueError naming the variable and the
+    expected form."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    if form is None:
+        form = (
+            f"an integer >= {minimum}" if cast is int
+            else f"a number >= {minimum:g}"
+        )
+    return env_number(name, default, cast=cast, minimum=minimum, form=form)
+
+
+def env_choice(name: str, default: str, choices: tuple) -> str:
+    """One validated enum env read (same fail-loud contract as
+    :func:`env_num`)."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    token = raw.strip().lower()
+    if token not in choices:
+        raise ValueError(
+            f"invalid {name}={raw!r}: expected one of {', '.join(choices)}"
+        )
+    return token
