@@ -71,6 +71,22 @@ Also embedded in the worker run:
   crossover) lands automatically on any live-relay run without ever
   risking the headline number.
 
+Two verdicts ride every record (this round's additions):
+
+- ``vs_twin`` / ``twin_regressions``: the "pays-rent" gate of
+  docs/kernels.md rule 7 made executable — every measured Pallas entry
+  records its throughput ratio against the XLA twin at the same config
+  (``pallas@BxS[@prec]`` / ``xla@BxS[@prec]``; flash entries carry
+  ``vs_twin`` against "full" per T), and any ratio < 1.0 lands in
+  ``twin_regressions`` so a slower-than-twin kernel (the r05 flash
+  regression, 2.3k vs 3.6k) can never again be reported as a neutral
+  data point.
+- ``precision_ab``: the sweep runs each (variant, config) at bf16 AND
+  f32, interleaved within the same lap (BENCH_PRECISIONS), and records
+  the bf16/f32 throughput ratio per entry — the mixed-precision
+  policy's on-chip win, measured not asserted. bf16 keys keep the
+  legacy spelling (``xla@1024x16``); f32 entries append ``@f32``.
+
 Env knobs: BENCH_CONFIGS (comma list of <batch>x<steps-per-dispatch>
 candidates swept per variant, default "1024x1,1024x16,2048x16,4096x16"
 — cheapest-to-compile first so a number banks fast; 1024x16 is the best
@@ -78,6 +94,8 @@ measured config, 9.36M samples/sec round 5, and 2048x16 probes the
 middle of the 1.8x batch effect; setting BENCH_BATCH and/or BENCH_SCAN
 pins a single config instead), BENCH_SECONDS (default 5),
 BENCH_VARIANTS (xla|remat|unroll|pallas|all, default "xla,remat,pallas"),
+BENCH_PRECISIONS (comma list of bf16|f32 measured per entry, default
+"bf16,f32" — bf16 first so the record-comparable number banks first),
 BENCH_UNROLL (scan unroll factor for the unrolled variant, default 8),
 BENCH_ATTEMPTS (default 3), BENCH_TIMEOUT (per-attempt seconds, default
 600), BENCH_DEADLINE (overall wall-clock budget in seconds, default 210;
@@ -134,6 +152,71 @@ def bench_configs() -> list[tuple[int, int]]:
             raise ValueError(f"BENCH_CONFIGS entry {c!r} is not <batch>x<scan>")
         configs.append((max(int(parts[0]), 1), max(int(parts[1]), 1)))
     return configs
+
+
+def bench_precisions() -> list[str]:
+    """The compute precisions swept per (variant, config) entry, in
+    order (BENCH_PRECISIONS, default "bf16,f32" — bf16 first so the
+    number comparable to every committed record banks before the A/B
+    leg spends budget). Parsed by the parent too: a typo must fail in
+    milliseconds, not burn every subprocess retry."""
+    from tpuflow.utils.roofline import PRECISION_ITEMSIZE
+
+    out = []
+    for tok in os.environ.get("BENCH_PRECISIONS", "bf16,f32").split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if tok not in PRECISION_ITEMSIZE:
+            raise ValueError(
+                f"BENCH_PRECISIONS entry {tok!r}: choose from "
+                f"{list(PRECISION_ITEMSIZE)}"
+            )
+        if tok not in out:
+            out.append(tok)
+    if not out:
+        raise ValueError("BENCH_PRECISIONS selected no precisions")
+    return out
+
+
+def _entry_key(name: str, batch: int, scan: int, precision: str) -> str:
+    """Backend-entry key: bf16 keeps the legacy ``name@BxS`` spelling
+    (comparable to every committed round); other precisions append the
+    token."""
+    key = f"{name}@{batch}x{scan}"
+    return key if precision == "bf16" else f"{key}@{precision}"
+
+
+def twin_verdicts(backends: dict) -> tuple[dict, list]:
+    """The "pays-rent" gate (docs/kernels.md rule 7) over a backends
+    map: for every measured Pallas entry with a measured XLA twin at
+    the same config (and precision), the kernel/twin throughput ratio —
+    ratios < 1.0 are the ``twin_regressions`` a kernel must clear to
+    earn a default."""
+    ratios: dict[str, float] = {}
+    for key, val in backends.items():
+        if not isinstance(val, (int, float)):
+            continue
+        name, _, rest = key.partition("@")
+        if name != "pallas":
+            continue
+        twin = backends.get(f"xla@{rest}")
+        if isinstance(twin, (int, float)) and twin > 0:
+            ratios[key] = round(val / twin, 3)
+    return ratios, sorted(k for k, r in ratios.items() if r < 1.0)
+
+
+def precision_ab(backends: dict) -> dict:
+    """bf16/f32 throughput ratio per entry measured at BOTH precisions
+    — the mixed-precision A/B the sweep interleaves."""
+    out: dict[str, float] = {}
+    for key, val in backends.items():
+        if not isinstance(val, (int, float)) or key.endswith("@f32"):
+            continue
+        f32 = backends.get(f"{key}@f32")
+        if isinstance(f32, (int, float)) and f32 > 0:
+            out[key] = round(val / f32, 3)
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -239,16 +322,20 @@ def _parity_check(jax, jnp) -> str:
 
 
 def _measure_backend(
-    jax, jnp, model_kwargs: dict, batch: int, seconds: float, scan: int
+    jax, jnp, model_kwargs: dict, batch: int, seconds: float, scan: int,
+    precision: str = "bf16",
 ):
     """Throughput of the full LSTM-64 train step for one recurrence variant."""
     from tpuflow.core.losses import mae_clip
     from tpuflow.models import LSTMRegressor
     from tpuflow.train import create_state, make_train_step
+    from tpuflow.train.precision import compute_dtype
     from tpuflow.train.steps import make_epoch_step
 
     window, features = WINDOW, FEATURES
-    model = LSTMRegressor(hidden=HIDDEN, dtype=jnp.bfloat16, **model_kwargs)
+    model = LSTMRegressor(
+        hidden=HIDDEN, dtype=compute_dtype(precision), **model_kwargs
+    )
     rng = np.random.default_rng(0)
     x_np = rng.standard_normal((batch, window, features)).astype(np.float32)
     y_np = rng.standard_normal((batch, window)).astype(np.float32)
@@ -328,6 +415,17 @@ def _measure_attention(jax, seconds: float, time_left) -> dict:
                 "tokens_per_sec": round(sps * T),
                 **roofline_report(sps, flops, bytes_, device_kind),
             }
+        # The flash kernel's pays-rent verdict vs its XLA twin at this
+        # T (docs/kernels.md rule 7; the r05 flash regression was 0.64).
+        if (
+            isinstance(entry.get("flash"), dict)
+            and isinstance(entry.get("full"), dict)
+        ):
+            entry["vs_twin"] = round(
+                entry["flash"]["samples_per_sec"]
+                / max(entry["full"]["samples_per_sec"], 1e-9), 3,
+            )
+            entry["pays_rent"] = entry["vs_twin"] >= 1.0
         out[f"T{T}"] = entry
     return out
 
@@ -373,31 +471,56 @@ def worker() -> None:
     from tpuflow.utils.roofline import (
         lstm_bytes_per_sample_step,
         lstm_flops_per_sample_step,
+        precision_itemsize,
         roofline_report,
     )
 
     flops = lstm_flops_per_sample_step(window, features, hidden)
-    bytes_ = lstm_bytes_per_sample_step(window, features, hidden, itemsize=2)
+    precisions = bench_precisions()
+    bytes_by_prec = {
+        p: lstm_bytes_per_sample_step(
+            window, features, hidden, itemsize=precision_itemsize(p)
+        )
+        for p in precisions
+    }
     variants = lstm_variants()
 
     # Sweep order: cheapest config first (smallest batch x scan compiles
     # and measures fastest), and within a config every variant in
     # lstm_variants() order (xla before pallas: the plain scan is the
-    # cheapest compile). The FIRST completed entry yields a full
-    # provisional record immediately — the round's number is banked
-    # within one compile + one measurement of backend-up, and everything
-    # after only improves it.
+    # cheapest compile), each at every BENCH_PRECISIONS entry (bf16
+    # first: the record-comparable number banks before the A/B leg).
+    # Interleaving the precisions INSIDE the lap — adjacent
+    # measurements, same warm backend — is what makes the bf16/f32
+    # ratio an A/B rather than two separate runs' noise. The FIRST
+    # completed entry yields a full provisional record immediately —
+    # the round's number is banked within one compile + one measurement
+    # of backend-up, and everything after only improves it.
     order = [
-        (name, kwargs, batch, scan)
+        (name, kwargs, batch, scan, prec)
         for batch, scan in sorted(configs, key=lambda c: c[0] * c[1])
         for name, kwargs in variants.items()
+        for prec in precisions
     ]
 
     backends: dict[str, float | str] = {}
     parity = "pending"
     attention: dict | str = "pending"
+    # The HEADLINE number only ever comes from the record precision
+    # (precisions[0], bf16 by default): every committed round measured
+    # bf16, and on hosts that EMULATE bf16 the f32 leg is ~7x faster —
+    # letting it take the headline would silently jump `value` against
+    # all prior rounds and ratio an f32 number against the
+    # bf16-measured north star. The A/B leg lives in backends/
+    # precision_ab; best_any is only the labeled fallback for a run
+    # where the record precision banked nothing at all.
+    record_prec = precisions[0]
     best: float | None = None
     best_backend = ""
+    best_precision = record_prec
+    best_any: float | None = None
+    best_any_backend = ""
+    best_any_prec = record_prec
 
     def emit_record(partial: bool) -> None:
         # The north-star ratio is only meaningful against the chip the
@@ -407,22 +530,43 @@ def worker() -> None:
         from tpuflow.utils.roofline import chip_peaks
 
         on_chip_device = chip_peaks(device_kind)[0] is not None
+        vs_twin, regressions = twin_verdicts(backends)
+        if best is not None:
+            value, backend, prec = best, best_backend, best_precision
+        else:
+            # Record precision banked nothing (its entries all errored)
+            # — fall back to the best of ANY precision, labeled, rather
+            # than reporting a dead round.
+            value, backend, prec = best_any, best_any_backend, best_any_prec
         rec = {
             "metric": METRIC,
-            "value": best,
+            "value": value,
             "unit": "samples/sec/chip",
             "vs_baseline": (
-                round(best / BASELINE_SPS, 3) if on_chip_device else None
+                round(value / BASELINE_SPS, 3)
+                if on_chip_device and prec == "bf16" else None
             ),
             "backends": dict(backends),
-            "best_backend": best_backend,
+            "best_backend": backend,
+            "precision": prec,
+            "precision_ab": precision_ab(backends),
+            "vs_twin": vs_twin,
+            "twin_regressions": regressions,
             "pallas_parity": parity,
             "attention": attention,
             "device": device_kind,
             "flops_per_sample": round(flops),
-            "hbm_bytes_per_sample": round(bytes_),
-            **roofline_report(best, flops, bytes_, device_kind),
+            "hbm_bytes_per_sample": round(bytes_by_prec[prec]),
+            **roofline_report(
+                value, flops, bytes_by_prec[prec], device_kind,
+                compute_dtype=prec,
+            ),
         }
+        if prec != "bf16" and on_chip_device:
+            rec["vs_baseline_note"] = (
+                "north star was set at bf16; no bf16 entry measured "
+                "this run, so the ratio is withheld"
+            )
         if not on_chip_device:
             rec["host_only"] = True
         if partial:
@@ -430,8 +574,8 @@ def worker() -> None:
         print(json.dumps(rec), flush=True)
 
     measured = 0
-    for name, kwargs, batch, scan in order:
-        key = f"{name}@{batch}x{scan}"
+    for name, kwargs, batch, scan, prec in order:
+        key = _entry_key(name, batch, scan, prec)
         # Once one number is banked, don't start an entry the budget
         # can't fit (compile + warmup + one timing pass ~= 3x seconds
         # plus slack); an unbanked worker keeps trying regardless.
@@ -451,15 +595,32 @@ def worker() -> None:
             continue
         try:
             backends[key] = round(
-                _measure_backend(jax, jnp, kwargs, batch, seconds, scan), 1
+                _measure_backend(
+                    jax, jnp, kwargs, batch, seconds, scan, prec
+                ), 1
             )
         except Exception as e:
             backends[key] = f"ERROR: {type(e).__name__}: {str(e)[:300]}"
         progress(f"{key}: {backends[key]}")
         if isinstance(backends[key], float):
             measured += 1
-            if best is None or backends[key] > best:
-                best, best_backend = backends[key], key
+            any_improved = best_any is None or backends[key] > best_any
+            if any_improved:
+                best_any, best_any_backend, best_any_prec = (
+                    backends[key], key, prec
+                )
+            improved = prec == record_prec and (
+                best is None or backends[key] > best
+            )
+            if improved:
+                best, best_backend, best_precision = (
+                    backends[key], key, prec
+                )
+            if improved or (best is None and any_improved):
+                # Re-emit on every record-precision improvement, and on
+                # any-precision improvements while the record precision
+                # is still unbanked (the tail line must always be the
+                # best COMPLETE record so far).
                 emit_record(partial=True)
         if measured == 1 and parity == "pending":
             # Parity runs AFTER the first number is banked: its kernel
@@ -475,7 +636,7 @@ def worker() -> None:
             progress(f"parity: {parity}")
             emit_record(partial=True)
 
-    if best is None:
+    if best_any is None:
         raise RuntimeError(f"all backends failed: {backends}")
     # Attention timing rides LAST: strictly after the LSTM number and
     # parity are banked (its flash compile is another of the risky
@@ -605,6 +766,7 @@ def main() -> None:
     try:
         init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", 90))
         bench_configs()
+        bench_precisions()
         from benchmarks.common import lstm_variants
 
         lstm_variants()

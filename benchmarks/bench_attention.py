@@ -29,14 +29,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from benchmarks.common import emit, time_train_steps
+from benchmarks.common import bench_dtype, emit, time_train_steps
 from tpuflow.models import AttentionRegressor
 from tpuflow.train import create_state, make_train_step
 
 
 def step_throughput(backend: str, batch: int, T: int, seconds: float) -> float:
     model = AttentionRegressor(
-        dim=64, num_layers=2, heads=4, dtype=jnp.bfloat16, backend=backend
+        dim=64, num_layers=2, heads=4, dtype=bench_dtype(), backend=backend
     )
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.standard_normal((batch, T, 5)), jnp.float32)
@@ -69,17 +69,23 @@ def main() -> None:
         ).split(",")
     ]
     device_kind = getattr(jax.devices()[0], "device_kind", "unknown")
-    label = {} if on_tpu else {"correctness_path": "cpu"}
+    from benchmarks.common import bench_itemsize, bench_precision
+
+    precision = bench_precision()
+    label = {"precision": precision}
+    if not on_tpu:
+        label["correctness_path"] = "cpu"
     for T in seq_lens:
         flops = attention_flops_per_sample_step(T, F=5, D=64, layers=2)
         # Per-backend byte models: "full" spills per-head [T, T] scores
         # to HBM; flash never does — so their bound verdicts differ.
+        # Itemsize follows the measured compute dtype.
         bytes_by_backend = {
             "full": attention_bytes_per_sample_step(
-                T, D=64, layers=2, itemsize=2, score_heads=4
+                T, D=64, layers=2, itemsize=bench_itemsize(), score_heads=4
             ),
             "flash": attention_bytes_per_sample_step(
-                T, D=64, layers=2, itemsize=2
+                T, D=64, layers=2, itemsize=bench_itemsize()
             ),
         }
         for backend in ("full", "flash"):
@@ -110,7 +116,8 @@ def main() -> None:
                 batch=batch,
                 **label,
                 **roofline_report(
-                    sps, flops, bytes_by_backend[backend], device_kind
+                    sps, flops, bytes_by_backend[backend], device_kind,
+                    compute_dtype=precision,
                 ),
             )
 

@@ -4,14 +4,29 @@ The north-star config (BASELINE.json: >=10k samples/sec/chip at
 Gilbert-matching MAE). Reports:
 
 - raw jitted-train-step throughput (the number ``bench.py`` records), for
-  both the XLA-scan and the fused-Pallas-kernel backends;
-- end-to-end accuracy (well-flow MAE vs Gilbert) from a short train run.
+  both the XLA-scan and the fused-Pallas-kernel backends, at the
+  BENCH_PRECISION compute dtype (default bf16 — the committed records'
+  precision);
+- end-to-end accuracy (well-flow MAE vs Gilbert) from a short train run;
+- with ``--ab``: the INTERLEAVED f32-vs-bf16 A/B lap over the scanned
+  (batch x 16) grid — 1024x16 (the on-chip record config), 2048x16 (the
+  queued knee probe between the 9.36M 1024 record and the 5.19M 4096
+  reading), 4096x16 — plus a fixed-seed loss-parity gate, written to
+  ``benchmarks/precision_results.json``. Interleaving f32/bf16 within
+  the same lap (adjacent measurements, warm backend) is what makes the
+  ratio an A/B instead of two runs' drift; host records carry
+  ``host_only: true`` / ``vs_baseline: null`` (CPU emulates bf16 in
+  software, so the host ratio INVERTS the chip story — the labeling
+  rules exist precisely so that can't be misread).
 
-Env knobs: BENCH_BATCH (4096), BENCH_SECONDS (5).
+Env knobs: BENCH_BATCH (4096), BENCH_SECONDS (5), BENCH_PRECISION
+(bf16), and for --ab: BENCH_AB_BATCHES ("1024,2048,4096"),
+BENCH_AB_SCAN (16), BENCH_AB_LAPS (2).
 """
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 
@@ -21,17 +36,38 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from benchmarks.common import emit, lstm_variants, time_train_steps
+from benchmarks.common import (
+    FEATURES,
+    HIDDEN,
+    WINDOW,
+    bench_precision,
+    emit,
+    lstm_variants,
+    time_train_steps,
+)
 from tpuflow.api import TrainJobConfig, train
 from tpuflow.models import LSTMRegressor
 from tpuflow.train import create_state, make_train_step
 
+# The documented f32-vs-bf16 parity tolerance — THE shared definition
+# (tpuflow/train/precision.py), same gate as tier-1
+# tests/test_precision.py: the speedup is disqualified if it is a
+# numerics regression.
+from tpuflow.train.precision import PARITY_RTOL
 
-def step_throughput(model_kwargs: dict, batch: int, seconds: float) -> float:
-    model = LSTMRegressor(hidden=64, dtype=jnp.bfloat16, **model_kwargs)
+
+def step_throughput(
+    model_kwargs: dict, batch: int, seconds: float, precision: str | None = None
+) -> float:
+    from tpuflow.train.precision import compute_dtype
+
+    precision = precision or bench_precision()
+    model = LSTMRegressor(
+        hidden=HIDDEN, dtype=compute_dtype(precision), **model_kwargs
+    )
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.standard_normal((batch, 24, 5)), jnp.float32)
-    y = jnp.asarray(rng.standard_normal((batch, 24)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((batch, WINDOW, FEATURES)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((batch, WINDOW)), jnp.float32)
     state = create_state(model, jax.random.PRNGKey(0), x[:2])
     steps, elapsed = time_train_steps(
         state, make_train_step(), x, y, seconds=seconds
@@ -39,9 +75,182 @@ def step_throughput(model_kwargs: dict, batch: int, seconds: float) -> float:
     return batch * steps / elapsed
 
 
+def _scanned_throughput(
+    batch: int, scan: int, seconds: float, precision: str
+) -> float:
+    """Throughput of the scanned (batch x scan) train program at one
+    precision — the A/B lap's unit of measurement, the same program
+    shape as the on-chip record (bench.py::_measure_backend)."""
+    from benchmarks.common import time_carried_steps
+    from tpuflow.core.losses import mae_clip
+    from tpuflow.train.precision import compute_dtype
+    from tpuflow.train.steps import make_epoch_step
+
+    model = LSTMRegressor(hidden=HIDDEN, dtype=compute_dtype(precision))
+    rng = np.random.default_rng(0)
+    x_np = rng.standard_normal((batch, WINDOW, FEATURES)).astype(np.float32)
+    y_np = rng.standard_normal((batch, WINDOW)).astype(np.float32)
+    state = create_state(model, jax.random.PRNGKey(0), x_np[:2])
+    key = jax.random.PRNGKey(0)
+    if scan > 1:
+        xs = jnp.asarray(np.broadcast_to(x_np, (scan,) + x_np.shape))
+        ys = jnp.asarray(np.broadcast_to(y_np, (scan,) + y_np.shape))
+        epoch_step = make_epoch_step(mae_clip)
+        step = lambda s: epoch_step(s, xs, ys, key)
+    else:
+        x, y = jnp.asarray(x_np), jnp.asarray(y_np)
+        one = make_train_step(mae_clip)
+        step = lambda s: one(s, x, y, key)
+    n, elapsed = time_carried_steps(step, state, seconds)
+    return batch * scan * n / elapsed
+
+
+def _parity_gate(seed: int = 3) -> dict:
+    """Fixed-seed f32-vs-bf16 fit: the compiled-parity gate that
+    disqualifies a speedup bought with broken numerics. Mirrors the
+    tier-1 drill (tests/test_precision.py) so the committed artifact
+    and the test suite enforce the same tolerance."""
+    losses = {}
+    for precision in ("f32", "bf16"):
+        report = train(TrainJobConfig(
+            model="lstm", window=8, synthetic_wells=2, synthetic_steps=64,
+            max_epochs=6, batch_size=32, seed=seed, verbose=False,
+            n_devices=1, precision=precision,
+        ))
+        losses[precision] = float(report.test_loss)
+    rel = abs(losses["bf16"] - losses["f32"]) / max(abs(losses["f32"]), 1e-12)
+    return {
+        "f32_final_loss": round(losses["f32"], 6),
+        "bf16_final_loss": round(losses["bf16"], 6),
+        "rel_diff": round(rel, 6),
+        "tolerance": PARITY_RTOL,
+        "ok": rel <= PARITY_RTOL,
+    }
+
+
+def precision_ab_lap() -> dict:
+    """The interleaved f32-vs-bf16 A/B over the scanned batch grid,
+    including the 2048x16 knee probe; writes
+    benchmarks/precision_results.json and returns the record."""
+    from tpuflow.utils.roofline import (
+        chip_peaks,
+        lstm_bytes_per_sample_step,
+        lstm_flops_per_sample_step,
+        precision_itemsize,
+        roofline_report,
+    )
+
+    batches = [
+        max(int(b), 1)
+        for b in os.environ.get(
+            "BENCH_AB_BATCHES", "1024,2048,4096"
+        ).split(",")
+    ]
+    scan = max(int(os.environ.get("BENCH_AB_SCAN", 16)), 1)
+    laps = max(int(os.environ.get("BENCH_AB_LAPS", 2)), 1)
+    seconds = float(os.environ.get("BENCH_SECONDS", 5))
+    device_kind = getattr(jax.devices()[0], "device_kind", "unknown")
+    host_only = chip_peaks(device_kind)[0] is None
+
+    measured: dict[tuple[int, str], list[float]] = {}
+    for lap in range(laps):
+        for batch in sorted(batches):
+            for precision in ("f32", "bf16"):  # adjacent = the A/B
+                try:
+                    sps = _scanned_throughput(batch, scan, seconds, precision)
+                except Exception as e:
+                    emit("lstm64", f"ab_{precision}_B{batch}x{scan}", -1.0,
+                         "samples/sec/chip", precision=precision, lap=lap,
+                         error=str(e)[:200])
+                    continue
+                measured.setdefault((batch, precision), []).append(sps)
+                emit("lstm64", f"ab_{precision}_B{batch}x{scan}", sps,
+                     "samples/sec/chip", precision=precision, lap=lap,
+                     device=device_kind)
+
+    flops = lstm_flops_per_sample_step(WINDOW, FEATURES, HIDDEN)
+    rows = []
+    for batch in sorted(batches):
+        row: dict = {"batch": batch, "scan": scan}
+        for precision in ("f32", "bf16"):
+            vals = measured.get((batch, precision))
+            if not vals:
+                continue
+            med = float(np.median(vals))
+            row[precision] = round(med, 1)
+            if not host_only:
+                row[f"{precision}_roofline"] = roofline_report(
+                    med, flops,
+                    lstm_bytes_per_sample_step(
+                        WINDOW, FEATURES, HIDDEN,
+                        precision_itemsize(precision),
+                    ),
+                    device_kind, compute_dtype=precision,
+                )
+        if "f32" in row and "bf16" in row:
+            row["bf16_vs_f32"] = round(row["bf16"] / row["f32"], 3)
+        rows.append(row)
+
+    # The knee: per-sample efficiency of each batch relative to the
+    # grid's best, per precision — the 1.8x batch effect the 2048 probe
+    # exists to locate (and, under bf16, to re-locate: halved working
+    # set moves it).
+    knee = {}
+    for precision in ("f32", "bf16"):
+        vals = {
+            r["batch"]: r[precision] for r in rows if precision in r
+        }
+        if vals:
+            best_batch = max(vals, key=vals.get)
+            knee[precision] = {
+                "best_batch": best_batch,
+                "relative": {
+                    str(b): round(v / vals[best_batch], 3)
+                    for b, v in vals.items()
+                },
+            }
+
+    best_bf16 = max((r.get("bf16", 0.0) for r in rows), default=0.0)
+    record = {
+        "metric": "lstm64_precision_ab",
+        "unit": "samples/sec/chip",
+        "device": device_kind,
+        "laps": laps,
+        "seconds_per_pass": seconds,
+        "rows": rows,
+        "knee": knee,
+        "parity": _parity_gate(),
+        "vs_baseline": (
+            round(best_bf16 / 10_000.0, 3) if not host_only else None
+        ),
+        "method": (
+            "interleaved f32/bf16 scanned-epoch laps (adjacent "
+            "measurements per batch, medians over laps), "
+            "transfer-drained timing (benchmarks/common.py)"
+        ),
+    }
+    if host_only:
+        record["host_only"] = True
+        record["note"] = (
+            "CPU emulates bfloat16 in software: the host bf16/f32 ratio "
+            "INVERTS the chip story and must never be read as the "
+            "policy's win or loss — re-run on a live relay for the real "
+            "A/B (vs_baseline stays null off-chip)"
+        )
+    out = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "precision_results.json"
+    )
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(record, f, indent=2)
+    print(f"[bench_lstm64] wrote A/B lap -> {out}", file=sys.stderr)
+    print(json.dumps(record), flush=True)
+    return record
+
+
 def main(seed: int = 0) -> None:
     batch = max(int(os.environ.get("BENCH_BATCH", 4096)), 1)
     seconds = float(os.environ.get("BENCH_SECONDS", 5))
+    precision = bench_precision()
     try:
         variants = lstm_variants()
     except ValueError as e:
@@ -53,9 +262,10 @@ def main(seed: int = 0) -> None:
         variants = {}
     for name, kwargs in variants.items():
         try:
-            sps = step_throughput(kwargs, batch, seconds)
+            sps = step_throughput(kwargs, batch, seconds, precision)
         except Exception as e:  # pallas unavailable on exotic backends
-            emit("lstm64", f"train_step_throughput_{name}", -1.0, "samples/sec/chip",
+            emit("lstm64", f"train_step_throughput_{name}", -1.0,
+                 "samples/sec/chip", precision=precision,
                  error=str(e)[:200])
             continue
         emit(
@@ -63,6 +273,7 @@ def main(seed: int = 0) -> None:
             f"train_step_throughput_{name}",
             sps,
             "samples/sec/chip",
+            precision=precision,
             vs_north_star=round(sps / 10_000.0, 3),
         )
 
@@ -76,6 +287,7 @@ def main(seed: int = 0) -> None:
             seed=seed,
             verbose=False,
             n_devices=1,
+            precision=precision,
         )
     )
     emit(
@@ -83,10 +295,14 @@ def main(seed: int = 0) -> None:
         "well_flow_mae",
         report.test_mae,
         "stb/day",
+        precision=precision,
         gilbert_mae=round(report.gilbert_mae, 4),
         beats_gilbert=report.test_mae <= report.gilbert_mae,
     )
 
 
 if __name__ == "__main__":
-    main()
+    if "--ab" in sys.argv:
+        precision_ab_lap()
+    else:
+        main()

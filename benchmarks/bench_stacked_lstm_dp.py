@@ -76,25 +76,31 @@ def _publish_dp_roofline(per_chip: float) -> None:
     from tpuflow.obs.health import publish_roofline
     from tpuflow.utils.roofline import model_cost_per_sample
 
+    from benchmarks.common import bench_itemsize, bench_precision
+
     cost = model_cost_per_sample(
         "lstm",
         window=WINDOW,
         features=FEATURES,
         model_kwargs={"hidden": HIDDEN, "num_layers": LAYERS},
-        itemsize=2,  # the benchmarked model trains in bfloat16
+        itemsize=bench_itemsize(),  # bytes follow the measured dtype
     )
     if cost is None:
         return
     publish_roofline(
-        per_chip, cost[0], cost[1], jax.devices()[0].device_kind
+        per_chip, cost[0], cost[1], jax.devices()[0].device_kind,
+        compute_dtype=bench_precision(),
     )
 
 
 def main() -> None:
+    from benchmarks.common import bench_dtype, bench_precision
+
+    precision = bench_precision()
     per_chip_batch = int(os.environ.get("BENCH_BATCH", 2048))
     seconds = float(os.environ.get("BENCH_SECONDS", 5))
     n_dev = jax.device_count()
-    model = LSTMRegressor(hidden=HIDDEN, num_layers=LAYERS, dtype=jnp.bfloat16)
+    model = LSTMRegressor(hidden=HIDDEN, num_layers=LAYERS, dtype=bench_dtype())
     rng = np.random.default_rng(0)
 
     # Single-device reference — the DP=1 step the scaling factor divides by.
@@ -109,7 +115,8 @@ def main() -> None:
         state, make_train_step(), x1, y1, seconds=seconds
     )
     single = per_chip_batch * steps / elapsed
-    emit("stacked_lstm_dp", "single_device_throughput", single, "samples/sec/chip")
+    emit("stacked_lstm_dp", "single_device_throughput", single,
+         "samples/sec/chip", precision=precision)
 
     # DP across the full mesh, same per-chip batch.
     B = per_chip_batch * n_dev
@@ -131,6 +138,7 @@ def main() -> None:
         per_chip,
         "samples/sec/chip",
         n_devices=n_dev,
+        precision=precision,
         total_throughput=round(total, 1),
         scaling_efficiency=round(per_chip / single, 3),
     )
@@ -140,6 +148,7 @@ def main() -> None:
         scaling,
         "x vs DP=1 step",
         n_devices=n_dev,
+        precision=precision,
     )
     _publish_parallel_gauges(per_chip, total, scaling, n_dev)
     _publish_dp_roofline(per_chip)
@@ -174,6 +183,7 @@ def main() -> None:
         total / n_dev,
         "samples/sec/chip",
         n_devices=n_dev,
+        precision=precision,
         steps_per_dispatch=scan,
         per_chip_batch=small,
     )

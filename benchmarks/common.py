@@ -35,6 +35,42 @@ maybe_pin_cpu()
 WINDOW, FEATURES, HIDDEN = 24, 5, 64
 
 
+def bench_precision() -> str:
+    """The compute-precision token this bench run measures under.
+
+    ``BENCH_PRECISION`` ("f32" | "bf16"), default "bf16" — the precision
+    every committed on-chip number was measured at (the model-building
+    benches have always passed ``dtype=jnp.bfloat16``), so unset-env
+    runs stay comparable to the record. ``benchmarks/run_all.py
+    --precision`` plumbs it through the whole sweep; records carry the
+    token so two precisions never collide in a results file.
+    """
+    token = os.environ.get("BENCH_PRECISION", "bf16").strip()
+    from tpuflow.utils.roofline import PRECISION_ITEMSIZE
+
+    if token not in PRECISION_ITEMSIZE:
+        raise ValueError(
+            f"BENCH_PRECISION: unknown precision {token!r}; "
+            f"choose from {list(PRECISION_ITEMSIZE)}"
+        )
+    return token
+
+
+def bench_dtype():
+    """The jnp dtype for :func:`bench_precision` (imports jax lazily)."""
+    from tpuflow.train.precision import compute_dtype
+
+    return compute_dtype(bench_precision())
+
+
+def bench_itemsize() -> int:
+    """HBM itemsize for :func:`bench_precision` — feed the roofline the
+    bytes the activations actually travel in."""
+    from tpuflow.utils.roofline import precision_itemsize
+
+    return precision_itemsize(bench_precision())
+
+
 def lstm_variants() -> dict[str, dict]:
     """The LSTM recurrence variants the benchmarks race: plain XLA scan,
     the gate-remat scan, the same scan unrolled (BENCH_UNROLL, default 8,

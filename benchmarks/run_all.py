@@ -1,11 +1,18 @@
 """Run the five BASELINE-config benchmarks; write benchmarks/results.json.
 
-Usage: python benchmarks/run_all.py [--quick] [script.py ...]
+Usage: python benchmarks/run_all.py [--quick] [--precision P] [script.py ...]
 
 With script names, only those benchmarks run and their records are
 MERGED into the existing results.json (rows with the same
-config+metric are replaced, everything else is kept) — re-measuring
-one family doesn't discard the others' recorded numbers.
+config+metric+precision are replaced, everything else is kept) —
+re-measuring one family doesn't discard the others' recorded numbers.
+
+``--precision f32|bf16|both`` plumbs the compute policy through the
+whole sweep (BENCH_PRECISION for every child; ``both`` runs each
+selected script once per precision, f32 first). Model-building benches
+stamp the token into every record they emit, so two policies coexist in
+one results.json without colliding; host_only labeling is the child
+benches' own and is preserved untouched.
 """
 
 from __future__ import annotations
@@ -27,47 +34,99 @@ SCRIPTS = [
 ]
 
 
+def _parse_precisions(argv: list[str]) -> tuple[list[str | None], list[str]]:
+    """Pop ``--precision P`` from argv; returns (precision passes, rest).
+    ``None`` in the passes list means "inherit the environment" (the
+    no-flag behavior, byte-identical to the pre-policy harness)."""
+    rest = list(argv)
+    if "--precision" not in rest:
+        return [None], rest
+    i = rest.index("--precision")
+    try:
+        value = rest[i + 1]
+    except IndexError:
+        sys.exit("[run_all] --precision needs a value: f32|bf16|both")
+    del rest[i:i + 2]
+    if value == "both":
+        return ["f32", "bf16"], rest
+    if value not in ("f32", "bf16"):
+        sys.exit(
+            f"[run_all] --precision {value!r}: choose f32, bf16, or both"
+        )
+    return [value], rest
+
+
 def main() -> None:
     here = os.path.dirname(os.path.abspath(__file__))
     root = os.path.dirname(here)
-    env = dict(os.environ)
-    args = [a for a in sys.argv[1:] if a != "--quick"]
-    if "--quick" in sys.argv:
-        env.setdefault("BENCH_SECONDS", "2")
-        env.setdefault("BENCH_BATCH", "1024")
+    base_env = dict(os.environ)
+    precisions, argv = _parse_precisions(sys.argv[1:])
+    args = [a for a in argv if a != "--quick"]
+    if "--quick" in argv:
+        base_env.setdefault("BENCH_SECONDS", "2")
+        base_env.setdefault("BENCH_BATCH", "1024")
         # Serving bench: one small client count, short window.
-        env.setdefault("BENCH_SERVE_CLIENTS", "8")
-        env.setdefault("BENCH_SERVE_SECONDS", "2")
+        base_env.setdefault("BENCH_SERVE_CLIENTS", "8")
+        base_env.setdefault("BENCH_SERVE_SECONDS", "2")
     selected = args or SCRIPTS
     unknown = [s for s in selected if s not in SCRIPTS]
     if unknown:
         sys.exit(f"[run_all] unknown benchmark(s) {unknown}; known: {SCRIPTS}")
     records = []
     failed = []
-    for script in selected:
-        proc = subprocess.run(
-            [sys.executable, os.path.join(here, script)],
-            capture_output=True,
-            text=True,
-            cwd=root,
-            env=env,
-        )
-        for line in proc.stdout.splitlines():
-            line = line.strip()
-            if line.startswith("{"):
-                records.append(json.loads(line))
-                print(line, flush=True)
-        if proc.returncode != 0:
-            failed.append(script)
-            print(f"[run_all] {script} FAILED:\n{proc.stderr[-2000:]}", file=sys.stderr)
+    for precision in precisions:
+        env = dict(base_env)
+        if precision is not None:
+            env["BENCH_PRECISION"] = precision
+        for script in selected:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(here, script)],
+                capture_output=True,
+                text=True,
+                cwd=root,
+                env=env,
+            )
+            for line in proc.stdout.splitlines():
+                line = line.strip()
+                if line.startswith("{"):
+                    records.append(json.loads(line))
+                    print(line, flush=True)
+            if proc.returncode != 0:
+                tag = f"{script}@{precision}" if precision else script
+                failed.append(tag)
+                print(f"[run_all] {tag} FAILED:\n{proc.stderr[-2000:]}",
+                      file=sys.stderr)
     out = os.path.join(here, "results.json")
+    # Intra-run dedup, last-wins: with --precision both, a
+    # precision-UNAWARE bench (gilbert, serving) runs once per pass and
+    # emits identical unstamped rows each time — keep one, not two
+    # contradictory copies.
+    deduped: dict[tuple, dict] = {}
+    for r in records:
+        deduped[(r.get("config"), r.get("metric"), r.get("precision"))] = r
+    records = list(deduped.values())
     if args and os.path.exists(out):
-        # Partial run: merge over the prior file instead of discarding it.
-        fresh = {(r.get("config"), r.get("metric")) for r in records}
+        # Partial run: merge over the prior file instead of discarding
+        # it. Precision is part of the row key (re-measuring one policy
+        # must not evict the other's records) — EXCEPT that a prior row
+        # with no precision stamp predates the policy and is superseded
+        # by ANY fresh measurement of the same config+metric (otherwise
+        # the stale pre-policy row survives forever next to its
+        # stamped replacement).
+        fresh = {
+            (r.get("config"), r.get("metric"), r.get("precision"))
+            for r in records
+        }
+        fresh_cm = {(r.get("config"), r.get("metric")) for r in records}
         with open(out, encoding="utf-8") as f:
             kept = [
                 r for r in json.load(f)
-                if (r.get("config"), r.get("metric")) not in fresh
+                if (r.get("config"), r.get("metric"), r.get("precision"))
+                not in fresh
+                and not (
+                    r.get("precision") is None
+                    and (r.get("config"), r.get("metric")) in fresh_cm
+                )
             ]
         records = kept + records
     with open(out, "w", encoding="utf-8") as f:

@@ -14,8 +14,13 @@ Per batch size B the two programs do identical samples/step work:
 - ``jit_epoch``: ONE dispatch of the scanned K-step epoch program.
 
 Env knobs: BENCH_BATCHES ("20,64,256,1024"), BENCH_SCAN (16),
-BENCH_SECONDS (5). Emits one JSON line per (program, batch) plus the
-crossover record; merges into program_sweep.json (per device kind).
+BENCH_SECONDS (5), BENCH_PRECISION ("bf16" default | "f32"). Emits one
+JSON line per (program, batch) plus the crossover record; merges into
+program_sweep.json keyed by device kind (bf16, the legacy key) or
+``"<device kind>@<precision>"``. Every record carries ``compute_dtype``
+so ``choose_epoch_program`` can refuse to let a crossover measured
+under one dtype decide runs under another — the HBM working set halves
+under bf16, which is exactly what moves the knee.
 """
 
 from __future__ import annotations
@@ -39,13 +44,13 @@ from benchmarks.common import FEATURES, HIDDEN, WINDOW  # noqa: E402
 
 def throughput(program: str, batch: int, scan: int, seconds: float) -> float:
     """Samples/sec of K train steps as K dispatches vs one scanned one."""
-    from benchmarks.common import time_carried_steps
+    from benchmarks.common import bench_dtype, time_carried_steps
     from tpuflow.core.losses import mae_clip
     from tpuflow.models import LSTMRegressor
     from tpuflow.train import create_state, make_train_step
     from tpuflow.train.steps import make_epoch_step
 
-    model = LSTMRegressor(hidden=HIDDEN, dtype=jnp.bfloat16)
+    model = LSTMRegressor(hidden=HIDDEN, dtype=bench_dtype())
     rng = np.random.default_rng(0)
     x_np = rng.standard_normal((batch, WINDOW, FEATURES)).astype(np.float32)
     y_np = rng.standard_normal((batch, WINDOW)).astype(np.float32)
@@ -72,12 +77,15 @@ def throughput(program: str, batch: int, scan: int, seconds: float) -> float:
 
 
 def main() -> None:
+    from benchmarks.common import bench_precision
+
     batches = [
         max(int(b), 1)
         for b in os.environ.get("BENCH_BATCHES", "20,64,256,1024").split(",")
     ]
     scan = max(int(os.environ.get("BENCH_SCAN", 16)), 1)
     seconds = float(os.environ.get("BENCH_SECONDS", 5))
+    precision = bench_precision()
     device_kind = getattr(
         jax.devices()[0], "device_kind", jax.default_backend()
     )
@@ -91,10 +99,12 @@ def main() -> None:
             except Exception as e:
                 sps[program] = None
                 emit("epoch_program", f"{program}_B{batch}", -1.0,
-                     "samples/sec/chip", error=str(e)[:200])
+                     "samples/sec/chip", precision=precision,
+                     error=str(e)[:200])
                 continue
             emit("epoch_program", f"{program}_B{batch}", sps[program],
-                 "samples/sec/chip", device=device_kind, scan=scan)
+                 "samples/sec/chip", device=device_kind, scan=scan,
+                 precision=precision)
         if sps.get("jit_epoch") and sps.get("per_batch"):
             rows.append(
                 {"batch": batch, "jit_epoch": round(sps["jit_epoch"], 1),
@@ -120,11 +130,13 @@ def main() -> None:
         "crossover_batch": crossover,
         "scan_always": crossover is None,
         "scan": scan,
+        "compute_dtype": precision,
         "rows": rows,
     }
     emit("epoch_program", "crossover_batch",
          -1.0 if crossover is None else crossover, "samples",
-         device=device_kind, scan_always=crossover is None)
+         device=device_kind, scan_always=crossover is None,
+         precision=precision)
 
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "program_sweep.json")
@@ -135,10 +147,15 @@ def main() -> None:
                 sweep = json.load(f)
         except (OSError, json.JSONDecodeError):
             sweep = {}
-    sweep[device_kind] = record
+    # bf16 keeps the legacy plain key (every committed sweep was bf16);
+    # other precisions get their own "<device>@<precision>" entry so one
+    # device can carry a crossover per dtype (autotune tries the exact
+    # key first, then dtype-matches the plain one).
+    key = device_kind if precision == "bf16" else f"{device_kind}@{precision}"
+    sweep[key] = record
     with open(out, "w", encoding="utf-8") as f:
         json.dump(sweep, f, indent=2)
-    print(f"[sweep_epoch_program] wrote {device_kind!r} -> {out}",
+    print(f"[sweep_epoch_program] wrote {key!r} -> {out}",
           file=sys.stderr)
 
 

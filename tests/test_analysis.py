@@ -571,6 +571,83 @@ class TestLinter:
                     jax.block_until_ready(x)  # noqa: TPF010
         """) == []
 
+    def test_tpf011_f32_promotion_in_train_step_flagged(self, tmp_path):
+        diags = self._lint_source(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            def make_train_step():
+                def step(state, x, y, rng):
+                    h = x.astype(jnp.bfloat16)
+                    h = h.astype(jnp.float32)
+                    z = jnp.float32(h)
+                    return state, z
+                return jax.jit(step)
+        """)
+        assert _codes(diags) == ["TPF011", "TPF011"]
+        assert any("astype" in d.message for d in diags)
+
+    def test_tpf011_loss_grad_and_aux_promotions_exempt(self, tmp_path):
+        # The policy REQUIRES f32 at the reduction sites: the loss_of
+        # closure's prediction promote, the loss/grad_norm aux casts —
+        # none of these defeat the precision policy, all are exempt.
+        assert self._lint_source(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            def make_train_step(loss_fn):
+                def step(state, x, y, rng):
+                    def loss_of(params):
+                        pred = state.apply_fn(params, x)
+                        return loss_fn(y, pred.astype(jnp.float32))
+                    loss, grads = jax.value_and_grad(loss_of)(state.params)
+                    gnorm = global_norm(grads)
+                    return state, {
+                        "loss": loss.astype(jnp.float32),
+                        "grad_norm": gnorm.astype(jnp.float32),
+                    }
+                return jax.jit(step)
+        """) == []
+
+    def test_tpf011_scoped_to_train_step_bodies(self, tmp_path):
+        # The same promotion in a jitted fn that is NOT a train step
+        # (serving forward, eval) is someone else's contract.
+        assert self._lint_source(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            def make_predict(apply_fn):
+                def predict(params, x):
+                    return apply_fn(params, x).astype(jnp.float32)
+                return jax.jit(predict)
+        """) == []
+
+    def test_tpf011_preferred_element_type_not_flagged(self, tmp_path):
+        # An f32 ACCUMULATOR request on a native-dtype matmul is design
+        # rule 2 of docs/kernels.md, not a promotion.
+        assert self._lint_source(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            def make_train_step():
+                def step(state, x, y, rng):
+                    z = jnp.dot(x, y, preferred_element_type=jnp.float32)
+                    return state, z
+                return jax.jit(step)
+        """) == []
+
+    def test_tpf011_noqa_suppression(self, tmp_path):
+        assert self._lint_source(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            def make_train_step():
+                def step(state, x, y, rng):
+                    h = x.astype(jnp.float32)  # noqa: TPF011
+                    return state, h
+                return jax.jit(step)
+        """) == []
+
     def test_self_lint_gate_package_is_clean(self):
         """The gate: the whole tpuflow package obeys its own lint rules.
         New framework code that host-syncs inside jit, uses untraced
@@ -588,11 +665,24 @@ class TestLinter:
         assert "spec.health.unknown" in codes
         (d,) = [d for d in diags if d.code == "spec.health.unknown"]
         assert "halve_lr" in d.choices and "abort" in d.choices
+
+    def test_valid_health_policies_pass(self):
+        from tpuflow.analysis.spec import validate_spec
+
         for ok in ("warn", "abort", "halve_lr", "off", None):
             assert not [
                 d for d in validate_spec(TrainJobConfig(health=ok))
                 if d.code == "spec.health.unknown"
             ]
+
+    def test_unknown_precision_is_a_spec_finding(self):
+        from tpuflow.analysis.spec import validate_spec
+
+        diags = validate_spec(TrainJobConfig(precision="fp8"))
+        (d,) = [d for d in diags if d.code == "spec.precision.unknown"]
+        assert d.where == "precision"
+        assert "f32" in d.choices and "bf16" in d.choices
+        assert validate_spec(TrainJobConfig(precision="bf16")) == []
 
 
 class TestFailFastWiring:

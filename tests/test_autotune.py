@@ -173,3 +173,19 @@ class TestCommittedSweepEntries:
         assert measured[0] == float("inf")
         c = choose_epoch_program(1024, device_kind="TPU v5 lite")
         assert c.source == "measured" and c.jit_epoch
+
+    def test_committed_entries_are_dtype_annotated(self, monkeypatch):
+        """Both precisions of the default train path resolve measured on
+        cpu (bf16 via the legacy entry, f32 via the cpu@f32 sweep this
+        policy landed with), while the bf16-measured on-chip entry
+        refuses to decide f32 runs — no f32 sweep ever ran there."""
+        monkeypatch.delenv("TPUFLOW_PROGRAM_SWEEP", raising=False)
+        assert load_measured_crossover("cpu", "bf16") is not None
+        f32 = load_measured_crossover("cpu", "f32")
+        assert f32 is not None and "cpu@f32" in f32[1]
+        assert load_measured_crossover("TPU v5 lite", "bf16") is not None
+        assert load_measured_crossover("TPU v5 lite", "f32") is None
+        c = choose_epoch_program(
+            1024, device_kind="TPU v5 lite", compute_dtype="f32"
+        )
+        assert c.source == "heuristic"

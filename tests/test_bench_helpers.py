@@ -5,7 +5,7 @@ from __future__ import annotations
 
 import pytest
 
-from bench import bench_configs
+from bench import bench_configs, bench_precisions, precision_ab, twin_verdicts
 from benchmarks.common import _timed_passes, lstm_variants
 
 
@@ -41,6 +41,59 @@ class TestBenchConfigs:
             monkeypatch.delenv(var, raising=False)
         monkeypatch.setenv("BENCH_CONFIGS", "256x0")
         assert bench_configs() == [(256, 1)]
+
+
+class TestBenchPrecisions:
+    def test_default_interleaves_bf16_first(self, monkeypatch):
+        monkeypatch.delenv("BENCH_PRECISIONS", raising=False)
+        assert bench_precisions() == ["bf16", "f32"]
+
+    def test_single_precision_and_dedup(self, monkeypatch):
+        monkeypatch.setenv("BENCH_PRECISIONS", "f32, f32,")
+        assert bench_precisions() == ["f32"]
+
+    def test_unknown_precision_rejected(self, monkeypatch):
+        monkeypatch.setenv("BENCH_PRECISIONS", "fp8")
+        with pytest.raises(ValueError, match="fp8"):
+            bench_precisions()
+
+
+class TestTwinVerdicts:
+    """The pays-rent gate (docs/kernels.md rule 7) as data: every
+    measured Pallas entry carries its kernel/XLA-twin ratio, and a
+    ratio < 1.0 is flagged — never again a neutral data point."""
+
+    def test_slower_kernel_is_a_flagged_regression(self):
+        ratios, regressions = twin_verdicts({
+            "xla@1024x16": 3600.0,
+            "pallas@1024x16": 2300.0,  # the r05 flash-regression shape
+            "xla@1024x16@f32": 1800.0,
+            "pallas@1024x16@f32": 2000.0,
+        })
+        assert ratios["pallas@1024x16"] == pytest.approx(0.639, abs=1e-3)
+        assert regressions == ["pallas@1024x16"]
+        # The f32 pair pays rent and is NOT flagged.
+        assert ratios["pallas@1024x16@f32"] == pytest.approx(1.111, abs=1e-3)
+
+    def test_error_and_skip_entries_never_pair(self):
+        ratios, regressions = twin_verdicts({
+            "xla@1024x16": "SKIPPED: worker deadline",
+            "pallas@1024x16": 2300.0,
+            "pallas@2048x16": "ERROR: boom",
+            "xla@2048x16": 5000.0,
+        })
+        assert ratios == {} and regressions == []
+
+
+class TestPrecisionAB:
+    def test_pairs_by_entry_and_ignores_singletons(self):
+        ab = precision_ab({
+            "xla@1024x16": 9_000_000.0,
+            "xla@1024x16@f32": 6_000_000.0,
+            "remat@1024x16": 8_000_000.0,  # no f32 pair measured
+            "xla@2048x16@f32": 5_000_000.0,  # no bf16 pair measured
+        })
+        assert ab == {"xla@1024x16": 1.5}
 
 
 class TestLstmVariants:

@@ -1,0 +1,269 @@
+"""The mixed-precision train path (tpuflow/train/precision.py).
+
+One knob — ``TrainJobConfig.precision`` — must deliver four contracts at
+once, each drilled here on CPU (tier-1):
+
+1. **Parity**: a fixed-seed bf16 fit lands within a documented tolerance
+   of the f32 fit (the speedup is never a numerics regression — the
+   bench gate's tier-1 twin).
+2. **f32 masters everywhere an artifact is read**: checkpoints written
+   by a bf16 run restore as f32 and overlay onto f32 consumers;
+   ``check_params_match`` names the leaf path on dtype drift.
+3. **Watchdog honesty**: the numerics watchdog still trips (and aborts)
+   under ``precision="bf16"`` — the aux reaches it in f32, so the EWMA
+   spike threshold never silently widens to bf16 resolution.
+4. **Preflight**: an unknown precision dies at submission naming the
+   valid choices, before any ingest or compile.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuflow.api import TrainJobConfig, train
+from tpuflow.train.precision import (
+    PARITY_RTOL,  # the ONE documented tolerance, shared with the bench gate
+    PRECISIONS,
+    cast_floating,
+    check_precision,
+    compute_dtype,
+    model_accepts_dtype,
+    precision_itemsize,
+)
+
+_FIT = dict(
+    model="lstm",
+    window=8,
+    synthetic_wells=2,
+    synthetic_steps=64,
+    max_epochs=6,
+    batch_size=32,
+    seed=3,
+    verbose=False,
+    n_devices=1,
+)
+# The stacked-LSTM reference config (BASELINE config 5 family), shrunk
+# to tier-1 scale — the acceptance drill's model.
+_STACKED_FIT = dict(_FIT, model="stacked_lstm")
+
+
+class TestPolicyHelpers:
+    def test_tokens_and_dtypes(self):
+        assert PRECISIONS == ("f32", "bf16")
+        assert compute_dtype("f32") == jnp.float32
+        assert compute_dtype("bf16") == jnp.bfloat16
+        assert precision_itemsize("f32") == 4
+        assert precision_itemsize("bf16") == 2
+
+    def test_unknown_precision_names_choices(self):
+        with pytest.raises(ValueError) as e:
+            check_precision("fp8")
+        assert "f32" in str(e.value) and "bf16" in str(e.value)
+
+    def test_cast_floating_leaves_ints_alone(self):
+        tree = {"w": jnp.ones((2, 2), jnp.float32), "step": jnp.int32(3)}
+        out = cast_floating(tree, jnp.bfloat16)
+        assert out["w"].dtype == jnp.bfloat16
+        assert out["step"].dtype == jnp.int32
+
+    def test_every_registry_model_takes_the_dtype_knob(self):
+        from tpuflow.models import MODELS
+
+        missing = [m for m in MODELS if not model_accepts_dtype(m)]
+        assert missing == [], (
+            f"model families without a compute-dtype knob: {missing} — "
+            "the precision policy cannot reach them"
+        )
+
+
+class TestParity:
+    def test_bf16_matches_f32_within_documented_tolerance(self):
+        """The acceptance gate's tier-1 twin: fixed-seed STACKED-LSTM
+        (the reference config's family) fit end-to-end on CPU, bf16
+        final loss within PARITY_RTOL of f32."""
+        f32 = train(TrainJobConfig(precision="f32", **_STACKED_FIT))
+        bf16 = train(TrainJobConfig(precision="bf16", **_STACKED_FIT))
+        assert np.isfinite(bf16.test_loss)
+        assert bf16.test_loss == pytest.approx(
+            f32.test_loss, rel=PARITY_RTOL
+        ), (
+            f"bf16 fit diverged from f32: {bf16.test_loss} vs "
+            f"{f32.test_loss} (documented tolerance {PARITY_RTOL})"
+        )
+
+    def test_bf16_state_params_stay_f32_masters(self):
+        report = train(TrainJobConfig(precision="bf16", **_FIT))
+        for leaf in jax.tree_util.tree_leaves(report.result.state.params):
+            assert leaf.dtype == jnp.float32
+
+    def test_bf16_trains_data_parallel_end_to_end(self):
+        """The multi-device leg: the injected DP steps build their own
+        programs (no FitConfig.compute_dtype), so the MODEL's dtype
+        cast must carry the policy there — stacked-LSTM DP under bf16
+        trains to a finite loss with f32 masters (conftest provides 8
+        virtual devices)."""
+        report = train(TrainJobConfig(
+            precision="bf16", n_devices=2, batch_size=32, **{
+                k: v for k, v in _STACKED_FIT.items()
+                if k not in ("n_devices", "batch_size")
+            },
+        ))
+        assert np.isfinite(report.test_loss)
+        for leaf in jax.tree_util.tree_leaves(report.result.state.params):
+            assert leaf.dtype == jnp.float32
+
+    def test_live_roofline_gauges_publish_under_bf16(self):
+        """The observability half of the acceptance: under the bf16
+        policy the MFU/HBM/bound gauges still publish, with the halved
+        byte account and the compute dtype echoed in the report."""
+        from tpuflow.obs import default_registry, publish_roofline
+        from tpuflow.utils.roofline import (
+            lstm_bytes_per_sample_step,
+            lstm_flops_per_sample_step,
+        )
+
+        flops = lstm_flops_per_sample_step(24, 5, 64)
+        rep = publish_roofline(
+            1e6, flops, lstm_bytes_per_sample_step(24, 5, 64, 2),
+            "TPU v5 lite", compute_dtype="bf16",
+        )
+        assert rep["compute_dtype"] == "bf16" and rep["mfu"] is not None
+        reg = default_registry()
+        assert reg.gauge("train_mfu", "").value() == rep["mfu"]
+        assert reg.gauge("train_hbm_util", "").value() == rep["hbm_util"]
+        assert reg.gauge("train_bound", "").value(bound="hbm") == 1.0
+
+
+class TestArtifactsStayF32:
+    def test_bf16_checkpoint_roundtrips_f32(self, tmp_path):
+        """A bf16 run's artifact is byte-compatible with f32 consumers:
+        the checkpoint restores f32 and warm-starts a fresh f32 run."""
+        storage = str(tmp_path / "art")
+        train(TrainJobConfig(
+            precision="bf16", storage_path=storage, **_FIT
+        ))
+        from tpuflow.train.checkpoint import BestCheckpointer
+
+        ckpt = BestCheckpointer(storage, "lstm")
+        try:
+            structure = ckpt.best_structure()
+        finally:
+            ckpt.close()
+        for leaf in jax.tree_util.tree_leaves(structure):
+            assert np.dtype(leaf.dtype) == np.float32
+        # And the sidecar records no compute dtype — serving builds f32.
+        import json
+        import os
+
+        with open(os.path.join(storage, "meta", "lstm.json")) as f:
+            meta = json.load(f)
+        assert "dtype" not in meta["model_kwargs"]
+        # Warm-starting a fresh f32 job from the bf16-trained artifact
+        # is the online loop's retrain path — it must just work.
+        report = train(TrainJobConfig(
+            precision="f32", warm_start=storage, **_FIT
+        ))
+        assert np.isfinite(report.test_loss)
+
+    def test_dtype_drift_errors_name_the_leaf_path(self):
+        from tpuflow.train.resume import apply_params, check_params_match
+
+        live = {"lstm_0": {"w_x": jnp.zeros((4, 8), jnp.float32)}}
+        drifted = {"lstm_0": {"w_x": jnp.zeros((4, 8), jnp.bfloat16)}}
+        with pytest.raises(ValueError) as e:
+            check_params_match(live, drifted)
+        assert "w_x" in str(e.value) and "bfloat16" in str(e.value)
+
+        from flax.training.train_state import TrainState
+        from tpuflow.train.optim import keras_sgd
+
+        state = TrainState.create(
+            apply_fn=lambda *a, **k: None, params=live, tx=keras_sgd()
+        )
+        with pytest.raises(ValueError):
+            apply_params(state, drifted)
+
+
+class TestWatchdogUnderBf16:
+    def test_divergence_drill_still_aborts(self, tmp_path):
+        """The numerics watchdog reads f32 aux whatever the compute
+        dtype: the synthetic diverging run (mse + lr=1e12, the
+        test_health drill) must trip and abort under bf16 too."""
+        from tpuflow.obs import NumericsDivergence
+
+        with pytest.raises(NumericsDivergence) as e:
+            train(TrainJobConfig(
+                model="static_mlp",
+                model_kwargs={"hidden": [8]},
+                max_epochs=6,
+                batch_size=32,
+                seed=0,
+                verbose=False,
+                n_devices=1,
+                synthetic_wells=2,
+                synthetic_steps=64,
+                loss="mse",
+                optimizer_kwargs={"learning_rate": 1e12},
+                precision="bf16",
+                health="abort",
+            ))
+        assert e.value.anomalies
+
+    def test_aux_is_f32_device_values(self):
+        """The step's loss/grad_norm aux is f32 even under bf16 compute
+        — the EWMA threshold keeps f32 resolution (TPF006's post-epoch
+        read then converts exact f32, not quantized bf16)."""
+        from tpuflow.models import LSTMRegressor
+        from tpuflow.train import create_state, make_train_step
+
+        model = LSTMRegressor(hidden=8, dtype=jnp.bfloat16)
+        x = np.random.default_rng(0).standard_normal((4, 8, 5)).astype(
+            np.float32
+        )
+        y = np.zeros((4, 8), np.float32)
+        state = create_state(model, jax.random.PRNGKey(0), x[:2])
+        step = make_train_step(compute_dtype=jnp.bfloat16)
+        _, metrics = step(state, x, y, jax.random.PRNGKey(0))
+        assert metrics["loss"].dtype == jnp.float32
+        assert metrics["grad_norm"].dtype == jnp.float32
+
+
+class TestPreflight:
+    def test_unknown_precision_rejected_before_ingest(self):
+        with pytest.raises(ValueError) as e:
+            train(TrainJobConfig(precision="fp8", **_FIT))
+        msg = str(e.value)
+        assert "precision" in msg and "f32" in msg and "bf16" in msg
+
+    def test_epoch_program_choice_keys_on_precision(self, tmp_path, monkeypatch):
+        """A crossover measured under bf16 must not decide f32 runs:
+        dtype-annotated sweep entries only match their own precision."""
+        import json
+
+        from tpuflow.train.autotune import choose_epoch_program
+
+        path = tmp_path / "sweep.json"
+        path.write_text(json.dumps({
+            "fake-chip": {"crossover_batch": 64, "compute_dtype": "bf16"},
+        }))
+        monkeypatch.setenv("TPUFLOW_PROGRAM_SWEEP", str(path))
+        bf16 = choose_epoch_program(
+            20, device_kind="fake-chip", compute_dtype="bf16"
+        )
+        f32 = choose_epoch_program(
+            20, device_kind="fake-chip", compute_dtype="f32"
+        )
+        assert bf16.source == "measured"
+        assert f32.source == "heuristic"
+        # A dtype-keyed entry wins over the plain one for its dtype.
+        path.write_text(json.dumps({
+            "fake-chip": {"crossover_batch": 64, "compute_dtype": "bf16"},
+            "fake-chip@f32": {"crossover_batch": 512},
+        }))
+        f32 = choose_epoch_program(
+            256, device_kind="fake-chip", compute_dtype="f32"
+        )
+        assert f32.source == "measured" and f32.jit_epoch

@@ -104,6 +104,17 @@ RULES = {
               "device work (the retrain) belongs in a helper the loop "
               "calls, where it runs once per retrain, not once per "
               "window",
+    "TPF011": "explicit f32 promotion (.astype(jnp.float32) / "
+              "jnp.float32(...)) on activations inside a jitted "
+              "*train_step body: it silently defeats the mixed-precision "
+              "policy — one promoted activation drags every downstream "
+              "op back to f32 and the HBM bytes the bf16 path saved come "
+              "back (tpuflow/train/precision.py). Loss/grad-reduction "
+              "sites are exempt (identifiers mentioning "
+              "loss/grad/norm/metric, or any code under a *loss* "
+              "function — reduction MUST promote), as is "
+              "preferred_element_type=jnp.float32 (an accumulator "
+              "request, not a promotion)",
 }
 
 _HOST_SYNC_NAMES = {"float", "bool"}
@@ -140,6 +151,15 @@ _ASYNC_BLOCKING_ATTRS = {
     ("request", "urlopen"),
 }
 _ASYNC_BLOCKING_BASES = {"requests"}
+# TPF011: scope and exemptions. The rule fires inside jitted functions
+# whose enclosing-def chain includes a ``*train_step`` name (the step
+# factories: make_train_step, make_dp_train_step, ...). An f32
+# promotion is EXEMPT when any identifier in the call mentions one of
+# these words (loss/grad reductions and the watchdog aux are REQUIRED
+# to promote) or when it sits under a function whose name mentions
+# "loss" (the loss_of closures — the loss site promotes the
+# prediction by design).
+_F32_EXEMPT_WORDS = ("loss", "grad", "norm", "metric")
 
 
 def _noqa_lines(source: str) -> dict[int, set[str]]:
@@ -208,6 +228,7 @@ class _Linter(ast.NodeVisitor):
         self.findings: list[Diagnostic] = []
         self._jit_depth = 0
         self._async_depth = 0
+        self._def_stack: list[str] = []
         norm = path.replace(os.sep, "/")
         self._is_compat = norm.endswith(_COMPAT_MODULE_SUFFIX)
         self._is_online = _ONLINE_PATH_FRAGMENT in norm
@@ -236,6 +257,7 @@ class _Linter(ast.NodeVisitor):
         self._check_defaults(node)
         entered = self._jit_depth > 0 or self._is_jitted_def(node)
         self._jit_depth += 1 if entered else 0
+        self._def_stack.append(node.name)
         # TPF009 scope: an ``async def`` body runs on the event loop; a
         # nested SYNC def does not (its callers choose the thread — the
         # run_in_executor pattern), so it resets the flag for its body.
@@ -246,6 +268,7 @@ class _Linter(ast.NodeVisitor):
             self._async_depth = 0
         self.generic_visit(node)
         self._async_depth = prev_async
+        self._def_stack.pop()
         self._jit_depth -= 1 if entered else 0
 
     visit_FunctionDef = _visit_function
@@ -529,10 +552,68 @@ class _Linter(ast.NodeVisitor):
 
     # --- TPF001 / TPF002 / TPF004: calls ---
 
+    # --- TPF011: f32 promotions inside jitted *train_step bodies ---
+
+    def _in_train_step_scope(self) -> bool:
+        return self._jit_depth > 0 and any(
+            name.endswith("train_step") for name in self._def_stack
+        )
+
+    @staticmethod
+    def _is_f32_expr(expr: ast.AST) -> bool:
+        """``jnp.float32`` / ``np.float32`` / the "float32" string."""
+        if isinstance(expr, ast.Attribute) and expr.attr == "float32":
+            return True
+        return isinstance(expr, ast.Constant) and expr.value == "float32"
+
+    def _f32_exempt(self, node: ast.Call) -> bool:
+        # A reduction site: the call mentions a loss/grad/norm/metric
+        # identifier, or sits under a *loss* function (loss_of) — those
+        # promotions ARE the policy ("loss/grad reduction in f32").
+        for name in self._def_stack:
+            if "loss" in name.lower():
+                return True
+        for sub in ast.walk(node):
+            ident = (
+                sub.id if isinstance(sub, ast.Name)
+                else sub.attr if isinstance(sub, ast.Attribute)
+                else None
+            )
+            if ident and any(
+                w in ident.lower() for w in _F32_EXEMPT_WORDS
+            ):
+                return True
+        return False
+
+    def _check_f32_promotion(self, node: ast.Call, func) -> None:
+        promotion = None
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "astype"
+            and node.args
+            and self._is_f32_expr(node.args[0])
+        ):
+            promotion = ".astype(jnp.float32)"
+        elif (
+            isinstance(func, ast.Attribute)
+            and func.attr == "float32"
+            and isinstance(func.value, ast.Name)
+            and func.value.id in (_NP_NAMES | {"jnp"})
+            and node.args
+        ):
+            promotion = f"{func.value.id}.float32(...)"
+        if promotion and not self._f32_exempt(node):
+            self._emit(
+                "TPF011", node,
+                f"{promotion} on an activation in a train step",
+            )
+
     def visit_Call(self, node) -> None:
         func = node.func
         if self._async_depth > 0:
             self._check_async_blocking(node, func)
+        if self._in_train_step_scope():
+            self._check_f32_promotion(node, func)
         if self._jit_depth > 0:
             if (
                 isinstance(func, ast.Name)

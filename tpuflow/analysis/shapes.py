@@ -120,6 +120,16 @@ def shape_dryrun(config) -> list[Diagnostic]:
         # finite placeholder exercises the same shape contract.
         model_kwargs.setdefault("target_mean", 0.0)
         model_kwargs.setdefault("target_std", 1.0)
+    # train()'s mixed-precision injection, via the SHARED rule: the
+    # dry-run must trace the graph the job will actually run (a model
+    # whose kwargs break under the bf16 cast fails HERE, before any
+    # compile). An invalid precision token is the spec pass's finding;
+    # inject_model_dtype ignores it and the dry-run proceeds at f32.
+    from tpuflow.train.precision import inject_model_dtype
+
+    model_kwargs = inject_model_dtype(
+        config.model, model_kwargs, getattr(config, "precision", "f32")
+    )
     try:
         model = build_model(config.model, **model_kwargs)
     except Exception as e:  # noqa: BLE001 — any constructor failure IS the finding

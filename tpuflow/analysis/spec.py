@@ -235,6 +235,20 @@ def _check_storage(config) -> list[Diagnostic]:
     return out
 
 
+def _check_precision(config) -> list[Diagnostic]:
+    from tpuflow.train.precision import PRECISIONS
+
+    precision = getattr(config, "precision", "f32")
+    if precision in PRECISIONS:
+        return []
+    return [_diag(
+        "spec.precision.unknown",
+        f"unknown precision {precision!r}",
+        where="precision",
+        choices=list(PRECISIONS),
+    )]
+
+
 def _check_health(config) -> list[Diagnostic]:
     from tpuflow.obs.health import HEALTH_OFF, HEALTH_POLICIES
 
@@ -383,7 +397,7 @@ def validate_spec(config) -> list[Diagnostic]:
     for check in (
         _check_registries, _check_schema, _check_scalars,
         _check_windowing, _check_stream, _check_storage, _check_health,
-        _check_faults, _check_elastic, _check_online,
+        _check_precision, _check_faults, _check_elastic, _check_online,
     ):
         try:
             out += check(config)

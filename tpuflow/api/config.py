@@ -50,6 +50,15 @@ class TrainJobConfig:
     optimizer: str = "keras_sgd"
     optimizer_kwargs: dict = field(default_factory=dict)
     clip_norm: float = 0.0  # 0 = off; optax.clip_by_global_norm otherwise
+    # Mixed-precision policy (tpuflow/train/precision.py): "f32" (default)
+    # or "bf16". Under bf16 the models compute in bfloat16 (params and
+    # activations cast per layer, batch cast at step entry) while master
+    # params, optimizer state, loss/grad reduction, checkpoints, and
+    # serving artifacts all stay float32 — roughly halving HBM
+    # bytes/sample on the HBM-bound train path with no change to any
+    # artifact consumer. Spec-validated; the roofline gauges and the
+    # epoch-program autotuner both key on it.
+    precision: str = "f32"
     # >1: average k micro-batch grads per optimizer update (MultiSteps) —
     # effective batch k*batch_size without k-times the activation memory.
     # Size epochs to a multiple of k: a trailing partial window's grads

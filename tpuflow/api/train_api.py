@@ -152,13 +152,18 @@ def _sidecar_kwargs(model_kwargs: dict) -> dict:
     serializes nor exists at serving time; the artifact's checkpoints are
     backend-interchangeable, so the sidecar swaps in the on-chip "full"
     backend and drops the mesh — a ring-trained run still produces a
-    servable artifact. Everything else passes through (and must be
+    servable artifact. The compute ``dtype`` is dropped for the same
+    reason: checkpoints hold f32 MASTER params whatever the training
+    precision (tpuflow/train/precision.py), so artifacts serve f32 and
+    a bf16-trained run's artifact is byte-compatible with every f32
+    consumer. Everything else passes through (and must be
     JSON-serializable; train() checks before fitting).
     """
     kwargs = dict(model_kwargs)
     if kwargs.get("backend") == "ring":
         kwargs["backend"] = "full"
     kwargs.pop("mesh", None)
+    kwargs.pop("dtype", None)
     return kwargs
 
 
@@ -610,6 +615,10 @@ def _train_impl(
             pp=config.pp,
             ep=config.ep,
             multi_host=jax.process_count() > 1,
+            # A crossover measured under one compute dtype must not
+            # silently decide runs under another (the HBM working-set
+            # halves under bf16, which is exactly what moves the knee).
+            compute_dtype=config.precision,
         )
     else:
         program = ProgramChoice(
@@ -677,7 +686,28 @@ def _train_impl(
         )
 
     # --- model + state (L3/L4) ---
-    model_kwargs = dict(config.model_kwargs)
+    # Mixed-precision policy (tpuflow/train/precision.py): the model
+    # leg (per-layer dtype cast inside the differentiated graph — grads
+    # stay f32 against f32 masters) is installed by the shared
+    # injection rule, the step leg (batch cast at step entry, f32 loss
+    # reduction and aux) rides FitConfig.compute_dtype below. The model
+    # leg is the one that reaches EVERY path — the injected dp/tp/pp/ep
+    # steps build their own programs without FitConfig.compute_dtype,
+    # and compute there goes bf16 because the model casts at its own
+    # entry (all registry families do). Explicit user model_kwargs
+    # dtype wins — the knob is a default, not a clamp.
+    from tpuflow.train.precision import (
+        compute_dtype as resolve_compute_dtype,
+        inject_model_dtype,
+        precision_itemsize,
+    )
+
+    step_dtype = None
+    if config.precision != "f32":
+        step_dtype = resolve_compute_dtype(config.precision)
+    model_kwargs = inject_model_dtype(
+        config.model, config.model_kwargs, config.precision
+    )
     if config.model == "gilbert_residual":
         # The physics-informed model standardizes its raw physical output
         # with the train-split stats (see GilbertResidualMLP docstring).
@@ -862,12 +892,18 @@ def _train_impl(
             window=config.window,
             features=int(feat_dim),
             model_kwargs=model_kwargs,
+            # Honest bytes: activation traffic travels in the COMPUTE
+            # dtype, so bf16 halves hbm_bytes_per_sample — the live
+            # train_hbm_util/train_bound gauges must reflect it or the
+            # policy's whole win is invisible to the roofline.
+            itemsize=precision_itemsize(config.precision),
         )
         if cost is not None:
             roofline_cfg = {
                 "flops_per_sample": cost[0],
                 "bytes_per_sample": cost[1],
                 "n_chips": n_dev,
+                "compute_dtype": config.precision,
             }
 
     # --- fit (the reference's hot loop, cnn.py:126-129) ---
@@ -892,6 +928,7 @@ def _train_impl(
         stop_fn=stop_fn,
         health=config.health,
         roofline=roofline_cfg,
+        compute_dtype=step_dtype,
         sync_fn=elastic_client.sync if elastic_client is not None else None,
     )
     if elastic_client is not None:

@@ -97,6 +97,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "anomalies: warn and continue (default), abort "
                         "the run (typed NumericsDivergence), halve the "
                         "optimizer LR, or off")
+    p.add_argument("--precision", default="f32",
+                   choices=["f32", "bf16"],
+                   help="mixed-precision policy "
+                        "(tpuflow/train/precision.py): bf16 computes in "
+                        "bfloat16 while master params, optimizer state, "
+                        "checkpoints, and serving artifacts stay f32 — "
+                        "roughly half the HBM bytes/sample on the "
+                        "HBM-bound train path")
     p.add_argument("--quiet", action="store_true")
     p.add_argument("--preflight", action="store_true", default=True,
                    dest="preflight",
@@ -245,6 +253,7 @@ def main(argv=None) -> int:
         synthetic_steps=args.synthetic_steps,
         verbose=not args.quiet,
         jit_epoch=args.jit_epoch,
+        precision=args.precision,
         stream=args.stream,
         stream_chunk_rows=args.stream_chunk_rows,
         stream_shuffle_buffer=args.stream_shuffle_buffer,

@@ -13,22 +13,34 @@ so the head is Dense(1).
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 
 
 class CNN1D(nn.Module):
-    """[B, T, F] -> [B] via 1-D convolution over the time axis."""
+    """[B, T, F] -> [B] via 1-D convolution over the time axis.
+
+    ``dtype`` is the COMPUTE dtype (mixed-precision policy,
+    tpuflow/train/precision.py): params stay f32, the conv/dense math
+    runs in ``dtype``, the output is promoted back to f32.
+    """
 
     filters: int = 100
     kernel_size: int = 13
     dropout_rate: float = 0.5
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, deterministic: bool = True) -> jnp.ndarray:
         x = nn.relu(
-            nn.Conv(features=self.filters, kernel_size=(self.kernel_size,))(x)
+            nn.Conv(
+                features=self.filters,
+                kernel_size=(self.kernel_size,),
+                dtype=self.dtype,
+            )(x.astype(self.dtype))
         )
         x = nn.Dropout(self.dropout_rate, deterministic=deterministic)(x)
         x = x.reshape(x.shape[0], -1)
-        return nn.Dense(1)(x)[..., 0]
+        return nn.Dense(1, dtype=self.dtype)(x)[..., 0].astype(jnp.float32)

@@ -15,7 +15,7 @@ learned regressors (Readme.md:7-21) gestures at but never builds.
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Any, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -27,18 +27,26 @@ SOFTPLUS_ONE = 0.5413248546129181  # ln(e - 1)
 
 
 class StaticMLP(nn.Module):
-    """3-layer MLP over tabular features: [B, F] -> [B]."""
+    """3-layer MLP over tabular features: [B, F] -> [B].
+
+    ``dtype`` is the COMPUTE dtype (mixed-precision policy,
+    tpuflow/train/precision.py): params stay f32 masters (flax
+    ``param_dtype``), activations/matmuls run in ``dtype``, and the
+    output is promoted to f32 so loss reduction never narrows.
+    """
 
     hidden: Sequence[int] = (64, 64)
     dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, deterministic: bool = True) -> jnp.ndarray:
+        x = x.astype(self.dtype)
         for h in self.hidden:
-            x = nn.relu(nn.Dense(h)(x))
+            x = nn.relu(nn.Dense(h, dtype=self.dtype)(x))
             if self.dropout_rate > 0:
                 x = nn.Dropout(self.dropout_rate, deterministic=deterministic)(x)
-        return nn.Dense(1)(x)[..., 0]
+        return nn.Dense(1, dtype=self.dtype)(x)[..., 0].astype(jnp.float32)
 
 
 class DynamicMLP(nn.Module):
@@ -46,15 +54,16 @@ class DynamicMLP(nn.Module):
 
     hidden: Sequence[int] = (128, 64)
     dropout_rate: float = 0.0
+    dtype: Any = jnp.float32  # compute dtype; params stay f32 (see StaticMLP)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, deterministic: bool = True) -> jnp.ndarray:
-        x = x.reshape(x.shape[0], -1)
+        x = x.reshape(x.shape[0], -1).astype(self.dtype)
         for h in self.hidden:
-            x = nn.relu(nn.Dense(h)(x))
+            x = nn.relu(nn.Dense(h, dtype=self.dtype)(x))
             if self.dropout_rate > 0:
                 x = nn.Dropout(self.dropout_rate, deterministic=deterministic)(x)
-        return nn.Dense(1)(x)[..., 0]
+        return nn.Dense(1, dtype=self.dtype)(x)[..., 0].astype(jnp.float32)
 
 
 class GilbertResidualMLP(nn.Module):
@@ -75,16 +84,22 @@ class GilbertResidualMLP(nn.Module):
     hidden: Sequence[int] = (64, 64)
     target_mean: float = 0.0
     target_std: float = 1.0
+    dtype: Any = jnp.float32  # compute dtype; params stay f32 (see StaticMLP)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, deterministic: bool = True) -> jnp.ndarray:
-        gilbert_q = x[..., -1]
-        h = x[..., :-1]
+        # The physical channel and the correction arithmetic stay f32
+        # whatever the compute dtype: raw flow spans orders of magnitude
+        # bf16 cannot hold without quantization error in the OUTPUT.
+        gilbert_q = x[..., -1].astype(jnp.float32)
+        h = x[..., :-1].astype(self.dtype)
         for width in self.hidden:
-            h = nn.relu(nn.Dense(width)(h))
+            h = nn.relu(nn.Dense(width, dtype=self.dtype)(h))
         # Zero-init head => raw=0 at init => softplus(SOFTPLUS_ONE) == 1:
         # training starts exactly at the physical model, learns deviations.
-        raw = nn.Dense(1, kernel_init=nn.initializers.zeros)(h)[..., 0]
+        raw = nn.Dense(
+            1, dtype=self.dtype, kernel_init=nn.initializers.zeros
+        )(h)[..., 0].astype(jnp.float32)
         correction = nn.softplus(raw + SOFTPLUS_ONE)
         return (gilbert_q * correction - self.target_mean) / self.target_std
 
@@ -106,22 +121,28 @@ class PipelineMLP(nn.Module):
 
     stages: int = 4
     hidden: int = 32
+    dtype: Any = jnp.float32  # compute dtype; params stay f32 (see StaticMLP)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, deterministic: bool = True) -> jnp.ndarray:
         import jax.nn.initializers as init
 
-        h = nn.relu(nn.Dense(self.hidden, name="embed")(x))
+        dt = self.dtype
+        h = nn.relu(nn.Dense(self.hidden, dtype=dt, name="embed")(
+            x.astype(dt)
+        ))
         wk = self.param(
             "stage_kernels", init.lecun_normal(),
             (self.stages, self.hidden, self.hidden),
-        )
+        ).astype(dt)
         bk = self.param(
             "stage_biases", init.zeros, (self.stages, self.hidden)
-        )
+        ).astype(dt)
         for s in range(self.stages):
             h = jnp.tanh(h @ wk[s] + bk[s])
-        return nn.Dense(1, name="head")(h)[..., 0]
+        return nn.Dense(1, dtype=dt, name="head")(h)[..., 0].astype(
+            jnp.float32
+        )
 
 
 class MoEMLP(nn.Module):
@@ -143,24 +164,28 @@ class MoEMLP(nn.Module):
     experts: int = 4
     hidden: int = 32
     ffn: int = 64
+    dtype: Any = jnp.float32  # compute dtype; params stay f32 (see StaticMLP)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, deterministic: bool = True) -> jnp.ndarray:
         import jax
         import jax.nn.initializers as init
 
-        h = nn.relu(nn.Dense(self.hidden, name="embed")(x))
+        dt = self.dtype
+        h = nn.relu(nn.Dense(self.hidden, dtype=dt, name="embed")(
+            x.astype(dt)
+        ))
         gate = self.param(
             "gate", init.lecun_normal(), (self.hidden, self.experts)
-        )
+        ).astype(dt)
         w1 = self.param(
             "expert_w1", init.lecun_normal(),
             (self.experts, self.hidden, self.ffn),
-        )
+        ).astype(dt)
         w2 = self.param(
             "expert_w2", init.lecun_normal(),
             (self.experts, self.ffn, self.hidden),
-        )
+        ).astype(dt)
         # THE shared top-1 router (tpuflow.parallel.ep.top1_gate): this
         # dense __call__ is the EP trainer's parity oracle AND the
         # serving path, so a routing change must reach all of them at
@@ -170,8 +195,10 @@ class MoEMLP(nn.Module):
 
         choice, weight = top1_gate(h, gate)
         moe = sum(
-            ((choice == e).astype(h.dtype) * weight)[:, None]
+            ((choice == e).astype(h.dtype) * weight.astype(h.dtype))[:, None]
             * (nn.relu(h @ w1[e]) @ w2[e])
             for e in range(self.experts)
         )
-        return nn.Dense(1, name="head")(h + moe)[..., 0]
+        return nn.Dense(1, dtype=dt, name="head")(h + moe)[..., 0].astype(
+            jnp.float32
+        )

@@ -423,6 +423,7 @@ def publish_roofline(
     bytes_per_sample: float,
     device_kind: str,
     *,
+    compute_dtype: str | None = None,
     registry=None,
     logger=None,
     epoch: int | None = None,
@@ -439,7 +440,7 @@ def publish_roofline(
 
     rep = roofline_report(
         samples_per_sec_per_chip, flops_per_sample, bytes_per_sample,
-        device_kind,
+        device_kind, compute_dtype=compute_dtype,
     )
     reg = registry or default_registry()
     if rep.get("mfu") is not None:

@@ -69,11 +69,23 @@ def _sweep_path() -> str:
     return os.path.join(root, "benchmarks", "program_sweep.json")
 
 
-def load_measured_crossover(device_kind: str) -> tuple[float, str] | None:
-    """The measured crossover batch for ``device_kind``, if a sweep for
-    that device kind has been recorded; ``(crossover, source_desc)``.
+def load_measured_crossover(
+    device_kind: str, compute_dtype: str | None = None
+) -> tuple[float, str] | None:
+    """The measured crossover batch for ``device_kind`` (and, when
+    given, ``compute_dtype`` — the precision token "f32"/"bf16"), if a
+    matching sweep has been recorded; ``(crossover, source_desc)``.
     ``inf`` means the sweep measured the scanned program faster at every
-    batch (``scan_always``)."""
+    batch (``scan_always``).
+
+    Dtype matching: sweeps are keyed ``"<device_kind>@<dtype>"`` (the
+    exact match, tried first) or plain ``"<device_kind>"`` whose record
+    carries a ``compute_dtype`` field — a crossover measured under one
+    compute dtype must never silently decide runs under another (the
+    HBM working set halves under bf16, which is what moves the knee).
+    A plain record WITHOUT the field matches any request (pre-policy
+    files), and ``compute_dtype=None`` requests match any record.
+    """
     path = _sweep_path()
     try:
         with open(path, encoding="utf-8") as f:
@@ -82,15 +94,25 @@ def load_measured_crossover(device_kind: str) -> tuple[float, str] | None:
         return None
     if not isinstance(sweep, dict):
         return None
-    rec = sweep.get(device_kind)
-    if not isinstance(rec, dict):
-        return None
-    if rec.get("scan_always") is True:
-        return float("inf"), f"{path} [{device_kind}]"
-    crossover = rec.get("crossover_batch")
-    if not isinstance(crossover, (int, float)) or crossover <= 0:
-        return None
-    return float(crossover), f"{path} [{device_kind}]"
+    candidates = []
+    if compute_dtype:
+        candidates.append((f"{device_kind}@{compute_dtype}", True))
+    candidates.append((device_kind, False))
+    for key, exact in candidates:
+        rec = sweep.get(key)
+        if not isinstance(rec, dict):
+            continue
+        if not exact and compute_dtype:
+            recorded = rec.get("compute_dtype")
+            if recorded is not None and recorded != compute_dtype:
+                continue
+        if rec.get("scan_always") is True:
+            return float("inf"), f"{path} [{key}]"
+        crossover = rec.get("crossover_batch")
+        if not isinstance(crossover, (int, float)) or crossover <= 0:
+            continue
+        return float(crossover), f"{path} [{key}]"
+    return None
 
 
 def choose_epoch_program(
@@ -102,6 +124,7 @@ def choose_epoch_program(
     ep: int = 1,
     multi_host: bool = False,
     device_kind: str | None = None,
+    compute_dtype: str | None = None,
 ) -> ProgramChoice:
     """Resolve ``jit_epoch=None`` ("auto") for one training job."""
     if stream:
@@ -139,19 +162,20 @@ def choose_epoch_program(
         device_kind = getattr(
             jax.devices()[0], "device_kind", jax.default_backend()
         )
-    measured = load_measured_crossover(device_kind)
+    measured = load_measured_crossover(device_kind, compute_dtype)
+    dtype_tag = f" [{compute_dtype}]" if compute_dtype else ""
     if measured is not None:
         crossover, source = measured
         jit = batch_size < crossover
         if crossover == float("inf"):
             desc = (
                 f"scanned program measured faster at every swept batch "
-                f"on {device_kind!r}"
+                f"on {device_kind!r}{dtype_tag}"
             )
         else:
             desc = (
                 f"batch_size {batch_size} {'<' if jit else '>='} measured "
-                f"crossover {int(crossover)} for {device_kind!r}"
+                f"crossover {int(crossover)} for {device_kind!r}{dtype_tag}"
             )
         return ProgramChoice(jit, desc, "measured")
     jit = batch_size < HEURISTIC_CROSSOVER_BATCH
@@ -159,6 +183,7 @@ def choose_epoch_program(
         jit,
         f"batch_size {batch_size} {'<' if jit else '>='} heuristic "
         f"crossover {HEURISTIC_CROSSOVER_BATCH} (no sweep recorded for "
-        f"{device_kind!r}; run benchmarks/sweep_epoch_program.py)",
+        f"{device_kind!r}{dtype_tag}; run "
+        "benchmarks/sweep_epoch_program.py)",
         "heuristic",
     )

@@ -135,9 +135,16 @@ class FitConfig:
     health: str | None = "warn"
     # Live roofline context: {"flops_per_sample", "bytes_per_sample",
     # "n_chips"} for the model being trained (tpuflow/utils/roofline.py
-    # model_cost_per_sample). When set, every epoch publishes train_mfu /
-    # train_hbm_util / train_bound gauges and a "roofline" JSONL record.
+    # model_cost_per_sample), plus optional "compute_dtype" ("f32" |
+    # "bf16") so the MFU verdict is judged against the right peak. When
+    # set, every epoch publishes train_mfu / train_hbm_util /
+    # train_bound gauges and a "roofline" JSONL record.
     roofline: dict | None = None
+    # Mixed-precision compute dtype (tpuflow/train/precision.py): when
+    # set, the DEFAULT train/eval/epoch steps cast the batch at step
+    # entry and keep loss/grad aux f32. Injected steps own their own
+    # precision (the model's dtype knob still applies either way).
+    compute_dtype: object = None
     # Recompile detection: wrap the step fns in a data-arg signature
     # check; steady-state signature churn (recompiles after the first
     # epoch) is surfaced as xla.compile spans, the train_recompiles
@@ -218,8 +225,12 @@ def fit(
             "resume/save_every need storage_path — without it no run "
             "checkpoints exist and a 'resumed' run would silently restart"
         )
-    train_step = train_step or make_train_step(config.loss)
-    eval_step = eval_step or make_eval_step(config.loss)
+    train_step = train_step or make_train_step(
+        config.loss, compute_dtype=config.compute_dtype
+    )
+    eval_step = eval_step or make_eval_step(
+        config.loss, compute_dtype=config.compute_dtype
+    )
     rng = jax.random.PRNGKey(config.seed)
 
     stopper = EarlyStopping(patience=config.patience)
@@ -259,7 +270,9 @@ def fit(
         if epoch_step is None:
             from tpuflow.train.steps import make_epoch_step
 
-            epoch_step = make_epoch_step(config.loss)
+            epoch_step = make_epoch_step(
+                config.loss, compute_dtype=config.compute_dtype
+            )
     else:
         epoch_step = None
 
@@ -525,6 +538,7 @@ def fit(
                     config.roofline["flops_per_sample"],
                     config.roofline["bytes_per_sample"],
                     _device_kind,
+                    compute_dtype=config.roofline.get("compute_dtype"),
                     logger=mlog,
                     epoch=epoch,
                 )

@@ -1,0 +1,135 @@
+"""The mixed-precision training policy: bf16 compute, f32 masters.
+
+One knob — ``TrainJobConfig.precision`` (``"f32"`` default | ``"bf16"``)
+— installs one policy across the whole train path:
+
+- **Master params and optimizer state stay float32.** ``create_state``
+  enforces it (``ensure_f32_masters``); checkpoints, serving artifacts,
+  warm starts, elastic averaging, and the online loop therefore never
+  see a bf16 leaf and need no changes.
+- **Compute runs in the compute dtype.** ``train()`` injects the
+  resolved dtype into ``model_kwargs`` (every model family takes a
+  ``dtype`` knob and casts params + activations per layer, flax-style:
+  the cast sits INSIDE the differentiated graph, so gradients come back
+  f32 against the f32 masters) and the jitted steps cast the input
+  batch at step entry (``tpuflow/train/steps.py``).
+- **Loss/grad reduction and the optimizer update stay f32.** Models
+  return f32 predictions, the steps promote predictions at the loss
+  site and cast the loss/grad_norm aux to f32, so the numerics
+  watchdog's EWMA spike threshold never silently widens to bf16
+  resolution, and ``apply_gradients`` updates f32 masters with f32
+  grads.
+
+Why bf16 at all: the LSTM-64 train step is HBM-BOUND on v5e (round 5:
+13.6% MFU at 63% HBM util), and activation traffic dominates its byte
+budget — halving the itemsize halves ``hbm_bytes_per_sample`` on the
+binding resource (``tpuflow/utils/roofline.py`` accounts for it).
+SparkNet-era CPU systems (PAPERS.md) could not express this
+compute/accumulate split; the MXU is built for it.
+
+Import-light: no jax at module import (preflight validates the knob
+without touching a device); dtypes resolve lazily.
+"""
+
+from __future__ import annotations
+
+# The knob's vocabulary — validated by the preflight spec pass
+# (tpuflow/analysis/spec.py) so a typo'd precision dies at submission,
+# naming these choices.
+PRECISIONS = ("f32", "bf16")
+
+# HBM itemsize of the compute dtype: the roofline's bytes-per-sample
+# accounting must follow the dtype the activations actually travel in.
+# Canonical map lives with the roofline (tpuflow/utils/roofline.py);
+# re-exported here so policy callers need one import.
+from tpuflow.utils.roofline import PRECISION_ITEMSIZE  # noqa: E402,F401
+
+_DTYPE_NAMES = {"f32": "float32", "bf16": "bfloat16"}
+
+# The documented bf16-vs-f32 parity tolerance for the fixed-seed LSTM
+# fit gate: final losses within 5% relative, or the speedup is
+# disqualified as a numerics regression. ONE definition — the tier-1
+# drill (tests/test_precision.py) and the committed A/B artifact's gate
+# (benchmarks/bench_lstm64.py --ab) both import it, so the two can
+# never enforce contradictory verdicts. Measured slack on the reference
+# drill is <1e-3 relative; 5% is the never-flaky bound that still fails
+# a real numerics break (docs/performance.md "Mixed precision").
+PARITY_RTOL = 0.05
+
+
+def check_precision(precision: str) -> str:
+    """Validate and return the precision token; raises naming choices."""
+    if precision not in PRECISIONS:
+        raise ValueError(
+            f"unknown precision {precision!r}; "
+            f"valid: {', '.join(PRECISIONS)}"
+        )
+    return precision
+
+
+def compute_dtype(precision: str):
+    """The jnp dtype activations/matmul operands run in under the policy."""
+    import jax.numpy as jnp
+
+    return jnp.dtype(_DTYPE_NAMES[check_precision(precision)]).type
+
+
+def precision_itemsize(precision: str) -> int:
+    """HBM bytes per activation element under the policy."""
+    return PRECISION_ITEMSIZE[check_precision(precision)]
+
+
+def model_accepts_dtype(model: str) -> bool:
+    """Whether a registry model family takes the ``dtype`` compute knob.
+
+    Every built-in family does (the policy's model leg); this exists so
+    ``train()`` degrades gracefully — precision still casts the batch at
+    step entry — if an external registry entry lacks the knob.
+    """
+    import inspect
+
+    from tpuflow.models import MODELS
+
+    if model not in MODELS:
+        return False
+    try:
+        module = MODELS[model]()
+    except TypeError:
+        return False
+    return "dtype" in {f.name for f in module.__dataclass_fields__.values()} \
+        if hasattr(module, "__dataclass_fields__") else False
+
+
+def inject_model_dtype(model: str, model_kwargs: dict, precision: str) -> dict:
+    """Return ``model_kwargs`` with the policy's compute dtype injected
+    — THE one injection rule, shared by ``train()`` and the preflight
+    shape dry-run (``analysis/shapes.py``) so the graph preflight traces
+    is the graph training runs. An explicit user ``dtype`` wins (the
+    knob is a default, not a clamp); f32 injects nothing (the models'
+    own default); families without the knob are left untouched (the
+    step-entry cast still applies).
+    """
+    if (
+        precision in PRECISIONS
+        and precision != "f32"
+        and "dtype" not in model_kwargs
+        and model_accepts_dtype(model)
+    ):
+        return {**model_kwargs, "dtype": compute_dtype(precision)}
+    return dict(model_kwargs)
+
+
+def cast_floating(tree, dtype):
+    """Cast every inexact (floating) leaf of a pytree to ``dtype``,
+    leaving integer leaves (step counters, routing indices) untouched.
+    Used at step entry for the batch and by ``ensure_f32_masters``."""
+    import jax
+    import jax.numpy as jnp
+
+    def _cast(leaf):
+        arr = jnp.asarray(leaf)
+        if jnp.issubdtype(arr.dtype, jnp.inexact) and arr.dtype != dtype:
+            return arr.astype(dtype)
+        return leaf
+
+    return jax.tree_util.tree_map(_cast, tree)

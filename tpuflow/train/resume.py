@@ -37,7 +37,12 @@ def check_params_match(live_params, incoming) -> None:
     ``incoming``'s leaves only need a ``.shape`` — real arrays and
     checkpoint METADATA leaves (``BestCheckpointer.best_structure``)
     both qualify, so a warm start can fail with a readable diagnosis
-    BEFORE paying for the restore.
+    BEFORE paying for the restore. Where BOTH sides expose a ``.dtype``,
+    it is checked too: live states hold f32 MASTER params (the
+    mixed-precision contract, ``train/state.py::ensure_f32_masters``),
+    so an artifact whose checkpoint drifted to bf16 (or f64) fails here
+    with the leaf path named, before any compile or restore — not as a
+    silent widening inside the overlay.
     """
     treedef = jax.tree_util.tree_structure(live_params)
     new_def = jax.tree_util.tree_structure(incoming)
@@ -75,6 +80,16 @@ def check_params_match(live_params, incoming) -> None:
                 f"{jax.tree_util.keystr(path)} has shape "
                 f"{tuple(got.shape)} but the live state's is "
                 f"{tuple(want.shape)} — different model/config?"
+            )
+        got_dt = getattr(got, "dtype", None)
+        want_dt = getattr(want, "dtype", None)
+        if got_dt is not None and want_dt is not None and got_dt != want_dt:
+            raise ValueError(
+                f"warm-start params leaf "
+                f"{jax.tree_util.keystr(path)} has dtype {got_dt} but the "
+                f"live state's is {want_dt} — checkpoints must stay f32 "
+                "masters whatever the compute precision "
+                "(tpuflow/train/precision.py)"
             )
 
 

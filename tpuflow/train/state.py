@@ -11,14 +11,37 @@ from flax.training import train_state
 from tpuflow.train.optim import keras_sgd
 
 
+def ensure_f32_masters(params):
+    """Cast any floating leaf to float32 — the MASTER-weights contract
+    of the mixed-precision policy (tpuflow/train/precision.py).
+
+    Flax keeps ``param_dtype`` f32 even when a model computes in bf16,
+    so this is normally a no-op; it exists so the contract is enforced
+    at the one place states are born rather than assumed: whatever a
+    model's initializers did, checkpoints, serving artifacts, warm
+    starts, and the optimizer update all see f32 leaves.
+    """
+    from tpuflow.train.precision import cast_floating
+
+    return cast_floating(params, jnp.float32)
+
+
 def create_state(
     model: nn.Module,
     rng: jax.Array,
     sample_x: jnp.ndarray,
     tx: optax.GradientTransformation | None = None,
 ) -> train_state.TrainState:
-    """Initialize params from a sample batch and wrap them in a TrainState."""
-    params = model.init(rng, jnp.asarray(sample_x))["params"]
+    """Initialize params from a sample batch and wrap them in a TrainState.
+
+    Params are forced to f32 masters regardless of the model's compute
+    dtype (``ensure_f32_masters``): the optimizer accumulates in f32 and
+    every artifact consumer reads f32, whatever precision the train
+    steps run at.
+    """
+    params = ensure_f32_masters(
+        model.init(rng, jnp.asarray(sample_x))["params"]
+    )
     return train_state.TrainState.create(
         apply_fn=model.apply, params=params, tx=tx or keras_sgd()
     )

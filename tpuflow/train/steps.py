@@ -3,6 +3,17 @@
 Each step is traced once per (model, shape) and reused for the whole run —
 the XLA contract SURVEY.md §7 calls out. Dropout randomness is derived by
 folding the step counter into a base rng, so steps stay functional.
+
+Mixed precision (tpuflow/train/precision.py): ``compute_dtype`` installs
+the step half of the policy — the input batch is cast to the compute
+dtype at step entry (the activations' HBM traffic halves under bf16
+before the first matmul), while differentiation still runs against the
+f32 MASTER params (the model's own per-layer ``dtype`` cast sits inside
+the differentiated graph, so grads come back f32), predictions are
+promoted to f32 at the loss site (reduction never happens in bf16), and
+the loss/grad_norm aux is returned f32 so the numerics watchdog's EWMA
+threshold keeps f32 resolution. ``compute_dtype=None`` (default) is the
+all-f32 path, byte-identical to the pre-policy steps.
 """
 
 from __future__ import annotations
@@ -18,11 +29,25 @@ from tpuflow.core.losses import mae_clip
 LossFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
 
 
-def make_train_step(loss_fn: LossFn = mae_clip, donate: bool = True):
+def _cast_batch(x, compute_dtype):
+    """Step-entry activation cast: the ONE sanctioned narrowing site.
+    Params are deliberately NOT cast here — the model casts them inside
+    the differentiated graph, which is what keeps grads f32 against the
+    f32 masters (a step-entry param cast would hand bf16 grads to the
+    f32 optimizer update)."""
+    if compute_dtype is None:
+        return x
+    return jnp.asarray(x).astype(compute_dtype)
+
+
+def make_train_step(
+    loss_fn: LossFn = mae_clip, donate: bool = True, compute_dtype=None
+):
     """Build a jitted step: (state, x, y, rng) -> (state, metrics)."""
 
     def step(state: TrainState, x, y, rng):
         dropout_rng = jax.random.fold_in(rng, state.step)
+        x = _cast_batch(x, compute_dtype)
 
         def loss_of(params):
             pred = state.apply_fn(
@@ -31,7 +56,11 @@ def make_train_step(loss_fn: LossFn = mae_clip, donate: bool = True):
                 deterministic=False,
                 rngs={"dropout": dropout_rng},
             )
-            return loss_fn(y, pred)
+            # Loss reduction stays f32 whatever the compute dtype: a
+            # model that returns bf16 must not narrow the reduction
+            # (models in this tree already emit f32; this is the
+            # contract made executable).
+            return loss_fn(y, pred.astype(jnp.float32))
 
         loss, grads = jax.value_and_grad(loss_of)(state.params)
         state = state.apply_gradients(grads=grads)
@@ -39,13 +68,19 @@ def make_train_step(loss_fn: LossFn = mae_clip, donate: bool = True):
         # The aux CONTRACT: loss/grad_norm stay device values through
         # the epoch's batch loop and feed the numerics watchdog as host
         # floats only post-epoch (tpuflow/obs/health.py; lint TPF006) —
-        # a float() per step here would serialize async dispatch.
-        return state, {"loss": loss, "grad_norm": gnorm}
+        # a float() per step here would serialize async dispatch. Both
+        # are f32 regardless of precision (watchdog EWMA resolution).
+        return state, {
+            "loss": loss.astype(jnp.float32),
+            "grad_norm": gnorm.astype(jnp.float32),
+        }
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
-def make_epoch_step(loss_fn: LossFn = mae_clip, donate: bool = True):
+def make_epoch_step(
+    loss_fn: LossFn = mae_clip, donate: bool = True, compute_dtype=None
+):
     """Build a jitted WHOLE-EPOCH step: (state, xs, ys, rng) -> (state, loss).
 
     ``xs [n_batches, B, ...]`` / ``ys [n_batches, B, ...]`` are the epoch's
@@ -66,13 +101,17 @@ def make_epoch_step(loss_fn: LossFn = mae_clip, donate: bool = True):
                 deterministic=False,
                 rngs={"dropout": rng},
             )
-            return loss_fn(y, pred)
+            return loss_fn(y, pred.astype(jnp.float32))
 
         loss, grads = jax.value_and_grad(loss_of)(state.params)
         state = state.apply_gradients(grads=grads)
-        return state, loss
+        return state, loss.astype(jnp.float32)
 
     def epoch(state, xs, ys, rng):
+        # One cast for the whole epoch's stacked batches: under bf16 the
+        # scanned program's dominant HBM stream (the per-step batch
+        # loads) moves half the bytes.
+        xs = _cast_batch(xs, compute_dtype)
         rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
             jnp.arange(xs.shape[0])
         )
@@ -82,16 +121,19 @@ def make_epoch_step(loss_fn: LossFn = mae_clip, donate: bool = True):
     return jax.jit(epoch, donate_argnums=(0,) if donate else ())
 
 
-def make_eval_step(loss_fn: LossFn = mae_clip):
+def make_eval_step(loss_fn: LossFn = mae_clip, compute_dtype=None):
     """Build a jitted eval step returning masked per-example SUMS.
 
     Returning sums + a valid-row mask (instead of a batch mean) lets the
     caller pad the tail batch to the fixed XLA shape and still aggregate
-    exact dataset-level metrics.
+    exact dataset-level metrics. Metrics aggregate in f32 whatever the
+    compute dtype (the model promotes its output; y/mask stay f32).
     """
 
     def step(state: TrainState, x, y, mask):
+        x = _cast_batch(x, compute_dtype)
         pred = state.apply_fn({"params": state.params}, x, deterministic=True)
+        pred = pred.astype(jnp.float32)
         per_loss = jax.vmap(loss_fn)(y, pred)  # [B]: per-example mean loss
         per_mae = jnp.abs(y - pred).reshape(y.shape[0], -1).mean(axis=1)
         return {
@@ -110,6 +152,10 @@ def make_predict(model_apply, donate_input: bool = False):
     call (serving fast path: the padded batch is freshly built per
     dispatch and never reused, so XLA may overwrite it in place). Off by
     default — callers that reuse ``x`` after the call must not donate.
+
+    No ``compute_dtype`` knob on purpose: serving rebuilds models from
+    the sidecar, which records no compute dtype — artifacts serve f32
+    (the precision policy's checkpoint/serving contract).
     """
 
     def predict(params, x):

@@ -21,6 +21,26 @@ CHIP_PEAKS = {
     "v2": (45e12, 700e9),
 }
 
+# HBM itemsize per compute-precision token — THE canonical map (the
+# mixed-precision policy in tpuflow/train/precision.py re-exports it):
+# activation bytes travel in the compute dtype, so the bytes-per-sample
+# models below must be fed the itemsize of the dtype the job actually
+# runs, not a hard-coded 4.
+PRECISION_ITEMSIZE = {"f32": 4, "bf16": 2}
+
+
+def precision_itemsize(compute_dtype: str) -> int:
+    """Itemsize for a precision token ("f32" | "bf16"); raises naming
+    the valid tokens on anything else — a typo here would silently
+    corrupt every byte account downstream."""
+    try:
+        return PRECISION_ITEMSIZE[compute_dtype]
+    except KeyError:
+        raise ValueError(
+            f"unknown compute precision {compute_dtype!r}; "
+            f"valid: {', '.join(PRECISION_ITEMSIZE)}"
+        ) from None
+
 
 def chip_peaks(device_kind: str) -> tuple[float | None, float | None]:
     """(peak bf16 FLOP/s, peak HBM bytes/s) for a device_kind, or Nones."""
@@ -154,19 +174,36 @@ def roofline_report(
     flops_per_sample: float,
     bytes_per_sample: float,
     device_kind: str,
+    compute_dtype: str | None = None,
 ) -> dict:
     """MFU, HBM utilization, and the bound-by verdict for a measurement.
+
+    ``compute_dtype`` ("f32" | "bf16") makes the verdict honest under
+    the mixed-precision policy: ``CHIP_PEAKS`` are bf16 matmul peaks,
+    and an all-f32 run cannot reach them — the MXU runs f32 dots as
+    multiple bf16 passes at roughly HALF the rate — so "f32" judges MFU
+    (and the ridge) against half the FLOP peak instead of flattering an
+    f32 run with an unreachable denominator. ``None`` (legacy callers)
+    keeps the bf16 peak. The token is echoed in the report when given.
 
     Returns ``{"mfu": None, "bound": "unknown chip ..."}`` for chips
     without a peaks entry (e.g. cpu).
     """
     peak_flops, peak_bw = chip_peaks(device_kind)
     if not peak_flops:
-        return {"mfu": None, "bound": f"unknown chip {device_kind!r}"}
+        rep = {"mfu": None, "bound": f"unknown chip {device_kind!r}"}
+        if compute_dtype is not None:
+            rep["compute_dtype"] = compute_dtype
+        return rep
+    if compute_dtype == "f32":
+        peak_flops = peak_flops / 2.0
     ai = flops_per_sample / bytes_per_sample  # arithmetic intensity
     ridge = peak_flops / peak_bw
-    return {
+    rep = {
         "mfu": round(samples_per_sec * flops_per_sample / peak_flops, 6),
         "hbm_util": round(samples_per_sec * bytes_per_sample / peak_bw, 6),
         "bound": "hbm" if ai < ridge else "mxu",
     }
+    if compute_dtype is not None:
+        rep["compute_dtype"] = compute_dtype
+    return rep
