@@ -1,0 +1,301 @@
+"""Straggler-injected A/B: async push + staleness bound vs synchronous
+averaging, wall-clock-to-target-loss.
+
+The DeepSpark claim (PAPERS.md, arXiv:1602.08191) made executable
+against tpuflow's own elastic stack: two identical socket-transport
+gangs train the same near-convex job while ONE worker is made a
+straggler with a single fault-registry line
+(``elastic.transport.send,p=1,mode=delay,delay=D`` — every exchange op
+that worker issues pays D seconds of injected link latency). A monitor
+thread polls the exchange server OVER THE WIRE (``SocketExchange``) and
+timestamps every published average; afterwards each snapshot's
+validation loss is evaluated against the job's real val split, giving
+loss-vs-wall-clock curves for both arms. The headline is the wall time
+at which each gang's PUBLISHED AVERAGE first reaches the target loss
+(a solo reference run's best, with 10% headroom):
+
+- **sync**: every round's waiting set includes the straggler, so the
+  gang's averages trail it — each publication costs the injected delay.
+- **async**: nobody waits; the straggler's stale pushes are
+  down-weighted and then dropped at the staleness bound, and the
+  average converges at the FAST workers' cadence.
+
+``host_only: true`` — CPU wall-clock; the ratio, not the absolute
+times, is the result (the injected delay dominates both arms equally
+per-op, asymmetrically per-round).
+
+Run: ``JAX_PLATFORMS=cpu python -m benchmarks.bench_elastic_async``
+Writes ``benchmarks/elastic_async_results.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from benchmarks.common import maybe_pin_cpu
+
+maybe_pin_cpu()
+
+SPEC = {
+    "model": "static_mlp",
+    "model_kwargs": {"hidden": []},  # linear + mse: near-convex, so
+    # both arms converge to the same neighborhood and the target-loss
+    # crossing is meaningful
+    "epochs": 10,
+    "batchSize": 32,
+    "patience": 100,
+    "loss": "mse",
+    # Plain SGD: the default keras_sgd momentum (0.99 nesterov) makes a
+    # warm-started late joiner's kept-momentum + adoption jumps teeter
+    # on the edge of stability for this tiny drill job — interesting,
+    # but not what this benchmark measures (wall-clock under a
+    # straggler). Momentum 0 keeps both arms' dynamics boring.
+    "optimizer_kwargs": {"learning_rate": 0.1, "momentum": 0.0},
+    "synthetic_wells": 4,
+    "synthetic_steps": 64,
+    "n_devices": 1,
+    "verbose": False,
+}
+N_WORKERS = 3
+STRAGGLER_ID = 2
+STRAGGLER_DELAY = 2.0  # injected seconds per exchange op — large
+# enough that the sync arm's early-round barrier waits (the rounds
+# where the straggler is still inside the waiting window) dominate
+# worker-process startup jitter
+MAX_STALENESS = 2
+POLL_S = 0.05
+
+
+def _free_addr() -> str:
+    """An OS-assigned loopback port the gang binds and the monitor
+    dials."""
+    import socket  # noqa: TPF012 (benchmark harness, not tpuflow)
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"127.0.0.1:{port}"
+
+
+def _build_eval():
+    """The job's REAL val split + an initialized state to overlay
+    averaged leaves onto (same construction train() uses)."""
+    import jax
+
+    from tpuflow.api.train_api import (
+        SYNTHETIC_COLUMN_NAMES,
+        SYNTHETIC_COLUMN_TYPES,
+        SYNTHETIC_TARGET,
+        _prepare_data,
+    )
+    from tpuflow.core.losses import LOSSES
+    from tpuflow.data.schema import Schema
+    from tpuflow.models import build_model
+    from tpuflow.serve import spec_to_config
+    from tpuflow.train.state import create_state
+
+    config = spec_to_config(dict(SPEC))
+    schema = Schema.from_cli(
+        SYNTHETIC_COLUMN_NAMES, SYNTHETIC_COLUMN_TYPES, SYNTHETIC_TARGET
+    )
+    prep = _prepare_data(config, schema, SYNTHETIC_TARGET)
+    model = build_model(config.model, **(config.model_kwargs or {}))
+    state = create_state(
+        model, jax.random.PRNGKey(config.seed), prep.train_ds.x[:2]
+    )
+    return state, prep.val_ds, LOSSES[config.loss]
+
+
+def _snapshot_loss(state, val_ds, loss_fn, leaves) -> float:
+    from tpuflow.elastic.exchange import unflatten_like
+    from tpuflow.train.loop import evaluate
+    from tpuflow.train.resume import apply_params
+
+    snap = apply_params(state, unflatten_like(state.params, leaves))
+    return float(evaluate(snap, val_ds, loss=loss_fn)["loss"])
+
+
+def _run_arm(tmp: str, async_push: bool) -> dict:
+    """One gang + a wire-side monitor; returns the run record with raw
+    (wall_s, round) publication snapshots (losses filled in later)."""
+    from tpuflow.elastic.runner import run_elastic
+    from tpuflow.elastic.transport import SocketExchange
+
+    addr = _free_addr()
+    monitor = SocketExchange(addr, timeout=2.0)
+    snapshots: list[tuple[float, int, list]] = []
+    gang_alive: list[float] = []  # first wire-visible heartbeat
+    stop = threading.Event()
+    t0 = time.monotonic()
+
+    def _watch():
+        seen = -1
+        while not stop.wait(POLL_S):
+            try:
+                if not gang_alive and monitor.read_members():
+                    # The gang's epoch zero: a worker is ALIVE (its
+                    # first heartbeat landed) — measuring the target
+                    # crossing from here drops worker-process startup
+                    # (~seconds of jax import, jitters more than the
+                    # effect under test) while keeping every barrier
+                    # wait in view.
+                    gang_alive.append(time.monotonic() - t0)
+                latest = monitor.latest_average()
+            except Exception:
+                continue  # gang not up yet / just torn down
+            if latest is None:
+                continue
+            round_, leaves = latest
+            if round_ > seen:
+                seen = round_
+                snapshots.append(
+                    (time.monotonic() - t0, round_, leaves)
+                )
+
+    watcher = threading.Thread(target=_watch, daemon=True)
+    watcher.start()
+    try:
+        result = run_elastic(
+            {**SPEC, "storagePath": tmp},
+            N_WORKERS,
+            mode="supervised",
+            transport="socket",
+            transport_addr=addr,
+            async_push=async_push,
+            max_staleness=MAX_STALENESS,
+            heartbeat_timeout=30.0,
+            round_timeout=60.0,
+            pull_timeout=120.0,
+            max_restarts=0,
+            worker_faults={STRAGGLER_ID: [
+                "elastic.transport.send,p=1,mode=delay,"
+                f"delay={STRAGGLER_DELAY}"
+            ]},
+        )
+    finally:
+        stop.set()
+        watcher.join(timeout=5)
+    wall = time.monotonic() - t0
+    assert result.ok, [w.error for w in result.workers]
+    return {
+        "async_push": async_push,
+        "run_wall_s": wall,
+        "gang_alive_s": gang_alive[0] if gang_alive else None,
+        "rounds_published": result.coordinator.get("round", 1) - 1,
+        "worker_best_val_loss": [
+            (w.report or {}).get("best_val_loss") for w in result.workers
+        ],
+        "_snapshots": snapshots,
+    }
+
+
+def main() -> dict:
+    import tempfile
+
+    # Solo reference: what this job converges to with no gang at all —
+    # the target both arms must reach.
+    from tpuflow.api import train
+    from tpuflow.serve import report_to_dict, spec_to_config
+
+    ref = report_to_dict(train(spec_to_config(
+        {**SPEC, "storagePath": None}
+    )))
+    target = ref["best_val_loss"] * 1.10
+
+    arms = {}
+    for name, async_push in (("sync", False), ("async", True)):
+        with tempfile.TemporaryDirectory() as tmp:
+            arms[name] = _run_arm(tmp, async_push)
+
+    state, val_ds, loss_fn = _build_eval()
+    for name, arm in arms.items():
+        curve = []
+        crossed = None
+        for wall_s, round_, leaves in arm.pop("_snapshots"):
+            loss = _snapshot_loss(state, val_ds, loss_fn, leaves)
+            curve.append({
+                "wall_s": round(wall_s, 3), "round": round_,
+                "val_loss": round(loss, 6),
+            })
+            if crossed is None and loss <= target:
+                crossed = wall_s
+        arm["loss_curve"] = curve
+        arm["wall_to_target_s"] = (
+            round(crossed, 3) if crossed is not None else None
+        )
+        # Startup-insensitive headline: crossing measured from the
+        # gang's first wire-visible heartbeat (drops the ~7s of
+        # worker-process jax imports, which jitter by more than the
+        # effect under test, while keeping every barrier wait in view).
+        alive = arm.get("gang_alive_s")
+        arm["alive_to_target_s"] = (
+            round(crossed - alive, 3)
+            if crossed is not None and alive is not None else None
+        )
+        arm["final_published_loss"] = (
+            curve[-1]["val_loss"] if curve else None
+        )
+
+    sync_t = arms["sync"]["alive_to_target_s"]
+    async_t = arms["async"]["alive_to_target_s"]
+    record = {
+        "benchmark": "elastic_async_vs_sync_straggler",
+        "host_only": True,
+        "vs_baseline": None,
+        "note": (
+            "CPU host wall-clock; straggler injected via the fault "
+            f"registry (worker {STRAGGLER_ID}: elastic.transport.send,"
+            f"p=1,mode=delay,delay={STRAGGLER_DELAY}). The published "
+            "average's validation loss is evaluated post-hoc from "
+            "wire-side snapshots; the A/B ratio is the result, the "
+            "absolute times are this host's."
+        ),
+        "config": {
+            "spec": SPEC, "n_workers": N_WORKERS,
+            "straggler_id": STRAGGLER_ID,
+            "straggler_delay_s": STRAGGLER_DELAY,
+            "max_staleness": MAX_STALENESS,
+            "transport": "socket", "mode": "supervised",
+        },
+        "reference": {
+            "best_val_loss": ref["best_val_loss"],
+            "target_loss": target,
+        },
+        "arms": arms,
+        "speedup_alive_to_target": (
+            round(sync_t / async_t, 3)
+            if sync_t is not None and async_t is not None and async_t > 0
+            else None
+        ),
+        # Secondary, very stable signal: the straggler's own epochs in
+        # the sync arm block on push+pull barriers it pays the injected
+        # delay for, so the GANG's total wall (all workers finish all
+        # epochs) stretches; async never blocks it.
+        "speedup_total_run_wall": round(
+            arms["sync"]["run_wall_s"] / arms["async"]["run_wall_s"], 3
+        ),
+    }
+    out = os.path.join(
+        os.path.dirname(__file__), "elastic_async_results.json"
+    )
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(record, f, indent=2)
+    print(json.dumps({
+        "config": "elastic_async_vs_sync_straggler",
+        "metric": "speedup_alive_to_target",
+        "value": record["speedup_alive_to_target"],
+        "unit": "x",
+        "sync_alive_to_target_s": sync_t,
+        "async_alive_to_target_s": async_t,
+        "speedup_total_run_wall": record["speedup_total_run_wall"],
+        "host_only": True,
+    }))
+    return record
+
+
+if __name__ == "__main__":
+    main()

@@ -478,6 +478,9 @@ class TestLinter:
         """) == []
 
     def test_tpf009_socket_and_urlopen_flagged(self, tmp_path):
+        # The bare `import socket` ALSO trips TPF012 here: this snippet
+        # is not the transport seam, and raw wire use outside it is
+        # exactly what that rule exists to catch.
         diags = self._lint_source(tmp_path, """
             import socket
             from urllib.request import urlopen
@@ -486,7 +489,48 @@ class TestLinter:
                 s = socket.socket()
                 return urlopen(url)
         """)
-        assert _codes(diags) == ["TPF009", "TPF009"]
+        assert _codes(diags) == ["TPF012", "TPF009", "TPF009"]
+
+    def test_tpf012_raw_wire_imports_flagged(self, tmp_path):
+        """TPF012: raw socket / http.client imports outside the
+        transport seam — ad-hoc sockets dodge the framed checksummed
+        protocol, the retry policy, and the transport fault sites."""
+        diags = self._lint_source(tmp_path, """
+            import socket
+            import socketserver
+            import http.client
+            from socket import create_connection
+            from http.client import HTTPConnection
+            from http import client
+        """)
+        assert _codes(diags) == ["TPF012"] * 6
+
+    def test_tpf012_allowed_in_the_transport_seam(self, tmp_path):
+        # The allowlist is path-based: the same source under the seam's
+        # path lints clean.
+        seam = tmp_path / "elastic"
+        seam.mkdir()
+        f = seam / "transport.py"
+        f.write_text("import socket\nimport socketserver\n")
+        assert lint_file(str(f)) == []
+        # ... and so do the serve modules.
+        f2 = tmp_path / "serve_async.py"
+        f2.write_text("import socket\n")
+        assert lint_file(str(f2)) == []
+
+    def test_tpf012_noqa_and_benign_imports(self, tmp_path):
+        assert self._lint_source(tmp_path, """
+            import socket  # noqa: TPF012
+        """) == []
+        # http.server (the serve stack's base) and plain http are not
+        # raw-wire imports; neither is a local name called socket.
+        assert self._lint_source(tmp_path, """
+            import http
+            from http.server import BaseHTTPRequestHandler
+
+            def use(socket):
+                return socket.close()
+        """) == []
 
     def test_tpf009_dotted_urlopen_flagged(self, tmp_path):
         # The common full spelling is a THREE-segment attribute chain;

@@ -518,9 +518,15 @@ class TestElasticSpec:
         assert any(d.code == "spec.elastic.stream" for d in diags)
         diags = validate_spec(TrainJobConfig(elastic=ok_block, tp=2))
         assert any(d.code == "spec.elastic.model_axis" for d in diags)
+        # The fleet-of-meshes shape: an EXPLICIT n_devices > 1 makes
+        # each worker data-parallel across its local devices and
+        # preflights clean; only UNSET n_devices warns (every
+        # co-located worker grabbing ALL visible devices).
         diags = validate_spec(TrainJobConfig(elastic=ok_block, n_devices=4))
+        assert not [d for d in diags if d.code.startswith("spec.elastic")]
+        diags = validate_spec(TrainJobConfig(elastic=ok_block))
         assert any(
-            d.code == "spec.elastic.n_devices" and d.severity == "error"
+            d.code == "spec.elastic.n_devices" and d.severity == "warning"
             for d in diags
         )
         # Runner-built blocks (n_devices=1) preflight clean of elastic
@@ -890,3 +896,786 @@ class TestBigGangs:
         assert proc.returncode == 0, proc.stderr[-800:]
         out = json.loads(proc.stdout.strip().splitlines()[-1])
         assert out["ok"] is True and out["rounds"] == 2
+
+
+# ---------------------------------------------------------------------
+# unit: wire framing + payload checksums (tpuflow/elastic/transport.py)
+# ---------------------------------------------------------------------
+
+
+class TestWireFormat:
+    def test_frame_roundtrip_over_a_real_socketpair(self):
+        import socket as _socket  # noqa: TPF012 (test harness, not tpuflow)
+
+        from tpuflow.elastic.transport import recv_frame, send_frame
+
+        a, b = _socket.socketpair()
+        try:
+            payload = exchange.encode_leaves(
+                exchange.flatten_params(_params(2.5))
+            )
+            send_frame(a, {"op": "push", "round": 3}, payload)
+            header, got = recv_frame(b)
+            assert header == {"op": "push", "round": 3}
+            leaves = exchange.decode_leaves(got)
+            np.testing.assert_allclose(leaves[1], 2.5)
+        finally:
+            a.close()
+            b.close()
+
+    def test_corrupted_payload_detected_not_trusted(self):
+        import socket as _socket  # noqa: TPF012 (test harness)
+
+        from tpuflow.elastic.transport import (
+            TransportError,
+            recv_frame,
+            send_frame,
+        )
+
+        a, b = _socket.socketpair()
+        try:
+            payload = exchange.encode_leaves(
+                exchange.flatten_params(_params(1.0))
+            )
+            send_frame(a, {"op": "push"}, payload)
+            raw = bytearray()
+            while len(raw) < 20 + len(payload):
+                raw += b.recv(1 << 16)
+            raw[-8] ^= 0xFF  # flip one payload byte in flight
+            c, d = _socket.socketpair()
+            c.sendall(bytes(raw))
+            with pytest.raises(TransportError, match="checksum"):
+                recv_frame(d)
+            c.close()
+            d.close()
+        finally:
+            a.close()
+            b.close()
+
+    def test_alien_bytes_rejected(self):
+        import socket as _socket  # noqa: TPF012 (test harness)
+
+        from tpuflow.elastic.transport import TransportError, recv_frame
+
+        a, b = _socket.socketpair()
+        try:
+            a.sendall(b"GET / HTTP/1.1\r\n\r\n" + b"\x00" * 16)
+            with pytest.raises(TransportError, match="magic"):
+                recv_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_npz_payload_checksum_rejects_bit_flips(self):
+        leaves = exchange.flatten_params(_params(4.0))
+        data = bytearray(exchange.encode_leaves(leaves))
+        assert exchange.decode_leaves(bytes(data))  # pristine reads
+        data[-4] ^= 0x01  # damage an array byte inside the npz
+        with pytest.raises(ValueError):
+            exchange.decode_leaves(bytes(data))
+
+    def test_parse_addr_fail_loud(self):
+        from tpuflow.elastic.transport import parse_addr
+
+        assert parse_addr("127.0.0.1:8000") == ("127.0.0.1", 8000)
+        for bad in ("localhost", ":9", "h:", "h:port", ""):
+            with pytest.raises(ValueError, match="host:port"):
+                parse_addr(bad)
+
+
+class TestFileChecksum:
+    def test_torn_push_file_skipped_by_averaging(self, tmp_path):
+        """A push file damaged AFTER its atomic rename (a torn NFS
+        page, a bad disk) must fail its checksum and be skipped —
+        ``np.load`` alone would average the garbage."""
+        gang = str(tmp_path)
+        exchange.push_params(gang, 1, 0, _params(1.0))
+        exchange.push_params(gang, 1, 1, _params(3.0))
+        victim = os.path.join(exchange.push_dir(gang, 1), "1.npz")
+        data = bytearray(open(victim, "rb").read())
+        data[-4] ^= 0xFF
+        open(victim, "wb").write(bytes(data))
+        leaves, used = exchange.average_pushes(gang, 1)
+        assert used == [0]  # the damaged push is out, the round lives
+        np.testing.assert_allclose(leaves[0], 1.0)
+
+    def test_corrupt_average_reads_as_missing(self, tmp_path):
+        """A damaged rebroadcast reads as None — the worker's wait loop
+        re-pulls until a clean copy (or its timeout) instead of
+        adopting poisoned params."""
+        gang = str(tmp_path)
+        exchange.publish_average(
+            gang, 2, exchange.flatten_params(_params(5.0))
+        )
+        path = exchange.avg_path(gang, 2)
+        data = bytearray(open(path, "rb").read())
+        data[-4] ^= 0xFF
+        open(path, "wb").write(bytes(data))
+        assert exchange.read_average(gang, 2) is None
+        assert exchange.latest_average(gang) is None
+
+    def test_pre_checksum_files_stay_readable(self, tmp_path):
+        # Back-compat: an npz written before the checksum field existed
+        # (no crc32 entry) must still read.
+        path = str(tmp_path / "old.npz")
+        leaves = exchange.flatten_params(_params(1.5))
+        with open(path, "wb") as f:
+            np.savez(f, n_leaves=np.int64(len(leaves)),
+                     **{f"arr_{i}": a for i, a in enumerate(leaves)})
+        got = exchange._read_npz(path)
+        np.testing.assert_allclose(got[0], 1.5)
+
+
+# ---------------------------------------------------------------------
+# unit: the in-memory gang store (socket transport's state)
+# ---------------------------------------------------------------------
+
+
+class TestGangStore:
+    def _store(self, clock):
+        from tpuflow.elastic.transport import GangStore
+
+        return GangStore(clock=clock)
+
+    def test_heartbeats_stamped_with_server_clock(self):
+        from tpuflow.elastic.membership import classify_view
+
+        clock = FakeClock()
+        store = self._store(clock)
+        store.write_heartbeat(0, epoch=1, round=1)
+        view = classify_view(store.read_members(), 5.0, clock())
+        assert view.live_ids == {0}
+        clock.advance(6.0)  # beats stop ARRIVING: transport liveness
+        view = classify_view(store.read_members(), 5.0, clock())
+        assert view.stale_ids == {0}
+        store.write_heartbeat(0, epoch=2, round=2)  # reconnect
+        view = classify_view(store.read_members(), 5.0, clock())
+        assert view.live_ids == {0}
+
+    def test_goodbye_sticky_and_joining_revokes(self):
+        store = self._store(FakeClock())
+        assert store.write_heartbeat(0, status="done")
+        assert store.write_heartbeat(0, status="running") is False
+        [m] = store.read_members()
+        assert m.status == "done"
+        assert store.write_heartbeat(0, status="joining")  # new life
+        assert store.write_heartbeat(0, status="running")
+        [m] = store.read_members()
+        assert m.status == "running"
+
+    def test_push_average_latest_prune(self):
+        store = self._store(FakeClock())
+        store.push(1, 0, _params(1.0))
+        store.push(1, 1, _params(3.0))
+        assert store.pushed_ids(1) == {0, 1}
+        leaves, used = exchange.average_leaf_sets(store.read_pushes(1))
+        assert used == [0, 1]
+        np.testing.assert_allclose(leaves[0], 2.0)
+        store.publish(1, leaves)
+        assert store.latest_round() == 1
+        round_, got = store.latest_average()
+        assert round_ == 1
+        store.push(4, 0, _params(9.0))
+        latest = store.latest_pushes(0)
+        assert [(w, r) for w, r, _ in latest] == [(0, 4), (1, 1)]
+        assert [(w, r) for w, r, _ in store.latest_pushes(2)] == [(0, 4)]
+        store.prune(3)
+        assert store.pushed_ids(1) == set()
+        assert store.read_average(1) is None
+        assert store.pushed_ids(4) == {0}
+
+    def test_final_pushes_never_pruned(self):
+        store = self._store(FakeClock())
+        store.push(exchange.FINAL_ROUND, 0, _params(1.0))
+        store.prune(10_000)
+        assert store.pushed_ids(exchange.FINAL_ROUND) == {0}
+        assert store.latest_pushes(0) == []  # final is not a round
+
+    def test_offsets(self):
+        store = self._store(FakeClock())
+        assert store.get_offset(3) == (0, False)
+        store.set_offset(3, 7)
+        assert store.get_offset(3) == (7, True)
+
+
+# ---------------------------------------------------------------------
+# the socket exchange: real TCP, tier-1 (loopback, ephemeral port)
+# ---------------------------------------------------------------------
+
+
+@pytest.fixture()
+def socket_gang():
+    """A live exchange server over a fake-clock store + a client."""
+    from tpuflow.elastic.transport import (
+        ExchangeServer,
+        GangStore,
+        SocketExchange,
+    )
+
+    clock = FakeClock()
+    store = GangStore(clock=clock)
+    with ExchangeServer(store) as server:
+        yield store, clock, SocketExchange(server.addr), server
+
+
+class TestSocketExchange:
+    def test_worker_ops_roundtrip(self, socket_gang):
+        store, clock, ex, _ = socket_gang
+        assert ex.ping()
+        assert ex.write_heartbeat(0, epoch=1, round=1)
+        ex.push(1, 0, _params(1.0))
+        ex.push(1, 1, _params(3.0))
+        assert ex.pushed_ids(1) == {0, 1}
+        leaves, used = exchange.average_leaf_sets(store.read_pushes(1))
+        store.publish(1, leaves)
+        got = ex.read_average(1)
+        np.testing.assert_allclose(got[0], 2.0)
+        assert ex.latest_round() == 1
+        round_, latest = ex.latest_average()
+        assert round_ == 1
+        np.testing.assert_allclose(latest[0], 2.0)
+        assert ex.read_average(9) is None
+        ex.set_offset(0, 4)
+        assert ex.get_offset(0) == (4, True)
+
+    def test_coordinator_over_the_store_publishes(self, socket_gang):
+        store, clock, ex, _ = socket_gang
+        coord = Coordinator(
+            "/tmp/unused-gang-state", backend=store,
+            heartbeat_timeout=5.0, clock=clock, sleep=lambda _: None,
+        )
+        ex.write_heartbeat(0, round=1)
+        ex.write_heartbeat(1, round=1)
+        ex.push(1, 0, _params(1.0))
+        assert coord.step() is False  # worker 1 live: hold the round
+        ex.push(1, 1, _params(3.0))
+        assert coord.step() is True
+        assert coord.rounds[1] == [0, 1]
+        np.testing.assert_allclose(ex.read_average(1)[0], 2.0)
+
+    def test_eviction_on_transport_silence(self, socket_gang):
+        """The liveness verdict is transport-level: a worker whose
+        beats stop ARRIVING goes stale on the coordinator's clock,
+        whatever its own clock thinks."""
+        store, clock, ex, _ = socket_gang
+        coord = Coordinator(
+            "/tmp/unused-gang-state", backend=store,
+            heartbeat_timeout=5.0, clock=clock, sleep=lambda _: None,
+        )
+        ex.write_heartbeat(0, round=1)
+        ex.write_heartbeat(1, round=1)
+        ex.push(1, 0, _params(1.0))
+        assert coord.step() is False
+        clock.advance(4.0)
+        ex.write_heartbeat(0, round=1)  # 0 keeps beating
+        clock.advance(2.0)  # 1's last beat is now 6s old
+        assert coord.step() is True
+        assert coord.evicted == {1}
+        ex.write_heartbeat(1, round=2)  # reconnect readmits
+        coord.step()
+        assert coord.evicted == set() and coord.rejoins == 1
+
+    @pytest.mark.faultdrill
+    def test_transient_send_fault_retried_within_deadline(
+        self, socket_gang, monkeypatch
+    ):
+        """The retry satellite: a transient transport fault costs a
+        backoff sleep, not the op — wired through the SAME io_policy
+        the checkpoint/CSV sites use."""
+        from tpuflow.resilience import FaultSpec, arm, clear_faults
+
+        monkeypatch.setenv("TPUFLOW_RETRY_BASE", "0.001")
+        store, clock, ex, _ = socket_gang
+        arm(FaultSpec(
+            site="elastic.transport.send", nth=1, transient=True,
+        ))
+        try:
+            ex.push(1, 0, _params(1.0))  # retried, then lands
+        finally:
+            clear_faults()
+        assert store.pushed_ids(1) == {0}
+
+    @pytest.mark.faultdrill
+    def test_hard_send_fault_exhausts_and_raises(
+        self, socket_gang, monkeypatch
+    ):
+        from tpuflow.resilience import (
+            FaultInjected,
+            FaultSpec,
+            arm,
+            clear_faults,
+        )
+
+        monkeypatch.setenv("TPUFLOW_RETRY_ATTEMPTS", "2")
+        monkeypatch.setenv("TPUFLOW_RETRY_BASE", "0.001")
+        store, clock, ex, _ = socket_gang
+        arm(FaultSpec(site="elastic.transport.send", p=1.0, seed=0))
+        try:
+            with pytest.raises(FaultInjected):
+                ex.push(1, 0, _params(1.0))
+        finally:
+            clear_faults()
+        assert store.pushed_ids(1) == set()
+
+    def test_dead_server_raises_oserror_not_hang(self, monkeypatch):
+        from tpuflow.elastic.transport import SocketExchange
+
+        monkeypatch.setenv("TPUFLOW_RETRY_ATTEMPTS", "2")
+        monkeypatch.setenv("TPUFLOW_RETRY_BASE", "0.001")
+        monkeypatch.setenv("TPUFLOW_RETRY_DEADLINE", "2")
+        ex = SocketExchange("127.0.0.1:1", timeout=0.2)  # nothing there
+        with pytest.raises(OSError):
+            ex.ping()
+
+
+# ---------------------------------------------------------------------
+# async push + staleness bounds (unit drills, fake clock)
+# ---------------------------------------------------------------------
+
+
+class TestAsyncStaleness:
+    def _async_gang(self, tmp_path, clock, **kw):
+        from tpuflow.elastic.transport import GangStore
+
+        store = GangStore(clock=clock)
+        kw.setdefault("max_staleness", 1)
+        coord = Coordinator(
+            str(tmp_path), backend=store, async_push=True,
+            heartbeat_timeout=30.0, clock=clock, sleep=lambda _: None,
+            **kw,
+        )
+        return store, coord
+
+    def _push(self, store, wid, round, value):
+        store.push_leaves(
+            round, wid, [np.full((2,), value, np.float32)]
+        )
+
+    def test_stale_push_downweighted_at_the_bound(self, tmp_path):
+        clock = FakeClock()
+        store, coord = self._async_gang(tmp_path, clock)
+        store.write_heartbeat(0, round=5)
+        store.write_heartbeat(1, round=4)
+        self._push(store, 0, 5, 1.0)  # at the frontier: weight 1
+        self._push(store, 1, 4, 4.0)  # staleness 1: weight 1/2
+        assert coord.step() is True
+        # The average is published AT the frontier — the one round
+        # numbering space workers, prune, and warm starts all share.
+        (leaf,) = store.read_average(5)
+        np.testing.assert_allclose(
+            leaf, (1.0 + 0.5 * 4.0) / 1.5, rtol=1e-6
+        )
+        assert store.latest_round() == 5
+
+    def test_push_beyond_bound_rejected_and_counted(self, tmp_path):
+        clock = FakeClock()
+        store, coord = self._async_gang(tmp_path, clock)
+        self._push(store, 0, 5, 1.0)
+        self._push(store, 1, 1, 9.0)  # staleness 4 > bound 1: rejected
+        before = coord._stale.value()
+        assert coord.step() is True
+        (leaf,) = store.read_average(5)
+        np.testing.assert_allclose(leaf, 1.0)  # the ancient push is OUT
+        assert coord._stale.value() == before + 1
+        # ... and counted ONCE, not once per scan.
+        self._push(store, 0, 6, 2.0)
+        assert coord.step() is True
+        assert coord._stale.value() == before + 1
+
+    def test_async_warm_start_offset_shares_the_round_space(
+        self, tmp_path, socket_gang
+    ):
+        """Regression: the published round number IS the push frontier,
+        so a late joiner's warm-start offset lands in the same space as
+        everyone's pushes — a separate publish counter racing ahead of
+        worker epochs would inflate the frontier and get the whole
+        gang's pushes staleness-rejected forever."""
+        from tpuflow.elastic.worker import ElasticWorkerClient
+
+        class _State:
+            def __init__(self, params):
+                self.params = params
+
+            def replace(self, params):
+                return _State(params)
+
+        store, clock, ex, server = socket_gang
+        coord = Coordinator(
+            str(tmp_path), backend=store, async_push=True,
+            max_staleness=1, heartbeat_timeout=30.0, clock=clock,
+            sleep=lambda _: None,
+        )
+        # Incumbent worker 0 marches to round 5 (one publish each).
+        for r in range(1, 6):
+            store.push_leaves(
+                r, 0, exchange.flatten_params(_params(1.0))
+            )
+            store.write_heartbeat(0, round=r)
+            assert coord.step() is True
+        assert store.latest_round() == 5
+        # A late joiner warm-starts: its offset is the frontier.
+        joiner = ElasticWorkerClient(
+            {"dir": str(tmp_path), "worker_id": 1, "n_workers": 2,
+             "transport": "socket", "addr": server.addr,
+             "async_push": True},
+            clock=clock, sleep=lambda _: None,
+        )
+        state = joiner.join(_State(_params(0.0)))
+        assert joiner.round_offset == 5
+        # Its first sync pushes round 6; the incumbent's round-5 push
+        # is staleness 1 — still IN the average, not rejected.
+        state = joiner.sync(1, state)
+        before = coord._stale.value()
+        assert coord.step() is True
+        assert coord._stale.value() == before  # nobody rejected
+        assert sorted(coord.rounds[6]) == [0, 1]
+        joiner.finish(failed=True)
+
+    def test_async_prune_bounds_retained_averages(self, tmp_path):
+        """Regression: with one round space, pruning keeps the retained
+        push/average keys bounded over a long async run instead of
+        leaking one param copy per publish."""
+        clock = FakeClock()
+        store, coord = self._async_gang(
+            tmp_path, clock, max_staleness=1, keep_rounds=2
+        )
+        store.write_heartbeat(0, round=0)
+        for r in range(1, 40):
+            self._push(store, 0, r, float(r))
+            store.write_heartbeat(0, round=r)
+            assert coord.step() is True
+        assert len(store._averages) <= 4
+        assert len(store._pushes) <= 4
+
+    def test_no_fresh_pushes_no_publish(self, tmp_path):
+        clock = FakeClock()
+        store, coord = self._async_gang(tmp_path, clock)
+        self._push(store, 0, 3, 1.0)
+        assert coord.step() is True
+        assert coord.step() is False  # same pushes: nothing new
+        self._push(store, 0, 4, 2.0)
+        assert coord.step() is True
+
+    def test_straggler_neither_stalls_nor_poisons(self, tmp_path):
+        """The DeepSpark claim, as a unit drill: the gang publishes at
+        the fast workers' cadence while the straggler is fresh-enough
+        (down-weighted), and drops it once it falls past the bound —
+        no round ever WAITS on it."""
+        clock = FakeClock()
+        store, coord = self._async_gang(tmp_path, clock)
+        store.write_heartbeat(0, round=1)
+        store.write_heartbeat(1, round=1)
+        self._push(store, 1, 1, 100.0)  # the straggler's only push
+        published = []
+        for r in range(1, 6):  # worker 0 marches on alone
+            self._push(store, 0, r, 1.0)
+            published.append(coord.step())
+        assert all(published)  # every scan published: zero stalls
+        seq, leaves = store.latest_average()
+        # By the last rounds the straggler is past the bound: the
+        # average is exactly the fast worker's params.
+        np.testing.assert_allclose(leaves[0], 1.0)
+
+    def test_async_worker_adopts_freshest_without_waiting(
+        self, socket_gang
+    ):
+        from tpuflow.elastic.worker import ElasticWorkerClient
+
+        class _State:
+            def __init__(self, params):
+                self.params = params
+
+            def replace(self, params):
+                return _State(params)
+
+        store, clock, ex, server = socket_gang
+        client = ElasticWorkerClient(
+            {"dir": "/tmp/unused", "worker_id": 0, "n_workers": 2,
+             "transport": "socket", "addr": server.addr,
+             "async_push": True},
+            clock=clock, sleep=lambda _: None,
+        )
+        state = _State(_params(0.0))
+        # No average published yet: the sync pushes and returns
+        # IMMEDIATELY on local params — no round barrier.
+        state = client.sync(1, state)
+        np.testing.assert_allclose(state.params["w"], 0.0)
+        assert store.pushed_ids(1) == {0}
+        # An average appears; the next sync adopts it.
+        store.publish(1, exchange.flatten_params(_params(7.0)))
+        state = client.sync(2, state)
+        np.testing.assert_allclose(state.params["w"], 7.0)
+        # Same average again: no re-adopt (nothing fresher).
+        state.params["w"][:] = 5.0
+        state = client.sync(3, state)
+        np.testing.assert_allclose(state.params["w"], 5.0)
+
+
+# ---------------------------------------------------------------------
+# graceful degradation: partition -> local training -> resync on heal
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.faultdrill
+class TestDegradation:
+    def test_partition_degrades_then_heals(
+        self, socket_gang, monkeypatch
+    ):
+        from tpuflow.elastic.worker import ElasticWorkerClient
+        from tpuflow.resilience import FaultSpec, arm, clear_faults
+
+        monkeypatch.setenv("TPUFLOW_RETRY_ATTEMPTS", "2")
+        monkeypatch.setenv("TPUFLOW_RETRY_BASE", "0.001")
+        monkeypatch.setenv("TPUFLOW_RETRY_DEADLINE", "1")
+
+        class _State:
+            def __init__(self, params):
+                self.params = params
+
+            def replace(self, params):
+                return _State(params)
+
+        store, clock, ex, server = socket_gang
+        client = ElasticWorkerClient(
+            {"dir": "/tmp/unused", "worker_id": 0, "n_workers": 2,
+             "transport": "socket", "addr": server.addr,
+             "async_push": True, "pull_timeout": 1.0},
+            clock=clock, sleep=lambda _: None,
+        )
+        state = client.join(_State(_params(0.0)))
+        state = client.sync(1, state)
+        assert store.pushed_ids(1) == {0}
+        assert client.degraded is False
+        # Partition: every connect fires; the worker keeps training.
+        spec = arm(FaultSpec(
+            site="elastic.transport.partition", p=1.0, seed=0,
+        ))
+        try:
+            state = client.sync(2, state)
+            assert client.degraded is True
+            assert store.pushed_ids(2) == set()  # nothing arrived
+            assert np.isfinite(state.params["w"]).all()
+        finally:
+            clear_faults()
+        # Heal: the next sync reconnects, pushes, and resyncs.
+        store.publish(1, exchange.flatten_params(_params(3.0)))
+        state = client.sync(3, state)
+        assert client.degraded is False
+        assert store.pushed_ids(3) == {0}
+        np.testing.assert_allclose(state.params["w"], 3.0)  # resynced
+        client.finish(state)
+        assert store.pushed_ids(exchange.FINAL_ROUND) == {0}
+
+    def test_nontransport_fault_still_kills_the_worker(
+        self, socket_gang
+    ):
+        """The degradation guard must NOT swallow the worker's own kill
+        drills: an injected elastic.push fault propagates even over the
+        socket backend."""
+        from tpuflow.elastic.worker import ElasticWorkerClient
+        from tpuflow.resilience import (
+            FaultInjected,
+            FaultSpec,
+            arm,
+            clear_faults,
+        )
+
+        class _State:
+            def __init__(self, params):
+                self.params = params
+
+        store, clock, ex, server = socket_gang
+        client = ElasticWorkerClient(
+            {"dir": "/tmp/unused", "worker_id": 0, "n_workers": 2,
+             "transport": "socket", "addr": server.addr},
+            clock=clock, sleep=lambda _: None,
+        )
+        arm(FaultSpec(site="elastic.push", at=1))
+        try:
+            with pytest.raises(FaultInjected, match="elastic.push"):
+                client.sync(1, _State(_params(0.0)))
+        finally:
+            clear_faults()
+
+
+# ---------------------------------------------------------------------
+# socket gangs end to end (tier-1: real train() loops, real TCP)
+# ---------------------------------------------------------------------
+
+
+class TestSocketGang:
+    def test_two_worker_gang_over_real_sockets(self, tmp_path):
+        """The tentpole's tier-1 proof: a 2-worker gang whose exchange
+        rides TCP — after the run the gang dir holds NO exchange state
+        (no members/, no push/), only per-worker checkpoints, the
+        coordinator's state mirror, and the final deliverable."""
+        spec = {**TINY, "storagePath": str(tmp_path)}
+        r = run_elastic(
+            spec, 2, mode="inprocess", transport="socket",
+            heartbeat_timeout=120.0,
+        )
+        assert r.ok, [w.error for w in r.workers]
+        assert all(
+            w.report["epochs_ran"] == TINY["epochs"] for w in r.workers
+        )
+        assert r.coordinator["round"] - 1 == TINY["epochs"]
+        assert all(
+            ids == [0, 1] for ids in r.coordinator["rounds"].values()
+        )
+        assert r.final_worker_ids == [0, 1]
+        assert os.path.exists(r.final_path)
+        gang = tmp_path / "elastic"
+        assert not (gang / "members").exists()
+        assert not (gang / "push").exists()
+        assert all(_finite(w.report["best_val_loss"]) for w in r.workers)
+
+    def test_async_socket_gang_converges(self, tmp_path):
+        spec = {**TINY, "storagePath": str(tmp_path)}
+        r = run_elastic(
+            spec, 2, mode="inprocess", transport="socket",
+            async_push=True, max_staleness=2, heartbeat_timeout=120.0,
+        )
+        assert r.ok, [w.error for w in r.workers]
+        assert r.coordinator["round"] >= 2  # rounds flowed
+        assert r.final_worker_ids == [0, 1]
+        for w in r.workers:
+            assert _finite(w.report["best_val_loss"])
+            assert w.report["best_val_loss"] < 0.5
+
+    def test_mesh_per_worker_gang(self, tmp_path):
+        """The fleet-of-meshes rebase: each elastic worker is itself
+        data-parallel across 2 local (virtual) devices through
+        parallel/compat.py + make_mesh, inside a socket gang."""
+        spec = {**TINY, "n_devices": 2, "storagePath": str(tmp_path)}
+        r = run_elastic(
+            spec, 2, mode="inprocess", transport="socket",
+            heartbeat_timeout=120.0,
+        )
+        assert r.ok, [w.error for w in r.workers]
+        assert r.final_worker_ids == [0, 1]
+        for w in r.workers:
+            assert _finite(w.report["best_val_loss"])
+
+
+# ---------------------------------------------------------------------
+# the transport/staleness env-knob family (validated at read time)
+# ---------------------------------------------------------------------
+
+
+class TestElasticEnvKnobs:
+    BASE = {"dir": "/g", "worker_id": 0, "n_workers": 2}
+
+    def test_env_supplies_defaults_spec_wins(self, monkeypatch):
+        monkeypatch.setenv("TPUFLOW_ELASTIC_TRANSPORT", "socket")
+        monkeypatch.setenv("TPUFLOW_ELASTIC_ADDR", "10.0.0.1:7000")
+        monkeypatch.setenv("TPUFLOW_ELASTIC_ASYNC", "1")
+        monkeypatch.setenv("TPUFLOW_ELASTIC_MAX_STALENESS", "5")
+        cfg = resolve_elastic(dict(self.BASE))
+        assert cfg["transport"] == "socket"
+        assert cfg["addr"] == "10.0.0.1:7000"
+        assert cfg["async_push"] is True
+        assert cfg["max_staleness"] == 5
+        # An explicit spec value beats the environment.
+        cfg = resolve_elastic(
+            {**self.BASE, "transport": "file", "max_staleness": 1}
+        )
+        assert cfg["transport"] == "file"
+        assert cfg["max_staleness"] == 1
+
+    @pytest.mark.parametrize("var,value", [
+        ("TPUFLOW_ELASTIC_TRANSPORT", "carrier-pigeon"),
+        ("TPUFLOW_ELASTIC_ADDR", "no-port-here"),
+        ("TPUFLOW_ELASTIC_ASYNC", "ture"),
+        ("TPUFLOW_ELASTIC_MAX_STALENESS", "-1"),
+        ("TPUFLOW_ELASTIC_MAX_STALENESS", "lots"),
+    ])
+    def test_malformed_env_names_the_variable(
+        self, monkeypatch, var, value
+    ):
+        monkeypatch.setenv(var, value)
+        with pytest.raises(ValueError, match=var):
+            resolve_elastic(dict(self.BASE))
+
+    def test_connect_timeout_knob_validated(self, monkeypatch):
+        from tpuflow.elastic.transport import connect_timeout
+
+        assert connect_timeout() == 5.0
+        monkeypatch.setenv("TPUFLOW_ELASTIC_CONNECT_TIMEOUT", "0.5")
+        assert connect_timeout() == 0.5
+        monkeypatch.setenv("TPUFLOW_ELASTIC_CONNECT_TIMEOUT", "soon")
+        with pytest.raises(
+            ValueError, match="TPUFLOW_ELASTIC_CONNECT_TIMEOUT"
+        ):
+            connect_timeout()
+
+    def test_block_validation_of_transport_keys(self):
+        msgs = "; ".join(validate_elastic_block({
+            **self.BASE, "transport": "pigeon", "addr": "nohost",
+            "async_push": "yes", "max_staleness": -2,
+        }))
+        assert "transport" in msgs
+        assert "addr" in msgs
+        assert "async_push" in msgs
+        assert "max_staleness" in msgs
+        with pytest.raises(ValueError, match="needs elastic.addr"):
+            resolve_elastic({**self.BASE, "transport": "socket"})
+
+
+# ---------------------------------------------------------------------
+# churn over real sockets (slow): kill, evict, readmit, converge
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.faultdrill
+class TestSocketChurn:
+    def test_four_workers_survive_mid_epoch_kill_over_sockets(
+        self, tmp_path
+    ):
+        """The acceptance drill re-run over real TCP with a 4-worker
+        gang and NO shared exchange dir: worker 1 dies at epoch 3
+        (registry exit fault), is evicted on transport liveness,
+        averaging proceeds over the survivors, the restarted worker
+        rejoins, and the final params match a fixed-membership
+        reference gang within the PR 6 tolerance."""
+        base = {**TINY, "epochs": 12}
+        churn = run_elastic(
+            {**base, "storagePath": str(tmp_path / "churn")}, 4,
+            mode="supervised",
+            transport="socket",
+            heartbeat_timeout=1.0,
+            heartbeat_interval=0.2,
+            round_timeout=10.0,
+            min_round_interval=1.2,
+            pull_timeout=300.0,
+            max_restarts=2,
+            backoff_base=3.0,
+            worker_faults={1: ["train.epoch_start,at=3,mode=exit,code=42"]},
+        )
+        assert churn.ok, [w.error for w in churn.workers]
+        victim = churn.workers[1]
+        assert victim.attempts == 2
+        assert victim.failures and victim.failures[0]["rc"] == 42
+        for w in churn.workers:
+            assert w.report["epochs_ran"] == base["epochs"]
+            assert _finite(w.report["best_val_loss"])
+            assert w.report["best_val_loss"] < 0.5
+        rounds = churn.coordinator["rounds"]
+        assert any(1 not in ids for ids in rounds.values()), rounds
+        assert churn.coordinator["rejoins"] >= 1
+        assert 1 not in churn.coordinator["evicted"]
+        assert churn.coordinator["round"] - 1 == base["epochs"]
+        assert churn.final_worker_ids == [0, 1, 2, 3]
+        # No shared exchange dir was ever used.
+        gang = tmp_path / "churn" / "elastic"
+        assert not (gang / "members").exists()
+        assert not (gang / "push").exists()
+
+        ref = run_elastic(
+            {**base, "storagePath": str(tmp_path / "ref")}, 4,
+            mode="inprocess", transport="socket",
+            heartbeat_timeout=300.0,
+        )
+        assert ref.ok, [w.error for w in ref.workers]
+        for got, want in zip(churn.final_params, ref.final_params):
+            np.testing.assert_allclose(got, want, atol=0.12)

@@ -80,6 +80,28 @@ class TestSpecGrammar:
         with pytest.raises(ValueError, match="passes no index"):
             parse_fault_spec("csv.read,at=3")
 
+    def test_parse_delay_mode(self):
+        s = parse_fault_spec(
+            "elastic.transport.send,p=1,mode=delay,delay=0.25"
+        )
+        assert s.mode == "delay" and s.delay == 0.25
+
+    def test_negative_delay_rejected(self):
+        with pytest.raises(ValueError, match="delay"):
+            parse_fault_spec("csv.read,nth=1,mode=delay,delay=-1")
+
+    def test_delay_mode_survives_the_site(self):
+        # The straggler knob: the site is SLOWED, not killed — the call
+        # returns normally and the firing is logged.
+        from tpuflow.resilience.faults import fired_log
+
+        arm(parse_fault_spec("csv.read,nth=1,mode=delay,delay=0.0"))
+        fault_point("csv.read")  # fires: sleeps 0s, then continues
+        assert any(
+            rec["site"] == "csv.read" for rec in fired_log()
+        )
+        fault_point("csv.read")  # one-shot: disarmed
+
 
 class TestRegistry:
     def test_nth_is_one_shot_by_count(self):
@@ -452,7 +474,7 @@ class TestCatalogSelfCheck:
         )
         assert section, "docs/resilience.md lost its fault-site-catalog markers"
         documented = set(
-            re.findall(r"`([a-z_]+\.[a-z_]+)`", section.group(1))
+            re.findall(r"`([a-z_]+(?:\.[a-z_]+)+)`", section.group(1))
         )
         assert documented == set(SITES), (
             "docs/resilience.md fault-site catalog and faults.SITES "

@@ -115,6 +115,14 @@ RULES = {
               "function — reduction MUST promote), as is "
               "preferred_element_type=jnp.float32 (an accumulator "
               "request, not a promotion)",
+    "TPF012": "raw socket / http.client import outside "
+              "tpuflow/elastic/transport.py and the serve modules — "
+              "the wire belongs to the transport seam (the TPF008 "
+              "compat-seam precedent): ad-hoc sockets dodge the framed "
+              "checksummed protocol, the retry policy, and the "
+              "elastic.transport.* fault sites, so their failures are "
+              "undrillable; speak the exchange backend interface "
+              "instead",
 }
 
 _HOST_SYNC_NAMES = {"float", "bool"}
@@ -206,6 +214,19 @@ def _collect_jitted_names(tree: ast.AST) -> set[str]:
 _COMPAT_OWNED_JAX_ATTRS = {"make_mesh", "shard_map", "set_mesh"}
 _COMPAT_MODULE_SUFFIX = "parallel/compat.py"
 
+# TPF012: the modules allowed to speak the raw wire — the elastic
+# transport seam and the HTTP serve stack. Everything else goes through
+# the exchange backend interface. Import-level detection is deliberate:
+# a module cannot use the socket API without importing it, and flagging
+# imports (not attribute chains) keeps the rule free of false positives
+# on local names that happen to be called ``socket``.
+_SOCKET_ALLOWED_SUFFIXES = (
+    "elastic/transport.py",
+    "/serve.py",
+    "/serve_async.py",
+)
+_SOCKET_MODULES = ("socket", "socketserver", "http.client")
+
 # TPF010: scope and trigger. The rule fires only in the online package
 # (the one place a per-window device sync stalls a live ingest loop);
 # a "streaming-window consumer loop" is a for-loop whose ITERABLE
@@ -232,6 +253,7 @@ class _Linter(ast.NodeVisitor):
         norm = path.replace(os.sep, "/")
         self._is_compat = norm.endswith(_COMPAT_MODULE_SUFFIX)
         self._is_online = _ONLINE_PATH_FRAGMENT in norm
+        self._socket_allowed = norm.endswith(_SOCKET_ALLOWED_SUFFIXES)
 
     def run(self) -> list[Diagnostic]:
         self.visit(self.tree)
@@ -515,7 +537,30 @@ class _Linter(ast.NodeVisitor):
             self._emit("TPF008", node, f"jax.{node.attr} reference")
         self.generic_visit(node)
 
+    # --- TPF012: raw wire imports outside the transport seam ---
+
+    @staticmethod
+    def _is_socket_module(name: str) -> bool:
+        return any(
+            name == m or name.startswith(m + ".")
+            for m in _SOCKET_MODULES
+        )
+
+    def _check_socket_import_from(self, node) -> None:
+        if self._socket_allowed or not node.module:
+            return
+        if self._is_socket_module(node.module):
+            names = ", ".join(sorted(a.name for a in node.names))
+            self._emit(
+                "TPF012", node, f"from {node.module} import {names}"
+            )
+        elif node.module == "http" and any(
+            a.name == "client" for a in node.names
+        ):
+            self._emit("TPF012", node, "from http import client")
+
     def visit_ImportFrom(self, node) -> None:
+        self._check_socket_import_from(node)
         if not self._is_compat and node.module:
             names = {a.name for a in node.names}
             raw_shard_map_import = (
@@ -548,6 +593,10 @@ class _Linter(ast.NodeVisitor):
             for alias in node.names:
                 if alias.name.startswith("jax.experimental.shard_map"):
                     self._emit("TPF008", node, f"import {alias.name}")
+        if not self._socket_allowed:
+            for alias in node.names:
+                if self._is_socket_module(alias.name):
+                    self._emit("TPF012", node, f"import {alias.name}")
         self.generic_visit(node)
 
     # --- TPF001 / TPF002 / TPF004: calls ---

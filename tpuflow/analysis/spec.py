@@ -328,20 +328,18 @@ def _check_elastic(config) -> list[Diagnostic]:
                 "not supported inside an elastic worker",
                 where=axis,
             ))
-    if config.n_devices is not None and config.n_devices > 1:
-        out.append(_diag(
-            "spec.elastic.n_devices",
-            f"elastic workers are single-device processes; n_devices="
-            f"{config.n_devices} would nest a device mesh inside each "
-            "worker",
-            where="n_devices",
-        ))
-    elif config.n_devices is None:
+    if config.n_devices is None:
+        # n_devices > 1 is the fleet-of-meshes shape (each worker is
+        # itself data-parallel across its local devices, through
+        # parallel/compat.py + make_mesh); only UNSET is flagged —
+        # every co-located worker defaulting to ALL visible devices
+        # would oversubscribe the host's mesh N times over.
         out.append(_diag(
             "spec.elastic.n_devices", severity="warning",
             message="elastic with n_devices unset defaults to ALL "
-            "visible devices inside every worker; set n_devices=1 "
-            "(runner-built specs do)",
+            "visible devices inside every worker; set it explicitly — "
+            "1 for process-level DP only (runner-built specs default "
+            "to that), >1 for an in-worker data-parallel mesh",
             where="n_devices",
         ))
     return out

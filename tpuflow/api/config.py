@@ -101,10 +101,14 @@ class TrainJobConfig:
     # --- elastic data-parallel membership (tpuflow/elastic) ---
     # When set, this run is ONE worker of an elastic gang: it trains on
     # its disjoint row shard and syncs params with the coordinator every
-    # sync_every epochs. Required keys: dir (shared gang directory),
-    # worker_id, n_workers; knobs and defaults in
-    # tpuflow/elastic/__init__.py (ELASTIC_DEFAULTS). Spec-validated by
-    # the preflight spec pass; normally assembled by
+    # sync_every epochs — blocking per round, or barrier-free when
+    # async_push is set (staleness-bounded adoption of the freshest
+    # average). The exchange rides transport="file" (shared gang dir)
+    # or "socket" (TCP to the coordinator-hosted exchange server at
+    # addr — no shared filesystem). Required keys: dir, worker_id,
+    # n_workers; knobs, defaults, and the TPUFLOW_ELASTIC_* env
+    # fallbacks in tpuflow/elastic/__init__.py (ELASTIC_DEFAULTS).
+    # Spec-validated by the preflight spec pass; normally assembled by
     # tpuflow.elastic.runner.worker_spec, not by hand.
     elastic: dict | None = None
     # --- online continuous training (tpuflow/online) ---

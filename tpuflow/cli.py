@@ -122,6 +122,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "(needs storagePath)")
     p.add_argument("--elastic-sync-every", type=int, default=1,
                    help="epochs between elastic averaging rounds")
+    p.add_argument("--elastic-transport", choices=("file", "socket"),
+                   default="file",
+                   help="exchange transport: 'file' (shared gang dir — "
+                        "the reference implementation) or 'socket' (a "
+                        "coordinator-hosted TCP exchange server; no "
+                        "shared filesystem needed for the exchange)")
+    p.add_argument("--elastic-async", action="store_true",
+                   help="asynchronous gradient/param push (DeepSpark "
+                        "style): workers push when ready and adopt the "
+                        "freshest average — no round barrier, so one "
+                        "straggler can't stall every round")
+    p.add_argument("--elastic-max-staleness", type=int, default=2,
+                   help="async only: pushes more than this many rounds "
+                        "behind the gang's frontier are rejected from "
+                        "the average (fresher-but-stale pushes are "
+                        "down-weighted by 1/(1+staleness))")
     p.add_argument("--elastic-heartbeat-timeout", type=float, default=30.0,
                    help="stale-heartbeat eviction deadline, seconds")
     p.add_argument("--elastic-max-restarts", type=int, default=2,
@@ -356,6 +372,9 @@ def main(argv=None) -> int:
                 dataclasses.asdict(config),
                 args.elastic,
                 sync_every=args.elastic_sync_every,
+                transport=args.elastic_transport,
+                async_push=args.elastic_async,
+                max_staleness=args.elastic_max_staleness,
                 heartbeat_timeout=args.elastic_heartbeat_timeout,
                 max_restarts=args.elastic_max_restarts,
                 stall_timeout=args.elastic_stall_timeout,

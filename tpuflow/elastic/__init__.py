@@ -1,12 +1,18 @@
 """Elastic data-parallel training: local SGD + coordinated averaging.
 
-ROADMAP item 4, in the SparkNet/DeepSpark mold (PAPERS.md): N worker
-processes each run the ordinary single-process ``train()`` loop on a
-disjoint shard of the training rows; a small coordinator periodically
-averages their parameters and rebroadcasts the mean. The exchange is
-deliberately file-based (``exchange.py``) — it needs no collective
-runtime at all (the in-worker device mesh, now alive again via
-``tpuflow/parallel/compat.py``, is orthogonal) and, more importantly,
+In the SparkNet/DeepSpark mold (PAPERS.md): N worker processes each
+run the ordinary ``train()`` loop on a disjoint shard of the training
+rows (each optionally data-parallel across its own local devices via
+``tpuflow/parallel/compat.py`` — a fleet of meshes); a small
+coordinator averages their parameters and rebroadcasts the mean —
+synchronously per round, or asynchronously with a staleness bound
+(``async_push``/``max_staleness``: push when ready, adopt the
+freshest, down-weight stale pushes by ``1/(1+s)`` and reject past the
+bound, so a straggler can't stall the gang). The exchange rides one of
+two transports behind a single backend interface — the file reference
+implementation (``exchange.py``: needs nothing but a shared directory)
+or a coordinator-hosted TCP exchange server (``transport.py``: framed,
+checksummed, retry-wrapped; no shared filesystem) — and either way
 tolerates membership churn by construction:
 
 - **Heartbeats + eviction** (``membership.py``): a worker whose
@@ -22,8 +28,10 @@ tolerates membership churn by construction:
   not from init.
 
 Drillable end to end through the resilience registry: the
-``elastic.heartbeat`` / ``elastic.push`` / ``elastic.join`` fault sites
-(docs/elastic.md has the recipes).
+``elastic.heartbeat`` / ``elastic.push`` / ``elastic.join`` sites plus
+the transport chaos sites ``elastic.transport.send`` / ``.recv`` /
+``.partition`` (docs/elastic.md has the recipes; a worker that loses
+the coordinator degrades to local training and resyncs on reconnect).
 
 A worker is configured by the spec-validated ``elastic`` block of
 ``TrainJobConfig`` (``analysis/spec.py`` rejects malformed blocks at
@@ -55,7 +63,30 @@ ELASTIC_DEFAULTS: dict = {
     # a fixed 20 Hz directory scan is needless metadata load on
     # NFS-class gang dirs when the gang only beats every few seconds.
     "warm_start": True,        # late joiners adopt the latest average
+    # --- transport + async push (transport.py; docs/elastic.md) ---
+    "transport": "file",       # "file" (shared dir — the reference/
+    # drill implementation) or "socket" (coordinator-hosted TCP RPC —
+    # no shared filesystem needed)
+    "addr": None,              # "host:port" of the exchange server
+    # (required when transport="socket"; the runner fills it in)
+    "async_push": False,       # DeepSpark-style async: push when ready,
+    # adopt the freshest average, no round barrier
+    "max_staleness": 2,        # async only: pushes older than this many
+    # rounds behind the coordinator are rejected from the average;
+    # fresher-but-stale ones are down-weighted by 1/(1+staleness)
 }
+
+# The env-knob family for the transport block (the TPUFLOW_RETRY_* /
+# TPUFLOW_SERVE_* precedent): each supplies the default for its config
+# key when the job spec leaves it unset, validated at read time through
+# tpuflow/utils/env.py so a malformed value names the variable and the
+# expected form. An explicit spec value always wins.
+#   TPUFLOW_ELASTIC_TRANSPORT       "file" | "socket"
+#   TPUFLOW_ELASTIC_ADDR            "host:port"
+#   TPUFLOW_ELASTIC_ASYNC           boolean flag
+#   TPUFLOW_ELASTIC_MAX_STALENESS   integer >= 0
+#   TPUFLOW_ELASTIC_CONNECT_TIMEOUT positive seconds (read by
+#                                   transport.connect_timeout)
 
 # Polls per heartbeat interval when poll_interval is derived: a scan a
 # few times per beat observes every membership/average transition within
@@ -135,22 +166,115 @@ def validate_elastic_block(block) -> list[str]:
             f"elastic.warm_start must be a bool, got "
             f"{block.get('warm_start')!r}"
         )
+    transport = block.get("transport", "file")
+    if transport not in ("file", "socket"):
+        out.append(
+            f"elastic.transport must be 'file' or 'socket', got "
+            f"{transport!r}"
+        )
+    addr = block.get("addr")
+    if addr is not None and not _valid_addr(addr):
+        out.append(
+            f"elastic.addr must be a 'host:port' string, got {addr!r}"
+        )
+    if transport == "socket" and "addr" in block and addr is None:
+        out.append(
+            "elastic.transport='socket' needs elastic.addr "
+            "('host:port' of the exchange server)"
+        )
+    if not isinstance(block.get("async_push", False), bool):
+        out.append(
+            f"elastic.async_push must be a bool, got "
+            f"{block.get('async_push')!r}"
+        )
+    staleness = block.get("max_staleness", 0)
+    if not isinstance(staleness, int) or isinstance(staleness, bool) \
+            or staleness < 0:
+        out.append(
+            f"elastic.max_staleness must be an int >= 0 (rounds), got "
+            f"{staleness!r}"
+        )
     return out
+
+
+def _valid_addr(addr) -> bool:
+    if not isinstance(addr, str):
+        return False
+    host, sep, port = addr.rpartition(":")
+    return bool(sep) and bool(host) and port.isdigit()
 
 
 def resolve_elastic(block: dict) -> dict:
     """Defaults-merged, validated copy of an ``elastic`` block; raises
     ``ValueError`` listing every problem. An unset (or explicit None)
     ``poll_interval`` resolves to ``derive_poll_interval`` of the
-    resolved heartbeat cadence."""
+    resolved heartbeat cadence. Transport keys the block leaves unset
+    fall back to the ``TPUFLOW_ELASTIC_*`` env knobs (validated at read
+    time through ``utils/env.py``) before the static defaults."""
     problems = validate_elastic_block(block)
     if problems:
         raise ValueError(
             "invalid elastic config block: " + "; ".join(problems)
         )
     out = {**ELASTIC_DEFAULTS, **block}
+    _apply_env_defaults(block, out)
     if out["poll_interval"] is None:
         out["poll_interval"] = derive_poll_interval(
             out["heartbeat_interval"]
         )
+    if out["transport"] == "socket" and not out["addr"]:
+        raise ValueError(
+            "invalid elastic config block: elastic.transport='socket' "
+            "needs elastic.addr ('host:port' of the exchange server, "
+            "or TPUFLOW_ELASTIC_ADDR)"
+        )
     return out
+
+
+def _apply_env_defaults(block: dict, out: dict) -> None:
+    """Fill transport keys absent from the spec block from the
+    ``TPUFLOW_ELASTIC_*`` env family (an explicit spec value wins;
+    malformed env values raise naming the variable — the fail-loud
+    contract every TPUFLOW_* knob family shares)."""
+    import os
+
+    from tpuflow.utils.env import env_choice, env_flag, env_num
+
+    if "transport" not in block:
+        out["transport"] = env_choice(
+            "TPUFLOW_ELASTIC_TRANSPORT", out["transport"],
+            ("file", "socket"),
+        )
+    if "addr" not in block:
+        raw = os.environ.get("TPUFLOW_ELASTIC_ADDR")
+        if raw is not None and raw.strip():
+            if not _valid_addr(raw.strip()):
+                raise ValueError(
+                    f"invalid TPUFLOW_ELASTIC_ADDR={raw!r}: expected "
+                    "a 'host:port' string"
+                )
+            out["addr"] = raw.strip()
+    if "async_push" not in block:
+        out["async_push"] = env_flag(
+            "TPUFLOW_ELASTIC_ASYNC", out["async_push"]
+        )
+    if "max_staleness" not in block:
+        out["max_staleness"] = env_num(
+            "TPUFLOW_ELASTIC_MAX_STALENESS", out["max_staleness"], int,
+            minimum=0, form="an integer round count >= 0",
+        )
+
+
+def make_backend(cfg: dict):
+    """The exchange backend a resolved elastic block names:
+    ``FileExchange`` over ``cfg['dir']`` or ``SocketExchange`` dialing
+    ``cfg['addr']`` (imported lazily — the file path must not pull the
+    socket machinery, and this module stays import-light for the
+    preflight spec pass)."""
+    if cfg.get("transport", "file") == "socket":
+        from tpuflow.elastic.transport import SocketExchange
+
+        return SocketExchange(cfg["addr"])
+    from tpuflow.elastic.exchange import FileExchange
+
+    return FileExchange(cfg["dir"])

@@ -32,6 +32,16 @@ def main(argv: list[str] | None = None) -> int:
                     "(default {storagePath}/elastic)")
     ap.add_argument("--sync-every", type=int, default=1,
                     help="epochs between averaging rounds")
+    ap.add_argument("--transport", choices=("file", "socket"),
+                    default="file",
+                    help="exchange transport: shared gang dir (file) or "
+                    "a coordinator-hosted TCP exchange server (socket)")
+    ap.add_argument("--async-push", action="store_true",
+                    help="asynchronous push with a staleness bound "
+                    "(DeepSpark style): no round barrier")
+    ap.add_argument("--max-staleness", type=int, default=2,
+                    help="async only: reject pushes more than this many "
+                    "rounds behind the gang's frontier")
     ap.add_argument("--heartbeat-timeout", type=float, default=30.0,
                     help="stale-heartbeat eviction deadline, seconds")
     ap.add_argument("--round-timeout", type=float, default=60.0,
@@ -50,6 +60,9 @@ def main(argv: list[str] | None = None) -> int:
             args.workers,
             gang_dir=args.gang_dir,
             mode=args.mode,
+            transport=args.transport,
+            async_push=args.async_push,
+            max_staleness=args.max_staleness,
             sync_every=args.sync_every,
             heartbeat_timeout=args.heartbeat_timeout,
             round_timeout=args.round_timeout,

@@ -32,7 +32,7 @@ import os
 import time
 
 from tpuflow.elastic import exchange
-from tpuflow.elastic.membership import classify_members
+from tpuflow.elastic.membership import classify_view
 
 STATE_FILE = "coordinator.json"
 
@@ -56,6 +56,9 @@ class Coordinator:
         keep_rounds: int = 16,
         expected_workers: int = 0,
         assembly_timeout: float = 60.0,
+        backend=None,
+        async_push: bool = False,
+        max_staleness: int = 2,
         clock=time.time,
         sleep=time.sleep,
         verbose: bool = False,
@@ -63,6 +66,23 @@ class Coordinator:
         from tpuflow.obs import default_registry
 
         self.gang_dir = gang_dir
+        # The exchange backend: FileExchange over gang_dir by default;
+        # in socket mode the runner passes the server's GangStore — the
+        # coordinator co-hosts it and scans in memory, no round trips.
+        self.backend = (
+            backend if backend is not None
+            else exchange.FileExchange(gang_dir)
+        )
+        # DeepSpark-style async publication: fold each worker's newest
+        # push, down-weighted by its distance behind the push frontier,
+        # rejecting anything more than max_staleness rounds behind; no
+        # waiting set, so one straggler never stalls a round.
+        self.async_push = bool(async_push)
+        self.max_staleness = int(max_staleness)
+        self._frontier = 0  # newest worker push round folded so far
+        self._consumed: dict[int, int] = {}  # wid -> newest folded round
+        self._stale_rejected: dict[int, int] = {}  # wid -> newest
+        # rejected round (so one stale push counts one rejection)
         self.heartbeat_timeout = heartbeat_timeout
         self.round_timeout = round_timeout
         # Poll cadence derives from the gang's heartbeat cadence unless
@@ -124,6 +144,10 @@ class Coordinator:
         self._rounds = reg.counter(
             "elastic_rounds_total", "averaging rounds published"
         )
+        self._stale = reg.counter(
+            "elastic_stale_pushes_total",
+            "async pushes rejected for exceeding the staleness bound",
+        )
         os.makedirs(gang_dir, exist_ok=True)
 
     # ---- one scan ----
@@ -138,7 +162,9 @@ class Coordinator:
         now = self.clock()
         if self._first_step is None:
             self._first_step = now
-        view = classify_members(self.gang_dir, self.heartbeat_timeout, now)
+        view = classify_view(
+            self.backend.read_members(), self.heartbeat_timeout, now
+        )
         self._last_view = view  # reused by run()'s end-of-gang check
         self.ever_seen |= view.live_ids | view.stale_ids
         self.ever_seen |= {m.worker_id for m in view.finished}
@@ -168,7 +194,13 @@ class Coordinator:
                 print(f"elastic: worker {wid} rejoined at round {self.round}")
         self._workers_gauge.set(len(view.live))
 
-        pushed = exchange.pushed_ids(self.gang_dir, self.round)
+        if self.async_push:
+            published = self._step_async(view, now, record_span)
+            if published or changed:
+                self._write_state(now)
+            return published
+
+        pushed = self.backend.pushed_ids(self.round)
         published = False
         if pushed:
             if self._round_opened is None:
@@ -220,7 +252,7 @@ class Coordinator:
             )
             below = min(min_live, self.round - self.keep_rounds)
             if below > 0:
-                exchange.prune_rounds(self.gang_dir, below)
+                self.backend.prune(below)
         if changed or published:
             self._write_state(now)
         return published
@@ -229,12 +261,13 @@ class Coordinator:
         # Average EVERY readable push for the round — including one from
         # a worker that pushed and then died: its params are legitimate
         # round data; eviction only stops the *waiting*.
-        leaves, used = exchange.average_pushes(self.gang_dir, self.round)
+        leaves, used = exchange.average_leaf_sets(
+            self.backend.read_pushes(self.round),
+            context=f"for round {self.round} ",
+        )
         if leaves is None:
             return False
-        exchange.publish_average(
-            self.gang_dir, self.round, leaves, clock=self.clock
-        )
+        self.backend.publish(self.round, leaves, clock=self.clock)
         opened = self._round_opened if self._round_opened is not None else now
         record_span(
             "elastic.round", max(now - opened, 0.0),
@@ -259,6 +292,124 @@ class Coordinator:
         self._last_publish = now
         return True
 
+    # ---- the async (DeepSpark-style) publish path ----
+
+    def _step_async(self, view, now: float, record_span) -> bool:
+        """One async scan: fold each worker's newest push into a
+        staleness-weighted average and publish it, with no waiting set.
+
+        There is ONE round numbering space — worker push rounds — and
+        the average is published AT the **push frontier** (the newest
+        round any worker has pushed, i.e. the gang's actual progress),
+        re-published in place when a slower worker's push lands at the
+        same frontier. A separate publish counter would race ahead of
+        worker epochs and poison every consumer of round numbers: a
+        late joiner warm-starting its offset from ``latest_round``
+        would inflate the frontier and get the whole gang's pushes
+        staleness-rejected, and pruning computed in one space would
+        never reach keys in the other.
+
+        Staleness of a push is its distance behind the frontier:
+        ``s`` rounds behind is down-weighted by ``1/(1+s)`` and
+        rejected outright past ``max_staleness`` (counted once per
+        rejected push in ``elastic_stale_pushes_total``). Publication
+        happens whenever at least one within-bound push is NEW since
+        the last publish — a straggler neither stalls the round
+        (nobody waits on it) nor poisons the average (its stale params
+        fade, then fall off the bound). Round-number metadata is
+        scanned cheaply every poll; full param payloads are read only
+        when a publication is actually happening."""
+        rounds = self.backend.latest_push_rounds(0)
+        if not rounds:
+            return False
+        frontier = max(self._frontier, max(r for _, r in rounds))
+        horizon = frontier - self.max_staleness
+        eligible_rounds: list[tuple[int, int]] = []
+        for wid, r in rounds:
+            if r < horizon:
+                if self._stale_rejected.get(wid, -1) < r:
+                    self._stale_rejected[wid] = r
+                    self._stale.inc()
+                    from tpuflow.obs import record_event
+
+                    record_event(
+                        "elastic_stale_push_rejected", worker_id=wid,
+                        push_round=r, frontier=frontier,
+                        staleness=frontier - r,
+                    )
+                    if self.verbose:
+                        print(
+                            f"elastic: rejected worker {wid}'s push for "
+                            f"round {r} (staleness {frontier - r} > "
+                            f"bound {self.max_staleness})"
+                        )
+                continue
+            eligible_rounds.append((wid, r))
+        fresh = any(
+            r > self._consumed.get(wid, -1) for wid, r in eligible_rounds
+        )
+        paced = (
+            self._last_publish is None
+            or now - self._last_publish >= self.min_round_interval
+        )
+        assembled = (
+            len(self.ever_seen) >= self.expected_workers
+            or now - self._first_step > self.assembly_timeout
+        )
+        if not (eligible_rounds and fresh and paced and assembled):
+            return False
+        # Payloads only now — and only within the staleness horizon.
+        pushes = self.backend.latest_pushes(max(horizon, 0))
+        if not pushes:
+            return False
+        # A push may have landed between the two scans; fold it in
+        # (the frontier only ever advances).
+        frontier = max(frontier, max(r for _, r, _ in pushes))
+        leaves, used = exchange.average_leaf_sets(
+            [(wid, ls) for wid, _, ls in pushes],
+            weights=[
+                1.0 / (1.0 + (frontier - r)) for _, r, _ in pushes
+            ],
+            context=f"(async, frontier {frontier}) ",
+        )
+        if leaves is None:
+            return False
+        self.backend.publish(frontier, leaves, clock=self.clock)
+        record_span(
+            "elastic.round", 0.0,
+            round=frontier, workers=len(used), worker_ids=used,
+            mode="async",
+        )
+        self.rounds[frontier] = used
+        cap = max(self.keep_rounds * 4, 64) if self.keep_rounds else 0
+        while cap and len(self.rounds) > cap:
+            del self.rounds[min(self.rounds)]
+        self._rounds.inc()
+        if self.verbose:
+            print(
+                f"elastic: published async round {frontier} "
+                f"averaged over workers {used}"
+            )
+        for wid, r, _ in pushes:
+            self._consumed[wid] = max(self._consumed.get(wid, -1), r)
+        self._frontier = frontier
+        # ``round`` keeps its sync-mode meaning — "the round currently
+        # being collected" — so summaries/state read the same way in
+        # both modes.
+        self.round = frontier + 1
+        self._last_publish = now
+        if self.keep_rounds:
+            min_live = min(
+                (m.round for m in view.live), default=frontier
+            )
+            below = min(
+                min_live,
+                frontier - max(self.max_staleness, self.keep_rounds),
+            )
+            if below > 0:
+                self.backend.prune(below)
+        return True
+
     # ---- lifecycle ----
 
     def all_finished(self, view=None) -> bool:
@@ -273,8 +424,9 @@ class Coordinator:
         decision. ``view`` reuses a scan the caller already did;
         without it the membership dir is re-read."""
         if view is None:
-            view = classify_members(
-                self.gang_dir, self.heartbeat_timeout, self.clock()
+            view = classify_view(
+                self.backend.read_members(), self.heartbeat_timeout,
+                self.clock(),
             )
         if len(self.ever_seen) < self.expected_workers:
             return False  # launched workers haven't all checked in yet

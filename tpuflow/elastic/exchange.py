@@ -24,11 +24,21 @@ Layout under the gang dir::
     avg/LATEST                          JSON {round, path, time}
 
 Params ride as their flattened pytree leaves (``arr_0..arr_{n-1}`` in
-tree-flatten order) plus a leaf count; the reader restores against the
-live state's own treedef, so structure mismatches fail loudly instead of
-silently mis-zipping leaves. Every write is atomic (tmp + rename): a
-reader never sees a torn file, only a missing one — "not pushed yet" and
+tree-flatten order) plus a leaf count and a CRC32 over every leaf's
+shape/dtype/bytes; the reader restores against the live state's own
+treedef, so structure mismatches fail loudly instead of silently
+mis-zipping leaves, and a payload whose checksum disagrees reads as
+*unreadable* (a ``ValueError``), not as trusted data — ``np.load``
+alone would happily hand a truncated socket read or a torn NFS page to
+the averaging math. Every write is atomic (tmp + rename): a reader
+never sees a torn file, only a missing one — "not pushed yet" and
 "crashed mid-push" are deliberately the same observation.
+
+This module also defines :class:`FileExchange` — the file transport
+packaged behind the backend interface that ``SocketExchange``
+(``transport.py``) implements over TCP, so the worker and coordinator
+speak to ONE contract whatever carries the bytes. The file backend
+stays the drill/reference implementation.
 
 The ``elastic.push`` fault site fires inside every push (index = round,
 so ``at=K`` drills "the worker that dies pushing round K").
@@ -36,9 +46,11 @@ so ``at=K`` drills "the worker that dies pushing round K").
 
 from __future__ import annotations
 
+import io
 import json
 import os
 import time
+import zlib
 
 import numpy as np
 
@@ -102,6 +114,53 @@ def unflatten_like(params, leaves: list[np.ndarray]):
     return jax.tree_util.tree_unflatten(treedef, cast)
 
 
+def leaves_crc32(leaves: list[np.ndarray]) -> int:
+    """CRC32 over every leaf's shape, dtype, and raw bytes — the
+    integrity stamp both transports carry (npz field / frame header)."""
+    crc = 0
+    for leaf in leaves:
+        a = np.ascontiguousarray(leaf)
+        crc = zlib.crc32(repr((a.shape, a.dtype.str)).encode(), crc)
+        crc = zlib.crc32(a.tobytes(), crc)
+    return crc & 0xFFFFFFFF
+
+
+def _savez(f, leaves: list[np.ndarray]) -> None:
+    np.savez(f, n_leaves=np.int64(len(leaves)),
+             crc32=np.uint64(leaves_crc32(leaves)),
+             **{f"arr_{i}": leaf for i, leaf in enumerate(leaves)})
+
+
+def _loadz(f) -> list[np.ndarray]:
+    with np.load(f) as z:
+        n = int(z["n_leaves"])
+        leaves = [z[f"arr_{i}"] for i in range(n)]
+        if "crc32" in z.files:  # pre-checksum files stay readable
+            want = int(z["crc32"])
+            got = leaves_crc32(leaves)
+            if got != want:
+                raise ValueError(
+                    f"param payload checksum mismatch (crc32 {got:#010x}"
+                    f" != recorded {want:#010x}) — torn file or "
+                    "truncated read; refusing to trust np.load's bytes"
+                )
+    return leaves
+
+
+def encode_leaves(leaves: list[np.ndarray]) -> bytes:
+    """Leaves -> checksummed npz bytes (the socket transport's payload
+    encoding — the SAME format the file backend writes to disk)."""
+    buf = io.BytesIO()
+    _savez(buf, leaves)
+    return buf.getvalue()
+
+
+def decode_leaves(data: bytes) -> list[np.ndarray]:
+    """Checksummed npz bytes -> leaves; raises ``ValueError`` on a
+    corrupt or truncated payload."""
+    return _loadz(io.BytesIO(data))
+
+
 def _write_npz(path: str, leaves: list[np.ndarray]) -> None:
     import threading
 
@@ -110,15 +169,13 @@ def _write_npz(path: str, leaves: list[np.ndarray]) -> None:
     # in-process runner mode runs workers as threads of one pid.
     tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     with open(tmp, "wb") as f:
-        np.savez(f, n_leaves=np.int64(len(leaves)),
-                 **{f"arr_{i}": leaf for i, leaf in enumerate(leaves)})
+        _savez(f, leaves)
     os.replace(tmp, path)
 
 
 def _read_npz(path: str) -> list[np.ndarray]:
-    with np.load(path) as z:
-        n = int(z["n_leaves"])
-        return [z[f"arr_{i}"] for i in range(n)]
+    with open(path, "rb") as f:
+        return _loadz(f)
 
 
 def write_leaves(path: str, leaves: list[np.ndarray]) -> str:
@@ -153,53 +210,73 @@ def pushed_ids(gang_dir: str, round) -> set[int]:
     return out
 
 
+def average_leaf_sets(
+    pairs: list[tuple[int, list[np.ndarray]]],
+    *,
+    weights: list[float] | None = None,
+    context: str = "",
+) -> tuple[list[np.ndarray] | None, list[int]]:
+    """Mean (optionally weighted — the async staleness down-weighting)
+    of several workers' leaf sets. THE averaging math, shared by every
+    backend: ``pairs`` is ``[(worker_id, leaves), ...]``; returns
+    ``(leaves, worker_ids_averaged)`` with leaves None when ``pairs``
+    is empty. Leaf counts and shapes are cross-checked — same depth +
+    different widths would otherwise either crash with a bare numpy
+    broadcast error or, worse, broadcast INTO the accumulator and
+    publish a silently wrong average for every worker to adopt."""
+    acc: list[np.ndarray] | None = None
+    used: list[int] = []
+    total_w = 0.0
+    for k, (wid, leaves) in enumerate(pairs):
+        w = 1.0 if weights is None else float(weights[k])
+        if w <= 0.0:
+            continue
+        if acc is None:
+            acc = [np.asarray(leaf, np.float64) * w for leaf in leaves]
+        else:
+            if len(leaves) != len(acc):
+                raise ValueError(
+                    f"worker {wid}'s push {context}has "
+                    f"{len(leaves)} leaves; others pushed {len(acc)} — "
+                    "mixed model configs in one gang"
+                )
+            for i, (a, leaf) in enumerate(zip(acc, leaves)):
+                if tuple(np.shape(leaf)) != tuple(a.shape):
+                    raise ValueError(
+                        f"worker {wid}'s push {context}leaf "
+                        f"{i} has shape {tuple(np.shape(leaf))}; others "
+                        f"pushed {tuple(a.shape)} — mixed model configs "
+                        "in one gang"
+                    )
+                a += w * leaf
+        total_w += w
+        used.append(wid)
+    if acc is None:
+        return None, []
+    return [np.asarray(a / total_w, np.float32) for a in acc], used
+
+
 def average_pushes(
     gang_dir: str, round, include: set[int] | None = None
 ) -> tuple[list[np.ndarray] | None, list[int]]:
     """Mean of the pushed leaves for ``round`` over ``include`` (None =
     every completed push). Returns ``(leaves, worker_ids_averaged)``;
     leaves is None when nothing (readable) was pushed. A torn/corrupt
-    file is skipped — the push side is atomic, so unreadable means a
-    concurrent replace, and averaging must proceed over the live set
-    rather than wedge the round."""
+    file (checksum mismatch included) is skipped — the push side is
+    atomic, so unreadable means a concurrent replace or a damaged
+    payload, and averaging must proceed over the live set rather than
+    wedge the round or trust poisoned bytes."""
     ids = sorted(pushed_ids(gang_dir, round))
     if include is not None:
         ids = [i for i in ids if i in include]
-    acc: list[np.ndarray] | None = None
-    used: list[int] = []
+    pairs: list[tuple[int, list[np.ndarray]]] = []
     for wid in ids:
         path = os.path.join(push_dir(gang_dir, round), f"{wid}.npz")
         try:
-            leaves = _read_npz(path)
+            pairs.append((wid, _read_npz(path)))
         except (OSError, ValueError, KeyError):
             continue
-        if acc is None:
-            acc = [np.asarray(leaf, np.float64) for leaf in leaves]
-        else:
-            if len(leaves) != len(acc):
-                raise ValueError(
-                    f"worker {wid}'s push for round {round} has "
-                    f"{len(leaves)} leaves; others pushed {len(acc)} — "
-                    "mixed model configs in one gang"
-                )
-            for i, (a, leaf) in enumerate(zip(acc, leaves)):
-                # Shape-checked like the adopt side (unflatten_like):
-                # same depth + different widths would otherwise either
-                # crash with a bare numpy broadcast error or — worse —
-                # broadcast INTO the accumulator and publish a silently
-                # wrong average for every worker to adopt.
-                if tuple(np.shape(leaf)) != tuple(a.shape):
-                    raise ValueError(
-                        f"worker {wid}'s push for round {round} leaf "
-                        f"{i} has shape {tuple(np.shape(leaf))}; others "
-                        f"pushed {tuple(a.shape)} — mixed model configs "
-                        "in one gang"
-                    )
-                a += leaf
-        used.append(wid)
-    if acc is None:
-        return None, []
-    return [np.asarray(a / len(used), np.float32) for a in acc], used
+    return average_leaf_sets(pairs, context=f"for round {round} ")
 
 
 def publish_average(
@@ -308,3 +385,156 @@ def latest_average(gang_dir: str) -> tuple[int, list[np.ndarray]] | None:
     except (OSError, ValueError, TypeError, KeyError,
             json.JSONDecodeError):
         return None
+
+
+# ---------------------------------------------------------------------
+# the backend interface: one contract, two transports
+# ---------------------------------------------------------------------
+
+
+class FileExchange:
+    """The file transport behind the backend interface.
+
+    Every method is a thin delegation to the module functions above —
+    this class exists so the worker and coordinator are written against
+    ONE contract that ``SocketExchange`` (``transport.py``) also
+    implements over TCP. ``network`` tells the worker whether transport
+    errors are a peer problem to degrade through (socket) or a local
+    storage problem to fail on (file — the existing supervisor-restart
+    semantics)."""
+
+    network = False
+
+    def __init__(self, gang_dir: str):
+        self.gang_dir = gang_dir
+
+    # --- params ---
+
+    def push(self, round, worker_id: int, params) -> None:
+        push_params(self.gang_dir, round, worker_id, params)
+
+    def pushed_ids(self, round) -> set[int]:
+        return pushed_ids(self.gang_dir, round)
+
+    def read_pushes(
+        self, round, include: set[int] | None = None
+    ) -> list[tuple[int, list[np.ndarray]]]:
+        """Every readable push for ``round`` as ``(worker_id, leaves)``
+        pairs (corrupt/torn payloads skipped)."""
+        ids = sorted(pushed_ids(self.gang_dir, round))
+        if include is not None:
+            ids = [i for i in ids if i in include]
+        out = []
+        for wid in ids:
+            path = os.path.join(
+                push_dir(self.gang_dir, round), f"{wid}.npz"
+            )
+            try:
+                out.append((wid, _read_npz(path)))
+            except (OSError, ValueError, KeyError):
+                continue
+        return out
+
+    def _newest_push_rounds(self, min_round: int) -> dict[int, int]:
+        push_root = os.path.join(self.gang_dir, PUSH_DIR)
+        try:
+            names = os.listdir(push_root)
+        except OSError:
+            return {}
+        newest: dict[int, int] = {}
+        for name in names:
+            r = _parse_round(name)
+            if r is None or r < min_round:
+                continue
+            for wid in pushed_ids(self.gang_dir, r):
+                if newest.get(wid, -1) < r:
+                    newest[wid] = r
+        return newest
+
+    def latest_push_rounds(
+        self, min_round: int
+    ) -> list[tuple[int, int]]:
+        """Each worker's newest push ROUND with round >= ``min_round``,
+        as ``(worker_id, round)`` — metadata only (directory listings,
+        no payload reads): the async coordinator's every-poll scan."""
+        newest = self._newest_push_rounds(min_round)
+        return [(wid, newest[wid]) for wid in sorted(newest)]
+
+    def latest_pushes(
+        self, min_round: int
+    ) -> list[tuple[int, int, list[np.ndarray]]]:
+        """Each worker's NEWEST push with round >= ``min_round``, as
+        ``(worker_id, round, leaves)`` — the payload read the async
+        coordinator pays only when a publication actually happens
+        (anything older than the staleness horizon is not even read)."""
+        newest = self._newest_push_rounds(min_round)
+        out = []
+        for wid in sorted(newest):
+            r = newest[wid]
+            path = os.path.join(push_dir(self.gang_dir, r), f"{wid}.npz")
+            try:
+                out.append((wid, r, _read_npz(path)))
+            except (OSError, ValueError, KeyError):
+                continue
+        return out
+
+    def publish(self, round: int, leaves, clock=time.time) -> None:
+        publish_average(self.gang_dir, round, leaves, clock=clock)
+
+    def read_average(self, round: int):
+        return read_average(self.gang_dir, round)
+
+    def latest_round(self) -> int | None:
+        return latest_round(self.gang_dir)
+
+    def latest_average(self):
+        return latest_average(self.gang_dir)
+
+    def prune(self, below: int) -> int:
+        return prune_rounds(self.gang_dir, below)
+
+    # --- membership ---
+
+    def write_heartbeat(
+        self, worker_id: int, *, epoch: int = 0, round: int = 0,
+        status: str = "running", clock=time.time,
+    ) -> bool:
+        from tpuflow.elastic.membership import write_heartbeat
+
+        return write_heartbeat(
+            self.gang_dir, worker_id,
+            epoch=epoch, round=round, status=status, clock=clock,
+        )
+
+    def read_members(self) -> list:
+        from tpuflow.elastic.membership import read_members
+
+        return read_members(self.gang_dir)
+
+    # --- the persisted round offset (survives restarts) ---
+
+    def _offset_path(self, worker_id: int) -> str:
+        # Deliberately NOT *.json: the membership scanner globs
+        # members/*.json and this file is not a heartbeat.
+        return os.path.join(
+            self.gang_dir, "members", f"{worker_id}.offset"
+        )
+
+    def set_offset(self, worker_id: int, offset: int) -> None:
+        from tpuflow.utils.paths import atomic_write_json
+
+        path = self._offset_path(worker_id)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        atomic_write_json(path, {"round_offset": int(offset)})
+
+    def get_offset(self, worker_id: int) -> tuple[int, bool]:
+        """``(offset, found)`` — found=False means no readable record
+        (the caller decides whether the 0 fallback is benign)."""
+        try:
+            with open(
+                self._offset_path(worker_id), encoding="utf-8"
+            ) as f:
+                return int(json.load(f)["round_offset"]), True
+        except (OSError, ValueError, TypeError, KeyError,
+                json.JSONDecodeError):
+            return 0, False

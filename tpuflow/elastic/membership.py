@@ -216,13 +216,19 @@ class MembershipView:
         return {m.worker_id for m in self.stale}
 
 
-def classify_members(
-    gang_dir: str, heartbeat_timeout: float, now: float
+def classify_view(
+    members: list[Member], heartbeat_timeout: float, now: float
 ) -> MembershipView:
-    """Partition the gang into live / stale (evictable) / finished
-    against the eviction deadline, at observation time ``now``."""
+    """Partition already-read member records into live / stale
+    (evictable) / finished against the eviction deadline at observation
+    time ``now`` — the transport-agnostic half of classification. The
+    file backend's records carry worker-side write times; the socket
+    backend's carry coordinator-side ARRIVAL times, which makes
+    eviction a transport-level liveness verdict (a partitioned worker's
+    beats never land, so it goes stale even though it is still beating
+    into the void)."""
     live, stale, finished = [], [], []
-    for m in read_members(gang_dir):
+    for m in members:
         if m.status in TERMINAL_STATUSES:
             finished.append(m)
         elif m.age(now) > heartbeat_timeout:
@@ -230,3 +236,11 @@ def classify_members(
         else:
             live.append(m)
     return MembershipView(live=live, stale=stale, finished=finished)
+
+
+def classify_members(
+    gang_dir: str, heartbeat_timeout: float, now: float
+) -> MembershipView:
+    """Read-and-classify over the file transport (see
+    :func:`classify_view`)."""
+    return classify_view(read_members(gang_dir), heartbeat_timeout, now)

@@ -111,8 +111,10 @@ def worker_spec(
     """One worker's job spec: the base job plus its ``elastic`` block,
     a per-worker checkpoint tree, and the supervisor's preconditions
     (``save_every >= 1`` so restarts resume instead of restart-over;
-    ``n_devices=1`` — elastic parallelism is across processes, not an
-    in-worker device mesh)."""
+    ``n_devices`` defaults to 1 when unset — an explicit ``n_devices``
+    in the base spec makes each worker data-parallel across that many
+    LOCAL devices via ``parallel/compat.py`` + ``make_mesh``: a fleet
+    of meshes, not a fleet of cores)."""
     spec = dict(base_spec)
     storage = spec.pop("storagePath", None) or spec.pop("storage_path", None)
     spec.pop("storage_path", None)
@@ -169,6 +171,10 @@ def run_elastic(
     *,
     gang_dir: str | None = None,
     mode: str = "supervised",
+    transport: str = "file",
+    transport_addr: str | None = None,
+    async_push: bool = False,
+    max_staleness: int = 2,
     sync_every: int = 1,
     heartbeat_interval: float = 0.25,
     heartbeat_timeout: float = 30.0,
@@ -186,6 +192,14 @@ def run_elastic(
 ) -> ElasticRunResult:
     """Run one elastic gang to completion; see the module docstring.
 
+    ``transport="socket"`` hosts a TCP exchange server in this process
+    (``elastic/transport.py``; ephemeral 127.0.0.1 port) and points
+    every worker's ``elastic`` block at it — heartbeats, pushes, and
+    rebroadcast pulls all ride the wire, and the gang dir is used only
+    for each worker's own checkpoints. ``async_push`` switches the
+    gang to DeepSpark-style asynchronous averaging with the
+    ``max_staleness`` bound (see docs/elastic.md).
+
     ``worker_faults`` maps worker_id -> a ``faults`` spec list for that
     worker's job (the churn drills: kill worker 1 at epoch 3, watch the
     gang absorb it). Targeting is exact only under ``supervised`` mode
@@ -201,6 +215,10 @@ def run_elastic(
         raise ValueError(f"n_workers must be >= 1, got {n_workers}")
     if mode not in MODES:
         raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+    if transport not in ("file", "socket"):
+        raise ValueError(
+            f"transport must be 'file' or 'socket', got {transport!r}"
+        )
     if worker_faults and mode == "inprocess":
         from tpuflow.resilience import parse_fault_spec
 
@@ -222,14 +240,35 @@ def run_elastic(
             "from there"
         )
     gang_dir = gang_dir or os.path.join(storage, "elastic")
-    _ensure_fresh_gang_dir(gang_dir)
+    if transport == "file":
+        # Socket gangs keep their state in the server's memory — a
+        # stale DIRECTORY cannot confuse them, so only the file
+        # transport needs the fresh-gang-dir refusal.
+        _ensure_fresh_gang_dir(gang_dir)
     os.makedirs(gang_dir, exist_ok=True)
+    server = None
+    coord_backend = None
+    if transport == "socket":
+        from tpuflow.elastic.transport import ExchangeServer, parse_addr
+
+        # transport_addr pins the server's bind ("host:port"; port 0 =
+        # ephemeral). The default loopback/ephemeral is right for
+        # single-host gangs; a multi-host gang (or an external monitor)
+        # needs a dialable address.
+        host, port = parse_addr(transport_addr or "127.0.0.1:0")
+        server = ExchangeServer(host=host, port=port).start()
+        coord_backend = server.store
     overrides = {
         "heartbeat_interval": heartbeat_interval,
         "heartbeat_timeout": heartbeat_timeout,
         "pull_timeout": pull_timeout,
         "poll_interval": poll_interval,
+        "transport": transport,
+        "async_push": async_push,
+        "max_staleness": max_staleness,
     }
+    if server is not None:
+        overrides["addr"] = server.addr
     # Fail at submission, not N jax-import-heavy worker launches
     # later: a bad knob (sync_every=0, negative timeout) or a bad base
     # job (stream=True, typo'd model) must die HERE, in this process,
@@ -238,23 +277,28 @@ def run_elastic(
     from tpuflow.elastic import resolve_elastic
     from tpuflow.serve import spec_to_config
 
-    resolve_elastic({
-        "dir": gang_dir, "worker_id": 0, "n_workers": n_workers,
-        "sync_every": sync_every, "round_timeout": round_timeout,
-        **overrides,
-    })
-    if min_round_interval < 0:
-        raise ValueError(
-            f"min_round_interval must be >= 0 (seconds), got "
-            f"{min_round_interval}"
+    try:
+        resolve_elastic({
+            "dir": gang_dir, "worker_id": 0, "n_workers": n_workers,
+            "sync_every": sync_every, "round_timeout": round_timeout,
+            **overrides,
+        })
+        if min_round_interval < 0:
+            raise ValueError(
+                f"min_round_interval must be >= 0 (seconds), got "
+                f"{min_round_interval}"
+            )
+        ensure_preflight(
+            spec_to_config(worker_spec(
+                spec, gang_dir, 0, n_workers,
+                sync_every=sync_every, elastic_overrides=overrides,
+            )),
+            passes=("spec",),
         )
-    ensure_preflight(
-        spec_to_config(worker_spec(
-            spec, gang_dir, 0, n_workers,
-            sync_every=sync_every, elastic_overrides=overrides,
-        )),
-        passes=("spec",),
-    )
+    except BaseException:
+        if server is not None:  # a rejected submission must not leak it
+            server.stop()
+        raise
     coordinator = Coordinator(
         gang_dir,
         heartbeat_timeout=heartbeat_timeout,
@@ -263,6 +307,9 @@ def run_elastic(
         min_round_interval=min_round_interval,
         poll_interval=poll_interval,
         expected_workers=n_workers,
+        backend=coord_backend,
+        async_push=async_push,
+        max_staleness=max_staleness,
         verbose=verbose,
     )
     stop = threading.Event()
@@ -329,15 +376,25 @@ def run_elastic(
         )
         for i in range(n_workers)
     ]
-    for t in workers:
-        t.start()
-    for t in workers:
-        t.join()
-    stop.set()
-    coord_thread.join(timeout=30)
+    try:
+        for t in workers:
+            t.start()
+        for t in workers:
+            t.join()
+        stop.set()
+        coord_thread.join(timeout=30)
+    finally:
+        stop.set()
+        if server is not None:
+            server.stop()
 
-    final_leaves, final_ids = exchange.average_pushes(
-        gang_dir, exchange.FINAL_ROUND
+    final_backend = (
+        coord_backend if coord_backend is not None
+        else exchange.FileExchange(gang_dir)
+    )
+    final_leaves, final_ids = exchange.average_leaf_sets(
+        final_backend.read_pushes(exchange.FINAL_ROUND),
+        context="for the final round ",
     )
     final_path = None
     if final_leaves is not None:

@@ -1,0 +1,625 @@
+"""Socket transport for the elastic gang: framed RPC, no shared disk.
+
+The file exchange (``exchange.py``) is SparkNet's design point — workers
+and coordinator rendezvous through a shared filesystem. This module is
+the DeepSpark-shaped upgrade (PAPERS.md, arXiv:1602.08191): a
+lightweight coordinator-hosted TCP server carrying the SAME
+push/average/rebroadcast contract over length-prefixed, checksummed
+frames, so a gang needs a route to one host:port instead of an NFS
+mount. This is the ONLY module in tpuflow allowed to touch the raw
+``socket`` API outside the serve stack (lint rule TPF012 — the TPF008
+compat-seam precedent): every other module speaks the backend
+interface, never the wire.
+
+Topology and split of labor::
+
+    coordinator process                      worker processes
+    ┌─────────────────────────┐              ┌──────────────────┐
+    │ GangStore (in-memory)   │   TCP RPC    │ SocketExchange   │
+    │   ↑ direct (no socket)  │ <=========== │   TransportClient│
+    │ Coordinator             │              │ heartbeat/push/  │
+    │ ExchangeServer (thread) │              │ pull as frames   │
+    └─────────────────────────┘              └──────────────────┘
+
+- :class:`GangStore` — the gang's state (heartbeats, pushes, averages,
+  offsets) in memory, same semantics as the file layout (sticky
+  goodbyes, atomic publishes, prune). The coordinator co-hosts it and
+  reads it DIRECTLY — its scans never pay a round trip.
+- :class:`ExchangeServer` — a threaded TCP server exposing the
+  worker-side ops over the wire. Heartbeat records are stamped with the
+  SERVER's clock at arrival: liveness is a transport-level observation,
+  so a partitioned worker goes stale even while it beats into the void.
+- :class:`SocketExchange` — the worker-side backend: the same interface
+  ``FileExchange`` implements, carried by :class:`TransportClient`.
+
+Wire format (one request/response pair per connection)::
+
+    magic "TPFX" | u32 header_len | u64 payload_len | u32 payload_crc32
+    | header JSON | payload bytes
+
+The payload is the checksummed npz encoding ``exchange.encode_leaves``
+produces — the SAME bytes the file backend writes — so a truncated read
+fails the frame CRC first and the npz CRC second, and never reaches the
+averaging math.
+
+Resilience wiring: every client request runs under
+``resilience/retry.py``'s ``io_policy`` (transient ``ECONNREFUSED`` /
+``EPIPE`` / timeouts cost backoff sleeps, not the attempt), and three
+fault sites make network chaos one line to inject —
+``elastic.transport.send`` (drop/delay a request; index = round for
+pushes), ``elastic.transport.recv`` (lose a response), and
+``elastic.transport.partition`` (fired at connect; arm with ``p=1`` to
+partition, disarm to heal). A worker whose requests exhaust the retry
+deadline degrades to local training and resyncs on reconnect
+(``worker.py``), it does not die.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+import time
+
+import numpy as np
+
+from tpuflow.elastic import exchange
+from tpuflow.elastic.membership import (
+    STATUSES,
+    TERMINAL_STATUSES,
+    Member,
+)
+from tpuflow.resilience import fault_point
+from tpuflow.resilience.retry import io_policy, retry_call
+from tpuflow.utils.env import env_num
+
+MAGIC = b"TPFX"
+_PREFIX = struct.Struct(">4sIQI")  # magic, header_len, payload_len, crc32
+# A frame header is a small JSON dict; anything bigger is garbage or an
+# attack, and a bounded reader fails fast instead of allocating it.
+MAX_HEADER = 1 << 20
+
+
+class TransportError(ConnectionError):
+    """A protocol-level failure (bad magic, short read, frame checksum
+    mismatch). Subclasses ``ConnectionError`` so the shared io_policy
+    treats it exactly like the transient socket errors it rides with."""
+
+
+def parse_addr(addr: str) -> tuple[str, int]:
+    """``"host:port"`` -> ``(host, port)``, fail-loud on malformed."""
+    host, sep, port = str(addr).rpartition(":")
+    if not sep or not host or not port.isdigit():
+        raise ValueError(
+            f"elastic transport addr must be 'host:port', got {addr!r}"
+        )
+    return host, int(port)
+
+
+def connect_timeout() -> float:
+    """The per-connection socket timeout, env-tunable
+    (``TPUFLOW_ELASTIC_CONNECT_TIMEOUT``, seconds; validated at read
+    time like every TPUFLOW_* knob)."""
+    return env_num(
+        "TPUFLOW_ELASTIC_CONNECT_TIMEOUT", 5.0, float, minimum=0.001,
+        form="a positive number of seconds",
+    )
+
+
+# ---------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            raise TransportError(
+                f"connection closed mid-frame ({got}/{n} bytes)"
+            )
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def send_frame(
+    sock: socket.socket, header: dict, payload: bytes = b""
+) -> None:
+    """Write one framed message (see module docstring for the layout)."""
+    import zlib
+
+    hdr = json.dumps(header, separators=(",", ":")).encode()
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    sock.sendall(
+        _PREFIX.pack(MAGIC, len(hdr), len(payload), crc) + hdr + payload
+    )
+
+
+def recv_frame(sock: socket.socket) -> tuple[dict, bytes]:
+    """Read one framed message; raises :class:`TransportError` on torn,
+    alien, or checksum-failing frames — corruption is DETECTED here,
+    never handed to ``np.load``."""
+    import zlib
+
+    prefix = _recv_exact(sock, _PREFIX.size)
+    magic, hlen, plen, crc = _PREFIX.unpack(prefix)
+    if magic != MAGIC:
+        raise TransportError(f"bad frame magic {magic!r}")
+    if hlen > MAX_HEADER:
+        raise TransportError(f"frame header too large ({hlen} bytes)")
+    try:
+        header = json.loads(_recv_exact(sock, hlen).decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise TransportError(f"unparseable frame header: {e}") from None
+    payload = _recv_exact(sock, plen) if plen else b""
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+        raise TransportError(
+            "frame payload checksum mismatch (truncated or corrupted "
+            "in flight)"
+        )
+    return header, payload
+
+
+# ---------------------------------------------------------------------
+# the coordinator-side store (same semantics as the file layout)
+# ---------------------------------------------------------------------
+
+
+class GangStore:
+    """In-memory gang state with the file layout's semantics: sticky
+    terminal goodbyes, publish-then-repoint averages, staleness-horizon
+    prune. Thread-safe (the server's handler threads and the
+    coordinator's scan share it); ``clock`` is injectable so liveness
+    drills run wall-clock-free."""
+
+    def __init__(self, clock=time.time):
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._members: dict[int, dict] = {}
+        self._goodbyes: dict[int, str] = {}
+        self._pushes: dict = {}  # round key -> {wid: leaves}
+        self._averages: dict[int, list[np.ndarray]] = {}
+        self._latest: int | None = None
+        self._offsets: dict[int, int] = {}
+
+    # --- membership (server-stamped arrival times) ---
+
+    def write_heartbeat(
+        self, worker_id: int, *, epoch: int = 0, round: int = 0,
+        status: str = "running", clock=None,
+    ) -> bool:
+        """Record a heartbeat at the STORE's clock (the coordinator-side
+        arrival time — transport-level liveness). The ``clock`` kwarg is
+        accepted for interface parity with FileExchange and ignored:
+        trusting a sender-side timestamp would let a worker with a
+        skewed clock dodge eviction."""
+        if status not in STATUSES:
+            raise ValueError(
+                f"unknown heartbeat status {status!r}; valid: {STATUSES}"
+            )
+        wid = int(worker_id)
+        with self._lock:
+            if status == "joining":
+                self._goodbyes.pop(wid, None)
+            elif (
+                status not in TERMINAL_STATUSES
+                and wid in self._goodbyes
+            ):
+                return False  # the goodbye stands; never beat over it
+            self._members[wid] = {
+                "worker_id": wid,
+                "time": self.clock(),
+                "epoch": int(epoch),
+                "round": int(round),
+                "status": status,
+            }
+            if status in TERMINAL_STATUSES:
+                self._goodbyes[wid] = status
+        return True
+
+    def read_members(self) -> list[Member]:
+        with self._lock:
+            out = []
+            for wid, rec in sorted(self._members.items()):
+                status = rec["status"]
+                if status not in TERMINAL_STATUSES:
+                    status = self._goodbyes.get(wid, status)
+                out.append(Member(
+                    worker_id=wid, time=rec["time"],
+                    epoch=rec["epoch"], round=rec["round"],
+                    status=status,
+                ))
+            return out
+
+    # --- params ---
+
+    def push(self, round, worker_id: int, params) -> None:
+        self.push_leaves(
+            round, worker_id, exchange.flatten_params(params)
+        )
+
+    def push_leaves(self, round, worker_id: int, leaves) -> None:
+        key = round if round == exchange.FINAL_ROUND else int(round)
+        with self._lock:
+            self._pushes.setdefault(key, {})[int(worker_id)] = leaves
+
+    def pushed_ids(self, round) -> set[int]:
+        key = round if round == exchange.FINAL_ROUND else int(round)
+        with self._lock:
+            return set(self._pushes.get(key, {}))
+
+    def read_pushes(
+        self, round, include: set[int] | None = None
+    ) -> list[tuple[int, list[np.ndarray]]]:
+        key = round if round == exchange.FINAL_ROUND else int(round)
+        with self._lock:
+            items = sorted(self._pushes.get(key, {}).items())
+        if include is not None:
+            items = [(w, ls) for w, ls in items if w in include]
+        return items
+
+    def _newest_push_rounds_locked(self, min_round: int) -> dict:
+        newest: dict[int, int] = {}
+        for key, by_wid in self._pushes.items():
+            if key == exchange.FINAL_ROUND or key < min_round:
+                continue
+            for wid in by_wid:
+                if newest.get(wid, -1) < key:
+                    newest[wid] = key
+        return newest
+
+    def latest_push_rounds(
+        self, min_round: int
+    ) -> list[tuple[int, int]]:
+        """Each worker's newest push ROUND (metadata only — the async
+        coordinator's every-poll scan; ``final`` pushes never count)."""
+        with self._lock:
+            newest = self._newest_push_rounds_locked(min_round)
+            return [(wid, newest[wid]) for wid in sorted(newest)]
+
+    def latest_pushes(
+        self, min_round: int
+    ) -> list[tuple[int, int, list[np.ndarray]]]:
+        """Each worker's newest push with round >= ``min_round`` — the
+        payload scan, paid only when a publication happens."""
+        with self._lock:
+            newest = self._newest_push_rounds_locked(min_round)
+            return [
+                (wid, newest[wid], self._pushes[newest[wid]][wid])
+                for wid in sorted(newest)
+            ]
+
+    def publish(self, round: int, leaves, clock=None) -> None:
+        with self._lock:
+            self._averages[int(round)] = leaves
+            if self._latest is None or round > self._latest:
+                self._latest = int(round)
+
+    def read_average(self, round: int):
+        with self._lock:
+            return self._averages.get(int(round))
+
+    def latest_round(self) -> int | None:
+        with self._lock:
+            return self._latest
+
+    def latest_average(self):
+        with self._lock:
+            if self._latest is None:
+                return None
+            leaves = self._averages.get(self._latest)
+            if leaves is None:  # pruned past the pointer (file parity)
+                return None
+            return self._latest, leaves
+
+    def prune(self, below: int) -> int:
+        removed = 0
+        with self._lock:
+            for key in [
+                k for k in self._pushes
+                if k != exchange.FINAL_ROUND and k < below
+            ]:
+                del self._pushes[key]
+                removed += 1
+            for key in [k for k in self._averages if k < below]:
+                del self._averages[key]
+                removed += 1
+        return removed
+
+    # --- offsets ---
+
+    def set_offset(self, worker_id: int, offset: int) -> None:
+        with self._lock:
+            self._offsets[int(worker_id)] = int(offset)
+
+    def get_offset(self, worker_id: int) -> tuple[int, bool]:
+        with self._lock:
+            if int(worker_id) in self._offsets:
+                return self._offsets[int(worker_id)], True
+            return 0, False
+
+
+# ---------------------------------------------------------------------
+# the server
+# ---------------------------------------------------------------------
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    """One request/response pair per connection. Op errors become
+    ``{"ok": false, "error": ...}`` responses; framing errors close the
+    connection (the client's retry policy owns the rest)."""
+
+    def handle(self):  # noqa: D102
+        store: GangStore = self.server.store  # type: ignore[attr-defined]
+        try:
+            header, payload = recv_frame(self.request)
+        except (OSError, TransportError):
+            return  # torn request: nothing to answer
+        try:
+            resp, out_payload = self._dispatch(store, header, payload)
+        except Exception as e:  # an op bug must not kill the server
+            resp, out_payload = (
+                {"ok": False, "error": f"{type(e).__name__}: {e}"}, b""
+            )
+        try:
+            send_frame(self.request, resp, out_payload)
+        except OSError:
+            pass  # the client is gone; its retry policy re-asks
+
+    @staticmethod
+    def _round_key(header):
+        r = header.get("round")
+        return r if r == exchange.FINAL_ROUND else int(r)
+
+    def _dispatch(self, store, header, payload):
+        op = header.get("op")
+        if op == "ping":
+            return {"ok": True}, b""
+        if op == "heartbeat":
+            accepted = store.write_heartbeat(
+                int(header["worker_id"]),
+                epoch=int(header.get("epoch", 0)),
+                round=int(header.get("round", 0)),
+                status=str(header.get("status", "running")),
+            )
+            return {"ok": True, "accepted": bool(accepted)}, b""
+        if op == "push":
+            store.push_leaves(
+                self._round_key(header), int(header["worker_id"]),
+                exchange.decode_leaves(payload),
+            )
+            return {"ok": True}, b""
+        if op == "read_average":
+            leaves = store.read_average(int(header["round"]))
+            if leaves is None:
+                return {"ok": True, "found": False}, b""
+            return (
+                {"ok": True, "found": True},
+                exchange.encode_leaves(leaves),
+            )
+        if op == "latest_round":
+            return {"ok": True, "round": store.latest_round()}, b""
+        if op == "latest_average":
+            latest = store.latest_average()
+            if latest is None:
+                return {"ok": True, "found": False}, b""
+            round_, leaves = latest
+            return (
+                {"ok": True, "found": True, "round": round_},
+                exchange.encode_leaves(leaves),
+            )
+        if op == "set_offset":
+            store.set_offset(
+                int(header["worker_id"]), int(header["offset"])
+            )
+            return {"ok": True}, b""
+        if op == "get_offset":
+            offset, found = store.get_offset(int(header["worker_id"]))
+            return {"ok": True, "offset": offset, "found": found}, b""
+        if op == "members":
+            # A wire-side gang-status probe (monitors, ops tooling —
+            # the coordinator itself reads the store directly).
+            return {"ok": True, "members": [
+                {"worker_id": m.worker_id, "time": m.time,
+                 "epoch": m.epoch, "round": m.round, "status": m.status}
+                for m in store.read_members()
+            ]}, b""
+        if op == "pushed_ids":
+            ids = store.pushed_ids(self._round_key(header))
+            return {"ok": True, "ids": sorted(ids)}, b""
+        return {"ok": False, "error": f"unknown op {op!r}"}, b""
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class ExchangeServer:
+    """The coordinator-hosted exchange endpoint: a threaded TCP server
+    over a :class:`GangStore`. ``start()`` binds (port 0 = ephemeral)
+    and serves from a daemon thread; ``addr`` is the ``host:port``
+    workers dial."""
+
+    def __init__(
+        self, store: GangStore | None = None,
+        host: str = "127.0.0.1", port: int = 0,
+    ):
+        self.store = store if store is not None else GangStore()
+        self._server = _TCPServer((host, port), _Handler)
+        self._server.store = self.store  # type: ignore[attr-defined]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def addr(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"{host}:{port}"
+
+    def start(self) -> "ExchangeServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="tpuflow-elastic-exchange-server", daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "ExchangeServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+# ---------------------------------------------------------------------
+# the client + worker-side backend
+# ---------------------------------------------------------------------
+
+
+class TransportClient:
+    """One RPC = connect, send a frame, read a frame, close. Stateless
+    between calls by design: gang churn means connections are the least
+    durable thing in the system, so none are kept. Each request runs
+    under the shared transient-I/O retry policy; the three
+    ``elastic.transport.*`` fault sites fire inside the attempt, so an
+    injected drop/delay/partition exercises the SAME backoff+deadline
+    path a real flaky network would."""
+
+    def __init__(self, addr: str, *, timeout: float | None = None):
+        self.host, self.port = parse_addr(addr)
+        self.addr = addr
+        self.timeout = timeout if timeout is not None else connect_timeout()
+
+    def request(
+        self, op: str, header: dict | None = None,
+        payload: bytes = b"", index: int | None = None,
+    ) -> tuple[dict, bytes]:
+        """Send one op; returns ``(response_header, response_payload)``.
+        Raises the last transport error once the retry policy is
+        exhausted, or ``RuntimeError`` on an op-level server error."""
+
+        def attempt():
+            fault_point("elastic.transport.partition")
+            with socket.create_connection(
+                (self.host, self.port), timeout=self.timeout
+            ) as sock:
+                fault_point("elastic.transport.send", index=index)
+                send_frame(sock, {"op": op, **(header or {})}, payload)
+                fault_point("elastic.transport.recv")
+                return recv_frame(sock)
+
+        resp, data = retry_call(io_policy(), attempt)
+        if not resp.get("ok"):
+            raise RuntimeError(
+                f"elastic transport op {op!r} failed at {self.addr}: "
+                f"{resp.get('error')}"
+            )
+        return resp, data
+
+
+class SocketExchange:
+    """The worker-side backend over TCP — the same contract
+    ``FileExchange`` implements, minus the coordinator-only scans (the
+    coordinator co-hosts the :class:`GangStore` and reads it directly).
+    ``network = True`` tells the worker that errors here are a PEER
+    problem: degrade to local training and resync on reconnect, never
+    die (``worker.py`` owns that policy)."""
+
+    network = True
+
+    def __init__(self, addr: str, *, timeout: float | None = None):
+        self.addr = addr
+        self._client = TransportClient(addr, timeout=timeout)
+
+    # --- params ---
+
+    def push(self, round, worker_id: int, params) -> None:
+        index = None if round == exchange.FINAL_ROUND else int(round)
+        fault_point("elastic.push", index=index)
+        self._client.request(
+            "push", {"round": round, "worker_id": int(worker_id)},
+            exchange.encode_leaves(exchange.flatten_params(params)),
+            index=index,
+        )
+
+    def read_average(self, round: int):
+        resp, data = self._client.request(
+            "read_average", {"round": int(round)}
+        )
+        if not resp.get("found"):
+            return None
+        return exchange.decode_leaves(data)
+
+    def latest_round(self) -> int | None:
+        resp, _ = self._client.request("latest_round")
+        round_ = resp.get("round")
+        return None if round_ is None else int(round_)
+
+    def latest_average(self):
+        resp, data = self._client.request("latest_average")
+        if not resp.get("found"):
+            return None
+        return int(resp["round"]), exchange.decode_leaves(data)
+
+    def pushed_ids(self, round) -> set[int]:
+        resp, _ = self._client.request("pushed_ids", {"round": round})
+        return set(resp.get("ids", []))
+
+    # --- membership ---
+
+    def write_heartbeat(
+        self, worker_id: int, *, epoch: int = 0, round: int = 0,
+        status: str = "running", clock=None,
+    ) -> bool:
+        # The elastic.heartbeat site fires here for drill parity with
+        # the file backend (membership.write_heartbeat): arming it
+        # silences THIS worker whichever transport carries the beats.
+        fault_point("elastic.heartbeat")
+        resp, _ = self._client.request("heartbeat", {
+            "worker_id": int(worker_id), "epoch": int(epoch),
+            "round": int(round), "status": status,
+        })
+        return bool(resp.get("accepted", True))
+
+    # --- offsets ---
+
+    def set_offset(self, worker_id: int, offset: int) -> None:
+        self._client.request(
+            "set_offset",
+            {"worker_id": int(worker_id), "offset": int(offset)},
+        )
+
+    def get_offset(self, worker_id: int) -> tuple[int, bool]:
+        resp, _ = self._client.request(
+            "get_offset", {"worker_id": int(worker_id)}
+        )
+        return int(resp.get("offset", 0)), bool(resp.get("found"))
+
+    def read_members(self) -> list[Member]:
+        """Wire-side gang status (monitors/ops tooling; the coordinator
+        reads its co-hosted store directly)."""
+        resp, _ = self._client.request("members")
+        return [
+            Member(
+                worker_id=int(m["worker_id"]), time=float(m["time"]),
+                epoch=int(m.get("epoch", 0)),
+                round=int(m.get("round", 0)),
+                status=str(m.get("status", "running")),
+            )
+            for m in resp.get("members", [])
+        ]
+
+    def ping(self) -> bool:
+        self._client.request("ping")
+        return True

@@ -9,15 +9,29 @@ One instance lives inside each worker's ``train()`` call (wired by
   run checkpoint, restored later inside ``fit``, takes precedence), and
   starts the heartbeat thread.
 - ``sync(epoch, state)`` — the ``FitConfig.sync_fn`` hook, called after
-  each epoch's bookkeeping: every ``sync_every``-th epoch it pushes the
-  worker's params for round ``epoch // sync_every`` and blocks (bounded
-  by ``pull_timeout``) for the coordinator's average, which it adopts.
-  A round whose average never appears is *skipped*, not fatal — the
-  worker continues on local params and re-syncs next round, so a slow
-  or briefly-absent coordinator degrades cadence, never the run.
+  each epoch's bookkeeping. **Synchronous mode** (default): every
+  ``sync_every``-th epoch it pushes the worker's params for round
+  ``epoch // sync_every`` and blocks (bounded by ``pull_timeout``) for
+  the coordinator's average, which it adopts. **Async mode**
+  (``async_push``, the DeepSpark shape): it pushes and immediately
+  adopts the FRESHEST published average if one newer than the last
+  adoption exists — no round barrier, so a straggling sibling never
+  stalls this worker; the coordinator's staleness bound keeps this
+  worker's own late pushes from poisoning the average.
 - ``finish(state)`` — post-fit: pushes the final params (the runner's
   end-of-gang average reads these), reports a terminal heartbeat
   status, and stops the thread.
+
+All gang I/O goes through ONE exchange backend (``make_backend``): the
+file transport or the socket transport (``transport.py``), chosen by
+the ``elastic.transport`` config key. Over the socket backend the
+worker **degrades instead of dying**: a transport error that survives
+the retry policy (a partitioned coordinator, a dead server) marks the
+worker degraded, training continues on local params, and the first
+successful exchange op afterwards resyncs it — adoption of the newest
+average rides the very next sync. Non-transport failures (an injected
+``elastic.push`` drill, a structure mismatch) still propagate: those
+are the worker's own problem, and hiding them would fake a pass.
 
 A restarted worker needs no special rejoin path: ``resume=True``
 restores its checkpoint, its next syncs replay *historic* rounds whose
@@ -31,9 +45,17 @@ import sys
 import threading
 import time
 
-from tpuflow.elastic import exchange, resolve_elastic
-from tpuflow.elastic.membership import write_heartbeat
+from tpuflow.elastic import make_backend, resolve_elastic
 from tpuflow.resilience import fault_point
+
+# The fault sites whose FaultInjected firings count as TRANSPORT
+# failures (degrade, don't die). elastic.push / elastic.heartbeat /
+# elastic.join firings are the worker's own kill drills and propagate.
+_TRANSPORT_SITES = frozenset({
+    "elastic.transport.send",
+    "elastic.transport.recv",
+    "elastic.transport.partition",
+})
 
 
 def shard_rows(ds, worker_id: int, n_workers: int):
@@ -85,6 +107,10 @@ class ElasticWorkerClient:
         self.pull_timeout = float(cfg["pull_timeout"])
         self.poll_interval = float(cfg["poll_interval"])
         self.warm_start = bool(cfg["warm_start"])
+        self.async_push = bool(cfg["async_push"])
+        self.backend = make_backend(cfg)
+        self.degraded = False  # transport lost: training local-only
+        self._adopted_round = -1  # newest average this worker runs on
         self.clock = clock
         self.sleep = sleep
         self.epoch = 0
@@ -109,6 +135,72 @@ class ElasticWorkerClient:
             "elastic_missed_rounds_total",
             "sync rounds skipped because no average appeared in time",
         )
+        self._transport_errors = reg.counter(
+            "elastic_transport_errors_total",
+            "exchange ops lost to transport failure (post-retry)",
+        )
+        self._resyncs = reg.counter(
+            "elastic_degraded_resyncs_total",
+            "recoveries from degraded local-only training",
+        )
+
+    # ---- transport guard: degrade, don't die (network backends) ----
+
+    @staticmethod
+    def _is_transport_error(e: BaseException) -> bool:
+        from tpuflow.resilience import FaultInjected
+
+        if isinstance(e, FaultInjected):
+            return getattr(e, "site", None) in _TRANSPORT_SITES
+        # RuntimeError: TransportClient's op-level server error — the
+        # peer is sick, not this worker.
+        return isinstance(e, (OSError, RuntimeError))
+
+    def _guard(self, what: str, fn, *args, **kwargs):
+        """Run one exchange op. Returns ``(ok, value)``. On a network
+        backend a transport-class failure flips the worker into
+        degraded local-only training instead of raising; the first
+        success afterwards flips it back (the resync — the caller's
+        normal adopt path completes it). File backends pass through
+        untouched: a shared-FS error keeps its existing
+        supervisor-restart semantics."""
+        if not getattr(self.backend, "network", False):
+            return True, fn(*args, **kwargs)
+        from tpuflow.obs import record_event
+
+        try:
+            value = fn(*args, **kwargs)
+        except BaseException as e:
+            if not self._is_transport_error(e):
+                raise
+            self._transport_errors.inc(op=what)
+            if not self.degraded:
+                self.degraded = True
+                record_event(
+                    "elastic_worker_degraded",
+                    worker_id=self.worker_id, op=what,
+                    error=f"{type(e).__name__}: {e}",
+                )
+                print(
+                    f"elastic: worker {self.worker_id} lost the "
+                    f"coordinator ({what}: {type(e).__name__}: {e}); "
+                    "degrading to local training, will resync on "
+                    "reconnect",
+                    file=sys.stderr,
+                )
+            return False, None
+        if self.degraded:
+            self.degraded = False
+            self._resyncs.inc()
+            record_event(
+                "elastic_worker_resynced", worker_id=self.worker_id,
+            )
+            print(
+                f"elastic: worker {self.worker_id} reconnected to the "
+                "coordinator; resyncing",
+                file=sys.stderr,
+            )
+        return True, value
 
     # ---- lifecycle ----
 
@@ -123,13 +215,16 @@ class ElasticWorkerClient:
             # in-memory-only offset would reset on restart and leave a
             # late joiner permanently misaligned with the gang's
             # rounds — adopting R-rounds-stale averages every sync.
-            self.round_offset, found = self._read_offset()
+            ok, got = self._guard(
+                "offset", self.backend.get_offset, self.worker_id
+            )
+            self.round_offset, found = got if ok else (0, False)
             if not found:
-                # Every first incarnation writes the file at join, so a
-                # missing one means it died before then. An original
-                # member is fine at 0; a warm-started late joiner is
-                # now misaligned — say so rather than train solo
-                # silently.
+                # Every first incarnation writes the record at join, so
+                # a missing one means it died before then (or the
+                # exchange is unreachable). An original member is fine
+                # at 0; a warm-started late joiner is now misaligned —
+                # say so rather than train solo silently.
                 print(
                     f"elastic: worker {self.worker_id} resuming with no "
                     "recorded round offset (first incarnation died "
@@ -138,18 +233,24 @@ class ElasticWorkerClient:
                     file=sys.stderr,
                 )
         elif self.warm_start:
-            latest = exchange.latest_average(self.gang_dir)
-            if latest is not None:
+            ok, latest = self._guard(
+                "warm_start", self.backend.latest_average
+            )
+            if ok and latest is not None:
                 round, leaves = latest
                 state = self._adopt(state, leaves)
                 self.round_offset = round
+                self._adopted_round = round
                 print(
                     f"elastic: worker {self.worker_id} warm-started from "
                     f"round {round}'s average",
                     file=sys.stderr,
                 )
         if not self.resuming:
-            self._write_offset()
+            self._guard(
+                "offset", self.backend.set_offset,
+                self.worker_id, self.round_offset,
+            )
         self._beat(status="running")
         self._thread = threading.Thread(
             target=self._heartbeat_loop,
@@ -169,9 +270,11 @@ class ElasticWorkerClient:
             self._thread = None
         try:
             if state is not None and not failed:
-                exchange.push_params(
-                    self.gang_dir, exchange.FINAL_ROUND, self.worker_id,
-                    state.params,
+                from tpuflow.elastic.exchange import FINAL_ROUND
+
+                self._guard(
+                    "final_push", self.backend.push,
+                    FINAL_ROUND, self.worker_id, state.params,
                 )
             self._beat(status="failed" if failed else "done")
         except BaseException as e:
@@ -192,20 +295,33 @@ class ElasticWorkerClient:
         round = self.round_offset + epoch // self.sync_every
         self.round = round
         self._beat()
-        published = exchange.read_average(self.gang_dir, round)
+        if self.async_push:
+            return self._sync_async(round, state)
+        ok, published = self._guard(
+            "pull", self.backend.read_average, round
+        )
+        if not ok:
+            self._missed.inc()
+            return state
         if published is not None:
             # Catch-up fast path: the round is already averaged and
             # rebroadcast (this worker is replaying history after a
-            # restart) — pushing a full param file nobody will ever
-            # read wastes shared-FS I/O; just adopt and move on.
-            return self._adopt(state, published)
+            # restart) — pushing a full param payload nobody will ever
+            # read wastes exchange bandwidth; just adopt and move on.
+            return self._adopt(state, published, round=round)
         if self._gang_moved_past(round):
             # The round's average is gone (pruned history): nothing to
             # adopt — and nothing to push, since the round will never
             # be re-averaged.
             self._missed.inc()
             return state
-        exchange.push_params(self.gang_dir, round, self.worker_id, state.params)
+        ok, _ = self._guard(
+            "push", self.backend.push, round, self.worker_id,
+            state.params,
+        )
+        if not ok:
+            self._missed.inc()
+            return state
         self._pushes.inc()
         leaves = self._wait_for_average(round)
         if leaves is None:
@@ -218,35 +334,66 @@ class ElasticWorkerClient:
                     file=sys.stderr,
                 )
             return state
-        return self._adopt(state, leaves)
+        return self._adopt(state, leaves, round=round)
 
-    def _adopt(self, state, leaves):
+    def _sync_async(self, round: int, state):
+        """The DeepSpark-shaped sync: push when ready, adopt the
+        freshest average if one newer than the last adoption exists,
+        never block on a round barrier. A straggling sibling costs this
+        worker nothing; this worker's own late pushes are the
+        coordinator's staleness bound's problem, not a barrier's."""
+        ok, _ = self._guard(
+            "push", self.backend.push, round, self.worker_id,
+            state.params,
+        )
+        if ok:
+            self._pushes.inc()
+        ok, latest = self._guard("pull", self.backend.latest_average)
+        if not ok or latest is None:
+            self._missed.inc()
+            return state
+        latest_round, leaves = latest
+        if latest_round <= self._adopted_round:
+            return state  # nothing fresher than what we already run on
+        return self._adopt(state, leaves, round=latest_round)
+
+    def _adopt(self, state, leaves, round: int | None = None):
         """Replace the live params with a rebroadcast's leaves — THE
-        one adoption path (warm start, catch-up, and per-round sync all
-        ride it), structure-checked by ``apply_params``."""
+        one adoption path (warm start, catch-up, per-round sync, and
+        async freshest-adopt all ride it), structure-checked by
+        ``apply_params``."""
+        from tpuflow.elastic.exchange import unflatten_like
         from tpuflow.train.resume import apply_params
 
         state = apply_params(
-            state, exchange.unflatten_like(state.params, leaves)
+            state, unflatten_like(state.params, leaves)
         )
         self._adopts.inc()
+        if round is not None:
+            self._adopted_round = max(self._adopted_round, round)
         return state
 
     def _gang_moved_past(self, round: int) -> bool:
         """True when the gang's newest published round is beyond
         ``round`` while ``round``'s own average is absent — i.e. the
         history this worker is replaying was pruned."""
-        latest = exchange.latest_round(self.gang_dir)
-        return latest is not None and latest > round
+        ok, latest = self._guard("pull", self.backend.latest_round)
+        return ok and latest is not None and latest > round
 
     def _wait_for_average(self, round: int):
         deadline = self.clock() + self.pull_timeout
         last_ping = self.clock()
         while True:
-            leaves = exchange.read_average(self.gang_dir, round)
-            if leaves is not None:
+            ok, leaves = self._guard(
+                "pull", self.backend.read_average, round
+            )
+            if ok and leaves is not None:
                 return leaves
-            if self._gang_moved_past(round):
+            # A transport outage inside the wait keeps polling until
+            # pull_timeout: a partition shorter than the window costs
+            # nothing, a longer one degrades this round to local
+            # training (the same miss a slow coordinator causes).
+            if ok and self._gang_moved_past(round):
                 # Skipping a pruned historic round immediately beats
                 # burning pull_timeout on a file that cannot appear.
                 return None
@@ -274,52 +421,27 @@ class ElasticWorkerClient:
             elastic_wait_round=round,
         )
 
-    # ---- the persisted round offset (survives restarts) ----
-
-    def _offset_path(self) -> str:
-        # Deliberately NOT *.json: the membership scanner globs
-        # members/*.json and this file is not a heartbeat.
-        import os
-
-        return os.path.join(
-            self.gang_dir, "members", f"{self.worker_id}.offset"
-        )
-
-    def _write_offset(self) -> None:
-        import os
-
-        from tpuflow.utils.paths import atomic_write_json
-
-        path = self._offset_path()
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        atomic_write_json(path, {"round_offset": self.round_offset})
-
-    def _read_offset(self) -> tuple[int, bool]:
-        """``(offset, found)`` — found=False means no readable record
-        (the caller decides whether the 0 fallback is benign)."""
-        import json
-
-        try:
-            with open(self._offset_path(), encoding="utf-8") as f:
-                return int(json.load(f)["round_offset"]), True
-        except (OSError, ValueError, TypeError, KeyError,
-                json.JSONDecodeError):
-            return 0, False
-
     # ---- heartbeats ----
 
     def _beat(self, status: str = "running") -> None:
-        write_heartbeat(
-            self.gang_dir, self.worker_id,
+        """One guarded heartbeat. Transport loss degrades (the beats
+        simply stop ARRIVING — which is exactly what the coordinator's
+        eviction deadline measures); a non-transport failure (the
+        ``elastic.heartbeat`` drill site) propagates to the caller —
+        main-thread beats kill the attempt, the daemon loop's die."""
+        self._guard(
+            "heartbeat", self.backend.write_heartbeat, self.worker_id,
             epoch=self.epoch, round=self.round, status=status,
             clock=self.clock,
         )
 
     def _heartbeat_loop(self) -> None:
-        # Covers liveness through long compiles and slow epochs; an
-        # injected elastic.heartbeat fault (or a genuinely dead
-        # filesystem) stops the beats — which IS the eviction drill —
-        # rather than crashing the training thread.
+        # Covers liveness through long compiles and slow epochs. A
+        # TRANSPORT failure is absorbed by the guard (beats resume when
+        # the partition heals — the degrade/resync story); an injected
+        # elastic.heartbeat fault (or a genuinely dead filesystem)
+        # kills the thread — which IS the eviction drill — rather than
+        # crashing the training thread.
         while not self._stop.wait(self.heartbeat_interval):
             if self._terminal:
                 return  # never overwrite the goodbye with "running"

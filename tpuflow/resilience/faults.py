@@ -26,6 +26,10 @@ Fire modes:
   no Python cleanup (the supervisor's detect-and-restart drill).
 - ``mode=hang`` — sleep forever at the site: a wedged I/O backend, the
   supervisor's stall-watchdog drill.
+- ``mode=delay`` — sleep ``delay`` seconds at the site, then continue: a
+  slow disk, a congested link, a straggling worker. Combined with ``p=``
+  it is the one-line straggler injection the elastic async-vs-sync A/B
+  uses (``elastic.transport.send,p=1,mode=delay,delay=0.3``).
 
 The text grammar (one entry per ``;`` in ``TPUFLOW_FAULTS``, or one string
 per ``TrainJobConfig.faults`` element)::
@@ -74,6 +78,16 @@ SITES: dict[str, str] = {
     "coordinator; index = averaging round",
     "elastic.join": "elastic/worker.py: worker registration/warm-start, "
     "before the first epoch",
+    "elastic.transport.send": "elastic/transport.py: before each RPC "
+    "request frame is written to the exchange socket; index = averaging "
+    "round for pushes (mode=delay here is the slow-link/straggler knob; "
+    "mode=raise is a dropped request)",
+    "elastic.transport.recv": "elastic/transport.py: before the RPC "
+    "response frame is read back (a firing is a response lost in "
+    "flight)",
+    "elastic.transport.partition": "elastic/transport.py: at every "
+    "exchange connect — arm with p=1 to partition this worker from the "
+    "coordinator, disarm to heal",
     "online.drift": "online/drift.py: scoring of one streaming window "
     "against the artifact's reference stats; index = window number",
     "online.retrain": "online/controller.py: launch of one warm-start "
@@ -92,6 +106,7 @@ SITES: dict[str, str] = {
 INDEXED_SITES = frozenset({
     "checkpoint.save", "checkpoint.restore",
     "train.epoch_start", "train.epoch_end", "elastic.push",
+    "elastic.transport.send",
     "online.drift", "online.retrain",
 })
 
@@ -119,8 +134,9 @@ class FaultSpec:
     at: int | None = None  # fire when index == at, one-shot
     p: float = 0.0  # fire probability per call (persistent)
     seed: int = 0  # seeds the private probability stream
-    mode: str = "raise"  # raise | exit | hang
+    mode: str = "raise"  # raise | exit | hang | delay
     code: int = 42  # exit code for mode=exit
+    delay: float = 0.05  # sleep seconds for mode=delay
     transient: bool = False  # raise TransientFault (retryable) instead
     on_fire: Callable | None = None  # called just before exit/raise
     # internal state
@@ -133,9 +149,17 @@ class FaultSpec:
             raise ValueError(
                 f"unknown fault site {self.site!r}; known: {sorted(SITES)}"
             )
-        if self.mode not in ("raise", "exit", "hang"):
+        if self.mode not in ("raise", "exit", "hang", "delay"):
             raise ValueError(
-                f"fault mode must be raise|exit|hang, got {self.mode!r}"
+                f"fault mode must be raise|exit|hang|delay, got "
+                f"{self.mode!r}"
+            )
+        if self.mode == "delay" and (
+            not isinstance(self.delay, (int, float)) or self.delay < 0
+        ):
+            raise ValueError(
+                f"fault delay must be a non-negative number of seconds, "
+                f"got {self.delay!r}"
             )
         if self.nth is None and self.at is None and not self.p:
             raise ValueError(
@@ -196,7 +220,7 @@ def parse_fault_spec(text: str) -> FaultSpec:
     kwargs: dict = {"site": parts[0]}
     casts = {
         "nth": int, "at": int, "p": float, "seed": int, "code": int,
-        "mode": str, "transient": lambda v: bool(int(v)),
+        "mode": str, "delay": float, "transient": lambda v: bool(int(v)),
     }
     for opt in parts[1:]:
         if "=" not in opt:
@@ -356,6 +380,10 @@ def fault_point(site: str, index: int | None = None) -> None:
     )
     if to_fire.mode == "exit":
         os._exit(to_fire.code)
+    if to_fire.mode == "delay":
+        # The straggler/slow-link mode: the site survives, just late.
+        time.sleep(to_fire.delay)
+        return
     if to_fire.mode == "hang":
         while True:  # noqa: TPF007 (a DELIBERATE wedge: only a kill gets out)
             time.sleep(3600)
