@@ -65,6 +65,31 @@ import urllib.request
 
 sys.path.insert(0, ".")
 
+
+def _replica_ladder() -> list[int]:
+    """Sorted, deduplicated: the sweep's baseline IS ladder[0] (its
+    capacity probe anchors the rate ladder, and knee_ratio is
+    knee(ladder[-1])/knee(ladder[0])), so an unsorted env value must
+    not silently invert what the committed ratio means."""
+    raw = os.environ.get("BENCH_SERVE_REPLICAS", "1,2,4")
+    ladder = sorted({int(r) for r in raw.split(",") if r.strip()})
+    if not ladder or ladder[0] < 1:
+        raise ValueError(f"BENCH_SERVE_REPLICAS={raw!r} needs positive ints")
+    return ladder
+
+
+if "--replicas" in sys.argv:
+    # The replica sweep needs N host devices, and jax reads XLA_FLAGS
+    # exactly once at backend init — set it BEFORE anything imports jax
+    # (benchmarks.common pins the cpu platform at import time).
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count="
+            f"{max(_replica_ladder())}"
+        ).strip()
+
 from benchmarks.common import emit, maybe_pin_cpu  # noqa: E402
 
 maybe_pin_cpu()
@@ -101,21 +126,31 @@ def _train_artifact(storage: str) -> None:
     )
 
 
-def _payload(storage: str, rows: int) -> bytes:
-    """One /predict body, reused by every request (the clients measure
-    serving, not JSON construction). Columns come from the same synthetic
-    generator the artifact trained on, so the full schema — including the
-    categorical ``completion`` column — is present."""
+def _payload_spec(storage: str, rows: int, shift: float = 0.0) -> dict:
+    """One /predict spec. Columns come from the same synthetic generator
+    the artifact trained on, so the full schema — including the
+    categorical ``completion`` column — is present. ``shift`` adds a
+    constant to every float column: the out-of-distribution payload for
+    the drift-admission drill (a mean shift of thousands of training
+    stds, unambiguous at any threshold)."""
     from tpuflow.data.synthetic import generate_wells, wells_to_table
 
     table = wells_to_table(generate_wells(1, max(rows, 2), seed=9))
     table.pop("flow")  # serving is unlabeled
-    columns = {
-        k: np.asarray(v)[:rows].tolist() for k, v in table.items()
-    }
-    return json.dumps(
-        {"storagePath": storage, "model": "static_mlp", "columns": columns}
-    ).encode()
+    columns = {}
+    for k, v in table.items():
+        arr = np.asarray(v)[:rows]
+        if shift and arr.dtype.kind == "f":
+            arr = arr + shift
+        columns[k] = arr.tolist()
+    return {"storagePath": storage, "model": "static_mlp",
+            "columns": columns}
+
+
+def _payload(storage: str, rows: int) -> bytes:
+    """One /predict body, reused by every request (the clients measure
+    serving, not JSON construction)."""
+    return json.dumps(_payload_spec(storage, rows)).encode()
 
 
 def _post(url: str, body: bytes) -> dict:
@@ -448,6 +483,344 @@ def _run_open_loop(storage: str, body: bytes) -> dict:
     return out
 
 
+def _probe_host_parallelism(k: int) -> dict:
+    """How much device-dispatch parallelism this host ACTUALLY has:
+    aggregate dispatch rate of one lane vs k concurrent lanes, each
+    pinned to its own device. Committed next to the knees so the
+    replica curve carries its own context — on a single-core container
+    the honest ceiling for a k-replica speedup is this ratio, whatever
+    the serving stack does (the BigDL lesson: scale-out wins are
+    measured against the single-instance knee, not asserted)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpuflow.parallel.placement import local_devices, place
+
+    devices = local_devices()[:k]
+
+    def make(dev):
+        w = place(np.random.default_rng(0).standard_normal(
+            (64, 64)).astype(np.float32), dev)
+
+        @jax.jit
+        def f(w, x):
+            for _ in range(8):
+                x = jnp.tanh(x @ w)
+            return x
+
+        return w, f
+
+    pairs = [make(d) for d in devices]
+    x = np.zeros((256, 64), np.float32)
+    for w, f in pairs:
+        jax.device_get(f(w, x))  # compile per device, outside timing
+
+    def serial(n: int) -> float:
+        w, f = pairs[0]
+        t0 = time.perf_counter()
+        for _ in range(n):
+            jax.device_get(f(w, x))
+        return n / (time.perf_counter() - t0)
+
+    def fanned(n_per: int) -> float:
+        def worker(i):
+            w, f = pairs[i]
+            for _ in range(n_per):
+                jax.device_get(f(w, x))
+
+        threads = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(len(pairs))
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return len(pairs) * n_per / (time.perf_counter() - t0)
+
+    serial_rps = np.median([serial(200) for _ in range(3)])
+    fanned_rps = np.median([fanned(200 // k + 1) for _ in range(3)])
+    return {
+        "devices": len(devices),
+        "serial_dispatch_rps": round(float(serial_rps), 1),
+        f"fanned_{k}_dispatch_rps": round(float(fanned_rps), 1),
+        "dispatch_speedup": round(float(fanned_rps / serial_rps), 3),
+    }
+
+
+def _start_replica_server(storage: str, replicas: int):
+    """One async server with R replica lanes; (base_url, shutdown)."""
+    from tpuflow.serve_async import make_async_server
+
+    srv = make_async_server(
+        "127.0.0.1", 0,
+        batch_predicts=True,
+        batch_max_rows=int(os.environ.get("BENCH_SERVE_MAX_BATCH", 256)),
+        warmup_buckets=int(os.environ.get("BENCH_SERVE_WARMUP", 4)),
+        replicas=replicas,
+        enable_jobs=False,
+    )
+    return f"http://127.0.0.1:{srv.port}", srv.shutdown
+
+
+def _run_replica_sweep(storage: str, body: bytes) -> dict:
+    """The replica scaling curve: open-loop Poisson sweeps against one
+    server per replica count, interleaved PER RUNG (every offered rate
+    measures all R configs back-to-back, so slow drift on a shared box
+    lands on every curve equally — the PR 8 interleaving lesson)."""
+    ladder = _replica_ladder()
+    seconds = float(os.environ.get("BENCH_SERVE_REPLICA_SECONDS", 5))
+    senders = int(os.environ.get("BENCH_SERVE_OPEN_CLIENTS", 96))
+    out: dict = {
+        "mode": "replica_scaling",
+        "device": "host_only",
+        "replica_ladder": ladder,
+        "host_cores": len(os.sched_getaffinity(0)),
+        "host_parallelism_probe": _probe_host_parallelism(max(ladder)),
+        "senders": senders,
+        "seconds_per_rung": seconds,
+        "rates": [],
+    }
+    servers: dict[int, tuple] = {}
+    try:
+        for r in ladder:
+            print(
+                f"[bench_serving] replicas={r}: starting + warming...",
+                file=sys.stderr,
+            )
+            base, stop = _start_replica_server(storage, r)
+            servers[r] = (base, stop)
+            for _ in range(8):
+                _post(base + "/predict", body)
+            _drive(base, body, min(32, senders), 1.5)  # concurrent warm
+        # Capacity probe on the baseline (smallest-R) server: the rate
+        # ladder is relative to ITS knee, the ratio's denominator.
+        capacity = _drive(servers[ladder[0]][0], body, 16, 3.0)[
+            "requests_per_sec"
+        ]
+        out[f"r{ladder[0]}_capacity_probe_rps"] = capacity
+        fractions = [
+            float(f) for f in os.environ.get(
+                "BENCH_SERVE_REPLICA_FRACTIONS", "0.6,0.85,1.05,1.4,1.9"
+            ).split(",") if f.strip()
+        ]
+        rates = [round(capacity * f, 1) for f in fractions]
+        # Discarded rung per server: the first full-sender connect storm
+        # repeatably poisons the first measured tail.
+        for r in ladder:
+            _drive_open_loop(
+                servers[r][0], body, senders,
+                max(rates[0] * 0.5, 20.0), min(2.0, seconds), seed=97,
+            )
+        for ri, rate in enumerate(rates):
+            for r in ladder:  # interleaved per rung
+                print(
+                    f"[bench_serving] replicas={r} @ {rate:g} req/s...",
+                    file=sys.stderr,
+                )
+                res = _drive_open_loop(
+                    servers[r][0], body, senders, rate, seconds, seed=ri,
+                )
+                res["replicas"] = r
+                out["rates"].append(res)
+                emit(
+                    f"serve_replicas_r{r}@{rate:g}rps",
+                    "predict_goodput_rps",
+                    res["goodput_rps"],
+                    "req/s",
+                    offered_rps=res["offered_rps"],
+                    replicas=r,
+                    p99_ms=res.get("p99_ms"),
+                    by_code=res["by_code"],
+                )
+        final = json.loads(
+            urllib.request.urlopen(
+                servers[ladder[-1]][0] + "/metrics", timeout=10
+            ).read()
+        )
+        out["final_replica_metrics"] = final["replicas"]
+    finally:
+        for _base, stop in servers.values():
+            stop()
+    out["knees_rps"] = {}
+    for r in ladder:
+        pts = [p for p in out["rates"] if p["replicas"] == r]
+        k = _knee(pts)
+        out["knees_rps"][str(r)] = k["offered_rps"] if k else None
+    k1 = out["knees_rps"].get(str(ladder[0]))
+    kmax = out["knees_rps"].get(str(ladder[-1]))
+    if k1 and kmax:
+        out["knee_ratio"] = round(kmax / k1, 3)
+        probe = out["host_parallelism_probe"]["dispatch_speedup"]
+        out["note"] = (
+            f"knee_ratio {out['knee_ratio']}x vs this host's measured "
+            f"device-dispatch parallelism of {probe}x over "
+            f"{out['host_cores']} core(s): the replica data plane can "
+            "scale the knee at most as far as concurrent dispatches "
+            "actually overlap. On a multi-core/multi-device host the "
+            "probe (and the curve) rises; on a single-core container "
+            "both honestly pin near 1x — commit the curve, not the "
+            "assertion (BigDL lesson, PAPERS.md)."
+        )
+        emit(
+            "serve_replica_knee_ratio",
+            f"r{ladder[-1]}_over_r{ladder[0]}_knee",
+            out["knee_ratio"],
+            "x",
+            knees_rps=out["knees_rps"],
+            host_dispatch_speedup=(
+                out["host_parallelism_probe"]["dispatch_speedup"]
+            ),
+        )
+    # p99 at matched offered rate: the largest rate every config served
+    # (>= 90% goodput) — replicas must not buy throughput with tail.
+    matched = None
+    for rate in sorted({p["offered_rps"] for p in out["rates"]}):
+        group = [p for p in out["rates"] if p["offered_rps"] == rate]
+        if len(group) == len(ladder) and all(
+            p["ok"] and p["goodput_rps"] >= 0.9 * p["offered_rps"]
+            and p.get("p99_ms") for p in group
+        ):
+            matched = {
+                "offered_rps": rate,
+                **{
+                    f"r{p['replicas']}_p99_ms": p["p99_ms"]
+                    for p in group
+                },
+            }
+    out["p99_at_matched_rate"] = matched
+    return out
+
+
+def _run_drift_drill(storage: str, rows: int) -> dict:
+    """The drift-admission drill: concurrent in-distribution and far
+    out-of-distribution floods against a shed-policy server. The
+    committed record is the exact split: every OOD request shed 429 at
+    admission, zero in-distribution requests dropped, counters equal to
+    the observed statuses."""
+    from tpuflow.serve_async import make_async_server
+
+    srv = make_async_server(
+        "127.0.0.1", 0,
+        batch_predicts=True,
+        batch_max_rows=int(os.environ.get("BENCH_SERVE_MAX_BATCH", 256)),
+        warmup_buckets=0,
+        drift_admission="shed",
+        drift_threshold=8.0,
+        enable_jobs=False,
+    )
+    base = f"http://127.0.0.1:{srv.port}"
+    id_body = json.dumps(_payload_spec(storage, rows)).encode()
+    ood_body = json.dumps(
+        _payload_spec(storage, rows, shift=1e6)
+    ).encode()
+    per = int(os.environ.get("BENCH_SERVE_DRIFT_REQUESTS", 200))
+    counts = {"id": {}, "ood": {}}
+    lock = threading.Lock()
+
+    def flood(kind: str, body: bytes, n: int) -> None:
+        for _ in range(n):
+            try:
+                code, out = _post_status(base + "/predict", body)
+                if code == 200 and "predictions" not in out:
+                    code = -1
+            except Exception:
+                code = -1
+            with lock:
+                counts[kind][code] = counts[kind].get(code, 0) + 1
+
+    try:
+        for _ in range(4):
+            _post(base + "/predict", id_body)  # warm: load + compile
+        threads = [
+            threading.Thread(
+                target=flood, args=("id", id_body, per // 4), daemon=True
+            ) for _ in range(4)
+        ] + [
+            threading.Thread(
+                target=flood, args=("ood", ood_body, per // 4),
+                daemon=True,
+            ) for _ in range(4)
+        ]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        elapsed = time.monotonic() - t0
+        metrics = json.loads(
+            urllib.request.urlopen(base + "/metrics", timeout=10).read()
+        )
+    finally:
+        srv.shutdown()
+    id_sent = sum(counts["id"].values())
+    ood_sent = sum(counts["ood"].values())
+    record = {
+        "policy": "shed",
+        "threshold": 8.0,
+        "elapsed_s": round(elapsed, 2),
+        "in_distribution": {
+            "sent": id_sent,
+            "ok_200": counts["id"].get(200, 0),
+            "by_code": {str(c): k for c, k in sorted(counts["id"].items())},
+        },
+        "out_of_distribution": {
+            "sent": ood_sent,
+            "shed_429": counts["ood"].get(429, 0),
+            "by_code": {
+                str(c): k for c, k in sorted(counts["ood"].items())
+            },
+        },
+        "counters": {
+            "drift_shed": metrics["serving"]["drift_shed"],
+            "drift_flagged": metrics["serving"]["drift_flagged"],
+        },
+        "zero_in_distribution_dropped": (
+            counts["id"].get(200, 0) == id_sent
+        ),
+        "all_ood_shed_at_admission": (
+            counts["ood"].get(429, 0) == ood_sent
+            and metrics["serving"]["drift_shed"] == ood_sent
+        ),
+    }
+    emit(
+        "serve_drift_admission_drill",
+        "ood_shed_fraction",
+        counts["ood"].get(429, 0) / max(ood_sent, 1),
+        "fraction",
+        in_distribution_ok=counts["id"].get(200, 0),
+        in_distribution_sent=id_sent,
+        ood_sent=ood_sent,
+        drift_shed_counter=metrics["serving"]["drift_shed"],
+    )
+    return record
+
+
+def _replicas_main() -> None:
+    """``--replicas``: the replica-scaling sweep + drift drill, written
+    to benchmarks/serving_replica_results.json (host_only)."""
+    rows = int(os.environ.get("BENCH_SERVE_ROWS", 64))
+    with tempfile.TemporaryDirectory(
+        prefix="tpuflow_bench_replica_"
+    ) as storage:
+        print("[bench_serving] training the artifact...", file=sys.stderr)
+        _train_artifact(storage)
+        body = _payload(storage, rows)
+        results = {
+            "rows_per_request": rows,
+            **_run_replica_sweep(storage, body),
+            "drift_drill": _run_drift_drill(storage, rows),
+        }
+    out = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "serving_replica_results.json",
+    )
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(results, f, indent=2)
+    print(f"[bench_serving] wrote {out}", file=sys.stderr)
+
+
 def _measure_mode(
     storage: str, body: bytes, batched: bool, clients: int, seconds: float
 ) -> dict:
@@ -491,6 +864,9 @@ def main() -> None:
     # the regression gate shape (same knobs run_all.py --quick sets via
     # env; explicit env values still win so CI can tune either way).
     argv = sys.argv[1:]
+    if "--replicas" in argv:
+        _replicas_main()
+        return
     quick = "--quick" in argv
     if quick:
         os.environ.setdefault("BENCH_SERVE_CLIENTS", "8")

@@ -1,6 +1,14 @@
 """Run the five BASELINE-config benchmarks; write benchmarks/results.json.
 
-Usage: python benchmarks/run_all.py [--quick] [--precision P] [script.py ...]
+Usage: python benchmarks/run_all.py [--quick] [--precision P]
+       [--replicas] [script.py ...]
+
+``--replicas`` runs the serving replica-scaling ladder instead of the
+standard sweep: ``bench_serving.py --replicas`` (open-loop Poisson,
+one server per replica count, interleaved per rung, plus the
+drift-admission drill) writing
+``benchmarks/serving_replica_results.json``; its emitted records still
+merge into results.json like any partial run.
 
 With script names, only those benchmarks run and their records are
 MERGED into the existing results.json (rows with the same
@@ -61,6 +69,15 @@ def main() -> None:
     root = os.path.dirname(here)
     base_env = dict(os.environ)
     precisions, argv = _parse_precisions(sys.argv[1:])
+    replica_ladder = "--replicas" in argv
+    if replica_ladder:
+        # The replica ladder is its own sweep: one script, one child
+        # flag, its own committed JSON (serving_replica_results.json).
+        # Appended only if absent — `--replicas bench_serving.py`
+        # must not run the multi-minute ladder twice.
+        argv = [a for a in argv if a != "--replicas"]
+        if "bench_serving.py" not in argv:
+            argv = argv + ["bench_serving.py"]
     args = [a for a in argv if a != "--quick"]
     if "--quick" in argv:
         base_env.setdefault("BENCH_SECONDS", "2")
@@ -79,8 +96,13 @@ def main() -> None:
         if precision is not None:
             env["BENCH_PRECISION"] = precision
         for script in selected:
+            child_args = (
+                ["--replicas"]
+                if replica_ladder and script == "bench_serving.py"
+                else []
+            )
             proc = subprocess.run(
-                [sys.executable, os.path.join(here, script)],
+                [sys.executable, os.path.join(here, script)] + child_args,
                 capture_output=True,
                 text=True,
                 cwd=root,

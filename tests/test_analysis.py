@@ -550,6 +550,55 @@ class TestLinter:
                 return open(path).read()  # noqa: TPF009
         """) == []
 
+    def test_tpf013_direct_device_apis_flagged(self, tmp_path):
+        """TPF013: device discovery/placement outside the placement
+        seam — the jax.devices()/jax.device_put call sites the seam
+        (tpuflow/parallel/placement.py) exists to absorb."""
+        diags = self._lint_source(tmp_path, """
+            import jax
+
+            def pick():
+                devs = jax.devices()
+                local = jax.local_devices()
+                return jax.device_put(devs[0], local[0])
+        """)
+        assert _codes(diags) == ["TPF013"] * 3
+        assert "jax.devices" in diags[0].message
+
+    def test_tpf013_from_imports_flagged(self, tmp_path):
+        diags = self._lint_source(tmp_path, """
+            from jax import devices, device_put
+        """)
+        assert _codes(diags) == ["TPF013"]
+        assert "device_put" in diags[0].message
+
+    def test_tpf013_exempt_in_the_placement_layer(self, tmp_path):
+        # Path-scoped like TPF008/TPF012: the whole parallel/ layer is
+        # the seam's side of the line.
+        d = tmp_path / "tpuflow" / "parallel"
+        d.mkdir(parents=True)
+        f = d / "placement.py"
+        f.write_text("import jax\nDEVS = jax.devices()\n")
+        assert lint_file(str(f)) == []
+        f2 = d / "dp.py"
+        f2.write_text("import jax\nputs = jax.device_put\n")
+        assert lint_file(str(f2)) == []
+
+    def test_tpf013_noqa_and_benign_attrs(self, tmp_path):
+        assert self._lint_source(tmp_path, """
+            import jax
+
+            DEVS = jax.devices()  # noqa: TPF013
+        """) == []
+        # jax.device_count and other jax attributes are not placement
+        # decisions; neither is a non-jax object's .devices().
+        assert self._lint_source(tmp_path, """
+            import jax
+
+            def info(arr):
+                return jax.device_count(), arr.devices()
+        """) == []
+
     def _lint_online_source(self, tmp_path, source):
         """Lint a file AS IF it lived in tpuflow/online/ (TPF010 scope)."""
         import textwrap

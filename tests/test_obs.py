@@ -452,6 +452,26 @@ class TestMetricsKeysDocDrift:
         finally:
             srv.shutdown()
 
+    def test_replicas_metrics_keys_match_docs(self):
+        """The multi-replica data plane's `replicas` section, same
+        marker-block contract — asserted on a replicated service so the
+        documented keys are the ones a real deployment renders."""
+        from tpuflow.serve import PredictService
+        from tpuflow.serve_async import AsyncServer
+
+        srv = AsyncServer(
+            "127.0.0.1", 0, enable_jobs=False,
+            service=PredictService(
+                batch_predicts=True, batch_mode="continuous", replicas=2
+            ),
+        )
+        try:
+            assert self._documented("replicas") == set(
+                srv.metrics()["replicas"]
+            )
+        finally:
+            srv.shutdown()
+
 
 class TestTrainRunSpans:
     def test_metrics_jsonl_carries_ingest_step_checkpoint_spans(

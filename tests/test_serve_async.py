@@ -511,7 +511,9 @@ class TestAsyncServerEndToEnd:
             status, health = _get(base, "/healthz")
             assert status == 200 and health["status"] == "ok"
             status, m = _get(base, "/metrics")
-            assert set(m) == {"jobs", "predict", "serving", "uptime_s"}
+            assert set(m) == {
+                "jobs", "predict", "serving", "replicas", "uptime_s",
+            }
             assert m["serving"]["admitted"] == 1
             assert m["predict"]["batching"]["mode"] == "continuous"
             with urllib.request.urlopen(

@@ -123,6 +123,14 @@ RULES = {
               "elastic.transport.* fault sites, so their failures are "
               "undrillable; speak the exchange backend interface "
               "instead",
+    "TPF013": "direct jax.devices() / jax.device_put / "
+              "jax.local_devices use outside tpuflow/parallel/ — "
+              "device discovery and value placement belong to the "
+              "placement seam (tpuflow/parallel/placement.py): serving "
+              "replica placement, forced host device counts, and any "
+              "future multi-host policy change ONE module, not every "
+              "scattered call site; use local_devices()/place()/"
+              "device_put() from the seam",
 }
 
 _HOST_SYNC_NAMES = {"float", "bool"}
@@ -227,6 +235,14 @@ _SOCKET_ALLOWED_SUFFIXES = (
 )
 _SOCKET_MODULES = ("socket", "socketserver", "http.client")
 
+# TPF013: the jax attribute names the placement seam owns — device
+# discovery and value placement. Everything under tpuflow/parallel/ is
+# exempt (the seam and the mesh/strategy modules it serves are one
+# layer); everywhere else these references are placement decisions that
+# belong in tpuflow/parallel/placement.py.
+_PLACEMENT_OWNED_JAX_ATTRS = {"devices", "device_put", "local_devices"}
+_PLACEMENT_DIR_FRAGMENT = "tpuflow/parallel/"
+
 # TPF010: scope and trigger. The rule fires only in the online package
 # (the one place a per-window device sync stalls a live ingest loop);
 # a "streaming-window consumer loop" is a for-loop whose ITERABLE
@@ -252,6 +268,7 @@ class _Linter(ast.NodeVisitor):
         self._def_stack: list[str] = []
         norm = path.replace(os.sep, "/")
         self._is_compat = norm.endswith(_COMPAT_MODULE_SUFFIX)
+        self._is_placement_layer = _PLACEMENT_DIR_FRAGMENT in norm
         self._is_online = _ONLINE_PATH_FRAGMENT in norm
         self._socket_allowed = norm.endswith(_SOCKET_ALLOWED_SUFFIXES)
 
@@ -535,6 +552,13 @@ class _Linter(ast.NodeVisitor):
             and node.value.id == "jax"
         ):
             self._emit("TPF008", node, f"jax.{node.attr} reference")
+        if (
+            not self._is_placement_layer
+            and node.attr in _PLACEMENT_OWNED_JAX_ATTRS
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "jax"
+        ):
+            self._emit("TPF013", node, f"jax.{node.attr} reference")
         self.generic_visit(node)
 
     # --- TPF012: raw wire imports outside the transport seam ---
@@ -582,6 +606,15 @@ class _Linter(ast.NodeVisitor):
                     "TPF008", node,
                     f"from {node.module} import "
                     f"{', '.join(sorted(offending))}",
+                )
+        if not self._is_placement_layer and node.module == "jax":
+            placed = {
+                a.name for a in node.names
+            } & _PLACEMENT_OWNED_JAX_ATTRS
+            if placed:
+                self._emit(
+                    "TPF013", node,
+                    f"from jax import {', '.join(sorted(placed))}",
                 )
         self.generic_visit(node)
 

@@ -283,3 +283,53 @@ def check_plan(
                 where=axis_name,
             ))
     return out
+
+
+def check_serve_plan(
+    replicas: int, device_count: int | None = None
+) -> list[Diagnostic]:
+    """Serving-plan arithmetic: can ``replicas`` predictor replicas be
+    placed one-per-device? The same contract as the train-plan checks —
+    a count the hardware cannot place is a DIAGNOSTIC naming the device
+    count and the fix, collected before any artifact loads or lanes
+    open, never a runtime crash deep in a device_put. Pass
+    ``device_count`` explicitly to check a remote topology from a
+    loginless node; default reads the local placement seam."""
+    out: list[Diagnostic] = []
+    try:
+        replicas = int(replicas)
+    except (TypeError, ValueError):
+        return [_diag(
+            "plan.serve.replicas_invalid",
+            f"replicas must be an integer >= 1, got {replicas!r}",
+            where="replicas",
+        )]
+    if replicas < 1:
+        return [_diag(
+            "plan.serve.replicas_invalid",
+            f"replicas must be >= 1, got {replicas}",
+            where="replicas",
+        )]
+    # The placement seam's own validation is the one source of truth
+    # for the can-these-replicas-be-placed rule AND its advice text —
+    # re-implementing it here is how the diagnostic and the
+    # construction-time ValueError would drift apart. A remote
+    # topology checks against a synthetic device list of the given
+    # length (replica_devices only counts and slices).
+    from tpuflow.parallel.placement import replica_devices
+
+    try:
+        replica_devices(
+            replicas,
+            devices=(
+                None if device_count is None
+                else [None] * int(device_count)
+            ),
+        )
+    except ValueError as e:
+        out.append(_diag(
+            "plan.serve.replicas_exceed_devices",
+            str(e),
+            where="replicas",
+        ))
+    return out

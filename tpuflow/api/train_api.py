@@ -40,6 +40,7 @@ from tpuflow.models import build_model
 from tpuflow.parallel import (
     data_sharding,
     init_distributed,
+    local_devices,
     make_dp_epoch_step,
     make_dp_eval_step,
     make_dp_train_step,
@@ -785,7 +786,7 @@ def _train_impl(
         mesh = make_tp_mesh(
             n_data=n_dev // config.tp,
             n_model=config.tp,
-            devices=jax.devices()[:n_dev],
+            devices=local_devices()[:n_dev],
         )
         # Fails loudly for non-Dense-stack families (mlp_tp_shardings).
         state = shard_state(mesh, state, mlp_tp_shardings(mesh, state.params))
@@ -806,7 +807,7 @@ def _train_impl(
         mesh = make_pp_mesh(
             n_data=n_dev // config.pp,
             n_model=config.pp,
-            devices=jax.devices()[:n_dev],
+            devices=local_devices()[:n_dev],
         )
         # Fails loudly for non-pipeline families (pp_shardings).
         state = shard_state(mesh, state, pp_shardings(mesh, state.params))
@@ -826,7 +827,7 @@ def _train_impl(
         mesh = make_ep_mesh(
             n_data=n_dev // config.ep,
             n_model=config.ep,
-            devices=jax.devices()[:n_dev],
+            devices=local_devices()[:n_dev],
         )
         # Fails loudly for non-MoE families (ep_shardings).
         state = shard_state(mesh, state, ep_shardings(mesh, state.params))
@@ -839,7 +840,7 @@ def _train_impl(
             raise ValueError(
                 f"batch_size {config.batch_size} not divisible by {n_dev} devices"
             )
-        mesh = make_mesh(n_data=n_dev, devices=jax.devices()[:n_dev])
+        mesh = make_mesh(n_data=n_dev, devices=local_devices()[:n_dev])
         state = replicate(mesh, state)
         dp_train = make_dp_train_step(mesh, loss_fn)
         dp_eval = make_dp_eval_step(mesh, loss_fn)

@@ -12,11 +12,12 @@ With no positional args, the synthetic well schema is used end-to-end.
 
 Daemon mode: ``python -m tpuflow.cli serve [...]`` launches the async
 serving control plane (``tpuflow/serve_async.py`` — admission control,
-continuous batching, deadlines; docs/serving.md) with the remaining
-args; ``serve --threaded`` launches the legacy threaded front end
-(``tpuflow/serve.py``) instead. The subcommand is intercepted before
-the training parser so the reference's positional contract is
-untouched.
+continuous batching, deadlines, ``--replicas`` for the multi-replica
+data plane and ``--drift-admission`` for the drift gate;
+docs/serving.md) with the remaining args; ``serve --threaded``
+launches the legacy threaded front end (``tpuflow/serve.py``) instead.
+The subcommand is intercepted before the training parser so the
+reference's positional contract is untouched.
 """
 
 from __future__ import annotations

@@ -103,11 +103,13 @@ def device_prefetch(
     """
     import jax
 
+    from tpuflow.parallel.placement import device_put
+
     def put(item):
         if sharding is None:
-            return jax.tree_util.tree_map(jax.device_put, item)
+            return jax.tree_util.tree_map(device_put, item)
         return jax.tree_util.tree_map(
-            lambda a: jax.device_put(a, sharding), item
+            lambda a: device_put(a, sharding), item
         )
 
     return prefetch((put(item) for item in iterator), buffer_size)

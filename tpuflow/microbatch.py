@@ -501,15 +501,26 @@ class _Lane:
     thread that drives its double-buffered dispatch loop, and a
     per-lane condition (sharing the batcher's one lock, so every
     invariant still holds under it) — an enqueue wakes exactly the
-    lane it fed, not every resident lane."""
+    lane it fed, not every resident lane.
 
-    __slots__ = ("entries", "thread", "closing", "cond")
+    ``inflight_rows``/``dispatches`` are the per-lane load accounting
+    the replica data plane selects on (``lane_outstanding``): rows a
+    lane has taken but not yet answered count against it exactly like
+    rows still queued, so join-shortest-queue sees the dispatch a lane
+    is busy running, not just its backlog."""
+
+    __slots__ = (
+        "entries", "thread", "closing", "cond", "inflight_rows",
+        "dispatches",
+    )
 
     def __init__(self, lock: threading.Lock):
         self.entries: list[_Pending] = []
         self.thread: threading.Thread | None = None
         self.closing = False
         self.cond = threading.Condition(lock)
+        self.inflight_rows = 0
+        self.dispatches = 0
 
 
 class ContinuousBatcher(_BatcherBase):
@@ -564,6 +575,11 @@ class ContinuousBatcher(_BatcherBase):
             "artifact dispatch lanes currently resident",
             fn=lambda: len(self._lanes),
         )
+        # Optional per-lane dispatch hook: called AFTER each lane
+        # dispatch completes with (key, requests, rows). The serving
+        # replica plane hangs its replica-labeled dispatch counters
+        # here; the batcher itself stays replica-agnostic.
+        self.on_lane_dispatch = None
 
     # ---- caller side ----
 
@@ -623,6 +639,40 @@ class ContinuousBatcher(_BatcherBase):
                 "lanes": len(self._lanes),
             }
 
+    def lane_outstanding(self, key: tuple) -> int:
+        """Rows this lane owes answers for: queued + currently
+        dispatching. THE join-shortest-queue load signal (an absent
+        lane reads as 0 — an idle replica is maximally attractive)."""
+        with self._cond:
+            lane = self._lanes.get(key)
+            if lane is None:
+                return 0
+            return sum(len(e.x) for e in lane.entries) + lane.inflight_rows
+
+    def lane_keys(self, prefix: tuple = ()) -> list[tuple]:
+        """Resident lane keys, optionally filtered to those extending
+        ``prefix`` (an artifact's replica lanes share its key as their
+        prefix — the replica-aware observability/teardown seam)."""
+        with self._cond:
+            return [
+                k for k in self._lanes if k[: len(prefix)] == prefix
+            ]
+
+    def lane_stats(self, prefix: tuple = ()) -> dict[tuple, dict]:
+        """Per-lane load snapshot under one lock acquisition: queued
+        rows, in-flight rows, lifetime dispatches — the JSON /metrics
+        view of what ``lane_outstanding`` selects on."""
+        with self._cond:
+            return {
+                k: {
+                    "queued_rows": sum(len(e.x) for e in lane.entries),
+                    "inflight_rows": lane.inflight_rows,
+                    "dispatches": lane.dispatches,
+                }
+                for k, lane in self._lanes.items()
+                if k[: len(prefix)] == prefix
+            }
+
     def close_lane(self, key: tuple) -> None:
         """Retire one artifact's lane (after the service evicts the
         artifact): queued entries still drain, then the thread exits.
@@ -632,6 +682,23 @@ class ContinuousBatcher(_BatcherBase):
             if lane is not None:
                 lane.closing = True
                 lane.cond.notify_all()
+
+    def close_lanes_for(self, prefix: tuple) -> int:
+        """Retire EVERY lane whose key extends ``prefix`` — the
+        replica-aware spill/reload hook: an artifact's eviction must
+        drain all of its replica lanes (keys ``prefix + (replica,)``)
+        as well as a plain ``prefix`` lane, with zero dropped entries
+        (each lane's queue drains before its thread exits). Returns how
+        many lanes were told to close."""
+        with self._cond:
+            matched = [
+                lane for k, lane in self._lanes.items()
+                if k[: len(prefix)] == prefix
+            ]
+            for lane in matched:
+                lane.closing = True
+                lane.cond.notify_all()
+        return len(matched)
 
     def close(self) -> None:
         """Stop every lane; queued entries are drained first so no
@@ -712,6 +779,7 @@ class ContinuousBatcher(_BatcherBase):
                 )
                 if taken:
                     self._inflight += 1
+                    lane.inflight_rows = sum(len(e.x) for e in taken)
             self._shed_expired(expired)
             if taken:
                 try:
@@ -719,3 +787,17 @@ class ContinuousBatcher(_BatcherBase):
                 finally:
                     with self._cond:
                         self._inflight -= 1
+                        lane.inflight_rows = 0
+                        lane.dispatches += 1
+                        hook = self.on_lane_dispatch
+                if hook is not None:
+                    # Outside the lock (the hook records metrics, which
+                    # take their own locks); guarded — a broken hook
+                    # must not kill the lane thread.
+                    try:
+                        hook(
+                            key, len(taken),
+                            sum(len(e.x) for e in taken),
+                        )
+                    except Exception:
+                        pass

@@ -220,7 +220,7 @@ def __getattr__(name: str):
     # Lazy re-exports: the spec preflight imports validate_online_block
     # without paying for jax/predictor imports in the controller.
     if name in ("DriftDetected", "DataDriftWatchdog", "ReferenceStats",
-                "reference_stats_from_sidecar"):
+                "reference_stats_from_sidecar", "admission_score"):
         from tpuflow.online import drift
 
         return getattr(drift, name)

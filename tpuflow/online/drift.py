@@ -137,6 +137,41 @@ def reference_stats_from_sidecar(storage_path: str, name: str) -> ReferenceStats
     )
 
 
+def admission_score(ref: ReferenceStats, columns: dict) -> float | None:
+    """One request's out-of-distribution score against the artifact's
+    reference stats: the MAX standardized mean shift across the request
+    columns that match reference features (the ``feature_shift`` z-score
+    of :class:`DataDriftWatchdog`, collapsed to a scalar a front door
+    can threshold on).
+
+    The serving admission gate (``serve_async.py``) calls this per
+    request BEFORE the request can occupy a dispatch slot — strictly
+    host-side numpy, no device work (the TPF010 discipline applies to
+    the admission path exactly as it does to the consumer loop).
+    Returns None when no reference feature is present in ``columns``
+    (nothing to score — the gate must not guess). A column carrying a
+    non-finite value (json.loads admits ``NaN``) scores **inf**: the
+    training data was finite, so nothing is further out of
+    distribution — and because ``nan > threshold`` is False, treating
+    it as anything less would let the single most malformed payload
+    sail through a shed-policy gate (and a leading NaN would mask
+    every later column's real shift)."""
+    best: float | None = None
+    for i, name in enumerate(ref.feature_names):
+        if name not in columns:
+            continue
+        v = np.asarray(columns[name])
+        if v.dtype.kind not in "fiu" or v.size == 0:
+            continue
+        v = v.astype(np.float64, copy=False).reshape(-1)
+        if not np.isfinite(v).all():
+            return float("inf")
+        z = abs(float(v.mean()) - ref.mean[i]) / ref.std[i]
+        if best is None or z > best:
+            best = float(z)
+    return best
+
+
 class DataDriftWatchdog:
     """Windowed drift scoring against :class:`ReferenceStats`.
 

@@ -39,6 +39,13 @@ from tpuflow.parallel.dp import (  # noqa: F401
     shard_epoch,
 )
 from tpuflow.parallel.distributed import init_distributed  # noqa: F401
+from tpuflow.parallel.placement import (  # noqa: F401
+    device_count,
+    device_kind,
+    local_devices,
+    place,
+    replica_devices,
+)
 from tpuflow.parallel.ep import moe_forward  # noqa: F401
 from tpuflow.parallel.pp import pipeline_forward  # noqa: F401
 from tpuflow.parallel.ring_attention import (  # noqa: F401

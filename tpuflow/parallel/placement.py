@@ -1,0 +1,79 @@
+"""Device placement seam: the one place that asks jax for devices.
+
+The compat module (``parallel/compat.py``) owns the APIs that move
+across jax releases; this module owns the APIs that move across
+*deployments* — how many devices exist, which one a value should live
+on, what kind of chip is underneath. Serving replica placement
+(``tpuflow/serve_replica.py``), mesh construction, prefetch, and the
+roofline's device-kind probe all route through here, so "where does
+work land" is answered in exactly one file:
+
+- a laptop/CI host can fan a single CPU into N schedulable devices with
+  ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` (the host-side
+  replica recipe, docs/serving.md) and every caller sees them;
+- a future remote/multi-host placement policy changes this module, not
+  a dozen ``jax.devices()`` call sites.
+
+Lint rule TPF013 (``tpuflow/analysis/linter.py``) makes the seam
+executable — the TPF008 compat-seam precedent: a direct
+``jax.devices()`` / ``jax.device_put()`` reference outside
+``tpuflow/parallel/`` fails the self-lint gate instead of scattering
+placement decisions back across the tree.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def local_devices() -> list:
+    """The devices THIS process can dispatch to, in jax's stable order
+    (the order every mesh/replica index refers to)."""
+    return list(jax.devices())
+
+
+def device_count() -> int:
+    """How many devices :func:`local_devices` returns."""
+    return len(local_devices())
+
+
+def device_kind(default: str = "unknown") -> str:
+    """The chip kind of device 0 (roofline peaks are keyed by it);
+    ``default`` when the backend does not say."""
+    devices = local_devices()
+    if not devices:
+        return default
+    return getattr(devices[0], "device_kind", default)
+
+
+def replica_devices(n: int, devices=None) -> list:
+    """The first ``n`` devices, for ``n`` predictor replicas — one
+    replica per device, never oversubscribed. Raises a ValueError that
+    names the available count and the host-side recipe, so a replica
+    count the hardware cannot place fails as configuration advice, not
+    as a runtime crash deep in a device_put."""
+    devices = local_devices() if devices is None else list(devices)
+    if n < 1:
+        raise ValueError(f"replica count must be >= 1, got {n}")
+    if n > len(devices):
+        raise ValueError(
+            f"cannot place {n} replicas on {len(devices)} available "
+            f"device(s); lower the replica count or add devices "
+            "(host-side: XLA_FLAGS=--xla_force_host_platform_device_"
+            f"count={n})"
+        )
+    return devices[:n]
+
+
+def place(tree, device):
+    """Commit a pytree to one device (committed semantics: computation
+    over it runs THERE — the serving-replica placement primitive)."""
+    return jax.device_put(tree, device)
+
+
+def device_put(x, where=None):
+    """``jax.device_put`` through the seam: default device when
+    ``where`` is None, else the given device or sharding."""
+    if where is None:
+        return jax.device_put(x)
+    return jax.device_put(x, where)

@@ -1015,14 +1015,22 @@ class PredictService:
       used one is spilled (cache evicted + its dispatch lane retired;
       ``spills`` counts them) — the next request for it re-loads. 0 =
       unbounded (the single-artifact workloads' historical behavior).
+    - ``replicas=R`` is the multi-replica data plane
+      (``tpuflow/serve_replica.py``; continuous engine only): every
+      successfully loaded artifact becomes a ReplicaSet of R predictor
+      clones placed one-per-device, each with its own dispatch lane;
+      enqueues join the shortest queue. Reload/spill retires ALL of an
+      artifact's replica lanes (zero dropped); a count the devices
+      cannot place fails at construction naming the device count.
 
     Knob resolution: explicit argument > env var (``TPUFLOW_SERVE_BATCH``,
     ``TPUFLOW_SERVE_BATCH_MODE``, ``TPUFLOW_SERVE_MAX_BATCH``,
     ``TPUFLOW_SERVE_MAX_WAIT_MS``, ``TPUFLOW_SERVE_WARMUP``,
-    ``TPUFLOW_SERVE_DONATE``, ``TPUFLOW_SERVE_RESIDENT``) > default
-    (off). Env values are validated at read time — a malformed value
-    raises a ValueError naming the variable and the expected form
-    (:func:`env_num`; the ``TPUFLOW_RETRY_*`` precedent).
+    ``TPUFLOW_SERVE_DONATE``, ``TPUFLOW_SERVE_RESIDENT``,
+    ``TPUFLOW_SERVE_REPLICAS``) > default (off). Env values are
+    validated at read time — a malformed value raises a ValueError
+    naming the variable and the expected form (:func:`env_num`; the
+    ``TPUFLOW_RETRY_*`` precedent).
     """
 
     def __init__(
@@ -1036,6 +1044,7 @@ class PredictService:
         warmup_buckets: int | None = None,
         donate_forward: bool | None = None,
         max_resident: int | None = None,
+        replicas: int | None = None,
         registry=None,
     ):
         from tpuflow.obs import Registry
@@ -1096,6 +1105,11 @@ class PredictService:
             donate_forward = env_flag("TPUFLOW_SERVE_DONATE", False)
         if max_resident is None:
             max_resident = env_num("TPUFLOW_SERVE_RESIDENT", 0, int)
+        if replicas is None:
+            replicas = env_num(
+                "TPUFLOW_SERVE_REPLICAS", 1, int, minimum=1,
+                form="an integer replica count >= 1",
+            )
         self.warmup_buckets = int(warmup_buckets)
         self.donate_forward = bool(donate_forward)
         self.batch_max_rows = int(batch_max_rows)
@@ -1115,24 +1129,75 @@ class PredictService:
             "per-request /predict latency (ms)",
             fn=self._latency.summary,
         )
+        # Replica data plane (tpuflow/serve_replica.py): N predictor
+        # replicas per artifact, placed across devices, one dispatch
+        # lane each, join-shortest-queue at enqueue. Validated EAGERLY
+        # against the engine (replica lanes exist only in the
+        # continuous batcher) and the hardware (a count the devices
+        # cannot place fails here naming the device count — the
+        # analysis pass gives the same diagnostic preflight-style).
+        self.replicas = int(replicas)
+        if self.replicas > 1:
+            if not batch_predicts or batch_mode != "continuous":
+                raise ValueError(
+                    f"replicas={self.replicas} needs the continuous "
+                    "batching engine (replica dispatch lanes); pass "
+                    "batch_predicts=True with batch_mode='continuous' "
+                    "or unset TPUFLOW_SERVE_BATCH/_BATCH_MODE"
+                )
+            from tpuflow.parallel.placement import replica_devices
+
+            replica_devices(self.replicas)  # raises naming the count
         self._batcher = None
         if batch_predicts and batch_mode == "continuous":
             from tpuflow.microbatch import ContinuousBatcher
 
             # Lane bound: at least the residency bound (every resident
-            # artifact must be able to hold a lane), floor 32, operator
-            # override via TPUFLOW_SERVE_MAX_LANES — a deployment with
-            # 40 active artifacts must not shed the last 8 forever.
+            # artifact must be able to hold a lane — times its replica
+            # lanes), floor 32, operator override via
+            # TPUFLOW_SERVE_MAX_LANES — a deployment with 40 active
+            # artifacts must not shed the last 8 forever.
             self._batcher = ContinuousBatcher(
                 self._run_forward,
                 max_batch_rows=self.batch_max_rows,
                 max_lanes=env_num(
                     "TPUFLOW_SERVE_MAX_LANES",
-                    max(32, self.max_resident), int, minimum=1,
+                    max(
+                        32,
+                        self.max_resident * self.replicas,
+                        self.replicas,
+                    ),
+                    int, minimum=1,
                     form="an integer lane bound >= 1",
                 ),
                 registry=self.registry,
             )
+            if self.replicas > 1:
+                # Per-replica observability: resident replica-lane
+                # count plus a dispatch counter labeled by replica
+                # index, fed by the batcher's lane-dispatch hook.
+                self.registry.gauge(
+                    "serve_replica_lanes",
+                    "replica dispatch lanes currently resident "
+                    "(artifact lanes with a replica index)",
+                    fn=self._replica_lane_count,
+                )
+                self._replica_dispatches = self.registry.counter(
+                    "serve_replica_dispatches_total",
+                    "device dispatches completed per replica lane, by "
+                    "replica index",
+                )
+                # Registered HERE (not first-touched by a metrics
+                # scrape or a ReplicaSet) so the family always carries
+                # its help text — the registry is first-registrant-
+                # wins, and an early /metrics scrape must not blank
+                # the HELP line for the life of the process.
+                self._replica_requests = self.registry.counter(
+                    "serve_replica_requests_total",
+                    "requests routed to a replica lane by join-"
+                    "shortest-queue, by replica index",
+                )
+                self._batcher.on_lane_dispatch = self._on_replica_dispatch
         elif batch_predicts:
             from tpuflow.microbatch import MicroBatcher
 
@@ -1193,11 +1258,89 @@ class PredictService:
         self._close_lane(key)
 
     def _close_lane(self, key: tuple[str, str]) -> None:
-        """Retire an evicted artifact's dispatch lane (continuous mode
-        only — the micro-batcher has one shared dispatcher). In-flight
-        entries still drain; a later request reopens the lane."""
-        if self._batcher is not None and hasattr(self._batcher, "close_lane"):
+        """Retire an evicted artifact's dispatch lane(s) (continuous
+        mode only — the micro-batcher has one shared dispatcher).
+        Replica-aware: the artifact key is the PREFIX of its replica
+        lane keys, so one call drains the plain lane and every replica
+        lane alike. In-flight entries still drain — a reload or spill
+        never drops a request; a later request reopens fresh lanes."""
+        if self._batcher is None:
+            return
+        if hasattr(self._batcher, "close_lanes_for"):
+            self._batcher.close_lanes_for(key)
+        elif hasattr(self._batcher, "close_lane"):
             self._batcher.close_lane(key)
+
+    def _replica_lane_count(self) -> int:
+        """Resident replica lanes (keys carrying a replica index) — the
+        ``serve_replica_lanes`` gauge."""
+        if self._batcher is None or not hasattr(self._batcher, "lane_keys"):
+            return 0
+        return sum(1 for k in self._batcher.lane_keys() if len(k) == 3)
+
+    def _on_replica_dispatch(self, key, requests, rows) -> None:
+        """Batcher lane-dispatch hook: count completed dispatches per
+        replica index (plain artifact lanes carry no index and are
+        already counted by the batcher's own families)."""
+        if len(key) == 3:
+            self._replica_dispatches.inc(replica=str(key[2]))
+
+    def _wrap_replicas(self, key: tuple[str, str], loaded):
+        """Wrap a successfully loaded predictor in a ReplicaSet when the
+        service is configured for more than one replica. Degraded
+        fallbacks are never wrapped — physics answers take the
+        unbatched path and replicating them buys nothing."""
+        if self.replicas <= 1 or getattr(loaded, "degraded", False):
+            return loaded
+        from tpuflow.serve_replica import ReplicaSet
+
+        return ReplicaSet(
+            loaded, key, self.replicas, registry=self.registry
+        )
+
+    def select_lane(self, key: tuple, pred) -> tuple[tuple, object]:
+        """The enqueue-time lane decision: a ReplicaSet picks its
+        least-loaded replica lane (join-shortest-queue); a plain
+        predictor keeps its artifact lane. Returns ``(lane_key,
+        predictor_instance)`` — what the batcher is handed."""
+        pick = getattr(pred, "pick_lane", None)
+        if pick is None:
+            return key, pred
+        return pick(self._batcher)
+
+    def replica_metrics(self) -> dict:
+        """The ``replicas`` /metrics section: configured width, lane
+        residency, and the per-replica routing/dispatch/depth split
+        (aggregated across artifacts — replica index i of every
+        resident ReplicaSet shares a label)."""
+        out: dict = {
+            "configured": self.replicas,
+            "policy": "jsq",
+            "lanes": self._replica_lane_count(),
+            "requests_by_replica": {},
+            "dispatches_by_replica": {},
+            "queue_depth_rows": {},
+        }
+        if self.replicas <= 1 or self._batcher is None:
+            return out
+        if hasattr(self._batcher, "lane_stats"):
+            for k, stats in self._batcher.lane_stats().items():
+                if len(k) != 3:
+                    continue
+                r = str(k[2])
+                out["queue_depth_rows"][r] = (
+                    out["queue_depth_rows"].get(r, 0)
+                    + stats["queued_rows"] + stats["inflight_rows"]
+                )
+        for labels in self._replica_requests.labels_seen():
+            out["requests_by_replica"][labels.get("replica", "?")] = int(
+                self._replica_requests.value(**labels)
+            )
+        for labels in self._replica_dispatches.labels_seen():
+            out["dispatches_by_replica"][labels.get("replica", "?")] = (
+                int(self._replica_dispatches.value(**labels))
+            )
+        return out
 
     def _spill_lru_locked(self) -> list[tuple[str, str]]:
         """Evict least-recently-used cache entries past ``max_resident``
@@ -1331,6 +1474,11 @@ class PredictService:
                 for sk in spilled:
                     self._close_lane(sk)
                 return loaded
+            # Replica placement happens BEFORE warmup so every
+            # replica's device gets its executables compiled, and under
+            # the per-key lock so concurrent cold requests build one
+            # ReplicaSet, not R of them.
+            loaded = self._wrap_replicas(key, loaded)
             warmed = 0
             if self.warmup_buckets > 0:
                 # Pre-compile the top pow-2 forward buckets while still
@@ -1491,8 +1639,10 @@ class PredictService:
             else:
                 # The predictor instance rides with the entry so a
                 # retrain mid-flight can't scatter another generation's
-                # predictions to this caller.
-                y = self._batcher.submit(key, pred, x)
+                # predictions to this caller. A ReplicaSet resolves to
+                # its least-loaded replica lane here (JSQ).
+                lane_key, lane_pred = self.select_lane(key, pred)
+                y = self._batcher.submit(lane_key, lane_pred, x)
         else:
             y = self.answer_unbatched(pred, payload)
         return self.finish_response(pred, y)

@@ -56,9 +56,23 @@ bound, default 256), ``TPUFLOW_SERVE_QUOTA_RPS`` /
 ``TPUFLOW_SERVE_QUOTA_BURST`` (per-client token bucket, 0 = off),
 ``TPUFLOW_SERVE_DEADLINE_MS`` (default per-request deadline, 0 = off),
 ``TPUFLOW_SERVE_HEDGE_MS`` (hedged re-dispatch, 0 = off),
-``TPUFLOW_SERVE_PREP_WORKERS`` (executor width), plus the
-``PredictService`` fast-path family (``TPUFLOW_SERVE_BATCH*``,
-``TPUFLOW_SERVE_RESIDENT``...).
+``TPUFLOW_SERVE_PREP_WORKERS`` (executor width),
+``TPUFLOW_SERVE_DRIFT_ADMISSION`` / ``TPUFLOW_SERVE_DRIFT_THRESHOLD``
+(drift-aware admission, below), plus the ``PredictService`` fast-path
+family (``TPUFLOW_SERVE_BATCH*``, ``TPUFLOW_SERVE_RESIDENT``,
+``TPUFLOW_SERVE_REPLICAS``...).
+
+Data plane (ISSUE 12): with ``TPUFLOW_SERVE_REPLICAS=R`` /
+``--replicas R`` the service places R predictor replicas per artifact
+across devices, each with its own dispatch lane, and every enqueue
+joins the shortest queue (``tpuflow/serve_replica.py``;
+docs/serving.md#the-multi-replica-data-plane---replicas). Drift-aware
+admission (``--drift-admission off|flag|shed``) scores request
+features against the artifact sidecar's reference stats at the front
+door: far-out-of-distribution requests are flagged (``X-Drift-Score``
+header + ``serving_drift_admissions_total``) or shed 429 BEFORE they
+occupy a dispatch slot — the online drift watchdog
+(``tpuflow/online/drift.py``) as a front-line defense.
 
 Run: ``python -m tpuflow.serve_async --port 8700`` (or
 ``python -m tpuflow.cli serve``); stop with SIGINT/SIGTERM.
@@ -207,6 +221,12 @@ class _Admission:
         back off' to the client."""
         self._shed.inc(code="503")
 
+    def shed_drift(self) -> None:
+        """A drift-admission shed: 429-class (the CLIENT's data sits
+        outside the artifact's training distribution — retrying the
+        same features buys nothing; the server is fine)."""
+        self._shed.inc(code="429")
+
     def release(self) -> None:
         self.inflight -= 1
 
@@ -244,6 +264,9 @@ class AsyncServer:
         warmup_buckets: int | None = None,
         donate_forward: bool | None = None,
         max_resident: int | None = None,
+        replicas: int | None = None,
+        drift_admission: str | None = None,
+        drift_threshold: float | None = None,
         enable_jobs: bool = True,
         max_queued: int = 64,
         default_timeout: float | None = None,
@@ -285,6 +308,36 @@ class AsyncServer:
             )
         self.deadline_ms = float(deadline_ms)
         self.hedge_ms = float(hedge_ms)
+        # Drift-aware admission (the PR 9 follow-up): score request
+        # features against the artifact sidecar's reference stats at
+        # the front door — BEFORE the request occupies a dispatch slot.
+        # off = never score; flag = X-Drift-Score header + counter on
+        # far-out-of-distribution requests; shed = answer them 429
+        # (caller-side data problem, not server capacity).
+        if drift_admission is None:
+            drift_admission = env_choice(
+                "TPUFLOW_SERVE_DRIFT_ADMISSION", "off",
+                ("off", "flag", "shed"),
+            )
+        if drift_admission not in ("off", "flag", "shed"):
+            raise ValueError(
+                f"drift_admission must be 'off', 'flag' or 'shed', "
+                f"got {drift_admission!r}"
+            )
+        if drift_threshold is None:
+            drift_threshold = env_num(
+                "TPUFLOW_SERVE_DRIFT_THRESHOLD", 6.0, float,
+                minimum=1e-9,
+                form="a positive standardized-shift threshold",
+            )
+        self.drift_admission = drift_admission
+        self.drift_threshold = float(drift_threshold)
+        # Per-artifact reference stats, loaded lazily from the sidecar
+        # on first scored request (None = sidecar has no stats; scoring
+        # is skipped, never guessed). Dropped on /artifacts/reload — a
+        # swapped artifact brings its own baseline.
+        self._drift_refs: dict[tuple, object] = {}
+        self._drift_lock = threading.Lock()
         self._started = time.monotonic()
         # ONE run-scoped registry for the whole daemon (the make_server
         # discipline): admission, batcher, predictor, and job counters
@@ -304,6 +357,7 @@ class AsyncServer:
                     "warmup_buckets": warmup_buckets,
                     "donate_forward": donate_forward,
                     "max_resident": max_resident,
+                    "replicas": replicas,
                 }.items() if v is not None
             )
             if conflicting:
@@ -331,6 +385,7 @@ class AsyncServer:
                 warmup_buckets=warmup_buckets,
                 donate_forward=donate_forward,
                 max_resident=max_resident,
+                replicas=replicas,
                 registry=self.registry,
             )
         self.registry.gauge(
@@ -350,10 +405,16 @@ class AsyncServer:
             "serving_hedge_wins_total", "requests answered by their "
             "hedge dispatch first",
         )
+        self._drift_admissions = self.registry.counter(
+            "serving_drift_admissions_total",
+            "requests whose features scored past the drift-admission "
+            "threshold, by action (flagged = served with X-Drift-Score; "
+            "shed = answered 429 before occupying a dispatch slot)",
+        )
         self.runner = None
         if enable_jobs:
             self.runner = JobRunner(
-                on_artifact_change=self.service.invalidate,
+                on_artifact_change=self._invalidate_artifact,
                 max_queued=max_queued,
                 default_timeout=default_timeout,
                 journal_path=journal_path,
@@ -373,15 +434,73 @@ class AsyncServer:
         self._announce = False  # main() flips it: print URL post-bind
         self._boot_error: BaseException | None = None
 
+    # ---- drift-aware admission ----
+
+    def _invalidate_artifact(self, storage_path: str, name: str) -> None:
+        """An artifact was rewritten (retrain job or reload): drop the
+        cached predictor AND the cached drift baseline — the new
+        artifact brings its own reference stats, and scoring admission
+        against the retired generation's mean/std would shed the wrong
+        requests. One helper so the job path and the /artifacts/reload
+        route cannot drift apart."""
+        self.service.invalidate(storage_path, name)
+        with self._drift_lock:
+            self._drift_refs.pop((storage_path, name), None)
+
+    def _drift_ref(self, key: tuple):
+        """The artifact's reference stats, cached per key. None caches
+        too — but ONLY for an artifact whose sidecar genuinely carries
+        no scoreable stats (the ValueError contract of
+        ``reference_stats_from_sidecar``): a transient read failure
+        (storage blip) must be retried on the next request, not pinned
+        as a silently-disabled gate. Blocking (sidecar read) —
+        executor-thread only."""
+        with self._drift_lock:
+            if key in self._drift_refs:
+                return self._drift_refs[key]
+        try:
+            from tpuflow.online.drift import reference_stats_from_sidecar
+
+            ref = reference_stats_from_sidecar(*key)
+        except (ValueError, KeyError):
+            # No numeric stats / malformed sidecar: deterministic for
+            # this artifact generation — cache the never-score verdict.
+            ref = None
+        except Exception:
+            # Transient (I/O, parse-of-truncated-read): score nothing
+            # THIS time, probe again on the next request.
+            return None
+        with self._drift_lock:
+            self._drift_refs.setdefault(key, ref)
+            return self._drift_refs[key]
+
+    def _drift_score(self, key: tuple, payload) -> float | None:
+        """One request's out-of-distribution score (max standardized
+        mean shift vs the sidecar baseline), or None when unscoreable
+        (CSV-path payloads, artifacts without stats). Host-side numpy,
+        on an executor thread."""
+        kind, value = payload
+        if kind != "columns":
+            return None
+        ref = self._drift_ref(key)
+        if ref is None:
+            return None
+        from tpuflow.online.drift import admission_score
+
+        return admission_score(ref, value)
+
     # ---- request pipeline ----
 
-    async def _predict(self, spec: dict, headers: dict) -> tuple[int, dict]:
+    async def _predict(
+        self, spec: dict, headers: dict
+    ) -> tuple[int, dict, dict]:
         from tpuflow.obs import current_trace_id
 
         svc = self.service
         loop = asyncio.get_running_loop()
         t0 = time.perf_counter()
         trace_id = current_trace_id()
+        out_headers: dict = {}
         deadline_ms = spec.pop("deadlineMs", None)
         if deadline_ms is None:
             deadline_ms = headers.get("x-deadline-ms") or self.deadline_ms
@@ -391,7 +510,7 @@ class AsyncServer:
             return 400, {
                 "error": f"deadlineMs={deadline_ms!r} is not a number",
                 "trace_id": trace_id,
-            }
+            }, out_headers
         deadline = (
             time.monotonic() + deadline_ms / 1000.0 if deadline_ms > 0
             else None
@@ -400,6 +519,36 @@ class AsyncServer:
             key, pred, payload = await loop.run_in_executor(
                 self._pool, svc.begin_request, spec
             )
+            if self.drift_admission != "off" and payload[0] == "columns":
+                # Front-line drift defense: a request whose features
+                # sit far outside what the artifact was trained on is
+                # flagged (header + counter) or shed 429 HERE — it
+                # never occupies a dispatch slot, and in-distribution
+                # traffic never pays more than one numpy mean per
+                # column (executor thread, host-side). Unscoreable
+                # payloads (CSV path) skip even the executor hop.
+                score = await loop.run_in_executor(
+                    self._pool, self._drift_score, key, payload
+                )
+                if score is not None:
+                    out_headers["X-Drift-Score"] = f"{score:.4f}"
+                    if score > self.drift_threshold:
+                        if self.drift_admission == "shed":
+                            self._drift_admissions.inc(action="shed")
+                            self.admission.shed_drift()
+                            return 429, {
+                                "error": (
+                                    f"request features score {score:.2f} "
+                                    "standardized shifts outside the "
+                                    "artifact's training distribution "
+                                    f"(threshold {self.drift_threshold:g})"
+                                    "; shed at admission"
+                                ),
+                                "shed": "drift",
+                                "drift_score": round(score, 4),
+                                "trace_id": trace_id,
+                            }, out_headers
+                        self._drift_admissions.inc(action="flagged")
             if svc.batcher is None or not svc.coalescable(pred):
                 # Degraded (Gilbert) answers and batching-off configs
                 # take the per-request path on an executor thread. The
@@ -431,7 +580,13 @@ class AsyncServer:
                         self._pool, pred.forward_prepared, x
                     )
                 elif hasattr(svc.batcher, "enqueue"):
-                    y = await self._forward_coalesced(key, pred, x, deadline)
+                    # The lane decision (a ReplicaSet resolves to its
+                    # least-loaded replica lane — join-shortest-queue;
+                    # a plain predictor keeps its artifact lane).
+                    lane_key, lane_pred = svc.select_lane(key, pred)
+                    y = await self._forward_coalesced(
+                        lane_key, lane_pred, x, deadline
+                    )
                 else:
                     # Injected micro-engine service (the embedding
                     # path): blocking submit on an executor thread —
@@ -452,25 +607,27 @@ class AsyncServer:
                 out["trace_id"] = trace_id
                 return json.dumps(out).encode()
 
-            return 200, await loop.run_in_executor(self._pool, shape_response)
+            return 200, await loop.run_in_executor(
+                self._pool, shape_response
+            ), out_headers
         except DeadlineExpired as e:
             self.admission.shed_deadline()
             return 504, {
                 "error": str(e), "shed": "deadline", "trace_id": trace_id,
-            }
+            }, out_headers
         except ValueError as e:
-            return 400, {"error": str(e), "trace_id": trace_id}
+            return 400, {"error": str(e), "trace_id": trace_id}, out_headers
         except QueueFull as e:
             # The batcher's bounded queue/lanes: capacity, not caller
             # error — 503 with retry semantics, counted as shed.
             self.admission.shed_queue()
             return 503, {
                 "error": str(e), "shed": "queue", "trace_id": trace_id,
-            }
+            }, out_headers
         except Exception as e:  # missing artifact, bad columns
             return 500, {
                 "error": f"{type(e).__name__}: {e}", "trace_id": trace_id,
-            }
+            }, out_headers
         finally:
             svc.record_latency(time.perf_counter() - t0)
 
@@ -589,14 +746,27 @@ class AsyncServer:
                     method, path, headers, body, writer
                 )
                 status, payload, ctype = res[:3]
+                # Trailing elements: dicts extend the response headers
+                # (X-Drift-Score rides here); callables run post-respond
+                # (admission release rides here).
+                extra_headers: dict = {}
+                hooks = []
+                for item in res[3:]:
+                    if isinstance(item, dict):
+                        extra_headers.update(item)
+                    else:
+                        hooks.append(item)
                 try:
-                    await self._respond(writer, status, payload, ctype, keep)
+                    await self._respond(
+                        writer, status, payload, ctype, keep,
+                        extra_headers=extra_headers,
+                    )
                 finally:
                     # Post-respond hooks (admission release rides here):
                     # the in-flight bound covers the response WRITE too,
                     # so slow readers holding big serialized bodies
                     # still count against max_inflight.
-                    for hook in res[3:]:
+                    for hook in hooks:
                         hook()
                 if not keep:
                     break
@@ -668,15 +838,22 @@ class AsyncServer:
         body = await reader.readexactly(length) if length else b""
         return method, path, headers, body
 
-    async def _respond(self, writer, status, payload, ctype, keep):
+    async def _respond(
+        self, writer, status, payload, ctype, keep, extra_headers=None,
+    ):
         body = (
             payload if isinstance(payload, (bytes, bytearray))
             else json.dumps(payload).encode()
+        )
+        extras = "".join(
+            f"{name}: {value}\r\n"
+            for name, value in (extra_headers or {}).items()
         )
         head = (
             f"HTTP/1.1 {status} {_STATUS_TEXT.get(status, 'OK')}\r\n"
             f"Content-Type: {ctype}\r\n"
             f"Content-Length: {len(body)}\r\n"
+            f"{extras}"
             f"Connection: {'keep-alive' if keep else 'close'}\r\n\r\n"
         )
         writer.write(head.encode("latin-1") + body)
@@ -750,12 +927,17 @@ class AsyncServer:
                 with use_trace(
                     _clean_trace_id(headers.get("x-trace-id"))
                 ):
-                    status, payload = await self._predict(spec, headers)
+                    status, payload, extra = await self._predict(
+                        spec, headers
+                    )
                 # The slot is released AFTER the response is written
                 # (the caller runs trailing hooks post-_respond): the
                 # in-flight bound must also cover a serialized body
                 # parked behind a slow reader.
-                return status, payload, json_ct, self.admission.release
+                return (
+                    status, payload, json_ct, extra,
+                    self.admission.release,
+                )
             except BaseException:
                 self.admission.release()
                 raise
@@ -777,8 +959,11 @@ class AsyncServer:
                     "error": "reload needs storagePath and model"
                 }, json_ct
             loop = asyncio.get_running_loop()
+            # Drops the cached predictor AND the drift baseline (the
+            # swapped artifact carries its own reference stats) — the
+            # same helper the job path's artifact-change hook calls.
             await loop.run_in_executor(
-                self._pool, self.service.invalidate, storage, name
+                self._pool, self._invalidate_artifact, storage, name
             )
             return 200, {
                 "reloaded": True, "storage_path": storage, "model": name,
@@ -851,7 +1036,20 @@ class AsyncServer:
                 "hedge_wins": int(self._hedge_wins.value()),
                 "deadline_ms": self.deadline_ms,
                 "hedge_ms": self.hedge_ms,
+                "drift_admission": self.drift_admission,
+                "drift_threshold": self.drift_threshold,
+                "drift_flagged": int(
+                    self._drift_admissions.value(action="flagged")
+                ),
+                "drift_shed": int(
+                    self._drift_admissions.value(action="shed")
+                ),
             },
+            "replicas": (
+                self.service.replica_metrics()
+                if hasattr(self.service, "replica_metrics")
+                else {}
+            ),
             "uptime_s": round(time.monotonic() - self._started, 1),
         }
         return out
@@ -954,6 +1152,7 @@ def make_async_server(host: str = "127.0.0.1", port: int = 0, **kwargs):
 def main(argv=None) -> int:
     import argparse
     import signal
+    import sys
 
     p = argparse.ArgumentParser(
         prog="tpuflow.serve_async",
@@ -1025,6 +1224,28 @@ def main(argv=None) -> int:
         "spill (default 0 = unbounded; also TPUFLOW_SERVE_RESIDENT)",
     )
     p.add_argument(
+        "--replicas", type=int, default=None, metavar="R",
+        help="predictor replicas per artifact, one per device with its "
+        "own dispatch lane, join-shortest-queue at enqueue (default 1; "
+        "also TPUFLOW_SERVE_REPLICAS; host-side devices via "
+        "XLA_FLAGS=--xla_force_host_platform_device_count=R)",
+    )
+    p.add_argument(
+        "--drift-admission", choices=("off", "flag", "shed"),
+        default=None,
+        help="score request features against the artifact sidecar's "
+        "reference stats at admission (default off; also "
+        "TPUFLOW_SERVE_DRIFT_ADMISSION): flag = X-Drift-Score header + "
+        "counter on far-out-of-distribution requests, shed = answer "
+        "them 429 before they occupy a dispatch slot",
+    )
+    p.add_argument(
+        "--drift-threshold", type=float, default=None, metavar="Z",
+        help="standardized-shift score past which a request counts as "
+        "out-of-distribution (default 6.0; also "
+        "TPUFLOW_SERVE_DRIFT_THRESHOLD)",
+    )
+    p.add_argument(
         "--no-jobs", action="store_false", dest="enable_jobs", default=True,
         help="serve /predict only (no job queue)",
     )
@@ -1033,24 +1254,46 @@ def main(argv=None) -> int:
     p.add_argument("--journal", default=None, metavar="PATH")
     args = p.parse_args(argv)
 
-    server = AsyncServer(
-        args.host, args.port,
-        max_inflight=args.max_inflight,
-        quota_rps=args.quota_rps,
-        quota_burst=args.quota_burst,
-        deadline_ms=args.deadline_ms,
-        hedge_ms=args.hedge_ms,
-        prep_workers=args.prep_workers,
-        batch_predicts=args.batch_predicts,
-        batch_max_rows=args.batch_max_rows,
-        warmup_buckets=args.warmup_buckets,
-        donate_forward=args.donate_forward,
-        max_resident=args.max_resident,
-        enable_jobs=args.enable_jobs,
-        max_queued=args.max_queued,
-        default_timeout=args.default_timeout,
-        journal_path=args.journal,
-    )
+    if args.replicas is not None:
+        # Preflight the replica count against the hardware BEFORE
+        # constructing anything: the diagnostic names the device count
+        # and the host-side recipe (analysis pass; the service performs
+        # the same check at construction for the env-var path).
+        from tpuflow.analysis.plan import check_serve_plan
+
+        diags = check_serve_plan(args.replicas)
+        if diags:
+            for d in diags:
+                print(d.render(), file=sys.stderr)
+            return 2
+
+    try:
+        server = AsyncServer(
+            args.host, args.port,
+            max_inflight=args.max_inflight,
+            quota_rps=args.quota_rps,
+            quota_burst=args.quota_burst,
+            deadline_ms=args.deadline_ms,
+            hedge_ms=args.hedge_ms,
+            prep_workers=args.prep_workers,
+            batch_predicts=args.batch_predicts,
+            batch_max_rows=args.batch_max_rows,
+            warmup_buckets=args.warmup_buckets,
+            donate_forward=args.donate_forward,
+            max_resident=args.max_resident,
+            replicas=args.replicas,
+            drift_admission=args.drift_admission,
+            drift_threshold=args.drift_threshold,
+            enable_jobs=args.enable_jobs,
+            max_queued=args.max_queued,
+            default_timeout=args.default_timeout,
+            journal_path=args.journal,
+        )
+    except ValueError as e:
+        # Configuration-shaped failure (malformed env knob, replica
+        # count the devices cannot place): a message, not a traceback.
+        print(f"tpuflow.serve_async: {e}", file=sys.stderr)
+        return 2
 
     def _stop(signum, frame):
         threading.Thread(target=server.shutdown, daemon=True).start()

@@ -1,0 +1,171 @@
+"""Multi-replica serving data plane: one predictor per device, one
+dispatch lane per replica, join-shortest-queue selection.
+
+The control plane (``tpuflow/serve_async.py``) scaled admission and
+coalescing; the data plane was still ONE predictor on ONE device behind
+one dispatch lane per artifact — the MMLSpark lesson (PAPERS.md) is
+that serving throughput past that point is a replica-placement problem.
+This module is the placement: a :class:`ReplicaSet` wraps a loaded
+:class:`~tpuflow.api.predict_api.Predictor` and places N clones of its
+params across local devices (``parallel/placement.py`` — host-side,
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` fans one CPU
+into N schedulable devices), each clone owning its OWN continuous
+dispatch lane (``microbatch.py``: lane key = artifact key + replica
+index), so N forwards can be in flight at once instead of one.
+
+Placement is jax's committed-arguments semantics doing the work: each
+replica's params are ``device_put`` COMMITTED to its device, so the
+shared jitted forward runs wherever the params live — no per-replica
+model code, no sharding, just N copies of the same artifact pinned to N
+devices.
+
+Lane selection is **join-shortest-queue** over per-lane outstanding
+rows (queued + currently dispatching, ``lane_outstanding``): under load
+the least-busy replica gets the next request; ties rotate so an idle
+set doesn't pile onto replica 0. Every pick increments
+``serve_replica_requests_total{replica=...}`` and publishes each lane's
+observed depth as ``serve_replica_queue_depth_rows{replica=...}`` — the
+balance is visible in ``/metrics``, not asserted.
+
+The batcher's contracts carry over untouched: each replica is a
+distinct predictor INSTANCE, so instance-grouped dispatch, stale-
+scatter protection across a reload, and error scatter all hold
+per-replica for free. A reload or LRU spill retires ALL of an
+artifact's replica lanes (``close_lanes_for`` — the lane keys share the
+artifact key as a prefix) with queued entries draining first: zero
+dropped, the reload-under-replicas drill in
+``tests/test_serve_replica.py``.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+
+
+def clone_to_device(pred, device):
+    """One replica: the same predictor with its params COMMITTED to
+    ``device``. The jitted forward is shared (jit caches per placement);
+    everything host-side (preprocessor, sidecar meta) is shared by
+    reference — only the params move."""
+    params = getattr(pred, "_params", None)
+    if params is None:
+        # Stub predictors (tests) carry no params; a plain copy still
+        # yields the distinct INSTANCE the per-lane contracts need.
+        return copy.copy(pred)
+    from tpuflow.parallel.placement import place
+
+    placed = place(params, device)
+    if dataclasses.is_dataclass(pred):
+        return dataclasses.replace(pred, _params=placed)
+    clone = copy.copy(pred)
+    clone._params = placed
+    return clone
+
+
+class ReplicaSet:
+    """N placed replicas of one artifact, with JSQ lane selection.
+
+    Duck-types the Predictor surface the service's request pipeline
+    touches (``prepare_columns`` / ``columns_from_csv`` /
+    ``forward_prepared`` / ``warmup`` / ``degraded``), so a cached
+    ReplicaSet flows through ``begin_request`` → ``transform_request``
+    unchanged; only the enqueue step asks it to :meth:`pick_lane`.
+    """
+
+    degraded = False  # only successful (non-fallback) loads are wrapped
+
+    def __init__(
+        self, base, key: tuple, n: int, *, devices=None, registry=None,
+        clone=None,
+    ):
+        from tpuflow.parallel.placement import replica_devices
+
+        self.base = base
+        self.key = tuple(key)
+        # Validates n against what the hardware can place (a ValueError
+        # naming the device count and the host-side recipe).
+        devices = replica_devices(n, devices=devices)
+        clone = clone_to_device if clone is None else clone
+        self.replicas = [clone(base, d) for d in devices]
+        self.devices = devices
+        self._rr = 0  # tie-rotation cursor, so an idle set spreads
+        self._requests = self._depth = None
+        if registry is not None:
+            self._requests = registry.counter(
+                "serve_replica_requests_total",
+                "requests routed to a replica lane by join-shortest-"
+                "queue, by replica index",
+            )
+            self._depth = registry.gauge(
+                "serve_replica_queue_depth_rows",
+                "outstanding rows (queued + dispatching) per replica "
+                "lane, as observed at the last lane selection",
+            )
+
+    def __len__(self) -> int:
+        return len(self.replicas)
+
+    def lane_keys(self) -> list[tuple]:
+        """The replica lane keys: artifact key + replica index (the
+        artifact key is the shared prefix ``close_lanes_for`` drains)."""
+        return [self.key + (i,) for i in range(len(self.replicas))]
+
+    def pick_lane(self, batcher) -> tuple[tuple, object]:
+        """Join-shortest-queue: (lane_key, replica) of the lane with the
+        fewest outstanding rows; ties rotate round-robin. All R depths
+        come from ONE ``lane_stats`` snapshot (a single acquisition of
+        the batcher's lock, which the lane threads contend on — this
+        runs on every request's hot path); an absent/idle lane reads as
+        depth 0. Publishes what it saw."""
+        n = len(self.replicas)
+        if hasattr(batcher, "lane_stats"):
+            stats = batcher.lane_stats(self.key)
+            depths = []
+            for i in range(n):
+                s = stats.get(self.key + (i,))
+                depths.append(
+                    s["queued_rows"] + s["inflight_rows"] if s else 0
+                )
+        else:  # depth-only test doubles
+            depths = [
+                batcher.lane_outstanding(self.key + (i,))
+                for i in range(n)
+            ]
+        start = self._rr
+        self._rr = (self._rr + 1) % n
+        best = min(
+            range(n), key=lambda i: (depths[i], (i - start) % n)
+        )
+        if self._requests is not None:
+            self._requests.inc(replica=str(best))
+            for i, d in enumerate(depths):
+                self._depth.set(d, replica=str(i))
+        return self.key + (best,), self.replicas[best]
+
+    # ---- Predictor surface the request pipeline touches ----
+
+    def prepare_columns(self, columns):
+        return self.base.prepare_columns(columns)
+
+    def columns_from_csv(self, path: str):
+        return self.base.columns_from_csv(path)
+
+    def forward_prepared(self, x, batch_size: int = 4096):
+        # The no-rows fast path (and any caller that never picked a
+        # lane) answers from replica 0 — same params, same answer.
+        return self.replicas[0].forward_prepared(x, batch_size)
+
+    def predict_columns(self, columns, **kwargs):
+        return self.replicas[0].predict_columns(columns, **kwargs)
+
+    def warmup(self, top: int = 2, max_rows: int = 4096) -> list[int]:
+        """Warm EVERY replica's forward buckets: each device compiles
+        its own executable, so warming only the base would leave
+        replicas 1..N-1 eating an XLA compile on their first dispatch.
+        Returns one entry per (replica, bucket) — the honest count of
+        compiles done."""
+        warmed: list[int] = []
+        for rep in self.replicas:
+            warmed.extend(rep.warmup(top=top, max_rows=max_rows))
+        return warmed

@@ -159,9 +159,11 @@ def choose_epoch_program(
     if device_kind is None:
         import jax
 
-        device_kind = getattr(
-            jax.devices()[0], "device_kind", jax.default_backend()
+        from tpuflow.parallel.placement import (
+            device_kind as _placed_kind,
         )
+
+        device_kind = _placed_kind(default=jax.default_backend())
     measured = load_measured_crossover(device_kind, compute_dtype)
     dtype_tag = f" [{compute_dtype}]" if compute_dtype else ""
     if measured is not None:

@@ -326,10 +326,12 @@ def fit(
         epoch_step = detector.wrap(epoch_step, "epoch_step")
     # Live MFU context: the chip this run dispatches to (roofline peaks
     # are keyed by device_kind; "cpu" reports honestly as unknown).
-    _device_kind = (
-        getattr(jax.devices()[0], "device_kind", "unknown")
-        if config.roofline else None
-    )
+    if config.roofline:
+        from tpuflow.parallel.placement import device_kind
+
+        _device_kind = device_kind()
+    else:
+        _device_kind = None
 
     # The legacy fault_epoch knob, re-expressed as a registry drill: an
     # exit fault at the train.epoch_end site. Soft (default) commits
