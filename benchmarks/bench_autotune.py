@@ -1,0 +1,274 @@
+"""Autotune A/B: online occupancy tuning vs static configs, from a
+deliberately mis-sized starting batch (ISSUE 13 acceptance).
+
+The drill: a static-MLP job whose SGD runs hot (lr 0.2, momentum 0) so
+small microbatches carry a real gradient-noise floor — the mis-sized
+batch (8) is genuinely bad twice over: ~2x the epoch wall of the knee
+batch AND a noise floor sitting above the target loss, so the static
+b=8 arm takes several-fold the wall-clock to cross it. A ladder of
+static arms (8/16/32/64) races the AUTOTUNED arm, which starts at the
+same mis-sized batch 8 and must discover the knee online
+(``TrainJobConfig.autotune``: pow-2 ladder, hysteresis, recompile
+budget 4). Interleaved laps (static ladder and tuned arm alternate
+per lap) so host drift hits both sides equally.
+
+Scoring: the target loss is ``1.1 x`` the deepest stable validation
+floor any static arm reaches (median of its last 10 epochs — the
+self-calibrating protocol of bench_elastic_async.py); each arm's
+result is the cumulative epoch wall-clock at its FIRST crossing,
+best-of-laps (contention only ever adds wall time — the timeit
+discipline; crossing epochs are seed-deterministic and committed per
+lap so the laps' agreement on the trajectory is inspectable).
+Acceptance asserts the tuned arm crosses within ``1.1 x`` the best
+static arm's wall while staying inside its recompile budget (count
+read back from the controller's own summary, which charges through
+the RecompileDetector), and the config trajectory is committed.
+
+The epoch program is pinned (``jit_epoch=True``): the committed cpu
+sweep already measured ``scan_always`` for this host, so the offline
+prior decides the program and the online tuner spends its budget on
+the knobs the prior cannot see (the batch knee; program toggling is
+exercised by tier-1 drills in tests/test_autotune.py).
+
+``host_only: true`` — CPU wall-clock; the RATIO is the result. The
+bench pins ``--xla_backend_optimization_level=0`` (the test harness's
+own CPU setting): default CPU codegen makes epochs artificially cheap
+relative to XLA compiles (compile:epoch ~9:1 — no accelerator looks
+like that), which would measure the compile bill, not the tuning; the
+unoptimized ratio (~3:1) is the regime a real chip shows. Semantics
+are unchanged and both arms run identical codegen.
+
+Run: ``JAX_PLATFORMS=cpu python -m benchmarks.bench_autotune``
+Writes ``benchmarks/autotune_results.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+if "xla_backend_optimization_level" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_backend_optimization_level=0"
+    ).strip()
+
+sys.path.insert(0, ".")
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+from benchmarks.common import maybe_pin_cpu  # noqa: E402
+
+maybe_pin_cpu()
+
+BAD_BATCH = 8
+STATIC_LADDER = (8, 16, 32, 64)
+LAPS = 3
+MAX_EPOCHS = 80
+RECOMPILE_BUDGET = 4
+ACCEPT_RATIO = 1.10
+
+BASE = dict(
+    model="static_mlp",
+    model_kwargs={"hidden": [64, 64]},
+    loss="mse",
+    # Hot SGD: the noise floor scales with lr/batch, which is what
+    # makes the mis-sized batch statistically bad, not just slow.
+    optimizer_kwargs={"learning_rate": 0.2, "momentum": 0.0},
+    max_epochs=MAX_EPOCHS,
+    patience=1000,
+    seed=0,
+    verbose=False,
+    n_devices=1,
+    synthetic_wells=16,
+    synthetic_steps=2048,
+    jit_epoch=True,  # the measured cpu prior (scan_always) pins it
+)
+
+AUTOTUNE_BLOCK = {
+    "interval": 1,
+    "warmup_epochs": 1,
+    "recompile_budget": RECOMPILE_BUDGET,
+    "tune_remat": False,  # the [64,64] MLP holds no activations worth
+    # rematerializing; spending budget probing it here would only add
+    # noise to the batch story (the remat path is tier-1 tested)
+    "min_batch": min(STATIC_LADDER),
+    "max_batch": max(STATIC_LADDER),
+    "persist": False,  # every lap must rediscover from the bad batch
+}
+
+
+def _run(cache, batch, autotune=None):
+    from tpuflow.api import TrainJobConfig, train
+
+    report = train(
+        TrainJobConfig(**BASE, batch_size=batch, autotune=autotune),
+        _data_cache=cache,
+    )
+    return report
+
+
+def _wall_to_target(history, target):
+    acc = 0.0
+    for e in history:
+        acc += e["time"]
+        if e["val_loss"] <= target:
+            return round(acc, 3), e["epoch"]
+    return None, None
+
+
+def main() -> int:
+    cache: dict = {}
+    static_hist = {b: [] for b in STATIC_LADDER}
+    tuned_laps = []
+    for lap in range(LAPS):
+        for b in STATIC_LADDER:
+            static_hist[b].append(_run(cache, b).result.history)
+        tuned_laps.append(_run(cache, BAD_BATCH, AUTOTUNE_BLOCK))
+        print(f"[bench_autotune] lap {lap + 1}/{LAPS} done", flush=True)
+
+    # Self-calibrating target: 1.1x the deepest stable static floor.
+    floors = {
+        b: min(
+            float(np.median([e["val_loss"] for e in h][-10:]))
+            for h in laps
+        )
+        for b, laps in static_hist.items()
+    }
+    target = round(1.1 * min(floors.values()), 6)
+
+    statics = {}
+    for b, laps in static_hist.items():
+        crossings = [_wall_to_target(h, target) for h in laps]
+        walls = [w for w, _ in crossings if w is not None]
+        # Best-of-laps: host contention only ever ADDS wall time, so
+        # min is the noise-robust estimator (the timeit discipline);
+        # crossing EPOCHS are seed-deterministic and committed so a
+        # reviewer can see the laps agree on the trajectory.
+        statics[b] = {
+            "wall_to_target_s": (
+                round(float(min(walls)), 3) if walls else None
+            ),
+            "crossed_laps": len(walls),
+            "epochs_at_crossing": [ep for _, ep in crossings],
+            "floor": round(floors[b], 6),
+        }
+    crossed = {
+        b: s["wall_to_target_s"] for b, s in statics.items()
+        if s["wall_to_target_s"] is not None
+    }
+    best_static_batch = min(crossed, key=crossed.get)
+    best_static_wall = crossed[best_static_batch]
+
+    tuned_walls, tuned_recs = [], []
+    for rep in tuned_laps:
+        wall, ep = _wall_to_target(rep.result.history, target)
+        at = rep.autotune
+        tuned_walls.append(wall)
+        tuned_recs.append({
+            "wall_to_target_s": wall,
+            "epoch_at_crossing": ep,
+            "best_config": at["best_config"],
+            "frozen": at["frozen"],
+            "recompiles_charged": at["recompiles_charged"],
+            "recompile_budget": at["recompile_budget"],
+            "reverts": at["reverts"],
+            "trajectory": [
+                {k: r[k] for k in
+                 ("epoch", "action", "config", "samples_per_sec")}
+                for r in at["trail"]
+                if r["action"] not in ("measure", "frozen")
+            ],
+        })
+    walls_ok = [w for w in tuned_walls if w is not None]
+    tuned_wall = (
+        round(float(min(walls_ok)), 3) if walls_ok else None
+    )
+    ratio = (
+        round(tuned_wall / best_static_wall, 3)
+        if tuned_wall is not None else None
+    )
+    within_budget = all(
+        r["recompiles_charged"] <= r["recompile_budget"]
+        for r in tuned_recs
+    )
+    ok = (
+        ratio is not None
+        and ratio <= ACCEPT_RATIO
+        and within_budget
+        and len(walls_ok) == LAPS
+    )
+
+    record = {
+        "benchmark": "autotune_ab",
+        "host_only": True,
+        "vs_baseline": None,
+        "note": (
+            "CPU host wall-clock, interleaved laps; the tuned-vs-best-"
+            "static RATIO is the result. Target = 1.1x the deepest "
+            "stable static validation floor; each arm scored at its "
+            "first crossing. The tuned arm starts at the mis-sized "
+            f"batch {BAD_BATCH} and must find the knee online under a "
+            f"recompile budget of {RECOMPILE_BUDGET}."
+        ),
+        "config": {
+            "base": {k: v for k, v in BASE.items()},
+            "autotune": AUTOTUNE_BLOCK,
+            "bad_batch": BAD_BATCH,
+            "static_ladder": list(STATIC_LADDER),
+            "laps": LAPS,
+            "accept_ratio": ACCEPT_RATIO,
+        },
+        "target_val_loss": target,
+        "static": {str(b): s for b, s in statics.items()},
+        "best_static": {
+            "batch_size": best_static_batch,
+            "wall_to_target_s": best_static_wall,
+        },
+        "autotuned": {
+            "wall_to_target_s": tuned_wall,
+            "laps": tuned_recs,
+        },
+        "ratio_vs_best_static": ratio,
+        "within_recompile_budget": within_budget,
+        "accepted": ok,
+    }
+    out = os.path.join(
+        os.path.dirname(__file__), "autotune_results.json"
+    )
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+    print(json.dumps({
+        "config": "autotune_ab",
+        "metric": "wall_to_target_vs_best_static",
+        "value": ratio,
+        "unit": "x",
+        "best_static_batch": best_static_batch,
+        "best_static_wall_s": best_static_wall,
+        "autotuned_wall_s": tuned_wall,
+        "mis_sized_static_wall_s": statics[BAD_BATCH][
+            "wall_to_target_s"
+        ],
+        "recompile_budget": RECOMPILE_BUDGET,
+        "within_recompile_budget": within_budget,
+        "host_only": True,
+    }))
+    if not ok:
+        print(
+            f"[bench_autotune] FAILED acceptance: ratio={ratio} "
+            f"(<= {ACCEPT_RATIO} required), within_budget="
+            f"{within_budget}, tuned crossings {len(walls_ok)}/{LAPS}",
+            flush=True,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,7 +1,7 @@
 """Run the five BASELINE-config benchmarks; write benchmarks/results.json.
 
 Usage: python benchmarks/run_all.py [--quick] [--precision P]
-       [--replicas] [script.py ...]
+       [--replicas] [--autotune] [script.py ...]
 
 ``--replicas`` runs the serving replica-scaling ladder instead of the
 standard sweep: ``bench_serving.py --replicas`` (open-loop Poisson,
@@ -9,6 +9,12 @@ one server per replica count, interleaved per rung, plus the
 drift-admission drill) writing
 ``benchmarks/serving_replica_results.json``; its emitted records still
 merge into results.json like any partial run.
+
+``--autotune`` runs the online-occupancy-tuning A/B instead
+(``bench_autotune.py``: interleaved static-ladder vs autotuned-from-a-
+mis-sized-batch laps, wall-clock-to-target-loss under a recompile
+budget) writing ``benchmarks/autotune_results.json``; its record
+merges the same way.
 
 With script names, only those benchmarks run and their records are
 MERGED into the existing results.json (rows with the same
@@ -39,6 +45,7 @@ SCRIPTS = [
     "bench_gilbert_residual.py",  # physics-informed extension
     "bench_attention.py",  # long-context family: full vs flash backends
     "bench_serving.py",  # HTTP serving: batched vs unbatched /predict
+    "bench_autotune.py",  # online occupancy tuning vs static configs
 ]
 
 
@@ -78,6 +85,13 @@ def main() -> None:
         argv = [a for a in argv if a != "--replicas"]
         if "bench_serving.py" not in argv:
             argv = argv + ["bench_serving.py"]
+    if "--autotune" in argv:
+        # The autotune A/B is its own pass (its committed JSON is
+        # autotune_results.json); selecting it narrows the run to that
+        # script unless others were named explicitly.
+        argv = [a for a in argv if a != "--autotune"]
+        if "bench_autotune.py" not in argv:
+            argv = argv + ["bench_autotune.py"]
     args = [a for a in argv if a != "--quick"]
     if "--quick" in argv:
         base_env.setdefault("BENCH_SECONDS", "2")

@@ -100,6 +100,38 @@ class TestSpecPass:
         assert "spec.window.range" in codes
         assert "spec.patience.range" in codes
 
+    def test_autotune_block_validated(self):
+        diags = validate_spec(TrainJobConfig(
+            model="static_mlp", n_devices=1,
+            autotune={"recompile_budgett": 3, "interval": 0},
+        ))
+        msgs = [d.message for d in diags if d.code == "spec.autotune.invalid"]
+        assert any("recompile_budgett" in m for m in msgs)
+        assert any("interval" in m for m in msgs)
+        # A clean block on a clean single-chip job: no findings.
+        assert _errors(validate_spec(TrainJobConfig(
+            model="static_mlp", n_devices=1, autotune={},
+        ))) == []
+
+    def test_autotune_conflicts_are_submission_errors(self):
+        codes = _codes(validate_spec(TrainJobConfig(
+            model="static_mlp", stream=True, data_path="x.csv",
+            n_devices=1, autotune={},
+        )))
+        assert "spec.autotune.stream" in codes
+        codes = _codes(validate_spec(TrainJobConfig(
+            model="moe_mlp", ep=2, n_devices=4, autotune={},
+        )))
+        assert "spec.autotune.model_axis" in codes
+        assert "spec.autotune.n_devices" in codes
+        # Unset n_devices: a warning (runtime rejects on multi-device
+        # hosts), not an error — single-device hosts are fine.
+        diags = validate_spec(TrainJobConfig(
+            model="static_mlp", autotune={},
+        ))
+        (d,) = [d for d in diags if d.code == "spec.autotune.n_devices"]
+        assert d.severity == "warning"
+
 
 class TestPlanPass:
     def test_clean_dp_plan(self):
@@ -597,6 +629,97 @@ class TestLinter:
 
             def info(arr):
                 return jax.device_count(), arr.devices()
+        """) == []
+
+    def test_tpf014_jit_in_loop_bodies_flagged(self, tmp_path):
+        """TPF014: a fresh jitted callable per loop iteration re-compiles
+        every pass and the RecompileDetector (which wraps named step fns
+        once) cannot attribute the churn."""
+        diags = self._lint_source(tmp_path, """
+            import jax
+
+            def run(batches, state, step):
+                for x, y in batches:
+                    state, _ = jax.jit(step)(state, x, y)
+                while not done():
+                    f = pjit(step)
+        """)
+        assert _codes(diags) == ["TPF014", "TPF014"]
+        assert "jax.jit" in diags[0].message
+        assert "pjit" in diags[1].message
+
+    def test_tpf014_factory_calls_and_outside_loops_clean(self, tmp_path):
+        # Building steps ONCE (the factory pattern) and calling the
+        # built function in the loop is the blessed shape; a nested def
+        # inside the loop defers to ITS callers (TPF007 rationale).
+        assert self._lint_source(tmp_path, """
+            import jax
+
+            def run(batches, state, step):
+                jitted = jax.jit(step)
+                for x, y in batches:
+                    state, _ = jitted(state, x, y)
+                for _ in range(2):
+                    def factory(fn):
+                        return jax.jit(fn)
+        """) == []
+
+    def test_tpf014_exempt_in_the_steps_seam(self, tmp_path):
+        # Path-scoped like TPF008/TPF012/TPF013: train/steps.py and the
+        # autotuner's step cache own the sanctioned jit sites.
+        import textwrap
+
+        d = tmp_path / "tpuflow" / "train"
+        d.mkdir(parents=True)
+        f = d / "steps.py"
+        f.write_text(textwrap.dedent("""
+            import jax
+
+            def warm(fns):
+                for fn in fns:
+                    jax.jit(fn)
+        """))
+        assert lint_file(str(f)) == []
+
+    def test_tpf014_async_for_covered(self, tmp_path):
+        # The async serving paths are where per-message re-jit churn is
+        # most likely; `async for` bodies must not escape the rule.
+        diags = self._lint_source(tmp_path, """
+            import jax
+
+            async def pump(stream, step):
+                async for batch in stream:
+                    jax.jit(step)(batch)
+        """)
+        assert _codes(diags) == ["TPF014"]
+
+    def test_tpf014_iterable_expression_not_flagged(self, tmp_path):
+        # A for-loop's ITERABLE evaluates once when the iterator is
+        # built — a jit call there is the factory pattern, not churn.
+        # A while-loop's TEST re-evaluates every pass, so it IS churn.
+        assert self._lint_source(tmp_path, """
+            import jax
+
+            def run(make_fn, data):
+                for x in jax.jit(make_fn)(data):
+                    handle(x)
+        """) == []
+        diags = self._lint_source(tmp_path, """
+            import jax
+
+            def run(step, state):
+                while jax.jit(step)(state):
+                    state = advance(state)
+        """)
+        assert _codes(diags) == ["TPF014"]
+
+    def test_tpf014_noqa_suppression(self, tmp_path):
+        assert self._lint_source(tmp_path, """
+            import jax
+
+            def warm(fns):
+                for fn in fns:
+                    jax.jit(fn)  # noqa: TPF014
         """) == []
 
     def _lint_online_source(self, tmp_path, source):

@@ -189,3 +189,496 @@ class TestCommittedSweepEntries:
             1024, device_kind="TPU v5 lite", compute_dtype="f32"
         )
         assert c.source == "heuristic"
+
+
+# ======================================================================
+# The ONLINE occupancy autotuner (ISSUE 13): block/env validation, the
+# controller state machine, persistence, and the end-to-end train()
+# integration including the freeze drill.
+# ======================================================================
+
+from tpuflow.train.autotune import (  # noqa: E402
+    AUTOTUNE_DEFAULTS,
+    OccupancyAutotuner,
+    TuningPoint,
+    load_tuned,
+    resolve_autotune,
+    save_tuned,
+    validate_autotune_block,
+)
+
+
+class _FakeDetector:
+    """Just enough RecompileDetector surface for unit drills: a count
+    the budget reads and the expect() tag hook."""
+
+    def __init__(self):
+        self.count = 0
+        self.expected = []
+
+    def expect(self, reason):
+        self.expected.append(reason)
+
+
+def _mk_tuner(start=None, *, n_train=4096, detector=None, **cfg):
+    from tpuflow.obs.metrics import Registry
+
+    tuner = OccupancyAutotuner(
+        {**{"interval": 1, "warmup_epochs": 0, "persist": False}, **cfg},
+        start or TuningPoint(32, False, True),
+        n_train_rows=n_train,
+        verbose=False,
+    )
+    tuner.bind(detector=detector or _FakeDetector(),
+               registry=Registry(namespace="t"))
+    return tuner
+
+
+def _drive(tuner, sps_of, epochs, *, compiles_per_move=1):
+    """Run the controller against a synthetic throughput landscape:
+    each epoch's samples/sec is ``sps_of(point, epoch)``; every applied
+    move bumps the fake detector's compile count like the real jit
+    would."""
+    det = tuner._detector
+    for epoch in range(1, epochs + 1):
+        sps = float(sps_of(tuner.current, epoch))
+        moved = tuner.observe_epoch(
+            epoch, samples=int(sps), train_time=1.0
+        )
+        if moved is not None and tuner._await_charge:
+            det.count += compiles_per_move
+    return tuner
+
+
+class TestAutotuneBlockValidation:
+    def test_empty_block_is_valid_defaults(self, monkeypatch):
+        # Isolate the env family: a developer's exported
+        # TPUFLOW_AUTOTUNE_* knob must not fail this equality.
+        import os
+
+        for var in list(os.environ):
+            if var.startswith("TPUFLOW_AUTOTUNE"):
+                monkeypatch.delenv(var, raising=False)
+        assert validate_autotune_block({}) == []
+        assert resolve_autotune({}) == AUTOTUNE_DEFAULTS
+
+    def test_non_dict_rejected(self):
+        (msg,) = validate_autotune_block("yes")
+        assert "dict" in msg
+
+    def test_unknown_keys_named(self):
+        (msg,) = validate_autotune_block({"budgett": 3})
+        assert "budgett" in msg and "recompile_budget" in msg
+
+    def test_type_and_range_findings(self):
+        msgs = validate_autotune_block({
+            "interval": 0, "recompile_budget": "many",
+            "hysteresis": 1.5, "tune_batch": "on",
+            "min_batch": 64, "max_batch": 8,
+        })
+        text = "\n".join(msgs)
+        assert "interval" in text
+        assert "recompile_budget" in text
+        assert "hysteresis" in text
+        assert "tune_batch" in text
+        assert "min_batch 64 exceeds" in text
+
+    def test_resolve_raises_naming_every_problem(self):
+        with pytest.raises(ValueError) as e:
+            resolve_autotune({"interval": 0, "hysteresis": 2})
+        assert "interval" in str(e.value) and "hysteresis" in str(e.value)
+
+
+class TestAutotuneEnvKnobs:
+    """TPUFLOW_AUTOTUNE_* supply defaults for keys the block leaves
+    unset, validated at read through utils/env.py (the TPUFLOW_SERVE_*
+    / TPUFLOW_ELASTIC_* precedent)."""
+
+    def test_env_supplies_defaults_block_wins(self, monkeypatch):
+        monkeypatch.setenv("TPUFLOW_AUTOTUNE_RECOMPILE_BUDGET", "3")
+        monkeypatch.setenv("TPUFLOW_AUTOTUNE_HYSTERESIS", "0.2")
+        monkeypatch.setenv("TPUFLOW_AUTOTUNE_TUNE_REMAT", "off")
+        resolved = resolve_autotune({})
+        assert resolved["recompile_budget"] == 3
+        assert resolved["hysteresis"] == 0.2
+        assert resolved["tune_remat"] is False
+        # An explicit block value always wins over the env default.
+        assert resolve_autotune(
+            {"recompile_budget": 9}
+        )["recompile_budget"] == 9
+
+    @pytest.mark.parametrize("var,value", [
+        ("TPUFLOW_AUTOTUNE_INTERVAL", "zero"),
+        ("TPUFLOW_AUTOTUNE_INTERVAL", "0"),
+        ("TPUFLOW_AUTOTUNE_WARMUP_EPOCHS", "-1"),
+        ("TPUFLOW_AUTOTUNE_RECOMPILE_BUDGET", "3.5x"),
+        ("TPUFLOW_AUTOTUNE_HYSTERESIS", "nan"),
+        ("TPUFLOW_AUTOTUNE_HYSTERESIS", "1.5"),
+        ("TPUFLOW_AUTOTUNE_MIN_BATCH", "0"),
+        ("TPUFLOW_AUTOTUNE_TUNE_BATCH", "ture"),
+        ("TPUFLOW_AUTOTUNE_PERSIST", "2"),
+    ])
+    def test_malformed_env_values_name_the_variable(
+        self, monkeypatch, var, value
+    ):
+        monkeypatch.setenv(var, value)
+        with pytest.raises(ValueError) as e:
+            resolve_autotune({})
+        assert var in str(e.value)
+
+
+class TestTuningGeometry:
+    def test_batch_ladder_bounds_and_pow2(self):
+        t = _mk_tuner(TuningPoint(32, False, True),
+                      min_batch=8, max_batch=128, batch_ladder=2)
+        assert t._batch_ok(64) and t._batch_ok(128) and t._batch_ok(16)
+        assert not t._batch_ok(256)   # above max_batch
+        assert not t._batch_ok(4)     # below min_batch
+        assert not t._batch_ok(48)    # not on the pow-2 ladder
+        t2 = _mk_tuner(TuningPoint(32, False, True), batch_ladder=1)
+        assert t2._batch_ok(64) and not t2._batch_ok(128)  # ladder cap
+
+    def test_start_clamped_to_train_rows(self):
+        t = _mk_tuner(TuningPoint(4096, False, True), n_train=100)
+        assert t.current.batch_size == 100
+
+    def test_neighbors_honor_knob_flags(self):
+        t = _mk_tuner(TuningPoint(32, False, True),
+                      tune_batch=False, tune_remat=False)
+        assert {p.key for p in t._neighbors(t.current)} == {
+            "b32-noremat-perbatch"
+        }
+        t2 = _mk_tuner(TuningPoint(32, False, True), tune_program=False)
+        keys = {p.key for p in t2._neighbors(t2.current)}
+        assert "b32-noremat-perbatch" not in keys
+        assert {"b64-noremat-scan", "b16-noremat-scan",
+                "b32-remat-scan"} == keys
+
+
+class TestControllerStateMachine:
+    def test_hill_climb_adopts_the_faster_batch(self):
+        # Throughput rises with batch up to 128 then falls: the climb
+        # must settle on 128 and freeze there.
+        curve = {16: 50, 32: 100, 64: 200, 128: 400, 256: 300}
+        t = _mk_tuner(TuningPoint(32, False, True),
+                      recompile_budget=8, tune_remat=False,
+                      tune_program=False)
+        _drive(t, lambda p, e: curve[p.batch_size], 30)
+        assert t.best.batch_size == 128
+        assert t.frozen
+        actions = [r["action"] for r in t.trail]
+        assert "adopt" in actions and "explore" in actions
+
+    def test_hysteresis_no_flip_flop_on_noisy_gauges(self):
+        # A flat landscape with ±3% alternating noise under a 5%
+        # hysteresis bar: no neighbor may ever be adopted, and once the
+        # neighborhood is exhausted the tuner freezes on the start
+        # point instead of oscillating forever.
+        def noisy(point, epoch):
+            return 100.0 * (1.03 if epoch % 2 else 0.97)
+
+        t = _mk_tuner(TuningPoint(32, False, True),
+                      hysteresis=0.05, recompile_budget=100)
+        _drive(t, noisy, 60)
+        actions = [r["action"] for r in t.trail]
+        assert "adopt" not in actions
+        assert t.best.key == "b32-noremat-scan"
+        assert t.frozen  # exhausted, not thrashing
+        # Every exploration was reverted — the flip-flop count is
+        # bounded by the neighborhood size, not the epoch count.
+        assert actions.count("explore") == actions.count("revert")
+        assert actions.count("explore") <= 4
+
+    def test_budget_exhaustion_freezes_on_best_seen(self):
+        curve = {16: 50, 32: 100, 64: 200, 128: 400}
+        t = _mk_tuner(TuningPoint(32, False, True),
+                      recompile_budget=1, tune_remat=False,
+                      tune_program=False)
+        _drive(t, lambda p, e: curve[p.batch_size], 20)
+        assert t.frozen and t.spent >= 1
+        # One move was affordable: best-seen is the explored b64, and
+        # the tuner sits ON best-seen after the freeze.
+        assert t.best.batch_size == 64
+        assert t.current == t.best
+        # Frozen means frozen: no further moves however long we run.
+        before = t.spent
+        moved = [
+            t.observe_epoch(100 + i, samples=1000, train_time=1.0)
+            for i in range(5)
+        ]
+        assert moved == [None] * 5 and t.spent == before
+
+    def test_revert_on_regression_returns_to_best(self):
+        curve = {16: 20, 32: 100, 64: 30}
+        t = _mk_tuner(TuningPoint(32, False, True),
+                      recompile_budget=8, tune_remat=False,
+                      tune_program=False)
+        _drive(t, lambda p, e: curve[p.batch_size], 20)
+        assert t.best.batch_size == 32
+        assert t.reverts >= 2  # both ladder moves regressed
+        revert = next(r for r in t.trail if r["action"] == "revert")
+        assert revert["budget_remaining"] is not None
+
+    def test_reverts_and_freeze_cost_no_budget(self):
+        # Charges come ONLY from explorations: with 2 neighbors
+        # explored (both reverted) the spend is exactly 2 even though
+        # the trail holds 2 reverts and a freeze.
+        curve = {16: 20, 32: 100, 64: 30}
+        det = _FakeDetector()
+        t = _mk_tuner(TuningPoint(32, False, True), detector=det,
+                      recompile_budget=8, tune_remat=False,
+                      tune_program=False)
+        _drive(t, lambda p, e: curve[p.batch_size], 20)
+        assert t.frozen and t.spent == 2
+
+    def test_detector_delta_charges_more_than_one(self):
+        # A move that triggers TWO observed recompiles (e.g. train and
+        # a late epoch program) is charged at the detector's delta.
+        curve = {16: 20, 32: 100, 64: 30}
+        t = _mk_tuner(TuningPoint(32, False, True),
+                      recompile_budget=8, tune_remat=False,
+                      tune_program=False)
+        _drive(t, lambda p, e: curve[p.batch_size], 20,
+               compiles_per_move=2)
+        assert t.spent == 4
+
+    def test_warmup_epochs_discard_compile_noise(self):
+        # With warmup=1, the first epoch after a move (the compile one,
+        # here 10x slower) is discarded — the neighbor's honest speed
+        # decides, so the better batch is still adopted.
+        seen_since_move = {"n": 0}
+
+        def sps(point, epoch):
+            seen_since_move["n"] += 1
+            base = {8: 30, 16: 50, 32: 100, 64: 200, 128: 400,
+                    256: 350, 512: 300}[point.batch_size]
+            return base / (10.0 if seen_since_move["n"] == 1 else 1.0)
+
+        t = _mk_tuner(TuningPoint(32, False, True), warmup_epochs=1,
+                      recompile_budget=8, tune_remat=False,
+                      tune_program=False)
+        det = t._detector
+        for epoch in range(1, 31):
+            moved = t.observe_epoch(
+                epoch,
+                samples=int(sps(t.current, epoch)),
+                train_time=1.0,
+            )
+            if moved is not None:
+                seen_since_move["n"] = 0
+                if t._await_charge:
+                    det.count += 1
+        assert t.best.batch_size == 128
+
+
+class TestTunedPersistence:
+    def test_save_load_roundtrip(self, tmp_path):
+        p = TuningPoint(64, True, False)
+        save_tuned(str(tmp_path), "lstm", "TPU v5 lite", "bf16", p,
+                   throughput=123.4, frozen=True, epoch=7)
+        got = load_tuned(str(tmp_path), "lstm", "TPU v5 lite", "bf16")
+        assert got == p
+
+    def test_dtype_keys_are_independent(self, tmp_path):
+        f32 = TuningPoint(64, False, True)
+        bf16 = TuningPoint(256, False, True)
+        save_tuned(str(tmp_path), "m", "chip", "f32", f32,
+                   throughput=1, frozen=True, epoch=1)
+        save_tuned(str(tmp_path), "m", "chip", "bf16", bf16,
+                   throughput=2, frozen=True, epoch=1)
+        assert load_tuned(str(tmp_path), "m", "chip", "f32") == f32
+        assert load_tuned(str(tmp_path), "m", "chip", "bf16") == bf16
+        # No wildcard: an untuned dtype (or device) resumes untuned.
+        assert load_tuned(str(tmp_path), "m", "chip", "f16") is None
+        assert load_tuned(str(tmp_path), "m", "other", "f32") is None
+
+    def test_uri_storage_round_trip(self):
+        """A remote storage_path (gs://-class; memory:// in tests) must
+        persist and resume through the URI-aware path layer — a local
+        os-path write would silently land in cwd and never be read."""
+        pytest.importorskip("fsspec")
+        root = "memory://autotune-bkt/run"
+        p = TuningPoint(64, True, False)
+        save_tuned(root, "lstm", "chip", "bf16", p,
+                   throughput=1.0, frozen=True, epoch=3)
+        q = TuningPoint(8, False, True)
+        save_tuned(root, "lstm", "chip", "f32", q,
+                   throughput=2.0, frozen=False, epoch=4)
+        assert load_tuned(root, "lstm", "chip", "bf16") == p
+        assert load_tuned(root, "lstm", "chip", "f32") == q
+
+    def test_corrupt_or_missing_file_is_none(self, tmp_path):
+        assert load_tuned(str(tmp_path), "m", "chip", "f32") is None
+        meta = tmp_path / "meta"
+        meta.mkdir()
+        (meta / "m.autotune.json").write_text("{not json")
+        assert load_tuned(str(tmp_path), "m", "chip", "f32") is None
+
+
+class TestAutotunedTrain:
+    """End-to-end: train(config) with the autotune block."""
+
+    def _config(self, tmp_path, **kw):
+        from tpuflow.api.config import TrainJobConfig
+
+        fields = dict(
+            model="static_mlp", model_kwargs={"hidden": [8]},
+            max_epochs=10, batch_size=8, seed=0, verbose=False,
+            n_devices=1, synthetic_wells=2, synthetic_steps=64,
+            storage_path=str(tmp_path),
+            autotune={
+                "interval": 1, "warmup_epochs": 1,
+                "recompile_budget": 2,
+            },
+        )
+        fields.update(kw)
+        return TrainJobConfig(**fields)
+
+    def test_report_summary_and_persistence(self, tmp_path):
+        from tpuflow.api import train
+        from tpuflow.train.autotune import tuned_config_path
+
+        report = train(self._config(tmp_path))
+        at = report.autotune
+        assert at is not None and at["decisions"] > 0
+        assert at["recompiles_charged"] <= at["recompile_budget"]
+        assert "Autotune:" in report.summary()
+        assert json.load(open(
+            tuned_config_path(str(tmp_path), "static_mlp")
+        ))
+
+    def test_freeze_drill_zero_recompiles_after_budget(self, tmp_path):
+        """The acceptance drill: once the budget is spent the tuner
+        freezes — every xla.compile span in the run's trail lands at or
+        before the freeze epoch; nothing compiles after it."""
+        from tpuflow.api import train
+        from tpuflow.obs.trail import read_events
+
+        trail = str(tmp_path / "metrics.jsonl")
+        report = train(self._config(
+            tmp_path, max_epochs=14, metrics_path=trail,
+        ))
+        at = report.autotune
+        assert at["frozen"] is True
+        assert at["recompiles_charged"] <= at["recompile_budget"]
+        events, _ = read_events(trail)
+        freezes = [e for e in events if e.get("event") == "autotune_freeze"]
+        assert len(freezes) == 1
+        freeze_epoch = freezes[0]["epoch"]
+        compiles = [
+            e for e in events
+            if e.get("event") == "span" and e.get("name") == "xla.compile"
+        ]
+        assert all(e["epoch"] <= freeze_epoch for e in compiles)
+        # And the run kept training well past the freeze.
+        assert report.result.epochs_ran > freeze_epoch
+
+    def test_resume_starts_from_the_persisted_winner(self, tmp_path):
+        from tpuflow.api import train
+
+        r1 = train(self._config(tmp_path))
+        r2 = train(self._config(tmp_path))
+        assert r2.autotune["start"] == r1.autotune["best"]
+        assert r2.autotune["prior"].startswith("autotuned:")
+        assert "resumed persisted tuned config" in r2.epoch_program_reason
+
+    def test_remat_start_keeps_variant_names_distinct(self, tmp_path):
+        """A run that STARTS at a persisted remat point must wrap its
+        seeded steps under the '@remat' name: a shared 'train_step'
+        signature set would swallow the remat-off variant's first
+        compile and leak the armed expect() tag onto a later unrelated
+        recompile."""
+        from tpuflow.api import train
+        from tpuflow.obs.trail import read_events
+        from tpuflow.train.autotune import save_tuned
+
+        save_tuned(
+            str(tmp_path), "static_mlp", "cpu", "f32",
+            TuningPoint(8, True, True),
+            throughput=1.0, frozen=False, epoch=1,
+        )
+        trail = str(tmp_path / "metrics.jsonl")
+        report = train(self._config(
+            tmp_path, metrics_path=trail,
+            autotune={"interval": 1, "warmup_epochs": 0,
+                      "recompile_budget": 2, "tune_remat": False},
+        ))
+        assert report.autotune["start"]["remat"] is True
+        events, _ = read_events(trail)
+        compiled_steps = {
+            e["step"] for e in events
+            if e.get("event") == "span" and e.get("name") == "xla.compile"
+        }
+        # The tuner moved batch at least once at remat=True: the
+        # charged compile is attributed to the remat-suffixed variant.
+        assert any(s.endswith("@remat") for s in compiled_steps), (
+            compiled_steps
+        )
+
+    def test_bf16_and_f32_tune_independently(self, tmp_path):
+        """Dtype-keyed persistence (the PR 10 program-sweep precedent):
+        an f32 winner never seeds a bf16 run."""
+        from tpuflow.api import train
+        from tpuflow.train.autotune import tuned_config_path
+
+        train(self._config(tmp_path))
+        r_bf16 = train(self._config(tmp_path, precision="bf16"))
+        # Fresh exploration, not a resume of the f32 entry.
+        assert not r_bf16.autotune["prior"].startswith("autotuned:")
+        doc = json.load(open(
+            tuned_config_path(str(tmp_path), "static_mlp")
+        ))
+        assert "cpu@f32" in doc and "cpu@bf16" in doc
+
+    def test_explicit_program_pin_disables_program_tuning(self, tmp_path):
+        from tpuflow.api import train
+
+        report = train(self._config(
+            tmp_path, jit_epoch=False,
+            autotune={"interval": 1, "warmup_epochs": 0,
+                      "recompile_budget": 6, "persist": False},
+        ))
+        assert report.epoch_program == "per_batch"
+        assert all(
+            "scan" not in key
+            for key in report.autotune["configs_measured"]
+        )
+
+    def test_autotune_conflicts_rejected_at_submission(self, tmp_path):
+        from tpuflow.analysis import PreflightError
+        from tpuflow.api import train
+
+        with pytest.raises(PreflightError) as e:
+            train(self._config(
+                tmp_path, model="moe_mlp", ep=2, n_devices=2,
+            ))
+        msg = str(e.value)
+        assert "spec.autotune" in msg
+
+
+class TestAutotuneSupervisedRestart:
+    def test_restart_resumes_tuned(self, tmp_path):
+        """A supervised restart (fault-killed child, resume=True
+        relaunch) begins at the tuned point its predecessor persisted —
+        the warm-restart story end to end."""
+        from tpuflow.train.supervisor import supervise
+
+        spec = {
+            "model": "static_mlp", "model_kwargs": {"hidden": [8]},
+            "max_epochs": 10, "batch_size": 8, "seed": 0,
+            "n_devices": 1, "synthetic_wells": 2, "synthetic_steps": 64,
+            "storage_path": str(tmp_path), "save_every": 1,
+            "autotune": {"interval": 1, "warmup_epochs": 0,
+                         "recompile_budget": 1},
+            # Kill the child AFTER the tiny budget has certainly frozen
+            # (one explore + decision epochs) and the winner persisted.
+            "fault_epoch": 6,
+        }
+        run = supervise(
+            spec, max_restarts=1, backoff_base=0.05, backoff_max=0.1,
+            verbose=False,
+        )
+        assert run.attempts == 2
+        at = run.report.get("autotune")
+        assert at is not None
+        assert at["prior"].startswith("autotuned:")

@@ -387,6 +387,51 @@ class TestTimelineExport:
                 names.add(e["name"])
         assert {"ingest", "step", "eval", "checkpoint"} <= names
 
+    def test_autotune_spans_get_their_own_lane(self, tmp_path):
+        """The occupancy autotuner's trajectory is visible in the
+        trace: autotune.step spans ride their own lane, and the
+        freeze/revert instant marks land in that lane (not train's) so
+        a tuned run's compile cost (the xla lane) lines up with the
+        decision that bought it."""
+        from tpuflow.obs.timeline import to_trace_events
+
+        events = [
+            {"event": "span", "name": "step", "time": 10.0,
+             "duration_s": 1.0, "epoch": 1},
+            {"event": "span", "name": "autotune.step", "time": 10.1,
+             "duration_s": 1.0, "epoch": 1, "action": "explore",
+             "config": "b16-noremat-scan"},
+            {"event": "autotune_revert", "time": 11.0, "epoch": 2,
+             "from_config": "b16-noremat-scan", "to": "b8-noremat-scan"},
+            {"event": "span", "name": "xla.compile", "time": 10.8,
+             "duration_s": 0.3, "epoch": 2, "expected": "autotune"},
+            {"event": "autotune_freeze", "time": 12.0, "epoch": 3,
+             "reason": "recompile budget spent"},
+        ]
+        doc = to_trace_events(events)
+        evs = doc["traceEvents"]
+        lanes = {
+            e["tid"]: e["args"]["name"]
+            for e in evs if e["ph"] == "M"
+        }
+        assert "autotune" in lanes.values()
+        (at_tid,) = [t for t, n in lanes.items() if n == "autotune"]
+        at_span = next(
+            e for e in evs if e.get("name") == "autotune.step"
+        )
+        assert at_span["tid"] == at_tid
+        assert at_span["args"]["action"] == "explore"
+        marks = [e for e in evs if e["ph"] == "i"]
+        assert {m["name"] for m in marks} == {
+            "autotune_revert", "autotune_freeze"
+        }
+        assert all(m["tid"] == at_tid for m in marks)
+        # The tuner-bought compile stays in the xla lane, time-aligned.
+        compile_span = next(
+            e for e in evs if e.get("name") == "xla.compile"
+        )
+        assert lanes[compile_span["tid"]] == "xla"
+
     def test_empty_trail_yields_empty_document(self, tmp_path):
         from tpuflow.obs.timeline import export_timeline
 

@@ -131,6 +131,15 @@ RULES = {
               "future multi-host policy change ONE module, not every "
               "scattered call site; use local_devices()/place()/"
               "device_put() from the seam",
+    "TPF014": "direct jax.jit / pjit call inside a loop body outside "
+              "the autotune/steps seam: every call builds a FRESH "
+              "jitted callable whose compile cache dies with it — the "
+              "loop re-jits (and re-compiles) every iteration, churn "
+              "the RecompileDetector cannot attribute because the new "
+              "callable was never wrapped. Build steps ONCE through "
+              "the factories in tpuflow/train/steps.py (or the "
+              "autotuner's memoized step cache in train/loop.py) and "
+              "call the built function in the loop",
 }
 
 _HOST_SYNC_NAMES = {"float", "bool"}
@@ -243,6 +252,22 @@ _SOCKET_MODULES = ("socket", "socketserver", "http.client")
 _PLACEMENT_OWNED_JAX_ATTRS = {"devices", "device_put", "local_devices"}
 _PLACEMENT_DIR_FRAGMENT = "tpuflow/parallel/"
 
+# TPF014: the modules allowed to create jitted callables inside a loop
+# — the step-factory seam (train/steps.py holds THE jit call sites for
+# training; train/autotune.py + train/loop.py own the tuner's memoized
+# variant cache, whose whole point is that a revisited config reuses
+# the SAME callable). Everywhere else, a jit/pjit call lexically inside
+# a for/while body is re-jit churn: each iteration's fresh callable
+# compiles from scratch and the RecompileDetector (which wraps named
+# step fns once) cannot attribute the cost. Nested function defs are
+# exempt — their callers own the calling context (TPF007 rationale).
+_JIT_SEAM_SUFFIXES = (
+    "train/steps.py",
+    "train/autotune.py",
+    "train/loop.py",
+)
+_JIT_CALL_NAMES = {"jit", "pjit"}
+
 # TPF010: scope and trigger. The rule fires only in the online package
 # (the one place a per-window device sync stalls a live ingest loop);
 # a "streaming-window consumer loop" is a for-loop whose ITERABLE
@@ -271,6 +296,7 @@ class _Linter(ast.NodeVisitor):
         self._is_placement_layer = _PLACEMENT_DIR_FRAGMENT in norm
         self._is_online = _ONLINE_PATH_FRAGMENT in norm
         self._socket_allowed = norm.endswith(_SOCKET_ALLOWED_SUFFIXES)
+        self._jit_seam = norm.endswith(_JIT_SEAM_SUFFIXES)
 
     def run(self) -> list[Diagnostic]:
         self.visit(self.tree)
@@ -356,7 +382,51 @@ class _Linter(ast.NodeVisitor):
     def visit_For(self, node) -> None:
         self._check_step_aux_loop(node)
         self._check_online_consumer_loop(node)
+        self._check_loop_jit(node)
         self.generic_visit(node)
+
+    # --- TPF014: jit/pjit calls inside loop bodies ---
+
+    @staticmethod
+    def _walk_loop_level(node):
+        """One loop's per-iteration code: the body (and orelse), plus
+        the test for ``while`` loops (re-evaluated every pass) — but
+        NOT a ``for`` loop's iterable, which evaluates exactly once
+        when the iterator is built (a jit call there is the factory
+        pattern, not churn). Nested loops are skipped (they get their
+        own visit — descending would double-report), as are nested
+        function defs (a def's body runs when CALLED; a loop-defined
+        jitted factory is owned by its callers)."""
+        stack = list(node.body) + list(node.orelse)
+        if isinstance(node, ast.While):
+            stack.append(node.test)
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (
+                ast.For, ast.AsyncFor, ast.While, ast.FunctionDef,
+                ast.AsyncFunctionDef, ast.Lambda,
+            )):
+                continue
+            yield sub
+            stack.extend(ast.iter_child_nodes(sub))
+
+    def _check_loop_jit(self, node) -> None:
+        if self._jit_seam:
+            return
+        for sub in self._walk_loop_level(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            func = sub.func
+            name = (
+                func.id if isinstance(func, ast.Name)
+                else func.attr if isinstance(func, ast.Attribute)
+                else None
+            )
+            if name in _JIT_CALL_NAMES:
+                self._emit(
+                    "TPF014", sub,
+                    f"{ast.unparse(func)}(...) inside a loop body",
+                )
 
     # --- TPF010: device calls in online streaming consumer loops ---
 
@@ -428,6 +498,13 @@ class _Linter(ast.NodeVisitor):
 
     def visit_While(self, node) -> None:
         self._check_unbounded_poll(node)
+        self._check_loop_jit(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFor(self, node) -> None:
+        # The async serving paths are where per-message re-jit churn is
+        # most likely — TPF014 covers them like any other loop.
+        self._check_loop_jit(node)
         self.generic_visit(node)
 
     def _check_unbounded_poll(self, node: ast.While) -> None:

@@ -345,6 +345,61 @@ def _check_elastic(config) -> list[Diagnostic]:
     return out
 
 
+def _check_autotune(config) -> list[Diagnostic]:
+    block = getattr(config, "autotune", None)
+    if block is None:
+        return []
+    from tpuflow.train.autotune import validate_autotune_block
+
+    out = [
+        _diag("spec.autotune.invalid", msg, where="autotune")
+        for msg in validate_autotune_block(block)
+    ]
+    # The online tuner drives the DEFAULT single-chip step programs:
+    # combinations that inject their own steps (or bake the microbatch
+    # into an iterator) are rejected at submission with the same
+    # reasons train() raises at runtime.
+    if config.stream:
+        out.append(_diag(
+            "spec.autotune.stream",
+            "autotune resizes the microbatch between epochs; "
+            "stream=True bakes it into the per-epoch iterators",
+            where="stream",
+        ))
+    for axis in ("tp", "pp", "ep"):
+        if getattr(config, axis, 1) > 1:
+            out.append(_diag(
+                "spec.autotune.model_axis",
+                f"autotune drives the default single-chip steps; "
+                f"{axis}={getattr(config, axis)} injects its own step "
+                "programs",
+                where=axis,
+            ))
+    if config.elastic is not None:
+        out.append(_diag(
+            "spec.autotune.elastic",
+            "autotune is per-run; elastic gang workers must keep one "
+            "shard shape for averaging",
+            where="elastic",
+        ))
+    if config.n_devices is not None and config.n_devices > 1:
+        out.append(_diag(
+            "spec.autotune.n_devices",
+            f"autotune drives the single-chip default steps; "
+            f"n_devices={config.n_devices} (set n_devices=1)",
+            where="n_devices",
+        ))
+    elif config.n_devices is None:
+        out.append(_diag(
+            "spec.autotune.n_devices", severity="warning",
+            message="autotune with n_devices unset defaults to ALL "
+            "visible devices and will be rejected at runtime on a "
+            "multi-device host; set n_devices=1",
+            where="n_devices",
+        ))
+    return out
+
+
 def _check_online(config) -> list[Diagnostic]:
     out = []
     ws = getattr(config, "warm_start", None)
@@ -395,7 +450,8 @@ def validate_spec(config) -> list[Diagnostic]:
     for check in (
         _check_registries, _check_schema, _check_scalars,
         _check_windowing, _check_stream, _check_storage, _check_health,
-        _check_precision, _check_faults, _check_elastic, _check_online,
+        _check_precision, _check_faults, _check_elastic,
+        _check_autotune, _check_online,
     ):
         try:
             out += check(config)

@@ -124,6 +124,23 @@ class TrainJobConfig:
     # spec pass. {} enables the loop with defaults.
     online: dict | None = None
 
+    # --- online occupancy autotuning (tpuflow/train/autotune.py) ---
+    # When set (a dict; {} enables defaults — CLI --autotune, env flag
+    # TPUFLOW_AUTOTUNE), a post-epoch controller hill-climbs the
+    # microbatch size (pow-2 ladder), remat on/off, and the
+    # scan-vs-per-batch epoch program from each epoch's measured
+    # throughput and the live MFU/HBM gauges, charging every move
+    # against an explicit recompile budget (RecompileDetector) and
+    # FREEZING on the best-seen config when the budget is spent. The
+    # winning point is persisted next to the serving sidecar (keyed by
+    # device@precision) so restarted/warm-started runs resume tuned.
+    # Knobs and defaults in tpuflow/train/autotune.py
+    # (AUTOTUNE_DEFAULTS); every knob has a TPUFLOW_AUTOTUNE_* env
+    # spelling. Spec-validated; single-chip default-step runs only
+    # (stream/tp/pp/ep/elastic/multi-device are rejected at
+    # submission).
+    autotune: dict | None = None
+
     # --- observability ---
     trace_dir: str | None = None  # jax.profiler trace of the first epoch
     metrics_path: str | None = None  # per-epoch JSONL metrics file

@@ -71,6 +71,8 @@ class TrainReport:
     # both surfaced in summary() as preflight-style diagnostics.
     anomalies: list = field(default_factory=list)
     recompiles: dict | None = None
+    # Occupancy-autotuner summary (train/autotune.py; None = not tuned).
+    autotune: dict | None = None
 
     def summary(self) -> str:
         lines = [
@@ -81,6 +83,15 @@ class TrainReport:
         ]
         if self.epoch_program:
             lines.append(f"Epoch program: {self.epoch_program}")
+        if self.autotune:
+            at = self.autotune
+            state = "frozen" if at.get("frozen") else "tuning"
+            lines.append(
+                f"Autotune: {at.get('best_config')} ({state}; "
+                f"{at.get('recompiles_charged')} recompile(s) of budget "
+                f"{at.get('recompile_budget')}, {at.get('reverts')} "
+                "revert(s))"
+            )
         if self.gilbert_mae is not None:
             beat = "beats" if self.test_mae <= self.gilbert_mae else "trails"
             lines.append(
@@ -638,6 +649,57 @@ def _train_impl(
         )
     n_dev = config.n_devices or jax.device_count()
     _validate_model_axis(config, jit_epoch, n_dev)
+
+    # --- online occupancy autotuner (tpuflow/train/autotune.py) ---
+    # The block (or the TPUFLOW_AUTOTUNE env flag) is resolved BEFORE
+    # data preparation so a malformed knob or an unsupported
+    # combination dies in milliseconds, not after an hours-long ingest
+    # (the _validate_model_axis discipline); the controller itself is
+    # built after prep — its batch ladder is bounded by the
+    # training-row count.
+    autotune_block = config.autotune
+    if autotune_block is None:
+        from tpuflow.utils.env import env_flag
+
+        if env_flag("TPUFLOW_AUTOTUNE", False):
+            autotune_block = {}
+    autotune_cfg = None
+    if autotune_block is not None:
+        from tpuflow.train.autotune import resolve_autotune
+
+        autotune_cfg = resolve_autotune(autotune_block)
+        conflict = None
+        if config.stream:
+            conflict = (
+                "stream=True (the stream bakes the microbatch into its "
+                "per-epoch iterators)"
+            )
+        elif config.tp > 1 or config.pp > 1 or config.ep > 1:
+            conflict = (
+                "a model axis (tp/pp/ep inject their own step programs)"
+            )
+        elif config.elastic is not None:
+            conflict = (
+                "elastic membership (gang workers must keep one shard "
+                "shape for averaging)"
+            )
+        elif jax.process_count() > 1:
+            conflict = "a multi-host runtime"
+        elif n_dev > 1:
+            conflict = (
+                f"n_devices={n_dev} (the tuner drives the single-chip "
+                "default steps; set n_devices=1)"
+            )
+        if conflict:
+            raise ValueError(
+                f"autotune is not supported with {conflict}; the online "
+                "occupancy tuner drives the default single-chip train "
+                "path (docs/performance.md)"
+            )
+        if config.jit_epoch is not None:
+            # An explicitly pinned epoch program is a user decision,
+            # not a knob: the tuner honors it and tunes the rest.
+            autotune_cfg = {**autotune_cfg, "tune_program": False}
     # (model_kwargs JSON-serializability under storage_path is enforced
     # by train()'s preflight spec pass — tpuflow/analysis/spec.py
     # _check_storage, which reuses _sidecar_kwargs — before we get here.)
@@ -752,6 +814,58 @@ def _train_impl(
         finally:
             ws.close()
         state = apply_params(state, warm)
+
+    # --- the occupancy-autotuner controller (single-chip path only;
+    # the conflicts above already rejected everything else) ---
+    tuner = None
+    if autotune_cfg is not None:
+        from tpuflow.parallel.placement import (
+            device_kind as _placed_kind,
+        )
+        from tpuflow.train.autotune import (
+            OccupancyAutotuner,
+            TuningPoint,
+            load_tuned,
+        )
+
+        _kind = _placed_kind(default=jax.default_backend())
+        start = None
+        if autotune_cfg["persist"] and config.storage_path:
+            start = load_tuned(
+                config.storage_path, config.model, _kind,
+                config.precision,
+            )
+        if start is not None:
+            # Resume tuned: a supervised restart or warm-started run
+            # begins at the persisted winner instead of re-exploring
+            # (dtype-keyed — a bf16 winner never seeds an f32 run).
+            if config.jit_epoch is not None:
+                start = TuningPoint(
+                    start.batch_size, start.remat, bool(config.jit_epoch)
+                )
+            program = ProgramChoice(
+                start.jit_epoch,
+                f"resumed persisted tuned config {start.key} for "
+                f"{_kind!r}@{config.precision}",
+                "autotuned",
+            )
+            jit_epoch = program.jit_epoch
+        else:
+            start = TuningPoint(config.batch_size, False, jit_epoch)
+        tuner = OccupancyAutotuner(
+            autotune_cfg,
+            start,
+            n_train_rows=int(train_ds.n),
+            n_devices=1,
+            device_kind=_kind,
+            compute_dtype=config.precision,
+            storage_path=config.storage_path,
+            model_name=config.model,
+            # The offline measured crossover decides the STARTING
+            # program — the prior the tuner climbs from, not a verdict.
+            prior=f"{program.source}: {program.reason}",
+            verbose=config.verbose,
+        )
 
     # --- parallelism: DP over the mesh when >1 device; DP x TP when
     # config.tp > 1 (GSPMD megatron layout, parallel/tp_train.py) ---
@@ -910,7 +1024,12 @@ def _train_impl(
     # --- fit (the reference's hot loop, cnn.py:126-129) ---
     fit_cfg = FitConfig(
         max_epochs=config.max_epochs,
-        batch_size=config.batch_size,
+        # A resumed tuned point starts the run at the persisted winner;
+        # the tuner keeps climbing (or holds) from there.
+        batch_size=(
+            tuner.current.batch_size if tuner is not None
+            else config.batch_size
+        ),
         patience=config.patience,
         seed=config.seed,
         loss=loss_fn,
@@ -931,6 +1050,7 @@ def _train_impl(
         roofline=roofline_cfg,
         compute_dtype=step_dtype,
         sync_fn=elastic_client.sync if elastic_client is not None else None,
+        autotune=tuner,
     )
     if elastic_client is not None:
         # Register with the gang: heartbeat thread + (for a fresh late
@@ -1030,6 +1150,7 @@ def _train_impl(
         epoch_program_reason=f"{program.source}: {program.reason}",
         anomalies=result.anomalies,
         recompiles=result.recompiles,
+        autotune=result.autotune,
     )
     if config.verbose:
         print(report.summary())

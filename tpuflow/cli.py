@@ -106,6 +106,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "checkpoints, and serving artifacts stay f32 — "
                         "roughly half the HBM bytes/sample on the "
                         "HBM-bound train path")
+    p.add_argument("--autotune", action="store_true",
+                   help="online occupancy autotuning "
+                        "(tpuflow/train/autotune.py): a post-epoch "
+                        "controller hill-climbs microbatch size, remat, "
+                        "and the epoch program from live throughput/MFU "
+                        "gauges under a recompile budget, freezing on "
+                        "the best-seen config when the budget is spent; "
+                        "the winner persists next to the artifact so "
+                        "restarts resume tuned (knobs via "
+                        "TPUFLOW_AUTOTUNE_*; docs/performance.md)")
+    p.add_argument("--autotune-budget", type=int, default=None,
+                   metavar="N",
+                   help="with --autotune: recompile budget (default "
+                        "8) — the tuner freezes after charging N "
+                        "XLA recompiles against its moves")
     p.add_argument("--quiet", action="store_true")
     p.add_argument("--preflight", action="store_true", default=True,
                    dest="preflight",
@@ -243,6 +258,27 @@ def main(argv=None) -> int:
             )
             return 2
 
+    autotune_block = None
+    autotune_on = args.autotune
+    if not autotune_on and args.autotune_budget is not None:
+        # The env spelling of the switch counts too: TPUFLOW_AUTOTUNE=1
+        # plus --autotune-budget is a legitimate combination (train_api
+        # enables the tuner from the flag either way).
+        from tpuflow.utils.env import env_flag
+
+        autotune_on = env_flag("TPUFLOW_AUTOTUNE", False)
+        if not autotune_on:
+            print(
+                "--autotune-budget needs --autotune (or TPUFLOW_AUTOTUNE"
+                "=1); the budget gates the online tuner's moves",
+                file=sys.stderr,
+            )
+            return 2
+    if autotune_on:
+        autotune_block = {}
+        if args.autotune_budget is not None:
+            autotune_block["recompile_budget"] = args.autotune_budget
+
     config = TrainJobConfig(
         column_names=args.columnNames,
         column_types=args.columnTypes,
@@ -281,6 +317,7 @@ def main(argv=None) -> int:
         trace_dir=args.trace_dir,
         metrics_path=args.metrics,
         health=args.health,
+        autotune=autotune_block,
     )
     if args.preflight:
         # Preflight-by-default: the whole job is statically analyzed —

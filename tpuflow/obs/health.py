@@ -320,6 +320,7 @@ class RecompileDetector:
         self.epoch = 0
         self.logger = logger
         self._signatures: dict[str, set] = {}
+        self._expected: str | None = None
         self._gauge = (registry or default_registry()).gauge(
             "train_recompiles",
             "XLA recompilations observed by the current run (signature "
@@ -327,7 +328,27 @@ class RecompileDetector:
         )
         self._gauge.set(0.0)
 
-    def wrap(self, fn, name: str):
+    @property
+    def count(self) -> int:
+        """Recompiles observed so far — the occupancy autotuner's
+        budget reads the delta of this across its moves."""
+        return len(self.events)
+
+    def expect(self, reason: str | None) -> None:
+        """Tag the NEXT recompile event as expected (``reason``, e.g.
+        "autotune"): a compile the controller deliberately paid for is
+        budget accounting, not the steady-state shape churn
+        :meth:`summary` diagnoses. One-shot — consumed by the next
+        event, replaced by the next call."""
+        self._expected = reason
+
+    def wrap(self, fn, name: str, count_first: bool = False):
+        """``count_first=True`` records even the FIRST compile of this
+        step name as an event: step variants the autotuner builds
+        mid-run (a remat toggle, a late scan program) are recompiles of
+        the RUN even though they are compile #1 of their wrapper —
+        without this their cost would be invisible to the budget and
+        the timeline."""
         if fn is None:
             return None
         seen = self._signatures.setdefault(name, set())
@@ -340,13 +361,16 @@ class RecompileDetector:
             seen.add(sig)
             t0 = time.perf_counter()
             out = fn(*args, **kwargs)
-            if not first:
+            if not first or count_first:
                 dur = time.perf_counter() - t0
                 event = {
                     "epoch": self.epoch,
                     "step": name,
                     "signature": repr(sig),
                 }
+                if self._expected is not None:
+                    event["expected"] = self._expected
+                    self._expected = None
                 self.events.append(event)
                 self._gauge.set(float(len(self.events)))
                 record_span(
@@ -364,10 +388,19 @@ class RecompileDetector:
         programs)."""
         if not self.events:
             return None
-        steady = [e for e in self.events if e["epoch"] > steady_after]
+        # Expected compiles (the autotuner's budgeted moves) are charged
+        # and visible in the trail, but they are not shape CHURN — the
+        # diagnostic exists for recompiles nobody asked for.
+        steady = [
+            e for e in self.events
+            if e["epoch"] > steady_after and not e.get("expected")
+        ]
         rec = {
             "recompiles": len(self.events),
             "steady_state": len(steady),
+            "expected": sum(
+                1 for e in self.events if e.get("expected")
+            ),
             "by_step": sorted({e["step"] for e in self.events}),
             "last_signature": self.events[-1]["signature"],
         }

@@ -272,6 +272,17 @@ class Registry:
             check=(None if fn is None else lambda fam: fam._fn is fn),
         )
 
+    def peek(self, name: str) -> _Family | None:
+        """Read an existing family WITHOUT creating it: consumers of
+        someone else's signal (the occupancy autotuner reading the live
+        roofline gauges) must not register an empty family under the
+        producer's name — get-or-create would pin an empty-help stub as
+        the first registrant and misreport honest absence (an unknown
+        chip publishes no MFU gauge at all) as a zero."""
+        full = f"{self.namespace}_{name}" if self.namespace else name
+        with self._lock:
+            return self._families.get(full)
+
     def collect(self) -> list[_Family]:
         with self._lock:
             return sorted(self._families.values(), key=lambda f: f.name)

@@ -33,12 +33,14 @@ _LANES = (
     ("predict", 2, "serving"),
     ("serve", 2, "serving"),
     ("xla", 3, "xla"),
+    ("autotune", 4, "autotune"),
 )
 _TRAIN_TID, _OTHER_TID = 1, 9
+_AUTOTUNE_TID = 4
 _TRAIN_NAMES = {"ingest", "step", "eval", "checkpoint"}
 _INSTANT_EVENTS = {
     "numerics_anomaly", "lr_halved", "fault_injected", "forensics_dump",
-    "supervisor_attempt_died",
+    "supervisor_attempt_died", "autotune_freeze", "autotune_revert",
 }
 _PID = 1
 
@@ -119,11 +121,17 @@ def to_trace_events(events: list[dict]) -> dict:
     for rec in instants:
         # Marks follow their subject: a fault injected at a serving
         # site must line up with the dispatch spans it interrupted,
-        # not sit in the train lane.
+        # not sit in the train lane — and the tuner's freeze/revert
+        # marks sit in the autotune lane with the autotune.step spans
+        # whose trajectory they punctuate.
+        name = str(rec.get("event", ""))
         site = str(rec.get("site", ""))
-        tid, lane = (
-            _lane(site) if site else (_TRAIN_TID, "train")
-        )
+        if name.startswith("autotune"):
+            tid, lane = _AUTOTUNE_TID, "autotune"
+        else:
+            tid, lane = (
+                _lane(site) if site else (_TRAIN_TID, "train")
+            )
         if lane == "other":
             tid, lane = _TRAIN_TID, "train"
         lanes_used.setdefault(tid, lane)

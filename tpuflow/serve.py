@@ -195,6 +195,9 @@ def report_to_dict(report) -> dict:
     recompiles = getattr(report, "recompiles", None)
     if recompiles:
         out["recompiles"] = dict(recompiles)
+    autotune = getattr(report, "autotune", None)
+    if autotune:
+        out["autotune"] = dict(autotune)
     return _json_finite(out)
 
 

@@ -1,40 +1,53 @@
-"""Epoch-program auto-selection: measured sweep data over static defaults.
+"""Occupancy autotuning: offline program selection + the online tuner.
 
-The fit loop has two epoch programs (tpuflow/train/loop.py): per-batch
-stepping (one XLA dispatch per minibatch) and ``jit_epoch`` (the whole
-epoch scanned into one compiled program). Which one is faster is a
-per-backend measurement, not a guess: on the relay-attached TPU a single
-dispatch costs ~700us of round-trip, so the scanned program wins at
-EVERY batch measured (round 5, transfer-drained timing: 9.36M samples/s
-scanned vs 1.47M per-batch at B=1024 — round 3's contrary 17.7M
-per-batch reading was a sync artifact of ``block_until_ready`` on the
-relay backend, see BENCHLOG.md). On other backends the ordering can
-differ, so ``train(config)`` resolves ``jit_epoch=None`` ("auto")
-through :func:`choose_epoch_program` from recorded sweeps instead of a
-static default.
+Two layers share this module:
 
-The decision source, in order:
+**Offline prior** — the fit loop has two epoch programs
+(tpuflow/train/loop.py): per-batch stepping (one XLA dispatch per
+minibatch) and ``jit_epoch`` (the whole epoch scanned into one compiled
+program). Which one is faster is a per-backend measurement, not a
+guess: on the relay-attached TPU a single dispatch costs ~700us of
+round-trip, so the scanned program wins at EVERY batch measured (round
+5, transfer-drained timing: 9.36M samples/s scanned vs 1.47M per-batch
+at B=1024 — round 3's contrary 17.7M per-batch reading was a sync
+artifact of ``block_until_ready`` on the relay backend, see
+BENCHLOG.md). ``train(config)`` resolves ``jit_epoch=None`` ("auto")
+through :func:`choose_epoch_program` from recorded sweeps
+(``benchmarks/program_sweep.json``) with constraint and heuristic
+fallbacks; the choice is reported on ``TrainReport.epoch_program``.
 
-1. **Constraints** — streaming ingest, tensor parallelism, and multi-host
-   runs require per-batch stepping (the scanned program would defeat
-   bounded-memory streaming / isn't wired for the TP GSPMD step).
-2. **Measured sweep** — ``benchmarks/sweep_epoch_program.py`` races both
-   programs over a batch-size grid on the CURRENT backend and records
-   the crossover to ``benchmarks/program_sweep.json``; when that file
-   exists and matches the running device kind, its crossover decides.
-   (Override the location with ``TPUFLOW_PROGRAM_SWEEP``.)
-3. **Heuristic fallback** — no measurement for this device: scan the
-   epoch when ``batch_size < 256`` (the dispatch-bound regime on every
-   backend measured so far), step per-batch otherwise.
+**Online controller** — :class:`OccupancyAutotuner` closes the loop
+*during* a run (ROADMAP item 2): a post-epoch, host-side controller in
+the NumericsWatchdog mold that reads each epoch's wall-time/throughput
+plus the live ``train_mfu``/``train_hbm_util``/``train_bound`` gauges,
+and hill-climbs the knobs that move them — microbatch size (a pow-2
+ladder around the starting batch), remat on/off (``jax.checkpoint`` on
+the step's apply — trade recompute FLOPs for HBM residency), and the
+scan-vs-per-batch epoch program. Every move is a known XLA recompile,
+charged against an explicit **recompile budget** through the
+RecompileDetector (``tpuflow/obs/health.py``); when the budget is
+spent the tuner FREEZES on the best-seen configuration — it converges
+instead of churning compiles. Adoption requires a hysteresis margin so
+noisy gauges never flip-flop the config, a regressing move is reverted
+(reverts revisit already-compiled programs, so they cost zero
+recompiles), and the winning point is persisted next to the serving
+sidecar (``{storage}/meta/{model}.autotune.json``, keyed by
+``device_kind@precision`` — bf16 and f32 runs tune independently) so
+warm-started and supervised-restart runs resume tuned. The offline
+measured crossover above is the controller's *prior* (it seeds the
+starting program), not its verdict.
 
-The choice is reported on ``TrainReport.epoch_program`` so a job's
-program is observable, and tested by ``tests/test_autotune.py``.
+Configured by the spec-validated ``TrainJobConfig.autotune`` block
+(CLI ``--autotune``; every knob has a ``TPUFLOW_AUTOTUNE_*`` env
+spelling validated through ``tpuflow/utils/env.py``). Tested by
+``tests/test_autotune.py``.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import statistics
 from dataclasses import dataclass
 
 # Batch sizes below this are dispatch-bound: the scanned epoch program
@@ -189,3 +202,653 @@ def choose_epoch_program(
         "benchmarks/sweep_epoch_program.py)",
         "heuristic",
     )
+
+
+# --- the online occupancy autotuner --------------------------------------
+
+# Per-knob defaults for the ``autotune`` config block. Kept import-light
+# (no jax): the preflight spec pass validates blocks without touching a
+# device. Every key has a ``TPUFLOW_AUTOTUNE_<KEY>`` env spelling that
+# supplies the default when the block leaves it unset (the
+# TPUFLOW_ELASTIC_* precedent); an explicit block value always wins.
+AUTOTUNE_DEFAULTS: dict = {
+    "interval": 1,          # epochs measured per config before a decision
+    "warmup_epochs": 1,     # post-move epochs discarded (compile noise)
+    "recompile_budget": 8,  # tuner-attributed recompiles before freeze
+    "hysteresis": 0.05,     # relative throughput gain a move must clear
+    "tune_batch": True,     # walk the pow-2 microbatch ladder
+    "tune_remat": True,     # toggle remat (jax.checkpoint on the step)
+    "tune_program": True,   # toggle scan-vs-per-batch epoch program
+    "min_batch": 1,         # ladder floor (also clamped to n_devices)
+    "max_batch": 4096,      # ladder ceiling (also clamped to n_train)
+    "batch_ladder": 6,      # max pow-2 steps away from the start batch
+    "persist": True,        # write the tuned point next to the sidecar
+}
+
+_AUTOTUNE_FLAG_KEYS = (
+    "tune_batch", "tune_remat", "tune_program", "persist",
+)
+_AUTOTUNE_INT_KEYS = {
+    # key -> minimum
+    "interval": 1,
+    "warmup_epochs": 0,
+    "recompile_budget": 0,
+    "min_batch": 1,
+    "max_batch": 1,
+    "batch_ladder": 0,
+}
+
+
+def validate_autotune_block(block) -> list[str]:
+    """Every problem with an ``autotune`` config block, as messages
+    (empty = valid). Never raises — the preflight spec pass reports all
+    findings at once; :func:`resolve_autotune` turns them into the
+    fail-loud raise for runtime callers."""
+    if not isinstance(block, dict):
+        return [
+            f"autotune must be a dict config block (or {{}} for "
+            f"defaults), got {type(block).__name__}"
+        ]
+    out = []
+    unknown = sorted(set(block) - set(AUTOTUNE_DEFAULTS))
+    if unknown:
+        out.append(
+            f"unknown autotune key(s) {unknown}; known: "
+            f"{sorted(AUTOTUNE_DEFAULTS)}"
+        )
+    for key, minimum in _AUTOTUNE_INT_KEYS.items():
+        if key not in block:
+            continue
+        value = block[key]
+        if isinstance(value, bool) or not isinstance(value, int):
+            out.append(
+                f"autotune.{key} must be an integer >= {minimum}, got "
+                f"{value!r}"
+            )
+        elif value < minimum:
+            out.append(
+                f"autotune.{key} must be >= {minimum}, got {value}"
+            )
+    if "hysteresis" in block:
+        h = block["hysteresis"]
+        if isinstance(h, bool) or not isinstance(h, (int, float)):
+            out.append(
+                f"autotune.hysteresis must be a number >= 0, got {h!r}"
+            )
+        elif not (0 <= float(h) < 1):
+            out.append(
+                f"autotune.hysteresis must be in [0, 1), got {h}"
+            )
+    for key in _AUTOTUNE_FLAG_KEYS:
+        if key in block and not isinstance(block[key], bool):
+            out.append(
+                f"autotune.{key} must be a boolean, got {block[key]!r}"
+            )
+    lo = block.get("min_batch", AUTOTUNE_DEFAULTS["min_batch"])
+    hi = block.get("max_batch", AUTOTUNE_DEFAULTS["max_batch"])
+    if (
+        isinstance(lo, int) and isinstance(hi, int)
+        and not isinstance(lo, bool) and not isinstance(hi, bool)
+        and lo > hi
+    ):
+        out.append(
+            f"autotune.min_batch {lo} exceeds autotune.max_batch {hi}"
+        )
+    return out
+
+
+def _env_knobs() -> dict:
+    """The ``TPUFLOW_AUTOTUNE_*`` env family, validated at read time
+    through tpuflow/utils/env.py (a malformed value raises naming the
+    variable and the expected form). Returns only the keys the
+    environment actually sets — spec-block values win over these."""
+    from tpuflow.utils.env import env_flag, env_num
+
+    out: dict = {}
+    for key, minimum in _AUTOTUNE_INT_KEYS.items():
+        var = f"TPUFLOW_AUTOTUNE_{key.upper()}"
+        value = env_num(var, None, int, minimum=minimum)
+        if value is not None:
+            out[key] = int(value)
+    hyst = env_num(
+        "TPUFLOW_AUTOTUNE_HYSTERESIS", None, float, minimum=0,
+        form="a number in [0, 1)",
+    )
+    if hyst is not None:
+        if hyst >= 1:
+            raise ValueError(
+                f"invalid TPUFLOW_AUTOTUNE_HYSTERESIS={hyst!r}: "
+                "expected a number in [0, 1)"
+            )
+        out["hysteresis"] = float(hyst)
+    for key in _AUTOTUNE_FLAG_KEYS:
+        var = f"TPUFLOW_AUTOTUNE_{key.upper()}"
+        if os.environ.get(var, "").strip():
+            out[key] = env_flag(var, AUTOTUNE_DEFAULTS[key])
+    return out
+
+
+def resolve_autotune(block: dict) -> dict:
+    """One resolved knob dict: defaults <- env knobs <- explicit block.
+    Raises ValueError naming every problem (the runtime spelling of
+    :func:`validate_autotune_block`)."""
+    problems = validate_autotune_block(block)
+    if problems:
+        raise ValueError(
+            "invalid autotune config: " + "; ".join(problems)
+        )
+    resolved = {**AUTOTUNE_DEFAULTS, **_env_knobs(), **block}
+    if resolved["min_batch"] > resolved["max_batch"]:
+        raise ValueError(
+            f"invalid autotune config: min_batch {resolved['min_batch']} "
+            f"exceeds max_batch {resolved['max_batch']}"
+        )
+    return resolved
+
+
+@dataclass(frozen=True)
+class TuningPoint:
+    """One point in the tuner's knob space."""
+
+    batch_size: int
+    remat: bool
+    jit_epoch: bool
+
+    @property
+    def key(self) -> str:
+        return (
+            f"b{self.batch_size}"
+            f"-{'remat' if self.remat else 'noremat'}"
+            f"-{'scan' if self.jit_epoch else 'perbatch'}"
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "batch_size": self.batch_size,
+            "remat": self.remat,
+            "jit_epoch": self.jit_epoch,
+        }
+
+
+def tuned_config_path(storage_path: str, model_name: str) -> str:
+    """The persisted tuned-config file, next to the serving sidecar."""
+    from tpuflow.utils.paths import join_path
+
+    return join_path(storage_path, "meta", f"{model_name}.autotune.json")
+
+
+def load_tuned(
+    storage_path: str, model_name: str, device_kind: str,
+    compute_dtype: str,
+) -> TuningPoint | None:
+    """The persisted winning point for EXACTLY this device kind and
+    compute dtype, if one was recorded — ``None`` otherwise. Exact-key
+    only, no wildcard: a point tuned under bf16 halves the HBM working
+    set and must never silently seed an f32 run (the
+    ``program_sweep.json`` dtype discipline, PR 10)."""
+    from tpuflow.utils.paths import open_file
+
+    path = tuned_config_path(storage_path, model_name)
+    try:
+        with open_file(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    rec = doc.get(f"{device_kind}@{compute_dtype}") if isinstance(
+        doc, dict
+    ) else None
+    if not isinstance(rec, dict):
+        return None
+    try:
+        return TuningPoint(
+            batch_size=int(rec["batch_size"]),
+            remat=bool(rec["remat"]),
+            jit_epoch=bool(rec["jit_epoch"]),
+        )
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def save_tuned(
+    storage_path: str, model_name: str, device_kind: str,
+    compute_dtype: str, point: TuningPoint, *, throughput: float,
+    frozen: bool, epoch: int,
+) -> None:
+    """Record the winning point under its ``device@dtype`` key (other
+    keys preserved — a bf16 entry never clobbers the f32 one).
+    Atomic write locally; URI storage (gs://, s3://) goes through
+    ``open_file`` like the sidecar — object stores replace whole
+    objects, which is the same no-torn-read guarantee the local
+    tmp+rename gives. Best-effort is the CALLER's policy."""
+    from tpuflow.utils.paths import atomic_write_json, is_uri, open_file
+
+    path = tuned_config_path(storage_path, model_name)
+    doc: dict = {}
+    try:
+        with open_file(path, "r", encoding="utf-8") as f:
+            loaded = json.load(f)
+        if isinstance(loaded, dict):
+            doc = loaded
+    except (OSError, json.JSONDecodeError):
+        pass
+    doc[f"{device_kind}@{compute_dtype}"] = {
+        **point.to_dict(),
+        "samples_per_sec": round(float(throughput), 3),
+        "frozen": frozen,
+        "epoch": epoch,
+    }
+    if is_uri(path):
+        with open_file(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+    else:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        atomic_write_json(path, doc)
+
+
+class OccupancyAutotuner:
+    """Post-epoch hill-climb over (batch, remat, program) under a
+    recompile budget.
+
+    Strictly host-side and strictly post-epoch (the NumericsWatchdog
+    mold): the fit loop calls :meth:`observe_epoch` once per epoch with
+    the epoch's sample count and train wall-time — values it already
+    holds as host floats — and applies the returned
+    :class:`TuningPoint` (or None: stay) before the next epoch. The
+    controller never touches a device.
+
+    The state machine per decision:
+
+    1. **Warmup** — ``warmup_epochs`` epochs after every move are
+       discarded (the first one carries the move's XLA compile) and the
+       move's actual recompile cost is charged from the
+       RecompileDetector's event delta (floored at 1 — every move to an
+       unseen point compiles by construction).
+    2. **Measure** — ``interval`` epochs of samples/sec at the current
+       point, reduced by median (one outlier epoch cannot fake a win).
+    3. **Decide** — an explored neighbor is ADOPTED only if its median
+       clears ``(1 + hysteresis) x`` the best-seen (no flip-flop on
+       noisy gauges); otherwise it is REVERTED — back to the best-seen
+       point, which is already compiled, so reverts are free. From the
+       anchor, the next unvisited neighbor is explored: batch x2,
+       batch /2 (pow-2 ladder, bounds- and divisibility-checked), remat
+       toggle, program toggle. When the budget is spent (or no
+       neighbors remain) the tuner FREEZES on the best-seen point: zero
+       further moves, zero further recompiles.
+
+    Every step is an ``autotune.step`` span (duration = the measured
+    epoch's train time, so the tuner's timeline rides its own lane in
+    ``obs timeline``) carrying the live MFU/HBM/bound gauge readings,
+    and the ``train_autotune_*`` counters/gauges track the trajectory.
+    """
+
+    def __init__(
+        self,
+        cfg: dict,
+        start: TuningPoint,
+        *,
+        n_train_rows: int,
+        n_devices: int = 1,
+        can_scan: bool = True,
+        can_remat: bool = True,
+        device_kind: str = "cpu",
+        compute_dtype: str = "f32",
+        storage_path: str | None = None,
+        model_name: str = "model",
+        prior: str | None = None,
+        verbose: bool = True,
+    ):
+        self.cfg = {**AUTOTUNE_DEFAULTS, **cfg}
+        self.n_train_rows = int(n_train_rows)
+        self.n_devices = max(int(n_devices), 1)
+        self.can_scan = can_scan
+        self.can_remat = can_remat
+        self.device_kind = device_kind
+        self.compute_dtype = compute_dtype
+        self.storage_path = storage_path
+        self.model_name = model_name
+        self.prior = prior
+        self.verbose = verbose
+
+        self.start = self._clamp(start)
+        self.current = self.start
+        self.best: TuningPoint = self.start
+        self.best_sps: float | None = None
+        self.measured: dict[TuningPoint, float] = {}
+        self.frozen = False
+        self.spent = 0
+        self.reverts = 0
+        self.trail: list[dict] = []
+        self._window: list[float] = []
+        self._cooldown = int(self.cfg["warmup_epochs"])
+        self._await_charge = False
+        self._detector_mark = 0
+        self._persisted = False
+
+        self._detector = None
+        self._registry = None
+        self._logger = None
+        self._steps = None
+
+    # --- wiring ---------------------------------------------------------
+
+    def bind(self, *, detector=None, registry=None, logger=None) -> None:
+        """Late wiring from inside fit(): the RecompileDetector the
+        budget charges against, the registry the live gauges live in,
+        and the run's metrics logger."""
+        from tpuflow.obs.metrics import default_registry
+
+        self._detector = detector
+        self._registry = registry or default_registry()
+        self._logger = logger
+        reg = self._registry
+        self._steps = reg.counter(
+            "train_autotune_steps_total",
+            "occupancy-autotuner decisions, by action",
+        )
+        self._recompiles_total = reg.counter(
+            "train_autotune_recompiles_total",
+            "XLA recompiles charged against the autotune budget",
+        )
+        self._reverts_total = reg.counter(
+            "train_autotune_reverts_total",
+            "autotuner moves reverted for missing the hysteresis bar",
+        )
+        self._freezes_total = reg.counter(
+            "train_autotune_freezes_total",
+            "autotuner freezes (budget spent or neighborhood exhausted)",
+        )
+        self._batch_gauge = reg.gauge(
+            "train_autotune_batch_size",
+            "microbatch size the autotuner is currently running",
+        )
+        self._frozen_gauge = reg.gauge(
+            "train_autotune_frozen",
+            "1 once the autotuner has frozen on its best-seen config",
+        )
+        self._budget_gauge = reg.gauge(
+            "train_autotune_budget_remaining",
+            "recompile budget the autotuner has left",
+        )
+        self._batch_gauge.set(float(self.current.batch_size))
+        self._frozen_gauge.set(0.0)
+        self._budget_gauge.set(float(self._budget_remaining()))
+        if detector is not None:
+            self._detector_mark = detector.count
+
+    # --- geometry -------------------------------------------------------
+
+    def _bounds(self) -> tuple[int, int]:
+        lo = max(int(self.cfg["min_batch"]), self.n_devices)
+        hi = min(int(self.cfg["max_batch"]), self.n_train_rows)
+        return lo, max(hi, lo)
+
+    def _clamp(self, point: TuningPoint) -> TuningPoint:
+        lo, hi = self._bounds()
+        b = min(max(point.batch_size, lo), hi)
+        remat = point.remat and self.can_remat
+        scan = point.jit_epoch and self.can_scan
+        if (b, remat, scan) == (
+            point.batch_size, point.remat, point.jit_epoch
+        ):
+            return point
+        return TuningPoint(b, remat, scan)
+
+    def _batch_ok(self, b: int) -> bool:
+        lo, hi = self._bounds()
+        if not (lo <= b <= hi) or b % self.n_devices:
+            return False
+        ladder = int(self.cfg["batch_ladder"])
+        ref, steps = self.start.batch_size, 0
+        big, small = max(b, ref), min(b, ref)
+        while small < big:
+            small *= 2
+            steps += 1
+        return small == big and steps <= ladder
+
+    def _neighbors(self, point: TuningPoint) -> list[TuningPoint]:
+        out = []
+        if self.cfg["tune_batch"]:
+            for b in (point.batch_size * 2, point.batch_size // 2):
+                if b and self._batch_ok(b):
+                    out.append(
+                        TuningPoint(b, point.remat, point.jit_epoch)
+                    )
+        if self.cfg["tune_program"] and self.can_scan:
+            out.append(TuningPoint(
+                point.batch_size, point.remat, not point.jit_epoch
+            ))
+        if self.cfg["tune_remat"] and self.can_remat:
+            out.append(TuningPoint(
+                point.batch_size, not point.remat, point.jit_epoch
+            ))
+        return out
+
+    def _propose(self) -> TuningPoint | None:
+        for cand in self._neighbors(self.best):
+            if cand not in self.measured and cand != self.current:
+                return cand
+        return None
+
+    def _budget_remaining(self) -> int:
+        return max(int(self.cfg["recompile_budget"]) - self.spent, 0)
+
+    # --- the controller step -------------------------------------------
+
+    def observe_epoch(
+        self, epoch: int, *, samples: int, train_time: float
+    ) -> TuningPoint | None:
+        """One post-epoch controller step; returns the point to apply
+        for the NEXT epoch when the tuner moves, None to stay."""
+        sps = float(samples) / max(float(train_time), 1e-9)
+        if self._await_charge:
+            # The epoch just measured carried the move's compile(s):
+            # charge the detector's event delta, floored at 1 — a move
+            # to an unseen point compiles by construction even when the
+            # detector cannot see it (a remat swap keeps data shapes).
+            delta = 1
+            if self._detector is not None:
+                delta = max(self._detector.count - self._detector_mark, 1)
+            self.spent += delta
+            self._recompiles_total.inc(delta)
+            self._budget_gauge.set(float(self._budget_remaining()))
+            self._await_charge = False
+        if self.frozen:
+            self._record(epoch, "frozen", sps, train_time)
+            return None
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            self._record(epoch, "warmup", sps, train_time)
+            return None
+        self._window.append(sps)
+        if len(self._window) < int(self.cfg["interval"]):
+            self._record(epoch, "measure", sps, train_time)
+            return None
+        med = statistics.median(self._window)
+        self._window = []
+        self.measured[self.current] = med
+
+        if self.best_sps is None:
+            self.best_sps = med
+        elif self.current == self.best:
+            # Re-measuring the anchor tracks drift: a regime change
+            # lowers the bar neighbors must clear, so the climb resumes
+            # from live truth rather than a stale record.
+            self.best_sps = med
+        elif med >= self.best_sps * (1.0 + float(self.cfg["hysteresis"])):
+            self.best, self.best_sps = self.current, med
+            self._record(epoch, "adopt", med, train_time)
+            self._persist(epoch)
+        else:
+            # Missed the bar: revert to the best-seen point. Its
+            # programs are already compiled (jit caches by signature),
+            # so the move back is recompile-free.
+            self.reverts += 1
+            self._reverts_total.inc()
+            self._record(epoch, "revert", med, train_time)
+            self._event("autotune_revert", epoch=epoch,
+                        from_config=self.current.key, to=self.best.key)
+            return self._move(self.best, charge=False)
+
+        if self._budget_remaining() <= 0:
+            return self._freeze(epoch, "recompile budget spent")
+        cand = self._propose()
+        if cand is None:
+            return self._freeze(epoch, "neighborhood exhausted")
+        self._record(epoch, "explore", med, train_time, target=cand.key)
+        return self._move(cand, charge=True)
+
+    def _move(
+        self, point: TuningPoint, *, charge: bool
+    ) -> TuningPoint | None:
+        if point == self.current:
+            return None
+        self.current = point
+        # Warmup discards post-COMPILE noise; a revert/freeze revisits
+        # an already-compiled point, so its next epoch measures clean —
+        # no epochs wasted cooling down a move that cost nothing.
+        self._cooldown = int(self.cfg["warmup_epochs"]) if charge else 0
+        self._window = []
+        if charge:
+            self._await_charge = True
+            if self._detector is not None:
+                self._detector_mark = self._detector.count
+                self._detector.expect("autotune")
+        self._batch_gauge.set(float(point.batch_size))
+        return point
+
+    def _freeze(self, epoch: int, reason: str) -> TuningPoint | None:
+        self.frozen = True
+        self._freezes_total.inc()
+        self._frozen_gauge.set(1.0)
+        self._event(
+            "autotune_freeze", epoch=epoch, reason=reason,
+            config=self.best.key, recompiles=self.spent,
+        )
+        if self.verbose:
+            import sys
+
+            print(
+                f"tpuflow.autotune: frozen on {self.best.key} at epoch "
+                f"{epoch} ({reason}; {self.spent} recompile(s) charged "
+                f"of budget {self.cfg['recompile_budget']})",
+                file=sys.stderr,
+            )
+        self._persist(epoch)
+        return self._move(self.best, charge=False)
+
+    # --- recording ------------------------------------------------------
+
+    def _gauge_readings(self) -> dict:
+        """The live occupancy gauges, read without creating absent
+        families (Registry.peek): on a chip without roofline peaks the
+        gauges are honestly absent and so are these fields."""
+        out: dict = {}
+        reg = self._registry
+        if reg is None:
+            return out
+        for field, metric in (
+            ("mfu", "train_mfu"), ("hbm_util", "train_hbm_util"),
+        ):
+            fam = reg.peek(metric)
+            if fam is not None and fam.labels_seen():
+                out[field] = fam.value()
+        bound = reg.peek("train_bound")
+        if bound is not None:
+            for b in ("hbm", "mxu"):
+                if bound.value(bound=b) == 1.0:
+                    out["bound"] = b
+        return out
+
+    def _record(
+        self, epoch: int, action: str, sps: float, train_time: float,
+        **extra,
+    ) -> None:
+        from tpuflow.obs.tracing import record_span
+
+        rec = {
+            "epoch": epoch,
+            "action": action,
+            "config": self.current.key,
+            "batch_size": self.current.batch_size,
+            "remat": self.current.remat,
+            "scan": self.current.jit_epoch,
+            "samples_per_sec": round(sps, 3),
+            "budget_remaining": self._budget_remaining(),
+            **self._gauge_readings(),
+            **extra,
+        }
+        self.trail.append(rec)
+        self._steps.inc(action=action)
+        record_span(
+            "autotune.step", float(train_time), logger=self._logger,
+            **rec,
+        )
+
+    def _event(self, name: str, **fields) -> None:
+        from tpuflow.obs.forensics import record_event
+
+        record_event(name, **fields)
+        if self._logger is not None:
+            self._logger.write(name, **fields)
+
+    def _persist(self, epoch: int) -> None:
+        """Write the best-seen point on every adoption/freeze — not
+        just at fit end — so a preempted run's next attempt still
+        resumes tuned. Best-effort: persistence is an optimization and
+        must never kill a healthy training run."""
+        if not (self.cfg["persist"] and self.storage_path):
+            return
+        try:
+            save_tuned(
+                self.storage_path, self.model_name, self.device_kind,
+                self.compute_dtype, self.best,
+                throughput=self.best_sps or 0.0, frozen=self.frozen,
+                epoch=epoch,
+            )
+            self._persisted = True
+        except Exception as e:  # noqa: BLE001 — URI backends raise
+            # non-OSError (gcsfs HttpError, botocore ClientError);
+            # best-effort means NONE of them may kill a healthy run
+            # (the train/resume.py precedent).
+            if self.verbose:
+                import sys
+
+                print(
+                    f"tpuflow.autotune: tuned-config write failed "
+                    f"({type(e).__name__}: {e}); continuing untuned "
+                    "next restart", file=sys.stderr,
+                )
+
+    def finalize(self, epoch: int | None = None) -> None:
+        """End-of-fit bookkeeping: persist the best-seen point (a run
+        that ended before freezing still hands its successor the best
+        it found)."""
+        if not self._persisted or not self.frozen:
+            self._persist(epoch if epoch is not None else 0)
+
+    def summary(self) -> dict:
+        """The run-report record (``TrainReport.autotune``)."""
+        return {
+            "start": self.start.to_dict(),
+            "best": self.best.to_dict(),
+            "best_config": self.best.key,
+            "best_samples_per_sec": (
+                round(self.best_sps, 3) if self.best_sps else None
+            ),
+            "frozen": self.frozen,
+            "recompiles_charged": self.spent,
+            "recompile_budget": int(self.cfg["recompile_budget"]),
+            "reverts": self.reverts,
+            "decisions": len(self.trail),
+            "configs_measured": sorted(p.key for p in self.measured),
+            "prior": self.prior,
+            # The DECISION trail: post-freeze epochs all record "frozen"
+            # and would evict the interesting prefix from any tail-cap —
+            # keep the decisions, count the frozen epochs.
+            "trail": [
+                r for r in self.trail if r["action"] != "frozen"
+            ][:64],
+            "frozen_epochs": sum(
+                1 for r in self.trail if r["action"] == "frozen"
+            ),
+        }

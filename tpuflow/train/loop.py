@@ -157,6 +157,15 @@ class FitConfig:
     # state to continue with (the worker client swaps in the gang's
     # averaged params on sync rounds).
     sync_fn: Callable | None = None
+    # Online occupancy autotuner (tpuflow/train/autotune.py): a
+    # constructed OccupancyAutotuner, or None. Post-epoch (NumericsWatchdog
+    # mold) it hill-climbs microbatch size / remat / epoch program from
+    # measured throughput under a recompile budget; the loop applies its
+    # moves between epochs. Requires the DEFAULT single-chip steps
+    # (injected train/epoch steps, batch sharding, and streaming sources
+    # are rejected loudly) and detect_recompiles=True (the budget is
+    # charged through the detector).
+    autotune: object | None = None
 
 
 @dataclass
@@ -174,6 +183,10 @@ class FitResult:
     # and the recompile detector's summary (None = no recompiles).
     anomalies: list = field(default_factory=list)
     recompiles: dict | None = None
+    # Occupancy-autotuner summary (train/autotune.py; None = not tuned):
+    # start/best points, freeze state, recompiles charged, and the
+    # decision trail.
+    autotune: dict | None = None
 
     def report(self) -> str:
         """The reference's final report (cnn.py:133-134), working and extended."""
@@ -225,8 +238,32 @@ def fit(
             "resume/save_every need storage_path — without it no run "
             "checkpoints exist and a 'resumed' run would silently restart"
         )
+    tuner = config.autotune
+    if tuner is not None:
+        if (
+            train_step is not None or eval_step is not None
+            or epoch_step is not None or batch_sharding is not None
+        ):
+            raise ValueError(
+                "autotune drives the DEFAULT single-chip steps; injected "
+                "train/eval/epoch steps or batch sharding would be "
+                "silently swapped out mid-run — tune those paths offline"
+            )
+        if isinstance(train_ds, StreamingSource):
+            raise ValueError(
+                "autotune resizes the microbatch between epochs; a "
+                "streaming source bakes its batch size into the stream "
+                "(tune streaming jobs offline)"
+            )
+        if not config.detect_recompiles:
+            raise ValueError(
+                "autotune charges its moves against the RecompileDetector;"
+                " detect_recompiles=False would leave the budget blind"
+            )
+    _start_remat = bool(tuner.current.remat) if tuner is not None else False
     train_step = train_step or make_train_step(
-        config.loss, compute_dtype=config.compute_dtype
+        config.loss, compute_dtype=config.compute_dtype,
+        remat=_start_remat,
     )
     eval_step = eval_step or make_eval_step(
         config.loss, compute_dtype=config.compute_dtype
@@ -266,12 +303,14 @@ def fit(
     samples_counted = 0  # high-water mark already added to the registry
     t0 = time.time()
 
-    if config.jit_epoch:
+    use_scan = bool(config.jit_epoch)
+    if use_scan:
         if epoch_step is None:
             from tpuflow.train.steps import make_epoch_step
 
             epoch_step = make_epoch_step(
-                config.loss, compute_dtype=config.compute_dtype
+                config.loss, compute_dtype=config.compute_dtype,
+                remat=_start_remat,
             )
     else:
         epoch_step = None
@@ -321,9 +360,55 @@ def fit(
     if config.detect_recompiles:
         install_compile_listener()  # process-wide count, best-effort
         detector = RecompileDetector(logger=mlog)
-        train_step = detector.wrap(train_step, "train_step")
+        # Variant-aware names: a run that STARTS remat (a resumed tuned
+        # point) must not share a signature set with the remat-off
+        # variant _live_step builds later — a shared name would swallow
+        # that variant's first compile (seen-signature fast path) and
+        # leak the armed expect() tag onto a later unrelated recompile.
+        _sfx = "@remat" if _start_remat else ""
+        train_step = detector.wrap(train_step, f"train_step{_sfx}")
         eval_step = detector.wrap(eval_step, "eval_step")
-        epoch_step = detector.wrap(epoch_step, "epoch_step")
+        epoch_step = detector.wrap(epoch_step, f"epoch_step{_sfx}")
+    # --- the occupancy autotuner's live knobs (train/autotune.py) ---
+    # live_batch is the microbatch the TRAIN loop uses this epoch (eval
+    # keeps config.batch_size — one fixed eval shape for the run);
+    # use_scan picks the epoch program. Both move only when the tuner
+    # hands back a decision, applied between epochs.
+    live_batch = config.batch_size
+    _step_cache: dict = {}
+    if tuner is not None:
+        tuner.bind(detector=detector, registry=_reg, logger=mlog)
+        _step_cache[("train", _start_remat)] = train_step
+        if epoch_step is not None:
+            _step_cache[("epoch", _start_remat)] = epoch_step
+
+    def _live_step(kind: str, remat: bool):
+        """Detector-wrapped step variants for the tuner's moves,
+        memoized by (kind, remat): revisiting a variant reuses the same
+        wrapped callable, so jit serves the cached executable and a
+        revert costs zero recompiles. Variants built mid-run are
+        wrapped with count_first=True — their first compile is a
+        recompile OF THE RUN, charged against the budget and visible as
+        an xla.compile span (building the variant here is lazy: jit
+        compiles nothing until the first call)."""
+        key = (kind, remat)
+        if key not in _step_cache:
+            from tpuflow.train.steps import make_epoch_step
+
+            factory = (
+                make_train_step if kind == "train" else make_epoch_step
+            )
+            fn = factory(
+                config.loss, compute_dtype=config.compute_dtype,
+                remat=remat,
+            )
+            if detector is not None:
+                suffix = "@remat" if remat else ""
+                fn = detector.wrap(
+                    fn, f"{kind}_step{suffix}", count_first=True
+                )
+            _step_cache[key] = fn
+        return _step_cache[key]
     # Live MFU context: the chip this run dispatches to (roofline peaks
     # are keyed by device_kind; "cpu" reports honestly as unknown).
     if config.roofline:
@@ -382,10 +467,10 @@ def fit(
             if tracing:
                 jax.profiler.start_trace(config.trace_dir)
 
-            if epoch_step is not None:
+            if use_scan:
                 # Whole epoch in one compiled call (scan over batches).
                 xs, ys = _stacked_epoch(
-                    train_ds, config.batch_size, config.seed + epoch
+                    train_ds, live_batch, config.seed + epoch
                 )
                 state, epoch_loss = epoch_step(
                     state, xs, ys, jax.random.fold_in(rng, epoch)
@@ -402,7 +487,7 @@ def fit(
                     epoch_batches = train_ds.epoch_batches(epoch)
                 else:
                     epoch_batches = batches(
-                        train_ds, config.batch_size, seed=config.seed + epoch
+                        train_ds, live_batch, seed=config.seed + epoch
                     )
                 if config.prefetch:
                     from tpuflow.data.prefetch import device_prefetch
@@ -428,7 +513,7 @@ def fit(
                         jax.profiler.stop_trace()
                     raise ValueError(
                         f"epoch {epoch} yielded zero batch_size="
-                        f"{config.batch_size} batches — training would be a "
+                        f"{live_batch} batches — training would be a "
                         "silent no-op reporting NaN loss (dataset/stream split "
                         "smaller than one batch?)"
                     )
@@ -527,6 +612,7 @@ def fit(
                 )
             result.epochs_ran = epoch
             _epochs_total.inc()
+            epoch_samples = samples_seen - samples_counted
             if config.roofline:
                 # Live MFU: this epoch's measured samples/sec/chip
                 # against the model's FLOPs/bytes cost — the roofline
@@ -534,7 +620,7 @@ def fit(
                 # the registry (GET /metrics?format=prometheus) and the
                 # run's metrics JSONL.
                 publish_roofline(
-                    (samples_seen - samples_counted)
+                    epoch_samples
                     / max(train_time, 1e-9)
                     / max(int(config.roofline.get("n_chips", 1)), 1),
                     config.roofline["flops_per_sample"],
@@ -547,9 +633,24 @@ def fit(
             # Per-epoch delta, not a bulk add at fit end: a scrape
             # mid-run must see live throughput, and a crashed run must
             # still have counted the samples it consumed.
-            _samples_total.inc(samples_seen - samples_counted)
+            _samples_total.inc(epoch_samples)
             samples_counted = samples_seen
             _epoch_seconds.observe(epoch_time)
+            if tuner is not None:
+                # One controller step per epoch, AFTER the roofline
+                # publish (the tuner reads the gauges this epoch just
+                # set) and strictly host-side: samples and train_time
+                # are already host floats. A returned point is applied
+                # before the next epoch begins.
+                decision = tuner.observe_epoch(
+                    epoch, samples=epoch_samples, train_time=train_time
+                )
+                if decision is not None:
+                    live_batch = decision.batch_size
+                    use_scan = decision.jit_epoch
+                    train_step = _live_step("train", decision.remat)
+                    if use_scan:
+                        epoch_step = _live_step("epoch", decision.remat)
             if config.progress_path:
                 _write_progress(config.progress_path, epoch)
             # The legacy fault_epoch fires here (armed above as an exit
@@ -566,6 +667,9 @@ def fit(
             # compiles are the price of admission; recompiles beyond it
             # are shape churn (the run-summary diagnostic).
             result.recompiles = detector.summary(steady_after=start_epoch)
+        if tuner is not None:
+            tuner.finalize(result.epochs_ran)
+            result.autotune = tuner.summary()
         if mlog is not None:
             mlog.write(
                 "fit_done",

@@ -40,8 +40,19 @@ def _cast_batch(x, compute_dtype):
     return jnp.asarray(x).astype(compute_dtype)
 
 
+def _maybe_remat(apply, remat: bool):
+    """The step half of the remat knob: ``jax.checkpoint`` on the
+    model's forward, so backward recomputes activations instead of
+    holding them in HBM — recompute FLOPs traded for residency on the
+    HBM-bound path, for EVERY model family (the model-level ``remat``
+    kwarg of the LSTM family remats only its gate scan). The occupancy
+    autotuner toggles this per run from measured throughput."""
+    return jax.checkpoint(apply) if remat else apply
+
+
 def make_train_step(
-    loss_fn: LossFn = mae_clip, donate: bool = True, compute_dtype=None
+    loss_fn: LossFn = mae_clip, donate: bool = True, compute_dtype=None,
+    remat: bool = False,
 ):
     """Build a jitted step: (state, x, y, rng) -> (state, metrics)."""
 
@@ -50,12 +61,16 @@ def make_train_step(
         x = _cast_batch(x, compute_dtype)
 
         def loss_of(params):
-            pred = state.apply_fn(
-                {"params": params},
-                x,
-                deterministic=False,
-                rngs={"dropout": dropout_rng},
+            apply = _maybe_remat(
+                lambda p, xs: state.apply_fn(
+                    {"params": p},
+                    xs,
+                    deterministic=False,
+                    rngs={"dropout": dropout_rng},
+                ),
+                remat,
             )
+            pred = apply(params, x)
             # Loss reduction stays f32 whatever the compute dtype: a
             # model that returns bf16 must not narrow the reduction
             # (models in this tree already emit f32; this is the
@@ -79,7 +94,8 @@ def make_train_step(
 
 
 def make_epoch_step(
-    loss_fn: LossFn = mae_clip, donate: bool = True, compute_dtype=None
+    loss_fn: LossFn = mae_clip, donate: bool = True, compute_dtype=None,
+    remat: bool = False,
 ):
     """Build a jitted WHOLE-EPOCH step: (state, xs, ys, rng) -> (state, loss).
 
@@ -95,12 +111,16 @@ def make_epoch_step(
         x, y, rng = batch
 
         def loss_of(params):
-            pred = state.apply_fn(
-                {"params": params},
-                x,
-                deterministic=False,
-                rngs={"dropout": rng},
+            apply = _maybe_remat(
+                lambda p, xs: state.apply_fn(
+                    {"params": p},
+                    xs,
+                    deterministic=False,
+                    rngs={"dropout": rng},
+                ),
+                remat,
             )
+            pred = apply(params, x)
             return loss_fn(y, pred.astype(jnp.float32))
 
         loss, grads = jax.value_and_grad(loss_of)(state.params)
