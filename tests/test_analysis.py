@@ -722,6 +722,55 @@ class TestLinter:
                     jax.jit(fn)  # noqa: TPF014
         """) == []
 
+    def test_tpf015_wall_clock_delta_flagged(self, tmp_path):
+        """TPF015: a duration computed as a time.time() delta is a
+        casualty of the next NTP step — flagged outside tpuflow/obs/,
+        whichever side of the subtraction the call sits on."""
+        diags = self._lint_source(tmp_path, """
+            import time
+
+            def run(t0):
+                dur = time.time() - t0
+                left = t0 - time.time()
+                return dur + left
+        """)
+        assert _codes(diags) == ["TPF015", "TPF015"]
+
+    def test_tpf015_monotonic_and_fake_clocks_not_flagged(self, tmp_path):
+        # monotonic/perf_counter deltas are the contract; an injectable
+        # clock() variable is the drills' fake-clock seam.
+        assert self._lint_source(tmp_path, """
+            import time
+
+            def run(t0, clock):
+                a = time.monotonic() - t0
+                b = time.perf_counter() - t0
+                c = clock() - t0
+                now = time.time()  # a timestamp, not a delta
+                return a + b + c + now
+        """) == []
+
+    def test_tpf015_obs_directory_exempt(self, tmp_path):
+        # tpuflow/obs/ owns the wall-clock trail format.
+        d = tmp_path / "tpuflow" / "obs"
+        d.mkdir(parents=True, exist_ok=True)
+        f = d / "mod.py"
+        f.write_text(textwrap.dedent("""
+            import time
+
+            def window(t0):
+                return time.time() - t0
+        """))
+        assert lint_file(str(f)) == []
+
+    def test_tpf015_noqa_suppression(self, tmp_path):
+        assert self._lint_source(tmp_path, """
+            import time
+
+            def run(t0):
+                return time.time() - t0  # noqa: TPF015
+        """) == []
+
     def _lint_online_source(self, tmp_path, source):
         """Lint a file AS IF it lived in tpuflow/online/ (TPF010 scope)."""
         import textwrap

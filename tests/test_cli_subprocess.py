@@ -45,12 +45,28 @@ def test_help_exits_zero():
 
 
 def test_tiny_train_job_subprocess(tmp_path):
+    import json
+
+    metrics = tmp_path / "metrics.jsonl"
     out = _run(
         ["--model", "static_mlp", "--epochs", "2", "--batch-size", "64",
          "--devices", "1", "--synthetic-wells", "2", "--synthetic-steps",
-         "64", "--quiet"]
+         "64", "--quiet", "--trace-id", "cli0smoke0000001",
+         "--metrics", str(metrics)]
     )
     assert out.returncode == 0, out.stderr[-2000:]
+    # --trace-id pins the run's trace (exported as TPUFLOW_TRACE_ID):
+    # every span in the trail carries it.
+    spans = [
+        json.loads(l) for l in metrics.read_text().splitlines()
+        if '"span"' in l
+    ]
+    assert spans
+    assert {s["trace_id"] for s in spans} == {"cli0smoke0000001"}
+
+    bad = _run(["--trace-id", "not a token!", "--quiet"])
+    assert bad.returncode == 2
+    assert "--trace-id" in bad.stderr and "Traceback" not in bad.stderr
 
 
 def test_model_kwargs_flag(tmp_path):
@@ -177,6 +193,98 @@ def test_obs_timeline_subprocess(tmp_path):
         capture_output=True, text=True, cwd=REPO, timeout=120,
     )
     assert empty.returncode == 2  # missing file is an OSError exit
+
+
+def test_obs_fleet_subprocess(tmp_path):
+    """python -m tpuflow.obs fleet: multi-trail discovery + merged
+    timeline + summary, as a REAL subprocess (no jax needed). A trace
+    id shared by two processes lands in cross_process_traces and draws
+    flow arrows."""
+    import json
+
+    w = tmp_path / "worker0"
+    w.mkdir()
+    (w / "metrics.jsonl").write_text(json.dumps({
+        "event": "span", "name": "step", "time": 10.0,
+        "duration_s": 1.0, "trace_id": "abc0000000000001",
+    }) + "\n")
+    c = tmp_path / "elastic"
+    c.mkdir()
+    (c / "coordinator-metrics.jsonl").write_text(json.dumps({
+        "event": "span", "name": "elastic.round", "time": 10.5,
+        "duration_s": 0.1,
+        "worker_traces": {"0": "abc0000000000001"},
+    }) + "\n")
+    out = tmp_path / "fleet.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "fleet", str(tmp_path),
+         "-o", str(out)],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    summary = json.loads(proc.stdout)
+    assert summary["trails"] == 2
+    assert summary["cross_process_traces"] == {
+        "abc0000000000001": [
+            "elastic/coordinator-metrics", "worker0/metrics",
+        ]
+    }
+    doc = json.loads(out.read_text())
+    assert {e["pid"] for e in doc["traceEvents"] if e["ph"] == "X"} \
+        == {1, 2}
+    assert any(e["ph"] in ("s", "t", "f") for e in doc["traceEvents"])
+
+    missing = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "fleet",
+         str(tmp_path / "nope")],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert missing.returncode == 2
+    assert "nope" in missing.stderr
+
+
+def test_obs_slo_subprocess(tmp_path):
+    """python -m tpuflow.obs slo: the report card from fleet trails in
+    a REAL subprocess — schema-valid JSON on stdout, written to -o,
+    and a malformed objectives file exits 2 with a message."""
+    import json
+
+    d = tmp_path / "online"
+    d.mkdir()
+    (d / "metrics.jsonl").write_text("\n".join(json.dumps(r) for r in [
+        {"event": "drift_anomaly", "time": 100.0,
+         "trace_id": "t0000000000000001"},
+        {"event": "online_retrain", "time": 101.0, "reason": "drift",
+         "trace_id": "t0000000000000001"},
+        {"event": "serve_reload", "time": 130.0,
+         "trace_id": "t0000000000000001"},
+    ]) + "\n")
+    objectives = tmp_path / "objectives.json"
+    objectives.write_text(json.dumps([
+        {"name": "tta", "kind": "time_to_adapt", "target": 300.0},
+    ]))
+    out = tmp_path / "card.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "slo", str(tmp_path),
+         "--objectives", str(objectives), "-o", str(out)],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    card = json.loads(out.read_text())
+    assert card["schema"] == "tpuflow.slo.report_card/v1"
+    [row] = card["objectives"]
+    assert row["status"] == "ok" and row["measured"] == 30.0
+    assert row["lifecycles"][0]["trace_id"] == "t0000000000000001"
+
+    objectives.write_text(json.dumps([{"kind": "p42", "target": 1}]))
+    bad = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "slo", str(tmp_path),
+         "--objectives", str(objectives)],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert bad.returncode == 2
+    assert "unknown kind" in bad.stderr
+    assert "Traceback" not in bad.stderr
 
 
 def test_analysis_module_entry_rejects_broken_spec(tmp_path):

@@ -1138,6 +1138,43 @@ class TestSocketExchange:
         ex.set_offset(0, 4)
         assert ex.get_offset(0) == (4, True)
 
+    def test_tpfx_headers_carry_the_worker_trace(
+        self, socket_gang, tmp_path
+    ):
+        """Cross-process trace propagation (ISSUE 14): a push sent
+        while a trace is bound carries it in the TPFX frame header,
+        the coordinator-side store remembers it, and the published
+        round's `elastic.round` span names the pushing workers'
+        traces — the worker->coordinator link on the fleet timeline.
+        An unbound (or garbage) trace simply yields no entry."""
+        from tpuflow.obs import clear_events, recent_events, use_trace
+
+        store, clock, ex, _ = socket_gang
+        with use_trace("w0trace000000001"):
+            ex.push(1, 0, _params(1.0))
+            ex.write_heartbeat(0, round=1)
+        ex.push(1, 1, _params(3.0))  # no bound trace: no entry
+        ex.write_heartbeat(1, round=1)
+        assert store.worker_traces() == {0: "w0trace000000001"}
+
+        clear_events()
+        coord = Coordinator(
+            str(tmp_path / "gang-state"), backend=store,
+            heartbeat_timeout=5.0, clock=clock, sleep=lambda _: None,
+        )
+        assert coord.step() is True
+        [span] = [
+            e for e in recent_events()
+            if e.get("name") == "elastic.round"
+        ]
+        assert span["worker_traces"] == {"0": "w0trace000000001"}
+        # The span also lands in the coordinator's on-disk trail (the
+        # fleet lane), same worker_traces attached.
+        trail = tmp_path / "gang-state" / "coordinator-metrics.jsonl"
+        recs = [json.loads(l) for l in open(trail)]
+        [rec] = [r for r in recs if r.get("name") == "elastic.round"]
+        assert rec["worker_traces"] == {"0": "w0trace000000001"}
+
     def test_coordinator_over_the_store_publishes(self, socket_gang):
         store, clock, ex, _ = socket_gang
         coord = Coordinator(

@@ -1,0 +1,572 @@
+"""The fleet telemetry plane (tpuflow/obs/fleet.py + slo.py): trail
+discovery and merged timelines, trace-id flow across processes, the SLO
+engine's burn-rate/error-budget math against hand-computed windows, the
+committed report-card schema — and the tier-1 acceptance drill: a
+2-worker socket elastic gang plus a live async daemon driven through an
+online hot swap produce ONE merged timeline in which a single trace id
+spans worker push → coordinator average, and a single trace id spans
+drift → retrain → swap → daemon reload.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpuflow.obs import Registry
+from tpuflow.obs.fleet import (
+    discover_trails,
+    export_fleet,
+    merge_fleet,
+    read_fleet,
+)
+from tpuflow.obs.slo import (
+    SloEngine,
+    burn_rate,
+    error_budget_remaining,
+    normalize_objectives,
+    report_card,
+    serve_objectives,
+    validate_report_card,
+    windowed_burn_rates,
+)
+
+NAMES = "pressure,choke,glr,temperature,water_cut,completion,flow"
+TYPES = "float,float,float,float,float,string,float"
+_COLS = NAMES.split(",")
+
+
+# ---------------------------------------------------------------------
+# the error-budget algebra, against hand-computed windows
+# ---------------------------------------------------------------------
+
+
+class TestBudgetMath:
+    def test_burn_rate_hand_computed(self):
+        # target 0.9 => 10% budget. 2 bad of 10 = 20% observed => 2x.
+        assert burn_rate(8, 2, 0.9) == pytest.approx(2.0)
+        # Exactly sustainable spending reads 1.0.
+        assert burn_rate(999, 1, 0.999) == pytest.approx(1.0)
+        # No failures = zero burn; no traffic = honest None, not 0.0.
+        assert burn_rate(50, 0, 0.999) == 0.0
+        assert burn_rate(0, 0, 0.999) is None
+        # A 100% target has no budget: any failure burns infinitely.
+        assert burn_rate(1, 1, 1.0) == math.inf
+        assert burn_rate(1, 0, 1.0) == 0.0
+
+    def test_error_budget_remaining_hand_computed(self):
+        # target 0.9 over 10 events buys exactly 1 failure.
+        assert error_budget_remaining(10, 0, 0.9) == pytest.approx(1.0)
+        assert error_budget_remaining(9, 1, 0.9) == pytest.approx(0.0)
+        # 2 failures = 200% of the budget spent => -1.0 (violated).
+        assert error_budget_remaining(8, 2, 0.9) == pytest.approx(-1.0)
+        assert error_budget_remaining(0, 0, 0.9) is None
+
+    def test_windowed_burn_rates_hand_computed(self):
+        """Three 10s windows: all-good, half-bad, all-bad — each
+        window's burn rate against target 0.5 (budget 50%) is 0, 1, 2;
+        an empty window is OMITTED, not reported as healthy 0.0."""
+        samples = [
+            (0.0, True), (3.0, True),              # window [0, 10)
+            (10.0, True), (14.0, False),           # window [10, 20)
+            # window [20, 30): no traffic at all
+            (30.0, False), (31.0, False),          # window [30, 40)
+        ]
+        w = windowed_burn_rates(samples, target=0.5, window_s=10.0)
+        assert [x["burn_rate"] for x in w] == [
+            pytest.approx(0.0), pytest.approx(1.0), pytest.approx(2.0),
+        ]
+        assert [(x["good"], x["bad"]) for x in w] == [(2, 0), (1, 1), (0, 2)]
+        assert [x["start"] for x in w] == [0.0, 10.0, 30.0]
+        # Budget per window: all-good untouched, half-bad exactly
+        # spent, all-bad overspent (negative).
+        assert [x["error_budget_remaining"] for x in w] == [
+            pytest.approx(1.0), pytest.approx(0.0), pytest.approx(-1.0),
+        ]
+
+    def test_window_must_be_positive(self):
+        with pytest.raises(ValueError, match="window_s"):
+            windowed_burn_rates([(0, True)], target=0.9, window_s=0)
+
+
+class TestObjectives:
+    def test_unknown_kind_fails_loudly(self):
+        with pytest.raises(ValueError, match="unknown kind"):
+            normalize_objectives([{"kind": "latency_p50", "target": 1}])
+
+    def test_bad_targets_fail_loudly(self):
+        with pytest.raises(ValueError, match="ratio"):
+            normalize_objectives(
+                [{"kind": "availability", "target": 1.5}]
+            )
+        with pytest.raises(ValueError, match="numeric 'target'"):
+            normalize_objectives([{"kind": "latency_p99"}])
+        with pytest.raises(ValueError, match="duplicate"):
+            normalize_objectives([
+                {"name": "a", "kind": "latency_p99", "target": 1},
+                {"name": "a", "kind": "goodput_floor", "target": 1},
+            ])
+
+    def test_serve_objective_env_targets_validated(self, monkeypatch):
+        monkeypatch.setenv("TPUFLOW_SERVE_SLO_TARGET", "0.99")
+        monkeypatch.setenv("TPUFLOW_SERVE_SLO_P99_MS", "250")
+        objs = {o["kind"]: o for o in serve_objectives()}
+        assert objs["availability"]["target"] == 0.99
+        assert objs["latency_p99"]["target"] == 250.0
+        monkeypatch.setenv("TPUFLOW_SERVE_SLO_TARGET", "1.7")
+        with pytest.raises(ValueError, match="TPUFLOW_SERVE_SLO_TARGET"):
+            serve_objectives()
+        monkeypatch.setenv("TPUFLOW_SERVE_SLO_TARGET", "0.999")
+        monkeypatch.setenv("TPUFLOW_SERVE_SLO_P99_MS", "fast")
+        with pytest.raises(ValueError, match="TPUFLOW_SERVE_SLO_P99_MS"):
+            serve_objectives()
+
+
+class TestSloEngineRegistry:
+    def test_availability_and_p99_from_counters(self):
+        reg = Registry()
+        reg.counter("serving_admitted_total").inc(995)
+        shed = reg.counter("serving_shed_total")
+        shed.inc(3, code="503")
+        shed.inc(2, code="429")
+        reg.summary(
+            "predict_latency_ms", "",
+            fn=lambda: {"quantiles": {0.5: 5.0, 0.99: 700.0},
+                        "sum": 1.0, "count": 10},
+        )
+        engine = SloEngine([
+            {"name": "availability", "kind": "availability",
+             "target": 0.99, "good": ("serving_admitted_total",),
+             "bad": ("serving_shed_total",)},
+            {"name": "latency_p99", "kind": "latency_p99",
+             "target": 500.0},
+        ], registry=reg)
+        rows = {
+            r["name"]: r
+            for r in engine.evaluate_registry(reg)["objectives"]
+        }
+        # 5 bad of 1000 at a 1% budget: half the budget spent.
+        assert rows["availability"]["measured"] == pytest.approx(0.995)
+        assert rows["availability"]["error_budget_remaining"] \
+            == pytest.approx(0.5)
+        assert rows["availability"]["burn_rate"] == pytest.approx(0.5)
+        assert rows["availability"]["status"] == "ok"
+        # p99 700ms over a 500ms ceiling: violated.
+        assert rows["latency_p99"]["status"] == "violated"
+        # The gauges render into the exposition for Prometheus.
+        from tpuflow.obs import render_prometheus
+
+        text = render_prometheus(reg)
+        assert (
+            'tpuflow_slo_error_budget_remaining{objective="availability"} '
+            "0.5" in text
+        )
+        assert 'tpuflow_slo_burn_rate{objective="availability"}' in text
+
+    def test_missing_families_read_no_data_not_zero(self):
+        engine = SloEngine(registry=Registry())
+        rows = engine.evaluate_registry(Registry())["objectives"]
+        assert all(r["status"] == "no_data" for r in rows)
+        assert all(r["measured"] is None for r in rows)
+
+
+class TestReportCard:
+    def test_time_to_adapt_lifecycles_grouped_by_trace(self):
+        events = [
+            {"event": "drift_anomaly", "time": 100.0, "trace_id": "t1"},
+            {"event": "online_retrain", "time": 101.0, "trace_id": "t1",
+             "reason": "drift"},
+            {"event": "artifact_swap", "time": 130.0, "trace_id": "t1"},
+            {"event": "serve_reload", "time": 131.0, "trace_id": "t1"},
+            # A second, slower lifecycle on its own trace.
+            {"event": "drift_anomaly", "time": 200.0, "trace_id": "t2"},
+            {"event": "serve_reload", "time": 640.0, "trace_id": "t2"},
+            # Noise: a trace with no completion never counts.
+            {"event": "drift_anomaly", "time": 300.0, "trace_id": "t3"},
+        ]
+        card = report_card(events, [
+            {"name": "tta", "kind": "time_to_adapt", "target": 300.0},
+        ])
+        validate_report_card(card)
+        [row] = card["objectives"]
+        lives = {lc["trace_id"]: lc for lc in row["lifecycles"]}
+        assert set(lives) == {"t1", "t2"}
+        assert lives["t1"]["seconds"] == pytest.approx(31.0)
+        assert lives["t2"]["seconds"] == pytest.approx(440.0)
+        assert row["measured"] == pytest.approx(440.0)  # worst case
+        assert row["status"] == "violated"  # t2 blew the 300s ceiling
+
+    def test_card_validates_against_committed_schema(self):
+        card = report_card([], None)
+        validate_report_card(card)  # jsonschema path (installed)
+        # The dependency-light structural fallback agrees.
+        from tpuflow.obs import slo as slo_mod
+
+        with open(slo_mod.SCHEMA_PATH, encoding="utf-8") as f:
+            schema = json.load(f)
+        assert slo_mod._structural_check(card, schema) == []
+        # ...and both reject a malformed card.
+        bad = {**card, "objectives": [{"kind": "nope"}]}
+        with pytest.raises(ValueError, match="schema"):
+            validate_report_card(bad)
+        assert slo_mod._structural_check(bad, schema)
+
+    def test_availability_from_dispatch_spans_in_trails(self):
+        events = [
+            {"event": "span", "name": "predict.dispatch",
+             "time": float(i), "duration_s": 0.01}
+            for i in range(9)
+        ] + [
+            {"event": "span", "name": "predict.dispatch", "time": 9.0,
+             "duration_s": 0.01, "ok": False},
+        ]
+        card = report_card(events, [
+            {"name": "availability", "kind": "availability",
+             "target": 0.9},
+        ], window_s=100.0)
+        validate_report_card(card)
+        [row] = card["objectives"]
+        assert row["measured"] == pytest.approx(0.9)
+        assert row["error_budget_remaining"] == pytest.approx(0.0)
+        assert row["windows"][0]["bad"] == 1
+
+
+# ---------------------------------------------------------------------
+# fleet discovery + merge on synthetic trails
+# ---------------------------------------------------------------------
+
+
+def _write_trail(path, records):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+class TestFleetMerge:
+    def test_discovery_names_lanes_from_relative_paths(self, tmp_path):
+        _write_trail(str(tmp_path / "worker0" / "metrics.jsonl"), [])
+        _write_trail(
+            str(tmp_path / "elastic" / "coordinator-metrics.jsonl"), []
+        )
+        trails = discover_trails([str(tmp_path)])
+        assert [t["process"] for t in trails] == [
+            "elastic/coordinator-metrics", "worker0/metrics",
+        ]
+
+    def test_merge_lanes_flows_and_summary(self, tmp_path):
+        _write_trail(str(tmp_path / "worker0" / "metrics.jsonl"), [
+            {"event": "span", "name": "step", "time": 10.0,
+             "duration_s": 1.0, "trace_id": "aaa0000000000001"},
+        ])
+        _write_trail(
+            str(tmp_path / "elastic" / "coordinator-metrics.jsonl"), [
+                # The coordinator's own trace is unbound; the round
+                # span NAMES the pushing worker's trace.
+                {"event": "span", "name": "elastic.round", "time": 10.5,
+                 "duration_s": 0.1,
+                 "worker_traces": {"0": "aaa0000000000001"}},
+            ],
+        )
+        doc, summary = merge_fleet([str(tmp_path)])
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert {e["pid"] for e in xs} == {1, 2}
+        # One fleet-wide time zero: the worker span starts at ts=0
+        # (time 10.0 - 1.0s duration) and the coordinator round at its
+        # own start, 10.5 - 0.1 - 9.0 = 1.4s later.
+        by_name = {e["name"]: e for e in xs}
+        assert by_name["step"]["ts"] == 0.0
+        assert by_name["elastic.round"]["ts"] == pytest.approx(1.4e6)
+        procs = [
+            e["args"]["name"] for e in doc["traceEvents"]
+            if e.get("name") == "process_name"
+        ]
+        assert set(procs) == {
+            "worker0/metrics", "elastic/coordinator-metrics",
+        }
+        # worker_traces counts as a trace sighting: the flow arrow
+        # links the worker's push to the coordinator's round.
+        flows = [e for e in doc["traceEvents"] if e["ph"] in "stf"]
+        assert {e["ph"] for e in flows} == {"s", "f"}
+        assert all(e["id"] == "aaa0000000000001" for e in flows)
+        assert summary["cross_process_traces"] == {
+            "aaa0000000000001": [
+                "elastic/coordinator-metrics", "worker0/metrics",
+            ]
+        }
+
+    def test_torn_lines_counted_never_fatal(self, tmp_path):
+        path = str(tmp_path / "w" / "metrics.jsonl")
+        _write_trail(path, [
+            {"event": "span", "name": "step", "time": 1.0,
+             "duration_s": 0.5},
+        ])
+        with open(path, "ab") as f:
+            f.write(b'{"event": "span", "torn mid-wr')
+        _doc, summary = merge_fleet([str(tmp_path)])
+        [proc] = summary["processes"]
+        assert proc["skipped_lines"] == 1
+        assert proc["events"] == 1
+
+    def test_export_writes_doc_and_reports(self, tmp_path):
+        _write_trail(str(tmp_path / "a" / "metrics.jsonl"), [
+            {"event": "span", "name": "step", "time": 1.0,
+             "duration_s": 0.5},
+        ])
+        out = str(tmp_path / "fleet.json")
+        summary = export_fleet([str(tmp_path)], out)
+        assert summary["timeline"]["spans"] == 1
+        doc = json.loads(open(out).read())
+        assert doc["displayTimeUnit"] == "ms"
+
+
+# ---------------------------------------------------------------------
+# the tier-1 acceptance drill: gang + daemon + online swap -> ONE
+# merged timeline + a schema-valid report card
+# ---------------------------------------------------------------------
+
+
+def _table_rows(cols, scale=1.0):
+    out = []
+    for i in range(len(cols["flow"])):
+        row = []
+        for c in _COLS:
+            v = cols[c][i]
+            if c in ("pressure", "flow"):
+                v = float(v) * scale
+            row.append(str(v))
+        out.append(",".join(row))
+    return out
+
+
+class TestFleetDrill:
+    def test_gang_plus_daemon_hot_swap_is_one_timeline(self, tmp_path):
+        """ISSUE 14's tier-1 drill. A 2-worker SOCKET elastic gang and
+        a live async daemon (with an on-disk trail) driven through an
+        online drift -> warm-start retrain -> shadow-eval -> swap ->
+        reload, all under one storage root. `merge_fleet` then proves:
+
+        - one trace id spans a worker's push and the coordinator's
+          averaging round (TPFX header propagation);
+        - one trace id spans drift-detect, retrain, swap, and the
+          daemon's reload (the online lifecycle trace + X-Trace-Id);
+        - the SLO report card computes an error budget from the
+          daemon's own counters and a time-to-adapt lifecycle, and
+          validates against the committed schema.
+        """
+        from tpuflow.api import TrainJobConfig, train
+        from tpuflow.data import wells_to_table
+        from tpuflow.data.synthetic import generate_wells
+        from tpuflow.elastic.runner import run_elastic
+        from tpuflow.online.controller import OnlineTrainer
+        from tpuflow.serve_async import AsyncServer
+
+        root = str(tmp_path)
+
+        # --- leg 1: the 2-worker socket gang under {root}/gang -------
+        gang_spec = {
+            "model": "static_mlp",
+            "model_kwargs": {"hidden": []},
+            "epochs": 2,
+            "batchSize": 32,
+            "patience": 100,
+            "loss": "mse",
+            "synthetic_wells": 2,
+            "synthetic_steps": 64,
+            "n_devices": 1,
+            "verbose": False,
+            "storagePath": os.path.join(root, "gang"),
+        }
+        r = run_elastic(
+            gang_spec, 2, mode="inprocess", transport="socket",
+            heartbeat_timeout=120.0,
+        )
+        assert r.ok, [w.error for w in r.workers]
+
+        # --- leg 2: serving artifact + daemon + online loop ----------
+        serving = os.path.join(root, "serving")
+        table = wells_to_table(generate_wells(n_wells=4, steps=200, seed=3))
+        base_csv = os.path.join(root, "base.csv")
+        with open(base_csv, "w", encoding="utf-8") as f:
+            f.write("\n".join(_table_rows(table)) + "\n")
+
+        def _config(**over):
+            kw = dict(
+                column_names=NAMES, column_types=TYPES, target="flow",
+                storage_path=serving, data_path=base_csv,
+                model="static_mlp", model_kwargs={"hidden": [4]},
+                max_epochs=4, patience=100, batch_size=64,
+                verbose=False, health="off",
+            )
+            kw.update(over)
+            return TrainJobConfig(**kw)
+
+        train(_config(metrics_path=os.path.join(serving, "metrics.jsonl")))
+
+        srv = AsyncServer(
+            "127.0.0.1", 0, enable_jobs=False,
+            trail_path=os.path.join(root, "serve-metrics.jsonl"),
+        ).start()
+        url = f"http://127.0.0.1:{srv.port}"
+        try:
+            # Live traffic through the daemon (the availability
+            # objective's good events).
+            probe = {
+                c: [float(v) if c != "completion" else str(v)
+                    for v in np.asarray(table[c][:16])]
+                for c in _COLS if c != "flow"
+            }
+            body = json.dumps({
+                "storagePath": serving, "model": "static_mlp",
+                "columns": probe,
+            }).encode()
+            for _ in range(5):
+                req = urllib.request.Request(
+                    url + "/predict", data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    assert resp.status == 200
+
+            # The regime shift: healthy windows, then shifted ones.
+            rng = np.random.default_rng(7)
+            n = len(table["flow"])
+
+            def _chunk(scale):
+                idx = rng.integers(0, n, 120)
+                return {
+                    c: (
+                        np.asarray(table[c])[idx] if c == "completion"
+                        else np.asarray(table[c], np.float64)[idx]
+                        * (scale if c in ("pressure", "flow") else 1.0)
+                    )
+                    for c in _COLS
+                }
+
+            chunks = [_chunk(1.0)] * 2 + [_chunk(3.0)] * 6
+            cfg = _config(online={
+                "warmup_windows": 1, "threshold": 3.0,
+                "replay_windows": 4, "eval_every": 3,
+                "retrain_epochs": 2, "margin": 1000.0,
+                "min_retrain_gap": 100, "rollback": False,
+                "daemon_url": url,
+            })
+            tr = OnlineTrainer(
+                cfg, source=iter(chunks), registry=Registry()
+            )
+            summary = tr.run()
+            assert summary["retrains"] >= 1, summary
+            assert summary["swaps"] >= 1, summary
+        finally:
+            srv.shutdown()
+
+        # --- the merged fleet timeline -------------------------------
+        doc, fleet = merge_fleet([root])
+        procs = {p["process"] for p in fleet["processes"]}
+        assert {
+            "gang/worker0/metrics", "gang/worker1/metrics",
+            "gang/elastic/coordinator-metrics",
+            "serving/online/metrics", "serve-metrics",
+        } <= procs, procs
+
+        # (a) worker push -> coordinator average: a worker's run trace
+        # appears in BOTH the worker's own trail and the coordinator's
+        # elastic.round span (via the TPFX frame header).
+        coord_events = next(
+            t for t in read_fleet([root])[0]
+            if t["process"] == "gang/elastic/coordinator-metrics"
+        )["events"]
+        round_traces = set()
+        for rec in coord_events:
+            if rec.get("name") == "elastic.round":
+                round_traces.update(
+                    (rec.get("worker_traces") or {}).values()
+                )
+        assert round_traces, "no worker traces on any averaging round"
+        cross = fleet["cross_process_traces"]
+        gang_links = {
+            tid: procs_ for tid, procs_ in cross.items()
+            if tid in round_traces
+        }
+        assert gang_links, (round_traces, cross)
+        assert any(
+            "gang/elastic/coordinator-metrics" in ps
+            and any(p.startswith("gang/worker") for p in ps)
+            for ps in gang_links.values()
+        ), gang_links
+
+        # (b) drift -> retrain -> swap -> reload: ONE trace id on the
+        # whole lifecycle, across the online loop's trail AND the
+        # daemon's.
+        online_events = next(
+            t for t in read_fleet([root])[0]
+            if t["process"] == "serving/online/metrics"
+        )["events"]
+        swap_traces = {
+            rec["trace_id"] for rec in online_events
+            if rec.get("event") == "online_swap" and rec.get("trace_id")
+        }
+        assert swap_traces, "no traced swap in the online trail"
+        lifecycle = None
+        for tid in swap_traces:
+            kinds = {
+                rec["event"] for rec in online_events
+                if rec.get("trace_id") == tid
+            }
+            if {"drift_anomaly", "online_retrain", "online_swap"} <= kinds:
+                lifecycle = tid
+        assert lifecycle, "no single trace spans drift+retrain+swap"
+        daemon_events = next(
+            t for t in read_fleet([root])[0]
+            if t["process"] == "serve-metrics"
+        )["events"]
+        assert any(
+            rec.get("event") == "serve_reload"
+            and rec.get("trace_id") == lifecycle
+            for rec in daemon_events
+        ), "the daemon's reload record does not carry the lifecycle trace"
+        assert set(cross.get(lifecycle, ())) >= {
+            "serving/online/metrics", "serve-metrics",
+        }
+        # The merged doc draws flow arrows for the lifecycle trace.
+        flow_ids = {
+            e["id"] for e in doc["traceEvents"] if e["ph"] in "stf"
+        }
+        assert lifecycle in flow_ids
+
+        # --- the SLO report card -------------------------------------
+        _trails, events = read_fleet([root])
+        card = report_card(
+            events,
+            [
+                {"name": "availability", "kind": "availability",
+                 "target": 0.999,
+                 "good": ("serving_admitted_total",),
+                 "bad": ("serving_shed_total",)},
+                {"name": "time_to_adapt", "kind": "time_to_adapt",
+                 "target": 600.0},
+            ],
+            registry=srv.registry,
+        )
+        validate_report_card(card)
+        rows = {r["name"]: r for r in card["objectives"]}
+        # Availability: every request the drill sent was admitted, so
+        # the budget is untouched and the burn-rate math had real
+        # traffic to chew on.
+        assert rows["availability"]["measured"] == 1.0
+        assert rows["availability"]["error_budget_remaining"] \
+            == pytest.approx(1.0)
+        assert rows["availability"]["status"] == "ok"
+        # Time-to-adapt: the lifecycle trace yields a measurable
+        # drift->reload duration.
+        assert rows["time_to_adapt"]["measured"] is not None
+        assert rows["time_to_adapt"]["status"] == "ok"
+        assert any(
+            lc["trace_id"] == lifecycle
+            for lc in rows["time_to_adapt"]["lifecycles"]
+        )

@@ -396,11 +396,18 @@ class TestPrometheusEndpoint:
             assert "tpuflow_predict_requests_total 1" in text
             assert "tpuflow_uptime_seconds" in types
 
-            # The JSON view is unchanged in shape.
+            # The JSON view keeps its keys, plus the SLO section
+            # (tpuflow/obs/slo.py) both daemons now render.
             status, _, js = _get_text(base + "/metrics")
             metrics = json.loads(js)
-            assert set(metrics) == {"jobs", "predict", "uptime_s"}
+            assert set(metrics) == {"jobs", "predict", "slo", "uptime_s"}
             assert metrics["predict"]["requests"] == 1
+            slo_rows = {
+                r["name"]: r for r in metrics["slo"]["objectives"]
+            }
+            assert slo_rows["availability"]["status"] in (
+                "ok", "no_data"
+            )
         finally:
             srv.shutdown()
             srv.predictor.close()
@@ -471,6 +478,211 @@ class TestMetricsKeysDocDrift:
             )
         finally:
             srv.shutdown()
+
+
+class TestMetricFamilyDocsDrift:
+    """docs/observability.md's metric-family catalog (the
+    `metric-families` marker block) must equal the set of families the
+    SOURCE TREE actually registers — in both directions — so new
+    `slo_*`/fleet families can't ship undocumented and removed ones
+    can't haunt the docs. Registration sites are found by AST scan;
+    the four f-string sites expand through an explicit table (a NEW
+    dynamic site must either use a literal name or be added there)."""
+
+    # f-string pattern -> the names it expands to at runtime.
+    DYNAMIC = {
+        "jobs_{}_total": ("submitted", "done", "failed", "cancelled"),
+        "predict_{}_total": (
+            "requests", "cache_hits", "loads", "invalidations",
+            "spills", "degraded_requests", "fallback_loads",
+            "warmed_buckets",
+        ),
+        "predict_batch_{}_total": (
+            "requests", "rejected", "dispatches",
+            "coalesced_dispatches", "rows_dispatched", "expired",
+        ),
+        "online_{}_total": (
+            "windows", "retrains", "swaps_notified",
+            "candidates_rejected",
+        ),
+    }
+
+    @classmethod
+    def _registered_families(cls) -> set[str]:
+        import ast
+
+        kinds = {"counter", "gauge", "histogram", "summary"}
+        found: set[str] = set()
+        pkg = os.path.join(REPO, "tpuflow")
+        for dirpath, dirs, files in os.walk(pkg):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                with open(path, encoding="utf-8") as f:
+                    tree = ast.parse(f.read(), filename=path)
+                for node in ast.walk(tree):
+                    if not (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in kinds
+                        and node.args
+                    ):
+                        continue
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Constant) and isinstance(
+                        arg.value, str
+                    ):
+                        found.add(arg.value)
+                    elif isinstance(arg, ast.JoinedStr):
+                        pattern = "".join(
+                            v.value if isinstance(v, ast.Constant)
+                            else "{}"
+                            for v in arg.values
+                        )
+                        assert pattern in cls.DYNAMIC, (
+                            f"{path}: dynamically-named metric family "
+                            f"{pattern!r} is not in the DYNAMIC "
+                            "expansion table — use a literal name or "
+                            "add its runtime names here AND to "
+                            "docs/observability.md"
+                        )
+                        found.update(
+                            pattern.format(n) for n in cls.DYNAMIC[pattern]
+                        )
+        return found
+
+    @staticmethod
+    def _documented_families() -> set[str]:
+        doc = os.path.join(REPO, "docs", "observability.md")
+        with open(doc, encoding="utf-8") as f:
+            text = f.read()
+        block = re.search(
+            r"<!-- metric-families -->(.*?)<!-- /metric-families -->",
+            text, re.S,
+        )
+        assert block, "docs/observability.md lost its metric-families markers"
+        return set(re.findall(r"`([a-z0-9_]+)`", block.group(1)))
+
+    def test_documented_families_equal_registered(self):
+        registered = self._registered_families()
+        documented = self._documented_families()
+        assert documented == registered, (
+            "docs/observability.md metric-families block and the code "
+            "disagree: "
+            f"undocumented={sorted(registered - documented)}, "
+            f"stale-in-docs={sorted(documented - registered)}"
+        )
+
+    def test_documented_families_render_in_exposition(self):
+        """Every documented family name is a legal exposition family:
+        registered into a registry, the rendered text carries exactly
+        the documented set (prefixed) and validates as exposition."""
+        reg = Registry()
+        for name in sorted(self._documented_families()):
+            reg.counter(name, "drift-gate smoke")
+        text = render_prometheus(reg)
+        types = _assert_valid_exposition(text)
+        assert set(types) == {
+            f"tpuflow_{n}" for n in self._documented_families()
+        }
+
+
+class TestTraceEnvPropagation:
+    def test_env_trace_id_validated(self, monkeypatch):
+        from tpuflow.utils.env import env_trace_id
+
+        monkeypatch.delenv("TPUFLOW_TRACE_ID", raising=False)
+        assert env_trace_id() is None
+        monkeypatch.setenv("TPUFLOW_TRACE_ID", "job-42.retry")
+        assert env_trace_id() == "job-42.retry"
+        for bad in ("spaces in it", "x" * 65, "semi;colon"):
+            monkeypatch.setenv("TPUFLOW_TRACE_ID", bad)
+            with pytest.raises(ValueError, match="TPUFLOW_TRACE_ID"):
+                env_trace_id()
+
+    def test_train_inherits_env_trace(self, tmp_path, monkeypatch):
+        """The cross-process leg: a run launched with TPUFLOW_TRACE_ID
+        set writes every span under THAT trace — how a supervised
+        child attempt lands on its parent's trail."""
+        from tpuflow.api import TrainJobConfig, train
+
+        monkeypatch.setenv("TPUFLOW_TRACE_ID", "parent00000trace")
+        metrics_path = str(tmp_path / "metrics.jsonl")
+        train(TrainJobConfig(
+            model="static_mlp", max_epochs=1, batch_size=32, seed=0,
+            verbose=False, n_devices=1, synthetic_wells=2,
+            synthetic_steps=64, metrics_path=metrics_path,
+        ))
+        recs = [json.loads(l) for l in open(metrics_path)]
+        spans = [r for r in recs if r["event"] == "span"]
+        assert spans
+        assert {s.get("trace_id") for s in spans} == {"parent00000trace"}
+
+    def test_bound_trace_beats_env(self, monkeypatch):
+        from tpuflow.obs import current_trace_id, trace_from_env, use_trace
+
+        monkeypatch.setenv("TPUFLOW_TRACE_ID", "envenvenvenv0001")
+        with use_trace("boundbound000001"):
+            assert (current_trace_id() or trace_from_env()) \
+                == "boundbound000001"
+        assert (current_trace_id() or trace_from_env()) \
+            == "envenvenvenv0001"
+
+    def test_record_event_stamps_bound_trace(self):
+        from tpuflow.obs import record_event
+
+        clear_events()
+        with use_trace("stampstamp000001"):
+            rec = record_event("something_happened", detail=1)
+        assert rec["trace_id"] == "stampstamp000001"
+        # Explicit trace_id wins; unbound records carry none.
+        rec = record_event("other", trace_id="explicit000000001")
+        assert rec["trace_id"] == "explicit000000001"
+        rec = record_event("plain")
+        assert "trace_id" not in rec
+
+
+class TestForensicsIdentitySuffix:
+    def test_forensics_path_suffixing(self):
+        from tpuflow.obs.forensics import forensics_path
+
+        assert forensics_path("/store").endswith("/store/forensics.jsonl")
+        assert forensics_path("/store", identity="w3").endswith(
+            "/store/forensics-w3.jsonl"
+        )
+
+    def test_elastic_worker_identity_derived_from_config(self):
+        from tpuflow.api import TrainJobConfig
+        from tpuflow.api.train_api import _worker_identity
+
+        assert _worker_identity(TrainJobConfig()) is None
+        assert _worker_identity(TrainJobConfig(
+            elastic={"dir": "/g", "worker_id": 3, "n_workers": 4}
+        )) == "w3"
+
+    def test_obs_cli_reads_the_dump_family(self, tmp_path, capsys):
+        """`obs summary` over a glob merges sibling workers' dumps —
+        the collision fix's read side."""
+        from tpuflow.obs.__main__ import main
+
+        for wid in (0, 1):
+            with open(tmp_path / f"forensics-w{wid}.jsonl", "w") as f:
+                f.write(json.dumps({
+                    "event": "span", "name": "step",
+                    "time": float(wid), "duration_s": 0.1,
+                }) + "\n")
+        assert main(
+            ["summary", str(tmp_path / "forensics*.jsonl")]
+        ) == 0
+        out = capsys.readouterr().out
+        assert "2 events" in out
+        assert "step: n=2" in out
+        # A directory argument reads every *.jsonl under it.
+        assert main(["tail", str(tmp_path), "-n", "5"]) == 0
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert len(lines) == 2
 
 
 class TestTrainRunSpans:

@@ -512,9 +512,15 @@ class TestAsyncServerEndToEnd:
             assert status == 200 and health["status"] == "ok"
             status, m = _get(base, "/metrics")
             assert set(m) == {
-                "jobs", "predict", "serving", "replicas", "uptime_s",
+                "jobs", "predict", "serving", "replicas", "slo",
+                "uptime_s",
             }
             assert m["serving"]["admitted"] == 1
+            # The SLO section (tpuflow/obs/slo.py): one admitted
+            # request, nothing shed => availability budget untouched.
+            slo_rows = {r["name"]: r for r in m["slo"]["objectives"]}
+            assert slo_rows["availability"]["status"] == "ok"
+            assert slo_rows["availability"]["measured"] == 1.0
             assert m["predict"]["batching"]["mode"] == "continuous"
             with urllib.request.urlopen(
                 base + "/metrics?format=prometheus", timeout=10

@@ -71,6 +71,36 @@ class TestSupervise:
         # One restart, one backoff delay (jitter off -> exactly base).
         assert run.backoffs == [0.2] and slept == [0.2]
 
+    def test_restart_attempts_share_one_trace(self, tmp_path):
+        """The cross-process trace bugfix (ISSUE 14 satellite): a
+        supervised job exports ONE trace id to every child attempt via
+        TPUFLOW_TRACE_ID, so the pre-crash attempt's spans and the
+        recovery's land on the same trace instead of orphaning the
+        crash trail. Both attempts append to the same metrics JSONL —
+        exactly one trace id across all of their spans, and it is the
+        one the caller pinned in the environment."""
+        metrics_path = str(tmp_path / "metrics.jsonl")
+        spec = {
+            **_TINY, "storagePath": str(tmp_path), "fault_epoch": 3,
+            "metrics_path": metrics_path,
+        }
+        os.environ["TPUFLOW_TRACE_ID"] = "pinned0000000job"
+        try:
+            run = supervise(
+                spec, max_restarts=2, verbose=False,
+                backoff_base=0.0, backoff_jitter=0.0,
+                sleep=lambda _: None,
+            )
+        finally:
+            os.environ.pop("TPUFLOW_TRACE_ID", None)
+        assert run.attempts == 2  # one crash, one resumed finish
+        recs = [json.loads(l) for l in open(metrics_path)]
+        spans = [r for r in recs if r["event"] == "span"]
+        assert spans
+        # Spans from BOTH attempts (the resumed attempt re-runs epochs
+        # past the crash point), all under the pinned trace.
+        assert {s.get("trace_id") for s in spans} == {"pinned0000000job"}
+
     @pytest.mark.slow
     def test_clean_run_needs_no_restart(self, tmp_path):
         spec = {**_TINY, "storagePath": str(tmp_path)}

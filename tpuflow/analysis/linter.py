@@ -140,6 +140,14 @@ RULES = {
               "the factories in tpuflow/train/steps.py (or the "
               "autotuner's memoized step cache in train/loop.py) and "
               "call the built function in the loop",
+    "TPF015": "duration computed from a time.time() delta outside "
+              "tpuflow/obs/: wall-clock subtraction makes every span "
+              "duration, throughput number, and SLO window a casualty "
+              "of the next NTP step or leap smear — and the fleet "
+              "timeline (obs/fleet.py) draws those durations. Measure "
+              "durations with time.monotonic()/time.perf_counter(); "
+              "time.time() is for TIMESTAMPS (trail records, "
+              "heartbeats), never for deltas",
 }
 
 _HOST_SYNC_NAMES = {"float", "bool"}
@@ -268,6 +276,12 @@ _JIT_SEAM_SUFFIXES = (
 )
 _JIT_CALL_NAMES = {"jit", "pjit"}
 
+# TPF015: the one directory exempt from the wall-clock-delta rule —
+# tpuflow/obs/ owns the trail format, whose records carry wall-clock
+# `time` stamps by design (cross-process merge needs one shared clock);
+# its own span timing already uses perf_counter.
+_OBS_DIR_FRAGMENT = "tpuflow/obs/"
+
 # TPF010: scope and trigger. The rule fires only in the online package
 # (the one place a per-window device sync stalls a live ingest loop);
 # a "streaming-window consumer loop" is a for-loop whose ITERABLE
@@ -295,6 +309,7 @@ class _Linter(ast.NodeVisitor):
         self._is_compat = norm.endswith(_COMPAT_MODULE_SUFFIX)
         self._is_placement_layer = _PLACEMENT_DIR_FRAGMENT in norm
         self._is_online = _ONLINE_PATH_FRAGMENT in norm
+        self._is_obs = _OBS_DIR_FRAGMENT in norm
         self._socket_allowed = norm.endswith(_SOCKET_ALLOWED_SUFFIXES)
         self._jit_seam = norm.endswith(_JIT_SEAM_SUFFIXES)
 
@@ -375,6 +390,36 @@ class _Linter(ast.NodeVisitor):
                     "TPF003", value,
                     f"mutable class-level default in {node.name}",
                 )
+        self.generic_visit(node)
+
+    # --- TPF015: wall-clock deltas as durations ---
+
+    @staticmethod
+    def _is_wall_clock_call(node) -> bool:
+        """Literal ``time.time()`` — the module spelling only: an
+        injectable ``clock()`` variable is the drills' fake-clock
+        pattern and never flagged, and ``self.clock()`` defaults are a
+        deliberate seam."""
+        return (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "time"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time"
+        )
+
+    def visit_BinOp(self, node) -> None:
+        if (
+            not self._is_obs
+            and isinstance(node.op, ast.Sub)
+            and (self._is_wall_clock_call(node.left)
+                 or self._is_wall_clock_call(node.right))
+        ):
+            self._emit(
+                "TPF015", node,
+                f"{ast.unparse(node)} computes a duration from a "
+                "time.time() delta",
+            )
         self.generic_visit(node)
 
     # --- TPF006: per-step host sync in the epoch batch loop ---

@@ -516,6 +516,20 @@ def _validate_model_axis(config, jit_epoch: bool, n_dev: int) -> None:
         raise ValueError("; ".join(d.message for d in errors))
 
 
+def _worker_identity(config) -> str | None:
+    """This run's fleet identity ("w{N}" for an elastic worker, None
+    for a plain run): the suffix that keeps forensics dumps from
+    sibling processes sharing one storage root from clobbering each
+    other (tpuflow/obs/forensics.py::forensics_path)."""
+    block = getattr(config, "elastic", None)
+    if isinstance(block, dict) and "worker_id" in block:
+        try:
+            return f"w{int(block['worker_id'])}"
+        except (TypeError, ValueError):
+            return None
+    return None
+
+
 def train(
     config: TrainJobConfig,
     *,
@@ -557,14 +571,23 @@ def train(
         # below can only disarm handles that were recorded).
         specs = [parse_fault_spec(s) for s in config.faults]
         fault_handles = [arm(s) for s in specs]
-    from tpuflow.obs import dump_forensics, use_trace
+    from tpuflow.obs import (
+        current_trace_id,
+        dump_forensics,
+        trace_from_env,
+        use_trace,
+    )
     from tpuflow.train.loop import TrainingInterrupted
 
     try:
         # One run-scoped trace ID for the whole job: the fit loop's
         # ingest/step/eval/checkpoint spans all carry it, so a run's
-        # JSONL (and a crash dump) is filterable to this run.
-        with use_trace():
+        # JSONL (and a crash dump) is filterable to this run. An
+        # already-bound trace (the online loop's drift lifecycle) or a
+        # validated TPUFLOW_TRACE_ID (a supervised child attempt — all
+        # attempts of one job share the parent's trace) is INHERITED,
+        # never replaced: cross-process propagation is the whole point.
+        with use_trace(current_trace_id() or trace_from_env()):
             return _train_impl(
                 config, _data_cache=_data_cache, stop_fn=stop_fn
             )
@@ -576,10 +599,16 @@ def train(
         # just before?" trail. Best-effort; never masks the original
         # failure.
         if config.storage_path:
-            from tpuflow.utils.paths import join_path
+            from tpuflow.obs.forensics import forensics_path
 
+            # Elastic workers sharing one storage root must not clobber
+            # each other's last-moments trail: the dump is suffixed with
+            # the worker identity (forensics-w{N}.jsonl); plain runs
+            # keep the bare forensics.jsonl name.
             dump_forensics(
-                join_path(config.storage_path, "forensics.jsonl"),
+                forensics_path(
+                    config.storage_path, identity=_worker_identity(config)
+                ),
                 reason=f"train({config.model}) failed",
             )
         raise
@@ -604,7 +633,7 @@ def _train_impl(
             from tpuflow.train.loop import TrainingInterrupted
 
             raise TrainingInterrupted(reason)
-    t0 = time.time()
+    t0 = time.monotonic()  # duration clock (TPF015): NTP-step-proof
 
     names = config.column_names or SYNTHETIC_COLUMN_NAMES
     types = config.column_types or SYNTHETIC_COLUMN_TYPES
@@ -1051,6 +1080,7 @@ def _train_impl(
         compute_dtype=step_dtype,
         sync_fn=elastic_client.sync if elastic_client is not None else None,
         autotune=tuner,
+        run_identity=_worker_identity(config),
     )
     if elastic_client is not None:
         # Register with the gang: heartbeat thread + (for a fresh late
@@ -1144,7 +1174,7 @@ def _train_impl(
         # MAE is reported in RAW flow units for the Gilbert comparison.
         test_mae=test["mae"] * target_std,
         gilbert_mae=gilbert_test,
-        time_elapsed=time.time() - t0,
+        time_elapsed=time.monotonic() - t0,
         samples_per_sec=result.samples_per_sec / max(n_dev, 1),
         epoch_program=program.name,
         epoch_program_reason=f"{program.source}: {program.reason}",

@@ -92,6 +92,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="capture a jax.profiler trace of the first epoch here")
     p.add_argument("--metrics", default=None, metavar="PATH",
                    help="append per-epoch JSONL metric records to PATH")
+    p.add_argument("--trace-id", default=None, metavar="TOKEN",
+                   help="bind this trace id (1-64 chars of "
+                        "[A-Za-z0-9._-]) for the whole job instead of "
+                        "minting one: exported as TPUFLOW_TRACE_ID so "
+                        "supervised restart attempts, elastic workers, "
+                        "and online retrains all share ONE trace on the "
+                        "fleet timeline (python -m tpuflow.obs fleet)")
     p.add_argument("--health", default="warn",
                    choices=["warn", "abort", "halve_lr", "off"],
                    help="numerics-watchdog policy on NaN/Inf/spike "
@@ -202,6 +209,22 @@ def main(argv=None) -> int:
 
         return _serve_async.main(rest)
     args = build_parser().parse_args(argv)
+    if args.trace_id:
+        from tpuflow.obs.tracing import TRACE_ENV, clean_trace_id
+
+        if clean_trace_id(args.trace_id) != args.trace_id:
+            print(
+                f"--trace-id: {args.trace_id!r} is not a valid trace "
+                "token (1-64 chars of [A-Za-z0-9._-])",
+                file=sys.stderr,
+            )
+            return 2
+        # The env spelling is THE propagation channel: train() binds it,
+        # supervise() hands it to every child attempt, elastic workers
+        # and online retrains inherit it (tpuflow/obs/tracing.py).
+        import os
+
+        os.environ[TRACE_ENV] = args.trace_id
     if args.predict:
         return _predict_main(args)
     # Registry-backed parse-time validation: an unknown family dies HERE

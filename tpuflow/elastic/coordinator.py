@@ -62,6 +62,7 @@ class Coordinator:
         clock=time.time,
         sleep=time.sleep,
         verbose: bool = False,
+        trail_path: str | None = "auto",
     ):
         from tpuflow.obs import default_registry
 
@@ -149,6 +150,53 @@ class Coordinator:
             "async pushes rejected for exceeding the staleness bound",
         )
         os.makedirs(gang_dir, exist_ok=True)
+        # The coordinator's on-disk trail (the fleet-timeline lane for
+        # this process): every averaging-round span and membership event
+        # is appended as JSONL next to the gang files, so `python -m
+        # tpuflow.obs fleet <storage>` can merge the coordinator's view
+        # with the workers' metrics trails — the ring alone dies with
+        # the process unless something crashes. "auto" = the default
+        # path under gang_dir; None disables.
+        self._mlog = None
+        if trail_path is not None:
+            from tpuflow.utils.logging import MetricsLogger
+
+            if trail_path == "auto":
+                trail_path = os.path.join(
+                    gang_dir, "coordinator-metrics.jsonl"
+                )
+            self._mlog = MetricsLogger(trail_path)
+
+    def _event(self, name: str, **fields) -> None:
+        """One membership/round event: the forensics ring always, the
+        on-disk trail when one is configured."""
+        from tpuflow.obs import record_event
+
+        rec = record_event(name, **fields)
+        if self._mlog is not None:
+            self._mlog.write(
+                name,
+                **{k: v for k, v in rec.items() if k not in ("event", "time")},
+            )
+
+    def _traces_for(self, worker_ids) -> dict | None:
+        """{wid: trace_id} for the workers folded into a publication —
+        the cross-process link from a coordinator-side round span back
+        to each pushing worker's run trace. The socket transport's
+        GangStore learns traces from TPFX frame headers; backends
+        without the surface (the file reference implementation) yield
+        None (the span simply omits the field)."""
+        traces_fn = getattr(self.backend, "worker_traces", None)
+        if traces_fn is None:
+            return None
+        try:
+            known = traces_fn()
+        except Exception:
+            return None
+        out = {
+            str(wid): known[wid] for wid in worker_ids if wid in known
+        }
+        return out or None
 
     # ---- one scan ----
 
@@ -157,7 +205,7 @@ class Coordinator:
         the current round if it is ready (live set covered, or the round
         deadline expired with at least one push). Returns True when a
         round was published."""
-        from tpuflow.obs import record_event, record_span
+        from tpuflow.obs import record_span
 
         now = self.clock()
         if self._first_step is None:
@@ -172,7 +220,7 @@ class Coordinator:
         for wid in sorted(view.stale_ids - self.evicted):
             self.evicted.add(wid)
             self._evictions.inc()
-            record_event(
+            self._event(
                 "elastic_worker_evicted", worker_id=wid, round=self.round,
             )
             changed = True
@@ -186,7 +234,7 @@ class Coordinator:
             self.evicted.discard(wid)
             self.rejoins += 1
             self._rejoins.inc()
-            record_event(
+            self._event(
                 "elastic_worker_rejoined", worker_id=wid, round=self.round,
             )
             changed = True
@@ -270,8 +318,9 @@ class Coordinator:
         self.backend.publish(self.round, leaves, clock=self.clock)
         opened = self._round_opened if self._round_opened is not None else now
         record_span(
-            "elastic.round", max(now - opened, 0.0),
+            "elastic.round", max(now - opened, 0.0), logger=self._mlog,
             round=self.round, workers=len(used), worker_ids=used,
+            worker_traces=self._traces_for(used),
         )
         self.rounds[self.round] = used
         # The mirrored per-round membership is a diagnostic window, not
@@ -330,12 +379,13 @@ class Coordinator:
                 if self._stale_rejected.get(wid, -1) < r:
                     self._stale_rejected[wid] = r
                     self._stale.inc()
-                    from tpuflow.obs import record_event
-
-                    record_event(
+                    self._event(
                         "elastic_stale_push_rejected", worker_id=wid,
                         push_round=r, frontier=frontier,
                         staleness=frontier - r,
+                        worker_trace=(self._traces_for([wid]) or {}).get(
+                            str(wid)
+                        ),
                     )
                     if self.verbose:
                         print(
@@ -376,8 +426,9 @@ class Coordinator:
             return False
         self.backend.publish(frontier, leaves, clock=self.clock)
         record_span(
-            "elastic.round", 0.0,
+            "elastic.round", 0.0, logger=self._mlog,
             round=frontier, workers=len(used), worker_ids=used,
+            worker_traces=self._traces_for(used),
             mode="async",
         )
         self.rounds[frontier] = used
@@ -440,7 +491,7 @@ class Coordinator:
         finished. On an unexpected abort the coordinator state and the
         recent-event ring are dumped next to the gang files before the
         error propagates."""
-        from tpuflow.obs import dump_forensics, record_event
+        from tpuflow.obs import dump_forensics
 
         try:
             while stop is None or not stop.is_set():
@@ -451,7 +502,7 @@ class Coordinator:
             self._write_state(self.clock())
             return self.state()
         except BaseException as e:
-            record_event(
+            self._event(
                 "elastic_coordinator_abort",
                 round=self.round,
                 error=f"{type(e).__name__}: {e}",
@@ -465,6 +516,9 @@ class Coordinator:
                 reason=f"elastic coordinator aborted at round {self.round}",
             )
             raise
+        finally:
+            if self._mlog is not None:
+                self._mlog.close()
 
     # ---- state ----
 

@@ -130,6 +130,15 @@ def worker_spec(
         spec["save_every"] = 1
     if spec.get("n_devices") is None:
         spec["n_devices"] = 1
+    # Per-worker metrics trail by default: each worker's spans (ingest/
+    # step/elastic.sync, each stamped with the worker's run trace) land
+    # under its own checkpoint tree, so `python -m tpuflow.obs fleet
+    # <storagePath>` gets one lane per worker out of the box. An
+    # explicit metrics path in the base spec is honored untouched.
+    if not spec.get("metrics_path") and not spec.get("metricsPath"):
+        spec["metrics_path"] = os.path.join(
+            spec["storagePath"], "metrics.jsonl"
+        )
     spec["elastic"] = {
         "dir": gang_dir,
         "worker_id": worker_id,

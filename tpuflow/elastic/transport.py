@@ -186,6 +186,25 @@ class GangStore:
         self._averages: dict[int, list[np.ndarray]] = {}
         self._latest: int | None = None
         self._offsets: dict[int, int] = {}
+        # wid -> the worker's run trace id (off the TPFX frame headers):
+        # coordinator-side spans (averaging rounds, staleness
+        # rejections) name the pushing worker's trace, so the fleet
+        # timeline links a push to the average it landed in.
+        self._traces: dict[int, str] = {}
+
+    # --- trace propagation (TPFX header -> coordinator spans) ---
+
+    def note_trace(self, worker_id: int, trace_id) -> None:
+        from tpuflow.obs.tracing import clean_trace_id
+
+        tid = clean_trace_id(trace_id)
+        if tid is not None:
+            with self._lock:
+                self._traces[int(worker_id)] = tid
+
+    def worker_traces(self) -> dict[int, str]:
+        with self._lock:
+            return dict(self._traces)
 
     # --- membership (server-stamped arrival times) ---
 
@@ -381,6 +400,8 @@ class _Handler(socketserver.BaseRequestHandler):
         if op == "ping":
             return {"ok": True}, b""
         if op == "heartbeat":
+            if header.get("trace"):
+                store.note_trace(int(header["worker_id"]), header["trace"])
             accepted = store.write_heartbeat(
                 int(header["worker_id"]),
                 epoch=int(header.get("epoch", 0)),
@@ -389,6 +410,8 @@ class _Handler(socketserver.BaseRequestHandler):
             )
             return {"ok": True, "accepted": bool(accepted)}, b""
         if op == "push":
+            if header.get("trace"):
+                store.note_trace(int(header["worker_id"]), header["trace"])
             store.push_leaves(
                 self._round_key(header), int(header["worker_id"]),
                 exchange.decode_leaves(payload),
@@ -509,13 +532,24 @@ class TransportClient:
         Raises the last transport error once the retry policy is
         exhausted, or ``RuntimeError`` on an op-level server error."""
 
+        # The caller's bound trace rides every frame header: the
+        # coordinator-side store remembers each worker's trace, so
+        # averaging-round spans link back to the pushing workers on the
+        # merged fleet timeline. Read once per request, outside retries.
+        from tpuflow.obs.tracing import current_trace_id
+
+        trace = current_trace_id()
+
         def attempt():
             fault_point("elastic.transport.partition")
             with socket.create_connection(
                 (self.host, self.port), timeout=self.timeout
             ) as sock:
                 fault_point("elastic.transport.send", index=index)
-                send_frame(sock, {"op": op, **(header or {})}, payload)
+                hdr = {"op": op, **(header or {})}
+                if trace is not None:
+                    hdr.setdefault("trace", trace)
+                send_frame(sock, hdr, payload)
                 fault_point("elastic.transport.recv")
                 return recv_frame(sock)
 

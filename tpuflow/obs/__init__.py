@@ -56,10 +56,13 @@ from tpuflow.obs.metrics import (
 )
 from tpuflow.obs.prometheus import render_prometheus
 from tpuflow.obs.tracing import (
+    TRACE_ENV,
+    clean_trace_id,
     current_trace_id,
     new_trace_id,
     record_span,
     span,
+    trace_from_env,
     use_trace,
 )
 
@@ -75,6 +78,8 @@ __all__ = [
     "RecompileDetector",
     "Registry",
     "Summary",
+    "TRACE_ENV",
+    "clean_trace_id",
     "clear_events",
     "current_trace_id",
     "default_registry",
@@ -87,5 +92,6 @@ __all__ = [
     "record_span",
     "render_prometheus",
     "span",
+    "trace_from_env",
     "use_trace",
 ]

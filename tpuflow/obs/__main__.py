@@ -2,19 +2,33 @@
 
 Usage::
 
-    python -m tpuflow.obs tail     <metrics.jsonl> [-n N]
-    python -m tpuflow.obs summary  <metrics.jsonl>
+    python -m tpuflow.obs tail     <trail.jsonl|glob|dir> [...] [-n N]
+    python -m tpuflow.obs summary  <trail.jsonl|glob|dir> [...]
     python -m tpuflow.obs timeline <metrics.jsonl> -o trace.json
+    python -m tpuflow.obs fleet    <dir...> [-o fleet.json] [--summary P]
+    python -m tpuflow.obs slo      <dir...> [--objectives F] [-o card.json]
 
-All subcommands read the JSONL event format every tpuflow sink writes —
-a training run's ``metrics.jsonl`` (``--metrics`` / ``metrics_path``),
-a crash dump's ``forensics.jsonl``, or a serve journal. ``tail`` prints
-the newest N records (default 20), one per line, newest last. ``summary``
-aggregates the whole trail: events by type, epoch-loss trajectory, span
-time by name, and the wall-clock window covered — the two-second answer
-to "what did this run do and where did the time go". ``timeline``
-exports the trail's spans as Chrome trace-event JSON, loadable in
-Perfetto (https://ui.perfetto.dev) — "where did the time go", drawn.
+``tail``/``summary`` read the JSONL event format every tpuflow sink
+writes — a training run's ``metrics.jsonl`` (``--metrics`` /
+``metrics_path``), a crash dump's ``forensics.jsonl``, a serve journal —
+and accept several of them at once: multiple paths, shell-style glob
+patterns (``'store/forensics*.jsonl'`` — elastic workers suffix their
+dumps with a worker identity, so a shared storage root holds a family),
+or a directory (every ``*.jsonl`` under it). Events merge ordered by
+timestamp. ``tail`` prints the newest N records (default 20), newest
+last; ``summary`` aggregates the whole trail: events by type, the
+epoch-loss trajectory, span time by name, the wall-clock window.
+``timeline`` exports one trail's spans as Chrome trace-event JSON,
+loadable in Perfetto (https://ui.perfetto.dev).
+
+``fleet`` is the multi-process view (``tpuflow/obs/fleet.py``): discover
+every trail under one or more storage roots, merge them into ONE
+Chrome-trace timeline — a lane group per process, a fleet-wide time
+zero, and flow arrows connecting every trace id seen in more than one
+process — and print the fleet summary JSON. ``slo`` scores the same
+merged events against declarative objectives
+(``tpuflow/obs/slo.py``) and emits the SLO report card, validated
+against the committed ``slo_report_card.schema.json``.
 
 Torn trails are data, not errors: corrupt/truncated lines (a forensics
 dump written during a crash can end mid-line, even mid-UTF-8-sequence)
@@ -27,14 +41,59 @@ only has the log files.
 from __future__ import annotations
 
 import argparse
+import glob as _glob
 import json
+import os
 import sys
 
 from tpuflow.obs.trail import read_events as _read_events
 
 
-def _tail(path: str, n: int) -> int:
-    events, skipped = _read_events(path)
+def _expand(patterns: list[str]) -> list[str]:
+    """Paths from a mix of files, glob patterns, and directories
+    (directories walk through ``fleet.iter_jsonl`` — the SAME discovery
+    the fleet merger uses, so tail/summary and fleet agree on what a
+    storage root contains). Missing literal paths stay in the list so
+    the caller's OSError handling names them (a typo'd path must not
+    silently vanish)."""
+    from tpuflow.obs.fleet import iter_jsonl
+
+    out: list[str] = []
+    for pat in patterns:
+        if os.path.isdir(pat):
+            out.extend(iter_jsonl(pat))
+            continue
+        matches = sorted(_glob.glob(pat))
+        out.extend(matches if matches else [pat])
+    # De-dup, order-preserving: one file named twice must not count
+    # its events twice.
+    seen, unique = set(), []
+    for path in out:
+        key = os.path.abspath(path)
+        if key not in seen:
+            seen.add(key)
+            unique.append(path)
+    return unique
+
+
+def _read_all(patterns: list[str]) -> tuple[list[dict], int, int]:
+    """Merged events (time-ordered) + skipped-line count + file count
+    across every expanded path."""
+    from tpuflow.obs.fleet import event_time_key
+
+    events: list[dict] = []
+    skipped = 0
+    paths = _expand(patterns)
+    for path in paths:
+        evs, skip = _read_events(path)
+        events.extend(evs)
+        skipped += skip
+    events.sort(key=event_time_key)
+    return events, skipped, len(paths)
+
+
+def _tail(patterns: list[str], n: int) -> int:
+    events, skipped, _ = _read_all(patterns)
     for rec in events[-n:]:
         print(json.dumps(rec))
     if skipped:
@@ -46,17 +105,20 @@ def _fmt_seconds(s: float) -> str:
     return f"{s:.3f}s" if s < 120 else f"{s / 60:.1f}m"
 
 
-def _summary(path: str) -> int:
-    events, skipped = _read_events(path)
+def _summary(patterns: list[str]) -> int:
+    events, skipped, n_files = _read_all(patterns)
+    label = patterns[0] if len(patterns) == 1 and n_files == 1 else (
+        f"{n_files} trails ({', '.join(patterns)})"
+    )
     if not events:
-        print(f"{path}: no events"
+        print(f"{label}: no events"
               + (f" (skipped_lines: {skipped})" if skipped else ""))
         return 1
     by_type: dict[str, int] = {}
     for rec in events:
         kind = str(rec.get("event", "?"))
         by_type[kind] = by_type.get(kind, 0) + 1
-    print(f"{path}: {len(events)} events"
+    print(f"{label}: {len(events)} events"
           + (f" (skipped_lines: {skipped})" if skipped else ""))
     times = [rec["time"] for rec in events if isinstance(rec.get("time"), (int, float))]
     if times:
@@ -131,32 +193,122 @@ def _timeline(path: str, out: str) -> int:
     return 0
 
 
+def _fleet(roots: list[str], out: str, summary_path: str | None) -> int:
+    from tpuflow.obs.fleet import export_fleet
+
+    missing = [r for r in roots if not os.path.exists(r)]
+    if missing:
+        print(f"no such path(s): {missing}", file=sys.stderr)
+        return 2
+    summary = export_fleet(roots, out)
+    if summary_path:
+        with open(summary_path, "w", encoding="utf-8") as f:
+            json.dump(summary, f, indent=2)
+            f.write("\n")
+    print(json.dumps(summary, indent=2))
+    if not summary["trails"]:
+        print("no trails discovered", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _slo(
+    roots: list[str], objectives_path: str | None, out: str | None,
+    window_s: float,
+) -> int:
+    from tpuflow.obs.fleet import read_fleet
+    from tpuflow.obs.slo import (
+        load_objectives,
+        report_card,
+        validate_report_card,
+    )
+
+    missing = [r for r in roots if not os.path.exists(r)]
+    if missing:
+        print(f"no such path(s): {missing}", file=sys.stderr)
+        return 2
+    objectives = (
+        load_objectives(objectives_path) if objectives_path else None
+    )
+    trails, events = read_fleet(roots)
+    card = report_card(
+        events, objectives, window_s=window_s,
+        source={"roots": [os.path.abspath(r) for r in roots],
+                "trails": [t["path"] for t in trails]},
+    )
+    validate_report_card(card)
+    if out:
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(card, f, indent=2)
+            f.write("\n")
+    print(json.dumps(card, indent=2))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tpuflow.obs",
-        description="summarize/tail/export a tpuflow JSONL event trail",
+        description="summarize/tail/export tpuflow JSONL event trails",
     )
     sub = ap.add_subparsers(dest="cmd", required=True)
     p_tail = sub.add_parser("tail", help="print the newest N records")
-    p_tail.add_argument("file")
+    p_tail.add_argument("file", nargs="+",
+                        help="trail file(s), glob pattern(s), or dir(s)")
     p_tail.add_argument("-n", type=int, default=20)
     p_sum = sub.add_parser("summary", help="aggregate the whole trail")
-    p_sum.add_argument("file")
+    p_sum.add_argument("file", nargs="+",
+                       help="trail file(s), glob pattern(s), or dir(s)")
     p_tl = sub.add_parser(
         "timeline",
         help="export spans as Chrome trace-event JSON (Perfetto-loadable)",
     )
     p_tl.add_argument("file")
     p_tl.add_argument("-o", "--out", default="trace.json")
+    p_fleet = sub.add_parser(
+        "fleet",
+        help="merge every trail under storage root(s) into one "
+        "fleet timeline (per-process lanes + trace flow arrows) "
+        "and print the fleet summary",
+    )
+    p_fleet.add_argument("root", nargs="+",
+                         help="storage root(s) to discover trails under")
+    p_fleet.add_argument("-o", "--out", default="fleet-trace.json",
+                         help="merged Chrome trace-event JSON output")
+    p_fleet.add_argument("--summary", default=None, metavar="PATH",
+                         help="also write the fleet summary JSON here")
+    p_slo = sub.add_parser(
+        "slo",
+        help="score fleet trails against SLO objectives and emit the "
+        "report card (validated against slo_report_card.schema.json)",
+    )
+    p_slo.add_argument("root", nargs="+",
+                       help="storage root(s) to discover trails under")
+    p_slo.add_argument("--objectives", default=None, metavar="FILE",
+                       help="JSON objectives file — a list of {name, "
+                       "kind, target, ...} dicts (default: the "
+                       "availability + latency_p99 pair; add a "
+                       "time_to_adapt objective to grade drift "
+                       "lifecycles — docs/observability.md)")
+    p_slo.add_argument("-o", "--out", default=None, metavar="PATH",
+                       help="also write the report card JSON here")
+    p_slo.add_argument("--window", type=float, default=300.0,
+                       metavar="S", help="burn-rate window seconds")
     args = ap.parse_args(argv)
     try:
         if args.cmd == "tail":
             return _tail(args.file, args.n)
         if args.cmd == "timeline":
             return _timeline(args.file, args.out)
+        if args.cmd == "fleet":
+            return _fleet(args.root, args.out, args.summary)
+        if args.cmd == "slo":
+            return _slo(args.root, args.objectives, args.out, args.window)
         return _summary(args.file)
     except OSError as e:
-        print(f"{args.file}: {e}", file=sys.stderr)
+        print(f"{e}", file=sys.stderr)
+        return 2
+    except ValueError as e:
+        print(f"{e}", file=sys.stderr)
         return 2
 
 

@@ -1,0 +1,310 @@
+"""Fleet aggregation: every process's trail, one merged timeline.
+
+A multi-process tpuflow deployment — elastic workers under supervisors,
+the averaging coordinator, serving daemons, the online retrain loop —
+leaves one JSONL trail per process under the shared storage root
+(workers' ``metrics.jsonl``, the coordinator's
+``coordinator-metrics.jsonl``, the online loop's
+``online/metrics.jsonl``, daemon trails, ``forensics*.jsonl`` crash
+dumps). Each is readable alone (``python -m tpuflow.obs summary``); the
+BigDL lesson (PAPERS.md) is that a distributed job is debuggable only
+from the merged, driver-side view. This module builds it:
+
+- :func:`discover_trails` walks storage roots and finds every ``*.jsonl``
+  trail, naming each process lane from its relative path
+  (``worker0/metrics``, ``elastic/coordinator-metrics``, ...).
+- :func:`merge_fleet` reads them all (tolerantly — ``trail.py``; torn
+  lines are counted, never fatal), normalizes every trail against ONE
+  fleet-wide time zero, and emits a single Chrome trace-event document:
+  one ``pid`` (lane group) per process, plus **trace-id flow arrows**
+  (``ph: s/t/f``) connecting the spans/marks of any trace id observed
+  in more than one process — a worker's push visibly flows into the
+  coordinator's averaging round; a drift window flows through retrain,
+  swap, and the daemon's reload.
+- :func:`fleet_summary` rolls the same trails up per process (events,
+  span time by name, anomalies, faults, trace ids) plus the
+  cross-process trace table — the two-second answer to "what did the
+  FLEET do".
+
+Deliberately dependency-light (no jax import): usable on a machine that
+only has the log files. ``python -m tpuflow.obs fleet <dir...>`` is the
+shell entry; the SLO report card over the same merged events lives in
+``tpuflow/obs/slo.py`` (``python -m tpuflow.obs slo``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from tpuflow.obs.timeline import (
+    earliest_start,
+    split_events,
+    to_trace_events,
+)
+from tpuflow.obs.trail import read_events
+
+# Filenames that are JSONL but NOT event trails (job journals hold
+# request/job records the timeline cannot draw; they still merge fine —
+# non-span records are simply not drawable — so this is only a naming
+# nicety, not a correctness filter).
+_TRAIL_SUFFIX = ".jsonl"
+
+
+def iter_jsonl(root: str) -> list[str]:
+    """Every ``*.jsonl`` under ``root``, deterministically ordered —
+    THE one directory walk trail discovery uses (``discover_trails``
+    here, ``python -m tpuflow.obs tail|summary`` for directory
+    arguments), so every consumer agrees on what a storage root
+    contains."""
+    out = []
+    for dirpath, dirs, files in sorted(os.walk(root)):
+        dirs.sort()
+        out.extend(
+            os.path.join(dirpath, fn) for fn in sorted(files)
+            if fn.endswith(_TRAIL_SUFFIX)
+        )
+    return out
+
+
+def event_time_key(rec: dict):
+    """Sort key for merged fleet events: by timestamp, records without
+    a finite time first — shared by every multi-trail reader."""
+    t = rec.get("time")
+    return t if isinstance(t, (int, float)) else float("-inf")
+
+
+def discover_trails(roots) -> list[dict]:
+    """Every ``*.jsonl`` under each root (a file argument names itself),
+    as ``{"path", "process"}`` — ``process`` is the lane label, derived
+    from the path relative to its root (extension dropped; a bare
+    ``metrics`` at the root keeps its directory's name for context)."""
+    if isinstance(roots, (str, os.PathLike)):
+        roots = [roots]
+    out, seen = [], set()
+    for root in roots:
+        root = os.fspath(root)
+        if os.path.isfile(root):
+            path = os.path.abspath(root)
+            if path not in seen:
+                seen.add(path)
+                out.append({
+                    "path": path,
+                    "process": os.path.splitext(os.path.basename(path))[0],
+                })
+            continue
+        for found in iter_jsonl(root):
+            path = os.path.abspath(found)
+            if path in seen:
+                continue
+            seen.add(path)
+            rel = os.path.relpath(path, root)
+            process = os.path.splitext(rel)[0].replace(os.sep, "/")
+            out.append({"path": path, "process": process})
+    return out
+
+
+def read_fleet(roots) -> tuple[list[dict], list[dict]]:
+    """``(trails, all_events)``: each trail dict grows ``events`` and
+    ``skipped_lines``; ``all_events`` is every record across the fleet,
+    sorted by time (records without a finite time sort first)."""
+    trails = discover_trails(roots)
+    all_events: list[dict] = []
+    for trail in trails:
+        events, skipped = read_events(trail["path"])
+        trail["events"] = events
+        trail["skipped_lines"] = skipped
+        all_events.extend(events)
+    all_events.sort(key=event_time_key)
+    return trails, all_events
+
+
+def _trace_refs(rec: dict):
+    """Every trace id a record REFERENCES: its own bound ``trace_id``,
+    plus cross-process links carried as data — the coordinator's
+    ``worker_traces`` map (an averaging round naming the pushing
+    workers' traces) and singular ``worker_trace`` fields (staleness
+    rejections). A record that names a trace belongs on that trace's
+    flow arrow even when its own process had nothing bound."""
+    tid = rec.get("trace_id")
+    if tid:
+        yield str(tid)
+    wt = rec.get("worker_trace")
+    if wt:
+        yield str(wt)
+    wts = rec.get("worker_traces")
+    if isinstance(wts, dict):
+        for v in wts.values():
+            if v:
+                yield str(v)
+
+
+def _flow_events(trails: list[dict], base: float) -> list[dict]:
+    """Chrome trace flow arrows (``ph`` s/t/f, one ``id`` per trace id)
+    for every trace id that appears in MORE THAN ONE process: the
+    cross-process causal links the propagation legs exist to create.
+    Each arrow point binds to its process's lane at the record's
+    timestamp; within one trace, points are ordered by time."""
+    sightings: dict[str, list[tuple[float, int, dict]]] = {}
+    for pid, trail in enumerate(trails, start=1):
+        spans, instants = split_events(trail["events"])
+        for is_span, recs in ((True, spans), (False, instants)):
+            for rec in recs:
+                t = rec["time"] - (rec["duration_s"] if is_span else 0.0)
+                for tid in set(_trace_refs(rec)):
+                    sightings.setdefault(tid, []).append((t, pid, rec))
+    out = []
+    for trace_id, points in sorted(sightings.items()):
+        if len({pid for _, pid, _ in points}) < 2:
+            continue
+        points.sort(key=lambda p: p[0])
+        # One arrow point per (process, trace): first sighting in each
+        # process — N points per process would draw a hairball.
+        first_in: dict[int, tuple[float, int, dict]] = {}
+        for p in points:
+            first_in.setdefault(p[1], p)
+        chain = sorted(first_in.values(), key=lambda p: p[0])
+        for i, (t, pid, rec) in enumerate(chain):
+            ph = "s" if i == 0 else ("f" if i == len(chain) - 1 else "t")
+            evt = {
+                "name": f"trace {trace_id}",
+                "cat": "trace",
+                "ph": ph,
+                "id": trace_id,
+                "ts": round((t - base) * 1e6, 3),
+                "pid": pid,
+                "tid": _tid_of(rec),
+            }
+            if ph == "f":
+                evt["bp"] = "e"  # bind to the enclosing slice
+            out.append(evt)
+    return out
+
+
+def _tid_of(rec: dict) -> int:
+    """The lane (tid) ``to_trace_events`` draws this record in — flow
+    endpoints must anchor to the SAME lane as the span/mark they
+    reference, so the routing mirrors the exporter's: spans by name;
+    instants by ``site`` when set, else by event name, defaulting to
+    the train lane."""
+    from tpuflow.obs.timeline import _lane
+
+    if rec.get("event") == "span":
+        return _lane(str(rec.get("name", "")))[0]
+    site = str(rec.get("site", ""))
+    tid, lane = _lane(site) if site else _lane(str(rec.get("event", "")))
+    return 1 if lane == "other" else tid
+
+
+def merge_fleet(roots) -> tuple[dict, dict]:
+    """Merge every discovered trail into ONE Chrome trace-event document
+    (per-process lane groups, fleet-wide time zero, trace-id flow
+    arrows) and the fleet summary JSON. Returns ``(doc, summary)``."""
+    trails, all_events = read_fleet(roots)
+    bases = [
+        b for b in (earliest_start(t["events"]) for t in trails)
+        if b is not None
+    ]
+    if not bases:
+        return (
+            {"traceEvents": [], "displayTimeUnit": "ms"},
+            fleet_summary(trails, all_events),
+        )
+    base = min(bases)
+    merged: list[dict] = []
+    for pid, trail in enumerate(trails, start=1):
+        doc = to_trace_events(trail["events"], pid=pid, base=base)
+        if doc["traceEvents"]:
+            merged.append({
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "args": {"name": trail["process"]},
+            })
+            merged.extend(doc["traceEvents"])
+    merged.extend(_flow_events(trails, base))
+    return (
+        {"traceEvents": merged, "displayTimeUnit": "ms"},
+        fleet_summary(trails, all_events),
+    )
+
+
+def fleet_summary(trails: list[dict], all_events: list[dict]) -> dict:
+    """Per-process rollups + the cross-process trace table."""
+    processes = []
+    trace_procs: dict[str, set] = {}
+    for trail in trails:
+        events = trail["events"]
+        by_type: dict[str, int] = {}
+        spans: dict[str, list] = {}
+        traces = set()
+        anomalies = faults = 0
+        for rec in events:
+            kind = str(rec.get("event", "?"))
+            by_type[kind] = by_type.get(kind, 0) + 1
+            for tid in set(_trace_refs(rec)):
+                traces.add(tid)
+                trace_procs.setdefault(tid, set()).add(trail["process"])
+            if kind == "span":
+                name = str(rec.get("name", "?"))
+                dur = rec.get("duration_s")
+                n_total = spans.setdefault(name, [0, 0.0])
+                n_total[0] += 1
+                if isinstance(dur, (int, float)):
+                    n_total[1] += float(dur)
+            elif kind in ("numerics_anomaly", "drift_anomaly"):
+                anomalies += 1
+            elif kind == "fault_injected":
+                faults += 1
+        processes.append({
+            "process": trail["process"],
+            "path": trail["path"],
+            "events": len(events),
+            "skipped_lines": trail["skipped_lines"],
+            "by_event": dict(sorted(by_type.items())),
+            "spans": {
+                name: {"n": n, "total_s": round(total, 6)}
+                for name, (n, total) in sorted(spans.items())
+            },
+            "anomalies": anomalies,
+            "faults": faults,
+            "trace_ids": len(traces),
+        })
+    cross = {
+        tid: sorted(procs)
+        for tid, procs in sorted(trace_procs.items())
+        if len(procs) > 1
+    }
+    times = [
+        r["time"] for r in all_events
+        if isinstance(r.get("time"), (int, float))
+    ]
+    return {
+        "processes": processes,
+        "trails": len(trails),
+        "events": len(all_events),
+        "window_s": round(max(times) - min(times), 3) if times else 0.0,
+        "cross_process_traces": cross,
+    }
+
+
+def export_fleet(roots, out_path: str) -> dict:
+    """Read every trail under ``roots``, write the merged trace-event
+    JSON to ``out_path``, and return the fleet summary (the CLI's
+    report)."""
+    doc, summary = merge_fleet(roots)
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+        f.write("\n")
+    summary["timeline"] = {
+        "path": out_path,
+        "events": len(doc["traceEvents"]),
+        "spans": sum(
+            1 for e in doc["traceEvents"] if e.get("ph") == "X"
+        ),
+        "flows": sum(
+            1 for e in doc["traceEvents"]
+            if e.get("ph") in ("s", "t", "f")
+        ),
+    }
+    return summary

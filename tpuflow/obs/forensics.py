@@ -45,15 +45,46 @@ _HOT_RING: deque = deque(maxlen=HOT_CAPACITY)
 
 def record_event(event: str, hot: bool = False, **fields) -> dict:
     """Append one event to the ring (``hot=True`` for high-rate serving
-    events, which get their own bounded ring). Never raises — forensics
-    must not fail the code path it observes."""
+    events, which get their own bounded ring). The bound trace ID (if
+    any) is stamped in, so every ring event — fault firings, eviction
+    notices, swap records — is causally linkable across the fleet
+    timeline, not just the span events. Never raises — forensics must
+    not fail the code path it observes."""
     rec = {"event": event, "time": time.time(), **fields}
+    if "trace_id" not in rec:
+        try:
+            from tpuflow.obs.tracing import current_trace_id
+
+            tid = current_trace_id()
+            if tid is not None:
+                rec["trace_id"] = tid
+        except Exception:
+            pass
     try:
         with _LOCK:
             (_HOT_RING if hot else _RING).append(rec)
     except Exception:
         pass
     return rec
+
+
+def forensics_path(storage: str, identity: str | None = None) -> str:
+    """The dump path under a storage root: ``forensics.jsonl`` for a
+    plain run, ``forensics-{identity}.jsonl`` when the process carries a
+    fleet identity (an elastic worker id, a daemon role). Processes
+    sharing one storage root MUST dump to distinct names — the crash
+    trail is exactly the file a concurrent sibling's dump would clobber
+    — and ``python -m tpuflow.obs tail|summary|fleet`` read the whole
+    ``forensics*.jsonl`` family."""
+    import os
+
+    name = f"forensics-{identity}.jsonl" if identity else "forensics.jsonl"
+    try:
+        from tpuflow.utils.paths import join_path
+
+        return join_path(storage, name)
+    except Exception:
+        return os.path.join(storage, name)
 
 
 def recent_events(n: int | None = None) -> list[dict]:

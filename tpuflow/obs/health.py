@@ -107,6 +107,7 @@ class NumericsWatchdog:
         logger=None,
         registry=None,
         verbose: bool = True,
+        dump_identity: str | None = None,
     ):
         if policy not in HEALTH_POLICIES:
             raise ValueError(
@@ -122,6 +123,9 @@ class NumericsWatchdog:
         self.model_name = model_name
         self.logger = logger
         self.verbose = verbose
+        # Fleet identity suffix for the dump file (an elastic worker id):
+        # siblings sharing one storage root must not clobber each other.
+        self.dump_identity = dump_identity
         self.anomalies: list[dict] = []
         self.halvings = 0
         self._ewma_loss: float | None = None
@@ -223,11 +227,11 @@ class NumericsWatchdog:
         if self._dumped or not self.storage_path:
             return
         self._dumped = True
-        from tpuflow.utils.paths import join_path
+        from tpuflow.obs.forensics import forensics_path
 
         kinds = ",".join(a["kind"] for a in found)
         dump_forensics(
-            join_path(self.storage_path, "forensics.jsonl"),
+            forensics_path(self.storage_path, identity=self.dump_identity),
             reason=f"numerics watchdog: {kinds} in {self.model_name}",
         )
 

@@ -1,0 +1,712 @@
+"""The SLO engine: declarative objectives, error budgets, burn rates.
+
+The chaos-soak report card (ROADMAP item 5) needs substrate: something
+that turns "the daemon served through the swap" into numbers a run can
+be GRADED by. This module is that substrate, in three layers:
+
+1. **Pure math** — :func:`burn_rate`, :func:`error_budget_remaining`,
+   :func:`windowed_burn_rates`: the standard SRE error-budget algebra
+   (a target of 0.999 over N requests buys ``(1-0.999)*N`` failures;
+   burn rate is the observed error rate divided by the budgeted one, so
+   ``1.0`` = spending exactly sustainably, ``>1`` = the budget dies
+   before the window does). Unit-tested against hand-computed windows.
+2. **Declarative objectives** — :func:`normalize_objectives` validates
+   ``{"name", "kind", "target", ...}`` dicts of four kinds:
+   ``availability`` (good/bad event ratio), ``latency_p99`` (summary
+   quantile vs a ceiling), ``goodput_floor`` (completed work per second
+   vs a floor), and ``time_to_adapt`` (drift-detect -> reload lifecycle
+   duration vs a ceiling — computable BECAUSE the trace propagation
+   makes a lifecycle one trace id).
+3. **Evaluation** — :class:`SloEngine` scores objectives from a live
+   metrics :class:`~tpuflow.obs.metrics.Registry` (both serve daemons
+   evaluate at scrape time: the ``slo`` section of the JSON ``/metrics``
+   view and ``slo_error_budget_remaining{objective=}`` /
+   ``slo_burn_rate{objective=}`` gauges in the Prometheus exposition),
+   and :func:`report_card` scores them from merged fleet trail events
+   (``python -m tpuflow.obs slo <dir...>``).
+
+The report card is a committed JSON contract
+(``tpuflow/obs/slo_report_card.schema.json``);
+:func:`validate_report_card` checks a card against it — with
+``jsonschema`` when installed, and a built-in structural check
+otherwise, so the log-reading CLI stays dependency-light (no jax, no
+hard third-party requirement).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+
+SCHEMA_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "slo_report_card.schema.json"
+)
+SCHEMA_ID = "tpuflow.slo.report_card/v1"
+
+KINDS = ("availability", "latency_p99", "goodput_floor", "time_to_adapt")
+STATUSES = ("ok", "at_risk", "violated", "no_data")
+
+# The serve daemons' default objective set: availability over
+# admitted-vs-shed and a p99 ceiling over the request latency summary.
+# Counter names are tried in order (the async daemon has admission
+# counters, the threaded daemon doesn't); "bad" names are SUMMED over
+# whichever exist. Targets are env-tunable (TPUFLOW_SERVE_SLO_*).
+DEFAULT_SERVE_OBJECTIVES = (
+    {
+        "name": "availability",
+        "kind": "availability",
+        "target": 0.999,
+        "good": ("serving_admitted_total", "predict_requests_total"),
+        "bad": (
+            "serving_shed_total",
+            "predict_batch_rejected_total",
+            "predict_batch_expired_total",
+        ),
+    },
+    {
+        "name": "latency_p99",
+        "kind": "latency_p99",
+        "target": 500.0,  # ms
+        "summary": "predict_latency_ms",
+    },
+)
+
+
+def serve_objectives(objectives=None) -> list[dict]:
+    """The serve daemons' objective list: an explicit list passes
+    through :func:`normalize_objectives` untouched; None builds the
+    default availability + p99 pair with env-tunable targets
+    (``TPUFLOW_SERVE_SLO_TARGET`` — the availability ratio;
+    ``TPUFLOW_SERVE_SLO_P99_MS`` — the latency ceiling), validated at
+    read time like every other ``TPUFLOW_SERVE_*`` knob."""
+    if objectives is not None:
+        return normalize_objectives(objectives)
+    from tpuflow.utils.env import env_num
+
+    target = env_num(
+        "TPUFLOW_SERVE_SLO_TARGET", 0.999, float, minimum=1e-9,
+        form="an availability ratio in (0, 1]",
+    )
+    if target > 1.0:
+        raise ValueError(
+            f"invalid TPUFLOW_SERVE_SLO_TARGET={target!r}: expected an "
+            "availability ratio in (0, 1]"
+        )
+    p99_ms = env_num(
+        "TPUFLOW_SERVE_SLO_P99_MS", 500.0, float, minimum=1e-9,
+        form="a positive p99 latency ceiling in milliseconds",
+    )
+    out = []
+    for obj in DEFAULT_SERVE_OBJECTIVES:
+        obj = dict(obj)
+        if obj["kind"] == "availability":
+            obj["target"] = target
+        elif obj["kind"] == "latency_p99":
+            obj["target"] = p99_ms
+        out.append(obj)
+    return normalize_objectives(out)
+
+
+# ---------------------------------------------------------------------
+# the pure error-budget algebra
+# ---------------------------------------------------------------------
+
+
+def burn_rate(good: float, bad: float, target: float) -> float | None:
+    """Observed error rate over budgeted error rate. ``1.0`` = spending
+    the budget exactly as fast as the window replenishes it; ``>1`` =
+    the budget runs out before the window does. None when there is no
+    traffic to judge (a missing sample is honest; a fake 0.0 would
+    suppress the alert the number exists to fire)."""
+    total = good + bad
+    if total <= 0:
+        return None
+    rate = bad / total
+    budget = 1.0 - float(target)
+    if budget <= 0:
+        # A 100% target has no budget: any failure burns infinitely.
+        return math.inf if bad > 0 else 0.0
+    return rate / budget
+
+
+def error_budget_remaining(
+    good: float, bad: float, target: float
+) -> float | None:
+    """Fraction of the window's error budget left: ``1.0`` = untouched,
+    ``0.0`` = exactly spent, negative = overspent (the objective is
+    violated). None when there was no traffic."""
+    total = good + bad
+    if total <= 0:
+        return None
+    allowed = (1.0 - float(target)) * total
+    if allowed <= 0:
+        return 1.0 if bad == 0 else -math.inf
+    return 1.0 - (bad / allowed)
+
+
+def windowed_burn_rates(
+    samples,
+    *,
+    target: float,
+    window_s: float,
+    t0: float | None = None,
+) -> list[dict]:
+    """Bucket ``(time, ok)`` samples into fixed windows and compute each
+    window's burn rate — the windowed view that distinguishes "bled
+    0.1% all day" from "died completely for 90 seconds", which a single
+    cumulative ratio cannot. Windows with no traffic are omitted (no
+    sample is honest; burn rate 0.0 would read as health)."""
+    if window_s <= 0:
+        raise ValueError(f"window_s must be > 0, got {window_s}")
+    pts = sorted(
+        (float(t), bool(ok)) for t, ok in samples
+    )
+    if not pts:
+        return []
+    base = float(t0) if t0 is not None else pts[0][0]
+    buckets: dict[int, list[int]] = {}
+    for t, ok in pts:
+        if t < base:
+            continue
+        idx = int((t - base) // window_s)
+        g_b = buckets.setdefault(idx, [0, 0])
+        g_b[0 if ok else 1] += 1
+    out = []
+    for idx in sorted(buckets):
+        good, bad = buckets[idx]
+        out.append({
+            "start": base + idx * window_s,
+            "end": base + (idx + 1) * window_s,
+            "good": good,
+            "bad": bad,
+            "burn_rate": burn_rate(good, bad, target),
+            "error_budget_remaining": error_budget_remaining(
+                good, bad, target
+            ),
+        })
+    return out
+
+
+def _status(
+    budget_remaining: float | None,
+    rate: float | None,
+    measured=None,
+    ceiling: float | None = None,
+) -> str:
+    """One objective's verdict. Ratio objectives judge the budget
+    (negative remaining = violated; burning >1x = at risk); ceiling
+    objectives (latency, time-to-adapt without lifecycles enough for a
+    budget) judge measured vs target."""
+    if budget_remaining is None and rate is None:
+        if measured is None or ceiling is None:
+            return "no_data"
+        return "ok" if float(measured) <= float(ceiling) else "violated"
+    if budget_remaining is not None and budget_remaining < 0:
+        return "violated"
+    if rate is not None and rate > 1.0:
+        return "at_risk"
+    return "ok"
+
+
+# ---------------------------------------------------------------------
+# objectives
+# ---------------------------------------------------------------------
+
+
+def normalize_objectives(raw) -> list[dict]:
+    """Validate a declarative objective list; fail-loud on unknown
+    kinds/shapes (a typo'd objective silently scoring no_data forever
+    is exactly what a report card must not do). Accepts tuples/lists of
+    dicts; returns plain dicts with the target coerced to float."""
+    if raw is None:
+        raw = DEFAULT_SERVE_OBJECTIVES
+    out = []
+    seen = set()
+    for i, obj in enumerate(raw):
+        if not isinstance(obj, dict):
+            raise ValueError(
+                f"objective #{i} must be a dict, got {type(obj).__name__}"
+            )
+        kind = obj.get("kind")
+        if kind not in KINDS:
+            raise ValueError(
+                f"objective #{i} has unknown kind {kind!r}; valid: "
+                f"{', '.join(KINDS)}"
+            )
+        name = str(obj.get("name") or kind)
+        if name in seen:
+            raise ValueError(f"duplicate objective name {name!r}")
+        seen.add(name)
+        try:
+            target = float(obj["target"])
+        except (KeyError, TypeError, ValueError):
+            raise ValueError(
+                f"objective {name!r} needs a numeric 'target' "
+                f"(got {obj.get('target')!r})"
+            ) from None
+        if kind == "availability" and not (0.0 < target <= 1.0):
+            raise ValueError(
+                f"availability objective {name!r}: target must be a "
+                f"ratio in (0, 1], got {target}"
+            )
+        if kind != "availability" and target <= 0:
+            raise ValueError(
+                f"objective {name!r}: target must be > 0, got {target}"
+            )
+        out.append({**obj, "name": name, "kind": kind, "target": target})
+    return out
+
+
+def load_objectives(path: str) -> list[dict]:
+    """Objectives from a JSON file: either a bare list or
+    ``{"objectives": [...]}``."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        doc = doc.get("objectives")
+    if not isinstance(doc, list):
+        raise ValueError(
+            f"{path}: expected a JSON list of objectives or "
+            '{"objectives": [...]}'
+        )
+    return normalize_objectives(doc)
+
+
+# ---------------------------------------------------------------------
+# registry-side evaluation (the live daemons)
+# ---------------------------------------------------------------------
+
+
+def _counter_total(registry, name: str) -> float | None:
+    """A counter family's total across every labelset (None when the
+    family was never registered — absence, not zero)."""
+    fam = registry.peek(name)
+    if fam is None:
+        return None
+    return sum(v for suffix, _, v in fam.collect() if suffix == "")
+
+
+def _summary_quantile(registry, name: str, q: str) -> float | None:
+    fam = registry.peek(name)
+    if fam is None:
+        return None
+    for suffix, labels, v in fam.collect():
+        if suffix == "" and labels.get("quantile") == q:
+            return float(v)
+    return None
+
+
+class SloEngine:
+    """Objective evaluation over a live registry, with the verdicts
+    published back INTO a registry so both ``/metrics`` formats carry
+    them: ``slo_error_budget_remaining{objective=}`` and
+    ``slo_burn_rate{objective=}`` gauges for the Prometheus scrape, and
+    the dict :meth:`evaluate_registry` returns for the JSON view.
+
+    Burn rates are computed over the **scrape window** (the counter
+    delta since the previous evaluation) so a dashboard sees current
+    spending, plus cumulatively since the daemon started — the
+    fast/slow window pair of standard burn-rate alerting, with the
+    scrape cadence as the fast window.
+    """
+
+    def __init__(self, objectives=None, registry=None, clock=time.monotonic):
+        self.objectives = normalize_objectives(objectives)
+        self.registry = registry
+        self.clock = clock
+        # The previous evaluation's counter snapshot per objective —
+        # the fast burn window is "since the last evaluation from ANY
+        # endpoint" (JSON and Prometheus scrapes share one engine), so
+        # the read-modify-write is guarded: concurrent scraper threads
+        # (ThreadingHTTPServer handlers) must not interleave a delta.
+        self._last: dict[str, tuple[float, float, float]] = {}
+        self._last_lock = threading.Lock()
+        self._budget_gauge = None
+        self._burn_gauge = None
+        if registry is not None:
+            self._budget_gauge = registry.gauge(
+                "slo_error_budget_remaining",
+                "fraction of each objective's error budget left "
+                "(cumulative; negative = violated)",
+            )
+            self._burn_gauge = registry.gauge(
+                "slo_burn_rate",
+                "each objective's cumulative burn rate (1.0 = spending "
+                "the budget exactly as fast as it replenishes)",
+            )
+
+    def _publish(self, name: str, budget, rate) -> None:
+        if self._budget_gauge is not None and budget is not None and (
+            math.isfinite(budget)
+        ):
+            self._budget_gauge.set(budget, objective=name)
+        if self._burn_gauge is not None and rate is not None and (
+            math.isfinite(rate)
+        ):
+            self._burn_gauge.set(rate, objective=name)
+
+    def _eval_availability(self, obj: dict, registry) -> dict:
+        good = bad = None
+        for name in obj.get("good", ()):
+            good = _counter_total(registry, name)
+            if good is not None:
+                break
+        bad_total, bad_seen = 0.0, False
+        for name in obj.get("bad", ()):
+            v = _counter_total(registry, name)
+            if v is not None:
+                bad_total, bad_seen = bad_total + v, True
+        bad = bad_total if bad_seen else 0.0
+        if good is None:
+            return {"measured": None, "budget": None, "rate": None}
+        target = obj["target"]
+        total = good + bad
+        now = self.clock()
+        with self._last_lock:
+            pg, pb = 0.0, 0.0
+            if obj["name"] in self._last:
+                _, pg, pb = self._last[obj["name"]]
+            self._last[obj["name"]] = (now, good, bad)
+        dg, db = max(good - pg, 0.0), max(bad - pb, 0.0)
+        return {
+            "measured": (good / total) if total > 0 else None,
+            "good": good,
+            "bad": bad,
+            "budget": error_budget_remaining(good, bad, target),
+            "rate": burn_rate(good, bad, target),
+            "window_burn_rate": burn_rate(dg, db, target),
+        }
+
+    def evaluate_registry(self, registry=None) -> dict:
+        """Score every objective against ``registry`` (defaults to the
+        engine's own); returns the ``slo`` section for the JSON
+        ``/metrics`` view and refreshes the exposition gauges. Never
+        raises — a broken objective must not fail the scrape."""
+        registry = registry if registry is not None else self.registry
+        rows = []
+        for obj in self.objectives:
+            kind, name, target = obj["kind"], obj["name"], obj["target"]
+            row = {
+                "name": name,
+                "kind": kind,
+                "target": target,
+                "measured": None,
+                "error_budget_remaining": None,
+                "burn_rate": None,
+                "status": "no_data",
+            }
+            try:
+                if kind == "availability":
+                    got = self._eval_availability(obj, registry)
+                    row["measured"] = got["measured"]
+                    row["error_budget_remaining"] = got["budget"]
+                    row["burn_rate"] = got["rate"]
+                    if "window_burn_rate" in got:
+                        row["window_burn_rate"] = got["window_burn_rate"]
+                    row["status"] = _status(got["budget"], got["rate"])
+                    self._publish(name, got["budget"], got["rate"])
+                elif kind == "latency_p99":
+                    p99 = _summary_quantile(
+                        registry, obj.get("summary", "predict_latency_ms"),
+                        "0.99",
+                    )
+                    row["measured"] = p99
+                    row["status"] = _status(
+                        None, None, measured=p99, ceiling=target
+                    )
+                    if p99 is not None:
+                        # Ceiling objectives publish headroom as the
+                        # budget analogue: 1 - measured/target (negative
+                        # = over the ceiling).
+                        headroom = 1.0 - p99 / target
+                        row["error_budget_remaining"] = headroom
+                        self._publish(name, headroom, None)
+                elif kind == "goodput_floor":
+                    good = None
+                    for cname in obj.get(
+                        "good",
+                        ("serving_admitted_total", "predict_requests_total"),
+                    ):
+                        good = _counter_total(registry, cname)
+                        if good is not None:
+                            break
+                    uptime = None
+                    fam = registry.peek(
+                        obj.get("uptime", "uptime_seconds")
+                    )
+                    if fam is not None:
+                        samples = fam.collect()
+                        if samples:
+                            uptime = float(samples[0][2])
+                    if good is not None and uptime and uptime > 0:
+                        rps = good / uptime
+                        row["measured"] = rps
+                        headroom = rps / target - 1.0
+                        row["error_budget_remaining"] = headroom
+                        row["status"] = (
+                            "ok" if rps >= target else "violated"
+                        )
+                        self._publish(name, headroom, None)
+                # time_to_adapt needs the fleet trails (report_card);
+                # a registry alone cannot see lifecycle durations.
+            except Exception:
+                row["status"] = "no_data"
+            rows.append(rows_finite(row))
+        return {"schema": SCHEMA_ID, "objectives": rows}
+
+
+def rows_finite(row: dict) -> dict:
+    """JSON-safe: +-inf budget/rate values become None (RFC 8259 has no
+    Infinity token, and the card must stay loadable everywhere)."""
+    out = {}
+    for k, v in row.items():
+        if isinstance(v, float) and not math.isfinite(v):
+            out[k] = None
+        else:
+            out[k] = v
+    return out
+
+
+# ---------------------------------------------------------------------
+# trail-side evaluation (the fleet report card)
+# ---------------------------------------------------------------------
+
+
+def adapt_lifecycles(events: list[dict]) -> list[dict]:
+    """Drift-adaptation lifecycles from merged fleet events, grouped by
+    trace id — the payoff of propagating ONE trace through drift-detect
+    -> retrain -> swap -> reload: "how long did adapting take" becomes
+    arithmetic. A lifecycle is a trace that saw a drift signal
+    (``drift_anomaly`` / a drift-reason ``online_retrain``) and a
+    completion (``artifact_swap`` / ``online_swap`` / ``serve_reload``);
+    its duration is last-completion minus first-signal."""
+    by_trace: dict[str, list[dict]] = {}
+    for rec in events:
+        tid = rec.get("trace_id")
+        if tid:
+            by_trace.setdefault(str(tid), []).append(rec)
+    out = []
+    for tid, recs in sorted(by_trace.items()):
+        starts = [
+            r["time"] for r in recs
+            if isinstance(r.get("time"), (int, float)) and (
+                r.get("event") == "drift_anomaly"
+                or (r.get("event") == "online_retrain"
+                    and r.get("reason", "drift") == "drift")
+            )
+        ]
+        ends = [
+            r["time"] for r in recs
+            if isinstance(r.get("time"), (int, float))
+            and r.get("event") in (
+                "artifact_swap", "online_swap", "serve_reload"
+            )
+        ]
+        if starts and ends and max(ends) >= min(starts):
+            out.append({
+                "trace_id": tid,
+                "start": min(starts),
+                "end": max(ends),
+                "seconds": max(ends) - min(starts),
+                "events": len(recs),
+            })
+    return out
+
+
+def report_card(
+    events: list[dict],
+    objectives=None,
+    *,
+    window_s: float = 300.0,
+    registry=None,
+    source=None,
+) -> dict:
+    """The fleet SLO report card from merged trail events (plus an
+    optional live registry for the counter-backed objectives) — the
+    artifact the chaos soak grades itself with, validating against
+    ``slo_report_card.schema.json``."""
+    objectives = normalize_objectives(objectives)
+    engine = SloEngine(objectives)
+    reg_rows: dict[str, dict] = {}
+    if registry is not None:
+        got = SloEngine(objectives).evaluate_registry(registry)
+        reg_rows = {r["name"]: r for r in got["objectives"]}
+    lifecycles = adapt_lifecycles(events)
+    times = [
+        r["time"] for r in events
+        if isinstance(r.get("time"), (int, float))
+    ]
+    rows = []
+    for obj in engine.objectives:
+        kind, name, target = obj["kind"], obj["name"], obj["target"]
+        if kind == "time_to_adapt":
+            row = {
+                "name": name, "kind": kind, "target": target,
+                "measured": None, "error_budget_remaining": None,
+                "burn_rate": None, "status": "no_data",
+                "lifecycles": lifecycles,
+            }
+            if lifecycles:
+                worst = max(lc["seconds"] for lc in lifecycles)
+                good = sum(
+                    1 for lc in lifecycles if lc["seconds"] <= target
+                )
+                bad = len(lifecycles) - good
+                # Within-target ratio judged at three nines: one slow
+                # adaptation out of a handful IS a budget event.
+                row["measured"] = worst
+                row["error_budget_remaining"] = error_budget_remaining(
+                    good, bad, 0.999
+                )
+                row["burn_rate"] = burn_rate(good, bad, 0.999)
+                row["status"] = _status(
+                    row["error_budget_remaining"], row["burn_rate"],
+                    measured=worst, ceiling=target,
+                )
+                if row["status"] == "ok" and worst > target:
+                    row["status"] = "violated"
+            rows.append(rows_finite(row))
+            continue
+        if name in reg_rows:
+            rows.append(rows_finite(reg_rows[name]))
+            continue
+        # Trail fallback for counter-backed kinds: per-dispatch serving
+        # spans when present (ok iff the span didn't record ok=false).
+        spans = [
+            r for r in events
+            if r.get("event") == "span"
+            and str(r.get("name", "")).startswith("predict.")
+            and isinstance(r.get("time"), (int, float))
+        ]
+        row = {
+            "name": name, "kind": kind, "target": target,
+            "measured": None, "error_budget_remaining": None,
+            "burn_rate": None, "status": "no_data",
+        }
+        if spans and kind == "availability":
+            samples = [
+                (r["time"], r.get("ok", True) is not False) for r in spans
+            ]
+            good = sum(1 for _, ok in samples if ok)
+            bad = len(samples) - good
+            row["measured"] = good / len(samples)
+            row["error_budget_remaining"] = error_budget_remaining(
+                good, bad, target
+            )
+            row["burn_rate"] = burn_rate(good, bad, target)
+            row["windows"] = [
+                rows_finite(w) for w in windowed_burn_rates(
+                    samples, target=target, window_s=window_s
+                )
+            ]
+            row["status"] = _status(
+                row["error_budget_remaining"], row["burn_rate"]
+            )
+        elif spans and kind == "latency_p99":
+            durs = sorted(
+                float(r["duration_s"]) * 1000.0 for r in spans
+                if isinstance(r.get("duration_s"), (int, float))
+            )
+            if durs:
+                p99 = durs[min(
+                    int(math.ceil(0.99 * len(durs))) - 1, len(durs) - 1
+                )]
+                row["measured"] = p99
+                row["error_budget_remaining"] = 1.0 - p99 / target
+                row["status"] = _status(
+                    None, None, measured=p99, ceiling=target
+                )
+        elif spans and kind == "goodput_floor":
+            ts = [r["time"] for r in spans]
+            elapsed = max(ts) - min(ts)
+            if elapsed > 0:
+                rps = len(spans) / elapsed
+                row["measured"] = rps
+                row["error_budget_remaining"] = rps / target - 1.0
+                row["status"] = "ok" if rps >= target else "violated"
+        rows.append(rows_finite(row))
+    card = {
+        "schema": SCHEMA_ID,
+        "generated_unix": time.time(),
+        "window_s": float(window_s),
+        "events": len(events),
+        "span": rows_finite({
+            "start": min(times) if times else None,
+            "end": max(times) if times else None,
+        }),
+        "objectives": rows,
+    }
+    if source is not None:
+        card["source"] = source
+    return card
+
+
+# ---------------------------------------------------------------------
+# schema validation
+# ---------------------------------------------------------------------
+
+
+def _structural_check(card: dict, schema: dict) -> list[str]:
+    """Minimal required/type/enum validation for environments without
+    jsonschema — enough to catch a malformed card, deliberately not a
+    full JSON Schema implementation."""
+    errors = []
+    if not isinstance(card, dict):
+        return ["card must be a JSON object"]
+    for key in schema.get("required", []):
+        if key not in card:
+            errors.append(f"missing required key {key!r}")
+    if card.get("schema") != SCHEMA_ID:
+        errors.append(
+            f"schema must be {SCHEMA_ID!r}, got {card.get('schema')!r}"
+        )
+    objectives = card.get("objectives")
+    if not isinstance(objectives, list):
+        errors.append("objectives must be a list")
+        return errors
+    obj_schema = (
+        schema.get("properties", {}).get("objectives", {}).get("items", {})
+    )
+    required = obj_schema.get("required", [])
+    for i, row in enumerate(objectives):
+        if not isinstance(row, dict):
+            errors.append(f"objectives[{i}] must be an object")
+            continue
+        for key in required:
+            if key not in row:
+                errors.append(f"objectives[{i}] missing {key!r}")
+        if row.get("kind") not in KINDS:
+            errors.append(f"objectives[{i}].kind {row.get('kind')!r} unknown")
+        if row.get("status") not in STATUSES:
+            errors.append(
+                f"objectives[{i}].status {row.get('status')!r} unknown"
+            )
+    return errors
+
+
+def validate_report_card(card: dict, schema_path: str | None = None) -> None:
+    """Raise ``ValueError`` listing every violation when ``card`` does
+    not match the committed report-card schema."""
+    with open(schema_path or SCHEMA_PATH, encoding="utf-8") as f:
+        schema = json.load(f)
+    try:
+        import jsonschema
+    except ImportError:
+        jsonschema = None
+    if jsonschema is not None:
+        validator = jsonschema.Draft202012Validator(schema)
+        errors = [
+            f"{'/'.join(str(p) for p in e.absolute_path) or '<root>'}: "
+            f"{e.message}"
+            for e in validator.iter_errors(card)
+        ]
+    else:
+        errors = _structural_check(card, schema)
+    if errors:
+        raise ValueError(
+            "report card does not match slo_report_card.schema.json:\n  "
+            + "\n  ".join(sorted(errors)[:20])
+        )

@@ -34,6 +34,9 @@ _LANES = (
     ("serve", 2, "serving"),
     ("xla", 3, "xla"),
     ("autotune", 4, "autotune"),
+    ("elastic", 5, "elastic"),
+    ("online", 6, "online"),
+    ("drift", 6, "online"),
 )
 _TRAIN_TID, _OTHER_TID = 1, 9
 _AUTOTUNE_TID = 4
@@ -41,6 +44,13 @@ _TRAIN_NAMES = {"ingest", "step", "eval", "checkpoint"}
 _INSTANT_EVENTS = {
     "numerics_anomaly", "lr_halved", "fault_injected", "forensics_dump",
     "supervisor_attempt_died", "autotune_freeze", "autotune_revert",
+    # Fleet-lifecycle marks (tpuflow/obs/fleet.py): the drift ->
+    # retrain -> swap -> reload chain and gang membership churn line up
+    # against the spans of the processes they happened in.
+    "drift_anomaly", "online_retrain", "online_swap", "online_rollback",
+    "artifact_swap", "artifact_rollback", "serve_reload",
+    "elastic_worker_evicted", "elastic_worker_rejoined",
+    "elastic_stale_push_rejected",
 }
 _PID = 1
 
@@ -74,12 +84,10 @@ def _args(rec: dict) -> dict:
     }
 
 
-def to_trace_events(events: list[dict]) -> dict:
-    """Convert parsed trail records into a Chrome trace-event document:
-    ``{"traceEvents": [...], "displayTimeUnit": "ms"}``. Spans become
-    complete ``X`` events (microsecond ``ts``/``dur``, sorted by
-    ``ts``); known point events become instant ``i`` marks; metadata
-    ``M`` rows (emitted first) name the lanes."""
+def split_events(events: list[dict]) -> tuple[list[dict], list[dict]]:
+    """``(spans, instants)`` with a finite time envelope — the shared
+    classification the single-trail exporter and the fleet merger
+    (``tpuflow/obs/fleet.py``) both build on."""
     spans, instants = [], []
     for rec in events:
         kind = rec.get("event")
@@ -96,11 +104,33 @@ def to_trace_events(events: list[dict]) -> dict:
             spans.append(rec)
         elif kind in _INSTANT_EVENTS:
             instants.append(rec)
-    if not spans and not instants:
-        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    return spans, instants
+
+
+def earliest_start(events: list[dict]) -> float | None:
+    """The trail's earliest span start / instant time (the ``ts=0``
+    anchor), or None for a trail with nothing drawable."""
+    spans, instants = split_events(events)
     starts = [r["time"] - r["duration_s"] for r in spans]
     starts += [r["time"] for r in instants]
-    base = min(starts)
+    return min(starts) if starts else None
+
+
+def to_trace_events(
+    events: list[dict], *, pid: int = _PID, base: float | None = None
+) -> dict:
+    """Convert parsed trail records into a Chrome trace-event document:
+    ``{"traceEvents": [...], "displayTimeUnit": "ms"}``. Spans become
+    complete ``X`` events (microsecond ``ts``/``dur``, sorted by
+    ``ts``); known point events become instant ``i`` marks; metadata
+    ``M`` rows (emitted first) name the lanes. ``pid``/``base`` let the
+    fleet merger give each process its own lane group while normalizing
+    every trail against ONE fleet-wide time zero."""
+    spans, instants = split_events(events)
+    if not spans and not instants:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    if base is None:
+        base = earliest_start(events)
 
     out: list[dict] = []
     lanes_used: dict[int, str] = {}
@@ -114,7 +144,7 @@ def to_trace_events(events: list[dict]) -> dict:
             "ph": "X",
             "ts": round((rec["time"] - rec["duration_s"] - base) * 1e6, 3),
             "dur": round(float(rec["duration_s"]) * 1e6, 3),
-            "pid": _PID,
+            "pid": pid,
             "tid": tid,
             "args": _args(rec),
         })
@@ -129,9 +159,11 @@ def to_trace_events(events: list[dict]) -> dict:
         if name.startswith("autotune"):
             tid, lane = _AUTOTUNE_TID, "autotune"
         else:
-            tid, lane = (
-                _lane(site) if site else (_TRAIN_TID, "train")
-            )
+            # A sited mark follows its subject; otherwise the event
+            # NAME's own prefix routes it (online_/elastic_/serve_
+            # lifecycle marks sit with their subsystem's spans), and
+            # anything unrecognized defaults to the train lane.
+            tid, lane = _lane(site) if site else _lane(name)
         if lane == "other":
             tid, lane = _TRAIN_TID, "train"
         lanes_used.setdefault(tid, lane)
@@ -141,7 +173,7 @@ def to_trace_events(events: list[dict]) -> dict:
             "ph": "i",
             "s": "p",  # process-scoped mark: visible across the lanes
             "ts": round((rec["time"] - base) * 1e6, 3),
-            "pid": _PID,
+            "pid": pid,
             "tid": tid,
             "args": _args(rec),
         })
@@ -150,7 +182,7 @@ def to_trace_events(events: list[dict]) -> dict:
         {
             "name": "thread_name",
             "ph": "M",
-            "pid": _PID,
+            "pid": pid,
             "tid": tid,
             "args": {"name": lane},
         }

@@ -37,6 +37,12 @@ _TRACE: contextvars.ContextVar[str | None] = contextvars.ContextVar(
     "tpuflow_trace_id", default=None
 )
 
+# The cross-process propagation env var: a supervisor exports it so
+# every restart attempt of one job shares ONE trace; train() reads it
+# (below the explicitly-bound trace in precedence) so a child's spans
+# land on the parent's trail. Validated at read (utils/env.py).
+TRACE_ENV = "TPUFLOW_TRACE_ID"
+
 # urandom-seeded PRNG, not uuid4: trace IDs are generated per /predict
 # request on the serving hot path, and getrandbits is ~5x cheaper than
 # a UUID while still collision-safe at 64 bits per process.
@@ -53,6 +59,31 @@ def new_trace_id() -> str:
 def current_trace_id() -> str | None:
     """The trace ID bound to this thread/context, if any."""
     return _TRACE.get()
+
+
+def clean_trace_id(raw: str | None) -> str | None:
+    """Clamp an externally-supplied trace ID (a client's ``X-Trace-Id``
+    header, a frame field off the wire): tokens only, bounded length.
+    A 64KB header retained per entry in the process-global forensics
+    ring (and echoed into span events) would pin attacker-controlled
+    memory; anything non-token-ish yields None (caller mints fresh)."""
+    if not raw:
+        return None
+    raw = str(raw).strip()
+    if 0 < len(raw) <= 64 and all(
+        c.isalnum() or c in "-_." for c in raw
+    ):
+        return raw
+    return None
+
+
+def trace_from_env() -> str | None:
+    """The validated ``TPUFLOW_TRACE_ID`` (None when unset): how a
+    supervised child attempt joins its parent's trace. Malformed values
+    fail loudly naming the variable (utils/env.py contract)."""
+    from tpuflow.utils.env import env_trace_id
+
+    return env_trace_id(TRACE_ENV)
 
 
 @contextlib.contextmanager

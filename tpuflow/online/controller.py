@@ -68,6 +68,7 @@ from tpuflow.online.swap import (
 )
 from tpuflow.obs.forensics import record_event
 from tpuflow.obs.metrics import default_registry
+from tpuflow.obs.tracing import use_trace
 from tpuflow.resilience import fault_point
 from tpuflow.utils.paths import join_path
 
@@ -91,7 +92,10 @@ class OnlineTrainer:
     overrides daemon notification with a callable ``(storage, model)``.
     """
 
-    def __init__(self, config, *, source=None, registry=None, notify=None):
+    def __init__(
+        self, config, *, source=None, registry=None, notify=None,
+        trail_path="auto",
+    ):
         if not config.storage_path:
             raise ValueError(
                 "online training needs storage_path (the serving "
@@ -115,6 +119,23 @@ class OnlineTrainer:
         self._source = source
         self._notify = notify
         self.registry = registry or default_registry()
+        # The loop's on-disk trail (its fleet-timeline lane): drift
+        # anomalies, retrain launches, swaps, and rollbacks — each
+        # stamped with the triggering window's trace id — appended as
+        # JSONL under {storage}/online/, where `python -m tpuflow.obs
+        # fleet` finds it next to the workers' and daemons' trails.
+        # "auto" = the default path; None disables.
+        self._trail = None
+        if trail_path is not None:
+            from tpuflow.utils.logging import MetricsLogger
+
+            if trail_path == "auto":
+                trail_path = os.path.join(
+                    self.storage, "online", "metrics.jsonl"
+                )
+            # MetricsLogger's open_file creates parent dirs itself (and
+            # handles URI paths) — no makedirs here.
+            self._trail = MetricsLogger(trail_path)
 
         from tpuflow.data.schema import Schema
         from tpuflow.data.synthetic import (
@@ -179,7 +200,18 @@ class OnlineTrainer:
             warmup_windows=self.knobs["warmup_windows"],
             registry=self.registry,
             model_name=self.model,
+            logger=self._trail,
         )
+
+    def _event(self, name: str, **fields) -> None:
+        """One lifecycle event: the forensics ring always (trace-stamped
+        there), mirrored to the on-disk trail when one is configured."""
+        rec = record_event(name, **fields)
+        if self._trail is not None:
+            self._trail.write(
+                name,
+                **{k: v for k, v in rec.items() if k not in ("event", "time")},
+            )
 
     def _chunks(self):
         if self._source is not None:
@@ -221,7 +253,7 @@ class OnlineTrainer:
             pred = self._serving_predictor()
             return serving_residuals(pred, dict(columns), self.target)
         except Exception as e:  # noqa: BLE001 — scoring must outlive loads
-            record_event(
+            self._event(
                 "online_residuals_skipped",
                 error=f"{type(e).__name__}: {e}",
             )
@@ -245,38 +277,48 @@ class OnlineTrainer:
         for idx, columns in enumerate(self._chunks()):
             if max_windows is not None and idx >= max_windows:
                 break
-            self._counters["windows"].inc()
-            self.windows_seen += 1
-            y = columns.get(self.target)
-            residuals = self._residuals(columns)
-            anomalies = self.watchdog.observe_window(
-                columns, y=y, residuals=residuals, index=idx
-            )
-            # Loop-level tallies: the watchdog is replaced on every
-            # generation change (fresh baseline), so ITS counts reset.
-            self.anomaly_count += len(anomalies)
+            # ONE trace per window lifecycle: the drift anomalies this
+            # window raises, the retrain they trigger, the shadow-eval
+            # verdict, the swap, and the daemon reload nudge all carry
+            # the same trace id — so a regime shift reads as one
+            # causally-linked trail across every process it touched
+            # (the retrain inherits the bound trace through train()/
+            # supervise(); the reload carries it as X-Trace-Id).
+            with use_trace():
+                self._counters["windows"].inc()
+                self.windows_seen += 1
+                y = columns.get(self.target)
+                residuals = self._residuals(columns)
+                anomalies = self.watchdog.observe_window(
+                    columns, y=y, residuals=residuals, index=idx
+                )
+                # Loop-level tallies: the watchdog is replaced on every
+                # generation change (fresh baseline), so ITS counts
+                # reset.
+                self.anomaly_count += len(anomalies)
 
-            if self._maybe_rollback(idx, residuals):
-                continue  # this window judged the old swap, not the data
+                if self._maybe_rollback(idx, residuals):
+                    continue  # this window judged the old swap
+                held_back = idx % eval_every == 0
+                if held_back:
+                    self.eval_chunks.append(columns)
+                else:
+                    self.replay.append(columns)
+                self._replay_gauge.set(float(self._replay_rows()))
 
-            held_back = idx % eval_every == 0
-            if held_back:
-                self.eval_chunks.append(columns)
-            else:
-                self.replay.append(columns)
-            self._replay_gauge.set(float(self._replay_rows()))
-
-            drifted = any(a["kind"] in _RETRAIN_KINDS for a in anomalies)
-            scheduled = retrain_every > 0 and idx > 0 \
-                and idx % retrain_every == 0
-            gap_ok = (
-                self._last_retrain_window is None
-                or idx - self._last_retrain_window >= min_gap
-            )
-            if (drifted or scheduled) and gap_ok and self.replay:
-                self._retrain_and_swap(idx, reason=(
-                    "drift" if drifted else "scheduled"
-                ))
+                drifted = any(
+                    a["kind"] in _RETRAIN_KINDS for a in anomalies
+                )
+                scheduled = retrain_every > 0 and idx > 0 \
+                    and idx % retrain_every == 0
+                gap_ok = (
+                    self._last_retrain_window is None
+                    or idx - self._last_retrain_window >= min_gap
+                )
+                if (drifted or scheduled) and gap_ok and self.replay:
+                    self._retrain_and_swap(idx, reason=(
+                        "drift" if drifted else "scheduled"
+                    ))
         return self.summary()
 
     def summary(self) -> dict:
@@ -327,14 +369,14 @@ class OnlineTrainer:
                 "window": idx, "stage": "rollback",
                 "error": f"{type(e).__name__}: {e}",
             })
-            record_event(
+            self._event(
                 "online_rollback_failed", window=idx,
                 error=f"{type(e).__name__}: {e}",
             )
             self._watch_left = 0
             return False
         self.rollbacks += 1
-        record_event(
+        self._event(
             "online_rollback", window=idx, mean_residual=mean_resid,
             baseline=self._resid_baseline, factor=factor,
         )
@@ -360,7 +402,7 @@ class OnlineTrainer:
                 "window": idx, "stage": "retrain",
                 "error": f"{type(e).__name__}: {e}",
             })
-            record_event(
+            self._event(
                 "online_retrain_failed", window=idx, retrain=n,
                 reason=reason, error=f"{type(e).__name__}: {e}",
             )
@@ -381,7 +423,7 @@ class OnlineTrainer:
             if gate is None or not gate["accept"]:
                 self.rejected += 1
                 self._counters["candidates_rejected"].inc()
-                record_event(
+                self._event(
                     "online_candidate_rejected", window=idx, retrain=n,
                     reason=(
                         "no held-back eval slice" if gate is None
@@ -404,13 +446,13 @@ class OnlineTrainer:
                 "window": idx, "stage": "swap",
                 "error": f"{type(e).__name__}: {e}",
             })
-            record_event(
+            self._event(
                 "online_swap_failed", window=idx, retrain=n,
                 error=f"{type(e).__name__}: {e}",
             )
             return
         self.swaps += 1
-        record_event(
+        self._event(
             "online_swap", window=idx, retrain=n, reason=reason, **gate
         )
         self._notify_swap()
@@ -430,7 +472,7 @@ class OnlineTrainer:
                 # about them" from healthy operation.
                 if res.get("ok"):
                     self._counters["swaps_notified"].inc()
-                record_event("online_daemon_notified", **res)
+                self._event("online_daemon_notified", **res)
 
     def _train_candidate(self, idx: int, n: int) -> str:
         """Spill the replay to CSV and train the candidate artifact —
@@ -459,7 +501,7 @@ class OnlineTrainer:
             save_every=1 if supervised else 0,
             progress_path=None,
         )
-        record_event(
+        self._event(
             "online_retrain", window=idx, retrain=n,
             replay_rows=self._replay_rows(), mode=self.knobs["mode"],
         )
@@ -480,7 +522,7 @@ class OnlineTrainer:
             from tpuflow.api import train
 
             train(cand_config)
-        record_event(
+        self._event(
             "online_retrain_done", window=idx, retrain=n,
             seconds=round(time.monotonic() - t0, 3),
         )

@@ -234,15 +234,24 @@ def notify_daemons(
     restart. Returns one ``{"url", "ok", ...}`` record per daemon."""
     import urllib.request
 
+    from tpuflow.obs.tracing import current_trace_id
+
     results = []
+    # The bound lifecycle trace rides the nudge as X-Trace-Id: the
+    # daemon stamps its reload record with it, closing the drift ->
+    # retrain -> swap -> reload chain across the process boundary.
+    trace = current_trace_id()
     for url in [u.strip() for u in (daemon_url or "").split(",") if u.strip()]:
         body = json.dumps(
             {"storagePath": storage, "model": name}
         ).encode()
+        headers = {"Content-Type": "application/json"}
+        if trace is not None:
+            headers["X-Trace-Id"] = trace
         req = urllib.request.Request(
             url.rstrip("/") + "/artifacts/reload",
             data=body,
-            headers={"Content-Type": "application/json"},
+            headers=headers,
             method="POST",
         )
         try:

@@ -956,18 +956,13 @@ class JobRunner:
 
 
 def _clean_trace_id(raw: str | None) -> str | None:
-    """Clamp a client-supplied X-Trace-Id: tokens only, bounded length.
-    A 64KB header retained per entry in the process-global forensics
-    ring (and echoed into span events) would pin attacker-controlled
-    memory; anything non-token-ish gets a fresh id instead (None)."""
-    if not raw:
-        return None
-    raw = raw.strip()
-    if 0 < len(raw) <= 64 and all(
-        c.isalnum() or c in "-_." for c in raw
-    ):
-        return raw
-    return None
+    """Clamp a client-supplied X-Trace-Id: tokens only, bounded length
+    — THE one copy now lives in tpuflow/obs/tracing.py (the elastic
+    transport and the TPUFLOW_TRACE_ID validation share it); this alias
+    keeps the serving stack's historical import path working."""
+    from tpuflow.obs.tracing import clean_trace_id
+
+    return clean_trace_id(raw)
 
 
 # One validated env-knob implementation for every TPUFLOW_* family
@@ -1664,16 +1659,22 @@ def make_server(
     warmup_buckets: int | None = None,
     donate_forward: bool | None = None,
     max_resident: int | None = None,
+    trail_path: str | None = None,
+    slo_objectives=None,
 ) -> ThreadingHTTPServer:
     """Build the HTTP server (caller drives serve_forever / shutdown).
 
     The ``batch_*`` / ``warmup_buckets`` / ``donate_forward`` knobs are
     the serving fast path (PredictService docstring; docs/serving.md);
-    ``None`` defers to the ``TPUFLOW_SERVE_*`` env vars, default off."""
+    ``None`` defers to the ``TPUFLOW_SERVE_*`` env vars, default off.
+    ``trail_path`` (also ``TPUFLOW_SERVE_TRAIL``) appends the daemon's
+    lifecycle events as JSONL — its lane in ``python -m tpuflow.obs
+    fleet``."""
     import time as _time
 
     from tpuflow.microbatch import QueueFull
-    from tpuflow.obs import Registry, use_trace
+    from tpuflow.obs import Registry, record_event, use_trace
+    from tpuflow.obs.slo import SloEngine, serve_objectives
 
     started = _time.monotonic()  # immune to wall-clock steps
     # ONE run-scoped registry for the whole daemon: predictor, batcher,
@@ -1685,6 +1686,21 @@ def make_server(
         "uptime_seconds", "seconds since the daemon started",
         fn=lambda: _time.monotonic() - started,
     )
+    # SLO engine (tpuflow/obs/slo.py): objectives scored at scrape time
+    # from the daemon's own counters — the `slo` JSON section plus
+    # slo_error_budget_remaining{objective=}/slo_burn_rate gauges in
+    # the Prometheus exposition.
+    slo = SloEngine(serve_objectives(slo_objectives), registry=registry)
+    if trail_path is None:
+        trail_path = os.environ.get("TPUFLOW_SERVE_TRAIL") or None
+    trail = None
+    if trail_path:
+        from tpuflow.utils.logging import MetricsLogger
+
+        trail = MetricsLogger(trail_path)
+        trail.write(
+            "serve_started", daemon="threaded", host=host, port=port,
+        )
     predictor = PredictService(
         batch_predicts=batch_predicts,
         batch_mode=batch_mode,
@@ -1752,6 +1768,9 @@ def make_server(
                         render_prometheus,
                     )
 
+                    # Refresh the SLO gauges first: the slo_* families
+                    # must reflect THIS scrape's counter state.
+                    slo.evaluate_registry(registry)
                     body = render_prometheus(
                         registry, default_registry()
                     ).encode()
@@ -1767,6 +1786,7 @@ def make_server(
                 self._send(200, {
                     "jobs": runner.metrics(),
                     "predict": predictor.metrics(),
+                    "slo": slo.evaluate_registry(registry),
                     "uptime_s": round(_time.monotonic() - started, 1),
                 })
             elif len(parts) == 3 and parts[1] == "jobs":
@@ -1849,9 +1869,27 @@ def make_server(
                         "error": "reload needs storagePath and model"
                     })
                     return
-                predictor.invalidate(storage, name)
+                # The online loop's lifecycle trace rides the nudge as
+                # X-Trace-Id: the reload record carries it, closing the
+                # drift -> retrain -> swap -> reload chain across the
+                # process boundary (tpuflow/obs/tracing.py).
+                with use_trace(
+                    _clean_trace_id(self.headers.get("X-Trace-Id"))
+                ) as tid:
+                    predictor.invalidate(storage, name)
+                    rec = record_event(
+                        "serve_reload", daemon="threaded",
+                        storage_path=storage, model=name,
+                    )
+                    if trail is not None:
+                        trail.write(
+                            "serve_reload",
+                            **{k: v for k, v in rec.items()
+                               if k not in ("event", "time")},
+                        )
                 self._send(200, {
                     "reloaded": True, "storage_path": storage, "model": name,
+                    "trace_id": tid,
                 })
             else:
                 self._send(404, {"error": f"no route {self.path!r}"})
@@ -1942,6 +1980,12 @@ def main(argv=None) -> int:
         help="donate the input batch buffer to the jitted forward "
         "(also TPUFLOW_SERVE_DONATE=1)",
     )
+    p.add_argument(
+        "--trail", default=None, metavar="PATH",
+        help="append lifecycle events (startup, trace-stamped "
+        "/artifacts/reload records) as JSONL here — this daemon's lane "
+        "in `python -m tpuflow.obs fleet` (also TPUFLOW_SERVE_TRAIL)",
+    )
     args = p.parse_args(argv)
 
     server = make_server(
@@ -1954,6 +1998,7 @@ def main(argv=None) -> int:
         batch_max_wait_ms=args.batch_max_wait_ms,
         warmup_buckets=args.warmup_buckets,
         donate_forward=args.donate_forward,
+        trail_path=args.trail,
     )
 
     def _stop(signum, frame):

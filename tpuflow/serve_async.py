@@ -85,6 +85,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -272,6 +273,8 @@ class AsyncServer:
         default_timeout: float | None = None,
         journal_path: str | None = None,
         service: PredictService | None = None,
+        trail_path: str | None = None,
+        slo_objectives=None,
     ):
         from tpuflow.obs import Registry
 
@@ -411,6 +414,30 @@ class AsyncServer:
             "threshold, by action (flagged = served with X-Drift-Score; "
             "shed = answered 429 before occupying a dispatch slot)",
         )
+        # The daemon's on-disk trail (its fleet-timeline lane, found by
+        # `python -m tpuflow.obs fleet`): lifecycle events — startup,
+        # trace-stamped /artifacts/reload records — appended as JSONL.
+        # None (default) = env TPUFLOW_SERVE_TRAIL; unset = no trail.
+        if trail_path is None:
+            trail_path = os.environ.get("TPUFLOW_SERVE_TRAIL") or None
+        self._trail = None
+        if trail_path:
+            from tpuflow.utils.logging import MetricsLogger
+
+            self._trail = MetricsLogger(trail_path)
+            self._trail.write(
+                "serve_started", daemon="async", host=host, port=port,
+            )
+        # The SLO engine (tpuflow/obs/slo.py): objectives scored at
+        # scrape time from this daemon's own counters — the `slo`
+        # section of the JSON /metrics view, and the
+        # slo_error_budget_remaining{objective=}/slo_burn_rate gauges
+        # in the Prometheus exposition. Targets are env-tunable.
+        from tpuflow.obs.slo import SloEngine, serve_objectives
+
+        self.slo = SloEngine(
+            serve_objectives(slo_objectives), registry=self.registry,
+        )
         self.runner = None
         if enable_jobs:
             self.runner = JobRunner(
@@ -433,6 +460,22 @@ class AsyncServer:
         self._ready = threading.Event()
         self._announce = False  # main() flips it: print URL post-bind
         self._boot_error: BaseException | None = None
+
+    def _record_reload(self, storage_path: str, name: str) -> None:
+        """One trace-stamped reload record: the forensics ring always,
+        the on-disk trail when configured — the daemon-side end of the
+        online loop's swap lifecycle on the fleet timeline."""
+        from tpuflow.obs import record_event
+
+        rec = record_event(
+            "serve_reload", daemon="async", storage_path=storage_path,
+            model=name,
+        )
+        if self._trail is not None:
+            self._trail.write(
+                "serve_reload",
+                **{k: v for k, v in rec.items() if k not in ("event", "time")},
+            )
 
     # ---- drift-aware admission ----
 
@@ -883,6 +926,10 @@ class AsyncServer:
                         render_prometheus,
                     )
 
+                    # Refresh the SLO gauges first: the exposition's
+                    # slo_* families must reflect THIS scrape's counter
+                    # state, not the previous JSON view's.
+                    self.slo.evaluate_registry(self.registry)
                     text = render_prometheus(
                         self.registry, default_registry()
                     )
@@ -959,14 +1006,24 @@ class AsyncServer:
                     "error": "reload needs storagePath and model"
                 }, json_ct
             loop = asyncio.get_running_loop()
-            # Drops the cached predictor AND the drift baseline (the
-            # swapped artifact carries its own reference stats) — the
-            # same helper the job path's artifact-change hook calls.
-            await loop.run_in_executor(
-                self._pool, self._invalidate_artifact, storage, name
-            )
+            # The online loop's lifecycle trace rides the nudge as
+            # X-Trace-Id: bound here, the reload record (ring + trail)
+            # carries it — the drift -> retrain -> swap -> reload chain
+            # stays ONE trace across the process boundary.
+            with use_trace(
+                _clean_trace_id(headers.get("x-trace-id"))
+            ) as tid:
+                # Drops the cached predictor AND the drift baseline
+                # (the swapped artifact carries its own reference
+                # stats) — the same helper the job path's
+                # artifact-change hook calls.
+                await loop.run_in_executor(
+                    self._pool, self._invalidate_artifact, storage, name
+                )
+                self._record_reload(storage, name)
             return 200, {
                 "reloaded": True, "storage_path": storage, "model": name,
+                "trace_id": tid,
             }, json_ct
         if method == "POST" and route == "/jobs" and self.runner is not None:
             import queue as _queue
@@ -1050,6 +1107,10 @@ class AsyncServer:
                 if hasattr(self.service, "replica_metrics")
                 else {}
             ),
+            # The SLO section (tpuflow/obs/slo.py): objectives scored
+            # against this daemon's own counters at scrape time — the
+            # same verdicts the Prometheus view carries as slo_* gauges.
+            "slo": self.slo.evaluate_registry(self.registry),
             "uptime_s": round(time.monotonic() - self._started, 1),
         }
         return out
@@ -1252,6 +1313,12 @@ def main(argv=None) -> int:
     p.add_argument("--max-queued", type=int, default=64)
     p.add_argument("--default-timeout", type=float, default=None)
     p.add_argument("--journal", default=None, metavar="PATH")
+    p.add_argument(
+        "--trail", default=None, metavar="PATH",
+        help="append lifecycle events (startup, trace-stamped "
+        "/artifacts/reload records) as JSONL here — this daemon's lane "
+        "in `python -m tpuflow.obs fleet` (also TPUFLOW_SERVE_TRAIL)",
+    )
     args = p.parse_args(argv)
 
     if args.replicas is not None:
@@ -1288,6 +1355,7 @@ def main(argv=None) -> int:
             max_queued=args.max_queued,
             default_timeout=args.default_timeout,
             journal_path=args.journal,
+            trail_path=args.trail,
         )
     except ValueError as e:
         # Configuration-shaped failure (malformed env knob, replica

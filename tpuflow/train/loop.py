@@ -133,6 +133,11 @@ class FitConfig:
     # already return, read POST-epoch (never per-step inside the scanned
     # body — TPF006). "warn" | "halve_lr" | "abort"; None/"off" disables.
     health: str | None = "warn"
+    # Fleet identity for crash artifacts (an elastic worker id like
+    # "w0"): forensics dumps under a SHARED storage root are suffixed
+    # with it so sibling processes never clobber each other's trail
+    # (tpuflow/obs/forensics.py::forensics_path). None = plain run.
+    run_identity: str | None = None
     # Live roofline context: {"flops_per_sample", "bytes_per_sample",
     # "n_chips"} for the model being trained (tpuflow/utils/roofline.py
     # model_cost_per_sample), plus optional "compute_dtype" ("f32" |
@@ -301,7 +306,10 @@ def fit(
                     print(f"Resuming from epoch {loop_meta['epoch']}")
     samples_seen = 0
     samples_counted = 0  # high-water mark already added to the registry
-    t0 = time.time()
+    # Monotonic, not wall-clock: the run's elapsed/throughput numbers
+    # must survive an NTP step mid-run (TPF015 — durations never come
+    # from time.time() deltas).
+    t0 = time.monotonic()
 
     use_scan = bool(config.jit_epoch)
     if use_scan:
@@ -355,6 +363,7 @@ def fit(
             model_name=config.model_name,
             logger=mlog,
             verbose=config.verbose,
+            dump_identity=config.run_identity,
         )
     detector = None
     if config.detect_recompiles:
@@ -462,7 +471,7 @@ def fit(
             fault_point("train.epoch_start", index=epoch)
             if detector is not None:
                 detector.epoch = epoch
-            te = time.time()
+            te = time.monotonic()
             tracing = config.trace_dir is not None and epoch == start_epoch
             if tracing:
                 jax.profiler.start_trace(config.trace_dir)
@@ -546,7 +555,7 @@ def fit(
             # divides TRAIN samples, so it must divide train time, not
             # train+eval (an inflated denominator would understate MFU
             # against the bench.py numbers it is documented to match).
-            train_time = time.time() - te
+            train_time = time.monotonic() - te
             record_span("step", train_time, logger=mlog, epoch=epoch)
             t_eval = time.perf_counter()
             val = _eval_dataset(eval_step, state, val_ds, config.batch_size)
@@ -554,7 +563,7 @@ def fit(
                 "eval", time.perf_counter() - t_eval, logger=mlog,
                 epoch=epoch,
             )
-            epoch_time = time.time() - te
+            epoch_time = time.monotonic() - te
             result.history.append(
                 {"epoch": epoch, "loss": train_loss, "val_loss": val["loss"],
                  "val_mae": val["mae"], "time": epoch_time}
@@ -659,7 +668,7 @@ def fit(
             if should_stop:
                 break
 
-        result.time_elapsed = time.time() - t0
+        result.time_elapsed = time.monotonic() - t0
         result.samples_per_sec = samples_seen / max(result.time_elapsed, 1e-9)
         result.state = state
         if detector is not None:

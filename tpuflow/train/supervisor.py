@@ -170,6 +170,7 @@ def _run_attempt(
     stall_timeout: float | None,
     poll_interval: float,
     term_grace: float = 5.0,
+    child_env: dict | None = None,
 ) -> tuple[int | None, str, str, str | None]:
     """One child attempt under the watchdog.
 
@@ -187,7 +188,8 @@ def _run_attempt(
     start = time.monotonic()
     with open(stdout_path, "w") as out_f, open(stderr_path, "w") as err_f:
         proc = subprocess.Popen(
-            cmd, stdout=out_f, stderr=err_f, cwd=os.getcwd()
+            cmd, stdout=out_f, stderr=err_f, cwd=os.getcwd(),
+            env=child_env,
         )
         kind = ""
         killed_by = None
@@ -263,7 +265,27 @@ def supervise(
     ``killed_by`` says which it took. ``sleep`` is injectable for tests.
     """
     _validate(spec)
-    from tpuflow.obs import default_registry, dump_forensics, record_event
+    from tpuflow.obs import (
+        current_trace_id,
+        default_registry,
+        dump_forensics,
+        new_trace_id,
+        record_event,
+        trace_from_env,
+    )
+    from tpuflow.obs.tracing import TRACE_ENV
+
+    # ONE trace for the whole supervised job, every attempt included: a
+    # restart that minted a fresh run trace would orphan the pre-crash
+    # spans from the recovery's — the one trail a crash investigation
+    # needs stitched. Precedence: an already-bound trace (the online
+    # loop supervising a retrain) > the validated TPUFLOW_TRACE_ID this
+    # supervisor itself inherited > fresh. Children get it via the env,
+    # the one channel that survives a process boundary; train() binds it
+    # below any explicitly-bound trace, so every attempt's spans carry
+    # the same id.
+    job_trace = current_trace_id() or trace_from_env() or new_trace_id()
+    child_env = {**os.environ, TRACE_ENV: job_trace}
 
     _reg = default_registry()
     _restarts = _reg.counter(
@@ -333,6 +355,7 @@ def supervise(
                 stall_timeout,
                 poll_interval,
                 term_grace,
+                child_env=child_env,
             )
             if rc == 0:
                 with open(out_path, encoding="utf-8") as f:
@@ -351,7 +374,7 @@ def supervise(
                 _numerics_aborts.inc()
                 record_event(
                     "supervisor_numerics_divergence", attempt=attempt,
-                    progress_epoch=progress_epoch,
+                    progress_epoch=progress_epoch, trace_id=job_trace,
                 )
                 _dump(
                     f"numerics divergence at epoch {progress_epoch} "
@@ -380,7 +403,7 @@ def supervise(
             record_event(
                 "supervisor_attempt_died", attempt=attempt, rc=rc,
                 kind=kind or "crash", progress_epoch=progress_epoch,
-                killed_by=killed_by,
+                killed_by=killed_by, trace_id=job_trace,
             )
             failures.append({
                 "rc": rc,

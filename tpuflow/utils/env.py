@@ -87,6 +87,30 @@ def env_num(name: str, default, cast, *, minimum=0, form: str | None = None):
     return env_number(name, default, cast=cast, minimum=minimum, form=form)
 
 
+def env_trace_id(name: str = "TPUFLOW_TRACE_ID") -> str | None:
+    """One validated trace-token env read (the cross-process trace
+    propagation contract, tpuflow/obs/tracing.py): unset/blank returns
+    None; a valid token returns it; anything else fails loudly naming
+    the variable, because a silently-dropped malformed trace would
+    quietly orphan every span a supervised child records from the
+    parent's trail. THE token rule (1-64 chars of ``[A-Za-z0-9._-]``,
+    the same clamp serving applies to a client's ``X-Trace-Id``) lives
+    in ``clean_trace_id`` — one copy, lazily imported (tracing imports
+    this module lazily too; no cycle)."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return None
+    from tpuflow.obs.tracing import clean_trace_id
+
+    token = clean_trace_id(raw)
+    if token is not None:
+        return token
+    raise ValueError(
+        f"invalid {name}={raw!r}: expected a trace token of 1-64 "
+        "characters from [A-Za-z0-9._-]"
+    )
+
+
 def env_choice(name: str, default: str, choices: tuple) -> str:
     """One validated enum env read (same fail-loud contract as
     :func:`env_num`)."""

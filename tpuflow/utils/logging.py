@@ -70,6 +70,19 @@ class MetricsLogger:
             "seq": self._seq,
             **fields,
         }
+        if "trace_id" not in rec:
+            # Stamp the bound trace (tpuflow/obs/tracing.py) so every
+            # trail record — epoch lines, drift anomalies, daemon
+            # reloads — is linkable on the merged fleet timeline, not
+            # just the span events (which carry it explicitly).
+            try:
+                from tpuflow.obs.tracing import current_trace_id
+
+                tid = current_trace_id()
+                if tid is not None:
+                    rec["trace_id"] = tid
+            except Exception:
+                pass
         line = json.dumps(rec)
         if self._fh:
             # A broken write drops THIS line (warn once) instead of
