@@ -1128,3 +1128,597 @@ class TestAnalysisMain:
         with pytest.raises(PreflightError):
             ensure_preflight(TrainJobConfig(model="resnet"),
                              passes=("spec",))
+
+
+# ---------------------------------------------------------------------
+# Pass 5 — the repo-wide concurrency analyzer (TPF016-TPF018)
+# ---------------------------------------------------------------------
+
+RACY_SOURCE = textwrap.dedent("""\
+    '''Seeded-race fixture: three planted defects.'''
+
+    import threading
+    import time
+
+
+    class Racy:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition(self._lock)
+            self._count = 0
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True
+            )
+            self._thread.start()
+
+        def _loop(self):
+            while True:
+                with self._lock:
+                    self._count += 1
+                time.sleep(0.01)
+
+        def peek(self):
+            return self._count  # PLANTED: TPF016 unguarded read
+
+        def flush(self):
+            with self._lock:
+                time.sleep(0.1)  # PLANTED: TPF017 blocking under lock
+
+        def pop(self):
+            with self._cond:
+                self._cond.wait()  # PLANTED: TPF018 un-looped wait
+                self._count -= 1
+""")
+
+TIDY_SOURCE = textwrap.dedent("""\
+    '''The lock-correct twin of the racy fixture: zero findings.'''
+
+    import threading
+    import time
+
+
+    class Tidy:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition(self._lock)
+            self._count = 0
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True
+            )
+            self._thread.start()
+
+        def _loop(self):
+            while True:
+                with self._lock:
+                    self._count += 1
+                time.sleep(0.01)
+
+        def peek(self):
+            with self._lock:
+                return self._count
+
+        def flush(self):
+            with self._lock:
+                count = self._count
+            time.sleep(0.1)
+            return count
+
+        def pop(self):
+            with self._cond:
+                while self._count <= 0:
+                    self._cond.wait()
+                self._count -= 1
+""")
+
+
+def _planted_line(source: str, marker: str) -> int:
+    for i, line in enumerate(source.splitlines(), start=1):
+        if marker in line:
+            return i
+    raise AssertionError(f"marker {marker!r} not in fixture")
+
+
+class TestConcurrencyAnalyzer:
+    def _analyze(self, tmp_path, sources: dict):
+        from tpuflow.analysis.concurrency import analyze_index, build_index
+
+        for name, src in sources.items():
+            (tmp_path / name).write_text(src)
+        return analyze_index(build_index(str(tmp_path)))
+
+    def test_seeded_races_all_flagged_with_file_line(self, tmp_path):
+        findings = self._analyze(tmp_path, {"racy.py": RACY_SOURCE})
+        by_rule = {f.rule: f for f in findings}
+        assert set(by_rule) == {"TPF016", "TPF017", "TPF018"}
+        assert by_rule["TPF016"].line == _planted_line(
+            RACY_SOURCE, "PLANTED: TPF016"
+        )
+        assert by_rule["TPF017"].line == _planted_line(
+            RACY_SOURCE, "PLANTED: TPF017"
+        )
+        assert by_rule["TPF018"].line == _planted_line(
+            RACY_SOURCE, "PLANTED: TPF018"
+        )
+        # each diagnostic carries file:line in its where
+        for f in findings:
+            d = f.diagnostic()
+            assert d.where == f"{f.path}:{f.line}"
+            assert "racy.py" in d.where
+        assert "_count" in by_rule["TPF016"].message
+        assert "sleep" in by_rule["TPF017"].message
+        assert "_cond" in by_rule["TPF018"].message
+
+    def test_lock_correct_twin_is_silent(self, tmp_path):
+        assert self._analyze(tmp_path, {"tidy.py": TIDY_SOURCE}) == []
+
+    def test_twin_does_not_contaminate_cross_file_index(self, tmp_path):
+        findings = self._analyze(tmp_path, {
+            "racy.py": RACY_SOURCE, "tidy.py": TIDY_SOURCE,
+        })
+        assert len(findings) == 3
+        assert all(f.rel == "racy.py" for f in findings)
+
+    def test_noqa_suppression_parity(self, tmp_path):
+        src = RACY_SOURCE.replace(
+            "self._count  # PLANTED: TPF016 unguarded read",
+            "self._count  # noqa: TPF016",
+        )
+        findings = self._analyze(tmp_path, {"racy.py": src})
+        assert {f.rule for f in findings} == {"TPF017", "TPF018"}
+
+    def test_gauge_callback_lambda_is_a_thread_entry(self, tmp_path):
+        # The exact shape fixed in microbatch.py this PR: a pull-gauge
+        # callback reads batcher state on the SCRAPE thread without the
+        # lock the dispatcher writes it under.
+        findings = self._analyze(tmp_path, {"b.py": textwrap.dedent("""\
+            import threading
+
+
+            class Batcher:
+                def __init__(self, registry):
+                    self._lock = threading.Lock()
+                    self._rows = 0
+                    registry.gauge("depth", fn=lambda: self._rows)
+                    self._thread = threading.Thread(
+                        target=self._loop, daemon=True
+                    )
+
+                def _loop(self):
+                    with self._lock:
+                        self._rows += 1
+        """)})
+        (f,) = findings
+        assert f.rule == "TPF016" and f.subject == "_rows"
+        assert f.scope == "Batcher.__init__"  # lambda -> named parent
+
+    def test_locked_reader_callback_is_clean(self, tmp_path):
+        assert self._analyze(tmp_path, {"b.py": textwrap.dedent("""\
+            import threading
+
+
+            class Batcher:
+                def __init__(self, registry):
+                    self._lock = threading.Lock()
+                    self._rows = 0
+                    registry.gauge("depth", fn=self._read_rows)
+                    self._thread = threading.Thread(
+                        target=self._loop, daemon=True
+                    )
+
+                def _read_rows(self):
+                    with self._lock:
+                        return self._rows
+
+                def _loop(self):
+                    with self._lock:
+                        self._rows += 1
+        """)}) == []
+
+    def test_locked_convention_and_sync_lambda_inlining(self, tmp_path):
+        # *_locked methods are callee-side convention ("caller holds the
+        # lock"); a non-escaping lambda (a min() selector) runs
+        # synchronously under whatever the caller holds.
+        assert self._analyze(tmp_path, {"q.py": textwrap.dedent("""\
+            import threading
+
+
+            class Queue:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+                    self._thread = threading.Thread(
+                        target=self._loop, daemon=True
+                    )
+
+                def _loop(self):
+                    with self._lock:
+                        self._drain_locked()
+
+                def _drain_locked(self):
+                    oldest = min(
+                        self._items, key=lambda k: self._items[k]
+                    )
+                    self._items.pop(oldest)
+
+                def push(self, k, v):
+                    with self._lock:
+                        self._items[k] = v
+        """)}) == []
+
+    def test_module_global_write_discipline(self, tmp_path):
+        findings = self._analyze(tmp_path, {"g.py": textwrap.dedent("""\
+            import threading
+
+            _LOCK = threading.Lock()
+            _SEQ = 0
+
+
+            def bump():
+                global _SEQ
+                with _LOCK:
+                    _SEQ += 1
+
+
+            def bump_racy():
+                global _SEQ
+                _SEQ += 1
+
+
+            def spawn():
+                threading.Thread(target=bump, daemon=True).start()
+        """)})
+        (f,) = findings
+        assert f.rule == "TPF016" and f.subject == "_SEQ"
+        assert f.scope == "bump_racy"
+
+    def test_tpf017_event_wait_flagged_condition_wait_exempt(
+        self, tmp_path
+    ):
+        findings = self._analyze(tmp_path, {"w.py": textwrap.dedent("""\
+            import threading
+
+
+            class Waiter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cond = threading.Condition(self._lock)
+                    self._ev = threading.Event()
+                    self._n = 0
+                    self._thread = threading.Thread(
+                        target=self._loop, daemon=True
+                    )
+
+                def _loop(self):
+                    with self._lock:
+                        self._ev.wait()
+                    with self._cond:
+                        while self._n == 0:
+                            self._cond.wait()
+        """)})
+        (f,) = findings
+        assert f.rule == "TPF017"
+        assert "_ev.wait" in f.message
+
+    def test_tpf018_nondaemon_thread_without_join(self, tmp_path):
+        findings = self._analyze(tmp_path, {"t.py": textwrap.dedent("""\
+            import threading
+
+
+            def fire():
+                threading.Thread(target=print).start()
+        """)})
+        (f,) = findings
+        assert f.rule == "TPF018" and f.subject == "thread"
+
+    def test_tpf018_joined_or_daemon_thread_is_clean(self, tmp_path):
+        assert self._analyze(tmp_path, {"t.py": textwrap.dedent("""\
+            import threading
+
+
+            def fire_joined():
+                t = threading.Thread(target=print)
+                t.start()
+                t.join()
+
+
+            def fire_daemon():
+                threading.Thread(target=print, daemon=True).start()
+        """)}) == []
+
+
+class TestConcurrencyBaseline:
+    def test_round_trip_add_accept_clean_then_stale(self, tmp_path):
+        from tpuflow.analysis.concurrency import (
+            STALE_CODE,
+            analyze_index,
+            analyze_repo,
+            build_index,
+            write_baseline,
+        )
+
+        (tmp_path / "racy.py").write_text(RACY_SOURCE)
+        baseline = tmp_path / "concurrency_baseline.json"
+        # 1. findings exist, no baseline yet
+        diags = analyze_repo(str(tmp_path), baseline_path=None)
+        assert {d.code for d in diags} == {"TPF016", "TPF017", "TPF018"}
+        # 2. accept them all into the baseline -> rerun clean
+        findings = analyze_index(build_index(str(tmp_path)))
+        write_baseline(str(baseline), findings)
+        assert analyze_repo(
+            str(tmp_path), baseline_path=str(baseline)
+        ) == []
+        # 3. fix the code -> every baseline entry is now stale, and the
+        # analyzer says so (naming the baseline) instead of passing
+        (tmp_path / "racy.py").write_text(
+            TIDY_SOURCE.replace("Tidy", "Racy")
+        )
+        stale = analyze_repo(str(tmp_path), baseline_path=str(baseline))
+        assert len(stale) == 3
+        assert all(d.code == STALE_CODE for d in stale)
+        assert all("prune" in d.message for d in stale)
+        assert all(d.where == str(baseline) for d in stale)
+
+    def test_accept_preserves_existing_reasons(self, tmp_path):
+        import json as _json
+
+        from tpuflow.analysis.concurrency import (
+            analyze_index,
+            build_index,
+            load_baseline,
+            write_baseline,
+        )
+
+        (tmp_path / "racy.py").write_text(RACY_SOURCE)
+        baseline = tmp_path / "b.json"
+        findings = analyze_index(build_index(str(tmp_path)))
+        write_baseline(str(baseline), findings)
+        entries = load_baseline(str(baseline))
+        assert len(entries) == 3
+        # Edit one TODO into a real justification, re-accept: kept.
+        entries[0]["reason"] = "drill hook: deliberate"
+        doc = _json.loads(baseline.read_text())
+        doc["entries"] = entries
+        baseline.write_text(_json.dumps(doc))
+        reasons = {
+            (e["rule"], e["file"], e["scope"], e["subject"]): e["reason"]
+            for e in load_baseline(str(baseline))
+        }
+        write_baseline(str(baseline), findings, reasons)
+        kept = load_baseline(str(baseline))
+        assert "drill hook: deliberate" in {e["reason"] for e in kept}
+
+    @pytest.mark.parametrize("content,needle", [
+        ("{not json", "not valid JSON"),
+        ("[]", "top level must be an object"),
+        ('{"entries": {}}', "field 'entries' must be a list"),
+        ('{"entries": [42]}', "entries[0] must be an object"),
+        ('{"entries": [{"rule": "TPF016"}]}', "entries[0] field 'file'"),
+        ('{"entries": [{"rule": "TPF016", "file": "x.py", '
+         '"scope": "C.m", "subject": "_a", "reason": "  "}]}',
+         "field 'reason'"),
+        ('{"entries": [{"rule": "TPF099", "file": "x.py", '
+         '"scope": "C.m", "subject": "_a", "reason": "ok"}]}',
+         "unknown rule code 'TPF099'"),
+    ])
+    def test_malformed_baseline_names_file_and_field(
+        self, tmp_path, content, needle
+    ):
+        from tpuflow.analysis.concurrency import BaselineError, load_baseline
+
+        path = tmp_path / "broken_baseline.json"
+        path.write_text(content)
+        with pytest.raises(BaselineError) as e:
+            load_baseline(str(path))
+        assert "broken_baseline.json" in str(e.value)
+        assert needle in str(e.value)
+        # BaselineError is a ValueError: existing bad-input seams
+        # (exit 2 / HTTP 400) handle it unchanged.
+        assert isinstance(e.value, ValueError)
+
+    def test_missing_baseline_file_is_loud(self, tmp_path):
+        from tpuflow.analysis.concurrency import BaselineError, load_baseline
+
+        with pytest.raises(BaselineError, match="unreadable"):
+            load_baseline(str(tmp_path / "nope.json"))
+
+    def test_unknown_rule_code_in_committed_baseline_schema(self):
+        # The committed baseline itself must load (and therefore obey
+        # the schema): a typo'd rule code there would silently
+        # un-suppress nothing and confuse the gate.
+        import os
+
+        from tpuflow.analysis.concurrency import (
+            default_baseline_path,
+            default_root,
+            load_baseline,
+        )
+
+        path = default_baseline_path(default_root())
+        assert os.path.exists(path)
+        entries = load_baseline(path)
+        for e in entries:
+            assert e["rule"].startswith("TPF01")
+            assert "TODO" not in e["reason"]
+
+
+class TestConcurrencyGate:
+    def test_self_concurrency_gate_package_is_clean(self):
+        """The repo-wide gate: zero unbaselined TPF016-TPF018 findings
+        (and zero stale baseline entries) across tpuflow/ — the first
+        pass that reasons across functions, classes, and files at once.
+        New framework code that reads a guarded attribute without its
+        lock, blocks while holding one, or waits on a condition outside
+        a predicate loop fails tier-1 right here."""
+        from tpuflow.analysis.concurrency import analyze_repo
+
+        diags = analyze_repo()
+        assert diags == [], "\n".join(d.render() for d in diags)
+
+    def test_concurrency_pass_wired_into_preflight(self):
+        report = preflight(TrainJobConfig(), passes=("concurrency",))
+        assert report.ok
+        assert report.passes_run == ("concurrency",)
+
+    def test_repo_cli_exit_codes(self, tmp_path, capsys):
+        from tpuflow.analysis.__main__ import main
+
+        # findings -> 1, naming each planted defect with file:line
+        (tmp_path / "racy.py").write_text(RACY_SOURCE)
+        assert main(["repo", str(tmp_path)]) == 1
+        out = capsys.readouterr().out
+        assert "TPF016" in out and "TPF017" in out and "TPF018" in out
+        assert f"racy.py:{_planted_line(RACY_SOURCE, 'PLANTED: TPF016')}" \
+            in out
+        # --baseline accepts -> rerun exits 0
+        assert main(["repo", str(tmp_path), "--baseline"]) == 0
+        capsys.readouterr()
+        assert main(["repo", str(tmp_path)]) == 0
+        assert "concurrency-clean" in capsys.readouterr().out
+        # --json is machine-parseable
+        (tmp_path / "concurrency_baseline.json").unlink()
+        assert main(["repo", str(tmp_path), "--json"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert {f["code"] for f in doc["findings"]} == {
+            "TPF016", "TPF017", "TPF018"
+        }
+        # a malformed baseline is exit 2 with the file named
+        (tmp_path / "concurrency_baseline.json").write_text("[]")
+        assert main(["repo", str(tmp_path)]) == 2
+        assert "top level must be an object" in capsys.readouterr().err
+        # missing root is exit 2
+        assert main(["repo", str(tmp_path / "nope")]) == 2
+
+
+class TestConcurrencyPrecision:
+    """Regression drills for the analyzer's soundness/precision seams:
+    local shadowing, wrong-lock detection, local-Lock pollution, and
+    the explicit-baseline-file contract."""
+
+    def _analyze(self, tmp_path, source):
+        from tpuflow.analysis.concurrency import analyze_index, build_index
+
+        (tmp_path / "m.py").write_text(textwrap.dedent(source))
+        return analyze_index(build_index(str(tmp_path)))
+
+    def test_local_shadowing_a_guarded_global_is_not_a_race(
+        self, tmp_path
+    ):
+        findings = self._analyze(tmp_path, """\
+            import threading
+
+            _LOCK = threading.Lock()
+            _SEQ = 0
+
+
+            def bump():
+                global _SEQ
+                with _LOCK:
+                    _SEQ += 1
+
+
+            def unrelated():
+                _SEQ = 41  # a LOCAL; no global decl, no race
+                return _SEQ + 1
+
+
+            def spawn():
+                threading.Thread(target=bump, daemon=True).start()
+        """)
+        assert findings == [], [f.message for f in findings]
+
+    def test_wrong_lock_is_flagged_not_credited(self, tmp_path):
+        findings = self._analyze(tmp_path, """\
+            import threading
+
+
+            class TwoLocks:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._other = threading.Lock()
+                    self._n = 0
+                    self._thread = threading.Thread(
+                        target=self._loop, daemon=True
+                    )
+
+                def _loop(self):
+                    with self._lock:
+                        self._n += 1
+
+                def bump(self):
+                    with self._lock:
+                        self._n += 1
+
+                def bump_wrong(self):
+                    with self._other:
+                        self._n += 1
+        """)
+        (f,) = findings
+        assert f.rule == "TPF016" and f.subject == "_n"
+        assert "DIFFERENT lock" in f.message
+        assert "_other" in f.message and "_lock" in f.message
+        assert f.scope == "TwoLocks.bump_wrong"
+
+    def test_condition_alias_shares_the_wrapped_mutex(self, tmp_path):
+        # Condition(self._lock) IS self._lock: holding either satisfies
+        # a guard established under the other (the microbatch pair).
+        assert self._analyze(tmp_path, """\
+            import threading
+
+
+            class Paired:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cond = threading.Condition(self._lock)
+                    self._n = 0
+                    self._thread = threading.Thread(
+                        target=self._loop, daemon=True
+                    )
+
+                def _loop(self):
+                    with self._cond:
+                        self._n += 1
+
+                def read(self):
+                    with self._lock:
+                        return self._n
+        """) == []
+
+    def test_function_local_lock_does_not_mask_a_race(self, tmp_path):
+        findings = self._analyze(tmp_path, """\
+            import threading
+
+            _LOCK = threading.Lock()
+            _COUNT = 0
+
+
+            def good():
+                global _COUNT
+                with _LOCK:
+                    _COUNT += 1
+
+
+            def racy():
+                global _COUNT
+                helper = threading.Lock()
+                with helper:
+                    _COUNT += 1
+
+
+            def spawn():
+                threading.Thread(target=good, daemon=True).start()
+        """)
+        (f,) = findings
+        assert f.rule == "TPF016" and f.subject == "_COUNT"
+        assert f.scope == "racy"
+
+    def test_explicit_missing_baseline_file_is_loud(self, tmp_path, capsys):
+        from tpuflow.analysis.__main__ import main
+
+        (tmp_path / "racy.py").write_text(RACY_SOURCE)
+        rc = main([
+            "repo", str(tmp_path),
+            "--baseline-file", str(tmp_path / "typo_baseline.json"),
+        ])
+        assert rc == 2
+        err = capsys.readouterr().err
+        assert "typo_baseline.json" in err and "unreadable" in err

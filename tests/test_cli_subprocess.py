@@ -374,3 +374,48 @@ def test_online_module_smoke(tmp_path):
     )
     assert out.returncode == 2
     assert "online" in out.stderr
+
+
+def test_analysis_repo_subprocess(tmp_path):
+    """python -m tpuflow.analysis repo: the repo-wide concurrency pass
+    as a REAL subprocess — exit 0 on the package (the committed baseline
+    covers triaged-accepted sites), exit 1 on a seeded-race fixture
+    naming all three planted defects with file:line, exit 2 on a
+    malformed baseline with the file/field in the error."""
+    import json
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    gate = subprocess.run(
+        [sys.executable, "-m", "tpuflow.analysis", "repo"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=240,
+    )
+    assert gate.returncode == 0, gate.stdout + gate.stderr[-2000:]
+    assert "concurrency-clean" in gate.stdout
+
+    from test_analysis import RACY_SOURCE, _planted_line
+
+    (tmp_path / "racy.py").write_text(RACY_SOURCE)
+    seeded = subprocess.run(
+        [sys.executable, "-m", "tpuflow.analysis", "repo", str(tmp_path),
+         "--json"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=240,
+    )
+    assert seeded.returncode == 1, seeded.stderr[-2000:]
+    doc = json.loads(seeded.stdout)
+    by_code = {f["code"]: f["where"] for f in doc["findings"]}
+    assert set(by_code) == {"TPF016", "TPF017", "TPF018"}
+    for code in ("TPF016", "TPF017", "TPF018"):
+        line = _planted_line(RACY_SOURCE, f"PLANTED: {code}")
+        assert by_code[code].endswith(f"racy.py:{line}")
+
+    (tmp_path / "concurrency_baseline.json").write_text(
+        '{"entries": [{"rule": "TPF099"}]}'
+    )
+    bad = subprocess.run(
+        [sys.executable, "-m", "tpuflow.analysis", "repo", str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=240,
+    )
+    assert bad.returncode == 2
+    assert "concurrency_baseline.json" in bad.stderr
+    assert "Traceback" not in bad.stderr

@@ -15,8 +15,16 @@ instead of raising, so one preflight reports every problem in a spec:
 4. **lint** (:mod:`tpuflow.analysis.linter`) — AST rules over the
    ``tpuflow`` package itself (host syncs in jit, untraced randomness,
    mutable defaults, unknown fault sites); tier-1 runs it as a gate.
+5. **concurrency** (:mod:`tpuflow.analysis.concurrency`) — the
+   REPO-WIDE pass: one AST index over every class, lock attribute,
+   ``with <lock>`` region, and thread entry point, feeding
+   lock-discipline race detection (TPF016 guarded-attribute access
+   outside its lock, TPF017 blocking call under a lock, TPF018
+   thread-lifecycle hygiene) with a committed baseline for
+   triaged-accepted sites; tier-1 runs it as a gate too.
 
 Entry points: ``python -m tpuflow.analysis spec.json`` for CI,
+``python -m tpuflow.analysis repo`` for the concurrency pass,
 ``tpuflow.cli --preflight`` (on by default; ``--no-preflight`` escapes),
 and ``train()``/``supervise()``/``serve`` fail-fast on submission.
 """
@@ -84,6 +92,10 @@ def preflight(
         from tpuflow.analysis.linter import lint_package
 
         _run("lint", lambda: lint_package())
+    if "concurrency" in passes:
+        from tpuflow.analysis.concurrency import analyze_repo
+
+        _run("concurrency", lambda: analyze_repo())
     return report
 
 

@@ -5,6 +5,7 @@ Usage::
     python -m tpuflow.analysis spec.json [spec2.json ...] [--devices N]
     python -m tpuflow.analysis --lint [PATH]
     python -m tpuflow.analysis spec.json --lint     # both
+    python -m tpuflow.analysis repo [ROOT] [--json|--baseline]
 
 Each positional argument is a JSON job spec in the job-runner contract
 (``tpuflow.serve.spec_to_config`` — camelCase or snake_case fields); the
@@ -14,8 +15,15 @@ the target device count for plan checking without touching a backend —
 nothing here compiles, allocates, or initializes accelerator state.
 ``--lint`` runs the framework linter over ``tpuflow`` (or PATH).
 
+``repo`` is the repo-wide concurrency pass (TPF016–TPF018,
+``tpuflow/analysis/concurrency.py``): findings minus the committed
+baseline, ``--json`` for machine output, ``--baseline`` to accept the
+current findings into the baseline file (existing justifications are
+preserved per fingerprint).
+
 Exit status: 0 when no pass reported an error, 1 otherwise, 2 for
-unusable inputs (missing/unparseable spec file).
+unusable inputs (missing/unparseable spec file, malformed baseline,
+missing analysis root).
 """
 
 from __future__ import annotations
@@ -25,7 +33,102 @@ import json
 import sys
 
 
+def _repo_main(argv: list[str]) -> int:
+    """The ``repo`` subcommand: repo-wide concurrency analysis."""
+    import os
+
+    from tpuflow.analysis import concurrency
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tpuflow.analysis repo",
+        description="repo-wide concurrency analysis (TPF016-TPF018): "
+                    "lock-discipline race detection over the package",
+    )
+    ap.add_argument("root", nargs="?", default=None, metavar="ROOT",
+                    help="directory to analyze (default: the installed "
+                         "tpuflow package)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--baseline", action="store_true",
+                    help="accept the current findings into the baseline "
+                         "file (existing entries keep their reasons; "
+                         "new ones get a TODO placeholder to edit)")
+    ap.add_argument("--baseline-file", default=None, metavar="PATH",
+                    help="baseline path (default: "
+                         "<ROOT>/analysis/concurrency_baseline.json "
+                         "when ROOT has an analysis/ dir, else "
+                         "<ROOT>/concurrency_baseline.json)")
+    args = ap.parse_args(argv)
+
+    root = args.root or concurrency.default_root()
+    if not os.path.isdir(root):
+        print(f"repo: {root}: not a directory", file=sys.stderr)
+        return 2
+    explicit_baseline = args.baseline_file is not None
+    baseline_file = (
+        args.baseline_file or concurrency.default_baseline_path(root)
+    )
+    try:
+        if args.baseline:
+            findings = concurrency.analyze_index(
+                concurrency.build_index(root)
+            )
+            reasons = {}
+            if os.path.exists(baseline_file):
+                reasons = {
+                    concurrency._baseline_key(e): e["reason"]
+                    for e in concurrency.load_baseline(baseline_file)
+                }
+            n = concurrency.write_baseline(
+                baseline_file, findings, reasons
+            )
+            print(
+                f"repo: accepted {n} finding(s) into {baseline_file} "
+                "(edit each TODO reason into a real justification)"
+            )
+            return 0
+        # An EXPLICIT --baseline-file is a contract: if it cannot be
+        # loaded, fail loudly (load_baseline raises "unreadable") —
+        # silently analyzing without the user's baseline would report
+        # "concurrency-clean" while skipping stale-entry checking. Only
+        # the implicit default path may be legitimately absent.
+        diags = concurrency.analyze_repo(
+            root,
+            baseline_path=(
+                baseline_file
+                if explicit_baseline or os.path.exists(baseline_file)
+                else None
+            ),
+        )
+    except concurrency.BaselineError as e:
+        print(f"repo: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps({
+            "root": root,
+            "findings": [
+                {
+                    "code": d.code,
+                    "message": d.message,
+                    "where": d.where,
+                }
+                for d in diags
+            ],
+        }, indent=2))
+    elif diags:
+        print(f"repo: {len(diags)} concurrency finding(s) in {root}")
+        for d in diags:
+            print(f"  {d.render()}")
+    else:
+        print(f"repo OK: {root} is concurrency-clean")
+    return 1 if diags else 0
+
+
 def main(argv: list[str] | None = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "repo":
+        return _repo_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m tpuflow.analysis",
         description="preflight static analysis for tpuflow job specs",

@@ -1,0 +1,1435 @@
+"""Pass 5 — repo-wide concurrency analyzer: static lock-discipline races.
+
+Every other analysis pass is per-file and per-function; this one builds
+ONE index over the whole package — every class, method, lock attribute,
+``with <lock>`` region, and thread entry point — and reasons across
+functions, classes, and files at once. tpuflow is a fleet of cooperating
+threads (batcher lanes, coordinator rounds, supervisor watchdogs, metric
+registries, daemon admission); a guarded attribute read without its lock
+is invisible to pytest until a chaos soak turns it into a flaky SLO
+violation. The cheapest place to catch it is here, statically, in
+tier-1 — the DeepSpark/BigDL lesson (PAPERS.md) that async exchange and
+thread-pooled serving make data races the dominant correctness hazard.
+
+Lock-discipline inference (the index's core judgment):
+
+- A **lock** is an attribute assigned ``threading.Lock()`` / ``RLock()``
+  / ``Condition(...)`` (module-level lock names count too). A Condition
+  wraps a mutex, so holding it IS holding a lock.
+- An attribute **written under** ``with <lock>:`` in any method — in the
+  class or anywhere in its inheritance family — is inferred *guarded*.
+  ``__init__``/``__post_init__`` writes neither guard nor violate: the
+  object is pre-publication, no other thread can hold a reference yet.
+- A method named ``*_locked`` is callee-side convention for "my caller
+  holds the lock": its accesses count as guarded, and its writes count
+  as guarding evidence (the repo's ``_admit_locked``/``_drain_locked``
+  idiom).
+- A class is **thread-shared** once any of its methods is reachable —
+  over the repo call graph — from a thread entry point: a
+  ``Thread(target=...)``, an ``executor.submit``/``run_in_executor``
+  argument, an HTTP-handler method, or any function/lambda registered
+  as a callback (gauge ``fn=``, batcher ``on_done=``, reload hooks).
+  Callback registration is deliberately over-approximated: a callable
+  that escapes into a registry runs on whatever thread collects it.
+
+Three rule families ride on the index:
+
+- **TPF016** — guarded-attribute access outside its lock: a read or
+  write of an inferred-guarded attribute, in a thread-shared class,
+  without holding THE guarding mutex (not in ``__init__`` / a
+  ``*_locked`` method). The guard is the intersection of every locked
+  write's canonical tokens — ``Condition(self._lock)`` aliases to the
+  lock it wraps — falling back to the majority mutex when writes
+  disagree, so holding a DIFFERENT lock (the classic wrong-lock race)
+  is flagged exactly like holding none. Module globals get the
+  write-only variant: an unguarded WRITE to a global that is elsewhere
+  written under a module lock (reads of module globals are pervasively
+  safe constants; lost updates are not), with Python scoping honored —
+  a local that shadows a guarded global is not a race.
+- **TPF017** — blocking call while holding a lock: ``sleep``, socket
+  ops, ``open(...)``, ``subprocess.*``, ``requests.*``, ``.result()``
+  on a future, ``Event.wait``, ``Thread.join`` inside a ``with <lock>``
+  region (or a ``*_locked`` method). Every other thread that needs the
+  lock stalls behind I/O it cannot see. ``Condition.wait`` is exempt —
+  it RELEASES the lock; that is its contract.
+- **TPF018** — thread-lifecycle hygiene: ``Condition.wait`` outside a
+  predicate loop (wakeups are allowed to be spurious; an un-looped wait
+  is a missed-notify hang), and a non-daemon ``Thread(...)`` that is
+  never ``join``ed or marked daemon (a leak that outlives — or hangs —
+  interpreter shutdown).
+
+Accepted findings live in a committed **baseline**
+(``tpuflow/analysis/concurrency_baseline.json``): entries are
+fingerprinted (rule, file, scope, subject) — line-number-free, so they
+survive unrelated edits — and every entry carries a one-line
+justification. A baseline entry whose finding no longer exists is
+itself reported (stale-entry hygiene). ``# noqa: TPF016`` line
+suppression works exactly as in the per-file linter.
+
+Entry points: ``python -m tpuflow.analysis repo [--json|--baseline]``
+and the tier-1 self-analysis gate (zero unbaselined findings over
+``tpuflow/``) in tests/test_analysis.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+
+from tpuflow.analysis.diagnostics import Diagnostic
+from tpuflow.analysis.linter import _noqa_lines
+
+_PASS = "concurrency"
+
+RULES = {
+    "TPF016": "guarded-attribute access outside its lock: the attribute "
+              "is written under a lock elsewhere in the class family, so "
+              "every access in a thread-shared class must hold it — an "
+              "unguarded read can observe a torn update, an unguarded "
+              "write can lose one",
+    "TPF017": "blocking call (sleep / socket / file I/O / .result() / "
+              "subprocess / Event.wait / Thread.join) while holding a "
+              "lock: every thread that needs the lock stalls behind I/O "
+              "it cannot see — move the blocking work outside the "
+              "critical section (Condition.wait is exempt: it releases "
+              "the lock by contract)",
+    "TPF018": "thread-lifecycle hygiene: Condition.wait outside a "
+              "predicate loop (spurious wakeups and missed notifies are "
+              "part of the contract — re-check the predicate in a while "
+              "loop), or a non-daemon Thread that is never joined or "
+              "marked daemon (leaks past — or hangs — interpreter "
+              "shutdown)",
+}
+
+# The stale-baseline hygiene code: an accepted finding whose code no
+# longer exists. Reported as an error so the gate forces the entry's
+# removal — a baseline that only grows is a baseline nobody reads.
+STALE_CODE = "concurrency.baseline.stale"
+
+# The *_locked convention's pseudo-token: "my caller holds the lock" —
+# which lock, the callee cannot know statically.
+_CALLER_TOKEN = "<caller holds the lock>"
+
+# threading constructors the index recognizes, by terminal call name.
+_LOCK_CTORS = {"Lock", "RLock"}
+_COND_CTORS = {"Condition"}
+_EVENT_CTORS = {"Event"}
+_THREAD_CTORS = {"Thread"}
+
+# Methods that mutate their receiver: a call like ``self._pending.pop(0)``
+# is a WRITE access to ``_pending`` for guarding/violation purposes.
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "remove", "clear", "add", "discard", "update", "setdefault",
+    "popitem",
+}
+
+# TPF017 blocking shapes. ``sleep`` matches by bare call name (catches
+# time.sleep, self.sleep, and injected sleeps — the TPF007 precedent)
+# except under an ``asyncio`` base; the module roots catch everything
+# dispatched through them.
+_BLOCKING_ROOTS = {"socket", "subprocess", "requests"}
+_BLOCKING_NAMES = {"open", "urlopen"}
+
+# Init-phase methods: accesses here are pre-publication (no other
+# thread can hold a reference to a half-constructed object), so they
+# neither establish guarding nor violate it.
+_INIT_METHODS = {"__init__", "__post_init__", "__del__", "__repr__"}
+
+# Callback-registration keywords: a callable passed under one of these
+# names escapes into a registry and runs on whatever thread collects
+# it (metrics scrape threads, dispatcher lanes, reload hooks).
+_CALLBACK_KWARGS = {
+    "target", "fn", "on_done", "callback", "on_artifact_change",
+}
+
+# HTTP-handler entry heuristic: ThreadingHTTPServer/socketserver spawn
+# one thread per request into these methods.
+_HANDLER_PREFIXES = ("do_",)
+_HANDLER_NAMES = {"handle", "handle_one_request", "process_request"}
+
+
+# ---------------------------------------------------------------------
+# the index
+# ---------------------------------------------------------------------
+
+
+@dataclass
+class Access:
+    """One ``self.<attr>`` (or module-global) touch inside a function."""
+
+    attr: str
+    write: bool
+    line: int
+    locks: frozenset  # lock tokens lexically held
+
+
+@dataclass
+class BlockingCall:
+    """A blocking-shaped call and the locks held around it."""
+
+    what: str  # rendered callable, e.g. "time.sleep"
+    line: int
+    locks: frozenset
+
+
+@dataclass
+class CondWait:
+    """A ``<condition>.wait(...)`` call site."""
+
+    expr: str  # rendered receiver, e.g. "self._cond"
+    line: int
+    in_loop: bool  # lexically inside a while/for of the same function
+
+
+@dataclass
+class ThreadSpawn:
+    """A ``threading.Thread(...)`` construction site."""
+
+    line: int
+    daemon: bool | None  # True/False from the kwarg; None = not passed
+    bound_to: str | None  # assignment target's terminal name, if any
+
+
+@dataclass
+class FuncInfo:
+    qual: str  # "Class.method", "func", "Class.__init__.<lambda>"
+    name: str
+    cls: str | None
+    lineno: int
+    module: "ModuleInfo" = field(repr=False, default=None)
+    callees: list = field(default_factory=list)  # (kind, name) pairs
+    accesses: list = field(default_factory=list)  # self.<attr> Access
+    global_accesses: list = field(default_factory=list)
+    blocking: list = field(default_factory=list)  # BlockingCall
+    cond_waits: list = field(default_factory=list)  # CondWait
+    spawns: list = field(default_factory=list)  # ThreadSpawn
+    is_entry: bool = False
+
+    @property
+    def locked_convention(self) -> bool:
+        return self.name.endswith("_locked")
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    module: "ModuleInfo" = field(repr=False, default=None)
+    bases: list = field(default_factory=list)  # base names (strings)
+    locks: dict = field(default_factory=dict)  # attr -> kind
+    events: set = field(default_factory=set)  # Event attrs
+    threads: set = field(default_factory=set)  # Thread attrs
+    methods: dict = field(default_factory=dict)  # name -> FuncInfo
+
+
+@dataclass
+class ModuleInfo:
+    path: str  # as walked (display)
+    rel: str  # /-normalized path relative to the analysis root
+    locks: dict = field(default_factory=dict)  # module lock name -> kind
+    global_names: set = field(default_factory=set)  # top-level bindings
+    classes: dict = field(default_factory=dict)
+    functions: dict = field(default_factory=dict)  # qual -> FuncInfo
+    noqa: dict = field(default_factory=dict)  # line -> {codes}
+    joined_names: set = field(default_factory=set)  # x in `x.join(...)`
+    daemon_set_names: set = field(default_factory=set)  # x.daemon = True
+    entry_refs: set = field(default_factory=set)  # (kind, name) escaped
+
+
+@dataclass
+class RepoIndex:
+    root: str
+    modules: dict = field(default_factory=dict)  # rel -> ModuleInfo
+    # repo-wide lookup tables (the cross-file reasoning surface)
+    cond_attr_names: set = field(default_factory=set)
+    event_attr_names: set = field(default_factory=set)
+    thread_attr_names: set = field(default_factory=set)
+    lock_attr_names: set = field(default_factory=set)
+    lock_aliases: dict = field(default_factory=dict)  # cond -> wrapped lock
+    methods_by_name: dict = field(default_factory=dict)  # name -> [FuncInfo]
+
+    def all_functions(self):
+        for mod in self.modules.values():
+            yield from mod.functions.values()
+            for cls in mod.classes.values():
+                yield from cls.methods.values()
+
+
+def _terminal_name(node) -> str | None:
+    """The rightmost identifier of a Name/Attribute chain."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _root_name(node) -> str | None:
+    """The leftmost identifier of a Name/Attribute chain."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _render(node) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover — unparse is total on our input
+        return "<expr>"
+
+
+def _ctor_kind(value) -> str | None:
+    """'Lock'|'RLock'|'Condition'|'Event'|'Thread' for a threading
+    constructor call, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = _terminal_name(value.func)
+    if name in _LOCK_CTORS | _COND_CTORS | _EVENT_CTORS | _THREAD_CTORS:
+        return name
+    return None
+
+
+# ---------------------------------------------------------------------
+# phase A — declarations: locks, conditions, events, threads, globals
+# ---------------------------------------------------------------------
+
+
+def _scan_declarations(index: RepoIndex, mod: ModuleInfo, tree) -> None:
+    # Module-level bindings (the global-candidate set: the write-only
+    # TPF016 variant must never mistake a local for a module global).
+    for stmt in tree.body:
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            targets = [stmt.target]
+        for t in targets:
+            if isinstance(t, ast.Name):
+                mod.global_names.add(t.id)
+        # Module LOCKS register from top-level statements ONLY: a
+        # function-local `helper = threading.Lock()` must not enter
+        # mod.locks (it would credit `with helper:` as held coverage
+        # everywhere in the module and mask real races).
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            kind = _ctor_kind(stmt.value)
+            if kind in _LOCK_CTORS | _COND_CTORS:
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        mod.locks[t.id] = kind
+                        index.lock_attr_names.add(t.id)
+                        if kind in _COND_CTORS:
+                            index.cond_attr_names.add(t.id)
+                            _note_alias(index, t.id, stmt.value)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Global):
+            mod.global_names.update(node.names)
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        kind = _ctor_kind(node.value)
+        if kind is None:
+            continue
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for target in targets:
+            # Attribute targets only here: an attribute assignment is
+            # object state regardless of where it happens; bare-Name
+            # locks were handled above at module level (a local Lock
+            # binding is the caller's business, not the index's).
+            if isinstance(target, ast.Attribute):
+                attr = target.attr
+                if kind in _LOCK_CTORS | _COND_CTORS:
+                    index.lock_attr_names.add(attr)
+                    if kind in _COND_CTORS:
+                        index.cond_attr_names.add(attr)
+                        _note_alias(index, attr, node.value)
+                elif kind in _EVENT_CTORS:
+                    index.event_attr_names.add(attr)
+                elif kind in _THREAD_CTORS:
+                    index.thread_attr_names.add(attr)
+
+
+def _note_alias(index: RepoIndex, cond_name: str, value) -> None:
+    """``X = threading.Condition(<lock>)`` wraps THE SAME mutex as
+    ``<lock>``: record the alias so holding either token satisfies a
+    guard established under the other (microbatch's ``_cond``/``_lock``
+    pair). Resolvable only when the wrapped lock is a Name or
+    ``self.<attr>`` — a parameter stays unaliased (coarse, and safe:
+    an unresolved alias means two distinct canonical tokens, which can
+    only ADD findings, never hide one)."""
+    if not (isinstance(value, ast.Call) and value.args):
+        return
+    wrapped = _terminal_name(value.args[0])
+    if wrapped and wrapped != cond_name:
+        index.lock_aliases[cond_name] = wrapped
+
+
+def _class_declarations(index: RepoIndex, cls: ClassInfo, node) -> None:
+    """Per-class lock/event/thread attribute tables (``self.X = ...``
+    anywhere in the class body, plus annotated attrs)."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+            continue
+        kind = _ctor_kind(sub.value)
+        # `self._thread: threading.Thread | None = None` style: the
+        # annotation names the kind even when the value is None.
+        if kind is None and isinstance(sub, ast.AnnAssign):
+            ann = _render(sub.annotation)
+            for k in ("Thread", "Event", "Condition", "RLock", "Lock"):
+                if k in ann:
+                    kind = k
+                    break
+        if kind is None:
+            continue
+        targets = (
+            sub.targets if isinstance(sub, ast.Assign) else [sub.target]
+        )
+        for target in targets:
+            if (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                attr = target.attr
+                if kind in _LOCK_CTORS | _COND_CTORS:
+                    cls.locks[attr] = kind
+                    index.lock_attr_names.add(attr)
+                    if kind in _COND_CTORS:
+                        index.cond_attr_names.add(attr)
+                        _note_alias(index, attr, sub.value)
+                elif kind in _EVENT_CTORS:
+                    cls.events.add(attr)
+                    index.event_attr_names.add(attr)
+                elif kind in _THREAD_CTORS:
+                    cls.threads.add(attr)
+                    index.thread_attr_names.add(attr)
+
+
+# ---------------------------------------------------------------------
+# phase B — per-function scan: accesses, held locks, blocking calls
+# ---------------------------------------------------------------------
+
+
+class _FunctionScanner:
+    """One function's body, walked with a lexical held-locks set.
+
+    Nested function/lambda bodies are NOT descended into — each nested
+    def is scanned as its own FuncInfo (a nested body runs when CALLED,
+    on whatever thread calls it, with whatever locks that thread then
+    holds — inheriting the definition site's locks would be wrong in
+    both directions)."""
+
+    def __init__(self, index: RepoIndex, mod: ModuleInfo, info: FuncInfo):
+        self.index = index
+        self.mod = mod
+        self.info = info
+        self.entry_lambda_lines: set = set()
+
+    def _is_lock_expr(self, node) -> bool:
+        name = _terminal_name(node)
+        if name is None:
+            return False
+        if isinstance(node, ast.Name):
+            return name in self.mod.locks
+        # An attribute chain against the repo-wide lock-attr table:
+        # `lane.cond` and `self._lock` both resolve by terminal name —
+        # the coarse-but-sound direction (more held coverage, not less).
+        return name in self.index.lock_attr_names
+
+    def scan(self, node) -> None:
+        self._collect_bindings(node)
+        base = (
+            frozenset({_CALLER_TOKEN})
+            if self.info.locked_convention else frozenset()
+        )
+        body = [node.body] if isinstance(node, ast.Lambda) else node.body
+        for stmt in body:
+            self._walk(stmt, base, 0)
+
+    def _collect_bindings(self, node) -> None:
+        """Python scoping for the global pass: a name ASSIGNED anywhere
+        in the function (params included) is a LOCAL unless declared
+        ``global`` — a local that happens to shadow a guarded module
+        global must not read as a race. Nested defs are their own
+        scope and are skipped (they get their own scan)."""
+        self._global_decls: set = set()
+        assigned: set = set()
+        args = getattr(node, "args", None)
+        if args is not None:
+            for a in (
+                list(args.posonlyargs) + list(args.args)
+                + list(args.kwonlyargs)
+                + ([args.vararg] if args.vararg else [])
+                + ([args.kwarg] if args.kwarg else [])
+            ):
+                assigned.add(a.arg)
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (
+                ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+            )):
+                continue
+            if isinstance(sub, ast.Global):
+                self._global_decls.update(sub.names)
+            elif isinstance(sub, ast.Name) and isinstance(
+                sub.ctx, (ast.Store, ast.Del)
+            ):
+                assigned.add(sub.id)
+            stack.extend(ast.iter_child_nodes(sub))
+        self._local_names = assigned
+
+    # -- the recursive walk --
+
+    def _walk(self, node, held: frozenset, loops: int) -> None:
+        if isinstance(node, ast.Lambda):
+            # A lambda that ESCAPED as a callback (fn=/target=/on_done=,
+            # recorded by _record_call before this child visit) runs on
+            # another thread with NO lock — it is its own FuncInfo. A
+            # non-escaping lambda (a sort key, a min() selector) runs
+            # synchronously right here, holding whatever we hold:
+            # inline its body.
+            if node.lineno not in self.entry_lambda_lines:
+                self._walk(node.body, held, loops)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # its own FuncInfo
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            taken = set()
+            for item in node.items:
+                expr = item.context_expr
+                if self._is_lock_expr(expr):
+                    taken.add(_render(expr))
+                self._walk(expr, held, loops)
+            inner = held | frozenset(taken)
+            for stmt in node.body:
+                self._walk(stmt, inner, loops)
+            return
+        if isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+            for child in ast.iter_child_nodes(node):
+                self._walk(child, held, loops + 1)
+            return
+        if isinstance(node, ast.Call):
+            self._record_call(node, held, loops)
+        elif isinstance(node, ast.Attribute):
+            self._record_attribute(node, held)
+        elif isinstance(node, ast.Name):
+            self._record_global(node, held)
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                             ast.Delete)):
+            self._record_store_shapes(node, held)
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, held, loops)
+        # `t = threading.Thread(...)` / `self._t = Thread(...)`: bind
+        # the spawn (recorded while walking the value) to its target
+        # name, the TPF018b join-evidence key.
+        if (
+            isinstance(node, ast.Assign)
+            and _ctor_kind(node.value) in _THREAD_CTORS
+            and node.targets
+        ):
+            bound = _terminal_name(node.targets[0])
+            for spawn in self.info.spawns:
+                if spawn.line == node.value.lineno:
+                    spawn.bound_to = bound
+
+    # -- accesses --
+
+    def _record_attribute(self, node: ast.Attribute, held) -> None:
+        if not (
+            isinstance(node.value, ast.Name) and node.value.id == "self"
+        ):
+            return
+        self.info.accesses.append(Access(
+            attr=node.attr,
+            write=isinstance(node.ctx, (ast.Store, ast.Del)),
+            line=node.lineno, locks=held,
+        ))
+
+    def _record_global(self, node: ast.Name, held) -> None:
+        if node.id not in self.mod.global_names:
+            return
+        if (
+            node.id in self._local_names
+            and node.id not in self._global_decls
+        ):
+            return  # a local shadowing the module name, not the global
+        self.info.global_accesses.append(Access(
+            attr=node.id,
+            write=isinstance(node.ctx, (ast.Store, ast.Del)),
+            line=node.lineno, locks=held,
+        ))
+
+    def _record_store_shapes(self, node, held) -> None:
+        """Writes the plain ctx walk misses: subscript stores
+        (``self._x[k] = v`` / ``GLOBAL[k] = v``) and their delete
+        forms. (Attribute/Name targets already carry Store ctx.)"""
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.Delete):
+            targets = node.targets
+        else:
+            targets = [node.target]
+        for t in targets:
+            if not isinstance(t, ast.Subscript):
+                continue
+            base = t.value
+            if (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+            ):
+                self.info.accesses.append(Access(
+                    attr=base.attr, write=True, line=t.lineno, locks=held,
+                ))
+            elif (
+                isinstance(base, ast.Name)
+                and base.id in self.mod.global_names
+                and not (base.id in self._local_names
+                         and base.id not in self._global_decls)
+            ):
+                self.info.global_accesses.append(Access(
+                    attr=base.id, write=True, line=t.lineno, locks=held,
+                ))
+
+    # -- calls: callees, entries, blocking shapes, waits, spawns --
+
+    def _record_call(self, node: ast.Call, held, loops: int) -> None:
+        func = node.func
+        name = _terminal_name(func)
+        # call-graph edge
+        if isinstance(func, ast.Name):
+            self.info.callees.append(("name", func.id))
+        elif isinstance(func, ast.Attribute):
+            if isinstance(func.value, ast.Name) and func.value.id == "self":
+                self.info.callees.append(("self", func.attr))
+            else:
+                self.info.callees.append(("attr", func.attr))
+
+        # mutation call == write access to the receiver
+        if name in _MUTATORS and isinstance(func, ast.Attribute):
+            base = func.value
+            if (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+            ):
+                self.info.accesses.append(Access(
+                    attr=base.attr, write=True, line=node.lineno,
+                    locks=held,
+                ))
+            elif (
+                isinstance(base, ast.Name)
+                and base.id in self.mod.global_names
+                and not (base.id in self._local_names
+                         and base.id not in self._global_decls)
+            ):
+                self.info.global_accesses.append(Access(
+                    attr=base.id, write=True, line=node.lineno, locks=held,
+                ))
+
+        # thread spawn (TPF018b)
+        if name in _THREAD_CTORS:
+            daemon = None
+            for kw in node.keywords:
+                if kw.arg == "daemon":
+                    daemon = (
+                        bool(kw.value.value)
+                        if isinstance(kw.value, ast.Constant) else True
+                    )
+            self.info.spawns.append(ThreadSpawn(
+                line=node.lineno, daemon=daemon, bound_to=None,
+            ))
+
+        # entry registration: Thread target / submit / run_in_executor /
+        # callback kwargs — anything that lets a callable escape onto
+        # another thread.
+        entry_args = []
+        if name in _THREAD_CTORS:
+            entry_args += [
+                kw.value for kw in node.keywords if kw.arg == "target"
+            ]
+        if name == "submit" and node.args:
+            entry_args.append(node.args[0])
+        if name == "run_in_executor" and len(node.args) >= 2:
+            entry_args.append(node.args[1])
+        if name in ("call_soon_threadsafe", "call_soon",
+                    "add_done_callback") and node.args:
+            entry_args.append(node.args[0])
+        entry_args += [
+            kw.value for kw in node.keywords
+            if kw.arg in _CALLBACK_KWARGS
+        ]
+        for arg in entry_args:
+            self._mark_entry(arg)
+
+        # TPF017 blocking shapes
+        self._record_blocking(node, func, name, held)
+
+        # TPF018a condition waits
+        if name == "wait" and isinstance(func, ast.Attribute):
+            recv = func.value
+            if _terminal_name(recv) in self.index.cond_attr_names:
+                self.info.cond_waits.append(CondWait(
+                    expr=_render(recv), line=node.lineno,
+                    in_loop=loops > 0,
+                ))
+
+    def _record_blocking(self, node, func, name, held) -> None:
+        if not held:
+            return
+        root = _root_name(func) if isinstance(func, ast.Attribute) else None
+        what = None
+        if name == "sleep" and root != "asyncio":
+            what = _render(func)
+        elif isinstance(func, ast.Name) and name in _BLOCKING_NAMES:
+            what = name
+        elif root in _BLOCKING_ROOTS:
+            what = _render(func)
+        elif name == "result" and isinstance(func, ast.Attribute):
+            what = _render(func)
+        elif name == "wait" and isinstance(func, ast.Attribute):
+            recv_name = _terminal_name(func.value)
+            # Event.wait blocks WITHOUT releasing the lock; a
+            # Condition.wait releases it — that is the exemption.
+            if (
+                recv_name in self.index.event_attr_names
+                and recv_name not in self.index.cond_attr_names
+            ):
+                what = _render(func)
+        elif name == "join" and isinstance(func, ast.Attribute):
+            recv_name = _terminal_name(func.value)
+            if recv_name in self.index.thread_attr_names or (
+                recv_name and "thread" in recv_name.lower()
+            ):
+                what = _render(func)
+        if what is not None:
+            self.info.blocking.append(BlockingCall(
+                what=what, line=node.lineno, locks=held,
+            ))
+
+    def _mark_entry(self, arg) -> None:
+        """Resolve a callable reference escaping onto another thread."""
+        if isinstance(arg, ast.Lambda):
+            self.entry_lambda_lines.add(arg.lineno)
+            return
+        if isinstance(arg, ast.Call):
+            # partial(self._loop, ...): the wrapped callable is the
+            # first argument.
+            if _terminal_name(arg.func) == "partial" and arg.args:
+                self._mark_entry(arg.args[0])
+            return
+        if isinstance(arg, ast.Attribute):
+            # `target=self._loop` and `target=worker.run` both resolve
+            # by terminal method name repo-wide (the thread does not
+            # care which class it entered through).
+            self.mod.entry_refs.add(("attr", arg.attr))
+        elif isinstance(arg, ast.Name):
+            self.mod.entry_refs.add(("name", arg.id))
+
+
+# ---------------------------------------------------------------------
+# phase B' — module walk: build FuncInfos, wire entries
+# ---------------------------------------------------------------------
+
+
+class _ModuleBuilder(ast.NodeVisitor):
+    def __init__(self, index: RepoIndex, mod: ModuleInfo, tree):
+        self.index = index
+        self.mod = mod
+        self.tree = tree
+        self._cls_stack: list[ClassInfo] = []
+        self._fn_stack: list[str] = []
+
+    def build(self) -> None:
+        self.visit(self.tree)
+        # join / daemon-flip evidence, module-wide (TPF018b)
+        for node in ast.walk(self.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+            ):
+                recv = _terminal_name(node.func.value)
+                if recv:
+                    self.mod.joined_names.add(recv)
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Attribute)
+                and node.targets[0].attr == "daemon"
+            ):
+                recv = _terminal_name(node.targets[0].value)
+                if recv:
+                    self.mod.daemon_set_names.add(recv)
+
+    def visit_ClassDef(self, node) -> None:
+        cls = ClassInfo(
+            name=node.name, module=self.mod,
+            bases=[_terminal_name(b) for b in node.bases
+                   if _terminal_name(b)],
+        )
+        _class_declarations(self.index, cls, node)
+        self.mod.classes[node.name] = cls
+        self._cls_stack.append(cls)
+        self.generic_visit(node)
+        self._cls_stack.pop()
+
+    def _visit_function(self, node) -> None:
+        cls = self._cls_stack[-1] if self._cls_stack else None
+        name = getattr(node, "name", "<lambda>")
+        qual_parts = ([cls.name] if cls else []) + self._fn_stack + [name]
+        info = FuncInfo(
+            qual=".".join(qual_parts), name=name,
+            cls=cls.name if cls else None,
+            lineno=node.lineno, module=self.mod,
+        )
+        scanner = _FunctionScanner(self.index, self.mod, info)
+        scanner.scan(node)
+        if isinstance(node, ast.Lambda):
+            self.mod.functions.setdefault(
+                f"{info.qual}@{node.lineno}", info
+            )
+        elif cls is not None and not self._fn_stack:
+            cls.methods[name] = info
+        else:
+            self.mod.functions.setdefault(info.qual, info)
+        self.index.methods_by_name.setdefault(name, []).append(info)
+        self._fn_stack.append(name)
+        for sub, owner in _direct_nested(node):
+            if owner is not node:
+                continue
+            if isinstance(sub, ast.Lambda):
+                if sub.lineno in scanner.entry_lambda_lines:
+                    # The literal lambda escaped as a callback: its
+                    # BODY is the thread entry.
+                    self._visit_entry_lambda(sub)
+                # else: inlined into this function's scan above
+            else:
+                self._visit_function(sub)
+        self._fn_stack.pop()
+
+    def _visit_entry_lambda(self, node) -> None:
+        before = set(self.mod.functions)
+        self._visit_function(node)
+        for key in set(self.mod.functions) - before:
+            self.mod.functions[key].is_entry = True
+
+    def visit_FunctionDef(self, node) -> None:
+        self._visit_function(node)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._visit_function(node)
+
+    def visit_Lambda(self, node) -> None:
+        self._visit_function(node)
+
+
+def _direct_nested(root):
+    """(nested def/lambda, owning function) pairs for defs directly
+    inside ``root`` — not inside a deeper def (those belong to their own
+    parent's visit)."""
+    out = []
+    stack = [(child, root) for child in ast.iter_child_nodes(root)]
+    while stack:
+        node, owner = stack.pop()
+        if isinstance(node, (
+            ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+        )):
+            out.append((node, owner))
+            continue  # its own visit walks deeper
+        stack.extend(
+            (child, owner) for child in ast.iter_child_nodes(node)
+        )
+    return [(n, o) for n, o in out if o is root]
+
+
+# ---------------------------------------------------------------------
+# index construction
+# ---------------------------------------------------------------------
+
+
+def build_index(root: str) -> RepoIndex:
+    """Walk every ``.py`` under ``root`` into one cross-file index."""
+    index = RepoIndex(root=os.path.abspath(root))
+    parsed: list[tuple[ModuleInfo, ast.AST]] = []
+    for dirpath, dirnames, filenames in os.walk(index.root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, index.root).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            mod = ModuleInfo(path=path, rel=rel)
+            mod.noqa = _noqa_lines(source)
+            try:
+                tree = ast.parse(source, filename=path)
+            except SyntaxError:
+                continue  # the linter owns TPF000 syntax reporting
+            _scan_declarations(index, mod, tree)
+            index.modules[rel] = mod
+            parsed.append((mod, tree))
+    # Phase B needs the COMPLETE lock tables (a `lane.cond` in module A
+    # resolves against a Condition declared in any module) — hence the
+    # two passes.
+    for mod, tree in parsed:
+        _ModuleBuilder(index, mod, tree).build()
+    # entry wiring: escaped callable references -> FuncInfos
+    for mod in index.modules.values():
+        for kind, name in mod.entry_refs:
+            for target in _resolve(index, mod, None, kind, name):
+                target.is_entry = True
+    # handler-method heuristic
+    for fn in index.all_functions():
+        if fn.cls and (
+            fn.name in _HANDLER_NAMES
+            or fn.name.startswith(_HANDLER_PREFIXES)
+        ):
+            fn.is_entry = True
+    return index
+
+
+def _resolve(index: RepoIndex, mod: ModuleInfo, cls_family,
+             kind: str, name: str):
+    """Call/reference targets for one (kind, name) edge."""
+    if kind == "name":
+        fn = mod.functions.get(name)
+        if fn is not None:
+            return [fn]
+        # nested defs are keyed by qual; fall back to same-module
+        # by-name lookup (`call_soon_threadsafe(_stop)` inside a method)
+        return [
+            f for f in index.methods_by_name.get(name, ())
+            if f.module is mod
+        ]
+    if kind == "self" and cls_family is not None:
+        out = [
+            cls.methods[name] for cls in cls_family
+            if name in cls.methods
+        ]
+        if out:
+            return out
+    # attr (or an unresolved self): every repo method with the name —
+    # class-hierarchy-insensitive, deliberately over-approximate in the
+    # "more reachable" direction.
+    return [
+        fn for fn in index.methods_by_name.get(name, ()) if fn.cls
+    ]
+
+
+# ---------------------------------------------------------------------
+# class families (inheritance closure within the repo)
+# ---------------------------------------------------------------------
+
+
+def _class_families(index: RepoIndex) -> list[list[ClassInfo]]:
+    """Union-find over repo-internal inheritance edges: a family shares
+    one attribute namespace (``self`` is one object), so guarding
+    evidence in a base method covers accesses in a derived one."""
+    by_name: dict[str, list[ClassInfo]] = {}
+    for mod in index.modules.values():
+        for cls in mod.classes.values():
+            by_name.setdefault(cls.name, []).append(cls)
+    classes = [c for group in by_name.values() for c in group]
+    ids = {id(c): i for i, c in enumerate(classes)}
+    parent = list(range(len(classes)))
+
+    def find(i):
+        while parent[i] != i:
+            parent[i] = parent[parent[i]]
+            i = parent[i]
+        return i
+
+    for cls in classes:
+        for base in cls.bases:
+            for target in by_name.get(base, ()):
+                ra, rb = find(ids[id(cls)]), find(ids[id(target)])
+                if ra != rb:
+                    parent[ra] = rb
+    families: dict[int, list[ClassInfo]] = {}
+    for cls in classes:
+        families.setdefault(find(ids[id(cls)]), []).append(cls)
+    return list(families.values())
+
+
+# ---------------------------------------------------------------------
+# reachability
+# ---------------------------------------------------------------------
+
+
+def _reachable_functions(index: RepoIndex, families) -> set:
+    family_of: dict[str, list] = {}
+    for fam in families:
+        for cls in fam:
+            family_of[f"{cls.module.rel}::{cls.name}"] = fam
+    work = [fn for fn in index.all_functions() if fn.is_entry]
+    reached = {id(fn) for fn in work}
+    while work:
+        fn = work.pop()
+        fam = (
+            family_of.get(f"{fn.module.rel}::{fn.cls}")
+            if fn.cls else None
+        )
+        for kind, name in fn.callees:
+            for target in _resolve(index, fn.module, fam, kind, name):
+                if id(target) not in reached:
+                    reached.add(id(target))
+                    work.append(target)
+    return reached
+
+
+# ---------------------------------------------------------------------
+# findings
+# ---------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One concurrency finding + its line-free baseline fingerprint."""
+
+    rule: str
+    message: str
+    path: str  # display path
+    rel: str  # /-normalized, root-relative (the fingerprint's file)
+    line: int
+    scope: str  # nearest named enclosing scope, e.g. "Class.method"
+    subject: str  # the attr / call / resource the finding is about
+
+    @property
+    def fingerprint(self) -> tuple:
+        return (self.rule, self.rel, self.scope, self.subject)
+
+    def diagnostic(self) -> Diagnostic:
+        return Diagnostic(
+            pass_name=_PASS, code=self.rule,
+            message=f"{self.message} — {RULES[self.rule]}",
+            where=f"{self.path}:{self.line}",
+        )
+
+
+def _named_scope(fn: FuncInfo) -> str:
+    """The fingerprint scope: the qualname with lambda segments dropped
+    (lambdas move lines; their nearest named parent does not)."""
+    parts = [p for p in fn.qual.split(".") if not p.startswith("<lambda")]
+    return ".".join(parts) or fn.qual
+
+
+def _canon_token(index: RepoIndex, token: str) -> str:
+    """One canonical name per mutex: the token's terminal segment
+    (``self._lock`` → ``_lock``, ``lane.cond`` → ``cond``), chased
+    through the Condition-alias map (``_cond`` → ``_lock`` when
+    ``Condition(self._lock)`` was seen anywhere in the repo)."""
+    if token == _CALLER_TOKEN:
+        return token
+    name = token.rsplit(".", 1)[-1]
+    seen = set()
+    while name in index.lock_aliases and name not in seen:
+        seen.add(name)
+        name = index.lock_aliases[name]
+    return name
+
+
+def _infer_guard(write_sets: list) -> set:
+    """THE mutex an attribute is disciplined under, from the canonical
+    token sets of its locked writes. Normally the intersection (every
+    write holds it); when writes disagree (already a wrong-lock bug at
+    one of the sites), fall back to the majority mutex so the minority
+    sites — not the whole attribute — read as the violations. Empty
+    when every locked write is *_locked-convention only (the callee
+    cannot name its caller's lock)."""
+    real = [s - {_CALLER_TOKEN} for s in write_sets]
+    real = [s for s in real if s]
+    if not real:
+        return set()
+    inter = set.intersection(*real)
+    if inter:
+        return inter
+    counts: dict[str, int] = {}
+    for s in real:
+        for t in s:
+            counts[t] = counts.get(t, 0) + 1
+    top = max(counts.items(), key=lambda kv: (kv[1], kv[0]))[0]
+    return {top}
+
+
+def _guard_violation(index, held: frozenset, guard: set) -> str | None:
+    """None when ``held`` satisfies ``guard``; else the violation
+    flavor. The SAME mutex must be held — a disjoint lock is the
+    classic wrong-lock race, just as torn as no lock at all. An empty
+    guard (convention-only) accepts any held lock."""
+    held_canon = {_canon_token(index, t) for t in held}
+    if _CALLER_TOKEN in held_canon:
+        return None  # the *_locked convention: caller vouches
+    if not held_canon:
+        return "without a lock"
+    if not guard or held_canon & guard:
+        return None
+    return (
+        f"under {', '.join(sorted(held_canon))} — a DIFFERENT lock"
+    )
+
+
+def analyze_index(index: RepoIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    families = _class_families(index)
+    reached = _reachable_functions(index, families)
+
+    def shared(funcs) -> bool:
+        return any(id(fn) in reached or fn.is_entry for fn in funcs)
+
+    # --- TPF016 over class families ---
+    for fam in families:
+        non_data: set = set()
+        for cls in fam:
+            # locks/conditions/events/threads are synchronization
+            # OBJECTS, not data: touching them is how you synchronize.
+            non_data |= cls.events | cls.threads | set(cls.locks)
+        everyone = [
+            m for cls in fam for m in cls.methods.values()
+        ] + _nested_of(fam)
+        if not shared(everyone):
+            continue
+        # A class with no lock attrs of its own still participates: an
+        # attribute written under a MODULE lock (MetricsLogger._seq
+        # under _SEQ_LOCK) is guarded all the same.
+        write_sets: dict[str, list] = {}
+        first_scope: dict[str, str] = {}
+        for fn in everyone:
+            if fn.name in _INIT_METHODS:
+                continue
+            for acc in fn.accesses:
+                if acc.write and acc.locks and acc.attr not in non_data:
+                    write_sets.setdefault(acc.attr, []).append(
+                        {_canon_token(index, t) for t in acc.locks}
+                    )
+                    first_scope.setdefault(acc.attr, _named_scope(fn))
+        if not write_sets:
+            continue
+        guards = {
+            attr: _infer_guard(sets)
+            for attr, sets in write_sets.items()
+        }
+        for fn in everyone:
+            if fn.name in _INIT_METHODS or fn.locked_convention:
+                continue
+            for acc in fn.accesses:
+                if acc.attr not in guards:
+                    continue
+                how = _guard_violation(
+                    index, acc.locks, guards[acc.attr]
+                )
+                if how is None:
+                    continue
+                verb = "written" if acc.write else "read"
+                named = sorted(guards[acc.attr]) or [
+                    "its lock (held via *_locked callers)"
+                ]
+                findings.append(Finding(
+                    rule="TPF016",
+                    message=(
+                        f"self.{acc.attr} {verb} {how}; it is "
+                        f"written under {', '.join(named)} in "
+                        f"{first_scope[acc.attr]} (inferred guarded)"
+                    ),
+                    path=fn.module.path, rel=fn.module.rel,
+                    line=acc.line, scope=_named_scope(fn),
+                    subject=acc.attr,
+                ))
+
+    # --- TPF016 over module-global lock discipline (writes only:
+    # global reads are pervasively constants; lost updates are the
+    # class that corrupts) ---
+    for mod in index.modules.values():
+        if not mod.locks:
+            continue
+        funcs = list(mod.functions.values()) + [
+            m for cls in mod.classes.values()
+            for m in cls.methods.values()
+        ]
+        if not shared(funcs):
+            continue
+        write_sets = {}
+        first_scope = {}
+        for fn in funcs:
+            for acc in fn.global_accesses:
+                if acc.write and acc.locks and acc.attr not in mod.locks:
+                    write_sets.setdefault(acc.attr, []).append(
+                        {_canon_token(index, t) for t in acc.locks}
+                    )
+                    first_scope.setdefault(acc.attr, _named_scope(fn))
+        guards = {
+            attr: _infer_guard(sets)
+            for attr, sets in write_sets.items()
+        }
+        for fn in funcs:
+            if fn.locked_convention:
+                continue
+            for acc in fn.global_accesses:
+                if acc.attr not in guards or not acc.write:
+                    continue
+                how = _guard_violation(
+                    index, acc.locks, guards[acc.attr]
+                )
+                if how is None:
+                    continue
+                named = sorted(guards[acc.attr]) or ["its lock"]
+                findings.append(Finding(
+                    rule="TPF016",
+                    message=(
+                        f"module global {acc.attr} written {how}; it "
+                        f"is written under {', '.join(named)} in "
+                        f"{first_scope[acc.attr]} (inferred guarded)"
+                    ),
+                    path=mod.path, rel=mod.rel, line=acc.line,
+                    scope=_named_scope(fn), subject=acc.attr,
+                ))
+
+    # --- TPF017 ---
+    for fn in index.all_functions():
+        for call in fn.blocking:
+            findings.append(Finding(
+                rule="TPF017",
+                message=(
+                    f"{call.what}(...) while holding "
+                    f"{', '.join(sorted(call.locks))}"
+                ),
+                path=fn.module.path, rel=fn.module.rel, line=call.line,
+                scope=_named_scope(fn),
+                subject=call.what.split(".")[-1],
+            ))
+
+    # --- TPF018a: un-looped Condition.wait ---
+    for fn in index.all_functions():
+        for wait in fn.cond_waits:
+            if wait.in_loop:
+                continue
+            findings.append(Finding(
+                rule="TPF018",
+                message=(
+                    f"{wait.expr}.wait() outside a predicate loop "
+                    "(spurious wakeup / missed notify hazard)"
+                ),
+                path=fn.module.path, rel=fn.module.rel, line=wait.line,
+                scope=_named_scope(fn), subject="wait",
+            ))
+
+    # --- TPF018b: non-daemon threads nobody joins ---
+    for mod in index.modules.values():
+        funcs = list(mod.functions.values()) + [
+            m for cls in mod.classes.values()
+            for m in cls.methods.values()
+        ]
+        for fn in funcs:
+            for spawn in fn.spawns:
+                if spawn.daemon is not None:
+                    continue
+                if spawn.bound_to and (
+                    spawn.bound_to in mod.joined_names
+                    or spawn.bound_to in mod.daemon_set_names
+                ):
+                    continue
+                if spawn.bound_to is None and mod.joined_names:
+                    # Unbound spawn in a module that joins SOMETHING:
+                    # the `threads.append(Thread(...))` + `for t in
+                    # threads: t.join()` shape — the binding is a list
+                    # element, invisible statically.
+                    continue
+                findings.append(Finding(
+                    rule="TPF018",
+                    message=(
+                        "non-daemon Thread with no reachable join() or "
+                        "daemon flag in this module"
+                    ),
+                    path=mod.path, rel=mod.rel, line=spawn.line,
+                    scope=_named_scope(fn), subject="thread",
+                ))
+
+    # noqa parity with the per-file linter
+    findings = [
+        f for f in findings
+        if f.rule not in index.modules[f.rel].noqa.get(f.line, ())
+    ]
+    findings.sort(key=lambda f: (f.rel, f.line, f.rule))
+    return findings
+
+
+def _nested_of(fam) -> list:
+    """FuncInfos nested under a family method (gauge-callback lambdas,
+    nested defs defined inside methods — their ``self`` is the
+    enclosing method's)."""
+    out = []
+    for cls in fam:
+        prefix = f"{cls.name}."
+        for key, fn in cls.module.functions.items():
+            if fn.cls == cls.name and key.startswith(prefix):
+                out.append(fn)
+    return out
+
+
+# ---------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------
+
+
+class BaselineError(ValueError):
+    """A malformed baseline file. Loud by design (the utils/env.py
+    posture): names the file and the offending entry/field."""
+
+
+def load_baseline(path: str) -> list[dict]:
+    """Parse + validate the baseline; returns its entries. Raises
+    :class:`BaselineError` naming the file and field on anything
+    malformed — a baseline that silently half-loads would silently
+    un-suppress (or worse, un-report) findings."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except OSError as e:
+        raise BaselineError(f"baseline {path}: unreadable ({e})") from e
+    except json.JSONDecodeError as e:
+        raise BaselineError(
+            f"baseline {path}: not valid JSON ({e})"
+        ) from e
+    if not isinstance(doc, dict):
+        raise BaselineError(
+            f"baseline {path}: top level must be an object, got "
+            f"{type(doc).__name__}"
+        )
+    entries = doc.get("entries")
+    if not isinstance(entries, list):
+        raise BaselineError(
+            f"baseline {path}: field 'entries' must be a list, got "
+            f"{type(entries).__name__}"
+        )
+    for i, entry in enumerate(entries):
+        if not isinstance(entry, dict):
+            raise BaselineError(
+                f"baseline {path}: entries[{i}] must be an object, got "
+                f"{type(entry).__name__}"
+            )
+        for key in ("rule", "file", "scope", "subject", "reason"):
+            value = entry.get(key)
+            if not isinstance(value, str) or not value.strip():
+                raise BaselineError(
+                    f"baseline {path}: entries[{i}] field {key!r} must "
+                    "be a non-empty string (every accepted finding "
+                    "carries a one-line justification)"
+                )
+        if entry["rule"] not in RULES:
+            raise BaselineError(
+                f"baseline {path}: entries[{i}] names unknown rule code "
+                f"{entry['rule']!r} (valid: {', '.join(sorted(RULES))})"
+            )
+    return entries
+
+
+def write_baseline(path: str, findings: list[Finding],
+                   reasons: dict | None = None) -> int:
+    """(Re)write the baseline accepting every current finding. Reasons
+    from an existing baseline are preserved per fingerprint; new entries
+    get a placeholder the owner must edit into a real justification."""
+    reasons = reasons or {}
+    seen = set()
+    entries = []
+    for f in findings:
+        if f.fingerprint in seen:
+            continue
+        seen.add(f.fingerprint)
+        entries.append({
+            "rule": f.rule,
+            "file": f.rel,
+            "scope": f.scope,
+            "subject": f.subject,
+            "reason": reasons.get(
+                f.fingerprint,
+                "TODO: replace with a one-line justification",
+            ),
+        })
+    doc = {
+        "version": 1,
+        "comment": (
+            "Triaged-accepted concurrency findings "
+            "(python -m tpuflow.analysis repo --baseline). Entries are "
+            "fingerprinted (rule, file, scope, subject) — no line "
+            "numbers, so they survive unrelated edits. Every entry "
+            "carries a one-line justification; stale entries (finding "
+            "gone) are reported and must be pruned."
+        ),
+        "entries": entries,
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    return len(entries)
+
+
+def _baseline_key(entry: dict) -> tuple:
+    return (entry["rule"], entry["file"], entry["scope"], entry["subject"])
+
+
+# ---------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------
+
+
+def default_root() -> str:
+    import tpuflow
+
+    return os.path.dirname(os.path.abspath(tpuflow.__file__))
+
+
+def default_baseline_path(root: str) -> str:
+    """``<root>/analysis/concurrency_baseline.json`` when the root has
+    an analysis/ package (the tpuflow layout), else flat in the root
+    (fixture dirs)."""
+    nested = os.path.join(root, "analysis")
+    if os.path.isdir(nested):
+        return os.path.join(nested, "concurrency_baseline.json")
+    return os.path.join(root, "concurrency_baseline.json")
+
+
+def analyze_repo(
+    root: str | None = None,
+    baseline_path: str | None = "auto",
+) -> list[Diagnostic]:
+    """The gate-shaped entry: analyze ``root`` (default: the installed
+    tpuflow package), subtract the baseline, and report the remainder
+    PLUS any stale baseline entries as :class:`Diagnostic` records.
+
+    ``baseline_path="auto"`` resolves next to the root (and is simply
+    skipped when absent); ``None`` disables baselining. A malformed
+    baseline raises :class:`BaselineError` — loud, naming file+field.
+    """
+    root = root or default_root()
+    if baseline_path == "auto":
+        candidate = default_baseline_path(root)
+        baseline_path = candidate if os.path.exists(candidate) else None
+    findings = analyze_index(build_index(root))
+    entries = load_baseline(baseline_path) if baseline_path else []
+    by_key: dict[tuple, dict] = {}
+    for e in entries:
+        by_key.setdefault(_baseline_key(e), e)
+    used: set = set()
+    out: list[Diagnostic] = []
+    for f in findings:
+        if f.fingerprint in by_key:
+            used.add(f.fingerprint)
+            continue
+        out.append(f.diagnostic())
+    for e in entries:
+        if _baseline_key(e) not in used:
+            out.append(Diagnostic(
+                pass_name=_PASS, code=STALE_CODE,
+                message=(
+                    f"stale baseline entry {e['rule']} "
+                    f"{e['file']}::{e['scope']}::{e['subject']} — the "
+                    "finding it accepts no longer exists; prune it "
+                    f"from {baseline_path}"
+                ),
+                where=baseline_path,
+            ))
+    return out

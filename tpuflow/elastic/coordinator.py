@@ -41,7 +41,19 @@ class Coordinator:
     """See the module docstring. ``clock``/``sleep`` are injectable for
     zero-wall-clock drills; metrics go to the process-wide registry
     (``elastic_workers`` gauge, eviction/rejoin/round counters,
-    ``elastic.round`` spans)."""
+    ``elastic.round`` spans).
+
+    Concurrency contract (the TPF016 pass's terms): this class holds NO
+    locks by design — every mutable attribute is owned by the one
+    thread driving ``run()``/``step()``; cross-thread communication
+    happens through the exchange backend (``GangStore`` is internally
+    locked) and the ``stop`` event. The single sanctioned cross-thread
+    read is ``state()`` on a wedged-join diagnosis (the runner's
+    timeout path), which snapshots each container before iterating so
+    a concurrent ``step()`` can never tear it mid-iteration. Keep it
+    this way: adding a lock for one field would put every attribute
+    under guarded-access inference, and the right fix for new shared
+    state is the backend, not a coordinator lock."""
 
     def __init__(
         self,
@@ -523,12 +535,23 @@ class Coordinator:
     # ---- state ----
 
     def state(self) -> dict:
+        # Snapshot each container BEFORE iterating: the runner reads
+        # state() cross-thread when coord_thread.join() times out (the
+        # wedged-coordinator diagnosis), and sorting a dict view the
+        # coordinator thread is concurrently publishing into could die
+        # mid-iteration — masking the wedge this summary exists to
+        # report. A C-level copy of builtin containers is atomic under
+        # the GIL; snapshot-granularity staleness is fine for a
+        # diagnostic read.
+        rounds = dict(self.rounds)
+        evicted = set(self.evicted)
+        ever_seen = set(self.ever_seen)
         return {
             "round": self.round,
-            "evicted": sorted(self.evicted),
+            "evicted": sorted(evicted),
             "rejoins": self.rejoins,
-            "rounds": {str(r): ids for r, ids in sorted(self.rounds.items())},
-            "ever_seen": sorted(self.ever_seen),
+            "rounds": {str(r): ids for r, ids in sorted(rounds.items())},
+            "ever_seen": sorted(ever_seen),
         }
 
     def _write_state(self, now: float) -> None:

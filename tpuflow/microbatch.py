@@ -240,10 +240,15 @@ class _BatcherBase:
                             "deadline (never dispatched)"),
             )
         }
+        # Pull-gauge callbacks run on the SCRAPE thread (registry
+        # collect), so they must take the batcher lock like any other
+        # cross-thread reader — the TPF016 discipline. Safe: collect()
+        # holds no metric-family lock while evaluating a callback, and
+        # the batcher's own lock→counter-lock order is one-directional.
         self._depth_gauge = self.registry.gauge(
             "predict_batch_queue_depth_rows",
             "rows currently waiting to be coalesced",
-            fn=lambda: self._queued_rows,
+            fn=self._read_queued_rows,
         )
         self._max_depth_gauge = self.registry.gauge(
             "predict_batch_max_queue_depth_rows",
@@ -254,7 +259,7 @@ class _BatcherBase:
         self._inflight_gauge = self.registry.gauge(
             "predict_batch_inflight_dispatches",
             "device dispatches currently executing",
-            fn=lambda: self._inflight,
+            fn=self._read_inflight,
         )
         self._size_hist = self.registry.histogram(
             "predict_batch_size",
@@ -264,6 +269,17 @@ class _BatcherBase:
         # Exact requests-per-dispatch tallies for the JSON view (the
         # fixed-bucket registry histogram backs the Prometheus one).
         self._hist: dict[int, int] = {}
+
+    def _read_queued_rows(self) -> int:
+        """Scrape-thread read of the queue depth, under the lock (the
+        dispatcher mutates ``_queued_rows`` under ``self._cond``, which
+        wraps this same mutex)."""
+        with self._lock:
+            return self._queued_rows
+
+    def _read_inflight(self) -> int:
+        with self._lock:
+            return self._inflight
 
     def _admit_locked(self, entry: _Pending, what: str) -> None:
         """Bounded-queue admission under ``self._cond`` (caller holds
@@ -573,13 +589,19 @@ class ContinuousBatcher(_BatcherBase):
         self._lanes_gauge = self.registry.gauge(
             "predict_batch_lanes",
             "artifact dispatch lanes currently resident",
-            fn=lambda: len(self._lanes),
+            fn=self._read_lanes,
         )
         # Optional per-lane dispatch hook: called AFTER each lane
         # dispatch completes with (key, requests, rows). The serving
         # replica plane hangs its replica-labeled dispatch counters
         # here; the batcher itself stays replica-agnostic.
         self.on_lane_dispatch = None
+
+    def _read_lanes(self) -> int:
+        """Scrape-thread read of the resident-lane count, under the
+        lock (lanes open/retire under ``self._cond``)."""
+        with self._lock:
+            return len(self._lanes)
 
     # ---- caller side ----
 

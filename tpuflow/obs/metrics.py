@@ -20,7 +20,14 @@ Design constraints:
   enforced by the TPF005 lint rule (``tpuflow/analysis/linter.py``).
 - **Pull-consistent.** Gauges may carry a callback evaluated at
   collect time, so "queued jobs right now" is read under the owner's
-  own lock instead of being pushed on every transition.
+  own lock instead of being pushed on every transition. The callback
+  RUNS ON THE SCRAPE THREAD — so it must actually take the owner's
+  lock when the value it reads is lock-guarded (the TPF016 rule of the
+  repo concurrency pass: pass a bound ``_read_*`` method that acquires
+  the lock, never a bare ``lambda: self._guarded_thing``). Safe by
+  construction: ``collect`` holds no metric-family lock while
+  evaluating a callback, so owner-lock → family-lock stays the one
+  ordering in the process.
 
 Rendering to Prometheus text exposition lives in
 ``tpuflow/obs/prometheus.py``; :meth:`Registry.collect` is the seam.
@@ -90,7 +97,9 @@ class Counter(_Family):
 class Gauge(_Family):
     """Point-in-time value. ``set``/``inc``/``dec`` for pushed values, or
     construct with ``fn`` for a pull gauge evaluated at collect time
-    (e.g. "queued jobs", read under the owning runner's lock)."""
+    (e.g. "queued jobs"). ``fn`` runs on the SCRAPE thread: if it reads
+    lock-guarded state, it must take the owner's lock itself — the
+    module-docstring contract the TPF016 pass enforces."""
 
     kind = "gauge"
 

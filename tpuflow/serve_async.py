@@ -170,7 +170,12 @@ class _Admission:
     """The bounded front door: at most ``max_inflight`` requests past
     admission at once (parsing done, response not yet written) — the
     explicit backlog bound every downstream queue inherits — plus the
-    per-client quota gate. Event-loop-thread only; the counters are the
+    per-client quota gate. ``inflight`` is mutated on the EVENT-LOOP
+    THREAD ONLY (that single-writer discipline is the lock; the TPF016
+    pass infers guarding only where locks exist, so keep all mutation
+    on the loop). The one cross-thread access is the gauge callback's
+    read on the scrape thread — a GIL-atomic int load whose staleness a
+    point-in-time gauge tolerates by definition. The counters are the
     observable 429/503 split."""
 
     def __init__(self, max_inflight: int, buckets: TokenBuckets, registry):

@@ -54,20 +54,27 @@ class MetricsLogger:
 
     def write(self, event: str, **fields) -> dict:
         now = time.time()
-        if self.path:
-            with _SEQ_LOCK:
-                self._seq = _SEQ_BY_PATH[self.path] = (
+        # One lock for BOTH branches, and the record takes the claimed
+        # seq from a local: a pathless logger shared across threads
+        # (the supervisor's echo logger) raced its `_seq += 1`, and
+        # even the pathed branch read `self._seq` back OUTSIDE the
+        # lock — a concurrent writer could overwrite it between claim
+        # and record, stamping two records with one seq (TPF016).
+        with _SEQ_LOCK:
+            if self.path:
+                seq = _SEQ_BY_PATH[self.path] = (
                     _SEQ_BY_PATH.get(self.path, 0) + 1
                 )
-        else:
-            self._seq += 1
+            else:
+                seq = self._seq + 1
+            self._seq = seq
         rec = {
             "event": event,
             "time": now,
             "ts": datetime.datetime.fromtimestamp(
                 now, datetime.timezone.utc
             ).isoformat(timespec="milliseconds"),
-            "seq": self._seq,
+            "seq": seq,
             **fields,
         }
         if "trace_id" not in rec:
