@@ -84,6 +84,27 @@ class TestSpecPass:
         assert d.where == "TPUFLOW_FAULTS"
         assert "site[,key=value...]" in d.message
 
+    def test_config_and_env_faults_colliding_on_a_site_warn(
+        self, monkeypatch
+    ):
+        # ISSUE 16 satellite: the precedence contract surfaced BEFORE
+        # the run — a site armed by both the job's faults list and
+        # TPUFLOW_FAULTS gets a warning naming the site and which spec
+        # wins (resilience/faults.py: the job's spec is evaluated
+        # first; env counters don't advance on calls it consumes).
+        monkeypatch.setenv(
+            "TPUFLOW_FAULTS", "csv.read,nth=3;stream.read,nth=1"
+        )
+        diags = validate_spec(TrainJobConfig(
+            faults=["csv.read,nth=1", "checkpoint.save,at=2"]
+        ))
+        (d,) = [d for d in diags if d.code == "spec.faults.precedence"]
+        assert d.severity == "warning"  # legal, just easy to misread
+        assert "'csv.read'" in d.message
+        assert "evaluated first" in d.message
+        assert "stream.read" not in d.message  # env-only site: no collision
+        assert not any(x.severity == "error" for x in diags)
+
     def test_unserializable_model_kwargs_with_storage(self, tmp_path):
         diags = validate_spec(TrainJobConfig(
             model="static_mlp", storage_path=str(tmp_path),

@@ -419,3 +419,38 @@ def test_analysis_repo_subprocess(tmp_path):
     assert bad.returncode == 2
     assert "concurrency_baseline.json" in bad.stderr
     assert "Traceback" not in bad.stderr
+
+
+def test_runtime_soak_subprocess(tmp_path):
+    """ISSUE 16 satellite: ``python -m tpuflow.runtime soak spec.json``
+    in a REAL subprocess — the full day-in-the-life wiring (supervisor,
+    gang, daemon, online loop, chaos schedule, report card) behind the
+    module entrypoint, exit 0 iff the card is valid with zero drops."""
+    import json
+
+    from tpuflow.runtime.soak import mini_soak_spec
+
+    spec_path = tmp_path / "soak-spec.json"
+    out_path = tmp_path / "soak-out.json"
+    root = tmp_path / "soak"
+    # The mini preset, trimmed further for a cold process (every JAX
+    # compile is paid fresh here, unlike the in-process mini soak).
+    spec = mini_soak_spec(str(root))
+    spec["deadline_s"] = 240.0
+    spec["traffic"]["max_requests"] = 12
+    spec_path.write_text(json.dumps(spec))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpuflow.runtime", "soak", str(spec_path),
+         "-o", str(out_path)],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=420,
+    )
+    assert proc.returncode == 0, proc.stdout[-800:] + proc.stderr[-1200:]
+    verdict = json.loads(proc.stdout.strip())
+    assert verdict["ok"] is True
+    assert verdict["dropped"] == 0
+    assert verdict["time_to_adapt_s"] > 0
+    full = json.loads(out_path.read_text())
+    assert full["card"]["schema"] == "tpuflow.slo.report_card/v1"
+    assert (root / "soak_report.json").exists()

@@ -636,3 +636,166 @@ class TestDegradedServing:
                 "columns": {"pressure": [1.0], "choke": [32.0],
                             "glr": [1.0]},
             })
+
+
+@pytest.mark.faultdrill
+class TestPrecedence:
+    """ISSUE 16 satellite: the documented precedence contract between
+    in-process specs (arm() / TrainJobConfig.faults) and TPUFLOW_FAULTS
+    at the SAME site — the in-process spec is evaluated first at every
+    hit, and when it fires the env spec's counters do not advance on
+    that call (tpuflow/resilience/faults.py module docstring)."""
+
+    def test_inprocess_spec_beats_env_on_the_same_call(self, monkeypatch):
+        # Both would fire on call 1. The env spec raises the TRANSIENT
+        # subtype, so which exception arrives identifies the winner.
+        monkeypatch.setenv("TPUFLOW_FAULTS", "csv.read,nth=1,transient=1")
+        arm(parse_fault_spec("csv.read,nth=1"))
+        with pytest.raises(FaultInjected) as e:
+            fault_point("csv.read")
+        assert not isinstance(e.value, TransientFault)
+        # The env spec's hit counter did NOT advance on the call the
+        # in-process spec consumed.
+        (env_spec,) = [s for s in armed() if s.transient]
+        assert env_spec.hits == 0 and env_spec.fired == 0
+
+    def test_env_counters_advance_once_nothing_inprocess_fires(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("TPUFLOW_FAULTS", "csv.read,nth=2,transient=1")
+        arm(parse_fault_spec("csv.read,nth=5"))
+        fault_point("csv.read")  # neither fires; BOTH counters advance
+        with pytest.raises(TransientFault):
+            fault_point("csv.read")  # env nth=2 reached
+
+
+@pytest.mark.faultdrill
+class TestFaultCursor:
+    """ISSUE 16 satellite: TPUFLOW_FAULTS_CURSOR persists env-spec
+    firing state across process restarts, so a seeded storm RESUMES
+    instead of replaying from hit zero. ``clear_faults()`` + unchanged
+    env simulates the restart (it resets the registry and the env
+    cache exactly as a fresh process would see them)."""
+
+    _SITES = ("stream.read", "checkpoint.save", "serve.execute")
+
+    def test_one_shot_stays_consumed_across_restart(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("TPUFLOW_FAULTS", "stream.read,nth=2")
+        monkeypatch.setenv(
+            "TPUFLOW_FAULTS_CURSOR", str(tmp_path / "cursor.json")
+        )
+        fault_point("stream.read")
+        with pytest.raises(FaultInjected):
+            fault_point("stream.read")
+        clear_faults()  # simulated restart; env unchanged
+        for _ in range(5):
+            fault_point("stream.read")  # consumed: never re-fires
+
+    def _storm(self, hits: int, restart_at: int | None) -> list:
+        """Replay a 3-fault schedule over ``hits`` rounds of all three
+        sites; optionally simulate a process restart before round
+        ``restart_at``. Returns the firing series."""
+        series = []
+        for i in range(1, hits + 1):
+            if restart_at is not None and i == restart_at:
+                clear_faults()
+            for site in self._SITES:
+                index = i if site == "checkpoint.save" else None
+                try:
+                    fault_point(site, index=index)
+                except FaultInjected:
+                    series.append((i, site))
+        return series
+
+    def test_restarted_storm_replays_identically(
+        self, tmp_path, monkeypatch
+    ):
+        """The ISSUE 16 regression drill: replay a 3-fault schedule
+        twice — once uninterrupted, once with a mid-storm restart — and
+        diff the firing series AND the faults_injected_total counter
+        deltas. With the cursor they must be identical."""
+        from tpuflow.obs import default_registry
+
+        env = ("stream.read,nth=2;checkpoint.save,p=0.5,seed=7;"
+               "serve.execute,nth=4")
+        monkeypatch.setenv("TPUFLOW_FAULTS", env)
+        counter = default_registry().counter(
+            "faults_injected_total",
+            "armed fault-injection firings by site",
+        )
+
+        def _deltas(fn):
+            before = {s: counter.value(site=s) for s in self._SITES}
+            series = fn()
+            return series, {
+                s: counter.value(site=s) - before[s] for s in self._SITES
+            }
+
+        monkeypatch.setenv(
+            "TPUFLOW_FAULTS_CURSOR", str(tmp_path / "a.json")
+        )
+        series_a, deltas_a = _deltas(lambda: self._storm(12, None))
+        assert series_a, "the seeded storm fired nothing"
+        clear_faults()
+        monkeypatch.setenv(
+            "TPUFLOW_FAULTS_CURSOR", str(tmp_path / "b.json")
+        )
+        series_b, deltas_b = _deltas(lambda: self._storm(12, restart_at=6))
+        assert series_b == series_a
+        assert deltas_b == deltas_a
+        # The one-shots fired exactly once across the restart.
+        assert sum(1 for _, s in series_b if s == "stream.read") == 1
+        assert sum(1 for _, s in series_b if s == "serve.execute") == 1
+
+    def test_without_cursor_a_restart_replays_from_hit_zero(
+        self, monkeypatch
+    ):
+        # The contrast case (and the crash-loop drills' dependency):
+        # no cursor means the one-shot re-fires after the restart.
+        monkeypatch.setenv("TPUFLOW_FAULTS", "stream.read,nth=1")
+        with pytest.raises(FaultInjected):
+            fault_point("stream.read")
+        clear_faults()
+        with pytest.raises(FaultInjected):
+            fault_point("stream.read")
+
+    def test_unresolved_auto_sentinel_means_no_persistence(
+        self, monkeypatch
+    ):
+        # 'auto' is resolved ONLY by train/supervisor.py; reaching a
+        # fault_point unresolved degrades to no persistence — and never
+        # creates a file literally named 'auto'.
+        monkeypatch.setenv("TPUFLOW_FAULTS", "stream.read,nth=1")
+        monkeypatch.setenv("TPUFLOW_FAULTS_CURSOR", "auto")
+        with pytest.raises(FaultInjected):
+            fault_point("stream.read")
+        clear_faults()
+        with pytest.raises(FaultInjected):
+            fault_point("stream.read")  # nothing persisted: re-fires
+        assert not os.path.exists("auto")
+
+    def test_stale_cursor_for_other_env_value_is_ignored(
+        self, tmp_path, monkeypatch
+    ):
+        path = tmp_path / "cursor.json"
+        path.write_text(json.dumps({
+            "version": 1, "env": "some.other,storm",
+            "state": {"0:stream.read,nth=1,mode=raise":
+                      {"hits": 1, "fired": 1}},
+        }))
+        monkeypatch.setenv("TPUFLOW_FAULTS", "stream.read,nth=1")
+        monkeypatch.setenv("TPUFLOW_FAULTS_CURSOR", str(path))
+        # A cursor written for a DIFFERENT storm must not pre-consume
+        # this one.
+        with pytest.raises(FaultInjected):
+            fault_point("stream.read")
+
+    def test_corrupt_cursor_fails_loudly(self, tmp_path, monkeypatch):
+        path = tmp_path / "cursor.json"
+        path.write_text("not json{")
+        monkeypatch.setenv("TPUFLOW_FAULTS", "stream.read,nth=1")
+        monkeypatch.setenv("TPUFLOW_FAULTS_CURSOR", str(path))
+        with pytest.raises(ValueError, match="TPUFLOW_FAULTS_CURSOR"):
+            fault_point("stream.read")

@@ -1,0 +1,534 @@
+"""Shared-runtime supervisor + chaos schedule + the day-in-the-life
+mini soak (tpuflow/runtime/, docs/architecture.md).
+
+The supervisor drills use synthetic ServiceSpecs (dict handles, scripted
+liveness) so lifecycle behavior — dependency order, restart policy,
+crash-loop classification, healthz rollup — is asserted without real
+workloads; the mini soak at the bottom is the real thing: gang + daemon
++ online loop + Poisson traffic under a seeded fault storm, graded by
+one SLO report card.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpuflow.obs import Registry
+from tpuflow.resilience import (
+    FaultInjected,
+    armed,
+    clear_faults,
+    fault_point,
+)
+from tpuflow.runtime import (
+    ChaosPhase,
+    ChaosSchedule,
+    RuntimeSupervisor,
+    ServiceSpec,
+    mini_soak_spec,
+    process_service,
+    run_soak,
+    thread_service,
+)
+from tpuflow.runtime.supervisor import _topo_order
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("TPUFLOW_FAULTS", raising=False)
+    monkeypatch.delenv("TPUFLOW_FAULTS_CURSOR", raising=False)
+    clear_faults()
+    yield
+    clear_faults()
+
+
+def _wait_for(cond, timeout: float = 8.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def _noop_spec(name: str, depends_on=(), **kw) -> ServiceSpec:
+    return ServiceSpec(
+        name=name, start=lambda: object(), stop=lambda h, g: "stopped",
+        liveness=lambda h: ("ok", ""), depends_on=depends_on, **kw,
+    )
+
+
+def _box_service(name: str, *, probe=None, depends_on=(), **kw):
+    """A scripted service: the box records starts/stops, ``probe(box)``
+    scripts the liveness answer."""
+    box = {
+        "starts": 0, "stops": [],
+        "probe": probe or (lambda b: ("ok", "")),
+    }
+
+    def _start():
+        box["starts"] += 1
+        return box
+
+    def _stop(handle, grace):
+        box["stops"].append(grace)
+        return "stopped"
+
+    def _liveness(handle):
+        return box["probe"](box)
+
+    return box, ServiceSpec(
+        name=name, start=_start, stop=_stop, liveness=_liveness,
+        depends_on=depends_on, **kw,
+    )
+
+
+class TestTopoOrder:
+    def test_declaration_order_without_deps(self):
+        specs = [_noop_spec(n) for n in ("c", "a", "b")]
+        assert _topo_order(specs) == ["c", "a", "b"]
+
+    def test_dependencies_start_first(self):
+        specs = [
+            _noop_spec("serving", depends_on=("gang",)),
+            _noop_spec("traffic", depends_on=("serving",)),
+            _noop_spec("gang"),
+        ]
+        assert _topo_order(specs) == ["gang", "serving", "traffic"]
+
+    def test_cycle_rejected(self):
+        specs = [
+            _noop_spec("a", depends_on=("b",)),
+            _noop_spec("b", depends_on=("a",)),
+        ]
+        with pytest.raises(ValueError, match="cycle"):
+            _topo_order(specs)
+
+    def test_unknown_dependency_rejected(self):
+        with pytest.raises(ValueError, match="unknown service"):
+            _topo_order([_noop_spec("a", depends_on=("ghost",))])
+
+    def test_self_dependency_rejected(self):
+        with pytest.raises(ValueError, match="depends on itself"):
+            _topo_order([_noop_spec("a", depends_on=("a",))])
+
+    def test_duplicate_names_rejected(self):
+        with pytest.raises(ValueError, match="duplicate service names"):
+            _topo_order([_noop_spec("a"), _noop_spec("a")])
+
+
+class TestSpecValidation:
+    def test_negative_grace_rejected(self):
+        with pytest.raises(ValueError, match="grace"):
+            _noop_spec("a", grace=-1.0)
+
+    def test_negative_restart_budget_rejected(self):
+        with pytest.raises(ValueError, match="max_restarts"):
+            _noop_spec("a", max_restarts=-1)
+
+    def test_zero_crash_loop_threshold_rejected(self):
+        with pytest.raises(ValueError, match="crash_loop_threshold"):
+            _noop_spec("a", crash_loop_threshold=0)
+
+    def test_empty_name_rejected(self):
+        with pytest.raises(ValueError, match="name"):
+            _noop_spec("")
+
+
+class TestSupervisorLifecycle:
+    def test_shutdown_reverses_startup_order(self):
+        boxes = {}
+        specs = []
+        for name, deps in (
+            ("gang", ()), ("serving", ("gang",)), ("traffic", ("serving",)),
+        ):
+            box, spec = _box_service(name, depends_on=deps)
+            boxes[name] = box
+            specs.append(spec)
+        sup = RuntimeSupervisor(specs, registry=Registry())
+        sup.start()
+        snap = sup.shutdown()
+        services = snap["services"]
+        # Reverse dependency order: the dependent stops FIRST.
+        assert services["traffic"]["stop_index"] == 0
+        assert services["serving"]["stop_index"] == 1
+        assert services["gang"]["stop_index"] == 2
+        assert all(s["state"] == "stopped" for s in services.values())
+        assert all(s["killed_by"] == "stopped" for s in services.values())
+        assert all(b["stops"] for b in boxes.values())
+
+    def test_start_failure_unwinds_started_prefix(self):
+        first, spec_a = _box_service("a")
+
+        def _boom():
+            raise RuntimeError("no port")
+
+        spec_b = ServiceSpec(
+            name="b", start=_boom, stop=lambda h, g: None,
+            liveness=lambda h: ("ok", ""), depends_on=("a",),
+        )
+        sup = RuntimeSupervisor([spec_a, spec_b], registry=Registry())
+        with pytest.raises(RuntimeError, match="no port"):
+            sup.start()
+        # The already-started prefix was stopped on the way out.
+        assert first["stops"], "service a leaked through the failed start"
+
+    def test_finished_service_detected_and_result_kept(self):
+        svc = thread_service("worker", lambda stop: 42, grace=2.0)
+        sup = RuntimeSupervisor(
+            [svc], registry=Registry(), probe_interval=0.02,
+        )
+        sup.start()
+        try:
+            assert _wait_for(
+                lambda: sup.healthz()["services"]["worker"]["state"]
+                == "finished"
+            )
+            # FINISHED is terminal-but-healthy.
+            assert sup.healthz()["status"] == "ok"
+            assert sup.service_handle("worker").result == 42
+            assert sup.wait(timeout=2.0)
+        finally:
+            sup.shutdown()
+
+    def test_dead_service_restarts_under_budget(self):
+        # Scripted: the first incarnation reads dead, later ones ok.
+        def _probe(box):
+            return ("dead", "first life ends") if box["starts"] == 1 \
+                else ("ok", "")
+
+        box, spec = _box_service(
+            "flappy", probe=_probe, max_restarts=2, min_uptime=0.0,
+            backoff_base=0.001, backoff_max=0.002,
+        )
+        registry = Registry()
+        sup = RuntimeSupervisor(
+            [spec], registry=registry, probe_interval=0.02,
+        )
+        sup.start()
+        try:
+            assert _wait_for(lambda: box["starts"] == 2)
+            assert _wait_for(
+                lambda: sup.healthz()["services"]["flappy"]["state"]
+                == "running"
+            )
+            snap = sup.healthz()["services"]["flappy"]
+            assert snap["restarts"] == 1
+            assert snap["failures"] and "first life ends" in \
+                snap["failures"][0]["detail"]
+            counter = registry.counter(
+                "runtime_service_restarts_total",
+                "runtime-supervised service restarts by service",
+            )
+            assert counter.value(service="flappy") == 1.0
+        finally:
+            sup.shutdown()
+
+    def test_crash_loop_classified_and_failed_with_budget_left(self):
+        box, spec = _box_service(
+            "looper", probe=lambda b: ("dead", "boom"),
+            max_restarts=10, min_uptime=60.0, crash_loop_threshold=2,
+            backoff_base=0.001, backoff_max=0.002,
+        )
+        sup = RuntimeSupervisor(
+            [spec], registry=Registry(), probe_interval=0.02,
+        )
+        sup.start()
+        try:
+            assert _wait_for(
+                lambda: sup.healthz()["services"]["looper"]["state"]
+                == "failed"
+            )
+            snap = sup.healthz()["services"]["looper"]
+            # Classified after 2 fast deaths, NOT after 11 attempts.
+            assert "crash loop" in snap["detail"]
+            assert snap["restarts"] < 10
+            assert sup.healthz()["status"] == "failed"
+        finally:
+            sup.shutdown()
+
+    def test_restart_budget_exhausted_fails(self):
+        box, spec = _box_service(
+            "mortal", probe=lambda b: ("dead", "gone"),
+            max_restarts=0, min_uptime=0.0,
+        )
+        sup = RuntimeSupervisor(
+            [spec], registry=Registry(), probe_interval=0.02,
+        )
+        sup.start()
+        try:
+            assert _wait_for(
+                lambda: sup.healthz()["services"]["mortal"]["state"]
+                == "failed"
+            )
+            assert "restart budget exhausted" in \
+                sup.healthz()["services"]["mortal"]["detail"]
+        finally:
+            sup.shutdown()
+
+    def test_runtime_services_gauge_tracks_states(self):
+        registry = Registry()
+        _, spec_a = _box_service("a")
+        _, spec_b = _box_service("b")
+        sup = RuntimeSupervisor([spec_a, spec_b], registry=registry)
+        gauge = registry.gauge(
+            "runtime_services",
+            "runtime-supervised services by lifecycle state",
+        )
+        # Before start: everything pending, and every state has a
+        # sample (zeros, not missing series).
+        assert gauge.value(state="pending") == 2.0
+        assert gauge.value(state="running") == 0.0
+        sup.start()
+        try:
+            assert gauge.value(state="running") == 2.0
+            assert gauge.value(state="pending") == 0.0
+        finally:
+            sup.shutdown()
+        assert gauge.value(state="stopped") == 2.0
+        assert gauge.value(state="running") == 0.0
+
+    def test_healthz_http_endpoint_rolls_up(self):
+        _, good = _box_service("good")
+        sup = RuntimeSupervisor(
+            [good], registry=Registry(), probe_interval=0.02,
+        )
+        sup.start()
+        try:
+            port = sup.serve_healthz()
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5
+            ) as resp:
+                assert resp.status == 200
+                doc = json.loads(resp.read().decode())
+            assert doc["status"] == "ok"
+            assert doc["services"]["good"]["state"] == "running"
+        finally:
+            sup.shutdown()
+
+    def test_healthz_http_503_once_a_service_failed(self):
+        _, bad = _box_service(
+            "bad", probe=lambda b: ("dead", "gone"), max_restarts=0,
+            min_uptime=0.0,
+        )
+        sup = RuntimeSupervisor(
+            [bad], registry=Registry(), probe_interval=0.02,
+        )
+        sup.start()
+        try:
+            port = sup.serve_healthz()
+            assert _wait_for(
+                lambda: sup.healthz()["status"] == "failed"
+            )
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=5
+                )
+            assert e.value.code == 503
+        finally:
+            sup.shutdown()
+
+
+class TestChaosSchedule:
+    def test_phase_needs_exactly_one_trigger(self):
+        with pytest.raises(ValueError, match="exactly one trigger"):
+            ChaosPhase(name="p", faults=("stream.read,nth=1",))
+        with pytest.raises(ValueError, match="exactly one trigger"):
+            ChaosPhase(
+                name="p", faults=("stream.read,nth=1",),
+                at_s=1.0, on_event="shift",
+            )
+
+    def test_phase_validation(self):
+        with pytest.raises(ValueError, match="no faults"):
+            ChaosPhase(name="p", faults=(), at_s=1.0)
+        with pytest.raises(ValueError, match="duration_s"):
+            ChaosPhase(
+                name="p", faults=("stream.read,nth=1",), at_s=1.0,
+                duration_s=0.0,
+            )
+        with pytest.raises(ValueError, match="duplicate"):
+            ChaosSchedule([
+                ChaosPhase(name="p", faults=("stream.read,nth=1",), at_s=1.0),
+                ChaosPhase(name="p", faults=("csv.read,nth=1",), at_s=2.0),
+            ], registry=Registry())
+
+    def test_typoed_entry_fails_at_construction(self):
+        with pytest.raises(ValueError, match="unknown fault site"):
+            ChaosSchedule([
+                {"name": "p", "at_s": 1.0, "faults": ["no.such.site,nth=1"]},
+            ], registry=Registry())
+
+    def test_event_arms_matching_phase_exactly_once(self):
+        sched = ChaosSchedule([
+            {"name": "drift", "on_event": "regime_shift",
+             "faults": ["stream.read,nth=1"]},
+            {"name": "later", "at_s": 9999.0,
+             "faults": ["csv.read,nth=1"]},
+        ], registry=Registry())
+        assert sched.fire_event("no_such_event") == []
+        assert sched.fire_event("regime_shift") == ["drift"]
+        assert [s.site for s in armed()] == ["stream.read"]
+        # Idempotent: one arming per phase, ever.
+        assert sched.fire_event("regime_shift") == []
+        summary = sched.stop()
+        assert armed() == []
+        assert [t["action"] for t in summary["trail"]] == \
+            ["armed", "disarmed"]
+
+    def test_at_s_phase_arms_then_duration_disarms(self):
+        registry = Registry()
+        sched = ChaosSchedule([
+            {"name": "storm", "at_s": 0.03, "duration_s": 0.1,
+             "faults": ["stream.read,p=0.5"]},
+        ], seed=3, registry=registry, tick=0.01)
+        sched.start()
+        try:
+            assert _wait_for(lambda: len(armed()) == 1)
+            assert _wait_for(lambda: len(armed()) == 0)
+        finally:
+            summary = sched.stop()
+        assert [t["action"] for t in summary["trail"]] == \
+            ["armed", "disarmed"]
+        assert summary["trail"][1]["why"] == "duration elapsed"
+        counter = registry.counter(
+            "runtime_chaos_phases_total",
+            "chaos-schedule phase transitions by phase and action",
+        )
+        assert counter.value(phase="storm", action="armed") == 1.0
+        assert counter.value(phase="storm", action="disarmed") == 1.0
+
+    def test_schedule_seed_derives_entry_seeds_pinned_wins(self):
+        def _specs(seed):
+            sched = ChaosSchedule([
+                {"name": "p", "on_event": "go",
+                 "faults": ["stream.read,p=0.5",
+                            "stream.read,p=0.5,seed=123"]},
+            ], seed=seed, registry=Registry())
+            sched.fire_event("go")
+            specs = list(armed())
+            sched.stop()
+            clear_faults()
+            return specs
+
+        a = _specs(9)
+        b = _specs(9)
+        c = _specs(10)
+        # Derived seed: deterministic per (schedule seed, phase, entry).
+        assert a[0].seed == b[0].seed != 0
+        assert a[0].seed != c[0].seed
+        # A pinned seed= in the entry text is never overridden.
+        assert a[1].seed == b[1].seed == c[1].seed == 123
+
+    def test_seeded_storm_replays_identically(self):
+        def _series():
+            sched = ChaosSchedule([
+                {"name": "p", "on_event": "go",
+                 "faults": ["stream.read,p=0.4"]},
+            ], seed=7, registry=Registry())
+            sched.fire_event("go")
+            out = []
+            for i in range(30):
+                try:
+                    fault_point("stream.read")
+                except FaultInjected:
+                    out.append(i)
+            sched.stop()
+            clear_faults()
+            return out
+
+        first = _series()
+        assert first, "p=0.4 over 30 hits fired nothing — seed bug"
+        assert _series() == first
+
+
+class TestMiniSoak:
+    """ISSUE 16 acceptance: the tier-1 day-in-the-life mini soak — 2
+    gang workers, 1 correlated storm phase, open-loop Poisson traffic,
+    a regime shift with drift-detect → warm retrain → hot swap — must
+    survive with dropped == 0 and a computed time-to-adapt, and its
+    report card must conform to the committed schema."""
+
+    def test_mini_soak_survives_seeded_storm(self, tmp_path):
+        result = run_soak(mini_soak_spec(str(tmp_path / "soak")))
+        assert result["ok"], {
+            k: result[k] for k in ("ok", "dropped", "card_error")
+        }
+        assert result["dropped"] == 0
+        assert result["card_error"] is None
+        # The adapt lifecycle was COMPUTED, not absent: drift detected,
+        # retrained, swapped, with a measured time-to-adapt.
+        assert result["time_to_adapt_s"] is not None
+        assert result["time_to_adapt_s"] > 0
+        card = result["card"]
+        from tpuflow.obs.slo import validate_report_card
+
+        validate_report_card(card)  # the committed schema contract
+        src = card["source"]
+        # The storm armed, fired, and was disarmed.
+        trail = src["chaos"]["trail"]
+        assert [t["action"] for t in trail] == ["armed", "disarmed"]
+        assert trail[1]["fired"] >= 1
+        # Every request answered; nothing dropped, nothing 500'd.
+        assert src["traffic"]["sent"] > 0
+        assert set(src["traffic"]["by_status"]) == {"200"}
+        # The online loop adapted under load.
+        assert src["online"]["retrains"] >= 1
+        assert src["online"]["swaps"] >= 1
+        # Dependency-aware shutdown: traffic stopped before serving,
+        # serving DRAINED before the gang was touched.
+        services = src["services"]
+        assert services["serving"]["killed_by"] == "drained"
+        assert services["traffic"]["stop_index"] \
+            < services["serving"]["stop_index"] \
+            < services["gang"]["stop_index"]
+        report_path = os.path.join(result["root"], "soak_report.json")
+        assert os.path.exists(report_path)
+        assert json.load(open(report_path))["ok"] is True
+
+
+@pytest.mark.slow
+class TestFullSoak:
+    def test_full_soak_within_wall_budget(self, tmp_path):
+        spec = mini_soak_spec(str(tmp_path / "soak"))
+        # More workers need more wells: each worker trains its shard,
+        # and a shard must still fill at least one batch.
+        spec["gang"].update({
+            "workers": 3, "epochs": 4,
+            "synthetic_wells": 3, "synthetic_steps": 128,
+        })
+        spec["traffic"].update({"max_requests": 200, "rate_rps": 50.0})
+        spec["online"].update({"shifted_windows": 8})
+        # A second storm phase keyed to the scenario, not the clock:
+        # flaky drift scoring exactly while drift is being detected.
+        spec["chaos"]["phases"].append({
+            "name": "drift-flake", "on_event": "regime_shift",
+            "duration_s": 6.0,
+            "faults": ["online.drift,p=0.2,mode=delay,delay=0.05"],
+        })
+        budget_s = 300.0
+        t0 = time.monotonic()
+        result = run_soak(spec)
+        wall = time.monotonic() - t0
+        assert result["ok"], {
+            k: result[k] for k in ("ok", "dropped", "card_error")
+        }
+        assert result["dropped"] == 0
+        assert wall < budget_s, (
+            f"full soak blew its wall-clock budget: {wall:.1f}s "
+            f">= {budget_s}s"
+        )
+        trail = result["card"]["source"]["chaos"]["trail"]
+        armed_phases = {
+            t["phase"] for t in trail if t["action"] == "armed"
+        }
+        # BOTH phases opened: the clocked storm and the one triggered
+        # by the regime shift actually happening.
+        assert armed_phases == {"storm", "drift-flake"}

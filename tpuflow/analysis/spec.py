@@ -291,13 +291,41 @@ def _check_faults(config) -> list[Diagnostic]:
 
     # The SAME parse loop the runtime arms with: a value that preflights
     # clean here is by construction a value fault_point will accept.
-    _, errors = parse_fault_entries(os.environ.get("TPUFLOW_FAULTS", ""))
+    env_specs, errors = parse_fault_entries(
+        os.environ.get("TPUFLOW_FAULTS", "")
+    )
     for entry, msg in errors:
         out.append(_diag(
             "spec.faults.env",
             f"TPUFLOW_FAULTS entry {entry!r}: {msg} "
             f"(expected {FAULTS_ENV_GRAMMAR})",
             where="TPUFLOW_FAULTS", choices=sorted(SITES),
+        ))
+    # A site armed by BOTH this job's faults list and the ambient
+    # TPUFLOW_FAULTS is legal but easy to misread — surface the
+    # documented precedence (resilience/faults.py: config specs are
+    # evaluated first at every hit, and when one fires the env spec's
+    # counters do not advance on that call) as a warning naming the
+    # colliding site, so a drill author learns which spec will win
+    # BEFORE the run instead of from a confusing firing log.
+    config_sites = set()
+    for entry in config.faults or ():
+        if isinstance(entry, str):
+            try:
+                config_sites.add(parse_fault_spec(entry).site)
+            except (ValueError, TypeError):
+                pass  # already reported above
+    env_sites = {spec.site for spec in env_specs}
+    for site in sorted(config_sites & env_sites):
+        out.append(_diag(
+            "spec.faults.precedence",
+            f"fault site {site!r} is armed by BOTH this job's faults "
+            "list and TPUFLOW_FAULTS — the job's spec is evaluated "
+            "first at every hit, and when it fires the env spec's "
+            "counters do not advance on that call (documented "
+            "precedence, tpuflow/resilience/faults.py)",
+            where="faults",
+            severity="warning",
         ))
     return out
 

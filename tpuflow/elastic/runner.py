@@ -50,6 +50,10 @@ class WorkerOutcome:
     attempts: int = 0
     failures: list = field(default_factory=list)
     error: str | None = None
+    # A cooperative stop (run_elastic(stop_event=...)) is an OUTCOME,
+    # not a failure: the reason string lands here and the worker stays
+    # error-free, so a drained gang still reports ok=True.
+    stopped: str | None = None
 
 
 @dataclass
@@ -86,6 +90,7 @@ class ElasticRunResult:
                     "worker_id": w.worker_id,
                     "attempts": w.attempts,
                     "error": w.error,
+                    "stopped": w.stopped,
                     "epochs_ran": (w.report or {}).get("epochs_ran"),
                     "best_val_loss": (w.report or {}).get("best_val_loss"),
                 }
@@ -197,6 +202,7 @@ def run_elastic(
     backoff_base: float = 0.05,
     backoff_jitter: float = 0.0,
     worker_faults: dict | None = None,
+    stop_event: threading.Event | None = None,
     verbose: bool = False,
 ) -> ElasticRunResult:
     """Run one elastic gang to completion; see the module docstring.
@@ -219,11 +225,25 @@ def run_elastic(
     rejected there. Worker failures never raise out of here — they
     land in the per-worker ``WorkerOutcome.error`` so a partial gang
     still reports what the survivors produced.
+
+    ``stop_event`` (inprocess mode only) is the runtime supervisor's
+    drain handle: setting it asks every worker to stop cooperatively at
+    its next epoch boundary via ``train(stop_fn=...)`` — the stop is an
+    outcome (``WorkerOutcome.stopped``), not an error, so a drained
+    gang still averages whatever its workers last pushed and reports
+    ``ok=True``. Supervised workers are separate processes; stopping
+    them is the process supervisor's SIGTERM escalation, not an Event.
     """
     if n_workers < 1:
         raise ValueError(f"n_workers must be >= 1, got {n_workers}")
     if mode not in MODES:
         raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+    if stop_event is not None and mode != "inprocess":
+        raise ValueError(
+            "stop_event needs mode='inprocess' (threaded workers polling "
+            "a shared Event); supervised workers are child processes — "
+            "stop those with the supervisor's SIGTERM escalation"
+        )
     if transport not in ("file", "socket"):
         raise ValueError(
             f"transport must be 'file' or 'socket', got {transport!r}"
@@ -358,10 +378,22 @@ def run_elastic(
             else:
                 from tpuflow.api import train
                 from tpuflow.serve import report_to_dict, spec_to_config
+                from tpuflow.train.loop import TrainingInterrupted
 
-                outcomes[i].report = report_to_dict(
-                    train(spec_to_config(wspec))
-                )
+                stop_fn = None
+                if stop_event is not None:
+                    def _stop_fn():
+                        if stop_event.is_set():
+                            return "runtime stop requested"
+                        return None
+
+                    stop_fn = _stop_fn
+                try:
+                    outcomes[i].report = report_to_dict(
+                        train(spec_to_config(wspec), stop_fn=stop_fn)
+                    )
+                except TrainingInterrupted as e:
+                    outcomes[i].stopped = str(e) or "stopped"
                 outcomes[i].attempts = 1
         except BaseException as e:
             outcomes[i].error = f"{type(e).__name__}: {e}"

@@ -48,6 +48,7 @@ import csv
 import dataclasses
 import os
 import shutil
+import threading
 import time
 from collections import deque
 
@@ -168,6 +169,12 @@ class OnlineTrainer:
         self.rollbacks = 0
         self.rejected = 0
         self.failures: list[dict] = []
+        # Cooperative shutdown handle for a thread-hosted loop (the
+        # runtime supervisor's online service): request_stop() ends the
+        # run at the next window boundary — mid-window work (a retrain,
+        # a swap) always completes, so a drain never strands a
+        # half-promoted candidate.
+        self._stop = threading.Event()
         self._last_retrain_window = None
         # Post-swap regression watch: windows remaining and the
         # incumbent's healthy-residual baseline snapshotted at swap time.
@@ -275,6 +282,8 @@ class OnlineTrainer:
         retrain_every = int(self.knobs["retrain_every"])
         min_gap = int(self.knobs["min_retrain_gap"])
         for idx, columns in enumerate(self._chunks()):
+            if self._stop.is_set():
+                break
             if max_windows is not None and idx >= max_windows:
                 break
             # ONE trace per window lifecycle: the drift anomalies this
@@ -320,6 +329,13 @@ class OnlineTrainer:
                         "drift" if drifted else "scheduled"
                     ))
         return self.summary()
+
+    def request_stop(self) -> None:
+        """Ask a running loop to stop at its next window boundary —
+        thread-safe, idempotent; ``run()`` then returns its summary
+        normally. The never-returning sidecar deployment's only clean
+        exit path."""
+        self._stop.set()
 
     def summary(self) -> dict:
         return {

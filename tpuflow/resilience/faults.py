@@ -43,10 +43,36 @@ per ``TrainJobConfig.faults`` element)::
 typo'd drill that silently never fires would fake a passing drill), and a
 tier-1 self-check asserts the catalog, the installed ``fault_point`` calls,
 and the docs/resilience.md table all agree.
+
+**Precedence.** When an in-process spec (``arm()`` /
+``TrainJobConfig.faults``) and a ``TPUFLOW_FAULTS`` spec are armed at
+the SAME site, the in-process spec is evaluated first at every
+``fault_point`` hit: its hit counter advances first, and when both
+would fire on the same call the in-process spec wins — the env drill's
+counters only advance once no in-process spec fired. The contract is
+deliberate: a job's own fault list is the more specific intent (it was
+written for THIS run), the environment is ambient (it leaks into every
+process in the tree). Preflight warns (``spec.faults.precedence``)
+when a job config and the env collide on a site, naming it.
+
+**Restart-deterministic storms.** ``TPUFLOW_FAULTS_CURSOR`` names a
+JSON file persisting each env spec's firing state (hits, fired) —
+written on every env-spec hit, restored when a fresh process re-arms
+the same ``TPUFLOW_FAULTS`` value. A one-shot (``nth=``/``at=``) that
+already fired stays consumed across the restart, and a ``p=,seed=``
+stream fast-forwards past its consumed draws — so a supervised child
+relaunched mid-storm resumes the SAME storm instead of replaying it
+from hit zero, and a seeded soak replays identically even when its
+workers die and restart at different moments. Opt-in by design (the
+crash-loop drills DEPEND on an env fault re-firing in every attempt):
+unset means no persistence, and the literal value ``auto`` is a
+sentinel only ``train/supervisor.py`` resolves (to a path next to its
+progress file) — unresolved ``auto`` means no persistence too.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import random
 import threading
@@ -143,6 +169,10 @@ class FaultSpec:
     hits: int = 0
     fired: int = 0
     _rng: random.Random | None = field(default=None, repr=False)
+    # cursor-file key for env-armed specs (TPUFLOW_FAULTS_CURSOR);
+    # compare=False keeps it out of the dataclass __eq__ so disarm()'s
+    # equality match is unchanged.
+    _cursor_key: str | None = field(default=None, repr=False, compare=False)
 
     def __post_init__(self):
         if self.site not in SITES:
@@ -244,6 +274,60 @@ _ARMED: dict[str, list[FaultSpec]] = {}
 _FIRED_LOG: list[dict] = []  # {site, spec, index} per firing — for tests
 _ENV_CACHE: str | None = None  # last TPUFLOW_FAULTS value parsed
 _ENV_SPECS: list[FaultSpec] = []
+# Persisted firing state for env specs (TPUFLOW_FAULTS_CURSOR), keyed by
+# each spec's position+description in the env value. Tracks EVERY env
+# spec — including consumed one-shots no longer in _ARMED — so a restart
+# restores the whole storm, not just the still-armed tail.
+_CURSOR_ENV = "TPUFLOW_FAULTS_CURSOR"
+_ENV_CURSOR: dict[str, dict] = {}
+
+
+def _cursor_path() -> str | None:
+    """The cursor file path, or None when persistence is off. The
+    literal ``auto`` is the supervisor's resolve-me sentinel — reaching
+    a fault_point unresolved means nobody owns a run directory to put
+    the file in, so it degrades to no persistence (not an error: the
+    same spec text must work under and outside the supervisor)."""
+    value = os.environ.get(_CURSOR_ENV, "").strip()
+    if not value or value == "auto":
+        return None
+    return value
+
+
+def _read_cursor(path: str) -> dict:
+    """Load the cursor file; missing is a clean first run ({}), corrupt
+    is fail-loud — resuming a storm from guessed state would fake the
+    determinism this file exists to provide."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except FileNotFoundError:
+        return {}
+    except (OSError, ValueError) as e:
+        raise ValueError(
+            f"unreadable {_CURSOR_ENV} file {path!r}: {e} — delete it or "
+            f"point {_CURSOR_ENV} at a fresh path; refusing to guess at "
+            "storm state"
+        ) from e
+    if not isinstance(doc, dict):
+        raise ValueError(
+            f"{_CURSOR_ENV} file {path!r} is not a JSON object — delete "
+            f"it or point {_CURSOR_ENV} at a fresh path"
+        )
+    return doc
+
+
+def _write_cursor(path: str, doc: dict) -> None:
+    try:
+        from tpuflow.utils.paths import atomic_write_json
+
+        atomic_write_json(path, doc)
+    except OSError as e:
+        raise ValueError(
+            f"cannot write {_CURSOR_ENV} file {path!r}: {e} — the cursor "
+            "was requested, so losing firing state is an error, not a "
+            "degraded mode"
+        ) from e
 
 
 def arm(spec: FaultSpec) -> FaultSpec:
@@ -269,6 +353,7 @@ def clear_faults() -> None:
         _ARMED.clear()
         _FIRED_LOG.clear()
         _ENV_SPECS.clear()
+        _ENV_CURSOR.clear()
         _ENV_CACHE = None
 
 
@@ -282,19 +367,21 @@ def fired_log() -> list[dict]:
         return list(_FIRED_LOG)
 
 
-def _sync_env_locked() -> None:
+def _sync_env() -> None:
     """(Re)arm the TPUFLOW_FAULTS specs whenever the env value changes —
     so a test's monkeypatch.setenv takes effect without any install call,
-    and child processes inherit drills through the environment alone."""
+    and child processes inherit drills through the environment alone.
+
+    Double-checked: the fast path is one env-string compare under the
+    lock; the slow path (parse + cursor-file read — file I/O must not
+    run under the registry lock) happens outside it, then re-checks
+    before swapping state in.
+    """
     global _ENV_CACHE
     value = os.environ.get("TPUFLOW_FAULTS", "")
-    if value == _ENV_CACHE:
-        return
-    for spec in _ENV_SPECS:
-        specs = _ARMED.get(spec.site, [])
-        if spec in specs:
-            specs.remove(spec)
-    _ENV_SPECS.clear()
+    with _LOCK:
+        if value == _ENV_CACHE:
+            return
     # Parse EVERY entry before arming ANY, and update the cache only
     # after a clean parse: a typo'd second entry must not leave the
     # first one armed with the rest silently dropped — and because the
@@ -310,10 +397,44 @@ def _sync_env_locked() -> None:
             f"malformed TPUFLOW_FAULTS entry — {detail} — expected "
             f"{FAULTS_ENV_GRAMMAR}; nothing was armed"
         )
-    _ENV_CACHE = value
-    for spec in new_specs:
-        _ARMED.setdefault(spec.site, []).append(spec)
-        _ENV_SPECS.append(spec)
+    # Restore persisted firing state — but only when the cursor file was
+    # written for THIS env value: a stale cursor from a different storm
+    # must not pre-consume the new one.
+    cursor_state: dict = {}
+    path = _cursor_path()
+    if path is not None:
+        doc = _read_cursor(path)
+        if doc.get("env") == value:
+            state = doc.get("state")
+            if isinstance(state, dict):
+                cursor_state = state
+    with _LOCK:
+        if value == _ENV_CACHE:
+            return  # another thread synced the same value while we parsed
+        for old in _ENV_SPECS:
+            lst = _ARMED.get(old.site, [])
+            lst[:] = [s for s in lst if s is not old]
+        _ENV_SPECS.clear()
+        _ENV_CURSOR.clear()
+        _ENV_CACHE = value
+        for i, spec in enumerate(new_specs):
+            key = f"{i}:{spec.describe()}"
+            spec._cursor_key = key
+            restored = cursor_state.get(key)
+            if isinstance(restored, dict):
+                spec.hits = int(restored.get("hits", 0))
+                spec.fired = int(restored.get("fired", 0))
+                if spec._rng is not None:
+                    # Fast-forward the probability stream past the draws
+                    # the previous process consumed — the resumed storm
+                    # continues the SAME seeded sequence.
+                    for _ in range(spec.hits):
+                        spec._rng.random()
+            _ENV_CURSOR[key] = {"hits": spec.hits, "fired": spec.fired}
+            _ENV_SPECS.append(spec)
+            if (spec.nth is not None or spec.at is not None) and spec.fired:
+                continue  # a consumed one-shot stays consumed across restarts
+            _ARMED.setdefault(spec.site, []).append(spec)
 
 
 def fault_point(site: str, index: int | None = None) -> None:
@@ -329,13 +450,24 @@ def fault_point(site: str, index: int | None = None) -> None:
             f"fault_point({site!r}) is not in the SITES catalog — add it "
             "to tpuflow/resilience/faults.py and docs/resilience.md"
         )
+    _sync_env()
     to_fire: FaultSpec | None = None
+    cursor_doc: dict | None = None
+    cursor_file: str | None = None
     with _LOCK:
-        _sync_env_locked()
         specs = _ARMED.get(site)
         if not specs:
             return
-        for spec in specs:
+        # Precedence: in-process specs (arm() / TrainJobConfig.faults)
+        # before TPUFLOW_FAULTS specs — the sort key is env-membership,
+        # and the sort is stable, so arming order is preserved within
+        # each class. When an in-process spec fires, the break below
+        # means the env specs' hit counters do not advance on this call
+        # (see the module docstring's precedence contract).
+        ordered = sorted(
+            specs, key=lambda s: any(s is e for e in _ENV_SPECS)
+        )
+        for spec in ordered:
             spec.hits += 1
             fire = False
             if spec.nth is not None:
@@ -350,9 +482,33 @@ def fault_point(site: str, index: int | None = None) -> None:
                     {"site": site, "spec": spec.describe(), "index": index}
                 )
                 if spec.nth is not None or spec.at is not None:
-                    specs.remove(spec)  # one-shot: never double-fires
+                    # one-shot: never double-fires (identity filter — two
+                    # field-equal specs must not shadow each other)
+                    specs[:] = [s for s in specs if s is not spec]
                 to_fire = spec
                 break
+        # Snapshot the cursor under the lock, write it after release
+        # (file I/O never runs under the registry lock).
+        cursor_file = _cursor_path()
+        if cursor_file is not None and _ENV_SPECS:
+            changed = False
+            for spec in _ENV_SPECS:
+                if spec._cursor_key is None:
+                    continue
+                state = {"hits": spec.hits, "fired": spec.fired}
+                if _ENV_CURSOR.get(spec._cursor_key) != state:
+                    _ENV_CURSOR[spec._cursor_key] = state
+                    changed = True
+            if changed:
+                cursor_doc = {
+                    "version": 1,
+                    "env": _ENV_CACHE,
+                    "state": {k: dict(v) for k, v in _ENV_CURSOR.items()},
+                }
+    if cursor_doc is not None and cursor_file is not None:
+        # Persist BEFORE the firing tail: a mode=exit spec records its
+        # own firing, so the restarted process sees it consumed.
+        _write_cursor(cursor_file, cursor_doc)
     if to_fire is None:
         return
     # Every firing is observable: a labeled counter in the process-wide

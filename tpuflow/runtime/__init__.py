@@ -1,0 +1,66 @@
+"""Shared-runtime supervisor: heterogeneous long-lived services under
+one lifecycle, plus the seeded chaos soak that exercises them together.
+
+- ``service.py`` — the declarative :class:`ServiceSpec` model and the
+  managed lifecycle states.
+- ``supervisor.py`` — :class:`RuntimeSupervisor`: dependency-ordered
+  startup, liveness probing, per-service restart policy, graceful
+  reverse-order shutdown, aggregated ``/healthz``.
+- ``services.py`` — adapters wiring tpuflow's own components (async
+  daemon, elastic gang, online controller, child processes) into specs.
+- ``chaos.py`` — :class:`ChaosSchedule`: seedable correlated fault
+  storms armed at declared soak phases.
+- ``soak.py`` — :func:`run_soak`: the day-in-the-life scenario emitting
+  one SLO report card (``obs/slo_report_card.schema.json``).
+
+CLI: ``python -m tpuflow.runtime soak spec.json`` /
+``python -m tpuflow.runtime run spec.json``.
+"""
+
+from tpuflow.runtime.chaos import ChaosPhase, ChaosSchedule
+from tpuflow.runtime.service import (
+    DEGRADED,
+    FAILED,
+    FINISHED,
+    PENDING,
+    RUNNING,
+    STARTING,
+    STATES,
+    STOPPED,
+    STOPPING,
+    ManagedService,
+    ServiceSpec,
+)
+from tpuflow.runtime.services import (
+    daemon_service,
+    gang_service,
+    online_service,
+    process_service,
+    thread_service,
+)
+from tpuflow.runtime.soak import mini_soak_spec, run_soak
+from tpuflow.runtime.supervisor import RuntimeSupervisor
+
+__all__ = [
+    "ChaosPhase",
+    "ChaosSchedule",
+    "ManagedService",
+    "RuntimeSupervisor",
+    "ServiceSpec",
+    "STATES",
+    "PENDING",
+    "STARTING",
+    "RUNNING",
+    "DEGRADED",
+    "FAILED",
+    "STOPPING",
+    "STOPPED",
+    "FINISHED",
+    "daemon_service",
+    "gang_service",
+    "online_service",
+    "process_service",
+    "thread_service",
+    "mini_soak_spec",
+    "run_soak",
+]

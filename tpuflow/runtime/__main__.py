@@ -1,0 +1,167 @@
+"""CLI for the shared runtime.
+
+``python -m tpuflow.runtime soak spec.json [-o out.json]``
+    Run the day-in-the-life chaos soak (``soak.run_soak``); prints the
+    verdict summary and exits 0 iff ``ok`` (card valid, dropped == 0,
+    workload finished, serving drained).
+
+``python -m tpuflow.runtime run spec.json``
+    Stand up a declarative service fleet under a
+    :class:`RuntimeSupervisor` and hold it until SIGTERM/SIGINT (or
+    until every service is terminal), then shut down gracefully in
+    reverse dependency order. Writes ``{root}/runtime-ready.json``
+    (ports) once up and ``{root}/runtime-final.json`` (per-service
+    ``state``/``killed_by``/``stop_index``) after shutdown — the
+    graceful-shutdown drill's forensics.
+
+Run-spec service types::
+
+    {"root": "...", "healthz": true, "services": [
+        {"type": "process", "name": "gang",
+         "argv": ["python", "-c", "..."], "grace": 5.0},
+        {"type": "daemon", "name": "serving", "depends_on": ["gang"],
+         "grace": 10.0},
+    ]}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+
+def _build_service(doc: dict, root: str, servers: dict):
+    from tpuflow.runtime.services import daemon_service, process_service
+
+    kind = doc.get("type")
+    name = doc.get("name")
+    if not name:
+        raise SystemExit(f"run spec: service entry needs a name: {doc}")
+    depends_on = tuple(doc.get("depends_on") or ())
+    grace = float(doc.get("grace", 10.0))
+    if kind == "daemon":
+
+        def _factory():
+            from tpuflow.serve_async import AsyncServer
+
+            server = AsyncServer(
+                doc.get("host", "127.0.0.1"), int(doc.get("port", 0)),
+                enable_jobs=bool(doc.get("enable_jobs", False)),
+                trail_path=os.path.join(root, f"{name}-metrics.jsonl"),
+            )
+            servers[name] = server
+            return server
+
+        return daemon_service(
+            name, _factory, depends_on=depends_on, grace=grace,
+        )
+    if kind == "process":
+        argv = doc.get("argv")
+        if not argv:
+            raise SystemExit(f"run spec: process service {name!r} needs argv")
+        env = None
+        if doc.get("env"):
+            env = dict(os.environ)
+            env.update({str(k): str(v) for k, v in doc["env"].items()})
+        return process_service(
+            name, list(argv), depends_on=depends_on, grace=grace, env=env,
+        )
+    raise SystemExit(
+        f"run spec: unknown service type {kind!r} for {name!r} "
+        "(expected 'daemon' or 'process')"
+    )
+
+
+def _cmd_run(spec_path: str) -> int:
+    from tpuflow.runtime.supervisor import RuntimeSupervisor
+    from tpuflow.utils.paths import atomic_write_json
+
+    with open(spec_path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    root = doc.get("root")
+    if not root:
+        raise SystemExit("run spec needs 'root'")
+    os.makedirs(root, exist_ok=True)
+    servers: dict = {}
+    specs = [
+        _build_service(sdoc, root, servers)
+        for sdoc in doc.get("services") or []
+    ]
+    supervisor = RuntimeSupervisor(
+        specs, trail_path=os.path.join(root, "runtime-metrics.jsonl"),
+    )
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    supervisor.start()
+    healthz_port = (
+        supervisor.serve_healthz() if doc.get("healthz", True) else None
+    )
+    atomic_write_json(os.path.join(root, "runtime-ready.json"), {
+        "pid": os.getpid(),
+        "healthz_port": healthz_port,
+        "ports": {name: server.port for name, server in servers.items()},
+    })
+    terminal = ("finished", "failed", "stopped")
+    while not stop.is_set():
+        if stop.wait(0.2):
+            break
+        states = supervisor.healthz()["services"]
+        if all(s["state"] in terminal for s in states.values()):
+            break
+    final = supervisor.shutdown()
+    atomic_write_json(os.path.join(root, "runtime-final.json"), final)
+    failed = [
+        n for n, s in final["services"].items() if s["state"] == "failed"
+    ]
+    return 1 if failed else 0
+
+
+def _cmd_soak(spec_path: str, out: str | None) -> int:
+    from tpuflow.runtime.soak import run_soak
+
+    with open(spec_path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    result = run_soak(doc)
+    if out:
+        from tpuflow.utils.paths import atomic_write_json
+
+        atomic_write_json(out, result)
+    print(json.dumps({
+        "ok": result["ok"],
+        "dropped": result["dropped"],
+        "time_to_adapt_s": result["time_to_adapt_s"],
+        "card_error": result["card_error"],
+        "root": result["root"],
+    }, indent=2))
+    return 0 if result["ok"] else 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpuflow.runtime",
+        description="shared-runtime supervisor CLI (module docstring)",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+    p_soak = sub.add_parser(
+        "soak", help="run the day-in-the-life chaos soak from a spec",
+    )
+    p_soak.add_argument("spec", help="soak spec JSON (soak.mini_soak_spec shape)")
+    p_soak.add_argument("-o", "--out", default=None,
+                        help="also write the full result JSON here")
+    p_run = sub.add_parser(
+        "run", help="supervise a declarative service fleet until SIGTERM",
+    )
+    p_run.add_argument("spec", help="run spec JSON (module docstring)")
+    args = parser.parse_args(argv)
+    if args.command == "soak":
+        return _cmd_soak(args.spec, args.out)
+    return _cmd_run(args.spec)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,258 @@
+"""Seedable chaos schedule: correlated cross-subsystem fault storms.
+
+A single armed :class:`~tpuflow.resilience.faults.FaultSpec` exercises
+one site; distributed stacks break where failures CORRELATE — a
+checkpoint flake while a swap is mid-flight, a worker death during an
+averaging round, a latency storm during a retrain. A
+:class:`ChaosSchedule` arms a *phase* — a named SET of fault specs —
+together, at a declared moment of the soak: either ``at_s`` seconds
+after ``start()`` (disarmed again after ``duration_s``), or when the
+scenario driver calls :func:`ChaosSchedule.fire_event` with the
+phase's ``on_event`` name (the "regime shift just happened" hook).
+
+Determinism: the schedule ``seed`` derives a per-entry seed for every
+probabilistic (``p=``) fault that does not pin its own ``seed=`` —
+``f(schedule_seed, phase_index, entry_index)`` — so one seed replays
+the ENTIRE storm's coin flips identically; the regression drill diffs
+two replays' ``faults_injected_total`` series. (Cross-process
+determinism — a storm surviving a supervised child's restart — is the
+``TPUFLOW_FAULTS_CURSOR`` mechanism in ``resilience/faults.py``; this
+schedule arms in-process specs, which also take precedence over env
+specs at a shared site.)
+
+Phase grammar (``from_dict``; the soak spec's ``chaos`` block)::
+
+    {"seed": 7, "phases": [
+        {"name": "storm", "at_s": 1.5, "duration_s": 6.0,
+         "faults": ["elastic.push,nth=2",
+                    "checkpoint.save,p=0.4,transient=1",
+                    "serve.execute,p=0.3,mode=delay,delay=0.02"]},
+        {"name": "drift-flake", "on_event": "regime_shift",
+         "duration_s": 4.0,
+         "faults": ["online.swap,nth=1"]},
+    ]}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+from tpuflow.resilience import faults as _faults
+
+
+@dataclasses.dataclass
+class ChaosPhase:
+    """One named storm: the fault entries armed together, and when."""
+
+    name: str
+    faults: tuple
+    at_s: float | None = None  # arm this long after start()
+    on_event: str | None = None  # ... or when fire_event(name) matches
+    duration_s: float | None = None  # disarm after; None = until stop()
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("chaos phase needs a name")
+        if not self.faults:
+            raise ValueError(f"chaos phase {self.name!r} has no faults")
+        if (self.at_s is None) == (self.on_event is None):
+            raise ValueError(
+                f"chaos phase {self.name!r} needs exactly one trigger: "
+                "at_s= (a clock moment) or on_event= (a scenario hook)"
+            )
+        if self.at_s is not None and self.at_s < 0:
+            raise ValueError(
+                f"chaos phase {self.name!r}: at_s must be >= 0"
+            )
+        if self.duration_s is not None and self.duration_s <= 0:
+            raise ValueError(
+                f"chaos phase {self.name!r}: duration_s must be > 0"
+            )
+        self.faults = tuple(self.faults)
+
+
+def _derive_seed(schedule_seed: int, phase_idx: int, entry_idx: int) -> int:
+    """Deterministic per-entry seed — one schedule seed pins every
+    probabilistic entry's private stream."""
+    return (
+        schedule_seed * 1_000_003 + phase_idx * 10_007 + entry_idx * 101 + 1
+    ) & 0x7FFFFFFF
+
+
+class ChaosSchedule:
+    """Arm/disarm phases of correlated faults on the shared registry
+    (module docstring). ``start()`` launches the timer thread for
+    ``at_s`` phases; ``fire_event()`` triggers ``on_event`` phases;
+    ``stop()`` disarms everything still armed.
+
+    Lock discipline: ``_lock`` guards the mutable collections
+    (``_armed``, ``_armed_ever``, ``_expires``, ``_trail``) only; the
+    fault-registry arm/disarm calls and observability writes run
+    outside it (they take their own locks)."""
+
+    def __init__(self, phases, *, seed: int = 0, registry=None,
+                 clock=time.monotonic, tick: float = 0.02):
+        from tpuflow.obs import default_registry
+
+        self.phases = [
+            p if isinstance(p, ChaosPhase) else ChaosPhase(**p)
+            for p in phases
+        ]
+        names = [p.name for p in self.phases]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate chaos phase names in {names}")
+        self.seed = int(seed)
+        self._clock = clock
+        self._tick = float(tick)
+        registry = registry or default_registry()
+        self._phases_total = registry.counter(
+            "runtime_chaos_phases_total",
+            "chaos-schedule phase transitions by phase and action",
+        )
+        # Parse + seed-derive up front: a typo'd entry fails at
+        # schedule construction, not mid-soak. These are validated
+        # PROTOTYPES — arming copies them, so hit counters and
+        # probability streams start at zero at arm time (the storm's
+        # randomness depends only on the seed and the sites' hit
+        # sequence, not on how long the fleet ran before the phase).
+        self._proto: dict[str, list] = {}
+        for pi, phase in enumerate(self.phases):
+            protos = []
+            for ei, entry in enumerate(phase.faults):
+                spec = _faults.parse_fault_spec(entry)
+                if spec.p and "seed=" not in entry.replace(" ", ""):
+                    spec = dataclasses.replace(
+                        spec, seed=_derive_seed(self.seed, pi, ei)
+                    )
+                protos.append(spec)
+            self._proto[phase.name] = protos
+        self._lock = threading.Lock()
+        self._armed: dict[str, list] = {}  # phase name -> live FaultSpecs
+        self._armed_ever: set = set()  # one arming per phase, ever
+        self._expires: dict[str, float] = {}  # phase name -> clock moment
+        self._trail: list[dict] = []  # arm/disarm records, in order
+        self._t0: float | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # --- triggers ------------------------------------------------------
+
+    def start(self) -> "ChaosSchedule":
+        self._t0 = self._clock()
+        self._thread = threading.Thread(
+            target=self._timer_loop, name="tpuflow-runtime-chaos",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def fire_event(self, event: str) -> list:
+        """Arm every not-yet-armed phase declared ``on_event=event``;
+        returns the phase names armed."""
+        armed = []
+        for phase in self.phases:
+            if phase.on_event == event and self._arm_phase(phase):
+                armed.append(phase.name)
+        return armed
+
+    def stop(self) -> dict:
+        """Stop the timer and disarm every armed phase; returns the
+        arm/disarm trail (the storm's own forensics)."""
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5)
+            self._thread = None
+        with self._lock:
+            names = list(self._armed)
+        for name in names:
+            self._disarm_phase(name, why="schedule stopped")
+        return self.summary()
+
+    def summary(self) -> dict:
+        phase_names = [p.name for p in self.phases]
+        with self._lock:
+            trail = list(self._trail)
+        return {"seed": self.seed, "phases": phase_names, "trail": trail}
+
+    # --- internals -----------------------------------------------------
+
+    def _timer_loop(self) -> None:
+        while not self._stop.wait(self._tick):
+            now = self._clock()
+            elapsed = now - self._t0
+            for phase in self.phases:
+                if phase.at_s is not None and elapsed >= phase.at_s:
+                    self._arm_phase(phase)
+            with self._lock:
+                expired = [
+                    name for name, at in self._expires.items()
+                    if now >= at
+                ]
+            for name in expired:
+                self._disarm_phase(name, why="duration elapsed")
+
+    def _arm_phase(self, phase: ChaosPhase) -> bool:
+        """Arm a phase exactly once (idempotent across timer ticks and
+        racing event fires)."""
+        with self._lock:
+            if phase.name in self._armed_ever:
+                return False
+            self._armed_ever.add(phase.name)
+        specs = [
+            dataclasses.replace(proto) for proto in self._proto[phase.name]
+        ]
+        expiry = None
+        if phase.duration_s is not None:
+            expiry = self._clock() + phase.duration_s
+        with self._lock:
+            self._armed[phase.name] = specs
+            if expiry is not None:
+                self._expires[phase.name] = expiry
+            self._trail.append({
+                "phase": phase.name, "action": "armed",
+                "faults": [s.describe() for s in specs],
+            })
+        for spec in specs:
+            _faults.arm(spec)
+        self._phases_total.inc(phase=phase.name, action="armed")
+        from tpuflow.obs import record_event
+
+        record_event(
+            "chaos_phase", phase=phase.name, action="armed",
+            faults=list(phase.faults),
+        )
+        return True
+
+    def _disarm_phase(self, name: str, *, why: str) -> None:
+        with self._lock:
+            specs = self._armed.pop(name, None)
+            self._expires.pop(name, None)
+        if specs is None:
+            return
+        for spec in specs:
+            _faults.disarm(spec)  # one-shots that fired already self-removed
+        fired = sum(s.fired for s in specs)
+        with self._lock:
+            self._trail.append({
+                "phase": name, "action": "disarmed", "why": why,
+                "fired": fired,
+            })
+        self._phases_total.inc(phase=name, action="disarmed")
+        from tpuflow.obs import record_event
+
+        record_event(
+            "chaos_phase", phase=name, action="disarmed", why=why,
+            fired=fired,
+        )
+
+    @classmethod
+    def from_dict(cls, doc: dict, *, registry=None) -> "ChaosSchedule":
+        if not isinstance(doc, dict):
+            raise ValueError(
+                f"chaos block must be an object, got {type(doc).__name__}"
+            )
+        phases = doc.get("phases") or []
+        return cls(phases, seed=int(doc.get("seed", 0)), registry=registry)

@@ -1,0 +1,139 @@
+"""The service model for the shared-runtime supervisor.
+
+A **service** is one long-lived component of the day-in-the-life fleet
+— the async serving daemon, the elastic gang, the online controller, an
+arbitrary child process — declared as a :class:`ServiceSpec`: how to
+start it, how to probe it, how to stop it, what it depends on, and what
+its restart budget is. The spec is pure declaration (three callables
+and some numbers); the supervisor (``runtime/supervisor.py``) owns the
+lifecycle, and the adapters (``runtime/services.py``) build specs for
+tpuflow's own components so a soak is a list of specs, not a script.
+
+The callables' contracts:
+
+- ``start() -> handle`` — launch the component, return whatever
+  ``liveness``/``stop`` need (a server object, a thread box, a Popen).
+  A raise here is a failed start: the supervisor applies the restart
+  policy exactly as for a death — starting and staying up are the same
+  promise.
+- ``liveness(handle) -> (state, detail)`` — one cheap probe. ``state``
+  is one of ``ok`` (healthy), ``degraded`` (up but impaired — reported,
+  never restarted: a degraded service is still doing work a restart
+  would destroy), ``dead`` (gone; the restart policy decides what
+  happens next), ``finished`` (exited on purpose — a gang that trained
+  to completion is done, not dead).
+- ``stop(handle, grace) -> killed_by | None`` — graceful stop with a
+  bounded grace window, escalating however the component requires
+  (drain then close; Event then join; SIGTERM then SIGKILL). The
+  return value records HOW it died ("sigterm", "sigkill", "drained",
+  "abandoned", ...) for the shutdown forensics.
+
+States a managed service moves through::
+
+    PENDING -> STARTING -> RUNNING <-> DEGRADED
+                              |            |
+                              v            v
+               FINISHED    (death) -> restart or FAILED
+                              |
+            STOPPING -> STOPPED          (shutdown path)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+PENDING = "pending"
+STARTING = "starting"
+RUNNING = "running"
+DEGRADED = "degraded"
+FAILED = "failed"
+STOPPING = "stopping"
+STOPPED = "stopped"
+FINISHED = "finished"
+
+# Every state a managed service can occupy — the runtime_services gauge
+# emits one labeled sample per state so a scrape sees zeros, not
+# missing series, for the states nothing is in.
+STATES = (
+    PENDING, STARTING, RUNNING, DEGRADED, FAILED,
+    STOPPING, STOPPED, FINISHED,
+)
+
+# What liveness() may return.
+PROBE_STATES = ("ok", "degraded", "dead", "finished")
+
+
+@dataclass
+class ServiceSpec:
+    """One declaratively-specced service (see the module docstring)."""
+
+    name: str
+    start: Callable[[], object]
+    stop: Callable[[object, float], str | None]
+    liveness: Callable[[object], tuple]
+    depends_on: tuple = ()
+    grace: float = 5.0  # seconds stop() gets before escalation
+    max_restarts: int = 0
+    # A service that dies faster than min_uptime after a (re)start is a
+    # fast death; crash_loop_threshold consecutive fast deaths classify
+    # a crash loop and fail the service even with restart budget left —
+    # the train/supervisor.py precedent: restarting into the same
+    # immediate death burns budget without buying recovery.
+    min_uptime: float = 1.0
+    crash_loop_threshold: int = 2
+    backoff_base: float = 0.05
+    backoff_max: float = 2.0
+    backoff_jitter: float = 0.0
+    backoff_seed: int | None = None
+
+    def __post_init__(self):
+        if not self.name or not isinstance(self.name, str):
+            raise ValueError(f"service name must be a non-empty string, "
+                             f"got {self.name!r}")
+        if self.grace < 0:
+            raise ValueError(
+                f"service {self.name!r}: grace must be >= 0 seconds, "
+                f"got {self.grace}"
+            )
+        if self.max_restarts < 0:
+            raise ValueError(
+                f"service {self.name!r}: max_restarts must be >= 0, "
+                f"got {self.max_restarts}"
+            )
+        if self.crash_loop_threshold < 1:
+            raise ValueError(
+                f"service {self.name!r}: crash_loop_threshold must be "
+                f">= 1, got {self.crash_loop_threshold}"
+            )
+        self.depends_on = tuple(self.depends_on)
+
+
+@dataclass
+class ManagedService:
+    """The supervisor's mutable record for one service. All fields
+    after ``spec`` are guarded by the supervisor's lock."""
+
+    spec: ServiceSpec
+    state: str = PENDING
+    handle: object = None
+    detail: str = ""
+    restarts: int = 0
+    failures: list = field(default_factory=list)
+    killed_by: str | None = None
+    started_at: float | None = None  # monotonic, last (re)start
+    fast_deaths: int = 0  # consecutive deaths under min_uptime
+    stop_index: int | None = None  # position in the shutdown order
+
+    def snapshot_locked(self) -> dict:
+        """A JSON-safe copy of the record (caller holds the lock)."""
+        return {
+            "name": self.spec.name,
+            "state": self.state,
+            "detail": self.detail,
+            "depends_on": list(self.spec.depends_on),
+            "restarts": self.restarts,
+            "failures": list(self.failures),
+            "killed_by": self.killed_by,
+            "stop_index": self.stop_index,
+        }

@@ -1,0 +1,275 @@
+"""ServiceSpec adapters for tpuflow's own long-lived components.
+
+Each factory returns a :class:`~tpuflow.runtime.service.ServiceSpec`
+wiring an existing component into the supervisor's three callables —
+riding the liveness machinery the component already has instead of
+inventing a parallel one:
+
+- :func:`daemon_service` — the async serving daemon; liveness is its
+  own ``/healthz`` (degraded artifacts report as ``degraded``), stop
+  is drain-then-shutdown (the zero-500s contract).
+- :func:`gang_service` — ``run_elastic`` in a thread; liveness is
+  thread-aliveness plus the outcome box (a finished-ok gang is
+  FINISHED, a raise is a death), stop sets the gang's cooperative
+  ``stop_event`` and joins.
+- :func:`online_service` — an ``OnlineTrainer.run`` thread; stop is
+  ``request_stop()`` + join (the loop ends at a window boundary, so a
+  mid-retrain drain completes the swap instead of stranding it).
+- :func:`process_service` — an arbitrary child process; liveness is
+  ``poll()``, stop reuses ``train/supervisor.py``'s
+  ``terminate_gracefully`` SIGTERM→grace→SIGKILL escalation.
+- :func:`thread_service` — the generic building block the gang and
+  online adapters are built on.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from tpuflow.runtime.service import ServiceSpec
+
+
+class ThreadHandle:
+    """A supervised worker thread plus its outcome box. ``result`` /
+    ``error`` are written exactly once, by the worker thread, before it
+    exits; readers only look after ``thread.is_alive()`` goes False —
+    the happens-before edge is the thread's own termination."""
+
+    def __init__(self, thread: threading.Thread, stop_event: threading.Event):
+        self.thread = thread
+        self.stop_event = stop_event
+        self.result = None
+        self.error: str | None = None
+
+
+def thread_service(
+    name: str,
+    run,
+    *,
+    check=None,
+    depends_on: tuple = (),
+    grace: float = 5.0,
+    **spec_kwargs,
+) -> ServiceSpec:
+    """A service backed by one worker thread running ``run(stop_event)``.
+
+    ``run`` returns the service's result (stored on the handle) or
+    raises (a death). ``check(result) -> (state, detail)`` optionally
+    judges a COMPLETED run — e.g. a gang whose outcome says a worker
+    crash-looped should read as dead, not finished; default is
+    ``finished``. Stop sets ``stop_event`` and joins for ``grace``
+    seconds; a thread that ignores its stop event cannot be killed
+    (Python threads aren't), so it is recorded as ``abandoned`` —
+    daemon=True means it cannot block process exit either.
+    """
+
+    def _start() -> ThreadHandle:
+        stop_event = threading.Event()
+        handle: ThreadHandle | None = None
+
+        def _worker():
+            try:
+                result = run(stop_event)
+                handle.result = result
+            except BaseException as e:
+                handle.error = f"{type(e).__name__}: {e}"
+
+        thread = threading.Thread(
+            target=_worker, name=f"tpuflow-runtime-{name}", daemon=True
+        )
+        handle = ThreadHandle(thread, stop_event)
+        thread.start()
+        return handle
+
+    def _liveness(handle: ThreadHandle):
+        if handle.thread.is_alive():
+            return "ok", ""
+        if handle.error is not None:
+            return "dead", handle.error
+        if check is not None:
+            return check(handle.result)
+        return "finished", ""
+
+    def _stop(handle: ThreadHandle, grace_s: float):
+        handle.stop_event.set()
+        handle.thread.join(timeout=max(grace_s, 0.0))
+        if handle.thread.is_alive():
+            return "abandoned"  # unkillable; daemon=True caps the damage
+        return "stopped" if handle.error is None else "died"
+
+    return ServiceSpec(
+        name=name, start=_start, stop=_stop, liveness=_liveness,
+        depends_on=tuple(depends_on), grace=grace, **spec_kwargs,
+    )
+
+
+def daemon_service(
+    name: str,
+    server_factory,
+    *,
+    depends_on: tuple = (),
+    grace: float = 10.0,
+    probe_timeout: float = 2.0,
+    **spec_kwargs,
+) -> ServiceSpec:
+    """The async serving daemon as a service. ``server_factory()``
+    builds (but does not start) an ``AsyncServer``; liveness rides the
+    daemon's own ``/healthz``; stop drains in-flight requests (the
+    zero-500s contract) before ``shutdown()`` — ``killed_by`` records
+    ``drained`` or ``abandoned-inflight``."""
+
+    def _start():
+        return server_factory().start()
+
+    def _liveness(server):
+        import urllib.request
+
+        url = f"http://127.0.0.1:{server.port}/healthz"
+        try:
+            with urllib.request.urlopen(url, timeout=probe_timeout) as resp:
+                import json
+
+                doc = json.loads(resp.read().decode())
+        except Exception as e:
+            return "dead", f"/healthz unreachable: {type(e).__name__}: {e}"
+        if doc.get("status") == "ok":
+            return "ok", ""
+        return "degraded", f"degraded artifacts: {doc.get('degraded_artifacts')}"
+
+    def _stop(server, grace_s: float):
+        drained = server.drain(timeout=grace_s)
+        server.shutdown()
+        return "drained" if drained else "abandoned-inflight"
+
+    return ServiceSpec(
+        name=name, start=_start, stop=_stop, liveness=_liveness,
+        depends_on=tuple(depends_on), grace=grace, **spec_kwargs,
+    )
+
+
+def gang_service(
+    name: str,
+    spec: dict,
+    n_workers: int,
+    *,
+    depends_on: tuple = (),
+    grace: float = 15.0,
+    allow_partial: bool = False,
+    **run_kwargs,
+) -> ServiceSpec:
+    """An in-process elastic gang as a service: ``run_elastic`` on a
+    worker thread with the cooperative ``stop_event`` plumbed through
+    to every worker's epoch loop. ``allow_partial=True`` treats a gang
+    that lost workers but still produced a final average as FINISHED
+    (churn absorbed — the elastic contract); default demands every
+    worker healthy."""
+
+    def _run(stop_event):
+        from tpuflow.elastic.runner import run_elastic
+
+        return run_elastic(
+            spec, n_workers, mode="inprocess", stop_event=stop_event,
+            **run_kwargs,
+        )
+
+    def _check(result):
+        if result is None:
+            return "dead", "run_elastic returned nothing"
+        if result.ok:
+            return "finished", ""
+        dead = [w.worker_id for w in result.workers if w.error]
+        if (
+            allow_partial
+            and "error" not in result.coordinator
+            and result.final_path is not None
+            and any(w.report is not None for w in result.workers)
+        ):
+            return "finished", f"absorbed worker deaths: {dead}"
+        return "dead", (
+            result.coordinator.get("error")
+            or f"workers died: {dead}"
+        )
+
+    return thread_service(
+        name, _run, check=_check, depends_on=depends_on, grace=grace,
+    )
+
+
+def online_service(
+    name: str,
+    trainer_factory,
+    *,
+    depends_on: tuple = (),
+    grace: float = 30.0,
+    max_windows: int | None = None,
+    **spec_kwargs,
+) -> ServiceSpec:
+    """The online controller as a service. ``trainer_factory()`` builds
+    an ``OnlineTrainer``; stop is ``request_stop()`` (the loop ends at
+    its next window boundary — a mid-retrain drain finishes the swap)
+    plus the thread join. The run summary lands on the handle."""
+
+    def _run(stop_event):
+        trainer = trainer_factory()
+
+        # request_stop on the trainer when the service's stop event
+        # fires: a watcher thread, because run() blocks this one.
+        def _watch():
+            stop_event.wait()
+            trainer.request_stop()
+
+        watcher = threading.Thread(
+            target=_watch, name=f"tpuflow-runtime-{name}-stop", daemon=True
+        )
+        watcher.start()
+        try:
+            return trainer.run(max_windows=max_windows)
+        finally:
+            stop_event.set()  # unblock the watcher so it exits
+            watcher.join(timeout=1.0)
+
+    return thread_service(
+        name, _run, depends_on=depends_on, grace=grace, **spec_kwargs,
+    )
+
+
+def process_service(
+    name: str,
+    argv: list,
+    *,
+    depends_on: tuple = (),
+    grace: float = 5.0,
+    env: dict | None = None,
+    cwd: str | None = None,
+    **spec_kwargs,
+) -> ServiceSpec:
+    """An arbitrary child process as a service. Liveness is ``poll()``
+    (exit 0 = FINISHED, anything else = dead); stop reuses the training
+    supervisor's SIGTERM→grace→SIGKILL escalation, so ``killed_by``
+    says whether teardown ran ("sigterm") or the child ignored it
+    ("sigkill")."""
+
+    def _start():
+        import subprocess
+
+        return subprocess.Popen(argv, env=env, cwd=cwd)
+
+    def _liveness(proc):
+        code = proc.poll()
+        if code is None:
+            return "ok", ""
+        if code == 0:
+            return "finished", "exit 0"
+        return "dead", f"exit code {code}"
+
+    def _stop(proc, grace_s: float):
+        from tpuflow.train.supervisor import terminate_gracefully
+
+        if proc.poll() is not None:
+            return "already-exited"
+        return terminate_gracefully(proc, grace_s)
+
+    return ServiceSpec(
+        name=name, start=_start, stop=_stop, liveness=_liveness,
+        depends_on=tuple(depends_on), grace=grace, **spec_kwargs,
+    )

@@ -1,0 +1,419 @@
+"""The day-in-the-life chaos soak: every subsystem as one organism.
+
+Every subsystem has been hardened in isolation — elastic gangs survive
+churn, the replica plane reloads under load, the online loop swaps with
+zero drops. ``run_soak`` runs them TOGETHER, under one
+:class:`~tpuflow.runtime.supervisor.RuntimeSupervisor`, through a
+seeded cross-subsystem fault storm:
+
+1. an elastic socket gang trains under churn (``gang`` service);
+2. the async daemon serves open-loop Poisson traffic the whole time
+   (``serving`` + ``traffic`` services);
+3. mid-soak the stream regime-shifts; the online loop detects drift,
+   warm-start retrains, and hot-swaps the serving artifact under load
+   (``online`` service);
+4. a :class:`~tpuflow.runtime.chaos.ChaosSchedule` arms correlated
+   faults at declared phases — a worker death during averaging, a
+   checkpoint flake during the retrain, a latency storm on the predict
+   path;
+5. graceful dependency-aware shutdown (traffic → online → serving
+   drain → gang), then ONE SLO report card
+   (``obs/slo_report_card.schema.json``) from the fleet's merged
+   trails + the daemon's own registry: availability and its error
+   budget, p99 latency, time-to-adapt, and the dropped-request count —
+   which must be 0.
+
+``mini_soak_spec`` is the tier-1 preset (2 workers, 1 storm phase,
+tens of seconds); the ``slow``-marked full soak and the CLI
+(``python -m tpuflow.runtime soak spec.json``) run bigger specs of the
+same shape.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+
+NAMES = "pressure,choke,glr,temperature,water_cut,completion,flow"
+TYPES = "float,float,float,float,float,string,float"
+_COLS = NAMES.split(",")
+
+# A request is DROPPED when it got neither an answer nor a deliberate
+# shed: transport failures and 5xx other than the 503/504 shed codes.
+# 429/503/504 are the admission/deadline policies doing their job —
+# counted, reported, but not drops.
+_SHED_CODES = {"429", "503", "504"}
+
+
+def mini_soak_spec(root: str) -> dict:
+    """The tier-1 mini-soak: 2 gang workers, one correlated storm
+    phase, ~50 Poisson requests, one regime shift — small enough for
+    the default suite, shaped exactly like the full soak."""
+    return {
+        "root": root,
+        "deadline_s": 150.0,
+        "gang": {
+            "workers": 2, "epochs": 2,
+            "synthetic_wells": 2, "synthetic_steps": 64,
+            "heartbeat_timeout": 1.5, "round_timeout": 10.0,
+        },
+        "serving": {"max_epochs": 4, "hidden": [4]},
+        "traffic": {
+            "rate_rps": 25.0, "max_requests": 50, "seed": 11,
+            "client_workers": 4, "timeout_s": 20.0,
+        },
+        "online": {
+            "healthy_windows": 2, "shifted_windows": 6,
+            "shift_scale": 3.0, "window_rows": 120, "seed": 7,
+            "knobs": {
+                "warmup_windows": 1, "threshold": 3.0,
+                "replay_windows": 4, "eval_every": 3,
+                "retrain_epochs": 2, "margin": 1000.0,
+                "min_retrain_gap": 100, "rollback": False,
+            },
+        },
+        "chaos": {
+            "seed": 5,
+            "phases": [{
+                # ONE correlated storm: a worker death during
+                # averaging, checkpoint I/O flaking under the retrain,
+                # and a latency storm on the predict path — armed
+                # together shortly after the fleet is up.
+                "name": "storm", "at_s": 0.5, "duration_s": 10.0,
+                "faults": [
+                    "elastic.push,nth=2",
+                    "checkpoint.save,p=0.35,transient=1",
+                    "serve.execute,p=0.3,mode=delay,delay=0.02",
+                ],
+            }],
+        },
+        "objectives": [
+            {"name": "availability", "kind": "availability",
+             "target": 0.999,
+             "good": ("serving_admitted_total",),
+             "bad": ("serving_shed_total",)},
+            {"name": "p99_latency", "kind": "latency_p99",
+             "target": 2000.0},
+            {"name": "time_to_adapt", "kind": "time_to_adapt",
+             "target": 120.0},
+        ],
+    }
+
+
+def _write_csv(path: str, table: dict) -> None:
+    rows = []
+    for i in range(len(table["flow"])):
+        rows.append(",".join(str(table[c][i]) for c in _COLS))
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write("\n".join(rows) + "\n")
+
+
+def _one_request(url: str, body: bytes, timeout_s: float) -> tuple:
+    """(status_or_transport_tag, latency_s_or_None)."""
+    import urllib.error
+    import urllib.request
+
+    t0 = time.monotonic()
+    req = urllib.request.Request(
+        url, data=body,
+        headers={"Content-Type": "application/json", "x-client-id": "soak"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            resp.read()
+            return str(resp.status), time.monotonic() - t0
+    except urllib.error.HTTPError as e:
+        return str(e.code), time.monotonic() - t0
+    except Exception as e:
+        return f"transport:{type(e).__name__}", None
+
+
+def _traffic_result(results: list) -> dict:
+    by_status: dict = {}
+    latencies = []
+    for status, latency in results:
+        by_status[status] = by_status.get(status, 0) + 1
+        if latency is not None:
+            latencies.append(latency)
+    dropped = sum(
+        n for status, n in by_status.items()
+        if status.startswith("transport:")
+        or (status.isdigit() and status >= "500" and status not in _SHED_CODES)
+    )
+    latencies.sort()
+    p99 = (
+        latencies[min(len(latencies) - 1, int(0.99 * len(latencies)))]
+        if latencies else None
+    )
+    return {
+        "sent": len(results),
+        "by_status": by_status,
+        "dropped": dropped,
+        "p99_s": p99,
+    }
+
+
+def run_soak(doc: dict) -> dict:
+    """Run one day-in-the-life soak from a spec doc (``mini_soak_spec``
+    shape); returns the result dict and writes
+    ``{root}/soak_report.json``. ``result["ok"]`` requires: the report
+    card validates against the committed schema, dropped == 0, the
+    workload services all FINISHED, and serving drained cleanly."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    from tpuflow.api import TrainJobConfig, train
+    from tpuflow.data import wells_to_table
+    from tpuflow.data.synthetic import generate_wells
+    from tpuflow.obs import Registry
+    from tpuflow.obs.fleet import read_fleet
+    from tpuflow.obs.slo import report_card, validate_report_card
+    from tpuflow.online.controller import OnlineTrainer
+    from tpuflow.runtime.chaos import ChaosSchedule
+    from tpuflow.runtime.services import (
+        daemon_service,
+        gang_service,
+        online_service,
+        thread_service,
+    )
+    from tpuflow.runtime.supervisor import RuntimeSupervisor
+    from tpuflow.utils.paths import atomic_write_json
+
+    root = doc.get("root")
+    if not root:
+        raise ValueError("soak spec needs 'root' (the storage root)")
+    os.makedirs(root, exist_ok=True)
+    wall0 = time.monotonic()
+    deadline_s = float(doc.get("deadline_s", 150.0))
+    gang_doc = dict(doc.get("gang") or {})
+    serving_doc = dict(doc.get("serving") or {})
+    traffic_doc = dict(doc.get("traffic") or {})
+    online_doc = dict(doc.get("online") or {})
+
+    # --- the shared data + the initial serving artifact ---------------
+    table = wells_to_table(generate_wells(n_wells=4, steps=200, seed=3))
+    base_csv = os.path.join(root, "base.csv")
+    _write_csv(base_csv, {c: list(np.asarray(table[c])) for c in _COLS})
+    serving_dir = os.path.join(root, "serving")
+
+    def _serving_config(**over):
+        kw = dict(
+            column_names=NAMES, column_types=TYPES, target="flow",
+            storage_path=serving_dir, data_path=base_csv,
+            model="static_mlp",
+            model_kwargs={"hidden": list(serving_doc.get("hidden", [4]))},
+            max_epochs=int(serving_doc.get("max_epochs", 4)),
+            patience=100, batch_size=64, verbose=False, health="off",
+        )
+        kw.update(over)
+        return TrainJobConfig(**kw)
+
+    train(_serving_config(
+        metrics_path=os.path.join(serving_dir, "metrics.jsonl")
+    ))
+
+    # --- chaos schedule (started only once the fleet is up) -----------
+    chaos = None
+    if doc.get("chaos"):
+        chaos = ChaosSchedule.from_dict(doc["chaos"])
+
+    # --- services ------------------------------------------------------
+    box: dict = {}  # "server": the running AsyncServer (set at start)
+
+    def _server_factory():
+        from tpuflow.serve_async import AsyncServer
+
+        server = AsyncServer(
+            "127.0.0.1", 0, enable_jobs=False,
+            trail_path=os.path.join(root, "serve-metrics.jsonl"),
+        )
+        box["server"] = server
+        return server
+
+    gang_spec = {
+        "model": "static_mlp", "model_kwargs": {"hidden": []},
+        "epochs": int(gang_doc.get("epochs", 2)),
+        "batchSize": 32, "patience": 100, "loss": "mse",
+        "synthetic_wells": int(gang_doc.get("synthetic_wells", 2)),
+        "synthetic_steps": int(gang_doc.get("synthetic_steps", 64)),
+        "n_devices": 1, "verbose": False,
+        "storagePath": os.path.join(root, "gang"),
+    }
+    gang = gang_service(
+        "gang", gang_spec, int(gang_doc.get("workers", 2)),
+        transport="socket",
+        heartbeat_timeout=float(gang_doc.get("heartbeat_timeout", 1.5)),
+        round_timeout=float(gang_doc.get("round_timeout", 10.0)),
+        # The storm WANTS a worker death absorbed, not reported as a
+        # fleet failure — the elastic contract under churn.
+        allow_partial=True,
+        grace=20.0,
+    )
+    # serving depends_on gang is the SHUTDOWN-ordering contract, not a
+    # data dependency: stop order is reverse topo, so serving drains
+    # BEFORE the gang is touched (ISSUE 16's drill).
+    serving = daemon_service("serving", _server_factory,
+                             depends_on=("gang",), grace=15.0)
+
+    # The regime-shifted stream: healthy windows, then scaled ones. The
+    # generator fires the chaos "regime_shift" hook as the first
+    # shifted window is consumed — the storm phases declared
+    # on_event="regime_shift" open exactly when drift begins.
+    healthy = int(online_doc.get("healthy_windows", 2))
+    shifted = int(online_doc.get("shifted_windows", 6))
+    scale = float(online_doc.get("shift_scale", 3.0))
+    window_rows = int(online_doc.get("window_rows", 120))
+    rng = np.random.default_rng(int(online_doc.get("seed", 7)))
+    n_rows = len(table["flow"])
+
+    def _chunk(chunk_scale):
+        idx = rng.integers(0, n_rows, window_rows)
+        return {
+            c: (
+                np.asarray(table[c])[idx] if c == "completion"
+                else np.asarray(table[c], np.float64)[idx]
+                * (chunk_scale if c in ("pressure", "flow") else 1.0)
+            )
+            for c in _COLS
+        }
+
+    chunks = [_chunk(1.0) for _ in range(healthy)] + \
+        [_chunk(scale) for _ in range(shifted)]
+
+    def _chunks_with_hook():
+        for i, chunk in enumerate(chunks):
+            if i == healthy and chaos is not None:
+                chaos.fire_event("regime_shift")
+            yield chunk
+
+    def _trainer_factory():
+        server = box["server"]
+        knobs = dict(online_doc.get("knobs") or {})
+        knobs["daemon_url"] = f"http://127.0.0.1:{server.port}"
+        cfg = _serving_config(online=knobs)
+        return OnlineTrainer(
+            cfg, source=_chunks_with_hook(), registry=Registry(),
+        )
+
+    online = online_service(
+        "online", _trainer_factory, depends_on=("serving",), grace=60.0,
+    )
+
+    def _traffic_run(stop_event):
+        server = box["server"]
+        url = f"http://127.0.0.1:{server.port}/predict"
+        probe = {
+            c: [
+                float(v) if c != "completion" else str(v)
+                for v in np.asarray(table[c][:16])
+            ]
+            for c in _COLS if c != "flow"
+        }
+        body = json.dumps({
+            "storagePath": serving_dir, "model": "static_mlp",
+            "columns": probe,
+        }).encode()
+        rate = float(traffic_doc.get("rate_rps", 25.0))
+        max_requests = int(traffic_doc.get("max_requests", 50))
+        timeout_s = float(traffic_doc.get("timeout_s", 20.0))
+        poisson = random.Random(int(traffic_doc.get("seed", 11)))
+        pool = ThreadPoolExecutor(
+            max_workers=int(traffic_doc.get("client_workers", 4)),
+            thread_name_prefix="tpuflow-soak-client",
+        )
+        futures = []
+        # Open loop: arrivals follow the seeded exponential gaps no
+        # matter how slow responses are — load does NOT back off when
+        # the server struggles, which is the honest way to grade it.
+        while len(futures) < max_requests and not stop_event.is_set():
+            if stop_event.wait(poisson.expovariate(rate)):
+                break
+            futures.append(
+                pool.submit(_one_request, url, body, timeout_s)
+            )
+        results = [f.result() for f in futures]
+        pool.shutdown(wait=True)
+        return _traffic_result(results)
+
+    traffic = thread_service(
+        "traffic", _traffic_run, depends_on=("serving",), grace=30.0,
+    )
+
+    supervisor = RuntimeSupervisor(
+        [gang, serving, online, traffic],
+        trail_path=os.path.join(root, "runtime-metrics.jsonl"),
+    )
+    supervisor.start()
+    healthz_port = supervisor.serve_healthz()
+    if chaos is not None:
+        chaos.start()
+
+    # --- the day in the life -------------------------------------------
+    workload = ("gang", "online", "traffic")
+    deadline = wall0 + deadline_s
+    while time.monotonic() < deadline:
+        snap = supervisor.healthz()["services"]
+        if all(
+            snap[n]["state"] in ("finished", "failed", "stopped")
+            for n in workload
+        ):
+            break
+        time.sleep(0.1)
+
+    chaos_summary = chaos.stop() if chaos is not None else None
+    gang_handle = supervisor.service_handle("gang")
+    online_handle = supervisor.service_handle("online")
+    traffic_handle = supervisor.service_handle("traffic")
+    final = supervisor.shutdown()
+
+    # --- the report card -----------------------------------------------
+    server = box.get("server")
+    _trails, events = read_fleet([root])
+    traffic_summary = traffic_handle.result if traffic_handle else None
+    gang_result = gang_handle.result if gang_handle else None
+    online_summary = online_handle.result if online_handle else None
+    dropped = (traffic_summary or {}).get("dropped")
+    source = {
+        "scenario": "day-in-the-life soak",
+        "root": root,
+        "traffic": traffic_summary,
+        "chaos": chaos_summary,
+        "online": online_summary,
+        "gang": gang_result.summary() if gang_result is not None else None,
+        "services": final["services"],
+        "wall_s": round(time.monotonic() - wall0, 3),
+    }
+    card = report_card(
+        events,
+        doc.get("objectives") or mini_soak_spec(root)["objectives"],
+        registry=server.registry if server is not None else None,
+        source=source,
+    )
+    card_error = None
+    try:
+        validate_report_card(card)
+    except ValueError as e:
+        card_error = str(e)
+    rows = {r["name"]: r for r in card.get("objectives", ())}
+    adapt = rows.get("time_to_adapt") or {}
+    states = {n: final["services"][n]["state"] for n in final["services"]}
+    ok = (
+        card_error is None
+        and dropped == 0
+        and all(states.get(n) in ("finished", "stopped") for n in workload)
+        and final["services"]["serving"].get("killed_by") == "drained"
+    )
+    result = {
+        "ok": ok,
+        "root": root,
+        "dropped": dropped,
+        "card_error": card_error,
+        "time_to_adapt_s": adapt.get("measured"),
+        "healthz_port": healthz_port,
+        "card": card,
+    }
+    atomic_write_json(os.path.join(root, "soak_report.json"), result)
+    return result

@@ -1,0 +1,466 @@
+"""The shared-runtime service supervisor.
+
+One process, many long-lived heterogeneous components — the async
+serving daemon, an elastic gang, the online controller, child
+processes — owned as declaratively-specced services
+(:class:`~tpuflow.runtime.service.ServiceSpec`) with:
+
+- **dependency-ordered startup**: services start in topological order
+  of ``depends_on`` (a cycle fails at construction); a failed start
+  stops the already-started prefix in reverse before re-raising, so a
+  half-started fleet never leaks.
+- **liveness probing**: one daemon probe thread polls each service's
+  ``liveness`` callable — riding whatever machinery the component
+  already has (``/healthz`` for the daemon, thread aliveness + result
+  boxes for gangs and loops, ``poll()`` for processes).
+- **per-service restart policy**: a dead service is restarted under
+  its spec's budget with ``resilience.RetryPolicy`` backoff; deaths
+  faster than ``min_uptime`` accumulate toward crash-loop
+  classification (the ``train/supervisor.py`` precedent) and fail the
+  service even with budget left.
+- **dependency-aware graceful shutdown**: reverse topological order —
+  a service stops before everything it depends on (drain serving
+  before killing the gang it fronts), each through its spec's
+  ``stop(handle, grace)`` with the escalation recorded as
+  ``killed_by``.
+
+Observability: a ``runtime_services{state=}`` gauge (default registry
+by default) holds the per-state service counts; every transition lands
+in the forensics ring (``runtime_service_state``) and, when
+``trail_path`` is set, on the fleet timeline; ``serve_healthz()``
+exposes the aggregated rollup over HTTP for external orchestrators.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+
+from tpuflow.resilience.retry import RetryPolicy
+from tpuflow.runtime.service import (
+    DEGRADED,
+    FAILED,
+    FINISHED,
+    PENDING,
+    RUNNING,
+    STARTING,
+    STATES,
+    STOPPED,
+    STOPPING,
+    ManagedService,
+    ServiceSpec,
+)
+
+
+def _topo_order(specs: list[ServiceSpec]) -> list[str]:
+    """Kahn's algorithm over ``depends_on``; deterministic (declaration
+    order breaks ties); raises on duplicates, unknown deps, cycles."""
+    names = [s.name for s in specs]
+    if len(set(names)) != len(names):
+        dupes = sorted({n for n in names if names.count(n) > 1})
+        raise ValueError(f"duplicate service names: {dupes}")
+    by_name = {s.name: s for s in specs}
+    for s in specs:
+        for dep in s.depends_on:
+            if dep not in by_name:
+                raise ValueError(
+                    f"service {s.name!r} depends on unknown service "
+                    f"{dep!r}; declared: {sorted(by_name)}"
+                )
+            if dep == s.name:
+                raise ValueError(f"service {s.name!r} depends on itself")
+    remaining = dict(by_name)
+    order: list[str] = []
+    placed: set = set()
+    progress = True
+    while remaining and progress:
+        progress = False
+        for name in list(names):
+            if name not in remaining:
+                continue
+            if all(d in placed for d in remaining[name].depends_on):
+                order.append(name)
+                placed.add(name)
+                del remaining[name]
+                progress = True
+    if remaining:
+        raise ValueError(
+            f"service dependency cycle among {sorted(remaining)} — "
+            "depends_on must be a DAG"
+        )
+    return order
+
+
+class RuntimeSupervisor:
+    """Own a fleet of :class:`ServiceSpec` services (module docstring).
+
+    Lifecycle: ``start()`` → (work happens; ``wait()`` to watch for
+    quiescence) → ``shutdown()``. ``healthz()``/``snapshot()`` are
+    callable from any thread at any point.
+    """
+
+    def __init__(
+        self,
+        specs,
+        *,
+        registry=None,
+        probe_interval: float = 0.25,
+        trail_path: str | None = None,
+        clock=time.monotonic,
+    ):
+        from tpuflow.obs import default_registry
+
+        specs = list(specs)
+        if not specs:
+            raise ValueError("RuntimeSupervisor needs at least one service")
+        if probe_interval <= 0:
+            raise ValueError(
+                f"probe_interval must be > 0 seconds, got {probe_interval}"
+            )
+        self._order = _topo_order(specs)  # startup order; stop reverses it
+        self._specs = {s.name: s for s in specs}
+        self._services = {s.name: ManagedService(spec=s) for s in specs}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._probe_thread: threading.Thread | None = None
+        self._healthz_server = None
+        self._healthz_thread: threading.Thread | None = None
+        self.probe_interval = float(probe_interval)
+        self._clock = clock
+        self.registry = registry or default_registry()
+        self._gauge = self.registry.gauge(
+            "runtime_services",
+            "runtime-supervised services by lifecycle state",
+        )
+        self._restarts_total = self.registry.counter(
+            "runtime_service_restarts_total",
+            "runtime-supervised service restarts by service",
+        )
+        self._trail = None
+        if trail_path:
+            from tpuflow.utils.logging import MetricsLogger
+
+            self._trail = MetricsLogger(trail_path)
+        # Every state gets a sample from the first scrape on — zeros,
+        # not missing series, for the states nothing occupies yet.
+        for state in STATES:
+            self._gauge.set(
+                float(len(specs)) if state == PENDING else 0.0, state=state
+            )
+
+    # --- transitions ---------------------------------------------------
+
+    def _transition(self, name: str, state: str, detail: str = "") -> None:
+        self._transition_if(name, None, state, detail)
+
+    def _transition_if(
+        self, name: str, from_states, state: str, detail: str = "",
+    ) -> bool:
+        """Move ``name`` to ``state`` (only from ``from_states`` when
+        given); refresh the per-state gauge; mirror to the forensics
+        ring and the trail. Returns whether the transition applied."""
+        with self._lock:
+            svc = self._services[name]
+            if from_states is not None and svc.state not in from_states:
+                return False
+            old = svc.state
+            svc.state = state
+            if detail:
+                svc.detail = detail
+            counts = self._state_counts_locked()
+        # Gauge/ring/trail updates run OUTSIDE the lock: none of them
+        # may ever block a probe or a shutdown pass.
+        for st in STATES:
+            self._gauge.set(float(counts.get(st, 0)), state=st)
+        from tpuflow.obs import record_event
+
+        record_event(
+            "runtime_service_state",
+            service=name, state=state, previous=old, detail=detail,
+        )
+        if self._trail is not None:
+            self._trail.write(
+                "runtime_service_state",
+                service=name, state=state, previous=old, detail=detail,
+            )
+        return True
+
+    def _state_counts_locked(self) -> dict:
+        counts: dict = {}
+        for svc in self._services.values():
+            counts[svc.state] = counts.get(svc.state, 0) + 1
+        return counts
+
+    # --- startup -------------------------------------------------------
+
+    def start(self) -> "RuntimeSupervisor":
+        """Start every service in dependency order, then the probe
+        thread. A start failure stops the started prefix (reverse
+        order) and re-raises — all-or-nothing."""
+        started: list[str] = []
+        try:
+            for name in self._order:
+                spec = self._specs[name]
+                self._transition(name, STARTING)
+                handle = spec.start()
+                now = self._clock()
+                with self._lock:
+                    svc = self._services[name]
+                    svc.handle = handle
+                    svc.started_at = now
+                self._transition(name, RUNNING)
+                started.append(name)
+        except BaseException:
+            for name in reversed(started):
+                try:
+                    self._stop_service(name)
+                except Exception:
+                    pass  # best-effort unwind; the start error wins
+            raise
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="tpuflow-runtime-probe",
+            daemon=True,
+        )
+        self._probe_thread.start()
+        return self
+
+    # --- liveness + restart --------------------------------------------
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval):
+            self._probe_once()
+
+    def _probe_once(self) -> None:
+        with self._lock:
+            targets = [
+                (svc.spec.name, svc.spec, svc.handle)
+                for svc in self._services.values()
+                if svc.state in (RUNNING, DEGRADED)
+            ]
+        for name, spec, handle in targets:
+            try:
+                probe, detail = spec.liveness(handle)
+            except Exception as e:  # a broken probe reads as a death
+                probe, detail = "dead", f"liveness probe raised: {e!r}"
+            if probe == "ok":
+                self._transition_if(name, (DEGRADED,), RUNNING, detail)
+            elif probe == "degraded":
+                self._transition_if(
+                    name, (RUNNING, DEGRADED), DEGRADED, detail
+                )
+            elif probe == "finished":
+                self._transition_if(
+                    name, (RUNNING, DEGRADED), FINISHED, detail
+                )
+            elif probe == "dead":
+                self._handle_death(name, detail)
+            else:
+                self._handle_death(
+                    name,
+                    f"liveness returned unknown state {probe!r} "
+                    f"(detail: {detail})",
+                )
+
+    def _handle_death(self, name: str, detail: str) -> None:
+        """Classify a death and apply the restart policy. Runs on the
+        probe thread; backoff sleeps happen here, outside the lock —
+        bounded by the spec's backoff_max."""
+        spec = self._specs[name]
+        rng = (
+            random.Random(spec.backoff_seed)
+            if spec.backoff_seed is not None else random
+        )
+        policy = RetryPolicy(
+            base_delay=spec.backoff_base, max_delay=spec.backoff_max,
+            jitter=spec.backoff_jitter,
+        )
+        while not self._stop.is_set():
+            now = self._clock()
+            with self._lock:
+                svc = self._services[name]
+                if svc.state not in (RUNNING, DEGRADED, STARTING):
+                    return  # shutdown (or a FAILED verdict) raced us
+                uptime = (
+                    now - svc.started_at
+                    if svc.started_at is not None else 0.0
+                )
+                svc.failures.append({
+                    "detail": detail, "uptime_s": round(uptime, 3),
+                })
+                if uptime < spec.min_uptime:
+                    svc.fast_deaths += 1
+                else:
+                    svc.fast_deaths = 0
+                crash_loop = svc.fast_deaths >= spec.crash_loop_threshold
+                exhausted = svc.restarts >= spec.max_restarts
+                attempt = None
+                if not crash_loop and not exhausted:
+                    svc.restarts += 1
+                    attempt = svc.restarts
+            if attempt is None:
+                why = (
+                    f"crash loop ({spec.crash_loop_threshold} consecutive "
+                    f"deaths under min_uptime={spec.min_uptime}s)"
+                    if crash_loop
+                    else f"restart budget exhausted "
+                    f"(max_restarts={spec.max_restarts})"
+                )
+                self._transition(name, FAILED, f"{detail} — {why}")
+                return
+            self._restarts_total.inc(service=name)
+            self._transition(
+                name, STARTING,
+                f"restart {attempt}/{spec.max_restarts} after: {detail}",
+            )
+            delay = policy.delay(attempt, rng)
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                handle = spec.start()
+            except Exception as e:
+                detail = f"restart {attempt} failed to start: {e}"
+                continue  # re-classify: a failed start is a fast death
+            now = self._clock()
+            with self._lock:
+                svc = self._services[name]
+                svc.handle = handle
+                svc.started_at = now
+            if not self._transition_if(
+                name, (STARTING,), RUNNING, f"restarted (attempt {attempt})"
+            ):
+                return  # shutdown raced the restart; stop pass owns it
+            return
+
+    # --- health --------------------------------------------------------
+
+    def healthz(self) -> dict:
+        """The aggregated rollup: ``failed`` beats ``degraded`` beats
+        ``ok``; FINISHED/STOPPED are terminal-but-healthy (a gang that
+        trained to completion does not degrade the fleet)."""
+        with self._lock:
+            snaps = [
+                svc.snapshot_locked() for svc in self._services.values()
+            ]
+        states = {s["state"] for s in snaps}
+        if FAILED in states:
+            status = "failed"
+        elif states & {DEGRADED, STARTING, PENDING}:
+            status = "degraded"
+        else:
+            status = "ok"
+        return {
+            "status": status,
+            "services": {s["name"]: s for s in snaps},
+        }
+
+    def snapshot(self) -> dict:
+        return self.healthz()
+
+    def service_handle(self, name: str):
+        """The live handle ``start()`` returned for ``name`` (the
+        server object, thread box, or Popen) — how a scenario driver
+        reads a finished service's result after shutdown."""
+        with self._lock:
+            return self._services[name].handle
+
+    def serve_healthz(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Expose ``healthz()`` over HTTP (GET /healthz); returns the
+        bound port. 200 while the fleet is ok/degraded, 503 once any
+        service is FAILED — the signal an external orchestrator keys
+        its replace-the-whole-runtime decision on."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        supervisor = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path.split("?")[0] not in ("/", "/healthz"):
+                    self.send_error(404)
+                    return
+                doc = supervisor.healthz()
+                body = json.dumps(doc).encode()
+                code = 503 if doc["status"] == "failed" else 200
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet by default
+                pass
+
+        self._healthz_server = ThreadingHTTPServer((host, port), _Handler)
+        self._healthz_thread = threading.Thread(
+            target=self._healthz_server.serve_forever,
+            name="tpuflow-runtime-healthz", daemon=True,
+        )
+        self._healthz_thread.start()
+        return self._healthz_server.server_address[1]
+
+    # --- wait + shutdown -----------------------------------------------
+
+    def wait(self, timeout: float, poll: float = 0.05) -> bool:
+        """Block until every service is terminal (FINISHED, FAILED, or
+        STOPPED) or ``timeout`` elapses; returns whether the fleet
+        quiesced. The soak's main loop."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                states = [s.state for s in self._services.values()]
+            if all(st in (FINISHED, FAILED, STOPPED) for st in states):
+                return True
+            time.sleep(poll)
+        return False
+
+    def _stop_service(self, name: str) -> None:
+        spec = self._specs[name]
+        with self._lock:
+            svc = self._services[name]
+            state = svc.state
+            handle = svc.handle
+        if state in (PENDING, STOPPED, FAILED):
+            return  # nothing running to stop
+        self._transition(name, STOPPING)
+        try:
+            killed_by = spec.stop(handle, spec.grace)
+        except Exception as e:
+            killed_by = f"stop-error: {type(e).__name__}: {e}"
+        with self._lock:
+            self._services[name].killed_by = (
+                killed_by if isinstance(killed_by, str) else None
+            )
+        self._transition(name, STOPPED)
+
+    def shutdown(self) -> dict:
+        """Dependency-aware graceful shutdown: reverse startup order, so
+        every service stops BEFORE the services it depends on (the
+        serving daemon drains before the gang it fronts is touched).
+        Records each service's ``stop_index`` (its position in the
+        shutdown sequence) and ``killed_by``. Idempotent; returns the
+        final snapshot."""
+        self._stop.set()
+        probe = self._probe_thread
+        if probe is not None:
+            probe.join(timeout=10)
+            self._probe_thread = None
+        for idx, name in enumerate(reversed(self._order)):
+            with self._lock:
+                already = self._services[name].stop_index is not None
+                if not already:
+                    self._services[name].stop_index = idx
+            if not already:
+                self._stop_service(name)
+        # The healthz endpoint answers THROUGH the drain (an
+        # orchestrator watches the shutdown happen) and closes last.
+        server = self._healthz_server
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+            self._healthz_server = None
+        thread = self._healthz_thread
+        if thread is not None:
+            thread.join(timeout=5)
+            self._healthz_thread = None
+        return self.snapshot()

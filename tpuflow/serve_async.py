@@ -233,6 +233,12 @@ class _Admission:
         same features buys nothing; the server is fine)."""
         self._shed.inc(code="429")
 
+    def shed_draining(self) -> None:
+        """A drain-window shed: 503-class ('my capacity, back off') —
+        the server is going away on purpose, and a load balancer treats
+        503 as 'retry elsewhere', which is exactly right mid-drain."""
+        self._shed.inc(code="503")
+
     def release(self) -> None:
         self.inflight -= 1
 
@@ -465,6 +471,9 @@ class AsyncServer:
         self._ready = threading.Event()
         self._announce = False  # main() flips it: print URL post-bind
         self._boot_error: BaseException | None = None
+        # drain(): set from the caller's thread, read on the event loop
+        # at each /predict — an Event, so both sides are race-free.
+        self._draining = threading.Event()
 
     def _record_reload(self, storage_path: str, name: str) -> None:
         """One trace-stamped reload record: the forensics ring always,
@@ -955,6 +964,15 @@ class AsyncServer:
                 return 200, rec, json_ct
             return 404, {"error": f"no route {path!r}"}, json_ct
         if method == "POST" and route == "/predict":
+            if self._draining.is_set():
+                # Mid-drain: refuse NEW work before admission touches
+                # its counters, while already-admitted requests keep
+                # running to completion — the zero-500s drain contract.
+                self.admission.shed_draining()
+                return 503, {
+                    "error": "server draining for shutdown; retry "
+                    "another replica", "shed": "draining",
+                }, json_ct
             client = headers.get("x-client-id") or (
                 (writer.get_extra_info("peername") or ("?",))[0]
             )
@@ -1180,6 +1198,26 @@ class AsyncServer:
     def serve_forever(self) -> None:
         """Foreground serving (``main()``): blocks until ``shutdown``."""
         self._run_loop()
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Stop admitting NEW /predict work (503 "draining" sheds) and
+        wait for every in-flight request to finish; returns True when
+        the server is empty, False on timeout (in-flight work still
+        running — the caller decides whether to abandon it).
+
+        The listener deliberately stays OPEN: closing it would end the
+        serve task, tear down the event loop, and cancel the very
+        in-flight handlers a drain exists to protect (and health checks
+        keep answering mid-drain, so an orchestrator can watch the
+        drain instead of flying blind). Call ``shutdown()`` after.
+        ``inflight`` is read cross-thread here — a GIL-atomic int load,
+        the same documented tolerance as the gauge callback's.
+        """
+        self._draining.set()
+        deadline = time.monotonic() + max(timeout, 0.0)
+        while self.admission.inflight > 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        return self.admission.inflight <= 0
 
     def shutdown(self) -> None:
         """Stop accepting, cancel the serve task, close the batcher and

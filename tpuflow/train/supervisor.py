@@ -138,7 +138,7 @@ def _tail(text: str, n: int = 5) -> str:
     return "\n".join((text or "").strip().splitlines()[-n:])
 
 
-def _terminate_gracefully(proc, term_grace: float) -> str:
+def terminate_gracefully(proc, term_grace: float) -> str:
     """SIGTERM, a bounded grace period, then SIGKILL.
 
     An immediate SIGKILL would deny a stalled-but-salvageable child its
@@ -149,6 +149,10 @@ def _terminate_gracefully(proc, term_grace: float) -> str:
     ignores it gets the axe. Returns which signal actually ended it
     ("sigterm" | "sigkill"; "sigkill" directly when term_grace <= 0) so
     the failure record says whether teardown ran.
+
+    Public: the runtime supervisor (tpuflow/runtime/) reuses this exact
+    escalation for its process-backed services — one teardown contract
+    for every child this codebase spawns.
     """
     if term_grace > 0:
         proc.terminate()
@@ -160,6 +164,9 @@ def _terminate_gracefully(proc, term_grace: float) -> str:
     proc.kill()
     proc.wait()
     return "sigkill"
+
+
+_terminate_gracefully = terminate_gracefully  # pre-rename internal alias
 
 
 def _run_attempt(
@@ -328,6 +335,16 @@ def supervise(
         # resumed attempt that dies before completing anything must read
         # as "same epoch again", not "no progress file".
         progress_path = os.path.join(run_dir, "progress.json")
+        # The fault-cursor sentinel: TPUFLOW_FAULTS_CURSOR=auto means
+        # "persist env-fault firing state next to my progress file" —
+        # resolved here because only the supervisor owns a run
+        # directory. Opt-in on purpose: the crash-loop drills DEPEND on
+        # an env fault re-firing in every attempt, so the default
+        # (unset) keeps env faults stateless across restarts.
+        if child_env.get("TPUFLOW_FAULTS_CURSOR") == "auto":
+            child_env["TPUFLOW_FAULTS_CURSOR"] = os.path.join(
+                run_dir, "faults-cursor.json"
+            )
         for attempt in range(1, max_restarts + 2):
             attempt_spec = dict(spec)
             attempt_spec["progress_path"] = progress_path
