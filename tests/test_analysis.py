@@ -1743,3 +1743,398 @@ class TestConcurrencyPrecision:
         assert rc == 2
         err = capsys.readouterr().err
         assert "typo_baseline.json" in err and "unreadable" in err
+
+
+# ---------------------------------------------------------------------
+# pass 6: the repo-wide storage-contract analyzer (TPF019-TPF021)
+# ---------------------------------------------------------------------
+
+STORAGE_RACY_SOURCE = textwrap.dedent("""\
+    '''Seeded storage-contract fixture: three planted defects.'''
+
+    import json
+    import os
+
+
+    def publish_report(path, report):
+        with open(path, "w") as f:  # PLANTED: TPF019 direct open
+            json.dump(report, f)
+
+
+    def promote_artifact(tmp, live):
+        os.replace(tmp, live)  # PLANTED: TPF020 rename publish
+
+
+    def bump_counter(path):
+        with open(path) as f:  # the read half of the RMW pair
+            doc = json.load(f)
+        doc["n"] += 1
+        with open(path, "w") as f:  # PLANTED: TPF021 in-place rewrite
+            json.dump(doc, f)
+""")
+
+STORAGE_TIDY_SOURCE = textwrap.dedent("""\
+    '''The seam-correct twin: same three jobs, zero findings.'''
+
+    from tpuflow.storage import read_json, write_json
+    from tpuflow.storage.local import replace_file
+    from tpuflow.utils.paths import atomic_write_json
+
+
+    def publish_report(path, report):
+        write_json(path, report)  # atomic publish through the seam
+
+
+    def promote_artifact(tmp, live):
+        replace_file(tmp, live)  # the audited local-move seam
+
+
+    def bump_counter(path):
+        doc = read_json(path)
+        doc["n"] += 1
+        atomic_write_json(path, doc)  # tmp+fsync+rename, not in-place
+""")
+
+
+class TestStorageAnalyzer:
+    def _analyze(self, tmp_path, sources: dict):
+        from tpuflow.analysis.concurrency import build_index
+        from tpuflow.analysis.storage import analyze_index
+
+        for name, src in sources.items():
+            dest = tmp_path / name
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            dest.write_text(src)
+        return analyze_index(build_index(str(tmp_path)))
+
+    def test_seeded_defects_all_flagged_with_file_line(self, tmp_path):
+        findings = self._analyze(
+            tmp_path, {"leaky.py": STORAGE_RACY_SOURCE}
+        )
+        assert {f.rule for f in findings} == {
+            "TPF019", "TPF020", "TPF021"
+        }
+        planted = {
+            rule: _planted_line(STORAGE_RACY_SOURCE, f"PLANTED: {rule}")
+            for rule in ("TPF019", "TPF020", "TPF021")
+        }
+        lines_by_rule: dict = {}
+        for f in findings:
+            lines_by_rule.setdefault(f.rule, []).append(f.line)
+        for rule, line in planted.items():
+            assert line in lines_by_rule[rule], rule
+        # The RMW function's read half is itself direct path I/O — one
+        # extra TPF019 on the read line, nothing else.
+        read_line = _planted_line(
+            STORAGE_RACY_SOURCE, "the read half of the RMW pair"
+        )
+        assert sorted(lines_by_rule["TPF019"]) == sorted(
+            [planted["TPF019"], read_line]
+        )
+        for f in findings:
+            d = f.diagnostic()
+            assert d.where == f"{f.path}:{f.line}"
+            assert "leaky.py" in d.where
+        by_rule = {f.rule: f for f in findings}
+        assert by_rule["TPF020"].subject == "os.replace"
+        assert by_rule["TPF021"].subject == "path"
+
+    def test_seam_correct_twin_is_silent(self, tmp_path):
+        assert self._analyze(
+            tmp_path, {"tidy.py": STORAGE_TIDY_SOURCE}
+        ) == []
+
+    def test_twin_does_not_contaminate_cross_file_index(self, tmp_path):
+        findings = self._analyze(tmp_path, {
+            "leaky.py": STORAGE_RACY_SOURCE,
+            "tidy.py": STORAGE_TIDY_SOURCE,
+        })
+        assert findings and all(f.rel == "leaky.py" for f in findings)
+
+    def test_noqa_suppression_parity(self, tmp_path):
+        src = STORAGE_RACY_SOURCE.replace(
+            '  # PLANTED: TPF019 direct open', "  # noqa: TPF019"
+        )
+        findings = self._analyze(tmp_path, {"leaky.py": src})
+        planted19 = _planted_line(
+            STORAGE_RACY_SOURCE, "PLANTED: TPF019"
+        )
+        assert planted19 not in [
+            f.line for f in findings if f.rule == "TPF019"
+        ]
+
+    def test_allow_list_exempts_leaf_modules_but_not_rmw(self, tmp_path):
+        # Under data/ (ingestion: direct reads are the business) the
+        # TPF019/TPF020 findings vanish — but read-modify-write is torn
+        # no matter whose business the file is, so TPF021 stays.
+        findings = self._analyze(
+            tmp_path, {"data/ingest.py": STORAGE_RACY_SOURCE}
+        )
+        assert {f.rule for f in findings} == {"TPF021"}
+
+    def test_seam_package_itself_is_exempt(self, tmp_path):
+        assert self._analyze(
+            tmp_path, {"storage/backend.py": STORAGE_RACY_SOURCE}
+        ) == []
+
+    def test_seam_transaction_escape_hatch_for_rmw(self, tmp_path):
+        # A function that reads a path and hands the rewrite to a seam
+        # writer (atomic publish) is not an in-place tear.
+        findings = self._analyze(tmp_path, {"data/x.py": textwrap.dedent("""\
+            from tpuflow.utils.paths import atomic_write_json
+
+
+            def bump(path):
+                with open(path) as f:
+                    doc = f.read()
+                atomic_write_json(path, {"doc": doc})
+        """)})
+        assert [f.rule for f in findings] == []
+
+    def test_write_then_read_back_is_not_rmw(self, tmp_path):
+        # The log-capture shape (open for write, read the file back
+        # later in the same function) must NOT be TPF021: the read
+        # came second.
+        findings = self._analyze(tmp_path, {"data/x.py": textwrap.dedent("""\
+            def capture(path, cmd):
+                with open(path, "w") as f:
+                    f.write(run(cmd))
+                with open(path) as f:
+                    return f.read()
+        """)})
+        assert [f.rule for f in findings] == []
+
+    def test_tmp_then_rename_discipline_is_not_rmw(self, tmp_path):
+        # Read path, write path.tmp, os.replace(tmp, path): the write
+        # target differs and the final name arrives by rename — the
+        # correct local discipline (TPF020 is separately judged by
+        # module, and data/ is allow-listed).
+        findings = self._analyze(tmp_path, {"data/x.py": textwrap.dedent("""\
+            import os
+
+
+            def bump(path, tmp):
+                with open(path) as f:
+                    doc = f.read()
+                with open(tmp, "w") as f:
+                    f.write(doc + "x")
+                os.replace(tmp, path)
+        """)})
+        assert [f.rule for f in findings] == []
+
+    def test_np_and_shutil_and_path_ops_flagged(self, tmp_path):
+        findings = self._analyze(tmp_path, {"x.py": textwrap.dedent("""\
+            import shutil
+
+            import numpy as np
+
+
+            def save(dst, arr, src, p):
+                np.save(dst, arr)
+                shutil.copyfile(src, dst)
+                p.write_text("hello")
+                p.unlink()
+        """)})
+        assert [f.rule for f in findings] == ["TPF019"] * 4
+        assert {f.subject for f in findings} == {
+            "np.save", "shutil.copyfile", "p.write_text", "p.unlink"
+        }
+
+    def test_json_ops_are_never_flagged_alone(self, tmp_path):
+        # json.dump/load ride a handle some open produced; that open is
+        # the finding (here it is allow-listed away, leaving nothing).
+        findings = self._analyze(tmp_path, {"data/x.py": textwrap.dedent("""\
+            import json
+
+
+            def load(f):
+                return json.load(f)
+        """)})
+        assert findings == []
+
+
+class TestStorageBaseline:
+    def test_round_trip_add_accept_clean_then_stale(self, tmp_path):
+        from tpuflow.analysis.concurrency import build_index
+        from tpuflow.analysis.storage import (
+            STALE_CODE,
+            analyze_index,
+            analyze_repo,
+            write_baseline,
+        )
+
+        (tmp_path / "leaky.py").write_text(STORAGE_RACY_SOURCE)
+        baseline = tmp_path / "storage_baseline.json"
+        diags = analyze_repo(str(tmp_path), baseline_path=None)
+        assert {d.code for d in diags} == {
+            "TPF019", "TPF020", "TPF021"
+        }
+        findings = analyze_index(build_index(str(tmp_path)))
+        write_baseline(str(baseline), findings)
+        assert analyze_repo(
+            str(tmp_path), baseline_path=str(baseline)
+        ) == []
+        # fix the code -> every entry is stale, reported by name
+        (tmp_path / "leaky.py").write_text(STORAGE_TIDY_SOURCE)
+        stale = analyze_repo(str(tmp_path), baseline_path=str(baseline))
+        assert stale and all(d.code == STALE_CODE for d in stale)
+        assert all(d.where == str(baseline) for d in stale)
+
+    def test_reasons_survive_pure_file_moves(self, tmp_path):
+        # Satellite: fingerprints are package-relative, so moving a
+        # file changes them — but regeneration re-attaches an orphaned
+        # justification when exactly one current finding shares the
+        # moved entry's (rule, scope, subject).
+        import json as _json
+
+        from tpuflow.analysis.concurrency import build_index
+        from tpuflow.analysis.storage import (
+            analyze_index,
+            load_baseline,
+            write_baseline,
+        )
+
+        (tmp_path / "leaky.py").write_text(STORAGE_RACY_SOURCE)
+        baseline = tmp_path / "b.json"
+        findings = analyze_index(build_index(str(tmp_path)))
+        write_baseline(str(baseline), findings)
+        entries = load_baseline(str(baseline))
+        doc = _json.loads(baseline.read_text())
+        for e in doc["entries"]:
+            e["reason"] = f"justified: {e['rule']} at {e['scope']}"
+        baseline.write_text(_json.dumps(doc))
+        # Pure move: same sources, new path (leaky.py -> moved/leaky.py)
+        (tmp_path / "leaky.py").unlink()
+        moved = tmp_path / "moved" / "leaky.py"
+        moved.parent.mkdir()
+        moved.write_text(STORAGE_RACY_SOURCE)
+        reasons = {
+            (e["rule"], e["file"], e["scope"], e["subject"]): e["reason"]
+            for e in load_baseline(str(baseline))
+        }
+        new_findings = analyze_index(build_index(str(tmp_path)))
+        write_baseline(str(baseline), new_findings, reasons)
+        kept = load_baseline(str(baseline))
+        assert len(kept) == len(entries)
+        for e in kept:
+            assert e["file"].startswith("moved/")
+            assert e["reason"] == (
+                f"justified: {e['rule']} at {e['scope']}"
+            ), "justification lost across a pure file move"
+
+    def test_malformed_baseline_names_file_and_field(self, tmp_path):
+        from tpuflow.analysis.storage import BaselineError, load_baseline
+
+        path = tmp_path / "broken_baseline.json"
+        path.write_text(
+            '{"entries": [{"rule": "TPF016", "file": "x.py", '
+            '"scope": "f", "subject": "open", "reason": "ok"}]}'
+        )
+        with pytest.raises(BaselineError) as e:
+            load_baseline(str(path))
+        # TPF016 is a CONCURRENCY rule: each pass validates its own
+        # rule namespace, so cross-pass contamination is loud.
+        assert "unknown rule code 'TPF016'" in str(e.value)
+        assert "broken_baseline.json" in str(e.value)
+        assert isinstance(e.value, ValueError)
+
+    def test_committed_baseline_is_schema_clean_and_justified(self):
+        import os
+
+        from tpuflow.analysis.concurrency import default_root
+        from tpuflow.analysis.storage import (
+            default_baseline_path,
+            load_baseline,
+        )
+
+        path = default_baseline_path(default_root())
+        assert os.path.exists(path)
+        entries = load_baseline(path)
+        assert entries, "the seeded baseline documents the leaf sites"
+        for e in entries:
+            assert e["rule"] in ("TPF019", "TPF020", "TPF021")
+            assert "TODO" not in e["reason"]
+
+
+class TestStorageGate:
+    def test_self_storage_gate_package_is_clean(self):
+        """The repo-wide storage gate: zero unbaselined TPF019-TPF021
+        findings (and zero stale baseline entries) across tpuflow/.
+        New framework code that opens files directly, publishes by
+        rename outside the seam, or rewrites a shared file in place
+        fails tier-1 right here."""
+        from tpuflow.analysis.storage import analyze_repo
+
+        diags = analyze_repo()
+        assert diags == [], "\n".join(d.render() for d in diags)
+
+    def test_both_passes_share_one_walk(self, tmp_path):
+        # The PR's refactor contract: ONE build_index call feeds both
+        # repo-wide passes (file ops are recorded during the
+        # concurrency walk; the storage pass only classifies them).
+        from tpuflow.analysis import concurrency, storage
+
+        (tmp_path / "leaky.py").write_text(STORAGE_RACY_SOURCE)
+        (tmp_path / "racy.py").write_text(RACY_SOURCE)
+        index = concurrency.build_index(str(tmp_path))
+        c = concurrency.analyze_index(index)
+        s = storage.analyze_index(index)
+        assert {f.rule for f in c} == {"TPF016", "TPF017", "TPF018"}
+        assert {f.rule for f in s} == {"TPF019", "TPF020", "TPF021"}
+
+    def test_file_ops_recorded_during_concurrency_walk(self, tmp_path):
+        from tpuflow.analysis.concurrency import build_index
+
+        (tmp_path / "x.py").write_text(textwrap.dedent("""\
+            import os
+
+
+            def f(a, b):
+                open(a).read()
+                os.replace(a, b)
+        """))
+        index = build_index(str(tmp_path))
+        (fn,) = [
+            f for f in index.all_functions() if f.file_ops
+        ]
+        kinds = [op.kind for op in fn.file_ops]
+        assert kinds == ["open", "rename"]
+
+    def test_repo_cli_passes_flag_and_exit_codes(self, tmp_path, capsys):
+        from tpuflow.analysis.__main__ import main
+
+        (tmp_path / "leaky.py").write_text(STORAGE_RACY_SOURCE)
+        # storage findings -> 1; the concurrency pass stays clean
+        assert main(["repo", str(tmp_path)]) == 1
+        out = capsys.readouterr().out
+        assert "concurrency-clean" in out
+        assert "TPF019" in out and "TPF020" in out and "TPF021" in out
+        # single-pass selection
+        assert main(
+            ["repo", str(tmp_path), "--passes", "concurrency"]
+        ) == 0
+        assert "concurrency-clean" in capsys.readouterr().out
+        # --baseline accepts per-pass -> rerun clean
+        assert main(
+            ["repo", str(tmp_path), "--passes", "storage", "--baseline"]
+        ) == 0
+        capsys.readouterr()
+        assert main(["repo", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "concurrency-clean" in out and "storage-clean" in out
+        # --json merges pass findings
+        (tmp_path / "storage_baseline.json").unlink()
+        assert main(["repo", str(tmp_path), "--json"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert {f["code"] for f in doc["findings"]} == {
+            "TPF019", "TPF020", "TPF021"
+        }
+        # malformed storage baseline -> 2, file named
+        (tmp_path / "storage_baseline.json").write_text("[]")
+        assert main(["repo", str(tmp_path)]) == 2
+        assert "top level must be an object" in capsys.readouterr().err
+        # unknown pass name -> 2
+        assert main(
+            ["repo", str(tmp_path), "--passes", "nope"]
+        ) == 2
+        assert "unknown pass" in capsys.readouterr().err

@@ -421,6 +421,57 @@ def test_analysis_repo_subprocess(tmp_path):
     assert "Traceback" not in bad.stderr
 
 
+def test_analysis_repo_storage_subprocess(tmp_path):
+    """python -m tpuflow.analysis repo --passes storage: the repo-wide
+    storage-contract pass as a REAL subprocess — exit 0 on the package
+    (the committed baseline covers the justified leaf sites), exit 1 on
+    a seeded fixture naming all three planted defects with file:line,
+    exit 2 on a malformed storage baseline."""
+    import json
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    gate = subprocess.run(
+        [sys.executable, "-m", "tpuflow.analysis", "repo",
+         "--passes", "storage"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=240,
+    )
+    assert gate.returncode == 0, gate.stdout + gate.stderr[-2000:]
+    assert "storage-clean" in gate.stdout
+
+    from test_analysis import STORAGE_RACY_SOURCE, _planted_line
+
+    (tmp_path / "leaky.py").write_text(STORAGE_RACY_SOURCE)
+    seeded = subprocess.run(
+        [sys.executable, "-m", "tpuflow.analysis", "repo", str(tmp_path),
+         "--passes", "storage", "--json"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=240,
+    )
+    assert seeded.returncode == 1, seeded.stderr[-2000:]
+    doc = json.loads(seeded.stdout)
+    wheres_by_code: dict = {}
+    for f in doc["findings"]:
+        wheres_by_code.setdefault(f["code"], []).append(f["where"])
+    assert set(wheres_by_code) == {"TPF019", "TPF020", "TPF021"}
+    for code in ("TPF019", "TPF020", "TPF021"):
+        line = _planted_line(STORAGE_RACY_SOURCE, f"PLANTED: {code}")
+        assert any(
+            w.endswith(f"leaky.py:{line}") for w in wheres_by_code[code]
+        ), code
+
+    (tmp_path / "storage_baseline.json").write_text(
+        '{"entries": [{"rule": "TPF099"}]}'
+    )
+    bad = subprocess.run(
+        [sys.executable, "-m", "tpuflow.analysis", "repo", str(tmp_path),
+         "--passes", "storage"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=240,
+    )
+    assert bad.returncode == 2
+    assert "storage_baseline.json" in bad.stderr
+    assert "Traceback" not in bad.stderr
+
+
 def test_runtime_soak_subprocess(tmp_path):
     """ISSUE 16 satellite: ``python -m tpuflow.runtime soak spec.json``
     in a REAL subprocess — the full day-in-the-life wiring (supervisor,

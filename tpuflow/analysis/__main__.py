@@ -15,11 +15,14 @@ the target device count for plan checking without touching a backend —
 nothing here compiles, allocates, or initializes accelerator state.
 ``--lint`` runs the framework linter over ``tpuflow`` (or PATH).
 
-``repo`` is the repo-wide concurrency pass (TPF016–TPF018,
-``tpuflow/analysis/concurrency.py``): findings minus the committed
-baseline, ``--json`` for machine output, ``--baseline`` to accept the
-current findings into the baseline file (existing justifications are
-preserved per fingerprint).
+``repo`` runs the repo-wide passes — concurrency (TPF016–TPF018,
+``tpuflow/analysis/concurrency.py``) and storage (TPF019–TPF021,
+``tpuflow/analysis/storage.py``) — over ONE shared AST walk:
+findings minus each pass's committed baseline,
+``--passes concurrency,storage`` to select, ``--json`` for machine
+output, ``--baseline`` to accept the current findings into each
+selected pass's baseline file (existing justifications are preserved
+per fingerprint, and survive pure file moves).
 
 Exit status: 0 when no pass reported an error, 1 otherwise, 2 for
 unusable inputs (missing/unparseable spec file, malformed baseline,
@@ -34,73 +37,123 @@ import sys
 
 
 def _repo_main(argv: list[str]) -> int:
-    """The ``repo`` subcommand: repo-wide concurrency analysis."""
+    """The ``repo`` subcommand: repo-wide static analysis passes."""
     import os
 
-    from tpuflow.analysis import concurrency
+    from tpuflow.analysis import concurrency, storage
+    from tpuflow.analysis.baseline import BaselineError
+
+    # Pass registry, in gate order. Both passes ride ONE AST walk
+    # (concurrency.build_index); storage only classifies the FileOps
+    # that walk already recorded.
+    passes = {"concurrency": concurrency, "storage": storage}
 
     ap = argparse.ArgumentParser(
         prog="python -m tpuflow.analysis repo",
-        description="repo-wide concurrency analysis (TPF016-TPF018): "
-                    "lock-discipline race detection over the package",
+        description="repo-wide static analysis: concurrency "
+                    "(TPF016-TPF018 lock discipline) and storage "
+                    "(TPF019-TPF021 storage contract) over the package",
     )
     ap.add_argument("root", nargs="?", default=None, metavar="ROOT",
                     help="directory to analyze (default: the installed "
                          "tpuflow package)")
+    ap.add_argument("--passes", default="concurrency,storage",
+                    metavar="NAMES",
+                    help="comma-separated pass list: concurrency, "
+                         "storage (default: both)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable findings on stdout")
     ap.add_argument("--baseline", action="store_true",
-                    help="accept the current findings into the baseline "
-                         "file (existing entries keep their reasons; "
-                         "new ones get a TODO placeholder to edit)")
+                    help="accept the current findings into each selected "
+                         "pass's baseline file (existing entries keep "
+                         "their reasons; new ones get a TODO placeholder "
+                         "to edit)")
     ap.add_argument("--baseline-file", default=None, metavar="PATH",
-                    help="baseline path (default: "
-                         "<ROOT>/analysis/concurrency_baseline.json "
-                         "when ROOT has an analysis/ dir, else "
-                         "<ROOT>/concurrency_baseline.json)")
+                    help="baseline path override (default: each pass's "
+                         "<ROOT>/analysis/<pass>_baseline.json when ROOT "
+                         "has an analysis/ dir, else flat). Pair with a "
+                         "single --passes value: one file holds one "
+                         "pass's rules.")
     args = ap.parse_args(argv)
+
+    selected = []
+    for name in args.passes.split(","):
+        name = name.strip()
+        if not name:
+            continue
+        if name not in passes:
+            print(
+                f"repo: unknown pass {name!r} "
+                f"(expected: {', '.join(passes)})",
+                file=sys.stderr,
+            )
+            return 2
+        if name not in selected:
+            selected.append(name)
+    if not selected:
+        print("repo: --passes selected nothing", file=sys.stderr)
+        return 2
 
     root = args.root or concurrency.default_root()
     if not os.path.isdir(root):
         print(f"repo: {root}: not a directory", file=sys.stderr)
         return 2
     explicit_baseline = args.baseline_file is not None
-    baseline_file = (
-        args.baseline_file or concurrency.default_baseline_path(root)
-    )
+    index = concurrency.build_index(root)
     try:
         if args.baseline:
-            findings = concurrency.analyze_index(
-                concurrency.build_index(root)
-            )
-            reasons = {}
-            if os.path.exists(baseline_file):
-                reasons = {
-                    concurrency._baseline_key(e): e["reason"]
-                    for e in concurrency.load_baseline(baseline_file)
-                }
-            n = concurrency.write_baseline(
-                baseline_file, findings, reasons
-            )
-            print(
-                f"repo: accepted {n} finding(s) into {baseline_file} "
-                "(edit each TODO reason into a real justification)"
-            )
+            for name in selected:
+                mod = passes[name]
+                baseline_file = (
+                    args.baseline_file
+                    or mod.default_baseline_path(root)
+                )
+                findings = mod.analyze_index(index)
+                reasons = {}
+                if os.path.exists(baseline_file):
+                    reasons = {
+                        mod._baseline_key(e): e["reason"]
+                        for e in mod.load_baseline(baseline_file)
+                    }
+                n = mod.write_baseline(baseline_file, findings, reasons)
+                print(
+                    f"repo: accepted {n} {name} finding(s) into "
+                    f"{baseline_file} (edit each TODO reason into a "
+                    "real justification)"
+                )
             return 0
         # An EXPLICIT --baseline-file is a contract: if it cannot be
         # loaded, fail loudly (load_baseline raises "unreadable") —
         # silently analyzing without the user's baseline would report
-        # "concurrency-clean" while skipping stale-entry checking. Only
+        # "<pass>-clean" while skipping stale-entry checking. Only
         # the implicit default path may be legitimately absent.
-        diags = concurrency.analyze_repo(
-            root,
-            baseline_path=(
-                baseline_file
-                if explicit_baseline or os.path.exists(baseline_file)
-                else None
-            ),
-        )
-    except concurrency.BaselineError as e:
+        diags = []
+        for name in selected:
+            mod = passes[name]
+            baseline_file = (
+                args.baseline_file or mod.default_baseline_path(root)
+            )
+            pass_diags = mod.analyze_repo(
+                root,
+                baseline_path=(
+                    baseline_file
+                    if explicit_baseline or os.path.exists(baseline_file)
+                    else None
+                ),
+                index=index,
+            )
+            if not args.json:
+                if pass_diags:
+                    print(
+                        f"repo: {len(pass_diags)} {name} finding(s) "
+                        f"in {root}"
+                    )
+                    for d in pass_diags:
+                        print(f"  {d.render()}")
+                else:
+                    print(f"repo OK: {root} is {name}-clean")
+            diags.extend(pass_diags)
+    except BaselineError as e:
         print(f"repo: {e}", file=sys.stderr)
         return 2
     if args.json:
@@ -115,12 +168,6 @@ def _repo_main(argv: list[str]) -> int:
                 for d in diags
             ],
         }, indent=2))
-    elif diags:
-        print(f"repo: {len(diags)} concurrency finding(s) in {root}")
-        for d in diags:
-            print(f"  {d.render()}")
-    else:
-        print(f"repo OK: {root} is concurrency-clean")
     return 1 if diags else 0
 
 

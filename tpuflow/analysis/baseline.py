@@ -1,0 +1,127 @@
+"""Shared baseline machinery for the repo-wide analyzer passes.
+
+Both whole-repo passes — concurrency (TPF016–018) and storage
+(TPF019–021) — accept triaged findings through the same committed-file
+workflow: entries are fingerprinted ``(rule, file, scope, subject)``
+with NO line numbers (they survive unrelated edits), every entry
+carries a one-line justification, and an entry whose finding no longer
+exists is itself reported (stale-entry hygiene). This module is the one
+implementation of that contract; the passes bind their own rule tables
+and baseline filenames.
+
+Fingerprints are **package-relative** (the ``file`` field is the
+/-normalized path under the analysis root), and regeneration
+(``write_baseline``) preserves justifications across pure file moves: a
+reason whose fingerprint matches a current finding exactly is carried
+verbatim, and a reason orphaned by a rename is re-attached when exactly
+one current finding shares its ``(rule, scope, subject)`` — the
+function moved, the accepted hazard did not.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+class BaselineError(ValueError):
+    """A malformed baseline file. Loud by design (the utils/env.py
+    posture): names the file and the offending entry/field."""
+
+
+def baseline_key(entry: dict) -> tuple:
+    """The line-free fingerprint of one accepted finding."""
+    return (entry["rule"], entry["file"], entry["scope"], entry["subject"])
+
+
+def load_baseline(path: str, known_rules) -> list[dict]:
+    """Parse + validate a baseline; returns its entries. Raises
+    :class:`BaselineError` naming the file and field on anything
+    malformed — a baseline that silently half-loads would silently
+    un-suppress (or worse, un-report) findings. ``known_rules`` is the
+    calling pass's rule table; an entry naming any other code is
+    malformed."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except OSError as e:
+        raise BaselineError(f"baseline {path}: unreadable ({e})") from e
+    except json.JSONDecodeError as e:
+        raise BaselineError(
+            f"baseline {path}: not valid JSON ({e})"
+        ) from e
+    if not isinstance(doc, dict):
+        raise BaselineError(
+            f"baseline {path}: top level must be an object, got "
+            f"{type(doc).__name__}"
+        )
+    entries = doc.get("entries")
+    if not isinstance(entries, list):
+        raise BaselineError(
+            f"baseline {path}: field 'entries' must be a list, got "
+            f"{type(entries).__name__}"
+        )
+    for i, entry in enumerate(entries):
+        if not isinstance(entry, dict):
+            raise BaselineError(
+                f"baseline {path}: entries[{i}] must be an object, got "
+                f"{type(entry).__name__}"
+            )
+        for key in ("rule", "file", "scope", "subject", "reason"):
+            value = entry.get(key)
+            if not isinstance(value, str) or not value.strip():
+                raise BaselineError(
+                    f"baseline {path}: entries[{i}] field {key!r} must "
+                    "be a non-empty string (every accepted finding "
+                    "carries a one-line justification)"
+                )
+        if entry["rule"] not in known_rules:
+            raise BaselineError(
+                f"baseline {path}: entries[{i}] names unknown rule code "
+                f"{entry['rule']!r} (valid: "
+                f"{', '.join(sorted(known_rules))})"
+            )
+    return entries
+
+
+def write_baseline(path: str, findings, reasons: dict | None = None,
+                   *, comment: str) -> int:
+    """(Re)write a baseline accepting every current finding (objects
+    with ``fingerprint``/``rule``/``rel``/``scope``/``subject``).
+
+    Reasons from an existing baseline are preserved per fingerprint;
+    a reason whose file component went stale (the function moved files)
+    follows it when exactly one current finding shares its
+    ``(rule, scope, subject)``. New entries get a placeholder the owner
+    must edit into a real justification."""
+    reasons = reasons or {}
+    # Rename-robust fallback: reasons indexed by the file-free remainder
+    # of the fingerprint. Only an UNAMBIGUOUS match may travel — two
+    # same-shaped findings in different files keep their own triage.
+    moved: dict[tuple, list[str]] = {}
+    for key, reason in reasons.items():
+        rule, _file, scope, subject = key
+        moved.setdefault((rule, scope, subject), []).append(reason)
+    seen = set()
+    entries = []
+    for f in findings:
+        if f.fingerprint in seen:
+            continue
+        seen.add(f.fingerprint)
+        reason = reasons.get(f.fingerprint)
+        if reason is None:
+            candidates = moved.get((f.rule, f.scope, f.subject), [])
+            if len(candidates) == 1:
+                reason = candidates[0]
+        entries.append({
+            "rule": f.rule,
+            "file": f.rel,
+            "scope": f.scope,
+            "subject": f.subject,
+            "reason": reason
+            or "TODO: replace with a one-line justification",
+        })
+    doc = {"version": 1, "comment": comment, "entries": entries}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    return len(entries)

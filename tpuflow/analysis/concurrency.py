@@ -74,10 +74,13 @@ and the tier-1 self-analysis gate (zero unbaselined findings over
 from __future__ import annotations
 
 import ast
-import json
 import os
 from dataclasses import dataclass, field
 
+from tpuflow.analysis.baseline import BaselineError  # noqa: F401 (re-export)
+from tpuflow.analysis.baseline import baseline_key as _baseline_key
+from tpuflow.analysis.baseline import load_baseline as _load_baseline
+from tpuflow.analysis.baseline import write_baseline as _write_baseline
 from tpuflow.analysis.diagnostics import Diagnostic
 from tpuflow.analysis.linter import _noqa_lines
 
@@ -150,6 +153,18 @@ _CALLBACK_KWARGS = {
 _HANDLER_PREFIXES = ("do_",)
 _HANDLER_NAMES = {"handle", "handle_one_request", "process_request"}
 
+# File-op shapes the shared walk records for the storage pass. Kept
+# deliberately syntactic — the storage pass classifies, this walk only
+# observes. ``.replace`` is NOT a path op unless rooted at ``os`` (str
+# .replace is everywhere); ``.rename`` has no str/dict collision, so an
+# attribute ``.rename`` is recorded (Path.rename is rename-as-publish).
+_OS_RENAMES = {"replace", "rename", "renames"}
+_PATH_WRITES = {"write_text", "write_bytes"}
+_PATH_READS = {"read_text", "read_bytes"}
+_PATH_FS = {"unlink", "glob", "rglob"}
+_NP_IO = {"save", "load", "savez", "savez_compressed"}
+_JSON_IO = {"dump", "load"}
+
 
 # ---------------------------------------------------------------------
 # the index
@@ -194,6 +209,32 @@ class ThreadSpawn:
 
 
 @dataclass
+class FileOp:
+    """One filesystem touchpoint, recorded raw during the shared walk.
+
+    The storage pass (tpuflow/analysis/storage.py, TPF019–021) owns the
+    CLASSIFICATION; this index only records what it saw. ``kind``:
+
+    - ``open``        — ``open(...)`` / ``<x>.open(...)``
+    - ``rename``      — ``os.replace``/``os.rename``/``os.renames``,
+                        ``shutil.move``, ``<path>.rename(...)``
+    - ``path_write``  — ``<x>.write_text``/``write_bytes``
+    - ``path_read``   — ``<x>.read_text``/``read_bytes``
+    - ``path_fs``     — ``<x>.unlink``/``glob``/``rglob``
+    - ``np``          — ``np.save``/``load``/``savez[_compressed]``
+    - ``json``        — ``json.dump``/``json.load`` (handle-mediated:
+                        read-modify-write evidence, never flagged alone)
+    - ``shutil``      — any other ``shutil.*`` call
+    """
+
+    kind: str
+    what: str  # rendered callable, e.g. "os.replace", "open"
+    target: str  # rendered path expression ("" when not resolvable)
+    mode: str  # open()'s literal mode string when constant, else ""
+    line: int
+
+
+@dataclass
 class FuncInfo:
     qual: str  # "Class.method", "func", "Class.__init__.<lambda>"
     name: str
@@ -206,6 +247,7 @@ class FuncInfo:
     blocking: list = field(default_factory=list)  # BlockingCall
     cond_waits: list = field(default_factory=list)  # CondWait
     spawns: list = field(default_factory=list)  # ThreadSpawn
+    file_ops: list = field(default_factory=list)  # FileOp (storage pass)
     is_entry: bool = False
 
     @property
@@ -668,6 +710,10 @@ class _FunctionScanner:
         # TPF017 blocking shapes
         self._record_blocking(node, func, name, held)
 
+        # storage-pass raw material (TPF019–021): every filesystem
+        # touchpoint, recorded during this same walk
+        self._record_file_op(node, func, name)
+
         # TPF018a condition waits
         if name == "wait" and isinstance(func, ast.Attribute):
             recv = func.value
@@ -708,6 +754,68 @@ class _FunctionScanner:
         if what is not None:
             self.info.blocking.append(BlockingCall(
                 what=what, line=node.lineno, locks=held,
+            ))
+
+    def _record_file_op(self, node, func, name) -> None:
+        """Record one filesystem touchpoint (see :class:`FileOp`)."""
+        root = _root_name(func) if isinstance(func, ast.Attribute) else None
+        is_attr = isinstance(func, ast.Attribute)
+        kind = None
+        target = ""
+        mode = ""
+        if name == "open" and (isinstance(func, ast.Name) or is_attr):
+            kind = "open"
+            if node.args:
+                target = _render(node.args[0]) if not is_attr else ""
+            if is_attr:
+                target = _render(func.value)
+            for i, arg in enumerate(node.args):
+                if i == (1 if not is_attr else 0) and isinstance(
+                    arg, ast.Constant
+                ) and isinstance(arg.value, str):
+                    mode = arg.value
+            for kw in node.keywords:
+                if kw.arg == "mode" and isinstance(
+                    kw.value, ast.Constant
+                ) and isinstance(kw.value.value, str):
+                    mode = kw.value.value
+        elif root == "os" and name in _OS_RENAMES:
+            kind = "rename"
+            if node.args:
+                target = _render(node.args[-1])  # the destination
+        elif root == "shutil" and name == "move":
+            kind = "rename"
+            if len(node.args) >= 2:
+                target = _render(node.args[1])
+        elif is_attr and name == "rename" and root != "os":
+            kind = "rename"
+            target = _render(node.args[0]) if node.args else ""
+        elif root == "shutil":
+            kind = "shutil"
+            if node.args:
+                target = _render(node.args[0])
+        elif root in ("np", "numpy") and name in _NP_IO:
+            kind = "np"
+            if node.args:
+                target = _render(node.args[0])
+        elif root == "json" and name in _JSON_IO:
+            kind = "json"
+            idx = 1 if name == "dump" else 0
+            if len(node.args) > idx:
+                target = _render(node.args[idx])
+        elif is_attr and name in _PATH_WRITES:
+            kind = "path_write"
+            target = _render(func.value)
+        elif is_attr and name in _PATH_READS:
+            kind = "path_read"
+            target = _render(func.value)
+        elif is_attr and name in _PATH_FS:
+            kind = "path_fs"
+            target = _render(func.value)
+        if kind is not None:
+            self.info.file_ops.append(FileOp(
+                kind=kind, what=_render(func), target=target, mode=mode,
+                line=node.lineno,
             ))
 
     def _mark_entry(self, arg) -> None:
@@ -1275,100 +1383,35 @@ def _nested_of(fam) -> list:
 # ---------------------------------------------------------------------
 
 
-class BaselineError(ValueError):
-    """A malformed baseline file. Loud by design (the utils/env.py
-    posture): names the file and the offending entry/field."""
+# The one baseline implementation lives in tpuflow/analysis/baseline.py
+# (shared with the storage pass); these bindings keep this module's
+# public surface — tests and the CLI import from here.
+
+_BASELINE_COMMENT = (
+    "Triaged-accepted concurrency findings "
+    "(python -m tpuflow.analysis repo --baseline). Entries are "
+    "fingerprinted (rule, file, scope, subject) — no line "
+    "numbers, so they survive unrelated edits. Every entry "
+    "carries a one-line justification; stale entries (finding "
+    "gone) are reported and must be pruned."
+)
 
 
 def load_baseline(path: str) -> list[dict]:
-    """Parse + validate the baseline; returns its entries. Raises
-    :class:`BaselineError` naming the file and field on anything
-    malformed — a baseline that silently half-loads would silently
-    un-suppress (or worse, un-report) findings."""
-    try:
-        with open(path, encoding="utf-8") as f:
-            doc = json.load(f)
-    except OSError as e:
-        raise BaselineError(f"baseline {path}: unreadable ({e})") from e
-    except json.JSONDecodeError as e:
-        raise BaselineError(
-            f"baseline {path}: not valid JSON ({e})"
-        ) from e
-    if not isinstance(doc, dict):
-        raise BaselineError(
-            f"baseline {path}: top level must be an object, got "
-            f"{type(doc).__name__}"
-        )
-    entries = doc.get("entries")
-    if not isinstance(entries, list):
-        raise BaselineError(
-            f"baseline {path}: field 'entries' must be a list, got "
-            f"{type(entries).__name__}"
-        )
-    for i, entry in enumerate(entries):
-        if not isinstance(entry, dict):
-            raise BaselineError(
-                f"baseline {path}: entries[{i}] must be an object, got "
-                f"{type(entry).__name__}"
-            )
-        for key in ("rule", "file", "scope", "subject", "reason"):
-            value = entry.get(key)
-            if not isinstance(value, str) or not value.strip():
-                raise BaselineError(
-                    f"baseline {path}: entries[{i}] field {key!r} must "
-                    "be a non-empty string (every accepted finding "
-                    "carries a one-line justification)"
-                )
-        if entry["rule"] not in RULES:
-            raise BaselineError(
-                f"baseline {path}: entries[{i}] names unknown rule code "
-                f"{entry['rule']!r} (valid: {', '.join(sorted(RULES))})"
-            )
-    return entries
+    """Parse + validate the concurrency baseline (see
+    :mod:`tpuflow.analysis.baseline`); raises :class:`BaselineError`
+    naming the file and field on anything malformed."""
+    return _load_baseline(path, RULES)
 
 
 def write_baseline(path: str, findings: list[Finding],
                    reasons: dict | None = None) -> int:
-    """(Re)write the baseline accepting every current finding. Reasons
-    from an existing baseline are preserved per fingerprint; new entries
-    get a placeholder the owner must edit into a real justification."""
-    reasons = reasons or {}
-    seen = set()
-    entries = []
-    for f in findings:
-        if f.fingerprint in seen:
-            continue
-        seen.add(f.fingerprint)
-        entries.append({
-            "rule": f.rule,
-            "file": f.rel,
-            "scope": f.scope,
-            "subject": f.subject,
-            "reason": reasons.get(
-                f.fingerprint,
-                "TODO: replace with a one-line justification",
-            ),
-        })
-    doc = {
-        "version": 1,
-        "comment": (
-            "Triaged-accepted concurrency findings "
-            "(python -m tpuflow.analysis repo --baseline). Entries are "
-            "fingerprinted (rule, file, scope, subject) — no line "
-            "numbers, so they survive unrelated edits. Every entry "
-            "carries a one-line justification; stale entries (finding "
-            "gone) are reported and must be pruned."
-        ),
-        "entries": entries,
-    }
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump(doc, f, indent=2)
-        f.write("\n")
-    return len(entries)
-
-
-def _baseline_key(entry: dict) -> tuple:
-    return (entry["rule"], entry["file"], entry["scope"], entry["subject"])
+    """(Re)write the baseline accepting every current finding; reasons
+    survive regeneration (and pure file moves — see
+    :func:`tpuflow.analysis.baseline.write_baseline`)."""
+    return _write_baseline(
+        path, findings, reasons, comment=_BASELINE_COMMENT
+    )
 
 
 # ---------------------------------------------------------------------
@@ -1395,6 +1438,7 @@ def default_baseline_path(root: str) -> str:
 def analyze_repo(
     root: str | None = None,
     baseline_path: str | None = "auto",
+    index: RepoIndex | None = None,
 ) -> list[Diagnostic]:
     """The gate-shaped entry: analyze ``root`` (default: the installed
     tpuflow package), subtract the baseline, and report the remainder
@@ -1403,12 +1447,15 @@ def analyze_repo(
     ``baseline_path="auto"`` resolves next to the root (and is simply
     skipped when absent); ``None`` disables baselining. A malformed
     baseline raises :class:`BaselineError` — loud, naming file+field.
+    Pass ``index`` to reuse an already-built walk (the CLI builds ONE
+    index for both repo-wide passes).
     """
     root = root or default_root()
     if baseline_path == "auto":
         candidate = default_baseline_path(root)
         baseline_path = candidate if os.path.exists(candidate) else None
-    findings = analyze_index(build_index(root))
+    findings = analyze_index(index if index is not None
+                             else build_index(root))
     entries = load_baseline(baseline_path) if baseline_path else []
     by_key: dict[tuple, dict] = {}
     for e in entries:

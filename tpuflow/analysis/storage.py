@@ -1,0 +1,329 @@
+"""Pass 6 — repo-wide storage-contract analyzer: path I/O discipline.
+
+Rides the SAME index as the concurrency pass (one walk of the package —
+``tpuflow.analysis.concurrency.build_index`` records every filesystem
+touchpoint into ``FuncInfo.file_ops`` while it scans for locks), and
+enforces the object-store seam (``tpuflow/storage/``, docs/storage.md):
+durable bytes move through ``ObjectStore`` / the audited local helpers,
+not through scattered ``open``/``os.replace``/``shutil`` calls. The
+contract exists because the next backend (``gs://``) has **no rename
+and no partial write** — code that quietly assumes POSIX rename today
+is code that cannot be pointed at a bucket tomorrow.
+
+Three rules:
+
+- **TPF019** — direct path I/O outside the seam: ``open(...)``,
+  ``Path.write_*``/``read_*``/``unlink``/``glob``, ``np.save``/
+  ``np.load``, ``shutil.*`` anywhere except the seam itself and a short
+  allow-list of leaf modules whose business IS local files (ingestion,
+  log sinks, the analyzers reading source). ``json.dump``/``load`` are
+  recorded but never flagged alone — they ride a handle some ``open``
+  already produced (that open is the finding).
+- **TPF020** — rename-assumed-atomic publish outside the seam:
+  ``os.replace``/``os.rename``, ``shutil.move``, ``Path.rename``. A
+  rename is the one primitive object stores don't have; every
+  rename-as-publish must live behind the seam (``fsync_write``,
+  ``replace_file``, ``move_tree``) where the storage analyzer — and the
+  gs:// port — can find them all in one place. A TPF020 site is NOT
+  also TPF019 (one defect, one finding).
+- **TPF021** — read-modify-write of a shared file without tmp+rename
+  discipline or a seam transaction: the same function reads path
+  expression ``X`` and writes ``X`` directly (no tmp + ``os.replace``,
+  no ``atomic_write_json``/``write_json``/``put_atomic`` publish). A
+  crash between the read and the in-place write tears the file; a
+  concurrent reader sees the torn middle.
+
+Accepted findings live in ``tpuflow/analysis/storage_baseline.json`` —
+the same fingerprinted, justification-required workflow as the
+concurrency baseline (shared machinery:
+:mod:`tpuflow.analysis.baseline`), including stale-entry hygiene and
+``# noqa: TPF019`` line suppression.
+
+Entry points: ``python -m tpuflow.analysis repo --passes storage`` and
+the tier-1 self-gate in tests/test_analysis.py.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from tpuflow.analysis.baseline import BaselineError  # noqa: F401
+from tpuflow.analysis.baseline import baseline_key as _baseline_key
+from tpuflow.analysis.baseline import load_baseline as _load_baseline
+from tpuflow.analysis.baseline import write_baseline as _write_baseline
+from tpuflow.analysis.concurrency import (
+    FuncInfo,
+    RepoIndex,
+    _named_scope,
+    build_index,
+    default_root,
+)
+from tpuflow.analysis.diagnostics import Diagnostic
+
+_PASS = "storage"
+
+RULES = {
+    "TPF019": "direct path I/O outside the storage seam: durable bytes "
+              "must move through tpuflow.storage (ObjectStore / the "
+              "audited local helpers) or an allow-listed leaf module — "
+              "scattered open/Path/np/shutil calls are exactly the "
+              "sites an object-store backend (no rename, no partial "
+              "write) cannot honor",
+    "TPF020": "rename-assumed-atomic publish outside the seam: "
+              "os.replace/os.rename/shutil.move/Path.rename is the one "
+              "primitive object stores don't have — route it through "
+              "the seam (fsync_write / replace_file / move_tree) or "
+              "publish by pointer promotion",
+    "TPF021": "read-modify-write of a shared file without tmp+rename "
+              "discipline or a seam transaction: a crash between the "
+              "read and the in-place write tears the file, a "
+              "concurrent reader sees the torn middle — write a tmp "
+              "and os.replace it, or publish through "
+              "atomic_write_json/write_json/put_atomic",
+}
+
+# Stale-baseline hygiene code (mirrors the concurrency pass).
+STALE_CODE = "storage.baseline.stale"
+
+# Where direct path I/O is the module's BUSINESS, not a seam violation
+# (matched by /-normalized path prefix under the analysis root):
+#
+# - storage/        the seam itself — every primitive lands here
+# - utils/paths.py  atomic_write_json + the fsspec shim the seam wraps
+# - analysis/       the analyzers read source files and write baselines
+# - data/           CSV/stream ingestion: leaf reads of user datasets
+# - obs/            log/trace/forensics sinks (append-only local files)
+# - utils/logging.py      the metrics JSONL sink
+# - elastic/exchange.py   the FILE transport: its business is the gang
+#                         directory (npz payloads, atomic publishes) —
+#                         the store transport is its seam twin
+# - elastic/membership.py the file transport's heartbeat half
+#
+# Everything else goes through the seam or carries a baseline entry.
+ALLOWED_PREFIXES = (
+    "storage/",
+    "utils/paths.py",
+    "analysis/",
+    "data/",
+    "obs/",
+    "utils/logging.py",
+    "elastic/exchange.py",
+    "elastic/membership.py",
+)
+
+# Callee names that mark a function as publishing through the seam —
+# TPF021's "seam transaction" escape hatch. A function that hands its
+# bytes to one of these is preparing input for an atomic publish, not
+# tearing a shared file in place.
+_SEAM_WRITERS = {
+    "atomic_write_json", "write_json", "put_atomic", "fsync_write",
+    "put", "promote", "replace_file", "write_leaves",
+}
+
+# open() modes that WRITE (r+ included: in-place update is the sharpest
+# TPF021 shape). Default mode is read.
+def _mode_writes(mode: str) -> bool:
+    return any(c in mode for c in "wax+")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One storage finding + its line-free baseline fingerprint."""
+
+    rule: str
+    message: str
+    path: str  # display path
+    rel: str  # /-normalized, root-relative (the fingerprint's file)
+    line: int
+    scope: str  # nearest named enclosing scope
+    subject: str  # the op / path expression the finding is about
+
+    @property
+    def fingerprint(self) -> tuple:
+        return (self.rule, self.rel, self.scope, self.subject)
+
+    def diagnostic(self) -> Diagnostic:
+        return Diagnostic(
+            pass_name=_PASS, code=self.rule,
+            message=f"{self.message} — {RULES[self.rule]}",
+            where=f"{self.path}:{self.line}",
+        )
+
+
+def _allowed(rel: str) -> bool:
+    return any(rel.startswith(p) for p in ALLOWED_PREFIXES)
+
+
+def _uses_seam(fn: FuncInfo) -> bool:
+    return any(name in _SEAM_WRITERS for _kind, name in fn.callees)
+
+
+def analyze_index(index: RepoIndex) -> list[Finding]:
+    """Classify every recorded file op (see the module docstring)."""
+    findings: list[Finding] = []
+    for fn in index.all_functions():
+        mod = fn.module
+        if not fn.file_ops:
+            continue
+        allowed = _allowed(mod.rel)
+        # --- TPF021 evidence tables (built even in allowed modules:
+        # read-modify-write is torn no matter whose business the file
+        # is — only the seam itself is exempt, its helpers ARE the
+        # discipline). Reads carry their earliest line: RMW means the
+        # read came FIRST — a write-then-read-back (log capture) is a
+        # different, harmless shape. ---
+        reads: dict[str, int] = {}
+        rename_dsts: set[str] = set()
+        for op in fn.file_ops:
+            if op.kind == "rename":
+                rename_dsts.add(op.target)
+            elif op.kind == "path_read" or (
+                op.kind == "open" and not _mode_writes(op.mode)
+            ):
+                if op.target and op.line < reads.get(
+                    op.target, op.line + 1
+                ):
+                    reads[op.target] = op.line
+        seam_fn = _uses_seam(fn)
+        for op in fn.file_ops:
+            # TPF020: rename-as-publish — one defect, one finding
+            if op.kind == "rename":
+                if not allowed:
+                    findings.append(Finding(
+                        rule="TPF020",
+                        message=(
+                            f"{op.what}(...) publishes by rename "
+                            "outside the storage seam"
+                        ),
+                        path=mod.path, rel=mod.rel, line=op.line,
+                        scope=_named_scope(fn), subject=op.what,
+                    ))
+                continue
+            # TPF021: in-place rewrite of something this function reads
+            writes_here = (
+                op.kind in ("path_write",)
+                or (op.kind == "open" and _mode_writes(op.mode))
+            )
+            if (
+                writes_here
+                and op.target
+                and reads.get(op.target, op.line + 1) < op.line
+                and op.target not in rename_dsts
+                and not seam_fn
+                and mod.rel.split("/")[0] != "storage"
+            ):
+                findings.append(Finding(
+                    rule="TPF021",
+                    message=(
+                        f"{op.target} is read and rewritten in place "
+                        "in the same function (no tmp+rename, no seam "
+                        "transaction)"
+                    ),
+                    path=mod.path, rel=mod.rel, line=op.line,
+                    scope=_named_scope(fn), subject=op.target,
+                ))
+                continue  # the sharper finding; don't also TPF019 it
+            # TPF019: any other direct path I/O outside the allow-list.
+            # json ops are handle-mediated — the open that produced the
+            # handle is the finding.
+            if op.kind == "json" or allowed:
+                continue
+            findings.append(Finding(
+                rule="TPF019",
+                message=(
+                    f"{op.what}(...) touches the filesystem directly "
+                    "outside the storage seam"
+                ),
+                path=mod.path, rel=mod.rel, line=op.line,
+                scope=_named_scope(fn), subject=op.what,
+            ))
+    # noqa parity with the per-file linter and the concurrency pass
+    findings = [
+        f for f in findings
+        if f.rule not in index.modules[f.rel].noqa.get(f.line, ())
+    ]
+    findings.sort(key=lambda f: (f.rel, f.line, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------------------
+# baseline + gate entry points (shared machinery, storage bindings)
+# ---------------------------------------------------------------------
+
+_BASELINE_COMMENT = (
+    "Triaged-accepted storage findings "
+    "(python -m tpuflow.analysis repo --passes storage --baseline). "
+    "Entries are fingerprinted (rule, file, scope, subject) — no line "
+    "numbers, so they survive unrelated edits. Every entry carries a "
+    "one-line justification; stale entries (finding gone) are reported "
+    "and must be pruned."
+)
+
+
+def load_baseline(path: str) -> list[dict]:
+    """Parse + validate the storage baseline; raises
+    :class:`BaselineError` naming the file and field on anything
+    malformed."""
+    return _load_baseline(path, RULES)
+
+
+def write_baseline(path: str, findings: list[Finding],
+                   reasons: dict | None = None) -> int:
+    """(Re)write the baseline accepting every current finding; reasons
+    survive regeneration and pure file moves."""
+    return _write_baseline(
+        path, findings, reasons, comment=_BASELINE_COMMENT
+    )
+
+
+def default_baseline_path(root: str) -> str:
+    """``<root>/analysis/storage_baseline.json`` when the root has an
+    analysis/ package (the tpuflow layout), else flat in the root
+    (fixture dirs)."""
+    nested = os.path.join(root, "analysis")
+    if os.path.isdir(nested):
+        return os.path.join(nested, "storage_baseline.json")
+    return os.path.join(root, "storage_baseline.json")
+
+
+def analyze_repo(
+    root: str | None = None,
+    baseline_path: str | None = "auto",
+    index: RepoIndex | None = None,
+) -> list[Diagnostic]:
+    """The gate-shaped entry: analyze ``root`` (default: the installed
+    tpuflow package), subtract the baseline, and report the remainder
+    PLUS any stale baseline entries. Pass ``index`` to reuse an
+    already-built walk (the CLI builds ONE index for both repo-wide
+    passes)."""
+    root = root or default_root()
+    if baseline_path == "auto":
+        candidate = default_baseline_path(root)
+        baseline_path = candidate if os.path.exists(candidate) else None
+    findings = analyze_index(index if index is not None
+                             else build_index(root))
+    entries = load_baseline(baseline_path) if baseline_path else []
+    by_key: dict[tuple, dict] = {}
+    for e in entries:
+        by_key.setdefault(_baseline_key(e), e)
+    used: set = set()
+    out: list[Diagnostic] = []
+    for f in findings:
+        if f.fingerprint in by_key:
+            used.add(f.fingerprint)
+            continue
+        out.append(f.diagnostic())
+    for e in entries:
+        if _baseline_key(e) not in used:
+            out.append(Diagnostic(
+                pass_name=_PASS, code=STALE_CODE,
+                message=(
+                    f"stale baseline entry {e['rule']} "
+                    f"{e['file']}::{e['scope']}::{e['subject']} — the "
+                    "finding it accepts no longer exists; prune it "
+                    f"from {baseline_path}"
+                ),
+                where=baseline_path,
+            ))
+    return out

@@ -26,7 +26,7 @@ from tpuflow.data.csv_io import read_csv
 from tpuflow.data.features import FeaturePipeline
 from tpuflow.data.schema import ColumnSpec, Schema
 from tpuflow.models import build_model
-from tpuflow.train.checkpoint import BestCheckpointer
+from tpuflow.train.checkpoint import make_checkpointer
 from tpuflow.train.steps import make_predict
 from tpuflow.utils.paths import join_path, open_file
 
@@ -95,10 +95,17 @@ class Predictor:
         """``donate_forward=True`` donates the input batch buffer to the
         jitted forward (serving fast path: each padded batch is built
         fresh per dispatch and never reused after the call)."""
-        with open_file(
-            _meta_path(storage_path, name), "r", encoding="utf-8"
-        ) as f:
-            meta = json.load(f)
+        from tpuflow.storage import is_store_uri, read_json
+
+        if is_store_uri(storage_path):
+            # Store-resident artifacts (fake:// today) read through the
+            # object-store seam; everything else keeps the fsspec shim.
+            meta = read_json(_meta_path(storage_path, name))
+        else:
+            with open_file(
+                _meta_path(storage_path, name), "r", encoding="utf-8"
+            ) as f:
+                meta = json.load(f)
         # Static sidecar/config compatibility BEFORE touching the
         # checkpoint: a stale or hand-edited sidecar fails here naming
         # the bad field, not deep in Orbax restore as a pytree mismatch.
@@ -108,7 +115,7 @@ class Predictor:
         model = build_model(meta["model"], **meta["model_kwargs"])
         sample = np.zeros([2] + list(meta["sample_shape"][1:]), np.float32)
         template = model.init(jax.random.PRNGKey(0), sample)["params"]
-        ckpt = BestCheckpointer(storage_path, name)
+        ckpt = make_checkpointer(storage_path, name)
         params = ckpt.restore_best(template)
         ckpt.close()
         pipeline = (

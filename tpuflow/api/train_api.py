@@ -828,17 +828,23 @@ def _train_impl(
         # the first mismatching leaf paths, before any epoch runs.
         # Optimizer state stays fresh — the warm start transfers the
         # weights, not a previous run's trajectory bookkeeping.
-        from tpuflow.train.checkpoint import BestCheckpointer
+        from tpuflow.train.checkpoint import make_checkpointer
         from tpuflow.train.resume import apply_params, check_params_match
 
-        ws = BestCheckpointer(config.warm_start, config.model)
+        from tpuflow.storage import is_store_uri
+
+        ws = make_checkpointer(config.warm_start, config.model)
         try:
             # Compatibility first, against the checkpoint's METADATA: a
             # structurally-different artifact fails here with the first
             # mismatching leaf paths named (check_params_match), not
             # inside Orbax's template matching as an opaque pytree
             # error. Only a compatible artifact pays for the restore.
-            check_params_match(state.params, ws.best_structure())
+            # Store-resident artifacts carry flat leaf metadata instead
+            # of a tree; their restore path runs the same leaf-count and
+            # shape checks inside ``unflatten_like``.
+            if not is_store_uri(config.warm_start):
+                check_params_match(state.params, ws.best_structure())
             warm = ws.restore_best(state.params)
         finally:
             ws.close()

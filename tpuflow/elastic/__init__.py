@@ -267,14 +267,23 @@ def _apply_env_defaults(block: dict, out: dict) -> None:
 
 def make_backend(cfg: dict):
     """The exchange backend a resolved elastic block names:
-    ``FileExchange`` over ``cfg['dir']`` or ``SocketExchange`` dialing
-    ``cfg['addr']`` (imported lazily — the file path must not pull the
-    socket machinery, and this module stays import-light for the
+    ``FileExchange`` over ``cfg['dir']``, ``StoreExchange`` when the
+    dir is an object-store URI (``fake://bucket/gang`` — see
+    ``tpuflow/storage/``), or ``SocketExchange`` dialing ``cfg['addr']``
+    (all imported lazily — the file path must not pull the socket or
+    store machinery, and this module stays import-light for the
     preflight spec pass)."""
     if cfg.get("transport", "file") == "socket":
         from tpuflow.elastic.transport import SocketExchange
 
         return SocketExchange(cfg["addr"])
+    from tpuflow.storage import is_store_uri
+
+    if is_store_uri(cfg["dir"]):
+        from tpuflow.elastic.store_backend import StoreExchange
+        from tpuflow.storage import resolve_store
+
+        return StoreExchange(*resolve_store(cfg["dir"]))
     from tpuflow.elastic.exchange import FileExchange
 
     return FileExchange(cfg["dir"])

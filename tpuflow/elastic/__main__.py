@@ -52,8 +52,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="per-worker progress watchdog, seconds")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
-    with open(args.spec, encoding="utf-8") as f:
-        spec = json.load(f)
+    from tpuflow.storage import read_json
+
+    spec = read_json(args.spec)
     try:
         result = run_elastic(
             spec,

@@ -27,7 +27,6 @@ the "what was the gang doing?" trail for a dead coordinator.
 
 from __future__ import annotations
 
-import json
 import os
 import time
 
@@ -567,8 +566,9 @@ class Coordinator:
 
 
 def read_coordinator_state(gang_dir: str) -> dict | None:
+    from tpuflow.storage import read_json
+
     try:
-        with open(os.path.join(gang_dir, STATE_FILE), encoding="utf-8") as f:
-            return json.load(f)
-    except (OSError, json.JSONDecodeError):
+        return read_json(os.path.join(gang_dir, STATE_FILE))
+    except (OSError, ValueError):
         return None

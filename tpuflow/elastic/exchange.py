@@ -170,6 +170,8 @@ def _write_npz(path: str, leaves: list[np.ndarray]) -> None:
     tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     with open(tmp, "wb") as f:
         _savez(f, leaves)
+        f.flush()
+        os.fsync(f.fileno())  # data before name: no torn-write publish
     os.replace(tmp, path)
 
 

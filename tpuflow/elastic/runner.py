@@ -269,14 +269,42 @@ def run_elastic(
             "from there"
         )
     gang_dir = gang_dir or os.path.join(storage, "elastic")
-    if transport == "file":
+    from tpuflow.storage import is_store_uri
+
+    # A store-URI gang dir (fake://bucket/gang — see tpuflow/storage/)
+    # rides StoreExchange: all gang state becomes objects, and the
+    # coordinator's OBSERVABILITY files (state mirror, metrics trail,
+    # forensics) land in a local sidecar dir under storagePath instead.
+    store_gang = is_store_uri(gang_dir)
+    meta_dir = (
+        os.path.join(storage, "elastic-meta") if store_gang else gang_dir
+    )
+    if transport == "file" and not store_gang:
         # Socket gangs keep their state in the server's memory — a
         # stale DIRECTORY cannot confuse them, so only the file
         # transport needs the fresh-gang-dir refusal.
         _ensure_fresh_gang_dir(gang_dir)
-    os.makedirs(gang_dir, exist_ok=True)
+    os.makedirs(meta_dir, exist_ok=True)
     server = None
     coord_backend = None
+    if store_gang:
+        if transport != "file":
+            raise ValueError(
+                f"a store-URI gang dir ({gang_dir!r}) carries the "
+                "exchange itself; combine it with transport='file' "
+                "(the default), not 'socket'"
+            )
+        from tpuflow.elastic import make_backend
+
+        coord_backend = make_backend({"dir": gang_dir})
+        if coord_backend.has_state():
+            # The same silent catastrophe _ensure_fresh_gang_dir blocks
+            # for directories: stale done-heartbeats end the gang
+            # instantly and stale LATEST warm-starts orphaned rounds.
+            raise ValueError(
+                f"gang namespace {gang_dir!r} holds a previous gang's "
+                "state — remove the old objects or pass a fresh prefix"
+            )
     if transport == "socket":
         from tpuflow.elastic.transport import ExchangeServer, parse_addr
 
@@ -329,7 +357,7 @@ def run_elastic(
             server.stop()
         raise
     coordinator = Coordinator(
-        gang_dir,
+        meta_dir,
         heartbeat_timeout=heartbeat_timeout,
         heartbeat_interval=heartbeat_interval,
         round_timeout=round_timeout,
@@ -439,8 +467,18 @@ def run_elastic(
     )
     final_path = None
     if final_leaves is not None:
-        final_path = os.path.join(gang_dir, exchange.AVG_DIR, "final.npz")
-        exchange.write_leaves(final_path, final_leaves)
+        if store_gang:
+            # The deliverable is an object too: avg/final.npz in the
+            # store, reported as its URI.
+            key = final_backend.write_final(final_leaves)
+            scheme, _, rest = gang_dir.partition("://")
+            bucket = rest.split("/", 1)[0]
+            final_path = f"{scheme}://{bucket}/{key}"
+        else:
+            final_path = os.path.join(
+                gang_dir, exchange.AVG_DIR, "final.npz"
+            )
+            exchange.write_leaves(final_path, final_leaves)
     coord_state = coord_outcome.get("state") or coordinator.state()
     if coord_thread.is_alive():
         # The join timed out: the coordinator is wedged (slow shared

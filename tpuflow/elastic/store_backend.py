@@ -1,0 +1,324 @@
+"""StoreExchange: the elastic gang over an object store — no renames.
+
+The third transport behind the exchange backend contract
+(``FileExchange`` over a shared directory, ``SocketExchange`` over TCP,
+and now any ``tpuflow.storage.ObjectStore``): pushes, averages,
+heartbeats, goodbye markers, and round offsets become **objects** under
+the gang's key namespace, the LATEST average is published by pointer
+**promotion** instead of tmp+rename, and payloads ride the exchange's
+own checksummed npz encoding (``encode_leaves``/``decode_leaves`` — the
+socket transport's format, byte-identical on disk and in a bucket).
+
+``elastic`` blocks select this transport by URI: ``{"dir":
+"fake://bucket/gang", ...}`` resolves through
+``tpuflow.storage.resolve_store`` (``make_backend``), so a 2-worker
+in-process gang can run end to end against ``FakeRemoteStore`` — the
+drill that proves the gang's storage contract needs no rename anywhere.
+
+Key layout mirrors the file transport's directory layout one-to-one
+(``push/r000007/3.npz``, ``avg/r000007.npz``, ``avg/LATEST``,
+``members/3.json``/``.goodbye``/``.offset``), so operators can read a
+bucket listing the way they read a gang dir.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+from tpuflow.elastic import exchange
+from tpuflow.elastic.membership import (
+    STATUSES,
+    TERMINAL_STATUSES,
+    Member,
+)
+from tpuflow.resilience import fault_point
+from tpuflow.storage import join_key
+from tpuflow.storage.base import ObjectStore
+
+
+class StoreExchange:
+    """The exchange backend contract over an ``ObjectStore``.
+
+    ``network`` stays False: a store op failing is a storage problem to
+    fail (and supervise-restart) on, exactly like the file transport —
+    the degrade-and-resync path is for lost *peers*, not lost buckets.
+    """
+
+    network = False
+
+    def __init__(self, store: ObjectStore, prefix: str = ""):
+        self.store = store
+        self.prefix = prefix.strip("/")
+
+    def _key(self, *parts: str) -> str:
+        return join_key(self.prefix, *parts)
+
+    # --- params ---
+
+    def _push_key(self, round, worker_id: int) -> str:
+        return self._key(
+            exchange.PUSH_DIR, exchange._round_name(round),
+            f"{worker_id}.npz",
+        )
+
+    def _avg_key(self, round) -> str:
+        return self._key(
+            exchange.AVG_DIR, exchange._round_name(round) + ".npz"
+        )
+
+    def push(self, round, worker_id: int, params) -> None:
+        index = None if round == exchange.FINAL_ROUND else int(round)
+        fault_point("elastic.push", index=index)
+        self.store.put(
+            self._push_key(round, worker_id),
+            exchange.encode_leaves(exchange.flatten_params(params)),
+        )
+
+    def pushed_ids(self, round) -> set[int]:
+        prefix = self._key(
+            exchange.PUSH_DIR, exchange._round_name(round)
+        ) + "/"
+        out = set()
+        for key in self.store.list(prefix):
+            stem = key[len(prefix):]
+            if stem.endswith(".npz") and stem[:-4].isdigit():
+                out.add(int(stem[:-4]))
+        return out
+
+    def _read_push(self, round, wid: int) -> list[np.ndarray] | None:
+        try:
+            return exchange.decode_leaves(
+                self.store.get(self._push_key(round, wid))
+            )
+        except (OSError, ValueError, KeyError):
+            return None  # put is atomic: unreadable = absent/corrupt
+
+    def read_pushes(
+        self, round, include: set[int] | None = None
+    ) -> list[tuple[int, list[np.ndarray]]]:
+        ids = sorted(self.pushed_ids(round))
+        if include is not None:
+            ids = [i for i in ids if i in include]
+        out = []
+        for wid in ids:
+            leaves = self._read_push(round, wid)
+            if leaves is not None:
+                out.append((wid, leaves))
+        return out
+
+    def _newest_push_rounds(self, min_round: int) -> dict[int, int]:
+        prefix = self._key(exchange.PUSH_DIR) + "/"
+        newest: dict[int, int] = {}
+        for key in self.store.list(prefix):
+            parts = key[len(prefix):].split("/")
+            if len(parts) != 2:
+                continue
+            r = exchange._parse_round(parts[0])
+            stem = parts[1]
+            if (
+                r is None or r < min_round
+                or not stem.endswith(".npz") or not stem[:-4].isdigit()
+            ):
+                continue
+            wid = int(stem[:-4])
+            if newest.get(wid, -1) < r:
+                newest[wid] = r
+        return newest
+
+    def latest_push_rounds(self, min_round: int) -> list[tuple[int, int]]:
+        newest = self._newest_push_rounds(min_round)
+        return [(wid, newest[wid]) for wid in sorted(newest)]
+
+    def latest_pushes(
+        self, min_round: int
+    ) -> list[tuple[int, int, list[np.ndarray]]]:
+        newest = self._newest_push_rounds(min_round)
+        out = []
+        for wid in sorted(newest):
+            leaves = self._read_push(newest[wid], wid)
+            if leaves is not None:
+                out.append((wid, newest[wid], leaves))
+        return out
+
+    def publish(self, round: int, leaves, clock=time.time) -> None:
+        """Average first, pointer second: the promotion flip is the
+        publication instant, and a crash in between leaves the previous
+        LATEST standing — the file transport's write-then-repoint
+        ordering, expressed without a rename."""
+        self.store.put(self._avg_key(round), exchange.encode_leaves(leaves))
+        self.store.promote(
+            self._key(exchange.AVG_DIR, exchange.LATEST),
+            self._avg_key(round),
+            meta={"round": int(round)},
+            clock=clock,
+        )
+
+    def read_average(self, round: int) -> list[np.ndarray] | None:
+        try:
+            return exchange.decode_leaves(
+                self.store.get(self._avg_key(round))
+            )
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def latest_round(self) -> int | None:
+        doc = self.store.resolve(
+            self._key(exchange.AVG_DIR, exchange.LATEST)
+        )
+        if doc is None:
+            return None
+        try:
+            return int(doc["meta"]["round"])
+        except (KeyError, TypeError, ValueError):
+            return None
+
+    def latest_average(self) -> tuple[int, list[np.ndarray]] | None:
+        doc = self.store.resolve(
+            self._key(exchange.AVG_DIR, exchange.LATEST)
+        )
+        if doc is None:
+            return None
+        try:
+            return (
+                int(doc["meta"]["round"]),
+                exchange.decode_leaves(self.store.get(doc["target"])),
+            )
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
+    def prune(self, below: int) -> int:
+        removed = 0
+        push_prefix = self._key(exchange.PUSH_DIR) + "/"
+        pruned_rounds = set()
+        for key in self.store.list(push_prefix):
+            parts = key[len(push_prefix):].split("/")
+            r = exchange._parse_round(parts[0]) if parts else None
+            if r is not None and r < below and self.store.delete(key):
+                pruned_rounds.add(r)
+        removed += len(pruned_rounds)
+        avg_prefix = self._key(exchange.AVG_DIR) + "/"
+        for key in self.store.list(avg_prefix):
+            name = key[len(avg_prefix):]
+            if not name.endswith(".npz"):
+                continue
+            r = exchange._parse_round(name[: -len(".npz")])
+            if r is not None and r < below and self.store.delete(key):
+                removed += 1
+        return removed
+
+    def write_final(self, leaves) -> str:
+        """The runner's deliverable: the final cross-worker average as
+        ``avg/final.npz`` in the store; returns the key."""
+        key = self._key(exchange.AVG_DIR, "final.npz")
+        self.store.put(key, exchange.encode_leaves(leaves))
+        return key
+
+    # --- membership ---
+
+    def _member_key(self, worker_id: int, ext: str = "json") -> str:
+        return self._key("members", f"{worker_id}.{ext}")
+
+    def write_heartbeat(
+        self, worker_id: int, *, epoch: int = 0, round: int = 0,
+        status: str = "running", clock=time.time,
+    ) -> bool:
+        """The file transport's sticky-goodbye contract over objects:
+        a terminal beat also puts the goodbye marker; once it exists a
+        late non-terminal beat is skipped, and only an explicit
+        ``joining`` beat (a new incarnation) deletes it."""
+        if status not in STATUSES:
+            raise ValueError(
+                f"unknown heartbeat status {status!r}; valid: {STATUSES}"
+            )
+        fault_point("elastic.heartbeat")
+        marker = self._member_key(worker_id, "goodbye")
+        if status == "joining":
+            self.store.delete(marker)
+        elif status not in TERMINAL_STATUSES and self.store.exists(marker):
+            return False  # the goodbye stands; never beat over it
+        self.store.put_atomic(
+            self._member_key(worker_id),
+            json.dumps({
+                "worker_id": worker_id,
+                "time": clock(),
+                "epoch": epoch,
+                "round": round,
+                "status": status,
+                "pid": None,  # threads of one process share a pid
+            }).encode("utf-8"),
+        )
+        if status in TERMINAL_STATUSES:
+            self.store.put_atomic(
+                marker, json.dumps({"status": status}).encode("utf-8")
+            )
+        return True
+
+    def read_members(self) -> list[Member]:
+        prefix = self._key("members") + "/"
+        keys = self.store.list(prefix)
+        goodbyes = {k for k in keys if k.endswith(".goodbye")}
+        out: list[Member] = []
+        for key in keys:
+            if not key.endswith(".json"):
+                continue
+            try:
+                rec = json.loads(self.store.get(key).decode("utf-8"))
+                if not isinstance(rec, dict):
+                    continue
+                status = str(rec.get("status", "running"))
+                wid = int(rec["worker_id"])
+                if (
+                    status not in TERMINAL_STATUSES
+                    and self._member_key(wid, "goodbye") in goodbyes
+                ):
+                    try:
+                        marker = json.loads(
+                            self.store.get(
+                                self._member_key(wid, "goodbye")
+                            ).decode("utf-8")
+                        ).get("status")
+                    except (OSError, ValueError, AttributeError):
+                        marker = None
+                    if marker in TERMINAL_STATUSES:
+                        status = marker
+                out.append(Member(
+                    worker_id=wid,
+                    time=float(rec["time"]),
+                    epoch=int(rec.get("epoch", 0)),
+                    round=int(rec.get("round", 0)),
+                    status=status,
+                    pid=rec.get("pid"),
+                ))
+            except (OSError, ValueError, TypeError, KeyError):
+                continue  # torn/alien object: the next scan decides
+        return out
+
+    # --- the persisted round offset (survives restarts) ---
+
+    def set_offset(self, worker_id: int, offset: int) -> None:
+        self.store.put_atomic(
+            self._member_key(worker_id, "offset"),
+            json.dumps({"round_offset": int(offset)}).encode("utf-8"),
+        )
+
+    def get_offset(self, worker_id: int) -> tuple[int, bool]:
+        try:
+            rec = json.loads(
+                self.store.get(
+                    self._member_key(worker_id, "offset")
+                ).decode("utf-8")
+            )
+            return int(rec["round_offset"]), True
+        except (OSError, ValueError, TypeError, KeyError):
+            return 0, False
+
+    def has_state(self) -> bool:
+        """True when the namespace already holds a previous gang's
+        members/pushes/averages — the runner's stale-gang refusal."""
+        for sub in ("members", exchange.PUSH_DIR, exchange.AVG_DIR):
+            if self.store.list(self._key(sub) + "/"):
+                return True
+        return False

@@ -45,11 +45,11 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     from tpuflow.serve import spec_to_config
+    from tpuflow.storage import read_json
 
     try:
-        with open(args.spec, encoding="utf-8") as f:
-            config = spec_to_config(json.load(f))
-    except (OSError, json.JSONDecodeError, ValueError, TypeError) as e:
+        config = spec_to_config(read_json(args.spec))
+    except (OSError, ValueError, TypeError) as e:
         print(f"tpuflow.online: bad spec {args.spec!r}: {e}", file=sys.stderr)
         return 2
 

@@ -47,7 +47,6 @@ from __future__ import annotations
 import csv
 import dataclasses
 import os
-import shutil
 import threading
 import time
 from collections import deque
@@ -71,6 +70,7 @@ from tpuflow.obs.forensics import record_event
 from tpuflow.obs.metrics import default_registry
 from tpuflow.obs.tracing import use_trace
 from tpuflow.resilience import fault_point
+from tpuflow.storage.local import remove_tree
 from tpuflow.utils.paths import join_path
 
 # Drift kinds that justify a retrain. feature_variance alone is advisory
@@ -499,7 +499,7 @@ class OnlineTrainer:
         replay_csv = os.path.join(online_root, f"replay-{n}.csv")
         self._spill_replay(replay_csv)
         candidate = join_path(online_root, "candidate")
-        shutil.rmtree(candidate, ignore_errors=True)
+        remove_tree(candidate)
         os.makedirs(candidate, exist_ok=True)
 
         supervised = self.knobs["mode"] == "supervised"

@@ -24,22 +24,36 @@ degraded-serving behavior, not a new failure mode.
 ``promote_candidate`` fires the ``online.swap`` fault site BEFORE any
 file moves, so an injected fault rejects the candidate with the serving
 artifact untouched; ``rollback_artifact`` mirrors it with
-``online.rollback``. Local filesystems only — renames are the atomicity
-primitive; an object-store (gs://) swap needs a pointer indirection this
-module does not implement (docs/online.md lists it as a follow-up).
+``online.rollback``. Local moves go through the storage seam's
+local-move helpers (``tpuflow/storage/local.py`` — the one audited home
+for rename-as-publish); storage roots that resolve through
+``tpuflow.storage`` (``fake://`` today, ``gs://`` next) dispatch to the
+store-native path instead: **pointer-indirected promotion**
+(``tpuflow/storage/artifacts.py``) with zero renames, rollback as a
+pointer flip back to the retained previous generation (docs/storage.md).
 """
 
 from __future__ import annotations
 
 import json
 import os
-import shutil
 
 import numpy as np
 
 from tpuflow.obs.forensics import record_event
 from tpuflow.obs.metrics import default_registry
 from tpuflow.resilience import fault_point
+from tpuflow.storage import (
+    is_store_uri,
+    join_key,
+    read_json,
+    resolve_store,
+)
+from tpuflow.storage.local import (
+    move_tree,
+    remove_file,
+    remove_tree,
+)
 from tpuflow.utils.paths import atomic_write_json, is_uri, join_path
 
 
@@ -51,13 +65,32 @@ def _artifact_paths(storage: str, name: str) -> tuple[str, str]:
 
 
 def _require_local(*paths: str) -> None:
-    remote = [p for p in paths if is_uri(p)]
+    remote = [p for p in paths if is_uri(p) and not is_store_uri(p)]
     if remote:
         raise ValueError(
-            f"online artifact swap needs local storage paths (renames are "
-            f"the atomicity primitive); got URI(s) {remote} — object-store "
-            "promotion needs a pointer indirection (docs/online.md)"
+            f"online artifact swap needs local storage paths or "
+            f"store-resolvable URIs (tpuflow.storage); got URI(s) "
+            f"{remote} — object-store promotion rides the pointer "
+            "indirection of tpuflow/storage/artifacts.py (docs/storage.md)"
         )
+
+
+def _collect_artifact_objects(storage: str, name: str) -> dict[str, bytes]:
+    """Every object of one store-resident artifact (checkpoint tree +
+    sidecar), keyed by its serving-layout-relative name."""
+    store, prefix = resolve_store(storage)
+    files: dict[str, bytes] = {}
+    ckpt_prefix = join_key(prefix, "models", name) + "/"
+    for key in store.list(ckpt_prefix):
+        files[f"models/{name}/" + key[len(ckpt_prefix):]] = store.get(key)
+    meta_key = join_key(prefix, "meta", f"{name}.json")
+    if not files or not store.exists(meta_key):
+        raise FileNotFoundError(
+            f"artifact at {storage!r} is incomplete: needs a "
+            f"models/{name}/ tree and meta/{name}.json"
+        )
+    files[f"meta/{name}.json"] = store.get(meta_key)
+    return files
 
 
 def _require_artifact(ckpt: str, meta: str, what: str) -> None:
@@ -144,25 +177,26 @@ def promote_candidate(
     retaining the incumbent under ``{storage}/online/prev`` for
     rollback. See the module docstring for the swap discipline."""
     fault_point("online.swap")
+    _require_local(storage, candidate_storage)
+    if is_store_uri(storage):
+        return _promote_candidate_store(
+            storage, name, candidate_storage, registry=registry
+        )
     ckpt, meta = _artifact_paths(storage, name)
     cand_ckpt, cand_meta = _artifact_paths(candidate_storage, name)
-    _require_local(storage, candidate_storage)
     _require_artifact(cand_ckpt, cand_meta, "candidate")
     _require_artifact(ckpt, meta, "incumbent (serving)")
 
     prev_root = join_path(storage, "online", "prev")
     prev_ckpt, prev_meta = _artifact_paths(prev_root, name)
     # One retained generation: clear the older prev, then move the
-    # incumbent aside (renames — same filesystem).
-    shutil.rmtree(prev_root, ignore_errors=True)
-    os.makedirs(os.path.dirname(prev_ckpt), exist_ok=True)
-    os.makedirs(os.path.dirname(prev_meta), exist_ok=True)
-    os.rename(ckpt, prev_ckpt)
-    os.rename(meta, prev_meta)
-    # Candidate in: checkpoint tree by rename, sidecar atomically.
-    os.rename(cand_ckpt, ckpt)
-    with open(cand_meta, encoding="utf-8") as f:
-        atomic_write_json(meta, json.load(f))
+    # incumbent aside (seam-routed renames — same filesystem).
+    remove_tree(prev_root)
+    move_tree(ckpt, prev_ckpt)
+    move_tree(meta, prev_meta)
+    # Candidate in: checkpoint tree by seam move, sidecar atomically.
+    move_tree(cand_ckpt, ckpt)
+    atomic_write_json(meta, read_json(cand_meta))
     (registry or default_registry()).counter(
         "online_swaps_total",
         "candidate artifacts promoted into the serving path",
@@ -178,33 +212,66 @@ def promote_candidate(
     return rec
 
 
+def _promote_candidate_store(
+    storage: str, name: str, candidate_storage: str, *, registry=None
+) -> dict:
+    """The store-native swap: upload the candidate's objects as the
+    next generation under ``{storage}/online/{name}/`` and flip the
+    CURRENT pointer at its manifest — zero renames, and the incumbent
+    generation is retained by NOT being deleted (the rollback target).
+    """
+    from tpuflow.storage import artifacts
+
+    store, prefix = resolve_store(storage)
+    files = _collect_artifact_objects(candidate_storage, name)
+    doc = artifacts.promote_files(
+        store, files,
+        prefix=join_key(prefix, "online", name),
+        meta={"model": name, "candidate": candidate_storage},
+    )
+    (registry or default_registry()).counter(
+        "online_swaps_total",
+        "candidate artifacts promoted into the serving path",
+    ).inc()
+    rec = {
+        "promoted": True,
+        "model": name,
+        "storage_path": storage,
+        "candidate": candidate_storage,
+        "generation": int(doc["generation"]),
+        "pointer": doc["target"],
+    }
+    record_event("artifact_swap", **rec)
+    return rec
+
+
 def rollback_artifact(storage: str, name: str, *, registry=None) -> dict:
     """Restore the retained previous artifact into the serving path; the
     regressed artifact is kept under ``{storage}/online/rejected`` for
-    forensics. Raises FileNotFoundError when no previous artifact was
-    retained (nothing to roll back to)."""
+    forensics (locally — store roots retain every generation and roll
+    back by pointer flip). Raises FileNotFoundError when no previous
+    artifact was retained (nothing to roll back to)."""
     fault_point("online.rollback")
+    _require_local(storage)
+    if is_store_uri(storage):
+        return _rollback_store(storage, name, registry=registry)
     ckpt, meta = _artifact_paths(storage, name)
     prev_root = join_path(storage, "online", "prev")
     prev_ckpt, prev_meta = _artifact_paths(prev_root, name)
-    _require_local(storage)
     _require_artifact(
         prev_ckpt, prev_meta, "retained previous (rollback target)"
     )
 
     rejected_root = join_path(storage, "online", "rejected")
     rej_ckpt, rej_meta = _artifact_paths(rejected_root, name)
-    shutil.rmtree(rejected_root, ignore_errors=True)
-    os.makedirs(os.path.dirname(rej_ckpt), exist_ok=True)
-    os.makedirs(os.path.dirname(rej_meta), exist_ok=True)
+    remove_tree(rejected_root)
     if os.path.exists(ckpt):
-        os.rename(ckpt, rej_ckpt)
+        move_tree(ckpt, rej_ckpt)
     if os.path.exists(meta):
-        os.rename(meta, rej_meta)
-    os.rename(prev_ckpt, ckpt)
-    with open(prev_meta, encoding="utf-8") as f:
-        atomic_write_json(meta, json.load(f))
-    os.remove(prev_meta)
+        move_tree(meta, rej_meta)
+    move_tree(prev_ckpt, ckpt)
+    atomic_write_json(meta, read_json(prev_meta))
+    remove_file(prev_meta)
     (registry or default_registry()).counter(
         "online_rollbacks_total",
         "post-swap regressions rolled back to the retained artifact",
@@ -214,6 +281,32 @@ def rollback_artifact(storage: str, name: str, *, registry=None) -> dict:
         "model": name,
         "storage_path": storage,
         "rejected_retained": rejected_root,
+    }
+    record_event("artifact_rollback", **rec)
+    return rec
+
+
+def _rollback_store(storage: str, name: str, *, registry=None) -> dict:
+    """Store-native rollback: one pointer flip back to the previous
+    generation (never deleted — that IS the retention policy when
+    rename does not exist). The regressed generation's objects stay put
+    for forensics, named by the pointer doc's ``rolled_back_from``."""
+    from tpuflow.storage import artifacts
+
+    store, prefix = resolve_store(storage)
+    doc = artifacts.rollback(
+        store, prefix=join_key(prefix, "online", name)
+    )
+    (registry or default_registry()).counter(
+        "online_rollbacks_total",
+        "post-swap regressions rolled back to the retained artifact",
+    ).inc()
+    rec = {
+        "rolled_back": True,
+        "model": name,
+        "storage_path": storage,
+        "generation": int(doc["generation"]),
+        "rejected_retained": doc["meta"].get("rolled_back_from"),
     }
     record_event("artifact_rollback", **rec)
     return rec

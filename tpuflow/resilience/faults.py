@@ -124,6 +124,13 @@ SITES: dict[str, str] = {
     "candidate cleanly)",
     "online.rollback": "online/swap.py: rollback to the retained "
     "previous artifact, before any file is moved",
+    "storage.put": "storage/base.py: every object-store PUT (checkpoint "
+    "payloads, artifact files, exchange pushes) before any byte lands",
+    "storage.get": "storage/base.py: every object-store GET/tail "
+    "(restores, artifact loads, exchange reads)",
+    "storage.promote": "storage/base.py: every pointer promotion (the "
+    "publish instant for BEST/CURRENT/LATEST), before the pointer "
+    "object is written",
 }
 
 # Sites whose fault_point() passes an index (the at= reproducibility

@@ -78,10 +78,10 @@ def _build_service(doc: dict, root: str, servers: dict):
 
 def _cmd_run(spec_path: str) -> int:
     from tpuflow.runtime.supervisor import RuntimeSupervisor
+    from tpuflow.storage import read_json
     from tpuflow.utils.paths import atomic_write_json
 
-    with open(spec_path, encoding="utf-8") as fh:
-        doc = json.load(fh)
+    doc = read_json(spec_path)
     root = doc.get("root")
     if not root:
         raise SystemExit("run spec needs 'root'")
@@ -123,9 +123,9 @@ def _cmd_run(spec_path: str) -> int:
 
 def _cmd_soak(spec_path: str, out: str | None) -> int:
     from tpuflow.runtime.soak import run_soak
+    from tpuflow.storage import read_json
 
-    with open(spec_path, encoding="utf-8") as fh:
-        doc = json.load(fh)
+    doc = read_json(spec_path)
     result = run_soak(doc)
     if out:
         from tpuflow.utils.paths import atomic_write_json

@@ -103,11 +103,12 @@ def mini_soak_spec(root: str) -> dict:
 
 
 def _write_csv(path: str, table: dict) -> None:
+    from tpuflow.storage.local import fsync_write
+
     rows = []
     for i in range(len(table["flow"])):
         rows.append(",".join(str(table[c][i]) for c in _COLS))
-    with open(path, "w", encoding="utf-8") as fh:
-        fh.write("\n".join(rows) + "\n")
+    fsync_write(path, ("\n".join(rows) + "\n").encode("utf-8"))
 
 
 def _one_request(url: str, body: bytes, timeout_s: float) -> tuple:

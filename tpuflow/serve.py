@@ -544,7 +544,9 @@ class JobRunner:
             # untouched and the old write handle stays live.
             with open(path, encoding="utf-8") as src:
                 new_handle = self._flocked_append(tmp)
-                os.replace(tmp, path)  # the single point of no return
+                from tpuflow.storage.local import replace_file
+
+                replace_file(tmp, path)  # the single point of no return
                 old, self._journal_file = self._journal_file, new_handle
                 old.close()
                 # Archive only AFTER a successful promote, and only the

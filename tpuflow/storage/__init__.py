@@ -1,0 +1,82 @@
+"""tpuflow.storage — the object-store seam (ROADMAP item 1).
+
+One contract (:class:`~tpuflow.storage.base.ObjectStore`), two
+backends — :class:`~tpuflow.storage.local.LocalStore` (POSIX reference;
+atomic put = tmp+fsync+rename) and
+:class:`~tpuflow.storage.fake.FakeRemoteStore` (bucket semantics,
+deliberately no rename) — plus the resolvers and small JSON helpers the
+migrated subsystems use. The repo-wide storage analyzer
+(``tpuflow/analysis/storage.py``, TPF019–TPF021) enforces that direct
+path I/O stays inside this seam and a short allow-list of leaf modules;
+see docs/storage.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from tpuflow.storage.base import ObjectStore, StorageError  # noqa: F401
+from tpuflow.storage.fake import (  # noqa: F401
+    FakeRemoteStore,
+    fake_store,
+    reset_fakes,
+)
+from tpuflow.storage.local import LocalStore  # noqa: F401
+
+FAKE_SCHEME = "fake://"
+
+
+def is_store_uri(path) -> bool:
+    """True when ``path`` names an object-store root this package can
+    resolve (``fake://bucket[/prefix]`` today; ``gs://`` is the next
+    backend — ROADMAP item 1 is landed-except-gs)."""
+    return isinstance(path, str) and path.startswith(FAKE_SCHEME)
+
+
+def resolve_store(root: str) -> tuple[ObjectStore, str]:
+    """``root`` -> ``(store, key_prefix)``.
+
+    ``fake://bucket/prefix`` resolves to the process-global fake bucket
+    with ``prefix`` as the key namespace; any other string is a local
+    directory backed by :class:`LocalStore` with an empty prefix."""
+    if is_store_uri(root):
+        rest = root[len(FAKE_SCHEME):]
+        bucket, _, prefix = rest.partition("/")
+        if not bucket:
+            raise ValueError(f"store URI {root!r} names no bucket")
+        return fake_store(bucket), prefix.strip("/")
+    return LocalStore(root), ""
+
+
+def join_key(prefix: str, *parts: str) -> str:
+    """Join key components under an optional namespace prefix."""
+    pieces = [p.strip("/") for p in (prefix, *parts) if p and p.strip("/")]
+    return "/".join(pieces)
+
+
+def for_path(path: str) -> tuple[ObjectStore, str]:
+    """A single file path -> ``(store, key)`` — the helper behind
+    ``read_json``/``write_json`` so sidecar-sized records ride the seam
+    whether the path is local or a store URI."""
+    if is_store_uri(path):
+        store, key = resolve_store(path)
+        if not key:
+            raise ValueError(f"store URI {path!r} names no object key")
+        return store, key
+    parent, name = os.path.split(os.path.abspath(path))
+    return LocalStore(parent), name
+
+
+def read_json(path: str):
+    """Load one JSON record through the seam; raises
+    ``FileNotFoundError``/``ValueError`` exactly like a direct read."""
+    store, key = for_path(path)
+    return json.loads(store.get(key).decode("utf-8"))
+
+
+def write_json(path: str, obj) -> None:
+    """Atomically publish one JSON record through the seam (local paths
+    get tmp+fsync+rename; store URIs a single-object PUT)."""
+    store, key = for_path(path)
+    store.put_atomic(key, json.dumps(obj).encode("utf-8"))

@@ -1,0 +1,125 @@
+"""Pointer-indirected artifact promotion/rollback over an ObjectStore.
+
+``online/swap.py``'s local implementation retains the incumbent by
+renaming directories — the exact idiom a bucket store cannot express.
+This is the store-native equivalent the swap seam dispatches to for
+store-URI artifact roots: each promotion uploads the candidate's files
+under a fresh **generation prefix**, writes a manifest object, and
+flips the ``CURRENT`` pointer at it (old-or-new, never torn, zero
+renames). Rollback is another pointer flip — back to the generation the
+pointer doc recorded as ``previous`` — so the incumbent is retained by
+*not deleting it*, which is how retention works when rename does not
+exist.
+
+Layout under ``{prefix}/``::
+
+    gen-{n:06d}/{file...}        one promoted candidate's files
+    gen-{n:06d}/MANIFEST.json    {"files": [...], "meta": {...}}
+    CURRENT                      promotion pointer -> the manifest
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from tpuflow.storage import join_key
+from tpuflow.storage.base import ObjectStore
+
+POINTER = "CURRENT"
+MANIFEST = "MANIFEST.json"
+
+
+def _manifest_key(prefix: str, generation: int) -> str:
+    return join_key(prefix, f"gen-{generation:06d}", MANIFEST)
+
+
+def promote_files(
+    store: ObjectStore,
+    files: dict[str, bytes],
+    *,
+    prefix: str = "online",
+    meta: dict | None = None,
+    clock=time.time,
+) -> dict:
+    """Publish one candidate: upload every file under the next
+    generation prefix, write the manifest, flip CURRENT. Returns the
+    new pointer doc. Write order (files, manifest, pointer) means a
+    crash anywhere mid-promotion leaves the old generation serving."""
+    if not files:
+        raise ValueError("promote_files: candidate has no files")
+    pointer = join_key(prefix, POINTER)
+    doc = store.resolve(pointer)
+    generation = (doc["generation"] + 1) if doc else 1
+    gen_prefix = join_key(prefix, f"gen-{generation:06d}")
+    for name, data in sorted(files.items()):
+        store.put(join_key(gen_prefix, name), data)
+    store.put_atomic(
+        _manifest_key(prefix, generation),
+        json.dumps({
+            "files": sorted(files),
+            "meta": meta or {},
+            "generation": generation,
+        }).encode("utf-8"),
+    )
+    return store.promote(
+        pointer, _manifest_key(prefix, generation),
+        meta={**(meta or {}), "generation": generation},
+        clock=clock,
+    )
+
+
+def rollback(
+    store: ObjectStore, *, prefix: str = "online", clock=time.time
+) -> dict:
+    """Flip CURRENT back at the previous generation's manifest (which
+    was never deleted — see the module docstring). Raises
+    ``FileNotFoundError`` when there is nothing promoted or no previous
+    generation to return to."""
+    pointer = join_key(prefix, POINTER)
+    doc = store.resolve(pointer)
+    if doc is None:
+        raise FileNotFoundError(
+            f"rollback: pointer {pointer!r} has never been promoted"
+        )
+    previous = doc.get("previous")
+    if not previous:
+        raise FileNotFoundError(
+            f"rollback: {pointer!r} has no previous generation "
+            "(nothing was retained before the current promotion)"
+        )
+    return store.promote(
+        pointer, previous,
+        meta={"rolled_back_from": doc["target"]},
+        clock=clock,
+    )
+
+
+def current_manifest(
+    store: ObjectStore, *, prefix: str = "online"
+) -> dict | None:
+    """The manifest CURRENT points at, or None pre-first-promotion."""
+    doc = store.resolve(join_key(prefix, POINTER))
+    if doc is None:
+        return None
+    try:
+        return json.loads(store.get(doc["target"]).decode("utf-8"))
+    except (FileNotFoundError, ValueError):
+        return None
+
+
+def current_files(
+    store: ObjectStore, *, prefix: str = "online"
+) -> dict[str, bytes]:
+    """Every file of the currently promoted generation, by name."""
+    doc = store.resolve(join_key(prefix, POINTER))
+    manifest = current_manifest(store, prefix=prefix)
+    if doc is None or manifest is None:
+        raise FileNotFoundError(
+            f"{prefix}: no promoted generation to read"
+        )
+    gen_prefix = doc["target"].rsplit("/", 1)[0]
+    return {
+        name: store.get(join_key(gen_prefix, name))
+        for name in manifest["files"]
+    }

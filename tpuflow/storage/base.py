@@ -1,0 +1,218 @@
+"""The object-store contract every backend implements.
+
+ROADMAP item 1, PAPERS.md's BigDL/MMLSpark lesson: scale-out is a
+storage-contract problem as much as a compute one. Every subsystem that
+assumes "one shared POSIX filesystem with atomic rename" breaks the day
+the artifact root becomes ``gs://`` — object stores have **no rename**,
+no append, and no directories; they have atomic single-object PUT and
+last-writer-wins overwrite. This module states the contract the rest of
+tpuflow is allowed to rely on:
+
+- ``put``/``get``/``list``/``delete``/``exists`` — whole-object ops on
+  ``/``-separated keys. ``put`` is **last-writer-wins**: two concurrent
+  writers of one key leave one complete object, never an interleave.
+- ``put_atomic`` — a reader concurrently fetching the key sees the old
+  object or the new one, never a torn write. On an object store this IS
+  ``put`` (single-object PUT is atomic); on a local filesystem it is
+  tmp + fsync + rename.
+- ``promote`` — publish-by-**pointer-indirection**: a small JSON pointer
+  object is atomically overwritten to name the new target key. This is
+  the only publish primitive; rename-as-publish is exactly the idiom
+  that cannot exist on ``gs://``, and the repo-wide storage analyzer
+  (TPF020, ``tpuflow/analysis/storage.py``) flags it outside this seam.
+- ``tail`` — read a growing object from an offset (trail followers).
+
+``storage.put`` / ``storage.get`` / ``storage.promote`` are registered
+fault sites, and every public op lands in ``storage_ops_total{op=,
+backend=}`` + the ``storage_op_seconds`` histogram. Each store also
+keeps an **op log** (``op_log``) of ``(op, key)`` tuples — the tests'
+proof artifact: a promotion cycle on :class:`FakeRemoteStore
+<tpuflow.storage.fake.FakeRemoteStore>` shows zero ``rename`` entries,
+while :class:`LocalStore <tpuflow.storage.local.LocalStore>` honestly
+records the rename its atomic put performs.
+
+See docs/storage.md for the contract table and backend matrix.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from tpuflow.resilience import fault_point
+
+POINTER_SCHEMA = "tpuflow.storage.pointer/v1"
+
+
+class StorageError(OSError):
+    """A store operation failed (missing key, backend refusal). Subclass
+    of OSError so existing ``except OSError`` I/O policies apply."""
+
+
+def _check_key(key: str) -> str:
+    if not isinstance(key, str) or not key or key.startswith("/"):
+        raise ValueError(
+            f"store key must be a non-empty relative string, got {key!r}"
+        )
+    if ".." in key.split("/"):
+        raise ValueError(f"store key must not contain '..': {key!r}")
+    return key
+
+
+class ObjectStore:
+    """Abstract base: backends implement the ``_``-prefixed primitives;
+    callers use the public ops, which add fault sites, metrics, and the
+    op log uniformly. ``supports_rename`` advertises whether the backend
+    has an atomic server-side rename at all — nothing in the public
+    contract exposes one either way, which is the point."""
+
+    name = "object"            # backend label in storage_ops_total
+    supports_rename = False
+
+    def __init__(self):
+        from tpuflow.obs.metrics import default_registry
+
+        self.op_log: list[tuple] = []
+        reg = default_registry()
+        self._ops = reg.counter(
+            "storage_ops_total",
+            "object-store operations by op= and backend=",
+        )
+        self._seconds = reg.histogram(
+            "storage_op_seconds", "object-store operation latency",
+        )
+
+    # ---- backend primitives (implement these) ----
+
+    def _put(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def _get(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def _list(self, prefix: str) -> list[str]:
+        raise NotImplementedError
+
+    def _delete(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def _exists(self, key: str) -> bool:
+        raise NotImplementedError
+
+    # ---- instrumentation ----
+
+    def _record(self, op: str, key: str, t0: float) -> None:
+        self.op_log.append((op, key))
+        self._ops.inc(op=op, backend=self.name)
+        self._seconds.observe(time.perf_counter() - t0)
+
+    # ---- the public contract ----
+
+    def put(self, key: str, data: bytes) -> None:
+        """Write one whole object (last-writer-wins)."""
+        t0 = time.perf_counter()
+        fault_point("storage.put")
+        self._put(_check_key(key), bytes(data))
+        self._record("put", key, t0)
+
+    def put_atomic(self, key: str, data: bytes) -> None:
+        """Write such that a concurrent reader sees old-or-new, never a
+        torn object. The base delegates to ``put`` (object PUT is
+        atomic); filesystem backends override with tmp+fsync+rename."""
+        self.put(key, data)
+
+    def get(self, key: str) -> bytes:
+        """The whole object; ``FileNotFoundError`` when absent."""
+        t0 = time.perf_counter()
+        fault_point("storage.get")
+        data = self._get(_check_key(key))
+        self._record("get", key, t0)
+        return data
+
+    def list(self, prefix: str = "") -> list[str]:
+        """Sorted keys under ``prefix`` (flat namespace scan — object
+        stores have no directories, so neither does this)."""
+        t0 = time.perf_counter()
+        keys = sorted(self._list(prefix))
+        self._record("list", prefix, t0)
+        return keys
+
+    def delete(self, key: str) -> bool:
+        """Remove one object; True when it existed."""
+        t0 = time.perf_counter()
+        existed = self._delete(_check_key(key))
+        self._record("delete", key, t0)
+        return existed
+
+    def exists(self, key: str) -> bool:
+        t0 = time.perf_counter()
+        found = self._exists(_check_key(key))
+        self._record("exists", key, t0)
+        return found
+
+    def tail(self, key: str, offset: int = 0) -> bytes:
+        """Bytes of a growing object from ``offset`` (may be empty).
+        Backends with ranged reads override; the base fetches whole."""
+        t0 = time.perf_counter()
+        fault_point("storage.get")
+        data = self._get(_check_key(key))[offset:]
+        self._record("tail", key, t0)
+        return data
+
+    # ---- pointer-indirected promotion ----
+
+    def promote(
+        self, pointer: str, target: str, meta: dict | None = None,
+        clock=time.time,
+    ) -> dict:
+        """Atomically repoint ``pointer`` at ``target`` — THE publish
+        primitive. The pointer object is a small JSON doc recording the
+        target key, a monotonic generation, and the previous target (the
+        rollback seam artifacts.py rides). Write order is
+        target-first-pointer-second by convention: callers put the
+        target object(s) before promoting, so a crash in between leaves
+        the old pointer valid — the same old-or-new contract
+        ``put_atomic`` gives a single object, lifted to a tree of them.
+        """
+        t0 = time.perf_counter()
+        fault_point("storage.promote")
+        _check_key(target)
+        prior = self.resolve(pointer)
+        doc = {
+            "schema": POINTER_SCHEMA,
+            "target": target,
+            "generation": (prior["generation"] + 1) if prior else 1,
+            "previous": prior["target"] if prior else None,
+            "time": clock(),
+            "meta": meta or {},
+        }
+        self._put(
+            _check_key(pointer),
+            json.dumps(doc, sort_keys=True).encode("utf-8"),
+        )
+        self._record("promote", pointer, t0)
+        return doc
+
+    def resolve(self, pointer: str) -> dict | None:
+        """The pointer doc, or None when the pointer does not exist or
+        is unreadable (pre-first-promote)."""
+        try:
+            doc = json.loads(self._get(_check_key(pointer)))
+        except (FileNotFoundError, ValueError):
+            return None
+        if not isinstance(doc, dict) or "target" not in doc:
+            return None
+        doc.setdefault("generation", 1)
+        doc.setdefault("previous", None)
+        doc.setdefault("meta", {})
+        return doc
+
+    def get_promoted(self, pointer: str) -> bytes:
+        """Fetch the object the pointer currently names."""
+        doc = self.resolve(pointer)
+        if doc is None:
+            raise FileNotFoundError(
+                f"{self.name} store: pointer {pointer!r} has never been "
+                "promoted"
+            )
+        return self.get(doc["target"])

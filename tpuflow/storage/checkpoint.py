@@ -1,0 +1,132 @@
+"""Store-backed best-checkpointing: save/restore through the seam.
+
+The Orbax ``BestCheckpointer`` (train/checkpoint.py) owns local trees;
+this is its object-store twin for storage roots that resolve through
+``tpuflow.storage`` (``fake://`` today, ``gs://`` next). Same contract
+the train loop speaks — ``maybe_save`` keeps only the best-by-val_loss
+checkpoint, reads wait for nothing (every put is synchronous and
+atomic) — but built exclusively from seam primitives: the params ride
+as the elastic exchange's checksummed npz payload (one object per
+step), the best step is published by **pointer promotion** (never
+rename), and superseded step objects are deleted after the pointer
+flip, so a crash at any instant leaves a resolvable BEST pointer.
+
+Layout under ``models/{name}/``::
+
+    steps/{step:08d}.npz    checksummed leaves (exchange encoding)
+    steps/{step:08d}.json   sidecar: val_loss + per-leaf shapes/dtypes
+    BEST                    promotion pointer -> the winning .npz
+
+``checkpoint.save`` / ``checkpoint.restore`` fire here exactly as in
+the Orbax path (index = step), under the shared I/O retry policy.
+"""
+
+from __future__ import annotations
+
+import json
+
+from tpuflow.resilience import fault_point, io_policy, retry_call
+from tpuflow.storage import join_key, resolve_store
+
+
+class StoreCheckpointer:
+    """Best-by-val-loss checkpointing against any ``ObjectStore``; see
+    the module docstring. ``storage_root`` is a store URI or local
+    directory (resolved through ``tpuflow.storage.resolve_store``)."""
+
+    def __init__(self, storage_root: str, name: str = "model"):
+        self.store, prefix = resolve_store(storage_root)
+        self.prefix = join_key(prefix, "models", name)
+        self.directory = storage_root
+
+    def _step_key(self, step: int, ext: str) -> str:
+        return join_key(self.prefix, "steps", f"{step:08d}.{ext}")
+
+    @property
+    def _pointer(self) -> str:
+        return join_key(self.prefix, "BEST")
+
+    def maybe_save(self, step: int, params, val_loss: float) -> bool:
+        """Offer a checkpoint; kept only when it beats the current best.
+        Write order is payload, sidecar, pointer, THEN the superseded
+        step's deletes — a crash mid-save never breaks the standing
+        BEST."""
+        from tpuflow.elastic.exchange import encode_leaves, flatten_params
+
+        doc = self.store.resolve(self._pointer)
+        if doc is not None and float(val_loss) >= float(
+            doc["meta"].get("val_loss", float("inf"))
+        ):
+            return False
+        leaves = flatten_params(params)
+
+        def _save():
+            fault_point("checkpoint.save", index=step)
+            self.store.put(self._step_key(step, "npz"),
+                           encode_leaves(leaves))
+            self.store.put_atomic(
+                self._step_key(step, "json"),
+                json.dumps({
+                    "step": int(step),
+                    "val_loss": float(val_loss),
+                    "leaves": [
+                        {"shape": list(leaf.shape),
+                         "dtype": str(leaf.dtype)}
+                        for leaf in leaves
+                    ],
+                }).encode("utf-8"),
+            )
+            self.store.promote(
+                self._pointer, self._step_key(step, "npz"),
+                meta={"step": int(step), "val_loss": float(val_loss)},
+            )
+
+        retry_call(io_policy(), _save)
+        if doc is not None:  # max_to_keep=1: drop the superseded step
+            old = int(doc["meta"].get("step", -1))
+            if old >= 0 and old != int(step):
+                self.store.delete(self._step_key(old, "npz"))
+                self.store.delete(self._step_key(old, "json"))
+        return True
+
+    @property
+    def best_step(self) -> int | None:
+        doc = self.store.resolve(self._pointer)
+        return None if doc is None else int(doc["meta"]["step"])
+
+    def best_structure(self):
+        """The best checkpoint's per-leaf shapes/dtypes (sidecar read,
+        no array data) — the cheap compatibility probe."""
+        step = self.best_step
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint under {self.directory}"
+            )
+        doc = json.loads(
+            self.store.get(self._step_key(step, "json")).decode("utf-8")
+        )
+        return doc["leaves"]
+
+    def restore_best(self, params_like=None):
+        """Restore the best params (into ``params_like``'s structure
+        when given, else as the raw leaf list)."""
+        doc = self.store.resolve(self._pointer)
+        if doc is None:
+            raise FileNotFoundError(
+                f"no checkpoint under {self.directory}"
+            )
+        from tpuflow.elastic.exchange import decode_leaves, unflatten_like
+
+        step = int(doc["meta"]["step"])
+
+        def _restore():
+            fault_point("checkpoint.restore", index=step)
+            return decode_leaves(self.store.get(doc["target"]))
+
+        leaves = retry_call(io_policy(), _restore)
+        if params_like is None:
+            return leaves
+        return unflatten_like(params_like, leaves)
+
+    def close(self):  # parity with BestCheckpointer; nothing in flight
+        return None

@@ -1,0 +1,87 @@
+"""FakeRemoteStore — an object store with deliberately NO rename.
+
+The test double that proves the seam without GCS credentials: a
+dict-backed backend whose primitives are exactly what a bucket store
+gives you — atomic whole-object PUT (last-writer-wins), GET, flat
+prefix LIST, DELETE — and **nothing else**. There is no rename method
+to call, so any code path that only works by renaming cannot pass a
+test against this store; promotion must go through the base class's
+pointer indirection. The inherited op log is the proof artifact: the
+checkpoint, artifact-swap, and elastic-gang drills assert it contains
+zero ``rename`` entries end to end.
+
+``fake://bucket[/prefix]`` URIs resolve here (``tpuflow.storage
+.resolve_store``): each bucket name maps to one process-global store,
+so a coordinator thread and two worker threads dialing the same URI
+share the same "remote" — the in-process gang drill's transport.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from tpuflow.storage.base import ObjectStore
+
+
+class FakeRemoteStore(ObjectStore):
+    """In-memory bucket semantics; see the module docstring."""
+
+    name = "fake"
+    supports_rename = False
+
+    def __init__(self, bucket: str = "fake"):
+        super().__init__()
+        self.bucket = bucket
+        self._lock = threading.Lock()
+        self._objects: dict[str, bytes] = {}
+
+    def _put(self, key: str, data: bytes) -> None:
+        with self._lock:
+            self._objects[key] = data  # whole-object, last-writer-wins
+
+    def _get(self, key: str) -> bytes:
+        with self._lock:
+            try:
+                return self._objects[key]
+            except KeyError:
+                raise FileNotFoundError(
+                    f"fake://{self.bucket}/{key}: no such object"
+                ) from None
+
+    def _list(self, prefix: str) -> list[str]:
+        with self._lock:
+            return [k for k in self._objects if k.startswith(prefix)]
+
+    def _delete(self, key: str) -> bool:
+        with self._lock:
+            return self._objects.pop(key, None) is not None
+
+    def _exists(self, key: str) -> bool:
+        with self._lock:
+            return key in self._objects
+
+    def clear(self) -> None:
+        """Drop every object and the op log (test isolation)."""
+        with self._lock:
+            self._objects.clear()
+        self.op_log.clear()
+
+
+_FAKES: dict[str, FakeRemoteStore] = {}
+_FAKES_LOCK = threading.Lock()
+
+
+def fake_store(bucket: str) -> FakeRemoteStore:
+    """The process-global store for ``fake://bucket`` (created on first
+    use — every thread dialing the bucket shares one instance)."""
+    with _FAKES_LOCK:
+        store = _FAKES.get(bucket)
+        if store is None:
+            store = _FAKES[bucket] = FakeRemoteStore(bucket)
+        return store
+
+
+def reset_fakes() -> None:
+    """Forget every registered fake bucket (test isolation)."""
+    with _FAKES_LOCK:
+        _FAKES.clear()

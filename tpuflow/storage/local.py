@@ -1,0 +1,139 @@
+"""LocalStore — the POSIX reference backend, plus the local-move seam.
+
+Two things live here:
+
+- :class:`LocalStore`: the ``ObjectStore`` contract over a directory.
+  Every ``put`` is atomic (tmp + **fsync** + rename — the fsync is the
+  torn-write fix: without it a crash between write and rename can
+  publish a zero-length "atomic" file), so ``put_atomic`` needs no
+  override. The op log honestly records the ``rename`` each put
+  performs — the contrast the FakeRemoteStore drills assert against.
+
+- The **local-move seam**: ``replace_file`` / ``move_tree`` /
+  ``remove_tree``, the only blessed home for ``os.replace`` /
+  ``os.rename`` / ``shutil.move`` outside ``utils/paths.py``. Callers
+  that still operate on local directory trees (``online/swap.py``'s
+  incumbent retention, ``serve.py``'s journal compaction) route their
+  moves through here, so the repo-wide storage analyzer (TPF020) keeps
+  exactly one place to audit when a backend without rename arrives.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+
+from tpuflow.storage.base import ObjectStore
+
+
+def fsync_write(path: str, data: bytes) -> None:
+    """Write ``data`` to ``path`` via tmp + fsync + ``os.replace``.
+
+    The fsync-before-rename is load-bearing: rename alone orders the
+    DIRECTORY entry, not the data blocks — after a crash the new name
+    can point at a zero-length or partial file. The tmp name is unique
+    per (process, thread), same discipline as
+    ``utils.paths.atomic_write_json``."""
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def replace_file(src: str, dst: str) -> None:
+    """Atomically move ``src`` over ``dst`` (same-filesystem rename).
+    Local seam only — a store-backed path publishes via
+    ``ObjectStore.promote`` instead."""
+    parent = os.path.dirname(dst)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    os.replace(src, dst)
+
+
+def move_tree(src: str, dst: str) -> None:
+    """Move a file or directory tree to ``dst`` (parent created).
+    Rename when possible, copy+delete across filesystems —
+    ``shutil.move`` semantics behind the seam."""
+    parent = os.path.dirname(dst)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    shutil.move(src, dst)
+
+
+def remove_tree(path: str) -> None:
+    """Best-effort recursive delete (missing path is fine)."""
+    shutil.rmtree(path, ignore_errors=True)
+
+
+def remove_file(path: str) -> bool:
+    """Delete one file; True when it existed."""
+    try:
+        os.remove(path)
+        return True
+    except FileNotFoundError:
+        return False
+
+
+class LocalStore(ObjectStore):
+    """The contract over a local directory: keys are ``/``-separated
+    paths under ``root``. ``put`` is atomic by construction (see
+    :func:`fsync_write`), so local callers get the same old-or-new
+    guarantee a single-object PUT gives on a real object store."""
+
+    name = "local"
+    supports_rename = True
+
+    def __init__(self, root: str):
+        super().__init__()
+        self.root = os.path.abspath(root)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, *key.split("/"))
+
+    def _put(self, key: str, data: bytes) -> None:
+        fsync_write(self._path(key), data)
+        self.op_log.append(("rename", key))  # what the atomic put did
+
+    def _get(self, key: str) -> bytes:
+        with open(self._path(key), "rb") as f:
+            return f.read()
+
+    def _list(self, prefix: str) -> list[str]:
+        out = []
+        for dirpath, _dirnames, filenames in os.walk(self.root):
+            for name in filenames:
+                full = os.path.join(dirpath, name)
+                key = os.path.relpath(full, self.root).replace(os.sep, "/")
+                if key.startswith(prefix):
+                    out.append(key)
+        return out
+
+    def _delete(self, key: str) -> bool:
+        try:
+            os.remove(self._path(key))
+            return True
+        except FileNotFoundError:
+            return False
+
+    def _exists(self, key: str) -> bool:
+        return os.path.isfile(self._path(key))
+
+    def tail(self, key: str, offset: int = 0) -> bytes:
+        """Ranged read: seek instead of fetching the whole object."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        from tpuflow.resilience import fault_point
+
+        fault_point("storage.get")
+        with open(self._path(key), "rb") as f:
+            f.seek(offset)
+            data = f.read()
+        self._record("tail", key, t0)
+        return data

@@ -99,11 +99,12 @@ def load_measured_crossover(
     A plain record WITHOUT the field matches any request (pre-policy
     files), and ``compute_dtype=None`` requests match any record.
     """
+    from tpuflow.storage import read_json
+
     path = _sweep_path()
     try:
-        with open(path, encoding="utf-8") as f:
-            sweep = json.load(f)
-    except (OSError, json.JSONDecodeError):
+        sweep = read_json(path)
+    except (OSError, ValueError):
         return None
     if not isinstance(sweep, dict):
         return None

@@ -19,6 +19,25 @@ from tpuflow.resilience import fault_point, io_policy, retry_call
 from tpuflow.utils.paths import join_path
 
 
+def make_checkpointer(
+    storage_path: str, name: str = "model", async_save: bool = True
+):
+    """The best-checkpointer for a storage root: Orbax
+    (:class:`BestCheckpointer`) for local trees and natively-supported
+    URIs, the object-store seam's :class:`~tpuflow.storage.checkpoint
+    .StoreCheckpointer` when the root resolves through
+    ``tpuflow.storage`` (``fake://`` today) — same ``maybe_save`` /
+    ``restore_best`` contract either way, so the train loop and the
+    serving load path pick by root, not by code path."""
+    from tpuflow.storage import is_store_uri
+
+    if is_store_uri(storage_path):
+        from tpuflow.storage.checkpoint import StoreCheckpointer
+
+        return StoreCheckpointer(storage_path, name)
+    return BestCheckpointer(storage_path, name, async_save=async_save)
+
+
 class BestCheckpointer:
     """Save-best-by-val-loss checkpoint manager with restore support.
 

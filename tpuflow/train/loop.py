@@ -21,7 +21,7 @@ from tpuflow.core.losses import mae_clip
 from tpuflow.data.pipeline import ArrayDataset, batches
 from tpuflow.resilience import fault_point
 from tpuflow.train.callbacks import EarlyStopping
-from tpuflow.train.checkpoint import BestCheckpointer
+from tpuflow.train.checkpoint import make_checkpointer
 from tpuflow.train.steps import make_eval_step, make_train_step
 
 
@@ -277,7 +277,7 @@ def fit(
 
     stopper = EarlyStopping(patience=config.patience)
     ckpt = (
-        BestCheckpointer(
+        make_checkpointer(
             config.storage_path, config.model_name,
             async_save=config.ckpt_async,
         )

@@ -68,6 +68,7 @@ from dataclasses import dataclass, field
 
 from tpuflow.obs.health import NumericsDivergence
 from tpuflow.resilience.retry import RetryPolicy
+from tpuflow.storage import read_json, write_json
 
 # The child's exit code when the numerics watchdog aborts a diverging
 # run (policy="abort"). A dedicated code because the parent must CLASSIFY
@@ -361,8 +362,9 @@ def supervise(
             os.makedirs(attempt_dir, exist_ok=True)
             spec_path = os.path.join(attempt_dir, "spec.json")
             out_path = os.path.join(attempt_dir, "report.json")
-            with open(spec_path, "w", encoding="utf-8") as f:
-                json.dump(attempt_spec, f)
+            # Atomic spec handoff through the storage seam: the child
+            # must never race a half-written spec.
+            write_json(spec_path, attempt_spec)
             rc, stderr_text, kind, killed_by = _run_attempt(
                 [python, "-m", "tpuflow.train.supervisor",
                  "--child", spec_path, out_path],
@@ -375,8 +377,7 @@ def supervise(
                 child_env=child_env,
             )
             if rc == 0:
-                with open(out_path, encoding="utf-8") as f:
-                    report = json.load(f)
+                report = read_json(out_path)
                 return SupervisedRun(
                     report=report, attempts=attempt, failures=failures,
                     backoffs=backoffs,
@@ -519,17 +520,15 @@ def _child(spec_path: str, out_path: str) -> None:
     from tpuflow.api import train
     from tpuflow.serve import report_to_dict, spec_to_config
 
-    with open(spec_path, encoding="utf-8") as f:
-        spec = json.load(f)
+    spec = read_json(spec_path)
     config = spec_to_config(spec)
     try:
         report = train(config)
     except NumericsDivergence as e:
         print(f"NumericsDivergence: {e}", file=sys.stderr)
         sys.exit(NUMERICS_EXIT_CODE)
-    rep = report_to_dict(report)
-    with open(out_path, "w", encoding="utf-8") as f:
-        json.dump(rep, f)
+    # Atomic report publish: the parent reads this the instant rc==0.
+    write_json(out_path, report_to_dict(report))
 
 
 def main(argv: list[str] | None = None) -> None:
@@ -559,8 +558,7 @@ def main(argv: list[str] | None = None) -> None:
                     "SIGKILL for a child that ignores it (0 = immediate "
                     "SIGKILL)")
     args = ap.parse_args(argv)
-    with open(args.spec, encoding="utf-8") as f:
-        spec = json.load(f)
+    spec = read_json(args.spec)
     run = supervise(
         spec,
         max_restarts=args.max_restarts,

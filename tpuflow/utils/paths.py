@@ -85,18 +85,24 @@ def exists(path: str) -> bool:
 
 
 def atomic_write_json(path: str, obj) -> None:
-    """Write ``obj`` as JSON via tmp + ``os.replace``: a concurrent
-    reader sees the old file or the new one, never a torn write. The
-    tmp name is unique per (process, thread), so concurrent writers of
-    the SAME path (e.g. an elastic worker's heartbeat thread racing its
-    main-thread beat) cannot yank each other's tmp mid-write. Local
-    filesystem only — the one shared owner of the rename idiom the
-    elastic gang files, progress records, and state mirrors all rely
-    on. Raises OSError; callers own their best-effort policy."""
+    """Write ``obj`` as JSON via tmp + fsync + ``os.replace``: a
+    concurrent reader sees the old file or the new one, never a torn
+    write. The tmp name is unique per (process, thread), so concurrent
+    writers of the SAME path (e.g. an elastic worker's heartbeat thread
+    racing its main-thread beat) cannot yank each other's tmp
+    mid-write. The fsync BEFORE the rename is load-bearing: rename
+    alone orders the directory entry, not the data blocks, so a crash
+    between write and rename could otherwise publish a zero-length
+    "atomic" file under the final name. Local filesystem only — the one
+    shared owner of the rename idiom the elastic gang files, progress
+    records, and state mirrors all rely on. Raises OSError; callers own
+    their best-effort policy."""
     import json
     import threading
 
     tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
