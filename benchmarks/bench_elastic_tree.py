@@ -1,0 +1,415 @@
+"""Tree fan-in vs star hub A/B: root-side aggregation cost, wire
+bytes, encoding parity, and the mid-soak aggregator-kill heal.
+
+Four measurements against tpuflow's own elastic stack (ISSUE 18):
+
+1. **Fan-in scaling** (synthetic push storm, no jax): W in {8, 16, 32}
+   simulated workers push one ~1 MB param round at a metered root,
+   star (every worker dials the root) vs tree (fanout-4 aggregators
+   fold subtrees and forward ONE weighted partial each). The root's
+   ingress bytes and push-record count collapse from W to ceil(W/4),
+   and the root-side fold wall (averaging its records) shrinks with
+   them — the sub-linear headline.
+2. **Wire encodings** (same storm, W=32 tree): full-f32 pushes vs
+   delta+bf16 pushes against an adopted base. Headline: the byte
+   ratio (>= 2x — bf16 halves every floating leaf) and the decoded
+   fold's max abs error vs the f32 fold (the documented tolerance:
+   half a bf16 ulp of the DELTA's scale, not the parameter's).
+3. **Final parity** (real 4-worker gangs, 3 epochs): a fanout-2
+   delta+bf16 tree gang's final averaged params vs the f32 star
+   reference gang's, max abs diff recorded.
+4. **Heal drill** (real 6-epoch gang): a leaf aggregator is killed the
+   moment round 1 publishes; its subtree re-parents to the root via
+   FailoverClient. Recorded: every round still published, the final
+   average still covers all four workers, no worker error. Plus a
+   small opt-policy A/B (carry/reset/average) on the same job.
+
+``host_only: true`` — CPU wall-clock and loopback sockets; the ratios
+(bytes, records, fold wall) are the result, the absolute times are
+this host's.
+
+Run: ``JAX_PLATFORMS=cpu python -m benchmarks.bench_elastic_tree``
+Writes ``benchmarks/elastic_tree_results.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+from benchmarks.common import maybe_pin_cpu
+
+maybe_pin_cpu()
+
+SPEC = {
+    "model": "static_mlp",
+    "model_kwargs": {"hidden": []},
+    "epochs": 3,
+    "batchSize": 32,
+    "patience": 100,
+    "loss": "mse",
+    "optimizer_kwargs": {"learning_rate": 0.1, "momentum": 0.0},
+    "synthetic_wells": 4,
+    "synthetic_steps": 64,
+    "n_devices": 1,
+    "verbose": False,
+}
+GANG_SIZES = (8, 16, 32)
+FANOUT = 4
+PARAM_KB = 1024  # synthetic push payload, f32
+
+
+def _params() -> dict:
+    rng = np.random.default_rng(7)
+    n = PARAM_KB * 1024 // 4 // 2
+    return {
+        "w": rng.standard_normal(n).astype(np.float32),
+        "b": rng.standard_normal(n).astype(np.float32),
+    }
+
+
+def _metered_root():
+    """An ExchangeServer whose handler counts push ingress (bytes and
+    records) before delegating — the root-side scaling measurement."""
+    from tpuflow.elastic.transport import ExchangeServer, _Handler
+
+    ingress = {"bytes": 0, "pushes": 0}
+
+    class _Metered(_Handler):
+        def _dispatch(self, store, header, payload):
+            if header.get("op") == "push":
+                ingress["bytes"] += len(payload)
+                ingress["pushes"] += 1
+            return super()._dispatch(store, header, payload)
+
+    server = ExchangeServer(handler=_Metered)
+    return server, ingress
+
+
+def _covered(store, round_: int) -> int:
+    return sum(
+        len(covers)
+        for _, _, _, covers in store.read_weighted_pushes(round_)
+    )
+
+
+def _push_storm(
+    n_workers: int, fanout: int, *, wire_dtype="f32", delta=False,
+    adopted=None,
+) -> dict:
+    """One synthetic round: n_workers threads push the same-shape
+    params; returns root ingress, wall to full coverage, and the
+    root-side fold (timed, value returned for parity checks)."""
+    from tpuflow.elastic.aggregator import Aggregator, plan_tree
+    from tpuflow.elastic.exchange import average_leaf_sets
+    from tpuflow.elastic.transport import SocketExchange
+
+    params = _params()
+    server, ingress = _metered_root()
+    server.start()
+    if adopted is not None:
+        # Round 1 is published at the root; each worker reads it
+        # THROUGH its aggregator (seeding the tier's delta base — the
+        # same path a live gang's adoption reads take) before pushing
+        # round 2 as a delta against it.
+        server.store.publish(1, adopted)
+    aggregators = []
+    agg_addr = {}
+    try:
+        if fanout:
+            for level in reversed(plan_tree(n_workers, fanout)):
+                addr_of = {a.agg_id: a.addr for a in aggregators}
+                for node in level:
+                    agg = Aggregator(
+                        node.agg_id,
+                        addr_of.get(node.parent, server.addr),
+                        expected_children=len(node.children),
+                        wire_dtype=wire_dtype,
+                        delta=delta,
+                    ).start()
+                    aggregators.append(agg)
+                    if node.tier == 1:  # leaf tier: children are workers
+                        for wid in node.children:
+                            agg_addr[wid] = agg.addr
+
+        def _worker(wid: int):
+            ex = SocketExchange(
+                agg_addr.get(wid, server.addr),
+                wire_dtype=wire_dtype, delta=delta,
+            )
+            if adopted is not None:
+                base = ex.read_average(1)
+                ex.note_adopted(1, base)
+                # Per-worker delta vs the adopted base: small, so the
+                # bf16 quantization error stays at the delta's scale.
+                leaves = [
+                    a + np.float32(1e-3) * (wid + 1)
+                    for a in base
+                ]
+                from tpuflow.elastic.exchange import unflatten_like
+
+                ex.push(2, wid, unflatten_like(params, leaves))
+            else:
+                ex.push(1, wid, params)
+
+        t0 = time.monotonic()
+        threads = [
+            threading.Thread(target=_worker, args=(wid,))
+            for wid in range(n_workers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        round_ = 2 if adopted is not None else 1
+        deadline = time.monotonic() + 60
+        while _covered(server.store, round_) < n_workers:
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"round never covered {n_workers} workers"
+                )
+            time.sleep(0.005)
+        wall_covered = time.monotonic() - t0
+        recs = server.store.read_weighted_pushes(round_)
+        f0 = time.monotonic()
+        folded, _ = average_leaf_sets(
+            [(wid, leaves) for wid, leaves, _, _ in recs],
+            weights=[w for _, _, w, _ in recs],
+        )
+        fold_wall = time.monotonic() - f0
+    finally:
+        for agg in reversed(aggregators):
+            agg.stop()
+        server.stop()
+    return {
+        "n_workers": n_workers,
+        "fanout": fanout,
+        "root_ingress_bytes": ingress["bytes"],
+        "root_push_records": ingress["pushes"],
+        "wall_to_coverage_s": round(wall_covered, 4),
+        "root_fold_wall_s": round(fold_wall, 4),
+        "_folded": folded,
+    }
+
+
+def _fanin_scaling() -> list[dict]:
+    rows = []
+    for w in GANG_SIZES:
+        star = _push_storm(w, 0)
+        tree = _push_storm(w, FANOUT)
+        parity = max(
+            float(np.abs(a - b).max())
+            for a, b in zip(star.pop("_folded"), tree.pop("_folded"))
+        )
+        rows.append({
+            "n_workers": w,
+            "star": star,
+            "tree": tree,
+            "root_bytes_ratio": round(
+                star["root_ingress_bytes"]
+                / tree["root_ingress_bytes"], 3
+            ),
+            "root_records_ratio": round(
+                star["root_push_records"]
+                / tree["root_push_records"], 3
+            ),
+            "fold_parity_max_abs": parity,
+        })
+    return rows
+
+
+def _wire_encoding_ab() -> dict:
+    from tpuflow.elastic.exchange import flatten_params
+
+    base = flatten_params(_params())
+    f32 = _push_storm(32, FANOUT, adopted=base)
+    packed = _push_storm(
+        32, FANOUT, wire_dtype="bf16", delta=True, adopted=base
+    )
+    err = max(
+        float(np.abs(a - b).max())
+        for a, b in zip(f32.pop("_folded"), packed.pop("_folded"))
+    )
+    delta_scale = 1e-3 * 32  # the largest per-worker delta pushed
+    # One bf16 quantization per tier (worker->agg, agg->root), each
+    # bounded by half a bf16 ulp of the DELTA's scale.
+    bound = 2 * delta_scale * 2.0 ** -8
+    return {
+        "f32_full": f32,
+        "delta_bf16": packed,
+        # Asymptotes to 2.0 from below: bf16 halves every floating
+        # array's bytes, the fixed npz container bytes don't shrink.
+        "bytes_ratio": round(
+            f32["root_ingress_bytes"]
+            / packed["root_ingress_bytes"], 3
+        ),
+        "fold_max_abs_error": err,
+        "error_bound": bound,
+        "error_within_bound": err <= bound,
+    }
+
+
+def _real_gang(tmp: str, **kw) -> dict:
+    from tpuflow.elastic.runner import run_elastic
+
+    t0 = time.monotonic()
+    result = run_elastic(
+        {**SPEC, **kw.pop("spec_over", {}), "storagePath": tmp},
+        kw.pop("n_workers", 4),
+        mode="inprocess",
+        transport="socket",
+        heartbeat_timeout=120.0,
+        **kw,
+    )
+    assert result.ok, [w.error for w in result.workers]
+    return {
+        "wall_s": round(time.monotonic() - t0, 3),
+        "rounds": result.coordinator.get("round", 1) - 1,
+        "evicted": result.coordinator.get("evicted", []),
+        "final_averaged_over": result.final_worker_ids,
+        "mean_best_val_loss": float(np.mean([
+            (w.report or {}).get("best_val_loss") for w in result.workers
+        ])),
+        "_final": result.final_params,
+    }
+
+
+def _final_parity(tmpdir) -> dict:
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as a, \
+            tempfile.TemporaryDirectory() as b:
+        star = _real_gang(a)
+        tree = _real_gang(b, fanout=2, delta=True, wire_dtype="bf16")
+    diff = max(
+        float(np.abs(x - y).max())
+        for x, y in zip(star.pop("_final"), tree.pop("_final"))
+    )
+    return {
+        "star_f32": star,
+        "tree_delta_bf16": tree,
+        # Trajectories diverge only through bf16 push rounding (folds
+        # and masters stay f32), compounded over 3 rounds.
+        "final_max_abs_diff": diff,
+        "tolerance": 5e-3,
+        "within_tolerance": diff <= 5e-3,
+    }
+
+
+def _heal_drill() -> dict:
+    import tempfile
+
+    killed = {}
+
+    def on_up(handles):
+        coord = handles["coordinator"]
+        aggs = handles["aggregators"]
+
+        def watcher():
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                if coord.rounds:
+                    aggs[-1].kill()  # a LEAF aggregator, mid-soak
+                    killed["after_round"] = max(coord.rounds)
+                    return
+                time.sleep(0.01)
+
+        threading.Thread(target=watcher, daemon=True).start()
+
+    with tempfile.TemporaryDirectory() as tmp:
+        gang = _real_gang(
+            tmp, fanout=2, delta=True, wire_dtype="bf16",
+            n_workers=4, on_gang_up=on_up,
+            spec_over={"epochs": 6},
+        )
+    gang.pop("_final")
+    return {
+        **gang,
+        "killed_after_round": killed.get("after_round"),
+        "healed": (
+            killed.get("after_round") is not None
+            and gang["rounds"] >= 6
+            and gang["final_averaged_over"] == [0, 1, 2, 3]
+            and gang["evicted"] == []
+        ),
+    }
+
+
+def _opt_policy_ab() -> dict:
+    import tempfile
+
+    out = {}
+    for policy in ("carry", "reset", "average"):
+        with tempfile.TemporaryDirectory() as tmp:
+            gang = _real_gang(
+                tmp, fanout=2, opt_policy=policy,
+                spec_over={
+                    "optimizer_kwargs": {
+                        "learning_rate": 0.1, "momentum": 0.9,
+                    },
+                },
+            )
+        gang.pop("_final")
+        out[policy] = gang
+    return out
+
+
+def main() -> dict:
+    scaling = _fanin_scaling()
+    encoding = _wire_encoding_ab()
+    parity = _final_parity(None)
+    heal = _heal_drill()
+    policies = _opt_policy_ab()
+
+    w32 = next(r for r in scaling if r["n_workers"] == 32)
+    # The combined headline: what a 32-worker star gang pushing full
+    # f32 costs the root vs the fanout-4 tree pushing delta+bf16.
+    encoding["combined_vs_star_f32"] = round(
+        w32["star"]["root_ingress_bytes"]
+        / encoding["delta_bf16"]["root_ingress_bytes"], 3
+    )
+    record = {
+        "benchmark": "elastic_tree_vs_star",
+        "host_only": True,
+        "vs_baseline": None,
+        "note": (
+            "CPU host wall-clock over loopback sockets; the ratios "
+            "(root ingress bytes, root push records, fold wall) are "
+            "the result, absolute times are this host's. Fan-in storm "
+            f"pushes ~{PARAM_KB} KB f32 params per worker; real gangs "
+            "are 4-worker in-process static_mlp jobs."
+        ),
+        "config": {
+            "spec": SPEC, "gang_sizes": list(GANG_SIZES),
+            "fanout": FANOUT, "param_kb": PARAM_KB,
+        },
+        "fanin_scaling": scaling,
+        "wire_encoding_ab": encoding,
+        "final_parity": parity,
+        "heal_drill": heal,
+        "opt_policy_ab": policies,
+    }
+    out = os.path.join(
+        os.path.dirname(__file__), "elastic_tree_results.json"
+    )
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(record, f, indent=2)
+    print(json.dumps({
+        "config": "elastic_tree_vs_star",
+        "metric": "root_bytes_ratio_w32",
+        "value": w32["root_bytes_ratio"],
+        "unit": "x",
+        "delta_bf16_bytes_ratio": encoding["bytes_ratio"],
+        "final_parity_max_abs": parity["final_max_abs_diff"],
+        "heal_ok": heal["healed"],
+        "host_only": True,
+    }))
+    return record
+
+
+if __name__ == "__main__":
+    main()

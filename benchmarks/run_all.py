@@ -46,6 +46,7 @@ SCRIPTS = [
     "bench_attention.py",  # long-context family: full vs flash backends
     "bench_serving.py",  # HTTP serving: batched vs unbatched /predict
     "bench_autotune.py",  # online occupancy tuning vs static configs
+    "bench_elastic_tree.py",  # tree fan-in vs star: root bytes/fold A/B
 ]
 
 

@@ -505,3 +505,43 @@ def test_runtime_soak_subprocess(tmp_path):
     full = json.loads(out_path.read_text())
     assert full["card"]["schema"] == "tpuflow.slo.report_card/v1"
     assert (root / "soak_report.json").exists()
+
+
+def test_elastic_tree_module_subprocess(tmp_path):
+    """ISSUE 18 satellite: ``python -m tpuflow.elastic spec.json
+    --fanout 2`` in a REAL subprocess — the tree topology end to end
+    (socket transport implied by --fanout, aggregator threads, delta
+    pushes) behind the module entrypoint, summary JSON on stdout."""
+    import json
+
+    spec_path = tmp_path / "gang-spec.json"
+    spec_path.write_text(json.dumps({
+        "model": "static_mlp",
+        "model_kwargs": {"hidden": []},
+        "epochs": 2,
+        "batchSize": 32,
+        "patience": 100,
+        "loss": "mse",
+        "optimizer_kwargs": {"learning_rate": 0.1},
+        "synthetic_wells": 4,
+        "synthetic_steps": 64,
+        "n_devices": 1,
+        "verbose": False,
+        "storagePath": str(tmp_path / "gang"),
+    }))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpuflow.elastic", str(spec_path),
+         "--workers", "2", "--fanout", "2", "--delta",
+         "--mode", "inprocess", "--heartbeat-timeout", "120",
+         "--quiet"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=420,
+    )
+    assert proc.returncode == 0, proc.stdout[-800:] + proc.stderr[-1200:]
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["ok"] is True
+    assert summary["rounds"] >= 2
+    assert summary["final_averaged_over"] == [0, 1]
+    for w in summary["workers"]:
+        assert w["error"] is None

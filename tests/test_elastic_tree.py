@@ -1,0 +1,907 @@
+"""Hierarchical gang aggregation: tree fan-in, wire encodings, failover.
+
+Four layers, mirroring the subsystem (tpuflow/elastic/aggregator.py,
+wire.py; docs/elastic.md "Hierarchical aggregation"):
+
+- **Wire codec units** (no sockets): bf16 quantization round-trips and
+  round-to-nearest-even, delta encoding against an adopted base, the
+  two composed, and the byte halving the encoding exists for.
+- **Store + planning units**: the weighted/covering push records and
+  the ``keep_rounds`` memory bound on ``GangStore`` (the satellite
+  churn drill), ``plan_tree`` shapes, and the coordinator's weighted
+  re-average of partial pushes under a fake clock.
+- **Aggregator + failover drills** (real loopback sockets, no jax):
+  fold/forward exactness vs. the flat mean, the delta
+  base-unavailable → full re-push fallback, read caching, and
+  ``FailoverClient`` death classification under a fake clock.
+- **Tier-1 in-process gangs**: a 2-tier tree where the mid-tier
+  aggregator is killed mid-soak (the round must complete over
+  survivors, nothing lost, nobody degraded), and tree-vs-star final
+  parity.
+
+Env-knob validation follows the PR 8/9 house style: every malformed
+``TPUFLOW_ELASTIC_{FANOUT,TIER,DELTA,WIRE_DTYPE}`` value must raise
+naming the variable.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from tpuflow.elastic import exchange, resolve_elastic, wire
+from tpuflow.elastic.aggregator import (
+    AGG_ID_BASE,
+    Aggregator,
+    default_fanout,
+    default_tiers,
+    plan_tree,
+)
+from tpuflow.elastic.coordinator import Coordinator
+from tpuflow.elastic.transport import (
+    ExchangeServer,
+    FailoverClient,
+    GangStore,
+    SocketExchange,
+    TransportError,
+)
+
+TINY = {
+    "model": "static_mlp",
+    "model_kwargs": {"hidden": []},
+    "epochs": 4,
+    "batchSize": 32,
+    "patience": 100,
+    "loss": "mse",
+    "optimizer_kwargs": {"learning_rate": 0.1},
+    "synthetic_wells": 4,
+    "synthetic_steps": 64,
+    "n_devices": 1,
+    "verbose": False,
+}
+
+_ENV_KEYS = ("JAX_PLATFORMS", "XLA_FLAGS")
+
+
+@pytest.fixture(autouse=True)
+def _pass_platform_env(monkeypatch):
+    for k in _ENV_KEYS:
+        if os.environ.get(k):
+            monkeypatch.setenv(k, os.environ[k])
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _params(seed: float):
+    return {"w": np.full((2, 3), seed, np.float32),
+            "b": np.full((3,), seed, np.float32)}
+
+
+def _leaves(seed: float):
+    return exchange.flatten_params(_params(seed))
+
+
+def _dead_addr() -> str:
+    """An addr nothing listens on (bind, grab the port, close)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"127.0.0.1:{port}"
+
+
+def _wait_for(predicate, timeout: float = 10.0, interval: float = 0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        got = predicate()
+        if got:
+            return got
+        time.sleep(interval)
+    raise AssertionError(
+        f"condition never became true within {timeout}s"
+    )
+
+
+# ---------------------------------------------------------------------
+# unit: the wire codec
+# ---------------------------------------------------------------------
+
+
+class TestWireCodec:
+    def test_plain_f32_is_byte_identical_to_legacy(self):
+        leaves = _leaves(1.5)
+        enc, payload = wire.encode_push(leaves)
+        assert enc == {}
+        assert payload == exchange.encode_leaves(leaves)
+        out = wire.decode_push(enc, payload)
+        for a, b in zip(leaves, out):
+            np.testing.assert_array_equal(a, b)
+
+    def test_bf16_roundtrip_exact_for_representable_values(self):
+        # Values whose mantissa fits in bf16's 8 bits survive exactly.
+        a = np.array([0.0, 1.0, -2.5, 0.15625, 1024.0], np.float32)
+        np.testing.assert_array_equal(
+            wire.dequantize_bf16(wire.quantize_bf16(a)), a
+        )
+
+    def test_bf16_rounds_to_nearest_even(self):
+        # bf16 keeps 7 mantissa bits: 1 + 2^-8 is exactly halfway
+        # between bf16(1.0) and the next representable value;
+        # nearest-EVEN keeps the even pattern 1.0.
+        halfway = np.array([1.0 + 2.0 ** -8], np.float32)
+        np.testing.assert_array_equal(
+            wire.dequantize_bf16(wire.quantize_bf16(halfway)),
+            np.array([1.0], np.float32),
+        )
+        # Just above halfway rounds up.
+        above = np.array([1.0 + 2.0 ** -8 + 2.0 ** -16], np.float32)
+        got = wire.dequantize_bf16(wire.quantize_bf16(above))[0]
+        assert got == np.float32(1.0 + 2.0 ** -7)
+
+    def test_bf16_relative_error_bound(self):
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal(10_000).astype(np.float32)
+        out = wire.dequantize_bf16(wire.quantize_bf16(a))
+        # 7 mantissa bits, round-to-nearest: relative error <= 2^-8.
+        rel = np.abs(out - a) / np.maximum(np.abs(a), 1e-30)
+        assert float(rel.max()) <= 2.0 ** -8
+
+    def test_bf16_halves_the_payload(self):
+        leaves = [np.zeros((256, 256), np.float32)]
+        _, full = wire.encode_push(leaves)
+        enc, packed = wire.encode_push(leaves, wire_dtype="bf16")
+        assert enc["bf16"] == [1]
+        assert len(full) / len(packed) >= 1.9  # npz header amortizes
+
+    def test_delta_roundtrip_is_exact_in_f32(self):
+        base = _leaves(1.25)
+        cur = _leaves(1.75)
+        enc, payload = wire.encode_push(cur, base=base, base_round=7)
+        assert enc == {"delta": True, "base_round": 7}
+        out = wire.decode_push(enc, payload, base=base)
+        for a, b in zip(cur, out):
+            np.testing.assert_array_equal(a, b)
+
+    def test_delta_plus_bf16_error_scales_with_the_delta(self):
+        rng = np.random.default_rng(1)
+        base = [rng.standard_normal((64, 64)).astype(np.float32) * 100]
+        cur = [base[0] + rng.standard_normal((64, 64)).astype(
+            np.float32) * 0.01]
+        enc, payload = wire.encode_push(
+            cur, wire_dtype="bf16", base=base, base_round=1
+        )
+        out = wire.decode_push(enc, payload, base=base)
+        # Quantizing the DELTA bounds the error by the delta's scale
+        # (half an ulp of ~0.01-magnitude values), not the parameter's
+        # (~100 * 2^-8 ≈ 0.4) — the reason delta+bf16 composes.
+        err = float(np.abs(out[0] - cur[0]).max())
+        delta_scale = float(np.abs(cur[0] - base[0]).max())
+        assert err <= delta_scale * 2.0 ** -8
+        assert err < 1e-3  # and absolutely tiny vs. the ~0.4 above
+
+    def test_non_floating_leaves_pass_through_both_stages(self):
+        counts = np.arange(5, dtype=np.int32)
+        leaves = [np.ones(3, np.float32), counts]
+        base = [np.zeros(3, np.float32), np.zeros(5, np.int32)]
+        enc, payload = wire.encode_push(
+            leaves, wire_dtype="bf16", base=base, base_round=2
+        )
+        assert enc["bf16"] == [1, 0]
+        out = wire.decode_push(enc, payload, base=base)
+        np.testing.assert_array_equal(out[1], counts)
+        assert out[1].dtype == np.int32
+
+    def test_delta_without_base_raises_base_unavailable(self):
+        enc, payload = wire.encode_push(
+            _leaves(1.0), base=_leaves(0.5), base_round=3
+        )
+        with pytest.raises(wire.DeltaBaseUnavailable, match="round 3"):
+            wire.decode_push(enc, payload)
+
+    def test_layout_mismatches_fail_loudly(self):
+        with pytest.raises(ValueError, match="stale base"):
+            wire.encode_push(
+                _leaves(1.0), base=[np.zeros(2, np.float32)],
+                base_round=1,
+            )
+        enc, payload = wire.encode_push(
+            _leaves(1.0), base=_leaves(0.0), base_round=1
+        )
+        with pytest.raises(ValueError, match="mixed layouts"):
+            wire.decode_push(
+                enc, payload, base=[np.zeros(2, np.float32)]
+            )
+        with pytest.raises(ValueError, match="wire_dtype"):
+            wire.encode_push(_leaves(1.0), wire_dtype="f16")
+
+
+# ---------------------------------------------------------------------
+# unit: weighted push records + the GangStore memory bound
+# ---------------------------------------------------------------------
+
+
+class TestGangStoreWeighted:
+    def test_weighted_covering_records(self):
+        store = GangStore()
+        store.push_leaves(1, 0, _leaves(1.0))
+        store.push_leaves(
+            1, AGG_ID_BASE + 10_000, _leaves(3.0),
+            weight=3.0, covers=(1, 2, 3),
+        )
+        # pushed_ids sees THROUGH the partial to the covered workers.
+        assert store.pushed_ids(1) == {0, 1, 2, 3}
+        recs = store.read_weighted_pushes(1)
+        assert [(r[0], r[2], r[3]) for r in recs] == [
+            (0, 1.0, (0,)),
+            (AGG_ID_BASE + 10_000, 3.0, (1, 2, 3)),
+        ]
+        # The back-compat unweighted reader still yields (wid, leaves).
+        pairs = store.read_pushes(1)
+        assert [wid for wid, _ in pairs] == [0, AGG_ID_BASE + 10_000]
+
+    def test_weighted_reaverage_equals_flat_mean(self):
+        # An aggregator folding workers {1,2,3} then the root folding
+        # (partial, worker 0) must equal mean of all four params.
+        subtree = [(i, _leaves(float(i))) for i in (1, 2, 3)]
+        partial, used = exchange.average_leaf_sets(subtree)
+        assert used == [1, 2, 3]
+        flat, _ = exchange.average_leaf_sets(
+            [(i, _leaves(float(i))) for i in range(4)]
+        )
+        reavg, _ = exchange.average_leaf_sets(
+            [(0, _leaves(0.0)), (99, partial)], weights=[1.0, 3.0]
+        )
+        for a, b in zip(flat, reavg):
+            np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_keep_rounds_bounds_memory_under_churn(self):
+        # The satellite drill: 200 rounds of push+publish with
+        # keep_rounds=8 must hold both dicts at the bound, with the
+        # newest rounds readable and the oldest gone.
+        store = GangStore(keep_rounds=8)
+        for r in range(1, 201):
+            store.push_leaves(r, 0, _leaves(float(r)))
+            store.push_leaves(r, 1, _leaves(float(r)))
+            leaves, _ = exchange.average_leaf_sets(
+                store.read_pushes(r)
+            )
+            store.publish(r, leaves)
+        # Publish-time self-prune keeps the current round plus its
+        # keep_rounds predecessors; everything older is gone.
+        assert len(store._averages) <= 9
+        assert len(store._pushes) <= 9
+        assert store.read_average(200) is not None
+        assert store.read_average(191) is None  # pruned
+        assert store.latest_round() == 200
+
+    def test_keep_rounds_zero_disables_the_bound(self):
+        store = GangStore(keep_rounds=0)
+        for r in range(1, 40):
+            store.push_leaves(r, 0, _leaves(1.0))
+            store.publish(r, _leaves(1.0))
+        assert len(store._averages) == 39
+
+    def test_final_round_survives_the_bound(self):
+        store = GangStore(keep_rounds=4)
+        store.push_leaves(exchange.FINAL_ROUND, 0, _leaves(7.0))
+        for r in range(1, 20):
+            store.push_leaves(r, 0, _leaves(float(r)))
+            store.publish(r, _leaves(float(r)))
+        # Integer-round pruning must never eat the final pushes.
+        assert store.read_weighted_pushes(exchange.FINAL_ROUND)
+
+
+class TestWeightedCoordinatorPublish:
+    def test_partial_pushes_fold_by_weight_and_cover_workers(
+        self, tmp_path
+    ):
+        clock = FakeClock()
+        store = GangStore(clock=clock)
+        coord = Coordinator(
+            str(tmp_path), backend=store, clock=clock,
+            expected_workers=4, heartbeat_timeout=30.0,
+            trail_path=None,
+        )
+        for wid in range(4):
+            store.write_heartbeat(wid, round=1, status="running")
+        agg = AGG_ID_BASE + 10_000
+        store.push_leaves(1, 0, _leaves(0.0))
+        store.push_leaves(
+            1, agg,
+            exchange.average_leaf_sets(
+                [(i, _leaves(float(i))) for i in (1, 2, 3)]
+            )[0],
+            weight=3.0, covers=(1, 2, 3),
+        )
+        assert coord.step()
+        # The span/summary sees the WORKERS the partial covered.
+        assert coord.rounds[1] == [0, 1, 2, 3]
+        avg = store.read_average(1)
+        flat, _ = exchange.average_leaf_sets(
+            [(i, _leaves(float(i))) for i in range(4)]
+        )
+        for a, b in zip(avg, flat):
+            np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------
+# unit: env knobs (the PR 8/9 house style: malformed names the var)
+# ---------------------------------------------------------------------
+
+
+class TestTreeEnvKnobs:
+    @pytest.mark.parametrize("value", ["-1", "two", "2.5", ""])
+    def test_malformed_fanout_names_the_variable(
+        self, monkeypatch, value
+    ):
+        monkeypatch.setenv("TPUFLOW_ELASTIC_FANOUT", value)
+        if value == "":
+            assert default_fanout() == 0  # unset/blank = default
+            return
+        with pytest.raises(ValueError, match="TPUFLOW_ELASTIC_FANOUT"):
+            default_fanout()
+
+    @pytest.mark.parametrize("value", ["0", "-3", "one", "1.5"])
+    def test_malformed_tier_names_the_variable(self, monkeypatch, value):
+        monkeypatch.setenv("TPUFLOW_ELASTIC_TIER", value)
+        with pytest.raises(ValueError, match="TPUFLOW_ELASTIC_TIER"):
+            default_tiers()
+
+    @pytest.mark.parametrize("value", ["maybe", "2", "yess"])
+    def test_malformed_delta_names_the_variable(self, monkeypatch, value):
+        monkeypatch.setenv("TPUFLOW_ELASTIC_DELTA", value)
+        with pytest.raises(ValueError, match="TPUFLOW_ELASTIC_DELTA"):
+            resolve_elastic({
+                "dir": "/tmp/g", "worker_id": 0, "n_workers": 2,
+                "transport": "socket", "addr": "127.0.0.1:1",
+            })
+
+    @pytest.mark.parametrize("value", ["f16", "fp32", "bfloat16"])
+    def test_malformed_wire_dtype_names_the_variable(
+        self, monkeypatch, value
+    ):
+        monkeypatch.setenv("TPUFLOW_ELASTIC_WIRE_DTYPE", value)
+        with pytest.raises(
+            ValueError, match="TPUFLOW_ELASTIC_WIRE_DTYPE"
+        ):
+            resolve_elastic({
+                "dir": "/tmp/g", "worker_id": 0, "n_workers": 2,
+                "transport": "socket", "addr": "127.0.0.1:1",
+            })
+
+    def test_good_env_values_apply_only_on_socket(self, monkeypatch):
+        monkeypatch.setenv("TPUFLOW_ELASTIC_FANOUT", "4")
+        monkeypatch.setenv("TPUFLOW_ELASTIC_TIER", "2")
+        monkeypatch.setenv("TPUFLOW_ELASTIC_DELTA", "1")
+        monkeypatch.setenv("TPUFLOW_ELASTIC_WIRE_DTYPE", "bf16")
+        assert default_fanout() == 4
+        assert default_tiers() == 2
+        got = resolve_elastic({
+            "dir": "/tmp/g", "worker_id": 0, "n_workers": 2,
+            "transport": "socket", "addr": "127.0.0.1:1",
+        })
+        assert got["delta"] is True and got["wire_dtype"] == "bf16"
+        # A file-backend gang must NOT inherit socket wire encodings
+        # from the environment (the validator rejects the combination
+        # when spelled out in a spec).
+        got = resolve_elastic({
+            "dir": "/tmp/g", "worker_id": 0, "n_workers": 2,
+        })
+        assert got["delta"] is False and got["wire_dtype"] == "f32"
+
+    def test_spec_block_wins_over_env(self, monkeypatch):
+        monkeypatch.setenv("TPUFLOW_ELASTIC_WIRE_DTYPE", "bf16")
+        got = resolve_elastic({
+            "dir": "/tmp/g", "worker_id": 0, "n_workers": 2,
+            "transport": "socket", "addr": "127.0.0.1:1",
+            "wire_dtype": "f32",
+        })
+        assert got["wire_dtype"] == "f32"
+
+    @pytest.mark.parametrize("block,needle", [
+        ({"wire_dtype": "f16"}, "wire_dtype"),
+        ({"delta": "yes"}, "delta"),
+        ({"opt_policy": "freeze"}, "opt_policy"),
+        ({"delta": True}, "socket"),
+        ({"wire_dtype": "bf16"}, "socket"),
+        ({"fallback_addrs": ["nope"]}, "fallback_addrs"),
+        (
+            {"fallback_addrs": ["127.0.0.1:2"], "transport": "file"},
+            "socket",
+        ),
+    ])
+    def test_spec_validation_rejects_bad_tree_blocks(
+        self, block, needle
+    ):
+        with pytest.raises(ValueError, match=needle):
+            resolve_elastic({
+                "dir": "/tmp/g", "worker_id": 0, "n_workers": 2,
+                **block,
+            })
+
+
+# ---------------------------------------------------------------------
+# unit: tree planning
+# ---------------------------------------------------------------------
+
+
+class TestPlanTree:
+    def test_one_tier_shapes(self):
+        levels = plan_tree(8, 3)
+        assert len(levels) == 1
+        assert [len(n.children) for n in levels[0]] == [3, 3, 2]
+        assert all(n.parent is None for n in levels[0])
+        covered = [w for n in levels[0] for w in n.children]
+        assert covered == list(range(8))
+
+    def test_two_tiers_link_parents(self):
+        levels = plan_tree(9, 3, tiers=2)
+        assert len(levels) == 2
+        top = levels[1][0]
+        assert top.children == tuple(n.agg_id for n in levels[0])
+        assert all(n.parent == top.agg_id for n in levels[0])
+        assert top.parent is None
+
+    def test_agg_ids_never_collide_with_workers(self):
+        levels = plan_tree(500, 2, tiers=3)
+        ids = [n.agg_id for level in levels for n in level]
+        assert len(set(ids)) == len(ids)
+        assert min(ids) >= AGG_ID_BASE
+
+    def test_extra_tiers_stop_when_a_level_is_singular(self):
+        levels = plan_tree(4, 4, tiers=3)
+        assert len(levels) == 1  # one agg covers all; stacking stops
+
+    def test_single_worker_is_a_star(self):
+        assert plan_tree(1, 2, tiers=2) == []
+
+    def test_rejects_star_fanouts(self):
+        with pytest.raises(ValueError, match="fanout"):
+            plan_tree(8, 1)
+        with pytest.raises(ValueError, match="tiers"):
+            plan_tree(8, 2, tiers=0)
+
+
+# ---------------------------------------------------------------------
+# aggregator drills (real loopback sockets, no jax)
+# ---------------------------------------------------------------------
+
+
+class TestAggregator:
+    def test_fold_forward_matches_flat_mean(self):
+        with ExchangeServer() as server:
+            with Aggregator(
+                AGG_ID_BASE + 10_000, server.addr, expected_children=3,
+            ) as agg:
+                for wid in range(3):
+                    SocketExchange(agg.addr).push(
+                        1, wid, _params(float(wid))
+                    )
+                recs = _wait_for(
+                    lambda: server.store.read_weighted_pushes(1)
+                )
+            (wid, leaves, weight, covers), = recs
+            assert wid == AGG_ID_BASE + 10_000
+            assert weight == 3.0 and covers == (0, 1, 2)
+            flat, _ = exchange.average_leaf_sets(
+                [(i, _leaves(float(i))) for i in range(3)]
+            )
+            for a, b in zip(leaves, flat):
+                np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_reads_are_cached_and_served_to_the_subtree(self):
+        with ExchangeServer() as server:
+            server.store.push_leaves(1, 0, _leaves(2.0))
+            server.store.publish(1, _leaves(2.0))
+            with Aggregator(
+                AGG_ID_BASE + 10_000, server.addr, expected_children=1,
+                cache_ttl=60.0,
+            ) as agg:
+                upstream = []
+                inner = agg._upstream.request
+                agg._upstream.request = lambda *a, **k: (
+                    upstream.append(a[0]) or inner(*a, **k)
+                )
+                ex = SocketExchange(agg.addr)
+                for _ in range(5):
+                    got = ex.read_average(1)
+                    assert got is not None
+                    assert ex.latest_round() == 1
+                # 5 subtree reads of each kind cost ONE upstream fetch
+                # each — the fan-out amortization the tier exists for.
+                assert upstream.count("read_average") == 1
+                assert upstream.count("latest_round") == 1
+                # Unpublished rounds are negative-cached within the TTL.
+                for _ in range(5):
+                    assert ex.read_average(2) is None
+                assert upstream.count("read_average") == 2
+
+    def test_delta_base_unavailable_triggers_full_repush(self):
+        # The worker adopted round 1 from the ROOT (through a now-dead
+        # aggregator, say); its next delta push lands at a FRESH
+        # aggregator that never served round 1 → stored:false → the
+        # exchange re-pushes full, nothing lost.
+        with ExchangeServer() as server:
+            server.store.push_leaves(1, 0, _leaves(2.0))
+            server.store.publish(1, _leaves(2.0))
+            with Aggregator(
+                AGG_ID_BASE + 10_000, server.addr, expected_children=1,
+            ) as agg:
+                ex = SocketExchange(agg.addr, delta=True)
+                ex.note_adopted(1, _leaves(2.0))
+                ex.push(2, 0, _params(5.0))
+                recs = _wait_for(
+                    lambda: server.store.read_weighted_pushes(2)
+                )
+            np.testing.assert_allclose(recs[0][1][0], 5.0)
+
+    def test_delta_flows_when_the_subtree_read_seeded_the_base(self):
+        with ExchangeServer() as server:
+            server.store.push_leaves(1, 0, _leaves(2.0))
+            server.store.publish(1, _leaves(2.0))
+            with Aggregator(
+                AGG_ID_BASE + 10_000, server.addr, expected_children=1,
+            ) as agg:
+                ex = SocketExchange(
+                    agg.addr, delta=True, wire_dtype="bf16"
+                )
+                base = ex.read_average(1)  # seeds the agg's avg cache
+                ex.note_adopted(1, base)
+                ex.push(2, 0, _params(2.25))
+                recs = _wait_for(
+                    lambda: server.store.read_weighted_pushes(2)
+                )
+            # Exact despite bf16: the delta (0.25) and base are both
+            # bf16-representable.
+            np.testing.assert_allclose(recs[0][1][0], 2.25)
+
+    def test_flush_after_forwards_partial_subtrees(self):
+        # Two expected children, one pushes, the deadline folds anyway
+        # — a dead sibling must not wedge the subtree's round.
+        with ExchangeServer() as server:
+            with Aggregator(
+                AGG_ID_BASE + 10_000, server.addr, expected_children=2,
+                flush_after=0.1,
+            ) as agg:
+                SocketExchange(agg.addr).push(1, 0, _params(4.0))
+                recs = _wait_for(
+                    lambda: server.store.read_weighted_pushes(1)
+                )
+            (wid, leaves, weight, covers), = recs
+            assert weight == 1.0 and covers == (0,)
+            np.testing.assert_allclose(leaves[0], 4.0)
+
+    def test_dead_upstream_drops_after_bounded_retries(self, capsys):
+        agg = Aggregator(
+            AGG_ID_BASE + 10_000, _dead_addr(), expected_children=1,
+            flush_after=0.05, max_forward_retries=1,
+        ).start()
+        try:
+            SocketExchange(agg.addr).push(1, 0, _params(1.0))
+            _wait_for(
+                lambda: agg._retries.get(1, 0) > 1, timeout=30.0
+            )
+            _wait_for(lambda: not agg._pending, timeout=10.0)
+        finally:
+            agg.kill()
+        err = capsys.readouterr().err
+        assert "failed to forward" in err
+        assert "dropping the partial" in err
+
+    def test_heartbeats_relay_to_the_root(self):
+        with ExchangeServer() as server:
+            with Aggregator(
+                AGG_ID_BASE + 10_000, server.addr, expected_children=1,
+            ) as agg:
+                ex = SocketExchange(agg.addr)
+                ex.write_heartbeat(3, epoch=2, round=1, status="running")
+                members = server.store.read_members()
+            assert [m.worker_id for m in members] == [3]
+            assert members[0].status == "running"
+
+
+# ---------------------------------------------------------------------
+# failover drills (fake clock death classification)
+# ---------------------------------------------------------------------
+
+
+class TestFailoverClient:
+    def test_transport_death_fails_over_and_reprobes_after_expiry(self):
+        clock = FakeClock()
+        with ExchangeServer() as server:
+            fc = FailoverClient(
+                [_dead_addr(), server.addr],
+                retry_after=5.0, clock=clock,
+            )
+            assert fc.alive_index() == 0
+            resp, _ = fc.request("ping")
+            assert resp.get("ok")
+            # The dead primary was classified dead; ops now route to
+            # the fallback without paying the connect-retry tax.
+            assert fc.alive_index() == 1
+            t0 = time.time()
+            fc.request("ping")
+            assert time.time() - t0 < 1.0
+            # After retry_after the primary is probed again (it is
+            # still dead, so it is re-marked and the fallback serves).
+            clock.advance(6.0)
+            assert fc.alive_index() == 0
+            resp, _ = fc.request("ping")
+            assert resp.get("ok")
+            assert fc.alive_index() == 1
+
+    def test_op_level_errors_never_fail_over(self):
+        # A server that ANSWERS with an error is alive — failing over
+        # would retry a deterministic failure elsewhere and mask it.
+        clock = FakeClock()
+        with ExchangeServer() as server:
+            fc = FailoverClient(
+                [server.addr, _dead_addr()], clock=clock,
+            )
+            with pytest.raises(RuntimeError, match="unknown op"):
+                fc.request("no_such_op")
+            assert fc.alive_index() == 0  # still classified alive
+
+    def test_all_dark_surfaces_the_transport_error(self):
+        clock = FakeClock()
+        fc = FailoverClient(
+            [_dead_addr(), _dead_addr()], retry_after=5.0, clock=clock,
+        )
+        with pytest.raises((OSError, TransportError)):
+            fc.request("ping")
+        assert fc.alive_index() == 2  # every addr marked dead
+
+
+# ---------------------------------------------------------------------
+# tier-1: in-process tree gangs
+# ---------------------------------------------------------------------
+
+
+class TestTreeGang:
+    def test_midtier_kill_heals_without_losing_a_round(self, tmp_path):
+        """The satellite acceptance drill: a 4-worker, fanout-2,
+        delta+bf16 gang whose first leaf aggregator is killed the
+        moment round 1 publishes. Its subtree must re-parent to the
+        root via FailoverClient, every round must still publish, and
+        no worker may end degraded or short an epoch."""
+        import threading
+
+        from tpuflow.elastic.runner import run_elastic
+
+        spec = dict(TINY, epochs=6, storagePath=str(tmp_path))
+        killed = {}
+
+        def on_up(handles):
+            coord = handles["coordinator"]
+            aggs = handles["aggregators"]
+
+            def watcher():
+                deadline = time.time() + 120
+                while time.time() < deadline:
+                    if coord.rounds:
+                        aggs[-1].kill()  # a LEAF aggregator
+                        killed["after_round"] = max(coord.rounds)
+                        return
+                    time.sleep(0.01)
+
+            threading.Thread(target=watcher, daemon=True).start()
+
+        result = run_elastic(
+            spec, 4, mode="inprocess", transport="socket",
+            fanout=2, delta=True, wire_dtype="bf16",
+            heartbeat_timeout=120.0, on_gang_up=on_up,
+        )
+        summary = result.summary()
+        assert result.ok, summary
+        assert killed, "the watcher never saw a published round"
+        # No round lost: one publication per epoch despite the kill.
+        assert summary["rounds"] >= 6
+        assert summary["evicted"] == []
+        assert summary["final_averaged_over"] == [0, 1, 2, 3]
+        for w in summary["workers"]:
+            assert w["error"] is None and w["epochs_ran"] == 6
+
+    def test_tree_final_params_match_star_reference(self, tmp_path):
+        """Tree fan-in is a pure re-bracketing of the same mean: an
+        f32 tree gang's final average must match the star gang's to
+        float tolerance (identical spec, membership, rounds)."""
+        from tpuflow.elastic.runner import run_elastic
+
+        spec = dict(TINY, epochs=3)
+        star = run_elastic(
+            dict(spec, storagePath=str(tmp_path / "star")), 4,
+            mode="inprocess", transport="socket",
+            heartbeat_timeout=120.0,
+        )
+        tree = run_elastic(
+            dict(spec, storagePath=str(tmp_path / "tree")), 4,
+            mode="inprocess", transport="socket", fanout=2,
+            heartbeat_timeout=120.0,
+        )
+        assert star.ok and tree.ok
+        assert star.summary()["rounds"] == tree.summary()["rounds"]
+        assert tree.final_worker_ids == [0, 1, 2, 3]
+        for a, b in zip(star.final_params, tree.final_params):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+
+# ---------------------------------------------------------------------
+# optimizer-state-on-adoption policies
+# ---------------------------------------------------------------------
+
+
+def _live_state(lr: float = 0.1):
+    """A real TrainState one update deep: nonzero momentum, count=1."""
+    import jax.numpy as jnp
+    from flax.training import train_state
+
+    from tpuflow.train.optim import keras_sgd, wrap_optimizer
+
+    params = {"w": jnp.ones((2, 2), jnp.float32),
+              "b": jnp.zeros((3,), jnp.float32)}
+    state = train_state.TrainState.create(
+        apply_fn=None, params=params,
+        tx=wrap_optimizer(keras_sgd(learning_rate=lr, momentum=0.9)),
+    )
+    grads = {"w": jnp.full((2, 2), 0.5, jnp.float32),
+             "b": jnp.full((3,), 0.5, jnp.float32)}
+    return state.apply_gradients(grads=grads)
+
+
+class TestOptPolicies:
+    def test_reset_zeroes_momenta_keeps_counts_and_lr_scale(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tpuflow.train.optim import (
+            LrScaleState,
+            reset_opt_state,
+            scale_lr_in_state,
+        )
+
+        state = scale_lr_in_state(_live_state(), 0.5)
+        fresh = reset_opt_state(state)
+        old_leaves = jax.tree_util.tree_leaves(state.opt_state)
+        new_leaves = jax.tree_util.tree_leaves(fresh.opt_state)
+        assert any(
+            jnp.issubdtype(leaf.dtype, jnp.floating)
+            and float(jnp.abs(leaf).max()) > 0
+            for leaf in old_leaves
+        ), "the live state should carry nonzero momentum"
+        for old, new in zip(old_leaves, new_leaves):
+            if not jnp.issubdtype(new.dtype, jnp.floating):
+                np.testing.assert_array_equal(old, new)  # counts kept
+        momenta = [
+            leaf for leaf in jax.tree_util.tree_leaves(
+                fresh.opt_state.inner
+            )
+            if jnp.issubdtype(leaf.dtype, jnp.floating)
+        ]
+        assert all(float(jnp.abs(m).max()) == 0.0 for m in momenta)
+        assert isinstance(fresh.opt_state, LrScaleState)
+        assert float(fresh.opt_state.lr_scale) == 0.5  # halving kept
+        # Params untouched: reset is about the TRAJECTORY, not the point.
+        for a, b in zip(
+            jax.tree_util.tree_leaves(state.params),
+            jax.tree_util.tree_leaves(fresh.params),
+        ):
+            np.testing.assert_array_equal(a, b)
+
+    def _client(self, tmp_path, opt_policy: str):
+        from tpuflow.elastic.worker import ElasticWorkerClient
+
+        return ElasticWorkerClient({
+            "dir": str(tmp_path), "worker_id": 0, "n_workers": 2,
+            "opt_policy": opt_policy,
+        })
+
+    def test_average_payload_ships_moments_first(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        state = _live_state()
+        client = self._client(tmp_path, "average")
+        payload = client._push_payload(state)
+        assert sorted(payload) == ["m", "p"]  # "m" flattens first
+        n_params = len(jax.tree_util.tree_leaves(state.params))
+        flat = jax.tree_util.tree_leaves(payload)
+        n_moments = len(flat) - n_params
+        assert n_moments == len([
+            leaf
+            for leaf in jax.tree_util.tree_leaves(state.opt_state)
+            if jnp.issubdtype(leaf.dtype, jnp.floating)
+        ])
+        # carry/reset ship plain params.
+        assert self._client(
+            tmp_path, "carry"
+        )._push_payload(state) is state.params
+
+    def test_average_adopt_splits_moments_and_params(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        state = _live_state()
+        client = self._client(tmp_path, "average")
+        # The "gang average": every floating leaf bumped by +1.
+        combined = [
+            np.asarray(leaf, np.float32) + 1.0
+            for leaf in jax.tree_util.tree_leaves(
+                client._push_payload(state)
+            )
+        ]
+        adopted = client._adopt(state, combined)
+        n_params = len(jax.tree_util.tree_leaves(state.params))
+        for got, sent in zip(
+            jax.tree_util.tree_leaves(adopted.params),
+            combined[len(combined) - n_params:],
+        ):
+            np.testing.assert_allclose(np.asarray(got), sent)
+        old_floats = [
+            leaf
+            for leaf in jax.tree_util.tree_leaves(state.opt_state)
+            if jnp.issubdtype(leaf.dtype, jnp.floating)
+        ]
+        new_floats = [
+            leaf
+            for leaf in jax.tree_util.tree_leaves(adopted.opt_state)
+            if jnp.issubdtype(leaf.dtype, jnp.floating)
+        ]
+        for old, new in zip(old_floats, new_floats):
+            np.testing.assert_allclose(
+                np.asarray(new), np.asarray(old) + 1.0, rtol=1e-6
+            )
+        # Counters stayed local.
+        for old, new in zip(
+            jax.tree_util.tree_leaves(state.opt_state),
+            jax.tree_util.tree_leaves(adopted.opt_state),
+        ):
+            if not jnp.issubdtype(np.asarray(new).dtype, jnp.floating):
+                np.testing.assert_array_equal(
+                    np.asarray(old), np.asarray(new)
+                )
+
+    def test_average_adopt_rejects_mismatched_moment_counts(
+        self, tmp_path
+    ):
+        import jax
+
+        state = _live_state()
+        client = self._client(tmp_path, "average")
+        combined = [
+            np.asarray(leaf, np.float32)
+            for leaf in jax.tree_util.tree_leaves(
+                client._push_payload(state)
+            )
+        ]
+        with pytest.raises(ValueError, match="moment leaves"):
+            client._adopt(state, combined[1:])
+
+    def test_params_only_average_still_adopts_under_average_policy(
+        self, tmp_path
+    ):
+        # A FINAL average (params only) must adopt cleanly even when
+        # the gang ran opt_policy="average" — finish() ships params.
+        import jax
+
+        state = _live_state()
+        client = self._client(tmp_path, "average")
+        flat = [
+            np.asarray(leaf, np.float32) * 0.0
+            for leaf in jax.tree_util.tree_leaves(state.params)
+        ]
+        adopted = client._adopt(state, flat)
+        for got in jax.tree_util.tree_leaves(adopted.params):
+            np.testing.assert_allclose(np.asarray(got), 0.0)

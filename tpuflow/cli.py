@@ -146,11 +146,35 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--elastic-sync-every", type=int, default=1,
                    help="epochs between elastic averaging rounds")
     p.add_argument("--elastic-transport", choices=("file", "socket"),
-                   default="file",
+                   default=None,
                    help="exchange transport: 'file' (shared gang dir — "
-                        "the reference implementation) or 'socket' (a "
-                        "coordinator-hosted TCP exchange server; no "
-                        "shared filesystem needed for the exchange)")
+                        "the reference implementation; the default) or "
+                        "'socket' (a coordinator-hosted TCP exchange "
+                        "server; no shared filesystem needed for the "
+                        "exchange; implied by --elastic-fanout)")
+    p.add_argument("--elastic-fanout", type=int, default=None,
+                   metavar="K",
+                   help="tree aggregation: fold pushes through mid-tier "
+                        "aggregators with this subtree fan-out (0 = "
+                        "star hub; implies the socket transport; "
+                        "default TPUFLOW_ELASTIC_FANOUT or 0)")
+    p.add_argument("--elastic-tiers", type=int, default=None,
+                   help="aggregator tier count for --elastic-fanout "
+                        "(default TPUFLOW_ELASTIC_TIER or 1)")
+    p.add_argument("--elastic-delta", action="store_true", default=None,
+                   help="delta-encode pushes against the last adopted "
+                        "average (socket transport)")
+    p.add_argument("--elastic-wire-dtype", choices=("f32", "bf16"),
+                   default=None,
+                   help="push payload dtype on the wire (socket "
+                        "transport; masters and folds stay f32)")
+    p.add_argument("--elastic-opt-policy",
+                   choices=("carry", "reset", "average"),
+                   default="carry",
+                   help="optimizer state across an elastic adoption: "
+                        "keep local moments (carry), re-init them for "
+                        "the adopted params (reset), or gang-average "
+                        "floating moments alongside the params")
     p.add_argument("--elastic-async", action="store_true",
                    help="asynchronous gradient/param push (DeepSpark "
                         "style): workers push when ready and adopt the "
@@ -433,9 +457,16 @@ def main(argv=None) -> int:
                 dataclasses.asdict(config),
                 args.elastic,
                 sync_every=args.elastic_sync_every,
-                transport=args.elastic_transport,
+                transport=args.elastic_transport or (
+                    "socket" if args.elastic_fanout else "file"
+                ),
                 async_push=args.elastic_async,
                 max_staleness=args.elastic_max_staleness,
+                fanout=args.elastic_fanout,
+                tiers=args.elastic_tiers,
+                delta=args.elastic_delta,
+                wire_dtype=args.elastic_wire_dtype,
+                opt_policy=args.elastic_opt_policy,
                 heartbeat_timeout=args.elastic_heartbeat_timeout,
                 max_restarts=args.elastic_max_restarts,
                 stall_timeout=args.elastic_stall_timeout,

@@ -74,6 +74,21 @@ ELASTIC_DEFAULTS: dict = {
     "max_staleness": 2,        # async only: pushes older than this many
     # rounds behind the coordinator are rejected from the average;
     # fresher-but-stale ones are down-weighted by 1/(1+staleness)
+    # --- tree aggregation + wire encoding (aggregator.py, wire.py) ---
+    "fallback_addrs": None,    # ordered "host:port" list tried when the
+    # primary addr is dead (tree mode: the root behind each worker's
+    # leaf aggregator — the re-parenting path); socket transport only
+    "wire_dtype": "f32",       # push payload dtype on the wire: "f32"
+    # (exact) or "bf16" (half the bytes; masters/folds stay f32) —
+    # socket transport only
+    "delta": False,            # push params minus the last-adopted
+    # average instead of full params (composes with bf16; a receiver
+    # missing the base answers stored=false and gets a full re-push) —
+    # socket transport only
+    "opt_policy": "carry",     # optimizer state across an adoption:
+    # "carry" (keep local moments — the historical behavior), "reset"
+    # (re-init moments for the adopted params; step counts survive), or
+    # "average" (gang-average floating moments alongside the params)
 }
 
 # The env-knob family for the transport block (the TPUFLOW_RETRY_* /
@@ -87,6 +102,13 @@ ELASTIC_DEFAULTS: dict = {
 #   TPUFLOW_ELASTIC_MAX_STALENESS   integer >= 0
 #   TPUFLOW_ELASTIC_CONNECT_TIMEOUT positive seconds (read by
 #                                   transport.connect_timeout)
+#   TPUFLOW_ELASTIC_DELTA           boolean flag (delta-encoded pushes)
+#   TPUFLOW_ELASTIC_WIRE_DTYPE      "f32" | "bf16"
+#   TPUFLOW_ELASTIC_FANOUT          integer >= 0 (runner-level tree
+#                                   fan-out; read by
+#                                   aggregator.default_fanout)
+#   TPUFLOW_ELASTIC_TIER            integer >= 1 (aggregator tiers;
+#                                   read by aggregator.default_tiers)
 
 # Polls per heartbeat interval when poll_interval is derived: a scan a
 # few times per beat observes every membership/average transition within
@@ -194,6 +216,48 @@ def validate_elastic_block(block) -> list[str]:
             f"elastic.max_staleness must be an int >= 0 (rounds), got "
             f"{staleness!r}"
         )
+    fallbacks = block.get("fallback_addrs")
+    if fallbacks is not None:
+        if not isinstance(fallbacks, (list, tuple)) or not all(
+            _valid_addr(a) for a in fallbacks
+        ):
+            out.append(
+                f"elastic.fallback_addrs must be a list of 'host:port' "
+                f"strings (or None), got {fallbacks!r}"
+            )
+        elif transport != "socket":
+            out.append(
+                "elastic.fallback_addrs needs elastic.transport="
+                "'socket' (failover is a wire-transport concern)"
+            )
+    wire_dtype = block.get("wire_dtype", "f32")
+    if wire_dtype not in ("f32", "bf16"):
+        out.append(
+            f"elastic.wire_dtype must be 'f32' or 'bf16', got "
+            f"{wire_dtype!r}"
+        )
+    elif wire_dtype == "bf16" and transport != "socket":
+        out.append(
+            "elastic.wire_dtype='bf16' needs elastic.transport="
+            "'socket' (quantization is a wire encoding; the file "
+            "backend exchanges full f32)"
+        )
+    delta = block.get("delta", False)
+    if not isinstance(delta, bool):
+        out.append(f"elastic.delta must be a bool, got {delta!r}")
+    elif delta and transport != "socket":
+        out.append(
+            "elastic.delta=true needs elastic.transport='socket' "
+            "(delta encoding is a wire encoding; the file backend "
+            "exchanges full f32)"
+        )
+    if block.get("opt_policy", "carry") not in (
+        "carry", "reset", "average",
+    ):
+        out.append(
+            f"elastic.opt_policy must be 'carry', 'reset', or "
+            f"'average', got {block.get('opt_policy')!r}"
+        )
     return out
 
 
@@ -263,6 +327,17 @@ def _apply_env_defaults(block: dict, out: dict) -> None:
             "TPUFLOW_ELASTIC_MAX_STALENESS", out["max_staleness"], int,
             minimum=0, form="an integer round count >= 0",
         )
+    # Wire-encoding knobs only make sense on the socket transport; the
+    # env fallback must not flip them on for a file-backend gang (the
+    # validator would have rejected the same combination in a spec).
+    if out["transport"] == "socket":
+        if "delta" not in block:
+            out["delta"] = env_flag("TPUFLOW_ELASTIC_DELTA", out["delta"])
+        if "wire_dtype" not in block:
+            out["wire_dtype"] = env_choice(
+                "TPUFLOW_ELASTIC_WIRE_DTYPE", out["wire_dtype"],
+                ("f32", "bf16"),
+            )
 
 
 def make_backend(cfg: dict):
@@ -276,7 +351,12 @@ def make_backend(cfg: dict):
     if cfg.get("transport", "file") == "socket":
         from tpuflow.elastic.transport import SocketExchange
 
-        return SocketExchange(cfg["addr"])
+        return SocketExchange(
+            cfg["addr"],
+            fallbacks=tuple(cfg.get("fallback_addrs") or ()),
+            wire_dtype=cfg.get("wire_dtype", "f32"),
+            delta=bool(cfg.get("delta", False)),
+        )
     from tpuflow.storage import is_store_uri
 
     if is_store_uri(cfg["dir"]):

@@ -33,9 +33,31 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--sync-every", type=int, default=1,
                     help="epochs between averaging rounds")
     ap.add_argument("--transport", choices=("file", "socket"),
-                    default="file",
-                    help="exchange transport: shared gang dir (file) or "
-                    "a coordinator-hosted TCP exchange server (socket)")
+                    default=None,
+                    help="exchange transport: shared gang dir (file; "
+                    "the default) or a coordinator-hosted TCP exchange "
+                    "server (socket; implied by --fanout)")
+    ap.add_argument("--fanout", type=int, default=None, metavar="K",
+                    help="tree aggregation: fold pushes through "
+                    "mid-tier aggregators with this subtree fan-out "
+                    "(0 = star hub; implies --transport socket; "
+                    "default TPUFLOW_ELASTIC_FANOUT or 0)")
+    ap.add_argument("--tiers", type=int, default=None,
+                    help="aggregator tier count for --fanout "
+                    "(default TPUFLOW_ELASTIC_TIER or 1)")
+    ap.add_argument("--delta", action="store_true", default=None,
+                    help="delta-encode pushes against the last adopted "
+                    "average (socket transport)")
+    ap.add_argument("--wire-dtype", choices=("f32", "bf16"),
+                    default=None,
+                    help="push payload dtype on the wire (socket "
+                    "transport; masters and folds stay f32)")
+    ap.add_argument("--opt-policy",
+                    choices=("carry", "reset", "average"),
+                    default="carry",
+                    help="optimizer state across an adoption: keep "
+                    "local moments, re-init them, or gang-average "
+                    "floating moments alongside the params")
     ap.add_argument("--async-push", action="store_true",
                     help="asynchronous push with a staleness bound "
                     "(DeepSpark style): no round barrier")
@@ -55,15 +77,26 @@ def main(argv: list[str] | None = None) -> int:
     from tpuflow.storage import read_json
 
     spec = read_json(args.spec)
+    # --fanout implies the socket transport (the tree IS a wire
+    # topology); an explicit --transport still wins, so the
+    # fanout-over-file mistake dies with the runner's message.
+    transport = args.transport or (
+        "socket" if args.fanout else "file"
+    )
     try:
         result = run_elastic(
             spec,
             args.workers,
             gang_dir=args.gang_dir,
             mode=args.mode,
-            transport=args.transport,
+            transport=transport,
             async_push=args.async_push,
             max_staleness=args.max_staleness,
+            fanout=args.fanout,
+            tiers=args.tiers,
+            delta=args.delta,
+            wire_dtype=args.wire_dtype,
+            opt_policy=args.opt_policy,
             sync_every=args.sync_every,
             heartbeat_timeout=args.heartbeat_timeout,
             round_timeout=args.round_timeout,

@@ -1,0 +1,532 @@
+"""Mid-tier gang aggregators: tree fan-in over the socket transport.
+
+The star-hub exchange (``transport.py``) makes the coordinator's server
+touch every worker's full parameter payload every round — wire bytes
+and fold work at the root scale with gang size, which caps the gang in
+the tens (ROADMAP item 4; DeepSpark's parameter-server fan-in and
+SparkNet's round-trip-amortized driver are the lineage, PAPERS.md).
+This module puts a tier (or several) of aggregators between the
+workers and the root::
+
+                         root ExchangeServer + Coordinator
+                        /                                \\
+              aggregator A                         aggregator B
+             /     |     \\                        /     |     \\
+           w0     w1     w2                      w3     w4     w5
+
+Each :class:`Aggregator` speaks the SAME ``TPFX`` framed protocol on
+both sides — downstream it *is* an exchange server (workers dial it
+exactly as they would dial the root), upstream it is a client of its
+parent (another aggregator, or the root). Per round it:
+
+- **folds** its subtree's pushes with the weighted
+  ``exchange.average_leaf_sets`` math (decode + fold in f32, whatever
+  the wire dtype — masters stay f32 at every tier) and forwards ONE
+  partial-average push upstream carrying the subtree's total weight
+  and the worker ids it covers. Weighted means compose associatively,
+  so the root's re-average of partials is exactly the flat mean — and
+  the root's ingress bytes and fold count scale with ITS fan-out, not
+  with gang size;
+- **serves** its subtree's average reads from a local cache (one
+  upstream fetch per round amortized over the whole subtree — root
+  egress drops by the same fan-out factor), with a short negative-TTL
+  so a not-yet-published round costs the root at most one probe per
+  TTL instead of one per polling worker;
+- **relays** everything else (heartbeats, offsets, membership probes)
+  verbatim — liveness stays a transport-level observation stamped at
+  the root, and the sticky-goodbye/rejoin machinery is unchanged.
+
+Failure is asymmetric by design. An aggregator holds NO durable state
+— every cache entry is reconstructable from the root — so a killed
+mid-tier node is healed entirely by its subtree's
+:class:`~tpuflow.elastic.transport.FailoverClient`: the workers mark it
+dead, re-parent to the fallback (root or a sibling), and the round
+completes over the survivors with no round lost and nobody degraded;
+when the address answers again the subtree re-parents back. The
+upstream direction retries with deferral and drops a round's partial
+only after a bounded number of failed forwards (the workers then see
+the round miss — the same observation a slow coordinator produces).
+
+``plan_tree`` lays out the tiers; the runner (``runner.py``) starts
+the aggregators, points each worker at its leaf aggregator with the
+root as fallback, and stops them leaf-tier-first so final pushes flush
+upward. Fan-out knobs ride ``TPUFLOW_ELASTIC_FANOUT`` /
+``TPUFLOW_ELASTIC_TIER`` (validated reads, ``utils/env.py``).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from dataclasses import dataclass, replace
+
+from tpuflow.elastic import exchange, wire
+from tpuflow.elastic.transport import (
+    ExchangeServer,
+    TransportClient,
+    _Handler,
+)
+from tpuflow.utils.env import env_num
+
+# Aggregator ids live far above any plausible worker id: they appear as
+# pusher ids on the wire and in fold diagnostics, and must never
+# collide with (or be mistaken for) gang worker ids.
+AGG_ID_BASE = 1_000_000
+
+
+def default_fanout() -> int:
+    """The tree fan-out when the caller leaves it unset (0 = star hub,
+    no aggregator tier) — ``TPUFLOW_ELASTIC_FANOUT``, validated at
+    read time like every TPUFLOW_* knob."""
+    return env_num(
+        "TPUFLOW_ELASTIC_FANOUT", 0, int, minimum=0,
+        form="an integer subtree fan-out >= 0 (0 = star, >= 2 = tree)",
+    )
+
+
+def default_tiers() -> int:
+    """Aggregator tier count when unset — ``TPUFLOW_ELASTIC_TIER``."""
+    return env_num(
+        "TPUFLOW_ELASTIC_TIER", 1, int, minimum=1,
+        form="an integer aggregator tier count >= 1",
+    )
+
+
+@dataclass(frozen=True)
+class AggNode:
+    """One planned aggregator: its id, tier (1 = leaf tier, workers
+    below), the ids it folds (worker ids at tier 1, child aggregator
+    ids above), and its parent aggregator id (None = the root)."""
+
+    agg_id: int
+    tier: int
+    children: tuple[int, ...]
+    parent: int | None
+
+
+def plan_tree(
+    n_workers: int, fanout: int, tiers: int = 1
+) -> list[list[AggNode]]:
+    """Lay out the aggregation tree: ``tiers`` levels of aggregators,
+    each folding at most ``fanout`` nodes of the level below. Returns
+    tiers bottom-up (``[0]`` is the leaf tier). Levels that would hold
+    a single node stop the stacking early — an aggregator chain above
+    one aggregator adds latency and nothing else."""
+    if fanout < 2:
+        raise ValueError(
+            f"tree aggregation needs fanout >= 2 (0 = star hub), got "
+            f"{fanout}"
+        )
+    if tiers < 1:
+        raise ValueError(f"tiers must be >= 1, got {tiers}")
+    if n_workers < 1:
+        raise ValueError(f"n_workers must be >= 1, got {n_workers}")
+    levels: list[list[AggNode]] = []
+    below = list(range(n_workers))
+    for tier in range(1, tiers + 1):
+        if len(below) <= 1:
+            break
+        nodes = [
+            AggNode(
+                agg_id=AGG_ID_BASE + tier * 10_000 + g,
+                tier=tier,
+                children=tuple(below[g * fanout:(g + 1) * fanout]),
+                parent=None,
+            )
+            for g in range((len(below) + fanout - 1) // fanout)
+        ]
+        levels.append(nodes)
+        below = [n.agg_id for n in nodes]
+    for t in range(len(levels) - 1):
+        parent_of = {
+            child: up.agg_id
+            for up in levels[t + 1]
+            for child in up.children
+        }
+        levels[t] = [
+            replace(n, parent=parent_of[n.agg_id]) for n in levels[t]
+        ]
+    return levels
+
+
+class _AggHandler(_Handler):
+    """The aggregator's wire dispatch: same framing/lifecycle as the
+    root's handler, but ``server.store`` is the :class:`Aggregator`
+    itself."""
+
+    def _dispatch(self, agg, header, payload):
+        return agg.dispatch(header, payload)
+
+
+class Aggregator:
+    """One mid-tier fold/forward/cache node (see module docstring).
+
+    Thread shape: the embedded :class:`ExchangeServer`'s handler
+    threads write pushes into ``_pending`` and read the caches; one
+    flush thread folds ready rounds and forwards them upstream. ALL
+    mutable state is guarded by ``_lock`` (``_cond`` wraps the same
+    lock); upstream requests always run outside it. ``clock`` is
+    injectable so flush-timing drills run wall-clock-free."""
+
+    def __init__(
+        self,
+        agg_id: int,
+        upstream_addr: str,
+        *,
+        expected_children: int = 0,
+        flush_after: float = 1.0,
+        cache_ttl: float = 0.05,
+        keep_rounds: int = 16,
+        wire_dtype: str = "f32",
+        delta: bool = False,
+        max_forward_retries: int = 3,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        clock=time.monotonic,
+    ):
+        from tpuflow.obs import default_registry
+
+        if wire_dtype not in wire.WIRE_DTYPES:
+            raise ValueError(
+                f"wire_dtype must be one of {wire.WIRE_DTYPES}, got "
+                f"{wire_dtype!r}"
+            )
+        self.agg_id = int(agg_id)
+        self.expected_children = int(expected_children)
+        self.flush_after = float(flush_after)
+        self.cache_ttl = float(cache_ttl)
+        self.keep_rounds = int(keep_rounds)
+        self.wire_dtype = wire_dtype
+        self.delta = bool(delta)
+        self.max_forward_retries = int(max_forward_retries)
+        self.clock = clock
+        self._upstream = TransportClient(upstream_addr)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        # round key -> {pusher_id: (leaves, weight, covers)}
+        self._pending: dict = {}
+        self._opened: dict = {}  # round key -> first-push time
+        self._defer: dict = {}  # round key -> not-before (retry pacing)
+        self._retries: dict = {}  # round key -> failed forwards so far
+        self._avg_cache: dict[int, list] = {}  # round -> avg leaves
+        self._neg_until: dict[int, float] = {}  # round -> miss expiry
+        self._latest_cache: tuple | None = None  # (expiry, round)
+        self._latest_avg: tuple | None = None  # (expiry, round | None)
+        self._stopping = False
+        self._server = ExchangeServer(
+            store=self, host=host, port=port, handler=_AggHandler
+        )
+        self._thread: threading.Thread | None = None
+        reg = default_registry()
+        self._pushes_ctr = reg.counter(
+            "elastic_agg_pushes_total",
+            "subtree pushes received by mid-tier aggregators",
+        )
+        self._folds_ctr = reg.counter(
+            "elastic_agg_folds_total",
+            "subtree partial averages folded and forwarded upstream",
+        )
+        self._cache_hits = reg.counter(
+            "elastic_agg_cache_hits_total",
+            "subtree reads served from an aggregator's local cache",
+        )
+
+    @property
+    def addr(self) -> str:
+        return self._server.addr
+
+    # ---- lifecycle ----
+
+    def start(self) -> "Aggregator":
+        self._server.start()
+        self._thread = threading.Thread(
+            target=self._flush_loop,
+            name=f"tpuflow-elastic-agg-{self.agg_id}", daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Graceful: stop accepting, flush every pending round upstream
+        (the leaf tier's final pushes ride this), stop. The runner
+        stops tiers leaf-first so each flush lands in a live parent."""
+        self._server.stop()
+        self._join_flush_thread()
+        with self._cond:
+            batch = {k: self._pending.pop(k) for k in list(self._pending)}
+            self._opened.clear()
+            self._defer.clear()
+        for key in sorted(batch, key=str):
+            self._forward(key, batch[key])
+
+    def kill(self) -> None:
+        """Abrupt death for the failover drills: the server vanishes
+        mid-round, nothing is flushed — the subtree's FailoverClient
+        and the root's round machinery own the healing."""
+        self._server.stop()
+        self._join_flush_thread()
+        with self._cond:
+            self._pending.clear()
+            self._opened.clear()
+            self._defer.clear()
+
+    def _join_flush_thread(self) -> None:
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def __enter__(self) -> "Aggregator":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ---- wire dispatch (the _AggHandler entry) ----
+
+    def dispatch(self, header: dict, payload: bytes):
+        op = header.get("op")
+        if op == "ping":
+            return {"ok": True}, b""
+        if op == "push":
+            return self._handle_push(header, payload)
+        if op == "read_average":
+            return self._handle_read_average(int(header["round"]))
+        if op == "latest_round":
+            return self._handle_latest_round()
+        if op == "latest_average":
+            return self._handle_latest_average()
+        # Everything else (heartbeat, offsets, members, pushed_ids) is
+        # relayed verbatim: membership and liveness stay root-stamped.
+        fwd = {k: v for k, v in header.items() if k != "op"}
+        return self._upstream.request(op, fwd, payload)
+
+    def _handle_push(self, header: dict, payload: bytes):
+        enc = header.get("enc") or {}
+        base = None
+        if enc.get("delta"):
+            with self._lock:
+                base = self._avg_cache.get(int(enc["base_round"]))
+            if base is None:
+                return {
+                    "ok": True, "stored": False,
+                    "reason": (
+                        f"delta base round {enc['base_round']} not "
+                        "held by this aggregator"
+                    ),
+                }, b""
+        leaves = wire.decode_push(enc, payload, base=base)
+        wid = int(header["worker_id"])
+        covers = header.get("covers")
+        rec = (
+            leaves,
+            float(header.get("weight", 1.0)),
+            (wid,) if covers is None
+            else tuple(sorted(int(c) for c in covers)),
+        )
+        key = self._round_key(header)
+        with self._cond:
+            if key not in self._pending:
+                self._opened[key] = self.clock()
+            self._pending.setdefault(key, {})[wid] = rec
+            self._cond.notify_all()
+        self._pushes_ctr.inc()
+        return {"ok": True, "stored": True}, b""
+
+    def _handle_read_average(self, round_: int):
+        now = self.clock()
+        with self._lock:
+            cached = self._avg_cache.get(round_)
+            missing_until = self._neg_until.get(round_, 0.0)
+        if cached is not None:
+            self._cache_hits.inc(op="read_average")
+            return (
+                {"ok": True, "found": True},
+                exchange.encode_leaves(cached),
+            )
+        if missing_until > now:
+            self._cache_hits.inc(op="read_average")
+            return {"ok": True, "found": False}, b""
+        resp, data = self._upstream.request(
+            "read_average", {"round": round_}
+        )
+        if not resp.get("found"):
+            with self._lock:
+                self._neg_until[round_] = self.clock() + self.cache_ttl
+            return {"ok": True, "found": False}, b""
+        self._note_average(round_, exchange.decode_leaves(data))
+        return {"ok": True, "found": True}, data
+
+    def _handle_latest_round(self):
+        now = self.clock()
+        with self._lock:
+            cached = self._latest_cache
+        if cached is not None and now < cached[0]:
+            self._cache_hits.inc(op="latest_round")
+            return {"ok": True, "round": cached[1]}, b""
+        resp, _ = self._upstream.request("latest_round")
+        round_ = resp.get("round")
+        with self._lock:
+            self._latest_cache = (self.clock() + self.cache_ttl, round_)
+        return {"ok": True, "round": round_}, b""
+
+    def _handle_latest_average(self):
+        now = self.clock()
+        with self._lock:
+            pointer = self._latest_avg
+            leaves = (
+                self._avg_cache.get(pointer[1])
+                if pointer is not None and pointer[1] is not None
+                else None
+            )
+        if pointer is not None and now < pointer[0]:
+            if pointer[1] is None:
+                self._cache_hits.inc(op="latest_average")
+                return {"ok": True, "found": False}, b""
+            if leaves is not None:
+                self._cache_hits.inc(op="latest_average")
+                return (
+                    {"ok": True, "found": True, "round": pointer[1]},
+                    exchange.encode_leaves(leaves),
+                )
+        resp, data = self._upstream.request("latest_average")
+        if not resp.get("found"):
+            with self._lock:
+                self._latest_avg = (self.clock() + self.cache_ttl, None)
+            return {"ok": True, "found": False}, b""
+        round_ = int(resp["round"])
+        self._note_average(round_, exchange.decode_leaves(data))
+        with self._lock:
+            self._latest_avg = (self.clock() + self.cache_ttl, round_)
+        return {"ok": True, "found": True, "round": round_}, data
+
+    def _note_average(self, round_: int, leaves) -> None:
+        with self._lock:
+            self._avg_cache[round_] = leaves
+            self._neg_until.pop(round_, None)
+            while len(self._avg_cache) > max(self.keep_rounds, 1):
+                del self._avg_cache[min(self._avg_cache)]
+
+    @staticmethod
+    def _round_key(header):
+        r = header.get("round")
+        return r if r == exchange.FINAL_ROUND else int(r)
+
+    # ---- the fold/forward loop ----
+
+    def _ready_keys_locked(self, now: float) -> list:
+        ready = []
+        for key, recs in self._pending.items():
+            if now < self._defer.get(key, 0.0):
+                continue  # a failed forward is pacing this round
+            if (
+                self.expected_children
+                and len(recs) >= self.expected_children
+            ):
+                ready.append(key)
+            elif now - self._opened.get(key, now) >= self.flush_after:
+                ready.append(key)
+        return ready
+
+    def _flush_loop(self) -> None:
+        tick = max(self.flush_after / 4.0, 0.01)
+        while True:
+            with self._cond:
+                while (
+                    not self._stopping
+                    and not self._ready_keys_locked(self.clock())
+                ):
+                    self._cond.wait(timeout=tick)
+                if self._stopping:
+                    return
+                batch = {}
+                for key in self._ready_keys_locked(self.clock()):
+                    batch[key] = self._pending.pop(key)
+                    self._opened.pop(key, None)
+            for key in sorted(batch, key=str):
+                self._forward(key, batch[key])
+
+    def _forward(self, key, recs: dict) -> None:
+        """Fold one round's subtree pushes into a weighted partial
+        average and push it upstream. Runs OUTSIDE the lock; on an
+        upstream transport failure the records are re-queued with a
+        deferral, a bounded number of times."""
+        items = sorted(recs.items())
+        leaves, used = exchange.average_leaf_sets(
+            [(wid, rec[0]) for wid, rec in items],
+            weights=[rec[1] for _, rec in items],
+            context=f"(aggregator {self.agg_id}, round {key}) ",
+        )
+        if leaves is None:
+            return
+        used_set = set(used)
+        total_weight = sum(
+            rec[1] for wid, rec in items if wid in used_set
+        )
+        covers = sorted({
+            c
+            for wid, rec in items if wid in used_set
+            for c in rec[2]
+        })
+        final = key == exchange.FINAL_ROUND
+        base_round = base = None
+        if self.delta and not final:
+            with self._lock:
+                if self._avg_cache:
+                    base_round = max(self._avg_cache)
+                    base = self._avg_cache[base_round]
+        header = {
+            "round": key, "worker_id": self.agg_id,
+            "weight": total_weight, "covers": covers,
+        }
+        try:
+            enc, payload = wire.encode_push(
+                leaves,
+                wire_dtype="f32" if final else self.wire_dtype,
+                base=base, base_round=base_round,
+            )
+            if enc:
+                header["enc"] = enc
+            resp, _ = self._upstream.request("push", header, payload)
+            if not resp.get("stored", True):
+                # Parent pruned past our base: re-push full.
+                enc, payload = wire.encode_push(
+                    leaves,
+                    wire_dtype="f32" if final else self.wire_dtype,
+                )
+                header = {k: v for k, v in header.items() if k != "enc"}
+                if enc:
+                    header["enc"] = enc
+                self._upstream.request("push", header, payload)
+        except (OSError, RuntimeError) as e:
+            self._requeue(key, recs, e)
+            return
+        self._folds_ctr.inc()
+
+    def _requeue(self, key, recs: dict, err: BaseException) -> None:
+        with self._cond:
+            tries = self._retries.get(key, 0) + 1
+            self._retries[key] = tries
+            if tries <= self.max_forward_retries and not self._stopping:
+                pending = self._pending.setdefault(key, {})
+                for wid, rec in recs.items():
+                    pending.setdefault(wid, rec)
+                self._opened.setdefault(key, self.clock())
+                self._defer[key] = self.clock() + self.flush_after
+                dropped = False
+            else:
+                dropped = True
+        print(
+            f"elastic: aggregator {self.agg_id} failed to forward "
+            f"round {key} upstream ({type(err).__name__}: {err}); "
+            + (
+                "dropping the partial (retries exhausted) — the "
+                "subtree sees a missed round"
+                if dropped else
+                f"will retry (attempt {tries}/{self.max_forward_retries})"
+            ),
+            file=sys.stderr,
+        )

@@ -320,10 +320,33 @@ class Coordinator:
         # Average EVERY readable push for the round — including one from
         # a worker that pushed and then died: its params are legitimate
         # round data; eviction only stops the *waiting*.
-        leaves, used = exchange.average_leaf_sets(
-            self.backend.read_pushes(self.round),
-            context=f"for round {self.round} ",
-        )
+        reader = getattr(self.backend, "read_weighted_pushes", None)
+        if reader is not None:
+            # Tree mode (aggregator.py): a pusher may be a mid-tier
+            # aggregator whose record carries its subtree's total
+            # weight and the worker ids it covers. The weighted
+            # re-average of partials IS the flat mean (the fold is
+            # associative), and `used` must name WORKERS, not
+            # aggregator ids, for spans/summaries/waiting-set parity.
+            recs = reader(self.round)
+            leaves, used_pushers = exchange.average_leaf_sets(
+                [(wid, ls) for wid, ls, _w, _c in recs],
+                weights=[w for _, _, w, _ in recs],
+                context=f"for round {self.round} ",
+            )
+            if leaves is not None:
+                pushers = set(used_pushers)
+                used = sorted({
+                    c
+                    for wid, _ls, _w, cov in recs
+                    if wid in pushers
+                    for c in cov
+                })
+        else:
+            leaves, used = exchange.average_leaf_sets(
+                self.backend.read_pushes(self.round),
+                context=f"for round {self.round} ",
+            )
         if leaves is None:
             return False
         self.backend.publish(self.round, leaves, clock=self.clock)

@@ -189,6 +189,12 @@ def run_elastic(
     transport_addr: str | None = None,
     async_push: bool = False,
     max_staleness: int = 2,
+    fanout: int | None = None,
+    tiers: int | None = None,
+    delta: bool | None = None,
+    wire_dtype: str | None = None,
+    opt_policy: str = "carry",
+    on_gang_up=None,
     sync_every: int = 1,
     heartbeat_interval: float = 0.25,
     heartbeat_timeout: float = 30.0,
@@ -226,6 +232,18 @@ def run_elastic(
     land in the per-worker ``WorkerOutcome.error`` so a partial gang
     still reports what the survivors produced.
 
+    ``fanout`` > 0 (or ``TPUFLOW_ELASTIC_FANOUT``) switches the socket
+    gang to tree aggregation (``aggregator.py``): ``tiers`` levels of
+    mid-tier aggregators fold subtree pushes and forward one weighted
+    partial each, each worker dials its leaf aggregator with the root
+    as failover fallback, and the aggregators are stopped leaf-tier
+    first so final pushes flush upward. ``delta``/``wire_dtype`` pick
+    the push encoding (``wire.py``); ``opt_policy`` picks what happens
+    to optimizer state on adoption (docs/elastic.md). ``on_gang_up``
+    (tests/benchmarks) is called once every thread is running, with
+    ``{"server", "aggregators", "coordinator", "stop"}`` — the seam
+    kill drills reach the live tree through.
+
     ``stop_event`` (inprocess mode only) is the runtime supervisor's
     drain handle: setting it asks every worker to stop cooperatively at
     its next epoch boundary via ``train(stop_fn=...)`` — the stop is an
@@ -248,6 +266,28 @@ def run_elastic(
         raise ValueError(
             f"transport must be 'file' or 'socket', got {transport!r}"
         )
+    from tpuflow.elastic.aggregator import (
+        default_fanout,
+        default_tiers,
+        plan_tree,
+    )
+
+    fanout = default_fanout() if fanout is None else int(fanout)
+    tiers = default_tiers() if tiers is None else int(tiers)
+    tree_levels = []
+    if fanout:
+        if transport != "socket":
+            raise ValueError(
+                "tree aggregation (fanout > 0) needs transport="
+                "'socket' — aggregators speak the TPFX wire protocol"
+            )
+        if async_push:
+            raise ValueError(
+                "tree aggregation folds per-round subtree barriers and "
+                "async_push has no rounds to barrier on — use one or "
+                "the other"
+            )
+        tree_levels = plan_tree(n_workers, fanout, tiers)
     if worker_faults and mode == "inprocess":
         from tpuflow.resilience import parse_fault_spec
 
@@ -323,9 +363,34 @@ def run_elastic(
         "transport": transport,
         "async_push": async_push,
         "max_staleness": max_staleness,
+        "opt_policy": opt_policy,
     }
     if server is not None:
         overrides["addr"] = server.addr
+        # Resolve the wire-encoding knobs HERE (explicit args, then the
+        # TPUFLOW_ELASTIC_* env family, then the static defaults) so
+        # workers and aggregators agree on one encoding — a worker
+        # reading the env while its aggregator doesn't would split the
+        # gang's wire format.
+        from tpuflow.utils.env import env_choice, env_flag
+
+        delta = (
+            env_flag("TPUFLOW_ELASTIC_DELTA", False)
+            if delta is None else bool(delta)
+        )
+        wire_dtype = (
+            env_choice(
+                "TPUFLOW_ELASTIC_WIRE_DTYPE", "f32", ("f32", "bf16")
+            )
+            if wire_dtype is None else wire_dtype
+        )
+        overrides["delta"] = delta
+        overrides["wire_dtype"] = wire_dtype
+    elif delta or (wire_dtype not in (None, "f32")):
+        raise ValueError(
+            "delta / wire_dtype are socket-transport wire encodings; "
+            "the file backend exchanges full f32"
+        )
     # Fail at submission, not N jax-import-heavy worker launches
     # later: a bad knob (sync_every=0, negative timeout) or a bad base
     # job (stream=True, typo'd model) must die HERE, in this process,
@@ -356,6 +421,37 @@ def run_elastic(
         if server is not None:  # a rejected submission must not leak it
             server.stop()
         raise
+    # The aggregation tree, parents first: a child's first forward must
+    # find its upstream dialable. `aggregators` ends up top-tier-first,
+    # so teardown iterates it REVERSED (leaf tier first) and every
+    # flush lands in a live parent.
+    aggregators: list = []
+    agg_addr_for: dict[int, str] = {}
+    if tree_levels:
+        from tpuflow.elastic.aggregator import Aggregator
+
+        try:
+            addr_of: dict[int, str] = {}
+            for level in reversed(tree_levels):
+                for node in level:
+                    agg = Aggregator(
+                        node.agg_id,
+                        addr_of[node.parent]
+                        if node.parent is not None else server.addr,
+                        expected_children=len(node.children),
+                        wire_dtype=wire_dtype,
+                        delta=delta,
+                    ).start()
+                    aggregators.append(agg)
+                    addr_of[node.agg_id] = agg.addr
+            for node in tree_levels[0]:
+                for wid in node.children:
+                    agg_addr_for[wid] = addr_of[node.agg_id]
+        except BaseException:
+            for agg in aggregators:
+                agg.kill()
+            server.stop()
+            raise
     coordinator = Coordinator(
         meta_dir,
         heartbeat_timeout=heartbeat_timeout,
@@ -381,9 +477,19 @@ def run_elastic(
     outcomes = [WorkerOutcome(worker_id=i) for i in range(n_workers)]
 
     def _work(i: int):
+        wover = overrides
+        if i in agg_addr_for:
+            # Tree mode: dial the leaf aggregator; the root is the
+            # failover fallback — an aggregator kill re-parents this
+            # worker's subtree to the root mid-round.
+            wover = {
+                **overrides,
+                "addr": agg_addr_for[i],
+                "fallback_addrs": [server.addr],
+            }
         wspec = worker_spec(
             spec, gang_dir, i, n_workers,
-            sync_every=sync_every, elastic_overrides=overrides,
+            sync_every=sync_every, elastic_overrides=wover,
         )
         if worker_faults and i in worker_faults:
             wspec["faults"] = list(worker_faults[i])
@@ -448,12 +554,23 @@ def run_elastic(
     try:
         for t in workers:
             t.start()
+        if on_gang_up is not None:
+            on_gang_up({
+                "server": server,
+                "aggregators": list(aggregators),
+                "coordinator": coordinator,
+                "stop": stop,
+            })
         for t in workers:
             t.join()
+        for agg in reversed(aggregators):
+            agg.stop()  # leaf tier first: finals flush up a live chain
         stop.set()
         coord_thread.join(timeout=30)
     finally:
         stop.set()
+        for agg in reversed(aggregators):
+            agg.kill()  # no-op after a clean stop(); kills on error
         if server is not None:
             server.stop()
 
@@ -461,10 +578,29 @@ def run_elastic(
         coord_backend if coord_backend is not None
         else exchange.FileExchange(gang_dir)
     )
-    final_leaves, final_ids = exchange.average_leaf_sets(
-        final_backend.read_pushes(exchange.FINAL_ROUND),
-        context="for the final round ",
-    )
+    weighted = getattr(final_backend, "read_weighted_pushes", None)
+    if weighted is not None:
+        # Socket/tree gangs: a final push may be an aggregator's
+        # weighted partial covering several workers — re-average by
+        # weight and report the covered WORKER ids.
+        recs = weighted(exchange.FINAL_ROUND)
+        final_leaves, used_pushers = exchange.average_leaf_sets(
+            [(wid, ls) for wid, ls, _w, _c in recs],
+            weights=[w for _, _, w, _ in recs],
+            context="for the final round ",
+        )
+        pushers = set(used_pushers)
+        final_ids = sorted({
+            c
+            for wid, _ls, _w, cov in recs
+            if wid in pushers
+            for c in cov
+        })
+    else:
+        final_leaves, final_ids = exchange.average_leaf_sets(
+            final_backend.read_pushes(exchange.FINAL_ROUND),
+            context="for the final round ",
+        )
     final_path = None
     if final_leaves is not None:
         if store_gang:

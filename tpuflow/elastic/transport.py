@@ -175,14 +175,31 @@ class GangStore:
     terminal goodbyes, publish-then-repoint averages, staleness-horizon
     prune. Thread-safe (the server's handler threads and the
     coordinator's scan share it); ``clock`` is injectable so liveness
-    drills run wall-clock-free."""
+    drills run wall-clock-free.
 
-    def __init__(self, clock=time.time):
+    ``keep_rounds`` bounds the store's own memory the way the file
+    backend's prune bounds disk: every publish drops pushes and
+    averages older than ``latest - keep_rounds``, whether or not the
+    coordinator ever calls :meth:`prune` (the async path and the
+    aggregator tier both publish without driving the coordinator's
+    live-member-aware prune on every round). 0 disables the bound.
+
+    A push record carries a ``weight`` and a ``covers`` set — a
+    mid-tier aggregator's partial average arrives as ONE push whose
+    weight is its subtree's fold weight and whose covers list the
+    worker ids folded into it (``aggregator.py``); a plain worker push
+    is the degenerate record (weight 1, covers = itself). Weighted
+    re-averaging of partial averages reproduces the flat mean exactly
+    (the weighted-mean math is associative)."""
+
+    def __init__(self, clock=time.time, keep_rounds: int = 64):
         self.clock = clock
+        self.keep_rounds = int(keep_rounds)
         self._lock = threading.Lock()
         self._members: dict[int, dict] = {}
         self._goodbyes: dict[int, str] = {}
-        self._pushes: dict = {}  # round key -> {wid: leaves}
+        # round key -> {pusher_id: {"leaves", "weight", "covers"}}
+        self._pushes: dict = {}
         self._averages: dict[int, list[np.ndarray]] = {}
         self._latest: int | None = None
         self._offsets: dict[int, int] = {}
@@ -262,25 +279,61 @@ class GangStore:
             round, worker_id, exchange.flatten_params(params)
         )
 
-    def push_leaves(self, round, worker_id: int, leaves) -> None:
+    def push_leaves(
+        self, round, worker_id: int, leaves, *,
+        weight: float = 1.0, covers=None,
+    ) -> None:
         key = round if round == exchange.FINAL_ROUND else int(round)
+        wid = int(worker_id)
+        rec = {
+            "leaves": leaves,
+            "weight": float(weight),
+            "covers": (
+                (wid,) if covers is None
+                else tuple(sorted(int(c) for c in covers))
+            ),
+        }
         with self._lock:
-            self._pushes.setdefault(key, {})[int(worker_id)] = leaves
+            self._pushes.setdefault(key, {})[wid] = rec
 
     def pushed_ids(self, round) -> set[int]:
+        """The WORKER ids a round's pushes cover — the union of every
+        push record's ``covers``, so the coordinator's waiting-set math
+        sees through aggregator partial averages to the workers whose
+        params they fold."""
         key = round if round == exchange.FINAL_ROUND else int(round)
+        out: set[int] = set()
         with self._lock:
-            return set(self._pushes.get(key, {}))
+            for rec in self._pushes.get(key, {}).values():
+                out.update(rec["covers"])
+        return out
 
     def read_pushes(
         self, round, include: set[int] | None = None
     ) -> list[tuple[int, list[np.ndarray]]]:
         key = round if round == exchange.FINAL_ROUND else int(round)
         with self._lock:
-            items = sorted(self._pushes.get(key, {}).items())
+            items = sorted(
+                (wid, rec["leaves"])
+                for wid, rec in self._pushes.get(key, {}).items()
+            )
         if include is not None:
             items = [(w, ls) for w, ls in items if w in include]
         return items
+
+    def read_weighted_pushes(
+        self, round
+    ) -> list[tuple[int, list[np.ndarray], float, tuple[int, ...]]]:
+        """Every push for ``round`` as ``(pusher_id, leaves, weight,
+        covers)`` — the fold input the coordinator (and the runner's
+        final average) uses so aggregator partials re-average into the
+        exact flat mean."""
+        key = round if round == exchange.FINAL_ROUND else int(round)
+        with self._lock:
+            return sorted(
+                (wid, rec["leaves"], rec["weight"], rec["covers"])
+                for wid, rec in self._pushes.get(key, {}).items()
+            )
 
     def _newest_push_rounds_locked(self, min_round: int) -> dict:
         newest: dict[int, int] = {}
@@ -309,7 +362,8 @@ class GangStore:
         with self._lock:
             newest = self._newest_push_rounds_locked(min_round)
             return [
-                (wid, newest[wid], self._pushes[newest[wid]][wid])
+                (wid, newest[wid],
+                 self._pushes[newest[wid]][wid]["leaves"])
                 for wid in sorted(newest)
             ]
 
@@ -318,6 +372,13 @@ class GangStore:
             self._averages[int(round)] = leaves
             if self._latest is None or round > self._latest:
                 self._latest = int(round)
+            if self.keep_rounds:
+                # The store's own memory bound (file-backend parity):
+                # the coordinator's live-member-aware prune is the
+                # primary policy, this backstop guarantees the
+                # in-memory store cannot grow without bound even when
+                # nobody drives prune().
+                self._prune_locked(self._latest - self.keep_rounds)
 
     def read_average(self, round: int):
         with self._lock:
@@ -336,19 +397,22 @@ class GangStore:
                 return None
             return self._latest, leaves
 
-    def prune(self, below: int) -> int:
+    def _prune_locked(self, below: int) -> int:
         removed = 0
-        with self._lock:
-            for key in [
-                k for k in self._pushes
-                if k != exchange.FINAL_ROUND and k < below
-            ]:
-                del self._pushes[key]
-                removed += 1
-            for key in [k for k in self._averages if k < below]:
-                del self._averages[key]
-                removed += 1
+        for key in [
+            k for k in self._pushes
+            if k != exchange.FINAL_ROUND and k < below
+        ]:
+            del self._pushes[key]
+            removed += 1
+        for key in [k for k in self._averages if k < below]:
+            del self._averages[key]
+            removed += 1
         return removed
+
+    def prune(self, below: int) -> int:
+        with self._lock:
+            return self._prune_locked(below)
 
     # --- offsets ---
 
@@ -412,11 +476,29 @@ class _Handler(socketserver.BaseRequestHandler):
         if op == "push":
             if header.get("trace"):
                 store.note_trace(int(header["worker_id"]), header["trace"])
+            enc = header.get("enc") or {}
+            base = None
+            if enc.get("delta"):
+                base = store.read_average(int(enc["base_round"]))
+                if base is None:
+                    # Pruned past the sender's base: a structured slow
+                    # path, not an error — the sender re-pushes full.
+                    return {
+                        "ok": True, "stored": False,
+                        "reason": (
+                            f"delta base round {enc['base_round']} "
+                            "not held here"
+                        ),
+                    }, b""
+            from tpuflow.elastic import wire
+
             store.push_leaves(
                 self._round_key(header), int(header["worker_id"]),
-                exchange.decode_leaves(payload),
+                wire.decode_push(enc, payload, base=base),
+                weight=float(header.get("weight", 1.0)),
+                covers=header.get("covers"),
             )
-            return {"ok": True}, b""
+            return {"ok": True, "stored": True}, b""
         if op == "read_average":
             leaves = store.read_average(int(header["round"]))
             if leaves is None:
@@ -472,9 +554,13 @@ class ExchangeServer:
     def __init__(
         self, store: GangStore | None = None,
         host: str = "127.0.0.1", port: int = 0,
+        handler=_Handler,
     ):
+        # ``handler`` lets a mid-tier aggregator reuse the whole server
+        # scaffold (framing, threading, lifecycle) with its own
+        # dispatch; ``store`` is then the aggregator itself.
         self.store = store if store is not None else GangStore()
-        self._server = _TCPServer((host, port), _Handler)
+        self._server = _TCPServer((host, port), handler)
         self._server.store = self.store  # type: ignore[attr-defined]
         self._thread: threading.Thread | None = None
 
@@ -520,9 +606,18 @@ class TransportClient:
     path a real flaky network would."""
 
     def __init__(self, addr: str, *, timeout: float | None = None):
+        from tpuflow.obs import default_registry
+
         self.host, self.port = parse_addr(addr)
         self.addr = addr
         self.timeout = timeout if timeout is not None else connect_timeout()
+        # Client-side payload-byte accounting per op and direction —
+        # the measurement behind the tree/delta/bf16 wire-byte claims
+        # (benchmarks/bench_elastic_tree.py reads counter deltas).
+        self._wire_bytes = default_registry().counter(
+            "elastic_wire_bytes_total",
+            "TPFX payload bytes sent/received on the client side",
+        )
 
     def request(
         self, op: str, header: dict | None = None,
@@ -550,8 +645,11 @@ class TransportClient:
                 if trace is not None:
                     hdr.setdefault("trace", trace)
                 send_frame(sock, hdr, payload)
+                self._wire_bytes.inc(len(payload), op=op, dir="sent")
                 fault_point("elastic.transport.recv")
-                return recv_frame(sock)
+                got = recv_frame(sock)
+                self._wire_bytes.inc(len(got[1]), op=op, dir="recv")
+                return got
 
         resp, data = retry_call(io_policy(), attempt)
         if not resp.get("ok"):
@@ -562,30 +660,171 @@ class TransportClient:
         return resp, data
 
 
+class FailoverClient:
+    """A :class:`TransportClient` over an ordered address list: the
+    primary (a worker's assigned mid-tier aggregator) first, fallbacks
+    (root — or a sibling aggregator) after it. A transport-class
+    failure on one address marks it dead for ``retry_after`` seconds
+    and the SAME request proceeds against the next — so a killed
+    aggregator costs its subtree one retry-policy exhaustion, after
+    which every op goes straight to the fallback and the round
+    completes with nobody degraded. The dead mark expires: the primary
+    is re-probed every ``retry_after`` and the subtree re-parents back
+    the moment it answers (the sticky-goodbye machinery upstream never
+    notices — heartbeats simply arrive via a different path).
+
+    Op-level server errors (``RuntimeError``) do NOT fail over: the
+    peer answered, the request itself was bad. ``clock`` is injectable
+    so the death-classification drills run wall-clock-free."""
+
+    def __init__(
+        self, addrs, *, timeout: float | None = None,
+        retry_after: float = 5.0, clock=time.monotonic,
+    ):
+        from tpuflow.obs import default_registry
+
+        addrs = list(addrs)
+        if not addrs:
+            raise ValueError("FailoverClient needs at least one addr")
+        self._clients = [
+            TransportClient(a, timeout=timeout) for a in addrs
+        ]
+        self._dead_until = [0.0] * len(self._clients)
+        self._dead_lock = threading.Lock()  # heartbeat thread + sync
+        # path share the dead marks
+        self.retry_after = float(retry_after)
+        self.clock = clock
+        self._failovers = default_registry().counter(
+            "elastic_agg_failovers_total",
+            "exchange addresses marked dead and failed over from",
+        )
+
+    @property
+    def addr(self) -> str:
+        return self._clients[0].addr
+
+    def alive_index(self) -> int:
+        """The index of the first address not currently marked dead
+        (len(addrs) when all are) — the death-classification probe the
+        drills and the re-parenting tests read."""
+        now = self.clock()
+        with self._dead_lock:
+            for i, until in enumerate(self._dead_until):
+                if until <= now:
+                    return i
+            return len(self._dead_until)
+
+    def request(
+        self, op: str, header: dict | None = None,
+        payload: bytes = b"", index: int | None = None,
+    ) -> tuple[dict, bytes]:
+        now = self.clock()
+        with self._dead_lock:
+            marks = list(self._dead_until)
+        order = [i for i, t in enumerate(marks) if t <= now]
+        # Everything marked dead still gets tried LAST: a fully-dark
+        # address list must surface the real transport error (the
+        # worker's degrade policy owns what happens next), not wedge.
+        order += [i for i, t in enumerate(marks) if t > now]
+        last_err: BaseException | None = None
+        for i in order:
+            try:
+                return self._clients[i].request(
+                    op, header, payload, index=index
+                )
+            except RuntimeError:
+                raise  # the server answered; not a liveness problem
+            except (OSError, TransportError) as e:
+                last_err = e
+                with self._dead_lock:
+                    self._dead_until[i] = (
+                        self.clock() + self.retry_after
+                    )
+                self._failovers.inc(addr=self._clients[i].addr)
+        assert last_err is not None
+        raise last_err
+
+
 class SocketExchange:
     """The worker-side backend over TCP — the same contract
     ``FileExchange`` implements, minus the coordinator-only scans (the
     coordinator co-hosts the :class:`GangStore` and reads it directly).
     ``network = True`` tells the worker that errors here are a PEER
     problem: degrade to local training and resync on reconnect, never
-    die (``worker.py`` owns that policy)."""
+    die (``worker.py`` owns that policy).
+
+    ``fallbacks`` names failover exchange addresses (tree mode: the
+    root server behind the worker's aggregator — see
+    :class:`FailoverClient`). ``wire_dtype``/``delta`` select the push
+    encoding (``wire.py``); the worker notes each adopted average via
+    :meth:`note_adopted` so delta pushes have a base both sides hold."""
 
     network = True
 
-    def __init__(self, addr: str, *, timeout: float | None = None):
+    def __init__(
+        self, addr: str, *, timeout: float | None = None,
+        fallbacks=(), wire_dtype: str = "f32", delta: bool = False,
+        retry_after: float = 5.0,
+    ):
+        from tpuflow.elastic import wire
+
+        if wire_dtype not in wire.WIRE_DTYPES:
+            raise ValueError(
+                f"wire_dtype must be one of {wire.WIRE_DTYPES}, got "
+                f"{wire_dtype!r}"
+            )
         self.addr = addr
-        self._client = TransportClient(addr, timeout=timeout)
+        self.wire_dtype = wire_dtype
+        self.delta = bool(delta)
+        self._base: tuple[int, list] | None = None  # last adopted avg
+        self._client = FailoverClient(
+            [addr, *fallbacks], timeout=timeout, retry_after=retry_after,
+        )
 
     # --- params ---
 
+    def note_adopted(self, round: int, leaves) -> None:
+        """Remember the average this worker last adopted — the delta
+        base for subsequent pushes (one extra host copy of the params;
+        only kept when delta encoding is on)."""
+        if self.delta:
+            self._base = (int(round), list(leaves))
+
     def push(self, round, worker_id: int, params) -> None:
+        from tpuflow.elastic import wire
+
         index = None if round == exchange.FINAL_ROUND else int(round)
         fault_point("elastic.push", index=index)
-        self._client.request(
-            "push", {"round": round, "worker_id": int(worker_id)},
-            exchange.encode_leaves(exchange.flatten_params(params)),
-            index=index,
+        leaves = exchange.flatten_params(params)
+        # The final push is the gang's deliverable: always full f32 —
+        # quantizing it would quantize the final average itself.
+        final = round == exchange.FINAL_ROUND
+        base_round, base = (
+            self._base if (self.delta and not final and self._base)
+            else (None, None)
         )
+        enc, payload = wire.encode_push(
+            leaves,
+            wire_dtype="f32" if final else self.wire_dtype,
+            base=base, base_round=base_round,
+        )
+        header = {"round": round, "worker_id": int(worker_id)}
+        if enc:
+            header["enc"] = enc
+        resp, _ = self._client.request(
+            "push", header, payload, index=index
+        )
+        if not resp.get("stored", True):
+            # The receiver pruned past our delta base: re-push full
+            # (still bf16-quantized when configured) — slow path, never
+            # a lost push.
+            enc, payload = wire.encode_push(
+                leaves, wire_dtype="f32" if final else self.wire_dtype,
+            )
+            header = {"round": round, "worker_id": int(worker_id)}
+            if enc:
+                header["enc"] = enc
+            self._client.request("push", header, payload, index=index)
 
     def read_average(self, round: int):
         resp, data = self._client.request(

@@ -1,0 +1,168 @@
+"""Wire encodings for parameter pushes: delta against the last adopted
+average, optionally quantized to bfloat16 on the wire.
+
+The TPFX payload (``exchange.encode_leaves``) carries full-f32 leaves by
+default. At gang scale the push traffic dominates the exchange, and two
+orthogonal reductions compose here:
+
+- **Delta encoding** (``delta``): the worker sends ``params - base``
+  where ``base`` is the average it last adopted. The receiver, which
+  published that average, reconstructs ``base + delta`` exactly (both
+  sides hold the same f32 base). Deltas shrink the *quantization* cost
+  of the second reduction — the error of rounding a delta is
+  proportional to the delta's magnitude, not the parameter's.
+- **bf16 quantization** (``wire_dtype="bf16"``): each floating leaf is
+  round-to-nearest-even truncated to the top 16 bits of its f32
+  pattern and shipped as ``uint16`` — exactly half the bytes. numpy has
+  no native bfloat16, so the bits ride as ``uint16`` and the per-leaf
+  flag list in the encoding header says which leaves to re-expand.
+
+Masters stay f32 (the PR 10 precision policy): quantization happens at
+the moment of encoding and is undone at the moment of decoding —
+every fold (``exchange.average_leaf_sets``) runs on f32/f64, at every
+tier. Non-floating leaves (step counters under the ``opt_policy=
+"average"`` payload) pass through both stages untouched.
+
+The encoding header (``enc`` on the TPFX frame) is self-describing::
+
+    {"delta": true, "base_round": 7, "bf16": [1, 1, 0, ...]}
+
+A receiver that no longer holds ``base_round``'s average (pruned past
+it) answers ``stored: false`` instead of an error and the sender
+re-pushes a full encoding — a slow path, never a lost push.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpuflow.elastic import exchange
+
+WIRE_DTYPES = ("f32", "bf16")
+
+
+class DeltaBaseUnavailable(ValueError):
+    """A delta-encoded payload references a base average the decoder
+    does not hold (pruned, or never published here). The transport
+    layer turns this into a ``stored: false`` response so the sender
+    falls back to a full push."""
+
+
+def quantize_bf16(a: np.ndarray) -> np.ndarray:
+    """f32 array -> its bfloat16 bit pattern as ``uint16`` (IEEE
+    round-to-nearest-even on the dropped mantissa half), half the
+    bytes of the input."""
+    bits = np.ascontiguousarray(a, np.float32).view(np.uint32)
+    # Round-to-nearest-even: add 0x7FFF plus the current LSB of the
+    # kept half, so exactly-halfway values round to an even result.
+    rounding = ((bits >> 16) & np.uint32(1)) + np.uint32(0x7FFF)
+    return ((bits + rounding) >> 16).astype(np.uint16)
+
+
+def dequantize_bf16(u: np.ndarray) -> np.ndarray:
+    """bfloat16 bit pattern (``uint16``) -> f32 (exact expansion)."""
+    return (
+        np.ascontiguousarray(u, np.uint16).astype(np.uint32) << 16
+    ).view(np.float32)
+
+
+def encode_push(
+    leaves: list[np.ndarray],
+    *,
+    wire_dtype: str = "f32",
+    base: list[np.ndarray] | None = None,
+    base_round: int | None = None,
+) -> tuple[dict, bytes]:
+    """Leaves -> ``(enc_header, payload_bytes)``.
+
+    ``base`` (with its ``base_round``) switches on delta encoding;
+    ``wire_dtype="bf16"`` quantizes floating leaves. The header is
+    ``{}`` for a plain full-f32 push — absent from the frame, so the
+    non-tree wire format is byte-identical to what it always was.
+    """
+    if wire_dtype not in WIRE_DTYPES:
+        raise ValueError(
+            f"wire_dtype must be one of {WIRE_DTYPES}, got {wire_dtype!r}"
+        )
+    enc: dict = {}
+    out = [np.asarray(leaf) for leaf in leaves]
+    if base is not None:
+        if base_round is None:
+            raise ValueError("delta encoding needs base_round")
+        if len(base) != len(out):
+            raise ValueError(
+                f"delta base has {len(base)} leaves; push has "
+                f"{len(out)} — stale base from a different layout"
+            )
+        deltas = []
+        for leaf, b in zip(out, base):
+            if np.issubdtype(leaf.dtype, np.floating):
+                deltas.append(
+                    np.asarray(leaf, np.float32)
+                    - np.asarray(b, np.float32)
+                )
+            else:
+                deltas.append(leaf)  # counters ship whole
+        out = deltas
+        enc["delta"] = True
+        enc["base_round"] = int(base_round)
+    if wire_dtype == "bf16":
+        flags = []
+        packed = []
+        for leaf in out:
+            if np.issubdtype(leaf.dtype, np.floating):
+                packed.append(quantize_bf16(leaf))
+                flags.append(1)
+            else:
+                packed.append(leaf)
+                flags.append(0)
+        out = packed
+        enc["bf16"] = flags
+    return enc, exchange.encode_leaves(out)
+
+
+def decode_push(
+    enc: dict | None,
+    payload: bytes,
+    *,
+    base: list[np.ndarray] | None = None,
+) -> list[np.ndarray]:
+    """``(enc_header, payload)`` -> full f32 leaves, undoing bf16 then
+    delta. A delta payload with no ``base`` raises
+    :class:`DeltaBaseUnavailable` (the caller resolves the base round
+    and decides the fallback)."""
+    enc = enc or {}
+    leaves = exchange.decode_leaves(payload)
+    flags = enc.get("bf16")
+    if flags:
+        if len(flags) != len(leaves):
+            raise ValueError(
+                f"bf16 flag list covers {len(flags)} leaves; payload "
+                f"has {len(leaves)}"
+            )
+        leaves = [
+            dequantize_bf16(leaf) if flag else leaf
+            for leaf, flag in zip(leaves, flags)
+        ]
+    if enc.get("delta"):
+        if base is None:
+            raise DeltaBaseUnavailable(
+                f"delta push against round {enc.get('base_round')!r} "
+                "but that average is not held here"
+            )
+        if len(base) != len(leaves):
+            raise ValueError(
+                f"delta base has {len(base)} leaves; payload has "
+                f"{len(leaves)} — mixed layouts"
+            )
+        leaves = [
+            np.asarray(
+                np.asarray(leaf, np.float32)
+                + np.asarray(b, np.float32),
+                np.float32,
+            )
+            if np.issubdtype(np.asarray(leaf).dtype, np.floating)
+            else leaf
+            for leaf, b in zip(leaves, base)
+        ]
+    return leaves

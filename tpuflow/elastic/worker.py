@@ -108,6 +108,7 @@ class ElasticWorkerClient:
         self.poll_interval = float(cfg["poll_interval"])
         self.warm_start = bool(cfg["warm_start"])
         self.async_push = bool(cfg["async_push"])
+        self.opt_policy = cfg["opt_policy"]
         self.backend = make_backend(cfg)
         self.degraded = False  # transport lost: training local-only
         self._adopted_round = -1  # newest average this worker runs on
@@ -317,7 +318,7 @@ class ElasticWorkerClient:
             return state
         ok, _ = self._guard(
             "push", self.backend.push, round, self.worker_id,
-            state.params,
+            self._push_payload(state),
         )
         if not ok:
             self._missed.inc()
@@ -344,7 +345,7 @@ class ElasticWorkerClient:
         coordinator's staleness bound's problem, not a barrier's."""
         ok, _ = self._guard(
             "push", self.backend.push, round, self.worker_id,
-            state.params,
+            self._push_payload(state),
         )
         if ok:
             self._pushes.inc()
@@ -357,21 +358,107 @@ class ElasticWorkerClient:
             return state  # nothing fresher than what we already run on
         return self._adopt(state, leaves, round=latest_round)
 
+    def _push_payload(self, state):
+        """What a non-final push ships. Params, or — under
+        ``opt_policy="average"`` — the combined tree
+        ``{"m": [floating opt leaves], "p": params}``: dict keys sort
+        ``"m" < "p"``, so the moment leaves flatten FIRST at every
+        tier's fold and the adopt-side split is purely positional.
+        Non-floating opt leaves (step counters) never ride the wire —
+        averaging a count is meaningless and each worker keeps its own
+        decay-schedule position."""
+        if self.opt_policy != "average":
+            return state.params
+        import jax
+        import jax.numpy as jnp
+
+        moments = [
+            leaf
+            for leaf in jax.tree_util.tree_leaves(state.opt_state)
+            if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
+        ]
+        return {"m": moments, "p": state.params}
+
     def _adopt(self, state, leaves, round: int | None = None):
         """Replace the live params with a rebroadcast's leaves — THE
         one adoption path (warm start, catch-up, per-round sync, and
         async freshest-adopt all ride it), structure-checked by
-        ``apply_params``."""
+        ``apply_params``. ``opt_policy`` decides what happens to the
+        optimizer state alongside (docs/elastic.md): carry it (the
+        historical behavior), reset its momenta for the new params, or
+        — when the gang ships combined moments+params payloads — adopt
+        the averaged moments too."""
+        import jax
+
         from tpuflow.elastic.exchange import unflatten_like
         from tpuflow.train.resume import apply_params
 
-        state = apply_params(
-            state, unflatten_like(state.params, leaves)
-        )
+        n_params = len(jax.tree_util.tree_leaves(state.params))
+        if self.opt_policy == "average" and len(leaves) > n_params:
+            state = self._adopt_with_moments(state, leaves, n_params)
+        else:
+            state = apply_params(
+                state, unflatten_like(state.params, leaves)
+            )
+            if self.opt_policy == "reset":
+                from tpuflow.train.optim import reset_opt_state
+
+                state = reset_opt_state(state)
         self._adopts.inc()
         if round is not None:
             self._adopted_round = max(self._adopted_round, round)
+            # Delta-encoding bookkeeping (socket transport): the newly
+            # adopted average is the base the next push is encoded
+            # against — both ends hold the same f32 leaves.
+            note = getattr(self.backend, "note_adopted", None)
+            if note is not None:
+                note(round, leaves)
         return state
+
+    def _adopt_with_moments(self, state, leaves, n_params: int):
+        """Split a combined average (``_push_payload``'s layout: moment
+        leaves first, then params) and adopt both halves — params via
+        the structure-checked ``apply_params``, moments merged back
+        into the floating slots of this worker's optimizer state
+        (counters stay local), cast to each slot's dtype."""
+        import jax
+        import jax.numpy as jnp
+
+        from tpuflow.elastic.exchange import unflatten_like
+        from tpuflow.train.resume import apply_params
+
+        opt_leaves, opt_def = jax.tree_util.tree_flatten(state.opt_state)
+        float_slots = [
+            i for i, leaf in enumerate(opt_leaves)
+            if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
+        ]
+        n_moments = len(leaves) - n_params
+        if n_moments != len(float_slots):
+            raise ValueError(
+                f"averaged payload carries {n_moments} moment leaves "
+                f"but this worker's optimizer state has "
+                f"{len(float_slots)} floating leaves — mixed "
+                "opt_policy or optimizer config across the gang?"
+            )
+        state = apply_params(
+            state,
+            unflatten_like(state.params, list(leaves[n_moments:])),
+        )
+        merged = list(opt_leaves)
+        for slot, leaf in zip(float_slots, leaves[:n_moments]):
+            old = jnp.asarray(opt_leaves[slot])
+            got = jnp.asarray(leaf)
+            if got.shape != old.shape:
+                raise ValueError(
+                    f"averaged moment leaf {slot} has shape "
+                    f"{tuple(got.shape)} but this worker's is "
+                    f"{tuple(old.shape)} — mixed optimizer config "
+                    "across the gang?"
+                )
+            merged[slot] = got.astype(old.dtype)
+        return state.replace(
+            opt_state=jax.tree_util.tree_unflatten(opt_def, merged)
+        )
 
     def _gang_moved_past(self, round: int) -> bool:
         """True when the gang's newest published round is beyond

@@ -84,6 +84,43 @@ def scale_lr_in_state(state, factor: float):
     return state.replace(opt_state=new_opt_state)
 
 
+def reset_opt_state(state):
+    """Re-initialize the optimizer state for the CURRENT params — the
+    ``opt_policy="reset"`` half of elastic adoption (docs/elastic.md):
+    after adopting a gang average, locally-accumulated momentum points
+    along a trajectory the averaged params are no longer on.
+
+    Only the floating leaves (momenta, EMAs) are reset; non-floating
+    leaves (step counters) are kept from the old state — zeroing the
+    count would restart ``keras_sgd``'s inverse-time decay schedule at
+    its hottest learning rate mid-run. The runtime ``lr_scale`` leaf is
+    likewise carried: the numerics watchdog's halvings are a property
+    of this worker's run, not of the momentum trajectory. Pure
+    host-side surgery, same shapes/dtypes — no retrace."""
+    import jax
+    import jax.numpy as jnp
+
+    fresh = state.tx.init(state.params)
+    old_leaves, old_def = jax.tree_util.tree_flatten(state.opt_state)
+    new_leaves, new_def = jax.tree_util.tree_flatten(fresh)
+    if old_def != new_def:
+        # A structurally different state (restored from an older
+        # optimizer config) cannot be leaf-merged; fresh is the only
+        # coherent choice.
+        return state.replace(opt_state=fresh)
+    merged = [
+        new if jnp.issubdtype(jnp.asarray(new).dtype, jnp.floating)
+        else old
+        for old, new in zip(old_leaves, new_leaves)
+    ]
+    out = jax.tree_util.tree_unflatten(new_def, merged)
+    if isinstance(out, LrScaleState) and isinstance(
+        state.opt_state, LrScaleState
+    ):
+        out = LrScaleState(inner=out.inner, lr_scale=state.opt_state.lr_scale)
+    return state.replace(opt_state=out)
+
+
 def keras_sgd(
     learning_rate: float = 1e-3,
     momentum: float = 0.99,
