@@ -151,6 +151,28 @@ class TestWireCodec:
         got = wire.dequantize_bf16(wire.quantize_bf16(above))[0]
         assert got == np.float32(1.0 + 2.0 ** -7)
 
+    def test_bf16_preserves_nan_and_infinities(self):
+        # The rounding bias add must not wrap a high-mantissa NaN into
+        # a finite pattern (0x7FFFFFFF + 0x8000 overflows to -0.0 bits)
+        # nor truncate a low-mantissa NaN to infinity — a diverged
+        # worker's NaNs must SURVIVE the wire, not be masked to zeros.
+        nans = np.array(
+            [0x7FFFFFFF, 0xFFFFFFFF, 0x7F800001, 0x7FC00000,
+             0xFF800001],
+            np.uint32,
+        ).view(np.float32)
+        assert np.isnan(nans).all()
+        back = wire.dequantize_bf16(wire.quantize_bf16(nans))
+        assert np.isnan(back).all()
+        # Infinities and finite neighbors are untouched by the special
+        # case.
+        mixed = np.array(
+            [1.0, np.nan, -2.0, np.inf, -np.inf], np.float32
+        )
+        back = wire.dequantize_bf16(wire.quantize_bf16(mixed))
+        assert back[0] == 1.0 and np.isnan(back[1]) and back[2] == -2.0
+        assert back[3] == np.inf and back[4] == -np.inf
+
     def test_bf16_relative_error_bound(self):
         rng = np.random.default_rng(0)
         a = rng.standard_normal(10_000).astype(np.float32)
@@ -302,6 +324,37 @@ class TestGangStoreWeighted:
             store.publish(r, _leaves(float(r)))
         # Integer-round pruning must never eat the final pushes.
         assert store.read_weighted_pushes(exchange.FINAL_ROUND)
+
+    def test_direct_push_covered_by_a_partial_is_deduped(self):
+        # Lost-response failover: the aggregator stored worker 1's push
+        # but the reply died, so FailoverClient re-sent it to the root.
+        # The fold must not count worker 1 twice (once inside the
+        # partial, once as the direct weight-1 record).
+        store = GangStore()
+        agg = AGG_ID_BASE + 10_000
+        partial, _ = exchange.average_leaf_sets(
+            [(i, _leaves(float(i))) for i in range(3)]
+        )
+        store.push_leaves(1, agg, partial, weight=3.0, covers=(0, 1, 2))
+        store.push_leaves(1, 1, _leaves(1.0))
+        recs = store.read_weighted_pushes(1)
+        assert [r[0] for r in recs] == [agg]
+        # The waiting-set math still sees every covered worker...
+        assert store.pushed_ids(1) == {0, 1, 2}
+        # ...and an UNcovered direct push still folds normally.
+        store.push_leaves(1, 7, _leaves(7.0))
+        assert [r[0] for r in store.read_weighted_pushes(1)] == [7, agg]
+        # The fold over the deduped records is the exact flat mean.
+        recs = store.read_weighted_pushes(1)
+        reavg, _ = exchange.average_leaf_sets(
+            [(wid, ls) for wid, ls, _w, _c in recs],
+            weights=[w for _, _, w, _ in recs],
+        )
+        flat, _ = exchange.average_leaf_sets(
+            [(i, _leaves(float(i))) for i in (0, 1, 2, 7)]
+        )
+        for a, b in zip(reavg, flat):
+            np.testing.assert_allclose(a, b, rtol=1e-6)
 
 
 class TestWeightedCoordinatorPublish:
@@ -583,6 +636,95 @@ class TestAggregator:
             (wid, leaves, weight, covers), = recs
             assert weight == 1.0 and covers == (0,)
             np.testing.assert_allclose(leaves[0], 4.0)
+
+    def test_straggler_after_flush_reforwards_cumulative_partial(self):
+        # Worker 2 arrives AFTER the deadline flush already forwarded
+        # {0, 1}. The root keys push records by pusher id, so the
+        # re-forward must cover all three — a {2}-only partial would
+        # REPLACE the first and silently drop workers 0/1 from the
+        # round's average.
+        with ExchangeServer() as server:
+            with Aggregator(
+                AGG_ID_BASE + 10_000, server.addr, expected_children=3,
+                flush_after=0.1,
+            ) as agg:
+                SocketExchange(agg.addr).push(1, 0, _params(0.0))
+                SocketExchange(agg.addr).push(1, 1, _params(1.0))
+                _wait_for(
+                    lambda: server.store.pushed_ids(1) == {0, 1}
+                )
+                SocketExchange(agg.addr).push(1, 2, _params(2.0))
+                _wait_for(
+                    lambda: server.store.pushed_ids(1) == {0, 1, 2}
+                )
+                (wid, leaves, weight, covers), = (
+                    server.store.read_weighted_pushes(1)
+                )
+            assert wid == AGG_ID_BASE + 10_000
+            assert weight == 3.0 and covers == (0, 1, 2)
+            flat, _ = exchange.average_leaf_sets(
+                [(i, _leaves(float(i))) for i in range(3)]
+            )
+            for a, b in zip(leaves, flat):
+                np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_worker_retry_after_flush_is_not_double_counted(self):
+        # A client retry after a lost response re-opens the round with
+        # the SAME worker id: the cumulative re-forward supersedes by
+        # pusher id, so the weight stays 1 and the partial unchanged.
+        with ExchangeServer() as server:
+            with Aggregator(
+                AGG_ID_BASE + 10_000, server.addr, expected_children=2,
+                flush_after=0.1,
+            ) as agg:
+                SocketExchange(agg.addr).push(1, 0, _params(4.0))
+                _wait_for(
+                    lambda: server.store.read_weighted_pushes(1)
+                )
+                upstream = []
+                inner = agg._upstream.request
+                agg._upstream.request = lambda *a, **k: (
+                    upstream.append(a[0]) or inner(*a, **k)
+                )
+                SocketExchange(agg.addr).push(1, 0, _params(4.0))
+                _wait_for(lambda: upstream.count("push") >= 1)
+                (wid, leaves, weight, covers), = (
+                    server.store.read_weighted_pushes(1)
+                )
+            assert weight == 1.0 and covers == (0,)
+            np.testing.assert_allclose(leaves[0], 4.0)
+
+    def test_forward_bookkeeping_is_pruned(self):
+        # The leak drills: _retries/_defer clear on a successful
+        # forward, _neg_until sheds expired and behind-horizon entries
+        # as averages move on, and _forwarded stays within keep_rounds
+        # — none of these dicts may grow for the life of the gang.
+        clock = FakeClock()
+        agg = Aggregator(
+            AGG_ID_BASE + 10_000, _dead_addr(), keep_rounds=2,
+            clock=clock,
+        )  # never started: drive the internals directly
+        try:
+            agg._upstream = type("FakeUpstream", (), {
+                "request": lambda self, op, header=None, payload=b"":
+                    ({"ok": True, "stored": True}, b""),
+            })()
+            agg._retries[3] = 2
+            agg._defer[3] = clock() + 1.0
+            agg._forward(3, {0: (_leaves(1.0), 1.0, (0,))})
+            assert 3 not in agg._retries and 3 not in agg._defer
+            assert 3 in agg._forwarded
+            for r in range(10, 30):
+                agg._neg_until[r] = clock() + 0.05
+            clock.advance(1.0)
+            agg._note_average(40, _leaves(1.0))
+            assert not agg._neg_until
+            assert 3 not in agg._forwarded  # settled behind round 40
+            for r in range(50, 60):
+                agg._forward(r, {0: (_leaves(1.0), 1.0, (0,))})
+            assert set(agg._forwarded) == {58, 59}  # keep_rounds bound
+        finally:
+            agg._server._server.server_close()
 
     def test_dead_upstream_drops_after_bounded_retries(self, capsys):
         agg = Aggregator(

@@ -209,6 +209,11 @@ class Aggregator:
         self._opened: dict = {}  # round key -> first-push time
         self._defer: dict = {}  # round key -> not-before (retry pacing)
         self._retries: dict = {}  # round key -> failed forwards so far
+        # round key -> {pusher_id: rec} behind each successful forward:
+        # late pushes for an already-flushed round fold together with
+        # these into one CUMULATIVE re-forward (see _forward). Pruned
+        # when the round publishes and bounded by keep_rounds.
+        self._forwarded: dict = {}
         self._avg_cache: dict[int, list] = {}  # round -> avg leaves
         self._neg_until: dict[int, float] = {}  # round -> miss expiry
         self._latest_cache: tuple | None = None  # (expiry, round)
@@ -259,6 +264,9 @@ class Aggregator:
             self._defer.clear()
         for key in sorted(batch, key=str):
             self._forward(key, batch[key])
+        with self._cond:
+            self._forwarded.clear()
+            self._retries.clear()
 
     def kill(self) -> None:
         """Abrupt death for the failover drills: the server vanishes
@@ -270,6 +278,8 @@ class Aggregator:
             self._pending.clear()
             self._opened.clear()
             self._defer.clear()
+            self._retries.clear()
+            self._forwarded.clear()
 
     def _join_flush_thread(self) -> None:
         with self._cond:
@@ -404,11 +414,29 @@ class Aggregator:
         return {"ok": True, "found": True, "round": round_}, data
 
     def _note_average(self, round_: int, leaves) -> None:
+        now = self.clock()
         with self._lock:
             self._avg_cache[round_] = leaves
             self._neg_until.pop(round_, None)
             while len(self._avg_cache) > max(self.keep_rounds, 1):
                 del self._avg_cache[min(self._avg_cache)]
+            # Expired negative entries, and rounds behind the oldest
+            # kept average, will never be consulted again — without
+            # this sweep the dict grows one entry per probed-but-
+            # never-published round for the life of the gang.
+            oldest = min(self._avg_cache)
+            for r in [
+                r for r, until in self._neg_until.items()
+                if until <= now or r < oldest
+            ]:
+                del self._neg_until[r]
+            # A published round's fold is settled at the root: the
+            # records kept for cumulative re-forwards are done too.
+            for k in [
+                k for k in self._forwarded
+                if k != exchange.FINAL_ROUND and k <= round_
+            ]:
+                del self._forwarded[k]
 
     @staticmethod
     def _round_key(header):
@@ -453,23 +481,40 @@ class Aggregator:
         """Fold one round's subtree pushes into a weighted partial
         average and push it upstream. Runs OUTSIDE the lock; on an
         upstream transport failure the records are re-queued with a
-        deferral, a bounded number of times."""
-        items = sorted(recs.items())
+        deferral, a bounded number of times.
+
+        An already-flushed round can accumulate more pushes — a
+        straggler slower than ``flush_after``, or a client retry after
+        a lost response. The upstream store keys push records by pusher
+        id, so a second partial under this agg_id REPLACES the first;
+        it must therefore cover everything forwarded so far, not just
+        the late arrivals. The records behind each successful forward
+        are kept per round (``_forwarded``) and merged under the late
+        ones here — same pusher superseded, everything else folded in —
+        so every re-forward is cumulative and the round's covered set
+        only ever grows."""
+        with self._lock:
+            merged = dict(self._forwarded.get(key, {}))
+        merged.update(recs)
+        items = exchange.dedupe_weighted_records([
+            (wid, rec[0], rec[1], rec[2])
+            for wid, rec in sorted(merged.items())
+        ])
         leaves, used = exchange.average_leaf_sets(
-            [(wid, rec[0]) for wid, rec in items],
-            weights=[rec[1] for _, rec in items],
+            [(wid, ls) for wid, ls, _w, _c in items],
+            weights=[w for _, _, w, _ in items],
             context=f"(aggregator {self.agg_id}, round {key}) ",
         )
         if leaves is None:
             return
         used_set = set(used)
         total_weight = sum(
-            rec[1] for wid, rec in items if wid in used_set
+            w for wid, _ls, w, _c in items if wid in used_set
         )
         covers = sorted({
             c
-            for wid, rec in items if wid in used_set
-            for c in rec[2]
+            for wid, _ls, _w, cov in items if wid in used_set
+            for c in cov
         })
         final = key == exchange.FINAL_ROUND
         base_round = base = None
@@ -505,6 +550,17 @@ class Aggregator:
             self._requeue(key, recs, e)
             return
         self._folds_ctr.inc()
+        with self._lock:
+            self._forwarded[key] = merged
+            # The forward landed: its retry/pacing state is spent —
+            # left behind, both dicts grow one entry per round forever.
+            self._retries.pop(key, None)
+            self._defer.pop(key, None)
+            ints = sorted(
+                k for k in self._forwarded if k != exchange.FINAL_ROUND
+            )
+            while len(ints) > max(self.keep_rounds, 1):
+                del self._forwarded[ints.pop(0)]
 
     def _requeue(self, key, recs: dict, err: BaseException) -> None:
         with self._cond:

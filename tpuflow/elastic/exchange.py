@@ -258,6 +258,35 @@ def average_leaf_sets(
     return [np.asarray(a / total_w, np.float32) for a in acc], used
 
 
+def dedupe_weighted_records(
+    recs: list[tuple[int, list[np.ndarray], float, tuple[int, ...]]],
+) -> list[tuple[int, list[np.ndarray], float, tuple[int, ...]]]:
+    """Drop direct worker pushes already covered by another pusher's
+    partial average; ``recs`` is ``[(pusher_id, leaves, weight,
+    covers), ...]`` and the survivors come back in input order.
+
+    A lost response makes a worker's FailoverClient re-send a push the
+    first receiver actually stored (``transport.py``): the round's fold
+    then sees that worker twice — once inside its aggregator's weighted
+    partial, once as a direct weight-1 record — and the weighted mean
+    is biased toward it. A direct record is recognizable (``covers`` is
+    exactly its own pusher id); when any OTHER record's covers already
+    include that worker, the direct record is redundant and dropped
+    before the fold. Partial-vs-partial overlap (two aggregators each
+    folding the same worker after a sibling re-parent) cannot be
+    subtracted back out of an already-folded average and is accepted as
+    a bounded down-round bias instead."""
+    out = []
+    for k, (pid, leaves, w, cov) in enumerate(recs):
+        if tuple(cov) == (pid,) and any(
+            pid in other
+            for j, (_p, _l, _w, other) in enumerate(recs) if j != k
+        ):
+            continue
+        out.append((pid, leaves, w, cov))
+    return out
+
+
 def average_pushes(
     gang_dir: str, round, include: set[int] | None = None
 ) -> tuple[list[np.ndarray] | None, list[int]]:

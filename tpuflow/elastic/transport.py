@@ -327,13 +327,17 @@ class GangStore:
         """Every push for ``round`` as ``(pusher_id, leaves, weight,
         covers)`` — the fold input the coordinator (and the runner's
         final average) uses so aggregator partials re-average into the
-        exact flat mean."""
+        exact flat mean. Direct pushes whose worker is already covered
+        by a partial (a lost-response failover re-send — the aggregator
+        stored the push but the reply died, so :class:`FailoverClient`
+        re-sent it here) are dropped so no worker is folded twice."""
         key = round if round == exchange.FINAL_ROUND else int(round)
         with self._lock:
-            return sorted(
+            recs = sorted(
                 (wid, rec["leaves"], rec["weight"], rec["covers"])
                 for wid, rec in self._pushes.get(key, {}).items()
             )
+        return exchange.dedupe_weighted_records(recs)
 
     def _newest_push_rounds_locked(self, min_round: int) -> dict:
         newest: dict[int, int] = {}

@@ -51,12 +51,25 @@ class DeltaBaseUnavailable(ValueError):
 def quantize_bf16(a: np.ndarray) -> np.ndarray:
     """f32 array -> its bfloat16 bit pattern as ``uint16`` (IEEE
     round-to-nearest-even on the dropped mantissa half), half the
-    bytes of the input."""
-    bits = np.ascontiguousarray(a, np.float32).view(np.uint32)
+    bytes of the input. NaN never rounds: the bias add would carry a
+    high-mantissa NaN's bits into the sign (0x7FFFFFFF + 0x8000 wraps
+    to -0.0 bits), silently zeroing the very divergence the wire must
+    surface — so NaNs are truncated with the quiet bit forced instead,
+    the standard bf16 treatment."""
+    f = np.ascontiguousarray(a, np.float32)
+    bits = f.view(np.uint32)
     # Round-to-nearest-even: add 0x7FFF plus the current LSB of the
     # kept half, so exactly-halfway values round to an even result.
     rounding = ((bits >> 16) & np.uint32(1)) + np.uint32(0x7FFF)
-    return ((bits + rounding) >> 16).astype(np.uint16)
+    out = ((bits + rounding) >> 16).astype(np.uint16)
+    nan = np.isnan(f)
+    if nan.any():
+        out = np.where(
+            nan,
+            (bits >> 16).astype(np.uint16) | np.uint16(0x0040),
+            out,
+        )
+    return out
 
 
 def dequantize_bf16(u: np.ndarray) -> np.ndarray:
