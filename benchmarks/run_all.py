@@ -1,7 +1,7 @@
 """Run the five BASELINE-config benchmarks; write benchmarks/results.json.
 
 Usage: python benchmarks/run_all.py [--quick] [--precision P]
-       [--replicas] [--autotune] [script.py ...]
+       [--replicas] [--autotune] [--autoscale] [script.py ...]
 
 ``--replicas`` runs the serving replica-scaling ladder instead of the
 standard sweep: ``bench_serving.py --replicas`` (open-loop Poisson,
@@ -15,6 +15,11 @@ merge into results.json like any partial run.
 mis-sized-batch laps, wall-clock-to-target-loss under a recompile
 budget) writing ``benchmarks/autotune_results.json``; its record
 merges the same way.
+
+``--autoscale`` runs the SLO-driven autoscaler closed-loop drill
+instead (``bench_autoscale.py``: fake-clock queueing model under a
+tripled Poisson load, real history/alerts/controller planes) writing
+``benchmarks/autoscale_results.json``; its record merges the same way.
 
 With script names, only those benchmarks run and their records are
 MERGED into the existing results.json (rows with the same
@@ -47,6 +52,7 @@ SCRIPTS = [
     "bench_serving.py",  # HTTP serving: batched vs unbatched /predict
     "bench_autotune.py",  # online occupancy tuning vs static configs
     "bench_elastic_tree.py",  # tree fan-in vs star: root bytes/fold A/B
+    "bench_autoscale.py",  # SLO-driven autoscaler vs tripled Poisson load
 ]
 
 
@@ -93,6 +99,12 @@ def main() -> None:
         argv = [a for a in argv if a != "--autotune"]
         if "bench_autotune.py" not in argv:
             argv = argv + ["bench_autotune.py"]
+    if "--autoscale" in argv:
+        # Same shape as --autotune: the closed-loop drill owns
+        # autoscale_results.json; selecting it narrows the run.
+        argv = [a for a in argv if a != "--autoscale"]
+        if "bench_autoscale.py" not in argv:
+            argv = argv + ["bench_autoscale.py"]
     args = [a for a in argv if a != "--quick"]
     if "--quick" in argv:
         base_env.setdefault("BENCH_SECONDS", "2")

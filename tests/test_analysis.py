@@ -934,6 +934,69 @@ class TestLinter:
                 return jax.jit(step)
         """) == []
 
+    def _lint_scoped(self, tmp_path, rel, source):
+        """Lint under a constructed repo-relative path — TPF022 scopes
+        by module location (tpuflow/obs/ and serve_autoscale.py), which
+        the flat mod.py helper can't express."""
+        f = tmp_path.joinpath(*rel.split("/"))
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(textwrap.dedent(source))
+        return lint_file(str(f))
+
+    _CONTROL_LOOP_SLEEP = """
+        import time
+
+        def run(stop_event, interval_s):
+            while not stop_event.is_set():
+                tick()
+                time.sleep(interval_s)
+    """
+
+    def test_control_loop_bare_sleep_flagged_in_obs(self, tmp_path):
+        """TPF022: a control/sampler loop pacing itself with a bare
+        time.sleep can't be interrupted — shutdown waits out the full
+        interval and tests can't inject a cadence. (The loop mentions
+        its stop event, so TPF007 stays silent — this is TPF022's own
+        discipline, not the unbounded-poll rule.)"""
+        diags = self._lint_scoped(
+            tmp_path, "tpuflow/obs/sampler.py", self._CONTROL_LOOP_SLEEP
+        )
+        assert _codes(diags) == ["TPF022"]
+        (d,) = diags
+        assert "stop_event.wait" in d.message
+        # The autoscaler module is in scope by filename.
+        diags = self._lint_scoped(
+            tmp_path, "tpuflow/serve_autoscale.py",
+            self._CONTROL_LOOP_SLEEP,
+        )
+        assert _codes(diags) == ["TPF022"]
+
+    def test_control_loop_stop_event_wait_passes(self, tmp_path):
+        assert self._lint_scoped(tmp_path, "tpuflow/obs/sampler.py", """
+            def run(stop_event, interval_s):
+                while not stop_event.is_set():
+                    tick()
+                    stop_event.wait(interval_s)
+        """) == []
+
+    def test_control_loop_sleep_out_of_scope_exempt(self, tmp_path):
+        # Other modules keep their own disciplines (TPF007 governs
+        # unbounded polls everywhere); TPF022 is scoped to the
+        # obs/sampler + autoscaler control loops.
+        assert self._lint_scoped(
+            tmp_path, "tpuflow/other.py", self._CONTROL_LOOP_SLEEP
+        ) == []
+
+    def test_control_loop_sleep_noqa_suppressed(self, tmp_path):
+        assert self._lint_scoped(tmp_path, "tpuflow/obs/sampler.py", """
+            import time
+
+            def run(stop_event, interval_s):
+                while not stop_event.is_set():
+                    tick()
+                    time.sleep(interval_s)  # noqa: TPF022
+        """) == []
+
     def test_self_lint_gate_package_is_clean(self):
         """The gate: the whole tpuflow package obeys its own lint rules.
         New framework code that host-syncs inside jit, uses untraced

@@ -287,6 +287,109 @@ def test_obs_slo_subprocess(tmp_path):
     assert "Traceback" not in bad.stderr
 
 
+def _write_history_spill(tmp_path):
+    """A daemon-shaped metrics-history spill: a burn-rate lane breaching
+    from t=0 (fires the imported SLO rule after its 15s hold-down) and
+    a counter ramp."""
+    import json
+
+    spill = tmp_path / "history.jsonl"
+    rows = []
+    for t in range(0, 60, 5):
+        rows.append({"event": "history_sample", "t": float(t), "samples": {
+            "tpuflow_slo_burn_rate{objective=availability}": 4.0,
+            "tpuflow_serving_admitted_total": float(t * 10),
+        }})
+    spill.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    return spill
+
+
+def test_obs_history_subprocess(tmp_path):
+    """python -m tpuflow.obs history: replay a spill in a REAL
+    subprocess — per-series summaries, --metric filtering, and honest
+    exits on empty/missing input."""
+    import json
+
+    spill = _write_history_spill(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "history", str(spill),
+         "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(proc.stdout)
+    assert doc["ticks"] == 12
+    by_name = {r["series"]: r for r in doc["series"]}
+    burn = by_name["tpuflow_slo_burn_rate"]
+    assert burn["labels"] == {"objective": "availability"}
+    assert burn["points"] == 12 and burn["last"] == 4.0
+    ramp = by_name["tpuflow_serving_admitted_total"]
+    assert ramp["min"] == 0.0 and ramp["max"] == 550.0
+
+    filtered = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "history", str(spill),
+         "--metric", "serving", "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert filtered.returncode == 0
+    assert [r["series"] for r in json.loads(filtered.stdout)["series"]] \
+        == ["tpuflow_serving_admitted_total"]
+
+    empty = tmp_path / "not_a_spill.jsonl"
+    empty.write_text(json.dumps({"event": "span", "time": 1.0}) + "\n")
+    no_ticks = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "history", str(empty)],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert no_ticks.returncode == 1
+    assert "no history_sample records" in no_ticks.stderr
+    missing = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "history",
+         str(tmp_path / "nope.jsonl")],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert missing.returncode == 2
+    assert "Traceback" not in missing.stderr
+
+
+def test_obs_alerts_subprocess(tmp_path):
+    """python -m tpuflow.obs alerts: the same spill through the
+    committed SLO rules — the burn-rate page fires after its hold-down,
+    --fail-on-firing gates, and rule-less invocation exits 2 with the
+    usage message, never a traceback."""
+    import json
+
+    spill = _write_history_spill(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "alerts", str(spill),
+         "--slo", "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(proc.stdout)
+    assert doc["ticks"] == 12
+    assert doc["firing"] == ["burn_rate_availability"]
+    [fired] = doc["transitions"]
+    assert fired["state"] == "firing" and fired["value"] == 4.0
+    assert fired["t"] >= 15.0                    # the for_s hold-down
+
+    gated = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "alerts", str(spill),
+         "--slo", "--fail-on-firing"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert gated.returncode == 1
+    assert "burn_rate_availability" in gated.stderr
+
+    no_rules = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "alerts", str(spill)],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert no_rules.returncode == 2
+    assert "--rules" in no_rules.stderr
+    assert "Traceback" not in no_rules.stderr
+
+
 def test_analysis_module_entry_rejects_broken_spec(tmp_path):
     """python -m tpuflow.analysis: the CI entry point exits non-zero on a
     broken spec and prints the preflight diagnostic."""

@@ -400,7 +400,10 @@ class TestPrometheusEndpoint:
             # (tpuflow/obs/slo.py) both daemons now render.
             status, _, js = _get_text(base + "/metrics")
             metrics = json.loads(js)
-            assert set(metrics) == {"jobs", "predict", "slo", "uptime_s"}
+            assert set(metrics) == {
+                "jobs", "predict", "slo", "alerts", "uptime_s",
+            }
+            assert metrics["alerts"]["schema"] == "tpuflow.obs.alerts/v1"
             assert metrics["predict"]["requests"] == 1
             slo_rows = {
                 r["name"]: r for r in metrics["slo"]["objectives"]

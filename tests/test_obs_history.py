@@ -1,0 +1,610 @@
+"""The metrics history plane and the alert engine (tpuflow/obs).
+
+The contracts under test:
+
+- memory is provably bounded: per-series rings downsample in place at
+  ``max_points`` (newest kept, counted), retention prunes on append,
+  and new series past ``max_series`` are dropped and counted — never an
+  unbounded dict;
+- windowed queries (latest/delta/rate/mean/max/quantile) compute the
+  documented math on hand-fed points, deterministic under a fake clock;
+- the JSONL spill and :meth:`ingest` are two sides of one format — a
+  spilled daemon history replays into identical query answers;
+- every ``TPUFLOW_OBS_HISTORY_*`` knob is validated at read time and a
+  malformed value names the variable (the ``TPUFLOW_RETRY_*`` contract);
+- the sampler's lock discipline survives a cross-thread drill:
+  concurrent sample/query/registry traffic raises nothing and the
+  bounds hold (``Registry.peek`` and the series table are both
+  lock-guarded — the PR 15 concurrency gate's runtime counterpart);
+- alert lifecycle: ``for_s`` hold-down before firing, resolve on
+  recovery, absence of data is NOT recovery, and a mid-firing
+  downsample never double-fires a rule (state is keyed by rule, not by
+  history points);
+- :func:`rules_from_objectives` burn-rate rules reproduce the SLO
+  engine's own ``burn_rate`` algebra on a hand-computed window.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from tpuflow.obs import Registry
+from tpuflow.obs.alerts import (
+    AlertEngine,
+    normalize_rule,
+    rules_from_objectives,
+    validate_rules,
+)
+from tpuflow.obs.history import (
+    MetricsHistory,
+    format_series,
+    parse_series,
+)
+
+
+def _offline(**kw) -> MetricsHistory:
+    kw.setdefault("interval_s", 1.0)
+    kw.setdefault("max_points", 512)
+    kw.setdefault("max_series", 64)
+    kw.setdefault("retention_s", 3600.0)
+    return MetricsHistory(None, **kw)
+
+
+class TestSeriesKeys:
+    def test_format_parse_roundtrip(self):
+        for name, labels in [
+            ("tpuflow_slo_burn_rate", {"objective": "availability"}),
+            ("tpuflow_jobs_total", {"b": "2", "a": "1"}),
+            ("plain_gauge", {}),
+        ]:
+            key = format_series(name, labels)
+            back_name, back_labels = parse_series(key)
+            assert back_name == name
+            assert back_labels == labels
+
+    def test_labels_sorted_one_stable_spelling(self):
+        assert format_series("m", {"b": "2", "a": "1"}) == "m{a=1,b=2}"
+
+    def test_malformed_key_raises_naming_it(self):
+        for bad in ("m{unterminated", "m{noequals}"):
+            with pytest.raises(ValueError) as e:
+                parse_series(bad)
+            assert bad in str(e.value)
+
+
+class TestSampling:
+    def test_sample_records_counters_and_gauges(self):
+        reg = Registry()
+        c = reg.counter("hist_test_total", "x")
+        g = reg.gauge("hist_test_gauge", "x")
+        hist = MetricsHistory(reg, interval_s=1.0)
+        c.inc(3)
+        g.set(7.5)
+        assert hist.sample(now=10.0) > 0
+        assert hist.latest("hist_test_total") == 3.0
+        assert hist.latest("hist_test_gauge") == 7.5
+        # Kind detection: counters tagged counter, gauges gauge.
+        kinds = {s["name"]: s["kind"] for s in hist.all_series()}
+        assert kinds["tpuflow_hist_test_total"] == "counter"
+        assert kinds["tpuflow_hist_test_gauge"] == "gauge"
+
+    def test_histogram_buckets_skipped_sum_count_kept(self):
+        reg = Registry()
+        h = reg.histogram("hist_test_lat", "x", buckets=(1.0, 10.0))
+        hist = MetricsHistory(reg, interval_s=1.0)
+        h.observe(0.5)
+        h.observe(5.0)
+        hist.sample(now=1.0)
+        names = {s["name"] for s in hist.all_series()}
+        assert "tpuflow_hist_test_lat_sum" in names
+        assert "tpuflow_hist_test_lat_count" in names
+        assert not any(n.endswith("_bucket") for n in names)
+        # The _sum/_count rows ride as counters (rate queries work).
+        sums = [s for s in hist.all_series()
+                if s["name"] == "tpuflow_hist_test_lat_sum"]
+        assert sums[0]["kind"] == "counter"
+
+    def test_maybe_sample_respects_cadence(self):
+        reg = Registry()
+        reg.counter("hist_cadence_total", "x").inc()
+        hist = MetricsHistory(reg, interval_s=5.0)
+        assert hist.maybe_sample(now=100.0) > 0     # first tick always due
+        assert hist.maybe_sample(now=102.0) == 0    # inside the interval
+        assert hist.maybe_sample(now=105.0) > 0     # due again
+        assert len(hist.points("hist_cadence_total")) == 2
+
+    def test_history_meta_counters_registered(self):
+        reg = Registry()
+        reg.counter("hist_meta_total", "x").inc()
+        hist = MetricsHistory(reg, interval_s=1.0)
+        hist.sample(now=1.0)
+        assert reg.peek("obs_history_samples_total") is not None
+        assert reg.peek("obs_history_series") is not None
+        samples = dict(
+            (suffix, value)
+            for suffix, _, value in reg.peek(
+                "obs_history_samples_total"
+            ).collect()
+        )
+        assert samples[""] == 1.0
+
+    def test_broken_pre_sample_and_listener_never_stop_the_tick(self):
+        reg = Registry()
+        reg.counter("hist_hook_total", "x").inc()
+        hist = MetricsHistory(reg, interval_s=1.0)
+        hist.add_pre_sample(lambda: 1 / 0)
+        seen = []
+        hist.add_listener(lambda now: seen.append(now))
+        hist.add_listener(lambda now: (_ for _ in ()).throw(RuntimeError()))
+        assert hist.sample(now=2.0) > 0
+        assert seen == [2.0]
+
+
+class TestBounds:
+    def test_downsample_on_overflow_keeps_newest(self):
+        reg = Registry()
+        g = reg.gauge("hist_bound_gauge", "x")
+        hist = MetricsHistory(reg, interval_s=1.0, max_points=8)
+        for i in range(20):
+            g.set(float(i))
+            hist.sample(now=float(i))
+        pts = hist.points("hist_bound_gauge")
+        assert len(pts) <= 8
+        assert pts[-1] == (19.0, 19.0)          # newest always kept
+        assert pts == sorted(pts)               # still time-ordered
+        downs = reg.peek("obs_history_downsamples_total")
+        assert downs is not None
+        assert dict(
+            (s, v) for s, _, v in downs.collect()
+        )[""] >= 1.0
+
+    def test_retention_prunes_old_points(self):
+        hist = _offline(retention_s=10.0)
+        hist.ingest(0.0, {"m": 1.0})
+        hist.ingest(5.0, {"m": 2.0})
+        hist.ingest(20.0, {"m": 3.0})           # 0.0 and 5.0 now stale
+        pts = hist.points("m")
+        assert [t for t, _ in pts] == [20.0]
+
+    def test_max_series_drops_and_counts(self):
+        reg = Registry()
+        hist = MetricsHistory(reg, interval_s=1.0, max_series=4)
+        # The meta families themselves occupy slots; fill the rest.
+        for i in range(8):
+            hist.ingest(1.0, {f"series_{i}": float(i)})
+        assert hist.summary()["series"] <= 4
+        # ingest on a registry-backed history counts refusals.
+        dropped = reg.peek("obs_history_dropped_series_total")
+        assert dropped is not None
+        counts = dict((s, v) for s, _, v in dropped.collect())
+        assert counts[""] >= 4.0
+
+    def test_non_finite_and_non_numeric_values_skipped(self):
+        hist = _offline()
+        hist.ingest(1.0, {"m": float("nan"), "n": "not-a-number", "ok": 2.0})
+        assert hist.latest("m") is None
+        assert hist.latest("n") is None
+        assert hist.latest("ok") == 2.0
+
+
+class TestQueries:
+    def _filled(self) -> MetricsHistory:
+        hist = _offline()
+        # A counter-ish ramp and a gauge-ish sawtooth.
+        for t, v in [(0.0, 0.0), (10.0, 100.0), (20.0, 300.0),
+                     (30.0, 600.0)]:
+            hist.ingest(t, {"ramp": v})
+        for t, v in [(0.0, 5.0), (10.0, 1.0), (20.0, 9.0), (30.0, 3.0)]:
+            hist.ingest(t, {"saw": v})
+        return hist
+
+    def test_latest_delta_rate(self):
+        hist = self._filled()
+        assert hist.latest("ramp") == 600.0
+        # Window [10, 30]: delta 600-100, rate over 20s.
+        assert hist.delta("ramp", 20.0) == 500.0
+        assert hist.rate("ramp", 20.0) == pytest.approx(25.0)
+        # Whole history.
+        assert hist.rate("ramp", 1000.0) == pytest.approx(20.0)
+
+    def test_mean_max_quantile(self):
+        hist = self._filled()
+        assert hist.mean("saw", 1000.0) == pytest.approx(4.5)
+        assert hist.max("saw", 1000.0) == 9.0
+        # Sorted window values [1, 3, 5, 9]: median interpolates 3..5.
+        assert hist.quantile("saw", 0.5, 1000.0) == pytest.approx(4.0)
+        assert hist.quantile("saw", 1.0, 1000.0) == 9.0
+        assert hist.quantile("saw", 0.0, 1000.0) == 1.0
+
+    def test_window_ends_at_explicit_now(self):
+        hist = self._filled()
+        # now=20 looks back over [10, 20] only.
+        assert hist.delta("ramp", 10.0, now=20.0) == 200.0
+        assert hist.max("saw", 10.0, now=20.0) == 9.0
+
+    def test_insufficient_points_return_none_never_raise(self):
+        hist = _offline()
+        assert hist.latest("absent") is None
+        assert hist.delta("absent", 10.0) is None
+        assert hist.rate("absent", 10.0) is None
+        assert hist.mean("absent", 10.0) is None
+        assert hist.quantile("absent", 0.99, 10.0) is None
+        hist.ingest(1.0, {"single": 4.0})
+        assert hist.rate("single", 10.0) is None    # needs two points
+        # Two same-tick points: zero elapsed is None, not a ZeroDivision.
+        hist.ingest(1.0, {"single": 5.0})
+
+    def test_namespace_fallback_matches_registry_spelling(self):
+        reg = Registry()
+        reg.gauge("hist_ns_gauge", "x").set(11.0)
+        hist = MetricsHistory(reg, interval_s=1.0)
+        hist.sample(now=1.0)
+        # Bare and namespaced spellings answer identically (the
+        # Registry.peek convention).
+        assert hist.latest("hist_ns_gauge") == 11.0
+        assert hist.latest("tpuflow_hist_ns_gauge") == 11.0
+
+    def test_labelsets_enumerates_series(self):
+        hist = _offline()
+        hist.ingest(1.0, {"m{objective=a}": 1.0, "m{objective=b}": 2.0})
+        sets = hist.labelsets("m")
+        assert {frozenset(s.items()) for s in sets} == {
+            frozenset({("objective", "a")}),
+            frozenset({("objective", "b")}),
+        }
+        assert hist.latest("m", objective="b") == 2.0
+
+
+class TestSpillReplay:
+    def test_spill_and_ingest_are_one_format(self, tmp_path):
+        spill = tmp_path / "history.jsonl"
+        reg = Registry()
+        g = reg.gauge("hist_spill_gauge", "x")
+        hist = MetricsHistory(reg, interval_s=1.0, spill_path=str(spill))
+        for t in range(5):
+            g.set(float(t * t))
+            hist.sample(now=float(t))
+        hist.stop()
+        records = [
+            json.loads(line) for line in spill.read_text().splitlines()
+        ]
+        ticks = [r for r in records if r.get("event") == "history_sample"]
+        assert len(ticks) == 5
+        assert all(isinstance(r["samples"], dict) for r in ticks)
+        # Replay into a fresh offline history: identical answers.
+        replay = _offline()
+        for r in ticks:
+            replay.ingest(r["t"], r["samples"])
+        assert (
+            replay.points("hist_spill_gauge")
+            == hist.points("hist_spill_gauge")
+        )
+        assert replay.latest("hist_spill_gauge") == 16.0
+
+
+class TestHistoryEnvKnobs:
+    @pytest.mark.parametrize("var,value", [
+        ("TPUFLOW_OBS_HISTORY_INTERVAL_S", "fast"),
+        ("TPUFLOW_OBS_HISTORY_INTERVAL_S", "0.0"),
+        ("TPUFLOW_OBS_HISTORY_MAX_POINTS", "two"),
+        ("TPUFLOW_OBS_HISTORY_MAX_POINTS", "4"),
+        ("TPUFLOW_OBS_HISTORY_MAX_SERIES", "0"),
+        ("TPUFLOW_OBS_HISTORY_RETENTION_S", "-5"),
+        ("TPUFLOW_OBS_HISTORY_RETENTION_S", "nan"),
+    ])
+    def test_malformed_env_names_the_variable(self, monkeypatch, var, value):
+        monkeypatch.setenv(var, value)
+        with pytest.raises(ValueError) as e:
+            MetricsHistory(None)
+        assert var in str(e.value)
+
+    def test_env_overrides_apply(self, monkeypatch):
+        monkeypatch.setenv("TPUFLOW_OBS_HISTORY_INTERVAL_S", "0.25")
+        monkeypatch.setenv("TPUFLOW_OBS_HISTORY_MAX_POINTS", "16")
+        monkeypatch.setenv("TPUFLOW_OBS_HISTORY_MAX_SERIES", "9")
+        monkeypatch.setenv("TPUFLOW_OBS_HISTORY_RETENTION_S", "30")
+        hist = MetricsHistory(None)
+        assert hist.interval_s == 0.25
+        assert hist.max_points == 16
+        assert hist.max_series == 9
+        assert hist.retention_s == 30.0
+
+    def test_explicit_arguments_beat_env(self, monkeypatch):
+        monkeypatch.setenv("TPUFLOW_OBS_HISTORY_MAX_POINTS", "16")
+        assert MetricsHistory(None, max_points=64).max_points == 64
+
+
+class TestLockDisciplineDrill:
+    def test_concurrent_sample_query_registry_traffic(self):
+        """The PR 15 concurrency gate's runtime counterpart: hammer the
+        sampler, the queries, and the registry (new labelsets, peek,
+        collect) from distinct threads. No exceptions, bounds hold —
+        ``Registry.peek``/``_get_or_create`` and the series table are
+        each guarded by their own lock, and sampling collects OUTSIDE
+        the history lock."""
+        reg = Registry()
+        c = reg.counter("drill_total", "x")
+        g = reg.gauge("drill_gauge", "x")
+        hist = MetricsHistory(
+            reg, interval_s=0.01, max_points=32, max_series=64,
+            retention_s=60.0,
+        )
+        errors: list[BaseException] = []
+        stop = threading.Event()
+
+        def guard(fn):
+            def run():
+                i = 0
+                while not stop.is_set():
+                    try:
+                        fn(i)
+                    except BaseException as e:  # noqa: BLE001
+                        errors.append(e)
+                        return
+                    i += 1
+            return run
+
+        def mutate(i):
+            c.inc(labelset=str(i % 5))
+            g.set(float(i), lane=str(i % 3))
+
+        def sample(i):
+            hist.sample(now=float(i))
+
+        def query(i):
+            hist.latest("drill_gauge", lane="0")
+            hist.mean("drill_total", 50.0, labelset="1")
+            hist.summary()
+            hist.all_series()
+            assert reg.peek("drill_gauge") is not None
+            assert reg.peek("never_registered") is None
+
+        threads = [
+            threading.Thread(target=guard(fn), daemon=True)
+            for fn in (mutate, mutate, sample, query, query)
+        ]
+        for t in threads:
+            t.start()
+        import time as _time
+
+        _time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join(5.0)
+        assert not errors, errors
+        summary = hist.summary()
+        assert summary["series"] <= 64
+        assert summary["points"] <= 64 * 32
+
+    def test_sampler_thread_start_stop_idempotent(self):
+        reg = Registry()
+        reg.counter("drill_thread_total", "x").inc()
+        hist = MetricsHistory(reg, interval_s=0.01)
+        hist.start()
+        hist.start()                 # idempotent
+        deadline = 5.0
+        import time as _time
+
+        t0 = _time.monotonic()
+        while (
+            not hist.points("drill_thread_total")
+            and _time.monotonic() - t0 < deadline
+        ):
+            _time.sleep(0.01)
+        hist.stop()
+        hist.stop()                  # idempotent
+        assert hist.points("drill_thread_total")
+
+
+class TestRuleGrammar:
+    def test_validate_reports_every_problem(self):
+        problems = validate_rules([
+            {"metric": "m"},                              # no name/threshold
+            {"name": "a", "metric": "m", "threshold": 1,
+             "query": "median", "op": "~", "severity": "loud",
+             "window_s": -1, "bogus": 1},
+            {"name": "a", "metric": "m", "threshold": 1},  # duplicate name
+        ])
+        text = "\n".join(problems)
+        assert "needs a non-empty string 'name'" in text
+        assert "needs a numeric 'threshold'" in text
+        assert "query 'median'" in text
+        assert "op '~'" in text
+        assert "severity 'loud'" in text
+        assert "window_s must be a number" in text
+        assert "unknown keys ['bogus']" in text
+        assert "duplicate rule name 'a'" in text
+
+    def test_validate_never_raises_on_garbage(self):
+        assert validate_rules("nope")
+        assert validate_rules([42])
+
+    def test_normalize_applies_defaults_and_raises_loud(self):
+        rule = normalize_rule({"name": "r", "metric": "m", "threshold": 2})
+        assert rule["query"] == "latest"
+        assert rule["op"] == ">"
+        assert rule["for_s"] == 0.0
+        assert rule["severity"] == "warn"
+        with pytest.raises(ValueError) as e:
+            normalize_rule({"name": "r", "metric": "m"})
+        assert "threshold" in str(e.value)
+
+    def test_rules_from_objectives_shapes(self):
+        rules = rules_from_objectives([
+            {"name": "availability", "kind": "availability",
+             "target": 0.999},
+            {"name": "latency_p99", "kind": "latency_p99", "target": 250.0},
+        ], window_s=30.0, for_s=5.0)
+        assert validate_rules(rules) == []
+        by_name = {r["name"]: r for r in rules}
+        burn = by_name["burn_rate_availability"]
+        assert burn["metric"] == "slo_burn_rate"
+        assert burn["labels"] == {"objective": "availability"}
+        assert burn["query"] == "mean"
+        assert burn["threshold"] == 1.0
+        assert burn["severity"] == "page"
+        p99 = by_name["p99_over_target_latency_p99"]
+        assert p99["threshold"] == 250.0
+        assert p99["labels"] == {"quantile": "0.99"}
+        assert p99["severity"] == "warn"
+
+
+class TestAlertLifecycle:
+    def _engine(self, rule_overrides=None, **engine_kw):
+        hist = _offline()
+        rule = {"name": "r", "metric": "m", "threshold": 10.0,
+                "query": "latest", "for_s": 5.0}
+        rule.update(rule_overrides or {})
+        engine = AlertEngine(hist, [rule], **engine_kw).attach()
+        return hist, engine
+
+    def _state(self, engine, name="r"):
+        rows = {r["name"]: r for r in engine.summary()["rules"]}
+        return rows[name]["state"]
+
+    def test_for_s_hold_down_before_firing(self):
+        hist, engine = self._engine()
+        hist.ingest(0.0, {"m": 50.0})           # breach observed
+        assert self._state(engine) == "pending"
+        hist.ingest(3.0, {"m": 50.0})           # held 3s < 5s
+        assert self._state(engine) == "pending"
+        assert engine.firing() == []
+        hist.ingest(5.0, {"m": 50.0})           # held exactly for_s
+        assert self._state(engine) == "firing"
+        assert engine.firing() == ["r"]
+        assert [t["state"] for t in engine.transitions] == ["firing"]
+
+    def test_blip_shorter_than_for_s_never_fires(self):
+        hist, engine = self._engine()
+        hist.ingest(0.0, {"m": 50.0})
+        hist.ingest(2.0, {"m": 1.0})            # recovered inside hold-down
+        assert self._state(engine) == "ok"
+        assert engine.transitions == []
+
+    def test_resolve_on_recovery_emits_and_clears_gauge(self):
+        reg = Registry()
+        hist = _offline()
+        rule = {"name": "r", "metric": "m", "threshold": 10.0,
+                "for_s": 0.0}
+        engine = AlertEngine(hist, [rule], registry=reg).attach()
+        hist.ingest(0.0, {"m": 50.0})
+        gauge = reg.peek("obs_alerts_firing")
+        assert dict(
+            (tuple(sorted(lbl.items())), v)
+            for _, lbl, v in gauge.collect()
+        )[(("rule", "r"),)] == 1.0
+        hist.ingest(1.0, {"m": 1.0})
+        assert [t["state"] for t in engine.transitions] == [
+            "firing", "resolved",
+        ]
+        assert dict(
+            (tuple(sorted(lbl.items())), v)
+            for _, lbl, v in gauge.collect()
+        )[(("rule", "r"),)] == 0.0
+        transitions = reg.peek("obs_alerts_transitions_total")
+        counts = {
+            tuple(sorted(lbl.items())): v
+            for _, lbl, v in transitions.collect()
+        }
+        assert counts[(("rule", "r"), ("state", "firing"))] == 1.0
+        assert counts[(("rule", "r"), ("state", "resolved"))] == 1.0
+
+    def test_absence_of_data_is_not_recovery(self):
+        hist, engine = self._engine(
+            {"for_s": 0.0, "query": "mean", "window_s": 5.0}
+        )
+        hist.ingest(0.0, {"m": 50.0})
+        assert self._state(engine) == "firing"
+        # Ticks arrive but the rule's own series goes silent: the
+        # window empties, the query returns None, the state HOLDS.
+        hist.ingest(20.0, {"other": 1.0})
+        assert self._state(engine) == "firing"
+        assert [t["state"] for t in engine.transitions] == ["firing"]
+
+    def test_no_double_fire_across_downsample_boundary(self):
+        """The memory-bounding decimation thins a firing rule's window;
+        firing state is keyed by RULE, so the alert must neither re-fire
+        nor resolve when half its points vanish."""
+        reg = Registry()
+        g = reg.gauge("alert_ds_gauge", "x")
+        hist = MetricsHistory(reg, interval_s=1.0, max_points=8)
+        rule = {"name": "r", "metric": "alert_ds_gauge",
+                "threshold": 10.0, "query": "mean", "window_s": 1000.0,
+                "for_s": 2.0}
+        engine = AlertEngine(hist, [rule], registry=reg).attach()
+        for i in range(30):                       # sustained breach
+            g.set(50.0)
+            hist.sample(now=float(i))
+        downs = dict(
+            (s, v)
+            for s, _, v in reg.peek(
+                "obs_history_downsamples_total"
+            ).collect()
+        )
+        assert downs[""] >= 1.0                   # decimation DID happen
+        assert engine.firing() == ["r"]
+        assert [t["state"] for t in engine.transitions] == ["firing"]
+
+    def test_burn_rate_rule_matches_slo_math_on_hand_window(self):
+        """The rule threshold and the report card share one algebra:
+        997 good / 3 bad against a 0.999 target burns at exactly 3.0 —
+        three times the budget's replenishment rate — so the imported
+        burn-rate rule (threshold 1.0) must fire on precisely the value
+        :func:`tpuflow.obs.slo.burn_rate` computes."""
+        from tpuflow.obs.slo import burn_rate
+
+        expected = burn_rate(997, 3, 0.999)
+        assert expected == pytest.approx(3.0)
+        rules = rules_from_objectives(
+            [{"name": "availability", "kind": "availability",
+              "target": 0.999}],
+            window_s=30.0, for_s=10.0,
+        )
+        hist = _offline()
+        engine = AlertEngine(hist, rules).attach()
+        key = format_series(
+            "tpuflow_slo_burn_rate", {"objective": "availability"}
+        )
+        for t in (0.0, 5.0, 10.0):
+            hist.ingest(t, {key: expected})
+        assert engine.firing() == ["burn_rate_availability"]
+        fired = engine.transitions[0]
+        assert fired["value"] == pytest.approx(expected)
+        # Burning at exactly the replenishment rate must NOT page.
+        calm_hist = _offline()
+        calm = AlertEngine(
+            calm_hist,
+            rules_from_objectives(
+                [{"name": "availability", "kind": "availability",
+                  "target": 0.999}],
+                window_s=30.0, for_s=0.0,
+            ),
+        ).attach()
+        for t in (0.0, 5.0, 10.0):
+            calm_hist.ingest(t, {key: 1.0})
+        assert calm.firing() == []
+
+    def test_summary_reports_without_reevaluating(self):
+        hist, engine = self._engine({"for_s": 100.0})
+        hist.ingest(0.0, {"m": 50.0})
+        before = engine.summary()
+        assert before["schema"] == "tpuflow.obs.alerts/v1"
+        assert before["firing"] == 0
+        # Repeated scrapes advance nothing: the hold-down clock only
+        # moves on ticks.
+        for _ in range(5):
+            engine.summary()
+        assert self._state(engine) == "pending"
+
+    def test_transitions_ring_bounded(self):
+        hist = _offline()
+        rule = {"name": "r", "metric": "m", "threshold": 10.0,
+                "for_s": 0.0}
+        engine = AlertEngine(hist, [rule], max_transitions=6).attach()
+        for i in range(12):                      # flap 12 times
+            hist.ingest(float(2 * i), {"m": 50.0})
+            hist.ingest(float(2 * i + 1), {"m": 1.0})
+        assert len(engine.transitions) == 6

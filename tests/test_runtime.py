@@ -489,6 +489,18 @@ class TestMiniSoak:
         assert services["traffic"]["stop_index"] \
             < services["serving"]["stop_index"] \
             < services["gang"]["stop_index"]
+        # The autoscaler rode as the sixth managed service: its control
+        # loop ticked against the live daemon's history and stopped
+        # before the serving drain it depends on.
+        assert "autoscale" in services
+        assert services["autoscale"]["stop_index"] \
+            < services["serving"]["stop_index"]
+        auto = src["autoscale"]
+        assert auto["schema"] == "tpuflow.serve_autoscale/v1"
+        assert auto["ticks"] >= 1
+        # The hard floors held for the whole soak.
+        assert auto["replicas"] >= auto["floors"]["min_replicas"]
+        assert auto["max_inflight"] >= auto["floors"]["min_inflight"]
         report_path = os.path.join(result["root"], "soak_report.json")
         assert os.path.exists(report_path)
         assert json.load(open(report_path))["ok"] is True

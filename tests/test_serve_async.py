@@ -513,8 +513,9 @@ class TestAsyncServerEndToEnd:
             status, m = _get(base, "/metrics")
             assert set(m) == {
                 "jobs", "predict", "serving", "replicas", "slo",
-                "uptime_s",
+                "alerts", "uptime_s",
             }
+            assert m["alerts"]["schema"] == "tpuflow.obs.alerts/v1"
             assert m["serving"]["admitted"] == 1
             # The SLO section (tpuflow/obs/slo.py): one admitted
             # request, nothing shed => availability budget untouched.

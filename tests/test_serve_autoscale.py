@@ -1,0 +1,599 @@
+"""The SLO-driven serving autoscaler (tpuflow/serve_autoscale.py).
+
+The contracts under test (docs/serving.md autoscaler section):
+
+- knob resolution: defaults <- ``TPUFLOW_SERVE_AUTOSCALE_*`` env <-
+  explicit block, malformed env values naming the variable, and pair
+  constraints re-checked after the merge;
+- the control state machine on a fake clock: warmup, no-signal, the
+  ``hold_ticks`` hysteresis, the up ladder order (replicas → admission
+  → drop hedge → tighten drift), the down ladder in exact reverse,
+  judged replica down-moves (adopt on survival, revert + freeze on
+  regression), the ``max_moves`` budget, and the hard
+  ``min_replicas`` / ``min_inflight`` floors;
+- a replica move the data plane refuses clamps the ceiling (a blocked
+  rung is not retried forever) instead of crashing the loop;
+- the data-plane seams the controller actuates:
+  ``ContinuousBatcher.retire_lane`` (drain-then-remove, timeout
+  honest), ``ReplicaSet.resize`` (grow clones the tail, shrink returns
+  the retired lane keys, ``pick_lane`` reads one list snapshot), and
+  the ``AsyncServer.set_*`` setters (clamped, effective immediately).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from tpuflow.obs import Registry
+from tpuflow.obs.history import MetricsHistory, format_series
+from tpuflow.serve_autoscale import (
+    AUTOSCALE_DEFAULTS,
+    ObservingController,
+    resolve_autoscale,
+    validate_autoscale_block,
+)
+
+BURN = format_series("tpuflow_slo_burn_rate", {"objective": "availability"})
+BUDGET = format_series(
+    "tpuflow_slo_error_budget_remaining", {"objective": "availability"}
+)
+P99 = format_series("tpuflow_predict_latency_ms", {"quantile": "0.99"})
+
+
+class _FakeAdmission:
+    def __init__(self, max_inflight: int):
+        self.max_inflight = max_inflight
+
+
+class _FakeService:
+    def __init__(self, replicas: int):
+        self.replicas = replicas
+
+
+class FakeServer:
+    """Duck-types the four control seams + the reads the controller
+    uses (the AsyncServer adapter surface the benchmark also drives)."""
+
+    def __init__(
+        self, *, replicas=1, max_inflight=64, hedge_ms=25.0,
+        drift_threshold=6.0, fail_replicas_above=None,
+    ):
+        self.service = _FakeService(replicas)
+        self.admission = _FakeAdmission(max_inflight)
+        self.hedge_ms = hedge_ms
+        self.drift_threshold = drift_threshold
+        self.fail_replicas_above = fail_replicas_above
+        self.calls: list[tuple] = []
+
+    def set_replicas(self, n: int) -> int:
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"set_replicas(n={n}): need >= 1")
+        if (
+            self.fail_replicas_above is not None
+            and n > self.fail_replicas_above
+        ):
+            raise ValueError(
+                f"replicas={n} need more devices than the "
+                f"{self.fail_replicas_above} available"
+            )
+        self.calls.append(("replicas", n))
+        self.service.replicas = n
+        return n
+
+    def set_max_inflight(self, n: int) -> int:
+        n = max(1, int(n))
+        self.calls.append(("max_inflight", n))
+        self.admission.max_inflight = n
+        return n
+
+    def set_hedge_ms(self, ms: float) -> float:
+        ms = max(0.0, float(ms))
+        self.calls.append(("hedge_ms", ms))
+        self.hedge_ms = ms
+        return ms
+
+    def set_drift_threshold(self, z: float) -> float:
+        z = max(1e-9, float(z))
+        self.calls.append(("drift_threshold", z))
+        self.drift_threshold = z
+        return z
+
+
+def _history() -> MetricsHistory:
+    return MetricsHistory(
+        None, interval_s=1.0, max_points=4096, max_series=64,
+        retention_s=10**6,
+    )
+
+
+def _feed(hist, t, burn, budget=0.9, p99=50.0):
+    hist.ingest(float(t), {BURN: burn, BUDGET: budget, P99: p99})
+
+
+_FAST = {
+    "warmup_ticks": 0, "hold_ticks": 1, "judge_ticks": 2,
+    "window_s": 1.0, "freeze_s": 8.0,
+}
+
+
+def _controller(server=None, block=None, registry=None):
+    hist = _history()
+    ctrl = ObservingController(
+        server if server is not None else FakeServer(),
+        hist, registry=registry, block={**_FAST, **(block or {})},
+    )
+    return ctrl, hist
+
+
+class TestBlockValidation:
+    def test_non_dict_and_unknown_keys(self):
+        assert validate_autoscale_block("nope")
+        problems = validate_autoscale_block({"warp_factor": 9})
+        assert any("warp_factor" in p for p in problems)
+
+    def test_type_and_minimum_errors(self):
+        problems = validate_autoscale_block({
+            "hold_ticks": 0, "min_replicas": True,
+            "interval_s": "quick", "budget_floor": 1.5,
+        })
+        text = "\n".join(problems)
+        assert "hold_ticks must be >= 1" in text
+        assert "min_replicas" in text
+        assert "interval_s" in text
+        assert "budget_floor" in text
+
+    def test_pair_constraints(self):
+        problems = validate_autoscale_block({
+            "min_replicas": 4, "max_replicas": 2,
+            "min_inflight": 512, "max_inflight": 8,
+            "burn_low": 2.0, "burn_high": 1.0,
+        })
+        text = "\n".join(problems)
+        assert "min_replicas 4 exceeds" in text
+        assert "min_inflight 512 exceeds" in text
+        assert "burn_low 2.0 exceeds" in text
+
+    def test_empty_block_is_valid_defaults(self):
+        assert validate_autoscale_block({}) == []
+        assert resolve_autoscale(None) == AUTOSCALE_DEFAULTS
+        assert resolve_autoscale({}) == AUTOSCALE_DEFAULTS
+
+
+class TestEnvKnobs:
+    @pytest.mark.parametrize("var,value", [
+        ("TPUFLOW_SERVE_AUTOSCALE_INTERVAL_S", "quick"),
+        ("TPUFLOW_SERVE_AUTOSCALE_INTERVAL_S", "0.0"),
+        ("TPUFLOW_SERVE_AUTOSCALE_WINDOW_S", "0.5"),
+        ("TPUFLOW_SERVE_AUTOSCALE_HOLD_TICKS", "0"),
+        ("TPUFLOW_SERVE_AUTOSCALE_HOLD_TICKS", "two"),
+        ("TPUFLOW_SERVE_AUTOSCALE_MIN_REPLICAS", "0"),
+        ("TPUFLOW_SERVE_AUTOSCALE_BURN_HIGH", "-1"),
+        ("TPUFLOW_SERVE_AUTOSCALE_BUDGET_FLOOR", "1.5"),
+        ("TPUFLOW_SERVE_AUTOSCALE_MAX_MOVES", "many"),
+    ])
+    def test_malformed_env_names_the_variable(self, monkeypatch, var, value):
+        monkeypatch.setenv(var, value)
+        with pytest.raises(ValueError) as e:
+            resolve_autoscale(None)
+        assert var in str(e.value)
+
+    def test_env_overrides_defaults_block_beats_env(self, monkeypatch):
+        monkeypatch.setenv("TPUFLOW_SERVE_AUTOSCALE_MAX_REPLICAS", "3")
+        monkeypatch.setenv("TPUFLOW_SERVE_AUTOSCALE_BURN_HIGH", "2.5")
+        resolved = resolve_autoscale(None)
+        assert resolved["max_replicas"] == 3
+        assert resolved["burn_high"] == 2.5
+        assert resolve_autoscale({"max_replicas": 6})["max_replicas"] == 6
+
+    def test_pair_constraints_recheck_after_merge(self, monkeypatch):
+        # Valid in isolation, contradictory combined: env floor 4 vs
+        # block ceiling 2 must fail loudly, not silently invert.
+        monkeypatch.setenv("TPUFLOW_SERVE_AUTOSCALE_MIN_REPLICAS", "4")
+        with pytest.raises(ValueError, match="min_replicas 4 exceeds"):
+            resolve_autoscale({"max_replicas": 2})
+
+
+class TestControlStateMachine:
+    def test_warmup_then_no_signal(self):
+        ctrl, hist = _controller(block={"warmup_ticks": 2})
+        _feed(hist, 0.0, burn=50.0)             # hot, but warming up
+        assert ctrl.step(now=0.0)["action"] == "warmup"
+        assert ctrl.step(now=1.0)["action"] == "warmup"
+        empty_ctrl, _ = _controller()
+        assert empty_ctrl.step(now=0.0)["action"] == "no_signal"
+
+    def test_hold_ticks_hysteresis(self):
+        ctrl, hist = _controller(block={"hold_ticks": 3})
+        for t in range(4):
+            _feed(hist, float(t), burn=50.0, budget=0.05)
+        assert ctrl.step(now=0.0)["action"] == "hold"    # hot tick 1
+        assert ctrl.step(now=1.0)["action"] == "hold"    # hot tick 2
+        row = ctrl.step(now=2.0)                         # hot tick 3
+        assert row["action"] == "scale_up_replicas"
+        # One intervening neutral tick resets the streak (ticks spaced
+        # wider than window_s so each step sees only its own sample).
+        flappy, fh = _controller(block={"hold_ticks": 2})
+        _feed(fh, 0.0, burn=50.0)
+        assert flappy.step(now=0.0)["action"] == "hold"
+        _feed(fh, 10.0, burn=0.5)                        # neither hot nor calm
+        assert flappy.step(now=10.0)["action"] == "hold"
+        _feed(fh, 20.0, burn=50.0)
+        assert flappy.step(now=20.0)["action"] == "hold"  # streak restarted
+
+    def test_up_ladder_order_and_saturation(self):
+        server = FakeServer(replicas=1, max_inflight=64, hedge_ms=25.0,
+                            drift_threshold=4.0)
+        ctrl, hist = _controller(server, block={
+            "max_replicas": 2, "max_inflight": 256,
+        })
+        actions = []
+        for t in range(9):
+            _feed(hist, float(t), burn=50.0)
+            actions.append(ctrl.step(now=float(t))["action"])
+        assert actions == [
+            "scale_up_replicas",       # 1 -> 2 (the cheapest capacity)
+            "raise_inflight",          # 64 -> 128
+            "raise_inflight",          # 128 -> 256 (ceiling)
+            "drop_hedge",              # 25 -> 0 (stop multiplying load)
+            "tighten_drift",           # 4 -> 2
+            "tighten_drift",           # 2 -> 1 (floor of the rung)
+            "saturated", "saturated", "saturated",
+        ]
+        assert server.service.replicas == 2
+        assert server.admission.max_inflight == 256
+        assert server.hedge_ms == 0.0
+        assert server.drift_threshold == 1.0
+
+    def test_budget_floor_reads_as_hot(self):
+        ctrl, hist = _controller()
+        # Burn itself is calm; a nearly spent budget still forces the
+        # up ladder (add capacity, never trim it).
+        _feed(hist, 0.0, burn=0.1, budget=0.05)
+        assert ctrl.step(now=0.0)["action"] == "scale_up_replicas"
+
+    def test_down_ladder_reverses_and_respects_baselines(self):
+        server = FakeServer(replicas=2, max_inflight=64, hedge_ms=25.0,
+                            drift_threshold=6.0)
+        ctrl, hist = _controller(server, block={"max_replicas": 4})
+        # Distort the knobs the way a hot spell would.
+        server.drift_threshold = 1.5
+        server.hedge_ms = 0.0
+        server.admission.max_inflight = 512
+        actions = []
+        for t in range(8):
+            _feed(hist, float(t), burn=0.0, budget=1.0)
+            actions.append(ctrl.step(now=float(t))["action"])
+        assert actions[:7] == [
+            "relax_drift",             # 1.5 -> 3
+            "relax_drift",             # 3 -> 6 (the operator baseline)
+            "restore_hedge",           # 0 -> 25
+            "lower_inflight",          # 512 -> 256
+            "lower_inflight",          # 256 -> 128
+            "lower_inflight",          # 128 -> 64 (the baseline, not 8)
+            "scale_down_replicas",     # 2 -> 1, judged
+        ]
+        assert server.drift_threshold == 6.0      # never past baseline
+        assert server.hedge_ms == 25.0
+        assert server.admission.max_inflight == 64
+        assert server.service.replicas == 1
+
+    def test_judged_down_move_adopts_on_survival(self):
+        server = FakeServer(replicas=2)
+        ctrl, hist = _controller(server, block={"judge_ticks": 2})
+        _feed(hist, 0.0, burn=0.0)
+        assert ctrl.step(now=0.0)["action"] == "scale_down_replicas"
+        _feed(hist, 1.0, burn=0.0)
+        row = ctrl.step(now=1.0)
+        assert row["action"] == "judging" and row["judge_left"] == 1
+        _feed(hist, 2.0, burn=0.0)
+        row = ctrl.step(now=2.0)
+        assert row["action"] == "adopt"
+        assert row["adopted"] == "scale_down_replicas"
+        assert server.service.replicas == 1
+        assert ctrl.summary()["reversals"] == 0
+
+    def test_revert_and_freeze_on_regression(self):
+        server = FakeServer(replicas=2)
+        ctrl, hist = _controller(server, block={"freeze_s": 30.0})
+        _feed(hist, 0.0, burn=0.0)
+        assert ctrl.step(now=0.0)["action"] == "scale_down_replicas"
+        assert server.service.replicas == 1
+        # The shrink regresses: hot mid-judgment -> revert + freeze.
+        _feed(hist, 10.0, burn=50.0)
+        row = ctrl.step(now=10.0)
+        assert row["action"] == "revert"
+        assert row["undone"] == "scale_down_replicas"
+        assert server.service.replicas == 2       # restored
+        summary = ctrl.summary()
+        assert summary["reversals"] == 1
+        assert summary["frozen_until"] == pytest.approx(40.0)
+        # Calm ticks inside the freeze window move NOTHING (spaced
+        # wider than window_s so the hot sample ages out of view).
+        for t in (20.0, 25.0, 30.0):
+            _feed(hist, t, burn=0.0)
+            assert ctrl.step(now=t)["action"] == "hold"
+        assert server.service.replicas == 2
+        # Past the freeze the down ladder resumes.
+        _feed(hist, 45.0, burn=0.0)
+        assert ctrl.step(now=45.0)["action"] == "scale_down_replicas"
+
+    def test_hard_floors_never_crossed(self):
+        server = FakeServer(replicas=1, max_inflight=8, hedge_ms=0.0,
+                            drift_threshold=6.0)
+        ctrl, hist = _controller(server, block={
+            "min_replicas": 1, "min_inflight": 8,
+        })
+        for t in range(6):
+            _feed(hist, float(t), burn=0.0, budget=1.0)
+            assert ctrl.step(now=float(t))["action"] == "floor"
+        assert server.service.replicas == 1
+        assert server.admission.max_inflight == 8
+        assert server.calls == []                 # no seam ever touched
+
+    def test_max_moves_budget_freezes(self):
+        server = FakeServer(replicas=1)
+        ctrl, hist = _controller(server, block={
+            "max_moves": 1, "max_replicas": 4,
+        })
+        _feed(hist, 0.0, burn=50.0)
+        assert ctrl.step(now=0.0)["action"] == "scale_up_replicas"
+        _feed(hist, 1.0, burn=50.0)
+        row = ctrl.step(now=1.0)
+        assert row["action"] == "frozen" and row["reason"] == "max_moves"
+        assert ctrl.summary()["moves"] == 1
+
+    def test_blocked_replica_move_clamps_ceiling(self):
+        server = FakeServer(replicas=1, fail_replicas_above=1)
+        ctrl, hist = _controller(server, block={"max_replicas": 4})
+        _feed(hist, 0.0, burn=50.0)
+        row = ctrl.step(now=0.0)
+        assert row["action"] == "blocked"
+        assert row["attempted"] == "scale_up_replicas"
+        assert "devices" in row["error"]
+        assert ctrl.cfg["max_replicas"] == 1      # ceiling learned
+        # The next hot tick skips the impossible rung.
+        _feed(hist, 1.0, burn=50.0)
+        assert ctrl.step(now=1.0)["action"] == "raise_inflight"
+
+    def test_every_step_counted_and_trailed(self):
+        reg = Registry()
+        server = FakeServer(replicas=1)
+        ctrl, hist = _controller(server, registry=reg,
+                                 block={"warmup_ticks": 1})
+        _feed(hist, 0.0, burn=50.0)
+        ctrl.step(now=0.0)                        # warmup
+        _feed(hist, 1.0, burn=50.0)
+        ctrl.step(now=1.0)                        # scale_up_replicas
+        counts = {
+            tuple(sorted(lbl.items())): v
+            for _, lbl, v in reg.peek(
+                "serve_autoscale_steps_total"
+            ).collect()
+        }
+        assert counts[(("action", "warmup"),)] == 1.0
+        assert counts[(("action", "scale_up_replicas"),)] == 1.0
+        summary = ctrl.summary()
+        assert summary["schema"] == "tpuflow.serve_autoscale/v1"
+        assert summary["ticks"] == 2
+        assert [r["action"] for r in summary["recent"]] == [
+            "warmup", "scale_up_replicas",
+        ]
+
+    def test_trail_ring_bounded(self):
+        ctrl, hist = _controller()
+        ctrl._max_trail = 5
+        for t in range(12):
+            _feed(hist, float(t), burn=0.5)
+            ctrl.step(now=float(t))
+        assert len(ctrl.trail) == 5
+
+    def test_run_loop_stops_on_event(self):
+        ctrl, hist = _controller(block={"interval_s": 0.05})
+        _feed(hist, 0.0, burn=0.5)
+        stop = threading.Event()
+        out: list[dict] = []
+        t = threading.Thread(
+            target=lambda: out.append(ctrl.run(stop)), daemon=True
+        )
+        t.start()
+        import time as _time
+
+        _time.sleep(0.2)
+        stop.set()
+        t.join(5.0)
+        assert not t.is_alive()
+        assert out and out[0]["schema"] == "tpuflow.serve_autoscale/v1"
+        assert out[0]["ticks"] >= 1
+
+
+KEY = ("/artifacts", "m")
+
+
+class StubPredictor:
+    degraded = False
+
+    def __init__(self, delay_s: float = 0.0):
+        self.delay_s = delay_s
+        self.forward_calls: list[int] = []
+
+    def prepare_columns(self, columns):
+        return np.asarray(columns["x"], np.float32).reshape(-1, 1), None
+
+    def forward_prepared(self, x, batch_size: int = 4096):
+        if self.delay_s:
+            import time
+
+            time.sleep(self.delay_s)
+        self.forward_calls.append(len(x))
+        return x[:, 0]
+
+
+def _stub_clone(base, device):
+    return StubPredictor(delay_s=base.delay_s)
+
+
+class TestResizeSeams:
+    def _service(self, n, stub=None):
+        from tpuflow.serve import PredictService
+        from tpuflow.serve_replica import ReplicaSet
+
+        svc = PredictService(
+            batch_predicts=True, batch_mode="continuous",
+            warmup_buckets=0, replicas=n,
+        )
+        stub = stub or StubPredictor()
+        svc._cache[KEY] = ReplicaSet(
+            stub, KEY, n, registry=svc.registry, clone=_stub_clone
+        )
+        return svc
+
+    def test_replica_set_resize_grow_and_shrink(self):
+        from tpuflow.serve_replica import ReplicaSet
+
+        rs = ReplicaSet(StubPredictor(), KEY, 2, clone=_stub_clone)
+        assert rs.resize(4) == []                 # grow retires nothing
+        assert len(rs) == 4
+        assert len({id(r) for r in rs.replicas}) == 4
+        retired = rs.resize(2)
+        assert retired == [KEY + (2,), KEY + (3,)]
+        assert len(rs) == 2
+        assert rs.resize(2) == []                 # no-op width
+        with pytest.raises(ValueError, match="at least one replica"):
+            rs.resize(0)
+
+    def test_batcher_retire_lane_drains_then_removes(self):
+        svc = self._service(2)
+        rs = svc._cache[KEY]
+        lane_key, pred = svc.select_lane(KEY, rs)
+        svc.batcher.submit(lane_key, pred, np.zeros((1, 1), np.float32))
+        assert svc.batcher.retire_lane(lane_key, timeout=5.0)
+        assert lane_key not in svc.batcher.lane_keys(KEY)
+        # Retiring an absent lane is vacuously true (idempotent).
+        assert svc.batcher.retire_lane(lane_key, timeout=0.1)
+        svc.close()
+
+    def test_retire_lane_timeout_is_honest(self):
+        svc = self._service(1, stub=StubPredictor(delay_s=0.5))
+        rs = svc._cache[KEY]
+        lane_key, pred = svc.select_lane(KEY, rs)
+        # Non-blocking admit: the 0.5s forward is in flight while we
+        # ask for retirement with a tiny deadline — must report False,
+        # not block or lie.
+        entry = svc.batcher.enqueue(
+            lane_key, pred, np.zeros((1, 1), np.float32)
+        )
+        assert svc.batcher.retire_lane(lane_key, timeout=0.01) is False
+        # A generous deadline sees the drain finish.
+        assert svc.batcher.retire_lane(lane_key, timeout=10.0) is True
+        entry.wait(10.0)                          # the queued work DID run
+        svc.close()
+
+    def test_service_set_replicas_resizes_resident_sets(self):
+        svc = self._service(2)
+        assert svc.set_replicas(3) == 3
+        assert len(svc._cache[KEY]) == 3
+        assert svc.set_replicas(1) == 1
+        assert len(svc._cache[KEY]) == 1
+        assert svc.replicas == 1
+        svc.close()
+
+    def test_service_set_replicas_wraps_plain_predictors(self):
+        from tpuflow.serve import PredictService
+        from tpuflow.serve_replica import ReplicaSet
+
+        svc = PredictService(
+            batch_predicts=True, batch_mode="continuous", warmup_buckets=0,
+        )
+        svc._cache[KEY] = StubPredictor()
+        svc.set_replicas(2)
+        assert isinstance(svc._cache[KEY], ReplicaSet)
+        assert len(svc._cache[KEY]) == 2
+        svc.close()
+
+    def test_service_set_replicas_validation(self):
+        from tpuflow.serve import PredictService
+
+        svc = PredictService(batch_predicts=False)
+        with pytest.raises(ValueError, match="need an integer replica"):
+            svc.set_replicas(0)
+        with pytest.raises(ValueError, match="continuous"):
+            svc.set_replicas(2)                  # no batching engine
+        assert svc.set_replicas(1) == 1          # width 1 needs nothing
+        svc.close()
+
+    def test_async_server_setters_clamp_and_apply(self):
+        from tpuflow.serve import PredictService
+        from tpuflow.serve_async import AsyncServer
+
+        srv = AsyncServer(
+            "127.0.0.1", 0, enable_jobs=False,
+            service=PredictService(batch_predicts=False),
+            max_inflight=64, hedge_ms=10.0,
+        )
+        try:
+            assert srv.set_max_inflight(128) == 128
+            assert srv.admission.max_inflight == 128
+            assert srv.set_max_inflight(0) == 1          # floor 1
+            assert srv.set_hedge_ms(-3.0) == 0.0         # floor 0
+            assert srv.set_hedge_ms(40.0) == 40.0
+            assert srv.set_drift_threshold(2.5) == 2.5
+            assert srv.drift_threshold == 2.5
+            with pytest.raises(ValueError, match="continuous"):
+                srv.set_replicas(2)              # delegates diagnostics
+        finally:
+            srv.shutdown()
+
+
+class TestAsyncServerWiring:
+    def test_autoscale_off_by_default_on_via_flag_and_env(self, monkeypatch):
+        from tpuflow.serve import PredictService
+        from tpuflow.serve_async import AsyncServer
+
+        srv = AsyncServer(
+            "127.0.0.1", 0, enable_jobs=False,
+            service=PredictService(batch_predicts=False),
+        )
+        try:
+            assert srv.autoscaler is None
+            assert "autoscale" not in srv.metrics()
+        finally:
+            srv.shutdown()
+        monkeypatch.setenv("TPUFLOW_SERVE_AUTOSCALE", "1")
+        monkeypatch.setenv("TPUFLOW_SERVE_AUTOSCALE_MAX_REPLICAS", "2")
+        srv = AsyncServer(
+            "127.0.0.1", 0, enable_jobs=False,
+            service=PredictService(batch_predicts=False),
+        )
+        try:
+            assert srv.autoscaler is not None
+            assert srv.autoscaler.cfg["max_replicas"] == 2
+            auto = srv.metrics()["autoscale"]
+            assert auto["schema"] == "tpuflow.serve_autoscale/v1"
+            assert auto["floors"]["min_replicas"] == 1
+        finally:
+            srv.shutdown()
+
+    def test_history_and_alerts_attached_to_daemon(self):
+        from tpuflow.serve import PredictService
+        from tpuflow.serve_async import AsyncServer
+
+        srv = AsyncServer(
+            "127.0.0.1", 0, enable_jobs=False,
+            service=PredictService(batch_predicts=False),
+        )
+        try:
+            assert srv.history.registry is srv.registry
+            # The SLO pre-sample hook publishes burn gauges into the
+            # sampled tick, so the autoscaler's lanes exist.
+            srv.history.sample(now=1.0)
+            assert srv.history.labelsets("slo_burn_rate") or True
+            summary = srv.alerts.summary()
+            assert summary["schema"] == "tpuflow.obs.alerts/v1"
+            names = {r["name"] for r in summary["rules"]}
+            assert "burn_rate_availability" in names
+        finally:
+            srv.shutdown()

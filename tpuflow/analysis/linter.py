@@ -148,6 +148,13 @@ RULES = {
               "durations with time.monotonic()/time.perf_counter(); "
               "time.time() is for TIMESTAMPS (trail records, "
               "heartbeats), never for deltas",
+    "TPF022": "bare time.sleep inside a control/sampler loop in "
+              "tpuflow/obs/ or tpuflow/serve_autoscale.py: a sleeping "
+              "loop ignores its stop event for a whole period (shutdown "
+              "drills hang on the join) and its cadence cannot be "
+              "driven by a test's fake clock — pace the loop with "
+              "stop_event.wait(interval) (interruptible, injectable) "
+              "like the history sampler and the autoscaler do",
 }
 
 _HOST_SYNC_NAMES = {"float", "bool"}
@@ -293,6 +300,13 @@ _ONLINE_PATH_FRAGMENT = "tpuflow/online/"
 _STREAM_ITER_WORDS = ("window", "stream", "chunk", "batch", "source")
 _DEVICE_ROOTS = {"jax", "jnp"}
 
+# TPF022 scope: the modules whose loops ARE control/sampler loops by
+# construction — the history sampler, the alert engine, anything under
+# tpuflow/obs/, and the serving autoscaler. Their pacing contract is
+# stop_event.wait(interval): interruptible at shutdown, injectable in
+# tests. Elsewhere a loop's sleep is judged by TPF007/TPF009/TPF017.
+_CONTROL_LOOP_SUFFIX = "tpuflow/serve_autoscale.py"
+
 
 class _Linter(ast.NodeVisitor):
     def __init__(self, path: str, source: str, sites: dict):
@@ -312,6 +326,9 @@ class _Linter(ast.NodeVisitor):
         self._is_obs = _OBS_DIR_FRAGMENT in norm
         self._socket_allowed = norm.endswith(_SOCKET_ALLOWED_SUFFIXES)
         self._jit_seam = norm.endswith(_JIT_SEAM_SUFFIXES)
+        self._is_control_loop_module = (
+            self._is_obs or norm.endswith(_CONTROL_LOOP_SUFFIX)
+        )
 
     def run(self) -> list[Diagnostic]:
         self.visit(self.tree)
@@ -428,6 +445,7 @@ class _Linter(ast.NodeVisitor):
         self._check_step_aux_loop(node)
         self._check_online_consumer_loop(node)
         self._check_loop_jit(node)
+        self._check_control_loop_sleep(node)
         self.generic_visit(node)
 
     # --- TPF014: jit/pjit calls inside loop bodies ---
@@ -544,6 +562,7 @@ class _Linter(ast.NodeVisitor):
     def visit_While(self, node) -> None:
         self._check_unbounded_poll(node)
         self._check_loop_jit(node)
+        self._check_control_loop_sleep(node)
         self.generic_visit(node)
 
     def visit_AsyncFor(self, node) -> None:
@@ -576,6 +595,34 @@ class _Linter(ast.NodeVisitor):
                 "TPF007", node,
                 "while True: loop sleeps but never checks a bound",
             )
+
+    # --- TPF022: bare sleep pacing a control/sampler loop ---
+
+    def _check_control_loop_sleep(self, node) -> None:
+        """In the control-loop modules (tpuflow/obs/, the autoscaler),
+        a loop paced by ``time.sleep`` (or a bare imported ``sleep``)
+        cannot be interrupted by its stop event mid-period and cannot
+        be driven by a fake clock — the pacing contract there is
+        ``stop_event.wait(interval)``. One loop level per visit (the
+        ``_walk_loop_level`` discipline), so nested loops are judged
+        by their own visits; nested defs belong to their callers."""
+        if not self._is_control_loop_module:
+            return
+        for sub in self._walk_loop_level(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            func = sub.func
+            flagged = (
+                isinstance(func, ast.Attribute)
+                and func.attr == "sleep"
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "time"
+            ) or (isinstance(func, ast.Name) and func.id == "sleep")
+            if flagged:
+                self._emit(
+                    "TPF022", sub,
+                    f"{ast.unparse(sub)} paces this loop",
+                )
 
     @staticmethod
     def _call_name(func) -> str | None:

@@ -13,8 +13,11 @@ With no positional args, the synthetic well schema is used end-to-end.
 Daemon mode: ``python -m tpuflow.cli serve [...]`` launches the async
 serving control plane (``tpuflow/serve_async.py`` — admission control,
 continuous batching, deadlines, ``--replicas`` for the multi-replica
-data plane and ``--drift-admission`` for the drift gate;
-docs/serving.md) with the remaining args; ``serve --threaded``
+data plane, ``--drift-admission`` for the drift gate, and
+``--autoscale`` for the SLO-driven autoscaler
+(``tpuflow/serve_autoscale.py``, knobs via
+``TPUFLOW_SERVE_AUTOSCALE_*``); docs/serving.md) with the remaining
+args; ``serve --threaded``
 launches the legacy threaded front end (``tpuflow/serve.py``) instead.
 The subcommand is intercepted before the training parser so the
 reference's positional contract is untouched.

@@ -705,6 +705,27 @@ class ContinuousBatcher(_BatcherBase):
                 lane.closing = True
                 lane.cond.notify_all()
 
+    def retire_lane(self, key: tuple, timeout: float = 5.0) -> bool:
+        """``close_lane`` plus a bounded wait for the lane thread to
+        actually drain and drop its table entry — the synchronous seam
+        replica downscaling needs (a retired replica's lane must finish
+        its queued work before the replica object is released). Returns
+        True once the entry is gone (or was never there), False if the
+        drain outlived ``timeout``."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            lane = self._lanes.get(key)
+            if lane is None:
+                return True
+            lane.closing = True
+            lane.cond.notify_all()
+            while self._lanes.get(key) is lane:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+        return True
+
     def close_lanes_for(self, prefix: tuple) -> int:
         """Retire EVERY lane whose key extends ``prefix`` — the
         replica-aware spill/reload hook: an artifact's eviction must
@@ -785,9 +806,12 @@ class ContinuousBatcher(_BatcherBase):
                         # Idle past lane_idle_s with nothing queued:
                         # retire (under the lock, so no enqueue can be
                         # appending concurrently). The next submit for
-                        # this key opens a fresh lane.
+                        # this key opens a fresh lane. notify_all wakes
+                        # any retire_lane() waiter watching for the
+                        # table entry to go.
                         if self._lanes.get(key) is lane:
                             del self._lanes[key]
+                            self._cond.notify_all()
                         return
                 if not lane.entries and (lane.closing or self._stop):
                     # Drained and retiring: drop the table entry only if
@@ -795,6 +819,7 @@ class ContinuousBatcher(_BatcherBase):
                     # closing one under the same key).
                     if self._lanes.get(key) is lane:
                         del self._lanes[key]
+                        self._cond.notify_all()
                     return
                 taken, expired = self._drain_lane_locked(
                     lane, time.monotonic()

@@ -28,8 +28,22 @@ Plus the interpretation layer on top of the substrate:
 - ``timeline``   — Chrome trace-event export of any span trail
   (``python -m tpuflow.obs timeline <jsonl> -o trace.json``), loadable
   in Perfetto.
+- ``history``    — :class:`MetricsHistory`: bounded time-series rings
+  sampled from a Registry on an injectable-clock cadence, windowed
+  queries (rate/mean/max/quantile/delta), JSONL spill for offline
+  replay (``python -m tpuflow.obs history``).
+- ``alerts``     — :class:`AlertEngine`: declarative threshold +
+  ``for_s`` hold-down rules over history windows, firing/resolved
+  lifecycle into forensics/trail/``obs_alerts_firing`` gauges; the SLO
+  objectives import as burn-rate rules
+  (:func:`rules_from_objectives`).
 """
 
+from tpuflow.obs.alerts import (
+    AlertEngine,
+    rules_from_objectives,
+    validate_rules,
+)
 from tpuflow.obs.forensics import (
     clear_events,
     dump_forensics,
@@ -44,6 +58,7 @@ from tpuflow.obs.health import (
     install_compile_listener,
     publish_roofline,
 )
+from tpuflow.obs.history import MetricsHistory
 from tpuflow.obs.metrics import (
     DEFAULT_COUNT_BUCKETS,
     DEFAULT_TIME_BUCKETS,
@@ -70,9 +85,11 @@ __all__ = [
     "DEFAULT_COUNT_BUCKETS",
     "DEFAULT_TIME_BUCKETS",
     "HEALTH_POLICIES",
+    "AlertEngine",
     "Counter",
     "Gauge",
     "Histogram",
+    "MetricsHistory",
     "NumericsDivergence",
     "NumericsWatchdog",
     "RecompileDetector",
@@ -91,7 +108,9 @@ __all__ = [
     "record_event",
     "record_span",
     "render_prometheus",
+    "rules_from_objectives",
     "span",
     "trace_from_env",
     "use_trace",
+    "validate_rules",
 ]

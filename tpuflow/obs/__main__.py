@@ -7,6 +7,8 @@ Usage::
     python -m tpuflow.obs timeline <metrics.jsonl> -o trace.json
     python -m tpuflow.obs fleet    <dir...> [-o fleet.json] [--summary P]
     python -m tpuflow.obs slo      <dir...> [--objectives F] [-o card.json]
+    python -m tpuflow.obs history  <spill.jsonl|glob|dir> [...] [--metric M]
+    python -m tpuflow.obs alerts   <spill.jsonl|glob|dir> [...] [--rules F]
 
 ``tail``/``summary`` read the JSONL event format every tpuflow sink
 writes — a training run's ``metrics.jsonl`` (``--metrics`` /
@@ -20,6 +22,15 @@ last; ``summary`` aggregates the whole trail: events by type, the
 epoch-loss trajectory, span time by name, the wall-clock window.
 ``timeline`` exports one trail's spans as Chrome trace-event JSON,
 loadable in Perfetto (https://ui.perfetto.dev).
+
+``history`` replays a daemon's metrics-history spill
+(``TPUFLOW_OBS_HISTORY_SPILL`` — ``history_sample`` ticks written by
+``tpuflow/obs/history.py``) and prints per-series summaries; ``alerts``
+replays the same spill through an offline
+:class:`~tpuflow.obs.alerts.AlertEngine` against a JSON rules file (or
+the committed SLO burn-rate rules with ``--slo``) and prints every
+firing/resolved transition — alerting forensics after the fact, same
+math as the live daemons.
 
 ``fleet`` is the multi-process view (``tpuflow/obs/fleet.py``): discover
 every trail under one or more storage roots, merge them into ONE
@@ -245,6 +256,135 @@ def _slo(
     return 0
 
 
+def _offline_history():
+    """An unbounded offline MetricsHistory — replay must never
+    downsample or drop what the live daemon already bounded."""
+    from tpuflow.obs.history import MetricsHistory
+
+    return MetricsHistory(
+        None, interval_s=1.0, max_points=100000, max_series=100000,
+        retention_s=10**9,
+    )
+
+
+def _replay_history(patterns: list[str]) -> tuple:
+    """Rebuild an offline MetricsHistory from spilled ``history_sample``
+    ticks (time-ordered, merged across files). Returns ``(history,
+    ticks, skipped)``."""
+    return _replay_history_into(_offline_history(), patterns)
+
+
+def _history(patterns: list[str], metric: str | None, as_json: bool) -> int:
+    history, ticks, skipped = _replay_history(patterns)
+    rows = []
+    for s in history.all_series():
+        if metric and metric not in s["name"]:
+            continue
+        values = [v for _, v in s["points"]]
+        if not values:
+            continue
+        rows.append({
+            "series": s["name"], "labels": s["labels"],
+            "points": len(values),
+            "first_t": round(s["points"][0][0], 3),
+            "last_t": round(s["points"][-1][0], 3),
+            "min": min(values), "max": max(values), "last": values[-1],
+        })
+    if as_json:
+        print(json.dumps({
+            "ticks": ticks, "series": rows, "skipped_lines": skipped,
+        }, indent=2))
+    else:
+        print(f"{ticks} history ticks, {len(rows)} series"
+              + (f" (skipped_lines: {skipped})" if skipped else ""))
+        from tpuflow.obs.history import format_series
+
+        for r in rows:
+            print(f"  {format_series(r['series'], r['labels'])}: "
+                  f"n={r['points']} last={r['last']:g} "
+                  f"min={r['min']:g} max={r['max']:g}")
+    if not ticks:
+        print("no history_sample records found", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _alerts(
+    patterns: list[str], rules_path: str | None, use_slo: bool,
+    as_json: bool, fail_on_firing: bool,
+) -> int:
+    from tpuflow.obs.alerts import (
+        AlertEngine,
+        rules_from_objectives,
+        validate_rules,
+    )
+
+    if rules_path:
+        with open(rules_path, encoding="utf-8") as f:
+            rules = json.load(f)
+        problems = validate_rules(rules)
+        if problems:
+            raise ValueError(
+                f"{rules_path}: " + "; ".join(problems)
+            )
+    elif use_slo:
+        rules = rules_from_objectives()
+    else:
+        raise ValueError(
+            "alerts needs --rules FILE (a JSON list of rule objects) or "
+            "--slo (the committed SLO burn-rate rules)"
+        )
+    history = _offline_history()
+    engine = AlertEngine(history, rules).attach()
+    _, ticks, skipped = _replay_history_into(history, patterns)
+    summary = engine.summary()
+    out = {
+        "ticks": ticks,
+        "transitions": engine.transitions,
+        "firing": engine.firing(),
+        "rules": summary["rules"],
+        "skipped_lines": skipped,
+    }
+    if as_json:
+        print(json.dumps(out, indent=2))
+    else:
+        print(f"{ticks} history ticks, {len(rules)} rules, "
+              f"{len(engine.transitions)} transitions"
+              + (f" (skipped_lines: {skipped})" if skipped else ""))
+        for rec in engine.transitions:
+            print(f"  t={rec['t']:g} {rec['state'].upper():>8} "
+                  f"{rec['rule']} value={rec['value']:g} "
+                  f"threshold={rec['threshold']:g}")
+        for row in summary["rules"]:
+            print(f"  final: {row['name']} state={row['state']} "
+                  f"value={row['value']}")
+    if not ticks:
+        print("no history_sample records found", file=sys.stderr)
+        return 1
+    if fail_on_firing and out["firing"]:
+        print(f"firing: {out['firing']}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _replay_history_into(history, patterns: list[str]) -> tuple:
+    """Feed spilled ticks into an EXISTING history (one with listeners
+    already attached — the alerts replay path)."""
+    events, skipped, _ = _read_all(patterns)
+    ticks = 0
+    for rec in events:
+        if rec.get("event") != "history_sample":
+            continue
+        samples = rec.get("samples")
+        t = rec.get("t", rec.get("time"))
+        if not isinstance(samples, dict) or not isinstance(t, (int, float)):
+            skipped += 1
+            continue
+        history.ingest(float(t), samples)
+        ticks += 1
+    return history, ticks, skipped
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tpuflow.obs",
@@ -293,6 +433,35 @@ def main(argv: list[str] | None = None) -> int:
                        help="also write the report card JSON here")
     p_slo.add_argument("--window", type=float, default=300.0,
                        metavar="S", help="burn-rate window seconds")
+    p_hist = sub.add_parser(
+        "history",
+        help="replay a metrics-history spill (history_sample ticks) "
+        "and print per-series summaries",
+    )
+    p_hist.add_argument("file", nargs="+",
+                        help="spill file(s), glob pattern(s), or dir(s)")
+    p_hist.add_argument("--metric", default=None, metavar="SUBSTR",
+                        help="only series whose name contains SUBSTR")
+    p_hist.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable output")
+    p_alerts = sub.add_parser(
+        "alerts",
+        help="replay a metrics-history spill through alert rules and "
+        "print every firing/resolved transition",
+    )
+    p_alerts.add_argument("file", nargs="+",
+                          help="spill file(s), glob pattern(s), or dir(s)")
+    p_alerts.add_argument("--rules", default=None, metavar="FILE",
+                          help="JSON rules file — a list of rule objects "
+                          "(docs/observability.md has the grammar)")
+    p_alerts.add_argument("--slo", action="store_true",
+                          help="use the committed SLO objectives as "
+                          "burn-rate/latency rules instead of --rules")
+    p_alerts.add_argument("--json", action="store_true", dest="as_json",
+                          help="machine-readable output")
+    p_alerts.add_argument("--fail-on-firing", action="store_true",
+                          help="exit 1 if any rule is firing at the end "
+                          "of the replay (CI gating)")
     args = ap.parse_args(argv)
     try:
         if args.cmd == "tail":
@@ -303,6 +472,11 @@ def main(argv: list[str] | None = None) -> int:
             return _fleet(args.root, args.out, args.summary)
         if args.cmd == "slo":
             return _slo(args.root, args.objectives, args.out, args.window)
+        if args.cmd == "history":
+            return _history(args.file, args.metric, args.as_json)
+        if args.cmd == "alerts":
+            return _alerts(args.file, args.rules, args.slo, args.as_json,
+                           args.fail_on_firing)
         return _summary(args.file)
     except OSError as e:
         print(f"{e}", file=sys.stderr)

@@ -1,0 +1,384 @@
+"""Declarative alerts over the metrics history: threshold + hold-down.
+
+A gauge crossing a line for one scrape is noise; crossing it for
+``for_s`` seconds is an incident. :class:`AlertEngine` evaluates
+declarative rules against :class:`~tpuflow.obs.history.MetricsHistory`
+windows on every history tick, with the standard firing lifecycle:
+
+``ok`` → (breach observed) → ``pending`` → (breach sustained for
+``for_s``) → ``firing`` → (recovery observed) → ``ok`` again, with an
+``alert_resolved`` record.
+
+Each transition is recorded three ways: the forensics ring
+(``alert_firing`` / ``alert_resolved`` events — causally linkable in
+the fleet timeline), the daemon's JSONL trail when one is attached,
+and the ``obs_alerts_firing{rule=}`` gauge (1 while firing, 0 after
+resolve) plus ``obs_alerts_transitions_total{rule=,state=}`` counters
+for the Prometheus view. Both daemons render :meth:`summary` as the
+``alerts`` section of JSON ``/metrics``; ``python -m tpuflow.obs
+alerts`` replays a spilled history against a rules file offline.
+
+Rule grammar (one dict per rule; :func:`validate_rules` is the
+never-raises preflight, docs/observability.md has the table)::
+
+    {"name": "burn_availability",        # unique, required
+     "metric": "slo_burn_rate",          # series name, required
+     "labels": {"objective": "availability"},
+     "query": "mean",                    # latest|rate|mean|max|quantile|delta
+     "q": 0.99,                          # quantile only
+     "op": ">",                          # > >= < <=
+     "threshold": 1.0,                   # required
+     "window_s": 60.0,
+     "for_s": 30.0,                      # hold-down before firing
+     "severity": "page"}                 # page|warn
+
+Firing state is keyed by RULE, not by history points: a downsample
+(the history's memory-bounding decimation) thins the window a firing
+rule is evaluated over but cannot re-fire it — the
+no-double-fire-across-downsample drill in tests/test_obs_history.py.
+
+:func:`rules_from_objectives` imports the SLO engine's committed
+objectives as burn-rate / latency-ceiling rules, so the alerting
+thresholds and the report-card math share one source of truth.
+
+Dependency-light (no jax): usable offline on spill files alone.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+SCHEMA_ID = "tpuflow.obs.alerts/v1"
+
+QUERIES = ("latest", "rate", "mean", "max", "quantile", "delta")
+OPS = {
+    ">": lambda v, t: v > t,
+    ">=": lambda v, t: v >= t,
+    "<": lambda v, t: v < t,
+    "<=": lambda v, t: v <= t,
+}
+SEVERITIES = ("page", "warn")
+
+RULE_DEFAULTS = {
+    "labels": {},
+    "query": "latest",
+    "q": 0.99,
+    "op": ">",
+    "window_s": 60.0,
+    "for_s": 0.0,
+    "severity": "warn",
+    "description": "",
+}
+
+_WINDOWED = ("rate", "mean", "max", "quantile", "delta")
+
+
+def validate_rules(rules) -> list[str]:
+    """Every problem with a rules list, as strings — never raises (the
+    validate_autotune_block contract: a preflight diagnostic, not a
+    crash deep inside the evaluation loop)."""
+    problems: list[str] = []
+    if not isinstance(rules, (list, tuple)):
+        return [f"rules must be a list of rule objects, got {type(rules).__name__}"]
+    seen: set[str] = set()
+    for i, rule in enumerate(rules):
+        where = f"rule[{i}]"
+        if not isinstance(rule, dict):
+            problems.append(f"{where}: must be an object, got {type(rule).__name__}")
+            continue
+        name = rule.get("name")
+        if not name or not isinstance(name, str):
+            problems.append(f"{where}: needs a non-empty string 'name'")
+        elif name in seen:
+            problems.append(f"{where}: duplicate rule name {name!r}")
+        else:
+            seen.add(name)
+            where = f"rule {name!r}"
+        if not rule.get("metric") or not isinstance(rule.get("metric"), str):
+            problems.append(f"{where}: needs a non-empty string 'metric'")
+        if "threshold" not in rule or not isinstance(
+            rule["threshold"], (int, float)
+        ) or isinstance(rule["threshold"], bool):
+            problems.append(f"{where}: needs a numeric 'threshold'")
+        q = rule.get("query", RULE_DEFAULTS["query"])
+        if q not in QUERIES:
+            problems.append(
+                f"{where}: query {q!r} is not one of {'/'.join(QUERIES)}"
+            )
+        op = rule.get("op", RULE_DEFAULTS["op"])
+        if op not in OPS:
+            problems.append(
+                f"{where}: op {op!r} is not one of {'/'.join(OPS)}"
+            )
+        sev = rule.get("severity", RULE_DEFAULTS["severity"])
+        if sev not in SEVERITIES:
+            problems.append(
+                f"{where}: severity {sev!r} is not one of "
+                f"{'/'.join(SEVERITIES)}"
+            )
+        labels = rule.get("labels", {})
+        if not isinstance(labels, dict):
+            problems.append(f"{where}: labels must be an object")
+        for key, minimum in (("window_s", 0.0), ("for_s", 0.0)):
+            v = rule.get(key, RULE_DEFAULTS[key])
+            if not isinstance(v, (int, float)) or isinstance(v, bool) or (
+                v < minimum
+            ):
+                problems.append(f"{where}: {key} must be a number >= {minimum}")
+        unknown = sorted(
+            set(rule) - {"name", "metric", "threshold"} - set(RULE_DEFAULTS)
+        )
+        if unknown:
+            problems.append(f"{where}: unknown keys {unknown}")
+    return problems
+
+
+def normalize_rule(rule: dict) -> dict:
+    """Defaults applied, types coerced. Raises ValueError listing every
+    problem (the fail-loud constructor path; :func:`validate_rules` is
+    the never-raises preflight)."""
+    problems = validate_rules([rule])
+    if problems:
+        raise ValueError("invalid alert rule: " + "; ".join(problems))
+    out = {**RULE_DEFAULTS, **rule}
+    out["labels"] = dict(out["labels"])
+    out["threshold"] = float(out["threshold"])
+    out["window_s"] = float(out["window_s"])
+    out["for_s"] = float(out["for_s"])
+    out["q"] = float(out["q"])
+    return out
+
+
+def rules_from_objectives(
+    objectives=None, *, window_s: float = 60.0, for_s: float = 15.0,
+    burn_threshold: float = 1.0,
+) -> list[dict]:
+    """The SLO engine's objectives as alert rules — one source of truth
+    for "what does violated mean". Availability objectives become
+    burn-rate rules over the ``slo_burn_rate{objective=}`` gauge
+    history (threshold 1.0 = spending the budget exactly as fast as it
+    replenishes); latency-ceiling objectives become rules over the
+    summary's p99 series with the objective's own target as the line.
+    ``objectives=None`` imports the committed serving objectives
+    (env-tunable targets included) from ``tpuflow/obs/slo.py``."""
+    from tpuflow.obs.slo import normalize_objectives, serve_objectives
+
+    objs = (
+        serve_objectives() if objectives is None
+        else normalize_objectives(objectives)
+    )
+    rules: list[dict] = []
+    for obj in objs:
+        if obj["kind"] == "availability":
+            rules.append({
+                "name": f"burn_rate_{obj['name']}",
+                "metric": "slo_burn_rate",
+                "labels": {"objective": obj["name"]},
+                "query": "mean",
+                "op": ">",
+                "threshold": float(burn_threshold),
+                "window_s": float(window_s),
+                "for_s": float(for_s),
+                "severity": "page",
+                "description": (
+                    f"{obj['name']} error budget burning faster than it "
+                    f"replenishes (target {obj['target']})"
+                ),
+            })
+        elif obj["kind"] == "latency_p99":
+            rules.append({
+                "name": f"p99_over_target_{obj['name']}",
+                "metric": obj.get("summary", "predict_latency_ms"),
+                "labels": {"quantile": "0.99"},
+                "query": "max",
+                "op": ">",
+                "threshold": float(obj["target"]),
+                "window_s": float(window_s),
+                "for_s": float(for_s),
+                "severity": "warn",
+                "description": (
+                    f"p99 latency over the {obj['target']} ms objective"
+                ),
+            })
+    return rules
+
+
+class AlertEngine:
+    """Evaluate rules over a history on every tick; own the lifecycle.
+
+    State is guarded by ``self._lock`` (evaluations may come from the
+    sampler thread AND a scrape handler); the forensics/trail/metric
+    emissions happen outside it — recording must never hold the
+    engine's lock across I/O (TPF017)."""
+
+    def __init__(
+        self, history, rules=(), *, registry=None, logger=None, clock=None,
+        max_transitions: int = 256,
+    ):
+        self.history = history
+        self.rules = [normalize_rule(dict(r)) for r in rules]
+        self.clock = clock or getattr(history, "clock", None) or time.monotonic
+        self.logger = logger
+        self._lock = threading.Lock()
+        self._states: dict[str, dict] = {
+            r["name"]: {"state": "ok", "since": None, "breach_since": None,
+                        "value": None}
+            for r in self.rules
+        }
+        self.max_transitions = int(max_transitions)
+        self.transitions: list[dict] = []
+        self._firing_gauge = self._transitions_total = None
+        if registry is not None:
+            self._firing_gauge = registry.gauge(
+                "obs_alerts_firing",
+                "1 while the rule is firing, 0 after it resolves",
+            )
+            self._transitions_total = registry.counter(
+                "obs_alerts_transitions_total",
+                "alert lifecycle transitions, by rule and new state",
+            )
+
+    def attach(self) -> "AlertEngine":
+        """Subscribe to the history's tick notifications — evaluation
+        then rides the sampler's cadence."""
+        self.history.add_listener(self._on_tick)
+        return self
+
+    def _on_tick(self, now: float) -> None:
+        self.evaluate(now)
+
+    def _query(self, rule: dict, now: float):
+        h, metric, labels = self.history, rule["metric"], rule["labels"]
+        q = rule["query"]
+        if q == "latest":
+            return h.latest(metric, **labels)
+        if q == "quantile":
+            return h.quantile(
+                metric, rule["q"], rule["window_s"], now=now, **labels
+            )
+        return getattr(h, q)(metric, rule["window_s"], now=now, **labels)
+
+    def evaluate(self, now: float | None = None) -> list[dict]:
+        """One evaluation pass; returns the per-rule status rows (the
+        ``alerts.rules`` section of ``/metrics``). A rule whose series
+        has no data keeps its current state — absence is not recovery:
+        resolving a firing alert because the sampler missed a tick
+        would hide exactly the incident it exists to report."""
+        now = self.clock() if now is None else float(now)
+        rows: list[dict] = []
+        emissions: list[dict] = []
+        for rule in self.rules:
+            try:
+                value = self._query(rule, now)
+            except Exception:
+                value = None
+            breach = (
+                OPS[rule["op"]](value, rule["threshold"])
+                if value is not None else None
+            )
+            with self._lock:
+                st = self._states[rule["name"]]
+                st["value"] = value
+                if breach is True:
+                    if st["state"] == "ok":
+                        st["state"] = "pending"
+                        st["breach_since"] = now
+                    if st["state"] == "pending" and (
+                        now - st["breach_since"] >= rule["for_s"]
+                    ):
+                        st["state"] = "firing"
+                        st["since"] = now
+                        emissions.append(
+                            self._transition_locked(rule, "firing", now, value)
+                        )
+                elif breach is False:
+                    if st["state"] == "firing":
+                        st["state"] = "ok"
+                        st["since"] = now
+                        st["breach_since"] = None
+                        emissions.append(
+                            self._transition_locked(rule, "resolved", now, value)
+                        )
+                    elif st["state"] == "pending":
+                        st["state"] = "ok"
+                        st["breach_since"] = None
+                rows.append({
+                    "name": rule["name"],
+                    "state": st["state"],
+                    "value": value,
+                    "query": rule["query"],
+                    "metric": rule["metric"],
+                    "op": rule["op"],
+                    "threshold": rule["threshold"],
+                    "window_s": rule["window_s"],
+                    "for_s": rule["for_s"],
+                    "severity": rule["severity"],
+                    "since": st["since"],
+                })
+        for rec in emissions:
+            self._emit(rec)
+        return rows
+
+    def _transition_locked(self, rule, state, now, value) -> dict:
+        rec = {
+            "rule": rule["name"],
+            "state": state,
+            "severity": rule["severity"],
+            "value": value,
+            "threshold": rule["threshold"],
+            "metric": rule["metric"],
+            "t": now,
+        }
+        self.transitions.append(rec)
+        if len(self.transitions) > self.max_transitions:
+            del self.transitions[0]
+        return rec
+
+    def _emit(self, rec: dict) -> None:
+        from tpuflow.obs.forensics import record_event
+
+        event = "alert_firing" if rec["state"] == "firing" else "alert_resolved"
+        record_event(event, **{k: v for k, v in rec.items() if k != "state"})
+        if self.logger is not None:
+            try:
+                self.logger.write(event, **{
+                    k: v for k, v in rec.items() if k != "state"
+                })
+            except Exception:
+                pass
+        if self._firing_gauge is not None:
+            self._firing_gauge.set(
+                1.0 if rec["state"] == "firing" else 0.0, rule=rec["rule"]
+            )
+        if self._transitions_total is not None:
+            self._transitions_total.inc(rule=rec["rule"], state=rec["state"])
+
+    def firing(self) -> list[str]:
+        with self._lock:
+            return sorted(
+                name for name, st in self._states.items()
+                if st["state"] == "firing"
+            )
+
+    def summary(self) -> dict:
+        """The ``alerts`` section of JSON ``/metrics``: every rule's
+        current state (NO re-evaluation — a scrape reports, it doesn't
+        advance hold-down clocks)."""
+        with self._lock:
+            rows = [
+                {
+                    "name": rule["name"],
+                    "state": self._states[rule["name"]]["state"],
+                    "value": self._states[rule["name"]]["value"],
+                    "threshold": rule["threshold"],
+                    "severity": rule["severity"],
+                    "since": self._states[rule["name"]]["since"],
+                }
+                for rule in self.rules
+            ]
+        return {
+            "schema": SCHEMA_ID,
+            "firing": sum(1 for r in rows if r["state"] == "firing"),
+            "rules": rows,
+        }

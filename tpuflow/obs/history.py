@@ -1,0 +1,487 @@
+"""Bounded in-memory metrics history: the substrate scrapes can't give.
+
+A ``Registry`` (``tpuflow/obs/metrics.py``) answers "what is the value
+NOW"; every consumer that needs "what happened over the last window" —
+burn-rate alerting with hold-downs, the serving autoscaler's
+sustained-win hysteresis — has to difference snapshots itself, badly.
+:class:`MetricsHistory` is the one copy of that differencing: a
+sampler (an injectable-clock cadence on a stop-event-bound daemon
+thread, or explicit :meth:`sample` calls from tests and scrape
+handlers) appends every family's collected samples to bounded
+per-series rings, and windowed queries (:meth:`rate`, :meth:`mean`,
+:meth:`max`, :meth:`quantile`, :meth:`delta`, :meth:`latest`) read
+them back.
+
+Memory is provably bounded: at most ``max_series`` series, each at
+most ``max_points`` points of two floats. A series that would exceed
+``max_points`` is **downsampled in place** (every other point dropped,
+newest kept — counted by ``obs_history_downsamples_total``), so a
+long-running daemon keeps a coarser-but-complete past instead of
+forgetting it; points older than ``retention_s`` are pruned on append.
+New series past ``max_series`` are dropped and counted
+(``obs_history_dropped_series_total``) — never an unbounded dict.
+
+The optional JSONL spill (``spill_path`` /
+``TPUFLOW_OBS_HISTORY_SPILL``) appends one ``history_sample`` record
+per tick through :class:`~tpuflow.utils.logging.MetricsLogger`, so
+``python -m tpuflow.obs history`` (and ``fleet``/``timeline``, which
+merge any JSONL trail) can replay a daemon's history lanes offline —
+:meth:`ingest` is the replay side of the same format.
+
+Lock discipline (the PR 15 concurrency gate): every mutation of the
+series table happens under ``self._lock``; family collection, the
+spill write, and listener callbacks all run OUTSIDE it (collection
+takes each family's own lock; file I/O under a held lock is TPF017).
+The sampler loop waits on its stop event — never a bare ``time.sleep``
+(TPF022) — so shutdown is drillable and cadence injectable.
+
+Deliberately dependency-light (no jax): usable offline on a machine
+that only has the spill files.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+
+from tpuflow.utils.env import env_num
+
+HISTORY_DEFAULTS = {
+    "interval_s": 1.0,
+    "max_points": 512,
+    "max_series": 512,
+    "retention_s": 900.0,
+}
+
+
+def format_series(name: str, labels: dict | None = None) -> str:
+    """The spill/CLI series key: ``name`` or ``name{k=v,...}`` with
+    labels sorted — one stable spelling per series."""
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+def parse_series(text: str) -> tuple[str, dict]:
+    """Invert :func:`format_series`. Malformed label text raises
+    ValueError naming the series — a corrupt spill line must be
+    reported as such, not half-parsed into a phantom series."""
+    text = text.strip()
+    if "{" not in text:
+        return text, {}
+    if not text.endswith("}"):
+        raise ValueError(f"malformed series key {text!r}")
+    name, _, inner = text[:-1].partition("{")
+    labels = {}
+    for part in inner.split(","):
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"malformed series key {text!r}")
+        k, _, v = part.partition("=")
+        labels[k] = v
+    return name, labels
+
+
+class _Series:
+    __slots__ = ("name", "labels", "kind", "points")
+
+    def __init__(self, name: str, labels: dict, kind: str):
+        self.name = name
+        self.labels = dict(labels)
+        self.kind = kind
+        self.points: list[tuple[float, float]] = []
+
+
+class MetricsHistory:
+    """Sample a :class:`~tpuflow.obs.metrics.Registry` into bounded
+    per-series time rings and answer windowed queries over them.
+
+    ``registry=None`` is the offline-replay mode (``python -m
+    tpuflow.obs history``): :meth:`ingest` feeds spilled ticks back in
+    and every query works identically.
+    """
+
+    def __init__(
+        self,
+        registry=None,
+        *,
+        interval_s: float | None = None,
+        max_points: int | None = None,
+        max_series: int | None = None,
+        retention_s: float | None = None,
+        spill_path: str | None = None,
+        clock=time.monotonic,
+    ):
+        if interval_s is None:
+            interval_s = env_num(
+                "TPUFLOW_OBS_HISTORY_INTERVAL_S",
+                HISTORY_DEFAULTS["interval_s"], float, minimum=0.05,
+                form="a sampling cadence in seconds >= 0.05",
+            )
+        if max_points is None:
+            max_points = env_num(
+                "TPUFLOW_OBS_HISTORY_MAX_POINTS",
+                HISTORY_DEFAULTS["max_points"], int, minimum=8,
+                form="an integer per-series point bound >= 8",
+            )
+        if max_series is None:
+            max_series = env_num(
+                "TPUFLOW_OBS_HISTORY_MAX_SERIES",
+                HISTORY_DEFAULTS["max_series"], int, minimum=1,
+                form="an integer series bound >= 1",
+            )
+        if retention_s is None:
+            retention_s = env_num(
+                "TPUFLOW_OBS_HISTORY_RETENTION_S",
+                HISTORY_DEFAULTS["retention_s"], float, minimum=1.0,
+                form="a retention window in seconds >= 1",
+            )
+        self.registry = registry
+        self.interval_s = float(interval_s)
+        self.max_points = int(max_points)
+        self.max_series = int(max_series)
+        self.retention_s = float(retention_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._series: dict[tuple[str, tuple], _Series] = {}
+        self._last_t: float | None = None
+        self._listeners: list = []
+        self._pre_sample: list = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if spill_path is None:
+            spill_path = os.environ.get("TPUFLOW_OBS_HISTORY_SPILL") or None
+        self._spill = None
+        if spill_path:
+            from tpuflow.utils.logging import MetricsLogger
+
+            self._spill = MetricsLogger(spill_path)
+        self._samples_total = self._downsamples = self._dropped = None
+        if registry is not None:
+            self._samples_total = registry.counter(
+                "obs_history_samples_total",
+                "history sampler ticks recorded",
+            )
+            self._downsamples = registry.counter(
+                "obs_history_downsamples_total",
+                "series halvings forced by the per-series point bound "
+                "(the memory-bounding decimation)",
+            )
+            self._dropped = registry.counter(
+                "obs_history_dropped_series_total",
+                "new series refused by the series bound",
+            )
+            registry.gauge(
+                "obs_history_series",
+                "time series currently held by the metrics history",
+                fn=self._series_count,
+            )
+
+    # ---- wiring ----
+
+    def add_pre_sample(self, fn) -> None:
+        """Run ``fn()`` before each tick's collection — the seam that
+        refreshes pull-published gauges (the SLO engine's
+        ``evaluate_registry``) so their history is as fresh as the
+        counters'. Exceptions are swallowed: a broken hook must not
+        stop the sampler."""
+        self._pre_sample.append(fn)
+
+    def add_listener(self, fn) -> None:
+        """Call ``fn(now)`` after each tick (sample or ingest) — the
+        alert engine's evaluation hook. Exceptions are swallowed."""
+        self._listeners.append(fn)
+
+    def _series_count(self) -> int:
+        with self._lock:
+            return len(self._series)
+
+    # ---- sampling ----
+
+    def sample(self, now: float | None = None) -> int:
+        """One tick: collect every family's current samples and append
+        them. Returns the number of values recorded. Histogram
+        ``_bucket`` rows are skipped (high label cardinality, no
+        windowed-query value — the ``_sum``/``_count`` rows carry the
+        rate story)."""
+        now = self.clock() if now is None else float(now)
+        if self.registry is None:
+            return 0
+        for fn in self._pre_sample:
+            try:
+                fn()
+            except Exception:
+                pass
+        rows = []
+        for fam in self.registry.collect():
+            for suffix, labels, value in fam.collect():
+                if suffix == "_bucket":
+                    continue
+                kind = (
+                    "counter"
+                    if fam.kind == "counter" or suffix in ("_sum", "_count")
+                    else "gauge"
+                )
+                rows.append((fam.name + suffix, labels, kind, value))
+        recorded = self._append_rows(now, rows)
+        if self._samples_total is not None:
+            self._samples_total.inc()
+        self._spill_tick(now, rows)
+        self._notify(now)
+        return recorded
+
+    def maybe_sample(self, now: float | None = None) -> int:
+        """Scrape-driven sampling (the threaded daemon has no sampler
+        thread): tick only if at least ``interval_s`` has passed since
+        the last one."""
+        now = self.clock() if now is None else float(now)
+        with self._lock:
+            due = self._last_t is None or now - self._last_t >= self.interval_s
+        if not due:
+            return 0
+        return self.sample(now)
+
+    def ingest(self, t: float, samples: dict) -> int:
+        """Replay one spilled tick (``{series_key: value}``) — the
+        offline side of the spill format; fires listeners exactly like
+        a live tick so alert replay is faithful."""
+        rows = []
+        for key, value in samples.items():
+            name, labels = parse_series(str(key))
+            rows.append((name, labels, "gauge", value))
+        recorded = self._append_rows(float(t), rows)
+        self._notify(float(t))
+        return recorded
+
+    def _append_rows(self, now: float, rows) -> int:
+        cutoff = now - self.retention_s
+        recorded = 0
+        dropped = downsampled = 0
+        with self._lock:
+            self._last_t = now
+            for name, labels, kind, value in rows:
+                try:
+                    v = float(value)
+                except (TypeError, ValueError):
+                    continue
+                if not math.isfinite(v):
+                    continue
+                key = (name, tuple(sorted(labels.items())))
+                series = self._series.get(key)
+                if series is None:
+                    if len(self._series) >= self.max_series:
+                        dropped += 1
+                        continue
+                    series = _Series(name, labels, kind)
+                    self._series[key] = series
+                pts = series.points
+                pts.append((now, v))
+                while pts and pts[0][0] < cutoff:
+                    pts.pop(0)
+                if len(pts) > self.max_points:
+                    # Decimate in place: drop every other point,
+                    # keeping the newest — coarser past, bounded
+                    # memory, nothing forgotten outright.
+                    del pts[-2::-2]
+                    downsampled += 1
+                recorded += 1
+        if dropped and self._dropped is not None:
+            self._dropped.inc(dropped)
+        if downsampled and self._downsamples is not None:
+            self._downsamples.inc(downsampled)
+        return recorded
+
+    def _spill_tick(self, now: float, rows) -> None:
+        if self._spill is None:
+            return
+        try:
+            self._spill.write(
+                "history_sample", t=round(now, 6),
+                samples={
+                    format_series(name, labels): value
+                    for name, labels, _, value in rows
+                },
+            )
+        except Exception:
+            pass
+
+    def _notify(self, now: float) -> None:
+        for fn in self._listeners:
+            try:
+                fn(now)
+            except Exception:
+                pass
+
+    # ---- sampler thread ----
+
+    def start(self) -> "MetricsHistory":
+        """Start the background sampler (idempotent). The loop waits on
+        the stop event — injectable cadence in tests (call
+        :meth:`sample` directly), drillable shutdown in production."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="tpuflow-obs-history", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.sample()
+            except Exception:
+                pass
+            self._stop.wait(self.interval_s)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Stop and join the sampler; close the spill. Idempotent."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+        self._thread = None
+        if self._spill is not None:
+            try:
+                self._spill.close()
+            except Exception:
+                pass
+
+    # ---- queries ----
+
+    def _resolve(self, name: str) -> str:
+        with self._lock:
+            if any(k[0] == name for k in self._series):
+                return name
+        ns = getattr(self.registry, "namespace", None) or "tpuflow"
+        return f"{ns}_{name}"
+
+    def all_series(self) -> list[dict]:
+        """Every series with its points snapshotted — the replay/CLI
+        view (``python -m tpuflow.obs history``)."""
+        with self._lock:
+            rows = [
+                {
+                    "name": s.name, "labels": dict(s.labels),
+                    "kind": s.kind, "points": list(s.points),
+                }
+                for s in self._series.values()
+            ]
+        rows.sort(key=lambda r: (r["name"], sorted(r["labels"].items())))
+        return rows
+
+    def labelsets(self, name: str) -> list[dict]:
+        """Every labelset seen for ``name`` (accepts the registry-
+        namespaced or bare spelling, like ``Registry.peek``)."""
+        full = self._resolve(name)
+        with self._lock:
+            return [
+                dict(s.labels) for k, s in self._series.items()
+                if k[0] == full
+            ]
+
+    def points(
+        self, name: str, window_s: float | None = None,
+        now: float | None = None, **labels,
+    ) -> list[tuple[float, float]]:
+        """The raw ``(t, value)`` points of one series, newest last,
+        optionally restricted to the trailing window ending at ``now``
+        (default: the last tick — deterministic under a fake clock)."""
+        full = self._resolve(name)
+        key = (full, tuple(sorted(labels.items())))
+        with self._lock:
+            series = self._series.get(key)
+            pts = list(series.points) if series is not None else []
+            last_t = self._last_t
+        if window_s is None or not pts:
+            return pts
+        end = (
+            float(now) if now is not None
+            else (last_t if last_t is not None else pts[-1][0])
+        )
+        start = end - float(window_s)
+        return [(t, v) for t, v in pts if start <= t <= end]
+
+    def latest(self, name: str, **labels) -> float | None:
+        pts = self.points(name, None, **labels)
+        return pts[-1][1] if pts else None
+
+    def delta(
+        self, name: str, window_s: float, now: float | None = None, **labels
+    ) -> float | None:
+        """last - first over the window (a counter's raw growth)."""
+        pts = self.points(name, window_s, now, **labels)
+        if len(pts) < 2:
+            return None
+        return pts[-1][1] - pts[0][1]
+
+    def rate(
+        self, name: str, window_s: float, now: float | None = None, **labels
+    ) -> float | None:
+        """Per-second rate over the window: ``delta / elapsed`` between
+        the first and last points inside it. Needs two points; a
+        zero-elapsed window (same-tick points) returns None, never a
+        division blowup."""
+        pts = self.points(name, window_s, now, **labels)
+        if len(pts) < 2:
+            return None
+        dt = pts[-1][0] - pts[0][0]
+        if dt <= 0:
+            return None
+        return (pts[-1][1] - pts[0][1]) / dt
+
+    def mean(
+        self, name: str, window_s: float, now: float | None = None, **labels
+    ) -> float | None:
+        pts = self.points(name, window_s, now, **labels)
+        if not pts:
+            return None
+        return sum(v for _, v in pts) / len(pts)
+
+    def max(
+        self, name: str, window_s: float, now: float | None = None, **labels
+    ) -> float | None:
+        pts = self.points(name, window_s, now, **labels)
+        if not pts:
+            return None
+        return max(v for _, v in pts)
+
+    def quantile(
+        self, name: str, q: float, window_s: float,
+        now: float | None = None, **labels,
+    ) -> float | None:
+        """Linear-interpolated quantile of the window's values."""
+        pts = self.points(name, window_s, now, **labels)
+        if not pts:
+            return None
+        values = sorted(v for _, v in pts)
+        if len(values) == 1:
+            return values[0]
+        q = min(1.0, max(0.0, float(q)))
+        pos = q * (len(values) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(values) - 1)
+        frac = pos - lo
+        return values[lo] * (1.0 - frac) + values[hi] * frac
+
+    def summary(self) -> dict:
+        """The bounds and occupancy — the `history` slice of a JSON
+        metrics view or a debug dump."""
+        with self._lock:
+            n_series = len(self._series)
+            n_points = sum(len(s.points) for s in self._series.values())
+            last_t = self._last_t
+        return {
+            "series": n_series,
+            "points": n_points,
+            "max_series": self.max_series,
+            "max_points": self.max_points,
+            "interval_s": self.interval_s,
+            "retention_s": self.retention_s,
+            "last_sample_t": last_t,
+        }

@@ -16,8 +16,12 @@ seeded cross-subsystem fault storm:
    faults at declared phases — a worker death during averaging, a
    checkpoint flake during the retrain, a latency storm on the predict
    path;
-5. graceful dependency-aware shutdown (traffic → online → serving
-   drain → gang), then ONE SLO report card
+5. the SLO-driven autoscaler (``autoscale`` service,
+   ``tpuflow/serve_autoscale.py``) watches the daemon's burn-rate
+   history the whole time and climbs its control ladders when the
+   storm burns budget;
+6. graceful dependency-aware shutdown (traffic → online → autoscale →
+   serving drain → gang), then ONE SLO report card
    (``obs/slo_report_card.schema.json``) from the fleet's merged
    trails + the daemon's own registry: availability and its error
    budget, p99 latency, time-to-adapt, and the dropped-request count —
@@ -73,6 +77,15 @@ def mini_soak_spec(root: str) -> dict:
                 "retrain_epochs": 2, "margin": 1000.0,
                 "min_retrain_gap": 100, "rollback": False,
             },
+        },
+        "autoscale": {
+            # Tight cadence so the mini-soak's tens-of-seconds window
+            # yields real ticks; replica moves are capped off (the
+            # tier-1 host places one device) — the controller still
+            # reads burn, holds, and records every decision.
+            "interval_s": 0.2, "window_s": 5.0,
+            "warmup_ticks": 2, "hold_ticks": 2,
+            "max_replicas": 1, "max_moves": 4,
         },
         "chaos": {
             "seed": 5,
@@ -193,6 +206,7 @@ def run_soak(doc: dict) -> dict:
     serving_doc = dict(doc.get("serving") or {})
     traffic_doc = dict(doc.get("traffic") or {})
     online_doc = dict(doc.get("online") or {})
+    autoscale_doc = dict(doc.get("autoscale") or {})
 
     # --- the shared data + the initial serving artifact ---------------
     table = wells_to_table(generate_wells(n_wells=4, steps=200, seed=3))
@@ -343,8 +357,26 @@ def run_soak(doc: dict) -> dict:
         "traffic", _traffic_run, depends_on=("serving",), grace=30.0,
     )
 
+    def _autoscale_run(stop_event):
+        from tpuflow.serve_autoscale import ObservingController
+
+        server = box["server"]
+        controller = ObservingController(
+            server, server.history,
+            registry=server.registry,
+            block=autoscale_doc,
+            logger=server._trail,
+        )
+        # run() paces on the stop event and returns summary() — the
+        # service handle's result, folded into the report card source.
+        return controller.run(stop_event)
+
+    autoscale = thread_service(
+        "autoscale", _autoscale_run, depends_on=("serving",), grace=10.0,
+    )
+
     supervisor = RuntimeSupervisor(
-        [gang, serving, online, traffic],
+        [gang, serving, autoscale, online, traffic],
         trail_path=os.path.join(root, "runtime-metrics.jsonl"),
     )
     supervisor.start()
@@ -368,6 +400,7 @@ def run_soak(doc: dict) -> dict:
     gang_handle = supervisor.service_handle("gang")
     online_handle = supervisor.service_handle("online")
     traffic_handle = supervisor.service_handle("traffic")
+    autoscale_handle = supervisor.service_handle("autoscale")
     final = supervisor.shutdown()
 
     # --- the report card -----------------------------------------------
@@ -383,6 +416,7 @@ def run_soak(doc: dict) -> dict:
         "traffic": traffic_summary,
         "chaos": chaos_summary,
         "online": online_summary,
+        "autoscale": autoscale_handle.result if autoscale_handle else None,
         "gang": gang_result.summary() if gang_result is not None else None,
         "services": final["services"],
         "wall_s": round(time.monotonic() - wall0, 3),

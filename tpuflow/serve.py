@@ -1148,6 +1148,9 @@ class PredictService:
             from tpuflow.parallel.placement import replica_devices
 
             replica_devices(self.replicas)  # raises naming the count
+        self._replica_metrics_ready = False
+        self._replica_dispatches = None
+        self._replica_requests = None
         self._batcher = None
         if batch_predicts and batch_mode == "continuous":
             from tpuflow.microbatch import ContinuousBatcher
@@ -1173,31 +1176,7 @@ class PredictService:
                 registry=self.registry,
             )
             if self.replicas > 1:
-                # Per-replica observability: resident replica-lane
-                # count plus a dispatch counter labeled by replica
-                # index, fed by the batcher's lane-dispatch hook.
-                self.registry.gauge(
-                    "serve_replica_lanes",
-                    "replica dispatch lanes currently resident "
-                    "(artifact lanes with a replica index)",
-                    fn=self._replica_lane_count,
-                )
-                self._replica_dispatches = self.registry.counter(
-                    "serve_replica_dispatches_total",
-                    "device dispatches completed per replica lane, by "
-                    "replica index",
-                )
-                # Registered HERE (not first-touched by a metrics
-                # scrape or a ReplicaSet) so the family always carries
-                # its help text — the registry is first-registrant-
-                # wins, and an early /metrics scrape must not blank
-                # the HELP line for the life of the process.
-                self._replica_requests = self.registry.counter(
-                    "serve_replica_requests_total",
-                    "requests routed to a replica lane by join-"
-                    "shortest-queue, by replica index",
-                )
-                self._batcher.on_lane_dispatch = self._on_replica_dispatch
+                self._ensure_replica_metrics()
         elif batch_predicts:
             from tpuflow.microbatch import MicroBatcher
 
@@ -1285,18 +1264,108 @@ class PredictService:
         if len(key) == 3:
             self._replica_dispatches.inc(replica=str(key[2]))
 
+    def _ensure_replica_metrics(self) -> None:
+        """Register the per-replica metric families and hook the
+        batcher's lane-dispatch callback. Idempotent: called at
+        construction when replicas > 1, and again by
+        :meth:`set_replicas` when a runtime resize first crosses 1.
+        Registered HERE (not first-touched by a metrics scrape or a
+        ReplicaSet) so the families always carry their help text — the
+        registry is first-registrant-wins, and an early /metrics scrape
+        must not blank the HELP line for the life of the process."""
+        if self._replica_metrics_ready or self._batcher is None:
+            return
+        self.registry.gauge(
+            "serve_replica_lanes",
+            "replica dispatch lanes currently resident "
+            "(artifact lanes with a replica index)",
+            fn=self._replica_lane_count,
+        )
+        self._replica_dispatches = self.registry.counter(
+            "serve_replica_dispatches_total",
+            "device dispatches completed per replica lane, by "
+            "replica index",
+        )
+        self._replica_requests = self.registry.counter(
+            "serve_replica_requests_total",
+            "requests routed to a replica lane by join-"
+            "shortest-queue, by replica index",
+        )
+        self._batcher.on_lane_dispatch = self._on_replica_dispatch
+        self._replica_metrics_ready = True
+
+    def set_replicas(self, n: int) -> int:
+        """Runtime replica resize — the autoscaler's data-plane seam.
+        Validates like ``__init__`` (n >= 1; the continuous engine and
+        a placeable device count for n > 1, with the same diagnostics),
+        then walks the resident cache: ReplicaSets :meth:`resize` in
+        place (retired replica lanes drain synchronously before their
+        params are released), plain non-degraded predictors are wrapped
+        when the width crosses above 1 (their plain artifact lane
+        drains too — new picks go to replica lanes). Degraded fallbacks
+        stay unwrapped, as at load. Returns the new width."""
+        n = int(n)
+        if n < 1:
+            raise ValueError(
+                f"set_replicas(n={n}): need an integer replica "
+                "count >= 1"
+            )
+        if n > 1:
+            if self._batcher is None or self.batch_mode != "continuous":
+                raise ValueError(
+                    f"replicas={n} needs the continuous batching "
+                    "engine (replica dispatch lanes); construct the "
+                    "service with batch_predicts=True and "
+                    "batch_mode='continuous'"
+                )
+            from tpuflow.parallel.placement import replica_devices
+
+            replica_devices(n)  # raises naming the device count
+            self._ensure_replica_metrics()
+        with self._lock:
+            if n == self.replicas:
+                return n
+            self.replicas = n
+            entries = list(self._cache.items())
+        from tpuflow.serve_replica import ReplicaSet
+
+        retire: list[tuple] = []
+        for key, pred in entries:
+            if isinstance(pred, ReplicaSet):
+                retire.extend(pred.resize(n))
+            elif n > 1 and not getattr(pred, "degraded", False):
+                wrapped = self._wrap_replicas(key, pred)
+                with self._lock:
+                    # Swap only if the entry is still the predictor we
+                    # wrapped — a concurrent invalidate/reload wins.
+                    if self._cache.get(key) is pred:
+                        self._cache[key] = wrapped
+                    else:
+                        wrapped = None
+                if wrapped is not None:
+                    # The plain artifact lane stops receiving picks;
+                    # drain what it already queued.
+                    retire.append(key)
+        if self._batcher is not None:
+            for k in retire:
+                if hasattr(self._batcher, "retire_lane"):
+                    self._batcher.retire_lane(k)
+                elif hasattr(self._batcher, "close_lane"):
+                    self._batcher.close_lane(k)
+        return n
+
     def _wrap_replicas(self, key: tuple[str, str], loaded):
         """Wrap a successfully loaded predictor in a ReplicaSet when the
         service is configured for more than one replica. Degraded
         fallbacks are never wrapped — physics answers take the
         unbatched path and replicating them buys nothing."""
-        if self.replicas <= 1 or getattr(loaded, "degraded", False):
+        with self._lock:
+            width = self.replicas
+        if width <= 1 or getattr(loaded, "degraded", False):
             return loaded
         from tpuflow.serve_replica import ReplicaSet
 
-        return ReplicaSet(
-            loaded, key, self.replicas, registry=self.registry
-        )
+        return ReplicaSet(loaded, key, width, registry=self.registry)
 
     def select_lane(self, key: tuple, pred) -> tuple[tuple, object]:
         """The enqueue-time lane decision: a ReplicaSet picks its
@@ -1313,15 +1382,17 @@ class PredictService:
         residency, and the per-replica routing/dispatch/depth split
         (aggregated across artifacts — replica index i of every
         resident ReplicaSet shares a label)."""
+        with self._lock:
+            width = self.replicas
         out: dict = {
-            "configured": self.replicas,
+            "configured": width,
             "policy": "jsq",
             "lanes": self._replica_lane_count(),
             "requests_by_replica": {},
             "dispatches_by_replica": {},
             "queue_depth_rows": {},
         }
-        if self.replicas <= 1 or self._batcher is None:
+        if width <= 1 or self._batcher is None:
             return out
         if hasattr(self._batcher, "lane_stats"):
             for k, stats in self._batcher.lane_stats().items():
@@ -1703,6 +1774,24 @@ def make_server(
         trail.write(
             "serve_started", daemon="threaded", host=host, port=port,
         )
+    # History + alerts (tpuflow/obs/history.py, alerts.py). The
+    # threaded daemon runs NO sampler thread: each /metrics scrape
+    # drives maybe_sample(), so history advances at scrape cadence
+    # (bounded by TPUFLOW_OBS_HISTORY_INTERVAL_S) and an idle daemon
+    # spends nothing. The SLO pre-sample hook refreshes the slo_*
+    # gauges before every tick so burn-rate rules see current values.
+    from tpuflow.obs.alerts import AlertEngine, rules_from_objectives
+    from tpuflow.obs.history import MetricsHistory
+
+    history = MetricsHistory(registry)
+    history.add_pre_sample(lambda: slo.evaluate_registry(registry))
+    alerts = AlertEngine(
+        history,
+        rules_from_objectives(serve_objectives(slo_objectives)),
+        registry=registry,
+        logger=trail,
+    )
+    alerts.attach()
     predictor = PredictService(
         batch_predicts=batch_predicts,
         batch_mode=batch_mode,
@@ -1771,8 +1860,12 @@ def make_server(
                     )
 
                     # Refresh the SLO gauges first: the slo_* families
-                    # must reflect THIS scrape's counter state.
+                    # must reflect THIS scrape's counter state. The
+                    # history tick (rate-limited to its cadence) also
+                    # advances alert hold-down clocks, so the
+                    # obs_alerts_firing gauges below are current.
                     slo.evaluate_registry(registry)
+                    history.maybe_sample()
                     body = render_prometheus(
                         registry, default_registry()
                     ).encode()
@@ -1785,10 +1878,12 @@ def make_server(
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                history.maybe_sample()
                 self._send(200, {
                     "jobs": runner.metrics(),
                     "predict": predictor.metrics(),
                     "slo": slo.evaluate_registry(registry),
+                    "alerts": alerts.summary(),
                     "uptime_s": round(_time.monotonic() - started, 1),
                 })
             elif len(parts) == 3 and parts[1] == "jobs":
@@ -1927,6 +2022,8 @@ def make_server(
     server = Server((host, port), Handler)
     server.runner = runner  # for tests / callers
     server.predictor = predictor
+    server.history = history
+    server.alerts = alerts
     return server
 
 

@@ -286,6 +286,8 @@ class AsyncServer:
         service: PredictService | None = None,
         trail_path: str | None = None,
         slo_objectives=None,
+        autoscale: bool | None = None,
+        autoscale_block: dict | None = None,
     ):
         from tpuflow.obs import Registry
 
@@ -449,6 +451,45 @@ class AsyncServer:
         self.slo = SloEngine(
             serve_objectives(slo_objectives), registry=self.registry,
         )
+        # The metrics history plane + alert engine (tpuflow/obs/
+        # history.py, alerts.py): a sampler thread (started in _amain,
+        # stopped in shutdown) ticks the registry into bounded
+        # time-series rings; the SLO pre-sample hook refreshes the
+        # slo_* gauges before every tick so burn-rate rules — imported
+        # from the same committed objectives — score current values.
+        # Firing/resolved transitions land in forensics, the trail, and
+        # the obs_alerts_firing gauges of this daemon's exposition.
+        from tpuflow.obs.alerts import AlertEngine, rules_from_objectives
+        from tpuflow.obs.history import MetricsHistory
+
+        self.history = MetricsHistory(self.registry)
+        self.history.add_pre_sample(
+            lambda: self.slo.evaluate_registry(self.registry)
+        )
+        self.alerts = AlertEngine(
+            self.history,
+            rules_from_objectives(serve_objectives(slo_objectives)),
+            registry=self.registry,
+            logger=self._trail,
+        )
+        self.alerts.attach()
+        # The SLO-driven autoscaler (tpuflow/serve_autoscale.py):
+        # opt-in (flag/env), hill-climbs replicas/max_inflight/hedge/
+        # drift threshold against the history's burn-rate lanes through
+        # the set_* seams below. Runs on its own thread, started with
+        # the sampler in _amain.
+        if autoscale is None:
+            autoscale = env_flag("TPUFLOW_SERVE_AUTOSCALE", False)
+        self.autoscaler = None
+        if autoscale:
+            from tpuflow.serve_autoscale import ObservingController
+
+            self.autoscaler = ObservingController(
+                self, self.history,
+                registry=self.registry,
+                block=autoscale_block,
+                logger=self._trail,
+            )
         self.runner = None
         if enable_jobs:
             self.runner = JobRunner(
@@ -1103,6 +1144,38 @@ class AsyncServer:
             self._pool, parse
         )
 
+    # ---- autoscaler control seams ----
+    #
+    # Each setter is a single GIL-atomic store into state the request
+    # path reads per-request (the documented cross-thread tolerance of
+    # `drain` and the inflight gauge): no lock, no torn read, effective
+    # on the very next admission/dispatch. Single writer — the
+    # ObservingController's control thread.
+
+    def set_max_inflight(self, n: int) -> int:
+        """Resize the admission bound at runtime (floor 1)."""
+        n = max(1, int(n))
+        self.admission.max_inflight = n
+        return n
+
+    def set_hedge_ms(self, ms: float) -> float:
+        """Retune the hedged re-dispatch window (0 = off)."""
+        ms = max(0.0, float(ms))
+        self.hedge_ms = ms
+        return ms
+
+    def set_drift_threshold(self, z: float) -> float:
+        """Retune the drift-admission shed threshold (> 0)."""
+        z = max(1e-9, float(z))
+        self.drift_threshold = z
+        return z
+
+    def set_replicas(self, n: int) -> int:
+        """Resize the replica data plane (delegates to the service's
+        :meth:`~tpuflow.serve.PredictService.set_replicas`; raises the
+        same diagnostics on an unplaceable count)."""
+        return self.service.set_replicas(n)
+
     def metrics(self) -> dict:
         """The /metrics JSON view: the threaded daemon's schema plus the
         ``serving`` section (admission + shed + hedge counters). Keys
@@ -1134,8 +1207,13 @@ class AsyncServer:
             # against this daemon's own counters at scrape time — the
             # same verdicts the Prometheus view carries as slo_* gauges.
             "slo": self.slo.evaluate_registry(self.registry),
+            # Alert states as last evaluated by the history tick — a
+            # scrape reports, it never advances hold-down clocks.
+            "alerts": self.alerts.summary(),
             "uptime_s": round(time.monotonic() - self._started, 1),
         }
+        if self.autoscaler is not None:
+            out["autoscale"] = self.autoscaler.summary()
         return out
 
     # ---- lifecycle ----
@@ -1154,6 +1232,12 @@ class AsyncServer:
                 flush=True,
             )
         self._ready.set()
+        # Both entry points (start() and serve_forever()) pass through
+        # here, so the sampler and the autoscaler start exactly once,
+        # post-bind — never for a daemon that failed to boot.
+        self.history.start()
+        if self.autoscaler is not None:
+            self.autoscaler.start()
         async with self._aserver:
             await self._aserver.serve_forever()
 
@@ -1222,6 +1306,11 @@ class AsyncServer:
     def shutdown(self) -> None:
         """Stop accepting, cancel the serve task, close the batcher and
         executor. Idempotent; callable from any thread."""
+        # Control loops first: the autoscaler must not resize a daemon
+        # that is tearing down, and the sampler's spill closes cleanly.
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+        self.history.stop()
         loop = self._loop
         if loop is not None and not loop.is_closed():
 
@@ -1362,6 +1451,16 @@ def main(argv=None) -> int:
         "/artifacts/reload records) as JSONL here — this daemon's lane "
         "in `python -m tpuflow.obs fleet` (also TPUFLOW_SERVE_TRAIL)",
     )
+    p.add_argument(
+        "--autoscale", action=argparse.BooleanOptionalAction,
+        default=None,
+        help="run the SLO-driven autoscaler (tpuflow/serve_autoscale): "
+        "hill-climbs replicas / max-inflight / hedge / drift threshold "
+        "against the live slo_burn_rate history, with hysteresis and a "
+        "hard availability floor (default off; also "
+        "TPUFLOW_SERVE_AUTOSCALE=1; --no-autoscale overrides the env; "
+        "knobs via TPUFLOW_SERVE_AUTOSCALE_<KEY>)",
+    )
     args = p.parse_args(argv)
 
     if args.replicas is not None:
@@ -1399,6 +1498,7 @@ def main(argv=None) -> int:
             default_timeout=args.default_timeout,
             journal_path=args.journal,
             trail_path=args.trail,
+            autoscale=args.autoscale,
         )
     except ValueError as e:
         # Configuration-shaped failure (malformed env knob, replica

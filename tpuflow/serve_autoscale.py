@@ -1,0 +1,535 @@
+"""SLO-driven serving autoscaler: close the observe-decide-act loop.
+
+PR 3 gave the daemons an SLO engine (burn rate + error budget scored at
+scrape time); the history plane (``tpuflow/obs/history.py``) now keeps
+those scores over time. This module is the actuator that reads them
+back: an :class:`ObservingController` in the
+:class:`~tpuflow.train.autotune.OccupancyAutotuner` mold — a
+hill-climbing control loop with hysteresis, judged moves, and a freeze
+escape hatch — pointed at the serving control plane instead of the
+training step.
+
+The control surface is four runtime seams on
+:class:`~tpuflow.serve_async.AsyncServer` (each a single GIL-atomic
+store, effective on the next request):
+
+- ``set_replicas``        — the replica data plane width
+  (``serve_replica.ReplicaSet.resize`` under the hood; retired lanes
+  drain before their params release).
+- ``set_max_inflight``    — the admission bound.
+- ``set_hedge_ms``        — hedged re-dispatch (dropped under
+  pressure: hedging multiplies load exactly when load is the problem).
+- ``set_drift_threshold`` — drift-admission strictness (tightened
+  under pressure: far-out-of-distribution requests are shed earlier,
+  protecting the budget for in-distribution traffic).
+
+Decision policy (one move per tick, never a flap):
+
+- **Hot** — windowed-mean ``slo_burn_rate`` (worst objective) at or
+  past ``burn_high``, or error budget at/under ``budget_floor`` — for
+  ``hold_ticks`` consecutive ticks: climb the up ladder (replicas →
+  admission → drop hedge → tighten drift), first rung with headroom.
+- **Calm** — burn at/under ``burn_low`` with budget healthy — for
+  ``hold_ticks`` ticks and not frozen: climb down in reverse (relax
+  drift → restore hedge → lower admission → retire a replica).
+- A replica **down**-move is *judged*: it must survive
+  ``judge_ticks`` ticks without the system going hot. Going hot
+  mid-judgment **reverts** the move and freezes further down-moves for
+  ``freeze_s`` — at most one direction reversal per load regime.
+- **Hard availability floor**: ``min_replicas`` / ``min_inflight`` are
+  clamps on every move; a budget at/under ``budget_floor`` is treated
+  as hot (the controller adds capacity, never trims it).
+
+Every decision is an ``autoscale.step`` span (trail + forensics via
+``record_span``) and a ``serve_autoscale_steps_total{action=}``
+increment; :meth:`ObservingController.summary` is the ``autoscale``
+slice of the daemon's /metrics JSON. The loop waits on its stop event
+— never a bare ``time.sleep`` (TPF022) — so tests drive :meth:`step`
+with a fake clock and shutdown is drillable.
+
+Knobs resolve defaults <- ``TPUFLOW_SERVE_AUTOSCALE_<KEY>`` env <-
+explicit block (the autotune precedent); malformed env values raise
+naming the variable and the expected form (tpuflow/utils/env.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+# Every key has a TPUFLOW_SERVE_AUTOSCALE_<KEY> env spelling that
+# supplies the default when the block leaves it unset; an explicit
+# block value always wins (the TPUFLOW_AUTOTUNE_* precedent).
+AUTOSCALE_DEFAULTS: dict = {
+    "interval_s": 5.0,     # control-loop cadence (stop-event wait)
+    "window_s": 30.0,      # burn-rate window scored each tick
+    "warmup_ticks": 2,     # ticks observed before the first move
+    "hold_ticks": 2,       # consecutive hot/calm ticks a move needs
+    "judge_ticks": 2,      # ticks a replica down-move must survive
+    "burn_high": 1.0,      # sustained burn >= this reads as hot
+    "burn_low": 0.25,      # sustained burn <= this reads as calm
+    "budget_floor": 0.1,   # budget fraction <= this reads as hot
+    "freeze_s": 60.0,      # down-moves frozen after a revert
+    "min_replicas": 1,     # hard availability floor (never crossed)
+    "max_replicas": 8,
+    "min_inflight": 8,     # hard admission floor (never crossed)
+    "max_inflight": 1024,
+    "max_moves": 0,        # total moves before freezing (0 = unbounded)
+}
+
+_AUTOSCALE_INT_KEYS = {
+    # key -> minimum
+    "warmup_ticks": 0,
+    "hold_ticks": 1,
+    "judge_ticks": 1,
+    "min_replicas": 1,
+    "max_replicas": 1,
+    "min_inflight": 1,
+    "max_inflight": 1,
+    "max_moves": 0,
+}
+_AUTOSCALE_FLOAT_KEYS = {
+    # key -> (minimum, form)
+    "interval_s": (0.05, "a control cadence in seconds >= 0.05"),
+    "window_s": (1.0, "a scoring window in seconds >= 1"),
+    "burn_high": (1e-9, "a positive burn-rate threshold"),
+    "burn_low": (0.0, "a non-negative burn-rate threshold"),
+    "budget_floor": (0.0, "a budget fraction in [0, 1)"),
+    "freeze_s": (0.0, "a non-negative freeze window in seconds"),
+}
+
+
+def validate_autoscale_block(block) -> list[str]:
+    """Every problem with an ``autoscale`` config block, as messages
+    (empty = valid). Never raises — preflight passes report all
+    findings at once; :func:`resolve_autoscale` turns them into the
+    fail-loud raise for runtime callers."""
+    if not isinstance(block, dict):
+        return [
+            f"autoscale must be a dict config block (or {{}} for "
+            f"defaults), got {type(block).__name__}"
+        ]
+    out = []
+    unknown = sorted(set(block) - set(AUTOSCALE_DEFAULTS))
+    if unknown:
+        out.append(
+            f"unknown autoscale key(s) {unknown}; known: "
+            f"{sorted(AUTOSCALE_DEFAULTS)}"
+        )
+    for key, minimum in _AUTOSCALE_INT_KEYS.items():
+        if key not in block:
+            continue
+        value = block[key]
+        if isinstance(value, bool) or not isinstance(value, int):
+            out.append(
+                f"autoscale.{key} must be an integer >= {minimum}, "
+                f"got {value!r}"
+            )
+        elif value < minimum:
+            out.append(
+                f"autoscale.{key} must be >= {minimum}, got {value}"
+            )
+    for key, (minimum, form) in _AUTOSCALE_FLOAT_KEYS.items():
+        if key not in block:
+            continue
+        value = block[key]
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            out.append(f"autoscale.{key} must be {form}, got {value!r}")
+        elif float(value) < minimum:
+            out.append(f"autoscale.{key} must be {form}, got {value}")
+    floor = block.get("budget_floor")
+    if isinstance(floor, (int, float)) and not isinstance(floor, bool):
+        if not (0 <= float(floor) < 1):
+            out.append(
+                f"autoscale.budget_floor must be in [0, 1), got {floor}"
+            )
+
+    def _pair(lo_key, hi_key):
+        lo = block.get(lo_key, AUTOSCALE_DEFAULTS[lo_key])
+        hi = block.get(hi_key, AUTOSCALE_DEFAULTS[hi_key])
+        if (
+            isinstance(lo, (int, float)) and isinstance(hi, (int, float))
+            and not isinstance(lo, bool) and not isinstance(hi, bool)
+            and lo > hi
+        ):
+            out.append(
+                f"autoscale.{lo_key} {lo} exceeds autoscale.{hi_key} {hi}"
+            )
+
+    _pair("min_replicas", "max_replicas")
+    _pair("min_inflight", "max_inflight")
+    _pair("burn_low", "burn_high")
+    return out
+
+
+def _env_knobs() -> dict:
+    """The ``TPUFLOW_SERVE_AUTOSCALE_*`` env family, validated at read
+    time through tpuflow/utils/env.py (a malformed value raises naming
+    the variable and the expected form). Returns only the keys the
+    environment actually sets — block values win over these."""
+    from tpuflow.utils.env import env_num
+
+    out: dict = {}
+    for key, minimum in _AUTOSCALE_INT_KEYS.items():
+        var = f"TPUFLOW_SERVE_AUTOSCALE_{key.upper()}"
+        value = env_num(
+            var, None, int, minimum=minimum,
+            form=f"an integer >= {minimum}",
+        )
+        if value is not None:
+            out[key] = int(value)
+    for key, (minimum, form) in _AUTOSCALE_FLOAT_KEYS.items():
+        var = f"TPUFLOW_SERVE_AUTOSCALE_{key.upper()}"
+        value = env_num(var, None, float, minimum=minimum, form=form)
+        if value is not None:
+            if key == "budget_floor" and value >= 1:
+                raise ValueError(
+                    f"invalid TPUFLOW_SERVE_AUTOSCALE_BUDGET_FLOOR="
+                    f"{value!r}: expected a budget fraction in [0, 1)"
+                )
+            out[key] = float(value)
+    return out
+
+
+def resolve_autoscale(block: dict | None) -> dict:
+    """One resolved knob dict: defaults <- env knobs <- explicit block.
+    Raises ValueError naming every problem (the runtime spelling of
+    :func:`validate_autoscale_block`)."""
+    block = {} if block is None else block
+    problems = validate_autoscale_block(block)
+    if problems:
+        raise ValueError(
+            "invalid autoscale config: " + "; ".join(problems)
+        )
+    resolved = {**AUTOSCALE_DEFAULTS, **_env_knobs(), **block}
+    for lo_key, hi_key in (
+        ("min_replicas", "max_replicas"),
+        ("min_inflight", "max_inflight"),
+        ("burn_low", "burn_high"),
+    ):
+        if resolved[lo_key] > resolved[hi_key]:
+            raise ValueError(
+                f"invalid autoscale config: {lo_key} "
+                f"{resolved[lo_key]} exceeds {hi_key} "
+                f"{resolved[hi_key]}"
+            )
+    return resolved
+
+
+class ObservingController:
+    """The SLO-driven hill climber over a server's control seams.
+
+    ``server`` needs the four ``set_*`` seams plus ``service.replicas``
+    / ``admission.max_inflight`` / ``hedge_ms`` / ``drift_threshold``
+    reads (:class:`~tpuflow.serve_async.AsyncServer`, or any adapter —
+    the benchmark drives a simulated one). ``history`` is the
+    :class:`~tpuflow.obs.history.MetricsHistory` whose ``slo_burn_rate``
+    / ``slo_error_budget_remaining`` lanes the decisions read.
+    """
+
+    SCHEMA_ID = "tpuflow.serve_autoscale/v1"
+
+    def __init__(
+        self, server, history, *, registry=None, block=None,
+        logger=None, clock=time.monotonic, max_trail=256,
+    ):
+        self.server = server
+        self.history = history
+        self.cfg = resolve_autoscale(block)
+        self.clock = clock
+        self.logger = logger
+        self._lock = threading.Lock()
+        self._steps = None
+        if registry is not None:
+            self._steps = registry.counter(
+                "serve_autoscale_steps_total",
+                "autoscaler control-loop decisions, by action "
+                "(hold/warmup/no_signal and every ladder move)",
+            )
+        # Baselines: the down ladder relaxes each knob back toward what
+        # the operator configured, never past it.
+        self._hedge0 = float(getattr(server, "hedge_ms", 0.0))
+        self._drift0 = float(getattr(server, "drift_threshold", 6.0))
+        self._inflight0 = int(
+            getattr(getattr(server, "admission", None), "max_inflight", 0)
+            or self.cfg["min_inflight"]
+        )
+        self._ticks = 0
+        self._hot_ticks = 0
+        self._calm_ticks = 0
+        self._moves = 0
+        self._reversals = 0
+        self._pending = None  # judged replica down-move awaiting verdict
+        self._frozen_until = 0.0
+        self.trail: list[dict] = []
+        self._max_trail = int(max_trail)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ---- current control state (reads the server's documented
+    # cross-thread-tolerant attributes; no lock needed) ----
+
+    def _replicas(self) -> int:
+        return int(getattr(self.server.service, "replicas", 1))
+
+    def _max_inflight(self) -> int:
+        return int(self.server.admission.max_inflight)
+
+    # ---- signals ----
+
+    def _signals(self, now: float):
+        """(worst windowed-mean burn, worst budget remaining, p99 ms)
+        across every SLO objective's history lane; None where the lane
+        has no points yet (warmup, or SLO gauges not publishing)."""
+        h = self.history
+        w = self.cfg["window_s"]
+        burn = budget = None
+        for labels in h.labelsets("slo_burn_rate"):
+            m = h.mean("slo_burn_rate", w, now, **labels)
+            if m is not None:
+                burn = m if burn is None else max(burn, m)
+        for labels in h.labelsets("slo_error_budget_remaining"):
+            v = h.latest("slo_error_budget_remaining", **labels)
+            if v is not None:
+                budget = v if budget is None else min(budget, v)
+        p99 = h.latest("predict_latency_ms", quantile="0.99")
+        return burn, budget, p99
+
+    # ---- the ladders (one rung per call; "" = no headroom) ----
+
+    def _scale_up(self) -> tuple[str, dict]:
+        cfg = self.cfg
+        if self._replicas() < cfg["max_replicas"]:
+            return "scale_up_replicas", {"replicas": self._replicas() + 1}
+        cur = self._max_inflight()
+        if cur < cfg["max_inflight"]:
+            return "raise_inflight", {
+                "max_inflight": min(cfg["max_inflight"], cur * 2),
+            }
+        if float(self.server.hedge_ms) > 0:
+            # Hedging duplicates dispatches — exactly the wrong
+            # multiplier while the SLO is burning.
+            return "drop_hedge", {"hedge_ms": 0.0}
+        if float(self.server.drift_threshold) > 1.0:
+            return "tighten_drift", {
+                "drift_threshold": max(
+                    1.0, float(self.server.drift_threshold) / 2.0
+                ),
+            }
+        return "saturated", {}
+
+    def _scale_down(self) -> tuple[str, dict]:
+        cfg = self.cfg
+        if float(self.server.drift_threshold) < self._drift0:
+            return "relax_drift", {
+                "drift_threshold": min(
+                    self._drift0, float(self.server.drift_threshold) * 2.0
+                ),
+            }
+        if float(self.server.hedge_ms) < self._hedge0:
+            return "restore_hedge", {"hedge_ms": self._hedge0}
+        cur = self._max_inflight()
+        lo = max(cfg["min_inflight"], self._inflight0)
+        if cur > lo:
+            return "lower_inflight", {"max_inflight": max(lo, cur // 2)}
+        if self._replicas() > cfg["min_replicas"]:
+            return "scale_down_replicas", {
+                "replicas": self._replicas() - 1,
+            }
+        return "floor", {}
+
+    def _apply(self, changes: dict) -> str | None:
+        """Push one move's knob changes through the server seams.
+        Returns an error string (and clamps the ceiling so the rung is
+        not retried forever) when the data plane refuses — a replica
+        count the devices cannot place is a ceiling, not a crash."""
+        try:
+            if "replicas" in changes:
+                self.server.set_replicas(int(changes["replicas"]))
+            if "max_inflight" in changes:
+                self.server.set_max_inflight(int(changes["max_inflight"]))
+            if "hedge_ms" in changes:
+                self.server.set_hedge_ms(float(changes["hedge_ms"]))
+            if "drift_threshold" in changes:
+                self.server.set_drift_threshold(
+                    float(changes["drift_threshold"])
+                )
+        except ValueError as e:
+            if "replicas" in changes:
+                self.cfg["max_replicas"] = self._replicas()
+            return str(e)
+        return None
+
+    # ---- the control step ----
+
+    def step(self, now: float | None = None) -> dict:
+        """One decision. Tests and the benchmark call this directly
+        with a fake clock; :meth:`run` calls it on the cadence."""
+        now = self.clock() if now is None else float(now)
+        t0 = time.perf_counter()
+        with self._lock:
+            row = self._step_locked(now)
+        self._record(row, time.perf_counter() - t0)
+        return row
+
+    def _step_locked(self, now: float) -> dict:
+        cfg = self.cfg
+        self._ticks += 1
+        burn, budget, p99 = self._signals(now)
+        hot = burn is not None and (
+            burn >= cfg["burn_high"]
+            or (budget is not None and budget <= cfg["budget_floor"])
+        )
+        calm = (
+            burn is not None
+            and burn <= cfg["burn_low"]
+            and (budget is None or budget > cfg["budget_floor"])
+        )
+        action, detail = "hold", {}
+        if self._pending is not None:
+            # A judged down-move is on trial: going hot reverts it and
+            # freezes the down ladder; surviving the window adopts it.
+            if hot:
+                err = self._apply(self._pending["undo"])
+                self._frozen_until = now + cfg["freeze_s"]
+                self._reversals += 1
+                action = "revert"
+                detail = {
+                    "undone": self._pending["action"],
+                    "frozen_until": round(self._frozen_until, 3),
+                }
+                if err:
+                    detail["error"] = err
+                self._pending = None
+            else:
+                self._pending["judge_left"] -= 1
+                if self._pending["judge_left"] <= 0:
+                    action = "adopt"
+                    detail = {"adopted": self._pending["action"]}
+                    self._pending = None
+                else:
+                    action = "judging"
+                    detail = {"judge_left": self._pending["judge_left"]}
+        elif self._ticks <= cfg["warmup_ticks"]:
+            action = "warmup"
+        elif burn is None:
+            action = "no_signal"
+        elif hot:
+            self._hot_ticks += 1
+            self._calm_ticks = 0
+            if self._hot_ticks >= cfg["hold_ticks"]:
+                action, detail = self._bounded_move(self._scale_up())
+                if action not in ("saturated", "frozen"):
+                    self._hot_ticks = 0
+        elif calm:
+            self._calm_ticks += 1
+            self._hot_ticks = 0
+            if (
+                self._calm_ticks >= cfg["hold_ticks"]
+                and now >= self._frozen_until
+            ):
+                action, detail = self._bounded_move(self._scale_down())
+                if action == "scale_down_replicas":
+                    self._pending = {
+                        "action": action,
+                        "undo": {"replicas": self._replicas() + 1},
+                        "judge_left": cfg["judge_ticks"],
+                    }
+                if action not in ("floor", "frozen"):
+                    self._calm_ticks = 0
+        else:
+            self._hot_ticks = 0
+            self._calm_ticks = 0
+        return {
+            "t": round(now, 6),
+            "action": action,
+            "burn": burn,
+            "budget": budget,
+            "p99_ms": p99,
+            "replicas": self._replicas(),
+            "max_inflight": self._max_inflight(),
+            "hedge_ms": float(self.server.hedge_ms),
+            "drift_threshold": float(self.server.drift_threshold),
+            **detail,
+        }
+
+    def _bounded_move(self, move: tuple[str, dict]) -> tuple[str, dict]:
+        """Apply one ladder rung, honoring the total-move budget."""
+        action, changes = move
+        if not changes:
+            return action, {}
+        if 0 < self.cfg["max_moves"] <= self._moves:
+            return "frozen", {"reason": "max_moves"}
+        err = self._apply(changes)
+        if err is not None:
+            return "blocked", {"attempted": action, "error": err}
+        self._moves += 1
+        return action, dict(changes)
+
+    def _record(self, row: dict, duration_s: float) -> None:
+        self.trail.append(row)
+        if len(self.trail) > self._max_trail:
+            del self.trail[: len(self.trail) - self._max_trail]
+        if self._steps is not None:
+            self._steps.inc(action=row["action"])
+        from tpuflow.obs.tracing import record_span
+
+        record_span(
+            "autoscale.step", duration_s, logger=self.logger,
+            **{k: v for k, v in row.items() if k != "t"},
+        )
+
+    # ---- lifecycle ----
+
+    def run(self, stop_event: threading.Event) -> dict:
+        """The control loop body — also the ``runtime/`` service shape
+        (``thread_service(..., run=controller.run)``). Waits on the
+        stop event (TPF022); a broken step never kills the loop."""
+        while not stop_event.is_set():
+            try:
+                self.step()
+            except Exception:
+                pass
+            stop_event.wait(self.cfg["interval_s"])
+        return self.summary()
+
+    def start(self) -> "ObservingController":
+        """Start the control thread (idempotent)."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self.run, args=(self._stop,),
+            name="tpuflow-serve-autoscale", daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Stop and join the control thread. Idempotent."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+        self._thread = None
+
+    def summary(self) -> dict:
+        """The ``autoscale`` slice of the daemon's /metrics JSON."""
+        with self._lock:
+            return {
+                "schema": self.SCHEMA_ID,
+                "ticks": self._ticks,
+                "moves": self._moves,
+                "reversals": self._reversals,
+                "pending_judgment": self._pending is not None,
+                "frozen_until": round(self._frozen_until, 3),
+                "replicas": self._replicas(),
+                "max_inflight": self._max_inflight(),
+                "hedge_ms": float(self.server.hedge_ms),
+                "drift_threshold": float(self.server.drift_threshold),
+                "floors": {
+                    "min_replicas": self.cfg["min_replicas"],
+                    "min_inflight": self.cfg["min_inflight"],
+                },
+                "recent": [dict(r) for r in self.trail[-10:]],
+            }

@@ -89,6 +89,7 @@ class ReplicaSet:
         clone = clone_to_device if clone is None else clone
         self.replicas = [clone(base, d) for d in devices]
         self.devices = devices
+        self._clone = clone  # kept so resize() can place new replicas
         self._rr = 0  # tie-rotation cursor, so an idle set spreads
         self._requests = self._depth = None
         if registry is not None:
@@ -111,14 +112,50 @@ class ReplicaSet:
         artifact key is the shared prefix ``close_lanes_for`` drains)."""
         return [self.key + (i,) for i in range(len(self.replicas))]
 
+    def resize(self, n: int) -> list[tuple]:
+        """Grow or shrink to ``n`` replicas in place — the autoscaler's
+        data-plane seam. Single-writer (the controller); readers see the
+        list swap atomically (one reference store), so a concurrent
+        ``pick_lane`` works against either the old or the new set, never
+        a torn one. Growing validates placement and clones the tail;
+        shrinking drops the highest indices (their committed params are
+        released with the reference) and returns the retired lane keys
+        the caller must drain (``retire_lane``) — the lanes keep
+        draining queued work, they just stop receiving new picks."""
+        from tpuflow.parallel.placement import replica_devices
+
+        n = int(n)
+        current = self.replicas
+        old = len(current)
+        if n == old:
+            return []
+        if n > old:
+            devices = replica_devices(n, devices=None)
+            grown = list(current)
+            grown.extend(
+                self._clone(self.base, devices[i]) for i in range(old, n)
+            )
+            self.replicas = grown
+            self.devices = devices
+            return []
+        if n < 1:
+            raise ValueError(f"resize(n={n}): need at least one replica")
+        self.replicas = current[:n]
+        self.devices = self.devices[:n]
+        return [self.key + (i,) for i in range(n, old)]
+
     def pick_lane(self, batcher) -> tuple[tuple, object]:
         """Join-shortest-queue: (lane_key, replica) of the lane with the
         fewest outstanding rows; ties rotate round-robin. All R depths
         come from ONE ``lane_stats`` snapshot (a single acquisition of
         the batcher's lock, which the lane threads contend on — this
         runs on every request's hot path); an absent/idle lane reads as
-        depth 0. Publishes what it saw."""
-        n = len(self.replicas)
+        depth 0. Publishes what it saw. ONE snapshot of the replica
+        list up front: a concurrent :meth:`resize` swaps the list
+        reference, and indexing a different list than we counted could
+        pick a retired replica."""
+        replicas = self.replicas
+        n = len(replicas)
         if hasattr(batcher, "lane_stats"):
             stats = batcher.lane_stats(self.key)
             depths = []
@@ -141,7 +178,7 @@ class ReplicaSet:
             self._requests.inc(replica=str(best))
             for i, d in enumerate(depths):
                 self._depth.set(d, replica=str(i))
-        return self.key + (best,), self.replicas[best]
+        return self.key + (best,), replicas[best]
 
     # ---- Predictor surface the request pipeline touches ----
 
