@@ -48,6 +48,15 @@ BENCH_SERVE_LOAD_FRACTIONS (offered-load ladder as fractions of the
 probed capacity, default "0.5,0.75,0.9,1.1,1.35"), BENCH_SERVE_RATES
 (absolute req/s list; overrides the fraction ladder).
 
+Since ISSUE 20 every closed-loop run (``--quick`` included) also runs
+the **profiler-overhead A/B**: the same batched closed-loop drive with
+the sampling profiler (``tpuflow/obs/profiler.py``) off vs on at its
+default cadence, interleaved lap-by-lap, medians committed — the
+"always-on profiling costs <2%" claim as a measured record rather than
+an assertion. Knobs: BENCH_SERVE_PROFILER_LAPS (default 5),
+BENCH_SERVE_PROFILER_SECONDS (default 2), BENCH_SERVE_PROFILER_CLIENTS
+(default 8).
+
 Flags: ``--quick`` (small closed-loop only — the regression-gate
 shape), ``--open-loop`` (open-loop sweep only), ``--closed-loop``
 (closed-loop only); default runs both and commits the merged JSON.
@@ -859,6 +868,75 @@ def _measure_mode(
         srv.predictor.close()
 
 
+def _run_profiler_overhead(storage: str, body: bytes) -> dict:
+    """Interleaved A/B for the always-on sampling profiler: the same
+    batched closed-loop drive with the profiler off vs on at its
+    default cadence, alternating arm-by-arm so box drift lands on both
+    arms equally (the PR 8 interleaving lesson), medians over the laps.
+    The profiler's own self-metrics ride along: ``overhead_s`` is the
+    wall-clock the sampler itself spent walking frames — the precise
+    accounting behind the noisy end-to-end delta."""
+    from tpuflow.obs.metrics import Registry
+    from tpuflow.obs.profiler import SamplingProfiler
+
+    clients = int(os.environ.get("BENCH_SERVE_PROFILER_CLIENTS", 8))
+    seconds = float(os.environ.get("BENCH_SERVE_PROFILER_SECONDS", 2))
+    laps = int(os.environ.get("BENCH_SERVE_PROFILER_LAPS", 5))
+    arms: dict[str, list[float]] = {"profiler_off": [], "profiler_on": []}
+    self_metrics = None
+    for lap in range(laps):
+        for arm in ("profiler_off", "profiler_on"):
+            print(
+                f"[bench_serving] {arm} @ {clients} clients "
+                f"(lap {lap + 1}/{laps})...",
+                file=sys.stderr,
+            )
+            prof = None
+            if arm == "profiler_on":
+                prof = SamplingProfiler(registry=Registry())
+                prof.start()
+            try:
+                res = _measure_mode(storage, body, True, clients, seconds)
+            finally:
+                if prof is not None:
+                    prof.stop()
+                    snap = prof.snapshot()
+                    self_metrics = {
+                        "interval_s": prof.interval_s,
+                        "ticks": snap["ticks"],
+                        "thread_samples": snap["thread_samples"],
+                        "sampler_overhead_s": snap["overhead_s"],
+                    }
+            arms[arm].append(res["requests_per_sec"])
+    off = float(np.median(arms["profiler_off"]))
+    on = float(np.median(arms["profiler_on"]))
+    overhead_pct = round((off - on) / max(off, 1e-9) * 100.0, 2)
+    out = {
+        "clients": clients,
+        "seconds_per_lap": seconds,
+        "laps": laps,
+        "rps_profiler_off": round(off, 1),
+        "rps_profiler_on": round(on, 1),
+        "overhead_pct": overhead_pct,
+        "off_laps_rps": arms["profiler_off"],
+        "on_laps_rps": arms["profiler_on"],
+        "last_on_lap_profiler": self_metrics,
+    }
+    emit(
+        "serve_profiler_overhead",
+        "profiler_overhead_pct",
+        overhead_pct,
+        "%",
+        rps_profiler_off=out["rps_profiler_off"],
+        rps_profiler_on=out["rps_profiler_on"],
+        laps=laps,
+        sampler_overhead_s=(
+            self_metrics["sampler_overhead_s"] if self_metrics else None
+        ),
+    )
+    return out
+
+
 def main() -> None:
     # --quick: one small client count, short window, closed loop only —
     # the regression gate shape (same knobs run_all.py --quick sets via
@@ -964,6 +1042,10 @@ def main() -> None:
                 clients=clients,
             )
             results["by_clients"][str(clients)] = per
+        if run_closed:
+            results["profiler_overhead"] = _run_profiler_overhead(
+                storage, body
+            )
         if run_open:
             results["open_loop"] = _run_open_loop(storage, body)
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -977,6 +1059,8 @@ def main() -> None:
             results["open_loop"] = prior["open_loop"]
         if not run_closed and prior.get("by_clients"):
             results["by_clients"] = prior["by_clients"]
+        if not run_closed and "profiler_overhead" in prior:
+            results["profiler_overhead"] = prior["profiler_overhead"]
     with open(out, "w", encoding="utf-8") as f:
         json.dump(results, f, indent=2)
     print(f"[bench_serving] wrote {out}", file=sys.stderr)

@@ -997,6 +997,61 @@ class TestLinter:
                     time.sleep(interval_s)  # noqa: TPF022
         """) == []
 
+    def test_nameless_thread_flagged(self, tmp_path):
+        """TPF023: an anonymous Thread gets a Thread-N name, so the
+        sampling profiler attributes its wall-clock to 'other' and the
+        flight recorder's stack dumps lose their subsystem label."""
+        diags = self._lint_source(tmp_path, """
+            import threading
+
+            def spawn(worker):
+                t = threading.Thread(target=worker, daemon=True)
+                t.start()
+                return t
+        """)
+        assert _codes(diags) == ["TPF023"]
+        (d,) = diags
+        assert "name=" in d.message
+
+    def test_nameless_thread_bare_import_flagged(self, tmp_path):
+        diags = self._lint_source(tmp_path, """
+            from threading import Thread
+
+            def spawn(worker):
+                Thread(target=worker).start()
+        """)
+        assert _codes(diags) == ["TPF023"]
+
+    def test_named_thread_passes(self, tmp_path):
+        assert self._lint_source(tmp_path, """
+            import threading
+
+            def spawn(worker):
+                t = threading.Thread(
+                    target=worker, name="tpuflow-data-prefetch", daemon=True
+                )
+                t.start()
+                return t
+        """) == []
+
+    def test_thread_kwargs_splat_not_judged(self, tmp_path):
+        # A **kwargs splat may carry name= — the linter can't see inside
+        # it, and guessing would flag every wrapper helper.
+        assert self._lint_source(tmp_path, """
+            import threading
+
+            def spawn(worker, **kw):
+                return threading.Thread(target=worker, **kw)
+        """) == []
+
+    def test_nameless_thread_noqa_suppressed(self, tmp_path):
+        assert self._lint_source(tmp_path, """
+            import threading
+
+            def spawn(worker):
+                return threading.Thread(target=worker)  # noqa: TPF023
+        """) == []
+
     def test_self_lint_gate_package_is_clean(self):
         """The gate: the whole tpuflow package obeys its own lint rules.
         New framework code that host-syncs inside jit, uses untraced

@@ -390,6 +390,118 @@ def test_obs_alerts_subprocess(tmp_path):
     assert "Traceback" not in no_rules.stderr
 
 
+def test_obs_profile_subprocess(tmp_path):
+    """python -m tpuflow.obs profile: render a snapshot, and --diff the
+    two COMMITTED snapshots (benchmarks/profiles/) — the acceptance
+    demo: the storm capture regresses the batcher component, verdict is
+    deterministic, exit 1 flags it for CI."""
+    import json
+
+    steady = os.path.join(REPO, "benchmarks", "profiles", "steady.json")
+    storm = os.path.join(REPO, "benchmarks", "profiles", "storm.json")
+    render = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "profile", steady, "--top", "5"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert render.returncode == 0, render.stderr[-2000:]
+    assert "component" in render.stdout and "busy-share" in render.stdout
+
+    diff = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "profile", "--diff",
+         steady, storm, "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert diff.returncode == 1, diff.stderr[-2000:]  # regression == exit 1
+    verdict = json.loads(diff.stdout)
+    assert verdict["verdict"] == "regression"
+    assert verdict["regressions"] == ["batcher"]
+    assert verdict["base_top"] == "serving"
+    assert verdict["new_top"] == "batcher"
+    # Deterministic: the same committed inputs give the same verdict.
+    again = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "profile", "--diff",
+         steady, storm, "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert again.stdout == diff.stdout
+
+    same = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "profile", "--diff",
+         steady, steady],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert same.returncode == 0
+    assert "verdict=ok" in same.stdout
+
+    one_file = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "profile", "--diff", steady],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert one_file.returncode == 2
+    assert "BASE NEW" in one_file.stderr
+    assert "Traceback" not in one_file.stderr
+
+    missing = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "profile",
+         str(tmp_path / "nope.json")],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert missing.returncode == 2
+    assert "Traceback" not in missing.stderr
+
+
+def test_obs_flight_subprocess(tmp_path):
+    """python -m tpuflow.obs flight: list and inspect a real captured
+    bundle in a subprocess; empty dirs exit 1, missing bundles exit 2,
+    never a traceback."""
+    import json
+
+    from tpuflow.obs.flight import FlightRecorder
+    from tpuflow.obs.profiler import SamplingProfiler
+
+    root = tmp_path / "flight"
+    profiler = SamplingProfiler(0.01)
+    profiler.sample()
+    rec = FlightRecorder(str(root), profiler=profiler)
+    name = rec.capture("manual", reason="cli smoke", force=True)
+    assert name
+
+    listed = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "flight", str(root)],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert listed.returncode == 0, listed.stderr[-2000:]
+    assert name in listed.stdout and "[ok]" in listed.stdout
+
+    inspect = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "flight", str(root),
+         "--inspect", name, "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert inspect.returncode == 0, inspect.stderr[-2000:]
+    doc = json.loads(inspect.stdout)
+    assert doc["problems"] == []
+    assert doc["doc"]["schema"] == "tpuflow.obs.flight/v1"
+    assert doc["doc"]["trigger"] == "manual"
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    none = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "flight", str(empty)],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert none.returncode == 1
+    assert "no flight bundles" in none.stderr
+
+    missing = subprocess.run(
+        [sys.executable, "-m", "tpuflow.obs", "flight", str(root),
+         "--inspect", "bundle-that-is-not-there.json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert missing.returncode == 2
+    assert "Traceback" not in missing.stderr
+
+
 def test_analysis_module_entry_rejects_broken_spec(tmp_path):
     """python -m tpuflow.analysis: the CI entry point exits non-zero on a
     broken spec and prints the preflight diagnostic."""

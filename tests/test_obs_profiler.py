@@ -1,0 +1,453 @@
+"""Profiling plane + flight recorder (tpuflow/obs/profiler.py, flight.py).
+
+Covers: thread-name component attribution and the busy/idle leaf-frame
+split, include= scoping, the bounded-stack overflow path, snapshot
+merge/diff regression verdicts, JSONL spill + load, the alert-triggered
+and supervisor-crash capture paths, rate limiting, retention, bundle
+schema validation, and the TPUFLOW_OBS_PROFILE_* / TPUFLOW_OBS_FLIGHT_*
+knob validation (malformed values must fail loud, naming the variable).
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tpuflow.obs.alerts import AlertEngine
+from tpuflow.obs.flight import (
+    FlightRecorder,
+    flight_from_env,
+    list_bundles,
+    load_bundle,
+    validate_bundle,
+)
+from tpuflow.obs.history import MetricsHistory
+from tpuflow.obs.metrics import Registry
+from tpuflow.obs.profiler import (
+    SamplingProfiler,
+    component_for,
+    diff_snapshots,
+    load_snapshot,
+    merge_snapshots,
+    profiler_from_env,
+    render_folded,
+    render_profile,
+    top_component,
+    validate_snapshot,
+)
+
+
+class _Workload:
+    """One CPU-burning thread + one Event-parked thread, with tpuflow
+    lane/prep names so samples attribute to batcher/serving."""
+
+    def __init__(self, busy_name="tpuflow-lane-t", idle_name="tpuflow-prep-t"):
+        self.stop = threading.Event()
+
+        def burn():
+            x = 0
+            while not self.stop.is_set():
+                x += sum(range(128))
+
+        self.busy = threading.Thread(target=burn, name=busy_name, daemon=True)
+        self.idle = threading.Thread(
+            target=self.stop.wait, name=idle_name, daemon=True
+        )
+        self.busy.start()
+        self.idle.start()
+
+    def close(self):
+        self.stop.set()
+        self.busy.join(timeout=5)
+        self.idle.join(timeout=5)
+
+
+@pytest.fixture
+def workload():
+    w = _Workload()
+    yield w
+    w.close()
+
+
+def _sample_n(profiler, n=25):
+    for _ in range(n):
+        profiler.sample()
+        time.sleep(0.002)
+
+
+class TestSamplingProfiler:
+    def test_component_attribution_table(self):
+        assert component_for("tpuflow-lane-8/f32") == "batcher"
+        assert component_for("tpuflow-microbatch") == "batcher"
+        assert component_for("tpuflow-prep_0") == "serving"
+        assert component_for("tpuflow-serve-async") == "serving"
+        assert component_for("tpuflow-serve-autoscale") == "autoscaler"
+        assert component_for("tpuflow-runtime-probe") == "supervisor"
+        assert component_for("tpuflow-runtime-online") == "online"
+        assert component_for("tpuflow-elastic-w3") == "gang"
+        assert component_for("tpuflow-jobs") == "jobs"
+        assert component_for("MainThread") == "main"
+        assert component_for("Thread-7") == "other"
+
+    def test_busy_idle_split_and_top_component(self, workload):
+        p = SamplingProfiler(0.01, include=("tpuflow-lane", "tpuflow-prep"))
+        _sample_n(p)
+        snap = p.snapshot()
+        assert validate_snapshot(snap) == []
+        comps = snap["components"]
+        # The burner is busy wall-clock; the Event-parked thread's leaf
+        # frame is threading.wait — sampled, but idle.
+        assert comps["batcher"]["busy"] > 0
+        assert comps["serving"]["samples"] > 0
+        assert comps["serving"]["busy"] == 0
+        assert top_component(snap) == "batcher"
+        assert comps["batcher"]["share"] == 1.0
+
+    def test_include_scopes_threads(self, workload):
+        p = SamplingProfiler(0.01, include=("tpuflow-prep",))
+        p.sample()
+        snap = p.snapshot()
+        assert set(snap["components"]) == {"serving"}
+
+    def test_self_metrics(self, workload):
+        reg = Registry()
+        p = SamplingProfiler(0.01, registry=reg,
+                             include=("tpuflow-lane", "tpuflow-prep"))
+        _sample_n(p, 10)
+        families = {f.name: f for f in reg.collect()}
+        samples = families["tpuflow_obs_profiler_samples_total"].collect()
+        assert samples and samples[0][2] == 20.0  # 10 ticks x 2 threads
+        overhead = families["tpuflow_obs_profiler_overhead_seconds_total"]
+        assert overhead.collect()[0][2] > 0.0
+        assert families["tpuflow_obs_profiler_stacks"].collect()[0][2] >= 1.0
+
+    def test_bounded_stacks_overflow(self):
+        p = SamplingProfiler(0.01, max_stacks=3)
+        with p._lock:
+            for i in range(10):
+                p._ingest_locked("batcher", f"mod:f{i}", False, 1)
+        snap = p.snapshot()
+        assert snap["dropped_stacks"] == 7
+        stacks = {r["stack"]: r["count"] for r in snap["stacks"]}
+        assert stacks["<overflow>"] == 7
+        # Bound holds (+1 overflow bucket); component totals are exact.
+        assert len(snap["stacks"]) == 4
+        assert snap["components"]["batcher"]["samples"] == 10
+
+    def test_sampler_thread_start_stop(self, workload):
+        p = SamplingProfiler(0.005, include=("tpuflow-lane",))
+        p.start()
+        deadline = time.monotonic() + 5.0
+        while p.snapshot()["ticks"] < 5 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        p.stop()
+        snap = p.snapshot()
+        assert snap["ticks"] >= 5
+        # The sampler never samples itself.
+        assert all("tpuflow-obs-profiler" not in r["stack"]
+                   for r in snap["stacks"])
+
+    def test_render_profile_and_folded(self, workload):
+        p = SamplingProfiler(0.01, include=("tpuflow-lane", "tpuflow-prep"))
+        _sample_n(p, 10)
+        snap = p.snapshot()
+        text = render_profile(snap, top=5)
+        assert "batcher" in text and "busy-share" in text
+        assert "burn" in text  # top busy frame names the burner
+        folded = render_folded(snap)
+        line = folded.splitlines()[0]
+        assert line.startswith(("batcher;", "serving;"))
+        assert line.rsplit(" ", 1)[1].isdigit()
+
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError, match="interval_s"):
+            SamplingProfiler(0.0)
+        with pytest.raises(ValueError, match="max_stacks"):
+            SamplingProfiler(0.01, max_stacks=0)
+
+
+def _snap(components, stacks=(), **over):
+    total_busy = sum(b for _, b in components.values()) or 1
+    doc = {
+        "schema": "tpuflow.obs.profile/v1",
+        "started_unix": 1.0, "captured_unix": 2.0, "interval_s": 0.05,
+        "ticks": 10, "thread_samples": 20, "dropped_stacks": 0,
+        "overhead_s": 0.001,
+        "components": {
+            name: {"samples": s, "busy": b, "share": round(b / total_busy, 6)}
+            for name, (s, b) in components.items()
+        },
+        "stacks": [
+            {"component": c, "stack": st, "count": n, "idle": idle}
+            for c, st, n, idle in stacks
+        ],
+    }
+    doc.update(over)
+    return doc
+
+
+class TestMergeDiff:
+    def test_merge_sums_components_and_stacks(self):
+        a = _snap({"batcher": (10, 8)}, [("batcher", "m:f", 8, False)])
+        b = _snap({"batcher": (4, 2), "serving": (6, 1)},
+                  [("batcher", "m:f", 2, False), ("serving", "m:g", 1, False)])
+        m = merge_snapshots(a, b)
+        assert validate_snapshot(m) == []
+        assert m["components"]["batcher"] == {
+            "samples": 14, "busy": 10, "share": round(10 / 11, 6),
+        }
+        assert {(r["stack"], r["count"]) for r in m["stacks"]} == {
+            ("m:f", 10), ("m:g", 1),
+        }
+        assert m["ticks"] == 20
+
+    def test_diff_regression_verdict_deterministic(self):
+        base = _snap({"batcher": (10, 2), "serving": (10, 8)})
+        new = _snap({"batcher": (10, 8), "serving": (10, 2)})
+        verdict = diff_snapshots(base, new, threshold=0.05)
+        assert verdict["verdict"] == "regression"
+        assert verdict["regressions"] == ["batcher"]
+        assert verdict["base_top"] == "serving"
+        assert verdict["new_top"] == "batcher"
+        row = verdict["components"][0]
+        assert row["component"] == "batcher"
+        assert row["delta"] == 0.6
+        # Same inputs, same verdict — byte-for-byte.
+        assert diff_snapshots(base, new, threshold=0.05) == verdict
+
+    def test_diff_ok_within_threshold(self):
+        base = _snap({"batcher": (10, 5), "serving": (10, 5)})
+        new = _snap({"batcher": (10, 52), "serving": (10, 48)})
+        verdict = diff_snapshots(base, new, threshold=0.05)
+        assert verdict["verdict"] == "ok"
+        assert verdict["regressions"] == []
+
+    def test_diff_rejects_invalid_snapshot(self):
+        with pytest.raises(ValueError, match="base"):
+            diff_snapshots({"schema": "nope"}, _snap({"batcher": (1, 1)}))
+
+    def test_load_snapshot_json_and_spill(self, tmp_path):
+        doc = _snap({"batcher": (3, 3)})
+        path = tmp_path / "snap.json"
+        path.write_text(json.dumps(doc))
+        assert load_snapshot(str(path))["components"] == doc["components"]
+        # A spill holds cumulative snapshots; the LAST one wins.
+        spill = tmp_path / "spill.jsonl"
+        older = _snap({"batcher": (1, 1)})
+        with spill.open("w") as fh:
+            fh.write(json.dumps({"event": "profile_snapshot", "snapshot": older}) + "\n")
+            fh.write("{torn json\n")
+            fh.write(json.dumps({"event": "profile_snapshot", "snapshot": doc}) + "\n")
+        assert load_snapshot(str(spill))["thread_samples"] == 20
+        empty = tmp_path / "none.jsonl"
+        empty.write_text(json.dumps({"event": "history_sample"}) + "\n")
+        with pytest.raises(ValueError, match="no profile_snapshot"):
+            load_snapshot(str(empty))
+
+    def test_spill_written_on_stop(self, tmp_path, workload):
+        spill = tmp_path / "prof.jsonl"
+        p = SamplingProfiler(
+            0.01, include=("tpuflow-lane",), spill_path=str(spill),
+        )
+        p.start()
+        time.sleep(0.05)
+        p.stop()
+        snap = load_snapshot(str(spill))
+        assert validate_snapshot(snap) == []
+        assert snap["ticks"] >= 1
+
+
+class TestFlightRecorder:
+    def _wired(self, tmp_path, clock=None):
+        reg = Registry()
+        counter = reg.counter("requests_total", "requests")
+        counter.inc(5)
+        hist = MetricsHistory(reg)
+        prof = SamplingProfiler(0.01)
+        prof.sample()
+        rec = FlightRecorder(
+            str(tmp_path / "flight"),
+            history=hist, profiler=prof, registry=reg,
+            min_interval_s=30.0, max_bundles=2,
+            clock=clock or time.monotonic,
+        )
+        return rec, hist, reg
+
+    def test_capture_bundle_schema_valid(self, tmp_path, workload):
+        rec, _, _ = self._wired(tmp_path)
+        name = rec.capture("manual", reason="unit test", force=True)
+        assert name is not None and name.endswith("-manual.json")
+        doc = rec.load(name)
+        assert validate_bundle(doc) == []
+        assert doc["trigger"] == "manual"
+        assert doc["reason"] == "unit test"
+        thread_names = {t["name"] for t in doc["threads"]}
+        assert "tpuflow-lane-t" in thread_names
+        assert doc["profile"]["schema"] == "tpuflow.obs.profile/v1"
+        assert "python" in doc["env"] and "knobs" in doc["env"]
+        assert "tpuflow_requests_total" in doc["registry"]
+
+    def test_alert_transition_triggers_capture(self, tmp_path):
+        rec, hist, reg = self._wired(tmp_path)
+        engine = AlertEngine(hist, [{
+            "name": "too_many", "metric": "requests_total",
+            "query": "latest", "op": ">", "threshold": 1.0, "for_s": 0.0,
+        }], registry=reg)
+        rec.attach(engine)
+        hist.sample()
+        engine.evaluate()
+        names = rec.list_bundles()
+        assert len(names) == 1
+        doc = rec.load(names[0])
+        assert doc["trigger"] == "alert"
+        assert doc["rule"] == "too_many"
+        assert "too_many" in doc["reason"]
+        # The rule-relevant history window rides along.
+        series = doc["history"]["series"]["requests_total"]
+        assert series["points"]
+        # Alerts state shows the rule firing.
+        states = {r["name"]: r["state"] for r in doc["alerts"]["rules"]}
+        assert states["too_many"] == "firing"
+
+    def test_rate_limit_and_force(self, tmp_path):
+        t = [0.0]
+        rec, _, reg = self._wired(tmp_path, clock=lambda: t[0])
+        assert rec.capture("manual") is not None
+        assert rec.capture("manual") is None  # inside min_interval_s
+        assert rec.capture("crash", force=True) is not None
+        t[0] = 31.0
+        assert rec.capture("manual") is not None
+        families = {f.name: f for f in reg.collect()}
+        suppressed = families["tpuflow_obs_flight_suppressed_total"]
+        assert suppressed.collect()[0][2] == 1.0
+        bundles = families["tpuflow_obs_flight_bundles_total"].collect()
+        assert {(lbl["trigger"], v) for _, lbl, v in bundles} == {
+            ("manual", 2.0), ("crash", 1.0),
+        }
+
+    def test_retention_keeps_newest(self, tmp_path):
+        t = [0.0]
+        rec, _, _ = self._wired(tmp_path, clock=lambda: t[0])
+        kept = []
+        for i in range(4):
+            t[0] = i * 60.0
+            kept.append(rec.capture("manual"))
+        names = rec.list_bundles()
+        assert names == sorted(kept[-2:])
+        root = str(tmp_path / "flight")
+        assert list_bundles(root) == names
+        assert validate_bundle(load_bundle(root, names[-1])) == []
+
+    def test_validate_bundle_problems(self):
+        assert validate_bundle("x") == ["bundle is not an object"]
+        problems = validate_bundle({"schema": "wrong"})
+        assert any("schema" in p for p in problems)
+        assert any("threads" in p for p in problems)
+        assert any("trigger" in p for p in problems)
+
+    def test_supervisor_failed_service_captures_crash_bundle(self, tmp_path):
+        from tpuflow.runtime.services import thread_service
+        from tpuflow.runtime.supervisor import RuntimeSupervisor
+
+        def _die(stop_event):
+            raise RuntimeError("synthetic death")
+
+        rec = FlightRecorder(str(tmp_path / "flight"), min_interval_s=0.0)
+        sup = RuntimeSupervisor(
+            [thread_service("doomed", _die, grace=1.0)],
+            registry=Registry(), probe_interval=0.02, flight=rec,
+        )
+        sup.start()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if sup.healthz()["services"]["doomed"]["state"] == "failed":
+                break
+            time.sleep(0.02)
+        sup.shutdown()
+        names = rec.list_bundles()
+        assert len(names) >= 1
+        doc = rec.load(names[0])
+        assert validate_bundle(doc) == []
+        assert doc["trigger"] == "crash"
+        assert "doomed" in doc["reason"]
+
+
+class TestEnvKnobs:
+    def test_profiler_from_env_off_by_default(self, monkeypatch):
+        monkeypatch.delenv("TPUFLOW_OBS_PROFILE", raising=False)
+        assert profiler_from_env() is None
+
+    def test_profiler_from_env_on(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("TPUFLOW_OBS_PROFILE", "1")
+        monkeypatch.setenv("TPUFLOW_OBS_PROFILE_INTERVAL_S", "0.02")
+        monkeypatch.setenv("TPUFLOW_OBS_PROFILE_MAX_STACKS", "64")
+        monkeypatch.setenv(
+            "TPUFLOW_OBS_PROFILE_SPILL", str(tmp_path / "p.jsonl")
+        )
+        p = profiler_from_env(include=("tpuflow-lane",))
+        assert p is not None
+        assert p.interval_s == 0.02
+        assert p.max_stacks == 64
+        assert p.include == ("tpuflow-lane",)
+        p.stop()
+
+    def test_flight_from_env_off_by_default(self, monkeypatch):
+        monkeypatch.delenv("TPUFLOW_OBS_FLIGHT", raising=False)
+        assert flight_from_env() is None
+
+    def test_flight_from_env_requires_dir(self, monkeypatch):
+        monkeypatch.setenv("TPUFLOW_OBS_FLIGHT", "1")
+        monkeypatch.delenv("TPUFLOW_OBS_FLIGHT_DIR", raising=False)
+        with pytest.raises(ValueError, match="TPUFLOW_OBS_FLIGHT_DIR"):
+            flight_from_env()
+
+    def test_flight_from_env_on(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("TPUFLOW_OBS_FLIGHT", "1")
+        monkeypatch.setenv("TPUFLOW_OBS_FLIGHT_DIR", str(tmp_path / "f"))
+        monkeypatch.setenv("TPUFLOW_OBS_FLIGHT_MIN_INTERVAL_S", "5")
+        monkeypatch.setenv("TPUFLOW_OBS_FLIGHT_MAX_BUNDLES", "3")
+        rec = flight_from_env()
+        assert rec is not None
+        assert rec.min_interval_s == 5.0
+        assert rec.max_bundles == 3
+
+    @pytest.mark.parametrize("var,value", [
+        ("TPUFLOW_OBS_PROFILE", "ture"),
+        ("TPUFLOW_OBS_PROFILE_INTERVAL_S", "fast"),
+        ("TPUFLOW_OBS_PROFILE_INTERVAL_S", "-1"),
+        ("TPUFLOW_OBS_PROFILE_INTERVAL_S", "inf"),
+        ("TPUFLOW_OBS_PROFILE_MAX_STACKS", "many"),
+        ("TPUFLOW_OBS_PROFILE_MAX_STACKS", "0"),
+        ("TPUFLOW_OBS_PROFILE_SPILL_EVERY_S", "often"),
+    ])
+    def test_malformed_profiler_knobs_name_the_variable(
+        self, monkeypatch, var, value,
+    ):
+        monkeypatch.setenv("TPUFLOW_OBS_PROFILE", "1")
+        monkeypatch.setenv(var, value)
+        with pytest.raises(ValueError, match=var):
+            profiler_from_env()
+
+    @pytest.mark.parametrize("var,value", [
+        ("TPUFLOW_OBS_FLIGHT", "maybe"),
+        ("TPUFLOW_OBS_FLIGHT_MIN_INTERVAL_S", "soon"),
+        ("TPUFLOW_OBS_FLIGHT_MIN_INTERVAL_S", "-2"),
+        ("TPUFLOW_OBS_FLIGHT_MAX_BUNDLES", "lots"),
+        ("TPUFLOW_OBS_FLIGHT_MAX_BUNDLES", "0"),
+    ])
+    def test_malformed_flight_knobs_name_the_variable(
+        self, monkeypatch, tmp_path, var, value,
+    ):
+        monkeypatch.setenv("TPUFLOW_OBS_FLIGHT", "1")
+        monkeypatch.setenv("TPUFLOW_OBS_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv(var, value)
+        with pytest.raises(ValueError, match=var):
+            flight_from_env()
+
+    def test_serve_alert_for_s_malformed(self, monkeypatch):
+        from tpuflow.utils.env import env_num
+
+        monkeypatch.setenv("TPUFLOW_SERVE_ALERT_FOR_S", "later")
+        with pytest.raises(ValueError, match="TPUFLOW_SERVE_ALERT_FOR_S"):
+            env_num("TPUFLOW_SERVE_ALERT_FOR_S", 15.0, float)

@@ -505,6 +505,68 @@ class TestMiniSoak:
         assert os.path.exists(report_path)
         assert json.load(open(report_path))["ok"] is True
 
+    def test_mini_soak_latency_storm_emits_flight_bundle(
+        self, tmp_path, monkeypatch
+    ):
+        """ISSUE 20 acceptance: with the profiling plane and flight
+        recorder armed, the seeded latency storm breaches the p99
+        alert and the firing transition captures at least one
+        schema-valid bundle whose profiler snapshot names the storm's
+        component — the micro-batcher lanes, where the serve.execute
+        delay faults sleep — as the top wall-clock consumer."""
+        from tpuflow.obs.flight import list_bundles, load_bundle, \
+            validate_bundle
+        from tpuflow.obs.profiler import top_component
+
+        flight_dir = str(tmp_path / "flight")
+        monkeypatch.setenv("TPUFLOW_OBS_PROFILE", "1")
+        monkeypatch.setenv("TPUFLOW_OBS_PROFILE_INTERVAL_S", "0.01")
+        monkeypatch.setenv("TPUFLOW_OBS_FLIGHT", "1")
+        monkeypatch.setenv("TPUFLOW_OBS_FLIGHT_DIR", flight_dir)
+        # Make the storm's 20 ms injected delays an SLO breach: p99
+        # target far below them, short confirmation window, and
+        # history ticks fast enough to see the breach while it lasts.
+        monkeypatch.setenv("TPUFLOW_SERVE_SLO_P99_MS", "5")
+        monkeypatch.setenv("TPUFLOW_SERVE_ALERT_FOR_S", "1")
+        monkeypatch.setenv("TPUFLOW_OBS_HISTORY_INTERVAL_S", "0.25")
+
+        spec = mini_soak_spec(str(tmp_path / "soak"))
+        # Harden the seeded latency storm: the stock 20 ms delays fire
+        # once per coalesced dispatch and lose the wall-clock race to
+        # per-request prep work; 50 ms at p=0.9 makes the batcher lanes
+        # the unambiguous top consumer the profiler must name.
+        spec["chaos"]["phases"][0]["faults"][2] = \
+            "serve.execute,p=0.9,mode=delay,delay=0.05"
+        result = run_soak(spec)
+        # The observability plane rides along without harming the
+        # soak's own acceptance.
+        assert result["ok"], {
+            k: result[k] for k in ("ok", "dropped", "card_error")
+        }
+        names = list_bundles(flight_dir)
+        assert names, "latency storm produced no flight bundle"
+        docs = [load_bundle(flight_dir, n) for n in names]
+        for doc in docs:
+            assert validate_bundle(doc) == []
+        alert_docs = [d for d in docs if d["trigger"] == "alert"]
+        assert alert_docs, "no bundle was captured by an alert firing"
+        # The black box names the culprit: the profiler snapshot inside
+        # at least one alert bundle ranks the batcher lanes (where the
+        # injected delays slept) as the top wall-clock consumer.
+        tops = {
+            top_component(d["profile"])
+            for d in alert_docs if d.get("profile")
+        }
+        assert "batcher" in tops, tops
+        # Every alert bundle carries the evidence chain: the firing
+        # rule, the rule-relevant history window, and live threads.
+        for doc in alert_docs:
+            assert doc["rule"]
+            assert doc["history"]["series"]
+            assert any(
+                t["component"] == "batcher" for t in doc["threads"]
+            )
+
 
 @pytest.mark.slow
 class TestFullSoak:

@@ -155,6 +155,13 @@ RULES = {
               "driven by a test's fake clock — pace the loop with "
               "stop_event.wait(interval) (interruptible, injectable) "
               "like the history sampler and the autoscaler do",
+    "TPF023": "threading.Thread(...) constructed without an explicit "
+              "name=: the sampling profiler (tpuflow/obs/profiler.py) "
+              "attributes wall-clock to components BY thread-name "
+              "prefix, so an anonymous Thread-N lands every sample in "
+              "'other' and the flight recorder's stack dumps lose "
+              "their subsystem labels. Name the thread with its "
+              "tpuflow-<subsystem> prefix",
 }
 
 _HOST_SYNC_NAMES = {"float", "bool"}
@@ -905,7 +912,29 @@ class _Linter(ast.NodeVisitor):
                         f"{ast.unparse(func)}(...) call",
                     )
         self._check_fault_site(node)
+        self._check_nameless_thread(node, func)
         self.generic_visit(node)
+
+    def _check_nameless_thread(self, node: ast.Call, func) -> None:
+        """TPF023: ``Thread(...)`` / ``threading.Thread(...)`` without an
+        explicit ``name=``. A ``**kwargs`` splat may carry the name, so
+        splatted constructions are not judged."""
+        is_thread = (
+            isinstance(func, ast.Name) and func.id == "Thread"
+        ) or (
+            isinstance(func, ast.Attribute)
+            and func.attr == "Thread"
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "threading"
+        )
+        if not is_thread:
+            return
+        if len(node.args) >= 3:  # Thread(group, target, name, ...)
+            return
+        for kw in node.keywords:
+            if kw.arg == "name" or kw.arg is None:  # name= or **splat
+                return
+        self._emit("TPF023", node, "Thread(...) constructed without name=")
 
     def _check_async_blocking(self, node: ast.Call, func) -> None:
         """TPF009: blocking-call shapes under an ``async def``."""

@@ -64,7 +64,7 @@ def prefetch(iterator: Iterable, buffer_size: int = 2) -> Iterator:
         "consumer arrivals that found the prefetch buffer empty",
     )
 
-    t = threading.Thread(target=worker, daemon=True)
+    t = threading.Thread(target=worker, name="tpuflow-data-prefetch", daemon=True)
     t.start()
     try:
         yielded = False

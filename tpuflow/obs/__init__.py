@@ -37,6 +37,14 @@ Plus the interpretation layer on top of the substrate:
   lifecycle into forensics/trail/``obs_alerts_firing`` gauges; the SLO
   objectives import as burn-rate rules
   (:func:`rules_from_objectives`).
+- ``profiler``   — :class:`SamplingProfiler`: stdlib wall-clock sampler
+  over ``sys._current_frames()``, folded stacks keyed by component
+  (thread-name derived), busy/idle split, snapshot merge/diff with
+  regression verdicts (``python -m tpuflow.obs profile``).
+- ``flight``     — :class:`FlightRecorder`: alert/crash-triggered
+  atomic forensic bundles (threads + profile + history window + alerts
+  + registry + env) through the storage seam
+  (``python -m tpuflow.obs flight``).
 """
 
 from tpuflow.obs.alerts import (
@@ -44,6 +52,7 @@ from tpuflow.obs.alerts import (
     rules_from_objectives,
     validate_rules,
 )
+from tpuflow.obs.flight import FlightRecorder, flight_from_env, validate_bundle
 from tpuflow.obs.forensics import (
     clear_events,
     dump_forensics,
@@ -69,6 +78,12 @@ from tpuflow.obs.metrics import (
     Summary,
     default_registry,
 )
+from tpuflow.obs.profiler import (
+    SamplingProfiler,
+    diff_snapshots,
+    merge_snapshots,
+    profiler_from_env,
+)
 from tpuflow.obs.prometheus import render_prometheus
 from tpuflow.obs.tracing import (
     TRACE_ENV,
@@ -87,6 +102,7 @@ __all__ = [
     "HEALTH_POLICIES",
     "AlertEngine",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsHistory",
@@ -94,15 +110,20 @@ __all__ = [
     "NumericsWatchdog",
     "RecompileDetector",
     "Registry",
+    "SamplingProfiler",
     "Summary",
     "TRACE_ENV",
     "clean_trace_id",
     "clear_events",
     "current_trace_id",
     "default_registry",
+    "diff_snapshots",
     "dump_forensics",
+    "flight_from_env",
     "install_compile_listener",
+    "merge_snapshots",
     "new_trace_id",
+    "profiler_from_env",
     "publish_roofline",
     "recent_events",
     "record_event",
@@ -112,5 +133,6 @@ __all__ = [
     "span",
     "trace_from_env",
     "use_trace",
+    "validate_bundle",
     "validate_rules",
 ]

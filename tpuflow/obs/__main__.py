@@ -9,6 +9,9 @@ Usage::
     python -m tpuflow.obs slo      <dir...> [--objectives F] [-o card.json]
     python -m tpuflow.obs history  <spill.jsonl|glob|dir> [...] [--metric M]
     python -m tpuflow.obs alerts   <spill.jsonl|glob|dir> [...] [--rules F]
+    python -m tpuflow.obs profile  <snap.json|spill.jsonl> [--top N] [--folded]
+    python -m tpuflow.obs profile  --diff BASE NEW [--threshold T]
+    python -m tpuflow.obs flight   <bundle-dir> [--inspect NAME] [--json]
 
 ``tail``/``summary`` read the JSONL event format every tpuflow sink
 writes — a training run's ``metrics.jsonl`` (``--metrics`` /
@@ -31,6 +34,15 @@ replays the same spill through an offline
 the committed SLO burn-rate rules with ``--slo``) and prints every
 firing/resolved transition — alerting forensics after the fact, same
 math as the live daemons.
+
+``profile`` renders a sampling-profiler snapshot (a JSON document or a
+``TPUFLOW_OBS_PROFILE_SPILL`` JSONL, latest record winning) as the
+component table + top-N busy frames, ``--folded`` flamegraph text, or
+``--json``; ``--diff BASE NEW`` compares two snapshots' busy-share per
+component and exits 1 on a ``regression`` verdict (CI gating). ``flight``
+lists the flight-recorder bundles under a storage root (newest last) and
+``--inspect`` pretty-prints one bundle: validation, per-component thread
+census, firing alerts, and the embedded profile's top components.
 
 ``fleet`` is the multi-process view (``tpuflow/obs/fleet.py``): discover
 every trail under one or more storage roots, merge them into ONE
@@ -385,6 +397,116 @@ def _replay_history_into(history, patterns: list[str]) -> tuple:
     return history, ticks, skipped
 
 
+def _profile(
+    files: list[str], diff: bool, threshold: float, top: int,
+    folded: bool, as_json: bool,
+) -> int:
+    from tpuflow.obs.profiler import (
+        diff_snapshots,
+        load_snapshot,
+        render_diff,
+        render_folded,
+        render_profile,
+    )
+
+    if diff:
+        if len(files) != 2:
+            raise ValueError("profile --diff takes exactly two snapshots: BASE NEW")
+        verdict = diff_snapshots(
+            load_snapshot(files[0]), load_snapshot(files[1]),
+            threshold=threshold,
+        )
+        print(json.dumps(verdict, indent=2) if as_json else render_diff(verdict))
+        return 1 if verdict["verdict"] == "regression" else 0
+    if len(files) == 1:
+        snap = load_snapshot(files[0])
+    else:
+        from tpuflow.obs.profiler import merge_snapshots
+
+        snap = load_snapshot(files[0])
+        for path in files[1:]:
+            snap = merge_snapshots(snap, load_snapshot(path))
+    if as_json:
+        print(json.dumps(snap, indent=2))
+    elif folded:
+        print(render_folded(snap))
+    else:
+        print(render_profile(snap, top=top))
+    if not snap.get("thread_samples"):
+        print("snapshot holds no samples", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _flight(root: str, inspect: str | None, as_json: bool) -> int:
+    from tpuflow.obs.flight import list_bundles, load_bundle, validate_bundle
+    from tpuflow.obs.profiler import top_component
+
+    if inspect:
+        doc = load_bundle(root, inspect)
+        problems = validate_bundle(doc)
+        if as_json:
+            print(json.dumps({"bundle": inspect, "problems": problems,
+                              "doc": doc}, indent=2, default=str))
+        else:
+            print(f"{inspect}: trigger={doc.get('trigger')} "
+                  f"rule={doc.get('rule')} reason={doc.get('reason')!r}")
+            by_comp: dict[str, int] = {}
+            for row in doc.get("threads", []) or []:
+                c = row.get("component", "?")
+                by_comp[c] = by_comp.get(c, 0) + 1
+            print("  threads: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(by_comp.items())
+            ))
+            alerts = doc.get("alerts") or {}
+            firing = [r["name"] for r in alerts.get("rules", [])
+                      if r.get("state") == "firing"]
+            print(f"  alerts firing: {firing}")
+            profile = doc.get("profile")
+            if profile:
+                comps = sorted(
+                    profile.get("components", {}).items(),
+                    key=lambda kv: (-kv[1].get("busy", 0), kv[0]),
+                )
+                print(f"  profile top: {top_component(profile)} ("
+                      + ", ".join(
+                          f"{k}:{v.get('share', 0.0):.0%}" for k, v in comps[:4]
+                      ) + ")")
+            history = doc.get("history") or {}
+            for name, series in (history.get("series") or {}).items():
+                print(f"  history[{name}]: {len(series.get('points', []))} "
+                      f"points over {series.get('window_s')}s")
+            if problems:
+                print("  INVALID: " + "; ".join(problems))
+        if problems:
+            print(f"{inspect}: schema-invalid bundle", file=sys.stderr)
+            return 2
+        return 0
+    names = list_bundles(root)
+    if as_json:
+        rows = []
+        for name in names:
+            doc = load_bundle(root, name)
+            rows.append({
+                "bundle": name,
+                "trigger": doc.get("trigger"),
+                "rule": doc.get("rule"),
+                "captured_unix": doc.get("captured_unix"),
+                "valid": not validate_bundle(doc),
+            })
+        print(json.dumps({"root": root, "bundles": rows}, indent=2))
+    else:
+        for name in names:
+            doc = load_bundle(root, name)
+            valid = "ok" if not validate_bundle(doc) else "INVALID"
+            print(f"{name}  trigger={doc.get('trigger')} "
+                  f"rule={doc.get('rule')} [{valid}]")
+    if not names:
+        print(f"{root}: no flight bundles", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tpuflow.obs",
@@ -462,6 +584,39 @@ def main(argv: list[str] | None = None) -> int:
     p_alerts.add_argument("--fail-on-firing", action="store_true",
                           help="exit 1 if any rule is firing at the end "
                           "of the replay (CI gating)")
+    p_prof = sub.add_parser(
+        "profile",
+        help="render a sampling-profiler snapshot, or --diff two of "
+        "them (exit 1 on a regression verdict)",
+    )
+    p_prof.add_argument("file", nargs="+",
+                        help="snapshot JSON file(s) or profile spill "
+                        "JSONL(s); several merge into one view")
+    p_prof.add_argument("--diff", action="store_true",
+                        help="treat the two files as BASE NEW and emit "
+                        "the component-share regression verdict")
+    p_prof.add_argument("--threshold", type=float, default=0.05,
+                        metavar="FRAC",
+                        help="busy-share growth that counts as a "
+                        "regression (default 0.05)")
+    p_prof.add_argument("--top", type=int, default=15, metavar="N",
+                        help="rows in the self/cumulative frame table")
+    p_prof.add_argument("--folded", action="store_true",
+                        help="emit flamegraph-ready folded-stack text")
+    p_prof.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable output")
+    p_flight = sub.add_parser(
+        "flight",
+        help="list flight-recorder bundles under a storage root, or "
+        "--inspect one (validation + thread/alert/profile digest)",
+    )
+    p_flight.add_argument("root",
+                          help="bundle dir or storage URL "
+                          "(TPUFLOW_OBS_FLIGHT_DIR)")
+    p_flight.add_argument("--inspect", default=None, metavar="NAME",
+                          help="bundle name to pretty-print")
+    p_flight.add_argument("--json", action="store_true", dest="as_json",
+                          help="machine-readable output")
     args = ap.parse_args(argv)
     try:
         if args.cmd == "tail":
@@ -477,6 +632,11 @@ def main(argv: list[str] | None = None) -> int:
         if args.cmd == "alerts":
             return _alerts(args.file, args.rules, args.slo, args.as_json,
                            args.fail_on_firing)
+        if args.cmd == "profile":
+            return _profile(args.file, args.diff, args.threshold,
+                            args.top, args.folded, args.as_json)
+        if args.cmd == "flight":
+            return _flight(args.root, args.inspect, args.as_json)
         return _summary(args.file)
     except OSError as e:
         print(f"{e}", file=sys.stderr)

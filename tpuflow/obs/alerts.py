@@ -228,6 +228,7 @@ class AlertEngine:
         }
         self.max_transitions = int(max_transitions)
         self.transitions: list[dict] = []
+        self._listeners: list = []
         self._firing_gauge = self._transitions_total = None
         if registry is not None:
             self._firing_gauge = registry.gauge(
@@ -247,6 +248,12 @@ class AlertEngine:
 
     def _on_tick(self, now: float) -> None:
         self.evaluate(now)
+
+    def add_listener(self, fn) -> None:
+        """Subscribe to firing/resolved transition records. Listeners run
+        OUTSIDE the engine lock (same discipline as ``_emit``'s I/O) and
+        must not raise — this is the flight recorder's capture seam."""
+        self._listeners.append(fn)
 
     def _query(self, rule: dict, now: float):
         h, metric, labels = self.history, rule["metric"], rule["labels"]
@@ -353,6 +360,11 @@ class AlertEngine:
             )
         if self._transitions_total is not None:
             self._transitions_total.inc(rule=rec["rule"], state=rec["state"])
+        for fn in self._listeners:
+            try:
+                fn(rec)
+            except Exception:
+                pass
 
     def firing(self) -> list[str]:
         with self._lock:

@@ -1,0 +1,385 @@
+"""Alert-triggered flight recorder: atomic forensic bundles.
+
+When an :class:`~tpuflow.obs.alerts.AlertEngine` rule starts firing (or a
+supervised service is declared FAILED), the evidence that explains *why*
+is usually gone by the time anyone looks — threads have moved on, the
+history ring has rotated, the profiler keeps averaging the spike away.
+The recorder captures one **bundle** at that instant: an all-thread stack
+dump, the profiler snapshot, the rule-relevant :class:`MetricsHistory`
+window, the trail tail, alerts state, a registry snapshot, and an
+env/knob fingerprint — written in a single ``put_atomic`` through the
+storage seam under manifest schema ``tpuflow.obs.flight/v1`` so a
+concurrent ``obs flight`` reader never sees a torn bundle.
+
+Captures are rate-limited (``min_interval_s``; a crash capture passes
+``force=True`` — crashes are rare and must never be suppressed by alert
+chatter) and retention-bounded (``max_bundles`` newest kept; bundle names
+sort by capture time). Everything is off by default; ``flight_from_env``
+wires the ``TPUFLOW_OBS_FLIGHT_*`` knobs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+
+from tpuflow.storage import join_key, resolve_store
+from tpuflow.utils.env import env_flag, env_num
+
+SCHEMA = "tpuflow.obs.flight/v1"
+
+DEFAULT_MIN_INTERVAL_S = 30.0
+DEFAULT_MAX_BUNDLES = 8
+
+_TRAIL_TAIL_LINES = 64
+_FORENSICS_TAIL = 64
+_ENV_PREFIXES = ("TPUFLOW_", "JAX_", "XLA_", "BENCH_")
+
+
+def _thread_dump() -> list[dict]:
+    from tpuflow.obs.profiler import component_for
+
+    names = {}
+    for t in threading.enumerate():
+        if t.ident is not None:
+            names[t.ident] = (t.name, t.daemon)
+    me = threading.get_ident()
+    rows = []
+    for ident, frame in sys._current_frames().items():
+        name, daemon = names.get(ident, (f"thread-{ident}", True))
+        rows.append(
+            {
+                "name": name,
+                "ident": ident,
+                "daemon": daemon,
+                "component": component_for(name),
+                "current": ident == me,
+                "stack": [
+                    {"file": fs.filename, "line": fs.lineno, "func": fs.name}
+                    for fs in traceback.extract_stack(frame)
+                ],
+            }
+        )
+    rows.sort(key=lambda r: (r["name"], r["ident"]))
+    return rows
+
+
+def _env_fingerprint() -> dict:
+    return {
+        "python": sys.version.split()[0],
+        "platform": sys.platform,
+        "pid": os.getpid(),
+        "argv": list(sys.argv),
+        "knobs": {
+            k: os.environ[k]
+            for k in sorted(os.environ)
+            if k.startswith(_ENV_PREFIXES)
+        },
+    }
+
+
+def _tail_lines(path: str | None, n: int) -> list[str]:
+    if not path:
+        return []
+    try:
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            return [line.rstrip("\n") for line in fh][-n:]
+    except OSError:
+        return []
+
+
+class FlightRecorder:
+    """Capture forensic bundles into ``root`` through the storage seam.
+
+    All wiring is optional — a recorder with nothing but a root still
+    produces a useful bundle (threads + env + forensics tail). ``attach``
+    subscribes it to an engine's transitions; the supervisor calls
+    ``capture("crash", ..., force=True)`` directly.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        *,
+        history=None,
+        profiler=None,
+        alerts=None,
+        registry=None,
+        logger=None,
+        min_interval_s: float = DEFAULT_MIN_INTERVAL_S,
+        max_bundles: int = DEFAULT_MAX_BUNDLES,
+        clock=time.monotonic,
+    ):
+        if max_bundles < 1:
+            raise ValueError(f"max_bundles must be >= 1, got {max_bundles!r}")
+        self.root = root
+        self.history = history
+        self.profiler = profiler
+        self.alerts = alerts
+        self.registry = registry
+        self.logger = logger
+        self.min_interval_s = float(min_interval_s)
+        self.max_bundles = int(max_bundles)
+        self.clock = clock
+        self._store, self._prefix = resolve_store(root)
+        self._lock = threading.Lock()
+        self._last_capture: float | None = None
+        self._seq = 0
+        self._m_bundles = self._m_suppressed = None
+        if registry is not None:
+            self._m_bundles = registry.counter(
+                "obs_flight_bundles_total",
+                "Flight-recorder bundles captured, by trigger",
+            )
+            self._m_suppressed = registry.counter(
+                "obs_flight_suppressed_total",
+                "Flight captures suppressed by the rate limit",
+            )
+
+    def attach(self, alerts) -> "FlightRecorder":
+        """Subscribe to an AlertEngine: every ``firing`` transition
+        becomes a (rate-limited) capture."""
+        self.alerts = alerts
+        alerts.add_listener(self._on_transition)
+        return self
+
+    def _on_transition(self, rec: dict) -> None:
+        if rec.get("state") != "firing":
+            return
+        self.capture(
+            "alert",
+            reason=(
+                f"rule {rec.get('rule')} firing: {rec.get('metric')}"
+                f"={rec.get('value')} vs {rec.get('threshold')}"
+            ),
+            rule_name=rec.get("rule"),
+        )
+
+    # -- capture --------------------------------------------------------
+
+    def capture(
+        self,
+        trigger: str,
+        *,
+        reason: str = "",
+        rule_name: str | None = None,
+        force: bool = False,
+    ) -> str | None:
+        """Capture one bundle; returns its key suffix (bundle name) or
+        None when rate-limited or the write failed. Never raises — the
+        recorder must not take down the plane it is documenting."""
+        now = self.clock()
+        with self._lock:
+            if (
+                not force
+                and self._last_capture is not None
+                and now - self._last_capture < self.min_interval_s
+            ):
+                if self._m_suppressed is not None:
+                    self._m_suppressed.inc()
+                return None
+            self._last_capture = now
+            self._seq += 1
+            seq = self._seq
+        try:
+            doc = self._build(trigger, reason, rule_name)
+            name = (
+                f"bundle-{int(doc['captured_unix'] * 1000):013d}"
+                f"-{os.getpid()}-{seq:03d}-{trigger}.json"
+            )
+            data = json.dumps(doc, default=str, sort_keys=True).encode("utf-8")
+            self._store.put_atomic(join_key(self._prefix, name), data)
+            self._enforce_retention()
+        except Exception:
+            return None
+        if self._m_bundles is not None:
+            self._m_bundles.inc(trigger=trigger)
+        try:
+            from tpuflow.obs.forensics import record_event
+
+            record_event("flight_capture", bundle=name, trigger=trigger, reason=reason)
+        except Exception:
+            pass
+        if self.logger is not None:
+            try:
+                self.logger.write(
+                    "flight_capture", bundle=name, trigger=trigger, reason=reason
+                )
+            except Exception:
+                pass
+        return name
+
+    def _build(self, trigger: str, reason: str, rule_name: str | None) -> dict:
+        from tpuflow.obs.forensics import recent_events
+
+        doc = {
+            "schema": SCHEMA,
+            "trigger": trigger,
+            "reason": reason,
+            "rule": rule_name,
+            "captured_unix": time.time(),
+            "threads": _thread_dump(),
+            "env": _env_fingerprint(),
+            "forensics_tail": recent_events(_FORENSICS_TAIL),
+            "trail_tail": _tail_lines(
+                getattr(self.logger, "path", None), _TRAIL_TAIL_LINES
+            ),
+        }
+        if self.profiler is not None:
+            try:
+                doc["profile"] = self.profiler.snapshot()
+            except Exception:
+                doc["profile"] = None
+        if self.alerts is not None:
+            try:
+                doc["alerts"] = self.alerts.summary()
+            except Exception:
+                doc["alerts"] = None
+        if self.registry is not None:
+            try:
+                doc["registry"] = {
+                    fam.name: {
+                        "kind": fam.kind,
+                        "samples": [
+                            [suffix, labels, value]
+                            for suffix, labels, value in fam.collect()
+                        ],
+                    }
+                    for fam in self.registry.collect()
+                }
+            except Exception:
+                doc["registry"] = None
+        if self.history is not None:
+            doc["history"] = self._history_window(rule_name)
+        return doc
+
+    def _history_window(self, rule_name: str | None) -> dict | None:
+        try:
+            out = {"summary": self.history.summary(), "series": {}}
+            rule = None
+            if self.alerts is not None and rule_name:
+                for r in self.alerts.rules:
+                    if r["name"] == rule_name:
+                        rule = r
+                        break
+            if rule is not None:
+                window = 2 * rule["window_s"] + rule["for_s"]
+                pts = self.history.points(
+                    rule["metric"], window, **rule["labels"]
+                )
+                out["series"][rule["metric"]] = {
+                    "labels": rule["labels"],
+                    "window_s": window,
+                    "points": [[t, v] for t, v in pts],
+                }
+            return out
+        except Exception:
+            return None
+
+    # -- retention / access ---------------------------------------------
+
+    def _enforce_retention(self) -> None:
+        names = self.list_bundles()
+        for name in names[: -self.max_bundles]:
+            try:
+                self._store.delete(join_key(self._prefix, name))
+            except Exception:
+                pass
+
+    def list_bundles(self) -> list[str]:
+        """Bundle names, oldest first (names embed capture time)."""
+        prefix = join_key(self._prefix, "bundle-")
+        return sorted(
+            key.rsplit("/", 1)[-1] for key in self._store.list(prefix)
+        )
+
+    def load(self, name: str) -> dict:
+        return json.loads(
+            self._store.get(join_key(self._prefix, name)).decode("utf-8")
+        )
+
+
+def validate_bundle(doc) -> list[str]:
+    """Structural check for a flight bundle; empty list == schema-valid."""
+    problems: list[str] = []
+    if not isinstance(doc, dict):
+        return ["bundle is not an object"]
+    if doc.get("schema") != SCHEMA:
+        problems.append(f"schema is {doc.get('schema')!r}, want {SCHEMA!r}")
+    if not isinstance(doc.get("trigger"), str) or not doc.get("trigger"):
+        problems.append("trigger missing or not a string")
+    if not isinstance(doc.get("captured_unix"), (int, float)):
+        problems.append("captured_unix missing or not a number")
+    threads = doc.get("threads")
+    if not isinstance(threads, list) or not threads:
+        problems.append("threads missing or empty")
+    else:
+        for i, row in enumerate(threads):
+            if not isinstance(row, dict) or not {"name", "component", "stack"} <= set(row):
+                problems.append(f"threads[{i}] malformed")
+                break
+    if not isinstance(doc.get("env"), dict) or "knobs" not in doc.get("env", {}):
+        problems.append("env fingerprint missing")
+    if "profile" in doc and doc["profile"] is not None:
+        from tpuflow.obs.profiler import validate_snapshot
+
+        problems.extend(
+            f"profile: {p}" for p in validate_snapshot(doc["profile"])
+        )
+    return problems
+
+
+def list_bundles(root: str) -> list[str]:
+    """Bundle names under ``root``, oldest first (CLI helper)."""
+    store, prefix = resolve_store(root)
+    return sorted(
+        key.rsplit("/", 1)[-1]
+        for key in store.list(join_key(prefix, "bundle-"))
+    )
+
+
+def load_bundle(root: str, name: str) -> dict:
+    store, prefix = resolve_store(root)
+    return json.loads(store.get(join_key(prefix, name)).decode("utf-8"))
+
+
+def flight_from_env(
+    *,
+    default_root: str | None = None,
+    history=None,
+    profiler=None,
+    alerts=None,
+    registry=None,
+    logger=None,
+) -> FlightRecorder | None:
+    """Build a recorder from ``TPUFLOW_OBS_FLIGHT_*`` knobs; None when off.
+
+    ``TPUFLOW_OBS_FLIGHT_DIR`` (or ``default_root``) names the bundle
+    store — enabling the recorder without a destination is a config
+    error and fails loud."""
+    if not env_flag("TPUFLOW_OBS_FLIGHT", False):
+        return None
+    root = os.environ.get("TPUFLOW_OBS_FLIGHT_DIR") or default_root
+    if not root:
+        raise ValueError(
+            "TPUFLOW_OBS_FLIGHT=1 requires TPUFLOW_OBS_FLIGHT_DIR=<dir-or-url> "
+            "(where should bundles go?)"
+        )
+    return FlightRecorder(
+        root,
+        history=history,
+        profiler=profiler,
+        alerts=alerts,
+        registry=registry,
+        logger=logger,
+        min_interval_s=env_num(
+            "TPUFLOW_OBS_FLIGHT_MIN_INTERVAL_S", DEFAULT_MIN_INTERVAL_S, float, minimum=0.0
+        ),
+        max_bundles=env_num(
+            "TPUFLOW_OBS_FLIGHT_MAX_BUNDLES", DEFAULT_MAX_BUNDLES, int, minimum=1
+        ),
+    )

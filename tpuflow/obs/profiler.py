@@ -1,0 +1,557 @@
+"""Continuous wall-clock sampling profiler (stdlib-only).
+
+A daemon thread walks ``sys._current_frames()`` on a fixed cadence and
+aggregates **folded stacks** (``module:function`` frames joined with ``;``)
+keyed by *component* — derived from thread names, which is why every
+thread in the package carries an explicit ``tpuflow-*`` name (TPF023).
+Samples are classified **busy** vs **idle** by the leaf Python frame: a
+thread parked in a wait primitive (``threading``, ``queue``, ``selectors``,
+``socket``, ``asyncio`` …) is idle; everything else — including
+``time.sleep``, whose Python-visible leaf is the *caller* — counts as
+busy wall-clock. Component shares and regression verdicts rank by busy
+samples so parked worker pools do not drown out the thread that is
+actually burning the budget.
+
+The aggregate is bounded (``max_stacks`` distinct folded stacks; overflow
+is counted, never grows memory), snapshots are plain JSON documents under
+schema ``tpuflow.obs.profile/v1``, and two snapshots can be ``merge``d or
+``diff``ed — the diff emits a deterministic per-component share delta and
+an overall ``regression``/``ok`` verdict used by ``obs profile --diff``.
+Cumulative snapshots can be spilled as JSONL through
+:class:`tpuflow.utils.logging.MetricsLogger` (latest record wins on
+replay).
+
+Everything is off by default; ``profiler_from_env`` wires the
+``TPUFLOW_OBS_PROFILE_*`` knobs (validated via :mod:`tpuflow.utils.env`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+from tpuflow.utils.env import env_flag, env_num
+
+SNAPSHOT_SCHEMA = "tpuflow.obs.profile/v1"
+DIFF_SCHEMA = "tpuflow.obs.profile_diff/v1"
+
+DEFAULT_INTERVAL_S = 0.05
+DEFAULT_MAX_STACKS = 512
+DEFAULT_SPILL_EVERY_S = 30.0
+DEFAULT_DIFF_THRESHOLD = 0.05
+
+_MAX_FRAMES = 48
+_OVERFLOW_STACK = "<overflow>"
+
+# Thread-name prefix -> component, first match wins (ordered most-specific
+# first so "tpuflow-serve-autoscale" does not land in "serving").
+_COMPONENTS: tuple[tuple[str, str], ...] = (
+    ("tpuflow-serve-autoscale", "autoscaler"),
+    ("tpuflow-runtime-online", "online"),
+    ("tpuflow-runtime-gang", "gang"),
+    ("tpuflow-runtime-autoscale", "autoscaler"),
+    ("tpuflow-runtime-traffic", "traffic"),
+    ("tpuflow-runtime", "supervisor"),
+    ("tpuflow-online", "online"),
+    ("tpuflow-elastic", "gang"),
+    ("tpuflow-lane", "batcher"),
+    ("tpuflow-microbatch", "batcher"),
+    ("tpuflow-prep", "serving"),
+    ("tpuflow-serve", "serving"),
+    ("tpuflow-jobs", "jobs"),
+    ("tpuflow-data", "data"),
+    ("tpuflow-obs", "obs"),
+    ("tpuflow-soak", "traffic"),
+    ("MainThread", "main"),
+)
+
+# Leaf-frame modules that mean "parked, not burning wall-clock".
+_WAIT_MODULES = frozenset(
+    {
+        "threading",
+        "queue",
+        "selectors",
+        "socket",
+        "socketserver",
+        "ssl",
+        "subprocess",
+    }
+)
+_WAIT_PREFIXES = ("asyncio", "concurrent.futures", "multiprocessing")
+
+
+def component_for(thread_name: str) -> str:
+    """Map a thread name to its profiling component (``other`` if unknown)."""
+    for prefix, component in _COMPONENTS:
+        if thread_name.startswith(prefix):
+            return component
+    return "other"
+
+
+def _frame_module(frame) -> str:
+    mod = frame.f_globals.get("__name__")
+    if isinstance(mod, str) and mod:
+        return mod
+    base = os.path.basename(frame.f_code.co_filename)
+    return base[:-3] if base.endswith(".py") else base
+
+
+def _is_wait_module(module: str) -> bool:
+    top = module.split(".", 1)[0]
+    return top in _WAIT_MODULES or any(top == p.split(".")[0] for p in _WAIT_PREFIXES)
+
+
+def fold_frame(frame) -> tuple[str, bool]:
+    """Fold a frame chain into ``mod:func;…;leaf`` text plus an idle flag."""
+    parts: list[str] = []
+    leaf_module = ""
+    f = frame
+    while f is not None:
+        module = _frame_module(f)
+        if not leaf_module:
+            leaf_module = module
+        parts.append(f"{module}:{f.f_code.co_name}")
+        f = f.f_back
+    parts.reverse()
+    if len(parts) > _MAX_FRAMES:
+        parts = ["<truncated>"] + parts[-_MAX_FRAMES:]
+    return ";".join(parts), _is_wait_module(leaf_module)
+
+
+class SamplingProfiler:
+    """Wall-clock sampler over ``sys._current_frames()``.
+
+    ``include`` (thread-name prefixes) scopes sampling to one subsystem's
+    threads — essential when several planes share a process (the soak) and
+    a serving-side profile must not be dominated by training compute.
+    ``None`` samples every thread except the sampler itself.
+    """
+
+    def __init__(
+        self,
+        interval_s: float = DEFAULT_INTERVAL_S,
+        *,
+        max_stacks: int = DEFAULT_MAX_STACKS,
+        include: tuple[str, ...] | None = None,
+        registry=None,
+        spill_path: str | None = None,
+        spill_every_s: float = DEFAULT_SPILL_EVERY_S,
+    ):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s!r}")
+        if max_stacks < 1:
+            raise ValueError(f"max_stacks must be >= 1, got {max_stacks!r}")
+        self.interval_s = float(interval_s)
+        self.max_stacks = int(max_stacks)
+        self.include = tuple(include) if include is not None else None
+        self.spill_every_s = float(spill_every_s)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._started_unix = time.time()
+        # (component, folded) -> [count, idle]; bounded by max_stacks.
+        self._stacks: dict[tuple[str, str], list] = {}
+        # component -> [samples, busy]
+        self._components: dict[str, list] = {}
+        self._ticks = 0
+        self._thread_samples = 0
+        self._dropped = 0
+        self._overhead_s = 0.0
+        self._spill = None
+        if spill_path:
+            from tpuflow.utils.logging import MetricsLogger
+
+            self._spill = MetricsLogger(spill_path)
+        self._m_samples = None
+        self._m_stacks = None
+        self._m_dropped = None
+        self._m_overhead = None
+        if registry is not None:
+            self._m_samples = registry.counter(
+                "obs_profiler_samples_total",
+                "Thread samples aggregated by the sampling profiler",
+            )
+            self._m_stacks = registry.gauge(
+                "obs_profiler_stacks",
+                "Distinct folded stacks currently held by the profiler",
+            )
+            self._m_dropped = registry.counter(
+                "obs_profiler_dropped_stacks_total",
+                "Samples folded into the overflow bucket because max_stacks was hit",
+            )
+            self._m_overhead = registry.counter(
+                "obs_profiler_overhead_seconds_total",
+                "Wall-clock seconds the profiler spent walking frames",
+            )
+
+    # -- sampling -------------------------------------------------------
+
+    def start(self) -> "SamplingProfiler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="tpuflow-obs-profiler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+            self._thread = None
+        spill = self._spill
+        if spill is not None:
+            try:
+                spill.write("profile_snapshot", snapshot=self.snapshot())
+                spill.close()
+            except Exception:
+                pass
+            self._spill = None
+
+    def _run(self) -> None:
+        last_spill = time.monotonic()
+        while not self._stop.is_set():
+            try:
+                self.sample()
+            except Exception:
+                pass
+            if self._spill is not None and self.spill_every_s > 0:
+                now = time.monotonic()
+                if now - last_spill >= self.spill_every_s:
+                    last_spill = now
+                    try:
+                        self._spill.write("profile_snapshot", snapshot=self.snapshot())
+                    except Exception:
+                        pass
+            self._stop.wait(self.interval_s)
+
+    def sample(self) -> int:
+        """Take one sample pass; returns the number of threads sampled."""
+        t0 = time.perf_counter()
+        me = threading.get_ident()
+        names = {}
+        for t in threading.enumerate():
+            if t.ident is not None:
+                names[t.ident] = t.name
+        frames = sys._current_frames()
+        sampled = 0
+        batch: list[tuple[str, str, bool]] = []
+        for ident, frame in frames.items():
+            if ident == me:
+                continue
+            name = names.get(ident, f"thread-{ident}")
+            if name == "tpuflow-obs-profiler":
+                continue
+            if self.include is not None and not name.startswith(self.include):
+                continue
+            folded, idle = fold_frame(frame)
+            batch.append((component_for(name), folded, idle))
+            sampled += 1
+        del frames
+        with self._lock:
+            self._ticks += 1
+            self._thread_samples += sampled
+            for component, folded, idle in batch:
+                self._ingest_locked(component, folded, idle, 1)
+            stacks = len(self._stacks)
+        elapsed = time.perf_counter() - t0
+        with self._lock:
+            self._overhead_s += elapsed
+        if self._m_samples is not None:
+            self._m_samples.inc(sampled)
+            self._m_stacks.set(stacks)
+            self._m_overhead.inc(elapsed)
+        return sampled
+
+    def _ingest_locked(self, component: str, folded: str, idle: bool, n: int) -> None:
+        comp = self._components.setdefault(component, [0, 0])
+        comp[0] += n
+        if not idle:
+            comp[1] += n
+        key = (component, folded)
+        slot = self._stacks.get(key)
+        if slot is None and len(self._stacks) >= self.max_stacks:
+            # Bound hit: fold the sample into the per-component overflow
+            # bucket (may overshoot the bound by one entry per component).
+            self._dropped += n
+            if self._m_dropped is not None:
+                self._m_dropped.inc(n)
+            key = (component, _OVERFLOW_STACK)
+            idle = False
+            slot = self._stacks.get(key)
+        if slot is None:
+            slot = self._stacks.setdefault(key, [0, idle])
+        slot[0] += n
+
+    # -- snapshots ------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Cumulative state as a plain ``tpuflow.obs.profile/v1`` document."""
+        with self._lock:
+            stacks = [
+                {"component": c, "stack": s, "count": v[0], "idle": bool(v[1])}
+                for (c, s), v in self._stacks.items()
+            ]
+            components = {c: {"samples": v[0], "busy": v[1]} for c, v in self._components.items()}
+            doc = {
+                "schema": SNAPSHOT_SCHEMA,
+                "started_unix": self._started_unix,
+                "captured_unix": time.time(),
+                "interval_s": self.interval_s,
+                "ticks": self._ticks,
+                "thread_samples": self._thread_samples,
+                "dropped_stacks": self._dropped,
+                "overhead_s": round(self._overhead_s, 6),
+            }
+        total_busy = sum(v["busy"] for v in components.values())
+        for v in components.values():
+            v["share"] = round(v["busy"] / total_busy, 6) if total_busy else 0.0
+        stacks.sort(key=lambda r: (-r["count"], r["component"], r["stack"]))
+        doc["components"] = dict(sorted(components.items()))
+        doc["stacks"] = stacks
+        return doc
+
+
+def validate_snapshot(doc) -> list[str]:
+    """Structural check; returns a list of problems (empty == valid)."""
+    problems: list[str] = []
+    if not isinstance(doc, dict):
+        return ["snapshot is not an object"]
+    if doc.get("schema") != SNAPSHOT_SCHEMA:
+        problems.append(f"schema is {doc.get('schema')!r}, want {SNAPSHOT_SCHEMA!r}")
+    if not isinstance(doc.get("components"), dict):
+        problems.append("components missing or not an object")
+    if not isinstance(doc.get("stacks"), list):
+        problems.append("stacks missing or not a list")
+    else:
+        for i, rec in enumerate(doc["stacks"]):
+            if not isinstance(rec, dict) or not {"component", "stack", "count"} <= set(rec):
+                problems.append(f"stacks[{i}] malformed")
+                break
+    return problems
+
+
+def top_component(doc: dict) -> str | None:
+    """Component with the most *busy* wall-clock samples, or None if all idle."""
+    best, best_busy = None, 0
+    for name, rec in sorted((doc.get("components") or {}).items()):
+        busy = rec.get("busy", 0)
+        if busy > best_busy:
+            best, best_busy = name, busy
+    return best
+
+
+def merge_snapshots(a: dict, b: dict) -> dict:
+    """Sum two snapshots (same schema) into one."""
+    for doc in (a, b):
+        problems = validate_snapshot(doc)
+        if problems:
+            raise ValueError(f"cannot merge invalid snapshot: {problems[0]}")
+    components: dict[str, dict] = {}
+    for doc in (a, b):
+        for name, rec in doc["components"].items():
+            slot = components.setdefault(name, {"samples": 0, "busy": 0})
+            slot["samples"] += rec.get("samples", 0)
+            slot["busy"] += rec.get("busy", 0)
+    stacks: dict[tuple[str, str], dict] = {}
+    for doc in (a, b):
+        for rec in doc["stacks"]:
+            key = (rec["component"], rec["stack"])
+            slot = stacks.setdefault(
+                key,
+                {
+                    "component": rec["component"],
+                    "stack": rec["stack"],
+                    "count": 0,
+                    "idle": bool(rec.get("idle", False)),
+                },
+            )
+            slot["count"] += rec["count"]
+    total_busy = sum(v["busy"] for v in components.values())
+    for v in components.values():
+        v["share"] = round(v["busy"] / total_busy, 6) if total_busy else 0.0
+    merged_stacks = sorted(
+        stacks.values(), key=lambda r: (-r["count"], r["component"], r["stack"])
+    )
+    return {
+        "schema": SNAPSHOT_SCHEMA,
+        "started_unix": min(a.get("started_unix", 0), b.get("started_unix", 0)),
+        "captured_unix": max(a.get("captured_unix", 0), b.get("captured_unix", 0)),
+        "interval_s": a.get("interval_s"),
+        "ticks": a.get("ticks", 0) + b.get("ticks", 0),
+        "thread_samples": a.get("thread_samples", 0) + b.get("thread_samples", 0),
+        "dropped_stacks": a.get("dropped_stacks", 0) + b.get("dropped_stacks", 0),
+        "overhead_s": round(a.get("overhead_s", 0.0) + b.get("overhead_s", 0.0), 6),
+        "components": dict(sorted(components.items())),
+        "stacks": merged_stacks,
+    }
+
+
+def diff_snapshots(base: dict, new: dict, *, threshold: float = DEFAULT_DIFF_THRESHOLD) -> dict:
+    """Compare busy-share per component; verdict ``regression`` when any
+    component's share of busy wall-clock grew by more than ``threshold``."""
+    for label, doc in (("base", base), ("new", new)):
+        problems = validate_snapshot(doc)
+        if problems:
+            raise ValueError(f"{label} snapshot invalid: {problems[0]}")
+    names = sorted(set(base["components"]) | set(new["components"]))
+    rows = []
+    for name in names:
+        b = base["components"].get(name, {}).get("share", 0.0)
+        n = new["components"].get(name, {}).get("share", 0.0)
+        rows.append(
+            {
+                "component": name,
+                "base_share": round(b, 6),
+                "new_share": round(n, 6),
+                "delta": round(n - b, 6),
+            }
+        )
+    rows.sort(key=lambda r: (-r["delta"], r["component"]))
+    regressions = [r["component"] for r in rows if r["delta"] > threshold]
+    return {
+        "schema": DIFF_SCHEMA,
+        "threshold": threshold,
+        "base_top": top_component(base),
+        "new_top": top_component(new),
+        "components": rows,
+        "regressions": regressions,
+        "verdict": "regression" if regressions else "ok",
+    }
+
+
+# -- rendering ----------------------------------------------------------
+
+
+def render_folded(doc: dict) -> str:
+    """Flamegraph-ready folded-stack text (``component;frames count``)."""
+    lines = [f"{r['component']};{r['stack']} {r['count']}" for r in doc.get("stacks", [])]
+    return "\n".join(lines)
+
+
+def _frame_table(doc: dict, top: int) -> list[tuple[str, int, int]]:
+    self_counts: dict[str, int] = {}
+    cum_counts: dict[str, int] = {}
+    for rec in doc.get("stacks", []):
+        if rec.get("idle"):
+            continue
+        frames = rec["stack"].split(";")
+        count = rec["count"]
+        self_counts[frames[-1]] = self_counts.get(frames[-1], 0) + count
+        for frame in set(frames):
+            cum_counts[frame] = cum_counts.get(frame, 0) + count
+    rows = [
+        (frame, self_counts.get(frame, 0), cum)
+        for frame, cum in cum_counts.items()
+    ]
+    rows.sort(key=lambda r: (-r[1], -r[2], r[0]))
+    return rows[:top]
+
+
+def render_profile(doc: dict, *, top: int = 15) -> str:
+    """Human-readable component table + top-N self/cumulative frames."""
+    out = []
+    busy_total = sum(v.get("busy", 0) for v in doc.get("components", {}).values())
+    out.append(
+        f"profile  ticks={doc.get('ticks', 0)}  thread_samples={doc.get('thread_samples', 0)}"
+        f"  busy={busy_total}  interval={doc.get('interval_s')}s"
+        f"  overhead={doc.get('overhead_s', 0.0)}s  dropped={doc.get('dropped_stacks', 0)}"
+    )
+    out.append("")
+    out.append(f"{'component':<12} {'samples':>8} {'busy':>8} {'busy-share':>10}")
+    comps = sorted(
+        doc.get("components", {}).items(), key=lambda kv: (-kv[1].get("busy", 0), kv[0])
+    )
+    for name, rec in comps:
+        out.append(
+            f"{name:<12} {rec.get('samples', 0):>8} {rec.get('busy', 0):>8}"
+            f" {rec.get('share', 0.0):>9.1%}"
+        )
+    rows = _frame_table(doc, top)
+    if rows:
+        out.append("")
+        out.append(f"{'self':>8} {'cum':>8}  frame (busy samples, top {top})")
+        for frame, self_n, cum_n in rows:
+            out.append(f"{self_n:>8} {cum_n:>8}  {frame}")
+    return "\n".join(out)
+
+
+def render_diff(doc: dict) -> str:
+    out = [
+        f"profile diff  verdict={doc['verdict']}  threshold={doc['threshold']:.1%}"
+        f"  base_top={doc.get('base_top')}  new_top={doc.get('new_top')}"
+    ]
+    out.append("")
+    out.append(f"{'component':<12} {'base':>8} {'new':>8} {'delta':>8}")
+    for row in doc["components"]:
+        marker = "  << regression" if row["component"] in doc["regressions"] else ""
+        out.append(
+            f"{row['component']:<12} {row['base_share']:>7.1%} {row['new_share']:>7.1%}"
+            f" {row['delta']:>+7.1%}{marker}"
+        )
+    return "\n".join(out)
+
+
+def load_snapshot(path: str) -> dict:
+    """Load a snapshot from a JSON file or a JSONL spill (latest record wins)."""
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    text = text.strip()
+    if not text:
+        raise ValueError(f"{path}: empty file")
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict):
+        if doc.get("event") == "profile_snapshot":
+            snap = doc.get("snapshot")
+        elif "event" in doc:
+            # A one-record JSONL trail of some other event kind.
+            raise ValueError(f"{path}: no profile_snapshot records found")
+        else:
+            snap = doc
+        problems = validate_snapshot(snap)
+        if problems:
+            raise ValueError(f"{path}: {problems[0]}")
+        return snap
+    snap = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and rec.get("event") == "profile_snapshot":
+            candidate = rec.get("snapshot")
+            if isinstance(candidate, dict) and not validate_snapshot(candidate):
+                snap = candidate
+    if snap is None:
+        raise ValueError(f"{path}: no profile_snapshot records found")
+    return snap
+
+
+def profiler_from_env(
+    registry=None, *, include: tuple[str, ...] | None = None
+) -> SamplingProfiler | None:
+    """Build a profiler from ``TPUFLOW_OBS_PROFILE_*`` knobs; None when off."""
+    if not env_flag("TPUFLOW_OBS_PROFILE", False):
+        return None
+    return SamplingProfiler(
+        env_num("TPUFLOW_OBS_PROFILE_INTERVAL_S", DEFAULT_INTERVAL_S, float, minimum=1e-4),
+        max_stacks=env_num("TPUFLOW_OBS_PROFILE_MAX_STACKS", DEFAULT_MAX_STACKS, int, minimum=1),
+        include=include,
+        registry=registry,
+        spill_path=os.environ.get("TPUFLOW_OBS_PROFILE_SPILL") or None,
+        spill_every_s=env_num(
+            "TPUFLOW_OBS_PROFILE_SPILL_EVERY_S", DEFAULT_SPILL_EVERY_S, float, minimum=0.1
+        ),
+    )

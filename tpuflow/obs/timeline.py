@@ -37,6 +37,7 @@ _LANES = (
     ("elastic", 5, "elastic"),
     ("online", 6, "online"),
     ("drift", 6, "online"),
+    ("flight", 8, "obs"),
 )
 _TRAIN_TID, _OTHER_TID = 1, 9
 _AUTOTUNE_TID = 4
@@ -51,6 +52,10 @@ _INSTANT_EVENTS = {
     "artifact_swap", "artifact_rollback", "serve_reload",
     "elastic_worker_evicted", "elastic_worker_rejoined",
     "elastic_stale_push_rejected",
+    # Flight-recorder captures (tpuflow/obs/flight.py): an alert or
+    # crash froze a forensic bundle here — the mark names the bundle to
+    # open next to the spans around it.
+    "flight_capture",
 }
 _PID = 1
 

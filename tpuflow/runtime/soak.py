@@ -375,9 +375,16 @@ def run_soak(doc: dict) -> dict:
         "autoscale", _autoscale_run, depends_on=("serving",), grace=10.0,
     )
 
+    # Env-gated flight recorder for crash verdicts (TPUFLOW_OBS_FLIGHT):
+    # the serving daemon attaches its own alert-triggered recorder; this
+    # one covers the supervisor's FAILED path, same bundle dir.
+    from tpuflow.obs.flight import flight_from_env
+
+    flight = flight_from_env(default_root=os.path.join(root, "flight"))
     supervisor = RuntimeSupervisor(
         [gang, serving, autoscale, online, traffic],
         trail_path=os.path.join(root, "runtime-metrics.jsonl"),
+        flight=flight,
     )
     supervisor.start()
     healthz_port = supervisor.serve_healthz()

@@ -109,6 +109,7 @@ class RuntimeSupervisor:
         probe_interval: float = 0.25,
         trail_path: str | None = None,
         clock=time.monotonic,
+        flight=None,
     ):
         from tpuflow.obs import default_registry
 
@@ -143,6 +144,11 @@ class RuntimeSupervisor:
             from tpuflow.utils.logging import MetricsLogger
 
             self._trail = MetricsLogger(trail_path)
+        # Optional FlightRecorder (tpuflow/obs/flight.py): a service
+        # declared FAILED captures a forensic bundle at the moment of
+        # the verdict — forced past the alert rate limit, because crash
+        # verdicts are rare and each one deserves its evidence.
+        self._flight = flight
         # Every state gets a sample from the first scrape on — zeros,
         # not missing series, for the states nothing occupies yet.
         for state in STATES:
@@ -184,6 +190,13 @@ class RuntimeSupervisor:
             self._trail.write(
                 "runtime_service_state",
                 service=name, state=state, previous=old, detail=detail,
+            )
+        if state == FAILED and self._flight is not None:
+            self._flight.capture(
+                "crash",
+                reason=f"service {name} failed: {detail}" if detail
+                else f"service {name} failed",
+                force=True,
             )
         return True
 

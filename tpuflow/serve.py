@@ -282,7 +282,9 @@ class JobRunner:
             self._journal_file = self._flocked_append(journal_path)
             self._replay_journal(journal_path)
             self._compact_journal(journal_path)
-        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker = threading.Thread(
+            target=self._run, name="tpuflow-jobs", daemon=True
+        )
         self._worker.start()
 
     # ---- journal ----
@@ -1787,11 +1789,30 @@ def make_server(
     history.add_pre_sample(lambda: slo.evaluate_registry(registry))
     alerts = AlertEngine(
         history,
-        rules_from_objectives(serve_objectives(slo_objectives)),
+        rules_from_objectives(
+            serve_objectives(slo_objectives),
+            for_s=env_num("TPUFLOW_SERVE_ALERT_FOR_S", 15.0, float),
+        ),
         registry=registry,
         logger=trail,
     )
     alerts.attach()
+    # Profiling plane + flight recorder (tpuflow/obs/profiler.py,
+    # flight.py), env-gated off by default. The threaded daemon samples
+    # the whole process (its stdlib handler threads carry no tpuflow
+    # prefix to scope by); the recorder captures an atomic forensic
+    # bundle on every firing alert transition.
+    from tpuflow.obs.flight import flight_from_env
+    from tpuflow.obs.profiler import profiler_from_env
+
+    profiler = profiler_from_env(registry)
+    flight = flight_from_env(
+        history=history, profiler=profiler, registry=registry, logger=trail,
+    )
+    if flight is not None:
+        flight.attach(alerts)
+    if profiler is not None:
+        profiler.start()
     predictor = PredictService(
         batch_predicts=batch_predicts,
         batch_mode=batch_mode,
@@ -2019,11 +2040,20 @@ def make_server(
         # assume; 128 matches common server defaults.
         request_queue_size = 128
 
+        def shutdown(self):
+            # The profiler's sampler (and its spill) must stop with the
+            # daemon; everything else tears down in close_server paths.
+            if profiler is not None:
+                profiler.stop()
+            super().shutdown()
+
     server = Server((host, port), Handler)
     server.runner = runner  # for tests / callers
     server.predictor = predictor
     server.history = history
     server.alerts = alerts
+    server.profiler = profiler
+    server.flight = flight
     return server
 
 
@@ -2101,7 +2131,9 @@ def main(argv=None) -> int:
     )
 
     def _stop(signum, frame):
-        threading.Thread(target=server.shutdown, daemon=True).start()
+        threading.Thread(
+            target=server.shutdown, name="tpuflow-serve-shutdown", daemon=True,
+        ).start()
 
     signal.signal(signal.SIGTERM, _stop)
     signal.signal(signal.SIGINT, _stop)

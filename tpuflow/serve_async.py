@@ -468,11 +468,37 @@ class AsyncServer:
         )
         self.alerts = AlertEngine(
             self.history,
-            rules_from_objectives(serve_objectives(slo_objectives)),
+            rules_from_objectives(
+                serve_objectives(slo_objectives),
+                for_s=env_num("TPUFLOW_SERVE_ALERT_FOR_S", 15.0, float),
+            ),
             registry=self.registry,
             logger=self._trail,
         )
         self.alerts.attach()
+        # The profiling plane + flight recorder (tpuflow/obs/profiler.py,
+        # flight.py), both env-gated off by default. The profiler samples
+        # ONLY this daemon's thread families — in a shared process (the
+        # soak) a serving bundle must profile serving, not whatever the
+        # training gang is computing. The recorder subscribes to the
+        # alert engine above: every firing transition captures an atomic
+        # forensic bundle through the storage seam.
+        from tpuflow.obs.flight import flight_from_env
+        from tpuflow.obs.profiler import profiler_from_env
+
+        self.profiler = profiler_from_env(
+            self.registry,
+            include=("tpuflow-serve", "tpuflow-prep", "tpuflow-lane",
+                     "tpuflow-microbatch", "tpuflow-jobs"),
+        )
+        self.flight = flight_from_env(
+            history=self.history,
+            profiler=self.profiler,
+            registry=self.registry,
+            logger=self._trail,
+        )
+        if self.flight is not None:
+            self.flight.attach(self.alerts)
         # The SLO-driven autoscaler (tpuflow/serve_autoscale.py):
         # opt-in (flag/env), hill-climbs replicas/max_inflight/hedge/
         # drift threshold against the history's burn-rate lanes through
@@ -1236,6 +1262,8 @@ class AsyncServer:
         # here, so the sampler and the autoscaler start exactly once,
         # post-bind — never for a daemon that failed to boot.
         self.history.start()
+        if self.profiler is not None:
+            self.profiler.start()
         if self.autoscaler is not None:
             self.autoscaler.start()
         async with self._aserver:
@@ -1310,6 +1338,8 @@ class AsyncServer:
         # that is tearing down, and the sampler's spill closes cleanly.
         if self.autoscaler is not None:
             self.autoscaler.stop()
+        if self.profiler is not None:
+            self.profiler.stop()
         self.history.stop()
         loop = self._loop
         if loop is not None and not loop.is_closed():
@@ -1507,7 +1537,9 @@ def main(argv=None) -> int:
         return 2
 
     def _stop(signum, frame):
-        threading.Thread(target=server.shutdown, daemon=True).start()
+        threading.Thread(
+            target=server.shutdown, name="tpuflow-serve-shutdown", daemon=True,
+        ).start()
 
     signal.signal(signal.SIGTERM, _stop)
     signal.signal(signal.SIGINT, _stop)

@@ -17,15 +17,21 @@ from dataclasses import dataclass, field
 def trace(logdir: str):
     """Capture a device trace for the enclosed block.
 
-    View with TensorBoard's profile plugin or ui.perfetto.dev.
+    View with TensorBoard's profile plugin or ui.perfetto.dev. The block
+    also records an ``xla.profile`` span carrying the logdir, so device
+    captures are visible on the ``obs timeline``/``obs fleet`` xla lane
+    next to the flight-recorder marks they usually accompany.
     """
     import jax
 
-    jax.profiler.start_trace(logdir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+    from tpuflow.obs.tracing import span
+
+    with span("xla.profile", logdir=logdir):
+        jax.profiler.start_trace(logdir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
 
 
 @dataclass
